@@ -1,0 +1,148 @@
+"""Topology / runtime configuration.
+
+Parses the reference's JSON schema (/root/reference/config.json:1-18,
+parsed at node.py:222-277) — `nodes[].{id,address,part_index}`,
+`model_weights`, `num_parts`, `return_to_node_id` — and extends it with
+TPU-native keys. Unlike the reference, which hard-exits unless
+`num_parts == 2` (node.py:246-248), any num_parts supported by the model
+family is accepted.
+
+Extended keys (all optional, with reference-equivalent defaults):
+  model:           model-zoo name (default "cifar_cnn", the reference's only
+                   wired family — node.py:11,29-32)
+  device_type:     "tpu" | "cpu" (BASELINE.json north-star `device_type=tpu`
+                   dispatch)
+  runtime:         "spmd" (shard_map+ppermute pipeline) | "relay"
+                   (device-per-stage sequential relay, the reference's
+                   semantics) | "auto"
+  microbatches:    GPipe-style microbatching factor for the spmd runtime
+  dtype:           compute dtype ("float32" | "bfloat16")
+  mesh:            {axis_name: size} overrides for multi-axis runs
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Dict, List, Optional, Tuple
+
+
+@dataclasses.dataclass(frozen=True)
+class NodeEntry:
+    """One entry of config `nodes[]` (config.json:3-14). In the TPU runtime
+    a "node" maps to a pipeline-stage coordinate on the mesh rather than a
+    separate gRPC process; `address` is kept for the gRPC edge/serve mode."""
+
+    id: str
+    part_index: int
+    address: Optional[str] = None
+
+    @property
+    def port(self) -> Optional[int]:
+        # node.py:254-258 parses the port off "ip:port".
+        if not self.address:
+            return None
+        try:
+            return int(self.address.rsplit(":", 1)[-1])
+        except ValueError:
+            raise ValueError(
+                f"Invalid address '{self.address}' for node '{self.id}'; expected IP:Port"
+            ) from None
+
+
+@dataclasses.dataclass(frozen=True)
+class TopologyConfig:
+    nodes: Tuple[NodeEntry, ...]
+    num_parts: int
+    model_weights: Optional[str] = None
+    return_to_node_id: Optional[str] = None
+    model: str = "cifar_cnn"
+    device_type: str = "tpu"
+    runtime: str = "auto"
+    microbatches: int = 1
+    dtype: str = "float32"
+    mesh: Dict[str, int] = dataclasses.field(default_factory=dict)
+
+    # ---- construction ----------------------------------------------------
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "TopologyConfig":
+        raw_nodes: List[dict] = d.get("nodes", [])
+        nodes = tuple(
+            NodeEntry(id=n["id"], part_index=int(n["part_index"]), address=n.get("address"))
+            for n in raw_nodes
+        )
+        num_parts = d.get("num_parts")
+        if num_parts is None:
+            num_parts = len(nodes) if nodes else 1
+        cfg = cls(
+            nodes=nodes,
+            num_parts=int(num_parts),
+            model_weights=d.get("model_weights"),
+            return_to_node_id=d.get("return_to_node_id"),
+            model=d.get("model", "cifar_cnn"),
+            device_type=d.get("device_type", "tpu"),
+            runtime=d.get("runtime", "auto"),
+            microbatches=int(d.get("microbatches", 1)),
+            dtype=d.get("dtype", "float32"),
+            mesh=dict(d.get("mesh", {})),
+        )
+        cfg.validate()
+        return cfg
+
+    @classmethod
+    def from_json(cls, path: str) -> "TopologyConfig":
+        with open(path) as f:
+            return cls.from_dict(json.load(f))
+
+    def validate(self):
+        if self.num_parts < 1:
+            raise ValueError(f"num_parts must be >= 1, got {self.num_parts}")
+        if self.nodes:
+            part_indices = sorted(n.part_index for n in self.nodes)
+            if part_indices != list(range(self.num_parts)):
+                raise ValueError(
+                    "nodes[].part_index must cover exactly 0..num_parts-1; got "
+                    f"{part_indices} for num_parts={self.num_parts}"
+                )
+            ids = [n.id for n in self.nodes]
+            if len(set(ids)) != len(ids):
+                raise ValueError(f"duplicate node ids in config: {ids}")
+        if self.return_to_node_id and self.nodes:
+            if all(n.id != self.return_to_node_id for n in self.nodes):
+                raise ValueError(
+                    f"return_to_node_id '{self.return_to_node_id}' not among node ids"
+                )
+        if self.runtime not in ("auto", "spmd", "relay"):
+            raise ValueError(f"runtime must be auto|spmd|relay, got '{self.runtime}'")
+        if self.microbatches < 1:
+            raise ValueError("microbatches must be >= 1")
+
+    # ---- lookups (reference: node.py:234-277) ----------------------------
+
+    def node_by_id(self, node_id: str) -> NodeEntry:
+        for n in self.nodes:
+            if n.id == node_id:
+                return n
+        raise KeyError(f"Node ID '{node_id}' not found in config")
+
+    def node_by_part(self, part_index: int) -> NodeEntry:
+        for n in self.nodes:
+            if n.part_index == part_index:
+                return n
+        raise KeyError(f"No node with part_index {part_index} in config")
+
+    def next_node(self, node: NodeEntry) -> Optional[NodeEntry]:
+        """Next-hop resolution (node.py:262-271): the node owning
+        part_index+1, or None for the last stage."""
+        if node.part_index == self.num_parts - 1:
+            return None
+        return self.node_by_part(node.part_index + 1)
+
+    def return_node(self) -> Optional[NodeEntry]:
+        """The reference resolves `return_to_node_id` but never dials it
+        (dead code, node.py:272-277 / SURVEY §3.3); here it names the stage
+        coordinate that receives the final result ring-shifted back."""
+        if not self.return_to_node_id:
+            return None
+        return self.node_by_id(self.return_to_node_id)

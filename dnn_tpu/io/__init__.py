@@ -1,0 +1,18 @@
+from dnn_tpu.io.checkpoint import (
+    load_checkpoint,
+    load_pth_state_dict,
+    cifar_params_from_torch_state_dict,
+    gpt_params_from_state_dict,
+    save_npz,
+)
+from dnn_tpu.io.preprocess import load_image, dummy_image
+
+__all__ = [
+    "load_checkpoint",
+    "load_pth_state_dict",
+    "cifar_params_from_torch_state_dict",
+    "gpt_params_from_state_dict",
+    "save_npz",
+    "load_image",
+    "dummy_image",
+]

@@ -1,0 +1,326 @@
+"""Checkpoint loading and torch->JAX weight conversion.
+
+The reference loads one shared full-model `.pth` state dict on every node
+and keeps each node's slice via `load_state_dict(strict=False)`
+(/root/reference/node.py:294-317, path from config.json:15). The rebuild
+must do the same *without assuming torch exists on a TPU host* (SURVEY.md
+§5 "Checkpoint / resume"): `load_pth_state_dict` parses the torch zipfile
+serialization format directly (zip of a pickle program + raw storage blobs)
+with a restricted unpickler, and falls back to `torch.load` only if torch
+is importable and the file is in a legacy format.
+
+Also accepts `.npz` and `.safetensors` full-model checkpoints, and converts
+between torch layouts (NCHW conv / (out,in) linear / HF Conv1D) and this
+framework's TPU layouts (HWIO conv / (in,out) linear).
+"""
+
+from __future__ import annotations
+
+import io
+import os
+import pickle
+import zipfile
+from typing import Dict, Optional
+
+import numpy as np
+
+# ----------------------------------------------------------------------
+# torch-free .pth (zip serialization) reader
+# ----------------------------------------------------------------------
+
+_STORAGE_DTYPES = {
+    "FloatStorage": np.float32,
+    "DoubleStorage": np.float64,
+    "HalfStorage": np.float16,
+    "LongStorage": np.int64,
+    "IntStorage": np.int32,
+    "ShortStorage": np.int16,
+    "CharStorage": np.int8,
+    "ByteStorage": np.uint8,
+    "BoolStorage": np.bool_,
+}
+
+
+def _bfloat16_dtype():
+    import ml_dtypes
+
+    return np.dtype(ml_dtypes.bfloat16)
+
+
+class _StorageRef:
+    __slots__ = ("dtype", "key", "numel")
+
+    def __init__(self, dtype, key, numel):
+        self.dtype, self.key, self.numel = dtype, key, numel
+
+
+class _StorageType:
+    """Sentinel returned by find_class for torch.<T>Storage references."""
+
+    __slots__ = ("dtype",)
+
+    def __init__(self, dtype):
+        self.dtype = dtype
+
+
+def _rebuild_tensor(storage: np.ndarray, storage_offset, size, stride, *args, **kwargs):
+    size, stride = tuple(size), tuple(stride)
+    if not size:
+        # 0-d tensor (e.g. a saved step counter): keep it an ndarray so it
+        # survives _flatten_state_dict, matching torch.load's behavior.
+        return np.array(storage[storage_offset])
+    itemsize = storage.dtype.itemsize
+    byte_strides = tuple(s * itemsize for s in stride)
+    view = np.lib.stride_tricks.as_strided(
+        storage[storage_offset:], shape=size, strides=byte_strides
+    )
+    return np.ascontiguousarray(view)
+
+
+def _rebuild_parameter(data, requires_grad=True, backward_hooks=None):
+    return data
+
+
+class _TorchUnpickler(pickle.Unpickler):
+    """Restricted unpickler for torch state dicts: only tensor-rebuild
+    machinery and plain containers are allowed; anything else (i.e.
+    arbitrary code objects in a malicious checkpoint) raises."""
+
+    def __init__(self, file, read_storage):
+        super().__init__(file)
+        self._read_storage = read_storage
+
+    def find_class(self, module, name):
+        if module == "torch._utils" and name in ("_rebuild_tensor_v2", "_rebuild_tensor"):
+            return _rebuild_tensor
+        if module == "torch._utils" and name == "_rebuild_parameter":
+            return _rebuild_parameter
+        if module == "torch" and name in _STORAGE_DTYPES:
+            return _StorageType(np.dtype(_STORAGE_DTYPES[name]))
+        if module == "torch" and name == "BFloat16Storage":
+            return _StorageType(_bfloat16_dtype())
+        if module == "torch.storage" and name == "TypedStorage":
+            return _StorageType(None)
+        if module == "collections" and name == "OrderedDict":
+            from collections import OrderedDict
+
+            return OrderedDict
+        if module == "builtins" and name in ("dict", "list", "tuple", "set", "int", "float", "str"):
+            import builtins
+
+            return getattr(builtins, name)
+        raise pickle.UnpicklingError(
+            f"Refusing to unpickle {module}.{name} from checkpoint (not tensor data)"
+        )
+
+    def persistent_load(self, pid):
+        # torch zip format: ('storage', StorageType, key, location, numel)
+        if not (isinstance(pid, tuple) and len(pid) == 5 and pid[0] == "storage"):
+            raise pickle.UnpicklingError(f"Unsupported persistent id: {pid!r}")
+        storage_type, key, _location, numel = pid[1], pid[2], pid[3], pid[4]
+        dtype = storage_type.dtype if isinstance(storage_type, _StorageType) else None
+        if dtype is None:
+            dtype = np.dtype(np.float32)
+        return self._read_storage(key, dtype, numel)
+
+
+def load_pth_state_dict(path: str) -> Dict[str, np.ndarray]:
+    """Parse a torch-saved checkpoint into {name: numpy array} without
+    importing torch. Handles the zipfile format (torch >= 1.6 default);
+    legacy formats fall back to torch.load if torch is available."""
+    with open(path, "rb") as f:
+        magic = f.read(4)
+    if magic[:2] != b"PK":
+        return _load_pth_legacy(path)
+
+    with zipfile.ZipFile(path) as zf:
+        names = zf.namelist()
+        pkl_name = next((n for n in names if n.endswith("data.pkl")), None)
+        if pkl_name is None:
+            raise ValueError(
+                f"{path} is a zip archive but not a torch checkpoint "
+                "(no data.pkl member)"
+            )
+        prefix = pkl_name[: -len("data.pkl")]
+        cache: Dict[str, np.ndarray] = {}
+
+        def read_storage(key, dtype, numel):
+            if key not in cache:
+                raw = zf.read(f"{prefix}data/{key}")
+                cache[key] = np.frombuffer(raw, dtype=dtype)
+            return cache[key]
+
+        with zf.open(pkl_name) as pf:
+            obj = _TorchUnpickler(io.BytesIO(pf.read()), read_storage).load()
+
+    return _flatten_state_dict(obj)
+
+
+def _load_pth_legacy(path: str) -> Dict[str, np.ndarray]:
+    try:
+        import torch
+    except ImportError:
+        raise RuntimeError(
+            f"{path} is a legacy (non-zip) torch checkpoint and torch is not "
+            "installed; re-save it in zip format, .npz, or .safetensors"
+        ) from None
+    sd = torch.load(path, map_location="cpu", weights_only=True)
+    return _flatten_state_dict(
+        {k: v.to(torch.float32).numpy() if v.dtype == torch.bfloat16 else v.numpy()
+         for k, v in sd.items()}
+    )
+
+
+def _flatten_state_dict(obj, prefix="") -> Dict[str, np.ndarray]:
+    out: Dict[str, np.ndarray] = {}
+    if isinstance(obj, dict):
+        for k, v in obj.items():
+            key = f"{prefix}.{k}" if prefix else str(k)
+            if isinstance(v, dict):
+                out.update(_flatten_state_dict(v, key))
+            elif isinstance(v, np.ndarray):
+                out[key] = v
+            # non-tensor metadata entries are dropped
+    return out
+
+
+# ----------------------------------------------------------------------
+# generic container formats
+# ----------------------------------------------------------------------
+
+def load_npz(path: str) -> Dict[str, np.ndarray]:
+    with np.load(path) as z:
+        return {k: z[k] for k in z.files}
+
+
+def load_safetensors(path: str, keys=None) -> Dict[str, np.ndarray]:
+    from safetensors.numpy import load_file
+
+    sd = load_file(path)
+    if keys is not None:
+        sd = {k: v for k, v in sd.items() if k in keys}
+    return sd
+
+
+def save_npz(path: str, flat_state_dict: Dict[str, np.ndarray]):
+    np.savez(path, **flat_state_dict)
+
+
+def load_checkpoint(path: str) -> Dict[str, np.ndarray]:
+    """Dispatch on extension: .pth/.pt (torch), .npz, .safetensors."""
+    ext = os.path.splitext(path)[1].lower()
+    if ext in (".pth", ".pt", ".bin"):
+        return load_pth_state_dict(path)
+    if ext == ".npz":
+        return load_npz(path)
+    if ext == ".safetensors":
+        return load_safetensors(path)
+    raise ValueError(f"Unsupported checkpoint format: {path}")
+
+
+# ----------------------------------------------------------------------
+# torch layout -> TPU layout converters
+# ----------------------------------------------------------------------
+
+def _t_conv(w: np.ndarray) -> np.ndarray:
+    """torch OIHW conv weight -> HWIO."""
+    return np.ascontiguousarray(w.transpose(2, 3, 1, 0))
+
+
+def _t_linear(w: np.ndarray) -> np.ndarray:
+    """torch (out, in) linear weight -> (in, out)."""
+    return np.ascontiguousarray(w.T)
+
+
+def cifar_params_from_torch_state_dict(sd: Dict[str, np.ndarray]):
+    """Convert the reference CNN's state dict (keys conv1/conv2/fc1/fc2
+    .weight/.bias — cifar_model_parts.py:9-13) to this framework's NHWC
+    param pytree.
+
+    The subtle part: the reference flattens NCHW as (C,H,W)
+    (`x.view(-1, 64*8*8)`, cifar_model_parts.py:21,41) while we flatten
+    NHWC as (H,W,C), so fc1's input dimension must be permuted
+    (C,H,W)->(H,W,C) for identical numerics.
+    """
+    fc1_w = sd["fc1.weight"]  # (512, 4096) with 4096 = C*H*W = 64*8*8
+    out_f = fc1_w.shape[0]
+    fc1_w = fc1_w.reshape(out_f, 64, 8, 8).transpose(0, 2, 3, 1).reshape(out_f, -1)
+    return {
+        "conv1": {"kernel": np.asarray(_t_conv(sd["conv1.weight"])), "bias": sd["conv1.bias"]},
+        "conv2": {"kernel": np.asarray(_t_conv(sd["conv2.weight"])), "bias": sd["conv2.bias"]},
+        "fc1": {"kernel": _t_linear(fc1_w), "bias": sd["fc1.bias"]},
+        "fc2": {"kernel": _t_linear(sd["fc2.weight"]), "bias": sd["fc2.bias"]},
+    }
+
+
+def _strip_prefix(sd: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
+    if any(k.startswith("transformer.") for k in sd):
+        stripped = {}
+        for k, v in sd.items():
+            stripped[k[len("transformer."):] if k.startswith("transformer.") else k] = v
+        return stripped
+    return sd
+
+
+def _detect_gpt_layout(sd: Dict[str, np.ndarray]) -> str:
+    """HF GPT-2 uses Conv1D weights stored (in, out); nanoGPT uses nn.Linear
+    stored (out, in). Distinguish by the non-square c_attn shape."""
+    for k, v in sd.items():
+        if k.endswith("attn.c_attn.weight"):
+            if v.shape[1] == 3 * v.shape[0]:
+                return "conv1d"  # (C, 3C): already (in, out)
+            if v.shape[0] == 3 * v.shape[1]:
+                return "linear"  # (3C, C): torch Linear, transpose needed
+    raise ValueError("Cannot detect GPT checkpoint layout (no c_attn.weight found)")
+
+
+def gpt_params_from_state_dict(sd: Dict[str, np.ndarray], n_layer: Optional[int] = None):
+    """Convert an HF-GPT-2 or nanoGPT state dict to this framework's GPT
+    param pytree (dnn_tpu/models/gpt.py). Re-authors the weight-compat path
+    the reference leaves implicit by importing nanoGPT's missing model.py
+    (gpt_model_parts.py:4)."""
+    sd = _strip_prefix(sd)
+    layout = _detect_gpt_layout(sd)
+    w = (lambda x: np.ascontiguousarray(x)) if layout == "conv1d" else _t_linear
+
+    if n_layer is None:
+        n_layer = 1 + max(
+            int(k.split(".")[1]) for k in sd if k.startswith("h.") and k.split(".")[1].isdigit()
+        )
+
+    params = {
+        "wte": {"embedding": sd["wte.weight"]},
+        "wpe": {"embedding": sd["wpe.weight"]},
+        "ln_f": {"scale": sd["ln_f.weight"], "bias": sd["ln_f.bias"]},
+    }
+    for i in range(n_layer):
+        p = f"h.{i}."
+        params[f"h_{i}"] = {
+            "ln_1": {"scale": sd[p + "ln_1.weight"], "bias": sd[p + "ln_1.bias"]},
+            "attn": {
+                "qkv": {"kernel": w(sd[p + "attn.c_attn.weight"]), "bias": sd[p + "attn.c_attn.bias"]},
+                "proj": {"kernel": w(sd[p + "attn.c_proj.weight"]), "bias": sd[p + "attn.c_proj.bias"]},
+            },
+            "ln_2": {"scale": sd[p + "ln_2.weight"], "bias": sd[p + "ln_2.bias"]},
+            "mlp": {
+                "fc": {"kernel": w(sd[p + "mlp.c_fc.weight"]), "bias": sd[p + "mlp.c_fc.bias"]},
+                "proj": {"kernel": w(sd[p + "mlp.c_proj.weight"]), "bias": sd[p + "mlp.c_proj.bias"]},
+            },
+        }
+    # lm_head: explicit if present, else tied to wte (GPT-2 ties weights).
+    if "lm_head.weight" in sd:
+        params["lm_head"] = {"kernel": _t_linear(sd["lm_head.weight"])}
+    else:
+        params["lm_head"] = {"kernel": np.ascontiguousarray(sd["wte.weight"].T)}
+    return params
+
+
+# ----------------------------------------------------------------------
+# per-stage slicing
+# ----------------------------------------------------------------------
+
+def slice_params_for_stage(full_params, stage_spec):
+    """Stage-local view of the shared checkpoint — the rebuild of every node
+    loading the full .pth and keeping its slice via strict=False
+    (node.py:294-317), except nothing foreign is ever materialized on-device."""
+    return stage_spec.slice_params(full_params)

@@ -1,0 +1,49 @@
+"""Client-side input preprocessing.
+
+Rebuilds the reference's node-0 image path (/root/reference/node.py:142-154):
+PIL open -> RGB -> Resize(32, 32) -> ToTensor (scale to [0,1]) ->
+Normalize(mean=0.5, std=0.5 per channel) -> add batch dim; on any failure,
+fall back to a dummy random input (node.py:149-154). Differences: output is
+NHWC (TPU layout) and torchvision is not required — the transform is PIL +
+numpy.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+CIFAR_SIZE = (32, 32)
+_MEAN = 0.5
+_STD = 0.5
+
+
+def load_image(path: str, size=CIFAR_SIZE) -> np.ndarray:
+    """Image file -> normalized (1, H, W, 3) float32 array.
+
+    Matches torchvision Resize((32,32)) (bilinear) + ToTensor + Normalize
+    ((0.5,)*3, (0.5,)*3) from node.py:142-148, in NHWC.
+    """
+    from PIL import Image
+
+    img = Image.open(path).convert("RGB").resize(size[::-1], Image.BILINEAR)
+    arr = np.asarray(img, dtype=np.float32) / 255.0
+    arr = (arr - _MEAN) / _STD
+    return arr[None, ...]  # (1, H, W, 3)
+
+
+def dummy_image(size=CIFAR_SIZE, seed: int = 0) -> np.ndarray:
+    """The reference's torch.randn(1, 3, 32, 32) fallback (node.py:149-154),
+    in NHWC."""
+    rng = np.random.default_rng(seed)
+    return rng.standard_normal((1, *size, 3), dtype=np.float32)
+
+
+def load_image_or_dummy(path, size=CIFAR_SIZE):
+    """Load `path`, falling back to dummy data on *any* failure — exactly the
+    reference's error handling (node.py:149-154). Returns (array, used_dummy)."""
+    if not path:
+        return dummy_image(size), True
+    try:
+        return load_image(path, size), False
+    except Exception:
+        return dummy_image(size), True

@@ -1,0 +1,161 @@
+"""CIFAR-10 CNN model family, TPU-native.
+
+Re-authors the reference's `NeuralNetwork`
+(/root/reference/cifar_model_parts.py:6-25):
+
+    conv1 3->32 k3 s1 p1, relu, maxpool 2x2
+    conv2 32->64 k3 s1 p1, relu, maxpool 2x2
+    flatten -> fc1 4096->512, relu -> fc2 512->10 -> softmax(dim=1)
+
+and its 2-way split (`ModelPart0_2Node` = convs + flatten,
+`ModelPart1_2Node` = fcs + softmax — cifar_model_parts.py:29-58), but:
+
+  * NHWC activations / HWIO kernels (TPU MXU layout) instead of NCHW;
+  * pure functions over a param pytree instead of nn.Module aliasing;
+  * partitioning generalized to any 1 <= num_parts <= 4 at layer
+    boundaries (the reference hard-codes exactly 2 — node.py:246-248);
+  * the flatten order is (H, W, C); the checkpoint converter permutes
+    torch fc1 weights to match (dnn_tpu/io/checkpoint.py).
+
+Param pytree layout (keys are the stage-sliceable unit, mirroring the
+reference's per-layer state-dict keys conv1/conv2/fc1/fc2):
+
+  {"conv1": {kernel, bias}, "conv2": {kernel, bias},
+   "fc1": {kernel, bias}, "fc2": {kernel, bias}}
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from dnn_tpu.ops.nn import conv2d, linear, max_pool2d, relu, softmax
+from dnn_tpu.registry import ModelSpec, StageSpec, register_model
+
+NUM_CLASSES = 10
+IMAGE_SHAPE = (32, 32, 3)  # HWC
+FLAT_FEATURES = 8 * 8 * 64  # after two 2x2 pools: 32->16->8 spatial, 64 ch
+
+
+def _kaiming_conv(key, kh, kw, cin, cout, dtype):
+    # Matches torch's default Conv2d init scale (kaiming_uniform a=sqrt(5)).
+    fan_in = kh * kw * cin
+    bound = 1.0 / math.sqrt(fan_in)
+    kkey, bkey = jax.random.split(key)
+    kernel = jax.random.uniform(
+        kkey, (kh, kw, cin, cout), dtype, minval=-math.sqrt(3.0) * bound, maxval=math.sqrt(3.0) * bound
+    )
+    bias = jax.random.uniform(bkey, (cout,), dtype, minval=-bound, maxval=bound)
+    return {"kernel": kernel, "bias": bias}
+
+
+def _torch_linear(key, cin, cout, dtype):
+    bound = 1.0 / math.sqrt(cin)
+    kkey, bkey = jax.random.split(key)
+    kernel = jax.random.uniform(
+        kkey, (cin, cout), dtype, minval=-math.sqrt(3.0) * bound, maxval=math.sqrt(3.0) * bound
+    )
+    bias = jax.random.uniform(bkey, (cout,), dtype, minval=-bound, maxval=bound)
+    return {"kernel": kernel, "bias": bias}
+
+
+def init(rng, dtype=jnp.float32):
+    k1, k2, k3, k4 = jax.random.split(rng, 4)
+    return {
+        "conv1": _kaiming_conv(k1, 3, 3, 3, 32, dtype),
+        "conv2": _kaiming_conv(k2, 3, 3, 32, 64, dtype),
+        "fc1": _torch_linear(k3, FLAT_FEATURES, 512, dtype),
+        "fc2": _torch_linear(k4, 512, NUM_CLASSES, dtype),
+    }
+
+
+# --- layer-granular segments: the partitionable unit ----------------------
+# Reference forward order: pool(relu(conv1)) -> pool(relu(conv2)) -> flatten
+# -> relu(fc1) -> softmax(fc2)  (cifar_model_parts.py:18-25).
+
+
+def _seg_conv1(params, x):
+    return max_pool2d(relu(conv2d(params["conv1"], x)))
+
+
+def _seg_conv2(params, x):
+    h = max_pool2d(relu(conv2d(params["conv2"], x)))
+    return h.reshape(h.shape[0], -1)  # flatten (B, 8, 8, 64) -> (B, 4096)
+
+
+def _seg_fc1(params, x):
+    return relu(linear(params["fc1"], x))
+
+
+def _seg_fc2(params, x):
+    return softmax(linear(params["fc2"], x), axis=1)
+
+
+_SEGMENTS = (
+    ("conv1", _seg_conv1, ("conv1",)),
+    ("conv2", _seg_conv2, ("conv2",)),
+    ("fc1", _seg_fc1, ("fc1",)),
+    ("fc2", _seg_fc2, ("fc2",)),
+)
+
+# Split points chosen so num_parts=2 reproduces the reference split exactly:
+# part0 = convs + flatten, part1 = fcs + softmax (cifar_model_parts.py:29-58).
+_PARTITIONS = {
+    1: ((0, 1, 2, 3),),
+    2: ((0, 1), (2, 3)),
+    3: ((0,), (1,), (2, 3)),
+    4: ((0,), (1,), (2,), (3,)),
+}
+
+
+def apply(params, x):
+    """Full-model forward: (B, 32, 32, 3) NHWC -> (B, 10) class probs."""
+    for _, fn, _ in _SEGMENTS:
+        x = fn(params, x)
+    return x
+
+
+def partition(num_parts):
+    if num_parts not in _PARTITIONS:
+        raise ValueError(
+            f"cifar_cnn supports num_parts in {sorted(_PARTITIONS)}, got {num_parts}"
+        )
+    stages = []
+    for seg_ids in _PARTITIONS[num_parts]:
+        segs = [_SEGMENTS[i] for i in seg_ids]
+        param_keys = tuple(k for _, _, keys in segs for k in keys)
+
+        def stage_fn(params, x, _segs=tuple(segs)):
+            for _, fn, _ in _segs:
+                x = fn(params, x)
+            return x
+
+        stages.append(
+            StageSpec(
+                name="+".join(s[0] for s in segs),
+                apply=stage_fn,
+                param_keys=param_keys,
+            )
+        )
+    return stages
+
+
+def example_input(batch_size=1, rng=None):
+    """Dummy input mirroring the reference's torch.randn(1, 3, 32, 32)
+    fallback (node.py:149-154), in NHWC."""
+    rng = rng if rng is not None else jax.random.PRNGKey(0)
+    return jax.random.normal(rng, (batch_size, *IMAGE_SHAPE), jnp.float32)
+
+
+register_model(
+    ModelSpec(
+        name="cifar_cnn",
+        init=init,
+        apply=apply,
+        partition=partition,
+        example_input=example_input,
+        supported_parts=tuple(sorted(_PARTITIONS)),
+    )
+)

@@ -1,0 +1,295 @@
+"""GPT-2 model family, TPU-native.
+
+The reference ships GPT partition wrappers
+(/root/reference/partitions/gpt_model_parts.py) over a nanoGPT-style
+`GPT/GPTConfig/Block` imported from a `model.py` that is ABSENT from its
+repo (gpt_model_parts.py:4) — so this module re-authors the base model from
+the standard GPT-2 architecture (the reference survey mandates this:
+SURVEY.md §7g), weight-compatible with HuggingFace GPT-2 checkpoints via
+the converter in dnn_tpu/io/checkpoint.py.
+
+Partitioning mirrors the reference's three wrapper classes:
+  * first stage  = wte + wpe + blocks[0..k]      (ModelPart0, :6-22)
+  * middle stage = blocks[i..j]                  (ModelPartIntermediate, :26-34)
+  * final stage  = blocks[..] + ln_f + lm_head   (ModelPartFinal_GPT, :36-50)
+and generalizes to any num_parts <= n_layer.
+
+TPU-first choices (vs a torch translation):
+  * params are a flat dict keyed by stage-sliceable units
+    ({"wte","wpe","h_0".."h_{L-1}","ln_f","lm_head"});
+  * blocks are a single pure function -> stacked-params `lax.scan` over
+    layers inside a stage (one compiled block body, MXU-friendly);
+  * bf16 compute / f32 params via `compute_dtype`;
+  * optional Pallas flash attention for long sequences.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from dnn_tpu.ops.attention import causal_self_attention
+from dnn_tpu.ops.nn import embedding, gelu, layer_norm, linear
+from dnn_tpu.registry import ModelSpec, StageSpec, register_model
+
+
+@dataclasses.dataclass(frozen=True)
+class GPTConfig:
+    """Mirrors the nanoGPT GPTConfig the reference depends on
+    (gpt_model_parts.py:4,15 uses config.block_size)."""
+
+    block_size: int = 1024
+    vocab_size: int = 50257
+    n_layer: int = 12
+    n_head: int = 12
+    n_embd: int = 768
+    ln_eps: float = 1e-5
+
+
+PRESETS = {
+    "gpt2": GPTConfig(n_layer=12, n_head=12, n_embd=768),
+    "gpt2-medium": GPTConfig(n_layer=24, n_head=16, n_embd=1024),
+    "gpt2-large": GPTConfig(n_layer=36, n_head=20, n_embd=1280),
+    "gpt2-xl": GPTConfig(n_layer=48, n_head=25, n_embd=1600),
+    # tiny config for tests / CPU-mesh CI
+    "gpt2-test": GPTConfig(block_size=64, vocab_size=256, n_layer=4, n_head=4, n_embd=64),
+}
+
+
+# --------------------------------------------------------------------------
+# init
+# --------------------------------------------------------------------------
+
+def _normal(key, shape, dtype, std=0.02):
+    return (jax.random.normal(key, shape) * std).astype(dtype)
+
+
+def init_block(key, cfg: GPTConfig, dtype=jnp.float32):
+    c = cfg.n_embd
+    ks = jax.random.split(key, 4)
+    # GPT-2 scales residual-projection init by 1/sqrt(2*n_layer).
+    proj_std = 0.02 / (2 * cfg.n_layer) ** 0.5
+    return {
+        "ln_1": {"scale": jnp.ones((c,), dtype), "bias": jnp.zeros((c,), dtype)},
+        "attn": {
+            "qkv": {"kernel": _normal(ks[0], (c, 3 * c), dtype), "bias": jnp.zeros((3 * c,), dtype)},
+            "proj": {"kernel": _normal(ks[1], (c, c), dtype, proj_std), "bias": jnp.zeros((c,), dtype)},
+        },
+        "ln_2": {"scale": jnp.ones((c,), dtype), "bias": jnp.zeros((c,), dtype)},
+        "mlp": {
+            "fc": {"kernel": _normal(ks[2], (c, 4 * c), dtype), "bias": jnp.zeros((4 * c,), dtype)},
+            "proj": {"kernel": _normal(ks[3], (4 * c, c), dtype, proj_std), "bias": jnp.zeros((c,), dtype)},
+        },
+    }
+
+
+def init(rng, cfg: GPTConfig = PRESETS["gpt2"], dtype=jnp.float32, tie_lm_head=True):
+    keys = jax.random.split(rng, cfg.n_layer + 3)
+    c = cfg.n_embd
+    params = {
+        "wte": {"embedding": _normal(keys[0], (cfg.vocab_size, c), dtype)},
+        "wpe": {"embedding": _normal(keys[1], (cfg.block_size, c), dtype, std=0.01)},
+        "ln_f": {"scale": jnp.ones((c,), dtype), "bias": jnp.zeros((c,), dtype)},
+    }
+    for i in range(cfg.n_layer):
+        params[f"h_{i}"] = init_block(keys[2 + i], cfg, dtype)
+    # GPT-2 ties lm_head to wte; we materialize the tied weight under its own
+    # key so pipeline stages stay cleanly sliceable (the reference's final
+    # stage likewise carries original_model.lm_head — gpt_model_parts.py:42).
+    params["lm_head"] = {
+        "kernel": params["wte"]["embedding"].T if tie_lm_head else _normal(keys[-1], (c, cfg.vocab_size), dtype)
+    }
+    return params
+
+
+# --------------------------------------------------------------------------
+# forward
+# --------------------------------------------------------------------------
+
+def block_apply(block_params, x, *, cfg: GPTConfig, use_flash=False, compute_dtype=None):
+    """Pre-LN transformer block (nanoGPT Block semantics). With
+    `compute_dtype=bf16`, every matmul runs bf16 on the MXU while residuals
+    and layer norms stay in the activation dtype."""
+    h = layer_norm(block_params["ln_1"], x, eps=cfg.ln_eps)
+    x = x + causal_self_attention(
+        block_params["attn"], h, n_head=cfg.n_head, use_flash=use_flash, compute_dtype=compute_dtype
+    )
+    h = layer_norm(block_params["ln_2"], x, eps=cfg.ln_eps)
+    m = linear(
+        block_params["mlp"]["proj"],
+        gelu(linear(block_params["mlp"]["fc"], h, compute_dtype=compute_dtype)),
+        compute_dtype=compute_dtype,
+    )
+    return x + m
+
+
+def stack_blocks(params, layer_ids):
+    """Stack per-layer block params along a leading axis (for lax.scan over
+    layers, and for sharding the stack over a pipeline mesh axis).
+
+    Do this ONCE at load time (see `prepare_stacked` / the pipeline engine),
+    not per forward call — restacking is an O(params) copy."""
+    blocks = [params[f"h_{i}"] for i in layer_ids]
+    return jax.tree.map(lambda *xs: jnp.stack(xs), *blocks)
+
+
+def prepare_stacked(params, cfg: GPTConfig):
+    """One-time load-side transform: {'h_0'..'h_{L-1}', ...} ->
+    {'blocks': stacked, 'wte', 'wpe', 'ln_f', 'lm_head'} for use with
+    `make_apply_stacked`. The stacked layout is also what the pipeline
+    runtime shards over the 'stage' mesh axis."""
+    out = {k: v for k, v in params.items() if not k.startswith("h_")}
+    out["blocks"] = stack_blocks(params, range(cfg.n_layer))
+    return out
+
+
+def blocks_scan(stacked, x, *, cfg: GPTConfig, use_flash=False, compute_dtype=None):
+    """Run a stack of blocks via lax.scan: one compiled block body regardless
+    of depth (the TPU-idiomatic form of the reference's Python
+    `for block in self.h` loop, gpt_model_parts.py:20-21)."""
+
+    def body(carry, layer_params):
+        return (
+            block_apply(layer_params, carry, cfg=cfg, use_flash=use_flash, compute_dtype=compute_dtype),
+            None,
+        )
+
+    out, _ = jax.lax.scan(body, x, stacked)
+    return out
+
+
+def embed(params, idx, *, cfg: GPTConfig):
+    """Token + position embedding (ModelPart0 semantics,
+    gpt_model_parts.py:13-18, incl. the T <= block_size guard)."""
+    t = idx.shape[-1]
+    if t > cfg.block_size:
+        raise ValueError(f"Cannot forward: sequence length {t} > block_size {cfg.block_size}")
+    pos = jnp.arange(t)
+    return embedding(params["wte"], idx) + embedding(params["wpe"], pos)
+
+
+def head(params, x, *, cfg: GPTConfig):
+    """Final LN + lm_head (ModelPartFinal_GPT semantics,
+    gpt_model_parts.py:44-50)."""
+    x = layer_norm(params["ln_f"], x, eps=cfg.ln_eps)
+    return linear(params["lm_head"], x)
+
+
+def make_apply(cfg: GPTConfig, *, use_flash=False, compute_dtype=None):
+    """Full-model forward over the per-layer param layout (restacks blocks
+    per call — fine under jit for tests/small models; perf paths should use
+    `prepare_stacked` + `make_apply_stacked`)."""
+
+    def apply(params, idx):
+        x = embed(params, idx, cfg=cfg)
+        if compute_dtype is not None:
+            x = x.astype(compute_dtype)
+        stacked = stack_blocks(params, range(cfg.n_layer))
+        x = blocks_scan(stacked, x, cfg=cfg, use_flash=use_flash, compute_dtype=compute_dtype)
+        logits = head(params, x.astype(jnp.float32), cfg=cfg)
+        return logits
+
+    return apply
+
+
+def make_apply_stacked(cfg: GPTConfig, *, use_flash=False, compute_dtype=None):
+    """Forward over `prepare_stacked` params: zero per-call restacking."""
+
+    def apply(prepared, idx):
+        x = embed(prepared, idx, cfg=cfg)
+        if compute_dtype is not None:
+            x = x.astype(compute_dtype)
+        x = blocks_scan(prepared["blocks"], x, cfg=cfg, use_flash=use_flash, compute_dtype=compute_dtype)
+        return head(prepared, x.astype(jnp.float32), cfg=cfg)
+
+    return apply
+
+
+# --------------------------------------------------------------------------
+# partitioning (mirrors gpt_model_parts.py stage layout)
+# --------------------------------------------------------------------------
+
+def layer_ranges(n_layer: int, num_parts: int):
+    """Split n_layer blocks into num_parts contiguous ranges, earlier stages
+    taking the remainder (matches the reference's inclusive
+    [start_layer, end_layer] convention, gpt_model_parts.py:12,30,40)."""
+    if not 1 <= num_parts <= n_layer:
+        raise ValueError(f"num_parts must be in [1, {n_layer}], got {num_parts}")
+    base, rem = divmod(n_layer, num_parts)
+    ranges, lo = [], 0
+    for p in range(num_parts):
+        hi = lo + base + (1 if p < rem else 0)
+        ranges.append((lo, hi))
+        lo = hi
+    return ranges
+
+
+def make_partition(cfg: GPTConfig, *, use_flash=False, compute_dtype=None):
+    def partition(num_parts):
+        ranges = layer_ranges(cfg.n_layer, num_parts)
+        stages = []
+        for p, (lo, hi) in enumerate(ranges):
+            is_first, is_last = p == 0, p == num_parts - 1
+            hkeys = tuple(f"h_{i}" for i in range(lo, hi))
+            param_keys = hkeys
+            if is_first:
+                param_keys = ("wte", "wpe") + param_keys
+            if is_last:
+                param_keys = param_keys + ("ln_f", "lm_head")
+
+            def stage_fn(params, x, _lo=lo, _hi=hi, _first=is_first, _last=is_last):
+                if _first:
+                    x = embed(params, x, cfg=cfg)
+                if compute_dtype is not None and jnp.issubdtype(x.dtype, jnp.floating):
+                    x = x.astype(compute_dtype)
+                if _hi > _lo:
+                    stacked = stack_blocks(params, range(_lo, _hi))
+                    x = blocks_scan(
+                        stacked, x, cfg=cfg, use_flash=use_flash, compute_dtype=compute_dtype
+                    )
+                if _last:
+                    x = head(params, x.astype(jnp.float32), cfg=cfg)
+                return x
+
+            stages.append(
+                StageSpec(
+                    name=f"gpt_blocks[{lo}:{hi}]"
+                    + ("+embed" if is_first else "")
+                    + ("+head" if is_last else ""),
+                    apply=stage_fn,
+                    param_keys=param_keys,
+                )
+            )
+        return stages
+
+    return partition
+
+
+def make_example_input(cfg: GPTConfig):
+    def example_input(batch_size=1, seq_len=None, rng=None):
+        t = min(seq_len or cfg.block_size, cfg.block_size)
+        rng = rng if rng is not None else jax.random.PRNGKey(0)
+        return jax.random.randint(rng, (batch_size, t), 0, cfg.vocab_size, dtype=jnp.int32)
+
+    return example_input
+
+
+def _register(name: str, cfg: GPTConfig):
+    register_model(
+        ModelSpec(
+            name=name,
+            init=lambda rng, dtype=jnp.float32, _cfg=cfg: init(rng, _cfg, dtype),
+            apply=make_apply(cfg),
+            partition=make_partition(cfg),
+            example_input=make_example_input(cfg),
+            supported_parts=tuple(range(1, cfg.n_layer + 1)),
+            config=cfg,
+        )
+    )
+
+
+for _name, _cfg in PRESETS.items():
+    _register(_name, _cfg)

@@ -1,0 +1,23 @@
+from dnn_tpu.ops.nn import (
+    conv2d,
+    max_pool2d,
+    linear,
+    relu,
+    gelu,
+    softmax,
+    layer_norm,
+    embedding,
+)
+from dnn_tpu.ops.attention import causal_self_attention
+
+__all__ = [
+    "conv2d",
+    "max_pool2d",
+    "linear",
+    "relu",
+    "gelu",
+    "softmax",
+    "layer_norm",
+    "embedding",
+    "causal_self_attention",
+]

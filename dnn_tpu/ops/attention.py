@@ -1,0 +1,57 @@
+"""Multi-head causal self-attention.
+
+The reference's GPT partitions delegate attention to a nanoGPT-style `Block`
+imported from a `model.py` that is absent from its repo
+(/root/reference/partitions/gpt_model_parts.py:4); this module re-authors
+that math TPU-first:
+
+  * one fused qkv projection (a single big MXU matmul),
+  * attention computed per head via einsum (XLA maps these onto the MXU),
+  * optional Pallas flash-attention kernel on TPU for long sequences
+    (dnn_tpu/ops/pallas/flash_attention.py) with this jnp version as the
+    numerically-identical fallback / ground truth.
+
+Shapes: x is (B, T, C); params:
+  {"qkv": {"kernel": (C, 3C), "bias": (3C,)},
+   "proj": {"kernel": (C, C), "bias": (C,)}}
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from dnn_tpu.ops.nn import linear
+
+
+def split_heads(x, n_head):
+    b, t, c = x.shape
+    return x.reshape(b, t, n_head, c // n_head).transpose(0, 2, 1, 3)  # (B, H, T, D)
+
+
+def merge_heads(x):
+    b, h, t, d = x.shape
+    return x.transpose(0, 2, 1, 3).reshape(b, t, h * d)
+
+
+def causal_self_attention(params, x, *, n_head, use_flash=False, compute_dtype=None):
+    """Full causal MHA: fused qkv matmul -> per-head attention -> out proj.
+
+    `use_flash=True` routes the inner attention through the Pallas TPU
+    kernel (falls back to the jnp path off-TPU or for tiny shapes).
+    `compute_dtype` (e.g. bf16) casts the matmul operands for the MXU.
+    """
+    qkv = linear(params["qkv"], x, compute_dtype=compute_dtype)  # (B, T, 3C)
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    q, k, v = (split_heads(t, n_head) for t in (q, k, v))
+
+    # Single source of truth for the attention math: the flash kernel and
+    # its jnp reference live in one module, so both paths share numerics.
+    from dnn_tpu.ops.pallas.flash_attention import flash_attention, reference_attention
+
+    if use_flash:
+        y = flash_attention(q, k, v, causal=True)
+    else:
+        y = reference_attention(q, k, v, causal=True)
+
+    y = merge_heads(y)
+    return linear(params["proj"], y, compute_dtype=compute_dtype)

@@ -1,0 +1,119 @@
+"""Core neural-net ops as pure functions over parameter pytrees.
+
+TPU-first conventions:
+  * Activations are NHWC and weights HWIO — the layouts XLA tiles best onto
+    the TPU MXU (the reference is NCHW PyTorch; see
+    /root/reference/cifar_model_parts.py:10-26 for the ops this module must
+    be able to express).
+  * Everything is a pure function of (params, x): jit/vmap/shard_map safe,
+    no module objects, no Python-side state.
+  * Matmul-bearing ops accept a `compute_dtype` so models can run bf16 on
+    the MXU while keeping f32 params.
+
+Parameter pytrees are plain dicts:
+  conv2d:    {"kernel": (kh, kw, in_ch, out_ch), "bias": (out_ch,)}
+  linear:    {"kernel": (in_features, out_features), "bias": (out_features,)}
+  layer_norm:{"scale": (dim,), "bias": (dim,)}
+  embedding: {"embedding": (vocab, dim)}
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def conv2d(params, x, *, stride=(1, 1), padding="SAME", compute_dtype=None):
+    """2-D convolution, NHWC activations / HWIO kernel.
+
+    Equivalent capability to torch nn.Conv2d as used by the reference CNN
+    (/root/reference/cifar_model_parts.py:9,11 — k3 s1 p1 == SAME).
+    """
+    kernel = params["kernel"]
+    if compute_dtype is not None:
+        x = x.astype(compute_dtype)
+        kernel = kernel.astype(compute_dtype)
+    out = lax.conv_general_dilated(
+        x,
+        kernel,
+        window_strides=stride,
+        padding=padding,
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+    )
+    bias = params.get("bias")
+    if bias is not None:
+        out = out + bias.astype(out.dtype)
+    return out
+
+
+def max_pool2d(x, *, window=(2, 2), stride=(2, 2)):
+    """Max pooling over spatial dims of an NHWC tensor.
+
+    Reference: torch nn.MaxPool2d(kernel_size=2, stride=2, padding=0)
+    (/root/reference/cifar_model_parts.py:10).
+    """
+    return lax.reduce_window(
+        x,
+        -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min,
+        lax.max,
+        window_dimensions=(1, *window, 1),
+        window_strides=(1, *stride, 1),
+        padding="VALID",
+    )
+
+
+def linear(params, x, *, compute_dtype=None):
+    """Dense layer: x @ kernel + bias. kernel is (in, out) — already the
+    layout XLA wants for an MXU matmul (torch stores (out, in); the
+    checkpoint converter transposes — see dnn_tpu/io/checkpoint.py).
+
+    Reference: torch nn.Linear (/root/reference/cifar_model_parts.py:12-13).
+    """
+    kernel = params["kernel"]
+    orig_dtype = x.dtype
+    if compute_dtype is not None:
+        x = x.astype(compute_dtype)
+        kernel = kernel.astype(compute_dtype)
+    out = x @ kernel
+    bias = params.get("bias")
+    if bias is not None:
+        out = out + bias.astype(out.dtype)
+    if compute_dtype is not None:
+        out = out.astype(orig_dtype)
+    return out
+
+
+def relu(x):
+    return jax.nn.relu(x)
+
+
+def gelu(x):
+    """tanh-approximate GELU (the GPT-2 nonlinearity)."""
+    return jax.nn.gelu(x, approximate=True)
+
+
+def softmax(x, axis=-1):
+    """Reference: torch nn.Softmax(dim=1) on (B, 10) logits
+    (/root/reference/cifar_model_parts.py:15,25)."""
+    return jax.nn.softmax(x, axis=axis)
+
+
+def layer_norm(params, x, *, eps=1e-5):
+    """LayerNorm over the last dim (torch nn.LayerNorm semantics, biased
+    variance, as in GPT-2)."""
+    x32 = x.astype(jnp.float32)
+    mean = jnp.mean(x32, axis=-1, keepdims=True)
+    var = jnp.var(x32, axis=-1, keepdims=True)
+    y = (x32 - mean) * lax.rsqrt(var + eps)
+    y = y * params["scale"].astype(jnp.float32) + params["bias"].astype(jnp.float32)
+    return y.astype(x.dtype)
+
+
+def embedding(params, ids):
+    """Token/position embedding lookup.
+
+    Reference: torch nn.Embedding via wte/wpe
+    (/root/reference/partitions/gpt_model_parts.py:9-10,16-18).
+    """
+    return jnp.take(params["embedding"], ids, axis=0)

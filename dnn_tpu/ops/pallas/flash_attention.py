@@ -1,0 +1,161 @@
+"""Flash attention as a Pallas TPU kernel.
+
+The hot op of the GPT family (SURVEY.md §5 "long-context"). Online-softmax
+blockwise attention: never materializes the (T, T) score matrix in HBM —
+scores live in VMEM one (block_q, block_k) tile at a time, with running
+row-max / row-sum rescaling (the flash-attention recurrence).
+
+Grid: (batch*heads, q_blocks, k_blocks); the k dimension is sequential
+("arbitrary") so the f32 accumulator scratch persists across k steps, while
+batch/head/q blocks parallelize. Causal masking skips fully-masked k blocks
+outright (upper triangle), so causal costs ~half the FLOPs of full.
+
+Falls back to the jnp reference implementation (numerically identical math)
+when not running on TPU, when shapes don't tile, or when the sequence is too
+short to be worth a kernel launch.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+_NEG_BIG = -1e30  # finite "minus infinity": keeps exp()/max() NaN-free
+
+
+# ----------------------------------------------------------------------
+# reference path (also the off-TPU fallback and the test oracle)
+# ----------------------------------------------------------------------
+
+def reference_attention(q, k, v, *, causal=True):
+    d = q.shape[-1]
+    s = jnp.einsum("bhtd,bhsd->bhts", q, k).astype(jnp.float32) / jnp.sqrt(d)
+    if causal:
+        t, sk = s.shape[-2], s.shape[-1]
+        mask = jnp.tril(jnp.ones((t, sk), dtype=bool), k=sk - t)
+        s = jnp.where(mask, s, _NEG_BIG)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhts,bhsd->bhtd", p.astype(v.dtype), v)
+
+
+# ----------------------------------------------------------------------
+# pallas kernel
+# ----------------------------------------------------------------------
+
+def _flash_kernel(
+    q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *, causal, scale, block_q, block_k, offset
+):
+    """`offset = S - T` aligns the causal mask bottom-right (query t attends
+    to keys <= t + offset), matching reference_attention's tril(k=S-T) —
+    the KV-cache decode convention when S > T."""
+    from jax.experimental import pallas as pl
+
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, _NEG_BIG)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    # causal: k block is dead iff its first col exceeds the max valid col of
+    # this q block's last row (qi*bq + bq - 1 + offset).
+    live = (not causal) or (ki * block_k <= qi * block_q + block_q - 1 + offset)
+
+    @pl.when(live)
+    def _step():
+        q = q_ref[0].astype(jnp.float32)  # (block_q, d)
+        k = k_ref[0].astype(jnp.float32)  # (block_k, d)
+        v = v_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale  # (block_q, block_k)
+
+        if causal:
+            rows = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0) + qi * block_q
+            cols = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1) + ki * block_k
+            s = jnp.where(rows + offset >= cols, s, _NEG_BIG)
+
+        m_prev = m_scr[:, :1]  # (block_q, 1) row stats, lane-broadcast storage
+        m_new = jnp.maximum(m_prev, s.max(axis=-1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new)
+        l_new = l_scr[:, :1] * alpha + p.sum(axis=-1, keepdims=True)
+        acc_scr[...] = acc_scr[...] * alpha + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        m_scr[...] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[...] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    @pl.when(ki == nk - 1)
+    def _finish():
+        o_ref[0] = (acc_scr[...] / l_scr[:, :1]).astype(o_ref.dtype)
+
+
+def _flash_tpu(q, k, v, *, causal, block_q, block_k, interpret):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, h, t, d = q.shape
+    s_len = k.shape[2]
+    scale = 1.0 / (d ** 0.5)
+    bh = b * h
+    q3 = q.reshape(bh, t, d)
+    k3 = k.reshape(bh, s_len, d)
+    v3 = v.reshape(bh, s_len, d)
+    nq, nk = t // block_q, s_len // block_k
+
+    kernel = functools.partial(
+        _flash_kernel,
+        causal=causal,
+        scale=scale,
+        block_q=block_q,
+        block_k=block_k,
+        offset=s_len - t,
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid=(bh, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh_, qi, ki: (bh_, qi, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh_, qi, ki: (bh_, ki, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh_, qi, ki: (bh_, ki, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda bh_, qi, ki: (bh_, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, t, d), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 128), jnp.float32),  # running row max
+            pltpu.VMEM((block_q, 128), jnp.float32),  # running row sum
+            pltpu.VMEM((block_q, d), jnp.float32),  # output accumulator
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")
+        ),
+        interpret=interpret,
+    )(q3, k3, v3)
+    return out.reshape(b, h, t, d)
+
+
+def flash_attention(q, k, v, *, causal=True, block_q=128, block_k=128, interpret=None):
+    """(B, H, T, D) scaled-dot-product attention. Dispatches to the Pallas
+    TPU kernel when shapes tile cleanly on a TPU backend; otherwise runs the
+    numerically-identical jnp reference (so `use_flash=True` is always safe —
+    the review contract of dnn_tpu/ops/attention.py)."""
+    t, s_len = q.shape[2], k.shape[2]
+    if causal:
+        block_k = block_q  # diagonal-block masking assumes square tiles
+    on_tpu = jax.default_backend() == "tpu"
+    if interpret is None:
+        interpret = False
+        if not on_tpu:
+            return reference_attention(q, k, v, causal=causal)
+    tiles = t % block_q == 0 and s_len % block_k == 0 and t >= block_q and s_len >= block_k
+    if not tiles or (causal and s_len < t):
+        # s < t causal (queries before the first key) is a degenerate case
+        # the kernel's masking doesn't model — use the reference path.
+        return reference_attention(q, k, v, causal=causal)
+    return _flash_tpu(q, k, v, causal=causal, block_q=block_q, block_k=block_k, interpret=interpret)

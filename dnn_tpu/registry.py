@@ -1,0 +1,75 @@
+"""Model zoo and stage registry.
+
+The reference hard-codes a registry `MODEL_PARTS_CLASSES = {0: ModelPart0_2Node,
+1: ModelPart1_2Node}` (/root/reference/node.py:29-32) that must be hand-edited
+to swap model families (its readme.md:100-108 says exactly that). Here the
+registry is a first-class, config-selected model zoo: each `ModelSpec` knows
+how to init params, run the full model, and partition itself into
+`StageSpec`s for any supported number of pipeline parts.
+
+A StageSpec is the rebuild of the reference's ModelPart* classes
+(cifar_model_parts.py:29-58, partitions/gpt_model_parts.py:6-50): a pure
+function over the slice of the param pytree named by `param_keys` — the
+functional analog of `load_state_dict(strict=False)` keeping only your
+layers (node.py:306).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, Optional, Sequence, Tuple
+
+
+@dataclasses.dataclass(frozen=True)
+class StageSpec:
+    """One pipeline stage: a pure function plus the param keys it owns."""
+
+    name: str
+    apply: Callable[[Any, Any], Any]  # (params_slice, activation) -> activation
+    param_keys: Tuple[str, ...]
+
+    def slice_params(self, full_params):
+        """Keep only this stage's entries of the full param pytree — the
+        functional equivalent of the reference's strict=False per-part
+        state-dict load (node.py:294-317)."""
+        return {k: full_params[k] for k in self.param_keys}
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelSpec:
+    name: str
+    init: Callable[..., Any]  # (rng, **kw) -> params
+    apply: Callable[[Any, Any], Any]  # (params, x) -> y; full model forward
+    partition: Callable[[int], Sequence[StageSpec]]
+    example_input: Callable[..., Any]
+    supported_parts: Tuple[int, ...] = (1, 2)
+    # Optional extras (model-family specific):
+    config: Optional[Any] = None  # e.g. GPTConfig for transformer families
+    extras: Dict[str, Any] = dataclasses.field(default_factory=dict)
+
+
+_REGISTRY: Dict[str, ModelSpec] = {}
+
+
+def register_model(spec: ModelSpec) -> ModelSpec:
+    _REGISTRY[spec.name] = spec
+    return spec
+
+
+def get_model(name: str) -> ModelSpec:
+    # Import built-in families lazily so `import dnn_tpu` stays cheap but
+    # get_model("cifar_cnn") always works.
+    if name not in _REGISTRY:
+        import dnn_tpu.models  # noqa: F401  (registers built-ins)
+    try:
+        return _REGISTRY[name]
+    except KeyError:
+        raise KeyError(
+            f"Unknown model '{name}'. Available: {sorted(_REGISTRY)}"
+        ) from None
+
+
+def available_models():
+    import dnn_tpu.models  # noqa: F401
+
+    return sorted(_REGISTRY)

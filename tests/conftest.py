@@ -1,0 +1,38 @@
+"""Test harness: run every test on a virtual 8-device CPU mesh.
+
+The reference has no tests at all (SURVEY.md §4); its only multi-node story
+is "N localhost processes". The TPU-native analog is N virtual host devices:
+we force the CPU platform with 8 devices *before* JAX initializes, so the
+pipeline/mesh tests (tests/test_pipeline*.py) exercise real
+shard_map/ppermute collectives without TPU hardware.
+"""
+
+import os
+
+# This environment pre-sets JAX_PLATFORMS=axon (the TPU tunnel), which would
+# silently put the whole suite on the one real TPU chip — with bf16-default
+# matmul precision and no multi-device mesh. Worse, `import pytest` already
+# imports jax via a plugin, so env vars alone are too late for platform
+# selection; backend init is lazy though, so jax.config still takes effect.
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "--xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session", autouse=True)
+def _cpu_mesh_guard():
+    """Fail loudly if the suite ever lands on the TPU backend again."""
+    assert jax.default_backend() == "cpu", f"suite must run on CPU, got {jax.default_backend()}"
+    assert len(jax.devices()) >= 8, f"expected >=8 virtual devices, got {jax.devices()}"
+
+
+@pytest.fixture(scope="session")
+def devices():
+    return jax.devices()

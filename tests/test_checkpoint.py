@@ -1,0 +1,100 @@
+"""Checkpoint subsystem: torch-free .pth parsing, format round-trips,
+layout conversion, per-stage slicing."""
+
+import numpy as np
+import pytest
+
+from dnn_tpu import get_model
+from dnn_tpu.io import checkpoint as ckpt
+
+
+@pytest.fixture(scope="module")
+def torch_cifar_sd(tmp_path_factory):
+    torch = pytest.importorskip("torch")
+    import torch.nn as nn
+
+    m = nn.Sequential()
+    m.add_module("conv1", nn.Conv2d(3, 32, 3, 1, 1))
+    m.add_module("conv2", nn.Conv2d(32, 64, 3, 1, 1))
+    m.add_module("fc1", nn.Linear(64 * 8 * 8, 512))
+    m.add_module("fc2", nn.Linear(512, 10))
+    path = tmp_path_factory.mktemp("ckpt") / "cifar10_model.pth"
+    torch.save(m.state_dict(), str(path))
+    return str(path), {k: v.numpy() for k, v in m.state_dict().items()}
+
+
+def test_pth_reader_matches_torch(torch_cifar_sd):
+    """Our zip+pickle reader must reproduce torch.load exactly — this is the
+    rebuild of node.py:296 without a torch dependency."""
+    path, expect = torch_cifar_sd
+    got = ckpt.load_pth_state_dict(path)
+    assert sorted(got) == sorted(expect)
+    for k in expect:
+        np.testing.assert_array_equal(got[k], expect[k])
+
+
+def test_pth_reader_rejects_code(tmp_path):
+    """The restricted unpickler must refuse non-tensor classes."""
+    import pickle
+    import zipfile
+
+    evil = tmp_path / "evil.pth"
+    payload = pickle.dumps(eval)  # builtins.eval reference
+    with zipfile.ZipFile(evil, "w") as zf:
+        zf.writestr("archive/data.pkl", payload)
+    with pytest.raises(Exception):
+        ckpt.load_pth_state_dict(str(evil))
+
+
+def test_npz_roundtrip(tmp_path, torch_cifar_sd):
+    _, sd = torch_cifar_sd
+    p = tmp_path / "m.npz"
+    ckpt.save_npz(str(p), sd)
+    got = ckpt.load_checkpoint(str(p))
+    for k in sd:
+        np.testing.assert_array_equal(got[k], sd[k])
+
+
+def test_safetensors_load(tmp_path, torch_cifar_sd):
+    st = pytest.importorskip("safetensors.numpy")
+    _, sd = torch_cifar_sd
+    p = tmp_path / "m.safetensors"
+    st.save_file(sd, str(p))
+    got = ckpt.load_checkpoint(str(p))
+    for k in sd:
+        np.testing.assert_array_equal(got[k], sd[k])
+
+
+def test_cifar_conversion_and_stage_slicing(torch_cifar_sd):
+    path, _ = torch_cifar_sd
+    params = ckpt.cifar_params_from_torch_state_dict(ckpt.load_pth_state_dict(path))
+    assert params["conv1"]["kernel"].shape == (3, 3, 3, 32)  # HWIO
+    assert params["fc1"]["kernel"].shape == (4096, 512)  # (in, out)
+
+    spec = get_model("cifar_cnn")
+    s0, s1 = spec.partition(2)
+    p0 = ckpt.slice_params_for_stage(params, s0)
+    p1 = ckpt.slice_params_for_stage(params, s1)
+    assert set(p0) == {"conv1", "conv2"} and set(p1) == {"fc1", "fc2"}
+
+
+def test_pth_reader_keeps_scalar_tensors(tmp_path):
+    """0-d tensors (step counters, logit scales) must survive the torch-free
+    reader, matching torch.load."""
+    torch = pytest.importorskip("torch")
+    p = tmp_path / "scalars.pth"
+    torch.save({"step": torch.tensor(7), "scale": torch.tensor(0.5), "w": torch.ones(3)}, str(p))
+    got = ckpt.load_pth_state_dict(str(p))
+    assert set(got) == {"step", "scale", "w"}
+    assert got["step"].shape == () and int(got["step"]) == 7
+    assert float(got["scale"]) == 0.5
+
+
+def test_pth_reader_rejects_non_torch_zip(tmp_path):
+    import zipfile
+
+    p = tmp_path / "notatorch.pth"
+    with zipfile.ZipFile(p, "w") as zf:
+        zf.writestr("hello.txt", "hi")
+    with pytest.raises(ValueError, match="data.pkl"):
+        ckpt.load_pth_state_dict(str(p))

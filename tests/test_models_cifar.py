@@ -1,0 +1,114 @@
+"""CIFAR CNN family: shapes, partition-vs-full parity, registry.
+
+The key invariant is the one implied (but never tested) by the reference:
+composing the split parts must reproduce the full model bit-for-bit
+(cifar_model_parts.py:18-26 vs :37-42 + :53-58; SURVEY.md §4).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dnn_tpu import get_model
+from dnn_tpu.models import cifar
+
+
+@pytest.fixture(scope="module")
+def cifar_setup():
+    spec = get_model("cifar_cnn")
+    params = spec.init(jax.random.PRNGKey(0))
+    x = spec.example_input(batch_size=4, rng=jax.random.PRNGKey(1))
+    return spec, params, x
+
+
+def test_full_forward_shape_and_probs(cifar_setup):
+    spec, params, x = cifar_setup
+    y = spec.apply(params, x)
+    assert y.shape == (4, 10)
+    # softmax output (reference applies Softmax(dim=1) in-model,
+    # cifar_model_parts.py:15,25)
+    np.testing.assert_allclose(np.asarray(y).sum(axis=1), np.ones(4), rtol=1e-5)
+    assert (np.asarray(y) >= 0).all()
+
+
+@pytest.mark.parametrize("num_parts", [1, 2, 3, 4])
+def test_partition_parity(cifar_setup, num_parts):
+    """Composed stages == full model, exactly."""
+    spec, params, x = cifar_setup
+    stages = spec.partition(num_parts)
+    assert len(stages) == num_parts
+    h = x
+    for stage in stages:
+        h = stage.apply(stage.slice_params(params), h)
+    np.testing.assert_array_equal(np.asarray(h), np.asarray(spec.apply(params, x)))
+
+
+def test_two_way_split_boundary(cifar_setup):
+    """The 2-way split happens at the flatten boundary with a (B, 4096)
+    activation, exactly like the reference (cifar_model_parts.py:41)."""
+    spec, params, x = cifar_setup
+    s0, s1 = spec.partition(2)
+    act = s0.apply(s0.slice_params(params), x)
+    assert act.shape == (4, cifar.FLAT_FEATURES)
+    assert set(s0.param_keys) == {"conv1", "conv2"}
+    assert set(s1.param_keys) == {"fc1", "fc2"}
+
+
+def test_param_keys_cover_model_exactly(cifar_setup):
+    spec, params, _ = cifar_setup
+    for n in (1, 2, 3, 4):
+        keys = [k for s in spec.partition(n) for k in s.param_keys]
+        assert sorted(keys) == sorted(params.keys())
+        assert len(set(keys)) == len(keys)  # no param owned by two stages
+
+
+def test_unsupported_parts_raises(cifar_setup):
+    spec, _, _ = cifar_setup
+    with pytest.raises(ValueError):
+        spec.partition(5)
+
+
+def test_jit_forward(cifar_setup):
+    spec, params, x = cifar_setup
+    jy = jax.jit(spec.apply)(params, x)
+    np.testing.assert_allclose(np.asarray(jy), np.asarray(spec.apply(params, x)), rtol=1e-6)
+
+
+def test_torch_numerical_parity():
+    """Cross-framework check: our NHWC functional CNN must match a torch
+    NCHW model built exactly like the reference's NeuralNetwork
+    (cifar_model_parts.py:6-25) when given the converted weights."""
+    torch = pytest.importorskip("torch")
+    import torch.nn as nn
+
+    class RefNet(nn.Module):
+        # Same architecture as /root/reference/cifar_model_parts.py:6-16
+        # (re-typed, not copied: conv-pool-conv-pool-fc-fc-softmax).
+        def __init__(self):
+            super().__init__()
+            self.conv1 = nn.Conv2d(3, 32, 3, 1, 1)
+            self.pool = nn.MaxPool2d(2, 2)
+            self.conv2 = nn.Conv2d(32, 64, 3, 1, 1)
+            self.fc1 = nn.Linear(64 * 8 * 8, 512)
+            self.fc2 = nn.Linear(512, 10)
+
+        def forward(self, x):
+            x = self.pool(torch.relu(self.conv1(x)))
+            x = self.pool(torch.relu(self.conv2(x)))
+            x = x.reshape(-1, 64 * 8 * 8)
+            x = torch.relu(self.fc1(x))
+            return torch.softmax(self.fc2(x), dim=1)
+
+    from dnn_tpu.io.checkpoint import cifar_params_from_torch_state_dict
+
+    tmodel = RefNet().eval()
+    params = cifar_params_from_torch_state_dict(
+        {k: v.numpy() for k, v in tmodel.state_dict().items()}
+    )
+    xt = torch.randn(2, 3, 32, 32)
+    with torch.no_grad():
+        yt = tmodel(xt).numpy()
+    xj = jnp.asarray(xt.numpy().transpose(0, 2, 3, 1))  # NCHW -> NHWC
+    yj = np.asarray(get_model("cifar_cnn").apply(params, xj))
+    np.testing.assert_allclose(yj, yt, atol=1e-5)
