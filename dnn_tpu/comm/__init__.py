@@ -1,0 +1,4 @@
+from dnn_tpu.comm.service import StageServer, serve_stage
+from dnn_tpu.comm.client import NodeClient
+
+__all__ = ["StageServer", "serve_stage", "NodeClient"]

@@ -1,0 +1,71 @@
+"""gRPC edge client — the initiator role.
+
+Rebuilds the reference's node-0 client path (initiate_inference,
+node.py:137-200): run the local stage, send the activation downstream, wait
+for the result to ride back up the response chain, return the final tensor.
+Adds what the reference lacked: a real HealthCheck probe before submitting
+(its HealthCheck had no caller — SURVEY §3.4) and channel reuse.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Optional
+
+import grpc
+import numpy as np
+
+from dnn_tpu.comm import wire_pb2 as pb
+from dnn_tpu.comm.service import SERVICE_NAME, _tensor_arr, _tensor_msg
+
+log = logging.getLogger("dnn_tpu.comm")
+
+
+class NodeClient:
+    """Sync client for a NodeService endpoint (ours or a reference node's —
+    the wire protocol is identical)."""
+
+    def __init__(self, address: str):
+        self.address = address
+        self._channel = grpc.insecure_channel(address)
+
+    def health_check(self, timeout: float = 5.0) -> bool:
+        call = self._channel.unary_unary(
+            f"/{SERVICE_NAME}/HealthCheck",
+            request_serializer=pb.Empty.SerializeToString,
+            response_deserializer=pb.HealthCheckResponse.FromString,
+        )
+        try:
+            return bool(call(pb.Empty(), timeout=timeout).is_healthy)
+        except grpc.RpcError:
+            return False
+
+    def send_message(self, sender_id: str, text: str, timeout: float = 5.0) -> str:
+        call = self._channel.unary_unary(
+            f"/{SERVICE_NAME}/SendMessage",
+            request_serializer=pb.MessageRequest.SerializeToString,
+            response_deserializer=pb.MessageReply.FromString,
+        )
+        return call(
+            pb.MessageRequest(sender_id=sender_id, message_text=text), timeout=timeout
+        ).confirmation_text
+
+    def send_tensor(
+        self, arr: np.ndarray, *, request_id: str = "req", timeout: float = 60.0
+    ) -> tuple[str, Optional[np.ndarray]]:
+        """Submit an activation; returns (status, final_tensor_or_None) —
+        the response-chain semantics of node.py:180-194."""
+        call = self._channel.unary_unary(
+            f"/{SERVICE_NAME}/SendTensor",
+            request_serializer=pb.TensorRequest.SerializeToString,
+            response_deserializer=pb.TensorResponse.FromString,
+        )
+        resp = call(
+            pb.TensorRequest(request_id=request_id, tensor=_tensor_msg(arr)),
+            timeout=timeout,
+        )
+        result = _tensor_arr(resp.result_tensor) if resp.HasField("result_tensor") else None
+        return resp.status, result
+
+    def close(self):
+        self._channel.close()

@@ -316,6 +316,44 @@ def gpt_params_from_state_dict(sd: Dict[str, np.ndarray], n_layer: Optional[int]
 
 
 # ----------------------------------------------------------------------
+# native (framework-own) flat format
+# ----------------------------------------------------------------------
+
+_SEP = "/"
+
+
+def params_to_flat(params, prefix="") -> Dict[str, np.ndarray]:
+    """Nested param pytree -> flat {"a/b/c": array} dict, the framework's
+    own checkpoint layout (saved via save_npz / safetensors). This is the
+    save-side capability the reference lacks entirely (load-only — SURVEY
+    §5 'Checkpoint / resume')."""
+    out = {}
+    if isinstance(params, dict):
+        for k, v in params.items():
+            key = f"{prefix}{_SEP}{k}" if prefix else str(k)
+            out.update(params_to_flat(v, key))
+    else:
+        out[prefix] = np.asarray(params)
+    return out
+
+
+def flat_to_params(flat: Dict[str, np.ndarray]):
+    """Inverse of params_to_flat."""
+    tree: Dict[str, Any] = {}
+    for key, v in flat.items():
+        parts = key.split(_SEP)
+        node = tree
+        for p in parts[:-1]:
+            node = node.setdefault(p, {})
+        node[parts[-1]] = v
+    return tree
+
+
+def is_native_flat(sd: Dict[str, np.ndarray]) -> bool:
+    return bool(sd) and all(_SEP in k or "." not in k for k in sd)
+
+
+# ----------------------------------------------------------------------
 # per-stage slicing
 # ----------------------------------------------------------------------
 

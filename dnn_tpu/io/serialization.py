@@ -1,0 +1,48 @@
+"""Tensor wire codec.
+
+The reference serializes activations as raw little-endian numpy bytes plus
+a (shape, dtype-string) header carried in its protobuf `Tensor` message
+(node_service.proto:26-30; encode node.py:64-68, decode node.py:45-48) —
+with no endianness handling and no integrity check. This codec keeps the
+same wire triple (bytes, shape, dtype) for compatibility, normalizes to
+little-endian explicitly, supports bf16 (which numpy only has via
+ml_dtypes), and validates payload length against shape*itemsize instead of
+letting `reshape` throw.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import numpy as np
+
+
+def _np_dtype(name: str) -> np.dtype:
+    if name == "bfloat16":
+        import ml_dtypes
+
+        return np.dtype(ml_dtypes.bfloat16)
+    return np.dtype(name)
+
+
+def encode_tensor(arr) -> Tuple[bytes, Tuple[int, ...], str]:
+    """array -> (payload, shape, dtype_name), little-endian payload."""
+    a = np.asarray(arr)
+    if a.dtype.byteorder == ">":
+        a = a.astype(a.dtype.newbyteorder("<"))
+    shape = tuple(a.shape)  # before ascontiguousarray, which promotes 0-d to 1-d
+    a = np.ascontiguousarray(a)
+    return a.tobytes(), shape, a.dtype.name
+
+
+def decode_tensor(payload: bytes, shape: Sequence[int], dtype: str) -> np.ndarray:
+    """(payload, shape, dtype_name) -> array, with length validation."""
+    dt = _np_dtype(dtype)
+    shape = tuple(int(s) for s in shape)
+    expect = int(np.prod(shape)) * dt.itemsize if shape else dt.itemsize
+    if len(payload) != expect:
+        raise ValueError(
+            f"tensor payload is {len(payload)} bytes but shape {shape} "
+            f"dtype {dtype} needs {expect}"
+        )
+    return np.frombuffer(payload, dtype=dt).reshape(shape).copy()

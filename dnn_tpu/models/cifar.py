@@ -149,6 +149,12 @@ def example_input(batch_size=1, rng=None):
     return jax.random.normal(rng, (batch_size, *IMAGE_SHAPE), jnp.float32)
 
 
+def _convert_state_dict(sd):
+    from dnn_tpu.io.checkpoint import cifar_params_from_torch_state_dict
+
+    return cifar_params_from_torch_state_dict(sd)
+
+
 register_model(
     ModelSpec(
         name="cifar_cnn",
@@ -157,5 +163,6 @@ register_model(
         partition=partition,
         example_input=example_input,
         supported_parts=tuple(sorted(_PARTITIONS)),
+        convert_state_dict=_convert_state_dict,
     )
 )

@@ -278,6 +278,11 @@ def make_example_input(cfg: GPTConfig):
 
 
 def _register(name: str, cfg: GPTConfig):
+    def convert(sd, _cfg=cfg):
+        from dnn_tpu.io.checkpoint import gpt_params_from_state_dict
+
+        return gpt_params_from_state_dict(sd, n_layer=_cfg.n_layer)
+
     register_model(
         ModelSpec(
             name=name,
@@ -286,7 +291,19 @@ def _register(name: str, cfg: GPTConfig):
             partition=make_partition(cfg),
             example_input=make_example_input(cfg),
             supported_parts=tuple(range(1, cfg.n_layer + 1)),
+            convert_state_dict=convert,
             config=cfg,
+            extras={
+                # dtype/flash-aware factories so the engine can honor the
+                # config's `dtype` key (make_apply/make_partition above are
+                # the f32 defaults).
+                "make_apply": lambda compute_dtype=None, use_flash=False, _cfg=cfg: make_apply(
+                    _cfg, compute_dtype=compute_dtype, use_flash=use_flash
+                ),
+                "make_partition": lambda compute_dtype=None, use_flash=False, _cfg=cfg: make_partition(
+                    _cfg, compute_dtype=compute_dtype, use_flash=use_flash
+                ),
+            },
         )
     )
 

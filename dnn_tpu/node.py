@@ -1,0 +1,161 @@
+"""Node CLI — drop-in replacement for the reference's entrypoint.
+
+Same flags as /root/reference/node.py:212-216:
+
+    python -m dnn_tpu.node --node_id node1 --config ./config.json \
+        [--input_image img.png] [--serve] [--log_level INFO]
+
+Behavior by mode:
+
+  * Default (TPU single-controller): the whole pipeline runs on the local
+    mesh — `part_index` maps to stage coordinates, hops are ppermute, and
+    if `--input_image` is given the client path runs end to end and prints
+    `FINAL PREDICTION (Index): N` exactly like node.py:192. The reference
+    needed N machines + N terminals for this; here one process does it
+    with zero gRPC hops (BASELINE.json north star).
+
+  * `--serve` (distributed edge mode): behave like one reference node —
+    host this node's stage behind the gRPC NodeService and relay to
+    `next_node` by address. Wire-compatible with reference nodes. In this
+    mode a node with part_index 0 and `--input_image` also initiates
+    inference after a short delay (node.py:203-207,332-337).
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import logging
+import sys
+
+import numpy as np
+
+from dnn_tpu.config import TopologyConfig
+from dnn_tpu.io.preprocess import load_image_or_dummy
+from dnn_tpu.runtime.engine import PipelineEngine
+from dnn_tpu.utils.logging import setup_logging
+
+log = logging.getLogger("dnn_tpu.node")
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="dnn_tpu.node",
+        description="Run a pipeline node / the whole pipeline (reference-compatible CLI)",
+    )
+    p.add_argument("--node_id", required=True, help="Unique ID for this node (e.g. node1)")
+    p.add_argument("--config", required=True, help="Path to the JSON configuration file")
+    p.add_argument("--input_image", help="Input image path (part_index 0 initiates inference)")
+    p.add_argument("--serve", action="store_true",
+                   help="Host this node's stage behind gRPC (reference-interop mode)")
+    p.add_argument("--log_level", default="INFO")
+    return p
+
+
+def _initiate_local(engine: PipelineEngine, image_path: str) -> int:
+    """Single-controller client path: preprocess -> full pipeline -> argmax
+    (rebuilds initiate_inference, node.py:137-200, minus the RPCs)."""
+    x, used_dummy = load_image_or_dummy(image_path)
+    if used_dummy:
+        log.warning("input image unavailable; using dummy data (node.py:149-154 behavior)")
+    pred = engine.predict(x)
+    print(f"***** FINAL PREDICTION (Index): {pred} *****")
+    return pred
+
+
+async def _initiate_edge(engine: PipelineEngine, node_id: str, image_path: str, delay: float = 2.0):
+    """Edge-mode initiator: run stage 0 locally, relay downstream over gRPC
+    (start_inference_after_delay + initiate_inference, node.py:137-207).
+
+    The sync gRPC client calls run in a thread executor so this node's own
+    server handlers stay responsive while the pipeline round-trip is in
+    flight (the reference simply blocks inside one event loop, node.py:181).
+    """
+    from dnn_tpu.comm.client import NodeClient
+
+    await asyncio.sleep(delay)
+    loop = asyncio.get_running_loop()
+    cfg = engine.config
+    me = cfg.node_by_id(node_id)
+    nxt = cfg.next_node(me)
+    x, used_dummy = load_image_or_dummy(image_path)
+    if used_dummy:
+        log.warning("input image unavailable; using dummy data")
+    y = np.asarray(engine.run_stage(me.part_index, x))
+    if nxt is None:
+        print(f"***** FINAL PREDICTION (Index): {int(np.argmax(y))} *****")
+        return
+    client = NodeClient(nxt.address)
+    if not await loop.run_in_executor(None, client.health_check):
+        log.error("next node %s failed health check", nxt.address)
+        return
+    status, result = await loop.run_in_executor(
+        None, lambda: client.send_tensor(y, request_id="dnn_tpu_pipe_001")
+    )
+    log.info("pipeline status: %s", status)
+    if result is not None:
+        print(f"***** FINAL PREDICTION (Index): {int(np.argmax(result))} *****")
+    else:
+        log.error("no result tensor in response chain")
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    setup_logging(args.log_level, node_id=args.node_id)
+
+    try:
+        config = TopologyConfig.from_json(args.config)
+    except FileNotFoundError:
+        log.error("Config file not found at '%s'", args.config)
+        return 1
+    except (ValueError, KeyError) as e:
+        log.error("Invalid config '%s': %s", args.config, e)
+        return 1
+
+    try:
+        me = config.node_by_id(args.node_id)
+    except KeyError as e:
+        log.error("%s", e)
+        return 1
+
+    # --serve hosts ONE stage (the reference's per-node role): build the
+    # engine in stage role so an 8-part config serves fine from a 1-device
+    # host; full role only when this process drives the whole pipeline.
+    try:
+        engine = PipelineEngine(config, role="stage" if args.serve else "full")
+    except ValueError as e:
+        log.error("engine construction failed: %s", e)
+        return 1
+    log.info(
+        "node=%s part=%d/%d runtime=%s model=%s",
+        me.id, me.part_index, config.num_parts - 1, engine.runtime, config.model,
+    )
+
+    if args.serve:
+        from dnn_tpu.comm.service import serve_stage
+
+        async def _run():
+            tasks = [asyncio.create_task(serve_stage(engine, args.node_id))]
+            if me.part_index == 0 and args.input_image:
+                tasks.append(asyncio.create_task(
+                    _initiate_edge(engine, args.node_id, args.input_image)
+                ))
+            await asyncio.gather(*tasks)
+
+        try:
+            asyncio.run(_run())
+        except KeyboardInterrupt:
+            log.info("shutting down")
+        return 0
+
+    # single-controller mode
+    if args.input_image or me.part_index == 0:
+        _initiate_local(engine, args.input_image)
+    else:
+        log.info("nothing to do for non-initiator node in single-controller mode "
+                 "(use --serve for distributed edge mode)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

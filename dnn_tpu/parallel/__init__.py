@@ -1,0 +1,16 @@
+from dnn_tpu.parallel.mesh import make_mesh, mesh_from_config
+from dnn_tpu.parallel.pipeline import (
+    RelayExecutor,
+    spmd_pipeline,
+    split_microbatches,
+    merge_microbatches,
+)
+
+__all__ = [
+    "make_mesh",
+    "mesh_from_config",
+    "RelayExecutor",
+    "spmd_pipeline",
+    "split_microbatches",
+    "merge_microbatches",
+]

@@ -1,0 +1,68 @@
+"""Thin, named wrappers over XLA collectives.
+
+This module is the rebuild of the reference's entire communication layer
+(gRPC `SendTensor` unary RPCs with raw numpy payloads and a fresh insecure
+channel per hop — node.py:70-94, node_service.proto:26-35): one stage->stage
+activation hop becomes a single `CollectivePermute` over ICI, and the
+"return the result to the first node" path (config.json:17, dead code in the
+reference — SURVEY §3.3) becomes a ring shift back to coordinate 0.
+"""
+
+from __future__ import annotations
+
+import jax
+from jax import lax
+
+
+def axis_index(axis_name: str):
+    return lax.axis_index(axis_name)
+
+
+def axis_size(axis_name: str):
+    return lax.axis_size(axis_name)
+
+
+def shift_right(x, axis_name: str, *, wrap: bool = False):
+    """Send x from stage i to stage i+1 (the SendTensor hop, node.py:70-85).
+    Non-wrapping by default: stage 0 receives zeros, like having no
+    predecessor."""
+    n = lax.axis_size(axis_name)
+    perm = [(i, i + 1) for i in range(n - 1)]
+    if wrap:
+        perm.append((n - 1, 0))
+    return lax.ppermute(x, axis_name, perm)
+
+
+def shift_left(x, axis_name: str, *, wrap: bool = False):
+    n = lax.axis_size(axis_name)
+    perm = [(i + 1, i) for i in range(n - 1)]
+    if wrap:
+        perm.append((0, n - 1))
+    return lax.ppermute(x, axis_name, perm)
+
+
+def rotate(x, axis_name: str, offset: int = 1):
+    """Circular shift by `offset` along the axis (ring-attention building
+    block: K/V blocks travel the ring one hop per step)."""
+    n = lax.axis_size(axis_name)
+    perm = [(i, (i + offset) % n) for i in range(n)]
+    return lax.ppermute(x, axis_name, perm)
+
+
+def from_last_to_first(x, axis_name: str):
+    """Move a value from the last stage to stage 0 — the working version of
+    the reference's never-dialed `return_to_node_id` (node.py:272-277)."""
+    n = lax.axis_size(axis_name)
+    return lax.ppermute(x, axis_name, [(n - 1, 0)])
+
+
+def psum(x, axis_name: str):
+    return lax.psum(x, axis_name)
+
+
+def all_gather(x, axis_name: str, *, axis=0, tiled=False):
+    return lax.all_gather(x, axis_name, axis=axis, tiled=tiled)
+
+
+def reduce_scatter(x, axis_name: str, *, scatter_dimension=0):
+    return lax.psum_scatter(x, axis_name, scatter_dimension=scatter_dimension, tiled=True)

@@ -1,0 +1,309 @@
+"""Pipeline-parallel runtimes.
+
+Two executors for the reference's core capability — "split a model into
+sequential parts, run each part on a different device, relay activations"
+(readme.md:1-3, node.py:35-105) — redesigned for TPU:
+
+1. `RelayExecutor` — device-per-stage sequential relay. Execution semantics
+   identical to the reference (one request traverses the chain, stage i+1
+   starts after stage i finishes — SURVEY §3.3), but each hop is a
+   device-to-device transfer of a jit output instead of a gRPC unary RPC
+   with numpy-bytes payloads. Handles arbitrarily heterogeneous stages.
+
+2. `spmd_pipeline` — the TPU-native fast path. One SPMD program over a
+   Mesh "stage" axis: every device runs the same compiled step; activations
+   move stage->stage with `lax.ppermute` (XLA CollectivePermute over ICI);
+   microbatches flow in a GPipe schedule (M microbatches through S stages in
+   M+S-1 steps, all stages busy in steady state). The reference cannot
+   overlap stages at all — its nested-RPC design holds every hop open for
+   the full downstream latency (node.py:84, SURVEY §3.3).
+
+Heterogeneous stages are uniformized for SPMD by flattening + zero-padding
+activations to one (microbatch, F) f32 buffer and `lax.switch`-ing on the
+stage coordinate; homogeneous stacks (transformer blocks) should use
+`spmd_pipeline` with `stacked_params` instead, which shards one block's
+params per stage and skips the switch entirely.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from dnn_tpu.parallel.mesh import STAGE_AXIS
+
+
+# ----------------------------------------------------------------------
+# microbatch helpers
+# ----------------------------------------------------------------------
+
+def split_microbatches(x, num_microbatches: int):
+    """(B, ...) -> (M, B//M, ...). The reference has no microbatching (batch
+    size 1 end to end, node.py:147,151); this is the upgrade that makes the
+    pipeline actually parallel."""
+    b = x.shape[0]
+    if b % num_microbatches != 0:
+        raise ValueError(f"batch {b} not divisible by microbatches {num_microbatches}")
+    return x.reshape(num_microbatches, b // num_microbatches, *x.shape[1:])
+
+
+def merge_microbatches(y):
+    return y.reshape(y.shape[0] * y.shape[1], *y.shape[2:])
+
+
+# ----------------------------------------------------------------------
+# 1. relay executor (reference semantics, TPU devices)
+# ----------------------------------------------------------------------
+
+class RelayExecutor:
+    """Sequential stage relay across explicit devices.
+
+    Mirrors the reference pipeline one-to-one: stage i's jitted program runs
+    on device i, the output is handed to device i+1 (XLA device-to-device
+    copy — the rebuilt SendTensor hop), and the final output returns to the
+    host (the rebuilt result_tensor response chain, node.py:88-105).
+    """
+
+    def __init__(self, stage_fns: Sequence[Callable], stage_params: Sequence[Any], devices=None):
+        if len(stage_fns) != len(stage_params):
+            raise ValueError("one params pytree per stage required")
+        devices = list(devices if devices is not None else jax.devices())
+        self.devices = [devices[i % len(devices)] for i in range(len(stage_fns))]
+        # Params are committed to their stage's device once, at load time —
+        # the HBM-resident analog of each node loading its slice at startup
+        # (node.py:294-317).
+        self.stage_params = [
+            jax.device_put(p, d) for p, d in zip(stage_params, self.devices)
+        ]
+        self.stage_fns = [jax.jit(fn) for fn in stage_fns]
+        self.last_hop_times: Optional[List[float]] = None
+
+    def __call__(self, x, *, record_timings: bool = False):
+        timings = [] if record_timings else None
+        for fn, params, dev in zip(self.stage_fns, self.stage_params, self.devices):
+            t0 = time.perf_counter() if record_timings else 0.0
+            x = fn(params, jax.device_put(x, dev))
+            if record_timings:
+                x.block_until_ready()
+                timings.append(time.perf_counter() - t0)
+        self.last_hop_times = timings
+        return x
+
+
+# ----------------------------------------------------------------------
+# 2. SPMD microbatched pipeline (shard_map + ppermute)
+# ----------------------------------------------------------------------
+
+def _flat_size(shape) -> int:
+    return int(np.prod(shape)) if shape else 1
+
+
+def _pad_flat(y, width):
+    flat = y.reshape(y.shape[0], -1).astype(jnp.float32)
+    return jnp.pad(flat, ((0, 0), (0, width - flat.shape[1])))
+
+
+def _unpad(buf, shape, dtype):
+    mb = buf.shape[0]
+    flat = buf[:, : _flat_size(shape[1:])]
+    return flat.reshape(mb, *shape[1:]).astype(dtype)
+
+
+def _stage_shapes(stage_fns, stage_params, x_shape_dtype):
+    """Trace per-stage input/output shapes (static — the reference discovers
+    them at runtime from the wire header, node_service.proto:28-29)."""
+    shapes = [x_shape_dtype]
+    for fn, p in zip(stage_fns, stage_params):
+        out = jax.eval_shape(fn, p, shapes[-1])
+        shapes.append(jax.ShapeDtypeStruct(out.shape, out.dtype))
+    return shapes
+
+
+def _gpipe_loop(
+    stage_step, inputs_buf, num_stages, num_microbatches, mb, width_hop, width_out, axis_name
+):
+    """The schedule, run per-device inside shard_map: at step t, stage d
+    works on microbatch t-d; outputs hop to d+1 via ppermute.
+
+    `stage_step(buf) -> (hop, out)`: `hop` (mb, width_hop) feeds the next
+    stage; `out` (mb, width_out) is the pipeline product, only meaningful on
+    the last stage. Hop and output widths are separate on purpose — for LM
+    pipelines the final logits are ~vocab/hidden times wider than the
+    inter-stage activations, and must never ride the ppermute ring.
+    """
+    m_count = num_microbatches
+    steps = m_count + num_stages - 1
+    d = lax.axis_index(axis_name)
+    is_last = d == num_stages - 1
+
+    out_buf = jnp.zeros((m_count + 1, mb, width_out), jnp.float32)  # slot M = scratch
+    buf0 = inputs_buf[0]
+
+    def step(carry, t):
+        buf, out = carry
+        hop_y, out_y = stage_step(buf)
+
+        # collect on the last stage: microbatch m = t - (S-1)
+        m = t - (num_stages - 1)
+        valid = jnp.logical_and(is_last, jnp.logical_and(m >= 0, m < m_count))
+        write_idx = jnp.where(valid, jnp.clip(m, 0, m_count - 1), m_count)
+        out = lax.dynamic_update_index_in_dim(out, out_y, write_idx, 0)
+
+        # hop: my output becomes stage d+1's next input
+        recv = lax.ppermute(hop_y, axis_name, [(i, i + 1) for i in range(num_stages - 1)])
+        nxt = jnp.clip(t + 1, 0, m_count - 1)
+        fresh = lax.dynamic_index_in_dim(inputs_buf, nxt, 0, keepdims=False)
+        buf = jnp.where(d == 0, fresh, recv)
+        return (buf, out), None
+
+    (_, out_buf), _ = lax.scan(step, (buf0, out_buf), jnp.arange(steps))
+    out = out_buf[:m_count]
+    # only the last stage holds real data; replicate it to everyone
+    return lax.psum(jnp.where(is_last, out, jnp.zeros_like(out)), axis_name)
+
+
+def spmd_pipeline(
+    stage_fns: Sequence[Callable],
+    stage_params: Sequence[Any],
+    x,
+    *,
+    mesh: Mesh,
+    num_microbatches: int = 1,
+    axis_name: str = STAGE_AXIS,
+):
+    """Heterogeneous-stage SPMD pipeline.
+
+    All ranks run one program; each applies its own stage via `lax.switch`
+    on the stage coordinate. Activations ride a uniform padded f32 buffer
+    (ppermute needs one shape on every rank — the SPMD answer to the
+    reference's per-hop dynamic wire shapes). Integer inputs (token ids) are
+    carried exactly: f32 holds ints < 2^24 losslessly.
+
+    Memory note: because `lax.switch` branches embed every stage's params,
+    this path replicates all weights on all devices — right for small or
+    awkwardly heterogeneous models (the CIFAR CNN). Deep homogeneous models
+    should pipeline their block stack through `spmd_pipeline_stacked`
+    (per-stage HBM-resident weights) and keep embed/head outside, as
+    PipelineEngine does for the GPT family.
+
+    Returns the final stage's output with microbatches re-merged.
+    """
+    num_stages = len(stage_fns)
+    if mesh.shape[axis_name] != num_stages:
+        raise ValueError(
+            f"mesh axis '{axis_name}' has size {mesh.shape[axis_name]}, "
+            f"need {num_stages} (one device per stage)"
+        )
+
+    x_mb = split_microbatches(x, num_microbatches)
+    mb = x_mb.shape[1]
+    shapes = _stage_shapes(
+        stage_fns, stage_params, jax.ShapeDtypeStruct(x_mb.shape[1:], x_mb.dtype)
+    )
+    # Hop buffer carries stage INPUTS (shapes[0..S-1]); the final output
+    # (often vocab-wide logits) gets its own width and never rides the ring.
+    width_hop = max(_flat_size(s.shape[1:]) for s in shapes[:-1])
+    width_out = _flat_size(shapes[-1].shape[1:])
+    out_shape, out_dtype = shapes[-1].shape, shapes[-1].dtype
+
+    inputs_buf = _pad_flat(x_mb.reshape(num_microbatches * mb, -1), width_hop).reshape(
+        num_microbatches, mb, width_hop
+    )
+
+    def make_branch(i):
+        fn, in_s, in_dt = stage_fns[i], shapes[i].shape, shapes[i].dtype
+        is_last = i == num_stages - 1
+
+        def branch(buf):
+            xin = _unpad(buf, (mb, *in_s[1:]) if len(in_s) > 0 else (mb,), in_dt)
+            y = fn(stage_params[i], xin)
+            if is_last:
+                return jnp.zeros((mb, width_hop), jnp.float32), _pad_flat(y, width_out)
+            return _pad_flat(y, width_hop), jnp.zeros((mb, width_out), jnp.float32)
+
+        return branch
+
+    branches = [make_branch(i) for i in range(num_stages)]
+
+    def per_device(inputs):
+        d = lax.axis_index(axis_name)
+
+        def stage_step(buf):
+            return lax.switch(d, branches, buf)
+
+        return _gpipe_loop(
+            stage_step, inputs, num_stages, num_microbatches, mb,
+            width_hop, width_out, axis_name,
+        )
+
+    result = jax.shard_map(
+        per_device, mesh=mesh, in_specs=P(), out_specs=P(), check_vma=False
+    )(inputs_buf)
+
+    y = _unpad(
+        result.reshape(num_microbatches * mb, width_out),
+        (num_microbatches * mb, *out_shape[1:]),
+        out_dtype,
+    )
+    return y
+
+
+def spmd_pipeline_stacked(
+    block_fn: Callable,
+    stacked_params,
+    x,
+    *,
+    mesh: Mesh,
+    num_microbatches: int = 1,
+    axis_name: str = STAGE_AXIS,
+):
+    """Homogeneous-stage SPMD pipeline over stacked params.
+
+    `stacked_params` has a leading stage axis (S, ...) that lives sharded
+    P('stage', ...) — each device holds only its own stage's slice (the
+    HBM-resident per-stage weights of BASELINE.json's north star). No
+    switch, no padding: this is the fast path for transformer block stacks.
+    `block_fn(params_slice, x) -> y` must map (mb, ...) -> (mb, ...) with an
+    unchanged shape.
+    """
+    num_stages = mesh.shape[axis_name]
+    x_mb = split_microbatches(x, num_microbatches)
+    mb = x_mb.shape[1]
+
+    param_specs = jax.tree.map(lambda _: P(axis_name), stacked_params)
+    stacked_params = jax.device_put(
+        stacked_params, NamedSharding(mesh, P(axis_name))
+    )
+
+    # flatten trailing dims into the buffer width for the generic loop
+    trail = x_mb.shape[2:]
+    flat = x_mb.reshape(num_microbatches, mb, -1).astype(jnp.float32)
+
+    def per_device_wrapped(params, inputs):
+        local = jax.tree.map(lambda p: p[0], params)
+
+        def stage_step(buf):
+            xin = buf.reshape(mb, *trail)
+            y = block_fn(local, xin).reshape(mb, -1).astype(jnp.float32)
+            return y, y  # uniform shapes: hop and output coincide
+
+        return _gpipe_loop(
+            stage_step, inputs, num_stages, num_microbatches, mb,
+            flat.shape[-1], flat.shape[-1], axis_name,
+        )
+
+    result = jax.shard_map(
+        per_device_wrapped,
+        mesh=mesh,
+        in_specs=(param_specs, P()),
+        out_specs=P(),
+        check_vma=False,
+    )(stacked_params, flat)
+
+    return result.reshape(num_microbatches * mb, *trail)

@@ -43,6 +43,10 @@ class ModelSpec:
     partition: Callable[[int], Sequence[StageSpec]]
     example_input: Callable[..., Any]
     supported_parts: Tuple[int, ...] = (1, 2)
+    # Convert a foreign flat state dict (torch/HF names+layouts) into this
+    # family's param pytree — the torch->TPU half of the reference's
+    # torch.load path (node.py:296).
+    convert_state_dict: Optional[Callable[[Dict[str, Any]], Any]] = None
     # Optional extras (model-family specific):
     config: Optional[Any] = None  # e.g. GPTConfig for transformer families
     extras: Dict[str, Any] = dataclasses.field(default_factory=dict)

@@ -1,0 +1,3 @@
+from dnn_tpu.runtime.engine import PipelineEngine
+
+__all__ = ["PipelineEngine"]

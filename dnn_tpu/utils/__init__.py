@@ -1,0 +1,3 @@
+from dnn_tpu.utils.logging import setup_logging
+
+__all__ = ["setup_logging"]

@@ -1,0 +1,43 @@
+"""Structured logging.
+
+Replaces the reference's bare print() calls with `[{NODE_ID}]` prefixes
+scattered through every code path (e.g. node.py:38-39, 120-122, 280-290 —
+SURVEY §5 'Metrics / logging': stdout prints only, no levels, no files)
+with stdlib logging: leveled, timestamped, and still carrying the node-id
+prefix so operators see the familiar shape.
+"""
+
+from __future__ import annotations
+
+import logging
+import sys
+from typing import Optional
+
+
+class _NodeFilter(logging.Filter):
+    def __init__(self, node_id: str):
+        super().__init__()
+        self.node_id = node_id
+
+    def filter(self, record):
+        record.node_id = self.node_id
+        return True
+
+
+def setup_logging(level: str = "INFO", *, node_id: Optional[str] = None, stream=None):
+    root = logging.getLogger("dnn_tpu")
+    root.setLevel(getattr(logging, str(level).upper(), logging.INFO))
+    root.handlers.clear()
+    handler = logging.StreamHandler(stream or sys.stderr)
+    prefix = "[%(node_id)s] " if node_id else ""
+    handler.setFormatter(
+        logging.Formatter(
+            f"%(asctime)s %(levelname)s %(name)s: {prefix}%(message)s",
+            datefmt="%H:%M:%S",
+        )
+    )
+    if node_id:
+        handler.addFilter(_NodeFilter(node_id))
+    root.addHandler(handler)
+    root.propagate = False
+    return root

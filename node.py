@@ -1,0 +1,11 @@
+#!/usr/bin/env python
+"""Drop-in entrypoint shim: `python node.py --node_id X --config Y
+[--input_image Z]` — the reference framework's invocation (readme.md:82-95)
+— forwards to the dnn_tpu CLI."""
+
+import sys
+
+from dnn_tpu.node import main
+
+if __name__ == "__main__":
+    sys.exit(main())

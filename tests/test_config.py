@@ -1,0 +1,78 @@
+"""Topology config: reference-schema compatibility and validation
+(the reference validates manually with exit(1) per field, node.py:222-277)."""
+
+import json
+
+import pytest
+
+from dnn_tpu.config import TopologyConfig
+
+
+REFERENCE_STYLE = {
+    # exactly the reference's schema (config.json:1-18)
+    "nodes": [
+        {"id": "node1", "address": "192.168.1.101:50051", "part_index": 0},
+        {"id": "node2", "address": "192.168.1.120:50051", "part_index": 1},
+    ],
+    "model_weights": "./cifar10_model.pth",
+    "num_parts": 2,
+    "return_to_node_id": "node1",
+}
+
+
+def test_reference_config_parses():
+    cfg = TopologyConfig.from_dict(REFERENCE_STYLE)
+    assert cfg.num_parts == 2
+    assert cfg.model == "cifar_cnn"  # the reference's only wired family
+    assert cfg.node_by_id("node2").part_index == 1
+    assert cfg.node_by_part(0).id == "node1"
+    assert cfg.nodes[0].port == 50051
+
+
+def test_next_and_return_resolution():
+    cfg = TopologyConfig.from_dict(REFERENCE_STYLE)
+    n1, n2 = cfg.node_by_id("node1"), cfg.node_by_id("node2")
+    assert cfg.next_node(n1).id == "node2"  # node.py:262-271
+    assert cfg.next_node(n2) is None
+    assert cfg.return_node().id == "node1"  # node.py:272-277
+
+
+def test_arbitrary_num_parts_allowed():
+    """The reference hard-exits unless num_parts == 2 (node.py:246-248);
+    the rebuild accepts any coverage-complete topology."""
+    d = {
+        "nodes": [{"id": f"n{i}", "part_index": i} for i in range(5)],
+        "num_parts": 5,
+    }
+    assert TopologyConfig.from_dict(d).num_parts == 5
+
+
+@pytest.mark.parametrize(
+    "mutate,match",
+    [
+        (lambda d: d["nodes"].pop(), "cover exactly"),
+        (lambda d: d["nodes"][0].update(part_index=1), "cover exactly"),
+        (lambda d: d["nodes"][1].update(id="node1"), "duplicate"),
+        (lambda d: d.update(return_to_node_id="ghost"), "not among"),
+        (lambda d: d.update(runtime="mpi"), "runtime"),
+        (lambda d: d.update(microbatches=0), "microbatches"),
+    ],
+)
+def test_validation_errors(mutate, match):
+    d = json.loads(json.dumps(REFERENCE_STYLE))
+    mutate(d)
+    with pytest.raises(ValueError, match=match):
+        TopologyConfig.from_dict(d)
+
+
+def test_bad_address_port():
+    cfg = TopologyConfig.from_dict(REFERENCE_STYLE)
+    bad = cfg.nodes[0].__class__(id="x", part_index=0, address="nocolonhere")
+    with pytest.raises(ValueError, match="Invalid address"):
+        _ = bad.port
+
+
+def test_repo_example_configs_parse():
+    for p in ("configs/cifar_2stage.json", "configs/gpt2_8stage.json"):
+        cfg = TopologyConfig.from_json(p)
+        assert cfg.num_parts == len(cfg.nodes)
