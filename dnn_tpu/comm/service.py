@@ -113,6 +113,16 @@ class StageServer:
             self._next_channel = None
 
 
+def _resolve_port(servicer: StageServer, node_id: str, port: Optional[int]) -> int:
+    bind_port = port if port is not None else servicer.node.port
+    if bind_port is None:
+        raise ValueError(
+            f"node '{node_id}' has no address in the config; serving a stage "
+            "requires nodes[].address with an IP:Port (config.json:6)"
+        )
+    return bind_port
+
+
 def _handlers(servicer: StageServer):
     return grpc.method_handlers_generic_handler(
         SERVICE_NAME,
@@ -142,9 +152,12 @@ async def serve_stage(engine, node_id: str, *, port: Optional[int] = None):
     servicer = StageServer(engine, node_id)
     server = grpc.aio.server()
     server.add_generic_rpc_handlers((_handlers(servicer),))
-    bind_port = port if port is not None else servicer.node.port
+    bind_port = _resolve_port(servicer, node_id, port)
     listen = f"[::]:{bind_port}"
-    server.add_insecure_port(listen)
+    if server.add_insecure_port(listen) == 0:
+        # grpc reports bind failure as port 0, not an exception (the
+        # reference prints-and-exits on the same failure, node.py:124-126)
+        raise RuntimeError(f"failed to bind gRPC server to {listen}")
     log.info("gRPC stage server %s listening on %s (part %d)",
              node_id, listen, servicer.part_index)
     await server.start()
@@ -168,27 +181,43 @@ def start_stage_server_in_background(engine, node_id: str, *, port: Optional[int
         # grpc.aio binds to the event loop current at construction time, so
         # the server (and the servicer's forwarding channel) must be created
         # inside this thread's loop, not the caller's.
-        servicer = StageServer(engine, node_id)
-        server = grpc.aio.server()
-        server.add_generic_rpc_handlers((_handlers(servicer),))
-        bind_port = port if port is not None else servicer.node.port
-        server.add_insecure_port(f"[::]:{bind_port}")
-        await server.start()
-        state["servicer"], state["server"] = servicer, server
-        state["done"] = asyncio.Event()
-        started.set()
+        try:
+            servicer = StageServer(engine, node_id)
+            server = grpc.aio.server()
+            server.add_generic_rpc_handlers((_handlers(servicer),))
+            bind_port = _resolve_port(servicer, node_id, port)
+            if server.add_insecure_port(f"[::]:{bind_port}") == 0:
+                raise RuntimeError(f"failed to bind gRPC server to [::]:{bind_port}")
+            await server.start()
+            state["servicer"], state["server"] = servicer, server
+            state["done"] = asyncio.Event()
+        except BaseException as e:  # surface startup failure to the caller
+            state["error"] = e
+            raise
+        finally:
+            started.set()
         await state["done"].wait()
         # drain one cycle so the stop() future resolves before the loop ends
         await asyncio.sleep(0.05)
 
     def _thread_main():
         asyncio.set_event_loop(loop)
-        loop.run_until_complete(_run())
+        try:
+            loop.run_until_complete(_run())
+        except BaseException:
+            if "error" not in state:
+                raise  # startup succeeded; die loudly on later failures
+            # startup error already recorded and re-raised to the caller
 
     t = threading.Thread(target=_thread_main, daemon=True)
     t.start()
     if not started.wait(timeout=15):
         raise RuntimeError(f"stage server for {node_id} failed to start")
+    if "error" in state:
+        t.join(timeout=5)
+        raise RuntimeError(
+            f"stage server for {node_id} failed to start: {state['error']}"
+        ) from state["error"]
 
     def stop():
         async def _stop():

@@ -123,7 +123,10 @@ def main(argv=None) -> int:
     # host; full role only when this process drives the whole pipeline.
     try:
         engine = PipelineEngine(config, role="stage" if args.serve else "full")
-    except ValueError as e:
+    except Exception as e:  # noqa: BLE001 — CLI boundary: checkpoint loads
+        # raise FileNotFoundError/unpickling errors etc.; exit with a clean
+        # one-liner like the reference does for every config problem
+        # (node.py:296, 226-258) instead of a traceback.
         log.error("engine construction failed: %s", e)
         return 1
     log.info(
@@ -146,6 +149,11 @@ def main(argv=None) -> int:
             asyncio.run(_run())
         except KeyboardInterrupt:
             log.info("shutting down")
+        except Exception as e:  # noqa: BLE001 — CLI boundary: bind/address
+            # failures exit with a clean one-liner (node.py:124-126), not a
+            # traceback
+            log.error("serve failed: %s", e)
+            return 1
         return 0
 
     # single-controller mode

@@ -82,17 +82,36 @@ class RelayExecutor:
             jax.device_put(p, d) for p, d in zip(stage_params, self.devices)
         ]
         self.stage_fns = [jax.jit(fn) for fn in stage_fns]
+        # populated by record_timings runs: hop = inter-stage transfer
+        # (device i-1 -> device i; stage 0 excluded, it has no incoming
+        # hop), stage = per-stage compute time.
         self.last_hop_times: Optional[List[float]] = None
+        self.last_stage_times: Optional[List[float]] = None
 
     def __call__(self, x, *, record_timings: bool = False):
-        timings = [] if record_timings else None
-        for fn, params, dev in zip(self.stage_fns, self.stage_params, self.devices):
-            t0 = time.perf_counter() if record_timings else 0.0
-            x = fn(params, jax.device_put(x, dev))
-            if record_timings:
-                x.block_until_ready()
-                timings.append(time.perf_counter() - t0)
-        self.last_hop_times = timings
+        if not record_timings:
+            for fn, params, dev in zip(self.stage_fns, self.stage_params, self.devices):
+                x = fn(params, jax.device_put(x, dev))
+            self.last_hop_times = self.last_stage_times = None
+            return x
+
+        from dnn_tpu.utils.tracing import device_sync
+
+        hops, stages = [], []
+        for i, (fn, params, dev) in enumerate(
+            zip(self.stage_fns, self.stage_params, self.devices)
+        ):
+            t0 = time.perf_counter()
+            xd = jax.device_put(x, dev)
+            device_sync(xd)
+            t1 = time.perf_counter()
+            x = fn(params, xd)
+            device_sync(x)
+            stages.append(time.perf_counter() - t1)
+            if i > 0:  # stage 0's device_put is host ingress, not a hop
+                hops.append(t1 - t0)
+        self.last_hop_times = hops
+        self.last_stage_times = stages
         return x
 
 

@@ -1,0 +1,103 @@
+"""Ring attention: sequence/context parallelism over a "seq" mesh axis.
+
+The reference caps sequence length with a hard assert
+(`T <= config.block_size`, /root/reference/partitions/gpt_model_parts.py:15)
+and has no attention sharding of any kind (SURVEY §5 'Long-context:
+ABSENT'). This module supplies the long-context capability the rebuild
+treats as first-class: Q, K, V are sharded along the sequence dimension
+across the mesh's "seq" axis; each device computes attention of its local
+queries against one K/V block at a time while the K/V blocks travel the
+ring via `lax.ppermute` (one ICI hop per step), accumulating with the
+online-softmax recurrence — so the full (T, T) score matrix never exists
+anywhere, and per-device memory is O(T/n).
+
+Causality is resolved block-wise from ring positions: a K/V block that
+originated at a later shard is fully masked, the diagonal block gets the
+triangular mask, earlier blocks attend fully. All devices run the same
+program (SPMD); dead blocks cost one masked matmul rather than a branch.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from dnn_tpu.parallel.mesh import SEQ_AXIS
+
+_NEG_BIG = -1e30  # finite -inf, matches dnn_tpu/ops/pallas/flash_attention.py
+
+
+def _block_attend(q, k, v, m, l, acc, mask):
+    """One online-softmax accumulation step against a K/V block.
+    q (B,H,Tq,D); k,v (B,H,Tk,D); m,l (B,H,Tq,1); acc (B,H,Tq,D);
+    mask (Tq,Tk) bool (True = attend)."""
+    d = q.shape[-1]
+    s = jnp.einsum("bhtd,bhsd->bhts", q, k).astype(jnp.float32) / jnp.sqrt(d)
+    s = jnp.where(mask[None, None], s, _NEG_BIG)
+    m_new = jnp.maximum(m, s.max(axis=-1, keepdims=True))
+    alpha = jnp.exp(m - m_new)
+    p = jnp.exp(s - m_new)
+    l_new = l * alpha + p.sum(axis=-1, keepdims=True)
+    acc_new = acc * alpha + jnp.einsum(
+        "bhts,bhsd->bhtd", p, v.astype(jnp.float32)
+    )
+    return m_new, l_new, acc_new
+
+
+def ring_attention_local(q, k, v, *, axis_name: str = SEQ_AXIS, causal: bool = True):
+    """Per-device body (call inside shard_map). q/k/v are the local sequence
+    shards, (B, H, T_local, D); returns the local output shard."""
+    n = lax.axis_size(axis_name)
+    my = lax.axis_index(axis_name)
+    t_local = q.shape[2]
+    qf = q.astype(jnp.float32)
+
+    tri = jnp.tril(jnp.ones((t_local, t_local), dtype=bool))
+    full = jnp.ones((t_local, t_local), dtype=bool)
+
+    def step(carry, i):
+        k_cur, v_cur, m, l, acc = carry
+        # this K/V block originated at shard (my - i) mod n
+        src = (my - i) % n
+        if causal:
+            # src == my: diagonal (triangular); src < my: past (full);
+            # src > my: future (dead). Select via where on the mask.
+            mask = jnp.where(src == my, tri, full)
+            mask = jnp.logical_and(mask, (src <= my)[..., None, None])
+        else:
+            mask = full
+        m, l, acc = _block_attend(qf, k_cur, v_cur, m, l, acc, mask)
+        # rotate K/V one hop around the ring: shard j's block moves to j+1
+        k_nxt = lax.ppermute(k_cur, axis_name, [(j, (j + 1) % n) for j in range(n)])
+        v_nxt = lax.ppermute(v_cur, axis_name, [(j, (j + 1) % n) for j in range(n)])
+        return (k_nxt, v_nxt, m, l, acc), None
+
+    b, h, _, d = q.shape
+    init = (
+        k, v,
+        jnp.full((b, h, t_local, 1), _NEG_BIG, jnp.float32),
+        jnp.zeros((b, h, t_local, 1), jnp.float32),
+        jnp.zeros((b, h, t_local, d), jnp.float32),
+    )
+    (_, _, _, l, acc), _ = lax.scan(step, init, jnp.arange(n))
+    # fully-masked rows (none exist for causal self-attention since the
+    # diagonal block always contributes) would have l == 0; guard anyway.
+    return (acc / jnp.maximum(l, 1e-30)).astype(q.dtype)
+
+
+def ring_attention(q, k, v, *, mesh: Mesh, axis_name: str = SEQ_AXIS, causal: bool = True):
+    """Sharded entry: q/k/v are global (B, H, T, D) arrays; T is split over
+    `axis_name`. Output is the full attention result, identical (up to
+    float error) to dnn_tpu.ops.pallas.flash_attention.reference_attention."""
+    n = mesh.shape[axis_name]
+    if q.shape[2] % n != 0:
+        raise ValueError(f"sequence length {q.shape[2]} not divisible by ring size {n}")
+    body = functools.partial(ring_attention_local, axis_name=axis_name, causal=causal)
+    spec = P(None, None, axis_name, None)
+    return jax.shard_map(
+        body, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec, check_vma=False
+    )(q, k, v)

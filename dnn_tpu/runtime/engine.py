@@ -119,6 +119,12 @@ class PipelineEngine:
         self._stage_params = [s.slice_params(self.params) for s in self.stages]
         self._stage_jits = [jax.jit(s.apply) for s in self.stages]
 
+        # Per-part device-resident param cache for run_stage: committed to
+        # device on first use (HBM-resident thereafter, the analog of each
+        # node loading its slice at startup — node.py:294-317). Lazy so a
+        # 1-device stage host only ever uploads the one part it serves.
+        self._stage_params_on_device: dict = {}
+
         if role == "stage":
             self.runtime = "stage"
             self.mesh = None
@@ -208,7 +214,11 @@ class PipelineEngine:
             )
 
         fn = jax.jit(run_pipeline)
-        sp = tuple(self._stage_params)
+        # replicate the (heterogeneous-stage) params onto the mesh once —
+        # plain numpy args would re-transfer host->device every call
+        sp = jax.device_put(
+            tuple(self._stage_params), NamedSharding(mesh, P())
+        )
         return lambda x: fn(sp, x)
 
     def _build_gpt_stacked_fn(self):
@@ -266,9 +276,78 @@ class PipelineEngine:
     def run_stage(self, part_index: int, x) -> jax.Array:
         """One stage only — the unit of work a reference node performs per
         SendTensor (node.py:52-54); used by the gRPC edge service."""
-        return self._stage_jits[part_index](self._stage_params[part_index], x)
+        params = self._stage_params_on_device.get(part_index)
+        if params is None:
+            params = jax.device_put(
+                self._stage_params[part_index], self.devices[0]
+            )
+            self._stage_params_on_device[part_index] = params
+        return self._stage_jits[part_index](params, x)
 
     def predict(self, x) -> int:
         """Client-path final step: argmax over the last stage's output
         (node.py:61, 190-192)."""
         return int(np.argmax(np.asarray(self.run(x))))
+
+    # ------------------------------------------------------------------
+    # observability (SURVEY §5: the reference has none — prints only)
+    # ------------------------------------------------------------------
+
+    def benchmark(self, x, *, iters: int = 20, warmup: int = 3) -> dict:
+        """Measure the BASELINE.json metrics on this engine's pipeline:
+        items/sec (images or tokens), p50/p90 end-to-end step latency, and —
+        in relay mode, where hops are individually observable — p50
+        inter-stage hop latency (device->device transfer, stage 0's host
+        ingress excluded) and per-stage compute. Timings force device
+        completion via `tracing.device_sync` (block_until_ready is not a
+        reliable barrier on tunneled TPUs; timing dispatch alone measures
+        nothing)."""
+        from dnn_tpu.utils import tracing
+        from dnn_tpu.utils.metrics import Metrics
+
+        if self.role == "stage":
+            raise RuntimeError(
+                "benchmark() needs the full pipeline; this engine was built "
+                "with role='stage' (serves one part)"
+            )
+        m = Metrics()
+        xs = np.asarray(x).shape
+        # items: tokens (B*T) for integer id inputs, else examples (B) —
+        # the BASELINE.json tokens/sec vs images/sec distinction.
+        if np.issubdtype(np.asarray(x).dtype, np.integer) and len(xs) == 2:
+            batch_items = int(xs[0] * xs[1])
+        else:
+            batch_items = int(xs[0])
+        for _ in range(warmup):
+            tracing.device_sync(self.run(x))
+        # step latency: un-instrumented runs (one sync per step), so relay
+        # numbers are comparable to spmd and to production behavior
+        run_once = (lambda: self._relay(x)) if self.runtime == "relay" \
+            else (lambda: self._pipeline_fn(x))
+        for i in range(iters):
+            with tracing.step_span(i, "bench_step"):
+                with m.timer("step"):
+                    tracing.device_sync(run_once())
+        # hop/stage breakdown: separate instrumented relay runs (per-stage
+        # syncs perturb the step timing, so they don't share iterations)
+        if self.runtime == "relay":
+            for _ in range(min(iters, 5)):
+                self._relay(x, record_timings=True)
+                for hop_t in self._relay.last_hop_times or []:
+                    m.observe("inter_stage_hop", hop_t)
+                for st_t in self._relay.last_stage_times or []:
+                    m.observe("stage_compute", st_t)
+        snap = m.snapshot()
+        step = snap["latency"]["step"]
+        result = {
+            "items_per_sec": batch_items / step["p50"],
+            "step_latency_p50_s": step["p50"],
+            "step_latency_p90_s": step["p90"],
+            "runtime": self.runtime,
+            "iters": iters,
+        }
+        if "inter_stage_hop" in snap["latency"]:
+            result["inter_stage_hop_p50_s"] = snap["latency"]["inter_stage_hop"]["p50"]
+        if "stage_compute" in snap["latency"]:
+            result["stage_compute_p50_s"] = snap["latency"]["stage_compute"]["p50"]
+        return result

@@ -1,0 +1,161 @@
+"""Autoregressive generation with a KV cache for the GPT family.
+
+The reference's GPT path is a single stateless full-sequence forward per
+request — no KV cache, no sampling, no incremental decode (SURVEY §5
+'Long-context': "each forward is full-sequence, stateless",
+/root/reference/partitions/gpt_model_parts.py:13-50). A GPT user needs
+generation, so the rebuild supplies it TPU-first:
+
+  * prefill is one full-sequence forward that also writes K/V into a
+    preallocated static-shape cache (XLA-friendly: no growing arrays);
+  * decode is a `lax.scan` over steps — one compiled step regardless of
+    token count — each step a (B, 1) forward against the cache with
+    position masking instead of dynamic shapes;
+  * the cache is laid out (L, B, H, S, D) so layers scan over the leading
+    axis with the same stacked block params the pipeline runtime shards.
+
+Greedy (temperature=0) and temperature/top-k sampling are supported.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from dnn_tpu.models.gpt import GPTConfig
+from dnn_tpu.ops.attention import merge_heads, split_heads
+from dnn_tpu.ops.nn import gelu, layer_norm, linear
+
+_NEG_BIG = -1e30
+
+
+def init_cache(cfg: GPTConfig, batch: int, max_len: int, dtype=jnp.float32):
+    """Preallocated K/V cache, one leading layer axis: (L, B, H, S, D)."""
+    shape = (cfg.n_layer, batch, cfg.n_head, max_len, cfg.n_embd // cfg.n_head)
+    return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+
+
+def _qkv_heads(bp, h, *, cfg: GPTConfig, compute_dtype):
+    qkv = linear(bp["attn"]["qkv"], h, compute_dtype=compute_dtype)
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    return tuple(split_heads(t, cfg.n_head) for t in (q, k, v))  # (B,H,T,D)
+
+
+def _attend_cache(q, k_cache, v_cache, pos_limit):
+    """q (B,H,T,D) against the full static cache (B,H,S,D), masking key
+    positions > their allowed limit. `pos_limit` is (T,) — for row t, keys
+    at positions <= pos_limit[t] attend."""
+    d = q.shape[-1]
+    s = jnp.einsum("bhtd,bhsd->bhts", q, k_cache).astype(jnp.float32) / jnp.sqrt(d)
+    cols = jnp.arange(k_cache.shape[2])
+    s = jnp.where(cols[None, None, None, :] <= pos_limit[None, None, :, None], s, _NEG_BIG)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhts,bhsd->bhtd", p.astype(v_cache.dtype), v_cache)
+
+
+def _block_with_cache(bp, x, k_cache, v_cache, start_pos, *, cfg: GPTConfig,
+                      compute_dtype):
+    """One transformer block over x (B, T, C) whose tokens sit at positions
+    [start_pos, start_pos+T); writes this block's K/V into the cache and
+    attends against everything cached so far. T=prompt_len for prefill,
+    T=1 for decode — same code path."""
+    t = x.shape[1]
+    h = layer_norm(bp["ln_1"], x, eps=cfg.ln_eps)
+    q, k, v = _qkv_heads(bp, h, cfg=cfg, compute_dtype=compute_dtype)
+    k_cache = lax.dynamic_update_slice_in_dim(k_cache, k.astype(k_cache.dtype), start_pos, axis=2)
+    v_cache = lax.dynamic_update_slice_in_dim(v_cache, v.astype(v_cache.dtype), start_pos, axis=2)
+    pos_limit = start_pos + jnp.arange(t)  # causal within the new tokens
+    y = _attend_cache(q, k_cache, v_cache, pos_limit)
+    x = x + linear(bp["attn"]["proj"], merge_heads(y.astype(x.dtype)),
+                   compute_dtype=compute_dtype)
+    h = layer_norm(bp["ln_2"], x, eps=cfg.ln_eps)
+    m = linear(bp["mlp"]["proj"], gelu(linear(bp["mlp"]["fc"], h, compute_dtype=compute_dtype)),
+               compute_dtype=compute_dtype)
+    return x + m, k_cache, v_cache
+
+
+def forward_with_cache(prepared, ids, cache, start_pos, *, cfg: GPTConfig,
+                       compute_dtype=None):
+    """Forward ids (B, T) at positions [start_pos, start_pos+T) through all
+    layers (scan over the stacked blocks), updating the cache. Returns
+    (logits (B, T, V), cache)."""
+    pos = start_pos + jnp.arange(ids.shape[1])
+    x = jnp.take(prepared["wte"]["embedding"], ids, axis=0) + \
+        jnp.take(prepared["wpe"]["embedding"], pos, axis=0)
+    if compute_dtype is not None:
+        x = x.astype(compute_dtype)
+
+    def layer(carry, layer_in):
+        bp, k_c, v_c = layer_in
+        x, k_c, v_c = _block_with_cache(
+            bp, carry, k_c, v_c, start_pos, cfg=cfg, compute_dtype=compute_dtype
+        )
+        return x, (k_c, v_c)
+
+    x, (k_new, v_new) = lax.scan(layer, x, (prepared["blocks"], cache["k"], cache["v"]))
+    x = layer_norm(prepared["ln_f"], x.astype(jnp.float32), eps=cfg.ln_eps)
+    logits = linear(prepared["lm_head"], x)
+    return logits, {"k": k_new, "v": v_new}
+
+
+def _sample(logits, rng, *, temperature: float, top_k: Optional[int]):
+    """logits (B, V) -> token ids (B,). temperature=0 is greedy."""
+    if temperature == 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    logits = logits / temperature
+    if top_k is not None:
+        kth = lax.top_k(logits, top_k)[0][..., -1:]
+        logits = jnp.where(logits < kth, _NEG_BIG, logits)
+    return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
+
+
+def make_generate(cfg: GPTConfig, *, max_new_tokens: int, temperature: float = 0.0,
+                  top_k: Optional[int] = None, compute_dtype=None):
+    """Build a jitted generate(prepared, ids, rng) -> (B, max_new_tokens).
+
+    `prepared` is the stacked layout from `gpt.prepare_stacked`. The prompt
+    length is static per compilation (usual JAX contract); decode runs as a
+    single lax.scan.
+    """
+
+    @functools.partial(jax.jit, static_argnames=())
+    def generate(prepared, ids, rng):
+        b, t = ids.shape
+        s_max = t + max_new_tokens
+        if s_max > cfg.block_size:
+            raise ValueError(
+                f"prompt {t} + max_new_tokens {max_new_tokens} exceeds "
+                f"block_size {cfg.block_size}"
+            )
+        cache_dtype = compute_dtype or jnp.float32
+        cache = init_cache(cfg, b, s_max, cache_dtype)
+
+        # prefill: full prompt in one forward
+        logits, cache = forward_with_cache(
+            prepared, ids, cache, 0, cfg=cfg, compute_dtype=compute_dtype
+        )
+        rng, sub = jax.random.split(rng)
+        tok = _sample(logits[:, -1], sub, temperature=temperature, top_k=top_k)
+
+        def step(carry, i):
+            # carry token tok_i sits at sequence position t + i
+            cache, tok, rng = carry
+            logits, cache = forward_with_cache(
+                prepared, tok[:, None], cache, t + i, cfg=cfg,
+                compute_dtype=compute_dtype,
+            )
+            rng, sub = jax.random.split(rng)
+            nxt = _sample(logits[:, -1], sub, temperature=temperature, top_k=top_k)
+            return (cache, nxt, rng), tok
+
+        (_, last, _), toks = lax.scan(
+            step, (cache, tok, rng), jnp.arange(max_new_tokens - 1)
+        )
+        toks = jnp.moveaxis(toks, 0, 1)  # (B, max_new_tokens-1)
+        return jnp.concatenate([toks, last[:, None]], axis=1)
+
+    return generate

@@ -1,0 +1,205 @@
+"""Training on top of the inference framework.
+
+The reference is inference-only (readme.md:112; its weights arrive as a
+pre-trained `.pth`, node.py:294-317). This module goes beyond parity: the
+same pure-functional models and the same pipeline runtime also train, via
+`jax.value_and_grad` — including *through* the shard_map+ppermute pipeline
+(ppermute and scan are differentiable, so pipeline-parallel training needs
+no second code path; the backward ppermute rides the same ICI ring in the
+reverse direction).
+
+Three entry points:
+  * `make_train_step`          — generic single-program step (any model).
+  * `make_sharded_train_step`  — dp x tp step: params carry Megatron-style
+    PartitionSpecs (`gpt_tp_specs`), the batch is sharded over "data", and
+    GSPMD inserts the all-reduces (the scaling-book recipe: pick a mesh,
+    annotate shardings, let XLA place collectives).
+  * `make_pipeline_train_step` — pp step: loss through
+    `spmd_pipeline_stacked`, per-stage HBM-resident block weights, grads
+    and optimizer state sharded over the "stage" axis.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from dnn_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS, STAGE_AXIS
+from dnn_tpu.parallel.pipeline import spmd_pipeline_stacked
+
+
+# --------------------------------------------------------------------------
+# losses
+# --------------------------------------------------------------------------
+
+def cross_entropy(logits, targets, *, ignore_index: Optional[int] = None):
+    """Token-level cross entropy, mean over non-ignored positions.
+    logits (..., V) f32; targets (...) int."""
+    logits = logits.astype(jnp.float32)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    if ignore_index is None:
+        return jnp.mean(nll)
+    mask = (targets != ignore_index).astype(jnp.float32)
+    return jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+
+
+def next_token_loss(apply_fn: Callable, params, tokens, *, ignore_index=None):
+    """Causal-LM loss: predict tokens[:, 1:] from tokens[:, :-1]."""
+    logits = apply_fn(params, tokens[:, :-1])
+    return cross_entropy(logits, tokens[:, 1:], ignore_index=ignore_index)
+
+
+# --------------------------------------------------------------------------
+# generic step
+# --------------------------------------------------------------------------
+
+def make_train_step(loss_fn: Callable, optimizer: optax.GradientTransformation):
+    """(params, opt_state, batch) -> (params, opt_state, loss). `loss_fn`
+    is (params, batch) -> scalar. Jit-compiled; shardings of the inputs
+    propagate (pass pre-sharded params for dp/tp/pp)."""
+
+    @jax.jit
+    def step(params, opt_state, batch):
+        loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    return step
+
+
+# --------------------------------------------------------------------------
+# dp x tp sharding (Megatron-style specs for the GPT param layout)
+# --------------------------------------------------------------------------
+
+def gpt_tp_specs(params, *, axis: str = MODEL_AXIS):
+    """PartitionSpecs for the GPT family's flat param dict
+    (dnn_tpu/models/gpt.py init): attention qkv / mlp fc shard their output
+    features, their projections shard input features (so each device owns
+    whole heads / whole hidden slices and GSPMD inserts one all-reduce per
+    residual write); embeddings and lm_head shard the vocab/embed dim;
+    norms replicate."""
+
+    def spec_for(path, leaf):
+        keys = [getattr(p, "key", getattr(p, "name", None)) for p in path]
+        if leaf.ndim < 2:
+            return P()  # biases / norm params replicate
+        if "qkv" in keys or "fc" in keys:
+            return P(None, axis)        # (C, 3C) / (C, 4C): shard out dim
+        if "proj" in keys:
+            return P(axis, None)        # (C, C) / (4C, C): shard in dim
+        if "wte" in keys:
+            return P(axis, None)        # (V, C): vocab-parallel embedding
+        if "lm_head" in keys:
+            return P(None, axis)        # (C, V): vocab-parallel logits
+        return P()
+
+    return jax.tree_util.tree_map_with_path(spec_for, params)
+
+
+def shard_pytree(tree, mesh: Mesh, specs):
+    """Place a pytree on the mesh with the given PartitionSpecs."""
+    return jax.device_put(
+        tree, jax.tree.map(lambda s: NamedSharding(mesh, s), specs,
+                           is_leaf=lambda x: isinstance(x, P))
+    )
+
+
+def make_sharded_train_step(
+    loss_fn: Callable,
+    optimizer: optax.GradientTransformation,
+    mesh: Mesh,
+    param_specs,
+    *,
+    batch_axis: str = DATA_AXIS,
+):
+    """dp x tp train step. Params must be placed with `shard_pytree(params,
+    mesh, param_specs)`; the batch is sharded over `batch_axis` here. The
+    returned step keeps params/opt_state shardings stable across calls (no
+    resharding churn), and gradient all-reduce over "data" plus tp
+    collectives over "model" are inserted by GSPMD."""
+    param_shardings = jax.tree.map(
+        lambda s: NamedSharding(mesh, s), param_specs,
+        is_leaf=lambda x: isinstance(x, P),
+    )
+    batch_sharding = NamedSharding(mesh, P(batch_axis))
+
+    @jax.jit
+    def step(params, opt_state, batch):
+        batch = jax.lax.with_sharding_constraint(batch, batch_sharding)
+        loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+        grads = jax.lax.with_sharding_constraint(grads, param_shardings)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        params = jax.lax.with_sharding_constraint(params, param_shardings)
+        return params, opt_state, loss
+
+    return step
+
+
+def init_sharded(init_fn: Callable, rng, mesh: Mesh, specs_fn: Callable = gpt_tp_specs):
+    """Init params directly into their tp shardings (no full-replica
+    materialization on one device): eval_shape -> out_shardings -> jit."""
+    shapes = jax.eval_shape(init_fn, rng)
+    specs = specs_fn(shapes)
+    shardings = jax.tree.map(
+        lambda s: NamedSharding(mesh, s), specs, is_leaf=lambda x: isinstance(x, P)
+    )
+    params = jax.jit(init_fn, out_shardings=shardings)(rng)
+    return params, specs
+
+
+# --------------------------------------------------------------------------
+# pipeline-parallel training
+# --------------------------------------------------------------------------
+
+def make_pipeline_train_step(
+    block_fn: Callable,
+    embed_fn: Callable,
+    head_fn: Callable,
+    optimizer: optax.GradientTransformation,
+    mesh: Mesh,
+    *,
+    num_microbatches: int = 1,
+    axis_name: str = STAGE_AXIS,
+    loss: Callable = cross_entropy,
+):
+    """Pipeline-parallel LM training step.
+
+    `stacked` block params live sharded P(stage) (each device holds its
+    stage's blocks — same layout the inference engine uses); `aux` holds
+    embed/head params (replicated). Backward simply differentiates through
+    the GPipe loop: the reverse of each ppermute hop is a ppermute in the
+    opposite direction on the same ring.
+
+    step(stacked, aux, opt_states, tokens) ->
+        (stacked, aux, opt_states, loss_value)
+    """
+    def loss_fn(stacked, aux, tokens):
+        x = embed_fn(aux, tokens[:, :-1])
+        h = spmd_pipeline_stacked(
+            block_fn, stacked, x,
+            mesh=mesh, num_microbatches=num_microbatches, axis_name=axis_name,
+        )
+        logits = head_fn(aux, h)
+        return loss(logits, tokens[:, 1:])
+
+    @jax.jit
+    def step(stacked, aux, opt_states, tokens):
+        st_opt, aux_opt = opt_states
+        lval, grads = jax.value_and_grad(
+            lambda s, a: loss_fn(s, a, tokens), argnums=(0, 1)
+        )(stacked, aux)
+        g_st, g_aux = grads
+        up_st, st_opt = optimizer.update(g_st, st_opt, stacked)
+        stacked = optax.apply_updates(stacked, up_st)
+        up_aux, aux_opt = optimizer.update(g_aux, aux_opt, aux)
+        aux = optax.apply_updates(aux, up_aux)
+        return stacked, aux, (st_opt, aux_opt), lval
+
+    return step

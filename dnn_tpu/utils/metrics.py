@@ -1,0 +1,125 @@
+"""Step metrics and observability counters.
+
+The reference's only observability is ad-hoc stdout prints (SURVEY §5
+'Metrics': node.py:38-39, 85-86, 120-122 — no levels, no counters, no
+timers). This module supplies the rebuild's structured replacement: named
+counters/gauges plus a latency reservoir with percentiles, emitting the
+BASELINE.json metrics (images/sec, tokens/sec, p50 inter-stage latency) as
+plain dicts / JSON lines.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from collections import defaultdict
+from typing import Dict, List, Optional
+
+
+def percentile(values: List[float], q: float) -> float:
+    """Nearest-rank percentile, q in [0, 100]."""
+    if not values:
+        raise ValueError("no samples")
+    s = sorted(values)
+    k = max(0, min(len(s) - 1, int(round(q / 100.0 * (len(s) - 1)))))
+    return s[k]
+
+
+class LatencyReservoir:
+    """Bounded sample buffer for latency percentiles (seconds)."""
+
+    def __init__(self, capacity: int = 4096):
+        self.capacity = capacity
+        self._samples: List[float] = []
+        self._count = 0
+
+    def record(self, seconds: float):
+        self._count += 1
+        if len(self._samples) < self.capacity:
+            self._samples.append(seconds)
+        else:  # deterministic ring replacement; keeps a sliding window
+            self._samples[self._count % self.capacity] = seconds
+
+    @property
+    def count(self) -> int:
+        return self._count
+
+    def quantiles(self, qs=(50, 90, 99)) -> Dict[str, float]:
+        return {f"p{q}": percentile(self._samples, q) for q in qs}
+
+
+class Metrics:
+    """Thread-safe named counters, gauges, and latency reservoirs."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.counters: Dict[str, float] = defaultdict(float)
+        self.gauges: Dict[str, float] = {}
+        self.latencies: Dict[str, LatencyReservoir] = {}
+
+    def inc(self, name: str, value: float = 1.0):
+        with self._lock:
+            self.counters[name] += value
+
+    def set(self, name: str, value: float):
+        with self._lock:
+            self.gauges[name] = value
+
+    def observe(self, name: str, seconds: float):
+        with self._lock:
+            if name not in self.latencies:
+                self.latencies[name] = LatencyReservoir()
+            self.latencies[name].record(seconds)
+
+    def timer(self, name: str) -> "_Timer":
+        return _Timer(self, name)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            out = {"counters": dict(self.counters), "gauges": dict(self.gauges)}
+            out["latency"] = {
+                k: {"count": r.count, **r.quantiles()} for k, r in self.latencies.items()
+            }
+            return out
+
+    def json_line(self) -> str:
+        return json.dumps(self.snapshot(), sort_keys=True)
+
+
+class _Timer:
+    def __init__(self, metrics: Metrics, name: str):
+        self.metrics, self.name = metrics, name
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        self.metrics.observe(self.name, time.perf_counter() - self._t0)
+        return False
+
+
+class Throughput:
+    """items/sec over a sliding wall-clock window — the BASELINE.json
+    images/sec / tokens/sec counters."""
+
+    def __init__(self):
+        self._t0: Optional[float] = None
+        self._items = 0
+
+    def add(self, n: int):
+        if self._t0 is None:
+            self._t0 = time.perf_counter()
+        self._items += n
+
+    @property
+    def per_sec(self) -> float:
+        if self._t0 is None or self._items == 0:
+            return 0.0
+        dt = time.perf_counter() - self._t0
+        return self._items / dt if dt > 0 else 0.0
+
+
+# module-level default registry (imports are cheap; tests can make their own)
+default_metrics = Metrics()

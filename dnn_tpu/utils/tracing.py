@@ -1,0 +1,81 @@
+"""Profiling / tracing spans.
+
+The reference has no tracing at all (SURVEY §5 'Tracing/profiling:
+ABSENT'). The TPU-native replacement is `jax.profiler`: named trace
+annotations show up in TensorBoard/Perfetto timelines alongside the XLA
+device ops, and `trace_to(dir)` captures a full device+host profile.
+
+All helpers degrade to no-ops if profiling is unavailable, so library code
+can annotate unconditionally.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Iterator, Optional
+
+import jax
+
+
+@contextlib.contextmanager
+def span(name: str) -> Iterator[None]:
+    """Named host-side span, visible in captured profiles."""
+    try:
+        ctx = jax.profiler.TraceAnnotation(name)
+    except Exception:  # pragma: no cover - profiler unavailable
+        ctx = contextlib.nullcontext()
+    with ctx:
+        yield
+
+
+@contextlib.contextmanager
+def step_span(step: int, name: str = "step") -> Iterator[None]:
+    """Mark one pipeline/training step; XLA profilers group device ops
+    under it."""
+    try:
+        ctx = jax.profiler.StepTraceAnnotation(name, step_num=step)
+    except Exception:  # pragma: no cover
+        ctx = contextlib.nullcontext()
+    with ctx:
+        yield
+
+
+@contextlib.contextmanager
+def trace_to(log_dir: str) -> Iterator[None]:
+    """Capture a full profile (host + device) into `log_dir` for
+    TensorBoard / Perfetto."""
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+def device_sync(out) -> None:
+    """Force completion of all device work `out` depends on.
+
+    `jax.block_until_ready` is NOT sufficient on this machine: the TPU sits
+    behind a tunnel where readiness resolves before device execution
+    finishes, so naive timing measures dispatch only (see bench.py). A
+    1-element host read is the reliable barrier — device execution is
+    in-order, so the read completes only after everything queued before it.
+    """
+    import numpy as np
+
+    for leaf in jax.tree.leaves(out):
+        if hasattr(leaf, "ravel"):
+            np.asarray(leaf.ravel()[0])
+            break
+    else:  # no array leaves
+        jax.block_until_ready(out)
+
+
+def timed_blocked(fn, *args) -> tuple:
+    """Run `fn(*args)`, force device completion (`device_sync`), return
+    (result, seconds). The honest way to time jit'd code — timing dispatch
+    alone measures nothing (SURVEY §7 hard part 4)."""
+    t0 = time.perf_counter()
+    out = fn(*args)
+    device_sync(out)
+    return out, time.perf_counter() - t0

@@ -1,0 +1,88 @@
+"""Observability tests: metrics counters/percentiles, tracing spans, and
+the engine benchmark path (SURVEY §5 — all absent from the reference)."""
+
+import json
+
+import jax
+import numpy as np
+import pytest
+
+from dnn_tpu.utils import tracing
+from dnn_tpu.utils.metrics import LatencyReservoir, Metrics, Throughput, percentile
+
+
+def test_percentile_nearest_rank():
+    vals = [float(i) for i in range(101)]  # 0..100
+    assert percentile(vals, 50) == 50.0
+    assert percentile(vals, 0) == 0.0
+    assert percentile(vals, 100) == 100.0
+    with pytest.raises(ValueError):
+        percentile([], 50)
+
+
+def test_reservoir_sliding_window():
+    r = LatencyReservoir(capacity=10)
+    for i in range(25):
+        r.record(float(i))
+    assert r.count == 25
+    q = r.quantiles()
+    assert set(q) == {"p50", "p90", "p99"}
+    assert all(v >= 10.0 for v in q.values())  # early samples evicted
+
+
+def test_metrics_snapshot_and_json():
+    m = Metrics()
+    m.inc("requests")
+    m.inc("requests", 2)
+    m.set("stages", 4)
+    m.observe("hop", 0.001)
+    m.observe("hop", 0.003)
+    snap = m.snapshot()
+    assert snap["counters"]["requests"] == 3
+    assert snap["gauges"]["stages"] == 4
+    assert snap["latency"]["hop"]["count"] == 2
+    assert json.loads(m.json_line()) == snap
+
+
+def test_metrics_timer():
+    m = Metrics()
+    with m.timer("op"):
+        pass
+    assert m.snapshot()["latency"]["op"]["count"] == 1
+
+
+def test_throughput():
+    t = Throughput()
+    assert t.per_sec == 0.0
+    t.add(100)
+    t.add(100)
+    assert t.per_sec > 0
+
+
+def test_tracing_spans_are_safe():
+    with tracing.span("unit-test-span"):
+        pass
+    with tracing.step_span(3):
+        pass
+    out, dt = tracing.timed_blocked(jax.jit(lambda x: x * 2), np.ones(4))
+    np.testing.assert_allclose(np.asarray(out), 2 * np.ones(4))
+    assert dt >= 0
+
+
+def test_engine_benchmark_relay_and_spmd():
+    from dnn_tpu.config import TopologyConfig
+    from dnn_tpu.runtime.engine import PipelineEngine
+
+    x = np.zeros((4, 32, 32, 3), np.float32)
+    for runtime in ("relay", "spmd"):
+        cfg = TopologyConfig.from_dict({
+            "num_parts": 2, "model": "cifar_cnn", "device_type": "cpu",
+            "runtime": runtime, "microbatches": 2,
+        })
+        eng = PipelineEngine(cfg)
+        res = eng.benchmark(x, iters=3, warmup=1)
+        assert res["items_per_sec"] > 0
+        assert res["step_latency_p50_s"] > 0
+        assert res["runtime"] == runtime
+        if runtime == "relay":
+            assert res["inter_stage_hop_p50_s"] > 0

@@ -66,7 +66,14 @@ def test_relay_timings(cifar_setup):
     stages = spec.partition(2)
     ex = RelayExecutor([s.apply for s in stages], [s.slice_params(params) for s in stages])
     ex(x, record_timings=True)
-    assert ex.last_hop_times is not None and len(ex.last_hop_times) == 2
+    # 2 stages -> 1 inter-stage hop (stage 0's host ingress excluded)
+    # and one compute sample per stage
+    assert ex.last_hop_times is not None and len(ex.last_hop_times) == 1
+    assert ex.last_stage_times is not None and len(ex.last_stage_times) == 2
+    assert all(t > 0 for t in ex.last_hop_times + ex.last_stage_times)
+    # non-timed runs reset the records
+    ex(x)
+    assert ex.last_hop_times is None and ex.last_stage_times is None
 
 
 # ----------------------------------------------------------------------

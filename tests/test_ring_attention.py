@@ -1,0 +1,53 @@
+"""Ring attention (sequence parallelism) vs the dense reference oracle.
+
+The reference has zero long-context support (hard assert T <= block_size,
+gpt_model_parts.py:15; SURVEY §5). These tests check the ring produces the
+same numbers as full dense attention while only ever holding O(T/n) keys
+per device, on the virtual 8-device CPU mesh."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dnn_tpu.ops.pallas.flash_attention import reference_attention
+from dnn_tpu.parallel.mesh import SEQ_AXIS, make_mesh
+from dnn_tpu.parallel.ring_attention import ring_attention
+
+
+def _qkv(b=2, h=3, t=64, d=16, seed=0):
+    ks = jax.random.split(jax.random.PRNGKey(seed), 3)
+    mk = lambda k: jax.random.normal(k, (b, h, t, d), jnp.float32)
+    return mk(ks[0]), mk(ks[1]), mk(ks[2])
+
+
+@pytest.mark.parametrize("n_ring", [2, 4, 8])
+@pytest.mark.parametrize("causal", [True, False])
+def test_ring_matches_dense(n_ring, causal):
+    mesh = make_mesh({SEQ_AXIS: n_ring})
+    q, k, v = _qkv()
+    got = ring_attention(q, k, v, mesh=mesh, causal=causal)
+    want = reference_attention(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5)
+
+
+def test_ring_rejects_indivisible_seq():
+    mesh = make_mesh({SEQ_AXIS: 4})
+    q, k, v = _qkv(t=30)
+    with pytest.raises(ValueError, match="not divisible"):
+        ring_attention(q, k, v, mesh=mesh)
+
+
+def test_ring_under_jit_and_grad():
+    mesh = make_mesh({SEQ_AXIS: 4})
+    q, k, v = _qkv(t=32)
+
+    def loss_ring(q, k, v):
+        return jnp.sum(ring_attention(q, k, v, mesh=mesh, causal=True) ** 2)
+
+    def loss_dense(q, k, v):
+        return jnp.sum(reference_attention(q, k, v, causal=True) ** 2)
+
+    g_ring = jax.jit(jax.grad(loss_ring))(q, k, v)
+    g_dense = jax.grad(loss_dense)(q, k, v)
+    np.testing.assert_allclose(np.asarray(g_ring), np.asarray(g_dense), atol=3e-4)
