@@ -82,37 +82,69 @@ class RelayExecutor:
             jax.device_put(p, d) for p, d in zip(stage_params, self.devices)
         ]
         self.stage_fns = [jax.jit(fn) for fn in stage_fns]
-        # populated by record_timings runs: hop = inter-stage transfer
-        # (device i-1 -> device i; stage 0 excluded, it has no incoming
-        # hop), stage = per-stage compute time.
-        self.last_hop_times: Optional[List[float]] = None
+        # populated by record_timings runs (per-stage compute only; hop
+        # latency needs the slope method — see measure_hop_latency)
         self.last_stage_times: Optional[List[float]] = None
 
     def __call__(self, x, *, record_timings: bool = False):
         if not record_timings:
             for fn, params, dev in zip(self.stage_fns, self.stage_params, self.devices):
                 x = fn(params, jax.device_put(x, dev))
-            self.last_hop_times = self.last_stage_times = None
+            self.last_stage_times = None
             return x
 
         from dnn_tpu.utils.tracing import device_sync
 
-        hops, stages = [], []
-        for i, (fn, params, dev) in enumerate(
-            zip(self.stage_fns, self.stage_params, self.devices)
-        ):
-            t0 = time.perf_counter()
+        stages = []
+        for fn, params, dev in zip(self.stage_fns, self.stage_params, self.devices):
             xd = jax.device_put(x, dev)
             device_sync(xd)
             t1 = time.perf_counter()
             x = fn(params, xd)
             device_sync(x)
             stages.append(time.perf_counter() - t1)
-            if i > 0:  # stage 0's device_put is host ingress, not a hop
-                hops.append(t1 - t0)
-        self.last_hop_times = hops
         self.last_stage_times = stages
         return x
+
+    def measure_hop_latency(self, x, *, n1: int = 2, n2: int = 8) -> List[float]:
+        """One-way device-to-device transfer time per inter-stage hop,
+        measured honestly (SURVEY §7 hard part 4).
+
+        A naive `device_put + sync` sample would be dominated by the
+        host/tunnel round trip, not the transfer (see bench.py). Instead,
+        ping-pong the *actual activation entering stage i* between the two
+        stage devices n times back-to-back (an async dependency chain), sync
+        once, and take the two-point slope (t(n2) - t(n1)) / (n2 - n1) so
+        the constant sync RTT cancels; halve the per-pair slope for the
+        one-way time. Returns one entry per hop (stage i-1 -> stage i;
+        stage 0 has no incoming hop)."""
+        from dnn_tpu.utils.tracing import device_sync
+
+        acts = []  # activation entering each stage, as produced upstream
+        for fn, params, dev in zip(self.stage_fns, self.stage_params, self.devices):
+            acts.append(x)
+            x = fn(params, jax.device_put(x, dev))
+        device_sync(x)
+
+        hops = []
+        for i in range(1, len(self.devices)):
+            a, b = self.devices[i - 1], self.devices[i]
+            act = jax.device_put(acts[i], a)
+            device_sync(act)
+
+            def run(n):
+                y = act
+                t0 = time.perf_counter()
+                for _ in range(n):
+                    y = jax.device_put(jax.device_put(y, b), a)
+                device_sync(y)
+                return time.perf_counter() - t0
+
+            run(1)  # warmup
+            # clamp: on fast transports the slope can jitter below zero,
+            # which is pure measurement noise, not a latency
+            hops.append(max(0.0, (run(n2) - run(n1)) / (n2 - n1) / 2.0))
+        return hops
 
 
 # ----------------------------------------------------------------------

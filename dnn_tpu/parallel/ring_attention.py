@@ -59,19 +59,22 @@ def ring_attention_local(q, k, v, *, axis_name: str = SEQ_AXIS, causal: bool = T
     tri = jnp.tril(jnp.ones((t_local, t_local), dtype=bool))
     full = jnp.ones((t_local, t_local), dtype=bool)
 
-    def step(carry, i):
-        k_cur, v_cur, m, l, acc = carry
+    def _mask_for(i):
         # this K/V block originated at shard (my - i) mod n
         src = (my - i) % n
-        if causal:
-            # src == my: diagonal (triangular); src < my: past (full);
-            # src > my: future (dead). Select via where on the mask.
-            mask = jnp.where(src == my, tri, full)
-            mask = jnp.logical_and(mask, (src <= my)[..., None, None])
-        else:
-            mask = full
-        m, l, acc = _block_attend(qf, k_cur, v_cur, m, l, acc, mask)
-        # rotate K/V one hop around the ring: shard j's block moves to j+1
+        if not causal:
+            return full
+        # src == my: diagonal (triangular); src < my: past (full);
+        # src > my: future (dead). Select via where on the mask.
+        mask = jnp.where(src == my, tri, full)
+        return jnp.logical_and(mask, (src <= my)[..., None, None])
+
+    def step(carry, i):
+        k_cur, v_cur, m, l, acc = carry
+        m, l, acc = _block_attend(qf, k_cur, v_cur, m, l, acc, _mask_for(i))
+        # rotate K/V one hop around the ring: shard j's block moves to j+1.
+        # Rotation comes AFTER the attend so XLA can overlap the transfer
+        # with the matmuls (the attend does not depend on the permute).
         k_nxt = lax.ppermute(k_cur, axis_name, [(j, (j + 1) % n) for j in range(n)])
         v_nxt = lax.ppermute(v_cur, axis_name, [(j, (j + 1) % n) for j in range(n)])
         return (k_nxt, v_nxt, m, l, acc), None
@@ -83,7 +86,11 @@ def ring_attention_local(q, k, v, *, axis_name: str = SEQ_AXIS, causal: bool = T
         jnp.zeros((b, h, t_local, 1), jnp.float32),
         jnp.zeros((b, h, t_local, d), jnp.float32),
     )
-    (_, _, _, l, acc), _ = lax.scan(step, init, jnp.arange(n))
+    # scan the first n-1 blocks (each followed by a rotation), then attend
+    # the final block outside the loop — its rotation would be dead weight
+    # (one wasted ICI hop per K and V per call, and per backward).
+    (k_last, v_last, m, l, acc), _ = lax.scan(step, init, jnp.arange(n - 1))
+    m, l, acc = _block_attend(qf, k_last, v_last, m, l, acc, _mask_for(n - 1))
     # fully-masked rows (none exist for causal self-attention since the
     # diagonal block always contributes) would have l == 0; guard anyway.
     return (acc / jnp.maximum(l, 1e-30)).astype(q.dtype)

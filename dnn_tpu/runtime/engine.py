@@ -278,9 +278,14 @@ class PipelineEngine:
         SendTensor (node.py:52-54); used by the gRPC edge service."""
         params = self._stage_params_on_device.get(part_index)
         if params is None:
-            params = jax.device_put(
-                self._stage_params[part_index], self.devices[0]
-            )
+            if self._relay is not None:
+                # the relay executor already committed this stage's params to
+                # its stage device — reuse, don't duplicate HBM on device 0
+                params = self._relay.stage_params[part_index]
+            else:
+                params = jax.device_put(
+                    self._stage_params[part_index], self.devices[0]
+                )
             self._stage_params_on_device[part_index] = params
         return self._stage_jits[part_index](params, x)
 
@@ -329,14 +334,17 @@ class PipelineEngine:
                 with m.timer("step"):
                     tracing.device_sync(run_once())
         # hop/stage breakdown: separate instrumented relay runs (per-stage
-        # syncs perturb the step timing, so they don't share iterations)
+        # syncs perturb the step timing, so they don't share iterations).
+        # Hop latency uses the slope-based ping-pong measurement — a naive
+        # per-hop device_put+sync sample is dominated by host/tunnel RTT.
         if self.runtime == "relay":
             for _ in range(min(iters, 5)):
                 self._relay(x, record_timings=True)
-                for hop_t in self._relay.last_hop_times or []:
-                    m.observe("inter_stage_hop", hop_t)
                 for st_t in self._relay.last_stage_times or []:
                     m.observe("stage_compute", st_t)
+            if len(self.stages) > 1:
+                for hop_t in self._relay.measure_hop_latency(x):
+                    m.observe("inter_stage_hop", hop_t)
         snap = m.snapshot()
         step = snap["latency"]["step"]
         result = {

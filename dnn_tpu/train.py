@@ -102,12 +102,17 @@ def gpt_tp_specs(params, *, axis: str = MODEL_AXIS):
     return jax.tree_util.tree_map_with_path(spec_for, params)
 
 
+def specs_to_shardings(mesh: Mesh, specs):
+    """PartitionSpec pytree -> NamedSharding pytree (specs are themselves
+    pytrees, hence the is_leaf guard)."""
+    return jax.tree.map(
+        lambda s: NamedSharding(mesh, s), specs, is_leaf=lambda x: isinstance(x, P)
+    )
+
+
 def shard_pytree(tree, mesh: Mesh, specs):
     """Place a pytree on the mesh with the given PartitionSpecs."""
-    return jax.device_put(
-        tree, jax.tree.map(lambda s: NamedSharding(mesh, s), specs,
-                           is_leaf=lambda x: isinstance(x, P))
-    )
+    return jax.device_put(tree, specs_to_shardings(mesh, specs))
 
 
 def make_sharded_train_step(
@@ -123,10 +128,7 @@ def make_sharded_train_step(
     returned step keeps params/opt_state shardings stable across calls (no
     resharding churn), and gradient all-reduce over "data" plus tp
     collectives over "model" are inserted by GSPMD."""
-    param_shardings = jax.tree.map(
-        lambda s: NamedSharding(mesh, s), param_specs,
-        is_leaf=lambda x: isinstance(x, P),
-    )
+    param_shardings = specs_to_shardings(mesh, param_specs)
     batch_sharding = NamedSharding(mesh, P(batch_axis))
 
     @jax.jit
@@ -147,10 +149,7 @@ def init_sharded(init_fn: Callable, rng, mesh: Mesh, specs_fn: Callable = gpt_tp
     materialization on one device): eval_shape -> out_shardings -> jit."""
     shapes = jax.eval_shape(init_fn, rng)
     specs = specs_fn(shapes)
-    shardings = jax.tree.map(
-        lambda s: NamedSharding(mesh, s), specs, is_leaf=lambda x: isinstance(x, P)
-    )
-    params = jax.jit(init_fn, out_shardings=shardings)(rng)
+    params = jax.jit(init_fn, out_shardings=specs_to_shardings(mesh, specs))(rng)
     return params, specs
 
 

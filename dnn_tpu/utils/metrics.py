@@ -39,7 +39,7 @@ class LatencyReservoir:
         if len(self._samples) < self.capacity:
             self._samples.append(seconds)
         else:  # deterministic ring replacement; keeps a sliding window
-            self._samples[self._count % self.capacity] = seconds
+            self._samples[(self._count - 1) % self.capacity] = seconds
 
     @property
     def count(self) -> int:

@@ -63,11 +63,20 @@ def device_sync(out) -> None:
     """
     import numpy as np
 
+    # Per-device queues are independent, so the barrier must touch every
+    # device `out` lives on — one 1-element read per device (any array on
+    # that device works: the read completes only after all work enqueued
+    # before it on that device's in-order queue).
+    per_device = {}
     for leaf in jax.tree.leaves(out):
-        if hasattr(leaf, "ravel"):
-            np.asarray(leaf.ravel()[0])
-            break
-    else:  # no array leaves
+        shards = getattr(leaf, "addressable_shards", None)
+        if shards:
+            for s in shards:
+                per_device[s.device] = s.data
+    if per_device:
+        for data in per_device.values():
+            np.asarray(data.ravel()[0] if data.size else data)
+    else:  # no jax array leaves
         jax.block_until_ready(out)
 
 

@@ -66,14 +66,16 @@ def test_relay_timings(cifar_setup):
     stages = spec.partition(2)
     ex = RelayExecutor([s.apply for s in stages], [s.slice_params(params) for s in stages])
     ex(x, record_timings=True)
-    # 2 stages -> 1 inter-stage hop (stage 0's host ingress excluded)
-    # and one compute sample per stage
-    assert ex.last_hop_times is not None and len(ex.last_hop_times) == 1
+    # one compute sample per stage
     assert ex.last_stage_times is not None and len(ex.last_stage_times) == 2
-    assert all(t > 0 for t in ex.last_hop_times + ex.last_stage_times)
+    assert all(t > 0 for t in ex.last_stage_times)
+    # 2 stages -> 1 inter-stage hop (stage 0's host ingress excluded);
+    # slope-based measurement jitters to 0 on CPU, clamped non-negative
+    hops = ex.measure_hop_latency(x)
+    assert len(hops) == 1 and hops[0] >= 0.0
     # non-timed runs reset the records
     ex(x)
-    assert ex.last_hop_times is None and ex.last_stage_times is None
+    assert ex.last_stage_times is None
 
 
 # ----------------------------------------------------------------------
