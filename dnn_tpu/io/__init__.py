@@ -6,8 +6,18 @@ from dnn_tpu.io.checkpoint import (
     save_npz,
 )
 from dnn_tpu.io.preprocess import load_image, dummy_image
+from dnn_tpu.io.train_ckpt import (
+    save_train_state,
+    restore_train_state,
+    latest_checkpoint,
+    cleanup_old_checkpoints,
+)
 
 __all__ = [
+    "save_train_state",
+    "restore_train_state",
+    "latest_checkpoint",
+    "cleanup_old_checkpoints",
     "load_checkpoint",
     "load_pth_state_dict",
     "cifar_params_from_torch_state_dict",

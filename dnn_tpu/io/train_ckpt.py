@@ -1,0 +1,204 @@
+"""Training checkpoint save / resume.
+
+The reference is load-only: every node reads one pre-trained `.pth` and
+never writes anything back (/root/reference/node.py:294-317; SURVEY §5
+"Checkpoint / resume: LOAD-ONLY ... No saving, no resume"). The rebuild
+adds the other half: periodically persist the full train state (params +
+optimizer state + step) and resume from the newest checkpoint.
+
+Design (TPU-first, torch-free):
+  * A checkpoint is one `.npz` per step (`step_00000100.npz`) plus a JSON
+    manifest. Arbitrary pytrees are flattened with
+    `jax.tree_util.tree_flatten_with_path`; each leaf is keyed by its
+    keystr, so optax states (nested namedtuples) round-trip without custom
+    code.
+  * Restore is template-based: the caller passes a `like=` pytree with the
+    target structure (the freshly-initialized train state), mirroring how
+    the engine slices a full state dict per stage. This avoids pickling
+    treedefs.
+  * bfloat16 leaves are stored as a uint16 view with the true dtype
+    recorded in the manifest (npz has no native bf16).
+  * Sharded arrays are fine: `np.asarray` gathers the addressable shards
+    (single-process), and restore re-places leaves with `device_put` onto
+    each template leaf's sharding, so a dp/tp/pp-sharded train state resumes
+    into the same mesh layout it was saved from.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import tempfile
+from typing import Any, Optional, Tuple
+
+import jax
+import numpy as np
+
+_STEP_RE = re.compile(r"^step_(\d{8})\.npz$")
+_MANIFEST_SUFFIX = ".manifest.json"
+
+
+def _flatten(tree) -> Tuple[dict, Any]:
+    leaves, treedef = jax.tree_util.tree_flatten_with_path(tree)
+    flat = {}
+    for path, leaf in leaves:
+        flat[jax.tree_util.keystr(path)] = leaf
+    return flat, treedef
+
+
+def _to_savable(x: np.ndarray):
+    """Return (array-to-store, dtype-tag). bf16 -> uint16 view + tag."""
+    arr = np.asarray(x)
+    if arr.dtype.name == "bfloat16":
+        return arr.view(np.uint16), "bfloat16"
+    return arr, arr.dtype.name
+
+
+def _from_savable(arr: np.ndarray, tag: str):
+    if tag == "bfloat16":
+        import ml_dtypes
+
+        return arr.view(ml_dtypes.bfloat16)
+    return arr
+
+
+def checkpoint_path(ckpt_dir: str, step: int) -> str:
+    return os.path.join(ckpt_dir, f"step_{step:08d}.npz")
+
+
+def save_train_state(ckpt_dir: str, step: int, state) -> str:
+    """Persist `state` (any pytree: (params, opt_state), a dataclass of
+    arrays, ...) as checkpoint `step` under `ckpt_dir`. Atomic: written to a
+    temp file in the same directory, then renamed. Returns the path."""
+    os.makedirs(ckpt_dir, exist_ok=True)
+    flat, _ = _flatten(state)
+    arrays, dtypes = {}, {}
+    for i, (key, leaf) in enumerate(flat.items()):
+        arr, tag = _to_savable(leaf)
+        # npz member names must be safe; manifest maps index -> keystr.
+        arrays[f"leaf_{i}"] = arr
+        dtypes[f"leaf_{i}"] = {"key": key, "dtype": tag}
+
+    # Crash-safe ordering: both files are staged as temps, the manifest is
+    # renamed into place FIRST, the npz LAST. latest_checkpoint() keys on
+    # the npz and skips npz files without a manifest, so a kill at any
+    # point leaves either a complete checkpoint or ignorable debris — never
+    # a checkpoint that resume selects but cannot read.
+    path = checkpoint_path(ckpt_dir, step)
+    fd, tmp = tempfile.mkstemp(dir=ckpt_dir, suffix=".npz.tmp")
+    mfd, mtmp = tempfile.mkstemp(dir=ckpt_dir, suffix=".manifest.tmp")
+    try:
+        with os.fdopen(fd, "wb") as f:
+            np.savez(f, **arrays)
+        with os.fdopen(mfd, "w") as f:
+            json.dump({"step": step, "leaves": dtypes, "format": 1}, f)
+        os.replace(mtmp, path + _MANIFEST_SUFFIX)
+        os.replace(tmp, path)
+    except BaseException:
+        for t in (tmp, mtmp):
+            if os.path.exists(t):
+                os.unlink(t)
+        raise
+    return path
+
+
+def restore_train_state(ckpt_dir_or_path: str, like, step: Optional[int] = None):
+    """Load a checkpoint into the structure of `like` (a template pytree
+    with the desired treedef, e.g. a freshly-initialized train state).
+    Returns (state, step). Leaves are re-placed onto each template leaf's
+    sharding (committed device placement), so sharded states resume in
+    place."""
+    if os.path.isdir(ckpt_dir_or_path):
+        if step is not None:
+            path = checkpoint_path(ckpt_dir_or_path, step)
+        else:
+            found = latest_checkpoint(ckpt_dir_or_path)
+            if found is None:
+                raise FileNotFoundError(
+                    f"no checkpoints under {ckpt_dir_or_path}"
+                )
+            path, step = found
+    else:
+        path = ckpt_dir_or_path
+
+    with open(path + _MANIFEST_SUFFIX) as f:
+        manifest = json.load(f)
+    if step is None:
+        step = manifest["step"]
+
+    by_key = {}
+    with np.load(path) as zf:
+        for member, meta in manifest["leaves"].items():
+            by_key[meta["key"]] = _from_savable(zf[member], meta["dtype"])
+
+    leaves, treedef = jax.tree_util.tree_flatten_with_path(like)
+    out = []
+    for path_keys, tmpl in leaves:
+        key = jax.tree_util.keystr(path_keys)
+        if key not in by_key:
+            raise KeyError(f"checkpoint {path} is missing leaf {key}")
+        arr = by_key[key]
+        tmpl_arr = np.asarray(tmpl) if not hasattr(tmpl, "shape") else tmpl
+        if tuple(arr.shape) != tuple(tmpl_arr.shape):
+            raise ValueError(
+                f"shape mismatch for {key}: checkpoint {arr.shape} vs "
+                f"template {tmpl_arr.shape}"
+            )
+        if isinstance(tmpl, jax.Array):
+            out.append(jax.device_put(arr, tmpl.sharding))
+        else:
+            out.append(arr)
+    return jax.tree_util.tree_unflatten(treedef, out), step
+
+
+def latest_checkpoint(ckpt_dir: str) -> Optional[Tuple[str, int]]:
+    """Newest complete (path, step) under ckpt_dir, or None. An npz without
+    its manifest (crash debris) is skipped."""
+    if not os.path.isdir(ckpt_dir):
+        return None
+    best = None
+    for name in os.listdir(ckpt_dir):
+        m = _STEP_RE.match(name)
+        if m:
+            path = os.path.join(ckpt_dir, name)
+            if not os.path.exists(path + _MANIFEST_SUFFIX):
+                continue
+            s = int(m.group(1))
+            if best is None or s > best[1]:
+                best = (path, s)
+    return best
+
+
+def cleanup_old_checkpoints(ckpt_dir: str, keep: int = 3) -> int:
+    """Delete all but the newest `keep` COMPLETE checkpoints (npz+manifest
+    pairs — the same completeness rule latest_checkpoint applies), plus any
+    crash debris: an npz without its manifest or a manifest without its npz.
+    Returns #files-removed."""
+    if keep < 1:
+        raise ValueError("keep must be >= 1")
+    if not os.path.isdir(ckpt_dir):
+        return 0
+    complete, debris = [], []
+    for name in os.listdir(ckpt_dir):
+        m = _STEP_RE.match(name)
+        if m:
+            path = os.path.join(ckpt_dir, name)
+            if os.path.exists(path + _MANIFEST_SUFFIX):
+                complete.append((int(m.group(1)), path))
+            else:
+                debris.append(path)
+        elif name.endswith(_MANIFEST_SUFFIX):
+            npz = os.path.join(ckpt_dir, name[: -len(_MANIFEST_SUFFIX)])
+            if _STEP_RE.match(os.path.basename(npz)) and not os.path.exists(npz):
+                debris.append(os.path.join(ckpt_dir, name))
+    complete.sort(reverse=True)
+    removed = 0
+    for _, path in complete[keep:]:
+        os.unlink(path)
+        os.unlink(path + _MANIFEST_SUFFIX)
+        removed += 2
+    for path in debris:
+        os.unlink(path)
+        removed += 1
+    return removed

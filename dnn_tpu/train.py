@@ -157,6 +157,59 @@ def init_sharded(init_fn: Callable, rng, mesh: Mesh, specs_fn: Callable = gpt_tp
 # pipeline-parallel training
 # --------------------------------------------------------------------------
 
+def resume_or_init(ckpt_dir: Optional[str], init_state):
+    """Resume from the newest checkpoint under `ckpt_dir` (template =
+    `init_state`), or start fresh. Returns (state, start_step). The
+    resume half of SURVEY §5's checkpoint mandate (the reference has
+    neither — node.py:294-317 only ever loads)."""
+    from dnn_tpu.io.train_ckpt import restore_train_state
+
+    if ckpt_dir:
+        try:
+            return restore_train_state(ckpt_dir, like=init_state)
+        except FileNotFoundError:
+            pass
+    return init_state, 0
+
+
+def fit(
+    step_fn: Callable,
+    state,
+    batch_iter,
+    *,
+    num_steps: int,
+    start_step: int = 0,
+    ckpt_dir: Optional[str] = None,
+    ckpt_every: int = 0,
+    keep_checkpoints: int = 3,
+    on_step: Optional[Callable] = None,
+):
+    """Generic training loop with periodic checkpointing.
+
+    `step_fn(state, batch) -> (state, loss)` over any state pytree (wrap
+    the make_*_train_step outputs to this signature). `batch_iter` yields
+    batches. Saves every `ckpt_every` steps into `ckpt_dir` and prunes to
+    `keep_checkpoints`. Returns (state, last_loss)."""
+    from dnn_tpu.io.train_ckpt import cleanup_old_checkpoints, save_train_state
+
+    loss = None
+    for step in range(start_step, num_steps):
+        try:
+            batch = next(batch_iter)
+        except StopIteration:
+            raise ValueError(
+                f"batch_iter exhausted at step {step} (wanted {num_steps}); "
+                "pass an infinite iterator or lower num_steps"
+            ) from None
+        state, loss = step_fn(state, batch)
+        if on_step is not None:
+            on_step(step + 1, loss)
+        if ckpt_dir and ckpt_every and (step + 1) % ckpt_every == 0:
+            save_train_state(ckpt_dir, step + 1, state)
+            cleanup_old_checkpoints(ckpt_dir, keep=keep_checkpoints)
+    return state, loss
+
+
 def make_pipeline_train_step(
     block_fn: Callable,
     embed_fn: Callable,
