@@ -3,20 +3,28 @@
 Rebuilds the reference's node-0 client path (initiate_inference,
 node.py:137-200): run the local stage, send the activation downstream, wait
 for the result to ride back up the response chain, return the final tensor.
-Adds what the reference lacked: a real HealthCheck probe before submitting
-(its HealthCheck had no caller — SURVEY §3.4) and channel reuse.
+Adds what the reference lacked (SURVEY §5 "Failure detection ... No retry"):
+a real HealthCheck probe before submitting (its HealthCheck had no caller —
+SURVEY §3.4), channel reuse, and bounded retries with exponential backoff
+on transient transport failures.
 """
 
 from __future__ import annotations
 
 import logging
+import time
 from typing import Optional
 
 import grpc
 import numpy as np
 
 from dnn_tpu.comm import wire_pb2 as pb
-from dnn_tpu.comm.service import SERVICE_NAME, _tensor_arr, _tensor_msg
+from dnn_tpu.comm.service import (
+    RETRYABLE_CODES,
+    SERVICE_NAME,
+    _tensor_arr,
+    _tensor_msg,
+)
 
 log = logging.getLogger("dnn_tpu.comm")
 
@@ -50,20 +58,57 @@ class NodeClient:
             pb.MessageRequest(sender_id=sender_id, message_text=text), timeout=timeout
         ).confirmation_text
 
+    def wait_healthy(self, deadline: float = 30.0, interval: float = 0.5) -> bool:
+        """Poll HealthCheck until it answers healthy or `deadline` seconds
+        elapse. The startup-ordering fix for the reference's blind 2-second
+        sleep before initiating (start_inference_after_delay, node.py:203-207)."""
+        t_end = time.monotonic() + deadline
+        while True:
+            if self.health_check(timeout=min(5.0, interval * 4)):
+                return True
+            if time.monotonic() >= t_end:
+                return False
+            time.sleep(interval)
+
     def send_tensor(
-        self, arr: np.ndarray, *, request_id: str = "req", timeout: float = 60.0
+        self,
+        arr: np.ndarray,
+        *,
+        request_id: str = "req",
+        timeout: float = 60.0,
+        retries: int = 2,
+        backoff: float = 0.2,
     ) -> tuple[str, Optional[np.ndarray]]:
         """Submit an activation; returns (status, final_tensor_or_None) —
-        the response-chain semantics of node.py:180-194."""
+        the response-chain semantics of node.py:180-194. Transient transport
+        failures (RETRYABLE_CODES) are retried up to `retries` times with
+        exponential backoff; the pipeline is stateless per request, so a
+        resend is safe. `timeout` is the OVERALL budget across all attempts
+        and backoff sleeps, not a per-attempt deadline."""
         call = self._channel.unary_unary(
             f"/{SERVICE_NAME}/SendTensor",
             request_serializer=pb.TensorRequest.SerializeToString,
             response_deserializer=pb.TensorResponse.FromString,
         )
-        resp = call(
-            pb.TensorRequest(request_id=request_id, tensor=_tensor_msg(arr)),
-            timeout=timeout,
-        )
+        request = pb.TensorRequest(request_id=request_id, tensor=_tensor_msg(arr))
+        deadline = time.monotonic() + timeout
+        attempt = 0
+        while True:
+            remaining = deadline - time.monotonic()
+            try:
+                resp = call(request, timeout=max(remaining, 0.001))
+                break
+            except grpc.RpcError as e:
+                delay = backoff * (2 ** attempt)
+                out_of_budget = deadline - time.monotonic() <= delay
+                if e.code() not in RETRYABLE_CODES or attempt >= retries or out_of_budget:
+                    raise
+                log.warning(
+                    "send_tensor to %s failed (%s), retry %d/%d in %.2fs",
+                    self.address, e.code(), attempt + 1, retries, delay,
+                )
+                time.sleep(delay)
+                attempt += 1
         result = _tensor_arr(resp.result_tensor) if resp.HasField("result_tensor") else None
         return resp.status, result
 

@@ -35,6 +35,15 @@ log = logging.getLogger("dnn_tpu.comm")
 
 SERVICE_NAME = "node_service.NodeService"
 
+# Transient codes worth retrying, shared by the edge client and the server's
+# downstream relay; anything else (INVALID_ARGUMENT, UNIMPLEMENTED, ...) is a
+# real error and surfaces immediately.
+RETRYABLE_CODES = frozenset({
+    grpc.StatusCode.UNAVAILABLE,
+    grpc.StatusCode.DEADLINE_EXCEEDED,
+    grpc.StatusCode.RESOURCE_EXHAUSTED,
+})
+
 
 def _tensor_msg(arr) -> pb.Tensor:
     data, shape, dtype = encode_tensor(arr)
@@ -97,7 +106,15 @@ class StageServer:
 
     # --- plumbing ---
 
-    async def _forward(self, request_id: str, y: np.ndarray) -> pb.TensorResponse:
+    async def _forward(
+        self, request_id: str, y: np.ndarray, *, retries: int = 2, backoff: float = 0.2
+    ) -> pb.TensorResponse:
+        """Relay downstream with bounded retries on transient failures
+        (RETRYABLE_CODES), reusing the shared channel across attempts (gRPC
+        reconnects a broken channel on the next call) — the per-hop
+        resilience the reference lacks (SURVEY §5: failures only become
+        status strings, "No retry")."""
+        request = pb.TensorRequest(request_id=request_id, tensor=_tensor_msg(y))
         if self._next_channel is None:
             self._next_channel = grpc.aio.insecure_channel(self.next_address)
         call = self._next_channel.unary_unary(
@@ -105,7 +122,24 @@ class StageServer:
             request_serializer=pb.TensorRequest.SerializeToString,
             response_deserializer=pb.TensorResponse.FromString,
         )
-        return await call(pb.TensorRequest(request_id=request_id, tensor=_tensor_msg(y)))
+        attempt = 0
+        while True:
+            try:
+                return await call(request)
+            except grpc.aio.AioRpcError as e:
+                # NOTE: the shared channel is deliberately NOT closed between
+                # attempts — other requests may have calls in flight on it,
+                # and gRPC reconnects a broken channel on the next call anyway.
+                if e.code() not in RETRYABLE_CODES or attempt >= retries:
+                    raise
+                delay = backoff * (2 ** attempt)
+                log.warning(
+                    "forward %s -> %s failed (%s), retry %d/%d in %.2fs",
+                    self.node.id, self.next_address, e.code(),
+                    attempt + 1, retries, delay,
+                )
+                await asyncio.sleep(delay)
+                attempt += 1
 
     async def close(self):
         if self._next_channel is not None:

@@ -63,9 +63,14 @@ def _initiate_local(engine: PipelineEngine, image_path: str) -> int:
     return pred
 
 
-async def _initiate_edge(engine: PipelineEngine, node_id: str, image_path: str, delay: float = 2.0):
+async def _initiate_edge(engine: PipelineEngine, node_id: str, image_path: str,
+                         health_deadline: float = 30.0):
     """Edge-mode initiator: run stage 0 locally, relay downstream over gRPC
     (start_inference_after_delay + initiate_inference, node.py:137-207).
+    Instead of the reference's blind 2-second sleep before initiating
+    (node.py:203-207), poll the next node's HealthCheck until it comes up
+    (bounded by `health_deadline`) — late-starting downstream nodes are
+    normal during rollout, not errors.
 
     The sync gRPC client calls run in a thread executor so this node's own
     server handlers stay responsive while the pipeline round-trip is in
@@ -73,7 +78,6 @@ async def _initiate_edge(engine: PipelineEngine, node_id: str, image_path: str, 
     """
     from dnn_tpu.comm.client import NodeClient
 
-    await asyncio.sleep(delay)
     loop = asyncio.get_running_loop()
     cfg = engine.config
     me = cfg.node_by_id(node_id)
@@ -86,8 +90,10 @@ async def _initiate_edge(engine: PipelineEngine, node_id: str, image_path: str, 
         print(f"***** FINAL PREDICTION (Index): {int(np.argmax(y))} *****")
         return
     client = NodeClient(nxt.address)
-    if not await loop.run_in_executor(None, client.health_check):
-        log.error("next node %s failed health check", nxt.address)
+    if not await loop.run_in_executor(
+        None, lambda: client.wait_healthy(deadline=health_deadline)
+    ):
+        log.error("next node %s not healthy after %.0fs", nxt.address, health_deadline)
         return
     status, result = await loop.run_in_executor(
         None, lambda: client.send_tensor(y, request_id="dnn_tpu_pipe_001")
