@@ -34,6 +34,9 @@ class NodeClient:
     the wire protocol is identical)."""
 
     def __init__(self, address: str):
+        from dnn_tpu.native import native_available
+
+        native_available()  # warm the one-time native codec build up front
         self.address = address
         self._channel = grpc.insecure_channel(address)
 
@@ -97,20 +100,27 @@ class NodeClient:
             remaining = deadline - time.monotonic()
             try:
                 resp = call(request, timeout=max(remaining, 0.001))
-                break
-            except grpc.RpcError as e:
+                # decode INSIDE the loop: a crc32c mismatch on the response
+                # is transient corruption, and resending is as safe as for a
+                # transport failure.
+                result = (
+                    _tensor_arr(resp.result_tensor)
+                    if resp.HasField("result_tensor") else None
+                )
+                return resp.status, result
+            except (grpc.RpcError, ValueError) as e:
+                code = e.code() if isinstance(e, grpc.RpcError) else None
+                retryable = isinstance(e, ValueError) or code in RETRYABLE_CODES
                 delay = backoff * (2 ** attempt)
                 out_of_budget = deadline - time.monotonic() <= delay
-                if e.code() not in RETRYABLE_CODES or attempt >= retries or out_of_budget:
+                if not retryable or attempt >= retries or out_of_budget:
                     raise
                 log.warning(
                     "send_tensor to %s failed (%s), retry %d/%d in %.2fs",
-                    self.address, e.code(), attempt + 1, retries, delay,
+                    self.address, code or e, attempt + 1, retries, delay,
                 )
                 time.sleep(delay)
                 attempt += 1
-        result = _tensor_arr(resp.result_tensor) if resp.HasField("result_tensor") else None
-        return resp.status, result
 
     def close(self):
         self._channel.close()

@@ -47,10 +47,23 @@ RETRYABLE_CODES = frozenset({
 
 def _tensor_msg(arr) -> pb.Tensor:
     data, shape, dtype = encode_tensor(arr)
-    return pb.Tensor(tensor_data=data, shape=list(shape), dtype=dtype)
+    from dnn_tpu.native import crc32c
+
+    return pb.Tensor(
+        tensor_data=data, shape=list(shape), dtype=dtype, crc32c=crc32c(data)
+    )
 
 
 def _tensor_arr(msg: pb.Tensor) -> np.ndarray:
+    if msg.HasField("crc32c"):  # absent on reference-peer messages
+        from dnn_tpu.native import crc32c
+
+        got = crc32c(msg.tensor_data)
+        if got != msg.crc32c:
+            raise ValueError(
+                f"tensor payload corrupt: crc32c {got:#010x} != "
+                f"declared {msg.crc32c:#010x}"
+            )
     return decode_tensor(msg.tensor_data, list(msg.shape), msg.dtype)
 
 
@@ -60,6 +73,12 @@ class StageServer:
     which part this process owns via the shared topology config."""
 
     def __init__(self, engine, node_id: str):
+        # Warm the native codec NOW (a synchronous g++ compile on first
+        # build) so it never runs inside an async RPC handler, where it
+        # would freeze the event loop for the duration of the compile.
+        from dnn_tpu.native import native_available
+
+        native_available()
         self.engine = engine
         self.config = engine.config
         self.node = self.config.node_by_id(node_id)

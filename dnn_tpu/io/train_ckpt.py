@@ -67,15 +67,27 @@ def checkpoint_path(ckpt_dir: str, step: int) -> str:
     return os.path.join(ckpt_dir, f"step_{step:08d}.npz")
 
 
-def save_train_state(ckpt_dir: str, step: int, state) -> str:
+def save_train_state(
+    ckpt_dir: str, step: int, state, *, compress_bf16: bool = False
+) -> str:
     """Persist `state` (any pytree: (params, opt_state), a dataclass of
     arrays, ...) as checkpoint `step` under `ckpt_dir`. Atomic: written to a
-    temp file in the same directory, then renamed. Returns the path."""
+    temp file in the same directory, then renamed. Returns the path.
+
+    `compress_bf16=True` stores float32 leaves as bfloat16 (half the bytes,
+    round-to-nearest-even via the native codec); restore upcasts back to the
+    template's dtype. Use for inference snapshots / space-constrained
+    checkpoints — optimizer moments lose precision like everything else."""
     os.makedirs(ckpt_dir, exist_ok=True)
     flat, _ = _flatten(state)
     arrays, dtypes = {}, {}
     for i, (key, leaf) in enumerate(flat.items()):
-        arr, tag = _to_savable(leaf)
+        arr = np.asarray(leaf)
+        if compress_bf16 and arr.dtype == np.float32:
+            from dnn_tpu.native import f32_to_bf16
+
+            arr = f32_to_bf16(arr)
+        arr, tag = _to_savable(arr)
         # npz member names must be safe; manifest maps index -> keystr.
         arrays[f"leaf_{i}"] = arr
         dtypes[f"leaf_{i}"] = {"key": key, "dtype": tag}
@@ -145,6 +157,16 @@ def restore_train_state(ckpt_dir_or_path: str, like, step: Optional[int] = None)
                 f"shape mismatch for {key}: checkpoint {arr.shape} vs "
                 f"template {tmpl_arr.shape}"
             )
+        if arr.dtype != tmpl_arr.dtype:
+            # dtype adaptation (e.g. a compress_bf16 checkpoint restored
+            # into an f32 state); bf16 -> f32 upcasts through the native
+            # codec, everything else through numpy
+            if arr.dtype.name == "bfloat16" and tmpl_arr.dtype == np.float32:
+                from dnn_tpu.native import bf16_to_f32
+
+                arr = bf16_to_f32(arr)
+            else:
+                arr = arr.astype(tmpl_arr.dtype)
         if isinstance(tmpl, jax.Array):
             out.append(jax.device_put(arr, tmpl.sharding))
         else:

@@ -1,0 +1,100 @@
+// dnn_tpu native codec: payload integrity + dtype conversion kernels.
+//
+// The reference ships zero native code (SURVEY §2: "100% Python") and its
+// wire format carries raw bytes with no integrity check
+// (/root/reference/node_service.proto:26-30, node.py:45-48). This library
+// supplies the native half of the rebuild's transport hardening: CRC32C
+// (Castagnoli) at memory bandwidth via slice-by-8, plus bf16<->f32 block
+// converters (round-to-nearest-even, the MXU's native rounding) used when
+// staging checkpoint/activation buffers.
+//
+// Built on demand by dnn_tpu/native/__init__.py with the system g++; the
+// Python side falls back to a table-driven implementation when no compiler
+// is present, producing bit-identical results.
+
+#include <cstddef>
+#include <cstdint>
+#include <cstring>
+
+namespace {
+
+uint32_t g_tables[8][256];
+
+// Static-init at dlopen time: no lazy-init data race when the first
+// dnn_crc32c calls arrive concurrently from several server threads.
+struct TableInit {
+    TableInit() {
+        const uint32_t poly = 0x82f63b78u;  // CRC32C (Castagnoli), reflected
+        for (uint32_t i = 0; i < 256; ++i) {
+            uint32_t c = i;
+            for (int k = 0; k < 8; ++k)
+                c = (c & 1) ? (poly ^ (c >> 1)) : (c >> 1);
+            g_tables[0][i] = c;
+        }
+        for (uint32_t i = 0; i < 256; ++i) {
+            uint32_t c = g_tables[0][i];
+            for (int t = 1; t < 8; ++t) {
+                c = g_tables[0][c & 0xff] ^ (c >> 8);
+                g_tables[t][i] = c;
+            }
+        }
+    }
+};
+const TableInit g_table_init;
+
+}  // namespace
+
+extern "C" {
+
+// CRC32C over `n` bytes, continuing from `seed` (pass 0 to start).
+uint32_t dnn_crc32c(const uint8_t* data, size_t n, uint32_t seed) {
+    uint32_t crc = ~seed;
+    // align to 8 bytes
+    while (n && (reinterpret_cast<uintptr_t>(data) & 7u)) {
+        crc = g_tables[0][(crc ^ *data++) & 0xff] ^ (crc >> 8);
+        --n;
+    }
+    while (n >= 8) {
+        uint64_t w;
+        std::memcpy(&w, data, 8);
+        w ^= crc;  // little-endian host assumed (TPU hosts are x86/ARM LE)
+        crc = g_tables[7][w & 0xff] ^
+              g_tables[6][(w >> 8) & 0xff] ^
+              g_tables[5][(w >> 16) & 0xff] ^
+              g_tables[4][(w >> 24) & 0xff] ^
+              g_tables[3][(w >> 32) & 0xff] ^
+              g_tables[2][(w >> 40) & 0xff] ^
+              g_tables[1][(w >> 48) & 0xff] ^
+              g_tables[0][(w >> 56) & 0xff];
+        data += 8;
+        n -= 8;
+    }
+    while (n--) crc = g_tables[0][(crc ^ *data++) & 0xff] ^ (crc >> 8);
+    return ~crc;
+}
+
+// bf16 (as uint16) -> f32: exact (bf16 is a truncated f32).
+void dnn_bf16_to_f32(const uint16_t* src, float* dst, size_t n) {
+    for (size_t i = 0; i < n; ++i) {
+        uint32_t bits = static_cast<uint32_t>(src[i]) << 16;
+        std::memcpy(&dst[i], &bits, 4);
+    }
+}
+
+// f32 -> bf16 with round-to-nearest-even (matches XLA/ml_dtypes). NaNs are
+// quieted to preserve NaN-ness through truncation.
+void dnn_f32_to_bf16(const float* src, uint16_t* dst, size_t n) {
+    for (size_t i = 0; i < n; ++i) {
+        uint32_t bits;
+        std::memcpy(&bits, &src[i], 4);
+        if ((bits & 0x7fffffffu) > 0x7f800000u) {  // NaN
+            dst[i] = static_cast<uint16_t>((bits >> 16) | 0x0040u);
+            continue;
+        }
+        uint32_t lsb = (bits >> 16) & 1u;
+        bits += 0x7fffu + lsb;
+        dst[i] = static_cast<uint16_t>(bits >> 16);
+    }
+}
+
+}  // extern "C"

@@ -1,0 +1,131 @@
+"""Native codec tests: the compiled C++ path and the pure-Python fallback
+must be bit-identical, and the wire layer must reject corrupt payloads.
+(The reference has no native layer and no integrity checking — SURVEY §2
+"100% Python", §5 "no endianness/alignment handling".)
+"""
+
+import shutil
+
+import numpy as np
+import pytest
+
+from dnn_tpu import native
+
+
+@pytest.mark.skipif(shutil.which("g++") is None, reason="no C++ toolchain")
+def test_native_builds_here():
+    """Where a compiler exists the compiled path must actually be used —
+    a silent fallback would invalidate the perf claims. (Hosts without g++
+    run the bit-identical Python fallback by design.)"""
+    assert native.native_available()
+
+
+# Known-answer tests: RFC 3720 CRC32C vectors.
+@pytest.mark.parametrize(
+    "data,want",
+    [
+        (b"", 0x00000000),
+        (b"a", 0xC1D04330),
+        (b"123456789", 0xE3069283),
+        (bytes(32), 0x8A9136AA),
+        (bytes(range(32)), 0x46DD794E),
+    ],
+)
+def test_crc32c_known_answers(data, want):
+    assert native.crc32c(data) == want
+
+
+def test_crc32c_native_matches_python_fallback():
+    rng = np.random.default_rng(0)
+    for n in (0, 1, 7, 8, 9, 63, 64, 65, 1000, 4096, 100_000):
+        buf = rng.integers(0, 256, n, dtype=np.uint8).tobytes()
+        native_crc = native.crc32c(buf)
+        # force the fallback path
+        table = native._py_table()
+        crc = 0xFFFFFFFF
+        for b in buf:
+            crc = table[(crc ^ b) & 0xFF] ^ (crc >> 8)
+        assert native_crc == (~crc) & 0xFFFFFFFF
+
+
+def test_crc32c_seed_chaining():
+    buf = b"hello, pipeline world"
+    whole = native.crc32c(buf)
+    part = native.crc32c(buf[7:], seed=native.crc32c(buf[:7]))
+    assert whole == part
+
+
+def test_crc32c_unaligned_offsets():
+    """slice-by-8 has an alignment prologue; exercise every phase."""
+    base = np.frombuffer(bytes(range(256)) * 4, dtype=np.uint8)
+    want = [native.crc32c(base[off:].tobytes()) for off in range(9)]
+    got = [native.crc32c(base[off:]) for off in range(9)]
+    assert want == got
+
+
+def test_bf16_roundtrip_exact():
+    import ml_dtypes
+
+    rng = np.random.default_rng(1)
+    x = rng.standard_normal((137,)).astype(ml_dtypes.bfloat16)
+    f32 = native.bf16_to_f32(x)
+    assert f32.dtype == np.float32
+    np.testing.assert_array_equal(f32, x.astype(np.float32))
+    back = native.f32_to_bf16(f32)
+    np.testing.assert_array_equal(back.view(np.uint16), x.view(np.uint16))
+
+
+def test_f32_to_bf16_matches_ml_dtypes_rounding():
+    """Round-to-nearest-even must match ml_dtypes (== XLA) bit-for-bit,
+    including ties, subnormals, infinities."""
+    import ml_dtypes
+
+    rng = np.random.default_rng(2)
+    cases = np.concatenate([
+        rng.standard_normal(10_000).astype(np.float32),
+        rng.standard_normal(1000).astype(np.float32) * 1e30,
+        rng.standard_normal(1000).astype(np.float32) * 1e-30,
+        np.array([0.0, -0.0, np.inf, -np.inf, 1.0, -1.0,
+                  3.0000001, 0.1, 65504.0], np.float32),
+        # tie cases: exactly halfway between bf16 neighbors
+        np.array([1.00390625, 1.01171875], np.float32),
+    ])
+    ours = native.f32_to_bf16(cases).view(np.uint16)
+    ref = cases.astype(ml_dtypes.bfloat16).view(np.uint16)
+    np.testing.assert_array_equal(ours, ref)
+
+
+def test_f32_to_bf16_nan_stays_nan():
+    out = native.f32_to_bf16(np.array([np.nan, -np.nan], np.float32))
+    assert np.isnan(out.astype(np.float32)).all()
+
+
+def test_wire_rejects_corrupt_payload():
+    from dnn_tpu.comm import wire_pb2 as pb
+    from dnn_tpu.comm.service import _tensor_arr, _tensor_msg
+
+    msg = _tensor_msg(np.arange(12, dtype=np.float32).reshape(3, 4))
+    assert msg.HasField("crc32c")
+    # round-trips clean
+    np.testing.assert_array_equal(
+        _tensor_arr(msg), np.arange(12, dtype=np.float32).reshape(3, 4)
+    )
+    # flip one payload byte -> must be detected
+    data = bytearray(msg.tensor_data)
+    data[5] ^= 0x01
+    bad = pb.Tensor(
+        tensor_data=bytes(data), shape=msg.shape, dtype=msg.dtype, crc32c=msg.crc32c
+    )
+    with pytest.raises(ValueError, match="corrupt"):
+        _tensor_arr(bad)
+
+
+def test_wire_accepts_reference_peer_without_crc():
+    """A reference node.py peer sends no crc32c field; we must still decode
+    (wire compat, SURVEY C3)."""
+    from dnn_tpu.comm import wire_pb2 as pb
+    from dnn_tpu.comm.service import _tensor_arr
+
+    arr = np.ones((2, 2), np.float32)
+    msg = pb.Tensor(tensor_data=arr.tobytes(), shape=[2, 2], dtype="float32")
+    np.testing.assert_array_equal(_tensor_arr(msg), arr)

@@ -81,6 +81,31 @@ def test_cleanup_removes_debris_and_keeps_complete(tmp_path):
     assert latest_checkpoint(str(tmp_path))[1] == 10
 
 
+def test_compressed_bf16_checkpoint(tmp_path):
+    """compress_bf16 halves f32 leaf bytes; restore upcasts to the template
+    dtype within bf16 precision. int leaves pass through untouched."""
+    state = {
+        "w": jnp.asarray(np.random.default_rng(0).standard_normal((64, 64)),
+                         jnp.float32),
+        "step": jnp.int32(9),
+    }
+    save_train_state(str(tmp_path), 1, state, compress_bf16=True)
+    restored, _ = restore_train_state(str(tmp_path), like=state)
+    assert restored["w"].dtype == np.float32
+    assert int(restored["step"]) == 9
+    np.testing.assert_allclose(
+        np.asarray(restored["w"]), np.asarray(state["w"]), rtol=1e-2, atol=1e-2
+    )
+    # and it really is smaller than the uncompressed save
+    import os as _os
+
+    full_dir = tmp_path / "full"
+    save_train_state(str(full_dir), 1, state)
+    small = _os.path.getsize(tmp_path / "step_00000001.npz")
+    big = _os.path.getsize(full_dir / "step_00000001.npz")
+    assert small < 0.6 * big
+
+
 def test_latest_skips_manifestless_debris(tmp_path):
     """A crash can leave an npz without its manifest; resume must fall back
     to the previous complete checkpoint instead of dying on the orphan."""
