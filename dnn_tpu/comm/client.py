@@ -25,6 +25,7 @@ from dnn_tpu.comm.service import (
     _tensor_arr,
     _tensor_msg,
 )
+from dnn_tpu.io.serialization import PayloadCorruptError
 
 log = logging.getLogger("dnn_tpu.comm")
 
@@ -108,9 +109,9 @@ class NodeClient:
                     if resp.HasField("result_tensor") else None
                 )
                 return resp.status, result
-            except (grpc.RpcError, ValueError) as e:
+            except (grpc.RpcError, PayloadCorruptError) as e:
                 code = e.code() if isinstance(e, grpc.RpcError) else None
-                retryable = isinstance(e, ValueError) or code in RETRYABLE_CODES
+                retryable = isinstance(e, PayloadCorruptError) or code in RETRYABLE_CODES
                 delay = backoff * (2 ** attempt)
                 out_of_budget = deadline - time.monotonic() <= delay
                 if not retryable or attempt >= retries or out_of_budget:

@@ -29,7 +29,11 @@ import grpc
 import numpy as np
 
 from dnn_tpu.comm import wire_pb2 as pb
-from dnn_tpu.io.serialization import decode_tensor, encode_tensor
+from dnn_tpu.io.serialization import (
+    PayloadCorruptError,
+    decode_tensor,
+    encode_tensor,
+)
 
 log = logging.getLogger("dnn_tpu.comm")
 
@@ -42,25 +46,33 @@ RETRYABLE_CODES = frozenset({
     grpc.StatusCode.UNAVAILABLE,
     grpc.StatusCode.DEADLINE_EXCEEDED,
     grpc.StatusCode.RESOURCE_EXHAUSTED,
+    # a receiver detected payload corruption (crc32c mismatch) — the
+    # pipeline is stateless per request, so resending is safe and likely
+    # to succeed
+    grpc.StatusCode.DATA_LOSS,
 })
 
 
 def _tensor_msg(arr) -> pb.Tensor:
     data, shape, dtype = encode_tensor(arr)
-    from dnn_tpu.native import crc32c
+    from dnn_tpu.native import crc32c, native_available
 
-    return pb.Tensor(
-        tensor_data=data, shape=list(shape), dtype=dtype, crc32c=crc32c(data)
-    )
+    msg = pb.Tensor(tensor_data=data, shape=list(shape), dtype=dtype)
+    # Checksum only when the native codec is built: the Python fallback is a
+    # per-byte loop that would add seconds per MB on the transport hot path.
+    # Field absent == "not checksummed", same as a reference peer.
+    if native_available():
+        msg.crc32c = crc32c(data)
+    return msg
 
 
 def _tensor_arr(msg: pb.Tensor) -> np.ndarray:
-    if msg.HasField("crc32c"):  # absent on reference-peer messages
-        from dnn_tpu.native import crc32c
+    from dnn_tpu.native import crc32c, native_available
 
+    if msg.HasField("crc32c") and native_available():
         got = crc32c(msg.tensor_data)
         if got != msg.crc32c:
-            raise ValueError(
+            raise PayloadCorruptError(
                 f"tensor payload corrupt: crc32c {got:#010x} != "
                 f"declared {msg.crc32c:#010x}"
             )
@@ -94,7 +106,14 @@ class StageServer:
         nid = self.node.id
         result_msg = None
         try:
-            x = _tensor_arr(request.tensor)
+            try:
+                x = _tensor_arr(request.tensor)
+            except PayloadCorruptError as e:
+                # Fail the RPC itself (not a status string) so the sender's
+                # retry loop sees DATA_LOSS and resends — transient wire
+                # corruption must not become a terminal pipeline error.
+                log.warning("corrupt payload on %s: %s", nid, e)
+                await context.abort(grpc.StatusCode.DATA_LOSS, str(e))
             y = np.asarray(self.engine.run_stage(self.part_index, x))
             if self.is_last:
                 pred = int(np.argmax(y))
@@ -106,6 +125,8 @@ class StageServer:
                 status = f"[{nid}] Forwarded. Next node status: {resp.status}"
                 if resp.HasField("result_tensor"):
                     result_msg = resp.result_tensor
+        except grpc.aio.AbortError:
+            raise  # the DATA_LOSS abort above must fail the RPC, not relay
         except grpc.aio.AioRpcError as e:
             log.error("forward from %s to %s failed: %s", nid, self.next_address, e.details())
             status = f"[{nid}] Error forwarding: {e.details()}"

@@ -17,6 +17,12 @@ from typing import Sequence, Tuple
 import numpy as np
 
 
+class PayloadCorruptError(ValueError):
+    """Checksum mismatch on a tensor payload — transient wire corruption,
+    distinct from deterministic decode failures (bad dtype/shape), so the
+    transport layer knows a resend is worthwhile."""
+
+
 def _np_dtype(name: str) -> np.dtype:
     if name == "bfloat16":
         import ml_dtypes

@@ -98,6 +98,13 @@ def save_train_state(
     # point leaves either a complete checkpoint or ignorable debris — never
     # a checkpoint that resume selects but cannot read.
     path = checkpoint_path(ckpt_dir, step)
+    if os.path.exists(path):
+        # Overwriting an existing step: remove the old pair first (npz
+        # before manifest) or a crash mid-save could pair the NEW manifest
+        # with the OLD npz and present it as complete.
+        os.unlink(path)
+        if os.path.exists(path + _MANIFEST_SUFFIX):
+            os.unlink(path + _MANIFEST_SUFFIX)
     fd, tmp = tempfile.mkstemp(dir=ckpt_dir, suffix=".npz.tmp")
     mfd, mtmp = tempfile.mkstemp(dir=ckpt_dir, suffix=".manifest.tmp")
     try:

@@ -171,11 +171,23 @@ def embed(params, idx, *, cfg: GPTConfig):
     return embedding(params["wte"], idx) + embedding(params["wpe"], pos)
 
 
-def head(params, x, *, cfg: GPTConfig):
+def head(params, x, *, cfg: GPTConfig, compute_dtype=None):
     """Final LN + lm_head (ModelPartFinal_GPT semantics,
-    gpt_model_parts.py:44-50)."""
+    gpt_model_parts.py:44-50).
+
+    With `compute_dtype=bf16` the lm_head matmul reads bf16 operands and
+    accumulates f32 (`preferred_element_type`) — logits stay f32. This is
+    the dominant-cost matmul of a forward (C x V = 768 x 50257 for
+    gpt2-small). On v5e the default f32 matmul "precision" is a bf16 MXU
+    pass already, so output is bit-identical (measured: zero logit diff)
+    and throughput is within noise; the explicit operand dtype matters on
+    platforms where f32 matmul really runs f32, and makes the memory
+    traffic intent visible rather than relying on a backend default."""
     x = layer_norm(params["ln_f"], x, eps=cfg.ln_eps)
-    return linear(params["lm_head"], x)
+    if compute_dtype is None:
+        return linear(params["lm_head"], x)
+    return linear(params["lm_head"], x, compute_dtype=compute_dtype,
+                  accum_dtype=jnp.float32)
 
 
 def make_apply(cfg: GPTConfig, *, use_flash=False, compute_dtype=None):
@@ -189,21 +201,23 @@ def make_apply(cfg: GPTConfig, *, use_flash=False, compute_dtype=None):
             x = x.astype(compute_dtype)
         stacked = stack_blocks(params, range(cfg.n_layer))
         x = blocks_scan(stacked, x, cfg=cfg, use_flash=use_flash, compute_dtype=compute_dtype)
-        logits = head(params, x.astype(jnp.float32), cfg=cfg)
+        logits = head(params, x.astype(jnp.float32), cfg=cfg, compute_dtype=compute_dtype)
         return logits
 
     return apply
 
 
 def make_apply_stacked(cfg: GPTConfig, *, use_flash=False, compute_dtype=None):
-    """Forward over `prepare_stacked` params: zero per-call restacking."""
+    """Forward over `prepare_stacked` params: zero per-call restacking.
+    When `compute_dtype` is set, the head matmul also runs in it (f32
+    accumulation — see `head`)."""
 
     def apply(prepared, idx):
         x = embed(prepared, idx, cfg=cfg)
         if compute_dtype is not None:
             x = x.astype(compute_dtype)
         x = blocks_scan(prepared["blocks"], x, cfg=cfg, use_flash=use_flash, compute_dtype=compute_dtype)
-        return head(prepared, x.astype(jnp.float32), cfg=cfg)
+        return head(prepared, x.astype(jnp.float32), cfg=cfg, compute_dtype=compute_dtype)
 
     return apply
 
@@ -251,7 +265,8 @@ def make_partition(cfg: GPTConfig, *, use_flash=False, compute_dtype=None):
                         stacked, x, cfg=cfg, use_flash=use_flash, compute_dtype=compute_dtype
                     )
                 if _last:
-                    x = head(params, x.astype(jnp.float32), cfg=cfg)
+                    x = head(params, x.astype(jnp.float32), cfg=cfg,
+                             compute_dtype=compute_dtype)
                 return x
 
             stages.append(

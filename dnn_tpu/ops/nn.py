@@ -63,10 +63,16 @@ def max_pool2d(x, *, window=(2, 2), stride=(2, 2)):
     )
 
 
-def linear(params, x, *, compute_dtype=None):
+def linear(params, x, *, compute_dtype=None, accum_dtype=None):
     """Dense layer: x @ kernel + bias. kernel is (in, out) — already the
     layout XLA wants for an MXU matmul (torch stores (out, in); the
     checkpoint converter transposes — see dnn_tpu/io/checkpoint.py).
+
+    `compute_dtype` casts the matmul operands (e.g. bf16 for the MXU) and
+    casts the result back to the input dtype. `accum_dtype` instead keeps
+    the accumulator dtype as the output (`preferred_element_type`) — e.g.
+    compute_dtype=bf16 + accum_dtype=f32 reads bf16 operands but returns
+    f32, the idiom for a logits head.
 
     Reference: torch nn.Linear (/root/reference/cifar_model_parts.py:12-13).
     """
@@ -75,11 +81,18 @@ def linear(params, x, *, compute_dtype=None):
     if compute_dtype is not None:
         x = x.astype(compute_dtype)
         kernel = kernel.astype(compute_dtype)
-    out = x @ kernel
+    if accum_dtype is not None:
+        out = lax.dot_general(
+            x, kernel,
+            (((x.ndim - 1,), (0,)), ((), ())),
+            preferred_element_type=accum_dtype,
+        )
+    else:
+        out = x @ kernel
     bias = params.get("bias")
     if bias is not None:
         out = out + bias.astype(out.dtype)
-    if compute_dtype is not None:
+    if accum_dtype is None and compute_dtype is not None:
         out = out.astype(orig_dtype)
     return out
 

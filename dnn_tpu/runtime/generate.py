@@ -97,8 +97,10 @@ def forward_with_cache(prepared, ids, cache, start_pos, *, cfg: GPTConfig,
         return x, (k_c, v_c)
 
     x, (k_new, v_new) = lax.scan(layer, x, (prepared["blocks"], cache["k"], cache["v"]))
-    x = layer_norm(prepared["ln_f"], x.astype(jnp.float32), eps=cfg.ln_eps)
-    logits = linear(prepared["lm_head"], x)
+    from dnn_tpu.models.gpt import head
+
+    logits = head(prepared, x.astype(jnp.float32), cfg=cfg,
+                  compute_dtype=compute_dtype)
     return logits, {"k": k_new, "v": v_new}
 
 

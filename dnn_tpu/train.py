@@ -183,14 +183,26 @@ def fit(
     ckpt_every: int = 0,
     keep_checkpoints: int = 3,
     on_step: Optional[Callable] = None,
+    advance_batches: bool = True,
 ):
     """Generic training loop with periodic checkpointing.
 
     `step_fn(state, batch) -> (state, loss)` over any state pytree (wrap
     the make_*_train_step outputs to this signature). `batch_iter` yields
     batches. Saves every `ckpt_every` steps into `ckpt_dir` and prunes to
-    `keep_checkpoints`. Returns (state, last_loss)."""
+    `keep_checkpoints`. Returns (state, last_loss).
+
+    On resume (`start_step > 0`) the default `advance_batches=True` skips
+    the first `start_step` batches, so a deterministic data pipeline
+    restarted from scratch lines back up with the training step — without
+    this a resumed run would silently re-train on the earliest batches.
+    Pass False only when `batch_iter` is already positioned at
+    `start_step`."""
     from dnn_tpu.io.train_ckpt import cleanup_old_checkpoints, save_train_state
+
+    if advance_batches:
+        for _ in range(start_step):
+            next(batch_iter)
 
     loss = None
     for step in range(start_step, num_steps):

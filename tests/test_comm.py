@@ -179,6 +179,35 @@ def test_send_tensor_retries_until_server_appears(grpc_pipeline):
             holder["stop"]()
 
 
+def test_corrupt_request_fails_rpc_with_data_loss(grpc_pipeline):
+    """A corrupt payload must fail the RPC with DATA_LOSS (so senders
+    retry), not come back as a status-string 'success'."""
+    import grpc
+
+    from dnn_tpu.comm import wire_pb2 as pb
+    from dnn_tpu.comm.service import SERVICE_NAME, _tensor_msg
+
+    cfg, engine = grpc_pipeline
+    x = np.asarray(engine.spec.example_input(batch_size=1))
+    msg = _tensor_msg(x)
+    data = bytearray(msg.tensor_data)
+    data[3] ^= 0x10  # flip a bit, keep the declared crc
+    bad = pb.Tensor(
+        tensor_data=bytes(data), shape=msg.shape, dtype=msg.dtype,
+        crc32c=msg.crc32c,
+    )
+    channel = grpc.insecure_channel(cfg.node_by_id("node1").address)
+    call = channel.unary_unary(
+        f"/{SERVICE_NAME}/SendTensor",
+        request_serializer=pb.TensorRequest.SerializeToString,
+        response_deserializer=pb.TensorResponse.FromString,
+    )
+    with pytest.raises(grpc.RpcError) as exc_info:
+        call(pb.TensorRequest(request_id="corrupt", tensor=bad), timeout=10)
+    assert exc_info.value.code() == grpc.StatusCode.DATA_LOSS
+    channel.close()
+
+
 def test_wait_healthy(grpc_pipeline):
     from dnn_tpu.comm.client import NodeClient
 

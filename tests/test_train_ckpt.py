@@ -182,15 +182,16 @@ def test_fit_resume_matches_uninterrupted():
 
     with tempfile.TemporaryDirectory() as ckpt_dir:
         # interrupted: run 3 steps (checkpointing every step), then resume
+        # with a FRESH deterministic generator — fit's default
+        # advance_batches=True must line the data back up with the step
         train.fit(
-            step_fn, init_state, _skip(batches(), 0), num_steps=3,
+            step_fn, init_state, batches(), num_steps=3,
             ckpt_dir=ckpt_dir, ckpt_every=1,
         )
         resumed, start = train.resume_or_init(ckpt_dir, init_state)
         assert start == 3
         final, _ = train.fit(
-            step_fn, resumed, _skip(batches(), 3), num_steps=6,
-            start_step=start,
+            step_fn, resumed, batches(), num_steps=6, start_step=start,
         )
 
     jax.tree.map(
@@ -199,9 +200,3 @@ def test_fit_resume_matches_uninterrupted():
         ),
         final, ref_state,
     )
-
-
-def _skip(it, n):
-    for _ in range(n):
-        next(it)
-    return it
