@@ -22,43 +22,7 @@ import jax.numpy as jnp
 BATCH, SEQ = 8, 512
 
 
-def _time_fn(fn, *args, n1=4, n2=12, trials=3):
-    """Per-call wall time via the two-point slope method.
-
-    On this machine the TPU sits behind a tunnel where
-    `jax.block_until_ready` returns before device execution finishes, so
-    naive timing measures dispatch only. Instead: queue N calls, force the
-    dependency chain with a 1-element host read of the last output (device
-    execution is in-order, so that read completes only after all N), and
-    take (t(n2) - t(n1)) / (n2 - n1) so the constant tunnel RTT and
-    transfer cost cancel.
-
-    Validity guards (first-measurement effects were observed to skew a
-    single slope by up to 2x in either direction): warm up past compile
-    AND past the first few post-compile dispatches, evaluate t(n1) before
-    t(n2) in a fixed order, and report the median slope of `trials`
-    repeats.
-    """
-    import numpy as _np
-
-    def run(n):
-        out = None
-        t0 = time.perf_counter()
-        for _ in range(n):
-            out = fn(*args)
-        leaf = jax.tree.leaves(out)[0]
-        _np.asarray(leaf.ravel()[0])  # scalar pull -> full sync
-        return time.perf_counter() - t0
-
-    run(2)  # compile
-    run(n1)  # absorb post-compile first-dispatch overhead
-    slopes = []
-    for _ in range(trials):
-        t1 = run(n1)
-        t2 = run(n2)
-        slopes.append((t2 - t1) / (n2 - n1))
-    slopes.sort()
-    return slopes[len(slopes) // 2]
+from dnn_tpu.utils.timing import device_time as _time_fn  # shared harness
 
 
 def bench_ours():

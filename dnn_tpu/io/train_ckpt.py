@@ -98,13 +98,14 @@ def save_train_state(
     # point leaves either a complete checkpoint or ignorable debris — never
     # a checkpoint that resume selects but cannot read.
     path = checkpoint_path(ckpt_dir, step)
-    if os.path.exists(path):
-        # Overwriting an existing step: remove the old pair first (npz
-        # before manifest) or a crash mid-save could pair the NEW manifest
-        # with the OLD npz and present it as complete.
-        os.unlink(path)
-        if os.path.exists(path + _MANIFEST_SUFFIX):
-            os.unlink(path + _MANIFEST_SUFFIX)
+    if os.path.exists(path + _MANIFEST_SUFFIX):
+        # Overwriting an existing step: retract the old MANIFEST first.
+        # Completeness is keyed on the npz+manifest pair, so the stale npz
+        # becomes invisible debris — a crash mid-save can never pair the
+        # NEW manifest with the OLD npz, and the old npz payload survives
+        # on disk until the new pair lands (no data-loss window beyond the
+        # manifest itself).
+        os.unlink(path + _MANIFEST_SUFFIX)
     fd, tmp = tempfile.mkstemp(dir=ckpt_dir, suffix=".npz.tmp")
     mfd, mtmp = tempfile.mkstemp(dir=ckpt_dir, suffix=".manifest.tmp")
     try:

@@ -26,7 +26,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from dnn_tpu.models.gpt import GPTConfig
+from dnn_tpu.models.gpt import GPTConfig, head
 from dnn_tpu.ops.attention import merge_heads, split_heads
 from dnn_tpu.ops.nn import gelu, layer_norm, linear
 
@@ -97,8 +97,6 @@ def forward_with_cache(prepared, ids, cache, start_pos, *, cfg: GPTConfig,
         return x, (k_c, v_c)
 
     x, (k_new, v_new) = lax.scan(layer, x, (prepared["blocks"], cache["k"], cache["v"]))
-    from dnn_tpu.models.gpt import head
-
     logits = head(prepared, x.astype(jnp.float32), cfg=cfg,
                   compute_dtype=compute_dtype)
     return logits, {"k": k_new, "v": v_new}

@@ -201,8 +201,15 @@ def fit(
     from dnn_tpu.io.train_ckpt import cleanup_old_checkpoints, save_train_state
 
     if advance_batches:
-        for _ in range(start_step):
-            next(batch_iter)
+        for skipped in range(start_step):
+            try:
+                next(batch_iter)
+            except StopIteration:
+                raise ValueError(
+                    f"batch_iter exhausted after {skipped} batches while "
+                    f"skipping to resume step {start_step}; pass an "
+                    "iterator that covers the resume point"
+                ) from None
 
     loss = None
     for step in range(start_step, num_steps):

@@ -184,6 +184,11 @@ def test_corrupt_request_fails_rpc_with_data_loss(grpc_pipeline):
     retry), not come back as a status-string 'success'."""
     import grpc
 
+    from dnn_tpu.native import native_available
+
+    if not native_available():
+        pytest.skip("crc verification requires the native codec")
+
     from dnn_tpu.comm import wire_pb2 as pb
     from dnn_tpu.comm.service import SERVICE_NAME, _tensor_msg
 
