@@ -47,7 +47,7 @@ def run_device_section():
     import jax
     import jax.numpy as jnp
 
-    from dnn_tpu.models import cifar, gpt
+    from dnn_tpu.models import gpt
     from dnn_tpu.registry import get_model
     from dnn_tpu.utils.timing import device_time
 

@@ -92,20 +92,18 @@ def save_train_state(
         arrays[f"leaf_{i}"] = arr
         dtypes[f"leaf_{i}"] = {"key": key, "dtype": tag}
 
-    # Crash-safe ordering: both files are staged as temps, the manifest is
-    # renamed into place FIRST, the npz LAST. latest_checkpoint() keys on
-    # the npz and skips npz files without a manifest, so a kill at any
-    # point leaves either a complete checkpoint or ignorable debris — never
-    # a checkpoint that resume selects but cannot read.
+    # Crash-safe ordering. A checkpoint is "complete" only when BOTH the
+    # npz and its manifest exist (latest_checkpoint checks the pair), so:
+    #   1. stage both files as temps;
+    #   2. if overwriting an existing step, retract the OLD manifest — the
+    #      stale npz becomes invisible debris, and a crash from here on can
+    #      never pair a new manifest with the old npz;
+    #   3. rename the npz into place, THEN the manifest. A kill between
+    #      the renames leaves npz-without-manifest == ignorable debris.
+    # Every crash point therefore yields either the complete new pair, or
+    # no visible step-N checkpoint (resume falls back to the previous one)
+    # — never a checkpoint that resume selects but cannot trust.
     path = checkpoint_path(ckpt_dir, step)
-    if os.path.exists(path + _MANIFEST_SUFFIX):
-        # Overwriting an existing step: retract the old MANIFEST first.
-        # Completeness is keyed on the npz+manifest pair, so the stale npz
-        # becomes invisible debris — a crash mid-save can never pair the
-        # NEW manifest with the OLD npz, and the old npz payload survives
-        # on disk until the new pair lands (no data-loss window beyond the
-        # manifest itself).
-        os.unlink(path + _MANIFEST_SUFFIX)
     fd, tmp = tempfile.mkstemp(dir=ckpt_dir, suffix=".npz.tmp")
     mfd, mtmp = tempfile.mkstemp(dir=ckpt_dir, suffix=".manifest.tmp")
     try:
@@ -113,8 +111,10 @@ def save_train_state(
             np.savez(f, **arrays)
         with os.fdopen(mfd, "w") as f:
             json.dump({"step": step, "leaves": dtypes, "format": 1}, f)
-        os.replace(mtmp, path + _MANIFEST_SUFFIX)
+        if os.path.exists(path + _MANIFEST_SUFFIX):
+            os.unlink(path + _MANIFEST_SUFFIX)
         os.replace(tmp, path)
+        os.replace(mtmp, path + _MANIFEST_SUFFIX)
     except BaseException:
         for t in (tmp, mtmp):
             if os.path.exists(t):

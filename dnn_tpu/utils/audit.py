@@ -1,0 +1,62 @@
+"""Determinism / purity audit.
+
+The reference's concurrency story is implicit (single asyncio loop, global
+state written once — SURVEY §5 "Race detection: ABSENT"); there is nothing
+to race because nothing is parallel. This framework IS parallel, so it
+ships the TPU-native analog of a race detector: an audit that a compiled
+program is (a) deterministic — repeated runs produce bit-identical outputs,
+which fails if a collective's reduction order ever becomes
+schedule-dependent — and (b) pure — it does not mutate its inputs, which
+fails if buffer donation/aliasing is introduced accidentally.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import numpy as np
+
+
+def snapshot(tree):
+    """Host copies of every leaf, for before/after comparison."""
+    return jax.tree.map(lambda x: np.array(x), tree)
+
+
+def assert_deterministic(fn: Callable, *args, runs: int = 3):
+    """Run `fn(*args)` `runs` times; all outputs must be BIT-identical.
+    Collectives (psum/ppermute reductions) with a fixed mesh and fixed
+    inputs must not vary run to run — variation means the reduction order
+    leaked into the result."""
+    ref = jax.tree.map(np.array, fn(*args))
+    for i in range(1, runs):
+        out = jax.tree.map(np.array, fn(*args))
+        jax.tree.map(
+            lambda a, b, _i=i: np.testing.assert_array_equal(
+                a, b, err_msg=f"output differs on run {_i}"
+            ),
+            ref, out,
+        )
+    return ref
+
+
+def assert_pure(fn: Callable, *args):
+    """Run `fn(*args)` and verify no input leaf changed — catches
+    accidental donation/aliasing (donate_argnums, in-place dlpack views).
+    Returns the output."""
+    before = snapshot(args)
+    out = fn(*args)
+    jax.block_until_ready(out)
+    after = snapshot(args)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_array_equal(
+            a, b, err_msg="input mutated by supposedly-pure function"
+        ),
+        before, after,
+    )
+    return out
+
+
+def assert_deterministic_and_pure(fn: Callable, *args, runs: int = 3):
+    assert_pure(fn, *args)
+    return assert_deterministic(fn, *args, runs=runs)

@@ -81,6 +81,20 @@ def test_cleanup_removes_debris_and_keeps_complete(tmp_path):
     assert latest_checkpoint(str(tmp_path))[1] == 10
 
 
+def test_overwrite_same_step(tmp_path):
+    """Re-saving an existing step (restarted run re-reaching a boundary)
+    replaces it; restore sees the new payload."""
+    save_train_state(str(tmp_path), 5, {"w": jnp.zeros((3,))})
+    save_train_state(str(tmp_path), 5, {"w": jnp.ones((3,))})
+    restored, step = restore_train_state(str(tmp_path), like={"w": jnp.zeros((3,))})
+    assert step == 5
+    np.testing.assert_array_equal(np.asarray(restored["w"]), np.ones(3))
+    # no temp debris left behind
+    assert sorted(os.listdir(tmp_path)) == [
+        "step_00000005.npz", "step_00000005.npz.manifest.json"
+    ]
+
+
 def test_compressed_bf16_checkpoint(tmp_path):
     """compress_bf16 halves f32 leaf bytes; restore upcasts to the template
     dtype within bf16 precision. int leaves pass through untouched."""
