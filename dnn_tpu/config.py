@@ -18,6 +18,9 @@ Extended keys (all optional, with reference-equivalent defaults):
   microbatches:    GPipe-style microbatching factor for the spmd runtime
   dtype:           compute dtype ("float32" | "bfloat16")
   mesh:            {axis_name: size} overrides for multi-axis runs
+  distributed:     {coordinator_address, num_processes, process_id?} — join
+                   a multi-host jax.distributed job (DCN); see
+                   dnn_tpu/parallel/multihost.py
 """
 
 from __future__ import annotations
@@ -25,6 +28,14 @@ from __future__ import annotations
 import dataclasses
 import json
 from typing import Dict, List, Optional, Tuple
+
+
+def _parse_distributed(d: Optional[dict]):
+    if d is None:
+        return None
+    from dnn_tpu.parallel.multihost import DistributedConfig
+
+    return DistributedConfig.from_dict(d)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -62,6 +73,7 @@ class TopologyConfig:
     microbatches: int = 1
     dtype: str = "float32"
     mesh: Dict[str, int] = dataclasses.field(default_factory=dict)
+    distributed: Optional["DistributedConfig"] = None  # multihost job spec
 
     # ---- construction ----------------------------------------------------
 
@@ -86,6 +98,7 @@ class TopologyConfig:
             microbatches=int(d.get("microbatches", 1)),
             dtype=d.get("dtype", "float32"),
             mesh=dict(d.get("mesh", {})),
+            distributed=_parse_distributed(d.get("distributed")),
         )
         cfg.validate()
         return cfg

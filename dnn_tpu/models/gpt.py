@@ -109,14 +109,11 @@ def init(rng, cfg: GPTConfig = PRESETS["gpt2"], dtype=jnp.float32, tie_lm_head=T
 # forward
 # --------------------------------------------------------------------------
 
-def block_apply(block_params, x, *, cfg: GPTConfig, use_flash=False, compute_dtype=None):
-    """Pre-LN transformer block (nanoGPT Block semantics). With
-    `compute_dtype=bf16`, every matmul runs bf16 on the MXU while residuals
-    and layer norms stay in the activation dtype."""
+def _block_core(block_params, x, attn_fn, *, cfg: GPTConfig, compute_dtype=None):
+    """Pre-LN transformer block with a pluggable attention implementation
+    (local causal MHA, Pallas flash, or sequence-parallel ring)."""
     h = layer_norm(block_params["ln_1"], x, eps=cfg.ln_eps)
-    x = x + causal_self_attention(
-        block_params["attn"], h, n_head=cfg.n_head, use_flash=use_flash, compute_dtype=compute_dtype
-    )
+    x = x + attn_fn(block_params["attn"], h)
     h = layer_norm(block_params["ln_2"], x, eps=cfg.ln_eps)
     m = linear(
         block_params["mlp"]["proj"],
@@ -124,6 +121,19 @@ def block_apply(block_params, x, *, cfg: GPTConfig, use_flash=False, compute_dty
         compute_dtype=compute_dtype,
     )
     return x + m
+
+
+def block_apply(block_params, x, *, cfg: GPTConfig, use_flash=False, compute_dtype=None):
+    """Pre-LN transformer block (nanoGPT Block semantics). With
+    `compute_dtype=bf16`, every matmul runs bf16 on the MXU while residuals
+    and layer norms stay in the activation dtype."""
+    return _block_core(
+        block_params, x,
+        lambda ap, h: causal_self_attention(
+            ap, h, n_head=cfg.n_head, use_flash=use_flash, compute_dtype=compute_dtype
+        ),
+        cfg=cfg, compute_dtype=compute_dtype,
+    )
 
 
 def stack_blocks(params, layer_ids):
@@ -146,16 +156,22 @@ def prepare_stacked(params, cfg: GPTConfig):
     return out
 
 
-def blocks_scan(stacked, x, *, cfg: GPTConfig, use_flash=False, compute_dtype=None):
+def blocks_scan(stacked, x, *, cfg: GPTConfig, use_flash=False, compute_dtype=None,
+                attn_fn=None):
     """Run a stack of blocks via lax.scan: one compiled block body regardless
     of depth (the TPU-idiomatic form of the reference's Python
-    `for block in self.h` loop, gpt_model_parts.py:20-21)."""
+    `for block in self.h` loop, gpt_model_parts.py:20-21). `attn_fn`
+    overrides the attention implementation (e.g. the sequence-parallel ring
+    — see make_apply_seq_parallel); default is local causal MHA."""
 
     def body(carry, layer_params):
-        return (
-            block_apply(layer_params, carry, cfg=cfg, use_flash=use_flash, compute_dtype=compute_dtype),
-            None,
-        )
+        if attn_fn is None:
+            y = block_apply(layer_params, carry, cfg=cfg, use_flash=use_flash,
+                            compute_dtype=compute_dtype)
+        else:
+            y = _block_core(layer_params, carry, attn_fn, cfg=cfg,
+                            compute_dtype=compute_dtype)
+        return y, None
 
     out, _ = jax.lax.scan(body, x, stacked)
     return out
@@ -218,6 +234,68 @@ def make_apply_stacked(cfg: GPTConfig, *, use_flash=False, compute_dtype=None):
             x = x.astype(compute_dtype)
         x = blocks_scan(prepared["blocks"], x, cfg=cfg, use_flash=use_flash, compute_dtype=compute_dtype)
         return head(prepared, x.astype(jnp.float32), cfg=cfg, compute_dtype=compute_dtype)
+
+    return apply
+
+
+def make_apply_seq_parallel(cfg: GPTConfig, mesh, *, axis_name=None, compute_dtype=None):
+    """Sequence-parallel (long-context) full-model forward.
+
+    The reference hard-caps sequence length (`T <= block_size` assert,
+    gpt_model_parts.py:15) and holds every activation whole on one device.
+    This path shards the SEQUENCE dimension over the mesh's "seq" axis:
+    embed/LN/MLP/head act position-wise and run on local shards; attention
+    runs as ring attention (K/V blocks rotate the ring via `lax.ppermute`,
+    online-softmax accumulation — dnn_tpu/parallel/ring_attention.py), so
+    per-device activation memory is O(T/n) and the full (T, T) score matrix
+    never exists anywhere.
+
+    `apply(prepared, ids)`: `prepared` from `prepare_stacked` (replicated);
+    ids (B, T) with T divisible by the seq-axis size. Returns f32 logits
+    sharded over the sequence axis.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    from dnn_tpu.ops.attention import merge_heads, split_heads
+    from dnn_tpu.parallel.mesh import SEQ_AXIS
+    from dnn_tpu.parallel.ring_attention import ring_attention_local
+
+    axis = axis_name or SEQ_AXIS
+
+    def ring_attn(attn_params, h):
+        qkv = linear(attn_params["qkv"], h, compute_dtype=compute_dtype)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q, k, v = (split_heads(t, cfg.n_head) for t in (q, k, v))
+        y = ring_attention_local(q, k, v, axis_name=axis, causal=True)
+        return linear(attn_params["proj"], merge_heads(y), compute_dtype=compute_dtype)
+
+    def local_fn(prepared, ids_local):
+        t_local = ids_local.shape[-1]
+        my = jax.lax.axis_index(axis)
+        pos = my * t_local + jnp.arange(t_local)  # global positions
+        x = embedding(prepared["wte"], ids_local) + embedding(prepared["wpe"], pos)
+        if compute_dtype is not None:
+            x = x.astype(compute_dtype)
+        x = blocks_scan(prepared["blocks"], x, cfg=cfg,
+                        compute_dtype=compute_dtype, attn_fn=ring_attn)
+        return head(prepared, x.astype(jnp.float32), cfg=cfg,
+                    compute_dtype=compute_dtype)
+
+    def apply(prepared, ids):
+        t = ids.shape[-1]
+        if t > cfg.block_size:
+            raise ValueError(
+                f"Cannot forward: sequence length {t} > block_size {cfg.block_size}"
+            )
+        n = mesh.shape[axis]
+        if t % n != 0:
+            raise ValueError(f"sequence length {t} not divisible by seq axis size {n}")
+        return jax.shard_map(
+            local_fn, mesh=mesh,
+            in_specs=(P(), P(None, axis)),
+            out_specs=P(None, axis, None),
+            check_vma=False,
+        )(prepared, ids)
 
     return apply
 

@@ -48,6 +48,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--input_image", help="Input image path (part_index 0 initiates inference)")
     p.add_argument("--serve", action="store_true",
                    help="Host this node's stage behind gRPC (reference-interop mode)")
+    p.add_argument("--process_id", type=int, default=None,
+                   help="This host's process id for multi-host (config 'distributed') runs")
     p.add_argument("--log_level", default="INFO")
     return p
 
@@ -124,6 +126,29 @@ def main(argv=None) -> int:
         log.error("%s", e)
         return 1
 
+    if config.device_type == "cpu":
+        # Platform choice must land before first backend use; on hosts where
+        # a TPU plugin wins selection regardless of JAX_PLATFORMS (see
+        # tests/conftest.py), the in-process config update is the only
+        # override that sticks.
+        import jax
+
+        try:
+            jax.config.update("jax_platforms", "cpu")
+        except RuntimeError:
+            log.warning("backend already initialized; device_type=cpu ignored")
+
+    if config.distributed is not None:
+        # multi-host: join the jax.distributed job before any backend use so
+        # jax.devices() spans all hosts (dnn_tpu/parallel/multihost.py)
+        from dnn_tpu.parallel.multihost import initialize_from_config
+
+        try:
+            initialize_from_config(config.distributed, process_id=args.process_id)
+        except Exception as e:  # noqa: BLE001 — CLI boundary
+            log.error("distributed initialization failed: %s", e)
+            return 1
+
     # --serve hosts ONE stage (the reference's per-node role): build the
     # engine in stage role so an 8-part config serves fine from a 1-device
     # host; full role only when this process drives the whole pipeline.
@@ -163,6 +188,24 @@ def main(argv=None) -> int:
         return 0
 
     # single-controller mode
+    if config.distributed is not None and config.distributed.num_processes > 1:
+        # Multi-host SPMD: EVERY process must execute the same program — a
+        # host that exits here would strand the others' collectives over
+        # the global mesh. All hosts run the full pipeline on the same
+        # input (the standard run-the-same-script-everywhere JAX pattern);
+        # only process 0 announces the result.
+        import jax
+
+        x, used_dummy = load_image_or_dummy(args.input_image)
+        if used_dummy and args.input_image:
+            # every host must feed identical input (replicated SPMD operand)
+            log.warning("input image unavailable on this host; using dummy "
+                        "data — hosts may now disagree on the input")
+        pred = engine.predict(x)
+        if jax.process_index() == 0:
+            print(f"***** FINAL PREDICTION (Index): {pred} *****")
+        return 0
+
     if args.input_image or me.part_index == 0:
         _initiate_local(engine, args.input_image)
     else:

@@ -1,0 +1,103 @@
+"""Multi-host (multi-process) initialization: the DCN half of the
+transport story.
+
+The reference scales across machines with per-node gRPC processes relaying
+tensors over TCP (/root/reference/node.py:70-94). The TPU-native
+equivalent is `jax.distributed`: every host runs the SAME SPMD program,
+`jax.devices()` spans all hosts, and XLA routes collectives over ICI
+within a pod slice and DCN across slices — the transport disappears into
+the compiler. One `Mesh` covers both: intra-host axes ride ICI, cross-host
+axes ride DCN, behind the same `ppermute`/`psum` interface the single-host
+runtimes already use (SURVEY §7 hard part 5).
+
+Config (extends the reference JSON schema, SURVEY §2/C9):
+
+    "distributed": {
+        "coordinator_address": "10.0.0.1:9255",
+        "num_processes": 2,
+        "process_id": 0          # or omit and pass per-host via CLI/env
+    }
+
+`initialize_from_config` is a no-op for single-process runs, so the same
+config files work on one host.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import os
+from typing import Optional
+
+import jax
+
+log = logging.getLogger("dnn_tpu.multihost")
+
+
+@dataclasses.dataclass(frozen=True)
+class DistributedConfig:
+    coordinator_address: str
+    num_processes: int
+    process_id: Optional[int] = None  # resolvable from env/CLI per host
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "DistributedConfig":
+        return cls(
+            coordinator_address=d["coordinator_address"],
+            num_processes=int(d["num_processes"]),
+            # `"process_id": null` in JSON means "set per host" — same as absent
+            process_id=(int(d["process_id"]) if d.get("process_id") is not None else None),
+        )
+
+
+def resolve_process_id(dist: DistributedConfig, override: Optional[int] = None) -> int:
+    """Process id precedence: explicit override (CLI flag) > config key >
+    DNN_TPU_PROCESS_ID env var."""
+    if override is not None:
+        return override
+    if dist.process_id is not None:
+        return dist.process_id
+    env = os.environ.get("DNN_TPU_PROCESS_ID")
+    if env is not None:
+        return int(env)
+    raise ValueError(
+        "process_id not set: pass --process_id, set it in the config's "
+        "'distributed' block, or export DNN_TPU_PROCESS_ID"
+    )
+
+
+def initialize_from_config(
+    dist: Optional[DistributedConfig], *, process_id: Optional[int] = None
+) -> bool:
+    """Join the multi-host job described by `dist` (None or 1 process ==
+    single-host no-op). Must run before first backend use. Returns True if
+    jax.distributed was initialized. After this, `jax.devices()` is global
+    across hosts and `jax.local_devices()` is this host's slice."""
+    if dist is None or dist.num_processes <= 1:
+        return False
+    pid = resolve_process_id(dist, process_id)
+    jax.distributed.initialize(
+        coordinator_address=dist.coordinator_address,
+        num_processes=dist.num_processes,
+        process_id=pid,
+    )
+    log.info(
+        "joined distributed job: process %d/%d, coordinator %s, "
+        "%d global / %d local devices",
+        pid, dist.num_processes, dist.coordinator_address,
+        jax.device_count(), jax.local_device_count(),
+    )
+    return True
+
+
+def is_multihost() -> bool:
+    return jax.process_count() > 1
+
+
+def process_info() -> dict:
+    return {
+        "process_index": jax.process_index(),
+        "process_count": jax.process_count(),
+        "global_devices": jax.device_count(),
+        "local_devices": jax.local_device_count(),
+    }

@@ -1,0 +1,131 @@
+"""Multi-host (jax.distributed / DCN) support.
+
+Unit tests cover config parsing and process-id resolution; the integration
+test launches TWO real OS processes that join one jax.distributed job over
+localhost (the DCN story on one machine — the TPU-native analog of the
+reference's 'N localhost processes' deployment, readme.md:87) and run a
+global-mesh psum spanning both processes.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from dnn_tpu.config import TopologyConfig
+from dnn_tpu.parallel.multihost import (
+    DistributedConfig,
+    initialize_from_config,
+    resolve_process_id,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_config_parses_distributed_block():
+    cfg = TopologyConfig.from_dict({
+        "nodes": [{"id": "a", "part_index": 0, "address": "h1:9000"},
+                  {"id": "b", "part_index": 1, "address": "h2:9000"}],
+        "num_parts": 2,
+        "distributed": {"coordinator_address": "h1:9255", "num_processes": 2},
+    })
+    assert cfg.distributed.coordinator_address == "h1:9255"
+    assert cfg.distributed.num_processes == 2
+    assert cfg.distributed.process_id is None
+
+
+def test_config_without_distributed_is_none():
+    cfg = TopologyConfig.from_dict({"nodes": [], "num_parts": 1})
+    assert cfg.distributed is None
+
+
+def test_resolve_process_id_precedence(monkeypatch):
+    dist = DistributedConfig("h:1", 2, process_id=1)
+    assert resolve_process_id(dist, override=0) == 0  # CLI wins
+    assert resolve_process_id(dist) == 1              # then config
+    dist2 = DistributedConfig("h:1", 2)
+    monkeypatch.setenv("DNN_TPU_PROCESS_ID", "7")
+    assert resolve_process_id(dist2) == 7             # then env
+    monkeypatch.delenv("DNN_TPU_PROCESS_ID")
+    with pytest.raises(ValueError, match="process_id not set"):
+        resolve_process_id(dist2)
+
+
+def test_single_process_is_noop():
+    assert initialize_from_config(None) is False
+    assert initialize_from_config(DistributedConfig("h:1", 1)) is False
+
+
+_WORKER = textwrap.dedent("""
+    import json, os, sys
+    sys.path.insert(0, {repo!r})
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    from dnn_tpu.parallel.multihost import (
+        DistributedConfig, initialize_from_config, is_multihost, process_info,
+    )
+
+    pid = int(sys.argv[1])
+    dist = DistributedConfig({coord!r}, 2)
+    assert initialize_from_config(dist, process_id=pid)
+    assert is_multihost()
+    info = process_info()
+    assert info["process_count"] == 2
+    assert info["global_devices"] == 4  # 2 hosts x 2 local devices
+
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from dnn_tpu.parallel.mesh import DATA_AXIS, make_mesh
+
+    # global mesh over BOTH processes' devices; each host feeds its local
+    # shard, the psum crosses the process boundary
+    mesh = make_mesh({{DATA_AXIS: 4}}, jax.devices())
+    sharding = NamedSharding(mesh, P(DATA_AXIS))
+    local = [
+        jax.device_put(
+            jnp.full((1,), float(jax.process_index() * 2 + i + 1)), d
+        )
+        for i, d in enumerate(jax.local_devices())
+    ]
+    garr = jax.make_array_from_single_device_arrays((4,), sharding, local)
+    total = jax.jit(
+        lambda x: jnp.sum(x), out_shardings=NamedSharding(mesh, P())
+    )(garr)
+    # values are 1,2 on process 0 and 3,4 on process 1 -> 10
+    assert float(total) == 10.0, float(total)
+    print(json.dumps({{"pid": pid, "total": float(total)}}))
+""")
+
+
+def test_two_process_distributed_psum(tmp_path):
+    """Two real processes, one jax.distributed job, one global mesh, a sum
+    crossing the process boundary."""
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    coord = f"127.0.0.1:{port}"
+    script = tmp_path / "worker.py"
+    script.write_text(_WORKER.format(repo=REPO, coord=coord))
+
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(script), str(pid)],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True, env=env,
+        )
+        for pid in (0, 1)
+    ]
+    outs = [p.communicate(timeout=180) for p in procs]
+    for p, (out, err) in zip(procs, outs):
+        assert p.returncode == 0, f"worker failed:\n{out}\n{err}"
+    results = [json.loads(out.strip().splitlines()[-1]) for out, _ in outs]
+    assert all(r["total"] == 10.0 for r in results)
