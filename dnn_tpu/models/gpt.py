@@ -157,21 +157,31 @@ def prepare_stacked(params, cfg: GPTConfig):
 
 
 def blocks_scan(stacked, x, *, cfg: GPTConfig, use_flash=False, compute_dtype=None,
-                attn_fn=None):
+                attn_fn=None, remat=False):
     """Run a stack of blocks via lax.scan: one compiled block body regardless
     of depth (the TPU-idiomatic form of the reference's Python
     `for block in self.h` loop, gpt_model_parts.py:20-21). `attn_fn`
     overrides the attention implementation (e.g. the sequence-parallel ring
-    — see make_apply_seq_parallel); default is local causal MHA."""
+    — see make_apply_seq_parallel); default is local causal MHA.
+
+    `remat=True` wraps the block body in `jax.checkpoint`: the backward
+    pass recomputes each block's internals instead of keeping all
+    intermediates alive across the scan — activation memory drops from
+    O(L x intermediates) to O(L x residual + 1 block), the standard
+    FLOPs-for-HBM trade for training deep stacks."""
+
+    def block(layer_params, carry):
+        if attn_fn is None:
+            return block_apply(layer_params, carry, cfg=cfg, use_flash=use_flash,
+                               compute_dtype=compute_dtype)
+        return _block_core(layer_params, carry, attn_fn, cfg=cfg,
+                           compute_dtype=compute_dtype)
+
+    if remat:
+        block = jax.checkpoint(block)
 
     def body(carry, layer_params):
-        if attn_fn is None:
-            y = block_apply(layer_params, carry, cfg=cfg, use_flash=use_flash,
-                            compute_dtype=compute_dtype)
-        else:
-            y = _block_core(layer_params, carry, attn_fn, cfg=cfg,
-                            compute_dtype=compute_dtype)
-        return y, None
+        return block(layer_params, carry), None
 
     out, _ = jax.lax.scan(body, x, stacked)
     return out
@@ -206,24 +216,27 @@ def head(params, x, *, cfg: GPTConfig, compute_dtype=None):
                   accum_dtype=jnp.float32)
 
 
-def make_apply(cfg: GPTConfig, *, use_flash=False, compute_dtype=None):
+def make_apply(cfg: GPTConfig, *, use_flash=False, compute_dtype=None, remat=False):
     """Full-model forward over the per-layer param layout (restacks blocks
     per call — fine under jit for tests/small models; perf paths should use
-    `prepare_stacked` + `make_apply_stacked`)."""
+    `prepare_stacked` + `make_apply_stacked`). `remat=True` checkpoints
+    each block for training memory (see blocks_scan)."""
 
     def apply(params, idx):
         x = embed(params, idx, cfg=cfg)
         if compute_dtype is not None:
             x = x.astype(compute_dtype)
         stacked = stack_blocks(params, range(cfg.n_layer))
-        x = blocks_scan(stacked, x, cfg=cfg, use_flash=use_flash, compute_dtype=compute_dtype)
+        x = blocks_scan(stacked, x, cfg=cfg, use_flash=use_flash,
+                        compute_dtype=compute_dtype, remat=remat)
         logits = head(params, x.astype(jnp.float32), cfg=cfg, compute_dtype=compute_dtype)
         return logits
 
     return apply
 
 
-def make_apply_stacked(cfg: GPTConfig, *, use_flash=False, compute_dtype=None):
+def make_apply_stacked(cfg: GPTConfig, *, use_flash=False, compute_dtype=None,
+                       remat=False):
     """Forward over `prepare_stacked` params: zero per-call restacking.
     When `compute_dtype` is set, the head matmul also runs in it (f32
     accumulation — see `head`)."""
@@ -232,7 +245,8 @@ def make_apply_stacked(cfg: GPTConfig, *, use_flash=False, compute_dtype=None):
         x = embed(prepared, idx, cfg=cfg)
         if compute_dtype is not None:
             x = x.astype(compute_dtype)
-        x = blocks_scan(prepared["blocks"], x, cfg=cfg, use_flash=use_flash, compute_dtype=compute_dtype)
+        x = blocks_scan(prepared["blocks"], x, cfg=cfg, use_flash=use_flash,
+                        compute_dtype=compute_dtype, remat=remat)
         return head(prepared, x.astype(jnp.float32), cfg=cfg, compute_dtype=compute_dtype)
 
     return apply

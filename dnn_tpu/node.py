@@ -54,14 +54,17 @@ def build_parser() -> argparse.ArgumentParser:
     return p
 
 
-def _initiate_local(engine: PipelineEngine, image_path: str) -> int:
+def _initiate_local(engine: PipelineEngine, image_path: str, *, announce: bool = True) -> int:
     """Single-controller client path: preprocess -> full pipeline -> argmax
-    (rebuilds initiate_inference, node.py:137-200, minus the RPCs)."""
+    (rebuilds initiate_inference, node.py:137-200, minus the RPCs).
+    `announce=False` computes without printing (multi-host: every process
+    runs the same program, only process 0 speaks)."""
     x, used_dummy = load_image_or_dummy(image_path)
-    if used_dummy:
+    if used_dummy and image_path:
         log.warning("input image unavailable; using dummy data (node.py:149-154 behavior)")
     pred = engine.predict(x)
-    print(f"***** FINAL PREDICTION (Index): {pred} *****")
+    if announce:
+        print(f"***** FINAL PREDICTION (Index): {pred} *****")
     return pred
 
 
@@ -130,13 +133,11 @@ def main(argv=None) -> int:
         # Platform choice must land before first backend use; on hosts where
         # a TPU plugin wins selection regardless of JAX_PLATFORMS (see
         # tests/conftest.py), the in-process config update is the only
-        # override that sticks.
+        # override that sticks. The update never raises — whether it took
+        # effect is verified after the backend initializes, below.
         import jax
 
-        try:
-            jax.config.update("jax_platforms", "cpu")
-        except RuntimeError:
-            log.warning("backend already initialized; device_type=cpu ignored")
+        jax.config.update("jax_platforms", "cpu")
 
     if config.distributed is not None:
         # multi-host: join the jax.distributed job before any backend use so
@@ -164,6 +165,16 @@ def main(argv=None) -> int:
         "node=%s part=%d/%d runtime=%s model=%s",
         me.id, me.part_index, config.num_parts - 1, engine.runtime, config.model,
     )
+    if config.device_type == "cpu":
+        import jax
+
+        if jax.default_backend() != "cpu":
+            # config update above came too late (backend was already up)
+            log.warning(
+                "device_type=cpu requested but backend is '%s' — the JAX "
+                "backend was initialized before this CLI ran",
+                jax.default_backend(),
+            )
 
     if args.serve:
         from dnn_tpu.comm.service import serve_stage
@@ -196,14 +207,11 @@ def main(argv=None) -> int:
         # only process 0 announces the result.
         import jax
 
-        x, used_dummy = load_image_or_dummy(args.input_image)
-        if used_dummy and args.input_image:
-            # every host must feed identical input (replicated SPMD operand)
-            log.warning("input image unavailable on this host; using dummy "
-                        "data — hosts may now disagree on the input")
-        pred = engine.predict(x)
-        if jax.process_index() == 0:
-            print(f"***** FINAL PREDICTION (Index): {pred} *****")
+        # NOTE: every host must feed identical input (replicated SPMD
+        # operand) — run this CLI with the same --input_image path on
+        # shared storage, or no image at all (deterministic dummy).
+        _initiate_local(engine, args.input_image,
+                        announce=jax.process_index() == 0)
         return 0
 
     if args.input_image or me.part_index == 0:

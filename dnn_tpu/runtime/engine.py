@@ -172,6 +172,17 @@ class PipelineEngine:
 
     def _pick_runtime(self) -> str:
         rt = self.config.runtime
+        if jax.process_count() > 1:
+            # Multi-host: every process must run one SPMD program over the
+            # global mesh. The relay runtime device_puts onto explicit
+            # devices, which are non-addressable from other hosts — it is
+            # host-local by design.
+            if rt == "relay":
+                raise ValueError(
+                    "runtime=relay is host-local; multi-host (distributed) "
+                    "runs require runtime=spmd"
+                )
+            rt = "spmd"
         if rt == "auto":
             if self.config.num_parts == 1:
                 return "relay"

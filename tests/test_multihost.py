@@ -124,8 +124,20 @@ def test_two_process_distributed_psum(tmp_path):
         )
         for pid in (0, 1)
     ]
-    outs = [p.communicate(timeout=180) for p in procs]
+    outs = []
+    try:
+        for p in procs:
+            outs.append(p.communicate(timeout=180))
+    finally:
+        # a hung/crashed worker must not leak, and BOTH workers' stderr must
+        # surface (the failing one holds the root cause)
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                outs.append(p.communicate())
     for p, (out, err) in zip(procs, outs):
-        assert p.returncode == 0, f"worker failed:\n{out}\n{err}"
+        assert p.returncode == 0, "worker failed:\n" + "\n---\n".join(
+            f"rc={q.returncode}\n{o}\n{e}" for q, (o, e) in zip(procs, outs)
+        )
     results = [json.loads(out.strip().splitlines()[-1]) for out, _ in outs]
     assert all(r["total"] == 10.0 for r in results)

@@ -1,0 +1,95 @@
+"""Rematerialization (jax.checkpoint) support: gradients must be identical
+with and without remat — remat trades recompute for memory, never numerics.
+(The reference has no training at all, let alone memory management —
+SURVEY §5; remat is the TPU-native HBM lever.)"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from dnn_tpu import train
+from dnn_tpu.models import gpt
+
+CFG = gpt.PRESETS["gpt2-test"]
+
+
+def test_remat_forward_identical():
+    params = gpt.init(jax.random.PRNGKey(0), CFG)
+    ids = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0, CFG.vocab_size)
+    base = gpt.make_apply(CFG)(params, ids)
+    rem = gpt.make_apply(CFG, remat=True)(params, ids)
+    np.testing.assert_array_equal(np.asarray(base), np.asarray(rem))
+
+
+def test_remat_gradients_identical():
+    params = gpt.init(jax.random.PRNGKey(0), CFG)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (4, 17), 0, CFG.vocab_size)
+
+    def loss(apply_fn):
+        return lambda p: train.next_token_loss(apply_fn, p, tokens)
+
+    g_base = jax.grad(loss(gpt.make_apply(CFG)))(params)
+    g_rem = jax.grad(loss(gpt.make_apply(CFG, remat=True)))(params)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=1e-6
+        ),
+        g_base, g_rem,
+    )
+
+
+def test_remat_trains():
+    params = gpt.init(jax.random.PRNGKey(0), CFG)
+    opt = optax.adam(1e-3)
+    apply_fn = gpt.make_apply(CFG, remat=True)
+
+    def loss_fn(p, batch):
+        return train.next_token_loss(apply_fn, p, batch)
+
+    step = train.make_train_step(loss_fn, opt)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (4, 17), 0, CFG.vocab_size)
+    p, s = params, opt.init(params)
+    losses = []
+    for _ in range(4):
+        p, s, l = step(p, s, tokens)
+        losses.append(float(l))
+    assert losses[-1] < losses[0]
+
+
+def test_flash_auto_routing(monkeypatch):
+    """use_flash='auto' must stay on the XLA path below the threshold and
+    route through the flash kernel at/above it. Spy on the kernel entry
+    (its CPU fallback is numerically identical, so outputs can't
+    distinguish the paths — the routing decision itself is the subject)."""
+    import importlib
+
+    from dnn_tpu.ops import attention as attn_mod
+
+    # the package __init__ re-exports the function under the same name, so
+    # fetch the submodule explicitly
+    fa_mod = importlib.import_module("dnn_tpu.ops.pallas.flash_attention")
+
+    calls = []
+    real_flash = fa_mod.flash_attention
+
+    def spy(*args, **kwargs):
+        calls.append(1)
+        return real_flash(*args, **kwargs)
+
+    monkeypatch.setattr(fa_mod, "flash_attention", spy)
+    monkeypatch.setattr(attn_mod, "FLASH_AUTO_THRESHOLD", 16)
+
+    params = gpt.init(jax.random.PRNGKey(0), CFG)
+    below = jax.random.randint(jax.random.PRNGKey(1), (1, 8), 0, CFG.vocab_size)
+    at = jax.random.randint(jax.random.PRNGKey(1), (1, 16), 0, CFG.vocab_size)
+
+    gpt.make_apply(CFG, use_flash="auto")(params, below)
+    assert not calls, "flash engaged below threshold"
+    out_auto = gpt.make_apply(CFG, use_flash="auto")(params, at)
+    assert calls, "flash not engaged at threshold"
+    # and the routed result still matches the XLA path numerically
+    out_base = gpt.make_apply(CFG)(params, at)
+    np.testing.assert_allclose(
+        np.asarray(out_auto), np.asarray(out_base), atol=2e-4, rtol=2e-4
+    )
