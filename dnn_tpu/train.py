@@ -77,6 +77,22 @@ def make_train_step(loss_fn: Callable, optimizer: optax.GradientTransformation):
 # dp x tp sharding (Megatron-style specs for the GPT param layout)
 # --------------------------------------------------------------------------
 
+def _tp_base_spec(keys, nd, axis):
+    """The Megatron-style key->sharding table shared by the per-layer and
+    stacked layouts. `nd` is the leaf rank WITHOUT any leading layer axis."""
+    if nd < 2:
+        return P()  # biases / norm params replicate
+    if "qkv" in keys or "fc" in keys:
+        return P(None, axis)        # (C, 3C) / (C, 4C): shard out dim
+    if "proj" in keys:
+        return P(axis, None)        # (C, C) / (4C, C): shard in dim
+    if "wte" in keys:
+        return P(axis, None)        # (V, C): vocab-parallel embedding
+    if "lm_head" in keys:
+        return P(None, axis)        # (C, V): vocab-parallel logits
+    return P()
+
+
 def gpt_tp_specs(params, *, axis: str = MODEL_AXIS):
     """PartitionSpecs for the GPT family's flat param dict
     (dnn_tpu/models/gpt.py init): attention qkv / mlp fc shard their output
@@ -87,19 +103,28 @@ def gpt_tp_specs(params, *, axis: str = MODEL_AXIS):
 
     def spec_for(path, leaf):
         keys = [getattr(p, "key", getattr(p, "name", None)) for p in path]
-        if leaf.ndim < 2:
-            return P()  # biases / norm params replicate
-        if "qkv" in keys or "fc" in keys:
-            return P(None, axis)        # (C, 3C) / (C, 4C): shard out dim
-        if "proj" in keys:
-            return P(axis, None)        # (C, C) / (4C, C): shard in dim
-        if "wte" in keys:
-            return P(axis, None)        # (V, C): vocab-parallel embedding
-        if "lm_head" in keys:
-            return P(None, axis)        # (C, V): vocab-parallel logits
-        return P()
+        return _tp_base_spec(keys, leaf.ndim, axis)
 
     return jax.tree_util.tree_map_with_path(spec_for, params)
+
+
+def gpt_tp_specs_stacked(prepared, *, axis: str = MODEL_AXIS):
+    """PartitionSpecs for the STACKED param layout (`gpt.prepare_stacked`:
+    {'blocks': (L, ...) stacks, 'wte', 'wpe', 'ln_f', 'lm_head'}) — the
+    same Megatron-style sharding as `gpt_tp_specs`, with block leaves
+    carrying a leading (replicated) layer axis. Used to run the serving
+    path (make_apply_stacked / make_generate) tensor-parallel: place
+    `prepared` with these specs and GSPMD inserts the collectives."""
+
+    def spec_for(path, leaf):
+        keys = [getattr(p, "key", getattr(p, "name", None)) for p in path]
+        stacked = "blocks" in keys
+        base = _tp_base_spec(keys, leaf.ndim - (1 if stacked else 0), axis)
+        if stacked and base != P():
+            return P(None, *base)  # replicated leading layer axis
+        return base
+
+    return jax.tree_util.tree_map_with_path(spec_for, prepared)
 
 
 def specs_to_shardings(mesh: Mesh, specs):
