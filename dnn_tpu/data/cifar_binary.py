@@ -1,0 +1,99 @@
+"""CIFAR-10 binary-format dataset reader.
+
+The reference framework's only input path is one PIL image per inference
+request (/root/reference/node.py:142-154); it has no dataset/training
+input pipeline at all (SURVEY §5). This module supplies the training-side
+loader for the standard CIFAR-10 binary format (data_batch_*.bin: 10000
+records of [1 label byte | 3072 image bytes, R then G then B planes,
+32x32 row-major]).
+
+Output batches match the client path's preprocessing exactly
+(dnn_tpu/io/preprocess.py): float32 NHWC in [-1, 1] via /255 then
+(x - 0.5) / 0.5 — so a model trained from this loader serves unchanged
+behind the inference engine.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Iterator, Sequence, Tuple
+
+import numpy as np
+
+RECORD_BYTES = 1 + 3 * 32 * 32
+_MEAN = 0.5
+_STD = 0.5
+
+
+class CifarBinaryDataset:
+    """Memory-mapped CIFAR-10 binary batches with seeded shuffling.
+
+    `files` are one or more *.bin paths; records are concatenated. Images
+    decode to (H, W, C) float32 normalized; labels to int32.
+    """
+
+    def __init__(self, files: Sequence[str]):
+        if isinstance(files, (str, os.PathLike)):
+            files = [files]
+        if not files:
+            raise ValueError("need at least one CIFAR binary file")
+        self._mmaps = []
+        for path in files:
+            size = os.path.getsize(path)
+            if size == 0 or size % RECORD_BYTES != 0:
+                raise ValueError(
+                    f"{path}: size {size} is not a multiple of the "
+                    f"{RECORD_BYTES}-byte CIFAR record"
+                )
+            self._mmaps.append(
+                np.memmap(path, dtype=np.uint8, mode="r").reshape(-1, RECORD_BYTES)
+            )
+        self._records = np.concatenate(self._mmaps) if len(self._mmaps) > 1 \
+            else self._mmaps[0]
+
+    def __len__(self) -> int:
+        return self._records.shape[0]
+
+    def decode(self, idx) -> Tuple[np.ndarray, np.ndarray]:
+        """Records at `idx` (array-like) -> (images (N, 32, 32, 3) f32
+        normalized, labels (N,) int32)."""
+        recs = self._records[np.asarray(idx)]
+        labels = recs[:, 0].astype(np.int32)
+        # planes: (N, 3, 32, 32) CHW -> NHWC
+        imgs = recs[:, 1:].reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1)
+        imgs = imgs.astype(np.float32) / 255.0
+        imgs = (imgs - _MEAN) / _STD
+        return imgs, labels
+
+    def batches(
+        self, batch_size: int, *, shuffle: bool = True, seed: int = 0,
+        epochs: int | None = None, drop_remainder: bool = True,
+    ) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
+        """Yield (images, labels) batches. `epochs=None` repeats forever
+        (each epoch reshuffled deterministically from `seed`)."""
+        n = len(self)
+        if batch_size > n:
+            raise ValueError(f"batch_size {batch_size} > dataset size {n}")
+        rng = np.random.default_rng(seed)
+        epoch = 0
+        while epochs is None or epoch < epochs:
+            order = rng.permutation(n) if shuffle else np.arange(n)
+            stop = n - (n % batch_size) if drop_remainder else n
+            for lo in range(0, stop, batch_size):
+                yield self.decode(order[lo:lo + batch_size])
+            epoch += 1
+
+
+def write_cifar_binary(path: str, images: np.ndarray, labels: np.ndarray):
+    """Write (N, 32, 32, 3) uint8 images + (N,) labels in the CIFAR binary
+    format — the test-fixture/export counterpart of the reader."""
+    images = np.asarray(images, np.uint8)
+    labels = np.asarray(labels, np.uint8)
+    if images.ndim != 4 or images.shape[1:] != (32, 32, 3):
+        raise ValueError(f"expected (N, 32, 32, 3) uint8, got {images.shape}")
+    if labels.shape != (images.shape[0],):
+        raise ValueError("one label per image required")
+    chw = images.transpose(0, 3, 1, 2).reshape(images.shape[0], -1)
+    recs = np.concatenate([labels[:, None], chw], axis=1)
+    with open(path, "wb") as f:
+        f.write(recs.tobytes())
