@@ -20,6 +20,7 @@ import numpy as np
 
 from dnn_tpu.comm import wire_pb2 as pb
 from dnn_tpu.comm.service import (
+    PER_STAGE_BUDGET_S,
     RETRYABLE_CODES,
     SERVICE_NAME,
     _tensor_arr,
@@ -28,6 +29,16 @@ from dnn_tpu.comm.service import (
 from dnn_tpu.io.serialization import PayloadCorruptError
 
 log = logging.getLogger("dnn_tpu.comm")
+
+
+def pipeline_budget(num_parts: int, *, margin: float = 30.0) -> float:
+    """Overall edge-client budget for one pipeline traversal: one per-stage
+    slice per part plus a margin. Strictly larger than the first hop's
+    server-side budget (PER_STAGE_BUDGET_S * (num_parts - 1), see
+    StageServer._forward), so a downstream timeout surfaces to the client
+    as an error status from the first stage, never as the client's own
+    DEADLINE_EXCEEDED racing the relay."""
+    return PER_STAGE_BUDGET_S * num_parts + margin
 
 
 class NodeClient:

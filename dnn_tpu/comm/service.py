@@ -23,6 +23,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import time
 from typing import Optional
 
 import grpc
@@ -44,13 +45,23 @@ SERVICE_NAME = "node_service.NodeService"
 # real error and surfaces immediately.
 RETRYABLE_CODES = frozenset({
     grpc.StatusCode.UNAVAILABLE,
-    grpc.StatusCode.DEADLINE_EXCEEDED,
     grpc.StatusCode.RESOURCE_EXHAUSTED,
     # a receiver detected payload corruption (crc32c mismatch) — the
     # pipeline is stateless per request, so resending is safe and likely
     # to succeed
     grpc.StatusCode.DATA_LOSS,
 })
+# DEADLINE_EXCEEDED is deliberately NOT retryable: a deadline here always
+# spans the entire remaining pipeline (see _forward / pipeline_budget), so
+# when it expires, resending toward the same hung stage can only duplicate
+# every downstream stage's work — the timeout surfaces upward instead.
+
+# Per-stage slice of the pipeline deadline budget: generous for one stage's
+# jit-compiled forward + one LAN hop (first-call XLA compiles included). A
+# hop covering k downstream stages gets k * this as its OVERALL budget; the
+# edge client covering the whole pipeline gets num_parts * this + margin
+# (see dnn_tpu.comm.client.pipeline_budget).
+PER_STAGE_BUDGET_S = 30.0
 
 
 def _tensor_msg(arr) -> pb.Tensor:
@@ -147,13 +158,27 @@ class StageServer:
     # --- plumbing ---
 
     async def _forward(
-        self, request_id: str, y: np.ndarray, *, retries: int = 2, backoff: float = 0.2
+        self, request_id: str, y: np.ndarray, *, retries: int = 2,
+        backoff: float = 0.2, timeout: Optional[float] = None,
     ) -> pb.TensorResponse:
-        """Relay downstream with bounded retries on transient failures
-        (RETRYABLE_CODES), reusing the shared channel across attempts (gRPC
-        reconnects a broken channel on the next call) — the per-hop
-        resilience the reference lacks (SURVEY §5: failures only become
-        status strings, "No retry")."""
+        """Relay downstream with bounded retries on transient failures,
+        reusing the shared channel across attempts (gRPC reconnects a broken
+        channel on the next call) — the per-hop resilience the reference
+        lacks (SURVEY §5: failures only become status strings, "No retry").
+
+        Deadline discipline: the relayed call spans the ENTIRE remaining
+        pipeline (response-chain semantics, SURVEY §3.3), so this hop gets
+        an OVERALL budget that scales with remaining depth —
+        `PER_STAGE_BUDGET_S * downstream_stages` — shared across all
+        attempts and backoff sleeps (each attempt's gRPC deadline is the
+        budget REMAINING, mirroring NodeClient.send_tensor). Deeper stages
+        therefore hold strictly smaller budgets than the hops above them,
+        even when retryable failures arrive late (e.g. a crc32c DATA_LOSS
+        after most of the downstream compute), so a downstream error
+        status always has time to ride back up before any upstream
+        deadline fires. DEADLINE_EXCEEDED itself is not retryable (see
+        RETRYABLE_CODES): the expired budget already covered the whole
+        remaining pipeline."""
         request = pb.TensorRequest(request_id=request_id, tensor=_tensor_msg(y))
         if self._next_channel is None:
             self._next_channel = grpc.aio.insecure_channel(self.next_address)
@@ -162,17 +187,25 @@ class StageServer:
             request_serializer=pb.TensorRequest.SerializeToString,
             response_deserializer=pb.TensorResponse.FromString,
         )
+        if timeout is None:
+            timeout = PER_STAGE_BUDGET_S * max(
+                self.config.num_parts - self.part_index - 1, 1
+            )
+        deadline = time.monotonic() + timeout
         attempt = 0
         while True:
+            remaining = deadline - time.monotonic()
             try:
-                return await call(request)
+                return await call(request, timeout=max(remaining, 0.001))
             except grpc.aio.AioRpcError as e:
                 # NOTE: the shared channel is deliberately NOT closed between
                 # attempts — other requests may have calls in flight on it,
                 # and gRPC reconnects a broken channel on the next call anyway.
-                if e.code() not in RETRYABLE_CODES or attempt >= retries:
-                    raise
                 delay = backoff * (2 ** attempt)
+                out_of_budget = deadline - time.monotonic() <= delay
+                if e.code() not in RETRYABLE_CODES or attempt >= retries \
+                        or out_of_budget:
+                    raise
                 log.warning(
                     "forward %s -> %s failed (%s), retry %d/%d in %.2fs",
                     self.node.id, self.next_address, e.code(),

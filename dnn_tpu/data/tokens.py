@@ -42,7 +42,7 @@ class TokenDataset:
             raise ValueError(
                 f"seq_len {seq_len} + 1 exceeds dataset length {len(self.tokens)}"
             )
-        starts = rng.integers(0, len(self.tokens) - seq_len - 1, batch_size)
+        starts = rng.integers(0, len(self.tokens) - seq_len, batch_size)
         return np.stack(
             [self.tokens[s:s + seq_len + 1] for s in starts]
         ).astype(np.int32)

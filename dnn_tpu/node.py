@@ -81,7 +81,7 @@ async def _initiate_edge(engine: PipelineEngine, node_id: str, image_path: str,
     server handlers stay responsive while the pipeline round-trip is in
     flight (the reference simply blocks inside one event loop, node.py:181).
     """
-    from dnn_tpu.comm.client import NodeClient
+    from dnn_tpu.comm.client import NodeClient, pipeline_budget
 
     loop = asyncio.get_running_loop()
     cfg = engine.config
@@ -101,7 +101,10 @@ async def _initiate_edge(engine: PipelineEngine, node_id: str, image_path: str,
         log.error("next node %s not healthy after %.0fs", nxt.address, health_deadline)
         return
     status, result = await loop.run_in_executor(
-        None, lambda: client.send_tensor(y, request_id="dnn_tpu_pipe_001")
+        None, lambda: client.send_tensor(
+            y, request_id="dnn_tpu_pipe_001",
+            timeout=pipeline_budget(cfg.num_parts),
+        )
     )
     log.info("pipeline status: %s", status)
     if result is not None:

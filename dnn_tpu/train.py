@@ -223,8 +223,6 @@ def fit(
     this a resumed run would silently re-train on the earliest batches.
     Pass False only when `batch_iter` is already positioned at
     `start_step`."""
-    from dnn_tpu.io.train_ckpt import cleanup_old_checkpoints, save_train_state
-
     if advance_batches:
         for skipped in range(start_step):
             try:
@@ -249,9 +247,40 @@ def fit(
         if on_step is not None:
             on_step(step + 1, loss)
         if ckpt_dir and ckpt_every and (step + 1) % ckpt_every == 0:
-            save_train_state(ckpt_dir, step + 1, state)
-            cleanup_old_checkpoints(ckpt_dir, keep=keep_checkpoints)
+            save_checkpoint_multihost(
+                ckpt_dir, step + 1, state, keep=keep_checkpoints
+            )
     return state, loss
+
+
+def save_checkpoint_multihost(ckpt_dir: str, step: int, state, *, keep: int = 3):
+    """Checkpoint save that is correct under `jax.distributed`: every
+    process walks the state's leaves in the same order and allgathers each
+    non-fully-addressable one (a collective — all processes must reach the
+    call), but only process 0 RETAINS the gathered value; the others drop
+    each leaf immediately, so no host except the writer ever holds the full
+    unsharded state (params + optimizer moments) at once. Only process 0
+    writes, so N processes sharing one checkpoint directory never race on
+    the rename pair in save_train_state. Single-process: a plain save."""
+    from dnn_tpu.io.train_ckpt import cleanup_old_checkpoints, save_train_state
+
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        is_writer = jax.process_index() == 0
+        leaves, treedef = jax.tree.flatten(state)
+        gathered = []
+        for leaf in leaves:
+            if isinstance(leaf, jax.Array) and not leaf.is_fully_addressable:
+                full = multihost_utils.process_allgather(leaf, tiled=True)
+                gathered.append(full if is_writer else None)
+            else:
+                gathered.append(leaf)
+        if not is_writer:
+            return
+        state = jax.tree.unflatten(treedef, gathered)
+    save_train_state(ckpt_dir, step, state)
+    cleanup_old_checkpoints(ckpt_dir, keep=keep)
 
 
 def make_pipeline_train_step(

@@ -1,0 +1,89 @@
+"""Data-package tests: package importability, loader sampling bounds, and
+host→device prefetch. The reference has no input pipeline at all (SURVEY
+§5) — these cover the rebuild's training-side loaders end to end."""
+
+import numpy as np
+import pytest
+
+import dnn_tpu.data  # the package import itself is under test
+from dnn_tpu.data import CifarBinaryDataset, TokenDataset, prefetch_to_device
+from dnn_tpu.data.cifar_binary import write_cifar_binary
+from dnn_tpu.data.tokens import write_tokens
+
+
+def test_package_exports_resolve():
+    for name in dnn_tpu.data.__all__:
+        assert getattr(dnn_tpu.data, name) is not None
+
+
+def test_token_dataset_minimal_length_sampling(tmp_path):
+    # len(tokens) == seq_len + 1: exactly one valid window; previously this
+    # raised ValueError('high <= 0') from rng.integers(0, 0).
+    path = str(tmp_path / "toks.bin")
+    write_tokens(path, np.arange(9))
+    ds = TokenDataset(path)
+    rng = np.random.default_rng(0)
+    batch = ds.sample(rng, 4, seq_len=8)
+    assert batch.shape == (4, 9)
+    np.testing.assert_array_equal(batch, np.tile(np.arange(9), (4, 1)))
+
+
+def test_token_dataset_last_window_reachable(tmp_path):
+    # The final valid start offset (len - seq_len - 1) must be sampleable.
+    path = str(tmp_path / "toks.bin")
+    write_tokens(path, np.arange(12))
+    ds = TokenDataset(path)
+    rng = np.random.default_rng(0)
+    seq_len = 4
+    starts = set()
+    for _ in range(200):
+        batch = ds.sample(rng, 8, seq_len)
+        starts.update(int(b[0]) for b in batch)
+    assert max(starts) == len(ds) - seq_len - 1
+    assert min(starts) == 0
+
+
+def test_prefetch_to_device_order_and_placement(tmp_path):
+    import jax
+
+    path = str(tmp_path / "cifar.bin")
+    rng = np.random.default_rng(0)
+    write_cifar_binary(
+        path,
+        rng.integers(0, 256, (32, 32, 32, 3), dtype=np.uint8),
+        rng.integers(0, 10, 32, dtype=np.uint8),
+    )
+    ds = CifarBinaryDataset(path)
+    host = list(ds.batches(8, shuffle=False, epochs=1))
+    dev = list(prefetch_to_device(ds.batches(8, shuffle=False, epochs=1), size=3))
+    assert len(dev) == len(host) == 4
+    for (hx, hy), (dx, dy) in zip(host, dev):
+        assert isinstance(dx, jax.Array) and isinstance(dy, jax.Array)
+        np.testing.assert_array_equal(np.asarray(dx), hx)
+        np.testing.assert_array_equal(np.asarray(dy), hy)
+
+
+def test_prefetch_with_sharding(tmp_path):
+    import jax
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    path = str(tmp_path / "toks.bin")
+    write_tokens(path, np.arange(4096) % 1000)
+    ds = TokenDataset(path)
+    mesh = Mesh(np.array(jax.devices()[:8]), ("data",))
+    sharding = NamedSharding(mesh, P("data"))
+    it = prefetch_to_device(ds.batches(8, 16, seed=0), size=2, sharding=sharding)
+    batch = next(it)
+    assert batch.shape == (8, 17)
+    assert batch.sharding.is_equivalent_to(sharding, batch.ndim)
+    np.testing.assert_array_equal(
+        np.asarray(batch),
+        ds.sample(np.random.default_rng(0), 8, 16),
+    )
+
+
+def test_prefetch_shorter_than_queue():
+    out = list(prefetch_to_device(iter([np.ones(3)]), size=4))
+    assert len(out) == 1
+    with pytest.raises(ValueError):
+        next(prefetch_to_device(iter([]), size=0))
