@@ -46,6 +46,19 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--node_id", required=True, help="Unique ID for this node (e.g. node1)")
     p.add_argument("--config", required=True, help="Path to the JSON configuration file")
     p.add_argument("--input_image", help="Input image path (part_index 0 initiates inference)")
+    p.add_argument("--generate", type=int, metavar="N", default=None,
+                   help="GPT families: decode N new tokens through the "
+                        "pipeline (pipeline-parallel KV cache on the spmd "
+                        "runtime) and print them")
+    p.add_argument("--prompt_ids", default=None,
+                   help="Comma-separated prompt token ids for --generate "
+                        "(default: a single BOS-like token 0)")
+    p.add_argument("--temperature", type=float, default=0.0,
+                   help="Sampling temperature for --generate (0 = greedy)")
+    p.add_argument("--top_k", type=int, default=None,
+                   help="Top-k sampling cutoff for --generate")
+    p.add_argument("--seed", type=int, default=0,
+                   help="Sampling rng seed for --generate")
     p.add_argument("--serve", action="store_true",
                    help="Host this node's stage behind gRPC (reference-interop mode)")
     p.add_argument("--process_id", type=int, default=None,
@@ -202,6 +215,15 @@ def main(argv=None) -> int:
         return 0
 
     # single-controller mode
+    if args.generate is not None:
+        if config.distributed is not None and config.distributed.num_processes > 1:
+            # the decode loop is a single-controller program for now; a
+            # silently different behavior (image forward) would be worse
+            # than an honest error
+            log.error("--generate is not supported on multi-host runs yet")
+            return 1
+        return _generate_local(engine, args)
+
     if config.distributed is not None and config.distributed.num_processes > 1:
         # Multi-host SPMD: EVERY process must execute the same program — a
         # host that exits here would strand the others' collectives over
@@ -222,6 +244,38 @@ def main(argv=None) -> int:
     else:
         log.info("nothing to do for non-initiator node in single-controller mode "
                  "(use --serve for distributed edge mode)")
+    return 0
+
+
+def _generate_local(engine: PipelineEngine, args) -> int:
+    """CLI decode mode: prompt ids -> N generated tokens, pipeline-parallel
+    when the engine runs spmd (the serving capability the reference's GPT
+    partitions lack — one stateless forward is all they can do,
+    gpt_model_parts.py:36-50)."""
+    import jax
+
+    if args.prompt_ids:
+        try:
+            ids = [int(s) for s in args.prompt_ids.split(",") if s.strip()]
+        except ValueError:
+            log.error("--prompt_ids must be comma-separated integers, got %r",
+                      args.prompt_ids)
+            return 1
+    else:
+        ids = [0]
+    try:
+        toks = engine.generate(
+            np.asarray([ids], np.int32),
+            max_new_tokens=args.generate,
+            temperature=args.temperature,
+            top_k=args.top_k,
+            rng=jax.random.PRNGKey(args.seed),
+        )
+    except (ValueError, RuntimeError) as e:
+        log.error("generation failed: %s", e)
+        return 1
+    out = ",".join(str(int(t)) for t in np.asarray(toks)[0])
+    print(f"***** GENERATED TOKENS: {out} *****")
     return 0
 
 

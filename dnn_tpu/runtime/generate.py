@@ -83,11 +83,7 @@ def forward_with_cache(prepared, ids, cache, start_pos, *, cfg: GPTConfig,
     """Forward ids (B, T) at positions [start_pos, start_pos+T) through all
     layers (scan over the stacked blocks), updating the cache. Returns
     (logits (B, T, V), cache)."""
-    pos = start_pos + jnp.arange(ids.shape[1])
-    x = jnp.take(prepared["wte"]["embedding"], ids, axis=0) + \
-        jnp.take(prepared["wpe"]["embedding"], pos, axis=0)
-    if compute_dtype is not None:
-        x = x.astype(compute_dtype)
+    x = _embed_at(prepared, ids, start_pos, compute_dtype=compute_dtype)
 
     def layer(carry, layer_in):
         bp, k_c, v_c = layer_in
@@ -113,6 +109,175 @@ def _sample(logits, rng, *, temperature: float, top_k: Optional[int]):
     return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
 
 
+def _embed_at(aux, ids, start_pos, *, compute_dtype):
+    """Token+position embedding for ids (B, T) at absolute positions
+    [start_pos, start_pos+T) — the incremental-decode counterpart of
+    gpt.embed (same gathers as forward_with_cache, so pipeline and
+    single-device generation match bit for bit)."""
+    pos = start_pos + jnp.arange(ids.shape[1])
+    x = jnp.take(aux["wte"]["embedding"], ids, axis=0) + \
+        jnp.take(aux["wpe"]["embedding"], pos, axis=0)
+    if compute_dtype is not None:
+        x = x.astype(compute_dtype)
+    return x
+
+
+def prepare_pipeline_stacked(prepared, cfg: GPTConfig, mesh, *, axis_name=None):
+    """One-time load-side transform for pipeline-parallel generation:
+    reshape the (L, ...) block stack stage-major to (S, L/S, ...) and place
+    it sharded over the stage axis (each device holds only its own stage's
+    blocks — HBM-resident per-stage weights, same layout the inference
+    engine's stacked pipeline uses). Returns (stage_blocks, aux)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from dnn_tpu.parallel.mesh import STAGE_AXIS
+
+    axis = axis_name or STAGE_AXIS
+    num_stages = mesh.shape[axis]
+    if cfg.n_layer % num_stages != 0:
+        raise ValueError(
+            f"n_layer {cfg.n_layer} not divisible by {num_stages} stages"
+        )
+    per_stage = cfg.n_layer // num_stages
+    stage_blocks = jax.tree.map(
+        lambda p: p.reshape(num_stages, per_stage, *p.shape[1:]),
+        prepared["blocks"],
+    )
+    stage_blocks = jax.device_put(
+        stage_blocks, NamedSharding(mesh, P(axis))
+    )
+    aux = {k: v for k, v in prepared.items() if k != "blocks"}
+    return stage_blocks, aux
+
+
+def make_pipeline_generate(cfg: GPTConfig, mesh, *, max_new_tokens: int,
+                           temperature: float = 0.0, top_k: Optional[int] = None,
+                           compute_dtype=None, axis_name=None):
+    """Pipeline-parallel KV-cache generation across a stage-sharded mesh.
+
+    The serving capability the reference's 8-stage GPT pipeline stops short
+    of: its partitions can emit one stateless forward's logits
+    (/root/reference/partitions/gpt_model_parts.py:36-50) but cannot
+    decode. Here the whole decode loop runs as ONE SPMD program:
+
+      * each device holds its stage's blocks AND that stage's slice of the
+        KV cache — cache shards live with the weights they serve, nothing
+        cache-shaped ever crosses a device boundary;
+      * per token, the (B, 1, C) hidden state makes one full circuit of the
+        `ppermute` ring: at sub-step s the real value sits on stage s, which
+        applies its blocks against its local cache; every device computes
+        each sub-step (SPMD — one program), but only the active stage's
+        cache update is kept (`where` on the stage coordinate). Since
+        single-stream decode is inherently sequential through the stages,
+        wall-clock equals the sequential stage latency — the idle devices'
+        discarded compute costs energy, not time;
+      * embed runs where the ring starts and head/sampling where it ends
+        (stage 0 after the wraparound hop), and the sampled token is
+        psum-broadcast so every stage enters the next step agreed.
+
+    Token-for-token identical to single-device `make_generate` (same gather,
+    block, head, and rng-split sequence). Returns
+    generate(stage_blocks, aux, ids, rng) over `prepare_pipeline_stacked`
+    outputs.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    from dnn_tpu.parallel.mesh import STAGE_AXIS
+
+    if max_new_tokens < 1:
+        raise ValueError(f"max_new_tokens must be >= 1, got {max_new_tokens}")
+    axis = axis_name or STAGE_AXIS
+    num_stages = mesh.shape[axis]
+    if cfg.n_layer % num_stages != 0:
+        raise ValueError(
+            f"n_layer {cfg.n_layer} not divisible by {num_stages} stages"
+        )
+    per_stage = cfg.n_layer // num_stages
+    perm = [(i, (i + 1) % num_stages) for i in range(num_stages)]
+
+    def per_device(stage_blocks, aux, ids, rng):
+        local = jax.tree.map(lambda p: p[0], stage_blocks)  # (per_stage, ...)
+        d = lax.axis_index(axis)
+        b, t = ids.shape
+        s_max = t + max_new_tokens
+        cache_dtype = compute_dtype or jnp.float32
+        cshape = (per_stage, b, cfg.n_head, s_max, cfg.n_embd // cfg.n_head)
+        ck = jnp.zeros(cshape, cache_dtype)
+        cv = jnp.zeros(cshape, cache_dtype)
+
+        def my_blocks(x, ck, cv, start_pos):
+            def layer(carry, layer_in):
+                bp, k_c, v_c = layer_in
+                y, k_c, v_c = _block_with_cache(
+                    bp, carry, k_c, v_c, start_pos, cfg=cfg,
+                    compute_dtype=compute_dtype,
+                )
+                return y, (k_c, v_c)
+
+            x, (ck2, cv2) = lax.scan(layer, x, (local, ck, cv))
+            return x, ck2, cv2
+
+        def ring_pass(x, ck, cv, start_pos):
+            """x real on stage 0 -> through all stages in order -> real
+            result back on stage 0 (wraparound hop)."""
+            def sub(carry, s):
+                h, ck, cv = carry
+                h2, ck2, cv2 = my_blocks(h, ck, cv, start_pos)
+                active = d == s
+                ck = jnp.where(active, ck2, ck)
+                cv = jnp.where(active, cv2, cv)
+                h = lax.ppermute(h2, axis, perm)
+                return (h, ck, cv), None
+
+            (h, ck, cv), _ = lax.scan(sub, (x, ck, cv), jnp.arange(num_stages))
+            return h, ck, cv
+
+        def sample_last(h, sub_rng):
+            logits = head(aux, h[:, -1:].astype(jnp.float32), cfg=cfg,
+                          compute_dtype=compute_dtype)
+            tok = _sample(logits[:, -1], sub_rng,
+                          temperature=temperature, top_k=top_k)
+            # only stage 0 holds the real hidden state; broadcast its token
+            return lax.psum(jnp.where(d == 0, tok, jnp.zeros_like(tok)), axis)
+
+        # prefill: full prompt, one ring circuit
+        x = _embed_at(aux, ids, 0, compute_dtype=compute_dtype)
+        h, ck, cv = ring_pass(x, ck, cv, 0)
+        rng, sub = jax.random.split(rng)
+        tok = sample_last(h, sub)
+
+        def step(carry, i):
+            ck, cv, tok, rng = carry
+            x = _embed_at(aux, tok[:, None], t + i, compute_dtype=compute_dtype)
+            h, ck, cv = ring_pass(x, ck, cv, t + i)
+            rng, sub = jax.random.split(rng)
+            nxt = sample_last(h, sub)
+            return (ck, cv, nxt, rng), tok
+
+        (_, _, last, _), toks = lax.scan(
+            step, (ck, cv, tok, rng), jnp.arange(max_new_tokens - 1)
+        )
+        toks = jnp.moveaxis(toks, 0, 1)  # (B, max_new_tokens-1)
+        return jnp.concatenate([toks, last[:, None]], axis=1)
+
+    @jax.jit
+    def generate(stage_blocks, aux, ids, rng):
+        b, t = ids.shape
+        if t + max_new_tokens > cfg.block_size:
+            raise ValueError(
+                f"prompt {t} + max_new_tokens {max_new_tokens} exceeds "
+                f"block_size {cfg.block_size}"
+            )
+        return jax.shard_map(
+            per_device, mesh=mesh,
+            in_specs=(P(axis), P(), P(), P()),
+            out_specs=P(),
+            check_vma=False,
+        )(stage_blocks, aux, ids, rng)
+
+    return generate
+
+
 def make_generate(cfg: GPTConfig, *, max_new_tokens: int, temperature: float = 0.0,
                   top_k: Optional[int] = None, compute_dtype=None):
     """Build a jitted generate(prepared, ids, rng) -> (B, max_new_tokens).
@@ -121,6 +286,8 @@ def make_generate(cfg: GPTConfig, *, max_new_tokens: int, temperature: float = 0
     length is static per compilation (usual JAX contract); decode runs as a
     single lax.scan.
     """
+    if max_new_tokens < 1:
+        raise ValueError(f"max_new_tokens must be >= 1, got {max_new_tokens}")
 
     @functools.partial(jax.jit, static_argnames=())
     def generate(prepared, ids, rng):

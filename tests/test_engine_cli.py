@@ -132,6 +132,39 @@ def test_cli_bad_config(tmp_path):
     assert main(["--node_id", "ghost", "--config", str(bad)]) == 1
 
 
+def test_cli_generate_mode(tmp_path, capsys):
+    from dnn_tpu.node import main
+
+    cfg = {
+        "nodes": [{"id": f"n{i}", "part_index": i} for i in range(4)],
+        "num_parts": 4,
+        "model": "gpt2-test",
+        "device_type": "cpu",
+        "runtime": "spmd",
+    }
+    cfg_path = tmp_path / "config.json"
+    cfg_path.write_text(json.dumps(cfg))
+
+    rc = main(["--node_id", "n0", "--config", str(cfg_path),
+               "--generate", "5", "--prompt_ids", "1,2,3"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "GENERATED TOKENS:" in out
+    toks = [int(t) for t in out.split("GENERATED TOKENS:")[1].split("*")[0].strip().split(",")]
+    assert len(toks) == 5
+
+    # malformed prompt ids fail cleanly, reference-style exit(1)
+    assert main(["--node_id", "n0", "--config", str(cfg_path),
+                 "--generate", "3", "--prompt_ids", "a,b"]) == 1
+
+    # CIFAR family has no decode path -> clean error
+    cfg2 = _cfg_dict(2)
+    cfg2_path = tmp_path / "cifar.json"
+    cfg2_path.write_text(json.dumps(cfg2))
+    assert main(["--node_id", "node1", "--config", str(cfg2_path),
+                 "--generate", "3"]) == 1
+
+
 def test_engine_stage_role_minimal():
     """role='stage' must work with fewer devices than stages (the --serve
     deployment from a 1-device host) and refuse full-pipeline runs."""
