@@ -54,6 +54,12 @@ PRESETS = {
     "gpt2-medium": GPTConfig(n_layer=24, n_head=16, n_embd=1024),
     "gpt2-large": GPTConfig(n_layer=36, n_head=20, n_embd=1280),
     "gpt2-xl": GPTConfig(n_layer=48, n_head=25, n_embd=1600),
+    # long-context variants (train-from-scratch; the classic presets cap
+    # block_size at GPT-2's 1024, below the flash-attention auto crossover —
+    # these are the configs where use_flash="auto" engages the Pallas
+    # kernel and where the seq-parallel ring is worth its collectives)
+    "gpt2-4k": GPTConfig(block_size=4096, n_layer=12, n_head=12, n_embd=768),
+    "gpt2-8k": GPTConfig(block_size=8192, n_layer=12, n_head=12, n_embd=768),
     # tiny config for tests / CPU-mesh CI
     "gpt2-test": GPTConfig(block_size=64, vocab_size=256, n_layer=4, n_head=4, n_embd=64),
 }

@@ -261,6 +261,9 @@ def _generate_local(engine: PipelineEngine, args) -> int:
             log.error("--prompt_ids must be comma-separated integers, got %r",
                       args.prompt_ids)
             return 1
+        if not ids:
+            log.error("--prompt_ids contained no token ids: %r", args.prompt_ids)
+            return 1
     else:
         ids = [0]
     try:

@@ -96,37 +96,158 @@ def _flash_kernel(
         o_ref[0] = (acc_scr[...] / l_scr[:, :1]).astype(o_ref.dtype)
 
 
-def _flash_tpu(q, k, v, *, causal, block_q, block_k, interpret):
+def _fwd_lse_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
+                    *, causal, scale, block_q, block_k, offset):
+    """Forward that additionally writes the per-row logsumexp (lane-broadcast
+    to 128, the TPU row-stat storage convention — see the lse residual note
+    in _flash_tpu_fwd). Shares the step math with _flash_kernel via
+    delegation so the two can never drift."""
+    from jax.experimental import pallas as pl
+
+    _flash_kernel(
+        q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr,
+        causal=causal, scale=scale, block_q=block_q, block_k=block_k,
+        offset=offset,
+    )
+
+    ki = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(ki == nk - 1)
+    def _save_lse():
+        lse_ref[0] = m_scr[...] + jnp.log(l_scr[...])
+
+
+def _recompute_pds(q_ref, k_ref, v_ref, do_ref, lse_ref, di_ref, qi, ki,
+                   *, causal, scale, block_q, block_k, offset):
+    """Shared backward-step recompute (single source — the dq and dkv
+    kernels must apply identical masking/scaling or dQ silently disagrees
+    with dK/dV): rebuild the normalized probabilities P from the saved
+    logsumexp, then dS = P * (dP - D). Returns (q, k, do, p, ds) in f32."""
+    q = q_ref[0].astype(jnp.float32)    # (block_q, d)
+    k = k_ref[0].astype(jnp.float32)    # (block_k, d)
+    v = v_ref[0].astype(jnp.float32)
+    do = do_ref[0].astype(jnp.float32)  # (block_q, d)
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    ) * scale
+    if causal:
+        rows = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0) + qi * block_q
+        cols = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1) + ki * block_k
+        s = jnp.where(rows + offset >= cols, s, _NEG_BIG)
+    p = jnp.exp(s - lse_ref[0][:, :1])  # normalized probs (block_q, block_k)
+    dp = jax.lax.dot_general(            # dO V^T
+        do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    )
+    ds = p * (dp - di_ref[0][:, :1])
+    return q, k, do, p, ds
+
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, di_ref, dq_ref,
+                   acc_scr, *, causal, scale, block_q, block_k, offset):
+    """dQ for one q block, accumulated over the (sequential) k-block grid
+    axis: dQ = scale * dS K."""
+    from jax.experimental import pallas as pl
+
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    live = (not causal) or (ki * block_k <= qi * block_q + block_q - 1 + offset)
+
+    @pl.when(live)
+    def _step():
+        _, k, _, _, ds = _recompute_pds(
+            q_ref, k_ref, v_ref, do_ref, lse_ref, di_ref, qi, ki,
+            causal=causal, scale=scale, block_q=block_q, block_k=block_k,
+            offset=offset,
+        )
+        acc_scr[...] += jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+
+    @pl.when(ki == nk - 1)
+    def _finish():
+        dq_ref[0] = (acc_scr[...] * scale).astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, di_ref,
+                    dk_ref, dv_ref, dk_scr, dv_scr,
+                    *, causal, scale, block_q, block_k, offset):
+    """dK and dV for one k block, accumulated over the (sequential) q-block
+    grid axis: dV = P^T dO, dK = scale * dS^T Q."""
+    from jax.experimental import pallas as pl
+
+    ki = pl.program_id(1)
+    qi = pl.program_id(2)
+    nq = pl.num_programs(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_scr[...] = jnp.zeros_like(dk_scr)
+        dv_scr[...] = jnp.zeros_like(dv_scr)
+
+    live = (not causal) or (ki * block_k <= qi * block_q + block_q - 1 + offset)
+
+    @pl.when(live)
+    def _step():
+        q, _, do, p, ds = _recompute_pds(
+            q_ref, k_ref, v_ref, do_ref, lse_ref, di_ref, qi, ki,
+            causal=causal, scale=scale, block_q=block_q, block_k=block_k,
+            offset=offset,
+        )
+        dv_scr[...] += jax.lax.dot_general(          # P^T dO  (block_k, d)
+            p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        dk_scr[...] += jax.lax.dot_general(          # dS^T Q  (block_k, d)
+            ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+
+    @pl.when(qi == nq - 1)
+    def _finish():
+        dk_ref[0] = (dk_scr[...] * scale).astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[...].astype(dv_ref.dtype)
+
+
+def _qspec(block_q, d):
+    from jax.experimental import pallas as pl
+
+    return pl.BlockSpec((1, block_q, d), lambda bh_, qi, ki: (bh_, qi, 0))
+
+
+def _kspec(block_k, d):
+    from jax.experimental import pallas as pl
+
+    return pl.BlockSpec((1, block_k, d), lambda bh_, qi, ki: (bh_, ki, 0))
+
+
+def _call_fwd(q3, k3, v3, *, causal, block_q, block_k, interpret, with_lse):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
-    b, h, t, d = q.shape
-    s_len = k.shape[2]
-    scale = 1.0 / (d ** 0.5)
-    bh = b * h
-    q3 = q.reshape(bh, t, d)
-    k3 = k.reshape(bh, s_len, d)
-    v3 = v.reshape(bh, s_len, d)
+    bh, t, d = q3.shape
+    s_len = k3.shape[1]
     nq, nk = t // block_q, s_len // block_k
-
-    kernel = functools.partial(
-        _flash_kernel,
-        causal=causal,
-        scale=scale,
-        block_q=block_q,
-        block_k=block_k,
-        offset=s_len - t,
-    )
-    out = pl.pallas_call(
+    common = dict(causal=causal, scale=1.0 / (d ** 0.5), block_q=block_q,
+                  block_k=block_k, offset=s_len - t)
+    out_shape = [jax.ShapeDtypeStruct((bh, t, d), q3.dtype)]
+    out_specs = [_qspec(block_q, d)]
+    if with_lse:
+        kernel = functools.partial(_fwd_lse_kernel, **common)
+        out_shape.append(jax.ShapeDtypeStruct((bh, t, 128), jnp.float32))
+        out_specs.append(_qspec(block_q, 128))
+    else:
+        kernel = functools.partial(_flash_kernel, **common)
+    res = pl.pallas_call(
         kernel,
         grid=(bh, nq, nk),
-        in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda bh_, qi, ki: (bh_, qi, 0)),
-            pl.BlockSpec((1, block_k, d), lambda bh_, qi, ki: (bh_, ki, 0)),
-            pl.BlockSpec((1, block_k, d), lambda bh_, qi, ki: (bh_, ki, 0)),
-        ],
-        out_specs=pl.BlockSpec((1, block_q, d), lambda bh_, qi, ki: (bh_, qi, 0)),
-        out_shape=jax.ShapeDtypeStruct((bh, t, d), q.dtype),
+        in_specs=[_qspec(block_q, d), _kspec(block_k, d), _kspec(block_k, d)],
+        out_specs=out_specs if with_lse else out_specs[0],
+        out_shape=out_shape if with_lse else out_shape[0],
         scratch_shapes=[
             pltpu.VMEM((block_q, 128), jnp.float32),  # running row max
             pltpu.VMEM((block_q, 128), jnp.float32),  # running row sum
@@ -137,7 +258,110 @@ def _flash_tpu(q, k, v, *, causal, block_q, block_k, interpret):
         ),
         interpret=interpret,
     )(q3, k3, v3)
+    return res if with_lse else (res, None)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash_tpu(q, k, v, causal, block_q, block_k, interpret):
+    """Pallas flash attention with a custom VJP: `jax.grad` through
+    `use_flash=True` runs the recompute-based backward kernels below instead
+    of failing (pallas_call has no autodiff rule). Inference-only calls take
+    this primal path and never pay the logsumexp write."""
+    b, h, t, d = q.shape
+    bh = b * h
+    out, _ = _call_fwd(
+        q.reshape(bh, t, d), k.reshape(bh, k.shape[2], d),
+        v.reshape(bh, v.shape[2], d),
+        causal=causal, block_q=block_q, block_k=block_k, interpret=interpret,
+        with_lse=False,
+    )
     return out.reshape(b, h, t, d)
+
+
+def _flash_tpu_fwd(q, k, v, causal, block_q, block_k, interpret):
+    b, h, t, d = q.shape
+    bh = b * h
+    out, lse = _call_fwd(
+        q.reshape(bh, t, d), k.reshape(bh, k.shape[2], d),
+        v.reshape(bh, v.shape[2], d),
+        causal=causal, block_q=block_q, block_k=block_k, interpret=interpret,
+        with_lse=True,
+    )
+    # lse residual is (bh, t, 128) lane-broadcast f32 — the TPU-native row
+    # stat layout (row vectors must live along sublanes to broadcast against
+    # (block_q, block_k) score tiles; a (bh, t) array would put them in
+    # lanes and force an in-kernel transpose). 128 lanes of redundancy cost
+    # 128*T*4B per head — noise next to the (T, T) scores flash avoids.
+    return out.reshape(b, h, t, d), (q, k, v, out.reshape(b, h, t, d), lse)
+
+
+def _flash_tpu_bwd(causal, block_q, block_k, interpret, residuals, do):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    q, k, v, o, lse = residuals
+    b, h, t, d = q.shape
+    s_len = k.shape[2]
+    bh = b * h
+    nq, nk = t // block_q, s_len // block_k
+
+    # D_i = rowsum(dO * O): elementwise + reduce — jnp, not a kernel, and
+    # stored lane-broadcast like lse.
+    di = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
+    di = jnp.broadcast_to(di.reshape(bh, t, 1), (bh, t, 128))
+
+    q3 = q.reshape(bh, t, d)
+    k3 = k.reshape(bh, s_len, d)
+    v3 = v.reshape(bh, s_len, d)
+    do3 = do.reshape(bh, t, d).astype(q.dtype)
+
+    common = dict(causal=causal, scale=1.0 / (d ** 0.5), block_q=block_q,
+                  block_k=block_k, offset=s_len - t)
+    row_specs = [_qspec(block_q, d), _kspec(block_k, d), _kspec(block_k, d),
+                 _qspec(block_q, d), _qspec(block_q, 128), _qspec(block_q, 128)]
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, **common),
+        grid=(bh, nq, nk),
+        in_specs=row_specs,
+        out_specs=_qspec(block_q, d),
+        out_shape=jax.ShapeDtypeStruct((bh, t, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")
+        ),
+        interpret=interpret,
+    )(q3, k3, v3, do3, lse, di)
+
+    # dkv grid iterates k blocks in the parallel axis, q blocks sequentially;
+    # index maps therefore swap roles: grid = (bh, ki, qi).
+    def kblock(block, width):
+        return pl.BlockSpec((1, block, width), lambda bh_, ki, qi: (bh_, ki, 0))
+
+    def qblock(block, width):
+        return pl.BlockSpec((1, block, width), lambda bh_, ki, qi: (bh_, qi, 0))
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, **common),
+        grid=(bh, nk, nq),
+        in_specs=[qblock(block_q, d), kblock(block_k, d), kblock(block_k, d),
+                  qblock(block_q, d), qblock(block_q, 128), qblock(block_q, 128)],
+        out_specs=[kblock(block_k, d), kblock(block_k, d)],
+        out_shape=[jax.ShapeDtypeStruct((bh, s_len, d), k.dtype),
+                   jax.ShapeDtypeStruct((bh, s_len, d), v.dtype)],
+        scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
+                        pltpu.VMEM((block_k, d), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")
+        ),
+        interpret=interpret,
+    )(q3, k3, v3, do3, lse, di)
+
+    return (dq.reshape(b, h, t, d), dk.reshape(b, h, s_len, d),
+            dv.reshape(b, h, s_len, d))
+
+
+_flash_tpu.defvjp(_flash_tpu_fwd, _flash_tpu_bwd)
 
 
 def flash_attention(q, k, v, *, causal=True, block_q=128, block_k=128, interpret=None):
@@ -158,4 +382,4 @@ def flash_attention(q, k, v, *, causal=True, block_q=128, block_k=128, interpret
         # s < t causal (queries before the first key) is a degenerate case
         # the kernel's masking doesn't model — use the reference path.
         return reference_attention(q, k, v, causal=causal)
-    return _flash_tpu(q, k, v, causal=causal, block_q=block_q, block_k=block_k, interpret=interpret)
+    return _flash_tpu(q, k, v, causal, block_q, block_k, interpret)

@@ -235,23 +235,20 @@ class PipelineEngine:
     def _build_gpt_stacked_fn(self):
         from dnn_tpu.models import gpt
 
+        from dnn_tpu.runtime.generate import prepare_pipeline_stacked
+
         cfg = self.spec.config
         mesh, microbatches = self.mesh, self.config.microbatches
-        num_parts = self.config.num_parts
-        per_stage = cfg.n_layer // num_parts
         compute_dtype = self.compute_dtype
 
         # One-time, load-side: stack blocks stage-major (S, per_stage, ...)
         # and place each stage's slice on its device (HBM-resident per-stage
-        # weights — BASELINE.json north star).
-        per_stage_stacks = [
-            gpt.stack_blocks(self.params, range(s * per_stage, (s + 1) * per_stage))
-            for s in range(num_parts)
-        ]
-        stage_major = jax.tree.map(lambda *xs: jnp.stack(xs), *per_stage_stacks)
-        stage_major = jax.device_put(stage_major, NamedSharding(mesh, P(STAGE_AXIS)))
-        aux = {k: v for k, v in self.params.items() if not k.startswith("h_")}
-        # the same (S, per_stage, ...) placement feeds pipeline generation
+        # weights — BASELINE.json north star). prepare_pipeline_stacked is
+        # the single owner of this layout; generation consumes the same
+        # placement (self._gen_parts).
+        stage_major, aux = prepare_pipeline_stacked(
+            gpt.prepare_stacked(self.params, cfg), cfg, mesh
+        )
         self._gen_parts = (stage_major, aux)
 
         def block_fn(stage_blocks, h):

@@ -89,6 +89,66 @@ def test_flash_decode_shapes_bottom_right_mask():
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
 
 
+@pytest.mark.parametrize("causal,t,s", [
+    (True, 256, 256), (False, 256, 256), (True, 128, 256),
+])
+def test_flash_backward_matches_reference(causal, t, s):
+    """jax.grad through the Pallas kernel (custom_vjp recompute backward)
+    must match grads through the jnp reference — dq, dk, and dv, including
+    the bottom-right-aligned (KV-cache) mask when S > T."""
+    b, h, d = 2, 2, 64
+    kq, kk, kv, kw = jax.random.split(jax.random.PRNGKey(7), 4)
+    q = jax.random.normal(kq, (b, h, t, d))
+    k = jax.random.normal(kk, (b, h, s, d))
+    v = jax.random.normal(kv, (b, h, s, d))
+    w = jax.random.normal(kw, (b, h, t, d))
+
+    def loss_flash(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, causal=causal, interpret=True) * w)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(reference_attention(q, k, v, causal=causal) * w)
+
+    np.testing.assert_allclose(loss_flash(q, k, v), loss_ref(q, k, v), rtol=1e-4)
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for name, a, b_ in zip("qkv", gf, gr):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b_), atol=2e-4, rtol=1e-4,
+            err_msg=f"d{name} mismatch",
+        )
+
+
+def test_grad_through_use_flash_apply():
+    """Training with use_flash=True must differentiate end to end (weak #1
+    of the round-1 review: pallas_call alone has no autodiff rule)."""
+    from dnn_tpu.models import gpt
+    from dnn_tpu.train import next_token_loss
+
+    cfg = gpt.PRESETS["gpt2-test"]
+    params = gpt.init(jax.random.PRNGKey(0), cfg)
+    apply_fn = gpt.make_apply(cfg, use_flash=True, remat=True)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 17), 0, cfg.vocab_size,
+                                jnp.int32)
+    loss, grads = jax.value_and_grad(
+        lambda p: next_token_loss(apply_fn, p, tokens)
+    )(params)
+    assert jnp.isfinite(loss)
+    flat = jax.tree.leaves(grads)
+    assert all(jnp.isfinite(g).all() for g in flat)
+    assert any(float(jnp.abs(g).max()) > 0 for g in flat)
+
+
+def test_long_context_preset_reaches_flash_auto():
+    """gpt2-4k exists so `use_flash='auto'` can actually engage (all the
+    classic presets cap block_size at 1024, below FLASH_AUTO_THRESHOLD)."""
+    from dnn_tpu.models import gpt
+    from dnn_tpu.ops.attention import FLASH_AUTO_THRESHOLD
+
+    assert "gpt2-4k" in gpt.PRESETS
+    assert gpt.PRESETS["gpt2-4k"].block_size >= FLASH_AUTO_THRESHOLD
+
+
 def test_partition_compute_dtype_matches_full_model():
     """Pipeline stages with compute_dtype=bf16 must match the full-model
     bf16 path (the review-found silent-f32 regression)."""
