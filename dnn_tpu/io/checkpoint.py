@@ -235,20 +235,14 @@ def _t_linear(w: np.ndarray) -> np.ndarray:
 def cifar_params_from_torch_state_dict(sd: Dict[str, np.ndarray]):
     """Convert the reference CNN's state dict (keys conv1/conv2/fc1/fc2
     .weight/.bias — cifar_model_parts.py:9-13) to this framework's NHWC
-    param pytree.
-
-    The subtle part: the reference flattens NCHW as (C,H,W)
-    (`x.view(-1, 64*8*8)`, cifar_model_parts.py:21,41) while we flatten
-    NHWC as (H,W,C), so fc1's input dimension must be permuted
-    (C,H,W)->(H,W,C) for identical numerics.
-    """
-    fc1_w = sd["fc1.weight"]  # (512, 4096) with 4096 = C*H*W = 64*8*8
-    out_f = fc1_w.shape[0]
-    fc1_w = fc1_w.reshape(out_f, 64, 8, 8).transpose(0, 2, 3, 1).reshape(out_f, -1)
+    param pytree. fc1 needs only the usual (out, in) transpose: the model's
+    flatten boundary deliberately emits the reference's (C, H, W) feature
+    order (dnn_tpu/models/cifar.py _seg_conv2), so the 4096-dim input
+    layout already matches."""
     return {
         "conv1": {"kernel": np.asarray(_t_conv(sd["conv1.weight"])), "bias": sd["conv1.bias"]},
         "conv2": {"kernel": np.asarray(_t_conv(sd["conv2.weight"])), "bias": sd["conv2.bias"]},
-        "fc1": {"kernel": _t_linear(fc1_w), "bias": sd["fc1.bias"]},
+        "fc1": {"kernel": _t_linear(sd["fc1.weight"]), "bias": sd["fc1.bias"]},
         "fc2": {"kernel": _t_linear(sd["fc2.weight"]), "bias": sd["fc2.bias"]},
     }
 

@@ -14,8 +14,9 @@ and its 2-way split (`ModelPart0_2Node` = convs + flatten,
   * pure functions over a param pytree instead of nn.Module aliasing;
   * partitioning generalized to any 1 <= num_parts <= 4 at layer
     boundaries (the reference hard-codes exactly 2 — node.py:246-248);
-  * the flatten order is (H, W, C); the checkpoint converter permutes
-    torch fc1 weights to match (dnn_tpu/io/checkpoint.py).
+  * the flatten at the conv/fc boundary emits the reference's (C, H, W)
+    order (see _seg_conv2), so the 2-way split's wire activation and the
+    fc1 weight layout are interchangeable with a reference node's.
 
 Param pytree layout (keys are the stage-sliceable unit, mirroring the
 reference's per-layer state-dict keys conv1/conv2/fc1/fc2):
@@ -82,7 +83,13 @@ def _seg_conv1(params, x):
 
 def _seg_conv2(params, x):
     h = max_pool2d(relu(conv2d(params["conv2"], x)))
-    return h.reshape(h.shape[0], -1)  # flatten (B, 8, 8, 64) -> (B, 4096)
+    # Flatten in the REFERENCE'S (C, H, W) order (`x.view(-1, 64*8*8)` on
+    # NCHW, cifar_model_parts.py:41), not our activation-native (H, W, C):
+    # this is the 2-way split's wire boundary, so matching the order makes
+    # our stage-0 output byte-compatible with a reference part-1 node (and
+    # vice versa) and lets fc1 weights carry over with no permutation. The
+    # transpose is 4096 elements — noise next to the convs.
+    return h.transpose(0, 3, 1, 2).reshape(h.shape[0], -1)
 
 
 def _seg_fc1(params, x):

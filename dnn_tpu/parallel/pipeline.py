@@ -19,10 +19,13 @@ sequential parts, run each part on a different device, relay activations"
    the full downstream latency (node.py:84, SURVEY §3.3).
 
 Heterogeneous stages are uniformized for SPMD by flattening + zero-padding
-activations to one (microbatch, F) f32 buffer and `lax.switch`-ing on the
-stage coordinate; homogeneous stacks (transformer blocks) should use
-`spmd_pipeline` with `stacked_params` instead, which shards one block's
-params per stage and skips the switch entirely.
+activations to one (microbatch, F) buffer and `lax.switch`-ing on the
+stage coordinate. The buffer dtype follows the payloads (see
+_buffer_dtype): single-dtype pipelines ride natively (bf16 hops cost bf16
+bytes over ICI), mixed pipelines use an f32 carrier with integer payloads
+bitcast in (exact for all of int32, not just ints < 2^24). Homogeneous
+stacks (transformer blocks) should use `spmd_pipeline_stacked` instead,
+which shards one block's params per stage and skips the switch entirely.
 """
 
 from __future__ import annotations
@@ -155,14 +158,49 @@ def _flat_size(shape) -> int:
     return int(np.prod(shape)) if shape else 1
 
 
-def _pad_flat(y, width):
-    flat = y.reshape(y.shape[0], -1).astype(jnp.float32)
+def _buffer_dtype(dtypes):
+    """Carrier dtype for a ring buffer holding payloads of `dtypes`.
+
+    One payload dtype -> carry it natively (a bf16 pipeline pays bf16 ICI
+    bytes per hop, half of f32; an all-int pipeline rides exactly). Mixed
+    dtypes -> an f32 buffer; float payloads upcast losslessly and integer
+    payloads are BITCAST in (exact for the full int32 range — no "ints fit
+    in f32 below 2^24" assumption). Bitcasting is safe here because the
+    hop path is pure data movement (ppermute / select / pad / slice):
+    nothing arithmetic ever touches the carrier bits.
+    """
+    dtypes = {jnp.dtype(d) for d in dtypes}
+    if len(dtypes) == 1:
+        return next(iter(dtypes))
+    for d in dtypes:
+        if d.itemsize > 4:
+            raise ValueError(
+                f"cannot carry {d} on a mixed-dtype pipeline ring (the "
+                "carrier is 32-bit); cast integer ids to int32 / floats "
+                "to float32"
+            )
+    return jnp.dtype(jnp.float32)
+
+
+def _pad_flat(y, width, buf_dtype=jnp.float32):
+    flat = y.reshape(y.shape[0], -1)
+    if flat.dtype != buf_dtype:
+        if jnp.issubdtype(flat.dtype, jnp.integer):
+            # mixed-dtype buffer: ints bitcast into the f32 carrier
+            flat = lax.bitcast_convert_type(flat.astype(jnp.int32), jnp.float32)
+            flat = flat.astype(buf_dtype)  # no-op (carrier is f32)
+        else:
+            flat = flat.astype(buf_dtype)
     return jnp.pad(flat, ((0, 0), (0, width - flat.shape[1])))
 
 
-def _unpad(buf, shape, dtype):
+def _unpad(buf, shape, dtype, buf_dtype=jnp.float32):
     mb = buf.shape[0]
     flat = buf[:, : _flat_size(shape[1:])]
+    # mirror of _pad_flat: integer payloads on the mixed (f32-carrier) ring
+    # were bitcast in, so bitcast them back out; everything else astypes
+    if jnp.dtype(buf_dtype) != jnp.dtype(dtype) and jnp.issubdtype(dtype, jnp.integer):
+        flat = lax.bitcast_convert_type(flat, jnp.int32).astype(dtype)
     return flat.reshape(mb, *shape[1:]).astype(dtype)
 
 
@@ -177,7 +215,8 @@ def _stage_shapes(stage_fns, stage_params, x_shape_dtype):
 
 
 def _gpipe_loop(
-    stage_step, inputs_buf, num_stages, num_microbatches, mb, width_hop, width_out, axis_name
+    stage_step, inputs_buf, num_stages, num_microbatches, mb, width_hop, width_out, axis_name,
+    out_dtype=jnp.float32,
 ):
     """The schedule, run per-device inside shard_map: at step t, stage d
     works on microbatch t-d; outputs hop to d+1 via ppermute.
@@ -186,14 +225,19 @@ def _gpipe_loop(
     stage; `out` (mb, width_out) is the pipeline product, only meaningful on
     the last stage. Hop and output widths are separate on purpose — for LM
     pipelines the final logits are ~vocab/hidden times wider than the
-    inter-stage activations, and must never ride the ppermute ring.
+    inter-stage activations, and must never ride the ppermute ring. The hop
+    buffer dtype is whatever `inputs_buf` carries (see _buffer_dtype); the
+    out buffer is always the final stage's OWN dtype — unlike the hop ring
+    it passes through an arithmetic psum, so bitcast carriage would be
+    unsafe there (FTZ can flush denormal bit patterns), and it never mixes
+    dtypes anyway.
     """
     m_count = num_microbatches
     steps = m_count + num_stages - 1
     d = lax.axis_index(axis_name)
     is_last = d == num_stages - 1
 
-    out_buf = jnp.zeros((m_count + 1, mb, width_out), jnp.float32)  # slot M = scratch
+    out_buf = jnp.zeros((m_count + 1, mb, width_out), out_dtype)  # slot M = scratch
     buf0 = inputs_buf[0]
 
     def step(carry, t):
@@ -231,10 +275,12 @@ def spmd_pipeline(
     """Heterogeneous-stage SPMD pipeline.
 
     All ranks run one program; each applies its own stage via `lax.switch`
-    on the stage coordinate. Activations ride a uniform padded f32 buffer
+    on the stage coordinate. Activations ride a uniform padded buffer
     (ppermute needs one shape on every rank — the SPMD answer to the
-    reference's per-hop dynamic wire shapes). Integer inputs (token ids) are
-    carried exactly: f32 holds ints < 2^24 losslessly.
+    reference's per-hop dynamic wire shapes) whose dtype follows the
+    payloads (_buffer_dtype): native when uniform, f32 carrier with
+    integer payloads bitcast in — exact over the whole int32 range — when
+    mixed.
 
     Memory note: because `lax.switch` branches embed every stage's params,
     this path replicates all weights on all devices — right for small or
@@ -262,21 +308,23 @@ def spmd_pipeline(
     width_hop = max(_flat_size(s.shape[1:]) for s in shapes[:-1])
     width_out = _flat_size(shapes[-1].shape[1:])
     out_shape, out_dtype = shapes[-1].shape, shapes[-1].dtype
+    buf_dtype = _buffer_dtype([s.dtype for s in shapes[:-1]])
 
-    inputs_buf = _pad_flat(x_mb.reshape(num_microbatches * mb, -1), width_hop).reshape(
-        num_microbatches, mb, width_hop
-    )
+    inputs_buf = _pad_flat(
+        x_mb.reshape(num_microbatches * mb, -1), width_hop, buf_dtype
+    ).reshape(num_microbatches, mb, width_hop)
 
     def make_branch(i):
         fn, in_s, in_dt = stage_fns[i], shapes[i].shape, shapes[i].dtype
         is_last = i == num_stages - 1
 
         def branch(buf):
-            xin = _unpad(buf, (mb, *in_s[1:]) if len(in_s) > 0 else (mb,), in_dt)
+            xin = _unpad(buf, (mb, *in_s[1:]) if len(in_s) > 0 else (mb,), in_dt, buf_dtype)
             y = fn(stage_params[i], xin)
             if is_last:
-                return jnp.zeros((mb, width_hop), jnp.float32), _pad_flat(y, width_out)
-            return _pad_flat(y, width_hop), jnp.zeros((mb, width_out), jnp.float32)
+                return (jnp.zeros((mb, width_hop), buf_dtype),
+                        _pad_flat(y, width_out, out_dtype))
+            return _pad_flat(y, width_hop, buf_dtype), jnp.zeros((mb, width_out), out_dtype)
 
         return branch
 
@@ -290,7 +338,7 @@ def spmd_pipeline(
 
         return _gpipe_loop(
             stage_step, inputs, num_stages, num_microbatches, mb,
-            width_hop, width_out, axis_name,
+            width_hop, width_out, axis_name, out_dtype=out_dtype,
         )
 
     result = jax.shard_map(
@@ -300,7 +348,7 @@ def spmd_pipeline(
     y = _unpad(
         result.reshape(num_microbatches * mb, width_out),
         (num_microbatches * mb, *out_shape[1:]),
-        out_dtype,
+        out_dtype, out_dtype,
     )
     return y
 
@@ -332,21 +380,24 @@ def spmd_pipeline_stacked(
         stacked_params, NamedSharding(mesh, P(axis_name))
     )
 
-    # flatten trailing dims into the buffer width for the generic loop
+    # flatten trailing dims into the buffer width for the generic loop; the
+    # ring carries the activation's OWN dtype (bf16 pipelines pay bf16 ICI
+    # bytes per ppermute hop, not 2x in f32)
     trail = x_mb.shape[2:]
-    flat = x_mb.reshape(num_microbatches, mb, -1).astype(jnp.float32)
+    buf_dtype = x_mb.dtype
+    flat = x_mb.reshape(num_microbatches, mb, -1)
 
     def per_device_wrapped(params, inputs):
         local = jax.tree.map(lambda p: p[0], params)
 
         def stage_step(buf):
             xin = buf.reshape(mb, *trail)
-            y = block_fn(local, xin).reshape(mb, -1).astype(jnp.float32)
+            y = block_fn(local, xin).reshape(mb, -1).astype(buf_dtype)
             return y, y  # uniform shapes: hop and output coincide
 
         return _gpipe_loop(
             stage_step, inputs, num_stages, num_microbatches, mb,
-            flat.shape[-1], flat.shape[-1], axis_name,
+            flat.shape[-1], flat.shape[-1], axis_name, out_dtype=buf_dtype,
         )
 
     result = jax.shard_map(

@@ -1,0 +1,231 @@
+"""Flagship end-to-end example: train -> checkpoint -> serve -> interop.
+
+The reference's whole demo is "trained .pth -> split across nodes -> image
+in -> class out" (/root/reference/node.py:137-200, 294-325) — but its
+trained weights were stripped from the mirror and it cannot train new ones
+(inference-only, readme.md:112). This script performs the complete loop the
+reference only implies, TPU-first:
+
+  1. TRAIN the CIFAR CNN (dnn_tpu/models/cifar.py) with the generic train
+     step on the default backend (the real TPU chip when present);
+  2. EVALUATE test accuracy;
+  3. SAVE a native .npz checkpoint AND EXPORT a torch-layout
+     `cifar10_model.pth` — re-supplying the reference's missing blob with
+     weights its unmodified loader accepts (tests/test_interop_reference.py
+     proves a real reference node serves them);
+  4. SERVE the trained model through the 2-stage pipeline via the same CLI
+     and config schema the reference uses, on a real PNG image, and check
+     the pipeline prediction against the single-program forward.
+
+Data: point --data-dir at standard CIFAR-10 binaries (data_batch_*.bin /
+test_batch.bin) for the real dataset. Without it (this sandbox has no
+network), a deterministic procedurally-generated stand-in dataset with the
+same format/shapes is synthesized — learnable class structure, so training
+demonstrably works (accuracy far above the 10% chance floor), while the
+pipeline is byte-for-byte the one real data flows through.
+
+Run:  python examples/train_cifar_serve.py --steps 300 --out-dir /tmp/cifar_run
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+
+import numpy as np
+
+
+def synth_cifar(n: int, *, seed: int = 0):
+    """Deterministic CIFAR-shaped dataset with learnable class structure:
+    each class is a FIXED random 32x32x3 template (shared by every split —
+    that's what makes train->test generalization possible) plus
+    per-sample noise drawn from `seed`."""
+    templates = np.random.default_rng(1234).integers(40, 216, (10, 32, 32, 3))
+    rng = np.random.default_rng(seed)
+    labels = rng.integers(0, 10, n).astype(np.uint8)
+    noise = rng.normal(0.0, 40.0, (n, 32, 32, 3))
+    images = np.clip(templates[labels] + noise, 0, 255).astype(np.uint8)
+    return images, labels
+
+
+def ensure_data(data_dir: str | None, out_dir: str, *, n_train=4096, n_test=512):
+    """Return (train_files, test_file); synthesize the stand-in set when no
+    real CIFAR-10 binaries are supplied."""
+    from dnn_tpu.data.cifar_binary import write_cifar_binary
+
+    if data_dir:
+        train = sorted(
+            os.path.join(data_dir, f) for f in os.listdir(data_dir)
+            if f.startswith("data_batch") and f.endswith(".bin")
+        )
+        test = os.path.join(data_dir, "test_batch.bin")
+        if train and os.path.exists(test):
+            return train, test
+        raise FileNotFoundError(f"no CIFAR binaries under {data_dir}")
+
+    os.makedirs(out_dir, exist_ok=True)
+    train_path = os.path.join(out_dir, "synth_train.bin")
+    test_path = os.path.join(out_dir, "synth_test.bin")
+    if not (os.path.exists(train_path) and os.path.exists(test_path)):
+        xi, yi = synth_cifar(n_train, seed=0)
+        write_cifar_binary(train_path, xi, yi)
+        xt, yt = synth_cifar(n_test, seed=1)
+        write_cifar_binary(test_path, xt, yt)
+    return [train_path], test_path
+
+
+def train(train_files, *, steps: int, batch_size: int = 128, lr: float = 1e-3,
+          seed: int = 0, log_every: int = 50):
+    """Train the CIFAR CNN; returns (params, last_loss)."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from dnn_tpu.data import CifarBinaryDataset, prefetch_to_device
+    from dnn_tpu.models import cifar
+    from dnn_tpu.train import fit, make_train_step
+
+    ds = CifarBinaryDataset(train_files)
+    params = cifar.init(jax.random.PRNGKey(seed))
+
+    def loss_fn(p, batch):
+        x, y = batch
+        probs = cifar.apply(p, x)  # reference semantics: softmax output
+        logp = jnp.log(jnp.clip(probs, 1e-9))
+        return -jnp.mean(jnp.take_along_axis(logp, y[:, None], axis=1))
+
+    opt = optax.adam(lr)
+    raw_step = make_train_step(loss_fn, opt)
+
+    def step_fn(state, batch):
+        p, o = state
+        p, o, loss = raw_step(p, o, batch)
+        return (p, o), loss
+
+    def on_step(s, loss):
+        if log_every and s % log_every == 0:
+            print(f"  step {s}/{steps}  loss {float(loss):.4f}")
+
+    batches = prefetch_to_device(ds.batches(batch_size, seed=seed), size=2)
+    (params, _), loss = fit(step_fn, (params, opt.init(params)), batches,
+                            num_steps=steps, on_step=on_step)
+    return params, float(loss)
+
+
+def evaluate(params, test_file, *, batch_size: int = 256) -> float:
+    import jax
+
+    from dnn_tpu.data import CifarBinaryDataset
+    from dnn_tpu.models import cifar
+
+    ds = CifarBinaryDataset([test_file])
+    apply_jit = jax.jit(cifar.apply)
+    correct = total = 0
+    for x, y in ds.batches(min(batch_size, len(ds)), shuffle=False, epochs=1,
+                           drop_remainder=False):
+        pred = np.argmax(np.asarray(apply_jit(params, x)), axis=1)
+        correct += int((pred == y).sum())
+        total += len(y)
+    return correct / total
+
+
+def export(params, out_dir: str):
+    """Native .npz + reference-format .pth. Returns (npz_path, pth_path)."""
+    from dnn_tpu.io.checkpoint import params_to_flat, save_npz
+    from dnn_tpu.io.torch_export import cifar_state_dict_from_params, save_pth
+
+    os.makedirs(out_dir, exist_ok=True)
+    npz_path = os.path.join(out_dir, "cifar_cnn.npz")
+    pth_path = os.path.join(out_dir, "cifar10_model.pth")
+    save_npz(npz_path, params_to_flat(params))
+    save_pth(pth_path, cifar_state_dict_from_params(params))
+    return npz_path, pth_path
+
+
+def serve_and_check(npz_path: str, out_dir: str, test_file: str) -> int:
+    """Serve the trained checkpoint through the 2-stage pipeline CLI on a
+    real PNG image; assert the pipeline prediction matches the
+    single-program forward. Returns the predicted class."""
+    import jax
+    from PIL import Image
+
+    from dnn_tpu.config import TopologyConfig
+    from dnn_tpu.data.cifar_binary import CifarBinaryDataset
+    from dnn_tpu.models import cifar
+    from dnn_tpu.node import main as node_main
+    from dnn_tpu.runtime.engine import PipelineEngine
+
+    # a real image file, through the same PIL path a user's photo takes
+    ds = CifarBinaryDataset([test_file])
+    recs = ds.decode([0])
+    img_u8 = ((recs[0][0] * 0.5 + 0.5) * 255).clip(0, 255).astype(np.uint8)
+    img_path = os.path.join(out_dir, "sample.png")
+    Image.fromarray(img_u8).save(img_path)
+
+    cfg = {
+        "nodes": [
+            {"id": "node0", "address": "127.0.0.1:51000", "part_index": 0},
+            {"id": "node1", "address": "127.0.0.1:51001", "part_index": 1},
+        ],
+        "model_weights": npz_path,
+        "num_parts": 2,
+        "return_to_node_id": "node0",
+    }
+    cfg_path = os.path.join(out_dir, "config.json")
+    with open(cfg_path, "w") as f:
+        json.dump(cfg, f, indent=2)
+
+    rc = node_main(["--node_id", "node0", "--config", cfg_path,
+                    "--input_image", img_path])
+    assert rc == 0, "pipeline CLI failed"
+
+    # cross-check: same image through the un-partitioned model
+    engine = PipelineEngine(TopologyConfig.from_json(cfg_path))
+    from dnn_tpu.io.preprocess import load_image_or_dummy
+
+    x, used_dummy = load_image_or_dummy(img_path)
+    assert not used_dummy
+    direct = int(np.argmax(np.asarray(cifar.apply(engine.params, x))))
+    pipeline_pred = engine.predict(x)
+    assert pipeline_pred == direct, (pipeline_pred, direct)
+    return pipeline_pred
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    p.add_argument("--data-dir", default=None,
+                   help="directory with real CIFAR-10 binaries (optional)")
+    p.add_argument("--out-dir", default="/tmp/cifar_run")
+    p.add_argument("--steps", type=int, default=300)
+    p.add_argument("--batch-size", type=int, default=128)
+    p.add_argument("--lr", type=float, default=1e-3)
+    args = p.parse_args(argv)
+
+    import jax
+
+    print(f"[1/4] data ({'real' if args.data_dir else 'synthesized'}), "
+          f"backend={jax.default_backend()}")
+    train_files, test_file = ensure_data(args.data_dir, args.out_dir)
+
+    print(f"[2/4] training {args.steps} steps...")
+    params, loss = train(train_files, steps=args.steps,
+                         batch_size=args.batch_size, lr=args.lr)
+    acc = evaluate(params, test_file)
+    print(f"      final loss {loss:.4f}, test accuracy {acc:.1%} "
+          f"(chance = 10.0%)")
+
+    print("[3/4] exporting checkpoints...")
+    npz_path, pth_path = export(params, args.out_dir)
+    print(f"      native: {npz_path}\n      torch  : {pth_path} "
+          "(loadable by an unmodified reference node)")
+
+    print("[4/4] serving through the 2-stage pipeline CLI...")
+    pred = serve_and_check(npz_path, args.out_dir, test_file)
+    print(f"      pipeline prediction for sample.png: class {pred} "
+          "(matches single-program forward)")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,55 @@
+"""CI-sized run of the flagship train-and-serve example
+(examples/train_cifar_serve.py): the complete reference demo loop — train,
+evaluate, export (native + torch .pth), serve through the pipeline CLI on a
+real PNG — at a step count small enough for the CPU mesh."""
+
+import os
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "examples"))
+
+import train_cifar_serve as ex  # noqa: E402
+
+
+def test_synth_dataset_is_learnable_and_deterministic():
+    xa, ya = ex.synth_cifar(64, seed=3)
+    xb, yb = ex.synth_cifar(64, seed=3)
+    np.testing.assert_array_equal(xa, xb)
+    np.testing.assert_array_equal(ya, yb)
+    # different split, same class structure: templates must be shared
+    xt, yt = ex.synth_cifar(64, seed=4)
+    assert not np.array_equal(ya, yt)
+    cls_a = xa[ya == ya[0]].mean(axis=0)
+    cls_t = xt[yt == ya[0]].mean(axis=0)
+    assert np.abs(cls_a.astype(float) - cls_t.astype(float)).mean() < 25.0
+
+
+def test_end_to_end_mini(tmp_path):
+    out_dir = str(tmp_path)
+    train_files, test_file = ex.ensure_data(None, out_dir, n_train=512, n_test=128)
+    params, loss = ex.train(train_files, steps=60, batch_size=64, log_every=0)
+    assert np.isfinite(loss)
+    acc = ex.evaluate(params, test_file)
+    assert acc > 0.5, f"mini training should clear chance by far, got {acc:.1%}"
+
+    npz_path, pth_path = ex.export(params, out_dir)
+    assert os.path.exists(npz_path) and os.path.exists(pth_path)
+
+    pred = ex.serve_and_check(npz_path, out_dir, test_file)
+    assert 0 <= pred < 10
+
+
+def test_exported_pth_loads_in_torch(tmp_path):
+    torch = pytest.importorskip("torch")
+    train_files, test_file = ex.ensure_data(None, str(tmp_path), n_train=256, n_test=64)
+    params, _ = ex.train(train_files, steps=10, batch_size=64, log_every=0)
+    _, pth_path = ex.export(params, str(tmp_path))
+    sd = torch.load(pth_path, map_location="cpu", weights_only=True)
+    assert set(sd) == {
+        "conv1.weight", "conv1.bias", "conv2.weight", "conv2.bias",
+        "fc1.weight", "fc1.bias", "fc2.weight", "fc2.bias",
+    }
+    assert sd["fc1.weight"].shape == (512, 4096)
