@@ -15,7 +15,11 @@ Extended keys (all optional, with reference-equivalent defaults):
   runtime:         "spmd" (shard_map+ppermute pipeline) | "relay"
                    (device-per-stage sequential relay, the reference's
                    semantics) | "auto"
-  microbatches:    GPipe-style microbatching factor for the spmd runtime
+  microbatches:    GPipe-style microbatching factor for the spmd runtime;
+                   0 (the default) = auto — the engine picks the largest
+                   divisor of the batch up to 2*num_parts, so out of the
+                   box the pipeline actually overlaps stages instead of
+                   degenerating to a serial relay with a (S-1)/(S) bubble
   dtype:           compute dtype ("float32" | "bfloat16")
   mesh:            {axis_name: size} overrides for multi-axis runs
   distributed:     {coordinator_address, num_processes, process_id?} — join
@@ -70,7 +74,7 @@ class TopologyConfig:
     model: str = "cifar_cnn"
     device_type: str = "tpu"
     runtime: str = "auto"
-    microbatches: int = 1
+    microbatches: int = 0  # 0 = auto (see engine._effective_microbatches)
     dtype: str = "float32"
     mesh: Dict[str, int] = dataclasses.field(default_factory=dict)
     distributed: Optional["DistributedConfig"] = None  # multihost job spec
@@ -95,7 +99,7 @@ class TopologyConfig:
             model=d.get("model", "cifar_cnn"),
             device_type=d.get("device_type", "tpu"),
             runtime=d.get("runtime", "auto"),
-            microbatches=int(d.get("microbatches", 1)),
+            microbatches=int(d.get("microbatches", 0)),
             dtype=d.get("dtype", "float32"),
             mesh=dict(d.get("mesh", {})),
             distributed=_parse_distributed(d.get("distributed")),
@@ -128,8 +132,8 @@ class TopologyConfig:
                 )
         if self.runtime not in ("auto", "spmd", "relay"):
             raise ValueError(f"runtime must be auto|spmd|relay, got '{self.runtime}'")
-        if self.microbatches < 1:
-            raise ValueError("microbatches must be >= 1")
+        if self.microbatches < 0:
+            raise ValueError("microbatches must be >= 0 (0 = auto)")
 
     # ---- lookups (reference: node.py:234-277) ----------------------------
 

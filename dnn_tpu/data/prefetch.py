@@ -37,6 +37,9 @@ def prefetch_to_device(
     `jax.device_put`'s normal rules if leaves differ); without it, leaves
     go to the default device.
     """
+    # validate eagerly (this is a plain function returning a generator, so
+    # a bad `size` fails at the call site, not at the first next() deep
+    # inside some training loop)
     if size < 1:
         raise ValueError(f"prefetch size must be >= 1, got {size}")
 
@@ -45,17 +48,20 @@ def prefetch_to_device(
             return jax.device_put(batch, sharding)
         return jax.device_put(batch)
 
-    queue: collections.deque = collections.deque()
-    it = iter(iterator)
-    try:
-        while len(queue) < size:
-            queue.append(_put(next(it)))
-    except StopIteration:
-        pass
-    while queue:
-        out = queue.popleft()
+    def _gen():
+        queue: collections.deque = collections.deque()
+        it = iter(iterator)
         try:
-            queue.append(_put(next(it)))
+            while len(queue) < size:
+                queue.append(_put(next(it)))
         except StopIteration:
             pass
-        yield out
+        while queue:
+            out = queue.popleft()
+            try:
+                queue.append(_put(next(it)))
+            except StopIteration:
+                pass
+            yield out
+
+    return _gen()

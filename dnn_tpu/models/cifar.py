@@ -16,7 +16,10 @@ and its 2-way split (`ModelPart0_2Node` = convs + flatten,
     boundaries (the reference hard-codes exactly 2 — node.py:246-248);
   * the flatten at the conv/fc boundary emits the reference's (C, H, W)
     order (see _seg_conv2), so the 2-way split's wire activation and the
-    fc1 weight layout are interchangeable with a reference node's.
+    fc1 weight layout are interchangeable with a reference node's. NOTE:
+    this fixes the native fc1 layout too — a native .npz saved by the
+    earlier (H, W, C)-flatten revision would load without error but
+    mispredict; no such artifact was ever shipped.
 
 Param pytree layout (keys are the stage-sliceable unit, mirroring the
 reference's per-layer state-dict keys conv1/conv2/fc1/fc2):

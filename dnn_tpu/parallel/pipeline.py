@@ -353,6 +353,166 @@ def spmd_pipeline(
     return y
 
 
+def spmd_pipeline_train_1f1b(
+    block_fn: Callable,
+    embed_fn: Callable,
+    head_loss_fn: Callable,
+    stacked_params,
+    aux_params,
+    ids_mb,
+    tgt_mb,
+    *,
+    mesh: Mesh,
+    axis_name: str = STAGE_AXIS,
+):
+    """Fused 1F1B pipeline-parallel loss+grad (one fwd + one bwd per
+    microbatch, interleaved).
+
+    GPipe + `jax.grad` (make_pipeline_train_step) keeps every microbatch's
+    stage activations alive between the forward and backward sweeps — peak
+    live activations grow O(M). 1F1B starts each microbatch's backward as
+    soon as the last stage finishes its forward, so a stage frees its
+    stashed activation after at most one ring traversal: the stash here is
+    a static ring of K = min(M, 2S-1) slots per device, independent of M.
+
+    Schedule (step t, device d, S stages, M microbatches):
+      forward of microbatch m runs at t = m + d;
+      backward of microbatch m runs at t = 2(S-1) - d + m + 1
+    so the last stage's backward trails its forward by one step, gradients
+    ride a reverse ppermute ring one hop per step, and the whole loop is
+    M + 2S - 1 lockstep scan iterations.
+
+    Memory-for-compute trade vs GPipe, made explicit: embed is folded into
+    stage 0 and head+loss into the last stage (nothing M-sized outlives
+    the loop — embed grads come from re-linearizing embed_fn at stage 0's
+    backward, head grads from the last stage's), but SPMD lockstep means
+    every device evaluates both the mid-stage and the last-stage vjp forms
+    each step and selects — the head+loss vjp runs S times oftener than
+    mathematically needed. Right when activations dominate (long sequence,
+    many microbatches, big models); wrong when the head dominates (tiny
+    model, huge vocab, short sequences).
+
+    Args: `stacked_params` (S, per_stage, ...) sharded P(stage); `aux_params`
+    replicated (embed + head weights); `ids_mb`/`tgt_mb` (M, mb, T) int.
+    `embed_fn(aux, ids) -> x`; `block_fn(local, x) -> y` shape-preserving;
+    `head_loss_fn(aux, h, tgt) -> scalar` (mean over the microbatch's
+    tokens). Returns (loss, d_stacked, d_aux) — loss/grads averaged over
+    microbatches; d_stacked sharded P(stage) like its params.
+    """
+    num_stages = mesh.shape[axis_name]
+    m_count = ids_mb.shape[0]
+    if m_count < 1:
+        raise ValueError("need at least one microbatch")
+    k_slots = min(m_count, 2 * num_stages - 1)
+    steps = m_count + 2 * num_stages - 1
+    fwd_perm = [(i, i + 1) for i in range(num_stages - 1)]
+    bwd_perm = [(i, i - 1) for i in range(1, num_stages)]
+
+    param_specs = jax.tree.map(lambda _: P(axis_name), stacked_params)
+    stacked_params = jax.device_put(
+        stacked_params, NamedSharding(mesh, P(axis_name))
+    )
+    x_shape = jax.eval_shape(embed_fn, aux_params, ids_mb[0])
+
+    def per_device(params, aux, ids, tgt):
+        local = jax.tree.map(lambda p: p[0], params)
+        d = lax.axis_index(axis_name)
+        is_first = d == 0
+        is_last = d == num_stages - 1
+
+        stash = jnp.zeros((k_slots, *x_shape.shape), x_shape.dtype)
+        g_stacked = jax.tree.map(jnp.zeros_like, local)
+        g_aux = jax.tree.map(jnp.zeros_like, aux)
+        loss_acc = jnp.zeros((), jnp.float32)
+        fwd_buf = jnp.zeros(x_shape.shape, x_shape.dtype)
+        bwd_buf = jnp.zeros(x_shape.shape, x_shape.dtype)
+
+        def step(carry, t):
+            stash, g_stacked, g_aux, loss_acc, fwd_buf, bwd_buf = carry
+
+            # ---- backward stash READ first: with K = 2S-1 slots, stage 0's
+            # forward write of microbatch m+K lands in the same slot, same
+            # step, as its backward read of microbatch m — the read must
+            # see the old value (mb m's stash is dead right after) ----
+            m_b = t - (2 * (num_stages - 1) - d + 1)
+            active_b = jnp.logical_and(m_b >= 0, m_b < m_count)
+            mi_b = jnp.clip(m_b, 0, m_count - 1)
+            x_st = lax.dynamic_index_in_dim(stash, mi_b % k_slots, 0, False)
+            ids_b = lax.dynamic_index_in_dim(ids, mi_b, 0, False)
+            tgt_b = lax.dynamic_index_in_dim(tgt, mi_b, 0, False)
+
+            # ---- forward wave: microbatch m_f = t - d ----
+            m_f = t - d
+            active_f = jnp.logical_and(m_f >= 0, m_f < m_count)
+            mi_f = jnp.clip(m_f, 0, m_count - 1)
+            x0 = embed_fn(aux, lax.dynamic_index_in_dim(ids, mi_f, 0, False))
+            x_in = jnp.where(is_first, x0.astype(fwd_buf.dtype), fwd_buf)
+            slot_f = mi_f % k_slots
+            stash = jnp.where(
+                active_f,
+                lax.dynamic_update_index_in_dim(stash, x_in, slot_f, 0),
+                stash,
+            )
+            y = block_fn(local, x_in)
+            fwd_next = lax.ppermute(y.astype(fwd_buf.dtype), axis_name, fwd_perm)
+
+            # ---- backward wave: microbatch m_b (read above) ----
+
+            # last stage: d(loss_mb)/d(local, aux, x) seeded by the loss
+            lval, vjp_last = jax.vjp(
+                lambda lp, ax, xx: head_loss_fn(ax, block_fn(lp, xx), tgt_b),
+                local, aux, x_st,
+            )
+            dp_l, daux_l, dx_l = vjp_last(jnp.ones((), lval.dtype))
+            # mid/first stage: d(block)/d(local, x) seeded by the grad hop
+            _, vjp_mid = jax.vjp(lambda lp, xx: block_fn(lp, xx), local, x_st)
+            dp_m, dx_m = vjp_mid(bwd_buf.astype(x_shape.dtype))
+
+            dp = jax.tree.map(lambda a, b: jnp.where(is_last, a, b), dp_l, dp_m)
+            dx = jnp.where(is_last, dx_l, dx_m)
+            # stage 0 additionally backprops its dx through embed
+            _, vjp_emb = jax.vjp(lambda ax: embed_fn(ax, ids_b), aux)
+            (daux_e,) = vjp_emb(dx.astype(x_shape.dtype))
+
+            g_stacked = jax.tree.map(
+                lambda g, u: g + jnp.where(active_b, u, jnp.zeros_like(u)),
+                g_stacked, dp,
+            )
+            g_aux = jax.tree.map(
+                lambda g, ul, ue: g
+                + jnp.where(jnp.logical_and(active_b, is_last), ul, jnp.zeros_like(ul))
+                + jnp.where(jnp.logical_and(active_b, is_first), ue, jnp.zeros_like(ue)),
+                g_aux, daux_l, daux_e,
+            )
+            loss_acc = loss_acc + jnp.where(
+                jnp.logical_and(active_b, is_last), lval.astype(jnp.float32), 0.0
+            )
+            bwd_next = lax.ppermute(dx.astype(bwd_buf.dtype), axis_name, bwd_perm)
+
+            return (stash, g_stacked, g_aux, loss_acc, fwd_next, bwd_next), None
+
+        (_, g_stacked, g_aux, loss_acc, _, _), _ = lax.scan(
+            step,
+            (stash, g_stacked, g_aux, loss_acc, fwd_buf, bwd_buf),
+            jnp.arange(steps),
+        )
+        inv_m = 1.0 / m_count
+        # aux grads and loss live on single stages; psum replicates them.
+        # stacked grads stay per-stage (sharded like their params).
+        g_aux = jax.tree.map(lambda g: lax.psum(g * inv_m, axis_name), g_aux)
+        loss = lax.psum(loss_acc * inv_m, axis_name)
+        g_stacked = jax.tree.map(lambda g: (g * inv_m)[None], g_stacked)
+        return loss, g_stacked, g_aux
+
+    return jax.shard_map(
+        per_device,
+        mesh=mesh,
+        in_specs=(param_specs, P(), P(), P()),
+        out_specs=(P(), param_specs, P()),
+        check_vma=False,
+    )(stacked_params, aux_params, ids_mb, tgt_mb)
+
+
 def spmd_pipeline_stacked(
     block_fn: Callable,
     stacked_params,

@@ -199,6 +199,22 @@ class PipelineEngine:
     # compiled pipeline callables
     # ------------------------------------------------------------------
 
+    def _effective_microbatches(self, batch: int) -> int:
+        """Resolve the config's microbatch setting for a concrete batch.
+        Explicit values pass through; 0 (auto) picks the largest divisor of
+        the batch up to 2*num_parts — enough microbatches that the GPipe
+        bubble fraction (S-1)/(M+S-1) drops to ~1/3, without a remainder
+        microbatch. A batch of 1 degenerates to 1 (the reference's whole
+        operating regime, node.py:147)."""
+        m = self.config.microbatches
+        if m != 0:
+            return m
+        desired = max(2 * self.config.num_parts, 1)
+        for cand in range(min(desired, batch), 0, -1):
+            if batch % cand == 0:
+                return cand
+        return 1
+
     def _gpt_stacked_ready(self) -> bool:
         """GPT-family fast path: uniform block stacks sharded one-stage-per-
         device, embed/head outside the ring. Needs equal blocks per stage."""
@@ -216,21 +232,21 @@ class PipelineEngine:
             return self._build_gpt_stacked_fn()
 
         stage_applies = [s.apply for s in self.stages]
-        mesh, microbatches = self.mesh, self.config.microbatches
+        mesh = self.mesh
 
-        def run_pipeline(stage_params, x):
+        def run_pipeline(stage_params, x, microbatches):
             return spmd_pipeline(
                 stage_applies, stage_params, x,
                 mesh=mesh, num_microbatches=microbatches, axis_name=STAGE_AXIS,
             )
 
-        fn = jax.jit(run_pipeline)
+        fn = jax.jit(run_pipeline, static_argnums=2)
         # replicate the (heterogeneous-stage) params onto the mesh once —
         # plain numpy args would re-transfer host->device every call
         sp = jax.device_put(
             tuple(self._stage_params), NamedSharding(mesh, P())
         )
-        return lambda x: fn(sp, x)
+        return lambda x: fn(sp, x, self._effective_microbatches(x.shape[0]))
 
     def _build_gpt_stacked_fn(self):
         from dnn_tpu.models import gpt
@@ -238,7 +254,7 @@ class PipelineEngine:
         from dnn_tpu.runtime.generate import prepare_pipeline_stacked
 
         cfg = self.spec.config
-        mesh, microbatches = self.mesh, self.config.microbatches
+        mesh = self.mesh
         compute_dtype = self.compute_dtype
 
         # One-time, load-side: stack blocks stage-major (S, per_stage, ...)
@@ -257,7 +273,7 @@ class PipelineEngine:
                 stage_blocks, h, cfg=cfg, compute_dtype=compute_dtype
             )
 
-        def run_pipeline(stacked, aux_params, ids):
+        def run_pipeline(stacked, aux_params, ids, microbatches):
             x = gpt.embed(aux_params, ids, cfg=cfg)
             if compute_dtype is not None:
                 x = x.astype(compute_dtype)
@@ -267,8 +283,10 @@ class PipelineEngine:
             )
             return gpt.head(aux_params, h.astype(jnp.float32), cfg=cfg)
 
-        fn = jax.jit(run_pipeline)
-        return lambda ids: fn(stage_major, aux, ids)
+        fn = jax.jit(run_pipeline, static_argnums=3)
+        return lambda ids: fn(
+            stage_major, aux, ids, self._effective_microbatches(ids.shape[0])
+        )
 
     # ------------------------------------------------------------------
 

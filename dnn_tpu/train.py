@@ -29,7 +29,11 @@ import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from dnn_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS, STAGE_AXIS
-from dnn_tpu.parallel.pipeline import spmd_pipeline_stacked
+from dnn_tpu.parallel.pipeline import (
+    spmd_pipeline_stacked,
+    spmd_pipeline_train_1f1b,
+    split_microbatches,
+)
 
 
 # --------------------------------------------------------------------------
@@ -293,34 +297,62 @@ def make_pipeline_train_step(
     num_microbatches: int = 1,
     axis_name: str = STAGE_AXIS,
     loss: Callable = cross_entropy,
+    schedule: str = "gpipe",
 ):
     """Pipeline-parallel LM training step.
 
     `stacked` block params live sharded P(stage) (each device holds its
     stage's blocks — same layout the inference engine uses); `aux` holds
-    embed/head params (replicated). Backward simply differentiates through
-    the GPipe loop: the reverse of each ppermute hop is a ppermute in the
-    opposite direction on the same ring.
+    embed/head params (replicated).
+
+    `schedule="gpipe"`: forward through the microbatched GPipe loop, then
+    differentiate straight through it — the reverse of each ppermute hop is
+    a ppermute in the opposite direction on the same ring. Autodiff keeps
+    every microbatch's stage activations as residuals, so peak activation
+    memory grows with num_microbatches.
+
+    `schedule="1f1b"`: the fused one-forward-one-backward loop
+    (spmd_pipeline_train_1f1b) — each microbatch's backward starts as soon
+    as the last stage finishes its forward, bounding stashed activations
+    at min(M, 2S-1) slots per device regardless of M. Same loss and
+    gradients (parity-tested); choose it when activations dominate memory.
 
     step(stacked, aux, opt_states, tokens) ->
         (stacked, aux, opt_states, loss_value)
     """
-    def loss_fn(stacked, aux, tokens):
-        x = embed_fn(aux, tokens[:, :-1])
-        h = spmd_pipeline_stacked(
-            block_fn, stacked, x,
-            mesh=mesh, num_microbatches=num_microbatches, axis_name=axis_name,
+    if schedule not in ("gpipe", "1f1b"):
+        raise ValueError(f"schedule must be gpipe|1f1b, got {schedule!r}")
+
+    def gpipe_loss_and_grad(stacked, aux, tokens):
+        def loss_fn(stacked, aux):
+            x = embed_fn(aux, tokens[:, :-1])
+            h = spmd_pipeline_stacked(
+                block_fn, stacked, x,
+                mesh=mesh, num_microbatches=num_microbatches,
+                axis_name=axis_name,
+            )
+            logits = head_fn(aux, h)
+            return loss(logits, tokens[:, 1:])
+
+        return jax.value_and_grad(loss_fn, argnums=(0, 1))(stacked, aux)
+
+    def f1b_loss_and_grad(stacked, aux, tokens):
+        ids_mb = split_microbatches(tokens[:, :-1], num_microbatches)
+        tgt_mb = split_microbatches(tokens[:, 1:], num_microbatches)
+        lval, g_st, g_aux = spmd_pipeline_train_1f1b(
+            block_fn, embed_fn,
+            lambda ax, h, tgt: loss(head_fn(ax, h), tgt),
+            stacked, aux, ids_mb, tgt_mb,
+            mesh=mesh, axis_name=axis_name,
         )
-        logits = head_fn(aux, h)
-        return loss(logits, tokens[:, 1:])
+        return lval, (g_st, g_aux)
+
+    loss_and_grad = gpipe_loss_and_grad if schedule == "gpipe" else f1b_loss_and_grad
 
     @jax.jit
     def step(stacked, aux, opt_states, tokens):
         st_opt, aux_opt = opt_states
-        lval, grads = jax.value_and_grad(
-            lambda s, a: loss_fn(s, a, tokens), argnums=(0, 1)
-        )(stacked, aux)
-        g_st, g_aux = grads
+        lval, (g_st, g_aux) = loss_and_grad(stacked, aux, tokens)
         up_st, st_opt = optimizer.update(g_st, st_opt, stacked)
         stacked = optax.apply_updates(stacked, up_st)
         up_aux, aux_opt = optimizer.update(g_aux, aux_opt, aux)

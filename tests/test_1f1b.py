@@ -1,0 +1,170 @@
+"""1F1B pipeline-training schedule + auto microbatching.
+
+Round-1 weak spot #5: GPipe only, and microbatches defaulted to 1 — the
+out-of-the-box spmd runtime was semantically a serial relay with a
+(S-1)/(M+S-1) bubble. Now make_pipeline_train_step(schedule="1f1b") runs
+the fused one-forward-one-backward loop (activation stash bounded at
+min(M, 2S-1) slots, not M), and the engine auto-picks microbatches > 1."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from dnn_tpu import train
+from dnn_tpu.models import gpt
+from dnn_tpu.parallel.mesh import STAGE_AXIS, make_mesh
+from dnn_tpu.parallel.pipeline import split_microbatches, spmd_pipeline_train_1f1b
+
+CFG = gpt.PRESETS["gpt2-test"]
+
+
+def _setup(num_stages, seed=0):
+    mesh = make_mesh({STAGE_AXIS: num_stages}, jax.devices()[:num_stages])
+    params = gpt.init(jax.random.PRNGKey(seed), CFG)
+    prepared = gpt.prepare_stacked(params, CFG)
+    per = CFG.n_layer // num_stages
+    stacked = jax.tree.map(
+        lambda p: p.reshape(num_stages, per, *p.shape[1:]), prepared["blocks"]
+    )
+    aux = {k: v for k, v in params.items() if not k.startswith("h_")}
+    return mesh, stacked, aux
+
+
+def _fns():
+    return (
+        lambda bp, h: gpt.blocks_scan(bp, h, cfg=CFG),
+        lambda a, ids: gpt.embed(a, ids, cfg=CFG),
+        lambda a, h: gpt.head(a, h.astype(jnp.float32), cfg=CFG),
+    )
+
+
+@pytest.mark.parametrize("num_stages,microbatches", [(2, 4), (4, 8), (4, 2)])
+def test_1f1b_grads_match_single_device(num_stages, microbatches):
+    mesh, stacked, aux = _setup(num_stages)
+    block_fn, embed_fn, head_fn = _fns()
+    tokens = jax.random.randint(
+        jax.random.PRNGKey(1), (microbatches, 17), 0, CFG.vocab_size, jnp.int32
+    )
+
+    def sd_loss(stacked, aux):
+        flat = jax.tree.map(lambda p: p.reshape(CFG.n_layer, *p.shape[2:]), stacked)
+        h = gpt.blocks_scan(flat, embed_fn(aux, tokens[:, :-1]), cfg=CFG)
+        return train.cross_entropy(head_fn(aux, h), tokens[:, 1:])
+
+    l_sd, (g_st_sd, g_aux_sd) = jax.value_and_grad(sd_loss, argnums=(0, 1))(
+        stacked, aux
+    )
+
+    l_fb, g_st_fb, g_aux_fb = spmd_pipeline_train_1f1b(
+        block_fn, embed_fn,
+        lambda ax, h, t: train.cross_entropy(head_fn(ax, h), t),
+        stacked, aux,
+        split_microbatches(tokens[:, :-1], microbatches),
+        split_microbatches(tokens[:, 1:], microbatches),
+        mesh=mesh,
+    )
+    np.testing.assert_allclose(float(l_fb), float(l_sd), rtol=1e-6)
+    for a, b in zip(jax.tree.leaves(g_st_fb), jax.tree.leaves(g_st_sd)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-6, rtol=1e-4)
+    for a, b in zip(jax.tree.leaves(g_aux_fb), jax.tree.leaves(g_aux_sd)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-6, rtol=1e-4)
+
+
+def test_1f1b_train_step_parity_with_gpipe():
+    mesh, stacked, aux = _setup(4)
+    block_fn, embed_fn, head_fn = _fns()
+    opt = optax.sgd(1e-2)
+    tokens = jax.random.randint(jax.random.PRNGKey(2), (8, 17), 0, CFG.vocab_size,
+                                jnp.int32)
+    outs = {}
+    for sched in ("gpipe", "1f1b"):
+        step = train.make_pipeline_train_step(
+            block_fn, embed_fn, head_fn, opt, mesh,
+            num_microbatches=8, schedule=sched,
+        )
+        st, ax, _, loss = step(
+            stacked, aux, (opt.init(stacked), opt.init(aux)), tokens
+        )
+        outs[sched] = (float(loss), st, ax)
+    assert outs["gpipe"][0] == pytest.approx(outs["1f1b"][0], rel=1e-6)
+    for a, b in zip(jax.tree.leaves(outs["gpipe"][1]), jax.tree.leaves(outs["1f1b"][1])):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-7, rtol=1e-5)
+    for a, b in zip(jax.tree.leaves(outs["gpipe"][2]), jax.tree.leaves(outs["1f1b"][2])):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-7, rtol=1e-5)
+
+
+def test_1f1b_uses_less_activation_memory_than_gpipe():
+    """The schedule's point: at M >> S, compiled temp memory (which holds
+    the live activations) must be well below GPipe's."""
+    cfg = gpt.GPTConfig(block_size=128, vocab_size=128, n_layer=2, n_head=2,
+                        n_embd=64)
+    S, M = 2, 16
+    mesh = make_mesh({STAGE_AXIS: S}, jax.devices()[:S])
+    params = gpt.init(jax.random.PRNGKey(0), cfg)
+    prepared = gpt.prepare_stacked(params, cfg)
+    stacked = jax.tree.map(lambda p: p.reshape(S, 1, *p.shape[1:]),
+                           prepared["blocks"])
+    aux = {k: v for k, v in params.items() if not k.startswith("h_")}
+    opt = optax.sgd(1e-2)
+    tokens = jnp.zeros((16, 129), jnp.int32)
+
+    temp = {}
+    for sched in ("gpipe", "1f1b"):
+        step = train.make_pipeline_train_step(
+            lambda bp, h: gpt.blocks_scan(bp, h, cfg=cfg),
+            lambda a, ids: gpt.embed(a, ids, cfg=cfg),
+            lambda a, h: gpt.head(a, h.astype(jnp.float32), cfg=cfg),
+            opt, mesh, num_microbatches=M, schedule=sched,
+        )
+        ma = step.lower(
+            stacked, aux, (opt.init(stacked), opt.init(aux)), tokens
+        ).compile().memory_analysis()
+        if ma is None or not hasattr(ma, "temp_size_in_bytes"):
+            pytest.skip("backend does not report memory analysis")
+        temp[sched] = ma.temp_size_in_bytes
+    assert temp["1f1b"] < temp["gpipe"] / 3, temp
+
+
+def test_make_pipeline_train_step_rejects_bad_schedule():
+    mesh, _, _ = _setup(2)
+    with pytest.raises(ValueError, match="schedule"):
+        train.make_pipeline_train_step(*_fns(), optax.sgd(1e-2), mesh,
+                                       schedule="pipedream")
+
+
+def test_engine_auto_microbatches():
+    from dnn_tpu.config import TopologyConfig
+    from dnn_tpu.runtime.engine import PipelineEngine
+
+    cfg = TopologyConfig.from_dict({
+        "nodes": [{"id": f"n{i}", "part_index": i} for i in range(4)],
+        "num_parts": 4,
+        "model": "gpt2-test",
+        "device_type": "cpu",
+        "runtime": "spmd",
+    })
+    assert cfg.microbatches == 0  # default is now auto
+    eng = PipelineEngine(cfg, rng_seed=0)
+    # batch 8, 4 parts -> auto picks 2*parts = 8 microbatches
+    assert eng._effective_microbatches(8) == 8
+    assert eng._effective_microbatches(6) == 6
+    assert eng._effective_microbatches(7) == 7  # divisor of 7 <= 8
+    assert eng._effective_microbatches(1) == 1
+    assert eng._effective_microbatches(32) == 8  # capped at 2*parts
+    # explicit config value passes through untouched
+    cfg2 = TopologyConfig.from_dict({
+        "nodes": [{"id": f"n{i}", "part_index": i} for i in range(4)],
+        "num_parts": 4, "model": "gpt2-test", "device_type": "cpu",
+        "runtime": "spmd", "microbatches": 2,
+    })
+    assert PipelineEngine(cfg2, rng_seed=0)._effective_microbatches(8) == 2
+
+    # and the auto path must still match the full model numerically
+    ids = eng.spec.example_input(batch_size=8, seq_len=16)
+    np.testing.assert_allclose(
+        np.asarray(eng.run(ids)),
+        np.asarray(eng.spec.apply(eng.params, ids)),
+        atol=1e-4, rtol=1e-4,
+    )

@@ -55,7 +55,7 @@ def test_arbitrary_num_parts_allowed():
         (lambda d: d["nodes"][1].update(id="node1"), "duplicate"),
         (lambda d: d.update(return_to_node_id="ghost"), "not among"),
         (lambda d: d.update(runtime="mpi"), "runtime"),
-        (lambda d: d.update(microbatches=0), "microbatches"),
+        (lambda d: d.update(microbatches=-1), "microbatches"),
     ],
 )
 def test_validation_errors(mutate, match):
