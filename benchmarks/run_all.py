@@ -49,10 +49,17 @@ def run_device_section():
 
     from dnn_tpu.models import gpt
     from dnn_tpu.registry import get_model
+    from dnn_tpu.utils.flops import cifar_forward_flops, gpt_forward_flops, mfu
     from dnn_tpu.utils.timing import device_time
 
     platform = jax.default_backend()
     results = []
+
+    def _with_mfu(row, flops_per_item, items_per_sec):
+        m = mfu(flops_per_item, items_per_sec)
+        if m is not None:
+            row["mfu"] = round(m, 4)
+        return row
 
     # config 1 (full-model form): CIFAR CNN forward
     spec = get_model("cifar_cnn")
@@ -64,7 +71,8 @@ def run_device_section():
     # slope drowns in sync jitter
     dt = device_time(fn, params, x, n1=20, n2=100, trials=5)
     _emit(results, config="cifar_cnn_fwd", metric="images_per_sec",
-          value=round(batch / dt, 1), platform=platform, batch=batch)
+          value=round(batch / dt, 1), platform=platform, batch=batch,
+          **_with_mfu({}, cifar_forward_flops(1), batch / dt))
 
     # config 4/5 (full-model form): GPT-2 small + medium forward, bf16
     for preset, b, s in (("gpt2", 8, 512), ("gpt2-medium", 4, 512)):
@@ -75,8 +83,10 @@ def run_device_section():
         ids = jax.random.randint(jax.random.PRNGKey(1), (b, s), 0,
                                  cfg.vocab_size, dtype=jnp.int32)
         dt = device_time(fn, prepared, ids)
+        tps = b * s / dt
         _emit(results, config=f"{preset}_fwd", metric="tokens_per_sec",
-              value=round(b * s / dt, 1), platform=platform, batch=b, seq=s)
+              value=round(tps, 1), platform=platform, batch=b, seq=s,
+              **_with_mfu({}, gpt_forward_flops(cfg, b, s) / (b * s), tps))
 
     # KV-cache generation throughput (the serving path the reference lacks)
     from dnn_tpu.runtime import generate as gen
@@ -225,16 +235,17 @@ def write_results_md(rows, path):
         "8 virtual CPU devices (no multi-chip TPU in this environment) — they",
         "validate the parallel path; absolute values are CPU-bound.",
         "",
-        "| config | metric | value | platform | details |",
-        "|---|---|---|---|---|",
+        "| config | metric | value | mfu | platform | details |",
+        "|---|---|---|---|---|---|",
     ]
     for r in rows:
         details = ", ".join(
             f"{k}={v}" for k, v in r.items()
-            if k not in ("config", "metric", "value", "platform")
+            if k not in ("config", "metric", "value", "platform", "mfu")
         )
+        mfu_cell = f"{r['mfu']:.1%}" if "mfu" in r else "—"
         lines.append(
-            f"| {r['config']} | {r['metric']} | {r['value']} | "
+            f"| {r['config']} | {r['metric']} | {r['value']} | {mfu_cell} | "
             f"{r['platform']} | {details} |"
         )
     with open(path, "w") as f:

@@ -204,6 +204,51 @@ def _unpad(buf, shape, dtype, buf_dtype=jnp.float32):
     return flat.reshape(mb, *shape[1:]).astype(dtype)
 
 
+def pack_stage_params(stage_params):
+    """Heterogeneous per-stage param pytrees -> one (S, W) f32 array (each
+    stage's leaves flattened, concatenated, zero-padded to the widest
+    stage) + per-stage unpack metadata. Sharded P(stage), this is what
+    lets `spmd_pipeline` place each stage's weights on its own device:
+    lax.switch executes only the selected branch (XLA Case), but branch
+    OPERANDS must exist on every device — packing turns "operand = all
+    stages' params, replicated" into "operand = my (1, W) shard".
+
+    bf16/f16 leaves ride the f32 carrier losslessly (value upcast);
+    integer leaves are rejected (params are float in every shipped family,
+    and silent bitcast here would be invisible to readers of the packed
+    array)."""
+    flats, metas = [], []
+    for p in stage_params:
+        leaves, treedef = jax.tree.flatten(p)
+        vecs, leafmeta = [], []
+        for leaf in leaves:
+            arr = jnp.asarray(leaf)
+            if not jnp.issubdtype(arr.dtype, jnp.floating):
+                raise ValueError(
+                    f"pack_stage_params supports float leaves only, got {arr.dtype}"
+                )
+            vecs.append(arr.astype(jnp.float32).reshape(-1))
+            leafmeta.append((arr.shape, arr.dtype))
+        flat = jnp.concatenate(vecs) if vecs else jnp.zeros((0,), jnp.float32)
+        flats.append(flat)
+        metas.append((treedef, leafmeta))
+    width = max((f.shape[0] for f in flats), default=1) or 1
+    packed = jnp.stack([jnp.pad(f, (0, width - f.shape[0])) for f in flats])
+    return packed, metas
+
+
+def _unpack_stage(vec, meta):
+    """(W,) packed vector -> the stage's param pytree (inverse of one row
+    of pack_stage_params)."""
+    treedef, leafmeta = meta
+    leaves, off = [], 0
+    for shape, dtype in leafmeta:
+        n = _flat_size(shape)
+        leaves.append(lax.slice(vec, (off,), (off + n,)).reshape(shape).astype(dtype))
+        off += n
+    return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
 def _stage_shapes(stage_fns, stage_params, x_shape_dtype):
     """Trace per-stage input/output shapes (static — the reference discovers
     them at runtime from the wire header, node_service.proto:28-29)."""
@@ -271,6 +316,8 @@ def spmd_pipeline(
     mesh: Mesh,
     num_microbatches: int = 1,
     axis_name: str = STAGE_AXIS,
+    param_placement: str = "stage",
+    packed=None,
 ):
     """Heterogeneous-stage SPMD pipeline.
 
@@ -282,12 +329,15 @@ def spmd_pipeline(
     integer payloads bitcast in — exact over the whole int32 range — when
     mixed.
 
-    Memory note: because `lax.switch` branches embed every stage's params,
-    this path replicates all weights on all devices — right for small or
-    awkwardly heterogeneous models (the CIFAR CNN). Deep homogeneous models
-    should pipeline their block stack through `spmd_pipeline_stacked`
-    (per-stage HBM-resident weights) and keep embed/head outside, as
-    PipelineEngine does for the GPT family.
+    `param_placement="stage"` (default): stage params are packed into one
+    (S, W) array sharded over the stage axis (pack_stage_params), so each
+    device's HBM holds only its own stage's weights (padded to the widest
+    stage) — the per-stage-HBM north star, now for heterogeneous models
+    too. Long-lived callers (the engine) should pack ONCE at load time and
+    pass `packed=(packed_array, metas)`; otherwise the pack runs inside
+    this call. `"replicated"` keeps the round-1 behavior (all weights on
+    all devices, no pack/unpack work in the branches): right for models
+    whose params are smaller than their activations.
 
     Returns the final stage's output with microbatches re-merged.
     """
@@ -296,6 +346,10 @@ def spmd_pipeline(
         raise ValueError(
             f"mesh axis '{axis_name}' has size {mesh.shape[axis_name]}, "
             f"need {num_stages} (one device per stage)"
+        )
+    if param_placement not in ("stage", "replicated"):
+        raise ValueError(
+            f"param_placement must be stage|replicated, got {param_placement!r}"
         )
 
     x_mb = split_microbatches(x, num_microbatches)
@@ -314,13 +368,24 @@ def spmd_pipeline(
         x_mb.reshape(num_microbatches * mb, -1), width_hop, buf_dtype
     ).reshape(num_microbatches, mb, width_hop)
 
+    sharded = param_placement == "stage"
+    if sharded:
+        if packed is None:
+            packed_arr, metas = pack_stage_params(stage_params)
+            packed_arr = jax.device_put(
+                packed_arr, NamedSharding(mesh, P(axis_name))
+            )
+        else:
+            packed_arr, metas = packed
+
     def make_branch(i):
         fn, in_s, in_dt = stage_fns[i], shapes[i].shape, shapes[i].dtype
         is_last = i == num_stages - 1
 
-        def branch(buf):
+        def branch(params_vec, buf):
+            sp = _unpack_stage(params_vec, metas[i]) if sharded else stage_params[i]
             xin = _unpad(buf, (mb, *in_s[1:]) if len(in_s) > 0 else (mb,), in_dt, buf_dtype)
-            y = fn(stage_params[i], xin)
+            y = fn(sp, xin)
             if is_last:
                 return (jnp.zeros((mb, width_hop), buf_dtype),
                         _pad_flat(y, width_out, out_dtype))
@@ -330,11 +395,12 @@ def spmd_pipeline(
 
     branches = [make_branch(i) for i in range(num_stages)]
 
-    def per_device(inputs):
+    def per_device(params_local, inputs):
         d = lax.axis_index(axis_name)
+        vec = params_local[0] if sharded else params_local
 
         def stage_step(buf):
-            return lax.switch(d, branches, buf)
+            return lax.switch(d, branches, vec, buf)
 
         return _gpipe_loop(
             stage_step, inputs, num_stages, num_microbatches, mb,
@@ -342,8 +408,10 @@ def spmd_pipeline(
         )
 
     result = jax.shard_map(
-        per_device, mesh=mesh, in_specs=P(), out_specs=P(), check_vma=False
-    )(inputs_buf)
+        per_device, mesh=mesh,
+        in_specs=(P(axis_name) if sharded else P(), P()),
+        out_specs=P(), check_vma=False,
+    )(packed_arr if sharded else jnp.zeros(()), inputs_buf)
 
     y = _unpad(
         result.reshape(num_microbatches * mb, width_out),

@@ -231,22 +231,33 @@ class PipelineEngine:
         if self._gpt_stacked_ready():
             return self._build_gpt_stacked_fn()
 
+        from dnn_tpu.parallel.pipeline import pack_stage_params
+
         stage_applies = [s.apply for s in self.stages]
         mesh = self.mesh
 
-        def run_pipeline(stage_params, x, microbatches):
+        # pack ONCE at load: each device's HBM holds only its own stage's
+        # packed weight vector (P(stage)), not every stage's params — the
+        # per-stage placement the relay runtime gets for free from explicit
+        # devices, now on the SPMD path too
+        packed_arr, metas = pack_stage_params(self._stage_params)
+        packed_arr = jax.device_put(
+            packed_arr, NamedSharding(mesh, P(STAGE_AXIS))
+        )
+        stage_shapes = [
+            jax.tree.map(lambda l: jax.ShapeDtypeStruct(jnp.shape(l), jnp.asarray(l).dtype), p)
+            for p in self._stage_params
+        ]
+
+        def run_pipeline(packed_in, x, microbatches):
             return spmd_pipeline(
-                stage_applies, stage_params, x,
+                stage_applies, stage_shapes, x,
                 mesh=mesh, num_microbatches=microbatches, axis_name=STAGE_AXIS,
+                packed=(packed_in, metas),
             )
 
         fn = jax.jit(run_pipeline, static_argnums=2)
-        # replicate the (heterogeneous-stage) params onto the mesh once —
-        # plain numpy args would re-transfer host->device every call
-        sp = jax.device_put(
-            tuple(self._stage_params), NamedSharding(mesh, P())
-        )
-        return lambda x: fn(sp, x, self._effective_microbatches(x.shape[0]))
+        return lambda x: fn(packed_arr, x, self._effective_microbatches(x.shape[0]))
 
     def _build_gpt_stacked_fn(self):
         from dnn_tpu.models import gpt

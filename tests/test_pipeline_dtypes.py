@@ -120,9 +120,12 @@ def test_heterogeneous_int_payload_exact_beyond_2p24():
     def stage1(params, ids):  # int -> float
         return ids.astype(jnp.float64).astype(jnp.float32) * params
 
+    # integer stage params -> the packed (float) placement doesn't apply;
+    # replicated placement also exercises the non-packed branch path
     out = spmd_pipeline(
         [stage0, stage1], [jnp.int32(1), jnp.float32(1.0)],
         jnp.asarray(big), mesh=mesh, num_microbatches=2,
+        param_placement="replicated",
     )
     expect = (big.astype(np.int64) + 1).astype(np.float64).astype(np.float32)
     np.testing.assert_array_equal(np.asarray(out), expect)
