@@ -1,0 +1,83 @@
+"""One-off exploration: forward-throughput variants for the headline bench.
+
+Times GPT-2 small (B=8, S=512, bf16) forward variants on the real chip to
+find headroom beyond the current ~52% MFU:
+
+  scan        — shipped path (make_apply_stacked, lax.scan over blocks)
+  unroll{N}   — same but lax.scan unroll=N (cross-layer scheduling freedom)
+  flash       — Pallas flash-attention kernel at S=512
+  bf16head    — lm_head emits bf16 logits (halves the 823 MB f32 logit write)
+
+Not part of the benchmark suite; results inform which variants graduate
+into bench.py / the model factories.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from dnn_tpu.models import gpt
+from dnn_tpu.ops.nn import layer_norm, linear
+from dnn_tpu.utils.flops import gpt_forward_flops, mfu
+from dnn_tpu.utils.timing import device_time
+
+BATCH, SEQ = 8, 512
+BF16 = jnp.bfloat16
+
+
+def main():
+    cfg = gpt.PRESETS["gpt2"]
+    params = gpt.init(jax.random.PRNGKey(0), cfg)
+    prepared = gpt.prepare_stacked(params, cfg)
+    ids = jax.random.randint(
+        jax.random.PRNGKey(1), (BATCH, SEQ), 0, cfg.vocab_size, dtype=jnp.int32
+    )
+
+    def scan_unroll(unroll):
+        def apply(prep, idx):
+            x = gpt.embed(prep, idx, cfg=cfg).astype(BF16)
+
+            def body(carry, layer_params):
+                return gpt.block_apply(
+                    layer_params, carry, cfg=cfg, compute_dtype=BF16
+                ), None
+
+            x, _ = jax.lax.scan(body, x, prep["blocks"], unroll=unroll)
+            return gpt.head(prep, x.astype(jnp.float32), cfg=cfg, compute_dtype=BF16)
+
+        return apply
+
+    def bf16_head(prep, idx):
+        x = gpt.embed(prep, idx, cfg=cfg).astype(BF16)
+
+        def body(carry, layer_params):
+            return gpt.block_apply(layer_params, carry, cfg=cfg, compute_dtype=BF16), None
+
+        x, _ = jax.lax.scan(body, x, prep["blocks"])
+        x = layer_norm(prep["ln_f"], x.astype(jnp.float32), eps=cfg.ln_eps)
+        out = linear(prep["lm_head"], x, compute_dtype=BF16, accum_dtype=jnp.float32)
+        return out.astype(BF16)
+
+    variants = {
+        "scan": jax.jit(gpt.make_apply_stacked(cfg, compute_dtype=BF16)),
+        "unroll3": jax.jit(scan_unroll(3)),
+        "unroll12": jax.jit(scan_unroll(12)),
+        "flash": jax.jit(gpt.make_apply_stacked(cfg, compute_dtype=BF16, use_flash=True)),
+        "bf16head": jax.jit(bf16_head),
+    }
+
+    fpt = gpt_forward_flops(cfg, BATCH, SEQ) / (BATCH * SEQ)
+    for name, fn in variants.items():
+        try:
+            dt = device_time(fn, prepared, ids)
+        except Exception as e:  # a variant failing to compile is a finding, not a crash
+            print(f"{name:10s} FAILED: {type(e).__name__}: {str(e)[:200]}")
+            continue
+        tps = BATCH * SEQ / dt
+        m = mfu(fpt, tps)
+        print(f"{name:10s} {tps:12.0f} tok/s   mfu={m if m is None else round(m, 4)}")
+
+
+if __name__ == "__main__":
+    main()

@@ -61,18 +61,23 @@ def run_device_section():
             row["mfu"] = round(m, 4)
         return row
 
-    # config 1 (full-model form): CIFAR CNN forward
+    # config 1 (full-model form): CIFAR CNN forward — bf16 operands like the
+    # GPT rows, so the mfu column divides a bf16-executed workload by the
+    # bf16 peak table (an f32 workload against the bf16 peak would not be
+    # comparable across rows)
+    from dnn_tpu.models import cifar
+
     spec = get_model("cifar_cnn")
     params = spec.init(jax.random.PRNGKey(0))
     batch = 256
     x = jnp.asarray(spec.example_input(batch_size=batch))
-    fn = jax.jit(spec.apply)
+    fn = jax.jit(cifar.make_apply(compute_dtype=jnp.bfloat16))
     # the CIFAR CNN is sub-ms per batch: needs many reps per sample or the
     # slope drowns in sync jitter
     dt = device_time(fn, params, x, n1=20, n2=100, trials=5)
     _emit(results, config="cifar_cnn_fwd", metric="images_per_sec",
           value=round(batch / dt, 1), platform=platform, batch=batch,
-          **_with_mfu({}, cifar_forward_flops(1), batch / dt))
+          dtype="bf16", **_with_mfu({}, cifar_forward_flops(1), batch / dt))
 
     # config 4/5 (full-model form): GPT-2 small + medium forward, bf16
     for preset, b, s in (("gpt2", 8, 512), ("gpt2-medium", 4, 512)):
@@ -148,8 +153,12 @@ def run_cpu_mesh_section():
         mesh = make_mesh({STAGE_AXIS: parts}, jax.devices()[:parts])
         sparams = [st.slice_params(params) for st in stages]
         sfns = [st.apply for st in stages]
+        # param_placement matches what engine auto policy serves for these
+        # sub-threshold models (replicated; see engine.PLACEMENT_AUTO_BYTES)
+        # so the published number is the path users actually get
         fn = lambda xx, _s=sfns, _p=sparams, _m=mesh, _mb=mbs: spmd_pipeline(
-            _s, _p, xx, mesh=_m, num_microbatches=_mb
+            _s, _p, xx, mesh=_m, num_microbatches=_mb,
+            param_placement="replicated",
         )
         # parity guard: the pipeline must equal the full model before we
         # publish its number

@@ -75,6 +75,12 @@ class TopologyConfig:
     device_type: str = "tpu"
     runtime: str = "auto"
     microbatches: int = 0  # 0 = auto (see engine._effective_microbatches)
+    # spmd-runtime weight placement: "stage" (packed, each device holds only
+    # its own stage's weights), "replicated" (all weights everywhere, no
+    # pack/unpack work), or "auto" (stage iff the model is big enough for
+    # per-device HBM savings to outweigh the unpack overhead — see
+    # engine._resolve_param_placement)
+    param_placement: str = "auto"
     dtype: str = "float32"
     mesh: Dict[str, int] = dataclasses.field(default_factory=dict)
     distributed: Optional["DistributedConfig"] = None  # multihost job spec
@@ -100,6 +106,7 @@ class TopologyConfig:
             device_type=d.get("device_type", "tpu"),
             runtime=d.get("runtime", "auto"),
             microbatches=int(d.get("microbatches", 0)),
+            param_placement=d.get("param_placement", "auto"),
             dtype=d.get("dtype", "float32"),
             mesh=dict(d.get("mesh", {})),
             distributed=_parse_distributed(d.get("distributed")),
@@ -134,6 +141,11 @@ class TopologyConfig:
             raise ValueError(f"runtime must be auto|spmd|relay, got '{self.runtime}'")
         if self.microbatches < 0:
             raise ValueError("microbatches must be >= 0 (0 = auto)")
+        if self.param_placement not in ("auto", "stage", "replicated"):
+            raise ValueError(
+                "param_placement must be auto|stage|replicated, got "
+                f"'{self.param_placement}'"
+            )
 
     # ---- lookups (reference: node.py:234-277) ----------------------------
 

@@ -1,3 +1,4 @@
 # Importing the model modules registers them with the model registry.
 from dnn_tpu.models import cifar  # noqa: F401
 from dnn_tpu.models import gpt  # noqa: F401
+from dnn_tpu.models import mlp  # noqa: F401

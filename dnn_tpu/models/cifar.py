@@ -80,12 +80,12 @@ def init(rng, dtype=jnp.float32):
 # -> relu(fc1) -> softmax(fc2)  (cifar_model_parts.py:18-25).
 
 
-def _seg_conv1(params, x):
-    return max_pool2d(relu(conv2d(params["conv1"], x)))
+def _seg_conv1(params, x, compute_dtype=None):
+    return max_pool2d(relu(conv2d(params["conv1"], x, compute_dtype=compute_dtype)))
 
 
-def _seg_conv2(params, x):
-    h = max_pool2d(relu(conv2d(params["conv2"], x)))
+def _seg_conv2(params, x, compute_dtype=None):
+    h = max_pool2d(relu(conv2d(params["conv2"], x, compute_dtype=compute_dtype)))
     # Flatten in the REFERENCE'S (C, H, W) order (`x.view(-1, 64*8*8)` on
     # NCHW, cifar_model_parts.py:41), not our activation-native (H, W, C):
     # this is the 2-way split's wire boundary, so matching the order makes
@@ -95,12 +95,16 @@ def _seg_conv2(params, x):
     return h.transpose(0, 3, 1, 2).reshape(h.shape[0], -1)
 
 
-def _seg_fc1(params, x):
-    return relu(linear(params["fc1"], x))
+def _seg_fc1(params, x, compute_dtype=None):
+    return relu(linear(params["fc1"], x, compute_dtype=compute_dtype))
 
 
-def _seg_fc2(params, x):
-    return softmax(linear(params["fc2"], x), axis=1)
+def _seg_fc2(params, x, compute_dtype=None):
+    # bf16 operands still accumulate + softmax in f32: probs stay f32 in
+    # both modes (only matmul/conv operand traffic changes).
+    h = linear(params["fc2"], x, compute_dtype=compute_dtype,
+               accum_dtype=jnp.float32 if compute_dtype is not None else None)
+    return softmax(h, axis=1)
 
 
 _SEGMENTS = (
@@ -125,6 +129,22 @@ def apply(params, x):
     for _, fn, _ in _SEGMENTS:
         x = fn(params, x)
     return x
+
+
+def make_apply(compute_dtype=None):
+    """Forward with an explicit matmul/conv operand dtype (e.g. bf16 for
+    the MXU); probs are always f32 (see _seg_fc2). `None` returns the
+    default f32 `apply` used by the parity tests."""
+    if compute_dtype is None:
+        return apply
+
+    def apply_cd(params, x):
+        x = x.astype(compute_dtype)
+        for _, fn, _ in _SEGMENTS:
+            x = fn(params, x, compute_dtype=compute_dtype)
+        return x
+
+    return apply_cd
 
 
 def partition(num_parts):

@@ -1,0 +1,144 @@
+"""Tiny MLP model family — the worked "add your own family" example.
+
+The reference tells users to adapt it to a new model by hand-editing the
+node script: write ModelPart* classes, swap the import, and re-key the
+`MODEL_PARTS_CLASSES` dict (/root/reference/readme.md:100-108,
+node.py:29-32). Here the same job is one self-contained module that
+registers a `ModelSpec`; README's "Adding a model family" section walks
+through this file line by line. Keep it boring on purpose — it is
+documentation that happens to run.
+
+Architecture: fc stack over flattened inputs, relu between layers,
+softmax head — an MNIST-shaped (784 -> 512 -> 256 -> 10) classifier by
+default. Partitioning is at layer boundaries, like the reference's CIFAR
+split (cifar_model_parts.py:29-58) but for any 1 <= num_parts <= depth.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dnn_tpu.ops.nn import linear, relu, softmax
+from dnn_tpu.registry import ModelSpec, StageSpec, register_model
+
+# (in, hidden..., out). Chosen so the flagship config is MNIST-shaped; the
+# family supports any widths via make_spec().
+DEFAULT_WIDTHS = (784, 512, 256, 10)
+
+
+def _torch_linear(key, cin, cout, dtype):
+    # torch nn.Linear default init (kaiming_uniform a=sqrt(5) + bias bound),
+    # same convention as the other families so converted checkpoints and
+    # native inits share a scale.
+    bound = 1.0 / math.sqrt(cin)
+    kkey, bkey = jax.random.split(key)
+    kernel = jax.random.uniform(
+        kkey, (cin, cout), dtype, minval=-math.sqrt(3.0) * bound, maxval=math.sqrt(3.0) * bound
+    )
+    bias = jax.random.uniform(bkey, (cout,), dtype, minval=-bound, maxval=bound)
+    return {"kernel": kernel, "bias": bias}
+
+
+def make_spec(name="mlp", widths=DEFAULT_WIDTHS):
+    """Build and register an MLP ModelSpec.
+
+    The five ingredients every family provides (see README "Adding a model
+    family"): init, apply, partition, example_input, convert_state_dict.
+    """
+    widths = tuple(int(w) for w in widths)
+    if len(widths) < 2:
+        raise ValueError("widths needs at least (in, out)")
+    depth = len(widths) - 1
+    layer_names = tuple(f"fc{i}" for i in range(depth))
+
+    # 1. init: rng -> param pytree. Keys are the partitionable unit.
+    def init(rng, dtype=jnp.float32):
+        keys = jax.random.split(rng, depth)
+        return {
+            layer_names[i]: _torch_linear(keys[i], widths[i], widths[i + 1], dtype)
+            for i in range(depth)
+        }
+
+    # Layer-granular segments: relu between layers, softmax after the last.
+    def _seg(i):
+        last = i == depth - 1
+
+        def fn(params, x, _name=layer_names[i], _last=last):
+            h = linear(params[_name], x)
+            return softmax(h, axis=-1) if _last else relu(h)
+
+        return fn
+
+    _segments = tuple(_seg(i) for i in range(depth))
+
+    # 2. apply: full-model forward, (B, widths[0]) -> (B, widths[-1]) probs.
+    def apply(params, x):
+        for fn in _segments:
+            x = fn(params, x)
+        return x
+
+    # 3. partition: contiguous layer ranges, balanced like
+    #    np.array_split — the same rule gpt.layer_ranges uses for blocks.
+    def partition(num_parts):
+        if not 1 <= num_parts <= depth:
+            raise ValueError(
+                f"{name} has {depth} layers; num_parts must be in [1, {depth}], got {num_parts}"
+            )
+        bounds = np.linspace(0, depth, num_parts + 1).round().astype(int)
+        stages = []
+        for s in range(num_parts):
+            lo, hi = int(bounds[s]), int(bounds[s + 1])
+
+            def stage_fn(params, x, _lo=lo, _hi=hi):
+                for i in range(_lo, _hi):
+                    x = _segments[i](params, x)
+                return x
+
+            stages.append(
+                StageSpec(
+                    name="+".join(layer_names[lo:hi]),
+                    apply=stage_fn,
+                    param_keys=layer_names[lo:hi],
+                )
+            )
+        return stages
+
+    # 4. example_input: dummy batch for dryruns and the CLI's no-image
+    #    fallback (the reference's torch.randn analog, node.py:149-154).
+    def example_input(batch_size=1, rng=None):
+        rng = rng if rng is not None else jax.random.PRNGKey(0)
+        return jax.random.normal(rng, (batch_size, widths[0]), jnp.float32)
+
+    # 5. convert_state_dict: torch nn.Linear stores weight as (out, in);
+    #    ours is (in, out) so the matmul hits the MXU untransposed.
+    def convert_state_dict(sd):
+        params = {}
+        for i, lname in enumerate(layer_names):
+            w = np.asarray(sd[f"{lname}.weight"])
+            b = np.asarray(sd[f"{lname}.bias"])
+            if w.shape != (widths[i + 1], widths[i]):
+                raise ValueError(
+                    f"{lname}.weight shape {w.shape} != {(widths[i + 1], widths[i])}"
+                )
+            params[lname] = {"kernel": jnp.asarray(w.T), "bias": jnp.asarray(b)}
+        return params
+
+    return register_model(
+        ModelSpec(
+            name=name,
+            init=init,
+            apply=apply,
+            partition=partition,
+            example_input=example_input,
+            supported_parts=tuple(range(1, depth + 1)),
+            convert_state_dict=convert_state_dict,
+        )
+    )
+
+
+# The registered flagship instance (config: {"model": "mlp"}).
+make_spec()

@@ -205,35 +205,65 @@ def _unpad(buf, shape, dtype, buf_dtype=jnp.float32):
 
 
 def pack_stage_params(stage_params):
-    """Heterogeneous per-stage param pytrees -> one (S, W) f32 array (each
-    stage's leaves flattened, concatenated, zero-padded to the widest
-    stage) + per-stage unpack metadata. Sharded P(stage), this is what
-    lets `spmd_pipeline` place each stage's weights on its own device:
+    """Heterogeneous per-stage param pytrees -> one (S, W) f32 HOST (numpy)
+    array (each stage's leaves flattened, concatenated, zero-padded to the
+    widest stage) + per-stage unpack metadata. Sharded P(stage), this is
+    what lets `spmd_pipeline` place each stage's weights on its own device:
     lax.switch executes only the selected branch (XLA Case), but branch
     OPERANDS must exist on every device — packing turns "operand = all
     stages' params, replicated" into "operand = my (1, W) shard".
 
-    bf16/f16 leaves ride the f32 carrier losslessly (value upcast);
-    integer leaves are rejected (params are float in every shipped family,
-    and silent bitcast here would be invisible to readers of the packed
-    array)."""
-    flats, metas = [], []
+    Packing runs in numpy on the host on purpose: the whole (S, W) array
+    must never materialize in one device's HBM (that would cap model size
+    at single-device memory — the opposite of per-stage placement).
+    Callers `jax.device_put` the result with a P(stage) NamedSharding,
+    which sends each row directly to its stage's device.
+
+    Carrier dtype: when every leaf shares one float dtype, the packed
+    array keeps it — a bf16 model's per-device row is bf16, not a 2x-HBM
+    f32 upcast. Mixed float dtypes ride an f32 carrier (lossless for
+    bf16/f16/f32; a mix including f64 is rejected rather than silently
+    truncated). Integer leaves are rejected outright (params are float in
+    every shipped family, and silent bitcast here would be invisible to
+    readers of the packed array) — keep integer-param models on
+    `param_placement="replicated"`."""
+    per_stage, dtypes = [], set()
     for p in stage_params:
         leaves, treedef = jax.tree.flatten(p)
-        vecs, leafmeta = [], []
+        arrs = []
         for leaf in leaves:
-            arr = jnp.asarray(leaf)
+            arr = np.asarray(leaf)
             if not jnp.issubdtype(arr.dtype, jnp.floating):
                 raise ValueError(
-                    f"pack_stage_params supports float leaves only, got {arr.dtype}"
+                    f"pack_stage_params supports float leaves only, got "
+                    f"{arr.dtype}; use spmd_pipeline(..., "
+                    f"param_placement='replicated') for non-float params"
                 )
-            vecs.append(arr.astype(jnp.float32).reshape(-1))
-            leafmeta.append((arr.shape, arr.dtype))
-        flat = jnp.concatenate(vecs) if vecs else jnp.zeros((0,), jnp.float32)
-        flats.append(flat)
+            arrs.append(arr)
+            dtypes.add(jnp.dtype(arr.dtype))
+        per_stage.append((treedef, arrs))
+
+    if len(dtypes) == 1:
+        carrier = dtypes.pop()
+    else:
+        carrier = jnp.dtype(np.float32)
+        wide = [d for d in dtypes if d.itemsize > 4]
+        if wide:
+            raise ValueError(
+                f"pack_stage_params: mixed param dtypes {sorted(map(str, dtypes))} "
+                f"would silently truncate {sorted(map(str, wide))} through the "
+                f"f32 carrier; cast params to one dtype or use "
+                f"spmd_pipeline(..., param_placement='replicated')"
+            )
+
+    flats, metas = [], []
+    for treedef, arrs in per_stage:
+        vecs = [a.astype(carrier).reshape(-1) for a in arrs]
+        leafmeta = [(a.shape, jnp.dtype(a.dtype)) for a in arrs]
+        flats.append(np.concatenate(vecs) if vecs else np.zeros((0,), carrier))
         metas.append((treedef, leafmeta))
     width = max((f.shape[0] for f in flats), default=1) or 1
-    packed = jnp.stack([jnp.pad(f, (0, width - f.shape[0])) for f in flats])
+    packed = np.stack([np.pad(f, (0, width - f.shape[0])) for f in flats])
     return packed, metas
 
 
@@ -369,6 +399,14 @@ def spmd_pipeline(
     ).reshape(num_microbatches, mb, width_hop)
 
     sharded = param_placement == "stage"
+    if sharded and packed is None:
+        if any(isinstance(l, jax.core.Tracer) for l in jax.tree.leaves(stage_params)):
+            # Params are being traced (caller jits/grads with params as
+            # arguments): host-side packing is impossible mid-trace, and
+            # output is placement-independent — run replicated. Callers who
+            # want per-stage placement under jit pack once outside and pass
+            # `packed=` (what the engine does).
+            sharded = False
     if sharded:
         if packed is None:
             packed_arr, metas = pack_stage_params(stage_params)

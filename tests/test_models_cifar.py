@@ -75,6 +75,17 @@ def test_jit_forward(cifar_setup):
     np.testing.assert_allclose(np.asarray(jy), np.asarray(spec.apply(params, x)), rtol=1e-6)
 
 
+def test_bf16_compute_close_to_f32(cifar_setup):
+    """make_apply(bf16) — the benchmark configuration — stays close to the
+    f32 forward and still emits f32 probabilities."""
+    spec, params, x = cifar_setup
+    y32 = np.asarray(spec.apply(params, x))
+    y16 = np.asarray(jax.jit(cifar.make_apply(jnp.bfloat16))(params, x))
+    assert y16.dtype == np.float32
+    np.testing.assert_allclose(y16, y32, atol=2e-2)
+    assert cifar.make_apply(None) is cifar.apply
+
+
 def test_torch_numerical_parity():
     """Cross-framework check: our NHWC functional CNN must match a torch
     NCHW model built exactly like the reference's NeuralNetwork

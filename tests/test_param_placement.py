@@ -38,6 +38,52 @@ def test_pack_rejects_integer_leaves():
         pack_stage_params([{"w": jnp.zeros((2,), jnp.int32)}])
 
 
+def test_pack_carrier_keeps_uniform_dtype():
+    """A bf16 model packs as bf16 — per-device HBM is the stage's true
+    weight bytes, not a 2x f32 upcast."""
+    sp = [{"w": jnp.full((3,), 1.5, jnp.bfloat16)},
+          {"w": jnp.full((2,), -2.0, jnp.bfloat16)}]
+    packed, metas = pack_stage_params(sp)
+    assert packed.dtype == jnp.bfloat16
+    back = _unpack_stage(jnp.asarray(packed[0]), metas[0])
+    assert back["w"].dtype == jnp.bfloat16
+    np.testing.assert_array_equal(np.asarray(back["w"], np.float32),
+                                  np.full((3,), 1.5, np.float32))
+
+
+def test_pack_mixed_float_dtypes_use_f32_carrier():
+    sp = [{"w": jnp.ones((2,), jnp.bfloat16), "b": jnp.ones((2,), jnp.float32)}]
+    packed, metas = pack_stage_params(sp)
+    assert packed.dtype == np.float32
+    back = _unpack_stage(jnp.asarray(packed[0]), metas[0])
+    assert back["w"].dtype == jnp.bfloat16 and back["b"].dtype == jnp.float32
+
+
+def test_default_placement_works_with_traced_params():
+    """jit/grad with stage params as ARGUMENTS (the round-1 calling
+    pattern) must keep working under the new default placement: packing is
+    impossible mid-trace, so spmd_pipeline falls back to replicated."""
+    spec = get_model("cifar_cnn")
+    params = spec.init(jax.random.PRNGKey(4))
+    stages = spec.partition(2)
+    sp = [s.slice_params(params) for s in stages]
+    mesh = Mesh(np.array(jax.devices()[:2]), (STAGE_AXIS,))
+    x = jnp.asarray(spec.example_input(batch_size=4, rng=jax.random.PRNGKey(5)))
+    fns = [s.apply for s in stages]
+    out = jax.jit(
+        lambda sp_, x_: spmd_pipeline(fns, sp_, x_, mesh=mesh, num_microbatches=2)
+    )(sp, x)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(spec.apply(params, x)), atol=1e-5, rtol=1e-5
+    )
+
+
+def test_pack_rejects_lossy_f64_mix():
+    sp = [{"w": np.ones((2,), np.float64), "b": np.ones((2,), np.float32)}]
+    with pytest.raises(ValueError, match="truncate"):
+        pack_stage_params(sp)
+
+
 def test_cifar_4stage_per_device_weight_fraction():
     """The VERDICT's acceptance check: each device holds ~1/4 of the
     weights (one padded stage row), not the full model."""
@@ -88,23 +134,59 @@ def test_placements_agree(placement):
     )
 
 
-def test_engine_spmd_uses_per_stage_placement():
-    """The engine's spmd runtime must place packed params P(stage): every
-    device's addressable shard is one stage row."""
+def _engine_cfg(**over):
     from dnn_tpu.config import TopologyConfig
-    from dnn_tpu.runtime.engine import PipelineEngine
 
-    cfg = TopologyConfig.from_dict({
+    d = {
         "nodes": [{"id": f"n{i}", "part_index": i} for i in range(4)],
         "num_parts": 4,
         "model": "cifar_cnn",
         "device_type": "cpu",
         "runtime": "spmd",
-    })
-    eng = PipelineEngine(cfg, rng_seed=0)
+    }
+    d.update(over)
+    return TopologyConfig.from_dict(d)
+
+
+def test_engine_spmd_uses_per_stage_placement():
+    """With param_placement="stage" the engine's packed params ARE sharded
+    P(stage): every device's addressable shard is exactly one stage row —
+    not just output parity, the placement itself is asserted."""
+    from dnn_tpu.runtime.engine import PipelineEngine
+
+    eng = PipelineEngine(_engine_cfg(param_placement="stage"), rng_seed=0)
+    assert eng.runtime == "spmd" and eng.param_placement == "stage"
+    packed = eng._spmd_packed
+    assert {s.data.shape[0] for s in packed.addressable_shards} == {1}
+    assert packed.sharding.spec == P(STAGE_AXIS)
     x = np.asarray(eng.spec.example_input(batch_size=8))
     np.testing.assert_allclose(
         np.asarray(eng.run(x)), np.asarray(eng.spec.apply(eng.params, x)),
         atol=1e-5, rtol=1e-5,
     )
-    assert eng.runtime == "spmd"
+
+
+def test_engine_auto_placement_replicates_small_models():
+    """auto -> replicated for models far below the HBM-savings threshold
+    (CIFAR is ~9 MB): no packed array exists, parity still holds."""
+    from dnn_tpu.runtime.engine import PipelineEngine
+
+    eng = PipelineEngine(_engine_cfg(), rng_seed=0)
+    assert eng.runtime == "spmd" and eng.param_placement == "replicated"
+    assert not hasattr(eng, "_spmd_packed")
+    x = np.asarray(eng.spec.example_input(batch_size=8))
+    np.testing.assert_allclose(
+        np.asarray(eng.run(x)), np.asarray(eng.spec.apply(eng.params, x)),
+        atol=1e-5, rtol=1e-5,
+    )
+
+
+def test_engine_auto_placement_shards_big_models():
+    """auto -> stage once total param bytes cross the threshold."""
+    from dnn_tpu.runtime.engine import PipelineEngine
+
+    eng = PipelineEngine(_engine_cfg(), rng_seed=0)
+    big = jax.tree.map(lambda l: l, eng._stage_params)  # shallow copy
+    big[0]["pad"] = {"kernel": jnp.zeros((64 * 1024 * 1024 // 4,), jnp.float32)}
+    eng._stage_params = big
+    assert eng._resolve_param_placement() == "stage"
