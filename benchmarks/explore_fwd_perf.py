@@ -59,18 +59,29 @@ def main():
         out = linear(prep["lm_head"], x, compute_dtype=BF16, accum_dtype=jnp.float32)
         return out.astype(BF16)
 
+    # bf16-resident weights: inference holds no f32 master, so the per-layer
+    # param read halves (496 MB f32 -> 248 MB bf16 per forward for gpt2)
+    prepared_bf16 = jax.tree.map(
+        lambda a: a.astype(BF16) if a.dtype == jnp.float32 else a, prepared
+    )
+
     variants = {
-        "scan": jax.jit(gpt.make_apply_stacked(cfg, compute_dtype=BF16)),
-        "unroll3": jax.jit(scan_unroll(3)),
-        "unroll12": jax.jit(scan_unroll(12)),
-        "flash": jax.jit(gpt.make_apply_stacked(cfg, compute_dtype=BF16, use_flash=True)),
-        "bf16head": jax.jit(bf16_head),
+        "scan": (jax.jit(gpt.make_apply_stacked(cfg, compute_dtype=BF16)), prepared),
+        "unroll3": (jax.jit(scan_unroll(3)), prepared),
+        "unroll12": (jax.jit(scan_unroll(12)), prepared),
+        "flash": (jax.jit(gpt.make_apply_stacked(cfg, compute_dtype=BF16, use_flash=True)), prepared),
+        "bf16head": (jax.jit(bf16_head), prepared),
+        "bf16params": (
+            jax.jit(gpt.make_apply_stacked(cfg, compute_dtype=BF16,
+                                           logits_dtype=BF16)),
+            prepared_bf16,
+        ),
     }
 
     fpt = gpt_forward_flops(cfg, BATCH, SEQ) / (BATCH * SEQ)
-    for name, fn in variants.items():
+    for name, (fn, prep) in variants.items():
         try:
-            dt = device_time(fn, prepared, ids)
+            dt = device_time(fn, prep, ids)
         except Exception as e:  # a variant failing to compile is a finding, not a crash
             print(f"{name:10s} FAILED: {type(e).__name__}: {str(e)[:200]}")
             continue

@@ -80,17 +80,21 @@ def run_device_section():
           dtype="bf16", **_with_mfu({}, cifar_forward_flops(1), batch / dt))
 
     # config 4/5 (full-model form): GPT-2 small + medium forward, bf16
+    # operands + bf16 logit store (the serving configuration — see gpt.head)
     for preset, b, s in (("gpt2", 8, 512), ("gpt2-medium", 4, 512)):
         cfg = gpt.PRESETS[preset]
         p = gpt.init(jax.random.PRNGKey(0), cfg)
         prepared = gpt.prepare_stacked(p, cfg)
-        fn = jax.jit(gpt.make_apply_stacked(cfg, compute_dtype=jnp.bfloat16))
+        fn = jax.jit(gpt.make_apply_stacked(
+            cfg, compute_dtype=jnp.bfloat16, logits_dtype=jnp.bfloat16
+        ))
         ids = jax.random.randint(jax.random.PRNGKey(1), (b, s), 0,
                                  cfg.vocab_size, dtype=jnp.int32)
         dt = device_time(fn, prepared, ids)
         tps = b * s / dt
         _emit(results, config=f"{preset}_fwd", metric="tokens_per_sec",
               value=round(tps, 1), platform=platform, batch=b, seq=s,
+              logits="bf16",
               **_with_mfu({}, gpt_forward_flops(cfg, b, s) / (b * s), tps))
 
     # KV-cache generation throughput (the serving path the reference lacks)

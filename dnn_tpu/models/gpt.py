@@ -203,7 +203,7 @@ def embed(params, idx, *, cfg: GPTConfig):
     return embedding(params["wte"], idx) + embedding(params["wpe"], pos)
 
 
-def head(params, x, *, cfg: GPTConfig, compute_dtype=None):
+def head(params, x, *, cfg: GPTConfig, compute_dtype=None, logits_dtype=None):
     """Final LN + lm_head (ModelPartFinal_GPT semantics,
     gpt_model_parts.py:44-50).
 
@@ -214,12 +214,22 @@ def head(params, x, *, cfg: GPTConfig, compute_dtype=None):
     pass already, so output is bit-identical (measured: zero logit diff)
     and throughput is within noise; the explicit operand dtype matters on
     platforms where f32 matmul really runs f32, and makes the memory
-    traffic intent visible rather than relying on a backend default."""
+    traffic intent visible rather than relying on a backend default.
+
+    `logits_dtype=bf16` rounds the f32-accumulated logits on the way out
+    (XLA fuses the cast into the matmul epilogue): the (B, T, V) logit
+    write is the single largest HBM store of a forward — 823 MB at
+    B=8/T=512/V=50257 in f32 — and halving it measures +11% end-to-end
+    throughput on v5e (benchmarks/explore_fwd_perf.py). Accumulation is
+    still f32; only the stored values are rounded. Default None keeps f32
+    logits (the parity-test configuration)."""
     x = layer_norm(params["ln_f"], x, eps=cfg.ln_eps)
     if compute_dtype is None:
-        return linear(params["lm_head"], x)
-    return linear(params["lm_head"], x, compute_dtype=compute_dtype,
-                  accum_dtype=jnp.float32)
+        out = linear(params["lm_head"], x)
+    else:
+        out = linear(params["lm_head"], x, compute_dtype=compute_dtype,
+                     accum_dtype=jnp.float32)
+    return out if logits_dtype is None else out.astype(logits_dtype)
 
 
 def make_apply(cfg: GPTConfig, *, use_flash=False, compute_dtype=None, remat=False):
@@ -242,10 +252,11 @@ def make_apply(cfg: GPTConfig, *, use_flash=False, compute_dtype=None, remat=Fal
 
 
 def make_apply_stacked(cfg: GPTConfig, *, use_flash=False, compute_dtype=None,
-                       remat=False):
+                       remat=False, logits_dtype=None):
     """Forward over `prepare_stacked` params: zero per-call restacking.
     When `compute_dtype` is set, the head matmul also runs in it (f32
-    accumulation — see `head`)."""
+    accumulation — see `head`). `logits_dtype=bf16` halves the logit
+    store, the serving-path configuration (see `head`)."""
 
     def apply(prepared, idx):
         x = embed(prepared, idx, cfg=cfg)
@@ -253,7 +264,8 @@ def make_apply_stacked(cfg: GPTConfig, *, use_flash=False, compute_dtype=None,
             x = x.astype(compute_dtype)
         x = blocks_scan(prepared["blocks"], x, cfg=cfg, use_flash=use_flash,
                         compute_dtype=compute_dtype, remat=remat)
-        return head(prepared, x.astype(jnp.float32), cfg=cfg, compute_dtype=compute_dtype)
+        return head(prepared, x.astype(jnp.float32), cfg=cfg,
+                    compute_dtype=compute_dtype, logits_dtype=logits_dtype)
 
     return apply
 

@@ -81,17 +81,18 @@ def make_spec(name="mlp", widths=DEFAULT_WIDTHS):
             x = fn(params, x)
         return x
 
-    # 3. partition: contiguous layer ranges, balanced like
-    #    np.array_split — the same rule gpt.layer_ranges uses for blocks.
+    # 3. partition: contiguous layer ranges via gpt.layer_ranges — reuse
+    #    the framework's split rule instead of re-deriving one (earlier
+    #    stages take the remainder).
     def partition(num_parts):
+        from dnn_tpu.models.gpt import layer_ranges
+
         if not 1 <= num_parts <= depth:
             raise ValueError(
                 f"{name} has {depth} layers; num_parts must be in [1, {depth}], got {num_parts}"
             )
-        bounds = np.linspace(0, depth, num_parts + 1).round().astype(int)
         stages = []
-        for s in range(num_parts):
-            lo, hi = int(bounds[s]), int(bounds[s + 1])
+        for lo, hi in layer_ranges(depth, num_parts):
 
             def stage_fn(params, x, _lo=lo, _hi=hi):
                 for i in range(_lo, _hi):

@@ -7,10 +7,11 @@ coordinate on the "stage" axis of a `jax.sharding.Mesh`, and the "hop" is
 `lax.ppermute` over ICI instead of a gRPC call (BASELINE.json north star).
 
 Axis conventions used across the framework:
-  "data"  — data parallelism (batch sharding, gradient psum)
-  "stage" — pipeline parallelism (the reference's only axis)
-  "model" — tensor parallelism (Megatron-style head/mlp sharding)
-  "seq"   — sequence/context parallelism (ring attention)
+  "data"   — data parallelism (batch sharding, gradient psum)
+  "stage"  — pipeline parallelism (the reference's only axis)
+  "model"  — tensor parallelism (Megatron-style head/mlp sharding)
+  "seq"    — sequence/context parallelism (ring attention)
+  "expert" — expert parallelism (MoE expert sharding, all_to_all dispatch)
 """
 
 from __future__ import annotations
@@ -25,6 +26,7 @@ STAGE_AXIS = "stage"
 DATA_AXIS = "data"
 MODEL_AXIS = "model"
 SEQ_AXIS = "seq"
+EXPERT_AXIS = "expert"
 
 
 def make_mesh(axes: Dict[str, int], devices: Optional[Sequence] = None) -> Mesh:

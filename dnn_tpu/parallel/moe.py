@@ -1,0 +1,259 @@
+"""Mixture-of-Experts FFN with expert parallelism (EP).
+
+The reference has no MoE anywhere (SURVEY.md §2: "no MoE modules exist" —
+verified absence), so this module is pure capability extension, designed
+TPU-first rather than ported:
+
+  * GShard-style top-k routing with STATIC capacity: dispatch/combine are
+    dense one-hot tensors consumed by einsums — static shapes, no
+    data-dependent control flow under jit, and the expert FFNs run as one
+    batched (E, cap, D) x (E, D, F) matmul that tiles straight onto the
+    MXU. Tokens beyond an expert's capacity are dropped (their combine
+    weight is zero); callers keep a residual connection so dropped tokens
+    pass through unchanged — the standard MoE contract.
+  * Tokens are routed in GROUPS (the GShard "group" = the EP shard unit):
+    capacity is per (group, expert), so the grouped dense path and the
+    expert-parallel path compute IDENTICAL results — the parity invariant
+    the tests pin down.
+  * Expert parallelism: `moe_ffn_ep` runs under `shard_map` with groups
+    sharded over the "expert" mesh axis and expert weights sharded on
+    their leading E axis. Tokens travel to their experts and back via
+    `jax.lax.all_to_all` (XLA AllToAll over ICI) — the TPU-native
+    equivalent of the dispatch the reference would have done with gRPC
+    sends, and the 4th collective family the framework uses (ppermute /
+    psum / all_gather already ride the pipeline, dp×tp, and ring paths).
+
+Routing is computed in f32 regardless of compute dtype (router logits are
+tiny and routing decisions must not flip with the activation dtype).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from dnn_tpu.ops.nn import gelu
+from dnn_tpu.parallel.mesh import EXPERT_AXIS
+
+
+def moe_capacity(tokens_per_group: int, n_experts: int, top_k: int,
+                 capacity_factor: float) -> int:
+    """Static per-(group, expert) slot count: the expected k*S/E load times
+    the capacity factor, floored at 1."""
+    return max(1, int(math.ceil(top_k * tokens_per_group * capacity_factor / n_experts)))
+
+
+def init_moe(rng, n_embd: int, n_experts: int, d_ff: Optional[int] = None,
+             dtype=jnp.float32):
+    """Param pytree for one MoE FFN layer.
+
+    Expert weights are EXPERT-MAJOR stacked arrays — (E, D, F) / (E, F, D) —
+    so EP shards them with a plain P("expert") on the leading axis and the
+    dense path consumes them as one batched matmul."""
+    d_ff = 4 * n_embd if d_ff is None else d_ff
+    kr, k1, k2 = jax.random.split(rng, 3)
+    scale_in = 1.0 / math.sqrt(n_embd)
+    scale_out = 1.0 / math.sqrt(d_ff)
+    return {
+        "router": {"kernel": jax.random.normal(kr, (n_embd, n_experts), dtype) * scale_in},
+        "wi": jax.random.normal(k1, (n_experts, n_embd, d_ff), dtype) * scale_in,
+        "bi": jnp.zeros((n_experts, d_ff), dtype),
+        "wo": jax.random.normal(k2, (n_experts, d_ff, n_embd), dtype) * scale_out,
+        "bo": jnp.zeros((n_experts, n_embd), dtype),
+    }
+
+
+def route_topk(gate_logits, *, top_k: int, capacity: int, normalize: bool = True):
+    """One group's routing: (S, E) f32 gate logits -> dispatch/combine.
+
+    Returns:
+      dispatch: (S, E, cap) 0/1 — token s occupies slot c of expert e;
+      combine:  (S, E, cap) f32 — dispatch weighted by the (optionally
+                renormalized) router probability;
+      aux: dict with "load" (E,) fraction of tokens per expert and
+           "importance" (E,) mean router prob — the load-balance loss
+           ingredients (Shazeer et al.'s aux loss; see load_balance_loss).
+
+    Selection is iterative argmax (k rounds); slot positions are the
+    running per-expert count in token order, so results are deterministic
+    and order-stable. Tokens whose slot index >= capacity are dropped from
+    that expert (combine weight 0)."""
+    s, e = gate_logits.shape
+    probs = jax.nn.softmax(gate_logits.astype(jnp.float32), axis=-1)  # (S, E)
+
+    remaining = probs
+    counts = jnp.zeros((e,), jnp.int32)
+    dispatch = jnp.zeros((s, e, capacity), jnp.float32)
+    weight_sum = jnp.zeros((s, 1), jnp.float32)
+    picked = []
+    for _ in range(top_k):
+        sel = jax.nn.one_hot(jnp.argmax(remaining, axis=-1), e, dtype=jnp.float32)
+        remaining = remaining * (1.0 - sel)
+        # slot index: tokens before me this round + slots used by earlier rounds
+        pos = (jnp.cumsum(sel, axis=0) - sel) + counts[None, :].astype(jnp.float32)
+        keep = (pos < capacity) * sel  # (S, E)
+        slot = jax.nn.one_hot(pos.astype(jnp.int32), capacity, dtype=jnp.float32)
+        dispatch = dispatch + keep[..., None] * slot
+        w = (probs * keep).sum(axis=-1, keepdims=True)  # this round's weight
+        weight_sum = weight_sum + w
+        picked.append((keep, probs * keep))
+        counts = counts + sel.sum(axis=0).astype(jnp.int32)
+
+    combine = jnp.zeros_like(dispatch)
+    denom = jnp.maximum(weight_sum, 1e-9) if normalize else 1.0
+    for keep, w in picked:
+        slot_w = (w / denom if normalize else w).sum(axis=-1)  # (S,)
+        combine = combine + dispatch * (keep * slot_w[:, None])[..., None]
+
+    aux = {
+        "load": dispatch.sum(axis=(0, 2)) / s,          # realized fraction per expert
+        "importance": probs.mean(axis=0),               # mean router prob per expert
+    }
+    return dispatch, combine, aux
+
+
+def load_balance_loss(aux) -> jax.Array:
+    """Switch-Transformer load-balance term: E * <load, importance>. Equals
+    1.0 under perfectly uniform routing; add `alpha * (loss - 1.0)` (alpha
+    ~1e-2) to the training objective to keep experts busy."""
+    e = aux["load"].shape[-1]
+    return e * jnp.sum(aux["load"] * aux["importance"], axis=-1).mean()
+
+
+def _expert_ffn(params, expert_in, *, activation, compute_dtype):
+    """(E, cap, D) tokens through each expert's 2-layer FFN, one batched
+    matmul pair. Accumulate in f32, ride operands in compute_dtype."""
+    wi, bi, wo, bo = params["wi"], params["bi"], params["wo"], params["bo"]
+    x = expert_in
+    if compute_dtype is not None:
+        x, wi, wo = x.astype(compute_dtype), wi.astype(compute_dtype), wo.astype(compute_dtype)
+    h = jnp.einsum("ecd,edf->ecf", x, wi,
+                   preferred_element_type=jnp.float32) + bi[:, None, :].astype(jnp.float32)
+    h = activation(h)
+    if compute_dtype is not None:
+        h = h.astype(compute_dtype)
+    out = jnp.einsum("ecf,efd->ecd", h, wo,
+                     preferred_element_type=jnp.float32) + bo[:, None, :].astype(jnp.float32)
+    return out  # f32
+
+
+def _group_dispatch(params, xg, *, top_k, capacity, normalize):
+    """Routing for one (S, D) group -> dispatch/combine/aux (f32)."""
+    logits = xg.astype(jnp.float32) @ params["router"]["kernel"].astype(jnp.float32)
+    return route_topk(logits, top_k=top_k, capacity=capacity, normalize=normalize)
+
+
+def moe_ffn(params, x, *, top_k: int = 2, capacity_factor: float = 1.25,
+            groups: int = 1, activation=gelu, compute_dtype=None,
+            return_aux: bool = False):
+    """Dense (single-program) MoE FFN: (B, T, D) -> (B, T, D).
+
+    Tokens are routed in `groups` independent groups (B*T must divide by
+    groups); with groups == n_devices this computes exactly what
+    `moe_ffn_ep` computes on an n-device mesh — the parity contract.
+    Output does NOT include the residual; callers add it (dropped tokens
+    then degrade to identity, the standard MoE fallback)."""
+    b, t, d = x.shape
+    n_tok = b * t
+    if n_tok % groups:
+        raise ValueError(f"B*T={n_tok} not divisible by groups={groups}")
+    s = n_tok // groups
+    e = params["wi"].shape[0]
+    capacity = moe_capacity(s, e, top_k, capacity_factor)
+
+    xg = x.reshape(groups, s, d)
+    dispatch, combine, aux = jax.vmap(
+        lambda g: _group_dispatch(params, g, top_k=top_k, capacity=capacity,
+                                  normalize=True)
+    )(xg)
+
+    expert_in = jnp.einsum("gsec,gsd->gecd", dispatch,
+                           xg.astype(jnp.float32))  # (G, E, cap, D)
+    out = jax.vmap(
+        lambda ein: _expert_ffn(params, ein, activation=activation,
+                                compute_dtype=compute_dtype)
+    )(expert_in)  # (G, E, cap, D) f32
+    y = jnp.einsum("gsec,gecd->gsd", combine, out).reshape(b, t, d).astype(x.dtype)
+    if return_aux:
+        return y, {k: v.mean(axis=0) for k, v in aux.items()}
+    return y
+
+
+def moe_ffn_local(params_local, xg, *, top_k, capacity, axis_name,
+                  activation=gelu, compute_dtype=None):
+    """Per-device EP body (call inside shard_map): this device's group
+    (S, D) + its shard of the experts -> (S, D).
+
+    The two `all_to_all`s are the expert dispatch fabric: tokens leave for
+    the device that owns their expert and come back combined — XLA
+    AllToAll over ICI, replacing the reference's per-hop gRPC sends."""
+    dispatch, combine, _aux = _group_dispatch(
+        # router weights are replicated; only expert weights are sharded
+        params_local, xg, top_k=top_k, capacity=capacity, normalize=True,
+    )
+    expert_in = jnp.einsum("sec,sd->ecd", dispatch, xg.astype(jnp.float32))
+    # (E, cap, D) -> (E/n, n*cap, D): send each expert-block to its owner,
+    # gather every device's tokens for my experts
+    expert_in = jax.lax.all_to_all(
+        expert_in, axis_name, split_axis=0, concat_axis=1, tiled=True
+    )
+    out = _expert_ffn(params_local, expert_in, activation=activation,
+                      compute_dtype=compute_dtype)
+    # inverse exchange: (E/n, n*cap, D) -> (E, cap, D)
+    out = jax.lax.all_to_all(
+        out, axis_name, split_axis=1, concat_axis=0, tiled=True
+    )
+    y = jnp.einsum("sec,ecd->sd", combine, out)
+    return y.astype(xg.dtype)
+
+
+def make_moe_ffn_ep(mesh: Mesh, *, top_k: int = 2, capacity_factor: float = 1.25,
+                    axis_name: str = EXPERT_AXIS, activation=gelu,
+                    compute_dtype=None):
+    """Expert-parallel MoE FFN over `mesh`'s "expert" axis.
+
+    apply(params, x): x (B, T, D) with B divisible by the axis size; the
+    BATCH is sharded over the expert axis (each device's local batch is
+    its routing group — dp and ep share the axis, the standard MoE mesh
+    layout), expert weights shard P("expert") on their leading E axis,
+    router/bias params replicate. Equals moe_ffn(groups=n) exactly."""
+    n = mesh.shape[axis_name]
+
+    param_specs = {
+        "router": {"kernel": P()},
+        "wi": P(axis_name), "bi": P(axis_name),
+        "wo": P(axis_name), "bo": P(axis_name),
+    }
+
+    def apply(params, x):
+        b, t, d = x.shape
+        if b % n:
+            raise ValueError(f"batch {b} not divisible by expert-axis size {n}")
+        e = params["wi"].shape[0]
+        if e % n:
+            raise ValueError(f"{e} experts not divisible by expert-axis size {n}")
+        s = (b // n) * t
+        capacity = moe_capacity(s, e, top_k, capacity_factor)
+
+        def local(params_local, x_local):
+            bl = x_local.shape[0]
+            xg = x_local.reshape(bl * t, d)
+            y = moe_ffn_local(
+                params_local, xg, top_k=top_k, capacity=capacity,
+                axis_name=axis_name, activation=activation,
+                compute_dtype=compute_dtype,
+            )
+            return y.reshape(bl, t, d)
+
+        return jax.shard_map(
+            local, mesh=mesh,
+            in_specs=(param_specs, P(axis_name)),
+            out_specs=P(axis_name),
+            check_vma=False,
+        )(params, x)
+
+    return apply

@@ -346,7 +346,7 @@ def spmd_pipeline(
     mesh: Mesh,
     num_microbatches: int = 1,
     axis_name: str = STAGE_AXIS,
-    param_placement: str = "stage",
+    param_placement: str = "auto",
     packed=None,
 ):
     """Heterogeneous-stage SPMD pipeline.
@@ -359,15 +359,23 @@ def spmd_pipeline(
     integer payloads bitcast in — exact over the whole int32 range — when
     mixed.
 
-    `param_placement="stage"` (default): stage params are packed into one
-    (S, W) array sharded over the stage axis (pack_stage_params), so each
-    device's HBM holds only its own stage's weights (padded to the widest
-    stage) — the per-stage-HBM north star, now for heterogeneous models
-    too. Long-lived callers (the engine) should pack ONCE at load time and
-    pass `packed=(packed_array, metas)`; otherwise the pack runs inside
-    this call. `"replicated"` keeps the round-1 behavior (all weights on
-    all devices, no pack/unpack work in the branches): right for models
-    whose params are smaller than their activations.
+    `param_placement`:
+      * "auto" (default): per-stage packed placement when the params are
+        concrete values (or `packed=` is given); replicated when they are
+        tracers (caller jits/grads with params as arguments — packing is
+        impossible mid-trace, and output is placement-independent).
+      * "stage": stage params are packed into one (S, W) array sharded
+        over the stage axis (pack_stage_params), so each device's HBM
+        holds only its own stage's weights (padded to the widest stage) —
+        the per-stage-HBM north star, now for heterogeneous models too.
+        Long-lived callers (the engine) should pack ONCE at load time and
+        pass `packed=(packed_array, metas)`; otherwise the pack runs
+        inside this call. Raises if the params are tracers and no
+        `packed=` was supplied (an explicit placement request must not be
+        silently downgraded).
+      * "replicated": all weights on all devices, no pack/unpack work in
+        the branches — right for models whose params are smaller than
+        their activations.
 
     Returns the final stage's output with microbatches re-merged.
     """
@@ -377,9 +385,9 @@ def spmd_pipeline(
             f"mesh axis '{axis_name}' has size {mesh.shape[axis_name]}, "
             f"need {num_stages} (one device per stage)"
         )
-    if param_placement not in ("stage", "replicated"):
+    if param_placement not in ("auto", "stage", "replicated"):
         raise ValueError(
-            f"param_placement must be stage|replicated, got {param_placement!r}"
+            f"param_placement must be auto|stage|replicated, got {param_placement!r}"
         )
 
     x_mb = split_microbatches(x, num_microbatches)
@@ -398,15 +406,17 @@ def spmd_pipeline(
         x_mb.reshape(num_microbatches * mb, -1), width_hop, buf_dtype
     ).reshape(num_microbatches, mb, width_hop)
 
-    sharded = param_placement == "stage"
+    sharded = param_placement in ("auto", "stage")
     if sharded and packed is None:
         if any(isinstance(l, jax.core.Tracer) for l in jax.tree.leaves(stage_params)):
-            # Params are being traced (caller jits/grads with params as
-            # arguments): host-side packing is impossible mid-trace, and
-            # output is placement-independent — run replicated. Callers who
-            # want per-stage placement under jit pack once outside and pass
-            # `packed=` (what the engine does).
-            sharded = False
+            if param_placement == "stage":
+                raise ValueError(
+                    "param_placement='stage' with traced stage_params: "
+                    "packing is impossible mid-trace. Pack once outside the "
+                    "jit and pass packed=(array, metas) (what the engine "
+                    "does), or use param_placement='replicated'/'auto'."
+                )
+            sharded = False  # auto: replicated semantics, identical output
     if sharded:
         if packed is None:
             packed_arr, metas = pack_stage_params(stage_params)

@@ -219,13 +219,16 @@ class PipelineEngine:
         return 1
 
     def _gpt_stacked_ready(self) -> bool:
-        """GPT-family fast path: uniform block stacks sharded one-stage-per-
-        device, embed/head outside the ring. Needs equal blocks per stage."""
+        """Dense-GPT fast path: uniform block stacks sharded one-stage-per-
+        device, embed/head outside the ring. Needs equal blocks per stage.
+        EXACT type match on purpose: subclassed configs (GPTMoEConfig) have
+        different block params (no 'mlp'), so they take the generic
+        partitioned path instead."""
         from dnn_tpu.models.gpt import GPTConfig
 
         cfg = self.spec.config
         return (
-            isinstance(cfg, GPTConfig)
+            type(cfg) is GPTConfig
             and cfg.n_layer % self.config.num_parts == 0
             and self.config.num_parts > 1
         )
@@ -294,7 +297,10 @@ class PipelineEngine:
             jax.tree.map(np.asarray, p) for p in self._stage_params
         ]
         stage_shapes = [
-            jax.tree.map(lambda l: jax.ShapeDtypeStruct(jnp.shape(l), jnp.asarray(l).dtype), p)
+            # .dtype/.shape read straight off the (now-host) leaves — no
+            # jnp.asarray, which would round-trip the whole model through
+            # the default device right after demoting it
+            jax.tree.map(lambda l: jax.ShapeDtypeStruct(jnp.shape(l), l.dtype), p)
             for p in self._stage_params
         ]
 
@@ -412,10 +418,13 @@ class PipelineEngine:
         from dnn_tpu.runtime.generate import make_generate, make_pipeline_generate
 
         cfg = self.spec.config
-        if not isinstance(cfg, GPTConfig):
+        if type(cfg) is not GPTConfig:
+            # exact match: the KV-cache decoder assumes dense-GPT block
+            # params ('mlp'); subclassed families (MoE) are not decodable
+            # through it
             raise ValueError(
-                f"generation requires a GPT-family model; '{self.config.model}' "
-                f"has config {type(cfg).__name__}"
+                f"generation requires a dense GPT-family model; "
+                f"'{self.config.model}' has config {type(cfg).__name__}"
             )
         if self.role == "stage":
             raise RuntimeError(

@@ -1,0 +1,126 @@
+"""GPT-MoE family: forward shape, dense==EP parity, pipeline partition
+parity, registry wiring, and a training smoke test.
+
+The family has no reference counterpart (SURVEY.md §2: no MoE) — these
+tests pin the invariants that make EP a placement choice: the dense
+grouped forward equals the shard_map all_to_all forward exactly, and the
+staged pipeline equals the full model."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dnn_tpu import get_model
+from dnn_tpu.models import gpt_moe
+from dnn_tpu.parallel.mesh import EXPERT_AXIS, make_mesh
+
+
+@pytest.fixture(scope="module")
+def moe_setup():
+    spec = get_model("gpt2-moe-test")
+    params = spec.init(jax.random.PRNGKey(0))
+    ids = spec.example_input(batch_size=4, seq_len=16, rng=jax.random.PRNGKey(1))
+    return spec, params, ids
+
+
+def test_forward_shape(moe_setup):
+    spec, params, ids = moe_setup
+    logits = spec.apply(params, ids)
+    assert logits.shape == (4, 16, spec.config.vocab_size)
+    assert np.isfinite(np.asarray(logits)).all()
+
+
+@pytest.mark.parametrize("n_dev", [2, 4])
+def test_ep_matches_dense(moe_setup, n_dev):
+    """Full-model EP forward == dense forward with groups=n (exact routing
+    parity; fp tolerance only for reassociated matmuls)."""
+    spec, params, ids = moe_setup
+    cfg = spec.config
+    mesh = make_mesh({EXPERT_AXIS: n_dev}, jax.devices()[:n_dev])
+    dense = np.asarray(gpt_moe.make_apply(cfg, groups=n_dev)(params, ids))
+    ep = np.asarray(jax.jit(gpt_moe.make_apply_ep(cfg, mesh))(params, ids))
+    np.testing.assert_allclose(ep, dense, rtol=2e-4, atol=2e-4)
+
+
+@pytest.mark.parametrize("num_parts", [1, 2])
+def test_partition_parity(moe_setup, num_parts):
+    spec, params, ids = moe_setup
+    h = ids
+    for stage in spec.partition(num_parts):
+        h = stage.apply(stage.slice_params(params), h)
+    np.testing.assert_allclose(
+        np.asarray(h), np.asarray(spec.apply(params, ids)), rtol=1e-5, atol=1e-5
+    )
+
+
+def test_param_keys_cover_model(moe_setup):
+    spec, params, _ = moe_setup
+    for n in (1, 2):
+        keys = [k for s in spec.partition(n) for k in s.param_keys]
+        assert sorted(keys) == sorted(params)
+
+
+def test_ep_train_step_smoke(moe_setup):
+    """grad of an EP-forward LM loss flows into expert + router weights."""
+    spec, params, ids = moe_setup
+    cfg = spec.config
+    mesh = make_mesh({EXPERT_AXIS: 2}, jax.devices()[:2])
+    ep_apply = gpt_moe.make_apply_ep(cfg, mesh)
+
+    def loss_fn(p):
+        logits = ep_apply(p, ids)
+        tgt = jnp.roll(ids, -1, axis=1)
+        lp = jax.nn.log_softmax(logits, axis=-1)
+        return -jnp.take_along_axis(lp, tgt[..., None], axis=-1).mean()
+
+    loss, g = jax.value_and_grad(loss_fn)(params)
+    assert np.isfinite(float(loss))
+    assert float(jnp.abs(g["h_0"]["moe"]["wi"]).sum()) > 0
+    assert float(jnp.abs(g["h_0"]["moe"]["router"]["kernel"]).sum()) > 0
+
+
+def test_registry_presets():
+    spec = get_model("gpt2-moe-test")
+    assert spec.config.n_experts == 4
+    assert "make_apply_ep" in spec.extras
+
+
+def test_engine_serves_moe_by_config():
+    """The engine must NOT route GPTMoEConfig into the dense-GPT stacked
+    runtime (whose blocks read params['mlp']); the generic partitioned
+    path serves it."""
+    from dnn_tpu.config import TopologyConfig
+    from dnn_tpu.runtime.engine import PipelineEngine
+
+    cfg = TopologyConfig.from_dict({
+        "nodes": [{"id": f"n{i}", "part_index": i} for i in range(2)],
+        "num_parts": 2,
+        "model": "gpt2-moe-test",
+        "device_type": "cpu",
+        "runtime": "spmd",
+        # microbatching changes MoE routing groups (each microbatch routes
+        # independently — see gpt_moe.make_partition); parity vs the dense
+        # forward needs the whole batch as one group
+        "microbatches": 1,
+    })
+    eng = PipelineEngine(cfg, rng_seed=0)
+    ids = np.asarray(eng.spec.example_input(batch_size=2, seq_len=8))
+    np.testing.assert_allclose(
+        np.asarray(eng.run(ids)),
+        np.asarray(eng.spec.apply(eng.params, ids)),
+        rtol=1e-4, atol=1e-4,
+    )
+
+
+def test_ep_accepts_prepared_params(moe_setup):
+    """Pre-stacked params ({"blocks": ...}) skip the per-call restack."""
+    spec, params, ids = moe_setup
+    cfg = spec.config
+    from dnn_tpu.models.gpt import prepare_stacked
+
+    mesh = make_mesh({EXPERT_AXIS: 2}, jax.devices()[:2])
+    ep = gpt_moe.make_apply_ep(cfg, mesh)
+    raw = np.asarray(ep(params, ids))
+    prepped = np.asarray(ep(prepare_stacked(params, cfg), ids))
+    np.testing.assert_array_equal(raw, prepped)

@@ -25,6 +25,20 @@ def test_forward_shape(gpt_setup):
     assert logits.shape == (2, 16, spec.config.vocab_size)
 
 
+def test_bf16_logits_close_to_f32(gpt_setup):
+    """logits_dtype=bf16 (the serving/bench configuration) is the f32
+    forward rounded on the way out: accumulation stays f32, so values
+    differ only by final-rounding (~0.4% relative for bf16)."""
+    spec, params, x = gpt_setup
+    cfg = spec.config
+    prepared = gpt.prepare_stacked(params, cfg)
+    y32 = np.asarray(gpt.make_apply_stacked(cfg)(prepared, x), np.float32)
+    y16 = gpt.make_apply_stacked(cfg, logits_dtype=jnp.bfloat16)(prepared, x)
+    assert y16.dtype == jnp.bfloat16
+    np.testing.assert_allclose(np.asarray(y16, np.float32), y32,
+                               rtol=8e-3, atol=8e-3)
+
+
 @pytest.mark.parametrize("num_parts", [1, 2, 3, 4])
 def test_partition_parity(gpt_setup, num_parts):
     """Composed stage pipeline == full model (the reference's implied

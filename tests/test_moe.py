@@ -1,0 +1,154 @@
+"""MoE FFN + expert parallelism.
+
+Key invariants: (1) routing respects top_k and capacity; (2) the dense
+grouped path equals a slow per-token reference; (3) the expert-parallel
+shard_map path (all_to_all dispatch over the "expert" mesh axis) equals
+the dense path with groups == n_devices — the parity contract that makes
+EP a placement decision, not a semantics change; (4) the layer is
+differentiable (it trains)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dnn_tpu.ops.nn import gelu
+from dnn_tpu.parallel.mesh import EXPERT_AXIS, make_mesh
+from dnn_tpu.parallel.moe import (
+    init_moe,
+    load_balance_loss,
+    make_moe_ffn_ep,
+    moe_capacity,
+    moe_ffn,
+    route_topk,
+)
+
+D, E, F = 16, 4, 32
+
+
+@pytest.fixture(scope="module")
+def moe_params():
+    return init_moe(jax.random.PRNGKey(0), D, E, F)
+
+
+def test_route_topk_respects_k_and_capacity():
+    s, cap, k = 32, 3, 2
+    logits = jax.random.normal(jax.random.PRNGKey(1), (s, E))
+    dispatch, combine, aux = route_topk(logits, top_k=k, capacity=cap)
+    d = np.asarray(dispatch)
+    # each token occupies at most k slots, each slot at most one token-weight
+    assert d.sum(axis=(1, 2)).max() <= k
+    assert d.max() == 1.0
+    # no expert slot is double-booked
+    assert d.sum(axis=0).max() <= 1.0 + 1e-6
+    # capacity: at most cap tokens land on any expert
+    assert d.sum(axis=(0, 2)).max() <= cap
+    # combine weights live exactly on dispatched slots
+    c = np.asarray(combine)
+    assert ((c > 0) <= (d > 0)).all()
+    # kept tokens' weights are normalized over their kept experts
+    kept_w = c.sum(axis=(1, 2))
+    full = d.sum(axis=(1, 2)) == k  # tokens with all k slots kept
+    np.testing.assert_allclose(kept_w[full], 1.0, rtol=1e-5)
+    assert aux["load"].shape == (E,) and aux["importance"].shape == (E,)
+
+
+def test_route_deterministic_order():
+    logits = jax.random.normal(jax.random.PRNGKey(2), (16, E))
+    a = route_topk(logits, top_k=2, capacity=4)[0]
+    b = route_topk(logits, top_k=2, capacity=4)[0]
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def _slow_reference(params, x, *, top_k, capacity):
+    """Per-token numpy re-implementation of grouped routing + expert FFN
+    (groups=1). Independent code path: loops, no one-hot einsums."""
+    xt = np.asarray(x, np.float64).reshape(-1, x.shape[-1])
+    router = np.asarray(params["router"]["kernel"], np.float64)
+    wi, bi = np.asarray(params["wi"], np.float64), np.asarray(params["bi"], np.float64)
+    wo, bo = np.asarray(params["wo"], np.float64), np.asarray(params["bo"], np.float64)
+    logits = xt @ router
+    probs = np.exp(logits - logits.max(-1, keepdims=True))
+    probs /= probs.sum(-1, keepdims=True)
+
+    s = xt.shape[0]
+    counts = np.zeros(E, int)
+    assign = [[] for _ in range(s)]  # (expert, weight) kept pairs
+    rem = probs.copy()
+    for _ in range(top_k):
+        sel = rem.argmax(-1)
+        for t in range(s):
+            e = sel[t]
+            if counts[e] < capacity:
+                assign[t].append((e, probs[t, e]))
+            counts[e] += 1
+        # recompute counts pass-by-round like route_topk: positions count
+        # every selection, kept or not — replicate by NOT rolling back
+        for t in range(s):
+            rem[t, sel[t]] = 0.0
+    y = np.zeros_like(xt)
+    for t in range(s):
+        wsum = sum(w for _, w in assign[t])
+        if wsum <= 0:
+            continue
+        for e, w in assign[t]:
+            h = xt[t] @ wi[e] + bi[e]
+            h = np.asarray(gelu(jnp.asarray(h, jnp.float32)), np.float64)
+            o = h @ wo[e] + bo[e]
+            y[t] += (w / wsum) * o
+    return y.reshape(x.shape)
+
+
+def test_dense_matches_slow_reference(moe_params):
+    """The einsum dispatch path == an independent per-token loop."""
+    b, t = 2, 8
+    x = jax.random.normal(jax.random.PRNGKey(3), (b, t, D), jnp.float32)
+    cap = moe_capacity(b * t, E, 2, 1.25)
+    got = np.asarray(moe_ffn(moe_params, x, top_k=2, capacity_factor=1.25, groups=1))
+    want = _slow_reference(moe_params, x, top_k=2, capacity=cap)
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-5)
+
+
+def test_overflow_drops_to_zero(moe_params):
+    """With capacity 1 and many tokens, overflow tokens produce zero output
+    (callers' residual passes them through)."""
+    x = jnp.ones((1, 16, D))  # identical tokens -> identical routing -> overflow
+    y = moe_ffn(moe_params, x, top_k=1, capacity_factor=1.0 / 16.0)
+    yn = np.asarray(y)
+    # capacity is 1: exactly one token per selected expert got computed
+    nonzero = (np.abs(yn.reshape(16, D)).sum(-1) > 1e-6).sum()
+    assert nonzero <= 2  # top-1 of identical tokens: <= 1 expert used (+fp ties)
+
+
+@pytest.mark.parametrize("n_dev", [2, 4])
+def test_ep_matches_dense(moe_params, n_dev):
+    """shard_map EP over the expert axis == dense grouped path, exactly
+    (same routing groups, same capacity)."""
+    mesh = make_mesh({EXPERT_AXIS: n_dev}, jax.devices()[:n_dev])
+    b, t = n_dev * 2, 4
+    x = jax.random.normal(jax.random.PRNGKey(4), (b, t, D), jnp.float32)
+    dense = np.asarray(moe_ffn(moe_params, x, top_k=2, groups=n_dev))
+    ep_fn = make_moe_ffn_ep(mesh, top_k=2)
+    ep = np.asarray(jax.jit(ep_fn)(moe_params, x))
+    np.testing.assert_allclose(ep, dense, rtol=1e-5, atol=1e-6)
+
+
+def test_ep_grad_flows(moe_params):
+    """The EP layer trains: grads flow through routing + all_to_all."""
+    mesh = make_mesh({EXPERT_AXIS: 2}, jax.devices()[:2])
+    x = jax.random.normal(jax.random.PRNGKey(5), (4, 4, D), jnp.float32)
+    ep_fn = make_moe_ffn_ep(mesh, top_k=2)
+
+    def loss(p):
+        return jnp.mean(ep_fn(p, x) ** 2)
+
+    g = jax.grad(loss)(moe_params)
+    gn = sum(float(jnp.abs(l).sum()) for l in jax.tree.leaves(g))
+    assert np.isfinite(gn) and gn > 0
+    # expert weights receive gradient
+    assert float(jnp.abs(g["wi"]).sum()) > 0
+
+
+def test_load_balance_loss_uniform_is_one():
+    aux = {"load": jnp.full((E,), 1.0 / E), "importance": jnp.full((E,), 1.0 / E)}
+    np.testing.assert_allclose(float(load_balance_loss(aux)), 1.0, rtol=1e-6)

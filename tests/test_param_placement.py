@@ -78,6 +78,25 @@ def test_default_placement_works_with_traced_params():
     )
 
 
+def test_explicit_stage_with_traced_params_raises():
+    """An explicit 'stage' request is never silently downgraded: traced
+    params without packed= are an error pointing at the fix."""
+    spec = get_model("cifar_cnn")
+    params = spec.init(jax.random.PRNGKey(6))
+    stages = spec.partition(2)
+    sp = [s.slice_params(params) for s in stages]
+    mesh = Mesh(np.array(jax.devices()[:2]), (STAGE_AXIS,))
+    x = jnp.asarray(spec.example_input(batch_size=4))
+    fns = [s.apply for s in stages]
+    with pytest.raises(ValueError, match="impossible mid-trace"):
+        jax.jit(
+            lambda sp_, x_: spmd_pipeline(
+                fns, sp_, x_, mesh=mesh, num_microbatches=2,
+                param_placement="stage",
+            )
+        )(sp, x)
+
+
 def test_pack_rejects_lossy_f64_mix():
     sp = [{"w": np.ones((2,), np.float64), "b": np.ones((2,), np.float32)}]
     with pytest.raises(ValueError, match="truncate"):
