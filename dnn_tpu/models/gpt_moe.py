@@ -93,20 +93,31 @@ def init(rng, cfg: GPTMoEConfig = PRESETS["gpt2-moe"], dtype=jnp.float32):
     return params
 
 
-def block_apply(block_params, x, *, cfg: GPTMoEConfig, groups: int = 1,
-                compute_dtype=None):
-    """Pre-LN block: causal MHA + routed MoE FFN, both residual."""
+def _block_core(block_params, x, ffn_fn, *, cfg: GPTMoEConfig, compute_dtype=None):
+    """Pre-LN block: causal MHA + a pluggable FFN (dense-routed or
+    expert-parallel), both residual. ONE definition for both execution
+    paths — the dense==EP parity invariant depends on them never
+    diverging."""
     h = layer_norm(block_params["ln_1"], x, eps=cfg.ln_eps)
     x = x + causal_self_attention(
         block_params["attn"], h, n_head=cfg.n_head, compute_dtype=compute_dtype
     )
     h = layer_norm(block_params["ln_2"], x, eps=cfg.ln_eps)
-    m = moe_ffn(
-        block_params["moe"], h, top_k=cfg.top_k,
-        capacity_factor=cfg.capacity_factor, groups=groups,
-        compute_dtype=compute_dtype,
-    )
+    m = ffn_fn(block_params["moe"], h)
     return x + m.astype(x.dtype)
+
+
+def block_apply(block_params, x, *, cfg: GPTMoEConfig, groups: int = 1,
+                compute_dtype=None):
+    """Dense-path block: the FFN routes locally in `groups` groups."""
+    return _block_core(
+        block_params, x,
+        lambda mp, h: moe_ffn(
+            mp, h, top_k=cfg.top_k, capacity_factor=cfg.capacity_factor,
+            groups=groups, compute_dtype=compute_dtype,
+        ),
+        cfg=cfg, compute_dtype=compute_dtype,
+    )
 
 
 def _blocks_scan(stacked, x, *, cfg, groups, compute_dtype):
@@ -178,20 +189,16 @@ def make_apply_ep(cfg: GPTMoEConfig, mesh, *, axis_name: str = EXPERT_AXIS,
         s = b_local * t  # this device's tokens = one routing group
         capacity = moe_capacity(s, cfg.n_experts, cfg.top_k, cfg.capacity_factor)
 
-        def body(carry, layer_params):
-            h = layer_norm(layer_params["ln_1"], carry, eps=cfg.ln_eps)
-            carry = carry + causal_self_attention(
-                layer_params["attn"], h, n_head=cfg.n_head,
-                compute_dtype=compute_dtype,
-            )
-            h = layer_norm(layer_params["ln_2"], carry, eps=cfg.ln_eps)
+        def ep_ffn(mp, h):
             d = h.shape[-1]
-            m = moe_ffn_local(
-                layer_params["moe"], h.reshape(-1, d), top_k=cfg.top_k,
-                capacity=capacity, axis_name=axis_name,
-                compute_dtype=compute_dtype,
+            return moe_ffn_local(
+                mp, h.reshape(-1, d), top_k=cfg.top_k, capacity=capacity,
+                axis_name=axis_name, compute_dtype=compute_dtype,
             ).reshape(h.shape)
-            return carry + m.astype(carry.dtype), None
+
+        def body(carry, layer_params):
+            return _block_core(layer_params, carry, ep_ffn, cfg=cfg,
+                               compute_dtype=compute_dtype), None
 
         x, _ = jax.lax.scan(body, x, prep_local["blocks"])
         return gpt.head(prep_local, x.astype(jnp.float32), cfg=cfg,

@@ -110,16 +110,21 @@ def route_topk(gate_logits, *, top_k: int, capacity: int, normalize: bool = True
         combine = combine + dispatch * (keep * slot_w[:, None])[..., None]
 
     aux = {
-        "load": dispatch.sum(axis=(0, 2)) / s,          # realized fraction per expert
+        # realized fraction of SELECTIONS per expert (normalized by k*S, so
+        # it sums to <= 1 and uniform routing gives exactly 1/E per expert
+        # for any top_k — the convention load_balance_loss assumes)
+        "load": dispatch.sum(axis=(0, 2)) / (s * top_k),
         "importance": probs.mean(axis=0),               # mean router prob per expert
     }
     return dispatch, combine, aux
 
 
 def load_balance_loss(aux) -> jax.Array:
-    """Switch-Transformer load-balance term: E * <load, importance>. Equals
-    1.0 under perfectly uniform routing; add `alpha * (loss - 1.0)` (alpha
-    ~1e-2) to the training objective to keep experts busy."""
+    """Switch-Transformer load-balance term: E * <load, importance>, with
+    `load` the per-expert fraction of selections (normalized by k — see
+    route_topk's aux). Equals 1.0 under perfectly uniform routing for any
+    top_k; add `alpha * (loss - 1.0)` (alpha ~1e-2) to the training
+    objective to keep experts busy."""
     e = aux["load"].shape[-1]
     return e * jnp.sum(aux["load"] * aux["importance"], axis=-1).mean()
 
@@ -196,6 +201,12 @@ def moe_ffn_local(params_local, xg, *, top_k, capacity, axis_name,
         params_local, xg, top_k=top_k, capacity=capacity, normalize=True,
     )
     expert_in = jnp.einsum("sec,sd->ecd", dispatch, xg.astype(jnp.float32))
+    if compute_dtype is not None:
+        # round BEFORE the hop: _expert_ffn casts to compute_dtype anyway,
+        # and rounding commutes with the permutation, so this halves the
+        # dispatch collective's ICI bytes with bit-identical output vs the
+        # dense path (which rounds the same values device-locally)
+        expert_in = expert_in.astype(compute_dtype)
     # (E, cap, D) -> (E/n, n*cap, D): send each expert-block to its owner,
     # gather every device's tokens for my experts
     expert_in = jax.lax.all_to_all(

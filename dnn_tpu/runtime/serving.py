@@ -1,0 +1,257 @@
+"""Continuous-batching decode server for the GPT family.
+
+The reference serves exactly one request per pipeline traversal — a
+single stateless forward with no decode at all (SURVEY §3.2-3.3,
+/root/reference/node.py:137-200). `runtime/generate.py` already rebuilds
+batch decode; this module adds the modern serving layer on top:
+CONTINUOUS BATCHING — a fixed pool of decode slots over one static-shape
+KV cache, where requests enter (prefill into a free slot) and leave
+(EOS / token budget) independently while the other slots keep decoding.
+Throughput stays at full batch width without waiting for stragglers.
+
+TPU-first mechanics (everything static under jit, two compiled programs
+total):
+
+  * ONE decode step program for the whole pool: every slot advances one
+    token per call. Per-slot sequence positions live in a (B,) vector;
+    K/V writes land at each row's own position (vmap'd dynamic update —
+    rows are independent), attention masks each row against its own
+    length, inactive slots are fully masked no-ops.
+  * ONE prefill program: prompts are right-padded to a fixed bucket
+    length; pad positions write garbage K/V that is never attended (the
+    per-row position mask stops at the true length) and is overwritten as
+    the sequence grows through it. The first sampled token comes from the
+    logit row at the true last prompt position.
+  * Slot bookkeeping (which request owns which slot, emitted tokens, EOS)
+    is plain host Python — it changes per request, so it must not live
+    inside the compiled graphs.
+
+Numerics are the same ops as `make_generate` (same embed/block/head
+path), so a slot's token stream is identical to a solo batch-1 run of the
+same prompt — the isolation + parity contract `tests/test_serving.py`
+pins (one request's tokens never depend on what else is in the pool).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from dnn_tpu.models.gpt import GPTConfig, head
+from dnn_tpu.ops.attention import merge_heads
+from dnn_tpu.ops.nn import gelu, layer_norm, linear
+from dnn_tpu.runtime.generate import (
+    _NEG_BIG,
+    _qkv_heads,
+    _sample,
+    forward_with_cache,
+    init_cache,
+)
+
+
+def _write_kv_rows(cache, new, pos):
+    """cache (B,H,S,D) <- new (B,H,1,D) at per-row positions pos (B,)."""
+    return jax.vmap(
+        lambda c, n, p: lax.dynamic_update_slice_in_dim(c, n, p, axis=1)
+    )(cache, new, pos)
+
+
+def _attend_rows(q, k_cache, v_cache, pos):
+    """q (B,H,1,D) against (B,H,S,D), each row masked to keys at positions
+    <= its own pos (B,) — the per-slot analog of generate._attend_cache."""
+    d = q.shape[-1]
+    s = jnp.einsum("bhtd,bhsd->bhts", q, k_cache).astype(jnp.float32) / jnp.sqrt(d)
+    cols = jnp.arange(k_cache.shape[2])
+    mask = cols[None, None, None, :] <= pos[:, None, None, None]
+    s = jnp.where(mask, s, _NEG_BIG)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhts,bhsd->bhtd", p.astype(v_cache.dtype), v_cache)
+
+
+def _decode_block_rows(bp, x, k_cache, v_cache, pos, write, *, cfg, compute_dtype):
+    """One block over x (B,1,C) with per-row positions. `write` (B,) bool
+    gates the cache update (inactive slots must not touch their rows)."""
+    h = layer_norm(bp["ln_1"], x, eps=cfg.ln_eps)
+    q, k, v = _qkv_heads(bp, h, cfg=cfg, compute_dtype=compute_dtype)
+    k_new = _write_kv_rows(k_cache, k.astype(k_cache.dtype), pos)
+    v_new = _write_kv_rows(v_cache, v.astype(v_cache.dtype), pos)
+    w = write[:, None, None, None]
+    k_cache = jnp.where(w, k_new, k_cache)
+    v_cache = jnp.where(w, v_new, v_cache)
+    y = _attend_rows(q, k_cache, v_cache, pos)
+    x = x + linear(bp["attn"]["proj"], merge_heads(y.astype(x.dtype)),
+                   compute_dtype=compute_dtype)
+    h = layer_norm(bp["ln_2"], x, eps=cfg.ln_eps)
+    m = linear(bp["mlp"]["proj"], gelu(linear(bp["mlp"]["fc"], h, compute_dtype=compute_dtype)),
+               compute_dtype=compute_dtype)
+    return x + m, k_cache, v_cache
+
+
+class ContinuousBatcher:
+    """Slot-pool decode server. `slots` concurrent sequences over one
+    static cache of `max_len` positions; prompts are padded to
+    `prompt_pad` (one prefill compilation for all requests).
+
+    Usage:
+        srv = ContinuousBatcher(cfg, prepared, slots=4, max_len=96)
+        rid = srv.submit(prompt_ids, max_new_tokens=32)   # needs a free slot
+        srv.step()       # every active slot advances one token
+        srv.drain()      # run to completion -> {rid: np.ndarray tokens}
+    """
+
+    def __init__(self, cfg: GPTConfig, prepared, *, slots: int = 4,
+                 max_len: Optional[int] = None, prompt_pad: Optional[int] = None,
+                 temperature: float = 0.0, top_k: Optional[int] = None,
+                 compute_dtype=None, eos_id: Optional[int] = None, seed: int = 0):
+        self.cfg = cfg
+        self.prepared = prepared
+        self.slots = slots
+        self.max_len = min(max_len or cfg.block_size, cfg.block_size)
+        self.prompt_pad = prompt_pad or min(64, self.max_len)
+        self.eos_id = eos_id
+        self._rng = jax.random.PRNGKey(seed)
+        cache_dtype = compute_dtype or jnp.float32
+
+        # device state (functional updates)
+        self.cache = init_cache(cfg, slots, self.max_len, cache_dtype)
+        self.pos = jnp.zeros((slots,), jnp.int32)      # next write position
+        self.tok = jnp.zeros((slots,), jnp.int32)      # last sampled token
+        self.active = jnp.zeros((slots,), bool)
+
+        # host bookkeeping
+        self._next_rid = 0
+        self._slot_req: List[Optional[dict]] = [None] * slots
+        self.results: Dict[int, np.ndarray] = {}
+
+        def decode_step(prepared, cache, pos, tok, active, rng):
+            """Advance every active slot one token."""
+            # embed each slot's last token at its own position
+            x = jnp.take(prepared["wte"]["embedding"], tok[:, None], axis=0) + \
+                prepared["wpe"]["embedding"][pos][:, None, :]
+            if compute_dtype is not None:
+                x = x.astype(compute_dtype)
+
+            def layer(carry, layer_in):
+                bp, k_c, v_c = layer_in
+                y, k_c, v_c = _decode_block_rows(
+                    bp, carry, k_c, v_c, pos, active, cfg=cfg,
+                    compute_dtype=compute_dtype,
+                )
+                return y, (k_c, v_c)
+
+            x, (k_new, v_new) = lax.scan(
+                layer, x, (prepared["blocks"], cache["k"], cache["v"])
+            )
+            logits = head(prepared, x.astype(jnp.float32), cfg=cfg,
+                          compute_dtype=compute_dtype)
+            nxt = _sample(logits[:, -1], rng, temperature=temperature, top_k=top_k)
+            nxt = jnp.where(active, nxt, tok)
+            return {"k": k_new, "v": v_new}, pos + active.astype(jnp.int32), nxt
+
+        def prefill(prepared, cache, padded, true_len, slot, rng):
+            """Prefill one slot: padded (1, P) prompt, true_len real tokens.
+            Returns (cache, first_token). Pad positions beyond true_len
+            write K/V that the per-row position mask never attends."""
+            row = init_cache(cfg, 1, self.max_len, cache_dtype)
+            logits, row = forward_with_cache(
+                prepared, padded, row, 0, cfg=cfg, compute_dtype=compute_dtype
+            )
+            first = _sample(
+                logits[:, true_len - 1][0:1], rng,
+                temperature=temperature, top_k=top_k,
+            )[0]
+            cache = {
+                kk: lax.dynamic_update_slice_in_dim(cache[kk], row[kk], slot, axis=1)
+                for kk in ("k", "v")
+            }
+            return cache, first
+
+        self._decode = jax.jit(decode_step)
+        self._prefill = jax.jit(prefill)
+
+    # ------------------------------------------------------------------
+
+    def free_slots(self) -> int:
+        return sum(r is None for r in self._slot_req)
+
+    @property
+    def n_active(self) -> int:
+        return sum(r is not None for r in self._slot_req)
+
+    def submit(self, prompt, max_new_tokens: int) -> int:
+        """Prefill `prompt` (1-D int array) into a free slot; returns the
+        request id. The first token is sampled during prefill and counts
+        toward max_new_tokens."""
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        if len(prompt) == 0 or len(prompt) > self.prompt_pad:
+            raise ValueError(
+                f"prompt length {len(prompt)} not in [1, {self.prompt_pad}]"
+            )
+        if len(prompt) + max_new_tokens > self.max_len:
+            raise ValueError(
+                f"prompt {len(prompt)} + max_new_tokens {max_new_tokens} "
+                f"exceeds max_len {self.max_len}"
+            )
+        try:
+            slot = self._slot_req.index(None)
+        except ValueError:
+            raise RuntimeError("no free slot; call step()/drain() first") from None
+
+        padded = np.zeros((1, self.prompt_pad), np.int32)
+        padded[0, : len(prompt)] = prompt
+        self._rng, sub = jax.random.split(self._rng)
+        self.cache, first = self._prefill(
+            self.prepared, self.cache, jnp.asarray(padded), len(prompt),
+            slot, sub,
+        )
+        first = int(first)
+        self.pos = self.pos.at[slot].set(len(prompt))
+        self.tok = self.tok.at[slot].set(first)
+        self.active = self.active.at[slot].set(True)
+
+        rid = self._next_rid
+        self._next_rid += 1
+        self._slot_req[slot] = {"rid": rid, "emitted": [first],
+                                "budget": max_new_tokens}
+        self._retire_if_done(slot)
+        return rid
+
+    def _retire_if_done(self, slot: int):
+        req = self._slot_req[slot]
+        done = len(req["emitted"]) >= req["budget"] or (
+            self.eos_id is not None and req["emitted"][-1] == self.eos_id
+        )
+        if done:
+            self.results[req["rid"]] = np.asarray(req["emitted"], np.int32)
+            self._slot_req[slot] = None
+            self.active = self.active.at[slot].set(False)
+
+    def step(self) -> Dict[int, int]:
+        """One decode step for every active slot. Returns {rid: new_token}
+        for slots that advanced; finished requests move to .results."""
+        if self.n_active == 0:
+            return {}
+        self._rng, sub = jax.random.split(self._rng)
+        self.cache, self.pos, self.tok = self._decode(
+            self.prepared, self.cache, self.pos, self.tok, self.active, sub
+        )
+        toks = np.asarray(self.tok)
+        out = {}
+        for slot, req in enumerate(self._slot_req):
+            if req is None:
+                continue
+            token = int(toks[slot])
+            req["emitted"].append(token)
+            out[req["rid"]] = token
+            self._retire_if_done(slot)
+        return out
+
+    def drain(self) -> Dict[int, np.ndarray]:
+        """Run until every submitted request finishes; returns .results."""
+        while self.n_active:
+            self.step()
+        return self.results

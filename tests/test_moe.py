@@ -152,3 +152,16 @@ def test_ep_grad_flows(moe_params):
 def test_load_balance_loss_uniform_is_one():
     aux = {"load": jnp.full((E,), 1.0 / E), "importance": jnp.full((E,), 1.0 / E)}
     np.testing.assert_allclose(float(load_balance_loss(aux)), 1.0, rtol=1e-6)
+
+
+def test_load_normalized_by_top_k():
+    """aux['load'] is the fraction of SELECTIONS (normalized by k*S): under
+    perfectly uniform top-2 routing every expert reports 1/E, so the
+    balance loss's 1.0 floor holds for any k — the k=2 case the formula's
+    docstring promises."""
+    s = E  # one token per expert
+    # token i strongly prefers expert i, second-prefers expert (i+1) % E
+    logits = jnp.log(jnp.eye(E) * 8 + jnp.roll(jnp.eye(E), 1, axis=1) * 4 + 1e-4)
+    dispatch, _, aux = route_topk(logits, top_k=2, capacity=2)
+    assert np.asarray(dispatch).sum() == 2 * s  # nothing dropped
+    np.testing.assert_allclose(np.asarray(aux["load"]), 1.0 / E, atol=1e-6)
