@@ -1,5 +1,7 @@
 from dnn_tpu.data.cifar_binary import CifarBinaryDataset
 from dnn_tpu.data.tokens import TokenDataset
 from dnn_tpu.data.prefetch import prefetch_to_device
+from dnn_tpu.data.async_loader import AsyncCifarLoader
 
-__all__ = ["CifarBinaryDataset", "TokenDataset", "prefetch_to_device"]
+__all__ = ["CifarBinaryDataset", "TokenDataset", "prefetch_to_device",
+           "AsyncCifarLoader"]

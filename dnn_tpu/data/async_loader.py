@@ -1,0 +1,99 @@
+"""Async CIFAR batch loader backed by the native (C++) decoder.
+
+`CifarBinaryDataset.batches` decodes records on the calling thread, so
+host preprocessing serializes with TPU steps. `AsyncCifarLoader` moves
+decode + normalize onto a C++ background thread (dnn_tpu/native/
+loader.cpp) feeding a bounded ring of ready batches — the training loop's
+`next()` is a memcpy. When the native library can't build (no g++), it
+degrades to the Python loader with identical batch contents for
+shuffle=False (bit-for-bit; the shuffled permutation sequence differs —
+splitmix64 Fisher-Yates vs numpy Generator — with per-epoch full coverage
+either way).
+"""
+
+from __future__ import annotations
+
+import ctypes
+from typing import Iterator, Sequence, Tuple
+
+import numpy as np
+
+from dnn_tpu.data.cifar_binary import RECORD_BYTES, CifarBinaryDataset
+
+
+class AsyncCifarLoader:
+    """Iterator of (images (B,32,32,3) f32 normalized, labels (B,) i32),
+    repeating epochs forever. Use as a context manager (or call close())
+    to stop the background thread."""
+
+    def __init__(self, files: Sequence[str], batch_size: int, *,
+                 shuffle: bool = True, seed: int = 0, queue_depth: int = 4):
+        self.batch_size = int(batch_size)
+        self._ds = CifarBinaryDataset(files)
+        if self.batch_size > len(self._ds):
+            raise ValueError(
+                f"batch_size {batch_size} > dataset size {len(self._ds)}"
+            )
+        self._handle = None
+        self._fallback = None
+
+        from dnn_tpu import native
+
+        lib = native.loader_lib()
+        if lib is not None:
+            # C++ copies the records during create; the local ref keeps the
+            # buffer alive across the call
+            blob = np.ascontiguousarray(self._ds._records).reshape(-1)
+            assert blob.nbytes == len(self._ds) * RECORD_BYTES
+            handle = lib.dnn_loader_create(
+                blob.ctypes.data_as(ctypes.c_void_p), len(self._ds),
+                self.batch_size, seed, int(bool(shuffle)), queue_depth,
+            )
+            if handle:
+                self._handle = ctypes.c_void_p(handle)
+                self._lib = lib
+        if self._handle is None:
+            self._fallback = self._ds.batches(
+                self.batch_size, shuffle=shuffle, seed=seed, epochs=None
+            )
+
+    @property
+    def native(self) -> bool:
+        return self._handle is not None
+
+    def __iter__(self) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
+        return self
+
+    def __next__(self) -> Tuple[np.ndarray, np.ndarray]:
+        if self._fallback is not None:
+            return next(self._fallback)
+        if self._handle is None:
+            raise RuntimeError("loader is closed")
+        imgs = np.empty((self.batch_size, 32, 32, 3), np.float32)
+        labels = np.empty((self.batch_size,), np.int32)
+        rc = self._lib.dnn_loader_next(
+            self._handle,
+            imgs.ctypes.data_as(ctypes.c_void_p),
+            labels.ctypes.data_as(ctypes.c_void_p),
+        )
+        if rc != 0:
+            raise RuntimeError(f"native loader stopped (rc={rc})")
+        return imgs, labels
+
+    def close(self):
+        if self._handle is not None:
+            self._lib.dnn_loader_destroy(self._handle)
+            self._handle = None
+        self._fallback = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

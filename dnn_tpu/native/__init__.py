@@ -29,43 +29,53 @@ import numpy as np
 log = logging.getLogger("dnn_tpu.native")
 
 _SRC = os.path.join(os.path.dirname(__file__), "codec.cpp")
+_LOADER_SRC = os.path.join(os.path.dirname(__file__), "loader.cpp")
 _LIB: Optional[ctypes.CDLL] = None
 _TRIED = False
+_LOADER_LIB: Optional[ctypes.CDLL] = None
+_LOADER_TRIED = False
 
 
-def _build() -> Optional[str]:
-    """Compile (or locate the cached) .so; None means 'use the Python
-    fallback'. ANY environment problem — missing source in a wheel install,
-    read-only site-packages, missing g++ — must degrade, not raise."""
+def _build_src(src: str, stem: str, extra_flags=()) -> Optional[str]:
+    """Compile (or locate the cached) .so for `src`; None means 'use the
+    Python fallback'. ANY environment problem — missing source in a wheel
+    install, read-only site-packages, missing g++ — must degrade, not
+    raise."""
     tmp = None
     try:
         # key the cache on source mtime so edits rebuild automatically
-        src_dir = os.path.dirname(_SRC)
-        tag = int(os.stat(_SRC).st_mtime)
-        so = os.path.join(src_dir, f"_codec_{tag}.so")
+        src_dir = os.path.dirname(src)
+        tag = int(os.stat(src).st_mtime)
+        so = os.path.join(src_dir, f"_{stem}_{tag}.so")
         if os.path.exists(so):
             return so
         # stale caches from earlier source versions
         for name in os.listdir(src_dir):
-            if name.startswith("_codec_") and name.endswith(".so"):
+            if name.startswith(f"_{stem}_") and name.endswith(".so"):
                 try:
                     os.unlink(os.path.join(src_dir, name))
                 except OSError:
                     pass
         fd, tmp = tempfile.mkstemp(suffix=".so", dir=src_dir)
         os.close(fd)
-        cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", _SRC, "-o", tmp]
+        cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17",
+               *extra_flags, src, "-o", tmp]
         subprocess.run(cmd, check=True, capture_output=True, timeout=120)
         os.replace(tmp, so)
         return so
     except (subprocess.SubprocessError, OSError) as e:
-        log.info("native codec build unavailable (%s); using Python fallback", e)
+        log.info("native %s build unavailable (%s); using Python fallback",
+                 stem, e)
         try:
             if tmp and os.path.exists(tmp):
                 os.unlink(tmp)
         except OSError:
             pass
         return None
+
+
+def _build() -> Optional[str]:
+    return _build_src(_SRC, "codec")
 
 
 def _lib() -> Optional[ctypes.CDLL]:
@@ -93,6 +103,38 @@ def _lib() -> Optional[ctypes.CDLL]:
 
 def native_available() -> bool:
     return _lib() is not None
+
+
+def loader_lib() -> Optional[ctypes.CDLL]:
+    """The async-loader library (loader.cpp), or None -> Python fallback.
+    Built separately from the codec (needs -pthread)."""
+    global _LOADER_LIB, _LOADER_TRIED
+    if _LOADER_TRIED:
+        return _LOADER_LIB
+    _LOADER_TRIED = True
+    so = _build_src(_LOADER_SRC, "loader", extra_flags=("-pthread",))
+    if so is None:
+        return None
+    try:
+        lib = ctypes.CDLL(so)
+    except OSError as e:
+        log.info("native loader load failed (%s); using Python fallback", e)
+        return None
+    lib.dnn_loader_create.restype = ctypes.c_void_p
+    lib.dnn_loader_create.argtypes = [
+        ctypes.c_void_p, ctypes.c_uint64, ctypes.c_int,
+        ctypes.c_uint64, ctypes.c_int, ctypes.c_uint64,
+    ]
+    lib.dnn_loader_next.restype = ctypes.c_int
+    lib.dnn_loader_next.argtypes = [ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p]
+    lib.dnn_loader_destroy.restype = None
+    lib.dnn_loader_destroy.argtypes = [ctypes.c_void_p]
+    _LOADER_LIB = lib
+    return _LOADER_LIB
+
+
+def loader_available() -> bool:
+    return loader_lib() is not None
 
 
 # ----------------------------------------------------------------------

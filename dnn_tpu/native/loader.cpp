@@ -1,0 +1,169 @@
+// Native async data loader: CIFAR-10 binary batches decoded + normalized on
+// background threads into a bounded ring of ready batches.
+//
+// The reference has no input pipeline at all (its only input is one PIL
+// image per request — /root/reference/node.py:142-154); the Python loader
+// (dnn_tpu/data/cifar_binary.py) supplies the training path, and this
+// component moves its hot loop (uint8 record -> CHW->HWC transpose ->
+// float32 normalize) plus the file IO off the training thread, so host-side
+// preprocessing overlaps TPU steps instead of serializing with them.
+//
+// Contracts mirrored from the Python loader, verified by
+// tests/test_native_loader.py:
+//   * record layout: [1 label byte | 3072 image bytes, RGB planes, 32x32]
+//   * normalize EXACTLY as ((v / 255.0f) - 0.5f) / 0.5f (same op order as
+//     cifar_binary.decode, so shuffle=off batches are bit-identical);
+//   * shuffle=off yields the dataset in file order, epoch after epoch;
+//   * shuffle=on uses splitmix64-seeded Fisher-Yates, deterministic per
+//     (seed, epoch) — a different permutation sequence than numpy's
+//     Generator (documented; coverage-per-epoch is the tested invariant).
+//
+// Plain C ABI for ctypes; no pybind11 (not in this image).
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <mutex>
+#include <queue>
+#include <thread>
+#include <vector>
+
+namespace {
+
+constexpr int kRecordBytes = 1 + 3 * 32 * 32;
+constexpr int kImageFloats = 32 * 32 * 3;
+
+struct Batch {
+    std::vector<float> imgs;     // (B, 32, 32, 3) NHWC
+    std::vector<int32_t> labels; // (B,)
+};
+
+uint64_t splitmix64(uint64_t& s) {
+    s += 0x9E3779B97F4A7C15ULL;
+    uint64_t z = s;
+    z = (z ^ (z >> 30)) * 0xBF58476D1CE4E5B9ULL;
+    z = (z ^ (z >> 27)) * 0x94D049BB133111EBULL;
+    return z ^ (z >> 31);
+}
+
+struct Loader {
+    std::vector<uint8_t> records;  // n * kRecordBytes
+    size_t n = 0;
+    int batch = 0;
+    uint64_t seed = 0;
+    bool shuffle = true;
+    size_t depth = 0;
+
+    std::thread worker;
+    std::mutex mu;
+    std::condition_variable cv_push, cv_pop;
+    std::queue<Batch> ready;
+    std::atomic<bool> stop{false};
+
+    void decode(const size_t* idx, Batch& out) const {
+        out.imgs.resize(static_cast<size_t>(batch) * kImageFloats);
+        out.labels.resize(batch);
+        for (int b = 0; b < batch; ++b) {
+            const uint8_t* rec = records.data() + idx[b] * kRecordBytes;
+            out.labels[b] = rec[0];
+            const uint8_t* px = rec + 1;  // 3 planes of 32*32, R then G then B
+            float* dst = out.imgs.data() + static_cast<size_t>(b) * kImageFloats;
+            for (int hw = 0; hw < 32 * 32; ++hw) {
+                for (int c = 0; c < 3; ++c) {
+                    float v = static_cast<float>(px[c * 32 * 32 + hw]);
+                    dst[hw * 3 + c] = ((v / 255.0f) - 0.5f) / 0.5f;
+                }
+            }
+        }
+    }
+
+    void run() {
+        std::vector<size_t> order(n);
+        for (uint64_t epoch = 0; !stop.load(); ++epoch) {
+            for (size_t i = 0; i < n; ++i) order[i] = i;
+            if (shuffle) {
+                uint64_t s = seed + 0x1000003U * epoch + 1;
+                for (size_t i = n; i > 1; --i) {
+                    size_t j = splitmix64(s) % i;
+                    std::swap(order[i - 1], order[j]);
+                }
+            }
+            size_t usable = n - (n % static_cast<size_t>(batch));
+            for (size_t lo = 0; lo < usable && !stop.load(); lo += batch) {
+                Batch out;
+                decode(order.data() + lo, out);
+                std::unique_lock<std::mutex> lk(mu);
+                cv_push.wait(lk, [&] { return ready.size() < depth || stop.load(); });
+                if (stop.load()) return;
+                ready.push(std::move(out));
+                cv_pop.notify_one();
+            }
+        }
+    }
+};
+
+}  // namespace
+
+extern "C" {
+
+// Returns a handle, or 0 on any error (caller falls back to Python).
+// `blob` is the concatenated record bytes (Python does the file IO — it
+// already memory-maps the files; the native side owns decode + threading).
+void* dnn_loader_create(const uint8_t* blob, uint64_t n_records, int batch,
+                        uint64_t seed, int shuffle, uint64_t queue_depth) {
+    if (!blob || n_records == 0 || batch <= 0 ||
+        static_cast<uint64_t>(batch) > n_records || queue_depth == 0) {
+        return nullptr;
+    }
+    auto* L = new (std::nothrow) Loader();
+    if (!L) return nullptr;
+    L->n = n_records;
+    L->batch = batch;
+    L->seed = seed;
+    L->shuffle = shuffle != 0;
+    L->depth = queue_depth;
+    try {
+        L->records.assign(blob, blob + n_records * kRecordBytes);
+        L->worker = std::thread([L] { L->run(); });
+    } catch (...) {
+        delete L;
+        return nullptr;
+    }
+    return L;
+}
+
+// Blocks until a batch is ready; copies into caller-owned buffers
+// (imgs: batch*3072 floats, labels: batch int32). Returns 0 on success.
+int dnn_loader_next(void* handle, float* imgs, int32_t* labels) {
+    auto* L = static_cast<Loader*>(handle);
+    if (!L || !imgs || !labels) return 1;
+    Batch out;
+    {
+        std::unique_lock<std::mutex> lk(L->mu);
+        L->cv_pop.wait(lk, [&] { return !L->ready.empty() || L->stop.load(); });
+        if (L->ready.empty()) return 2;  // stopped
+        out = std::move(L->ready.front());
+        L->ready.pop();
+        L->cv_push.notify_one();
+    }
+    std::memcpy(imgs, out.imgs.data(), out.imgs.size() * sizeof(float));
+    std::memcpy(labels, out.labels.data(), out.labels.size() * sizeof(int32_t));
+    return 0;
+}
+
+void dnn_loader_destroy(void* handle) {
+    auto* L = static_cast<Loader*>(handle);
+    if (!L) return;
+    L->stop.store(true);
+    {
+        std::lock_guard<std::mutex> lk(L->mu);
+        L->cv_push.notify_all();
+        L->cv_pop.notify_all();
+    }
+    if (L->worker.joinable()) L->worker.join();
+    delete L;
+}
+
+}  // extern "C"

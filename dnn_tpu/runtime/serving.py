@@ -27,9 +27,14 @@ total):
     inside the compiled graphs.
 
 Numerics are the same ops as `make_generate` (same embed/block/head
-path), so a slot's token stream is identical to a solo batch-1 run of the
-same prompt — the isolation + parity contract `tests/test_serving.py`
-pins (one request's tokens never depend on what else is in the pool).
+path), so a greedy slot's token stream is identical to a solo batch-1 run
+of the same prompt — the parity contract `tests/test_serving.py` pins.
+Isolation holds for sampling too: every request gets its own rng stream,
+derived from (server seed, request id) and stepped per generated token,
+so one request's tokens never depend on what else shares the pool or
+when it arrived. (A sampled stream matches `make_generate`'s only in
+distribution, not token-for-token — the solo decoder uses one batch-wide
+key sequence.)
 """
 
 from __future__ import annotations
@@ -113,7 +118,7 @@ class ContinuousBatcher:
         self.max_len = min(max_len or cfg.block_size, cfg.block_size)
         self.prompt_pad = prompt_pad or min(64, self.max_len)
         self.eos_id = eos_id
-        self._rng = jax.random.PRNGKey(seed)
+        self._seed = seed
         cache_dtype = compute_dtype or jnp.float32
 
         # device state (functional updates)
@@ -121,13 +126,16 @@ class ContinuousBatcher:
         self.pos = jnp.zeros((slots,), jnp.int32)      # next write position
         self.tok = jnp.zeros((slots,), jnp.int32)      # last sampled token
         self.active = jnp.zeros((slots,), bool)
+        # per-slot rng keys: each request's stream derives from
+        # (server seed, request id) alone — pool-independent sampling
+        self.keys = jnp.zeros((slots, 2), jnp.uint32)
 
         # host bookkeeping
         self._next_rid = 0
         self._slot_req: List[Optional[dict]] = [None] * slots
         self.results: Dict[int, np.ndarray] = {}
 
-        def decode_step(prepared, cache, pos, tok, active, rng):
+        def decode_step(prepared, cache, pos, tok, active, keys):
             """Advance every active slot one token."""
             # embed each slot's last token at its own position
             x = jnp.take(prepared["wte"]["embedding"], tok[:, None], axis=0) + \
@@ -148,9 +156,17 @@ class ContinuousBatcher:
             )
             logits = head(prepared, x.astype(jnp.float32), cfg=cfg,
                           compute_dtype=compute_dtype)
-            nxt = _sample(logits[:, -1], rng, temperature=temperature, top_k=top_k)
+            # advance each slot's own stream; sample each row with its key
+            split = jax.vmap(jax.random.split)(keys)  # (B, 2, 2)
+            new_keys, subs = split[:, 0], split[:, 1]
+            nxt = jax.vmap(
+                lambda lg, k: _sample(lg[None, :], k, temperature=temperature,
+                                      top_k=top_k)[0]
+            )(logits[:, -1], subs)
             nxt = jnp.where(active, nxt, tok)
-            return {"k": k_new, "v": v_new}, pos + active.astype(jnp.int32), nxt
+            new_keys = jnp.where(active[:, None], new_keys, keys)
+            return ({"k": k_new, "v": v_new}, pos + active.astype(jnp.int32),
+                    nxt, new_keys)
 
         def prefill(prepared, cache, padded, true_len, slot, rng):
             """Prefill one slot: padded (1, P) prompt, true_len real tokens.
@@ -170,8 +186,12 @@ class ContinuousBatcher:
             }
             return cache, first
 
-        self._decode = jax.jit(decode_step)
-        self._prefill = jax.jit(prefill)
+        # donate the cache: without aliasing, every token would copy the
+        # whole (L, B, H, S, D) cache (hundreds of MB of HBM traffic per
+        # step at real sizes). The call sites reassign self.cache from the
+        # result, so the donated input is never reused.
+        self._decode = jax.jit(decode_step, donate_argnums=(1,))
+        self._prefill = jax.jit(prefill, donate_argnums=(1,))
 
     # ------------------------------------------------------------------
 
@@ -182,10 +202,14 @@ class ContinuousBatcher:
     def n_active(self) -> int:
         return sum(r is not None for r in self._slot_req)
 
-    def submit(self, prompt, max_new_tokens: int) -> int:
+    def submit(self, prompt, max_new_tokens: int,
+               seed: Optional[int] = None) -> int:
         """Prefill `prompt` (1-D int array) into a free slot; returns the
         request id. The first token is sampled during prefill and counts
-        toward max_new_tokens."""
+        toward max_new_tokens. `seed` names the request's private rng
+        stream (default: the request id) — a seeded sampled request
+        reproduces the same tokens regardless of pool contents or arrival
+        order."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if len(prompt) == 0 or len(prompt) > self.prompt_pad:
             raise ValueError(
@@ -203,18 +227,23 @@ class ContinuousBatcher:
 
         padded = np.zeros((1, self.prompt_pad), np.int32)
         padded[0, : len(prompt)] = prompt
-        self._rng, sub = jax.random.split(self._rng)
+        rid = self._next_rid
+        self._next_rid += 1
+        # this request's private stream: (server seed, request seed) —
+        # independent of what else is in the pool or when this arrived
+        req_key = jax.random.fold_in(
+            jax.random.PRNGKey(self._seed), rid if seed is None else seed
+        )
+        prefill_key, slot_key = jax.random.split(req_key)
         self.cache, first = self._prefill(
             self.prepared, self.cache, jnp.asarray(padded), len(prompt),
-            slot, sub,
+            slot, prefill_key,
         )
         first = int(first)
         self.pos = self.pos.at[slot].set(len(prompt))
         self.tok = self.tok.at[slot].set(first)
         self.active = self.active.at[slot].set(True)
-
-        rid = self._next_rid
-        self._next_rid += 1
+        self.keys = self.keys.at[slot].set(slot_key)
         self._slot_req[slot] = {"rid": rid, "emitted": [first],
                                 "budget": max_new_tokens}
         self._retire_if_done(slot)
@@ -235,9 +264,9 @@ class ContinuousBatcher:
         for slots that advanced; finished requests move to .results."""
         if self.n_active == 0:
             return {}
-        self._rng, sub = jax.random.split(self._rng)
-        self.cache, self.pos, self.tok = self._decode(
-            self.prepared, self.cache, self.pos, self.tok, self.active, sub
+        self.cache, self.pos, self.tok, self.keys = self._decode(
+            self.prepared, self.cache, self.pos, self.tok, self.active,
+            self.keys,
         )
         toks = np.asarray(self.tok)
         out = {}

@@ -82,11 +82,13 @@ def train(train_files, *, steps: int, batch_size: int = 128, lr: float = 1e-3,
     import jax.numpy as jnp
     import optax
 
-    from dnn_tpu.data import CifarBinaryDataset, prefetch_to_device
+    from dnn_tpu.data import AsyncCifarLoader, prefetch_to_device
     from dnn_tpu.models import cifar
     from dnn_tpu.train import fit, make_train_step
 
-    ds = CifarBinaryDataset(train_files)
+    # native C++ background-thread decode when available (falls back to the
+    # in-thread Python decoder transparently)
+    loader = AsyncCifarLoader(train_files, batch_size, seed=seed)
     params = cifar.init(jax.random.PRNGKey(seed))
 
     def loss_fn(p, batch):
@@ -107,9 +109,10 @@ def train(train_files, *, steps: int, batch_size: int = 128, lr: float = 1e-3,
         if log_every and s % log_every == 0:
             print(f"  step {s}/{steps}  loss {float(loss):.4f}")
 
-    batches = prefetch_to_device(ds.batches(batch_size, seed=seed), size=2)
-    (params, _), loss = fit(step_fn, (params, opt.init(params)), batches,
-                            num_steps=steps, on_step=on_step)
+    with loader:
+        batches = prefetch_to_device(loader, size=2)
+        (params, _), loss = fit(step_fn, (params, opt.init(params)), batches,
+                                num_steps=steps, on_step=on_step)
     return params, float(loss)
 
 

@@ -105,6 +105,31 @@ def test_eos_retires_early(setup):
     np.testing.assert_array_equal(trunc, full[: idx + 1])
 
 
+def test_sampled_stream_isolated_from_pool(setup):
+    """A seeded sampled request emits the same tokens whether it runs
+    alone or joins a busy pool mid-flight — the rng-isolation contract."""
+    cfg, prepared = setup
+    p1 = np.arange(1, 9)
+    other = (np.arange(1, 6) * 7) % cfg.vocab_size
+
+    def run_alone():
+        srv = ContinuousBatcher(cfg, prepared, slots=3, max_len=cfg.block_size,
+                                prompt_pad=16, temperature=1.0, seed=5)
+        rid = srv.submit(p1, max_new_tokens=10, seed=123)
+        return srv.drain()[rid]
+
+    def run_busy():
+        srv = ContinuousBatcher(cfg, prepared, slots=3, max_len=cfg.block_size,
+                                prompt_pad=16, temperature=1.0, seed=5)
+        srv.submit(other, max_new_tokens=8)   # different rid ordering
+        srv.step()
+        srv.step()
+        rid = srv.submit(p1, max_new_tokens=10, seed=123)
+        return srv.drain()[rid]
+
+    np.testing.assert_array_equal(run_alone(), run_busy())
+
+
 def test_pool_full_raises(setup):
     cfg, prepared = setup
     srv = ContinuousBatcher(cfg, prepared, slots=1, max_len=cfg.block_size,
