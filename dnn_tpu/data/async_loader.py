@@ -29,6 +29,8 @@ class AsyncCifarLoader:
     def __init__(self, files: Sequence[str], batch_size: int, *,
                  shuffle: bool = True, seed: int = 0, queue_depth: int = 4):
         self.batch_size = int(batch_size)
+        if int(queue_depth) < 1:
+            raise ValueError(f"queue_depth must be >= 1, got {queue_depth}")
         self._ds = CifarBinaryDataset(files)
         if self.batch_size > len(self._ds):
             raise ValueError(
@@ -41,13 +43,15 @@ class AsyncCifarLoader:
 
         lib = native.loader_lib()
         if lib is not None:
-            # C++ copies the records during create; the local ref keeps the
-            # buffer alive across the call
-            blob = np.ascontiguousarray(self._ds._records).reshape(-1)
-            assert blob.nbytes == len(self._ds) * RECORD_BYTES
+            # ZERO-COPY: the C++ side borrows this buffer for the loader's
+            # lifetime, so it must stay referenced until close() destroys
+            # the handle (which joins the worker thread first)
+            self._blob = np.ascontiguousarray(self._ds._records).reshape(-1)
+            assert self._blob.nbytes == len(self._ds) * RECORD_BYTES
             handle = lib.dnn_loader_create(
-                blob.ctypes.data_as(ctypes.c_void_p), len(self._ds),
-                self.batch_size, seed, int(bool(shuffle)), queue_depth,
+                self._blob.ctypes.data_as(ctypes.c_void_p), len(self._ds),
+                self.batch_size, int(seed), int(bool(shuffle)),
+                int(queue_depth),
             )
             if handle:
                 self._handle = ctypes.c_void_p(handle)
@@ -82,8 +86,9 @@ class AsyncCifarLoader:
 
     def close(self):
         if self._handle is not None:
-            self._lib.dnn_loader_destroy(self._handle)
+            self._lib.dnn_loader_destroy(self._handle)  # joins the worker
             self._handle = None
+            self._blob = None  # safe to release only after destroy
         self._fallback = None
 
     def __enter__(self):

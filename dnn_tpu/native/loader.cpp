@@ -49,7 +49,10 @@ uint64_t splitmix64(uint64_t& s) {
 }
 
 struct Loader {
-    std::vector<uint8_t> records;  // n * kRecordBytes
+    // Borrowed pointer into the caller's record buffer (the Python side
+    // keeps its backing memmap alive until dnn_loader_destroy returns —
+    // zero-copy: the dataset is NOT duplicated into C++ memory).
+    const uint8_t* records = nullptr;  // n * kRecordBytes
     size_t n = 0;
     int batch = 0;
     uint64_t seed = 0;
@@ -66,7 +69,7 @@ struct Loader {
         out.imgs.resize(static_cast<size_t>(batch) * kImageFloats);
         out.labels.resize(batch);
         for (int b = 0; b < batch; ++b) {
-            const uint8_t* rec = records.data() + idx[b] * kRecordBytes;
+            const uint8_t* rec = records + idx[b] * kRecordBytes;
             out.labels[b] = rec[0];
             const uint8_t* px = rec + 1;  // 3 planes of 32*32, R then G then B
             float* dst = out.imgs.data() + static_cast<size_t>(b) * kImageFloats;
@@ -110,7 +113,8 @@ extern "C" {
 
 // Returns a handle, or 0 on any error (caller falls back to Python).
 // `blob` is the concatenated record bytes (Python does the file IO — it
-// already memory-maps the files; the native side owns decode + threading).
+// memory-maps the files; the native side BORROWS the pointer, so the
+// caller must keep the buffer alive until dnn_loader_destroy returns).
 void* dnn_loader_create(const uint8_t* blob, uint64_t n_records, int batch,
                         uint64_t seed, int shuffle, uint64_t queue_depth) {
     if (!blob || n_records == 0 || batch <= 0 ||
@@ -119,13 +123,13 @@ void* dnn_loader_create(const uint8_t* blob, uint64_t n_records, int batch,
     }
     auto* L = new (std::nothrow) Loader();
     if (!L) return nullptr;
+    L->records = blob;
     L->n = n_records;
     L->batch = batch;
     L->seed = seed;
     L->shuffle = shuffle != 0;
     L->depth = queue_depth;
     try {
-        L->records.assign(blob, blob + n_records * kRecordBytes);
         L->worker = std::thread([L] { L->run(); });
     } catch (...) {
         delete L;

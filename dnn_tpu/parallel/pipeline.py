@@ -637,6 +637,7 @@ def spmd_pipeline_stacked(
     mesh: Mesh,
     num_microbatches: int = 1,
     axis_name: str = STAGE_AXIS,
+    data_axis: Optional[str] = None,
 ):
     """Homogeneous-stage SPMD pipeline over stacked params.
 
@@ -646,10 +647,24 @@ def spmd_pipeline_stacked(
     switch, no padding: this is the fast path for transformer block stacks.
     `block_fn(params_slice, x) -> y` must map (mb, ...) -> (mb, ...) with an
     unchanged shape.
+
+    `data_axis` composes data parallelism with the pipeline (a 2D
+    {data, stage} mesh): each microbatch's BATCH dim shards over the data
+    axis, so every data column runs the same pipeline on its batch slice —
+    stage params replicate across data columns (their spec doesn't mention
+    the axis), ppermute hops stay within a column, and under `jax.grad`
+    the shard_map transpose psums the param cotangents over data columns
+    automatically — dp×pp with no extra code at the call site.
     """
     num_stages = mesh.shape[axis_name]
     x_mb = split_microbatches(x, num_microbatches)
     mb = x_mb.shape[1]
+    d_size = mesh.shape[data_axis] if data_axis else 1
+    if mb % d_size:
+        raise ValueError(
+            f"microbatch size {mb} not divisible by data axis size {d_size}"
+        )
+    mb_local = mb // d_size
 
     param_specs = jax.tree.map(lambda _: P(axis_name), stacked_params)
     stacked_params = jax.device_put(
@@ -667,20 +682,20 @@ def spmd_pipeline_stacked(
         local = jax.tree.map(lambda p: p[0], params)
 
         def stage_step(buf):
-            xin = buf.reshape(mb, *trail)
-            y = block_fn(local, xin).reshape(mb, -1).astype(buf_dtype)
+            xin = buf.reshape(mb_local, *trail)
+            y = block_fn(local, xin).reshape(mb_local, -1).astype(buf_dtype)
             return y, y  # uniform shapes: hop and output coincide
 
         return _gpipe_loop(
-            stage_step, inputs, num_stages, num_microbatches, mb,
+            stage_step, inputs, num_stages, num_microbatches, mb_local,
             flat.shape[-1], flat.shape[-1], axis_name, out_dtype=buf_dtype,
         )
 
     result = jax.shard_map(
         per_device_wrapped,
         mesh=mesh,
-        in_specs=(param_specs, P()),
-        out_specs=P(),
+        in_specs=(param_specs, P(None, data_axis)),
+        out_specs=P(None, data_axis),
         check_vma=False,
     )(stacked_params, flat)
 

@@ -229,11 +229,14 @@ class ContinuousBatcher:
         padded[0, : len(prompt)] = prompt
         rid = self._next_rid
         self._next_rid += 1
-        # this request's private stream: (server seed, request seed) —
-        # independent of what else is in the pool or when this arrived
-        req_key = jax.random.fold_in(
-            jax.random.PRNGKey(self._seed), rid if seed is None else seed
+        # this request's private stream: (server seed, namespace, request
+        # seed) — independent of what else is in the pool or when this
+        # arrived. The namespace fold keeps auto-assigned rids and explicit
+        # seeds from colliding (rid=3 vs seed=3 must be distinct streams).
+        base = jax.random.fold_in(
+            jax.random.PRNGKey(self._seed), 0 if seed is None else 1
         )
+        req_key = jax.random.fold_in(base, rid if seed is None else seed)
         prefill_key, slot_key = jax.random.split(req_key)
         self.cache, first = self._prefill(
             self.prepared, self.cache, jnp.asarray(padded), len(prompt),

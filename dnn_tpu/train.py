@@ -298,12 +298,21 @@ def make_pipeline_train_step(
     axis_name: str = STAGE_AXIS,
     loss: Callable = cross_entropy,
     schedule: str = "gpipe",
+    data_axis: Optional[str] = None,
 ):
     """Pipeline-parallel LM training step.
 
     `stacked` block params live sharded P(stage) (each device holds its
     stage's blocks — same layout the inference engine uses); `aux` holds
     embed/head params (replicated).
+
+    `data_axis` composes DATA parallelism with the pipeline over a 2D
+    {data, stage} mesh (gpipe schedule only): the global batch shards over
+    the data axis, every data column pipelines its slice over the stage
+    axis, and the shard_map transpose psums block-param gradients across
+    columns; embed/head/loss run under GSPMD, which inserts the remaining
+    batch collectives. Same loss as the 1D run on the same global batch
+    (fp-reassociation tolerance) — tested in tests/test_dp_pp.py.
 
     `schedule="gpipe"`: forward through the microbatched GPipe loop, then
     differentiate straight through it — the reverse of each ppermute hop is
@@ -322,6 +331,11 @@ def make_pipeline_train_step(
     """
     if schedule not in ("gpipe", "1f1b"):
         raise ValueError(f"schedule must be gpipe|1f1b, got {schedule!r}")
+    if data_axis is not None and schedule != "gpipe":
+        raise ValueError(
+            "data_axis composition is implemented for the gpipe schedule "
+            "only; 1f1b runs on a 1D stage mesh"
+        )
 
     def gpipe_loss_and_grad(stacked, aux, tokens):
         def loss_fn(stacked, aux):
@@ -329,7 +343,7 @@ def make_pipeline_train_step(
             h = spmd_pipeline_stacked(
                 block_fn, stacked, x,
                 mesh=mesh, num_microbatches=num_microbatches,
-                axis_name=axis_name,
+                axis_name=axis_name, data_axis=data_axis,
             )
             logits = head_fn(aux, h)
             return loss(logits, tokens[:, 1:])

@@ -1,0 +1,103 @@
+"""dp×pp composition: pipeline training over a 2D {data, stage} mesh.
+
+The invariant: the same GLOBAL batch produces the same loss and the same
+updated params whether it runs data-parallel over 2 columns or on a 1D
+stage mesh — dp is a placement choice, the math is the batch mean either
+way (fp-reassociation tolerance only)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from dnn_tpu import train
+from dnn_tpu.models import gpt
+from dnn_tpu.parallel.mesh import DATA_AXIS, STAGE_AXIS, make_mesh
+from dnn_tpu.parallel.pipeline import spmd_pipeline_stacked
+
+
+@pytest.fixture(scope="module")
+def setup():
+    cfg = gpt.GPTConfig(block_size=32, vocab_size=128, n_layer=2, n_head=4,
+                        n_embd=32)
+    params = gpt.init(jax.random.PRNGKey(0), cfg)
+    # stage-major stacked layout: (S, per_stage, ...) — one block per stage
+    stacks = [gpt.stack_blocks(params, [i]) for i in range(cfg.n_layer)]
+    stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *stacks)
+    aux = {k: v for k, v in params.items() if not k.startswith("h_")}
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (8, 17), 0,
+                                cfg.vocab_size, dtype=jnp.int32)
+    return cfg, stacked, aux, tokens
+
+
+def test_forward_parity(setup):
+    """spmd_pipeline_stacked(data_axis=...) == 1D run on the same batch."""
+    cfg, stacked, aux, tokens = setup
+    x = gpt.embed(aux, tokens, cfg=cfg)
+
+    mesh1 = make_mesh({STAGE_AXIS: 2}, jax.devices()[:2])
+    ref = spmd_pipeline_stacked(
+        lambda bp, a: gpt.blocks_scan(bp, a, cfg=cfg), stacked, x,
+        mesh=mesh1, num_microbatches=2,
+    )
+    mesh2 = make_mesh({DATA_AXIS: 2, STAGE_AXIS: 2}, jax.devices()[:4])
+    got = spmd_pipeline_stacked(
+        lambda bp, a: gpt.blocks_scan(bp, a, cfg=cfg), stacked, x,
+        mesh=mesh2, num_microbatches=2, data_axis=DATA_AXIS,
+    )
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.parametrize("d", [2, 4])
+def test_train_step_parity(setup, d):
+    """One dp×pp train step == one 1D pipeline train step: same loss, same
+    updated stacked params, on the same global batch."""
+    cfg, stacked, aux, tokens = setup
+    opt = optax.sgd(1e-2)
+
+    def make(mesh, data_axis):
+        return train.make_pipeline_train_step(
+            lambda bp, h: gpt.blocks_scan(bp, h, cfg=cfg),
+            lambda a, ids: gpt.embed(a, ids, cfg=cfg),
+            lambda a, h: gpt.head(a, h.astype(jnp.float32), cfg=cfg),
+            opt, mesh, num_microbatches=2, data_axis=data_axis,
+        )
+
+    mesh1 = make_mesh({STAGE_AXIS: 2}, jax.devices()[:2])
+    st1, aux1, _, loss1 = make(mesh1, None)(
+        stacked, aux, (opt.init(stacked), opt.init(aux)), tokens
+    )
+    mesh2 = make_mesh({DATA_AXIS: d, STAGE_AXIS: 2}, jax.devices()[: 2 * d])
+    st2, aux2, _, loss2 = make(mesh2, DATA_AXIS)(
+        stacked, aux, (opt.init(stacked), opt.init(aux)), tokens
+    )
+    np.testing.assert_allclose(float(loss2), float(loss1), rtol=1e-5)
+    for a, b in zip(jax.tree.leaves(st1), jax.tree.leaves(st2)):
+        np.testing.assert_allclose(np.asarray(b), np.asarray(a),
+                                   rtol=1e-4, atol=1e-5)
+    for a, b in zip(jax.tree.leaves(aux1), jax.tree.leaves(aux2)):
+        np.testing.assert_allclose(np.asarray(b), np.asarray(a),
+                                   rtol=1e-4, atol=1e-5)
+
+
+def test_1f1b_rejects_data_axis(setup):
+    cfg, stacked, aux, tokens = setup
+    mesh = make_mesh({DATA_AXIS: 2, STAGE_AXIS: 2}, jax.devices()[:4])
+    with pytest.raises(ValueError, match="gpipe schedule only"):
+        train.make_pipeline_train_step(
+            lambda bp, h: h, lambda a, i: i, lambda a, h: h,
+            optax.sgd(1e-2), mesh, schedule="1f1b", data_axis=DATA_AXIS,
+        )
+
+
+def test_indivisible_batch_raises(setup):
+    cfg, stacked, aux, tokens = setup
+    mesh = make_mesh({DATA_AXIS: 2, STAGE_AXIS: 2}, jax.devices()[:4])
+    x = gpt.embed(aux, tokens[:3], cfg=cfg)  # 3 not divisible by 2
+    with pytest.raises(ValueError, match="not divisible by data axis"):
+        spmd_pipeline_stacked(
+            lambda bp, a: gpt.blocks_scan(bp, a, cfg=cfg), stacked, x,
+            mesh=mesh, num_microbatches=1, data_axis=DATA_AXIS,
+        )

@@ -102,3 +102,10 @@ def test_batch_size_validation(cifar_file):
     path, n = cifar_file
     with pytest.raises(ValueError):
         AsyncCifarLoader([path], n + 1)
+
+
+def test_queue_depth_validation(cifar_file):
+    path, _ = cifar_file
+    for bad in (0, -1):
+        with pytest.raises(ValueError, match="queue_depth"):
+            AsyncCifarLoader([path], 8, queue_depth=bad)
