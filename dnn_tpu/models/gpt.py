@@ -270,17 +270,24 @@ def make_apply_stacked(cfg: GPTConfig, *, use_flash=False, compute_dtype=None,
     return apply
 
 
-def make_apply_seq_parallel(cfg: GPTConfig, mesh, *, axis_name=None, compute_dtype=None):
+def make_apply_seq_parallel(cfg: GPTConfig, mesh, *, axis_name=None,
+                            compute_dtype=None, method: str = "ring"):
     """Sequence-parallel (long-context) full-model forward.
 
     The reference hard-caps sequence length (`T <= block_size` assert,
     gpt_model_parts.py:15) and holds every activation whole on one device.
     This path shards the SEQUENCE dimension over the mesh's "seq" axis:
     embed/LN/MLP/head act position-wise and run on local shards; attention
-    runs as ring attention (K/V blocks rotate the ring via `lax.ppermute`,
-    online-softmax accumulation — dnn_tpu/parallel/ring_attention.py), so
-    per-device activation memory is O(T/n) and the full (T, T) score matrix
-    never exists anywhere.
+    crosses shards via one of two strategies (`method`):
+
+      * "ring": K/V blocks rotate the ring via `lax.ppermute` with
+        online-softmax accumulation (dnn_tpu/parallel/ring_attention.py) —
+        per-device activation memory is O(T/n) and the full (T, T) score
+        matrix never exists anywhere; works for any head count.
+      * "ulysses": two `lax.all_to_all`s swap sequence sharding for head
+        sharding around one dense local attention
+        (dnn_tpu/parallel/ulysses.py) — fewer, denser collectives;
+        needs n_head divisible by the axis size.
 
     `apply(prepared, ids)`: `prepared` from `prepare_stacked` (replicated);
     ids (B, T) with T divisible by the seq-axis size. Returns f32 logits
@@ -291,14 +298,25 @@ def make_apply_seq_parallel(cfg: GPTConfig, mesh, *, axis_name=None, compute_dty
     from dnn_tpu.ops.attention import merge_heads, split_heads
     from dnn_tpu.parallel.mesh import SEQ_AXIS
     from dnn_tpu.parallel.ring_attention import ring_attention_local
+    from dnn_tpu.parallel.ulysses import ulysses_attention_local
 
+    if method not in ("ring", "ulysses"):
+        raise ValueError(f"method must be ring|ulysses, got {method!r}")
     axis = axis_name or SEQ_AXIS
+    if method == "ulysses" and cfg.n_head % mesh.shape[axis] != 0:
+        raise ValueError(
+            f"ulysses needs n_head ({cfg.n_head}) divisible by the seq-axis "
+            f"size ({mesh.shape[axis]}); use method='ring'"
+        )
 
     def ring_attn(attn_params, h):
         qkv = linear(attn_params["qkv"], h, compute_dtype=compute_dtype)
         q, k, v = jnp.split(qkv, 3, axis=-1)
         q, k, v = (split_heads(t, cfg.n_head) for t in (q, k, v))
-        y = ring_attention_local(q, k, v, axis_name=axis, causal=True)
+        if method == "ring":
+            y = ring_attention_local(q, k, v, axis_name=axis, causal=True)
+        else:
+            y = ulysses_attention_local(q, k, v, axis_name=axis, causal=True)
         return linear(attn_params["proj"], merge_heads(y), compute_dtype=compute_dtype)
 
     def local_fn(prepared, ids_local):

@@ -1,0 +1,56 @@
+"""Ulysses-style (all-to-all) sequence parallelism for attention.
+
+The second long-context strategy next to ring attention
+(dnn_tpu/parallel/ring_attention.py), trading its n-step ppermute ring
+for two all_to_all collectives:
+
+  activations are SEQUENCE-sharded everywhere except inside attention.
+  At the attention boundary an all_to_all re-shards Q/K/V from
+  (B, H, T/n, D) to (B, H/n, T, D) — every device sees ALL positions for
+  its subset of heads — so attention itself is the plain dense causal
+  kernel with no masking gymnastics; a second all_to_all restores
+  sequence sharding for the position-wise rest of the block.
+
+When to pick which (the standard trade): Ulysses moves 2x the attention
+activation bytes in two dense collectives and needs n_head % n == 0 but
+keeps the (T, T) work in one local kernel (flash-friendly); the ring
+keeps bytes-per-step minimal and head-count free but serializes K/V
+rotation over n ppermute steps. Both produce bit-comparable results to
+dense attention — parity is pinned in tests/test_ulysses.py.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from dnn_tpu.parallel.mesh import SEQ_AXIS
+
+
+def ulysses_attention_local(q, k, v, *, axis_name: str = SEQ_AXIS,
+                            causal: bool = True):
+    """Per-device attention body (call inside shard_map over the seq axis).
+
+    q/k/v: (B, H, T_local, D) — this device's sequence shard, all heads.
+    Returns (B, H, T_local, D). Requires H divisible by the axis size.
+    """
+    from dnn_tpu.ops.pallas.flash_attention import flash_attention
+
+    n = lax.axis_size(axis_name)  # static inside shard_map
+    h = q.shape[1]
+    if h % n != 0:
+        raise ValueError(f"n_head {h} not divisible by seq-axis size {n}")
+    # seq-sharded -> head-sharded: split heads across devices, gather the
+    # full sequence (chunks arrive in device order, so T stays contiguous).
+    # One collective over the stacked qkv — same bytes as three, one launch.
+    qkv = jnp.stack((q, k, v))  # (3, B, H, T_local, D)
+    qkv = lax.all_to_all(qkv, axis_name, split_axis=2, concat_axis=3, tiled=True)
+    q, k, v = qkv[0], qkv[1], qkv[2]  # (B, H/n, T, D)
+    # flash dispatches to the Pallas kernel on TPU at tileable shapes and
+    # to the dense jnp reference elsewhere — this is what makes the
+    # gathered-full-T attention viable at the long contexts Ulysses
+    # targets (a dense (T, T) score matrix would not be)
+    y = flash_attention(q, k, v, causal=causal)
+    # head-sharded -> seq-sharded: inverse exchange
+    return lax.all_to_all(y, axis_name, split_axis=2, concat_axis=1, tiled=True)
