@@ -131,19 +131,33 @@ def load_balance_loss(aux) -> jax.Array:
 
 def _expert_ffn(params, expert_in, *, activation, compute_dtype):
     """(E, cap, D) tokens through each expert's 2-layer FFN, one batched
-    matmul pair. Accumulate in f32, ride operands in compute_dtype."""
+    matmul pair. Accumulate in f32, ride operands in compute_dtype.
+
+    Accepts int8 weight-only-quantized expert stacks (dnn_tpu/quant.py):
+    `wi`/`wo` as int8 with per-(expert, out-channel) `wi_scale`/`wo_scale`
+    (E, 1, out). Per-channel scales commute with the contraction, so the
+    dequant is an exact epilogue on the f32 accumulator; the int8->
+    compute_dtype convert fuses into the einsum's operand read, keeping
+    the experts' HBM traffic at 1 byte/weight — MoE decode is the most
+    weight-bandwidth-bound path in the framework (E experts' weights
+    stream for one token's worth of FLOPs)."""
     wi, bi, wo, bo = params["wi"], params["bi"], params["wo"], params["bo"]
+    wi_scale, wo_scale = params.get("wi_scale"), params.get("wo_scale")
     x = expert_in
     if compute_dtype is not None:
         x, wi, wo = x.astype(compute_dtype), wi.astype(compute_dtype), wo.astype(compute_dtype)
     h = jnp.einsum("ecd,edf->ecf", x, wi,
-                   preferred_element_type=jnp.float32) + bi[:, None, :].astype(jnp.float32)
-    h = activation(h)
+                   preferred_element_type=jnp.float32)
+    if wi_scale is not None:
+        h = h * wi_scale  # (E, 1, ff) broadcasts over capacity
+    h = activation(h + bi[:, None, :].astype(jnp.float32))
     if compute_dtype is not None:
         h = h.astype(compute_dtype)
     out = jnp.einsum("ecf,efd->ecd", h, wo,
-                     preferred_element_type=jnp.float32) + bo[:, None, :].astype(jnp.float32)
-    return out  # f32
+                     preferred_element_type=jnp.float32)
+    if wo_scale is not None:
+        out = out * wo_scale
+    return out + bo[:, None, :].astype(jnp.float32)  # f32
 
 
 def _group_dispatch(params, xg, *, top_k, capacity, normalize):
@@ -234,11 +248,14 @@ def make_moe_ffn_ep(mesh: Mesh, *, top_k: int = 2, capacity_factor: float = 1.25
     router/bias params replicate. Equals moe_ffn(groups=n) exactly."""
     n = mesh.shape[axis_name]
 
-    param_specs = {
-        "router": {"kernel": P()},
-        "wi": P(axis_name), "bi": P(axis_name),
-        "wo": P(axis_name), "bo": P(axis_name),
-    }
+    def _param_specs(params):
+        # every expert-stack leaf (wi/wo/biases and, when quantized, the
+        # wi_scale/wo_scale factors) has leading dim E -> shard P(axis);
+        # the router replicates (tokens route locally, pre-dispatch)
+        return {
+            k: ({"kernel": P()} if k == "router" else P(axis_name))
+            for k in params
+        }
 
     def apply(params, x):
         b, t, d = x.shape
@@ -262,7 +279,7 @@ def make_moe_ffn_ep(mesh: Mesh, *, top_k: int = 2, capacity_factor: float = 1.25
 
         return jax.shard_map(
             local, mesh=mesh,
-            in_specs=(param_specs, P(axis_name)),
+            in_specs=(_param_specs(params), P(axis_name)),
             out_specs=P(axis_name),
             check_vma=False,
         )(params, x)

@@ -1,0 +1,153 @@
+"""Weight-only int8 quantization for serving.
+
+The reference has no quantization story at all — its weights ride the wire
+and the forward at full precision (/root/reference/node.py:294-325 loads the
+f32 `.pth`; node_service.proto:26-30 ships raw f32 bytes). For a serving
+framework that is a real capability gap: autoregressive decode reads every
+weight once per generated token, so decode throughput is bounded by
+HBM bandwidth, not FLOPs. Halving (bf16) or quartering (int8) the bytes
+per weight is the direct lever.
+
+Scheme (the standard weight-only recipe, TPU-shaped):
+
+  * **Symmetric per-output-channel int8.** For a (in, out) kernel, one
+    f32 scale per output column: ``scale = max|W[:, j]| / 127``,
+    ``q = round(W / scale)``. No zero-points — symmetric quant keeps the
+    matmul a plain dot (no cross terms), and transformer weights are
+    near-zero-mean so asymmetry buys nothing.
+  * **Scales stay out of the matmul.** Per-channel scales commute with
+    the contraction, so the apply path computes ``(x @ q) * scale`` —
+    the dequant is a cheap epilogue on the (small) output, never a
+    materialized f32 copy of the weight. See `_linear_int8` in
+    dnn_tpu/ops/nn.py.
+  * **Quantized params keep the pytree shape.** A quantized linear is
+    ``{"q": int8, "scale": f32, "bias"?}`` in place of
+    ``{"kernel", "bias"?}``; everything else (layer norms, embeddings,
+    biases) is untouched. Because every matmul in the framework funnels
+    through `ops.nn.linear`, the same quantized tree drops into
+    `make_apply*`, the KV-cache decoders, the continuous-batching server,
+    and the stage-sharded pipeline with zero per-path changes.
+  * **Stacked layouts quantize per layer.** A stacked kernel
+    (L, in, out) gets (L, 1, out) scales; `lax.scan` slices both in
+    lockstep, so each layer sees its own (1, out) scales.
+
+What is deliberately NOT here: activation quantization (int8 x int8 with
+dynamic ranges) — it changes numerics class and needs calibration data;
+weight-only at bf16 activations is the accuracy-free point on the curve.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+__all__ = [
+    "quantize_tensor",
+    "dequantize_tensor",
+    "quantize_linear",
+    "quantize_tree",
+    "quantize_gpt",
+    "param_bytes",
+]
+
+
+def quantize_tensor(w, *, axis: int = -2) -> Tuple[jax.Array, jax.Array]:
+    """Symmetric int8 quantization of `w` with scales reduced over `axis`
+    (kept as size-1, so q * scale broadcasts back to w's shape).
+
+    Default axis=-2 is the contraction (input) dim of an (in, out) or
+    stacked (L, in, out) kernel -> per-output-channel (and per-layer)
+    scales."""
+    w = jnp.asarray(w)
+    amax = jnp.max(jnp.abs(w), axis=axis, keepdims=True)
+    scale = jnp.where(amax > 0, amax / 127.0, 1.0).astype(jnp.float32)
+    q = jnp.clip(jnp.round(w.astype(jnp.float32) / scale), -127, 127)
+    return q.astype(jnp.int8), scale
+
+
+def dequantize_tensor(q, scale, dtype=jnp.float32):
+    return (q.astype(jnp.float32) * scale).astype(dtype)
+
+
+def quantize_linear(params):
+    """{"kernel", "bias"?} -> {"q", "scale", "bias"?} (see ops.nn.linear)."""
+    q, scale = quantize_tensor(params["kernel"])
+    out = {"q": q, "scale": scale}
+    if "bias" in params:
+        out["bias"] = params["bias"]
+    return out
+
+
+def _default_should_quantize(path: str, kernel) -> bool:
+    # matmul kernels only (2D, or 3D layer-stacked); convs (4D HWIO) and
+    # tiny projections are left alone — no bandwidth to win there. MoE
+    # routers stay f32: routing *decisions* must not flip under
+    # quantization noise (dnn_tpu/parallel/moe.py computes them in f32
+    # for the same reason), and a router is <0.1% of bytes anyway.
+    if path.endswith("/router"):
+        return False
+    return kernel.ndim in (2, 3) and min(kernel.shape[-2:]) >= 32
+
+
+def quantize_tree(params, *, should_quantize: Optional[Callable] = None):
+    """Walk a parameter pytree of nested dicts; replace every
+    {"kernel": ...} linear dict the predicate accepts with its int8 form.
+
+    Works on raw `gpt.init` trees, `prepare_stacked` trees (the stacked
+    blocks quantize per-layer), and per-stage pipeline shards alike —
+    anything made of nested dicts. MoE expert stacks (a dict holding raw
+    (E, in, out) `wi`/`wo` arrays, dnn_tpu/parallel/moe.py:init_moe) are
+    recognized structurally and quantized in place — int8 `wi`/`wo` with
+    per-(expert, channel) `wi_scale`/`wo_scale` keys the expert FFN
+    dequantizes in its epilogue; the router is left f32 (see
+    `_default_should_quantize`). Key names and leading-E shapes are
+    preserved, so the EP sharding specs apply unchanged."""
+    pred = should_quantize or _default_should_quantize
+
+    def walk(node, path):
+        if isinstance(node, dict):
+            if "kernel" in node and hasattr(node["kernel"], "ndim"):
+                if pred(path, node["kernel"]):
+                    return quantize_linear(node)
+                return node
+            if (
+                "wi" in node and "wo" in node
+                and hasattr(node["wi"], "ndim") and node["wi"].ndim == 3
+            ):
+                out = {k: walk(v, f"{path}/{k}") for k, v in node.items()
+                       if k not in ("wi", "wo")}
+                out["wi"], out["wi_scale"] = quantize_tensor(node["wi"])
+                out["wo"], out["wo_scale"] = quantize_tensor(node["wo"])
+                return out
+            return {k: walk(v, f"{path}/{k}") for k, v in node.items()}
+        return node
+
+    return walk(params, "")
+
+
+def quantize_gpt(prepared, *, quantize_head: bool = True):
+    """Quantize a GPT parameter tree (raw or prepare_stacked form).
+
+    Quantizes the qkv/proj/fc/mlp-proj kernels (and optionally lm_head);
+    embeddings, layer norms, and biases stay f32 — together they are <1%
+    of bytes but carry the model's dynamic range."""
+
+    def pred(path, kernel):
+        if not _default_should_quantize(path, kernel):
+            return False
+        if "lm_head" in path:
+            return quantize_head
+        return True
+
+    return quantize_tree(prepared, should_quantize=pred)
+
+
+def param_bytes(tree) -> int:
+    """Total bytes of all array leaves (for compression-ratio checks)."""
+    return sum(
+        leaf.size * leaf.dtype.itemsize
+        for leaf in jax.tree.leaves(tree)
+        if hasattr(leaf, "dtype")
+    )

@@ -1,0 +1,178 @@
+"""Weight-only int8 quantization (dnn_tpu/quant.py).
+
+Contracts pinned here:
+  * per-channel symmetric round trip: |W - q*scale| <= scale/2 elementwise;
+  * the int8 linear path in ops.nn equals explicit dequant-then-matmul;
+  * quantize-then-stack == stack-then-quantize (scales reduce over the
+    contraction dim only, so layer stacking commutes with quantization);
+  * a quantized GPT's logits track the f32 model closely (cosine) and the
+    quantized tree is the expected fraction of the bytes;
+  * the SAME quantized tree drops into every consumer unchanged: full
+    forward, KV-cache decode, the continuous-batching server (which must
+    stay token-identical to solo decode *under quantized weights*), and
+    the stage-sharded SPMD pipeline.
+
+The reference has no quantization (its f32 .pth rides the wire whole,
+/root/reference/node.py:294-325); this is a serving capability the rebuild
+adds because decode on TPU is HBM-bandwidth-bound.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dnn_tpu import quant
+from dnn_tpu.models import gpt
+from dnn_tpu.ops.nn import linear
+from dnn_tpu.parallel.mesh import make_mesh
+from dnn_tpu.parallel.pipeline import spmd_pipeline_stacked
+from dnn_tpu.runtime.generate import make_generate
+from dnn_tpu.runtime.serving import ContinuousBatcher
+
+
+@pytest.fixture(scope="module")
+def gpt_setup():
+    cfg = gpt.PRESETS["gpt2-test"]
+    params = gpt.init(jax.random.PRNGKey(0), cfg)
+    prepared = gpt.prepare_stacked(params, cfg)
+    return cfg, params, prepared
+
+
+def test_quantize_tensor_round_trip_bound():
+    w = jax.random.normal(jax.random.PRNGKey(1), (128, 96)) * 0.1
+    q, scale = quant.quantize_tensor(w)
+    assert q.dtype == jnp.int8 and scale.shape == (1, 96)
+    err = jnp.abs(quant.dequantize_tensor(q, scale) - w)
+    # round() puts every element within half a quantization step
+    assert (err <= scale / 2 + 1e-7).all()
+
+
+def test_quantize_tensor_zero_column():
+    """An all-zero output channel must not divide by zero."""
+    w = jnp.zeros((16, 4)).at[:, 1].set(1.0)
+    q, scale = quant.quantize_tensor(w)
+    assert jnp.isfinite(scale).all()
+    np.testing.assert_allclose(quant.dequantize_tensor(q, scale), w, atol=1e-6)
+
+
+def test_linear_int8_matches_explicit_dequant():
+    k1, k2 = jax.random.split(jax.random.PRNGKey(2))
+    w = jax.random.normal(k1, (64, 48)) * 0.05
+    b = jax.random.normal(k2, (48,)) * 0.01
+    x = jax.random.normal(jax.random.PRNGKey(3), (5, 64))
+    qp = quant.quantize_linear({"kernel": w, "bias": b})
+    got = linear(qp, x)
+    want = x @ quant.dequantize_tensor(qp["q"], qp["scale"]) + b
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-5)
+
+
+def test_quantize_commutes_with_stacking(gpt_setup):
+    cfg, params, prepared = gpt_setup
+    q_then_stack = gpt.prepare_stacked(quant.quantize_gpt(params), cfg)
+    stack_then_q = quant.quantize_gpt(prepared)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_array_equal(np.asarray(a), np.asarray(b)),
+        q_then_stack, stack_then_q,
+    )
+
+
+def test_quantized_gpt_logits_close(gpt_setup):
+    cfg, _, prepared = gpt_setup
+    qtree = quant.quantize_gpt(prepared)
+    ids = jax.random.randint(jax.random.PRNGKey(4), (2, 24), 0, cfg.vocab_size,
+                             dtype=jnp.int32)
+    apply_fn = gpt.make_apply_stacked(cfg)
+    ref = np.asarray(apply_fn(prepared, ids)).reshape(-1, cfg.vocab_size)
+    got = np.asarray(apply_fn(qtree, ids)).reshape(-1, cfg.vocab_size)
+    cos = (ref * got).sum(-1) / (
+        np.linalg.norm(ref, axis=-1) * np.linalg.norm(got, axis=-1)
+    )
+    assert (cos > 0.999).all(), f"min cosine {cos.min()}"
+
+
+def test_quantized_bytes_fraction(gpt_setup):
+    cfg, _, prepared = gpt_setup
+    qtree = quant.quantize_gpt(prepared)
+    ratio = quant.param_bytes(qtree) / quant.param_bytes(prepared)
+    # linears drop 4x (plus small scales); embeddings/norms stay f32
+    assert ratio < 0.5, f"quantized tree is {ratio:.2f} of original bytes"
+
+
+def test_quantized_decode_and_serving_parity(gpt_setup):
+    """KV-cache decode runs on the quantized tree, and the continuous
+    batcher remains token-identical to solo decode under it."""
+    cfg, _, prepared = gpt_setup
+    qtree = quant.quantize_gpt(prepared)
+    prompt = (np.arange(1, 9) * 7) % cfg.vocab_size
+    solo = make_generate(cfg, max_new_tokens=10)(
+        qtree, jnp.asarray(prompt, jnp.int32)[None, :], jax.random.PRNGKey(9)
+    )
+    assert np.asarray(solo).shape == (1, 10)
+    srv = ContinuousBatcher(cfg, qtree, slots=2, max_len=cfg.block_size,
+                            prompt_pad=16)
+    rid = srv.submit(prompt, max_new_tokens=10)
+    res = srv.drain()
+    np.testing.assert_array_equal(res[rid], np.asarray(solo)[0])
+
+
+def test_quantized_moe_expert_stacks():
+    """MoE trees quantize structurally: int8 wi/wo + per-(expert, channel)
+    scales, router untouched (routing decisions must not flip), and the
+    quantized tree runs both the dense and the expert-parallel paths —
+    which must still agree exactly with each other."""
+    from dnn_tpu.parallel.mesh import EXPERT_AXIS, make_mesh as mk
+    from dnn_tpu.parallel.moe import init_moe, make_moe_ffn_ep, moe_ffn
+
+    d, e, f = 64, 8, 96
+    params = init_moe(jax.random.PRNGKey(0), d, e, f)
+    qp = quant.quantize_tree(params)
+    assert qp["wi"].dtype == jnp.int8 and qp["wo"].dtype == jnp.int8
+    assert qp["wi_scale"].shape == (e, 1, f)
+    np.testing.assert_array_equal(  # router stays f32
+        np.asarray(qp["router"]["kernel"]), np.asarray(params["router"]["kernel"])
+    )
+
+    x = jax.random.normal(jax.random.PRNGKey(1), (8, 4, d))
+    dense_f32 = np.asarray(moe_ffn(params, x, top_k=2, groups=8))
+    dense_q = np.asarray(moe_ffn(qp, x, top_k=2, groups=8))
+    # same routing (f32 router) -> output differs only by weight rounding
+    cos = (dense_f32 * dense_q).sum() / (
+        np.linalg.norm(dense_f32) * np.linalg.norm(dense_q)
+    )
+    assert cos > 0.999, f"cosine {cos}"
+
+    mesh = mk({EXPERT_AXIS: 8}, jax.devices()[:8])
+    ep = np.asarray(make_moe_ffn_ep(mesh, top_k=2)(qp, x))
+    np.testing.assert_allclose(ep, dense_q, atol=1e-5, rtol=1e-5)
+
+
+def test_router_sized_like_a_linear_is_not_quantized():
+    """A wide router ((D, E>=32) kernel, 2D, big enough for the default
+    predicate) must still be excluded by path — the routing matmul reads
+    params['router']['kernel'] directly."""
+    from dnn_tpu.parallel.moe import init_moe, moe_ffn
+
+    params = init_moe(jax.random.PRNGKey(0), 64, 32, 64)
+    qp = quant.quantize_tree(params)
+    assert "kernel" in qp["router"]
+    x = jax.random.normal(jax.random.PRNGKey(1), (4, 4, 64))
+    out = moe_ffn(qp, x, top_k=2, groups=4)  # must not KeyError
+    assert np.isfinite(np.asarray(out)).all()
+
+
+def test_quantized_pipeline_stacked(gpt_setup):
+    """Int8 stacked block params shard over the stage axis like any other
+    leaf; pipeline output equals the single-program quantized forward."""
+    cfg, _, prepared = gpt_setup
+    qtree = quant.quantize_gpt(prepared)
+    mesh = make_mesh({"stage": cfg.n_layer})
+    x = jax.random.normal(jax.random.PRNGKey(5), (8, 16, cfg.n_embd))
+
+    y = spmd_pipeline_stacked(
+        lambda p, h: gpt.block_apply(p, h, cfg=cfg),
+        qtree["blocks"], x, mesh=mesh, num_microbatches=4,
+    )
+    ref = gpt.blocks_scan(qtree["blocks"], x, cfg=cfg)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(ref), atol=1e-5,
+                               rtol=1e-5)
