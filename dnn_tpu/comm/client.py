@@ -134,5 +134,28 @@ class NodeClient:
                 time.sleep(delay)
                 attempt += 1
 
+    def generate(
+        self,
+        prompt_ids,
+        *,
+        max_new_tokens: int = 32,
+        seed: Optional[int] = None,
+        timeout: float = 120.0,
+    ) -> np.ndarray:
+        """Client path for the LM daemon (dnn_tpu/runtime/lm_server.py):
+        prompt token ids -> generated tokens. Options ride the request_id
+        as "gen:max_new[:seed]" — the same wire message a reference-built
+        client would send, just with an integer payload. A request is
+        self-contained (prompt + options), so the transport-level retries
+        in send_tensor stay safe here."""
+        rid = f"gen:{max_new_tokens}" + (f":{seed}" if seed is not None else "")
+        status, result = self.send_tensor(
+            np.asarray(prompt_ids, np.int32).reshape(-1),
+            request_id=rid, timeout=timeout,
+        )
+        if result is None:
+            raise RuntimeError(f"LM server returned no tokens: {status}")
+        return np.asarray(result, np.int32)
+
     def close(self):
         self._channel.close()

@@ -19,6 +19,12 @@ Behavior by mode:
     `next_node` by address. Wire-compatible with reference nodes. In this
     mode a node with part_index 0 and `--input_image` also initiates
     inference after a short delay (node.py:203-207,332-337).
+
+  * `--serve_lm` (LM daemon): long-lived generation server on this node's
+    port — SendTensor carries prompt token ids, the response carries the
+    generated tokens, and all in-flight requests decode together through
+    the continuous-batching pool (runtime/lm_server.py). The LM analog of
+    the reference's serving-process shape (node.py:114-133).
 """
 
 from __future__ import annotations
@@ -61,6 +67,18 @@ def build_parser() -> argparse.ArgumentParser:
                    help="Sampling rng seed for --generate")
     p.add_argument("--serve", action="store_true",
                    help="Host this node's stage behind gRPC (reference-interop mode)")
+    p.add_argument("--serve_lm", action="store_true",
+                   help="GPT families: run the continuous-batching LM daemon "
+                        "on this node's port — SendTensor(prompt ids) answers "
+                        "with generated tokens (runtime/lm_server.py)")
+    p.add_argument("--slots", type=int, default=4,
+                   help="--serve_lm: concurrent decode slots in the pool")
+    p.add_argument("--max_len", type=int, default=None,
+                   help="--serve_lm: max sequence length per slot "
+                        "(default: model block_size)")
+    p.add_argument("--prompt_pad", type=int, default=None,
+                   help="--serve_lm: prompt padding bucket (one prefill "
+                        "compilation; default min(64, max_len))")
     p.add_argument("--process_id", type=int, default=None,
                    help="This host's process id for multi-host (config 'distributed') runs")
     p.add_argument("--log_level", default="INFO")
@@ -192,6 +210,9 @@ def main(argv=None) -> int:
                 jax.default_backend(),
             )
 
+    if args.serve_lm:
+        return _serve_lm(engine, args)
+
     if args.serve:
         from dnn_tpu.comm.service import serve_stage
 
@@ -244,6 +265,46 @@ def main(argv=None) -> int:
     else:
         log.info("nothing to do for non-initiator node in single-controller mode "
                  "(use --serve for distributed edge mode)")
+    return 0
+
+
+def _serve_lm(engine: PipelineEngine, args) -> int:
+    """Long-lived LM daemon: the reference's defining serving-process shape
+    (node.py:114-133) with the continuous batcher as the workload. Every
+    GPT family serves; MoE plugs its routed FFN into the same pool."""
+    from dnn_tpu.models.gpt import GPTConfig, prepare_stacked
+    from dnn_tpu.models.gpt_moe import GPTMoEConfig
+    from dnn_tpu.runtime.lm_server import serve_lm
+
+    cfg = engine.spec.config
+    ffn = None
+    if isinstance(cfg, GPTMoEConfig):
+        from dnn_tpu.runtime.generate_moe import moe_cache_ffn
+
+        ffn = moe_cache_ffn(cfg, compute_dtype=engine.compute_dtype)
+    elif type(cfg) is not GPTConfig:
+        log.error("--serve_lm requires a GPT-family model; '%s' (config %s) "
+                  "is not one", engine.config.model, type(cfg).__name__)
+        return 1
+    me = engine.config.node_by_id(args.node_id)
+    if me.port is None:
+        log.error("node '%s' has no IP:Port address in the config; the LM "
+                  "daemon needs one to bind", args.node_id)
+        return 1
+    prepared = prepare_stacked(engine.params, cfg)
+    try:
+        asyncio.run(serve_lm(
+            cfg, prepared, port=me.port, slots=args.slots,
+            max_len=args.max_len, prompt_pad=args.prompt_pad,
+            temperature=args.temperature, top_k=args.top_k,
+            compute_dtype=engine.compute_dtype, seed=args.seed, ffn=ffn,
+            default_max_new=args.generate or 32,
+        ))
+    except KeyboardInterrupt:
+        log.info("shutting down")
+    except Exception as e:  # noqa: BLE001 — CLI boundary (bind failures etc.)
+        log.error("LM serve failed: %s", e)
+        return 1
     return 0
 
 

@@ -415,23 +415,39 @@ class PipelineEngine:
         decode). Other runtimes fall back to the single-program KV-cache
         decoder; both are token-for-token identical."""
         from dnn_tpu.models.gpt import GPTConfig, prepare_stacked
+        from dnn_tpu.models.gpt_moe import GPTMoEConfig
         from dnn_tpu.runtime.generate import make_generate, make_pipeline_generate
 
         cfg = self.spec.config
-        if type(cfg) is not GPTConfig:
-            # exact match: the KV-cache decoder assumes dense-GPT block
-            # params ('mlp'); subclassed families (MoE) are not decodable
-            # through it
-            raise ValueError(
-                f"generation requires a dense GPT-family model; "
-                f"'{self.config.model}' has config {type(cfg).__name__}"
-            )
         if self.role == "stage":
             raise RuntimeError(
                 "generation needs the full pipeline; this engine was built "
                 "with role='stage' (serves one part)"
             )
         default_rng = jax.random.PRNGKey(0)
+        if isinstance(cfg, GPTMoEConfig):
+            # MoE family decodes through the single-program routed decoder
+            # (runtime/generate_moe.py); pipeline-parallel MoE decode is not
+            # built, so spmd engines fall back to the local program too.
+            from dnn_tpu.runtime.generate_moe import make_generate_moe
+
+            if not hasattr(self, "_prepared_single"):
+                self._prepared_single = prepare_stacked(self.params, cfg)
+            gen = make_generate_moe(
+                cfg, max_new_tokens=max_new_tokens, temperature=temperature,
+                sample_top_k=top_k, compute_dtype=self.compute_dtype,
+            )
+            prepared = self._prepared_single
+            return lambda ids, rng=None: gen(
+                prepared, ids, default_rng if rng is None else rng
+            )
+        if type(cfg) is not GPTConfig:
+            # exact match: the KV-cache decoder assumes dense-GPT block
+            # params ('mlp'); unknown subclasses are not decodable through it
+            raise ValueError(
+                f"generation requires a GPT-family model; "
+                f"'{self.config.model}' has config {type(cfg).__name__}"
+            )
         if self.runtime == "spmd" and self._gpt_stacked_ready():
             gen = make_pipeline_generate(
                 cfg, self.mesh, max_new_tokens=max_new_tokens,

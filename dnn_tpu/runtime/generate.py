@@ -29,14 +29,18 @@ from jax import lax
 from dnn_tpu.models.gpt import GPTConfig, head
 from dnn_tpu.ops.attention import merge_heads, split_heads
 from dnn_tpu.ops.nn import gelu, layer_norm, linear
+from dnn_tpu.runtime.kvcache import FloatKV, Int8KV, codec_for_cache
 
 _NEG_BIG = -1e30
 
 
 def init_cache(cfg: GPTConfig, batch: int, max_len: int, dtype=jnp.float32):
-    """Preallocated K/V cache, one leading layer axis: (L, B, H, S, D)."""
-    shape = (cfg.n_layer, batch, cfg.n_head, max_len, cfg.n_embd // cfg.n_head)
-    return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+    """Preallocated K/V cache, one leading layer axis: (L, B, H, S, D).
+    dtype="int8" builds the quantized cache (per-row scales ride along —
+    dnn_tpu/runtime/kvcache.Int8KV)."""
+    if dtype == "int8":
+        return Int8KV().init(cfg, batch, max_len)
+    return FloatKV(dtype).init(cfg, batch, max_len)
 
 
 def _qkv_heads(bp, h, *, cfg: GPTConfig, compute_dtype):
@@ -48,54 +52,60 @@ def _qkv_heads(bp, h, *, cfg: GPTConfig, compute_dtype):
 def _attend_cache(q, k_cache, v_cache, pos_limit):
     """q (B,H,T,D) against the full static cache (B,H,S,D), masking key
     positions > their allowed limit. `pos_limit` is (T,) — for row t, keys
-    at positions <= pos_limit[t] attend."""
-    d = q.shape[-1]
-    s = jnp.einsum("bhtd,bhsd->bhts", q, k_cache).astype(jnp.float32) / jnp.sqrt(d)
-    cols = jnp.arange(k_cache.shape[2])
-    s = jnp.where(cols[None, None, None, :] <= pos_limit[None, None, :, None], s, _NEG_BIG)
-    p = jax.nn.softmax(s, axis=-1)
-    return jnp.einsum("bhts,bhsd->bhtd", p.astype(v_cache.dtype), v_cache)
+    at positions <= pos_limit[t] attend. (Float-cache fast path; the codec
+    abstraction in dnn_tpu/runtime/kvcache.py generalizes this to int8.)"""
+    return FloatKV(k_cache.dtype).attend(
+        q, {"k": k_cache, "v": v_cache}, pos_limit)
 
 
-def _block_with_cache(bp, x, k_cache, v_cache, start_pos, *, cfg: GPTConfig,
-                      compute_dtype):
+def _block_with_cache(bp, x, layer_cache, start_pos, *, cfg: GPTConfig,
+                      compute_dtype, ffn=None, codec=None):
     """One transformer block over x (B, T, C) whose tokens sit at positions
-    [start_pos, start_pos+T); writes this block's K/V into the cache and
-    attends against everything cached so far. T=prompt_len for prefill,
-    T=1 for decode — same code path."""
+    [start_pos, start_pos+T); writes this block's K/V into the per-layer
+    cache (a codec pytree — float or int8+scales) and attends against
+    everything cached so far. T=prompt_len for prefill, T=1 for decode —
+    same code path. `ffn(bp, h)` overrides the dense MLP (the MoE family
+    plugs its routed FFN in here, dnn_tpu/runtime/generate_moe.py)."""
+    codec = codec or codec_for_cache(layer_cache)
     t = x.shape[1]
     h = layer_norm(bp["ln_1"], x, eps=cfg.ln_eps)
     q, k, v = _qkv_heads(bp, h, cfg=cfg, compute_dtype=compute_dtype)
-    k_cache = lax.dynamic_update_slice_in_dim(k_cache, k.astype(k_cache.dtype), start_pos, axis=2)
-    v_cache = lax.dynamic_update_slice_in_dim(v_cache, v.astype(v_cache.dtype), start_pos, axis=2)
+    layer_cache = codec.write(layer_cache, k, v, start_pos)
     pos_limit = start_pos + jnp.arange(t)  # causal within the new tokens
-    y = _attend_cache(q, k_cache, v_cache, pos_limit)
+    y = codec.attend(q, layer_cache, pos_limit)
     x = x + linear(bp["attn"]["proj"], merge_heads(y.astype(x.dtype)),
                    compute_dtype=compute_dtype)
     h = layer_norm(bp["ln_2"], x, eps=cfg.ln_eps)
-    m = linear(bp["mlp"]["proj"], gelu(linear(bp["mlp"]["fc"], h, compute_dtype=compute_dtype)),
-               compute_dtype=compute_dtype)
-    return x + m, k_cache, v_cache
+    if ffn is None:
+        m = linear(bp["mlp"]["proj"], gelu(linear(bp["mlp"]["fc"], h, compute_dtype=compute_dtype)),
+                   compute_dtype=compute_dtype)
+    else:
+        m = ffn(bp, h).astype(x.dtype)
+    return x + m, layer_cache
 
 
 def forward_with_cache(prepared, ids, cache, start_pos, *, cfg: GPTConfig,
-                       compute_dtype=None):
+                       compute_dtype=None, ffn=None):
     """Forward ids (B, T) at positions [start_pos, start_pos+T) through all
     layers (scan over the stacked blocks), updating the cache. Returns
-    (logits (B, T, V), cache)."""
+    (logits (B, T, V), cache). The cache format picks the storage codec:
+    {"k","v"} float (init_cache default) or the int8+scales form
+    (init_cache(..., dtype="int8"))."""
+    codec = codec_for_cache(cache)
     x = _embed_at(prepared, ids, start_pos, compute_dtype=compute_dtype)
 
     def layer(carry, layer_in):
-        bp, k_c, v_c = layer_in
-        x, k_c, v_c = _block_with_cache(
-            bp, carry, k_c, v_c, start_pos, cfg=cfg, compute_dtype=compute_dtype
+        bp, layer_cache = layer_in
+        x, layer_cache = _block_with_cache(
+            bp, carry, layer_cache, start_pos, cfg=cfg,
+            compute_dtype=compute_dtype, ffn=ffn, codec=codec,
         )
-        return x, (k_c, v_c)
+        return x, layer_cache
 
-    x, (k_new, v_new) = lax.scan(layer, x, (prepared["blocks"], cache["k"], cache["v"]))
+    x, new_cache = lax.scan(layer, x, (prepared["blocks"], cache))
     logits = head(prepared, x.astype(jnp.float32), cfg=cfg,
                   compute_dtype=compute_dtype)
-    return logits, {"k": k_new, "v": v_new}
+    return logits, new_cache
 
 
 def _sample(logits, rng, *, temperature: float, top_k: Optional[int]):
@@ -207,15 +217,15 @@ def make_pipeline_generate(cfg: GPTConfig, mesh, *, max_new_tokens: int,
 
         def my_blocks(x, ck, cv, start_pos):
             def layer(carry, layer_in):
-                bp, k_c, v_c = layer_in
-                y, k_c, v_c = _block_with_cache(
-                    bp, carry, k_c, v_c, start_pos, cfg=cfg,
+                bp, layer_cache = layer_in
+                y, layer_cache = _block_with_cache(
+                    bp, carry, layer_cache, start_pos, cfg=cfg,
                     compute_dtype=compute_dtype,
                 )
-                return y, (k_c, v_c)
+                return y, layer_cache
 
-            x, (ck2, cv2) = lax.scan(layer, x, (local, ck, cv))
-            return x, ck2, cv2
+            x, new_c = lax.scan(layer, x, (local, {"k": ck, "v": cv}))
+            return x, new_c["k"], new_c["v"]
 
         def ring_pass(x, ck, cv, start_pos):
             """x real on stage 0 -> through all stages in order -> real
@@ -279,12 +289,17 @@ def make_pipeline_generate(cfg: GPTConfig, mesh, *, max_new_tokens: int,
 
 
 def make_generate(cfg: GPTConfig, *, max_new_tokens: int, temperature: float = 0.0,
-                  top_k: Optional[int] = None, compute_dtype=None):
+                  top_k: Optional[int] = None, compute_dtype=None, ffn=None,
+                  kv_dtype=None):
     """Build a jitted generate(prepared, ids, rng) -> (B, max_new_tokens).
 
     `prepared` is the stacked layout from `gpt.prepare_stacked`. The prompt
     length is static per compilation (usual JAX contract); decode runs as a
-    single lax.scan.
+    single lax.scan. `ffn(bp, h)` overrides the dense block MLP (the MoE
+    family's entry point, dnn_tpu/runtime/generate_moe.py). `kv_dtype`
+    picks the cache storage: None follows compute_dtype (f32 default),
+    jnp.bfloat16 halves cache bandwidth, "int8" quarters it
+    (dnn_tpu/runtime/kvcache.py).
     """
     if max_new_tokens < 1:
         raise ValueError(f"max_new_tokens must be >= 1, got {max_new_tokens}")
@@ -298,12 +313,13 @@ def make_generate(cfg: GPTConfig, *, max_new_tokens: int, temperature: float = 0
                 f"prompt {t} + max_new_tokens {max_new_tokens} exceeds "
                 f"block_size {cfg.block_size}"
             )
-        cache_dtype = compute_dtype or jnp.float32
+        cache_dtype = kv_dtype if kv_dtype is not None else (compute_dtype or jnp.float32)
         cache = init_cache(cfg, b, s_max, cache_dtype)
 
         # prefill: full prompt in one forward
         logits, cache = forward_with_cache(
-            prepared, ids, cache, 0, cfg=cfg, compute_dtype=compute_dtype
+            prepared, ids, cache, 0, cfg=cfg, compute_dtype=compute_dtype,
+            ffn=ffn,
         )
         rng, sub = jax.random.split(rng)
         tok = _sample(logits[:, -1], sub, temperature=temperature, top_k=top_k)
@@ -313,7 +329,7 @@ def make_generate(cfg: GPTConfig, *, max_new_tokens: int, temperature: float = 0
             cache, tok, rng = carry
             logits, cache = forward_with_cache(
                 prepared, tok[:, None], cache, t + i, cfg=cfg,
-                compute_dtype=compute_dtype,
+                compute_dtype=compute_dtype, ffn=ffn,
             )
             rng, sub = jax.random.split(rng)
             nxt = _sample(logits[:, -1], sub, temperature=temperature, top_k=top_k)

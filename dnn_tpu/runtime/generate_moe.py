@@ -1,0 +1,199 @@
+"""KV-cache generation for the MoE GPT family (dense-routed and
+expert-parallel).
+
+The reference has neither MoE nor any decode loop (SURVEY.md §2 "no MoE
+modules exist"; §5 "no KV-cache even" — /root/reference/node.py:137-200 is
+one stateless forward). This module closes the round-2 gap where
+`gpt_moe` could train and forward but not serve: it reuses the dense
+family's cached-attention machinery (dnn_tpu/runtime/generate.py) and
+swaps the block MLP for the routed MoE FFN (dnn_tpu/parallel/moe.py).
+
+Routing granularity during decode: the MoE FFN routes over whatever
+tokens a forward sees. Prefill routes the whole prompt as one group
+(identical to the stateless forward at batch 1); each decode step routes
+the B current tokens. Per-token top-k routing is batch-independent as
+long as no token is dropped for capacity, so decode output matches the
+full-sequence forward exactly whenever capacity is not exceeded — the
+contract `tests/test_generate_moe.py` pins with a generous
+capacity_factor. (Capacity drops are batch-dependent by construction in
+any capacity-based MoE; that caveat is inherent, not an artifact of the
+cache.)
+
+Expert-parallel decode (`make_generate_moe_ep`) runs the WHOLE generate —
+prefill + `lax.scan` decode — as one shard_map program on the expert
+mesh axis: batch shards over the axis (each device's local batch is its
+routing group, so the local KV cache lives with the tokens it serves),
+expert weights shard on their leading E axis, and tokens travel to their
+experts via `jax.lax.all_to_all` per step. Greedy EP decode equals the
+dense path with groups == axis size token-for-token; sampled EP decode
+folds the device index into the rng stream (per-device local sampling),
+so it matches the dense path in distribution, not draw-for-draw.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from dnn_tpu.models.gpt import head
+from dnn_tpu.models.gpt_moe import GPTMoEConfig
+from dnn_tpu.parallel.mesh import EXPERT_AXIS
+from dnn_tpu.parallel.moe import moe_capacity, moe_ffn, moe_ffn_local
+from dnn_tpu.runtime.generate import (
+    _embed_at,
+    _sample,
+    forward_with_cache,
+    init_cache,
+    make_generate,
+)
+
+__all__ = [
+    "moe_cache_ffn",
+    "forward_with_cache_moe",
+    "make_generate_moe",
+    "make_generate_moe_ep",
+]
+
+
+def moe_cache_ffn(cfg: GPTMoEConfig, *, groups: int = 1, compute_dtype=None):
+    """The `ffn(bp, h)` hook that turns any dense cached decoder
+    (forward_with_cache / make_generate / ContinuousBatcher) into its MoE
+    counterpart: routes h's tokens through bp["moe"] in `groups` groups."""
+
+    def ffn(bp, h):
+        return moe_ffn(
+            bp["moe"], h, top_k=cfg.top_k,
+            capacity_factor=cfg.capacity_factor, groups=groups,
+            compute_dtype=compute_dtype,
+        )
+
+    return ffn
+
+
+def forward_with_cache_moe(prepared, ids, cache, start_pos, *,
+                           cfg: GPTMoEConfig, compute_dtype=None,
+                           groups: int = 1):
+    """MoE analog of generate.forward_with_cache: ids (B, T) at positions
+    [start_pos, start_pos+T), routed in `groups` groups per layer."""
+    return forward_with_cache(
+        prepared, ids, cache, start_pos, cfg=cfg,
+        compute_dtype=compute_dtype,
+        ffn=moe_cache_ffn(cfg, groups=groups, compute_dtype=compute_dtype),
+    )
+
+
+def make_generate_moe(cfg: GPTMoEConfig, *, max_new_tokens: int,
+                      temperature: float = 0.0,
+                      sample_top_k: Optional[int] = None,
+                      compute_dtype=None, groups: int = 1):
+    """Jitted generate(prepared, ids, rng) for the MoE family — the dense
+    family's make_generate with the routed FFN plugged in. `sample_top_k`
+    is the SAMPLING truncation (cfg.top_k is the ROUTING fan-out)."""
+    return make_generate(
+        cfg, max_new_tokens=max_new_tokens, temperature=temperature,
+        top_k=sample_top_k, compute_dtype=compute_dtype,
+        ffn=moe_cache_ffn(cfg, groups=groups, compute_dtype=compute_dtype),
+    )
+
+
+def make_generate_moe_ep(cfg: GPTMoEConfig, mesh, *, max_new_tokens: int,
+                         temperature: float = 0.0,
+                         sample_top_k: Optional[int] = None,
+                         compute_dtype=None, axis_name: str = EXPERT_AXIS):
+    """Expert-parallel KV-cache generation over `mesh`'s expert axis.
+
+    generate(prepared, ids, rng): ids (B, T), B divisible by the axis
+    size. Batch and KV cache shard over the axis; expert weights shard on
+    E; tokens reach their experts via all_to_all inside every prefill and
+    decode-step forward. Greedy output equals
+    make_generate_moe(groups=axis_size) token-for-token.
+    """
+    if max_new_tokens < 1:
+        raise ValueError(f"max_new_tokens must be >= 1, got {max_new_tokens}")
+    n = mesh.shape[axis_name]
+    if cfg.n_experts % n:
+        raise ValueError(
+            f"n_experts={cfg.n_experts} not divisible by axis size {n}")
+
+    moe_spec = {"router": {"kernel": P()},
+                "wi": P(None, axis_name), "bi": P(None, axis_name),
+                "wo": P(None, axis_name), "bo": P(None, axis_name)}
+    param_specs = {
+        "wte": {"embedding": P()}, "wpe": {"embedding": P()},
+        "ln_f": {"scale": P(), "bias": P()}, "lm_head": {"kernel": P()},
+        "blocks": {
+            "ln_1": {"scale": P(), "bias": P()},
+            "attn": {"qkv": {"kernel": P(), "bias": P()},
+                     "proj": {"kernel": P(), "bias": P()}},
+            "ln_2": {"scale": P(), "bias": P()},
+            "moe": moe_spec,
+        },
+    }
+
+    def per_device(prep_local, ids_local, rng):
+        b, t = ids_local.shape  # local batch = this device's routing group
+        s_max = t + max_new_tokens
+        cache = init_cache(cfg, b, s_max, compute_dtype or jnp.float32)
+
+        def ffn_for(tokens_per_group):
+            capacity = moe_capacity(
+                tokens_per_group, cfg.n_experts, cfg.top_k,
+                cfg.capacity_factor)
+
+            def ffn(bp, h):
+                d = h.shape[-1]
+                return moe_ffn_local(
+                    bp["moe"], h.reshape(-1, d), top_k=cfg.top_k,
+                    capacity=capacity, axis_name=axis_name,
+                    compute_dtype=compute_dtype,
+                ).reshape(h.shape)
+
+            return ffn
+
+        logits, cache = forward_with_cache(
+            prep_local, ids_local, cache, 0, cfg=cfg,
+            compute_dtype=compute_dtype, ffn=ffn_for(b * t))
+        # per-device stream: local rows sample locally (greedy ignores rng)
+        rng = jax.random.fold_in(rng, lax.axis_index(axis_name))
+        rng, sub = jax.random.split(rng)
+        tok = _sample(logits[:, -1], sub, temperature=temperature,
+                      top_k=sample_top_k)
+
+        step_ffn = ffn_for(b)
+
+        def step(carry, i):
+            cache, tok, rng = carry
+            logits, cache = forward_with_cache(
+                prep_local, tok[:, None], cache, t + i, cfg=cfg,
+                compute_dtype=compute_dtype, ffn=step_ffn)
+            rng, sub = jax.random.split(rng)
+            nxt = _sample(logits[:, -1], sub, temperature=temperature,
+                          top_k=sample_top_k)
+            return (cache, nxt, rng), tok
+
+        (_, last, _), toks = lax.scan(
+            step, (cache, tok, rng), jnp.arange(max_new_tokens - 1))
+        toks = jnp.moveaxis(toks, 0, 1)
+        return jnp.concatenate([toks, last[:, None]], axis=1)
+
+    @jax.jit
+    def generate(prepared, ids, rng):
+        b, t = ids.shape
+        if b % n:
+            raise ValueError(f"batch {b} not divisible by expert-axis size {n}")
+        if t + max_new_tokens > cfg.block_size:
+            raise ValueError(
+                f"prompt {t} + max_new_tokens {max_new_tokens} exceeds "
+                f"block_size {cfg.block_size}")
+        return jax.shard_map(
+            per_device, mesh=mesh,
+            in_specs=(param_specs, P(axis_name), P()),
+            out_specs=P(axis_name),
+            check_vma=False,
+        )(prepared, ids, rng)
+
+    return generate

@@ -1,0 +1,270 @@
+"""LM serving daemon: the ContinuousBatcher behind the gRPC edge.
+
+The reference's defining trait is a long-lived serving *process*
+(/root/reference/node.py:114-133 hosts a gRPC server until termination);
+its only workload is one CNN forward per request. The rebuild's LM analog
+is this daemon: a `NodeService` server whose SendTensor accepts a PROMPT
+(1-D int32 token ids) and answers with the GENERATED TOKENS, decoding all
+in-flight requests together through one continuous-batching pool
+(dnn_tpu/runtime/serving.py) — requests enter and leave slots
+independently, so concurrent callers share full batch width.
+
+Wire-compatible by construction: same proto as the reference
+(dnn_tpu/comm/wire.proto == node_service.proto), no new RPCs. Generation
+options ride the existing `request_id` field as "gen[:max_new[:seed]]"
+(anything unparseable falls back to server defaults) — a reference-built
+client could drive this server unmodified.
+
+Threading model: gRPC handlers are async, device compute is blocking, so
+ONE worker thread owns the batcher — it admits queued prompts whenever
+slots free up, steps the pool while anything is active, and resolves a
+`concurrent.futures.Future` per request that the async handlers await via
+`asyncio.wrap_future`. Handlers never touch the device; the pool never
+blocks the event loop (the reference blocks its loop on every hop,
+node.py:181 — SURVEY §3.3).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import queue
+import threading
+from typing import Optional
+
+import grpc
+import numpy as np
+
+from dnn_tpu.comm import wire_pb2 as pb
+from dnn_tpu.comm.service import (
+    PayloadCorruptError,
+    _handlers,
+    _tensor_arr,
+    _tensor_msg,
+)
+from dnn_tpu.runtime.serving import ContinuousBatcher
+
+log = logging.getLogger("dnn_tpu.lm_server")
+
+__all__ = ["LMServer", "serve_lm", "start_lm_server_in_background",
+           "parse_gen_options"]
+
+
+def parse_gen_options(request_id: str, default_max_new: int):
+    """'gen[:max_new[:seed]]' -> (max_new, seed). Unparseable segments fall
+    back to defaults (seed None = derive from the request id, the batcher's
+    own convention)."""
+    max_new, seed = default_max_new, None
+    parts = (request_id or "").split(":")
+    if len(parts) >= 2:
+        try:
+            max_new = max(1, int(parts[1]))
+        except ValueError:
+            pass
+    if len(parts) >= 3:
+        try:
+            seed = int(parts[2])
+        except ValueError:
+            pass
+    return max_new, seed
+
+
+class _BatcherWorker(threading.Thread):
+    """The one thread that talks to the device. Owns the ContinuousBatcher;
+    everyone else submits (prompt, max_new, seed, future) through a queue."""
+
+    def __init__(self, batcher: ContinuousBatcher):
+        super().__init__(daemon=True, name="lm-batcher")
+        self.batcher = batcher
+        self.q: "queue.Queue" = queue.Queue()
+        self._stop_evt = threading.Event()
+        self._futures = {}
+
+    def submit(self, prompt: np.ndarray, max_new: int, seed):
+        import concurrent.futures
+
+        fut = concurrent.futures.Future()
+        self.q.put((prompt, max_new, seed, fut))
+        return fut
+
+    def stop(self, *, drain: bool = True):
+        """Signal shutdown; the loop exits once the pool and queue are empty
+        (or immediately if drain=False — pending futures get cancelled)."""
+        if not drain:
+            while True:
+                try:
+                    *_rest, fut = self.q.get_nowait()
+                except queue.Empty:
+                    break
+                fut.cancel()
+        self._stop_evt.set()
+
+    # ------------------------------------------------------------------
+
+    def _admit(self, prompt, max_new, seed, fut):
+        try:
+            rid = self.batcher.submit(prompt, max_new, seed=seed)
+        except Exception as e:  # noqa: BLE001 — validation errors belong to
+            fut.set_exception(e)  # the submitting request, not the loop
+            return
+        self._futures[rid] = fut
+
+    def _publish_done(self):
+        b = self.batcher
+        for rid in [r for r in self._futures if r in b.results]:
+            self._futures.pop(rid).set_result(b.results.pop(rid))
+
+    def run(self):
+        b = self.batcher
+        while True:
+            if b.n_active == 0 and self.q.empty():
+                if self._stop_evt.is_set():
+                    return
+                try:
+                    self._admit(*self.q.get(timeout=0.1))
+                except queue.Empty:
+                    continue
+            while b.free_slots():
+                try:
+                    self._admit(*self.q.get_nowait())
+                except queue.Empty:
+                    break
+            if b.n_active:
+                b.step()
+            self._publish_done()  # submit alone can retire (budget == 1)
+
+
+class LMServer:
+    """NodeService servicer mapping SendTensor(prompt) -> generated tokens.
+
+    Build with the same (cfg, prepared) pair the batcher takes; batcher
+    kwargs pass through (slots, max_len, prompt_pad, temperature, top_k,
+    compute_dtype, eos_id, seed, ffn — `ffn` is how the MoE family serves,
+    dnn_tpu/runtime/generate_moe.moe_cache_ffn)."""
+
+    def __init__(self, cfg, prepared, *, default_max_new: int = 32,
+                 request_timeout: float = 120.0, **batcher_kwargs):
+        self.batcher = ContinuousBatcher(cfg, prepared, **batcher_kwargs)
+        self.default_max_new = default_max_new
+        self.request_timeout = request_timeout
+        self.worker = _BatcherWorker(self.batcher)
+        self.worker.start()
+
+    # --- RPC implementations (names/signatures fixed by the protocol) ---
+
+    async def SendTensor(self, request: pb.TensorRequest, context) -> pb.TensorResponse:
+        try:
+            prompt = _tensor_arr(request.tensor)
+        except PayloadCorruptError as e:
+            await context.abort(grpc.StatusCode.DATA_LOSS, str(e))
+        if not np.issubdtype(prompt.dtype, np.integer):
+            await context.abort(
+                grpc.StatusCode.INVALID_ARGUMENT,
+                f"prompt must be integer token ids, got dtype {prompt.dtype}")
+        max_new, seed = parse_gen_options(request.request_id, self.default_max_new)
+        fut = self.worker.submit(
+            np.asarray(prompt, np.int32).reshape(-1), max_new, seed)
+        try:
+            tokens = await asyncio.wait_for(
+                asyncio.wrap_future(fut), timeout=self.request_timeout)
+        except (ValueError, RuntimeError) as e:
+            # submit-side validation (overlong prompt, budget) — caller error
+            await context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
+        except asyncio.TimeoutError:
+            await context.abort(
+                grpc.StatusCode.DEADLINE_EXCEEDED,
+                f"generation exceeded {self.request_timeout}s")
+        return pb.TensorResponse(
+            status=f"[lm] ok: {len(tokens)} tokens",
+            result_tensor=_tensor_msg(np.asarray(tokens, np.int32)),
+        )
+
+    async def HealthCheck(self, request: pb.Empty, context) -> pb.HealthCheckResponse:
+        return pb.HealthCheckResponse(is_healthy=self.worker.is_alive())
+
+    async def SendMessage(self, request: pb.MessageRequest, context) -> pb.MessageReply:
+        b = self.batcher
+        return pb.MessageReply(
+            confirmation_text=(
+                f"[lm] pool: {b.n_active}/{b.slots} slots active, "
+                f"{len(b.results)} unclaimed results"))
+
+    def close(self):
+        self.worker.stop(drain=False)
+        self.worker.join(timeout=10)
+
+
+async def serve_lm(cfg, prepared, *, port: int, **server_kwargs):
+    """Start the LM daemon and block until termination — the LM analog of
+    comm.service.serve_stage (reference serve(), node.py:114-133)."""
+    servicer = LMServer(cfg, prepared, **server_kwargs)
+    server = grpc.aio.server()
+    server.add_generic_rpc_handlers((_handlers(servicer),))
+    listen = f"[::]:{port}"
+    if server.add_insecure_port(listen) == 0:
+        raise RuntimeError(f"failed to bind gRPC server to {listen}")
+    log.info("gRPC LM server listening on %s (%d slots)", listen,
+             servicer.batcher.slots)
+    await server.start()
+    try:
+        await server.wait_for_termination()
+    finally:
+        await server.stop(grace=1)
+        servicer.close()
+
+
+def start_lm_server_in_background(cfg, prepared, *, port: int, **server_kwargs):
+    """Test/embedding helper: serve_lm on a daemon thread; returns
+    (thread, stop_callback) — mirrors
+    comm.service.start_stage_server_in_background."""
+    loop = asyncio.new_event_loop()
+    started = threading.Event()
+    state = {}
+
+    async def _run():
+        try:
+            servicer = LMServer(cfg, prepared, **server_kwargs)
+            server = grpc.aio.server()
+            server.add_generic_rpc_handlers((_handlers(servicer),))
+            if server.add_insecure_port(f"[::]:{port}") == 0:
+                servicer.close()
+                raise RuntimeError(f"failed to bind gRPC server to [::]:{port}")
+            await server.start()
+            state["servicer"], state["server"] = servicer, server
+            state["done"] = asyncio.Event()
+        except BaseException as e:
+            state["error"] = e
+            raise
+        finally:
+            started.set()
+        await state["done"].wait()
+        await asyncio.sleep(0.05)
+
+    def _thread_main():
+        asyncio.set_event_loop(loop)
+        try:
+            loop.run_until_complete(_run())
+        except BaseException:
+            if "error" not in state:
+                raise
+            # startup error already recorded and re-raised to the caller
+
+    t = threading.Thread(target=_thread_main, daemon=True)
+    t.start()
+    if not started.wait(timeout=30):
+        raise RuntimeError("LM server failed to start")
+    if "error" in state:
+        t.join(timeout=5)
+        raise RuntimeError(f"LM server failed to start: {state['error']}") \
+            from state["error"]
+
+    def stop():
+        async def _stop():
+            await state["server"].stop(grace=0.2)
+            state["done"].set()
+
+        asyncio.run_coroutine_threadsafe(_stop(), loop).result(timeout=10)
+        state["servicer"].close()
+        t.join(timeout=5)
+
+    return t, stop

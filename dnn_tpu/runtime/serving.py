@@ -77,9 +77,12 @@ def _attend_rows(q, k_cache, v_cache, pos):
     return jnp.einsum("bhts,bhsd->bhtd", p.astype(v_cache.dtype), v_cache)
 
 
-def _decode_block_rows(bp, x, k_cache, v_cache, pos, write, *, cfg, compute_dtype):
+def _decode_block_rows(bp, x, k_cache, v_cache, pos, write, *, cfg, compute_dtype,
+                       ffn=None):
     """One block over x (B,1,C) with per-row positions. `write` (B,) bool
-    gates the cache update (inactive slots must not touch their rows)."""
+    gates the cache update (inactive slots must not touch their rows).
+    `ffn(bp, h)` overrides the dense MLP (MoE serving,
+    dnn_tpu/runtime/generate_moe.moe_cache_ffn)."""
     h = layer_norm(bp["ln_1"], x, eps=cfg.ln_eps)
     q, k, v = _qkv_heads(bp, h, cfg=cfg, compute_dtype=compute_dtype)
     k_new = _write_kv_rows(k_cache, k.astype(k_cache.dtype), pos)
@@ -91,8 +94,11 @@ def _decode_block_rows(bp, x, k_cache, v_cache, pos, write, *, cfg, compute_dtyp
     x = x + linear(bp["attn"]["proj"], merge_heads(y.astype(x.dtype)),
                    compute_dtype=compute_dtype)
     h = layer_norm(bp["ln_2"], x, eps=cfg.ln_eps)
-    m = linear(bp["mlp"]["proj"], gelu(linear(bp["mlp"]["fc"], h, compute_dtype=compute_dtype)),
-               compute_dtype=compute_dtype)
+    if ffn is None:
+        m = linear(bp["mlp"]["proj"], gelu(linear(bp["mlp"]["fc"], h, compute_dtype=compute_dtype)),
+                   compute_dtype=compute_dtype)
+    else:
+        m = ffn(bp, h).astype(x.dtype)
     return x + m, k_cache, v_cache
 
 
@@ -111,7 +117,8 @@ class ContinuousBatcher:
     def __init__(self, cfg: GPTConfig, prepared, *, slots: int = 4,
                  max_len: Optional[int] = None, prompt_pad: Optional[int] = None,
                  temperature: float = 0.0, top_k: Optional[int] = None,
-                 compute_dtype=None, eos_id: Optional[int] = None, seed: int = 0):
+                 compute_dtype=None, eos_id: Optional[int] = None, seed: int = 0,
+                 ffn=None):
         self.cfg = cfg
         self.prepared = prepared
         self.slots = slots
@@ -147,7 +154,7 @@ class ContinuousBatcher:
                 bp, k_c, v_c = layer_in
                 y, k_c, v_c = _decode_block_rows(
                     bp, carry, k_c, v_c, pos, active, cfg=cfg,
-                    compute_dtype=compute_dtype,
+                    compute_dtype=compute_dtype, ffn=ffn,
                 )
                 return y, (k_c, v_c)
 
@@ -174,7 +181,8 @@ class ContinuousBatcher:
             write K/V that the per-row position mask never attends."""
             row = init_cache(cfg, 1, self.max_len, cache_dtype)
             logits, row = forward_with_cache(
-                prepared, padded, row, 0, cfg=cfg, compute_dtype=compute_dtype
+                prepared, padded, row, 0, cfg=cfg, compute_dtype=compute_dtype,
+                ffn=ffn,
             )
             first = _sample(
                 logits[:, true_len - 1][0:1], rng,

@@ -131,44 +131,46 @@ def make_speculative_generate(
             "rng": rng, "iters": jnp.int32(0), "accepted": jnp.int32(0),
         }
 
-        def propose(d_cache, last, rng):
-            """k draft steps from `last` at pos; returns proposals (k,),
-            their proposal-probabilities (k,), and the updated cache."""
+        def propose(d_cache, last, rng, pos):
+            """k draft steps from `last` (which sits at position `pos`);
+            returns proposals (k,), the draft's full sampling distribution
+            per step (k, V) (needed for the residual resample at a
+            rejection), and the updated cache. Greedy carries a scalar 1.0
+            placeholder instead of the (k, V) rows — it never resamples."""
 
             def step(carry, i):
                 cache, tok, r = carry
                 logits, cache = forward_with_cache(
-                    draft_prepared, tok[:, None], cache, state_pos + i,
+                    draft_prepared, tok[:, None], cache, pos + i,
                     cfg=draft_cfg, compute_dtype=compute_dtype)
                 row = logits[0, -1]
                 if greedy:
                     nxt = jnp.argmax(row).astype(jnp.int32)[None]
-                    prob = jnp.float32(1.0)
+                    out = jnp.float32(1.0)
                 else:
                     r, sub = jax.random.split(r)
                     dist = _probs(row, temperature=temperature, top_k=top_k)
                     nxt = jax.random.categorical(sub, jnp.log(dist))[None].astype(jnp.int32)
-                    prob = dist[nxt[0]]
-                return (cache, nxt, r), (nxt[0], prob)
+                    out = dist
+                return (cache, nxt, r), (nxt[0], out)
 
-            (d_cache, _, rng), (props, d_probs) = lax.scan(
+            (d_cache, _, rng), (props, d_rows) = lax.scan(
                 step, (d_cache, last, rng), jnp.arange(k))
-            return d_cache, props, d_probs, rng
+            return d_cache, props, d_rows, rng
 
         def body(s):
-            nonlocal_pos = s["pos"]
+            pos = s["pos"]
             # 1. draft sync: idempotent re-feed of last verify chunk
             _, d_cache = forward_with_cache(
                 draft_prepared, s["prev_chunk"][None, :], s["d_cache"],
                 s["prev_pos"], cfg=draft_cfg, compute_dtype=compute_dtype)
             # 2. draft proposes k tokens
-            global state_pos
-            state_pos = nonlocal_pos
-            d_cache, props, d_probs, rng = propose(d_cache, s["last"], s["rng"])
+            d_cache, props, d_rows, rng = propose(
+                d_cache, s["last"], s["rng"], pos)
             # 3. target scores [last, p1..pk] in one forward
             chunk = jnp.concatenate([s["last"], props])[None, :]  # (1, k+1)
             t_logits, t_cache = forward_with_cache(
-                target_prepared, chunk, s["t_cache"], nonlocal_pos,
+                target_prepared, chunk, s["t_cache"], pos,
                 cfg=target_cfg, compute_dtype=compute_dtype)
             rows = t_logits[0]  # (k+1, V); row i predicts position pos+i+1
 
@@ -176,36 +178,40 @@ def make_speculative_generate(
                 t_toks = jnp.argmax(rows, axis=-1).astype(jnp.int32)  # (k+1,)
                 match = props == t_toks[:k]
                 m = jnp.where(match.all(), k, jnp.argmax(~match)).astype(jnp.int32)
-                commit = t_toks  # committed tokens ARE the target's greedy picks
+                w = t_toks  # committed tokens ARE the target's greedy picks
             else:
                 rng, r_acc, r_rep = jax.random.split(rng, 3)
                 t_dist = _probs(rows, temperature=temperature, top_k=top_k)
                 t_probs = t_dist[jnp.arange(k), props]  # target prob of each proposal
+                d_probs = d_rows[jnp.arange(k), props]  # draft prob of each proposal
                 ratio = t_probs / jnp.maximum(d_probs, 1e-30)
                 accept = jax.random.uniform(r_acc, (k,)) < jnp.minimum(ratio, 1.0)
                 m = jnp.where(accept.all(), k, jnp.argmax(~accept)).astype(jnp.int32)
-                # replacement at a rejection: sample norm(max(p_t - p_d, 0));
-                # bonus when all accepted: sample p_t row k. Row m covers both
-                # (d_resid degrades to p_t at m == k via the fallback guard).
-                d_dist_m = _probs(
-                    jnp.zeros_like(rows[0]), temperature=1.0, top_k=None
-                )  # placeholder; replaced below for the real draft row
-                # draft dist at row m is only defined for m < k; build it by
-                # indexing the draft's per-step dists lazily: recompute from
-                # scratch is wasteful, so carry the adjusted residual using
-                # the target row and the proposal's draft prob is NOT enough
-                # — we need the full draft row. Score the draft rows in one
-                # batched forward over the same chunk instead.
-                raise NotImplementedError  # replaced below; see sampled_body
-
-            w = commit
+                # Token at row m: on a rejection (m < k), resample from the
+                # residual norm(max(p_t − p_d, 0)) — together with the
+                # accept rule this reproduces p_t exactly (Leviathan et al.
+                # 2023, Thm 1). When all k accepted (m == k) the draft has
+                # no row there; d_row degrades to zeros so the "residual"
+                # is exactly p_t — the standard bonus sample.
+                d_row_m = jnp.where(
+                    m < k, d_rows[jnp.minimum(m, k - 1)], jnp.zeros_like(d_rows[0]))
+                t_row_m = t_dist[m]
+                resid = jnp.maximum(t_row_m - d_row_m, 0.0)
+                z = resid.sum()
+                # z == 0 only if p_t <= p_d pointwise, i.e. p_t == p_d: any
+                # draw from p_t is then distribution-correct.
+                resid = jnp.where(z > 0, resid / z, t_row_m)
+                rep = jax.random.categorical(r_rep, jnp.log(resid)).astype(jnp.int32)
+                props_ext = jnp.concatenate(
+                    [props, jnp.zeros((1,), jnp.int32)])  # (k+1,)
+                w = jnp.where(jnp.arange(k + 1) == m, rep, props_ext)
             buf2 = lax.dynamic_update_slice(s["buf"], w[None, :], (0, s["n"]))
             committed = m + 1
             return {
                 "t_cache": t_cache, "d_cache": d_cache, "buf": buf2,
                 "n": s["n"] + committed, "last": w[m][None],
-                "pos": nonlocal_pos + committed,
-                "prev_chunk": chunk[0], "prev_pos": nonlocal_pos,
+                "pos": pos + committed,
+                "prev_chunk": chunk[0], "prev_pos": pos,
                 "rng": rng, "iters": s["iters"] + 1,
                 "accepted": s["accepted"] + m,
             }

@@ -30,6 +30,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from dnn_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS, STAGE_AXIS
 from dnn_tpu.parallel.pipeline import (
+    spmd_pipeline_interleaved,
     spmd_pipeline_stacked,
     spmd_pipeline_train_1f1b,
     split_microbatches,
@@ -299,6 +300,7 @@ def make_pipeline_train_step(
     loss: Callable = cross_entropy,
     schedule: str = "gpipe",
     data_axis: Optional[str] = None,
+    virtual_stages: int = 1,
 ):
     """Pipeline-parallel LM training step.
 
@@ -326,25 +328,44 @@ def make_pipeline_train_step(
     at min(M, 2S-1) slots per device regardless of M. Same loss and
     gradients (parity-tested); choose it when activations dominate memory.
 
+    `schedule="interleaved"`: the virtual-stage schedule — `stacked` must
+    carry a leading (virtual_stages * S) CHUNK axis (each device owns
+    `virtual_stages` non-adjacent layer chunks) and the bubble shrinks
+    from (S-1)/(M+S-1) to (S-1)/(VM+S-1)
+    (pipeline.spmd_pipeline_interleaved). Differentiated through like
+    gpipe; same loss/grads.
+
     step(stacked, aux, opt_states, tokens) ->
         (stacked, aux, opt_states, loss_value)
     """
-    if schedule not in ("gpipe", "1f1b"):
-        raise ValueError(f"schedule must be gpipe|1f1b, got {schedule!r}")
+    if schedule not in ("gpipe", "1f1b", "interleaved"):
+        raise ValueError(
+            f"schedule must be gpipe|1f1b|interleaved, got {schedule!r}")
     if data_axis is not None and schedule != "gpipe":
         raise ValueError(
             "data_axis composition is implemented for the gpipe schedule "
-            "only; 1f1b runs on a 1D stage mesh"
+            "only; 1f1b/interleaved run on a 1D stage mesh"
         )
+    if schedule == "interleaved" and virtual_stages < 2:
+        raise ValueError(
+            "schedule='interleaved' needs virtual_stages >= 2 (1 is exactly "
+            "gpipe; use that)")
 
     def gpipe_loss_and_grad(stacked, aux, tokens):
         def loss_fn(stacked, aux):
             x = embed_fn(aux, tokens[:, :-1])
-            h = spmd_pipeline_stacked(
-                block_fn, stacked, x,
-                mesh=mesh, num_microbatches=num_microbatches,
-                axis_name=axis_name, data_axis=data_axis,
-            )
+            if schedule == "interleaved":
+                h = spmd_pipeline_interleaved(
+                    block_fn, stacked, x,
+                    mesh=mesh, num_microbatches=num_microbatches,
+                    virtual_stages=virtual_stages, axis_name=axis_name,
+                )
+            else:
+                h = spmd_pipeline_stacked(
+                    block_fn, stacked, x,
+                    mesh=mesh, num_microbatches=num_microbatches,
+                    axis_name=axis_name, data_axis=data_axis,
+                )
             logits = head_fn(aux, h)
             return loss(logits, tokens[:, 1:])
 
@@ -361,7 +382,10 @@ def make_pipeline_train_step(
         )
         return lval, (g_st, g_aux)
 
-    loss_and_grad = gpipe_loss_and_grad if schedule == "gpipe" else f1b_loss_and_grad
+    # interleaved shares the gpipe path (autodiff through the scheduled
+    # forward); only 1f1b has its own fused loop
+    loss_and_grad = (f1b_loss_and_grad if schedule == "1f1b"
+                     else gpipe_loss_and_grad)
 
     @jax.jit
     def step(stacked, aux, opt_states, tokens):

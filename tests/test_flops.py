@@ -52,3 +52,23 @@ def test_peak_table_matching():
     assert flops.mfu(1e12, 100.0, FakeDev("TPU v5e")) == pytest.approx(
         100e12 / 197e12
     )
+
+
+def test_hbm_and_roofline_accounting():
+    from dnn_tpu.utils.flops import (
+        cifar_forward_bytes, cifar_forward_flops, device_peak_hbm_bw, mbu,
+        roofline_items_per_sec,
+    )
+
+    # per-image activation traffic dominates; weights amortize over batch
+    b1, b256 = cifar_forward_bytes(1), cifar_forward_bytes(256)
+    assert b256 < 256 * b1  # weights counted once per batch
+    per_img = (b256 - (b1 - cifar_forward_bytes(2) + b1)) / 255
+    assert 2e5 < per_img < 4e5  # ~0.27 MB/image in bf16
+    # arithmetic intensity sits far below any TPU ridge point
+    intensity = cifar_forward_flops(1) / per_img
+    assert 30 < intensity < 120
+    # CPU host: no peak tables -> None, callers omit the fields
+    assert device_peak_hbm_bw() is None
+    assert mbu(1e6, 1e6) is None
+    assert roofline_items_per_sec(1e6, 1e5) is None

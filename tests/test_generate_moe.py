@@ -1,0 +1,108 @@
+"""MoE KV-cache generation + serving tests.
+
+Round-2 gap being closed: gpt_moe could forward and train but not serve
+(no decode path anywhere in dnn_tpu/runtime/). Oracles are the family's
+own stateless forward (dense-routed, tests/test_gpt_moe.py pins that one
+against the EP forward) — the reference has no MoE at all (SURVEY.md §2).
+
+Routing caveat the tests encode: per-token top-k routing is batch-size
+independent only when nothing is dropped for capacity, so decode-parity
+tests use a generous capacity_factor (drops are batch-dependent in ANY
+capacity-based MoE; prefill routes the same token set as the full
+forward and needs no such allowance).
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dnn_tpu.models import gpt, gpt_moe
+from dnn_tpu.parallel.mesh import EXPERT_AXIS
+from dnn_tpu.runtime.generate import init_cache
+from dnn_tpu.runtime.generate_moe import (
+    forward_with_cache_moe,
+    make_generate_moe,
+    make_generate_moe_ep,
+    moe_cache_ffn,
+)
+from dnn_tpu.runtime.serving import ContinuousBatcher
+
+CFG = gpt_moe.PRESETS["gpt2-moe-test"]  # L=2, C=32, E=4, top_k=2, d_ff=64
+# generous capacity: no token ever dropped -> routing is batch-independent
+CFG_HI = dataclasses.replace(CFG, capacity_factor=8.0)
+
+
+def _prepared(cfg, seed=0):
+    params = gpt_moe.init(jax.random.PRNGKey(seed), cfg)
+    return params, gpt.prepare_stacked(params, cfg)
+
+
+def test_moe_prefill_logits_match_full_forward():
+    params, prepared = _prepared(CFG)
+    ids = jax.random.randint(jax.random.PRNGKey(1), (2, 12), 0, CFG.vocab_size)
+    cache = init_cache(CFG, 2, 16)
+    logits_cache, cache = forward_with_cache_moe(prepared, ids, cache, 0, cfg=CFG)
+    logits_full = gpt_moe.make_apply(CFG)(params, ids)
+    np.testing.assert_allclose(
+        np.asarray(logits_cache), np.asarray(logits_full), atol=2e-4)
+
+
+def test_moe_incremental_decode_matches_full_recompute():
+    params, prepared = _prepared(CFG_HI)
+    apply_fn = gpt_moe.make_apply(CFG_HI)
+    ids = jax.random.randint(jax.random.PRNGKey(2), (2, 8), 0, CFG_HI.vocab_size)
+    n_new = 6
+    gen = make_generate_moe(CFG_HI, max_new_tokens=n_new, temperature=0.0)
+    got = np.asarray(gen(prepared, ids, jax.random.PRNGKey(0)))
+
+    cur = np.asarray(ids)
+    want = []
+    for _ in range(n_new):
+        logits = apply_fn(params, jnp.asarray(cur))
+        nxt = np.asarray(jnp.argmax(logits[:, -1], -1)).astype(np.int32)
+        want.append(nxt)
+        cur = np.concatenate([cur, nxt[:, None]], axis=1)
+    np.testing.assert_array_equal(got, np.stack(want, axis=1))
+
+
+def test_moe_ep_decode_matches_dense_grouped(devices):
+    n = 2
+    mesh = jax.sharding.Mesh(np.array(devices[:n]), (EXPERT_AXIS,))
+    _, prepared = _prepared(CFG_HI, seed=3)
+    ids = jax.random.randint(jax.random.PRNGKey(4), (4, 8), 0, CFG_HI.vocab_size)
+    n_new = 5
+    dense = make_generate_moe(CFG_HI, max_new_tokens=n_new, groups=n)
+    ep = make_generate_moe_ep(CFG_HI, mesh, max_new_tokens=n_new)
+    want = np.asarray(dense(prepared, ids, jax.random.PRNGKey(0)))
+    got = np.asarray(ep(prepared, ids, jax.random.PRNGKey(0)))
+    np.testing.assert_array_equal(got, want)
+
+
+def test_moe_ep_rejects_bad_batch(devices):
+    mesh = jax.sharding.Mesh(np.array(devices[:2]), (EXPERT_AXIS,))
+    _, prepared = _prepared(CFG_HI)
+    gen = make_generate_moe_ep(CFG_HI, mesh, max_new_tokens=2)
+    with pytest.raises(ValueError):
+        gen(prepared, jnp.zeros((3, 8), jnp.int32), jax.random.PRNGKey(0))
+
+
+def test_moe_batcher_matches_solo_decode():
+    """A greedy MoE slot in the pool == a solo batch-1 MoE run."""
+    _, prepared = _prepared(CFG_HI, seed=5)
+    prompts = [np.array([5, 3, 7, 1, 2]), np.array([9, 8, 2])]
+    n_new = 6
+    srv = ContinuousBatcher(
+        CFG_HI, prepared, slots=2, max_len=32, prompt_pad=8,
+        ffn=moe_cache_ffn(CFG_HI))
+    rids = [srv.submit(p, max_new_tokens=n_new) for p in prompts]
+    results = srv.drain()
+
+    gen = make_generate_moe(CFG_HI, max_new_tokens=n_new, temperature=0.0)
+    for rid, p in zip(rids, prompts):
+        want = np.asarray(
+            gen(prepared, jnp.asarray(p, jnp.int32)[None, :],
+                jax.random.PRNGKey(0)))[0]
+        np.testing.assert_array_equal(results[rid], want)

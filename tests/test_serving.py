@@ -146,3 +146,20 @@ def test_budget_validation(setup):
         srv.submit(np.arange(1, 8), max_new_tokens=30)
     with pytest.raises(ValueError, match="not in"):
         srv.submit(np.arange(1, 12), max_new_tokens=4)  # > prompt_pad
+
+
+def test_one_prefill_one_decode_program(setup):
+    """The batcher's compile story: ONE prefill program and ONE decode
+    program total, across mixed prompt lengths and slots. true_len and
+    slot enter `_prefill` as traced scalars (dynamic jit args), so
+    distinct (length, slot) pairs must NOT trigger recompiles — this pins
+    the "two compiled programs total" claim in the module docstring."""
+    cfg, prepared = setup
+    srv = ContinuousBatcher(cfg, prepared, slots=4, max_len=64, prompt_pad=16)
+    for plen in (3, 5, 9, 12):  # different lengths, different slots
+        srv.submit(np.arange(1, plen + 1) % cfg.vocab_size, max_new_tokens=4)
+    srv.drain()
+    assert srv._prefill._cache_size() == 1, (
+        f"prefill compiled {srv._prefill._cache_size()}x — per-(len, slot) "
+        "retraces are back")
+    assert srv._decode._cache_size() == 1
