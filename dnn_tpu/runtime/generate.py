@@ -49,15 +49,6 @@ def _qkv_heads(bp, h, *, cfg: GPTConfig, compute_dtype):
     return tuple(split_heads(t, cfg.n_head) for t in (q, k, v))  # (B,H,T,D)
 
 
-def _attend_cache(q, k_cache, v_cache, pos_limit):
-    """q (B,H,T,D) against the full static cache (B,H,S,D), masking key
-    positions > their allowed limit. `pos_limit` is (T,) — for row t, keys
-    at positions <= pos_limit[t] attend. (Float-cache fast path; the codec
-    abstraction in dnn_tpu/runtime/kvcache.py generalizes this to int8.)"""
-    return FloatKV(k_cache.dtype).attend(
-        q, {"k": k_cache, "v": v_cache}, pos_limit)
-
-
 def _block_with_cache(bp, x, layer_cache, start_pos, *, cfg: GPTConfig,
                       compute_dtype, ffn=None, codec=None):
     """One transformer block over x (B, T, C) whose tokens sit at positions

@@ -41,6 +41,13 @@ _NEG_BIG = -1e30
 __all__ = ["FloatKV", "Int8KV", "codec_for_cache"]
 
 
+def _rows_update(cache, new, pos):
+    """cache (B,H,S,...) <- new (B,H,1,...) at per-row positions pos (B,)."""
+    return jax.vmap(
+        lambda c, n, p: lax.dynamic_update_slice_in_dim(c, n, p, axis=1)
+    )(cache, new, pos)
+
+
 class FloatKV:
     """The plain cache: K/V stored in `dtype` (f32 default, bf16 for
     halved bandwidth)."""
@@ -71,6 +78,27 @@ class FloatKV:
         cols = jnp.arange(c["k"].shape[2])
         s = jnp.where(cols[None, None, None, :] <= pos_limit[None, None, :, None],
                       s, _NEG_BIG)
+        p = jax.nn.softmax(s, axis=-1)
+        return jnp.einsum("bhts,bhsd->bhtd", p.astype(c["v"].dtype), c["v"])
+
+    # --- per-row variants (continuous batching: each slot at its own
+    # position; `write_gate` (B,) bool keeps inactive slots untouched) ---
+
+    def write_rows(self, c, k, v, pos, write_gate):
+        k_new = _rows_update(c["k"], k.astype(c["k"].dtype), pos)
+        v_new = _rows_update(c["v"], v.astype(c["v"].dtype), pos)
+        w = write_gate[:, None, None, None]
+        return {"k": jnp.where(w, k_new, c["k"]),
+                "v": jnp.where(w, v_new, c["v"])}
+
+    def attend_rows(self, q, c, pos):
+        """q (B,H,1,D); each row masked to keys at positions <= its own
+        pos (B,)."""
+        d = q.shape[-1]
+        s = jnp.einsum("bhtd,bhsd->bhts", q, c["k"]).astype(jnp.float32) / jnp.sqrt(d)
+        cols = jnp.arange(c["k"].shape[2])
+        mask = cols[None, None, None, :] <= pos[:, None, None, None]
+        s = jnp.where(mask, s, _NEG_BIG)
         p = jax.nn.softmax(s, axis=-1)
         return jnp.einsum("bhts,bhsd->bhtd", p.astype(c["v"].dtype), c["v"])
 
@@ -122,6 +150,37 @@ class Int8KV:
         p = jax.nn.softmax(s, axis=-1)
         # fold the V scale into the (small) probability matrix, then
         # contract against the raw int8 values
+        p = p * c["vs"][:, :, None, :]
+        return jnp.einsum("bhts,bhsd->bhtd", p, c["v"].astype(jnp.float32),
+                          preferred_element_type=jnp.float32)
+
+    # --- per-row variants (continuous batching) ---
+
+    def write_rows(self, c, k, v, pos, write_gate):
+        kq, ks = _quantize_rows(k)   # (B,H,1,D), (B,H,1)
+        vq, vs = _quantize_rows(v)
+        new = {
+            "k": _rows_update(c["k"], kq, pos),
+            "v": _rows_update(c["v"], vq, pos),
+            "ks": _rows_update(c["ks"], ks, pos),
+            "vs": _rows_update(c["vs"], vs, pos),
+        }
+        gates = {"k": write_gate[:, None, None, None],
+                 "v": write_gate[:, None, None, None],
+                 "ks": write_gate[:, None, None],
+                 "vs": write_gate[:, None, None]}
+        return {kk: jnp.where(gates[kk], new[kk], c[kk]) for kk in c}
+
+    def attend_rows(self, q, c, pos):
+        d = q.shape[-1]
+        s = jnp.einsum("bhtd,bhsd->bhts", q.astype(jnp.float32),
+                       c["k"].astype(jnp.float32),
+                       preferred_element_type=jnp.float32)
+        s = s * c["ks"][:, :, None, :] / jnp.sqrt(d)
+        cols = jnp.arange(c["k"].shape[2])
+        mask = cols[None, None, None, :] <= pos[:, None, None, None]
+        s = jnp.where(mask, s, _NEG_BIG)
+        p = jax.nn.softmax(s, axis=-1)
         p = p * c["vs"][:, :, None, :]
         return jnp.einsum("bhts,bhsd->bhtd", p, c["v"].astype(jnp.float32),
                           preferred_element_type=jnp.float32)

@@ -51,11 +51,16 @@ __all__ = ["LMServer", "serve_lm", "start_lm_server_in_background",
 
 
 def parse_gen_options(request_id: str, default_max_new: int):
-    """'gen[:max_new[:seed]]' -> (max_new, seed). Unparseable segments fall
-    back to defaults (seed None = derive from the request id, the batcher's
-    own convention)."""
+    """'gen[:max_new[:seed]]' -> (max_new, seed). Only the literal 'gen'
+    prefix carries options — any other request_id (e.g. a reference
+    client's tracing id like 'req:1234') gets the server defaults instead
+    of being reinterpreted as a token budget. Unparseable segments fall
+    back to defaults (seed None = derive from the request id, the
+    batcher's own convention)."""
     max_new, seed = default_max_new, None
     parts = (request_id or "").split(":")
+    if parts[0] != "gen":
+        return max_new, seed
     if len(parts) >= 2:
         try:
             max_new = max(1, int(parts[1]))
@@ -78,6 +83,7 @@ class _BatcherWorker(threading.Thread):
         self.batcher = batcher
         self.q: "queue.Queue" = queue.Queue()
         self._stop_evt = threading.Event()
+        self._abandon = False
         self._futures = {}
 
     def submit(self, prompt: np.ndarray, max_new: int, seed):
@@ -88,9 +94,13 @@ class _BatcherWorker(threading.Thread):
         return fut
 
     def stop(self, *, drain: bool = True):
-        """Signal shutdown; the loop exits once the pool and queue are empty
-        (or immediately if drain=False — pending futures get cancelled)."""
+        """Signal shutdown. drain=True: the loop exits once the pool and
+        queue are empty. drain=False: abandon in-flight decodes too —
+        queued futures are cancelled here, admitted ones by the loop on
+        its next iteration (the worker must not keep stepping the device
+        after close())."""
         if not drain:
+            self._abandon = True
             while True:
                 try:
                     *_rest, fut = self.q.get_nowait()
@@ -114,9 +124,26 @@ class _BatcherWorker(threading.Thread):
         for rid in [r for r in self._futures if r in b.results]:
             self._futures.pop(rid).set_result(b.results.pop(rid))
 
+    def _fail_all(self, exc):
+        for fut in self._futures.values():
+            if not fut.done():
+                fut.set_exception(exc)
+        self._futures.clear()
+        while True:
+            try:
+                *_rest, fut = self.q.get_nowait()
+            except queue.Empty:
+                return
+            fut.set_exception(exc)
+
     def run(self):
         b = self.batcher
         while True:
+            if self._abandon:
+                for fut in self._futures.values():
+                    fut.cancel()
+                self._futures.clear()
+                return
             if b.n_active == 0 and self.q.empty():
                 if self._stop_evt.is_set():
                     return
@@ -129,8 +156,17 @@ class _BatcherWorker(threading.Thread):
                     self._admit(*self.q.get_nowait())
                 except queue.Empty:
                     break
-            if b.n_active:
-                b.step()
+            try:
+                if b.n_active:
+                    b.step()
+            except Exception as e:  # noqa: BLE001 — one device-side error
+                # must not leave callers hanging for request_timeout: fail
+                # every pending future fast and die visibly (HealthCheck
+                # reports not-alive; SendTensor aborts UNAVAILABLE)
+                log.exception("batcher worker died; failing %d pending "
+                              "requests", len(self._futures))
+                self._fail_all(RuntimeError(f"LM batcher worker died: {e}"))
+                return
             self._publish_done()  # submit alone can retire (budget == 1)
 
 
@@ -161,15 +197,22 @@ class LMServer:
             await context.abort(
                 grpc.StatusCode.INVALID_ARGUMENT,
                 f"prompt must be integer token ids, got dtype {prompt.dtype}")
+        if not self.worker.is_alive():
+            await context.abort(
+                grpc.StatusCode.UNAVAILABLE,
+                "LM batcher worker is not running (died or shut down)")
         max_new, seed = parse_gen_options(request.request_id, self.default_max_new)
         fut = self.worker.submit(
             np.asarray(prompt, np.int32).reshape(-1), max_new, seed)
         try:
             tokens = await asyncio.wait_for(
                 asyncio.wrap_future(fut), timeout=self.request_timeout)
-        except (ValueError, RuntimeError) as e:
+        except ValueError as e:
             # submit-side validation (overlong prompt, budget) — caller error
             await context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
+        except (RuntimeError, asyncio.CancelledError) as e:
+            # worker died mid-request or server shut down — server fault
+            await context.abort(grpc.StatusCode.UNAVAILABLE, str(e))
         except asyncio.TimeoutError:
             await context.abort(
                 grpc.StatusCode.DEADLINE_EXCEEDED,

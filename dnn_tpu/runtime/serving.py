@@ -50,47 +50,25 @@ from dnn_tpu.models.gpt import GPTConfig, head
 from dnn_tpu.ops.attention import merge_heads
 from dnn_tpu.ops.nn import gelu, layer_norm, linear
 from dnn_tpu.runtime.generate import (
-    _NEG_BIG,
     _qkv_heads,
     _sample,
     forward_with_cache,
     init_cache,
 )
+from dnn_tpu.runtime.kvcache import codec_for_cache
 
 
-def _write_kv_rows(cache, new, pos):
-    """cache (B,H,S,D) <- new (B,H,1,D) at per-row positions pos (B,)."""
-    return jax.vmap(
-        lambda c, n, p: lax.dynamic_update_slice_in_dim(c, n, p, axis=1)
-    )(cache, new, pos)
-
-
-def _attend_rows(q, k_cache, v_cache, pos):
-    """q (B,H,1,D) against (B,H,S,D), each row masked to keys at positions
-    <= its own pos (B,) — the per-slot analog of generate._attend_cache."""
-    d = q.shape[-1]
-    s = jnp.einsum("bhtd,bhsd->bhts", q, k_cache).astype(jnp.float32) / jnp.sqrt(d)
-    cols = jnp.arange(k_cache.shape[2])
-    mask = cols[None, None, None, :] <= pos[:, None, None, None]
-    s = jnp.where(mask, s, _NEG_BIG)
-    p = jax.nn.softmax(s, axis=-1)
-    return jnp.einsum("bhts,bhsd->bhtd", p.astype(v_cache.dtype), v_cache)
-
-
-def _decode_block_rows(bp, x, k_cache, v_cache, pos, write, *, cfg, compute_dtype,
-                       ffn=None):
+def _decode_block_rows(bp, x, layer_cache, pos, write, *, cfg, compute_dtype,
+                       codec, ffn=None):
     """One block over x (B,1,C) with per-row positions. `write` (B,) bool
     gates the cache update (inactive slots must not touch their rows).
-    `ffn(bp, h)` overrides the dense MLP (MoE serving,
-    dnn_tpu/runtime/generate_moe.moe_cache_ffn)."""
+    The cache codec (float or int8 — dnn_tpu/runtime/kvcache.py) owns the
+    per-row write/attend; `ffn(bp, h)` overrides the dense MLP (MoE
+    serving, dnn_tpu/runtime/generate_moe.moe_cache_ffn)."""
     h = layer_norm(bp["ln_1"], x, eps=cfg.ln_eps)
     q, k, v = _qkv_heads(bp, h, cfg=cfg, compute_dtype=compute_dtype)
-    k_new = _write_kv_rows(k_cache, k.astype(k_cache.dtype), pos)
-    v_new = _write_kv_rows(v_cache, v.astype(v_cache.dtype), pos)
-    w = write[:, None, None, None]
-    k_cache = jnp.where(w, k_new, k_cache)
-    v_cache = jnp.where(w, v_new, v_cache)
-    y = _attend_rows(q, k_cache, v_cache, pos)
+    layer_cache = codec.write_rows(layer_cache, k, v, pos, write)
+    y = codec.attend_rows(q, layer_cache, pos)
     x = x + linear(bp["attn"]["proj"], merge_heads(y.astype(x.dtype)),
                    compute_dtype=compute_dtype)
     h = layer_norm(bp["ln_2"], x, eps=cfg.ln_eps)
@@ -99,7 +77,7 @@ def _decode_block_rows(bp, x, k_cache, v_cache, pos, write, *, cfg, compute_dtyp
                    compute_dtype=compute_dtype)
     else:
         m = ffn(bp, h).astype(x.dtype)
-    return x + m, k_cache, v_cache
+    return x + m, layer_cache
 
 
 class ContinuousBatcher:
@@ -118,7 +96,7 @@ class ContinuousBatcher:
                  max_len: Optional[int] = None, prompt_pad: Optional[int] = None,
                  temperature: float = 0.0, top_k: Optional[int] = None,
                  compute_dtype=None, eos_id: Optional[int] = None, seed: int = 0,
-                 ffn=None):
+                 ffn=None, kv_dtype=None):
         self.cfg = cfg
         self.prepared = prepared
         self.slots = slots
@@ -126,10 +104,13 @@ class ContinuousBatcher:
         self.prompt_pad = prompt_pad or min(64, self.max_len)
         self.eos_id = eos_id
         self._seed = seed
-        cache_dtype = compute_dtype or jnp.float32
+        # kv_dtype picks the cache storage codec (None follows
+        # compute_dtype; "int8" = quantized cache, kvcache.Int8KV)
+        cache_dtype = kv_dtype if kv_dtype is not None else (compute_dtype or jnp.float32)
 
         # device state (functional updates)
         self.cache = init_cache(cfg, slots, self.max_len, cache_dtype)
+        codec = codec_for_cache(self.cache)
         self.pos = jnp.zeros((slots,), jnp.int32)      # next write position
         self.tok = jnp.zeros((slots,), jnp.int32)      # last sampled token
         self.active = jnp.zeros((slots,), bool)
@@ -151,16 +132,14 @@ class ContinuousBatcher:
                 x = x.astype(compute_dtype)
 
             def layer(carry, layer_in):
-                bp, k_c, v_c = layer_in
-                y, k_c, v_c = _decode_block_rows(
-                    bp, carry, k_c, v_c, pos, active, cfg=cfg,
-                    compute_dtype=compute_dtype, ffn=ffn,
+                bp, layer_cache = layer_in
+                y, layer_cache = _decode_block_rows(
+                    bp, carry, layer_cache, pos, active, cfg=cfg,
+                    compute_dtype=compute_dtype, codec=codec, ffn=ffn,
                 )
-                return y, (k_c, v_c)
+                return y, layer_cache
 
-            x, (k_new, v_new) = lax.scan(
-                layer, x, (prepared["blocks"], cache["k"], cache["v"])
-            )
+            x, new_cache = lax.scan(layer, x, (prepared["blocks"], cache))
             logits = head(prepared, x.astype(jnp.float32), cfg=cfg,
                           compute_dtype=compute_dtype)
             # advance each slot's own stream; sample each row with its key
@@ -172,7 +151,7 @@ class ContinuousBatcher:
             )(logits[:, -1], subs)
             nxt = jnp.where(active, nxt, tok)
             new_keys = jnp.where(active[:, None], new_keys, keys)
-            return ({"k": k_new, "v": v_new}, pos + active.astype(jnp.int32),
+            return (new_cache, pos + active.astype(jnp.int32),
                     nxt, new_keys)
 
         def prefill(prepared, cache, padded, true_len, slot, rng):
@@ -188,9 +167,11 @@ class ContinuousBatcher:
                 logits[:, true_len - 1][0:1], rng,
                 temperature=temperature, top_k=top_k,
             )[0]
+            # every cache leaf (K/V and, for int8, their scale arrays)
+            # carries batch on axis 1 after the layer axis
             cache = {
                 kk: lax.dynamic_update_slice_in_dim(cache[kk], row[kk], slot, axis=1)
-                for kk in ("k", "v")
+                for kk in cache
             }
             return cache, first
 

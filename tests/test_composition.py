@@ -1,0 +1,88 @@
+"""Runtime-feature composition tests — the support matrix in README
+("Runtime feature composition") is backed row-by-row by this file.
+
+The interesting compositions:
+  * speculative x quantized DRAFT: the rejection-sampling construction
+    makes greedy output depend ONLY on the target — ANY draft (including
+    an int8-quantized one, the natural choice: the draft is pure
+    overhead) must leave greedy output identical to target-only decode;
+  * speculative x quantized TARGET: spec decode on a quantized target
+    equals plain decode on the same quantized target;
+  * batcher x int8 weights x int8 KV cache: the pool's per-row cache
+    codec quantizes each row exactly like the solo decoder's, so a
+    greedy slot still reproduces the solo run token-for-token.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dnn_tpu.models import gpt
+from dnn_tpu.quant import quantize_gpt
+from dnn_tpu.runtime.generate import make_generate
+from dnn_tpu.runtime.serving import ContinuousBatcher
+from dnn_tpu.runtime.speculative import make_speculative_generate
+
+CFG = gpt.PRESETS["gpt2-test"]
+D_CFG = gpt.GPTConfig(block_size=64, vocab_size=256, n_layer=1, n_head=2,
+                      n_embd=32)
+
+
+def _pair(seed=0):
+    tp = gpt.prepare_stacked(gpt.init(jax.random.PRNGKey(seed), CFG), CFG)
+    dp = gpt.prepare_stacked(gpt.init(jax.random.PRNGKey(seed + 1), D_CFG), D_CFG)
+    return tp, dp
+
+
+def test_speculative_with_int8_draft_keeps_target_greedy():
+    tp, dp = _pair()
+    dq = quantize_gpt(dp)  # quantized draft: cheaper proposals, same output
+    ids = jax.random.randint(jax.random.PRNGKey(2), (1, 8), 0, CFG.vocab_size)
+    n = 12
+    spec = make_speculative_generate(CFG, D_CFG, max_new_tokens=n, k=4)
+    got = np.asarray(spec(tp, dq, ids, jax.random.PRNGKey(0)))
+    want = np.asarray(make_generate(CFG, max_new_tokens=n)(
+        tp, ids, jax.random.PRNGKey(0)))
+    np.testing.assert_array_equal(got, want)
+
+
+def test_speculative_with_int8_target_matches_plain_int8_decode():
+    tp, dp = _pair(seed=3)
+    tq = quantize_gpt(tp)
+    ids = jax.random.randint(jax.random.PRNGKey(4), (1, 8), 0, CFG.vocab_size)
+    n = 10
+    spec = make_speculative_generate(CFG, D_CFG, max_new_tokens=n, k=3)
+    got = np.asarray(spec(tq, dp, ids, jax.random.PRNGKey(0)))
+    want = np.asarray(make_generate(CFG, max_new_tokens=n)(
+        tq, ids, jax.random.PRNGKey(0)))
+    np.testing.assert_array_equal(got, want)
+
+
+def test_batcher_int8_weights_and_cache_matches_solo():
+    tp, _ = _pair(seed=5)
+    tq = quantize_gpt(tp)
+    prompts = [np.array([5, 3, 7, 1]), np.array([9, 8, 2])]
+    n = 6
+    srv = ContinuousBatcher(CFG, tq, slots=2, max_len=32, prompt_pad=8,
+                            kv_dtype="int8")
+    rids = [srv.submit(p, max_new_tokens=n) for p in prompts]
+    results = srv.drain()
+
+    solo = make_generate(CFG, max_new_tokens=n, kv_dtype="int8")
+    for rid, p in zip(rids, prompts):
+        want = np.asarray(solo(tq, jnp.asarray(p, jnp.int32)[None, :],
+                               jax.random.PRNGKey(0)))[0]
+        np.testing.assert_array_equal(results[rid], want)
+
+
+def test_batcher_bf16_cache_matches_solo():
+    tp, _ = _pair(seed=7)
+    prompt = np.array([4, 5, 6, 7, 8])
+    n = 6
+    srv = ContinuousBatcher(CFG, tp, slots=2, max_len=32, prompt_pad=8,
+                            kv_dtype=jnp.bfloat16)
+    rid = srv.submit(prompt, max_new_tokens=n)
+    got = srv.drain()[rid]
+    want = np.asarray(make_generate(CFG, max_new_tokens=n, kv_dtype=jnp.bfloat16)(
+        tp, jnp.asarray(prompt, jnp.int32)[None, :], jax.random.PRNGKey(0)))[0]
+    np.testing.assert_array_equal(got, want)

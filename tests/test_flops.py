@@ -61,9 +61,10 @@ def test_hbm_and_roofline_accounting():
     )
 
     # per-image activation traffic dominates; weights amortize over batch
-    b1, b256 = cifar_forward_bytes(1), cifar_forward_bytes(256)
+    b1, b2, b256 = (cifar_forward_bytes(n) for n in (1, 2, 256))
     assert b256 < 256 * b1  # weights counted once per batch
-    per_img = (b256 - (b1 - cifar_forward_bytes(2) + b1)) / 255
+    weights = 2 * b1 - b2   # bytes(n) = n*act + weights
+    per_img = (b256 - weights) / 256
     assert 2e5 < per_img < 4e5  # ~0.27 MB/image in bf16
     # arithmetic intensity sits far below any TPU ridge point
     intensity = cifar_forward_flops(1) / per_img

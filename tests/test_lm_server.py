@@ -41,6 +41,10 @@ def test_parse_gen_options():
     assert parse_gen_options("", 32) == (32, None)
     assert parse_gen_options("whatever:junk:x", 32) == (32, None)
     assert parse_gen_options("gen:0", 32) == (1, None)  # floored at 1
+    # only the literal 'gen' prefix carries options: a foreign client's
+    # tracing id must NOT be reinterpreted as a token budget
+    assert parse_gen_options("req:1234", 32) == (32, None)
+    assert parse_gen_options("cifar_pipe_2node_001", 32) == (32, None)
 
 
 def test_health_and_pool_stats(lm_server):
