@@ -309,6 +309,54 @@ def gpt_params_from_state_dict(sd: Dict[str, np.ndarray], n_layer: Optional[int]
     return params
 
 
+def llama_params_from_state_dict(sd: Dict[str, np.ndarray],
+                                 n_layer: Optional[int] = None):
+    """Convert an HF LlamaForCausalLM state dict (model.embed_tokens /
+    model.layers.N.self_attn.{q,k,v,o}_proj / mlp.{gate,up,down}_proj /
+    input_layernorm / post_attention_layernorm / model.norm / lm_head) to
+    this framework's LLaMA param pytree (dnn_tpu/models/llama.py). Every
+    projection is a plain torch Linear, so each kernel takes the usual
+    (out, in) -> (in, out) transpose; RMSNorm weights map to 'scale'."""
+    # HF prefixes everything but lm_head with "model."
+    sd = {(k[len("model."):] if k.startswith("model.") else k): v
+          for k, v in sd.items()}
+    if n_layer is None:
+        n_layer = 1 + max(
+            int(k.split(".")[1]) for k in sd
+            if k.startswith("layers.") and k.split(".")[1].isdigit()
+        )
+
+    params = {
+        "wte": {"embedding": sd["embed_tokens.weight"]},
+        "ln_f": {"scale": sd["norm.weight"]},
+    }
+    for i in range(n_layer):
+        p = f"layers.{i}."
+        params[f"h_{i}"] = {
+            "ln_1": {"scale": sd[p + "input_layernorm.weight"]},
+            "attn": {
+                "q": {"kernel": _t_linear(sd[p + "self_attn.q_proj.weight"])},
+                "k": {"kernel": _t_linear(sd[p + "self_attn.k_proj.weight"])},
+                "v": {"kernel": _t_linear(sd[p + "self_attn.v_proj.weight"])},
+                "o": {"kernel": _t_linear(sd[p + "self_attn.o_proj.weight"])},
+            },
+            "ln_2": {"scale": sd[p + "post_attention_layernorm.weight"]},
+            "mlp": {
+                "gate": {"kernel": _t_linear(sd[p + "mlp.gate_proj.weight"])},
+                "up": {"kernel": _t_linear(sd[p + "mlp.up_proj.weight"])},
+                "down": {"kernel": _t_linear(sd[p + "mlp.down_proj.weight"])},
+            },
+        }
+    # lm_head: explicit if present, else tied to the embedding (LLaMA-3.2
+    # and TinyLlama tie; 7B-class models don't)
+    if "lm_head.weight" in sd:
+        params["lm_head"] = {"kernel": _t_linear(sd["lm_head.weight"])}
+    else:
+        params["lm_head"] = {
+            "kernel": np.ascontiguousarray(sd["embed_tokens.weight"].T)}
+    return params
+
+
 # ----------------------------------------------------------------------
 # native (framework-own) flat format
 # ----------------------------------------------------------------------

@@ -1,0 +1,422 @@
+"""LLaMA-family decoder-only LM: RMSNorm, RoPE, SwiGLU, grouped-query
+attention (GQA).
+
+No counterpart exists in the reference (its only LM is the GPT-2 wrapper
+family, /root/reference/partitions/gpt_model_parts.py); this module widens
+the model zoo to the architecture most open-weight LMs ship today
+(LLaMA 1/2/3, Mistral, Qwen2, TinyLlama — all this block, different
+shapes). TPU-first choices:
+
+  * separate q/k/v projections sized H*D and KV*D (GQA's point is the
+    smaller KV projections and cache; a fused qkv matmul would erase the
+    asymmetry) — all bias-free single matmuls on the MXU;
+  * GQA attends GROUPED: q reshapes to (B, KV, G*T, D) so the score and
+    value einsums run at KV heads with the group folded into the row dim
+    — no repeat/materialization of K/V to H heads, on the forward AND on
+    the cached decode path (the KV cache stores KV heads, which is the
+    architecture's bandwidth win at decode time);
+  * RoPE tables are computed per call from absolute positions (decode
+    positions offset by the cache pointer) in f32, HF half-split
+    convention (ops/attention.rope_cos_sin/apply_rope) so converted HF
+    weights reproduce logits exactly;
+  * pipeline partitioning, stacking, and the KV-cache decode reuse the
+    same machinery as the GPT family (gpt.layer_ranges / prepare_stacked
+    signatures, kvcache codecs), so every parallel runtime — stacked
+    pipeline, dp x tp via generic specs, interleaved schedule — and the
+    int8 weight/cache paths apply unchanged.
+
+Param pytree (HF LlamaForCausalLM names map 1:1 — see
+io/checkpoint.llama_params_from_state_dict):
+
+  {"wte": {"embedding" (V, C)},
+   "h_i": {"ln_1": {"scale"}, "attn": {"q","k","v","o": {"kernel"}},
+           "ln_2": {"scale"}, "mlp": {"gate","up","down": {"kernel"}}},
+   "ln_f": {"scale"}, "lm_head": {"kernel" (C, V)}}
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from dnn_tpu.models import gpt
+from dnn_tpu.ops.attention import apply_rope, merge_heads, rope_cos_sin, split_heads
+from dnn_tpu.ops.nn import embedding, linear, rms_norm, silu
+from dnn_tpu.registry import ModelSpec, StageSpec, register_model
+
+_NEG_BIG = -1e30
+
+
+@dataclasses.dataclass(frozen=True)
+class LlamaConfig:
+    block_size: int = 2048
+    vocab_size: int = 32000
+    n_layer: int = 22
+    n_head: int = 32
+    n_kv_head: int = 4
+    n_embd: int = 2048
+    d_ff: int = 5632
+    rope_theta: float = 10000.0
+    rms_eps: float = 1e-5
+
+    @property
+    def head_dim(self):
+        return self.n_embd // self.n_head
+
+
+PRESETS = {
+    # TinyLlama-1.1B shape — the smallest real open-weight GQA model
+    "tinyllama-1.1b": LlamaConfig(),
+    # LLaMA-2-7B shape (MHA: kv == q heads)
+    "llama2-7b": LlamaConfig(block_size=4096, n_layer=32, n_head=32,
+                             n_kv_head=32, n_embd=4096, d_ff=11008),
+    # LLaMA-3-8B shape (GQA 4:1, big vocab, long rope)
+    "llama3-8b": LlamaConfig(block_size=8192, vocab_size=128256, n_layer=32,
+                             n_head=32, n_kv_head=8, n_embd=4096, d_ff=14336,
+                             rope_theta=500000.0),
+    # tiny config for tests / CPU-mesh CI (GQA 2:1, 4 layers)
+    "llama-test": LlamaConfig(block_size=64, vocab_size=256, n_layer=4,
+                              n_head=4, n_kv_head=2, n_embd=64, d_ff=128),
+}
+
+
+# --------------------------------------------------------------------------
+# init
+# --------------------------------------------------------------------------
+
+def _kernel(key, shape, dtype, std=0.02):
+    return {"kernel": (jax.random.normal(key, shape) * std).astype(dtype)}
+
+
+def init_block(key, cfg: LlamaConfig, dtype=jnp.float32):
+    c, d = cfg.n_embd, cfg.head_dim
+    ks = jax.random.split(key, 7)
+    return {
+        "ln_1": {"scale": jnp.ones((c,), dtype)},
+        "attn": {
+            "q": _kernel(ks[0], (c, cfg.n_head * d), dtype),
+            "k": _kernel(ks[1], (c, cfg.n_kv_head * d), dtype),
+            "v": _kernel(ks[2], (c, cfg.n_kv_head * d), dtype),
+            "o": _kernel(ks[3], (cfg.n_head * d, c), dtype,
+                         std=0.02 / (2 * cfg.n_layer) ** 0.5),
+        },
+        "ln_2": {"scale": jnp.ones((c,), dtype)},
+        "mlp": {
+            "gate": _kernel(ks[4], (c, cfg.d_ff), dtype),
+            "up": _kernel(ks[5], (c, cfg.d_ff), dtype),
+            "down": _kernel(ks[6], (cfg.d_ff, c), dtype,
+                            std=0.02 / (2 * cfg.n_layer) ** 0.5),
+        },
+    }
+
+
+def init(rng, cfg: LlamaConfig = PRESETS["llama-test"], dtype=jnp.float32):
+    keys = jax.random.split(rng, cfg.n_layer + 3)
+    c = cfg.n_embd
+    params = {
+        "wte": {"embedding": (jax.random.normal(keys[0], (cfg.vocab_size, c))
+                              * 0.02).astype(dtype)},
+        "ln_f": {"scale": jnp.ones((c,), dtype)},
+        "lm_head": _kernel(keys[1], (c, cfg.vocab_size), dtype),
+    }
+    for i in range(cfg.n_layer):
+        params[f"h_{i}"] = init_block(keys[2 + i], cfg, dtype)
+    return params
+
+
+# --------------------------------------------------------------------------
+# forward
+# --------------------------------------------------------------------------
+
+def _qkv_rope(bp, h, positions, *, cfg: LlamaConfig, compute_dtype):
+    """Project h (B, T, C) and rotate q/k at absolute `positions` (T,).
+    Returns q (B, H, T, D), k/v (B, KV, T, D) — KV heads stay narrow."""
+    q = split_heads(linear(bp["attn"]["q"], h, compute_dtype=compute_dtype),
+                    cfg.n_head)
+    k = split_heads(linear(bp["attn"]["k"], h, compute_dtype=compute_dtype),
+                    cfg.n_kv_head)
+    v = split_heads(linear(bp["attn"]["v"], h, compute_dtype=compute_dtype),
+                    cfg.n_kv_head)
+    cos, sin = rope_cos_sin(positions, cfg.head_dim, theta=cfg.rope_theta)
+    return apply_rope(q, cos, sin), apply_rope(k, cos, sin), v
+
+
+def _gqa_scores_attend(q, k, v, mask_fn):
+    """Grouped attention: q (B, H, T, D) vs k/v (B, KV, S, D) with
+    H = G * KV. Folds the group into the row dim so einsums run at KV
+    heads; `mask_fn(scores (B, KV, G, T, S)) -> masked scores`."""
+    b, h, t, d = q.shape
+    kv = k.shape[1]
+    g = h // kv
+    qg = q.reshape(b, kv, g, t, d)
+    s = jnp.einsum("bkgtd,bksd->bkgts", qg.astype(jnp.float32),
+                   k.astype(jnp.float32),
+                   preferred_element_type=jnp.float32) / jnp.sqrt(d)
+    p = jax.nn.softmax(mask_fn(s), axis=-1)
+    y = jnp.einsum("bkgts,bksd->bkgtd", p, v.astype(jnp.float32))
+    return y.reshape(b, h, t, d)
+
+
+def block_apply(bp, x, *, cfg: LlamaConfig, compute_dtype=None):
+    """Pre-RMSNorm block: GQA causal attention + SwiGLU MLP, both residual."""
+    b, t, c = x.shape
+    h = rms_norm(bp["ln_1"], x, eps=cfg.rms_eps)
+    q, k, v = _qkv_rope(bp, h, jnp.arange(t), cfg=cfg,
+                        compute_dtype=compute_dtype)
+
+    rows = jnp.arange(t)
+
+    def causal(s):
+        return jnp.where(rows[None, None, None, :, None] >=
+                         rows[None, None, None, None, :], s, _NEG_BIG)
+
+    y = _gqa_scores_attend(q, k, v, causal)
+    x = x + linear(bp["attn"]["o"], merge_heads(y.astype(x.dtype)),
+                   compute_dtype=compute_dtype)
+    h = rms_norm(bp["ln_2"], x, eps=cfg.rms_eps)
+    m = linear(bp["mlp"]["down"],
+               silu(linear(bp["mlp"]["gate"], h, compute_dtype=compute_dtype))
+               * linear(bp["mlp"]["up"], h, compute_dtype=compute_dtype),
+               compute_dtype=compute_dtype)
+    return x + m.astype(x.dtype)
+
+
+def embed(params, idx, *, cfg: LlamaConfig):
+    t = idx.shape[-1]
+    if t > cfg.block_size:
+        raise ValueError(
+            f"Cannot forward: sequence length {t} > block_size {cfg.block_size}")
+    return embedding(params["wte"], idx)  # positions live in RoPE, not here
+
+
+def head(params, x, *, cfg: LlamaConfig, compute_dtype=None, logits_dtype=None):
+    x = rms_norm(params["ln_f"], x, eps=cfg.rms_eps)
+    if compute_dtype is None:
+        out = linear(params["lm_head"], x)
+    else:
+        out = linear(params["lm_head"], x, compute_dtype=compute_dtype,
+                     accum_dtype=jnp.float32)
+    return out if logits_dtype is None else out.astype(logits_dtype)
+
+
+def _blocks_scan(stacked, x, *, cfg, compute_dtype, remat=False):
+    block = (lambda bp, carry: block_apply(bp, carry, cfg=cfg,
+                                           compute_dtype=compute_dtype))
+    if remat:
+        block = jax.checkpoint(block)
+
+    def body(carry, bp):
+        return block(bp, carry), None
+
+    out, _ = jax.lax.scan(body, x, stacked)
+    return out
+
+
+def make_apply(cfg: LlamaConfig, *, compute_dtype=None, remat=False):
+    def apply(params, idx):
+        x = embed(params, idx, cfg=cfg)
+        if compute_dtype is not None:
+            x = x.astype(compute_dtype)
+        stacked = gpt.stack_blocks(params, range(cfg.n_layer))
+        x = _blocks_scan(stacked, x, cfg=cfg, compute_dtype=compute_dtype,
+                         remat=remat)
+        return head(params, x.astype(jnp.float32), cfg=cfg,
+                    compute_dtype=compute_dtype)
+
+    return apply
+
+
+def make_apply_stacked(cfg: LlamaConfig, *, compute_dtype=None,
+                       logits_dtype=None, remat=False):
+    """Forward over the prepare_stacked layout (gpt.prepare_stacked works
+    unchanged — it only needs h_i keys and cfg.n_layer)."""
+
+    def apply(prepared, idx):
+        x = embed(prepared, idx, cfg=cfg)
+        if compute_dtype is not None:
+            x = x.astype(compute_dtype)
+        x = _blocks_scan(prepared["blocks"], x, cfg=cfg,
+                         compute_dtype=compute_dtype, remat=remat)
+        return head(prepared, x.astype(jnp.float32), cfg=cfg,
+                    compute_dtype=compute_dtype, logits_dtype=logits_dtype)
+
+    return apply
+
+
+# --------------------------------------------------------------------------
+# KV-cache decode (kvcache codecs; cache holds KV heads, not H)
+# --------------------------------------------------------------------------
+
+def _block_with_cache(bp, x, layer_cache, start_pos, *, cfg: LlamaConfig,
+                      compute_dtype, codec):
+    """Block over x (B, T, C) at absolute positions [start_pos,
+    start_pos+T), writing ROTATED k (and v) into the narrow KV-head cache.
+    GQA against the cache rides the same codec.attend as the GPT family by
+    folding the q group into the row dim and tiling pos_limit."""
+    b, t, c = x.shape
+    kv, g = cfg.n_kv_head, cfg.n_head // cfg.n_kv_head
+    h = rms_norm(bp["ln_1"], x, eps=cfg.rms_eps)
+    q, k, v = _qkv_rope(bp, h, start_pos + jnp.arange(t), cfg=cfg,
+                        compute_dtype=compute_dtype)
+    layer_cache = codec.write(layer_cache, k, v, start_pos)
+    pos_limit = start_pos + jnp.arange(t)
+    qg = q.reshape(b, kv, g * t, cfg.head_dim)
+    yg = codec.attend(qg, layer_cache, jnp.tile(pos_limit, g))
+    y = yg.reshape(b, cfg.n_head, t, cfg.head_dim)
+    x = x + linear(bp["attn"]["o"], merge_heads(y.astype(x.dtype)),
+                   compute_dtype=compute_dtype)
+    h = rms_norm(bp["ln_2"], x, eps=cfg.rms_eps)
+    m = linear(bp["mlp"]["down"],
+               silu(linear(bp["mlp"]["gate"], h, compute_dtype=compute_dtype))
+               * linear(bp["mlp"]["up"], h, compute_dtype=compute_dtype),
+               compute_dtype=compute_dtype)
+    return x + m.astype(x.dtype), layer_cache
+
+
+def init_cache(cfg: LlamaConfig, batch: int, max_len: int, dtype=jnp.float32):
+    """KV cache at KV-head width (L, B, KV, S, D) — GQA's decode-bandwidth
+    win made concrete: H/KV times fewer cache bytes per step than MHA.
+    Codec dispatch (f32/bf16/"int8") is generate.init_cache's."""
+    from dnn_tpu.runtime import generate
+
+    gqa_cfg = dataclasses.replace(
+        cfg, n_head=cfg.n_kv_head, n_embd=cfg.n_kv_head * cfg.head_dim)
+    return generate.init_cache(gqa_cfg, batch, max_len, dtype)
+
+
+def forward_with_cache(prepared, ids, cache, start_pos, *, cfg: LlamaConfig,
+                       compute_dtype=None):
+    from dnn_tpu.runtime.kvcache import codec_for_cache
+
+    codec = codec_for_cache(cache)
+    x = embedding(prepared["wte"], ids)
+    if compute_dtype is not None:
+        x = x.astype(compute_dtype)
+
+    def layer(carry, layer_in):
+        bp, layer_cache = layer_in
+        y, layer_cache = _block_with_cache(
+            bp, carry, layer_cache, start_pos, cfg=cfg,
+            compute_dtype=compute_dtype, codec=codec)
+        return y, layer_cache
+
+    x, new_cache = lax.scan(layer, x, (prepared["blocks"], cache))
+    logits = head(prepared, x.astype(jnp.float32), cfg=cfg,
+                  compute_dtype=compute_dtype)
+    return logits, new_cache
+
+
+def make_generate(cfg: LlamaConfig, *, max_new_tokens: int,
+                  temperature: float = 0.0, top_k: Optional[int] = None,
+                  compute_dtype=None, kv_dtype=None):
+    """Jitted generate(prepared, ids, rng) — same contract as the GPT
+    family's decoder, including kv_dtype (f32/bf16/"int8") cache storage."""
+    from dnn_tpu.runtime.generate import _sample
+
+    if max_new_tokens < 1:
+        raise ValueError(f"max_new_tokens must be >= 1, got {max_new_tokens}")
+
+    @jax.jit
+    def generate(prepared, ids, rng):
+        b, t = ids.shape
+        s_max = t + max_new_tokens
+        if s_max > cfg.block_size:
+            raise ValueError(
+                f"prompt {t} + max_new_tokens {max_new_tokens} exceeds "
+                f"block_size {cfg.block_size}")
+        cache_dtype = kv_dtype if kv_dtype is not None else (compute_dtype or jnp.float32)
+        cache = init_cache(cfg, b, s_max, cache_dtype)
+        logits, cache = forward_with_cache(
+            prepared, ids, cache, 0, cfg=cfg, compute_dtype=compute_dtype)
+        rng, sub = jax.random.split(rng)
+        tok = _sample(logits[:, -1], sub, temperature=temperature, top_k=top_k)
+
+        def step(carry, i):
+            cache, tok, rng = carry
+            logits, cache = forward_with_cache(
+                prepared, tok[:, None], cache, t + i, cfg=cfg,
+                compute_dtype=compute_dtype)
+            rng, sub = jax.random.split(rng)
+            nxt = _sample(logits[:, -1], sub, temperature=temperature,
+                          top_k=top_k)
+            return (cache, nxt, rng), tok
+
+        (_, last, _), toks = lax.scan(
+            step, (cache, tok, rng), jnp.arange(max_new_tokens - 1))
+        toks = jnp.moveaxis(toks, 0, 1)
+        return jnp.concatenate([toks, last[:, None]], axis=1)
+
+    return generate
+
+
+# --------------------------------------------------------------------------
+# pipeline partitioning + registry
+# --------------------------------------------------------------------------
+
+def make_partition(cfg: LlamaConfig, *, compute_dtype=None):
+    def partition(num_parts):
+        ranges = gpt.layer_ranges(cfg.n_layer, num_parts)
+        stages = []
+        for p, (lo, hi) in enumerate(ranges):
+            is_first, is_last = p == 0, p == num_parts - 1
+            param_keys = tuple(f"h_{i}" for i in range(lo, hi))
+            if is_first:
+                param_keys = ("wte",) + param_keys
+            if is_last:
+                param_keys = param_keys + ("ln_f", "lm_head")
+
+            def stage_fn(params, x, _lo=lo, _hi=hi, _first=is_first, _last=is_last):
+                if _first:
+                    x = embed(params, x, cfg=cfg)
+                if compute_dtype is not None and jnp.issubdtype(x.dtype, jnp.floating):
+                    x = x.astype(compute_dtype)
+                if _hi > _lo:
+                    stacked = gpt.stack_blocks(params, range(_lo, _hi))
+                    x = _blocks_scan(stacked, x, cfg=cfg,
+                                     compute_dtype=compute_dtype)
+                if _last:
+                    x = head(params, x.astype(jnp.float32), cfg=cfg,
+                             compute_dtype=compute_dtype)
+                return x
+
+            stages.append(StageSpec(
+                name=f"llama_blocks[{lo}:{hi}]"
+                + ("+embed" if is_first else "") + ("+head" if is_last else ""),
+                apply=stage_fn,
+                param_keys=param_keys,
+            ))
+        return stages
+
+    return partition
+
+
+def _register(name: str, cfg: LlamaConfig):
+    def convert(sd, _cfg=cfg):
+        from dnn_tpu.io.checkpoint import llama_params_from_state_dict
+
+        return llama_params_from_state_dict(sd, n_layer=_cfg.n_layer)
+
+    register_model(ModelSpec(
+        name=name,
+        init=lambda rng, dtype=jnp.float32, _cfg=cfg: init(rng, _cfg, dtype),
+        apply=make_apply(cfg),
+        partition=make_partition(cfg),
+        example_input=gpt.make_example_input(cfg),
+        supported_parts=tuple(range(1, cfg.n_layer + 1)),
+        convert_state_dict=convert,
+        config=cfg,
+        extras={
+            "make_apply": lambda compute_dtype=None, **_kw: make_apply(
+                cfg, compute_dtype=compute_dtype),
+            "make_partition": lambda compute_dtype=None, **_kw: make_partition(
+                cfg, compute_dtype=compute_dtype),
+        },
+    ))
+
+
+for _name, _cfg in PRESETS.items():
+    _register(_name, _cfg)

@@ -67,3 +67,27 @@ def causal_self_attention(params, x, *, n_head, use_flash=False, compute_dtype=N
 
     y = merge_heads(y)
     return linear(params["proj"], y, compute_dtype=compute_dtype)
+
+
+def rope_cos_sin(positions, head_dim, *, theta=10000.0):
+    """cos/sin tables for rotary position embedding at absolute
+    `positions` (any shape P...), HF half-split convention: frequencies
+    1/theta^(2i/d) over the first half of the head dim, tables tiled to
+    the full dim. Returns (cos, sin) of shape (*P, head_dim), f32."""
+    inv_freq = 1.0 / (theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32)
+                                / head_dim))
+    angles = positions.astype(jnp.float32)[..., None] * inv_freq  # (*P, d/2)
+    emb = jnp.concatenate([angles, angles], axis=-1)
+    return jnp.cos(emb), jnp.sin(emb)
+
+
+def apply_rope(x, cos, sin):
+    """Rotate head vectors x (..., T, D) by per-position tables
+    (T, D) — torch rotate_half convention: the two halves of the head dim
+    form the rotation pairs (NOT interleaved even/odd lanes; matching HF
+    weights requires matching this layout)."""
+    d = x.shape[-1]
+    x1, x2 = x[..., : d // 2], x[..., d // 2:]
+    rotated = jnp.concatenate([-x2, x1], axis=-1)
+    return (x.astype(jnp.float32) * cos + rotated.astype(jnp.float32) * sin
+            ).astype(x.dtype)

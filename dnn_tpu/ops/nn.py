@@ -169,3 +169,16 @@ def embedding(params, ids):
     (/root/reference/partitions/gpt_model_parts.py:9-10,16-18).
     """
     return jnp.take(params["embedding"], ids, axis=0)
+
+
+def silu(x):
+    """SiLU / swish (the LLaMA-family gate nonlinearity)."""
+    return jax.nn.silu(x)
+
+
+def rms_norm(params, x, *, eps=1e-6):
+    """RMSNorm over the last dim (LLaMA-family normalization: no mean
+    subtraction, no bias — torch LlamaRMSNorm semantics, f32 statistics)."""
+    x32 = x.astype(jnp.float32)
+    y = x32 * lax.rsqrt(jnp.mean(x32 * x32, axis=-1, keepdims=True) + eps)
+    return (y * params["scale"].astype(jnp.float32)).astype(x.dtype)

@@ -425,22 +425,36 @@ class PipelineEngine:
                 "with role='stage' (serves one part)"
             )
         default_rng = jax.random.PRNGKey(0)
+
+        def single_program(gen):
+            """Shared tail for every single-program family decoder: cache
+            the prepared layout once, default the rng."""
+            if not hasattr(self, "_prepared_single"):
+                self._prepared_single = prepare_stacked(self.params, cfg)
+            prepared = self._prepared_single
+            return lambda ids, rng=None: gen(
+                prepared, ids, default_rng if rng is None else rng
+            )
+
+        from dnn_tpu.models.llama import LlamaConfig
+
         if isinstance(cfg, GPTMoEConfig):
             # MoE family decodes through the single-program routed decoder
             # (runtime/generate_moe.py); pipeline-parallel MoE decode is not
             # built, so spmd engines fall back to the local program too.
             from dnn_tpu.runtime.generate_moe import make_generate_moe
 
-            if not hasattr(self, "_prepared_single"):
-                self._prepared_single = prepare_stacked(self.params, cfg)
-            gen = make_generate_moe(
+            return single_program(make_generate_moe(
                 cfg, max_new_tokens=max_new_tokens, temperature=temperature,
                 sample_top_k=top_k, compute_dtype=self.compute_dtype,
-            )
-            prepared = self._prepared_single
-            return lambda ids, rng=None: gen(
-                prepared, ids, default_rng if rng is None else rng
-            )
+            ))
+        if isinstance(cfg, LlamaConfig):
+            from dnn_tpu.models import llama
+
+            return single_program(llama.make_generate(
+                cfg, max_new_tokens=max_new_tokens, temperature=temperature,
+                top_k=top_k, compute_dtype=self.compute_dtype,
+            ))
         if type(cfg) is not GPTConfig:
             # exact match: the KV-cache decoder assumes dense-GPT block
             # params ('mlp'); unknown subclasses are not decodable through it
@@ -458,16 +472,10 @@ class PipelineEngine:
             return lambda ids, rng=None: gen(
                 stage_major, aux, ids, default_rng if rng is None else rng
             )
-        if not hasattr(self, "_prepared_single"):
-            self._prepared_single = prepare_stacked(self.params, cfg)
-        gen = make_generate(
+        return single_program(make_generate(
             cfg, max_new_tokens=max_new_tokens, temperature=temperature,
             top_k=top_k, compute_dtype=self.compute_dtype,
-        )
-        prepared = self._prepared_single
-        return lambda ids, rng=None: gen(
-            prepared, ids, default_rng if rng is None else rng
-        )
+        ))
 
     def generate(self, ids, *, max_new_tokens: int, temperature: float = 0.0,
                  top_k: Optional[int] = None, rng=None) -> jax.Array:

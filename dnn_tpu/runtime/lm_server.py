@@ -84,13 +84,23 @@ class _BatcherWorker(threading.Thread):
         self.q: "queue.Queue" = queue.Queue()
         self._stop_evt = threading.Event()
         self._abandon = False
+        # _lock serializes submit against the dead-marking in _fail_all /
+        # abandon: without it a future enqueued between the worker's final
+        # queue drain and thread exit would never resolve (the caller
+        # would hang for request_timeout instead of failing fast)
+        self._lock = threading.Lock()
+        self._dead: "Exception | None" = None
         self._futures = {}
 
     def submit(self, prompt: np.ndarray, max_new: int, seed):
         import concurrent.futures
 
         fut = concurrent.futures.Future()
-        self.q.put((prompt, max_new, seed, fut))
+        with self._lock:
+            if self._dead is not None:
+                fut.set_exception(self._dead)
+                return fut
+            self.q.put((prompt, max_new, seed, fut))
         return fut
 
     def stop(self, *, drain: bool = True):
@@ -100,13 +110,16 @@ class _BatcherWorker(threading.Thread):
         its next iteration (the worker must not keep stepping the device
         after close())."""
         if not drain:
-            self._abandon = True
-            while True:
-                try:
-                    *_rest, fut = self.q.get_nowait()
-                except queue.Empty:
-                    break
-                fut.cancel()
+            with self._lock:
+                self._abandon = True
+                if self._dead is None:
+                    self._dead = RuntimeError("LM server shut down")
+                while True:
+                    try:
+                        *_rest, fut = self.q.get_nowait()
+                    except queue.Empty:
+                        break
+                    fut.cancel()
         self._stop_evt.set()
 
     # ------------------------------------------------------------------
@@ -125,24 +138,27 @@ class _BatcherWorker(threading.Thread):
             self._futures.pop(rid).set_result(b.results.pop(rid))
 
     def _fail_all(self, exc):
-        for fut in self._futures.values():
-            if not fut.done():
+        with self._lock:
+            self._dead = exc  # submits from here on fail immediately
+            for fut in self._futures.values():
+                if not fut.done():
+                    fut.set_exception(exc)
+            self._futures.clear()
+            while True:
+                try:
+                    *_rest, fut = self.q.get_nowait()
+                except queue.Empty:
+                    return
                 fut.set_exception(exc)
-        self._futures.clear()
-        while True:
-            try:
-                *_rest, fut = self.q.get_nowait()
-            except queue.Empty:
-                return
-            fut.set_exception(exc)
 
     def run(self):
         b = self.batcher
         while True:
             if self._abandon:
-                for fut in self._futures.values():
-                    fut.cancel()
-                self._futures.clear()
+                with self._lock:
+                    for fut in self._futures.values():
+                        fut.cancel()
+                    self._futures.clear()
                 return
             if b.n_active == 0 and self.q.empty():
                 if self._stop_evt.is_set():
@@ -210,9 +226,15 @@ class LMServer:
         except ValueError as e:
             # submit-side validation (overlong prompt, budget) — caller error
             await context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
-        except (RuntimeError, asyncio.CancelledError) as e:
+        except RuntimeError as e:
             # worker died mid-request or server shut down — server fault
             await context.abort(grpc.StatusCode.UNAVAILABLE, str(e))
+        except asyncio.CancelledError:
+            if fut.cancelled():
+                # server-side abandon (non-drain shutdown) — server fault
+                await context.abort(grpc.StatusCode.UNAVAILABLE,
+                                    "LM server shut down")
+            raise  # client cancelled the RPC: let grpc.aio handle it
         except asyncio.TimeoutError:
             await context.abort(
                 grpc.StatusCode.DEADLINE_EXCEEDED,

@@ -1,0 +1,152 @@
+"""LLaMA family tests.
+
+Parity is tested three ways, mirroring the GPT family's strategy:
+HF/torch LlamaForCausalLM == our forward on converted weights (the
+weight-compat contract for real checkpoints), partition composition ==
+full model, and incremental KV-cache decode == repeated full forwards.
+GQA specifics get their own checks: the cache must hold KV heads (not H),
+and a 2-stage pipeline of the partitioned model must match the solo run.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dnn_tpu.models import llama
+from dnn_tpu.registry import get_model
+
+CFG = llama.PRESETS["llama-test"]  # L=4, H=4, KV=2, C=64, ff=128, V=256
+
+
+def _params(seed=0):
+    return llama.init(jax.random.PRNGKey(seed), CFG)
+
+
+def test_hf_llama_logit_parity():
+    torch = pytest.importorskip("torch")
+    transformers = pytest.importorskip("transformers")
+
+    hf_cfg = transformers.LlamaConfig(
+        vocab_size=CFG.vocab_size, hidden_size=CFG.n_embd,
+        intermediate_size=CFG.d_ff, num_hidden_layers=CFG.n_layer,
+        num_attention_heads=CFG.n_head, num_key_value_heads=CFG.n_kv_head,
+        max_position_embeddings=CFG.block_size, rope_theta=CFG.rope_theta,
+        rms_norm_eps=CFG.rms_eps, attention_bias=False, mlp_bias=False,
+        tie_word_embeddings=False, attn_implementation="eager",
+    )
+    torch.manual_seed(0)
+    model = transformers.LlamaForCausalLM(hf_cfg).eval()
+    sd = {k: v.numpy() for k, v in model.state_dict().items()}
+
+    from dnn_tpu.io.checkpoint import llama_params_from_state_dict
+
+    params = llama_params_from_state_dict(sd)
+    ids = np.random.RandomState(1).randint(0, CFG.vocab_size, (2, 12))
+    with torch.no_grad():
+        want = model(torch.from_numpy(ids)).logits.numpy()
+    got = np.asarray(llama.make_apply(CFG)(params, jnp.asarray(ids)))
+    np.testing.assert_allclose(got, want, atol=2e-3, rtol=2e-3)
+    # ranking parity is the bar that matters for decode
+    np.testing.assert_array_equal(got.argmax(-1), want.argmax(-1))
+
+
+def test_stacked_matches_per_layer():
+    from dnn_tpu.models import gpt
+
+    params = _params()
+    prepared = gpt.prepare_stacked(params, CFG)
+    ids = jax.random.randint(jax.random.PRNGKey(1), (2, 10), 0, CFG.vocab_size)
+    a = llama.make_apply(CFG)(params, ids)
+    b = llama.make_apply_stacked(CFG)(prepared, ids)
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+
+
+@pytest.mark.parametrize("parts", [1, 2, 4])
+def test_partition_composes_to_full_model(parts):
+    params = _params(seed=2)
+    ids = jax.random.randint(jax.random.PRNGKey(3), (2, 8), 0, CFG.vocab_size)
+    want = np.asarray(llama.make_apply(CFG)(params, ids))
+    stages = llama.make_partition(CFG)(parts)
+    x = ids
+    for st in stages:
+        x = st.apply(st.slice_params(params), x)
+    np.testing.assert_allclose(np.asarray(x), want, atol=1e-4, rtol=1e-4)
+
+
+def test_registry_and_pipeline():
+    spec = get_model("llama-test")
+    assert spec.config is CFG
+    params = spec.init(jax.random.PRNGKey(4))
+    ids = np.asarray(spec.example_input(batch_size=2, seq_len=8))
+
+    from dnn_tpu.parallel.mesh import STAGE_AXIS, make_mesh
+    from dnn_tpu.parallel.pipeline import spmd_pipeline
+
+    stages = spec.partition(2)
+    mesh = make_mesh({STAGE_AXIS: 2}, jax.devices()[:2])
+    got = spmd_pipeline(
+        [st.apply for st in stages],
+        [st.slice_params(params) for st in stages],
+        jnp.asarray(ids), mesh=mesh, num_microbatches=2,
+        param_placement="replicated",
+    )
+    want = spec.apply(params, jnp.asarray(ids))
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=1e-4, rtol=1e-4)
+
+
+def test_cache_holds_kv_heads_not_q_heads():
+    cache = llama.init_cache(CFG, 2, 16)
+    assert cache["k"].shape == (CFG.n_layer, 2, CFG.n_kv_head, 16,
+                                CFG.head_dim), cache["k"].shape
+    i8 = llama.init_cache(CFG, 2, 16, "int8")
+    assert i8["k"].dtype == jnp.int8
+    assert i8["ks"].shape == (CFG.n_layer, 2, CFG.n_kv_head, 16)
+
+
+def test_incremental_decode_matches_full_recompute():
+    params = _params(seed=5)
+    from dnn_tpu.models import gpt
+
+    prepared = gpt.prepare_stacked(params, CFG)
+    apply_fn = llama.make_apply(CFG)
+    ids = jax.random.randint(jax.random.PRNGKey(6), (2, 8), 0, CFG.vocab_size)
+    n_new = 6
+    gen = llama.make_generate(CFG, max_new_tokens=n_new)
+    got = np.asarray(gen(prepared, ids, jax.random.PRNGKey(0)))
+
+    cur = np.asarray(ids)
+    want = []
+    for _ in range(n_new):
+        logits = apply_fn(params, jnp.asarray(cur))
+        nxt = np.asarray(jnp.argmax(logits[:, -1], -1)).astype(np.int32)
+        want.append(nxt)
+        cur = np.concatenate([cur, nxt[:, None]], axis=1)
+    np.testing.assert_array_equal(got, np.stack(want, axis=1))
+
+
+def test_int8_cache_decode_tracks_f32():
+    params = _params(seed=7)
+    from dnn_tpu.models import gpt
+
+    prepared = gpt.prepare_stacked(params, CFG)
+    ids = jax.random.randint(jax.random.PRNGKey(8), (2, 8), 0, CFG.vocab_size)
+    f32 = np.asarray(llama.make_generate(CFG, max_new_tokens=10)(
+        prepared, ids, jax.random.PRNGKey(0)))
+    i8 = np.asarray(llama.make_generate(CFG, max_new_tokens=10,
+                                        kv_dtype="int8")(
+        prepared, ids, jax.random.PRNGKey(0)))
+    assert (i8 == f32).mean() >= 0.5, "int8 cache diverged wholesale"
+
+
+def test_quantized_weights_keep_ranking():
+    from dnn_tpu.quant import quantize_tree
+
+    params = _params(seed=9)
+    q = quantize_tree(params)
+    ids = jax.random.randint(jax.random.PRNGKey(10), (2, 10), 0, CFG.vocab_size)
+    a = np.asarray(llama.make_apply(CFG)(params, ids)).astype(np.float64)
+    b = np.asarray(llama.make_apply(CFG)(q, ids)).astype(np.float64)
+    cos = (a.ravel() @ b.ravel()) / (np.linalg.norm(a) * np.linalg.norm(b))
+    assert cos > 0.999, f"quantized llama cosine {cos}"
