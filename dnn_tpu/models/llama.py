@@ -145,6 +145,19 @@ def _qkv_rope(bp, h, positions, *, cfg: LlamaConfig, compute_dtype):
     return apply_rope(q, cos, sin), apply_rope(k, cos, sin), v
 
 
+def _mlp_residual(bp, x, *, cfg: LlamaConfig, compute_dtype):
+    """Post-attention half of every block: RMSNorm + SwiGLU MLP, residual.
+    ONE definition shared by the stateless forward, the cached decode, and
+    the per-slot batcher path — their parity contracts depend on these
+    never diverging."""
+    h = rms_norm(bp["ln_2"], x, eps=cfg.rms_eps)
+    m = linear(bp["mlp"]["down"],
+               silu(linear(bp["mlp"]["gate"], h, compute_dtype=compute_dtype))
+               * linear(bp["mlp"]["up"], h, compute_dtype=compute_dtype),
+               compute_dtype=compute_dtype)
+    return x + m.astype(x.dtype)
+
+
 def _gqa_scores_attend(q, k, v, mask_fn):
     """Grouped attention: q (B, H, T, D) vs k/v (B, KV, S, D) with
     H = G * KV. Folds the group into the row dim so einsums run at KV
@@ -177,12 +190,7 @@ def block_apply(bp, x, *, cfg: LlamaConfig, compute_dtype=None):
     y = _gqa_scores_attend(q, k, v, causal)
     x = x + linear(bp["attn"]["o"], merge_heads(y.astype(x.dtype)),
                    compute_dtype=compute_dtype)
-    h = rms_norm(bp["ln_2"], x, eps=cfg.rms_eps)
-    m = linear(bp["mlp"]["down"],
-               silu(linear(bp["mlp"]["gate"], h, compute_dtype=compute_dtype))
-               * linear(bp["mlp"]["up"], h, compute_dtype=compute_dtype),
-               compute_dtype=compute_dtype)
-    return x + m.astype(x.dtype)
+    return _mlp_residual(bp, x, cfg=cfg, compute_dtype=compute_dtype)
 
 
 def embed(params, idx, *, cfg: LlamaConfig):
@@ -269,12 +277,7 @@ def _block_with_cache(bp, x, layer_cache, start_pos, *, cfg: LlamaConfig,
     y = yg.reshape(b, cfg.n_head, t, cfg.head_dim)
     x = x + linear(bp["attn"]["o"], merge_heads(y.astype(x.dtype)),
                    compute_dtype=compute_dtype)
-    h = rms_norm(bp["ln_2"], x, eps=cfg.rms_eps)
-    m = linear(bp["mlp"]["down"],
-               silu(linear(bp["mlp"]["gate"], h, compute_dtype=compute_dtype))
-               * linear(bp["mlp"]["up"], h, compute_dtype=compute_dtype),
-               compute_dtype=compute_dtype)
-    return x + m.astype(x.dtype), layer_cache
+    return _mlp_residual(bp, x, cfg=cfg, compute_dtype=compute_dtype), layer_cache
 
 
 def init_cache(cfg: LlamaConfig, batch: int, max_len: int, dtype=jnp.float32):
@@ -351,6 +354,66 @@ def make_generate(cfg: LlamaConfig, *, max_new_tokens: int,
         return jnp.concatenate([toks, last[:, None]], axis=1)
 
     return generate
+
+
+class LlamaFamilyRows:
+    """ContinuousBatcher family adapter (see
+    runtime/serving.GPTFamilyRows for the protocol): per-slot LLaMA decode
+    with RoPE at each slot's own position and the KV-head-width cache. The
+    GQA fold for per-row attention treats the query group as the row dim —
+    q (B, H, 1, D) -> (B, KV, G, D) — since every group row shares its
+    slot's position limit."""
+
+    def __init__(self, cfg: LlamaConfig, *, compute_dtype=None):
+        self.cfg = cfg
+        self.compute_dtype = compute_dtype
+
+    def init_cache(self, batch, max_len, dtype):
+        return init_cache(self.cfg, batch, max_len, dtype)
+
+    def prefill(self, prepared, padded, row_cache):
+        return forward_with_cache(
+            prepared, padded, row_cache, 0, cfg=self.cfg,
+            compute_dtype=self.compute_dtype)
+
+    def _block_rows(self, bp, x, layer_cache, pos, write, codec):
+        cfg, compute_dtype = self.cfg, self.compute_dtype
+        b = x.shape[0]
+        kv, g, d = cfg.n_kv_head, cfg.n_head // cfg.n_kv_head, cfg.head_dim
+        h = rms_norm(bp["ln_1"], x, eps=cfg.rms_eps)
+        q = split_heads(linear(bp["attn"]["q"], h, compute_dtype=compute_dtype),
+                        cfg.n_head)
+        k = split_heads(linear(bp["attn"]["k"], h, compute_dtype=compute_dtype),
+                        kv)
+        v = split_heads(linear(bp["attn"]["v"], h, compute_dtype=compute_dtype),
+                        kv)
+        cos, sin = rope_cos_sin(pos, d, theta=cfg.rope_theta)  # (B, D)
+        cos, sin = cos[:, None, None, :], sin[:, None, None, :]
+        q, k = apply_rope(q, cos, sin), apply_rope(k, cos, sin)
+        layer_cache = codec.write_rows(layer_cache, k, v, pos, write)
+        qg = q.reshape(b, kv, g, d)  # group rows share the slot's limit
+        y = codec.attend_rows(qg, layer_cache, pos)
+        y = y.reshape(b, cfg.n_head, 1, d)
+        x = x + linear(bp["attn"]["o"], merge_heads(y.astype(x.dtype)),
+                       compute_dtype=compute_dtype)
+        return (_mlp_residual(bp, x, cfg=cfg, compute_dtype=compute_dtype),
+                layer_cache)
+
+    def decode_rows(self, prepared, cache, tok, pos, active, codec):
+        x = embedding(prepared["wte"], tok[:, None])  # (B, 1, C)
+        if self.compute_dtype is not None:
+            x = x.astype(self.compute_dtype)
+
+        def layer(carry, layer_in):
+            bp, layer_cache = layer_in
+            y, layer_cache = self._block_rows(
+                bp, carry, layer_cache, pos, active, codec)
+            return y, layer_cache
+
+        x, new_cache = lax.scan(layer, x, (prepared["blocks"], cache))
+        logits = head(prepared, x.astype(jnp.float32), cfg=self.cfg,
+                      compute_dtype=self.compute_dtype)
+        return logits[:, -1], new_cache
 
 
 # --------------------------------------------------------------------------

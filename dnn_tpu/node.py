@@ -274,14 +274,17 @@ def _serve_lm(engine: PipelineEngine, args) -> int:
     GPT family serves; MoE plugs its routed FFN into the same pool."""
     from dnn_tpu.models.gpt import GPTConfig, prepare_stacked
     from dnn_tpu.models.gpt_moe import GPTMoEConfig
+    from dnn_tpu.models.llama import LlamaConfig, LlamaFamilyRows
     from dnn_tpu.runtime.lm_server import serve_lm
 
     cfg = engine.spec.config
-    ffn = None
+    ffn, family = None, None
     if isinstance(cfg, GPTMoEConfig):
         from dnn_tpu.runtime.generate_moe import moe_cache_ffn
 
         ffn = moe_cache_ffn(cfg, compute_dtype=engine.compute_dtype)
+    elif isinstance(cfg, LlamaConfig):
+        family = LlamaFamilyRows(cfg, compute_dtype=engine.compute_dtype)
     elif type(cfg) is not GPTConfig:
         log.error("--serve_lm requires a GPT-family model; '%s' (config %s) "
                   "is not one", engine.config.model, type(cfg).__name__)
@@ -298,7 +301,7 @@ def _serve_lm(engine: PipelineEngine, args) -> int:
             max_len=args.max_len, prompt_pad=args.prompt_pad,
             temperature=args.temperature, top_k=args.top_k,
             compute_dtype=engine.compute_dtype, seed=args.seed, ffn=ffn,
-            default_max_new=args.generate or 32,
+            family=family, default_max_new=args.generate or 32,
         ))
     except KeyboardInterrupt:
         log.info("shutting down")

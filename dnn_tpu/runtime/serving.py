@@ -80,6 +80,53 @@ def _decode_block_rows(bp, x, layer_cache, pos, write, *, cfg, compute_dtype,
     return x + m, layer_cache
 
 
+class GPTFamilyRows:
+    """The GPT family's per-slot decode hooks — the default
+    `ContinuousBatcher` family adapter. A family adapter supplies three
+    things: the cache layout, the padded-prompt prefill forward, and the
+    per-row decode forward (per-slot positions); everything else —
+    slot bookkeeping, sampling streams, retirement — is family-agnostic
+    and lives in the batcher. Other families plug in the same way
+    (LLaMA: dnn_tpu/models/llama.LlamaFamilyRows — RoPE positions and a
+    KV-head-width cache; MoE stays a GPT block with `ffn` overridden)."""
+
+    def __init__(self, cfg, *, compute_dtype=None, ffn=None):
+        self.cfg = cfg
+        self.compute_dtype = compute_dtype
+        self.ffn = ffn
+
+    def init_cache(self, batch, max_len, dtype):
+        return init_cache(self.cfg, batch, max_len, dtype)
+
+    def prefill(self, prepared, padded, row_cache):
+        """padded (1, P) prompt -> (logits (1, P, V), row_cache)."""
+        return forward_with_cache(
+            prepared, padded, row_cache, 0, cfg=self.cfg,
+            compute_dtype=self.compute_dtype, ffn=self.ffn)
+
+    def decode_rows(self, prepared, cache, tok, pos, active, codec):
+        """One per-slot decode step: tok/pos/active (B,) ->
+        (logits (B, V), cache)."""
+        cfg, compute_dtype = self.cfg, self.compute_dtype
+        x = jnp.take(prepared["wte"]["embedding"], tok[:, None], axis=0) + \
+            prepared["wpe"]["embedding"][pos][:, None, :]
+        if compute_dtype is not None:
+            x = x.astype(compute_dtype)
+
+        def layer(carry, layer_in):
+            bp, layer_cache = layer_in
+            y, layer_cache = _decode_block_rows(
+                bp, carry, layer_cache, pos, active, cfg=cfg,
+                compute_dtype=compute_dtype, codec=codec, ffn=self.ffn,
+            )
+            return y, layer_cache
+
+        x, new_cache = lax.scan(layer, x, (prepared["blocks"], cache))
+        logits = head(prepared, x.astype(jnp.float32), cfg=cfg,
+                      compute_dtype=compute_dtype)
+        return logits[:, -1], new_cache
+
+
 class ContinuousBatcher:
     """Slot-pool decode server. `slots` concurrent sequences over one
     static cache of `max_len` positions; prompts are padded to
@@ -96,7 +143,7 @@ class ContinuousBatcher:
                  max_len: Optional[int] = None, prompt_pad: Optional[int] = None,
                  temperature: float = 0.0, top_k: Optional[int] = None,
                  compute_dtype=None, eos_id: Optional[int] = None, seed: int = 0,
-                 ffn=None, kv_dtype=None):
+                 ffn=None, kv_dtype=None, family=None):
         self.cfg = cfg
         self.prepared = prepared
         self.slots = slots
@@ -104,12 +151,30 @@ class ContinuousBatcher:
         self.prompt_pad = prompt_pad or min(64, self.max_len)
         self.eos_id = eos_id
         self._seed = seed
+        # `family` supplies the model-specific cache/prefill/decode hooks
+        # (default: the GPT block family; LLaMA passes LlamaFamilyRows).
+        # With an explicit family, the model math runs at the FAMILY's
+        # compute_dtype — a diverging batcher-level knob would silently
+        # lose, so it is rejected, and the cache default follows the
+        # family's dtype.
+        if family is not None:
+            if ffn is not None:
+                raise ValueError(
+                    "pass ffn on the family adapter, not alongside family=")
+            fam_dtype = getattr(family, "compute_dtype", None)
+            if compute_dtype is not None and fam_dtype != compute_dtype:
+                raise ValueError(
+                    f"compute_dtype mismatch: batcher={compute_dtype} vs "
+                    f"family adapter={fam_dtype} — set it on the adapter")
+            compute_dtype = fam_dtype
+        self.family = family or GPTFamilyRows(
+            cfg, compute_dtype=compute_dtype, ffn=ffn)
         # kv_dtype picks the cache storage codec (None follows
         # compute_dtype; "int8" = quantized cache, kvcache.Int8KV)
         cache_dtype = kv_dtype if kv_dtype is not None else (compute_dtype or jnp.float32)
 
         # device state (functional updates)
-        self.cache = init_cache(cfg, slots, self.max_len, cache_dtype)
+        self.cache = self.family.init_cache(slots, self.max_len, cache_dtype)
         codec = codec_for_cache(self.cache)
         self.pos = jnp.zeros((slots,), jnp.int32)      # next write position
         self.tok = jnp.zeros((slots,), jnp.int32)      # last sampled token
@@ -125,30 +190,15 @@ class ContinuousBatcher:
 
         def decode_step(prepared, cache, pos, tok, active, keys):
             """Advance every active slot one token."""
-            # embed each slot's last token at its own position
-            x = jnp.take(prepared["wte"]["embedding"], tok[:, None], axis=0) + \
-                prepared["wpe"]["embedding"][pos][:, None, :]
-            if compute_dtype is not None:
-                x = x.astype(compute_dtype)
-
-            def layer(carry, layer_in):
-                bp, layer_cache = layer_in
-                y, layer_cache = _decode_block_rows(
-                    bp, carry, layer_cache, pos, active, cfg=cfg,
-                    compute_dtype=compute_dtype, codec=codec, ffn=ffn,
-                )
-                return y, layer_cache
-
-            x, new_cache = lax.scan(layer, x, (prepared["blocks"], cache))
-            logits = head(prepared, x.astype(jnp.float32), cfg=cfg,
-                          compute_dtype=compute_dtype)
+            logits, new_cache = self.family.decode_rows(
+                prepared, cache, tok, pos, active, codec)
             # advance each slot's own stream; sample each row with its key
             split = jax.vmap(jax.random.split)(keys)  # (B, 2, 2)
             new_keys, subs = split[:, 0], split[:, 1]
             nxt = jax.vmap(
                 lambda lg, k: _sample(lg[None, :], k, temperature=temperature,
                                       top_k=top_k)[0]
-            )(logits[:, -1], subs)
+            )(logits, subs)
             nxt = jnp.where(active, nxt, tok)
             new_keys = jnp.where(active[:, None], new_keys, keys)
             return (new_cache, pos + active.astype(jnp.int32),
@@ -158,11 +208,8 @@ class ContinuousBatcher:
             """Prefill one slot: padded (1, P) prompt, true_len real tokens.
             Returns (cache, first_token). Pad positions beyond true_len
             write K/V that the per-row position mask never attends."""
-            row = init_cache(cfg, 1, self.max_len, cache_dtype)
-            logits, row = forward_with_cache(
-                prepared, padded, row, 0, cfg=cfg, compute_dtype=compute_dtype,
-                ffn=ffn,
-            )
+            row = self.family.init_cache(1, self.max_len, cache_dtype)
+            logits, row = self.family.prefill(prepared, padded, row)
             first = _sample(
                 logits[:, true_len - 1][0:1], rng,
                 temperature=temperature, top_k=top_k,

@@ -150,3 +150,45 @@ def test_quantized_weights_keep_ranking():
     b = np.asarray(llama.make_apply(CFG)(q, ids)).astype(np.float64)
     cos = (a.ravel() @ b.ravel()) / (np.linalg.norm(a) * np.linalg.norm(b))
     assert cos > 0.999, f"quantized llama cosine {cos}"
+
+
+def test_llama_batcher_matches_solo_decode():
+    """A greedy LLaMA slot in the continuous-batching pool == a solo
+    batch-1 run — the family-adapter contract (LlamaFamilyRows)."""
+    from dnn_tpu.models import gpt
+    from dnn_tpu.runtime.serving import ContinuousBatcher
+
+    params = _params(seed=11)
+    prepared = gpt.prepare_stacked(params, CFG)
+    prompts = [np.array([5, 3, 7, 1, 2]), np.array([9, 8, 2])]
+    n_new = 6
+    srv = ContinuousBatcher(
+        CFG, prepared, slots=2, max_len=32, prompt_pad=8,
+        family=llama.LlamaFamilyRows(CFG))
+    rids = [srv.submit(p, max_new_tokens=n_new) for p in prompts]
+    results = srv.drain()
+
+    gen = llama.make_generate(CFG, max_new_tokens=n_new)
+    for rid, p in zip(rids, prompts):
+        want = np.asarray(gen(prepared, jnp.asarray(p, jnp.int32)[None, :],
+                              jax.random.PRNGKey(0)))[0]
+        np.testing.assert_array_equal(results[rid], want)
+
+
+def test_llama_batcher_int8_cache():
+    from dnn_tpu.models import gpt
+    from dnn_tpu.runtime.serving import ContinuousBatcher
+
+    params = _params(seed=12)
+    prepared = gpt.prepare_stacked(params, CFG)
+    prompt = np.array([4, 5, 6, 7])
+    srv = ContinuousBatcher(
+        CFG, prepared, slots=2, max_len=32, prompt_pad=8, kv_dtype="int8",
+        family=llama.LlamaFamilyRows(CFG))
+    rid = srv.submit(prompt, max_new_tokens=6)
+    got = srv.drain()[rid]
+    want = np.asarray(llama.make_generate(CFG, max_new_tokens=6,
+                                          kv_dtype="int8")(
+        prepared, jnp.asarray(prompt, jnp.int32)[None, :],
+        jax.random.PRNGKey(0)))[0]
+    np.testing.assert_array_equal(got, want)
