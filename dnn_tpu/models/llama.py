@@ -211,7 +211,7 @@ def head(params, x, *, cfg: LlamaConfig, compute_dtype=None, logits_dtype=None):
     return out if logits_dtype is None else out.astype(logits_dtype)
 
 
-def _blocks_scan(stacked, x, *, cfg, compute_dtype, remat=False):
+def blocks_scan(stacked, x, *, cfg, compute_dtype, remat=False):
     block = (lambda bp, carry: block_apply(bp, carry, cfg=cfg,
                                            compute_dtype=compute_dtype))
     if remat:
@@ -230,7 +230,7 @@ def make_apply(cfg: LlamaConfig, *, compute_dtype=None, remat=False):
         if compute_dtype is not None:
             x = x.astype(compute_dtype)
         stacked = gpt.stack_blocks(params, range(cfg.n_layer))
-        x = _blocks_scan(stacked, x, cfg=cfg, compute_dtype=compute_dtype,
+        x = blocks_scan(stacked, x, cfg=cfg, compute_dtype=compute_dtype,
                          remat=remat)
         return head(params, x.astype(jnp.float32), cfg=cfg,
                     compute_dtype=compute_dtype)
@@ -247,7 +247,7 @@ def make_apply_stacked(cfg: LlamaConfig, *, compute_dtype=None,
         x = embed(prepared, idx, cfg=cfg)
         if compute_dtype is not None:
             x = x.astype(compute_dtype)
-        x = _blocks_scan(prepared["blocks"], x, cfg=cfg,
+        x = blocks_scan(prepared["blocks"], x, cfg=cfg,
                          compute_dtype=compute_dtype, remat=remat)
         return head(prepared, x.astype(jnp.float32), cfg=cfg,
                     compute_dtype=compute_dtype, logits_dtype=logits_dtype)
@@ -416,6 +416,59 @@ class LlamaFamilyRows:
         return logits[:, -1], new_cache
 
 
+class LlamaPipelineFamily:
+    """Pipeline-parallel decode hooks (see
+    runtime/generate.GPTPipelineFamily): stage-local cache shards at
+    KV-head width, RoPE at the ring's absolute positions."""
+
+    def __init__(self, cfg: LlamaConfig, *, compute_dtype=None):
+        self.cfg = cfg
+        self.compute_dtype = compute_dtype
+
+    def stage_cache(self, per_stage, batch, s_max):
+        cfg = self.cfg
+        dt = self.compute_dtype or jnp.float32
+        shape = (per_stage, batch, cfg.n_kv_head, s_max, cfg.head_dim)
+        return {"k": jnp.zeros(shape, dt), "v": jnp.zeros(shape, dt)}
+
+    def block_with_cache(self, bp, x, layer_cache, start_pos):
+        from dnn_tpu.runtime.kvcache import FloatKV
+
+        return _block_with_cache(
+            bp, x, layer_cache, start_pos, cfg=self.cfg,
+            compute_dtype=self.compute_dtype,
+            codec=FloatKV(layer_cache["k"].dtype))
+
+    def embed(self, aux, ids, start_pos):
+        x = embedding(aux["wte"], ids)
+        if self.compute_dtype is not None:
+            x = x.astype(self.compute_dtype)
+        return x
+
+    def head(self, aux, h):
+        return head(aux, h.astype(jnp.float32), cfg=self.cfg,
+                    compute_dtype=self.compute_dtype)
+
+
+def make_pipeline_generate(cfg: LlamaConfig, mesh, *, max_new_tokens: int,
+                           temperature: float = 0.0,
+                           top_k: Optional[int] = None,
+                           compute_dtype=None, axis_name=None):
+    """Pipeline-parallel KV-cache generation for the LLaMA family: each
+    stage keeps its blocks AND its KV-head-width cache shard, the hidden
+    state rides the ppermute ring per token (runtime/generate's ring
+    schedule with this family's hooks). Token-for-token identical to
+    llama.make_generate."""
+    from dnn_tpu.runtime.generate import (
+        make_pipeline_generate as _mk,
+    )
+
+    return _mk(cfg, mesh, max_new_tokens=max_new_tokens,
+               temperature=temperature, top_k=top_k,
+               compute_dtype=compute_dtype, axis_name=axis_name,
+               family=LlamaPipelineFamily(cfg, compute_dtype=compute_dtype))
+
+
 # --------------------------------------------------------------------------
 # pipeline partitioning + registry
 # --------------------------------------------------------------------------
@@ -439,7 +492,7 @@ def make_partition(cfg: LlamaConfig, *, compute_dtype=None):
                     x = x.astype(compute_dtype)
                 if _hi > _lo:
                     stacked = gpt.stack_blocks(params, range(_lo, _hi))
-                    x = _blocks_scan(stacked, x, cfg=cfg,
+                    x = blocks_scan(stacked, x, cfg=cfg,
                                      compute_dtype=compute_dtype)
                 if _last:
                     x = head(params, x.astype(jnp.float32), cfg=cfg,

@@ -151,9 +151,41 @@ def prepare_pipeline_stacked(prepared, cfg: GPTConfig, mesh, *, axis_name=None):
     return stage_blocks, aux
 
 
+class GPTPipelineFamily:
+    """Per-stage decode hooks for the pipeline-parallel generator — the
+    family-adapter pattern the batcher uses (serving.GPTFamilyRows),
+    applied to the ppermute ring: a family supplies its stage-local cache
+    layout, cached block, embed, and head; the ring schedule, cache-shard
+    bookkeeping, and sampling broadcast stay family-agnostic. LLaMA's
+    adapter is models/llama.LlamaPipelineFamily (RoPE positions,
+    KV-head-width cache shards)."""
+
+    def __init__(self, cfg, *, compute_dtype=None):
+        self.cfg = cfg
+        self.compute_dtype = compute_dtype
+
+    def stage_cache(self, per_stage: int, batch: int, s_max: int):
+        cfg = self.cfg
+        dt = self.compute_dtype or jnp.float32
+        shape = (per_stage, batch, cfg.n_head, s_max, cfg.n_embd // cfg.n_head)
+        return {"k": jnp.zeros(shape, dt), "v": jnp.zeros(shape, dt)}
+
+    def block_with_cache(self, bp, x, layer_cache, start_pos):
+        return _block_with_cache(
+            bp, x, layer_cache, start_pos, cfg=self.cfg,
+            compute_dtype=self.compute_dtype)
+
+    def embed(self, aux, ids, start_pos):
+        return _embed_at(aux, ids, start_pos, compute_dtype=self.compute_dtype)
+
+    def head(self, aux, h):
+        return head(aux, h.astype(jnp.float32), cfg=self.cfg,
+                    compute_dtype=self.compute_dtype)
+
+
 def make_pipeline_generate(cfg: GPTConfig, mesh, *, max_new_tokens: int,
                            temperature: float = 0.0, top_k: Optional[int] = None,
-                           compute_dtype=None, axis_name=None):
+                           compute_dtype=None, axis_name=None, family=None):
     """Pipeline-parallel KV-cache generation across a stage-sharded mesh.
 
     The serving capability the reference's 8-stage GPT pipeline stops short
@@ -195,68 +227,70 @@ def make_pipeline_generate(cfg: GPTConfig, mesh, *, max_new_tokens: int,
         )
     per_stage = cfg.n_layer // num_stages
     perm = [(i, (i + 1) % num_stages) for i in range(num_stages)]
+    if family is not None:
+        # same contract as ContinuousBatcher: with an explicit family the
+        # model math runs at the FAMILY's compute_dtype; a diverging
+        # top-level knob would silently lose
+        fam_dtype = getattr(family, "compute_dtype", None)
+        if compute_dtype is not None and fam_dtype != compute_dtype:
+            raise ValueError(
+                f"compute_dtype mismatch: make_pipeline_generate="
+                f"{compute_dtype} vs family adapter={fam_dtype} — set it "
+                f"on the adapter")
+    fam = family or GPTPipelineFamily(cfg, compute_dtype=compute_dtype)
 
     def per_device(stage_blocks, aux, ids, rng):
         local = jax.tree.map(lambda p: p[0], stage_blocks)  # (per_stage, ...)
         d = lax.axis_index(axis)
         b, t = ids.shape
         s_max = t + max_new_tokens
-        cache_dtype = compute_dtype or jnp.float32
-        cshape = (per_stage, b, cfg.n_head, s_max, cfg.n_embd // cfg.n_head)
-        ck = jnp.zeros(cshape, cache_dtype)
-        cv = jnp.zeros(cshape, cache_dtype)
+        cache = fam.stage_cache(per_stage, b, s_max)
 
-        def my_blocks(x, ck, cv, start_pos):
+        def my_blocks(x, cache, start_pos):
             def layer(carry, layer_in):
                 bp, layer_cache = layer_in
-                y, layer_cache = _block_with_cache(
-                    bp, carry, layer_cache, start_pos, cfg=cfg,
-                    compute_dtype=compute_dtype,
-                )
-                return y, layer_cache
+                return fam.block_with_cache(bp, carry, layer_cache, start_pos)
 
-            x, new_c = lax.scan(layer, x, (local, {"k": ck, "v": cv}))
-            return x, new_c["k"], new_c["v"]
+            return lax.scan(layer, x, (local, cache))
 
-        def ring_pass(x, ck, cv, start_pos):
+        def ring_pass(x, cache, start_pos):
             """x real on stage 0 -> through all stages in order -> real
             result back on stage 0 (wraparound hop)."""
             def sub(carry, s):
-                h, ck, cv = carry
-                h2, ck2, cv2 = my_blocks(h, ck, cv, start_pos)
+                h, cache = carry
+                h2, cache2 = my_blocks(h, cache, start_pos)
                 active = d == s
-                ck = jnp.where(active, ck2, ck)
-                cv = jnp.where(active, cv2, cv)
+                cache = jax.tree.map(
+                    lambda new, old: jnp.where(active, new, old), cache2, cache)
                 h = lax.ppermute(h2, axis, perm)
-                return (h, ck, cv), None
+                return (h, cache), None
 
-            (h, ck, cv), _ = lax.scan(sub, (x, ck, cv), jnp.arange(num_stages))
-            return h, ck, cv
+            (h, cache), _ = lax.scan(sub, (x, cache), jnp.arange(num_stages))
+            return h, cache
 
         def sample_last(h, sub_rng):
-            logits = head(aux, h[:, -1:].astype(jnp.float32), cfg=cfg,
-                          compute_dtype=compute_dtype)
+            logits = fam.head(aux, h[:, -1:])
             tok = _sample(logits[:, -1], sub_rng,
                           temperature=temperature, top_k=top_k)
             # only stage 0 holds the real hidden state; broadcast its token
             return lax.psum(jnp.where(d == 0, tok, jnp.zeros_like(tok)), axis)
 
         # prefill: full prompt, one ring circuit
-        x = _embed_at(aux, ids, 0, compute_dtype=compute_dtype)
-        h, ck, cv = ring_pass(x, ck, cv, 0)
+        x = fam.embed(aux, ids, 0)
+        h, cache = ring_pass(x, cache, 0)
         rng, sub = jax.random.split(rng)
         tok = sample_last(h, sub)
 
         def step(carry, i):
-            ck, cv, tok, rng = carry
-            x = _embed_at(aux, tok[:, None], t + i, compute_dtype=compute_dtype)
-            h, ck, cv = ring_pass(x, ck, cv, t + i)
+            cache, tok, rng = carry
+            x = fam.embed(aux, tok[:, None], t + i)
+            h, cache = ring_pass(x, cache, t + i)
             rng, sub = jax.random.split(rng)
             nxt = sample_last(h, sub)
-            return (ck, cv, nxt, rng), tok
+            return (cache, nxt, rng), tok
 
-        (_, _, last, _), toks = lax.scan(
-            step, (ck, cv, tok, rng), jnp.arange(max_new_tokens - 1)
+        (_, last, _), toks = lax.scan(
+            step, (cache, tok, rng), jnp.arange(max_new_tokens - 1)
         )
         toks = jnp.moveaxis(toks, 0, 1)  # (B, max_new_tokens-1)
         return jnp.concatenate([toks, last[:, None]], axis=1)

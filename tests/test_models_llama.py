@@ -192,3 +192,52 @@ def test_llama_batcher_int8_cache():
         prepared, jnp.asarray(prompt, jnp.int32)[None, :],
         jax.random.PRNGKey(0)))[0]
     np.testing.assert_array_equal(got, want)
+
+
+def test_llama_pipeline_training_loss_matches_single_program(devices):
+    """The LLaMA family trains through the pipeline schedules like its GPT
+    sibling: GPipe loss == the single-program next-token loss on the same
+    batch (embed/blocks/head plug into make_pipeline_train_step)."""
+    import optax
+
+    from dnn_tpu import train
+    from dnn_tpu.models import gpt
+    from dnn_tpu.parallel.mesh import STAGE_AXIS, make_mesh
+
+    params = _params(seed=13)
+    n_stages, per = 2, CFG.n_layer // 2
+    mesh = make_mesh({STAGE_AXIS: n_stages}, devices[:n_stages])
+    stacks = [gpt.stack_blocks(params, range(s * per, (s + 1) * per))
+              for s in range(n_stages)]
+    stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *stacks)
+    aux = {k: v for k, v in params.items() if not k.startswith("h_")}
+    tokens = jax.random.randint(jax.random.PRNGKey(14), (4, 17), 0,
+                                CFG.vocab_size, dtype=jnp.int32)
+    opt = optax.sgd(1e-3)
+    step = train.make_pipeline_train_step(
+        lambda bp, h: llama.blocks_scan(bp, h, cfg=CFG, compute_dtype=None),
+        lambda a, ids: llama.embed(a, ids, cfg=CFG),
+        lambda a, h: llama.head(a, h.astype(jnp.float32), cfg=CFG),
+        opt, mesh, num_microbatches=2,
+    )
+    _, _, _, loss = step(stacked, aux, (opt.init(stacked), opt.init(aux)),
+                         tokens)
+    want = train.next_token_loss(llama.make_apply(CFG), params, tokens)
+    assert float(loss) == pytest.approx(float(want), rel=1e-4)
+
+
+def test_llama_pipeline_generate_matches_solo(devices):
+    from dnn_tpu.models import gpt
+    from dnn_tpu.parallel.mesh import STAGE_AXIS, make_mesh
+    from dnn_tpu.runtime.generate import prepare_pipeline_stacked
+
+    params = _params(seed=15)
+    prepared = gpt.prepare_stacked(params, CFG)
+    mesh = make_mesh({STAGE_AXIS: 2}, devices[:2])
+    stage_blocks, aux = prepare_pipeline_stacked(prepared, CFG, mesh)
+    ids = jax.random.randint(jax.random.PRNGKey(16), (2, 6), 0, CFG.vocab_size)
+    gen = llama.make_pipeline_generate(CFG, mesh, max_new_tokens=5)
+    got = np.asarray(gen(stage_blocks, aux, ids, jax.random.PRNGKey(0)))
+    want = np.asarray(llama.make_generate(CFG, max_new_tokens=5)(
+        prepared, ids, jax.random.PRNGKey(0)))
+    np.testing.assert_array_equal(got, want)
