@@ -174,13 +174,11 @@ def _gqa_scores_attend(q, k, v, mask_fn):
     return y.reshape(b, h, t, d)
 
 
-def block_apply(bp, x, *, cfg: LlamaConfig, compute_dtype=None):
-    """Pre-RMSNorm block: GQA causal attention + SwiGLU MLP, both residual."""
-    b, t, c = x.shape
-    h = rms_norm(bp["ln_1"], x, eps=cfg.rms_eps)
+def _dense_attn(bp, h, *, cfg: LlamaConfig, compute_dtype):
+    """Default attention: local causal GQA over the whole (B, T, C) h."""
+    t = h.shape[1]
     q, k, v = _qkv_rope(bp, h, jnp.arange(t), cfg=cfg,
                         compute_dtype=compute_dtype)
-
     rows = jnp.arange(t)
 
     def causal(s):
@@ -188,8 +186,18 @@ def block_apply(bp, x, *, cfg: LlamaConfig, compute_dtype=None):
                          rows[None, None, None, None, :], s, _NEG_BIG)
 
     y = _gqa_scores_attend(q, k, v, causal)
-    x = x + linear(bp["attn"]["o"], merge_heads(y.astype(x.dtype)),
-                   compute_dtype=compute_dtype)
+    return linear(bp["attn"]["o"], merge_heads(y.astype(h.dtype)),
+                  compute_dtype=compute_dtype)
+
+
+def block_apply(bp, x, *, cfg: LlamaConfig, compute_dtype=None, attn_fn=None):
+    """Pre-RMSNorm block: GQA attention + SwiGLU MLP, both residual.
+    `attn_fn(bp, h)` overrides the attention (the sequence-parallel ring
+    plugs in here — same hook pattern as gpt._block_core)."""
+    fn = attn_fn or (lambda bp2, h: _dense_attn(
+        bp2, h, cfg=cfg, compute_dtype=compute_dtype))
+    h = rms_norm(bp["ln_1"], x, eps=cfg.rms_eps)
+    x = x + fn(bp, h)
     return _mlp_residual(bp, x, cfg=cfg, compute_dtype=compute_dtype)
 
 
@@ -211,9 +219,10 @@ def head(params, x, *, cfg: LlamaConfig, compute_dtype=None, logits_dtype=None):
     return out if logits_dtype is None else out.astype(logits_dtype)
 
 
-def blocks_scan(stacked, x, *, cfg, compute_dtype, remat=False):
+def blocks_scan(stacked, x, *, cfg, compute_dtype, remat=False, attn_fn=None):
     block = (lambda bp, carry: block_apply(bp, carry, cfg=cfg,
-                                           compute_dtype=compute_dtype))
+                                           compute_dtype=compute_dtype,
+                                           attn_fn=attn_fn))
     if remat:
         block = jax.checkpoint(block)
 
@@ -354,6 +363,71 @@ def make_generate(cfg: LlamaConfig, *, max_new_tokens: int,
         return jnp.concatenate([toks, last[:, None]], axis=1)
 
     return generate
+
+
+def make_apply_seq_parallel(cfg: LlamaConfig, mesh, *, axis_name=None,
+                            compute_dtype=None):
+    """Sequence-parallel (long-context) LLaMA forward over the "seq" mesh
+    axis — ring attention with GQA-narrow K/V blocks.
+
+    Embed/RMSNorm/SwiGLU/head act position-wise on local shards; RoPE uses
+    each shard's GLOBAL positions; attention crosses shards by rotating
+    K/V blocks around the ring at KV-HEAD width (H/KV times fewer ICI
+    bytes per hop than an MHA ring — GQA's bandwidth advantage applies to
+    the collective exactly as it does to the decode cache), with the
+    query group folded into rows (parallel/ring_attention.py's GQA mode).
+
+    apply(prepared, ids): ids (B, T), T divisible by the axis size;
+    returns f32 logits sharded over the sequence axis. Parity vs the
+    dense forward is pinned in tests/test_models_llama.py."""
+    from jax.sharding import PartitionSpec as P
+
+    from dnn_tpu.parallel.mesh import SEQ_AXIS
+    from dnn_tpu.parallel.ring_attention import ring_attention_local
+
+    axis = axis_name or SEQ_AXIS
+
+    def local_fn(prepared, ids_local):
+        b, t_local = ids_local.shape
+        my = lax.axis_index(axis)
+        pos = my * t_local + jnp.arange(t_local)  # global positions
+        x = embedding(prepared["wte"], ids_local)
+        if compute_dtype is not None:
+            x = x.astype(compute_dtype)
+        kv, g, d = cfg.n_kv_head, cfg.n_head // cfg.n_kv_head, cfg.head_dim
+
+        def ring_attn(bp, h):
+            q, k, v = _qkv_rope(bp, h, pos, cfg=cfg,
+                                compute_dtype=compute_dtype)
+            qg = q.reshape(b, kv, g * t_local, d)  # fold group into rows
+            y = ring_attention_local(qg, k, v, axis_name=axis, causal=True)
+            y = y.reshape(b, cfg.n_head, t_local, d)
+            return linear(bp["attn"]["o"], merge_heads(y.astype(h.dtype)),
+                          compute_dtype=compute_dtype)
+
+        x = blocks_scan(prepared["blocks"], x, cfg=cfg,
+                        compute_dtype=compute_dtype, attn_fn=ring_attn)
+        return head(prepared, x.astype(jnp.float32), cfg=cfg,
+                    compute_dtype=compute_dtype)
+
+    def apply(prepared, ids):
+        t = ids.shape[-1]
+        if t > cfg.block_size:
+            raise ValueError(
+                f"Cannot forward: sequence length {t} > block_size "
+                f"{cfg.block_size}")
+        n = mesh.shape[axis]
+        if t % n != 0:
+            raise ValueError(
+                f"sequence length {t} not divisible by seq axis size {n}")
+        return jax.shard_map(
+            local_fn, mesh=mesh,
+            in_specs=(P(), P(None, axis)),
+            out_specs=P(None, axis, None),
+            check_vma=False,
+        )(prepared, ids)
+
+    return apply
 
 
 class LlamaFamilyRows:

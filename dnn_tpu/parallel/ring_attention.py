@@ -50,14 +50,25 @@ def _block_attend(q, k, v, m, l, acc, mask):
 
 def ring_attention_local(q, k, v, *, axis_name: str = SEQ_AXIS, causal: bool = True):
     """Per-device body (call inside shard_map). q/k/v are the local sequence
-    shards, (B, H, T_local, D); returns the local output shard."""
+    shards, (B, H, T_local, D); returns the local output shard.
+
+    GQA-aware: q's row dim may be G * T_local with k/v at T_local and KV
+    heads (the group folded into rows — see llama._gqa_scores_attend);
+    each group of rows then shares its position's causal mask, i.e. the
+    triangular mask tiles G times down the rows. K/V rotate the ring at
+    KV-head width — the narrow blocks are GQA's ICI-bandwidth win here,
+    exactly as the narrow cache is its HBM win at decode."""
     n = lax.axis_size(axis_name)
     my = lax.axis_index(axis_name)
-    t_local = q.shape[2]
+    t_kv = k.shape[2]
+    g = q.shape[2] // t_kv  # 1 for MHA; the folded group count for GQA
+    if q.shape[2] != g * t_kv:
+        raise ValueError(
+            f"q rows {q.shape[2]} must be a multiple of K/V rows {t_kv}")
     qf = q.astype(jnp.float32)
 
-    tri = jnp.tril(jnp.ones((t_local, t_local), dtype=bool))
-    full = jnp.ones((t_local, t_local), dtype=bool)
+    tri = jnp.tile(jnp.tril(jnp.ones((t_kv, t_kv), dtype=bool)), (g, 1))
+    full = jnp.ones((g * t_kv, t_kv), dtype=bool)
 
     def _mask_for(i):
         # this K/V block originated at shard (my - i) mod n
@@ -79,12 +90,12 @@ def ring_attention_local(q, k, v, *, axis_name: str = SEQ_AXIS, causal: bool = T
         v_nxt = lax.ppermute(v_cur, axis_name, [(j, (j + 1) % n) for j in range(n)])
         return (k_nxt, v_nxt, m, l, acc), None
 
-    b, h, _, d = q.shape
+    b, h, t_q, d = q.shape
     init = (
         k, v,
-        jnp.full((b, h, t_local, 1), _NEG_BIG, jnp.float32),
-        jnp.zeros((b, h, t_local, 1), jnp.float32),
-        jnp.zeros((b, h, t_local, d), jnp.float32),
+        jnp.full((b, h, t_q, 1), _NEG_BIG, jnp.float32),
+        jnp.zeros((b, h, t_q, 1), jnp.float32),
+        jnp.zeros((b, h, t_q, d), jnp.float32),
     )
     # scan the first n-1 blocks (each followed by a rotation), then attend
     # the final block outside the loop — its rotation would be dead weight

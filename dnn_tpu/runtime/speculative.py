@@ -56,6 +56,26 @@ from dnn_tpu.runtime.generate import _NEG_BIG, forward_with_cache, init_cache
 __all__ = ["make_speculative_generate"]
 
 
+def _cached_lm(cfg, compute_dtype):
+    """(init_cache_fn(batch, max_len), forward_fn(prepared, ids, cache,
+    pos)) for whichever family `cfg` belongs to. Target and draft dispatch
+    independently, so a LLaMA target can verify a GPT draft (and vice
+    versa) — the construction only needs matching vocabularies."""
+    from dnn_tpu.models.llama import LlamaConfig
+
+    if isinstance(cfg, LlamaConfig):
+        from dnn_tpu.models import llama
+
+        return (lambda b, n: llama.init_cache(cfg, b, n),
+                lambda prepared, ids, cache, pos: llama.forward_with_cache(
+                    prepared, ids, cache, pos, cfg=cfg,
+                    compute_dtype=compute_dtype))
+    return (lambda b, n: init_cache(cfg, b, n),
+            lambda prepared, ids, cache, pos: forward_with_cache(
+                prepared, ids, cache, pos, cfg=cfg,
+                compute_dtype=compute_dtype))
+
+
 def _probs(logits, *, temperature: float, top_k: Optional[int]):
     """Rows of logits (..., V) -> the ACTUAL sampling distribution
     (temperature + top-k filtered), f32. Both draft proposal probs and
@@ -108,16 +128,14 @@ def make_speculative_generate(
                     f"{cfg.block_size}"
                 )
 
-        t_cache = init_cache(target_cfg, 1, need)
-        d_cache = init_cache(draft_cfg, 1, need)
+        t_init, t_fwd = _cached_lm(target_cfg, compute_dtype)
+        d_init, d_fwd = _cached_lm(draft_cfg, compute_dtype)
+        t_cache = t_init(1, need)
+        d_cache = d_init(1, need)
         # prefill both caches on everything but the last prompt token (it
         # is the first decode input, same as make_generate)
-        _, t_cache = forward_with_cache(
-            target_prepared, ids[:, :-1], t_cache, 0, cfg=target_cfg,
-            compute_dtype=compute_dtype)
-        _, d_cache = forward_with_cache(
-            draft_prepared, ids[:, :-1], d_cache, 0, cfg=draft_cfg,
-            compute_dtype=compute_dtype)
+        _, t_cache = t_fwd(target_prepared, ids[:, :-1], t_cache, 0)
+        _, d_cache = d_fwd(draft_prepared, ids[:, :-1], d_cache, 0)
 
         buf = jnp.zeros((1, max_new_tokens + k + 1), jnp.int32)
         state = {
@@ -140,9 +158,8 @@ def make_speculative_generate(
 
             def step(carry, i):
                 cache, tok, r = carry
-                logits, cache = forward_with_cache(
-                    draft_prepared, tok[:, None], cache, pos + i,
-                    cfg=draft_cfg, compute_dtype=compute_dtype)
+                logits, cache = d_fwd(draft_prepared, tok[:, None], cache,
+                                      pos + i)
                 row = logits[0, -1]
                 if greedy:
                     nxt = jnp.argmax(row).astype(jnp.int32)[None]
@@ -161,17 +178,15 @@ def make_speculative_generate(
         def body(s):
             pos = s["pos"]
             # 1. draft sync: idempotent re-feed of last verify chunk
-            _, d_cache = forward_with_cache(
-                draft_prepared, s["prev_chunk"][None, :], s["d_cache"],
-                s["prev_pos"], cfg=draft_cfg, compute_dtype=compute_dtype)
+            _, d_cache = d_fwd(draft_prepared, s["prev_chunk"][None, :],
+                               s["d_cache"], s["prev_pos"])
             # 2. draft proposes k tokens
             d_cache, props, d_rows, rng = propose(
                 d_cache, s["last"], s["rng"], pos)
             # 3. target scores [last, p1..pk] in one forward
             chunk = jnp.concatenate([s["last"], props])[None, :]  # (1, k+1)
-            t_logits, t_cache = forward_with_cache(
-                target_prepared, chunk, s["t_cache"], pos,
-                cfg=target_cfg, compute_dtype=compute_dtype)
+            t_logits, t_cache = t_fwd(target_prepared, chunk, s["t_cache"],
+                                      pos)
             rows = t_logits[0]  # (k+1, V); row i predicts position pos+i+1
 
             if greedy:

@@ -84,13 +84,17 @@ def make_train_step(loss_fn: Callable, optimizer: optax.GradientTransformation):
 
 def _tp_base_spec(keys, nd, axis):
     """The Megatron-style key->sharding table shared by the per-layer and
-    stacked layouts. `nd` is the leaf rank WITHOUT any leading layer axis."""
+    stacked layouts, covering both the GPT family's keys (qkv/fc/proj) and
+    the LLaMA family's (q/k/v/gate/up shard their output features — whole
+    heads / hidden slices per device; o/down shard input features, so
+    GSPMD inserts one all-reduce per residual write). `nd` is the leaf
+    rank WITHOUT any leading layer axis."""
     if nd < 2:
         return P()  # biases / norm params replicate
-    if "qkv" in keys or "fc" in keys:
-        return P(None, axis)        # (C, 3C) / (C, 4C): shard out dim
-    if "proj" in keys:
-        return P(axis, None)        # (C, C) / (4C, C): shard in dim
+    if {"qkv", "fc", "q", "k", "v", "gate", "up"} & set(keys):
+        return P(None, axis)        # (C, out): shard out dim
+    if {"proj", "o", "down"} & set(keys):
+        return P(axis, None)        # (out, C): shard in dim
     if "wte" in keys:
         return P(axis, None)        # (V, C): vocab-parallel embedding
     if "lm_head" in keys:
