@@ -160,9 +160,10 @@ class GPTPipelineFamily:
     adapter is models/llama.LlamaPipelineFamily (RoPE positions,
     KV-head-width cache shards)."""
 
-    def __init__(self, cfg, *, compute_dtype=None):
+    def __init__(self, cfg, *, compute_dtype=None, ffn=None):
         self.cfg = cfg
         self.compute_dtype = compute_dtype
+        self.ffn = ffn  # block-MLP override (MoE: generate_moe.moe_cache_ffn)
 
     def stage_cache(self, per_stage: int, batch: int, s_max: int):
         cfg = self.cfg
@@ -173,7 +174,7 @@ class GPTPipelineFamily:
     def block_with_cache(self, bp, x, layer_cache, start_pos):
         return _block_with_cache(
             bp, x, layer_cache, start_pos, cfg=self.cfg,
-            compute_dtype=self.compute_dtype)
+            compute_dtype=self.compute_dtype, ffn=self.ffn)
 
     def embed(self, aux, ids, start_pos):
         return _embed_at(aux, ids, start_pos, compute_dtype=self.compute_dtype)

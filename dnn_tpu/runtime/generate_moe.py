@@ -56,6 +56,7 @@ __all__ = [
     "forward_with_cache_moe",
     "make_generate_moe",
     "make_generate_moe_ep",
+    "make_pipeline_generate_moe",
 ]
 
 
@@ -98,6 +99,32 @@ def make_generate_moe(cfg: GPTMoEConfig, *, max_new_tokens: int,
         top_k=sample_top_k, compute_dtype=compute_dtype,
         ffn=moe_cache_ffn(cfg, groups=groups, compute_dtype=compute_dtype),
     )
+
+
+def make_pipeline_generate_moe(cfg: GPTMoEConfig, mesh, *,
+                               max_new_tokens: int,
+                               temperature: float = 0.0,
+                               sample_top_k: Optional[int] = None,
+                               compute_dtype=None, groups: int = 1,
+                               axis_name=None):
+    """Pipeline-parallel MoE decode over the STAGE axis: each stage holds
+    its block stack (attention + its layers' full expert sets) and its
+    cache shard; the hidden state rides the ppermute ring per token with
+    the routed FFN plugged into the cached block. Experts are NOT sharded
+    here — this is PP x dense-MoE (per-stage expert replication); the
+    EP x PP 2D composition (experts sharded within each stage) is not
+    built. Token-parity vs make_generate_moe on the same grouping."""
+    from dnn_tpu.runtime.generate import (
+        GPTPipelineFamily,
+        make_pipeline_generate,
+    )
+
+    fam = GPTPipelineFamily(
+        cfg, compute_dtype=compute_dtype,
+        ffn=moe_cache_ffn(cfg, groups=groups, compute_dtype=compute_dtype))
+    return make_pipeline_generate(
+        cfg, mesh, max_new_tokens=max_new_tokens, temperature=temperature,
+        top_k=sample_top_k, axis_name=axis_name, family=fam)
 
 
 def make_generate_moe_ep(cfg: GPTMoEConfig, mesh, *, max_new_tokens: int,

@@ -61,6 +61,7 @@ def _cached_lm(cfg, compute_dtype):
     pos)) for whichever family `cfg` belongs to. Target and draft dispatch
     independently, so a LLaMA target can verify a GPT draft (and vice
     versa) — the construction only needs matching vocabularies."""
+    from dnn_tpu.models.gpt_moe import GPTMoEConfig
     from dnn_tpu.models.llama import LlamaConfig
 
     if isinstance(cfg, LlamaConfig):
@@ -70,10 +71,18 @@ def _cached_lm(cfg, compute_dtype):
                 lambda prepared, ids, cache, pos: llama.forward_with_cache(
                     prepared, ids, cache, pos, cfg=cfg,
                     compute_dtype=compute_dtype))
+    ffn = None
+    if isinstance(cfg, GPTMoEConfig):
+        # MoE subclasses GPTConfig, so it MUST be caught before the dense
+        # fallback (whose blocks index 'mlp', not 'moe'); its cached
+        # forward is the dense block with the routed FFN plugged in
+        from dnn_tpu.runtime.generate_moe import moe_cache_ffn
+
+        ffn = moe_cache_ffn(cfg, compute_dtype=compute_dtype)
     return (lambda b, n: init_cache(cfg, b, n),
-            lambda prepared, ids, cache, pos: forward_with_cache(
+            lambda prepared, ids, cache, pos, _ffn=ffn: forward_with_cache(
                 prepared, ids, cache, pos, cfg=cfg,
-                compute_dtype=compute_dtype))
+                compute_dtype=compute_dtype, ffn=_ffn))
 
 
 def _probs(logits, *, temperature: float, top_k: Optional[int]):

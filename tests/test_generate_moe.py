@@ -106,3 +106,43 @@ def test_moe_batcher_matches_solo_decode():
             gen(prepared, jnp.asarray(p, jnp.int32)[None, :],
                 jax.random.PRNGKey(0)))[0]
         np.testing.assert_array_equal(results[rid], want)
+
+
+def test_moe_pipeline_decode_matches_dense(devices):
+    """PP x dense-MoE: stage-sharded blocks (each stage carrying its
+    layers' full expert sets), routed FFN inside the cached ring block."""
+    from dnn_tpu.parallel.mesh import STAGE_AXIS, make_mesh
+    from dnn_tpu.runtime.generate import prepare_pipeline_stacked
+    from dnn_tpu.runtime.generate_moe import make_pipeline_generate_moe
+
+    _, prepared = _prepared(CFG_HI, seed=21)
+    mesh = make_mesh({STAGE_AXIS: 2}, devices[:2])
+    stage_blocks, aux = prepare_pipeline_stacked(prepared, CFG_HI, mesh)
+    ids = jax.random.randint(jax.random.PRNGKey(22), (2, 6), 0,
+                             CFG_HI.vocab_size)
+    gen = make_pipeline_generate_moe(CFG_HI, mesh, max_new_tokens=5)
+    got = np.asarray(gen(stage_blocks, aux, ids, jax.random.PRNGKey(0)))
+    want = np.asarray(make_generate_moe(CFG_HI, max_new_tokens=5)(
+        prepared, ids, jax.random.PRNGKey(0)))
+    np.testing.assert_array_equal(got, want)
+
+
+def test_moe_speculative_greedy_parity():
+    """Speculative decoding with an MoE TARGET (generous capacity so
+    routing is chunk-size independent): greedy output == target-only
+    decode, with a dense GPT-2 draft proposing (same vocab)."""
+    from dnn_tpu.runtime.speculative import make_speculative_generate
+
+    _, prepared = _prepared(CFG_HI, seed=23)
+    ids = jax.random.randint(jax.random.PRNGKey(24), (1, 8), 0,
+                             CFG_HI.vocab_size)
+    n = 8
+    want = np.asarray(make_generate_moe(CFG_HI, max_new_tokens=n)(
+        prepared, ids, jax.random.PRNGKey(0)))
+
+    g_cfg = gpt.PRESETS["gpt2-test"]  # vocab 256 matches
+    g_prep = gpt.prepare_stacked(gpt.init(jax.random.PRNGKey(25), g_cfg),
+                                 g_cfg)
+    spec = make_speculative_generate(CFG_HI, g_cfg, max_new_tokens=n, k=3)
+    got = np.asarray(spec(prepared, g_prep, ids, jax.random.PRNGKey(0)))
+    np.testing.assert_array_equal(got, want)
