@@ -112,6 +112,30 @@ def run_device_section():
               logits="bf16",
               **_with_mfu({}, gpt_forward_flops(cfg, b, s) / (b * s), tps))
 
+    # LLaMA family forward (TinyLlama-1.1B shape, GQA 8:1) — the second
+    # LM architecture; MFU from its own analytic accounting. TPU-only: a
+    # 1.1B bf16 forward on a CPU host would blow the section's budget.
+    if platform == "tpu":
+        from dnn_tpu.models import llama
+        from dnn_tpu.utils.flops import llama_forward_flops
+
+        ll_cfg = llama.PRESETS["tinyllama-1.1b"]
+        ll_prep = gpt.prepare_stacked(
+            llama.init(jax.random.PRNGKey(0), ll_cfg, dtype=jnp.bfloat16),
+            ll_cfg)
+        ll_fn = jax.jit(llama.make_apply_stacked(
+            ll_cfg, compute_dtype=jnp.bfloat16, logits_dtype=jnp.bfloat16))
+        b, s = 8, 512
+        ll_ids = jax.random.randint(jax.random.PRNGKey(1), (b, s), 0,
+                                    ll_cfg.vocab_size, dtype=jnp.int32)
+        dt = device_time(ll_fn, ll_prep, ll_ids, n1=1, n2=3)
+        tps = b * s / dt
+        _emit(results, config="tinyllama_fwd", metric="tokens_per_sec",
+              value=round(tps, 1), platform=platform, batch=b, seq=s,
+              logits="bf16",
+              **_with_mfu({}, llama_forward_flops(ll_cfg, b, s) / (b * s), tps))
+        del ll_prep  # 2.2 GB of bf16 weights — free before the decode rows
+
     # KV-cache generation throughput (the serving path the reference lacks)
     from dnn_tpu.runtime import generate as gen
 

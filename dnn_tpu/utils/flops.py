@@ -63,6 +63,22 @@ def gpt_forward_flops(cfg, batch: int, seq: int) -> float:
     return float(batch) * per_seq
 
 
+def llama_forward_flops(cfg, batch: int, seq: int) -> float:
+    """Analytic forward FLOPs for one LLaMA batch
+    (dnn_tpu/models/llama.py): per layer q 2TC^2 + k/v 2*2TC*(KV*D) +
+    o 2TC^2 + SwiGLU 6TCF, plus the full-T^2 attention charge 4T^2C
+    (GQA narrows the K/V PROJECTIONS and cache, not the score/value
+    einsum FLOPs — every query head still attends), plus the 2TCV head."""
+    c, l, v, f = cfg.n_embd, cfg.n_layer, cfg.vocab_size, cfg.d_ff
+    kv_width = cfg.n_kv_head * cfg.head_dim
+    per_seq = l * (2 * seq * c * c            # q proj
+                   + 2 * 2 * seq * c * kv_width  # k + v projs
+                   + 2 * seq * c * c          # o proj
+                   + 6 * seq * c * f          # gate + up + down
+                   + 4 * seq * seq * c)       # attention score/value
+    return float(batch) * (per_seq + 2 * seq * c * v)
+
+
 def gpt_train_step_flops(cfg, batch: int, seq: int) -> float:
     """Training step ~= 3x forward (fwd + backward's two matmuls per fwd
     matmul); remat adds another forward where enabled — not counted here."""

@@ -73,3 +73,19 @@ def test_hbm_and_roofline_accounting():
     assert device_peak_hbm_bw() is None
     assert mbu(1e6, 1e6) is None
     assert roofline_items_per_sec(1e6, 1e5) is None
+
+
+def test_llama_flops_accounting():
+    from dnn_tpu.models import llama
+    from dnn_tpu.utils.flops import llama_forward_flops
+
+    cfg = llama.PRESETS["tinyllama-1.1b"]
+    # per-token cost ~ 2 * N_params + attention: TinyLlama has ~1.1B
+    # params, so the linear part sits near 2.2 GFLOPs/token
+    per_tok = llama_forward_flops(cfg, 1, 512) / 512
+    assert 2.0e9 < per_tok < 3.5e9, per_tok
+    # GQA narrows only the k/v projections: an MHA twin costs more
+    import dataclasses
+
+    mha = dataclasses.replace(cfg, n_kv_head=cfg.n_head)
+    assert llama_forward_flops(mha, 1, 512) > llama_forward_flops(cfg, 1, 512)
