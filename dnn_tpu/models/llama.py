@@ -495,23 +495,24 @@ class LlamaPipelineFamily:
     runtime/generate.GPTPipelineFamily): stage-local cache shards at
     KV-head width, RoPE at the ring's absolute positions."""
 
-    def __init__(self, cfg: LlamaConfig, *, compute_dtype=None):
+    def __init__(self, cfg: LlamaConfig, *, compute_dtype=None, kv_dtype=None):
         self.cfg = cfg
         self.compute_dtype = compute_dtype
+        self.kv_dtype = kv_dtype  # None follows compute_dtype; "int8" quantizes
 
     def stage_cache(self, per_stage, batch, s_max):
-        cfg = self.cfg
-        dt = self.compute_dtype or jnp.float32
-        shape = (per_stage, batch, cfg.n_kv_head, s_max, cfg.head_dim)
-        return {"k": jnp.zeros(shape, dt), "v": jnp.zeros(shape, dt)}
+        dt = self.kv_dtype if self.kv_dtype is not None else (
+            self.compute_dtype or jnp.float32)
+        stage_cfg = dataclasses.replace(self.cfg, n_layer=per_stage)
+        return init_cache(stage_cfg, batch, s_max, dt)
 
     def block_with_cache(self, bp, x, layer_cache, start_pos):
-        from dnn_tpu.runtime.kvcache import FloatKV
+        from dnn_tpu.runtime.kvcache import codec_for_cache
 
         return _block_with_cache(
             bp, x, layer_cache, start_pos, cfg=self.cfg,
             compute_dtype=self.compute_dtype,
-            codec=FloatKV(layer_cache["k"].dtype))
+            codec=codec_for_cache(layer_cache))
 
     def embed(self, aux, ids, start_pos):
         x = embedding(aux["wte"], ids)
@@ -527,7 +528,8 @@ class LlamaPipelineFamily:
 def make_pipeline_generate(cfg: LlamaConfig, mesh, *, max_new_tokens: int,
                            temperature: float = 0.0,
                            top_k: Optional[int] = None,
-                           compute_dtype=None, axis_name=None):
+                           compute_dtype=None, axis_name=None,
+                           kv_dtype=None):
     """Pipeline-parallel KV-cache generation for the LLaMA family: each
     stage keeps its blocks AND its KV-head-width cache shard, the hidden
     state rides the ppermute ring per token (runtime/generate's ring
@@ -540,7 +542,8 @@ def make_pipeline_generate(cfg: LlamaConfig, mesh, *, max_new_tokens: int,
     return _mk(cfg, mesh, max_new_tokens=max_new_tokens,
                temperature=temperature, top_k=top_k,
                compute_dtype=compute_dtype, axis_name=axis_name,
-               family=LlamaPipelineFamily(cfg, compute_dtype=compute_dtype))
+               family=LlamaPipelineFamily(cfg, compute_dtype=compute_dtype,
+                                          kv_dtype=kv_dtype))
 
 
 # --------------------------------------------------------------------------

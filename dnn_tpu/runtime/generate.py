@@ -160,16 +160,22 @@ class GPTPipelineFamily:
     adapter is models/llama.LlamaPipelineFamily (RoPE positions,
     KV-head-width cache shards)."""
 
-    def __init__(self, cfg, *, compute_dtype=None, ffn=None):
+    def __init__(self, cfg, *, compute_dtype=None, ffn=None, kv_dtype=None):
         self.cfg = cfg
         self.compute_dtype = compute_dtype
         self.ffn = ffn  # block-MLP override (MoE: generate_moe.moe_cache_ffn)
+        self.kv_dtype = kv_dtype  # None follows compute_dtype; "int8" quantizes
 
     def stage_cache(self, per_stage: int, batch: int, s_max: int):
+        import dataclasses
+
         cfg = self.cfg
-        dt = self.compute_dtype or jnp.float32
-        shape = (per_stage, batch, cfg.n_head, s_max, cfg.n_embd // cfg.n_head)
-        return {"k": jnp.zeros(shape, dt), "v": jnp.zeros(shape, dt)}
+        dt = self.kv_dtype if self.kv_dtype is not None else (
+            self.compute_dtype or jnp.float32)
+        # a per-stage cache is just a cache whose "layer count" is the
+        # stage's slice — reuse init_cache (and its codec dispatch)
+        stage_cfg = dataclasses.replace(cfg, n_layer=per_stage)
+        return init_cache(stage_cfg, batch, s_max, dt)
 
     def block_with_cache(self, bp, x, layer_cache, start_pos):
         return _block_with_cache(
@@ -186,7 +192,8 @@ class GPTPipelineFamily:
 
 def make_pipeline_generate(cfg: GPTConfig, mesh, *, max_new_tokens: int,
                            temperature: float = 0.0, top_k: Optional[int] = None,
-                           compute_dtype=None, axis_name=None, family=None):
+                           compute_dtype=None, axis_name=None, family=None,
+                           kv_dtype=None):
     """Pipeline-parallel KV-cache generation across a stage-sharded mesh.
 
     The serving capability the reference's 8-stage GPT pipeline stops short
@@ -238,7 +245,11 @@ def make_pipeline_generate(cfg: GPTConfig, mesh, *, max_new_tokens: int,
                 f"compute_dtype mismatch: make_pipeline_generate="
                 f"{compute_dtype} vs family adapter={fam_dtype} — set it "
                 f"on the adapter")
-    fam = family or GPTPipelineFamily(cfg, compute_dtype=compute_dtype)
+        if kv_dtype is not None:
+            raise ValueError("pass kv_dtype on the family adapter, not "
+                             "alongside family=")
+    fam = family or GPTPipelineFamily(cfg, compute_dtype=compute_dtype,
+                                      kv_dtype=kv_dtype)
 
     def per_device(stage_blocks, aux, ids, rng):
         local = jax.tree.map(lambda p: p[0], stage_blocks)  # (per_stage, ...)

@@ -106,7 +106,7 @@ def make_pipeline_generate_moe(cfg: GPTMoEConfig, mesh, *,
                                temperature: float = 0.0,
                                sample_top_k: Optional[int] = None,
                                compute_dtype=None, groups: int = 1,
-                               axis_name=None):
+                               axis_name=None, kv_dtype=None):
     """Pipeline-parallel MoE decode over the STAGE axis: each stage holds
     its block stack (attention + its layers' full expert sets) and its
     cache shard; the hidden state rides the ppermute ring per token with
@@ -120,7 +120,7 @@ def make_pipeline_generate_moe(cfg: GPTMoEConfig, mesh, *,
     )
 
     fam = GPTPipelineFamily(
-        cfg, compute_dtype=compute_dtype,
+        cfg, compute_dtype=compute_dtype, kv_dtype=kv_dtype,
         ffn=moe_cache_ffn(cfg, groups=groups, compute_dtype=compute_dtype))
     return make_pipeline_generate(
         cfg, mesh, max_new_tokens=max_new_tokens, temperature=temperature,

@@ -76,6 +76,7 @@ def main() -> int:
         jax.config.update("jax_platforms", "cpu")
 
     import jax
+    import jax.numpy as jnp
     import numpy as np
 
     from dnn_tpu.comm.client import NodeClient
@@ -89,11 +90,37 @@ def main() -> int:
         ckpt = os.path.join(tempfile.mkdtemp(prefix="llama_hf_"), "model.pth")
         make_tiny_checkpoint(ckpt, cfg)
 
-    # 2. torch-free conversion
+    # 2. torch-free conversion, with logit parity vs torch when available
     params = llama_params_from_state_dict(load_checkpoint(ckpt))
     prepared = gpt.prepare_stacked(params, cfg)
     print(f"[2] converted {ckpt} -> {cfg.n_layer}-layer LLaMA "
           f"(H={cfg.n_head}, KV={cfg.n_kv_head})")
+    try:
+        import torch
+        import transformers
+    except ImportError:
+        print("[2] torch/transformers unavailable; skipping parity check")
+    else:
+        hf_cfg = transformers.LlamaConfig(
+            vocab_size=cfg.vocab_size, hidden_size=cfg.n_embd,
+            intermediate_size=cfg.d_ff, num_hidden_layers=cfg.n_layer,
+            num_attention_heads=cfg.n_head,
+            num_key_value_heads=cfg.n_kv_head,
+            max_position_embeddings=cfg.block_size,
+            rope_theta=cfg.rope_theta, rms_norm_eps=cfg.rms_eps,
+            attention_bias=False, mlp_bias=False,
+            tie_word_embeddings=False, attn_implementation="eager")
+        hf = transformers.LlamaForCausalLM(hf_cfg).eval()
+        hf.load_state_dict(torch.load(ckpt, map_location="cpu"))
+        probe = np.arange(1, 9, dtype=np.int64)[None] % cfg.vocab_size
+        with torch.no_grad():
+            want = hf(torch.from_numpy(probe)).logits.numpy()
+        got = np.asarray(llama.make_apply(cfg)(
+            params, jnp.asarray(probe, jnp.int32)))
+        np.testing.assert_allclose(got, want, atol=2e-3, rtol=2e-3)
+        assert (got.argmax(-1) == want.argmax(-1)).all()
+        print("[2] conversion logit-parity vs torch OK "
+              f"(max diff {np.abs(got - want).max():.2e})")
 
     # 3. daemon with the LLaMA family adapter
     _t, stop = start_lm_server_in_background(

@@ -1,0 +1,112 @@
+"""LM daemon failure-path tests: a wedged/dying device worker must fail
+requests fast with UNAVAILABLE — never leave clients hanging for the full
+request timeout (the resilience contract added after round-2 review)."""
+
+import time
+
+import grpc
+import jax
+import numpy as np
+import pytest
+
+from dnn_tpu.comm.client import NodeClient
+from dnn_tpu.models import gpt
+from dnn_tpu.runtime.lm_server import _BatcherWorker
+from dnn_tpu.runtime.serving import ContinuousBatcher
+
+CFG = gpt.PRESETS["gpt2-test"]
+
+
+def _prepared(seed=0):
+    return gpt.prepare_stacked(gpt.init(jax.random.PRNGKey(seed), CFG), CFG)
+
+
+def test_worker_death_fails_pending_futures_fast():
+    """A device-side error in step() resolves every pending future with a
+    RuntimeError instead of leaving them to time out."""
+    srv = ContinuousBatcher(CFG, _prepared(), slots=2, max_len=32,
+                            prompt_pad=8)
+
+    calls = {"n": 0}
+    real_step = srv.step
+
+    def exploding_step():
+        calls["n"] += 1
+        if calls["n"] >= 2:
+            raise RuntimeError("injected device fault")
+        return real_step()
+
+    srv.step = exploding_step
+    worker = _BatcherWorker(srv)
+    worker.start()
+    fut = worker.submit(np.array([1, 2, 3], np.int32), 8, None)
+    t0 = time.monotonic()
+    with pytest.raises(RuntimeError, match="worker died"):
+        fut.result(timeout=60)
+    assert time.monotonic() - t0 < 30, "future resolved too slowly"
+    worker.join(timeout=10)
+    assert not worker.is_alive()
+
+    # submits AFTER death fail immediately (the dead-marking lock path)
+    fut2 = worker.submit(np.array([4, 5], np.int32), 4, None)
+    with pytest.raises(RuntimeError):
+        fut2.result(timeout=5)
+
+
+def test_dead_worker_surfaces_unavailable_over_grpc():
+    """End-to-end over the wire: kill the worker, then HealthCheck reports
+    unhealthy and SendTensor aborts UNAVAILABLE instead of hanging."""
+    from dnn_tpu.runtime.lm_server import start_lm_server_in_background
+
+    port = 59311
+    t, stop = start_lm_server_in_background(
+        CFG, _prepared(seed=1), port=port, slots=2, max_len=32,
+        prompt_pad=8, default_max_new=4)
+    try:
+        c = NodeClient(f"127.0.0.1:{port}")
+        assert c.health_check()
+        # one good request proves the path, then kill the worker thread
+        out = c.generate(np.array([1, 2, 3], np.int32), max_new_tokens=3)
+        assert out.shape == (3,)
+
+        # the background helper hides the servicer in its closure; find
+        # the live worker via thread enumeration and kill it abruptly
+        import threading
+
+        workers = [th for th in threading.enumerate()
+                   if th.name == "lm-batcher"]
+        assert workers, "no lm-batcher thread found"
+        for w in workers:
+            # simulate sudden device death: poison the queue path by
+            # marking dead exactly as a step() crash would
+            with w._lock:
+                w._dead = RuntimeError("injected: device gone")
+            w._abandon = True
+            w._stop_evt.set()
+        for w in workers:
+            w.join(timeout=10)
+
+        assert not c.health_check(), "dead worker must report unhealthy"
+        t0 = time.monotonic()
+        with pytest.raises((grpc.RpcError, RuntimeError)):
+            c.generate(np.array([1, 2], np.int32), max_new_tokens=3)
+        assert time.monotonic() - t0 < 30, "dead-worker request not fast-failed"
+        c.close()
+    finally:
+        stop()
+
+
+def test_stop_drain_false_cancels_quickly():
+    """Non-drain shutdown abandons an in-flight long generation instead of
+    stepping the device to completion."""
+    srv = ContinuousBatcher(CFG, _prepared(seed=2), slots=1,
+                            max_len=CFG.block_size, prompt_pad=8)
+    worker = _BatcherWorker(srv)
+    worker.start()
+    fut = worker.submit(np.array([1, 2, 3], np.int32), 50, None)
+    # let it get admitted and step a little
+    time.sleep(1.0)
+    worker.stop(drain=False)
+    worker.join(timeout=20)
+    assert not worker.is_alive(), "worker kept stepping after abandon"
+    assert fut.cancelled() or fut.done()

@@ -112,3 +112,26 @@ def test_engine_generate_pipeline_vs_relay_parity(tmp_path):
     # compiled generator is cached per sampling key
     _ = spmd.generate(ids, max_new_tokens=6, rng=jax.random.PRNGKey(1))
     assert len(spmd._generators) == 1
+
+
+def test_pipeline_generate_int8_cache_matches_solo(devices):
+    """Pipeline decode with int8 cache shards == solo decode with the
+    int8 cache (same per-row quantization at every write)."""
+    from dnn_tpu.models import gpt
+    from dnn_tpu.parallel.mesh import STAGE_AXIS, make_mesh
+    from dnn_tpu.runtime.generate import (
+        make_generate,
+        make_pipeline_generate,
+        prepare_pipeline_stacked,
+    )
+
+    cfg = gpt.PRESETS["gpt2-test"]
+    prepared = gpt.prepare_stacked(gpt.init(jax.random.PRNGKey(31), cfg), cfg)
+    mesh = make_mesh({STAGE_AXIS: 2}, devices[:2])
+    stage_blocks, aux = prepare_pipeline_stacked(prepared, cfg, mesh)
+    ids = jax.random.randint(jax.random.PRNGKey(32), (2, 5), 0, cfg.vocab_size)
+    gen = make_pipeline_generate(cfg, mesh, max_new_tokens=5, kv_dtype="int8")
+    got = np.asarray(gen(stage_blocks, aux, ids, jax.random.PRNGKey(0)))
+    want = np.asarray(make_generate(cfg, max_new_tokens=5, kv_dtype="int8")(
+        prepared, ids, jax.random.PRNGKey(0)))
+    np.testing.assert_array_equal(got, want)
