@@ -291,6 +291,55 @@ def run_cpu_mesh_section():
           value=round(b * s / dt, 1), platform="cpu-mesh", batch=b, seq=s,
           microbatches=mbs)
 
+    # interleaved vs GPipe schedule: same 8-layer model on 4 stages, V=2.
+    # The structural win is the schedule length — sub-step equivalents
+    # V*(M+S-1) vs VM+S-1 — reported alongside measured wall clock (CPU
+    # timings carry dispatch noise; the sub-step ratio is the claim)
+    from dnn_tpu.parallel.pipeline import (
+        interleaved_schedule_steps, spmd_pipeline_interleaved,
+    )
+
+    s_stages, v, mbs2 = 4, 2, 8
+    mesh4 = make_mesh({STAGE_AXIS: s_stages}, jax.devices()[:s_stages])
+    x_emb = gpt.embed(aux, ids, cfg=cfg)
+    per_st = cfg.n_layer // s_stages
+    st4 = gpt.stack_blocks(p, range(cfg.n_layer))
+    stage_form = jax.tree.map(
+        lambda q: q.reshape(s_stages, per_st, *q.shape[1:]), st4)
+    chunk_form = jax.tree.map(
+        lambda q: q.reshape(v * s_stages, cfg.n_layer // (v * s_stages),
+                            *q.shape[1:]), st4)
+
+    def run_gpipe(xx):
+        return spmd_pipeline_stacked(
+            lambda bp, a: gpt.blocks_scan(bp, a, cfg=cfg),
+            stage_form, xx, mesh=mesh4, num_microbatches=mbs2)
+
+    def run_inter(xx):
+        return spmd_pipeline_interleaved(
+            lambda bp, a: gpt.blocks_scan(bp, a, cfg=cfg),
+            chunk_form, xx, mesh=mesh4, num_microbatches=mbs2,
+            virtual_stages=v)
+
+    np.testing.assert_allclose(
+        np.asarray(run_inter(x_emb)), np.asarray(run_gpipe(x_emb)),
+        atol=1e-4, rtol=1e-4)
+    dt_g = device_time(run_gpipe, x_emb, n1=2, n2=6)
+    dt_i = device_time(run_inter, x_emb, n1=2, n2=6)
+    gpipe_substeps = v * (mbs2 + s_stages - 1)
+    inter_substeps = interleaved_schedule_steps(s_stages, v, mbs2)
+    _emit(results, config="interleaved_vs_gpipe",
+          metric="substep_ratio",
+          value=round(inter_substeps / gpipe_substeps, 4),
+          platform="cpu-mesh", stages=s_stages, virtual=v,
+          microbatches=mbs2,
+          gpipe_ms=round(dt_g * 1e3, 2), interleaved_ms=round(dt_i * 1e3, 2),
+          note="schedule length V(M+S-1) -> VM+S-1. CPU wall-clock "
+               "typically favors gpipe: interleaving doubles the scan "
+               "steps and ring hops (per-sub-step dispatch + dynamic "
+               "chunk gather dominate on CPU); the bubble win needs "
+               "stage COMPUTE to dominate, i.e. real chips + real models")
+
     # p50 inter-stage hop latency (relay executor, device-to-device)
     stages = spec.partition(2)
     relay = RelayExecutor(

@@ -587,6 +587,26 @@ def make_partition(cfg: LlamaConfig, *, compute_dtype=None):
     return partition
 
 
+def to_hf_config(cfg: LlamaConfig, *, tie_word_embeddings: bool = False,
+                 **overrides):
+    """The one LlamaConfig -> transformers.LlamaConfig mapping (tests, the
+    HF-serve example, and any converter round-trip share it — the field
+    list must not fork). Requires transformers; extra kwargs pass through
+    (e.g. attn_implementation="eager")."""
+    import transformers
+
+    kw = dict(
+        vocab_size=cfg.vocab_size, hidden_size=cfg.n_embd,
+        intermediate_size=cfg.d_ff, num_hidden_layers=cfg.n_layer,
+        num_attention_heads=cfg.n_head, num_key_value_heads=cfg.n_kv_head,
+        max_position_embeddings=cfg.block_size, rope_theta=cfg.rope_theta,
+        rms_norm_eps=cfg.rms_eps, attention_bias=False, mlp_bias=False,
+        tie_word_embeddings=tie_word_embeddings,
+    )
+    kw.update(overrides)
+    return transformers.LlamaConfig(**kw)
+
+
 def _register(name: str, cfg: LlamaConfig):
     def convert(sd, _cfg=cfg):
         from dnn_tpu.io.checkpoint import llama_params_from_state_dict

@@ -41,16 +41,10 @@ def make_tiny_checkpoint(path: str, cfg) -> None:
     import torch
     import transformers
 
-    hf_cfg = transformers.LlamaConfig(
-        vocab_size=cfg.vocab_size, hidden_size=cfg.n_embd,
-        intermediate_size=cfg.d_ff, num_hidden_layers=cfg.n_layer,
-        num_attention_heads=cfg.n_head, num_key_value_heads=cfg.n_kv_head,
-        max_position_embeddings=cfg.block_size, rope_theta=cfg.rope_theta,
-        rms_norm_eps=cfg.rms_eps, attention_bias=False, mlp_bias=False,
-        tie_word_embeddings=False,
-    )
+    from dnn_tpu.models.llama import to_hf_config
+
     torch.manual_seed(0)
-    model = transformers.LlamaForCausalLM(hf_cfg).eval()
+    model = transformers.LlamaForCausalLM(to_hf_config(cfg)).eval()
     torch.save(model.state_dict(), path)
     print(f"[1] synthesized random-init HF checkpoint -> {path}")
 
@@ -101,17 +95,16 @@ def main() -> int:
     except ImportError:
         print("[2] torch/transformers unavailable; skipping parity check")
     else:
-        hf_cfg = transformers.LlamaConfig(
-            vocab_size=cfg.vocab_size, hidden_size=cfg.n_embd,
-            intermediate_size=cfg.d_ff, num_hidden_layers=cfg.n_layer,
-            num_attention_heads=cfg.n_head,
-            num_key_value_heads=cfg.n_kv_head,
-            max_position_embeddings=cfg.block_size,
-            rope_theta=cfg.rope_theta, rms_norm_eps=cfg.rms_eps,
-            attention_bias=False, mlp_bias=False,
-            tie_word_embeddings=False, attn_implementation="eager")
-        hf = transformers.LlamaForCausalLM(hf_cfg).eval()
-        hf.load_state_dict(torch.load(ckpt, map_location="cpu"))
+        sd = torch.load(ckpt, map_location="cpu")
+        # mirror the checkpoint's own tying (TinyLlama/LLaMA-3.2 ship no
+        # lm_head.weight; the converter falls back to the tied embedding)
+        tie = "lm_head.weight" not in sd
+        hf = transformers.LlamaForCausalLM(llama.to_hf_config(
+            cfg, tie_word_embeddings=tie,
+            attn_implementation="eager")).eval()
+        # strict=False: extra buffers (old-transformers inv_freq etc.)
+        # must not kill an optional sanity check
+        hf.load_state_dict(sd, strict=False)
         probe = np.arange(1, 9, dtype=np.int64)[None] % cfg.vocab_size
         with torch.no_grad():
             want = hf(torch.from_numpy(probe)).logits.numpy()

@@ -27,14 +27,8 @@ def test_hf_llama_logit_parity():
     torch = pytest.importorskip("torch")
     transformers = pytest.importorskip("transformers")
 
-    hf_cfg = transformers.LlamaConfig(
-        vocab_size=CFG.vocab_size, hidden_size=CFG.n_embd,
-        intermediate_size=CFG.d_ff, num_hidden_layers=CFG.n_layer,
-        num_attention_heads=CFG.n_head, num_key_value_heads=CFG.n_kv_head,
-        max_position_embeddings=CFG.block_size, rope_theta=CFG.rope_theta,
-        rms_norm_eps=CFG.rms_eps, attention_bias=False, mlp_bias=False,
-        tie_word_embeddings=False, attn_implementation="eager",
-    )
+    hf_cfg = llama.to_hf_config(CFG, attn_implementation="eager")
+    assert isinstance(hf_cfg, transformers.LlamaConfig)
     torch.manual_seed(0)
     model = transformers.LlamaForCausalLM(hf_cfg).eval()
     sd = {k: v.numpy() for k, v in model.state_dict().items()}
@@ -313,3 +307,25 @@ def test_llama_seq_parallel_matches_dense(n, devices):
     want = llama.make_apply(CFG)(params, ids)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                atol=2e-4, rtol=2e-4)
+
+
+def test_llama_pipeline_generate_int8_cache(devices):
+    """LLaMA pipeline decode with int8 cache shards (GQA group fold over
+    the quantized codec, scale leaves riding the ring's where-merge) ==
+    solo int8 decode."""
+    from dnn_tpu.models import gpt
+    from dnn_tpu.parallel.mesh import STAGE_AXIS, make_mesh
+    from dnn_tpu.runtime.generate import prepare_pipeline_stacked
+
+    params = _params(seed=33)
+    prepared = gpt.prepare_stacked(params, CFG)
+    mesh = make_mesh({STAGE_AXIS: 2}, devices[:2])
+    stage_blocks, aux = prepare_pipeline_stacked(prepared, CFG, mesh)
+    ids = jax.random.randint(jax.random.PRNGKey(34), (2, 5), 0, CFG.vocab_size)
+    gen = llama.make_pipeline_generate(CFG, mesh, max_new_tokens=5,
+                                       kv_dtype="int8")
+    got = np.asarray(gen(stage_blocks, aux, ids, jax.random.PRNGKey(0)))
+    want = np.asarray(llama.make_generate(CFG, max_new_tokens=5,
+                                          kv_dtype="int8")(
+        prepared, ids, jax.random.PRNGKey(0)))
+    np.testing.assert_array_equal(got, want)
