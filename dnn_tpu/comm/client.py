@@ -157,5 +157,20 @@ class NodeClient:
             raise RuntimeError(f"LM server returned no tokens: {status}")
         return np.asarray(result, np.int32)
 
+    def generate_text(
+        self,
+        prompt: str,
+        *,
+        max_new_tokens: int = 32,
+        seed: Optional[int] = None,
+        timeout: float = 120.0,
+    ) -> str:
+        """Text client for a tokenizer-equipped LM daemon: the prompt rides
+        SendMessage's message_text, generation options ride sender_id as
+        "gen:max_new[:seed]", and the reply is the generated continuation
+        (dnn_tpu/runtime/lm_server.LMServer.SendMessage)."""
+        rid = f"gen:{max_new_tokens}" + (f":{seed}" if seed is not None else "")
+        return self.send_message(rid, prompt, timeout=timeout)
+
     def close(self):
         self._channel.close()

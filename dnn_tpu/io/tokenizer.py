@@ -1,0 +1,80 @@
+"""Tokenizers for text-in/text-out serving.
+
+The reference framework has no text layer at all — its GPT path takes and
+returns raw token ids (/root/reference/partitions/gpt_model_parts.py), and
+its only text RPC (`SendMessage`) is dead code with no caller
+(node.py:111-113, SURVEY §3.4). The rebuild gives that RPC a job: the LM
+daemon can serve PROMPT TEXT -> GENERATED TEXT when built with a
+tokenizer (dnn_tpu/runtime/lm_server.py).
+
+Two implementations behind one two-method protocol
+(`encode(str) -> list[int]`, `decode(ids) -> str`):
+
+  * `ByteTokenizer` — dependency-free UTF-8 bytes as ids (+ optional id
+    offset to keep specials free). Any model with vocab_size >= 256
+    serves text out of the box; it is also the test vehicle (exact
+    round-trip by construction, no vocab files needed).
+  * `load_hf_tokenizer(path)` — a thin adapter over a LOCAL HuggingFace
+    tokenizer directory (AutoTokenizer.from_pretrained on a path; this
+    environment has no network, and a hub name would try to download).
+    Use for real GPT-2/LLaMA vocabularies.
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence
+
+__all__ = ["ByteTokenizer", "load_hf_tokenizer"]
+
+
+class ByteTokenizer:
+    """UTF-8 bytes as token ids, shifted by `offset`.
+
+    Round-trips any text exactly (decode(encode(s)) == s). Ids outside
+    [offset, offset+256) decode to the replacement character rather than
+    raising — generated ids come from a model that does not know byte
+    boundaries, and a text endpoint must not 500 on them."""
+
+    def __init__(self, vocab_size: int, *, offset: int = 0):
+        if vocab_size < offset + 256:
+            raise ValueError(
+                f"byte tokenizer needs vocab_size >= offset+256, got "
+                f"{vocab_size} (offset {offset})")
+        self.vocab_size = vocab_size
+        self.offset = offset
+
+    def encode(self, text: str) -> List[int]:
+        return [b + self.offset for b in text.encode("utf-8")]
+
+    def decode(self, ids: Sequence[int]) -> str:
+        raw = bytearray()
+        for i in ids:
+            j = int(i) - self.offset
+            if 0 <= j < 256:
+                raw.append(j)
+            else:
+                raw += b"\xef\xbf\xbd"  # U+FFFD, as documented — never a
+                # fabricated 0x00/0xFF byte
+        return bytes(raw).decode("utf-8", errors="replace")
+
+
+def load_hf_tokenizer(path: str):
+    """Adapter over a local HF tokenizer directory: returns an object with
+    the same encode/decode protocol (no special tokens added on encode;
+    specials skipped on decode — the daemon serves raw continuations)."""
+    from transformers import AutoTokenizer
+
+    tok = AutoTokenizer.from_pretrained(path, local_files_only=True)
+
+    class _HF:
+        vocab_size = tok.vocab_size
+
+        @staticmethod
+        def encode(text: str) -> List[int]:
+            return tok.encode(text, add_special_tokens=False)
+
+        @staticmethod
+        def decode(ids: Sequence[int]) -> str:
+            return tok.decode(list(ids), skip_special_tokens=True)
+
+    return _HF()

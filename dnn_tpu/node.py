@@ -79,6 +79,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--prompt_pad", type=int, default=None,
                    help="--serve_lm: prompt padding bucket (one prefill "
                         "compilation; default min(64, max_len))")
+    p.add_argument("--tokenizer", default=None,
+                   help="--serve_lm: text endpoint tokenizer — 'bytes' "
+                        "(UTF-8 bytes as ids; any vocab >= 256) or a LOCAL "
+                        "HF tokenizer directory. SendMessage then serves "
+                        "prompt text -> generated text")
     p.add_argument("--process_id", type=int, default=None,
                    help="This host's process id for multi-host (config 'distributed') runs")
     p.add_argument("--log_level", default="INFO")
@@ -294,6 +299,29 @@ def _serve_lm(engine: PipelineEngine, args) -> int:
         log.error("node '%s' has no IP:Port address in the config; the LM "
                   "daemon needs one to bind", args.node_id)
         return 1
+    tokenizer = None
+    if args.tokenizer:
+        # CLI boundary: a bad --tokenizer (vocab too small, missing HF
+        # dir, vocab mismatch) exits with a clean one-liner, not a
+        # traceback — same contract as every other config failure here
+        try:
+            if args.tokenizer == "bytes":
+                from dnn_tpu.io.tokenizer import ByteTokenizer
+
+                tokenizer = ByteTokenizer(cfg.vocab_size)
+            else:
+                from dnn_tpu.io.tokenizer import load_hf_tokenizer
+
+                tokenizer = load_hf_tokenizer(args.tokenizer)
+            tok_vocab = getattr(tokenizer, "vocab_size", None)
+            if tok_vocab is not None and tok_vocab > cfg.vocab_size:
+                raise ValueError(
+                    f"tokenizer vocab {tok_vocab} exceeds the model's "
+                    f"vocab_size {cfg.vocab_size} — out-of-range ids would "
+                    f"gather garbage embeddings silently")
+        except Exception as e:  # noqa: BLE001 — CLI boundary
+            log.error("tokenizer setup failed: %s", e)
+            return 1
     prepared = prepare_stacked(engine.params, cfg)
     try:
         asyncio.run(serve_lm(
@@ -302,6 +330,7 @@ def _serve_lm(engine: PipelineEngine, args) -> int:
             temperature=args.temperature, top_k=args.top_k,
             compute_dtype=engine.compute_dtype, seed=args.seed, ffn=ffn,
             family=family, default_max_new=args.generate or 32,
+            tokenizer=tokenizer,
         ))
     except KeyboardInterrupt:
         log.info("shutting down")

@@ -195,33 +195,33 @@ class LMServer:
     dnn_tpu/runtime/generate_moe.moe_cache_ffn)."""
 
     def __init__(self, cfg, prepared, *, default_max_new: int = 32,
-                 request_timeout: float = 120.0, **batcher_kwargs):
+                 request_timeout: float = 120.0, tokenizer=None,
+                 **batcher_kwargs):
         self.batcher = ContinuousBatcher(cfg, prepared, **batcher_kwargs)
         self.default_max_new = default_max_new
         self.request_timeout = request_timeout
+        # optional text front (dnn_tpu/io/tokenizer.py): with it,
+        # SendMessage serves prompt text -> generated text
+        self.tokenizer = tokenizer
         self.worker = _BatcherWorker(self.batcher)
         self.worker.start()
 
     # --- RPC implementations (names/signatures fixed by the protocol) ---
 
-    async def SendTensor(self, request: pb.TensorRequest, context) -> pb.TensorResponse:
-        try:
-            prompt = _tensor_arr(request.tensor)
-        except PayloadCorruptError as e:
-            await context.abort(grpc.StatusCode.DATA_LOSS, str(e))
-        if not np.issubdtype(prompt.dtype, np.integer):
-            await context.abort(
-                grpc.StatusCode.INVALID_ARGUMENT,
-                f"prompt must be integer token ids, got dtype {prompt.dtype}")
+    async def _submit_and_await(self, ids, request_id: str, context):
+        """Shared submit/await/abort ladder for both RPC fronts: one place
+        owns the error mapping (caller errors -> INVALID_ARGUMENT, worker
+        death/shutdown -> UNAVAILABLE, client RPC cancellation re-raised
+        for grpc.aio, deadline -> DEADLINE_EXCEEDED)."""
         if not self.worker.is_alive():
             await context.abort(
                 grpc.StatusCode.UNAVAILABLE,
                 "LM batcher worker is not running (died or shut down)")
-        max_new, seed = parse_gen_options(request.request_id, self.default_max_new)
+        max_new, seed = parse_gen_options(request_id, self.default_max_new)
         fut = self.worker.submit(
-            np.asarray(prompt, np.int32).reshape(-1), max_new, seed)
+            np.asarray(ids, np.int32).reshape(-1), max_new, seed)
         try:
-            tokens = await asyncio.wait_for(
+            return await asyncio.wait_for(
                 asyncio.wrap_future(fut), timeout=self.request_timeout)
         except ValueError as e:
             # submit-side validation (overlong prompt, budget) — caller error
@@ -239,6 +239,17 @@ class LMServer:
             await context.abort(
                 grpc.StatusCode.DEADLINE_EXCEEDED,
                 f"generation exceeded {self.request_timeout}s")
+
+    async def SendTensor(self, request: pb.TensorRequest, context) -> pb.TensorResponse:
+        try:
+            prompt = _tensor_arr(request.tensor)
+        except PayloadCorruptError as e:
+            await context.abort(grpc.StatusCode.DATA_LOSS, str(e))
+        if not np.issubdtype(prompt.dtype, np.integer):
+            await context.abort(
+                grpc.StatusCode.INVALID_ARGUMENT,
+                f"prompt must be integer token ids, got dtype {prompt.dtype}")
+        tokens = await self._submit_and_await(prompt, request.request_id, context)
         return pb.TensorResponse(
             status=f"[lm] ok: {len(tokens)} tokens",
             result_tensor=_tensor_msg(np.asarray(tokens, np.int32)),
@@ -248,11 +259,25 @@ class LMServer:
         return pb.HealthCheckResponse(is_healthy=self.worker.is_alive())
 
     async def SendMessage(self, request: pb.MessageRequest, context) -> pb.MessageReply:
+        """Text endpoint. "!stats" (or any text without a tokenizer)
+        answers with pool stats; with a tokenizer, the message text is a
+        PROMPT and the reply is the generated continuation — the job the
+        reference defined this RPC for but never gave it (node.py:111-113,
+        no caller). Options ride the sender_id as "gen[:max_new[:seed]]"."""
         b = self.batcher
+        text = request.message_text
+        if self.tokenizer is None or text == "!stats":
+            return pb.MessageReply(
+                confirmation_text=(
+                    f"[lm] pool: {b.n_active}/{b.slots} slots active, "
+                    f"{len(b.results)} unclaimed results"))
+        ids = self.tokenizer.encode(text)
+        if not ids:
+            await context.abort(grpc.StatusCode.INVALID_ARGUMENT,
+                                "prompt text tokenized to nothing")
+        tokens = await self._submit_and_await(ids, request.sender_id, context)
         return pb.MessageReply(
-            confirmation_text=(
-                f"[lm] pool: {b.n_active}/{b.slots} slots active, "
-                f"{len(b.results)} unclaimed results"))
+            confirmation_text=self.tokenizer.decode([int(t) for t in tokens]))
 
     def close(self):
         self.worker.stop(drain=False)

@@ -1,0 +1,68 @@
+"""Tokenizer + text-serving tests: the SendMessage RPC (dead code in the
+reference, node.py:111-113) serving prompt text -> generated text through
+the tokenizer-equipped LM daemon."""
+
+import jax
+import numpy as np
+import pytest
+
+from dnn_tpu.comm.client import NodeClient
+from dnn_tpu.io.tokenizer import ByteTokenizer
+from dnn_tpu.models import gpt
+from dnn_tpu.runtime.generate import make_generate
+from dnn_tpu.runtime.lm_server import start_lm_server_in_background
+
+CFG = gpt.PRESETS["gpt2-test"]  # vocab 256: bytes fit exactly
+
+
+def test_byte_tokenizer_roundtrip():
+    tok = ByteTokenizer(256)
+    for s in ("hello", "héllo wörld", "", "a\nb\tc", "🙂"):
+        assert tok.decode(tok.encode(s)) == s
+    # out-of-range ids degrade to replacement bytes, never raise
+    assert isinstance(ByteTokenizer(300, offset=2).decode([0, 1, 299]), str)
+    with pytest.raises(ValueError, match="vocab_size"):
+        ByteTokenizer(100)
+
+
+def test_text_endpoint_matches_id_endpoint():
+    prepared = gpt.prepare_stacked(gpt.init(jax.random.PRNGKey(0), CFG), CFG)
+    tok = ByteTokenizer(CFG.vocab_size)
+    port = 59321
+    t, stop = start_lm_server_in_background(
+        CFG, prepared, port=port, slots=2, max_len=64, prompt_pad=16,
+        default_max_new=6, tokenizer=tok)
+    try:
+        c = NodeClient(f"127.0.0.1:{port}")
+        # stats path still reachable
+        assert "pool" in c.send_message("anyone", "!stats")
+
+        prompt = "hello"
+        text = c.generate_text(prompt, max_new_tokens=6)
+        # oracle: tokenize -> id-endpoint semantics -> detokenize
+        ids = np.asarray(tok.encode(prompt), np.int32)
+        want_ids = np.asarray(make_generate(CFG, max_new_tokens=6)(
+            prepared, ids[None, :], jax.random.PRNGKey(0)))[0]
+        assert text == tok.decode([int(i) for i in want_ids])
+        c.close()
+    finally:
+        stop()
+
+
+def test_text_endpoint_without_tokenizer_gives_stats():
+    prepared = gpt.prepare_stacked(gpt.init(jax.random.PRNGKey(1), CFG), CFG)
+    port = 59322
+    t, stop = start_lm_server_in_background(
+        CFG, prepared, port=port, slots=1, max_len=32, prompt_pad=8)
+    try:
+        c = NodeClient(f"127.0.0.1:{port}")
+        assert "pool" in c.send_message("gen:4", "some prompt")
+        c.close()
+    finally:
+        stop()
+
+
+def test_out_of_range_ids_become_replacement_char():
+    tok = ByteTokenizer(300, offset=2)
+    s = tok.decode([0, 1, 299, 2 + ord("a")])
+    assert s == "���a"
