@@ -324,6 +324,7 @@ def forward_with_cache(prepared, ids, cache, start_pos, *, cfg: LlamaConfig,
 
 def make_generate(cfg: LlamaConfig, *, max_new_tokens: int,
                   temperature: float = 0.0, top_k: Optional[int] = None,
+                  top_p: Optional[float] = None,
                   compute_dtype=None, kv_dtype=None):
     """Jitted generate(prepared, ids, rng) — same contract as the GPT
     family's decoder, including kv_dtype (f32/bf16/"int8") cache storage."""
@@ -345,7 +346,8 @@ def make_generate(cfg: LlamaConfig, *, max_new_tokens: int,
         logits, cache = forward_with_cache(
             prepared, ids, cache, 0, cfg=cfg, compute_dtype=compute_dtype)
         rng, sub = jax.random.split(rng)
-        tok = _sample(logits[:, -1], sub, temperature=temperature, top_k=top_k)
+        tok = _sample(logits[:, -1], sub, temperature=temperature,
+                      top_k=top_k, top_p=top_p)
 
         def step(carry, i):
             cache, tok, rng = carry
@@ -354,7 +356,7 @@ def make_generate(cfg: LlamaConfig, *, max_new_tokens: int,
                 compute_dtype=compute_dtype)
             rng, sub = jax.random.split(rng)
             nxt = _sample(logits[:, -1], sub, temperature=temperature,
-                          top_k=top_k)
+                          top_k=top_k, top_p=top_p)
             return (cache, nxt, rng), tok
 
         (_, last, _), toks = lax.scan(

@@ -63,6 +63,9 @@ def build_parser() -> argparse.ArgumentParser:
                    help="Sampling temperature for --generate (0 = greedy)")
     p.add_argument("--top_k", type=int, default=None,
                    help="Top-k sampling cutoff for --generate")
+    p.add_argument("--top_p", type=float, default=None,
+                   help="Nucleus (top-p) sampling cutoff for --generate / "
+                        "--serve_lm")
     p.add_argument("--seed", type=int, default=0,
                    help="Sampling rng seed for --generate")
     p.add_argument("--serve", action="store_true",
@@ -328,6 +331,7 @@ def _serve_lm(engine: PipelineEngine, args) -> int:
             cfg, prepared, port=me.port, slots=args.slots,
             max_len=args.max_len, prompt_pad=args.prompt_pad,
             temperature=args.temperature, top_k=args.top_k,
+            top_p=args.top_p,
             compute_dtype=engine.compute_dtype, seed=args.seed, ffn=ffn,
             family=family, default_max_new=args.generate or 32,
             tokenizer=tokenizer,
@@ -365,6 +369,7 @@ def _generate_local(engine: PipelineEngine, args) -> int:
             max_new_tokens=args.generate,
             temperature=args.temperature,
             top_k=args.top_k,
+            top_p=args.top_p,
             rng=jax.random.PRNGKey(args.seed),
         )
     except (ValueError, RuntimeError) as e:

@@ -404,7 +404,8 @@ class PipelineEngine:
     # ------------------------------------------------------------------
 
     def make_generator(self, *, max_new_tokens: int, temperature: float = 0.0,
-                       top_k: Optional[int] = None):
+                       top_k: Optional[int] = None,
+                       top_p: Optional[float] = None):
         """Build `generate(ids, rng=None) -> (B, max_new_tokens)` on this
         engine's weights. On the spmd runtime with the GPT stacked layout,
         decode runs PIPELINE-PARALLEL: each stage keeps its KV-cache shard
@@ -446,14 +447,15 @@ class PipelineEngine:
 
             return single_program(make_generate_moe(
                 cfg, max_new_tokens=max_new_tokens, temperature=temperature,
-                sample_top_k=top_k, compute_dtype=self.compute_dtype,
+                sample_top_k=top_k, sample_top_p=top_p,
+                compute_dtype=self.compute_dtype,
             ))
         if isinstance(cfg, LlamaConfig):
             from dnn_tpu.models import llama
 
             return single_program(llama.make_generate(
                 cfg, max_new_tokens=max_new_tokens, temperature=temperature,
-                top_k=top_k, compute_dtype=self.compute_dtype,
+                top_k=top_k, top_p=top_p, compute_dtype=self.compute_dtype,
             ))
         if type(cfg) is not GPTConfig:
             # exact match: the KV-cache decoder assumes dense-GPT block
@@ -465,7 +467,7 @@ class PipelineEngine:
         if self.runtime == "spmd" and self._gpt_stacked_ready():
             gen = make_pipeline_generate(
                 cfg, self.mesh, max_new_tokens=max_new_tokens,
-                temperature=temperature, top_k=top_k,
+                temperature=temperature, top_k=top_k, top_p=top_p,
                 compute_dtype=self.compute_dtype,
             )
             stage_major, aux = self._gen_parts
@@ -474,21 +476,23 @@ class PipelineEngine:
             )
         return single_program(make_generate(
             cfg, max_new_tokens=max_new_tokens, temperature=temperature,
-            top_k=top_k, compute_dtype=self.compute_dtype,
+            top_k=top_k, top_p=top_p, compute_dtype=self.compute_dtype,
         ))
 
     def generate(self, ids, *, max_new_tokens: int, temperature: float = 0.0,
-                 top_k: Optional[int] = None, rng=None) -> jax.Array:
+                 top_k: Optional[int] = None, top_p: Optional[float] = None,
+                 rng=None) -> jax.Array:
         """One-call generation; caches the compiled generator per
         (max_new_tokens, temperature, top_k) so repeated serving calls reuse
         the jitted program."""
-        key = (max_new_tokens, temperature, top_k)
+        key = (max_new_tokens, temperature, top_k, top_p)
         cache = getattr(self, "_generators", None)
         if cache is None:
             cache = self._generators = {}
         if key not in cache:
             cache[key] = self.make_generator(
-                max_new_tokens=max_new_tokens, temperature=temperature, top_k=top_k
+                max_new_tokens=max_new_tokens, temperature=temperature,
+                top_k=top_k, top_p=top_p,
             )
         return cache[key](jnp.asarray(ids, jnp.int32), rng)
 

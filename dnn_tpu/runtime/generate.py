@@ -14,7 +14,8 @@ generation, so the rebuild supplies it TPU-first:
   * the cache is laid out (L, B, H, S, D) so layers scan over the leading
     axis with the same stacked block params the pipeline runtime shards.
 
-Greedy (temperature=0) and temperature/top-k sampling are supported.
+Greedy (temperature=0), temperature/top-k, and nucleus (top-p) sampling
+are supported, composably (top-k filter first, nucleus over the rest).
 """
 
 from __future__ import annotations
@@ -99,14 +100,30 @@ def forward_with_cache(prepared, ids, cache, start_pos, *, cfg: GPTConfig,
     return logits, new_cache
 
 
-def _sample(logits, rng, *, temperature: float, top_k: Optional[int]):
-    """logits (B, V) -> token ids (B,). temperature=0 is greedy."""
+def _sample(logits, rng, *, temperature: float, top_k: Optional[int],
+            top_p: Optional[float] = None):
+    """logits (B, V) -> token ids (B,). temperature=0 is greedy; top_k
+    truncates to the k highest logits; top_p (nucleus) keeps the smallest
+    set of tokens whose probability mass reaches p — both static-shape
+    (sort + threshold, no dynamic vocab slicing) and composable (top_k
+    filter first, then the nucleus over what remains)."""
     if temperature == 0.0:
         return jnp.argmax(logits, axis=-1).astype(jnp.int32)
     logits = logits / temperature
     if top_k is not None:
         kth = lax.top_k(logits, top_k)[0][..., -1:]
         logits = jnp.where(logits < kth, _NEG_BIG, logits)
+    if top_p is not None:
+        sorted_logits = jnp.flip(jnp.sort(logits, axis=-1), axis=-1)
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        # keep a token while the mass BEFORE it is < p (top-1 always kept);
+        # the cutoff logit is the smallest kept one
+        keep = (cum - probs) < top_p
+        n_keep = jnp.maximum(keep.sum(axis=-1), 1)
+        thresh = jnp.take_along_axis(
+            sorted_logits, (n_keep - 1)[..., None], axis=-1)
+        logits = jnp.where(logits < thresh, _NEG_BIG, logits)
     return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
 
 
@@ -192,6 +209,7 @@ class GPTPipelineFamily:
 
 def make_pipeline_generate(cfg: GPTConfig, mesh, *, max_new_tokens: int,
                            temperature: float = 0.0, top_k: Optional[int] = None,
+                           top_p: Optional[float] = None,
                            compute_dtype=None, axis_name=None, family=None,
                            kv_dtype=None):
     """Pipeline-parallel KV-cache generation across a stage-sharded mesh.
@@ -283,7 +301,7 @@ def make_pipeline_generate(cfg: GPTConfig, mesh, *, max_new_tokens: int,
         def sample_last(h, sub_rng):
             logits = fam.head(aux, h[:, -1:])
             tok = _sample(logits[:, -1], sub_rng,
-                          temperature=temperature, top_k=top_k)
+                          temperature=temperature, top_k=top_k, top_p=top_p)
             # only stage 0 holds the real hidden state; broadcast its token
             return lax.psum(jnp.where(d == 0, tok, jnp.zeros_like(tok)), axis)
 
@@ -326,8 +344,8 @@ def make_pipeline_generate(cfg: GPTConfig, mesh, *, max_new_tokens: int,
 
 
 def make_generate(cfg: GPTConfig, *, max_new_tokens: int, temperature: float = 0.0,
-                  top_k: Optional[int] = None, compute_dtype=None, ffn=None,
-                  kv_dtype=None):
+                  top_k: Optional[int] = None, top_p: Optional[float] = None,
+                  compute_dtype=None, ffn=None, kv_dtype=None):
     """Build a jitted generate(prepared, ids, rng) -> (B, max_new_tokens).
 
     `prepared` is the stacked layout from `gpt.prepare_stacked`. The prompt
@@ -359,7 +377,7 @@ def make_generate(cfg: GPTConfig, *, max_new_tokens: int, temperature: float = 0
             ffn=ffn,
         )
         rng, sub = jax.random.split(rng)
-        tok = _sample(logits[:, -1], sub, temperature=temperature, top_k=top_k)
+        tok = _sample(logits[:, -1], sub, temperature=temperature, top_k=top_k, top_p=top_p)
 
         def step(carry, i):
             # carry token tok_i sits at sequence position t + i
@@ -369,7 +387,7 @@ def make_generate(cfg: GPTConfig, *, max_new_tokens: int, temperature: float = 0
                 compute_dtype=compute_dtype, ffn=ffn,
             )
             rng, sub = jax.random.split(rng)
-            nxt = _sample(logits[:, -1], sub, temperature=temperature, top_k=top_k)
+            nxt = _sample(logits[:, -1], sub, temperature=temperature, top_k=top_k, top_p=top_p)
             return (cache, nxt, rng), tok
 
         (_, last, _), toks = lax.scan(

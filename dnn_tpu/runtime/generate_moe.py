@@ -90,13 +90,14 @@ def forward_with_cache_moe(prepared, ids, cache, start_pos, *,
 def make_generate_moe(cfg: GPTMoEConfig, *, max_new_tokens: int,
                       temperature: float = 0.0,
                       sample_top_k: Optional[int] = None,
+                      sample_top_p: Optional[float] = None,
                       compute_dtype=None, groups: int = 1):
     """Jitted generate(prepared, ids, rng) for the MoE family — the dense
     family's make_generate with the routed FFN plugged in. `sample_top_k`
     is the SAMPLING truncation (cfg.top_k is the ROUTING fan-out)."""
     return make_generate(
         cfg, max_new_tokens=max_new_tokens, temperature=temperature,
-        top_k=sample_top_k, compute_dtype=compute_dtype,
+        top_k=sample_top_k, top_p=sample_top_p, compute_dtype=compute_dtype,
         ffn=moe_cache_ffn(cfg, groups=groups, compute_dtype=compute_dtype),
     )
 

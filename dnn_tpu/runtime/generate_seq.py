@@ -94,6 +94,7 @@ def _local_attn_stats(q, k_local, v_local, local_limit):
 def make_generate_seq_sharded(cfg: GPTConfig, mesh, *, max_new_tokens: int,
                               temperature: float = 0.0,
                               top_k: Optional[int] = None,
+                              top_p: Optional[float] = None,
                               compute_dtype=None,
                               axis_name: str = SEQ_AXIS):
     """Build generate(prepared, ids, rng) with the KV cache sharded over
@@ -133,7 +134,8 @@ def make_generate_seq_sharded(cfg: GPTConfig, mesh, *, max_new_tokens: int,
             for kk in ("k", "v")
         }  # (L, B, H, Sd, D) — my positions only
         rng, sub = jax.random.split(rng)
-        tok = _sample(logits[:, -1], sub, temperature=temperature, top_k=top_k)
+        tok = _sample(logits[:, -1], sub, temperature=temperature,
+                      top_k=top_k, top_p=top_p)
 
         def block_step(bp, x, lc_k, lc_v, p):
             """One block at decode position p against my cache slice."""
@@ -184,7 +186,7 @@ def make_generate_seq_sharded(cfg: GPTConfig, mesh, *, max_new_tokens: int,
                           compute_dtype=compute_dtype)
             rng, sub = jax.random.split(rng)
             nxt = _sample(logits[:, -1], sub, temperature=temperature,
-                          top_k=top_k)
+                          top_k=top_k, top_p=top_p)
             return {"k": k_new, "v": v_new}, nxt, rng
 
         def step(carry, j):

@@ -191,7 +191,8 @@ class LMServer:
 
     Build with the same (cfg, prepared) pair the batcher takes; batcher
     kwargs pass through (slots, max_len, prompt_pad, temperature, top_k,
-    compute_dtype, eos_id, seed, ffn — `ffn` is how the MoE family serves,
+    top_p, compute_dtype, eos_id, seed, ffn, kv_dtype, family — `ffn` is
+    how the MoE family serves,
     dnn_tpu/runtime/generate_moe.moe_cache_ffn)."""
 
     def __init__(self, cfg, prepared, *, default_max_new: int = 32,

@@ -142,6 +142,7 @@ class ContinuousBatcher:
     def __init__(self, cfg: GPTConfig, prepared, *, slots: int = 4,
                  max_len: Optional[int] = None, prompt_pad: Optional[int] = None,
                  temperature: float = 0.0, top_k: Optional[int] = None,
+                 top_p: Optional[float] = None,
                  compute_dtype=None, eos_id: Optional[int] = None, seed: int = 0,
                  ffn=None, kv_dtype=None, family=None):
         self.cfg = cfg
@@ -197,7 +198,7 @@ class ContinuousBatcher:
             new_keys, subs = split[:, 0], split[:, 1]
             nxt = jax.vmap(
                 lambda lg, k: _sample(lg[None, :], k, temperature=temperature,
-                                      top_k=top_k)[0]
+                                      top_k=top_k, top_p=top_p)[0]
             )(logits, subs)
             nxt = jnp.where(active, nxt, tok)
             new_keys = jnp.where(active[:, None], new_keys, keys)
@@ -212,7 +213,7 @@ class ContinuousBatcher:
             logits, row = self.family.prefill(prepared, padded, row)
             first = _sample(
                 logits[:, true_len - 1][0:1], rng,
-                temperature=temperature, top_k=top_k,
+                temperature=temperature, top_k=top_k, top_p=top_p,
             )[0]
             # every cache leaf (K/V and, for int8, their scale arrays)
             # carries batch on axis 1 after the layer axis

@@ -82,3 +82,41 @@ def test_generate_rejects_overlong():
     except ValueError as e:
         raised = "block_size" in str(e)
     assert raised
+
+
+def test_top_p_sampling():
+    """Nucleus sampling: with a known distribution, top_p must restrict
+    draws to the smallest prefix reaching the mass — and compose with
+    temperature/top_k without shape tricks."""
+    from dnn_tpu.runtime.generate import _sample
+
+    # hand-built logits: probs ~ [0.5, 0.3, 0.1, 0.06, 0.04]
+    p = np.array([0.5, 0.3, 0.1, 0.06, 0.04])
+    logits = jnp.asarray(np.log(p)[None, :], jnp.float32)
+    draws = []
+    for i in range(300):
+        draws.append(int(_sample(logits, jax.random.PRNGKey(i),
+                                 temperature=1.0, top_k=None, top_p=0.75)[0]))
+    seen = set(draws)
+    # nucleus at 0.75: keep {0 (0.5), 1 (cum-before 0.5 < .75)}; token 2's
+    # mass-before is 0.8 >= .75 -> excluded
+    assert seen <= {0, 1}, seen
+    assert 0 in seen and 1 in seen
+    # top-1-always-kept guard: tiny p still samples something valid
+    t = int(_sample(logits, jax.random.PRNGKey(0), temperature=1.0,
+                    top_k=None, top_p=1e-6)[0])
+    assert t == 0
+    # greedy ignores top_p entirely
+    g = _sample(logits, jax.random.PRNGKey(0), temperature=0.0,
+                top_k=None, top_p=0.5)
+    assert int(g[0]) == 0
+
+
+def test_generate_with_top_p_runs_and_reproduces():
+    _, prepared = _prepared()
+    ids = jnp.zeros((2, 4), jnp.int32)
+    gen = make_generate(CFG, max_new_tokens=5, temperature=0.8, top_p=0.9)
+    a = np.asarray(gen(prepared, ids, jax.random.PRNGKey(7)))
+    b = np.asarray(gen(prepared, ids, jax.random.PRNGKey(7)))
+    np.testing.assert_array_equal(a, b)
+    assert (a >= 0).all() and (a < CFG.vocab_size).all()
