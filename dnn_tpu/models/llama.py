@@ -447,9 +447,9 @@ class LlamaFamilyRows:
     def init_cache(self, batch, max_len, dtype):
         return init_cache(self.cfg, batch, max_len, dtype)
 
-    def prefill(self, prepared, padded, row_cache):
+    def prefill(self, prepared, padded, row_cache, start_pos=0):
         return forward_with_cache(
-            prepared, padded, row_cache, 0, cfg=self.cfg,
+            prepared, padded, row_cache, start_pos, cfg=self.cfg,
             compute_dtype=self.compute_dtype)
 
     def _block_rows(self, bp, x, layer_cache, pos, write, codec):

@@ -9,19 +9,22 @@ KV cache, where requests enter (prefill into a free slot) and leave
 (EOS / token budget) independently while the other slots keep decoding.
 Throughput stays at full batch width without waiting for stragglers.
 
-TPU-first mechanics (everything static under jit, two compiled programs
-total):
+TPU-first mechanics (everything static under jit, THREE compiled
+programs total — chunk prefill, prefill finish, decode step):
 
   * ONE decode step program for the whole pool: every slot advances one
     token per call. Per-slot sequence positions live in a (B,) vector;
     K/V writes land at each row's own position (vmap'd dynamic update —
     rows are independent), attention masks each row against its own
     length, inactive slots are fully masked no-ops.
-  * ONE prefill program: prompts are right-padded to a fixed bucket
-    length; pad positions write garbage K/V that is never attended (the
-    per-row position mask stops at the true length) and is overwritten as
-    the sequence grows through it. The first sampled token comes from the
-    logit row at the true last prompt position.
+  * ONE prefill-chunk program: prompts prefill as full prompt_pad-sized
+    chunks plus one right-padded tail, each at its absolute position —
+    any prompt length (up to max_len - max_new) reuses the same compiled
+    chunk. Tail pad positions write garbage K/V that is never attended
+    (the per-row position mask stops at the true length) and is
+    overwritten as the sequence grows through it; a second small program
+    samples the first token from the true last prompt row and installs
+    the finished slot-row cache into the pool.
   * Slot bookkeeping (which request owns which slot, emitted tokens, EOS)
     is plain host Python — it changes per request, so it must not live
     inside the compiled graphs.
@@ -98,10 +101,12 @@ class GPTFamilyRows:
     def init_cache(self, batch, max_len, dtype):
         return init_cache(self.cfg, batch, max_len, dtype)
 
-    def prefill(self, prepared, padded, row_cache):
-        """padded (1, P) prompt -> (logits (1, P, V), row_cache)."""
+    def prefill(self, prepared, padded, row_cache, start_pos=0):
+        """One (1, P) prompt chunk at positions [start_pos, start_pos+P)
+        -> (logits (1, P, V), row_cache). Long prompts prefill as several
+        full chunks + one padded tail (the batcher's chunk loop)."""
         return forward_with_cache(
-            prepared, padded, row_cache, 0, cfg=self.cfg,
+            prepared, padded, row_cache, start_pos, cfg=self.cfg,
             compute_dtype=self.compute_dtype, ffn=self.ffn)
 
     def decode_rows(self, prepared, cache, tok, pos, active, codec):
@@ -129,8 +134,9 @@ class GPTFamilyRows:
 
 class ContinuousBatcher:
     """Slot-pool decode server. `slots` concurrent sequences over one
-    static cache of `max_len` positions; prompts are padded to
-    `prompt_pad` (one prefill compilation for all requests).
+    static cache of `max_len` positions; prompts prefill in
+    `prompt_pad`-sized chunks (one prefill compilation for all requests,
+    any prompt length).
 
     Usage:
         srv = ContinuousBatcher(cfg, prepared, slots=4, max_len=96)
@@ -205,30 +211,48 @@ class ContinuousBatcher:
             return (new_cache, pos + active.astype(jnp.int32),
                     nxt, new_keys)
 
-        def prefill(prepared, cache, padded, true_len, slot, rng):
-            """Prefill one slot: padded (1, P) prompt, true_len real tokens.
-            Returns (cache, first_token). Pad positions beyond true_len
-            write K/V that the per-row position mask never attends."""
-            row = self.family.init_cache(1, self.max_len, cache_dtype)
-            logits, row = self.family.prefill(prepared, padded, row)
+        def prefill_chunk(prepared, row, chunk, chunk_start):
+            """One (1, prompt_pad) chunk of a prompt into the slot-row
+            cache at positions [chunk_start, chunk_start+P). Long prompts
+            loop this (full chunks + one padded tail) — ONE compiled
+            program for any prompt length. Pad positions in the tail write
+            K/V that the per-row position mask never attends."""
+            return self.family.prefill(prepared, chunk, row, chunk_start)
+
+        def prefill_finish(cache, row, logits, last_local, slot, rng):
+            """Sample the first token from the final chunk's true-last
+            logit row and install the finished row cache into `slot`."""
             first = _sample(
-                logits[:, true_len - 1][0:1], rng,
+                logits[:, last_local][0:1], rng,
                 temperature=temperature, top_k=top_k, top_p=top_p,
             )[0]
-            # every cache leaf (K/V and, for int8, their scale arrays)
-            # carries batch on axis 1 after the layer axis
+            # the row cache is chunk-rounded (possibly > max_len); only
+            # its first max_len positions install — the overhang holds
+            # nothing but tail-pad garbage (real prompt tokens always fit
+            # inside max_len by the submit() budget check)
             cache = {
-                kk: lax.dynamic_update_slice_in_dim(cache[kk], row[kk], slot, axis=1)
+                kk: lax.dynamic_update_slice_in_dim(
+                    cache[kk],
+                    lax.slice_in_dim(row[kk], 0, self.max_len, axis=3),
+                    slot, axis=1)
                 for kk in cache
             }
             return cache, first
 
-        # donate the cache: without aliasing, every token would copy the
+        # the transient slot-row cache rounds max_len UP to whole chunks:
+        # a tail chunk starting at (n_chunks-1)*prompt_pad must never have
+        # its write clamped back onto real prompt positions (dynamic
+        # updates clamp silently — an unrounded row corrupts the cache
+        # whenever max_len % prompt_pad != 0)
+        self._row_len = -(-self.max_len // self.prompt_pad) * self.prompt_pad
+        self._new_row = lambda: self.family.init_cache(1, self._row_len, cache_dtype)
+        # donate the caches: without aliasing, every token would copy the
         # whole (L, B, H, S, D) cache (hundreds of MB of HBM traffic per
-        # step at real sizes). The call sites reassign self.cache from the
-        # result, so the donated input is never reused.
+        # step at real sizes). The call sites reassign from the results,
+        # so the donated inputs are never reused.
         self._decode = jax.jit(decode_step, donate_argnums=(1,))
-        self._prefill = jax.jit(prefill, donate_argnums=(1,))
+        self._prefill_chunk = jax.jit(prefill_chunk, donate_argnums=(1,))
+        self._prefill_finish = jax.jit(prefill_finish, donate_argnums=(0, 1))
 
     # ------------------------------------------------------------------
 
@@ -248,10 +272,8 @@ class ContinuousBatcher:
         reproduces the same tokens regardless of pool contents or arrival
         order."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
-        if len(prompt) == 0 or len(prompt) > self.prompt_pad:
-            raise ValueError(
-                f"prompt length {len(prompt)} not in [1, {self.prompt_pad}]"
-            )
+        if len(prompt) == 0:
+            raise ValueError("prompt must have at least one token")
         if len(prompt) + max_new_tokens > self.max_len:
             raise ValueError(
                 f"prompt {len(prompt)} + max_new_tokens {max_new_tokens} "
@@ -262,8 +284,6 @@ class ContinuousBatcher:
         except ValueError:
             raise RuntimeError("no free slot; call step()/drain() first") from None
 
-        padded = np.zeros((1, self.prompt_pad), np.int32)
-        padded[0, : len(prompt)] = prompt
         rid = self._next_rid
         self._next_rid += 1
         # this request's private stream: (server seed, namespace, request
@@ -275,9 +295,24 @@ class ContinuousBatcher:
         )
         req_key = jax.random.fold_in(base, rid if seed is None else seed)
         prefill_key, slot_key = jax.random.split(req_key)
-        self.cache, first = self._prefill(
-            self.prepared, self.cache, jnp.asarray(padded), len(prompt),
-            slot, prefill_key,
+
+        # chunked prefill: full prompt_pad-sized chunks + one padded tail,
+        # each at its absolute start position — prompts of ANY length (up
+        # to max_len - max_new) reuse the one compiled chunk program
+        p_pad = self.prompt_pad
+        n_chunks = -(-len(prompt) // p_pad)
+        padded = np.zeros((1, n_chunks * p_pad), np.int32)
+        padded[0, : len(prompt)] = prompt
+        row = self._new_row()
+        logits = None
+        for c in range(n_chunks):
+            logits, row = self._prefill_chunk(
+                self.prepared, row,
+                jnp.asarray(padded[:, c * p_pad:(c + 1) * p_pad]), c * p_pad,
+            )
+        last_local = len(prompt) - 1 - (n_chunks - 1) * p_pad
+        self.cache, first = self._prefill_finish(
+            self.cache, row, logits, last_local, slot, prefill_key,
         )
         first = int(first)
         self.pos = self.pos.at[slot].set(len(prompt))

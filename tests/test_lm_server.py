@@ -103,8 +103,9 @@ def test_bad_prompt_rejected(lm_server):
 
     c = NodeClient(f"127.0.0.1:{PORT}")
     with pytest.raises((grpc.RpcError, RuntimeError)):
-        # prompt longer than prompt_pad=16 -> INVALID_ARGUMENT
-        c.generate(np.arange(30, dtype=np.int32), max_new_tokens=4)
+        # prompt + budget exceeding max_len=64 -> INVALID_ARGUMENT
+        # (prompts longer than prompt_pad alone are fine: chunked prefill)
+        c.generate(np.arange(70, dtype=np.int32) % 256, max_new_tokens=4)
     with pytest.raises((grpc.RpcError, RuntimeError)):
         # float payload -> INVALID_ARGUMENT (not silently truncated)
         c.send_tensor(np.zeros(4, np.float32), request_id="gen:4")

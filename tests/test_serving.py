@@ -144,22 +144,51 @@ def test_budget_validation(setup):
     srv = ContinuousBatcher(cfg, prepared, slots=1, max_len=32, prompt_pad=8)
     with pytest.raises(ValueError, match="exceeds max_len"):
         srv.submit(np.arange(1, 8), max_new_tokens=30)
-    with pytest.raises(ValueError, match="not in"):
-        srv.submit(np.arange(1, 12), max_new_tokens=4)  # > prompt_pad
+    with pytest.raises(ValueError, match="at least one token"):
+        srv.submit(np.array([], np.int32), max_new_tokens=4)
+    # > prompt_pad is no longer an error: it prefills in chunks
+    rid = srv.submit(np.arange(1, 12) % cfg.vocab_size, max_new_tokens=4)
+    assert rid in srv.drain()
 
 
 def test_one_prefill_one_decode_program(setup):
-    """The batcher's compile story: ONE prefill program and ONE decode
-    program total, across mixed prompt lengths and slots. true_len and
-    slot enter `_prefill` as traced scalars (dynamic jit args), so
-    distinct (length, slot) pairs must NOT trigger recompiles — this pins
-    the "two compiled programs total" claim in the module docstring."""
+    """The batcher's compile story: ONE prefill-chunk program, ONE finish
+    program, ONE decode program — across mixed prompt lengths (including
+    multi-chunk prompts longer than prompt_pad), slots, and chunk counts.
+    Positions/slots enter as traced scalars, so no combination may
+    retrace — this pins the "three compiled programs" claim in the
+    module docstring."""
     cfg, prepared = setup
     srv = ContinuousBatcher(cfg, prepared, slots=4, max_len=64, prompt_pad=16)
-    for plen in (3, 5, 9, 12):  # different lengths, different slots
+    for plen in (3, 12, 20, 37):  # 1-chunk, 1-chunk, 2-chunk, 3-chunk
         srv.submit(np.arange(1, plen + 1) % cfg.vocab_size, max_new_tokens=4)
     srv.drain()
-    assert srv._prefill._cache_size() == 1, (
-        f"prefill compiled {srv._prefill._cache_size()}x — per-(len, slot) "
-        "retraces are back")
+    assert srv._prefill_chunk._cache_size() == 1, (
+        f"prefill chunk compiled {srv._prefill_chunk._cache_size()}x")
+    assert srv._prefill_finish._cache_size() == 1
     assert srv._decode._cache_size() == 1
+
+
+def test_long_prompt_chunked_prefill_matches_solo(setup):
+    """A prompt longer than prompt_pad prefills in chunks and still
+    reproduces the solo batch-1 decode token-for-token."""
+    cfg, prepared = setup
+    srv = ContinuousBatcher(cfg, prepared, slots=2, max_len=64, prompt_pad=8)
+    prompt = (np.arange(1, 22) * 3) % cfg.vocab_size  # 21 tokens = 3 chunks
+    rid = srv.submit(prompt, max_new_tokens=6)
+    got = srv.drain()[rid]
+    want = np.asarray(_solo(cfg, prepared, prompt, 6))
+    np.testing.assert_array_equal(got, want)
+
+
+def test_chunked_prefill_non_divisible_max_len(setup):
+    """Regression (review repro): max_len not a multiple of prompt_pad —
+    the tail chunk must not have its cache write clamped back onto real
+    prompt positions. 17-token prompt, prompt_pad=8, max_len=20."""
+    cfg, prepared = setup
+    srv = ContinuousBatcher(cfg, prepared, slots=2, max_len=20, prompt_pad=8)
+    prompt = (np.arange(1, 18) * 5) % cfg.vocab_size  # 17 tokens, 3 chunks
+    rid = srv.submit(prompt, max_new_tokens=3)
+    got = srv.drain()[rid]
+    want = np.asarray(_solo(cfg, prepared, prompt, 3))
+    np.testing.assert_array_equal(got, want)
