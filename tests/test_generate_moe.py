@@ -146,3 +146,27 @@ def test_moe_speculative_greedy_parity():
     spec = make_speculative_generate(CFG_HI, g_cfg, max_new_tokens=n, k=3)
     got = np.asarray(spec(prepared, g_prep, ids, jax.random.PRNGKey(0)))
     np.testing.assert_array_equal(got, want)
+
+
+def test_moe_ep_pp_2d_decode_matches_dense(devices):
+    """EP x PP: experts sharded WITHIN each pipeline stage over a 2D
+    {stage, expert} mesh — the composition the dense-expert pipeline
+    decoder leaves out. Greedy parity vs the dense-grouped decoder."""
+    from dnn_tpu.parallel.mesh import STAGE_AXIS, make_mesh
+    from dnn_tpu.runtime.generate import prepare_pipeline_stacked
+    from dnn_tpu.runtime.generate_moe import make_pipeline_generate_moe_ep
+
+    _, prepared = _prepared(CFG_HI, seed=31)
+    mesh = make_mesh({STAGE_AXIS: 2, EXPERT_AXIS: 2}, devices[:4])
+    # reuse the stage-major reshape; expert leaves get re-placed inside
+    stage_mesh = make_mesh({STAGE_AXIS: 2}, devices[:2])
+    stage_blocks, aux = prepare_pipeline_stacked(prepared, CFG_HI, stage_mesh)
+    stage_blocks = jax.tree.map(np.asarray, stage_blocks)  # host copies
+
+    ids = jax.random.randint(jax.random.PRNGKey(32), (4, 6), 0,
+                             CFG_HI.vocab_size)
+    gen = make_pipeline_generate_moe_ep(CFG_HI, mesh, max_new_tokens=5)
+    got = np.asarray(gen(stage_blocks, aux, ids, jax.random.PRNGKey(0)))
+    want = np.asarray(make_generate_moe(CFG_HI, max_new_tokens=5, groups=2)(
+        prepared, ids, jax.random.PRNGKey(0)))
+    np.testing.assert_array_equal(got, want)
