@@ -432,6 +432,134 @@ def make_apply_seq_parallel(cfg: LlamaConfig, mesh, *, axis_name=None,
     return apply
 
 
+def make_generate_seq_sharded(cfg: LlamaConfig, mesh, *, max_new_tokens: int,
+                              temperature: float = 0.0,
+                              top_k: Optional[int] = None,
+                              top_p: Optional[float] = None,
+                              compute_dtype=None, axis_name=None):
+    """Sequence-sharded KV-cache decode for the LLaMA family: each device
+    of the "seq" axis owns a contiguous block of cache POSITIONS at
+    KV-head width, and every decode step combines per-shard partial
+    attention with the exact distributed online-softmax
+    (runtime/generate_seq.py's design — pmax + two psums, no K/V
+    movement), with the GQA query group folded into the stats rows and
+    RoPE at absolute positions. Token-parity with llama.make_generate
+    while each shard holds only ceil(S_max/n) positions.
+
+    NOTE: mirrors runtime/generate_seq.make_generate_seq_sharded's loop
+    (same reason as the EP x PP decoder's mirror — the per-family block
+    internals differ where that module's are GPT-fixed); drift is caught
+    by each file's parity tests against its own solo decoder."""
+    from dnn_tpu.parallel.mesh import SEQ_AXIS
+    from dnn_tpu.runtime.generate import _sample
+    from dnn_tpu.runtime.generate_seq import _local_attn_stats
+
+    if max_new_tokens < 1:
+        raise ValueError(f"max_new_tokens must be >= 1, got {max_new_tokens}")
+    axis = axis_name or SEQ_AXIS
+    n = mesh.shape[axis]
+    kv, g, hd = cfg.n_kv_head, cfg.n_head // cfg.n_kv_head, cfg.head_dim
+
+    def per_device(prepared, ids, rng):
+        b, t = ids.shape
+        s_max = t + max_new_tokens
+        sd = -(-s_max // n)
+        i = lax.axis_index(axis)
+        lo = i * sd
+
+        # prefill: full forward over a transient prompt-length KV-width
+        # cache; each device gathers its own position columns
+        prompt_cache = init_cache(cfg, b, t, compute_dtype or jnp.float32)
+        logits, prompt_cache = forward_with_cache(
+            prepared, ids, prompt_cache, 0, cfg=cfg,
+            compute_dtype=compute_dtype)
+        gpos = lo + jnp.arange(sd)
+        in_prompt = gpos < t
+        local = {
+            kk: jnp.where(
+                in_prompt[None, None, None, :, None],
+                jnp.take(prompt_cache[kk], jnp.clip(gpos, 0, t - 1), axis=3),
+                0,
+            )
+            for kk in ("k", "v")
+        }  # (L, B, KV, Sd, D)
+        rng, sub = jax.random.split(rng)
+        tok = _sample(logits[:, -1], sub, temperature=temperature,
+                      top_k=top_k, top_p=top_p)
+
+        def block_step(bp, x, lc_k, lc_v, p):
+            h = rms_norm(bp["ln_1"], x, eps=cfg.rms_eps)
+            q, k, v = _qkv_rope(bp, h, p + jnp.arange(1), cfg=cfg,
+                                compute_dtype=compute_dtype)
+            p_loc = jnp.clip(p - lo, 0, sd - 1)
+            own = jnp.logical_and(p >= lo, p < lo + sd)
+            lc_k = jnp.where(own, lax.dynamic_update_slice_in_dim(
+                lc_k, k.astype(lc_k.dtype), p_loc, axis=2), lc_k)
+            lc_v = jnp.where(own, lax.dynamic_update_slice_in_dim(
+                lc_v, v.astype(lc_v.dtype), p_loc, axis=2), lc_v)
+            local_limit = jnp.minimum(p - lo, sd - 1)
+            qg = q.reshape(b, kv, g, hd)  # fold group into stats rows
+            m, l, o = _local_attn_stats(qg, lc_k, lc_v, local_limit)
+            g_m = lax.pmax(m, axis)
+            w = jnp.exp(m - g_m)
+            g_l = lax.psum(l * w, axis)
+            g_o = lax.psum(o * w[..., None], axis)
+            y = g_o / jnp.maximum(g_l, 1e-30)[..., None]
+            y = y.reshape(b, cfg.n_head, 1, hd)
+            x = x + linear(bp["attn"]["o"], merge_heads(y.astype(x.dtype)),
+                           compute_dtype=compute_dtype)
+            return (_mlp_residual(bp, x, cfg=cfg,
+                                  compute_dtype=compute_dtype),
+                    lc_k, lc_v)
+
+        def decode_one(local, tok, rng, p):
+            x = embedding(prepared["wte"], tok[:, None])
+            if compute_dtype is not None:
+                x = x.astype(compute_dtype)
+
+            def layer(carry, layer_in):
+                bp, lk, lv = layer_in
+                y, lk, lv = block_step(bp, carry, lk, lv, p)
+                return y, (lk, lv)
+
+            x, (k_new, v_new) = lax.scan(
+                layer, x, (prepared["blocks"], local["k"], local["v"]))
+            logits = head(prepared, x.astype(jnp.float32), cfg=cfg,
+                          compute_dtype=compute_dtype)
+            rng, sub = jax.random.split(rng)
+            nxt = _sample(logits[:, -1], sub, temperature=temperature,
+                          top_k=top_k, top_p=top_p)
+            return {"k": k_new, "v": v_new}, nxt, rng
+
+        def step(carry, j):
+            local, tok, rng = carry
+            local, nxt, rng = decode_one(local, tok, rng, t + j)
+            return (local, nxt, rng), tok
+
+        (_, last, _), toks = lax.scan(
+            step, (local, tok, rng), jnp.arange(max_new_tokens - 1))
+        toks = jnp.moveaxis(toks, 0, 1)
+        return jnp.concatenate([toks, last[:, None]], axis=1)
+
+    @jax.jit
+    def generate(prepared, ids, rng):
+        from jax.sharding import PartitionSpec as P
+
+        b, t = ids.shape
+        if t + max_new_tokens > cfg.block_size:
+            raise ValueError(
+                f"prompt {t} + max_new_tokens {max_new_tokens} exceeds "
+                f"block_size {cfg.block_size}")
+        return jax.shard_map(
+            per_device, mesh=mesh,
+            in_specs=(P(), P(), P()),
+            out_specs=P(),
+            check_vma=False,
+        )(prepared, ids, rng)
+
+    return generate
+
+
 class LlamaFamilyRows:
     """ContinuousBatcher family adapter (see
     runtime/serving.GPTFamilyRows for the protocol): per-slot LLaMA decode

@@ -135,7 +135,7 @@ def make_pipeline_generate_moe_ep(cfg: GPTMoEConfig, mesh, *,
                                   sample_top_k: Optional[int] = None,
                                   sample_top_p: Optional[float] = None,
                                   compute_dtype=None,
-                                  stage_axis: str = None,
+                                  stage_axis: Optional[str] = None,
                                   expert_axis: str = EXPERT_AXIS):
     """EP x PP 2D MoE decode: layers shard over the STAGE axis (the
     ppermute decode ring) while each stage's experts shard over the

@@ -68,3 +68,41 @@ def test_seq_sharded_rejects_overlong(devices):
     gen = make_generate_seq_sharded(CFG, mesh, max_new_tokens=60)
     with pytest.raises(ValueError, match="block_size"):
         gen(prepared, jnp.zeros((1, 10), jnp.int32), jax.random.PRNGKey(0))
+
+
+@pytest.mark.parametrize("n", [2, 4])
+def test_llama_seq_sharded_matches_solo(n, devices):
+    """LLaMA sequence-sharded decode (KV-head-width position shards, GQA
+    fold over the distributed softmax) == the solo LLaMA decoder."""
+    from dnn_tpu.models import llama
+
+    lcfg = llama.PRESETS["llama-test"]
+    prepared = gpt.prepare_stacked(
+        llama.init(jax.random.PRNGKey(40), lcfg), lcfg)
+    mesh = make_mesh({SEQ_AXIS: n}, devices[:n])
+    ids = jax.random.randint(jax.random.PRNGKey(41), (2, 9), 0,
+                             lcfg.vocab_size)
+    n_new = 7  # context 16: shards of 8 (n=2) / 4 (n=4), both exact
+    gen = llama.make_generate_seq_sharded(
+        lcfg, mesh, max_new_tokens=n_new, temperature=0.9, top_k=40)
+    got = np.asarray(gen(prepared, ids, jax.random.PRNGKey(5)))
+    want = np.asarray(llama.make_generate(
+        lcfg, max_new_tokens=n_new, temperature=0.9, top_k=40)(
+        prepared, ids, jax.random.PRNGKey(5)))
+    np.testing.assert_array_equal(got, want)
+
+
+def test_llama_seq_sharded_ragged_tail(devices):
+    from dnn_tpu.models import llama
+
+    lcfg = llama.PRESETS["llama-test"]
+    prepared = gpt.prepare_stacked(
+        llama.init(jax.random.PRNGKey(42), lcfg), lcfg)
+    mesh = make_mesh({SEQ_AXIS: 4}, devices[:4])
+    ids = jax.random.randint(jax.random.PRNGKey(43), (1, 7), 0,
+                             lcfg.vocab_size)
+    gen = llama.make_generate_seq_sharded(lcfg, mesh, max_new_tokens=6)
+    got = np.asarray(gen(prepared, ids, jax.random.PRNGKey(0)))
+    want = np.asarray(llama.make_generate(lcfg, max_new_tokens=6)(
+        prepared, ids, jax.random.PRNGKey(0)))
+    np.testing.assert_array_equal(got, want)
