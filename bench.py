@@ -80,8 +80,8 @@ def bench_jax_cpu():
     return BATCH * SEQ / dt
 
 
-def _backend_alive(deadline_s: float = 240.0) -> bool:
-    """Probe the default backend in a SUBPROCESS with a hard deadline.
+def _probe_once(deadline_s: float) -> bool:
+    """One subprocess probe of the default backend with a hard deadline.
 
     Round-2 lesson (BENCH_r02.json, rc=1): a wedged TPU plugin hangs at
     backend init inside the first device op — in-process there is nothing
@@ -110,6 +110,29 @@ def _backend_alive(deadline_s: float = 240.0) -> bool:
     except subprocess.TimeoutExpired:
         proc.kill()
         return False
+
+
+def _backend_alive(deadlines_s=(90.0, 180.0, 300.0),
+                   backoff_s: float = 30.0) -> bool:
+    """Bounded retry-with-backoff around the probe. Round-3 lesson
+    (BENCH_r03.json): a single probe attempt means one TRANSIENT backend
+    wedge (driver restart, tunnel blip) costs the whole round's TPU
+    headline. Deadlines ESCALATE so a slow-but-healthy cold init (plugin
+    bringup + first-op compile can take minutes) is never mistaken for a
+    wedge: the last attempt allows 300 s, beyond the longest healthy init
+    observed, while a genuinely dead chip still falls back to the honest
+    CPU row in ~11 min worst case."""
+    import sys
+
+    n = len(deadlines_s)
+    for i, deadline in enumerate(deadlines_s):
+        if _probe_once(deadline):
+            return True
+        print(f"[bench] backend probe attempt {i + 1}/{n} failed "
+              f"({deadline:.0f}s deadline)", file=sys.stderr)
+        if i + 1 < n:
+            time.sleep(backoff_s * (i + 1))
+    return False
 
 
 def main():

@@ -173,12 +173,13 @@ def run_device_section():
     head_dim = cfg.n_embd  # per layer: H * D = C
     cache_elems = 2 * cfg.n_layer * b * head_dim * s_max  # K and V
     q_prepared = quantize_gpt(prepared)
+    bf16_prepared = _to_bf16(prepared)
     variants = (
         # kv dtype must be EXPLICIT f32 for the baseline: with kv=None,
         # make_generate follows compute_dtype (bf16 here) and the "f32
         # cache" row would silently run a bf16 cache
         ("w_f32_kv_f32", prepared, jnp.float32, 4),
-        ("w_bf16_kv_bf16", _to_bf16(prepared), jnp.bfloat16, 2),
+        ("w_bf16_kv_bf16", bf16_prepared, jnp.bfloat16, 2),
         ("w_int8_kv_bf16", q_prepared, jnp.bfloat16, 2),
         ("w_int8_kv_int8", q_prepared, "int8", 1),
     )
@@ -202,6 +203,29 @@ def run_device_section():
         _emit(results, config=f"gpt2_decode_{name}", metric="tokens_per_sec",
               value=round(tps, 1), platform=platform, batch=b,
               new_tokens=new_tokens, **row)
+
+    # top_p decode tax: nucleus sampling rides a static top-k prefilter
+    # (generate.TOP_P_PREFILTER_K ranked candidates + an O(V) logsumexp
+    # instead of a full-vocab sort per step). Both legs sample at
+    # temperature=1.0 so the delta isolates the FILTER's cost, not the
+    # cost of stochastic sampling itself.
+    tps_by_mode = {}
+    for mode, tp in (("off", None), ("on", 0.9)):
+        gfn = gen.make_generate(
+            cfg, max_new_tokens=new_tokens, compute_dtype=jnp.bfloat16,
+            kv_dtype=jnp.bfloat16, temperature=1.0, top_p=tp,
+        )
+        dt = device_time(gfn, bf16_prepared, ids, rng, n1=1, n2=3)
+        tps_by_mode[mode] = b * new_tokens / dt
+    overhead = tps_by_mode["off"] / tps_by_mode["on"] - 1.0
+    _emit(results, config="gpt2_decode_top_p_tax", metric="overhead_pct",
+          value=round(overhead * 100, 2), platform=platform, batch=b,
+          new_tokens=new_tokens,
+          tps_top_p_off=round(tps_by_mode["off"], 1),
+          tps_top_p_on=round(tps_by_mode["on"], 1),
+          note=f"top_p=0.9 via top-{gen.TOP_P_PREFILTER_K} prefilter "
+               "(bit-identical to the full-vocab filter when the nucleus "
+               "fits inside k)")
     return results
 
 
@@ -375,9 +399,37 @@ def _run_subprocess(section, extra_env):
             if l.startswith("{")]
 
 
+def _provenance():
+    """Commit/date/platform stamp so a reader can always tell whether the
+    table matches the harness that claims to produce it (round-3 lesson:
+    RESULTS.md silently predated run_all.py's own additions)."""
+    import datetime
+
+    try:
+        rev = subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"], capture_output=True,
+            text=True, cwd=REPO, timeout=10).stdout.strip() or "unknown"
+        dirty = subprocess.run(
+            ["git", "status", "--porcelain"], capture_output=True,
+            text=True, cwd=REPO, timeout=10).stdout.strip()
+        if dirty:
+            rev += "-dirty"
+    except Exception:
+        rev = "unknown"
+    stamp = datetime.datetime.now(datetime.timezone.utc).strftime(
+        "%Y-%m-%d %H:%M UTC")
+    return rev, stamp
+
+
 def write_results_md(rows, path):
+    rev, stamp = _provenance()
+    platforms = sorted({r.get("platform", "?") for r in rows
+                        if r.get("platform") != "cpu-mesh"})
     lines = [
         "# Benchmark results (measured)",
+        "",
+        f"Generated at commit `{rev}` on {stamp}; device-section platform: "
+        f"{', '.join(platforms) or 'none (device section skipped)'}.",
         "",
         "Produced by `python benchmarks/run_all.py`. The reference publishes",
         "no numbers (SURVEY §6); BASELINE.md maps these configs to its",

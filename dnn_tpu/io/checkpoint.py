@@ -347,8 +347,10 @@ def llama_params_from_state_dict(sd: Dict[str, np.ndarray],
                 "down": {"kernel": _t_linear(sd[p + "mlp.down_proj.weight"])},
             },
         }
-    # lm_head: explicit if present, else tied to the embedding (LLaMA-3.2
-    # and TinyLlama tie; 7B-class models don't)
+    # lm_head: explicit if present, else tied to the embedding
+    # (LLaMA-3.2/Gemma-class models tie; TinyLlama-1.1B ships
+    # tie_word_embeddings=false with an explicit lm_head.weight, as do
+    # the 7B-class models)
     if "lm_head.weight" in sd:
         params["lm_head"] = {"kernel": _t_linear(sd["lm_head.weight"])}
     else:

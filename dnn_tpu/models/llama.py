@@ -658,6 +658,7 @@ class LlamaPipelineFamily:
 def make_pipeline_generate(cfg: LlamaConfig, mesh, *, max_new_tokens: int,
                            temperature: float = 0.0,
                            top_k: Optional[int] = None,
+                           top_p: Optional[float] = None,
                            compute_dtype=None, axis_name=None,
                            kv_dtype=None):
     """Pipeline-parallel KV-cache generation for the LLaMA family: each
@@ -670,7 +671,7 @@ def make_pipeline_generate(cfg: LlamaConfig, mesh, *, max_new_tokens: int,
     )
 
     return _mk(cfg, mesh, max_new_tokens=max_new_tokens,
-               temperature=temperature, top_k=top_k,
+               temperature=temperature, top_k=top_k, top_p=top_p,
                compute_dtype=compute_dtype, axis_name=axis_name,
                family=LlamaPipelineFamily(cfg, compute_dtype=compute_dtype,
                                           kv_dtype=kv_dtype))

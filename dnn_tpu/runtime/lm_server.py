@@ -109,8 +109,8 @@ class _BatcherWorker(threading.Thread):
         queued futures are cancelled here, admitted ones by the loop on
         its next iteration (the worker must not keep stepping the device
         after close())."""
-        if not drain:
-            with self._lock:
+        with self._lock:
+            if not drain:
                 self._abandon = True
                 if self._dead is None:
                     self._dead = RuntimeError("LM server shut down")
@@ -120,6 +120,13 @@ class _BatcherWorker(threading.Thread):
                     except queue.Empty:
                         break
                     fut.cancel()
+            elif self._dead is None:
+                # drain path: mark dead BEFORE signaling stop so a submit
+                # racing the loop's final pool-empty/queue-empty check fails
+                # fast instead of enqueueing a future after the thread
+                # exits (which would hang its caller for request_timeout).
+                # Items already queued under the lock are still drained.
+                self._dead = RuntimeError("LM server shutting down")
         self._stop_evt.set()
 
     # ------------------------------------------------------------------
@@ -136,6 +143,23 @@ class _BatcherWorker(threading.Thread):
         b = self.batcher
         for rid in [r for r in self._futures if r in b.results]:
             self._futures.pop(rid).set_result(b.results.pop(rid))
+
+    def _shutdown_drain_queue(self):
+        """Final drain-path exit step, under _lock: mark dead and fail any
+        future that slipped into the queue between the loop's last
+        queue-empty check and its stop-event check (the TOCTOU window —
+        submit saw _dead=None and enqueued just before stop() marked dead).
+        Failing fast here bounds that racer to an immediate shutdown error
+        instead of a request_timeout hang."""
+        with self._lock:
+            if self._dead is None:
+                self._dead = RuntimeError("LM server shutting down")
+            while True:
+                try:
+                    *_rest, fut = self.q.get_nowait()
+                except queue.Empty:
+                    return
+                fut.set_exception(self._dead)
 
     def _fail_all(self, exc):
         with self._lock:
@@ -162,6 +186,7 @@ class _BatcherWorker(threading.Thread):
                 return
             if b.n_active == 0 and self.q.empty():
                 if self._stop_evt.is_set():
+                    self._shutdown_drain_queue()
                     return
                 try:
                     self._admit(*self.q.get(timeout=0.1))
@@ -250,6 +275,16 @@ class LMServer:
             await context.abort(
                 grpc.StatusCode.INVALID_ARGUMENT,
                 f"prompt must be integer token ids, got dtype {prompt.dtype}")
+        # the raw-id front must guard the vocab range itself (the text
+        # front's tokenizer can't emit out-of-vocab ids): JAX's clip-mode
+        # gather would otherwise silently substitute edge-of-table
+        # embeddings and generate plausible output from a corrupt prompt
+        vocab = self.batcher.cfg.vocab_size
+        if prompt.size and (prompt.min() < 0 or prompt.max() >= vocab):
+            await context.abort(
+                grpc.StatusCode.INVALID_ARGUMENT,
+                f"prompt token ids must be in [0, {vocab}), got range "
+                f"[{prompt.min()}, {prompt.max()}]")
         tokens = await self._submit_and_await(prompt, request.request_id, context)
         return pb.TensorResponse(
             status=f"[lm] ok: {len(tokens)} tokens",

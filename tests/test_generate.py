@@ -112,6 +112,62 @@ def test_top_p_sampling():
     assert int(g[0]) == 0
 
 
+def test_top_p_prefilter_matches_full_vocab_filter():
+    """The static top-k prefilter (TOP_P_PREFILTER_K candidates ranked
+    instead of a full-vocab sort) must be DISTRIBUTION-IDENTICAL to the
+    full filter whenever the nucleus fits inside k — proven the strong
+    way: same filtered logits -> same categorical draw per key."""
+    from dnn_tpu.runtime.generate import (
+        _NEG_BIG,
+        _sample,
+        TOP_P_PREFILTER_K,
+    )
+
+    rng = np.random.default_rng(0)
+    V = 4096  # > TOP_P_PREFILTER_K so the prefilter actually engages
+    # peaked logits (trained-LM-like): top-256 holds essentially all mass
+    logits_np = (7.0 * rng.standard_normal((3, V))).astype(np.float32)
+    probs = np.exp(logits_np - logits_np.max(-1, keepdims=True))
+    probs /= probs.sum(-1, keepdims=True)
+    top_mass = np.sort(probs, -1)[:, ::-1][:, :TOP_P_PREFILTER_K].sum(-1)
+    assert (top_mass > 0.999).all(), "fixture must keep nucleus inside k"
+
+    for p in (0.1, 0.5, 0.9, 0.99):
+        # reference: the full-vocab sort filter, in numpy
+        order = np.argsort(-logits_np, axis=-1)
+        sp = np.take_along_axis(probs, order, axis=-1)
+        cum = np.cumsum(sp, axis=-1)
+        keep = (cum - sp) < p
+        n_keep = np.maximum(keep.sum(-1), 1)
+        thresh = np.take_along_axis(
+            np.take_along_axis(logits_np, order, -1), (n_keep - 1)[:, None], -1)
+        ref_filtered = np.where(logits_np < thresh, _NEG_BIG, logits_np)
+
+        for i in range(20):
+            key = jax.random.PRNGKey(i)
+            want = np.asarray(jax.random.categorical(
+                key, jnp.asarray(ref_filtered), axis=-1))
+            got = np.asarray(_sample(jnp.asarray(logits_np), key,
+                                     temperature=1.0, top_k=None, top_p=p))
+            np.testing.assert_array_equal(got, want)
+
+
+def test_top_p_prefilter_overflow_truncates_to_top_k():
+    """When the nucleus would exceed TOP_P_PREFILTER_K tokens (near-flat
+    logits, p -> 1), the prefilter truncates to the k best — a strictly
+    tighter cut, so every draw still comes from the top-k set."""
+    from dnn_tpu.runtime.generate import _sample, TOP_P_PREFILTER_K
+
+    rng = np.random.default_rng(1)
+    V = 2048
+    logits_np = (0.01 * rng.standard_normal((1, V))).astype(np.float32)
+    top_set = set(np.argsort(-logits_np[0])[:TOP_P_PREFILTER_K].tolist())
+    for i in range(50):
+        t = int(_sample(jnp.asarray(logits_np), jax.random.PRNGKey(i),
+                        temperature=1.0, top_k=None, top_p=0.999)[0])
+        assert t in top_set
+
+
 def test_generate_with_top_p_runs_and_reproduces():
     _, prepared = _prepared()
     ids = jnp.zeros((2, 4), jnp.int32)

@@ -96,6 +96,60 @@ def test_dead_worker_surfaces_unavailable_over_grpc():
         stop()
 
 
+def test_stop_drain_true_fails_late_submits_fast():
+    """A submit racing (or following) a drain shutdown must fail fast, not
+    enqueue a future the exited worker will never resolve."""
+    srv = ContinuousBatcher(CFG, _prepared(seed=3), slots=1, max_len=32,
+                            prompt_pad=8)
+    worker = _BatcherWorker(srv)
+    worker.start()
+    fut = worker.submit(np.array([1, 2, 3], np.int32), 4, None)
+    worker.stop(drain=True)
+    # the pre-stop submit still drains to a real result
+    assert fut.result(timeout=60).shape == (4,)
+    worker.join(timeout=20)
+    assert not worker.is_alive()
+    # a post-stop submit resolves immediately with shutdown, not a hang
+    t0 = time.monotonic()
+    fut2 = worker.submit(np.array([4, 5], np.int32), 4, None)
+    with pytest.raises(RuntimeError, match="shutting down"):
+        fut2.result(timeout=5)
+    assert time.monotonic() - t0 < 5
+
+
+def test_out_of_range_prompt_ids_rejected_over_grpc():
+    """Raw-id prompts outside [0, vocab_size) must abort INVALID_ARGUMENT
+    instead of silently gathering edge-of-table embeddings."""
+    from dnn_tpu.runtime.lm_server import start_lm_server_in_background
+
+    port = 59317
+    t, stop = start_lm_server_in_background(
+        CFG, _prepared(seed=4), port=port, slots=1, max_len=32,
+        prompt_pad=8, default_max_new=4)
+    try:
+        c = NodeClient(f"127.0.0.1:{port}")
+        for bad in (np.array([0, CFG.vocab_size], np.int32),
+                    np.array([-1, 2], np.int32)):
+            with pytest.raises(grpc.RpcError) as ei:
+                c.generate(bad, max_new_tokens=2)
+            assert ei.value.code() == grpc.StatusCode.INVALID_ARGUMENT
+        # boundary ids are fine
+        ok = c.generate(np.array([0, CFG.vocab_size - 1], np.int32),
+                        max_new_tokens=2)
+        assert ok.shape == (2,)
+        c.close()
+    finally:
+        stop()
+
+
+def test_submit_rejects_nonpositive_budget():
+    srv = ContinuousBatcher(CFG, _prepared(seed=5), slots=1, max_len=32,
+                            prompt_pad=8)
+    for bad in (0, -3):
+        with pytest.raises(ValueError, match="max_new_tokens"):
+            srv.submit(np.array([1, 2], np.int32), max_new_tokens=bad)
+
+
 def test_stop_drain_false_cancels_quickly():
     """Non-drain shutdown abandons an in-flight long generation instead of
     stepping the device to completion."""
