@@ -270,6 +270,116 @@ def make_apply_stacked(cfg: GPTConfig, *, use_flash=False, compute_dtype=None,
     return apply
 
 
+def prepare_tp_blocks(stacked_blocks, cfg: GPTConfig, tp: int):
+    """One-time load-side transform for MANUAL (shard_map) tensor
+    parallelism over the fused-qkv layout: reorder the qkv output columns
+    SHARD-MAJOR so that slicing the last axis into `tp` equal parts hands
+    each tensor-parallel rank its own n_head/tp heads of q, k AND v
+    contiguously.
+
+    The fused kernel stores columns as [Q(C) | K(C) | V(C)] (one matmul —
+    ops/attention.py:52); naively sharding that axis would give rank 0 all
+    of Q plus half of K at tp=2, which no local attention can use. After
+    the reorder the columns read [Q_0 K_0 V_0 | Q_1 K_1 V_1 | ...] where
+    X_t is rank t's head slice, so the sharded local (C, 3C/tp) kernel
+    splits into three (C, C/tp) head-aligned pieces (make_tp_block_fn).
+    attn.proj / mlp.* need no reorder: merged heads already put rank t's
+    activation columns at rows [t*C/tp, (t+1)*C/tp) of the row-sharded
+    projection, and the MLP hidden axis is a single contiguous block.
+
+    Works on any leaf layout whose LAST axis is the fused 3C — per-layer,
+    (L, ...)-stacked, or (S, L/S, ...)-stage-stacked trees alike."""
+    if cfg.n_head % tp:
+        raise ValueError(f"n_head {cfg.n_head} not divisible by tp {tp}")
+    c = cfg.n_embd
+    shard = c // tp
+
+    def reorder(a):  # (..., 3C) -> (..., 3C) shard-major
+        q, k, v = a[..., :c], a[..., c:2 * c], a[..., 2 * c:]
+        parts = []
+        for t in range(tp):
+            sl = slice(t * shard, (t + 1) * shard)
+            parts += [q[..., sl], k[..., sl], v[..., sl]]
+        return jnp.concatenate(parts, axis=-1)
+
+    return {
+        **stacked_blocks,
+        "attn": {
+            **stacked_blocks["attn"],
+            "qkv": {
+                "kernel": reorder(stacked_blocks["attn"]["qkv"]["kernel"]),
+                "bias": reorder(stacked_blocks["attn"]["qkv"]["bias"]),
+            },
+        },
+    }
+
+
+def make_tp_block_fn(cfg: GPTConfig, *, axis_name=None, compute_dtype=None,
+                     remat=False):
+    """Tensor-parallel stacked-block function for the pipeline runtimes —
+    the Megatron recipe inside shard_map (TP x PP composition):
+
+      * qkv and mlp.fc are COLUMN-parallel: the local kernel holds this
+        rank's output slice ((C, 3C/tp) head-aligned via prepare_tp_blocks,
+        (C, 4C/tp) hidden slice), operand replicated, no communication;
+      * attention runs on the rank's own n_head/tp heads (heads are
+        independent, so local heads need no collective);
+      * attn.proj and mlp.proj are ROW-parallel: local (C/tp, C) /
+        (4C/tp, C) kernels produce partial sums combined by one
+        `lax.psum`, with the replicated bias added ONCE after the reduce.
+
+    Two psums per block over the `model` axis — the standard Megatron
+    count. Unlike classic Megatron there is NO explicit conjugate `f`/`g`
+    operator at the column-parallel inputs: shard_map's AD tracks per-axis
+    replication and inserts the exact transposes itself (gradient parity
+    vs the 1D pipeline is pinned by tests/test_tp_pp.py — see the note in
+    parallel/collectives.py). Returns block_fn(local_stacked, x) for
+    `spmd_pipeline_stacked(..., model_axis=...)`, where local_stacked
+    leaves carry (L_per_stage, ...) with model-sharded trailing dims.
+    `remat=True` checkpoints each block body (backward recomputes block
+    internals; the two forward psums replay in the recompute)."""
+    from jax import lax
+
+    from dnn_tpu.ops.pallas.flash_attention import reference_attention
+    from dnn_tpu.parallel.mesh import MODEL_AXIS
+
+    axis = axis_name or MODEL_AXIS
+
+    def one_block(bp, x):
+        tp = lax.axis_size(axis)
+        local_heads = cfg.n_head // tp
+        from dnn_tpu.ops.attention import merge_heads, split_heads
+
+        h = layer_norm(bp["ln_1"], x, eps=cfg.ln_eps)
+        qkv = linear(bp["attn"]["qkv"], h, compute_dtype=compute_dtype)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q, k, v = (split_heads(t, local_heads) for t in (q, k, v))
+        y = merge_heads(reference_attention(q, k, v, causal=True))
+        att = linear({"kernel": bp["attn"]["proj"]["kernel"]}, y,
+                     compute_dtype=compute_dtype)
+        att = lax.psum(att, axis) + bp["attn"]["proj"]["bias"].astype(x.dtype)
+        x = x + att
+
+        h = layer_norm(bp["ln_2"], x, eps=cfg.ln_eps)
+        m = gelu(linear(bp["mlp"]["fc"], h, compute_dtype=compute_dtype))
+        mm = linear({"kernel": bp["mlp"]["proj"]["kernel"]}, m,
+                    compute_dtype=compute_dtype)
+        mm = lax.psum(mm, axis) + bp["mlp"]["proj"]["bias"].astype(x.dtype)
+        return x + mm
+
+    if remat:
+        one_block = jax.checkpoint(one_block)
+
+    def block_fn(local, x):
+        def body(carry, lp):
+            return one_block(lp, carry), None
+
+        out, _ = jax.lax.scan(body, x, local)
+        return out
+
+    return block_fn
+
+
 def make_apply_seq_parallel(cfg: GPTConfig, mesh, *, axis_name=None,
                             compute_dtype=None, method: str = "ring"):
     """Sequence-parallel (long-context) full-model forward.

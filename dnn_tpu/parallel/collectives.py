@@ -59,6 +59,16 @@ def from_last_to_first(x, axis_name: str):
 def psum(x, axis_name: str):
     return lax.psum(x, axis_name)
 
+# NOTE on manual tensor parallelism (gpt.make_tp_block_fn): classic
+# Megatron needs an explicit conjugate collective pair (`f`/`g`: identity
+# fwd + all-reduce bwd at column-parallel inputs, all-reduce fwd +
+# identity bwd at row-parallel outputs). Under jax.shard_map a bare
+# `lax.psum` at the row-parallel output is sufficient — shard_map's AD
+# tracks per-axis replication and emits the exact transposes itself
+# (verified by gradient-parity tests in tests/test_tp_pp.py; hand-written
+# custom_vjp equivalents of the Megatron pair actually BREAK that
+# accounting and scale sharded-leaf grads by 1/tp — don't add them back).
+
 
 def all_gather(x, axis_name: str, *, axis=0, tiled=False):
     return lax.all_gather(x, axis_name, axis=axis, tiled=tiled)

@@ -770,6 +770,7 @@ def spmd_pipeline_stacked(
     num_microbatches: int = 1,
     axis_name: str = STAGE_AXIS,
     data_axis: Optional[str] = None,
+    param_specs=None,
 ):
     """Homogeneous-stage SPMD pipeline over stacked params.
 
@@ -787,7 +788,17 @@ def spmd_pipeline_stacked(
     the axis), ppermute hops stay within a column, and under `jax.grad`
     the shard_map transpose psums the param cotangents over data columns
     automatically — dp×pp with no extra code at the call site.
-    """
+
+    `param_specs` composes TENSOR parallelism with the pipeline (TP x PP,
+    the Megatron 3D recipe with `data_axis`): a PartitionSpec pytree for
+    `stacked_params` whose leading dim is the stage axis and whose trailing
+    dims may shard over a `model` axis (e.g. train.gpt_tp_pp_specs). The
+    supplied `block_fn` must then be TP-aware — compute on its local weight
+    shard and combine partial sums over the model axis itself
+    (gpt.make_tp_block_fn). Activations stay replicated over the model
+    axis: hops ppermute within each model column, and the ring pays one
+    activation per hop regardless of tp. Default None keeps the 1D
+    P(stage) placement."""
     num_stages = mesh.shape[axis_name]
     x_mb = split_microbatches(x, num_microbatches)
     mb = x_mb.shape[1]
@@ -798,9 +809,13 @@ def spmd_pipeline_stacked(
         )
     mb_local = mb // d_size
 
-    param_specs = jax.tree.map(lambda _: P(axis_name), stacked_params)
-    stacked_params = jax.device_put(
-        stacked_params, NamedSharding(mesh, P(axis_name))
+    if param_specs is None:
+        param_specs = jax.tree.map(lambda _: P(axis_name), stacked_params)
+    # map over the PARAMS tree: flatten_up_to stops at its array leaves, so
+    # the P specs (themselves tuples) come through whole
+    stacked_params = jax.tree.map(
+        lambda p, s: jax.device_put(p, NamedSharding(mesh, s)),
+        stacked_params, param_specs,
     )
 
     # flatten trailing dims into the buffer width for the generic loop; the

@@ -136,6 +136,36 @@ def gpt_tp_specs_stacked(prepared, *, axis: str = MODEL_AXIS):
     return jax.tree_util.tree_map_with_path(spec_for, prepared)
 
 
+def gpt_tp_pp_specs(stage_stacked, *, stage_axis: str = STAGE_AXIS,
+                    model_axis: str = MODEL_AXIS):
+    """PartitionSpecs for TP x PP: the stage-stacked GPT block tree
+    ((S, L/S, ...) leaves) sharded over BOTH the pipeline and the tensor
+    axis, for `spmd_pipeline_stacked(..., param_specs=...)` with
+    `gpt.make_tp_block_fn` as the block function.
+
+    Megatron placement per leaf (leading (stage, layer) axes always
+    P(stage, None)):
+      * qkv / fc kernels (S, L/S, C, out): column-parallel — output
+        features shard over `model` (qkv must be shard-major reordered
+        first, gpt.prepare_tp_blocks); their biases shard with the columns;
+      * proj kernels (S, L/S, in, C): row-parallel — input features shard
+        over `model`; their biases replicate (added once after the psum);
+      * layer norms replicate over `model`.
+    """
+
+    def spec_for(path, leaf):
+        keys = [getattr(p, "key", getattr(p, "name", None)) for p in path]
+        if {"qkv", "fc"} & set(keys):
+            if leaf.ndim >= 4:  # kernel (S, L/S, in, out)
+                return P(stage_axis, None, None, model_axis)
+            return P(stage_axis, None, model_axis)  # bias (S, L/S, out)
+        if "proj" in keys and leaf.ndim >= 4:
+            return P(stage_axis, None, model_axis, None)
+        return P(stage_axis)  # norms + row-parallel biases
+
+    return jax.tree_util.tree_map_with_path(spec_for, stage_stacked)
+
+
 def specs_to_shardings(mesh: Mesh, specs):
     """PartitionSpec pytree -> NamedSharding pytree (specs are themselves
     pytrees, hence the is_leaf guard)."""
@@ -305,6 +335,7 @@ def make_pipeline_train_step(
     schedule: str = "gpipe",
     data_axis: Optional[str] = None,
     virtual_stages: int = 1,
+    param_specs=None,
 ):
     """Pipeline-parallel LM training step.
 
@@ -339,6 +370,16 @@ def make_pipeline_train_step(
     (pipeline.spmd_pipeline_interleaved). Differentiated through like
     gpipe; same loss/grads.
 
+    `param_specs` composes TENSOR parallelism inside each stage (TP x PP;
+    with `data_axis` too, the full Megatron 3D {data, stage, model}
+    recipe; gpipe schedule only): pass `gpt_tp_pp_specs(stacked)` plus a
+    TP-aware `block_fn` (gpt.make_tp_block_fn over
+    gpt.prepare_tp_blocks'd params). Grad/optimizer sharding follows the
+    param specs — each device updates only its own weight shard; the
+    shard_map transpose reassembles cross-shard cotangents exactly
+    (loss/grad parity vs the 1D pipeline is pinned by
+    tests/test_tp_pp.py).
+
     step(stacked, aux, opt_states, tokens) ->
         (stacked, aux, opt_states, loss_value)
     """
@@ -349,6 +390,11 @@ def make_pipeline_train_step(
         raise ValueError(
             "data_axis composition is implemented for the gpipe schedule "
             "only; 1f1b/interleaved run on a 1D stage mesh"
+        )
+    if param_specs is not None and schedule != "gpipe":
+        raise ValueError(
+            "param_specs (TP x PP) composition is implemented for the "
+            "gpipe schedule only"
         )
     if schedule == "interleaved" and virtual_stages < 2:
         raise ValueError(
@@ -369,6 +415,7 @@ def make_pipeline_train_step(
                     block_fn, stacked, x,
                     mesh=mesh, num_microbatches=num_microbatches,
                     axis_name=axis_name, data_axis=data_axis,
+                    param_specs=param_specs,
                 )
             logits = head_fn(aux, h)
             return loss(logits, tokens[:, 1:])
