@@ -204,6 +204,41 @@ def run_device_section():
               value=round(tps, 1), platform=platform, batch=b,
               new_tokens=new_tokens, **row)
 
+    # Pallas cached-attention decode kernel, before/after: same weights,
+    # same cache dtype, einsum vs kernel attention. Shapes chosen so the
+    # cache tiles the kernel's 128-blocks (prompt 128 + 128 new = S 256);
+    # TPU-only — off-TPU the kernel dispatches to the einsum fallback and
+    # the row would measure nothing.
+    if platform == "tpu":
+        kb, kprompt, knew = 8, 128, 128
+        k_ids = jax.random.randint(jax.random.PRNGKey(3), (kb, kprompt), 0,
+                                   cfg.vocab_size, dtype=jnp.int32)
+        k_smax = kprompt + knew
+        k_cache_elems = 2 * cfg.n_layer * kb * head_dim * k_smax
+        for name, weights, kv, cache_itemsize in (
+                ("w_bf16_kv_bf16", bf16_prepared, jnp.bfloat16, 2),
+                ("w_int8_kv_int8", q_prepared, "int8", 1)):
+            row = {}
+            for mode, ak in (("einsum", False), ("kernel", True)):
+                gfn = gen.make_generate(
+                    cfg, max_new_tokens=knew, compute_dtype=jnp.bfloat16,
+                    kv_dtype=kv, attn_kernel=ak,
+                )
+                dt = device_time(gfn, weights, k_ids, rng, n1=1, n2=3)
+                row[f"tps_{mode}"] = round(kb * knew / dt, 1)
+            bpt = (param_bytes(weights) + k_cache_elems * cache_itemsize
+                   + (k_cache_elems // (cfg.n_embd // cfg.n_head) * 4
+                      if kv == "int8" else 0)) / kb
+            u = mbu(bpt, row["tps_kernel"])
+            if u is not None:
+                row["mbu_kernel"] = round(u, 4)
+            _emit(results, config=f"gpt2_decode_attnkernel_{name}",
+                  metric="kernel_vs_einsum_speedup",
+                  value=round(row["tps_kernel"] / row["tps_einsum"], 3),
+                  platform=platform, batch=kb, prompt=kprompt,
+                  new_tokens=knew,
+                  bytes_per_token_mb=round(bpt / 1e6, 2), **row)
+
     # top_p decode tax: nucleus sampling rides a static top-k prefilter
     # (generate.TOP_P_PREFILTER_K ranked candidates + an O(V) logsumexp
     # instead of a full-vocab sort per step). Both legs sample at

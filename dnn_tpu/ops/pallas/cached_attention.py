@@ -1,0 +1,218 @@
+"""Pallas TPU kernel for attention AGAINST A KV CACHE — the serving hot
+loop (decode + chunked prefill).
+
+Why `flash_attention.py` doesn't cover this: the cache path's masking is
+positional against a PREALLOCATED buffer — query token i (at absolute
+position pos+i) may attend cache columns <= pos+i, where `pos` is a
+RUNTIME value (a decode slot's current length, a prefill chunk's start).
+The flash kernel's causal offset is a compile-time constant baked into the
+kernel closure; specializing on it would recompile per chunk index and per
+decode length — exactly what the serving runtime's three-program contract
+forbids (dnn_tpu/runtime/serving.py). Here the limit arrives as a small
+array input instead, one scalar per (batch, head) program, so ONE compiled
+kernel serves every chunk start and every slot position.
+
+Second serving-specific capability: the cache may be stored int8 with
+per-(position, head) scales (dnn_tpu/runtime/kvcache.Int8KV). The kernel
+streams the int8 bytes directly from HBM and folds the scales into the
+score matrix / probability matrix inside VMEM — the dequantized cache
+never exists in HBM, which is the entire point of quantizing a
+bandwidth-bound loop. (The XLA einsum path expresses the same math, but
+whether the f32 upcast fuses into the dot or materializes is the
+compiler's choice; the kernel makes the 1-byte-per-element read a
+guarantee.)
+
+Decode is the degenerate case T=1 with a per-slot position vector — same
+kernel, block_q=1 grid row.
+
+Numerics: online softmax (running row max / row sum) in f32, identical to
+`reference_cached_attention` below, which is also the fallback for
+non-TPU backends and non-tiling shapes.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+_NEG_BIG = -1e30
+
+
+# ----------------------------------------------------------------------
+# reference (fallback + test oracle) — the kvcache.py einsum math
+# ----------------------------------------------------------------------
+
+def reference_cached_attention(q, k, v, pos, *, ks=None, vs=None):
+    """q (B, H, T, D) at absolute positions pos[b] + t; k/v (B, H, S, D)
+    cache buffers (any float dtype, or int8 with `ks`/`vs` scales
+    (B, H, S)); pos (B,) int32. Row (b, t) attends columns
+    <= pos[b] + t. Returns (B, H, T, D) f32."""
+    d = q.shape[-1]
+    s = jnp.einsum("bhtd,bhsd->bhts", q.astype(jnp.float32),
+                   k.astype(jnp.float32),
+                   preferred_element_type=jnp.float32)
+    if ks is not None:
+        s = s * ks[:, :, None, :]
+    s = s / jnp.sqrt(d)
+    cols = jnp.arange(k.shape[2])
+    rows = jnp.arange(q.shape[2])
+    limit = pos[:, None, None, None] + rows[None, None, :, None]
+    s = jnp.where(cols[None, None, None, :] <= limit, s, _NEG_BIG)
+    p = jax.nn.softmax(s, axis=-1)
+    if vs is not None:
+        p = p * vs[:, :, None, :]
+    return jnp.einsum("bhts,bhsd->bhtd", p, v.astype(jnp.float32),
+                      preferred_element_type=jnp.float32)
+
+
+# ----------------------------------------------------------------------
+# kernel
+# ----------------------------------------------------------------------
+
+def _cached_attn_kernel(pos_ref, q_ref, k_ref, v_ref, *rest,
+                        scale, block_q, block_s, quant):
+    from jax.experimental import pallas as pl
+
+    # the quant variant carries two extra scale inputs; the float variant
+    # omits them entirely (no placeholder traffic — see _kernel_call)
+    if quant:
+        ks_ref, vs_ref, o_ref, m_scr, l_scr, acc_scr = rest
+    else:
+        ks_ref = vs_ref = None
+        o_ref, m_scr, l_scr, acc_scr = rest
+
+    qi = pl.program_id(1)
+    si = pl.program_id(2)
+    ns = pl.num_programs(2)
+
+    @pl.when(si == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, _NEG_BIG)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    pos = pos_ref[0, 0, 0]  # this program's base position (runtime value)
+    # dead cache block iff its first column exceeds the block's largest
+    # row limit (pos + last row index). Unlike flash_attention this is a
+    # DYNAMIC predicate — pl.when handles it; dead blocks skip the loads.
+    live = si * block_s <= pos + (qi + 1) * block_q - 1
+
+    @pl.when(live)
+    def _step():
+        q = q_ref[0].astype(jnp.float32)  # (block_q, d)
+        k = k_ref[0].astype(jnp.float32)  # (block_s, d) — int8 streams raw
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # (block_q, block_s)
+        if quant:
+            s = s * ks_ref[0]  # (1, block_s) per-position K scales
+        s = s * scale
+
+        rows = jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_s), 0) + qi * block_q
+        cols = jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_s), 1) + si * block_s
+        s = jnp.where(cols <= pos + rows, s, _NEG_BIG)
+
+        m_prev = m_scr[:, :1]
+        m_new = jnp.maximum(m_prev, s.max(axis=-1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new)
+        if quant:
+            # V scale folds into the (small) probability matrix; the raw
+            # int8 V contracts directly (scales commute — kvcache.py)
+            pv = p * vs_ref[0]
+        else:
+            pv = p
+        v = v_ref[0].astype(jnp.float32)
+        l_new = l_scr[:, :1] * alpha + p.sum(axis=-1, keepdims=True)
+        acc_scr[...] = acc_scr[...] * alpha + jax.lax.dot_general(
+            pv, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        m_scr[...] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[...] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    @pl.when(si == ns - 1)
+    def _finish():
+        o_ref[0] = (acc_scr[...] / l_scr[:, :1]).astype(o_ref.dtype)
+
+
+def _kernel_call(q3, k3, v3, pos3, ks3, vs3, *, block_q, block_s, interpret):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    bh, t, d = q3.shape
+    s_len = k3.shape[1]
+    nq, ns = t // block_q, s_len // block_s
+    quant = ks3 is not None
+    kernel = functools.partial(
+        _cached_attn_kernel, scale=1.0 / (d ** 0.5), block_q=block_q,
+        block_s=block_s, quant=quant,
+    )
+    qspec = pl.BlockSpec((1, block_q, d), lambda b, qi, si: (b, qi, 0))
+    sspec = pl.BlockSpec((1, block_s, d), lambda b, qi, si: (b, si, 0))
+    scale_spec = pl.BlockSpec((1, 1, block_s), lambda b, qi, si: (b, 0, si))
+    pos_spec = pl.BlockSpec((1, 1, 1), lambda b, qi, si: (b, 0, 0))
+    in_specs = [pos_spec, qspec, sspec, sspec]
+    args = [pos3, q3, k3, v3]
+    if quant:
+        in_specs += [scale_spec, scale_spec]
+        args += [ks3, vs3]
+    return pl.pallas_call(
+        kernel,
+        grid=(bh, nq, ns),
+        in_specs=in_specs,
+        out_specs=qspec,
+        out_shape=jax.ShapeDtypeStruct((bh, t, d), jnp.float32),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 128), jnp.float32),  # running row max
+            pltpu.VMEM((block_q, 128), jnp.float32),  # running row sum
+            pltpu.VMEM((block_q, d), jnp.float32),    # output accumulator
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")
+        ),
+        interpret=interpret,
+    )(*args)
+
+
+def cached_attention(q, k, v, pos, *, ks=None, vs=None, block_q=128,
+                     block_s=128, interpret=None):
+    """Cache attention with runtime position limits (see module docstring).
+
+    q (B, H, T, D); k/v (B, H, S, D) — float, or int8 with ks/vs (B, H, S)
+    scales; pos (B,) int32 base positions (row t attends cols
+    <= pos[b] + t). Returns (B, H, T, D) f32.
+
+    Dispatches to the Pallas kernel on TPU when S tiles by `block_s`
+    (T tiles by block_q, or T < block_q which shrinks the q block);
+    otherwise runs the identical-math reference. `interpret=True` forces
+    the kernel in interpreter mode (CPU CI)."""
+    b, h, t, d = q.shape
+    s_len = k.shape[2]
+    on_tpu = jax.default_backend() == "tpu"
+    if interpret is None:
+        if not on_tpu:
+            return reference_cached_attention(q, k, v, pos, ks=ks, vs=vs)
+        interpret = False
+    if t <= block_q:
+        block_q = t  # decode: T=1 -> one q row per program
+    tiles = (s_len % block_s == 0 and t % block_q == 0)
+    if not tiles:
+        return reference_cached_attention(q, k, v, pos, ks=ks, vs=vs)
+
+    bh = b * h
+    q3 = q.reshape(bh, t, d)
+    k3 = k.reshape(bh, s_len, d)
+    v3 = v.reshape(bh, s_len, d)
+    # per-(batch, head) base position: heads share their batch row's limit
+    pos3 = jnp.repeat(pos.astype(jnp.int32), h).reshape(bh, 1, 1)
+    ks3 = ks.reshape(bh, 1, s_len).astype(jnp.float32) if ks is not None else None
+    vs3 = vs.reshape(bh, 1, s_len).astype(jnp.float32) if vs is not None else None
+    out = _kernel_call(q3, k3, v3, pos3, ks3, vs3, block_q=block_q,
+                       block_s=block_s, interpret=interpret)
+    return out.reshape(b, h, t, d)

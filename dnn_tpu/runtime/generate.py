@@ -70,7 +70,9 @@ def _block_with_cache(bp, x, layer_cache, start_pos, *, cfg: GPTConfig,
     q, k, v = _qkv_heads(bp, h, cfg=cfg, compute_dtype=compute_dtype)
     layer_cache = codec.write(layer_cache, k, v, start_pos)
     pos_limit = start_pos + jnp.arange(t)  # causal within the new tokens
-    y = codec.attend(q, layer_cache, pos_limit)
+    # base= asserts the contiguous-limit contract the Pallas kernel needs
+    # (kvcache.FloatKV.attend) — einsum codecs ignore it
+    y = codec.attend(q, layer_cache, pos_limit, base=start_pos)
     x = x + linear(bp["attn"]["proj"], merge_heads(y.astype(x.dtype)),
                    compute_dtype=compute_dtype)
     h = layer_norm(bp["ln_2"], x, eps=cfg.ln_eps)
@@ -83,13 +85,16 @@ def _block_with_cache(bp, x, layer_cache, start_pos, *, cfg: GPTConfig,
 
 
 def forward_with_cache(prepared, ids, cache, start_pos, *, cfg: GPTConfig,
-                       compute_dtype=None, ffn=None):
+                       compute_dtype=None, ffn=None, attn_kernel=False):
     """Forward ids (B, T) at positions [start_pos, start_pos+T) through all
     layers (scan over the stacked blocks), updating the cache. Returns
     (logits (B, T, V), cache). The cache format picks the storage codec:
     {"k","v"} float (init_cache default) or the int8+scales form
-    (init_cache(..., dtype="int8"))."""
-    codec = codec_for_cache(cache)
+    (init_cache(..., dtype="int8")). `attn_kernel=True` runs cache
+    attention through the Pallas streaming kernel
+    (dnn_tpu/ops/pallas/cached_attention.py) — decode steps AND prefill
+    chunks alike, one compiled program regardless of position."""
+    codec = codec_for_cache(cache, use_kernel=attn_kernel)
     x = _embed_at(prepared, ids, start_pos, compute_dtype=compute_dtype)
 
     def layer(carry, layer_in):
@@ -361,7 +366,8 @@ def make_pipeline_generate(cfg: GPTConfig, mesh, *, max_new_tokens: int,
 
 def make_generate(cfg: GPTConfig, *, max_new_tokens: int, temperature: float = 0.0,
                   top_k: Optional[int] = None, top_p: Optional[float] = None,
-                  compute_dtype=None, ffn=None, kv_dtype=None):
+                  compute_dtype=None, ffn=None, kv_dtype=None,
+                  attn_kernel: bool = False):
     """Build a jitted generate(prepared, ids, rng) -> (B, max_new_tokens).
 
     `prepared` is the stacked layout from `gpt.prepare_stacked`. The prompt
@@ -370,7 +376,9 @@ def make_generate(cfg: GPTConfig, *, max_new_tokens: int, temperature: float = 0
     family's entry point, dnn_tpu/runtime/generate_moe.py). `kv_dtype`
     picks the cache storage: None follows compute_dtype (f32 default),
     jnp.bfloat16 halves cache bandwidth, "int8" quarters it
-    (dnn_tpu/runtime/kvcache.py).
+    (dnn_tpu/runtime/kvcache.py). `attn_kernel=True` streams the cache
+    through the Pallas attention kernel on TPU (fused int8 dequant; einsum
+    fallback elsewhere).
     """
     if max_new_tokens < 1:
         raise ValueError(f"max_new_tokens must be >= 1, got {max_new_tokens}")
@@ -390,7 +398,7 @@ def make_generate(cfg: GPTConfig, *, max_new_tokens: int, temperature: float = 0
         # prefill: full prompt in one forward
         logits, cache = forward_with_cache(
             prepared, ids, cache, 0, cfg=cfg, compute_dtype=compute_dtype,
-            ffn=ffn,
+            ffn=ffn, attn_kernel=attn_kernel,
         )
         rng, sub = jax.random.split(rng)
         tok = _sample(logits[:, -1], sub, temperature=temperature, top_k=top_k, top_p=top_p)
@@ -401,6 +409,7 @@ def make_generate(cfg: GPTConfig, *, max_new_tokens: int, temperature: float = 0
             logits, cache = forward_with_cache(
                 prepared, tok[:, None], cache, t + i, cfg=cfg,
                 compute_dtype=compute_dtype, ffn=ffn,
+                attn_kernel=attn_kernel,
             )
             rng, sub = jax.random.split(rng)
             nxt = _sample(logits[:, -1], sub, temperature=temperature, top_k=top_k, top_p=top_p)

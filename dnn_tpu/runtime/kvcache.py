@@ -41,6 +41,18 @@ _NEG_BIG = -1e30
 __all__ = ["FloatKV", "Int8KV", "codec_for_cache"]
 
 
+class _KernelDispatch:
+    """Shared use_kernel plumbing: True engages the Pallas path with its
+    own TPU/tiling dispatch; the string "interpret" forces the kernel in
+    Pallas interpreter mode (CPU CI runs the REAL kernel logic inside the
+    full decode loop instead of silently falling back to the einsum)."""
+
+    use_kernel = False
+
+    def _interp(self):
+        return True if self.use_kernel == "interpret" else None
+
+
 def _rows_update(cache, new, pos):
     """cache (B,H,S,...) <- new (B,H,1,...) at per-row positions pos (B,)."""
     return jax.vmap(
@@ -48,12 +60,19 @@ def _rows_update(cache, new, pos):
     )(cache, new, pos)
 
 
-class FloatKV:
+class FloatKV(_KernelDispatch):
     """The plain cache: K/V stored in `dtype` (f32 default, bf16 for
-    halved bandwidth)."""
+    halved bandwidth).
 
-    def __init__(self, dtype=jnp.float32):
+    `use_kernel=True` routes attend/attend_rows through the Pallas
+    cached-attention kernel (dnn_tpu/ops/pallas/cached_attention.py):
+    online-softmax streaming of the cache with runtime position limits —
+    one compiled program for every chunk start and slot position. Falls
+    back to the einsum path off-TPU or when shapes don't tile."""
+
+    def __init__(self, dtype=jnp.float32, use_kernel: bool = False):
         self.dtype = dtype
+        self.use_kernel = use_kernel
 
     def init(self, cfg, batch: int, max_len: int):
         shape = (cfg.n_layer, batch, cfg.n_head, max_len,
@@ -70,9 +89,22 @@ class FloatKV:
                 c["v"], v.astype(c["v"].dtype), start_pos, axis=2),
         }
 
-    def attend(self, q, c, pos_limit):
+    def attend(self, q, c, pos_limit, base=None):
         """q (B,H,T,D) against the full cache, masking key positions >
-        their row's limit (pos_limit (T,))."""
+        their row's limit (pos_limit (T,)).
+
+        `base` is the kernel contract marker: the caller asserts
+        pos_limit == base + arange(T) by passing the start position
+        (generate.py's _block_with_cache does). The kernel path engages
+        ONLY with it — call sites with folded/tiled row limits (the LLaMA
+        GQA group trick, llama.py) never pass base, so use_kernel can't
+        silently mis-mask them; they fall through to the einsum."""
+        if self.use_kernel and base is not None:
+            from dnn_tpu.ops.pallas.cached_attention import cached_attention
+
+            return cached_attention(
+                q, c["k"], c["v"], jnp.broadcast_to(base, (q.shape[0],)),
+                interpret=self._interp()).astype(c["v"].dtype)
         d = q.shape[-1]
         s = jnp.einsum("bhtd,bhsd->bhts", q, c["k"]).astype(jnp.float32) / jnp.sqrt(d)
         cols = jnp.arange(c["k"].shape[2])
@@ -94,6 +126,15 @@ class FloatKV:
     def attend_rows(self, q, c, pos):
         """q (B,H,1,D); each row masked to keys at positions <= its own
         pos (B,)."""
+        # kernel contract: exactly one query row per slot (the kernel adds
+        # +row to each slot's limit — T>1 callers fold GQA groups into the
+        # row axis with SHARED limits, which must take the einsum)
+        if self.use_kernel and q.shape[2] == 1:
+            from dnn_tpu.ops.pallas.cached_attention import cached_attention
+
+            return cached_attention(q, c["k"], c["v"], pos,
+                                    interpret=self._interp()) \
+                .astype(c["v"].dtype)
         d = q.shape[-1]
         s = jnp.einsum("bhtd,bhsd->bhts", q, c["k"]).astype(jnp.float32) / jnp.sqrt(d)
         cols = jnp.arange(c["k"].shape[2])
@@ -112,9 +153,17 @@ def _quantize_rows(x):
     return q.astype(jnp.int8), scale
 
 
-class Int8KV:
+class Int8KV(_KernelDispatch):
     """int8 K/V with per-(position, head) f32 scales — 4x less cache
-    bandwidth per decode step than f32, 2x less than bf16."""
+    bandwidth per decode step than f32, 2x less than bf16.
+
+    `use_kernel=True`: the Pallas cached-attention kernel streams the
+    int8 bytes straight from HBM and folds the scales inside VMEM — the
+    1-byte read becomes a guarantee instead of an XLA fusion hope (see
+    dnn_tpu/ops/pallas/cached_attention.py)."""
+
+    def __init__(self, use_kernel: bool = False):
+        self.use_kernel = use_kernel
 
     def init(self, cfg, batch: int, max_len: int):
         shape = (cfg.n_layer, batch, cfg.n_head, max_len,
@@ -136,7 +185,15 @@ class Int8KV:
             "vs": lax.dynamic_update_slice_in_dim(c["vs"], vs, start_pos, axis=2),
         }
 
-    def attend(self, q, c, pos_limit):
+    def attend(self, q, c, pos_limit, base=None):
+        # `base` marks the pos_limit == base + arange(T) contract (see
+        # FloatKV.attend) — kernel path only with it
+        if self.use_kernel and base is not None:
+            from dnn_tpu.ops.pallas.cached_attention import cached_attention
+
+            return cached_attention(
+                q, c["k"], c["v"], jnp.broadcast_to(base, (q.shape[0],)),
+                ks=c["ks"], vs=c["vs"], interpret=self._interp())
         d = q.shape[-1]
         # scores in f32; the per-position K scale lands on the score matrix
         # (commutes with the D contraction)
@@ -172,6 +229,13 @@ class Int8KV:
         return {kk: jnp.where(gates[kk], new[kk], c[kk]) for kk in c}
 
     def attend_rows(self, q, c, pos):
+        # one query row per slot only (see FloatKV.attend_rows)
+        if self.use_kernel and q.shape[2] == 1:
+            from dnn_tpu.ops.pallas.cached_attention import cached_attention
+
+            return cached_attention(q, c["k"], c["v"], pos,
+                                    ks=c["ks"], vs=c["vs"],
+                                    interpret=self._interp())
         d = q.shape[-1]
         s = jnp.einsum("bhtd,bhsd->bhts", q.astype(jnp.float32),
                        c["k"].astype(jnp.float32),
@@ -186,9 +250,10 @@ class Int8KV:
                           preferred_element_type=jnp.float32)
 
 
-def codec_for_cache(cache):
+def codec_for_cache(cache, use_kernel: bool = False):
     """Infer the codec from a cache pytree's structure (int8 caches carry
-    scale leaves)."""
+    scale leaves). `use_kernel` opts attend/attend_rows into the Pallas
+    cached-attention kernel (TPU; einsum fallback elsewhere)."""
     if "ks" in cache:
-        return Int8KV()
-    return FloatKV(cache["k"].dtype)
+        return Int8KV(use_kernel=use_kernel)
+    return FloatKV(cache["k"].dtype, use_kernel=use_kernel)

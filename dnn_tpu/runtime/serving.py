@@ -93,10 +93,14 @@ class GPTFamilyRows:
     (LLaMA: dnn_tpu/models/llama.LlamaFamilyRows — RoPE positions and a
     KV-head-width cache; MoE stays a GPT block with `ffn` overridden)."""
 
-    def __init__(self, cfg, *, compute_dtype=None, ffn=None):
+    def __init__(self, cfg, *, compute_dtype=None, ffn=None,
+                 attn_kernel: bool = False):
         self.cfg = cfg
         self.compute_dtype = compute_dtype
         self.ffn = ffn
+        # route cache attention (prefill chunks + decode rows) through the
+        # Pallas streaming kernel (ops/pallas/cached_attention.py)
+        self.attn_kernel = attn_kernel
 
     def init_cache(self, batch, max_len, dtype):
         return init_cache(self.cfg, batch, max_len, dtype)
@@ -107,7 +111,8 @@ class GPTFamilyRows:
         full chunks + one padded tail (the batcher's chunk loop)."""
         return forward_with_cache(
             prepared, padded, row_cache, start_pos, cfg=self.cfg,
-            compute_dtype=self.compute_dtype, ffn=self.ffn)
+            compute_dtype=self.compute_dtype, ffn=self.ffn,
+            attn_kernel=self.attn_kernel)
 
     def decode_rows(self, prepared, cache, tok, pos, active, codec):
         """One per-slot decode step: tok/pos/active (B,) ->
@@ -150,7 +155,8 @@ class ContinuousBatcher:
                  temperature: float = 0.0, top_k: Optional[int] = None,
                  top_p: Optional[float] = None,
                  compute_dtype=None, eos_id: Optional[int] = None, seed: int = 0,
-                 ffn=None, kv_dtype=None, family=None):
+                 ffn=None, kv_dtype=None, family=None,
+                 attn_kernel: bool = False):
         self.cfg = cfg
         self.prepared = prepared
         self.slots = slots
@@ -168,6 +174,10 @@ class ContinuousBatcher:
             if ffn is not None:
                 raise ValueError(
                     "pass ffn on the family adapter, not alongside family=")
+            if attn_kernel:
+                raise ValueError(
+                    "pass attn_kernel on the family adapter, not alongside "
+                    "family= (the adapter owns its attention path)")
             fam_dtype = getattr(family, "compute_dtype", None)
             if compute_dtype is not None and fam_dtype != compute_dtype:
                 raise ValueError(
@@ -175,14 +185,17 @@ class ContinuousBatcher:
                     f"family adapter={fam_dtype} — set it on the adapter")
             compute_dtype = fam_dtype
         self.family = family or GPTFamilyRows(
-            cfg, compute_dtype=compute_dtype, ffn=ffn)
+            cfg, compute_dtype=compute_dtype, ffn=ffn,
+            attn_kernel=attn_kernel)
         # kv_dtype picks the cache storage codec (None follows
         # compute_dtype; "int8" = quantized cache, kvcache.Int8KV)
         cache_dtype = kv_dtype if kv_dtype is not None else (compute_dtype or jnp.float32)
 
         # device state (functional updates)
         self.cache = self.family.init_cache(slots, self.max_len, cache_dtype)
-        codec = codec_for_cache(self.cache)
+        codec = codec_for_cache(
+            self.cache,
+            use_kernel=getattr(self.family, "attn_kernel", False))
         self.pos = jnp.zeros((slots,), jnp.int32)      # next write position
         self.tok = jnp.zeros((slots,), jnp.int32)      # last sampled token
         self.active = jnp.zeros((slots,), bool)

@@ -20,9 +20,9 @@ from dnn_tpu.models import gpt
 from dnn_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS, STAGE_AXIS, make_mesh
 from dnn_tpu.parallel.pipeline import spmd_pipeline_stacked
 from dnn_tpu.train import (
+    cross_entropy,
     gpt_tp_pp_specs,
     make_pipeline_train_step,
-    next_token_loss,
 )
 
 CFG = gpt.PRESETS["gpt2-test"]  # L=4, H=4, C=64, vocab=256
@@ -116,8 +116,6 @@ def _loss_and_grads_1d(params, tokens, num_stages=2, mbs=2):
             lambda bp, a: gpt.blocks_scan(bp, a, cfg=CFG),
             stacked, x, mesh=mesh, num_microbatches=mbs)
         logits = gpt.head(aux, h.astype(jnp.float32), cfg=CFG)
-        from dnn_tpu.train import cross_entropy
-
         return cross_entropy(logits, tokens[:, 1:])
 
     (lval, (g_st, g_aux)) = jax.value_and_grad(loss_fn, argnums=(0, 1))(
@@ -145,8 +143,6 @@ def test_tp_pp_loss_and_grads_match_1d_pipeline():
             block_fn, stacked, x, mesh=mesh, num_microbatches=2,
             param_specs=specs)
         logits = gpt.head(aux, h.astype(jnp.float32), cfg=CFG)
-        from dnn_tpu.train import cross_entropy
-
         return cross_entropy(logits, tokens[:, 1:])
 
     lval, (g_st, g_aux) = jax.value_and_grad(loss_fn, argnums=(0, 1))(
