@@ -157,6 +157,40 @@ class NodeClient:
             raise RuntimeError(f"LM server returned no tokens: {status}")
         return np.asarray(result, np.int32)
 
+    def generate_stream(
+        self,
+        prompt_ids,
+        *,
+        max_new_tokens: int = 32,
+        seed: Optional[int] = None,
+        timeout: float = 120.0,
+    ):
+        """Streaming client for the LM daemon's GenerateStream RPC: yields
+        each token (int) as the server commits it. Abandoning the iterator
+        (break / close / GC) cancels the RPC, which frees the server-side
+        decode slot at its next step boundary — a disconnected client never
+        decodes on to its budget. NOT retried: a stream is stateful (tokens
+        already delivered), unlike the self-contained unary generate()."""
+        rid = f"gen:{max_new_tokens}" + (f":{seed}" if seed is not None else "")
+        call = self._channel.unary_stream(
+            f"/{SERVICE_NAME}/GenerateStream",
+            request_serializer=pb.TensorRequest.SerializeToString,
+            response_deserializer=pb.TensorResponse.FromString,
+        )
+        stream = call(
+            pb.TensorRequest(
+                request_id=rid,
+                tensor=_tensor_msg(
+                    np.asarray(prompt_ids, np.int32).reshape(-1))),
+            timeout=timeout,
+        )
+        try:
+            for resp in stream:
+                if resp.HasField("result_tensor"):
+                    yield int(_tensor_arr(resp.result_tensor)[0])
+        finally:
+            stream.cancel()  # no-op on a finished stream
+
     def generate_text(
         self,
         prompt: str,

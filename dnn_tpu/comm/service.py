@@ -231,26 +231,32 @@ def _resolve_port(servicer: StageServer, node_id: str, port: Optional[int]) -> i
 
 
 def _handlers(servicer: StageServer):
-    return grpc.method_handlers_generic_handler(
-        SERVICE_NAME,
-        {
-            "SendTensor": grpc.unary_unary_rpc_method_handler(
-                servicer.SendTensor,
-                request_deserializer=pb.TensorRequest.FromString,
-                response_serializer=pb.TensorResponse.SerializeToString,
-            ),
-            "HealthCheck": grpc.unary_unary_rpc_method_handler(
-                servicer.HealthCheck,
-                request_deserializer=pb.Empty.FromString,
-                response_serializer=pb.HealthCheckResponse.SerializeToString,
-            ),
-            "SendMessage": grpc.unary_unary_rpc_method_handler(
-                servicer.SendMessage,
-                request_deserializer=pb.MessageRequest.FromString,
-                response_serializer=pb.MessageReply.SerializeToString,
-            ),
-        },
-    )
+    handlers = {
+        "SendTensor": grpc.unary_unary_rpc_method_handler(
+            servicer.SendTensor,
+            request_deserializer=pb.TensorRequest.FromString,
+            response_serializer=pb.TensorResponse.SerializeToString,
+        ),
+        "HealthCheck": grpc.unary_unary_rpc_method_handler(
+            servicer.HealthCheck,
+            request_deserializer=pb.Empty.FromString,
+            response_serializer=pb.HealthCheckResponse.SerializeToString,
+        ),
+        "SendMessage": grpc.unary_unary_rpc_method_handler(
+            servicer.SendMessage,
+            request_deserializer=pb.MessageRequest.FromString,
+            response_serializer=pb.MessageReply.SerializeToString,
+        ),
+    }
+    # the LM daemon's per-token streaming front (wire.proto GenerateStream);
+    # stage servers don't implement it and callers get UNIMPLEMENTED
+    if hasattr(servicer, "GenerateStream"):
+        handlers["GenerateStream"] = grpc.unary_stream_rpc_method_handler(
+            servicer.GenerateStream,
+            request_deserializer=pb.TensorRequest.FromString,
+            response_serializer=pb.TensorResponse.SerializeToString,
+        )
+    return grpc.method_handlers_generic_handler(SERVICE_NAME, handlers)
 
 
 async def serve_stage(engine, node_id: str, *, port: Optional[int] = None):

@@ -93,10 +93,15 @@ def _cached_attn_kernel(pos_ref, q_ref, k_ref, v_ref, *rest,
         l_scr[...] = jnp.zeros_like(l_scr)
         acc_scr[...] = jnp.zeros_like(acc_scr)
 
-    pos = pos_ref[0, 0, 0]  # this program's base position (runtime value)
+    # base position for this (batch, head) program: a RUNTIME scalar, read
+    # from the scalar-prefetch ref (SMEM) — scalars driving control flow
+    # must not come from VMEM vector lanes on real hardware
+    pos = pos_ref[pl.program_id(0)]
     # dead cache block iff its first column exceeds the block's largest
     # row limit (pos + last row index). Unlike flash_attention this is a
-    # DYNAMIC predicate — pl.when handles it; dead blocks skip the loads.
+    # DYNAMIC predicate — pl.when skips the block's COMPUTE (the BlockSpec
+    # pipeline still fetches every block; the bandwidth story is the int8
+    # byte width and fused dequant, not block skipping).
     live = si * block_s <= pos + (qi + 1) * block_q - 1
 
     @pl.when(live)
@@ -141,7 +146,7 @@ def _cached_attn_kernel(pos_ref, q_ref, k_ref, v_ref, *rest,
         o_ref[0] = (acc_scr[...] / l_scr[:, :1]).astype(o_ref.dtype)
 
 
-def _kernel_call(q3, k3, v3, pos3, ks3, vs3, *, block_q, block_s, interpret):
+def _kernel_call(q3, k3, v3, pos1d, ks3, vs3, *, block_q, block_s, interpret):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -153,31 +158,40 @@ def _kernel_call(q3, k3, v3, pos3, ks3, vs3, *, block_q, block_s, interpret):
         _cached_attn_kernel, scale=1.0 / (d ** 0.5), block_q=block_q,
         block_s=block_s, quant=quant,
     )
-    qspec = pl.BlockSpec((1, block_q, d), lambda b, qi, si: (b, qi, 0))
-    sspec = pl.BlockSpec((1, block_s, d), lambda b, qi, si: (b, si, 0))
-    scale_spec = pl.BlockSpec((1, 1, block_s), lambda b, qi, si: (b, 0, si))
-    pos_spec = pl.BlockSpec((1, 1, 1), lambda b, qi, si: (b, 0, 0))
-    in_specs = [pos_spec, qspec, sspec, sspec]
-    args = [pos3, q3, k3, v3]
+    # index maps gain a TRAILING scalar-prefetch ref argument (unused here
+    # — blocks are addressed by grid coordinates alone)
+    qspec = pl.BlockSpec((1, block_q, d), lambda b, qi, si, p: (b, qi, 0))
+    sspec = pl.BlockSpec((1, block_s, d), lambda b, qi, si, p: (b, si, 0))
+    scale_spec = pl.BlockSpec((1, 1, block_s),
+                              lambda b, qi, si, p: (b, 0, si))
+    in_specs = [qspec, sspec, sspec]
+    args = [q3, k3, v3]
     if quant:
         in_specs += [scale_spec, scale_spec]
         args += [ks3, vs3]
-    return pl.pallas_call(
-        kernel,
+    # pos rides scalar prefetch: the whole (bh,) vector lands in SMEM and
+    # each program reads its scalar — the supported pattern for runtime
+    # values steering pl.when control flow on real hardware
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
         grid=(bh, nq, ns),
         in_specs=in_specs,
         out_specs=qspec,
-        out_shape=jax.ShapeDtypeStruct((bh, t, d), jnp.float32),
         scratch_shapes=[
             pltpu.VMEM((block_q, 128), jnp.float32),  # running row max
             pltpu.VMEM((block_q, 128), jnp.float32),  # running row sum
             pltpu.VMEM((block_q, d), jnp.float32),    # output accumulator
         ],
+    )
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((bh, t, d), jnp.float32),
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")
         ),
         interpret=interpret,
-    )(*args)
+    )(pos1d, *args)
 
 
 def cached_attention(q, k, v, pos, *, ks=None, vs=None, block_q=128,
@@ -210,9 +224,9 @@ def cached_attention(q, k, v, pos, *, ks=None, vs=None, block_q=128,
     k3 = k.reshape(bh, s_len, d)
     v3 = v.reshape(bh, s_len, d)
     # per-(batch, head) base position: heads share their batch row's limit
-    pos3 = jnp.repeat(pos.astype(jnp.int32), h).reshape(bh, 1, 1)
+    pos1d = jnp.repeat(pos.astype(jnp.int32), h)
     ks3 = ks.reshape(bh, 1, s_len).astype(jnp.float32) if ks is not None else None
     vs3 = vs.reshape(bh, 1, s_len).astype(jnp.float32) if vs is not None else None
-    out = _kernel_call(q3, k3, v3, pos3, ks3, vs3, block_q=block_q,
+    out = _kernel_call(q3, k3, v3, pos1d, ks3, vs3, block_q=block_q,
                        block_s=block_s, interpret=interpret)
     return out.reshape(b, h, t, d)

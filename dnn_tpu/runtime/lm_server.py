@@ -9,11 +9,14 @@ in-flight requests together through one continuous-batching pool
 (dnn_tpu/runtime/serving.py) — requests enter and leave slots
 independently, so concurrent callers share full batch width.
 
-Wire-compatible by construction: same proto as the reference
-(dnn_tpu/comm/wire.proto == node_service.proto), no new RPCs. Generation
-options ride the existing `request_id` field as "gen[:max_new[:seed]]"
-(anything unparseable falls back to server defaults) — a reference-built
-client could drive this server unmodified.
+Wire-compatible by construction: every reference RPC is byte-identical
+(dnn_tpu/comm/wire.proto keeps node_service.proto's methods, messages and
+field numbering untouched) — a reference-built client drives this server
+unmodified. Generation options ride the existing `request_id` field as
+"gen[:max_new[:seed]]" (anything unparseable falls back to server
+defaults). One ADDITIVE method exists beyond the reference protocol:
+`GenerateStream`, the per-token streaming front (new method name;
+reference peers never call it, so compatibility is preserved).
 
 Threading model: gRPC handlers are async, device compute is blocking, so
 ONE worker thread owns the batcher — it admits queued prompts whenever
@@ -90,9 +93,16 @@ class _BatcherWorker(threading.Thread):
         # would hang for request_timeout instead of failing fast)
         self._lock = threading.Lock()
         self._dead: "Exception | None" = None
+        # rid -> {"fut", "on_token", "cancel_evt"}
         self._futures = {}
 
-    def submit(self, prompt: np.ndarray, max_new: int, seed):
+    def submit(self, prompt: np.ndarray, max_new: int, seed, *,
+               on_token=None, cancel_evt=None):
+        """Queue a request. `on_token(tok)` (optional) fires from the
+        worker thread for every token as it commits — the streaming hook.
+        `cancel_evt` (optional threading.Event) set by the caller retires
+        the request's slot at the next step boundary; its future resolves
+        cancelled."""
         import concurrent.futures
 
         fut = concurrent.futures.Future()
@@ -100,7 +110,7 @@ class _BatcherWorker(threading.Thread):
             if self._dead is not None:
                 fut.set_exception(self._dead)
                 return fut
-            self.q.put((prompt, max_new, seed, fut))
+            self.q.put((prompt, max_new, seed, on_token, cancel_evt, fut))
         return fut
 
     def stop(self, *, drain: bool = True):
@@ -131,18 +141,49 @@ class _BatcherWorker(threading.Thread):
 
     # ------------------------------------------------------------------
 
-    def _admit(self, prompt, max_new, seed, fut):
+    def _admit(self, prompt, max_new, seed, on_token, cancel_evt, fut):
+        if cancel_evt is not None and cancel_evt.is_set():
+            fut.cancel()  # cancelled while still queued: never admit
+            return
         try:
             rid = self.batcher.submit(prompt, max_new, seed=seed)
         except Exception as e:  # noqa: BLE001 — validation errors belong to
             fut.set_exception(e)  # the submitting request, not the loop
             return
-        self._futures[rid] = fut
+        self._futures[rid] = {"fut": fut, "on_token": on_token,
+                              "cancel_evt": cancel_evt}
+        if on_token is not None:
+            # the first token samples during prefill (batcher.submit)
+            first = self.batcher.first_token(rid)
+            if first is not None:
+                self._emit_token(rid, first)
+
+    def _emit_token(self, rid, tok):
+        rec = self._futures.get(rid)
+        if rec is None or rec["on_token"] is None:
+            return
+        try:
+            rec["on_token"](int(tok))
+        except Exception:  # noqa: BLE001 — a dead stream consumer must not
+            log.debug("on_token callback failed for rid %d", rid,
+                      exc_info=True)  # kill the device loop
+
+    def _process_cancels(self):
+        """Retire cancelled requests at the step boundary: the slot
+        re-enters the free pool (batcher.cancel) and the future resolves
+        cancelled — the caller's disconnect must not decode on to its
+        token budget."""
+        for rid, rec in list(self._futures.items()):
+            evt = rec["cancel_evt"]
+            if evt is not None and evt.is_set():
+                self.batcher.cancel(rid)
+                del self._futures[rid]
+                rec["fut"].cancel()
 
     def _publish_done(self):
         b = self.batcher
         for rid in [r for r in self._futures if r in b.results]:
-            self._futures.pop(rid).set_result(b.results.pop(rid))
+            self._futures.pop(rid)["fut"].set_result(b.results.pop(rid))
 
     def _shutdown_drain_queue(self):
         """Final drain-path exit step, under _lock: mark dead and fail any
@@ -164,9 +205,9 @@ class _BatcherWorker(threading.Thread):
     def _fail_all(self, exc):
         with self._lock:
             self._dead = exc  # submits from here on fail immediately
-            for fut in self._futures.values():
-                if not fut.done():
-                    fut.set_exception(exc)
+            for rec in self._futures.values():
+                if not rec["fut"].done():
+                    rec["fut"].set_exception(exc)
             self._futures.clear()
             while True:
                 try:
@@ -180,10 +221,11 @@ class _BatcherWorker(threading.Thread):
         while True:
             if self._abandon:
                 with self._lock:
-                    for fut in self._futures.values():
-                        fut.cancel()
+                    for rec in self._futures.values():
+                        rec["fut"].cancel()
                     self._futures.clear()
                 return
+            self._process_cancels()  # step boundary: free cancelled slots
             if b.n_active == 0 and self.q.empty():
                 if self._stop_evt.is_set():
                     self._shutdown_drain_queue()
@@ -198,8 +240,7 @@ class _BatcherWorker(threading.Thread):
                 except queue.Empty:
                     break
             try:
-                if b.n_active:
-                    b.step()
+                stepped = b.step() if b.n_active else {}
             except Exception as e:  # noqa: BLE001 — one device-side error
                 # must not leave callers hanging for request_timeout: fail
                 # every pending future fast and die visibly (HealthCheck
@@ -208,6 +249,8 @@ class _BatcherWorker(threading.Thread):
                               "requests", len(self._futures))
                 self._fail_all(RuntimeError(f"LM batcher worker died: {e}"))
                 return
+            for rid, tok in stepped.items():  # streaming: tokens as they
+                self._emit_token(rid, tok)    # commit, before done-publish
             self._publish_done()  # submit alone can retire (budget == 1)
 
 
@@ -266,7 +309,11 @@ class LMServer:
                 grpc.StatusCode.DEADLINE_EXCEEDED,
                 f"generation exceeded {self.request_timeout}s")
 
-    async def SendTensor(self, request: pb.TensorRequest, context) -> pb.TensorResponse:
+    async def _validated_prompt(self, request: pb.TensorRequest, context):
+        """Decode + validate the raw-id prompt (shared by the unary and
+        streaming fronts): integrity, integer dtype, vocab range — JAX's
+        clip-mode gather would otherwise silently substitute edge-of-table
+        embeddings and generate plausible output from a corrupt prompt."""
         try:
             prompt = _tensor_arr(request.tensor)
         except PayloadCorruptError as e:
@@ -275,21 +322,91 @@ class LMServer:
             await context.abort(
                 grpc.StatusCode.INVALID_ARGUMENT,
                 f"prompt must be integer token ids, got dtype {prompt.dtype}")
-        # the raw-id front must guard the vocab range itself (the text
-        # front's tokenizer can't emit out-of-vocab ids): JAX's clip-mode
-        # gather would otherwise silently substitute edge-of-table
-        # embeddings and generate plausible output from a corrupt prompt
         vocab = self.batcher.cfg.vocab_size
         if prompt.size and (prompt.min() < 0 or prompt.max() >= vocab):
             await context.abort(
                 grpc.StatusCode.INVALID_ARGUMENT,
                 f"prompt token ids must be in [0, {vocab}), got range "
                 f"[{prompt.min()}, {prompt.max()}]")
+        return prompt
+
+    async def SendTensor(self, request: pb.TensorRequest, context) -> pb.TensorResponse:
+        prompt = await self._validated_prompt(request, context)
         tokens = await self._submit_and_await(prompt, request.request_id, context)
         return pb.TensorResponse(
             status=f"[lm] ok: {len(tokens)} tokens",
             result_tensor=_tensor_msg(np.asarray(tokens, np.int32)),
         )
+
+    async def GenerateStream(self, request: pb.TensorRequest, context):
+        """Server-streaming generate: one TensorResponse PER TOKEN as it
+        commits (result_tensor = [token]); stream end = generation done.
+        Client cancellation (disconnect / stream.cancel) sets the request's
+        cancel event, and the batcher worker retires the slot at the next
+        step boundary — a dropped stream never decodes on to its budget.
+        The unary SendTensor front stays untouched for reference
+        wire-compat (wire.proto)."""
+        prompt = await self._validated_prompt(request, context)
+        if not self.worker.is_alive():
+            await context.abort(
+                grpc.StatusCode.UNAVAILABLE,
+                "LM batcher worker is not running (died or shut down)")
+        max_new, seed = parse_gen_options(request.request_id, self.default_max_new)
+        loop = asyncio.get_running_loop()
+        q: "asyncio.Queue" = asyncio.Queue()
+        cancel_evt = threading.Event()
+
+        def on_token(tok):
+            loop.call_soon_threadsafe(q.put_nowait, ("tok", tok))
+
+        fut = self.worker.submit(
+            np.asarray(prompt, np.int32).reshape(-1), max_new, seed,
+            on_token=on_token, cancel_evt=cancel_evt)
+
+        def _done(f):
+            # fires in the worker thread AFTER any on_token calls for this
+            # request: call_soon_threadsafe preserves that order, so the
+            # "done" sentinel always trails the last token in the queue
+            loop.call_soon_threadsafe(q.put_nowait, ("done", f))
+
+        fut.add_done_callback(_done)
+        n = 0
+        deadline = loop.time() + self.request_timeout
+        try:
+            while True:
+                remaining = deadline - loop.time()
+                if remaining <= 0:
+                    cancel_evt.set()
+                    await context.abort(
+                        grpc.StatusCode.DEADLINE_EXCEEDED,
+                        f"generation exceeded {self.request_timeout}s")
+                try:
+                    kind, val = await asyncio.wait_for(q.get(), remaining)
+                except asyncio.TimeoutError:
+                    continue  # loop re-checks the deadline and aborts
+                if kind == "tok":
+                    n += 1
+                    yield pb.TensorResponse(
+                        status=f"[lm] token {n}",
+                        result_tensor=_tensor_msg(
+                            np.asarray([val], np.int32)),
+                    )
+                    continue
+                f = val
+                if f.cancelled():
+                    await context.abort(grpc.StatusCode.UNAVAILABLE,
+                                        "LM server shut down")
+                exc = f.exception()
+                if isinstance(exc, ValueError):
+                    await context.abort(grpc.StatusCode.INVALID_ARGUMENT,
+                                        str(exc))
+                if exc is not None:
+                    await context.abort(grpc.StatusCode.UNAVAILABLE, str(exc))
+                return
+        except asyncio.CancelledError:
+            # the client went away: free the slot at the next step boundary
+            cancel_evt.set()
+            raise
 
     async def HealthCheck(self, request: pb.Empty, context) -> pb.HealthCheckResponse:
         return pb.HealthCheckResponse(is_healthy=self.worker.is_alive())

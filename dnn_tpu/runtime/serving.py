@@ -350,6 +350,33 @@ class ContinuousBatcher:
             self._slot_req[slot] = None
             self.active = self.active.at[slot].set(False)
 
+    def first_token(self, rid: int):
+        """The token sampled during a request's prefill (the first entry of
+        its emitted stream), or None for an unknown rid — the streaming
+        front needs it before the first step() (budget == 1 requests are
+        already retired into results by then)."""
+        if rid in self.results:
+            return int(self.results[rid][0])
+        for req in self._slot_req:
+            if req is not None and req["rid"] == rid:
+                return int(req["emitted"][0])
+        return None
+
+    def cancel(self, rid: int) -> bool:
+        """Retire a request's slot WITHOUT producing a result — the slot
+        re-enters the free pool immediately (the next admit overwrites its
+        cache rows; nothing needs clearing because inactive slots are fully
+        masked in the decode program). Safe between step() calls (host
+        bookkeeping only). Returns True if the request was live (slot
+        freed) or still unclaimed in results (result dropped); False for
+        an unknown/already-claimed rid."""
+        for slot, req in enumerate(self._slot_req):
+            if req is not None and req["rid"] == rid:
+                self._slot_req[slot] = None
+                self.active = self.active.at[slot].set(False)
+                return True
+        return self.results.pop(rid, None) is not None
+
     def step(self) -> Dict[int, int]:
         """One decode step for every active slot. Returns {rid: new_token}
         for slots that advanced; finished requests move to .results."""
