@@ -79,6 +79,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--max_len", type=int, default=None,
                    help="--serve_lm: max sequence length per slot "
                         "(default: model block_size)")
+    p.add_argument("--prefix_cache", type=int, default=0,
+                   help="--serve_lm: prefix-cache capacity (LRU entries); "
+                        "requests sharing a prompt prefix skip re-prefilling "
+                        "identical chunks. 0 disables (default). Each entry "
+                        "holds one transient row cache in HBM")
     p.add_argument("--prompt_pad", type=int, default=None,
                    help="--serve_lm: prompt padding bucket (one prefill "
                         "compilation; default min(64, max_len))")
@@ -334,7 +339,7 @@ def _serve_lm(engine: PipelineEngine, args) -> int:
             top_p=args.top_p,
             compute_dtype=engine.compute_dtype, seed=args.seed, ffn=ffn,
             family=family, default_max_new=args.generate or 32,
-            tokenizer=tokenizer,
+            tokenizer=tokenizer, prefix_cache=args.prefix_cache,
         ))
     except KeyboardInterrupt:
         log.info("shutting down")

@@ -277,37 +277,53 @@ class LMServer:
 
     # --- RPC implementations (names/signatures fixed by the protocol) ---
 
-    async def _submit_and_await(self, ids, request_id: str, context):
-        """Shared submit/await/abort ladder for both RPC fronts: one place
-        owns the error mapping (caller errors -> INVALID_ARGUMENT, worker
-        death/shutdown -> UNAVAILABLE, client RPC cancellation re-raised
-        for grpc.aio, deadline -> DEADLINE_EXCEEDED)."""
+    async def _preflight(self, request_id: str, context):
+        """Shared request preflight for both RPC fronts: worker liveness
+        plus option parsing — one place, one status mapping."""
         if not self.worker.is_alive():
             await context.abort(
                 grpc.StatusCode.UNAVAILABLE,
                 "LM batcher worker is not running (died or shut down)")
-        max_new, seed = parse_gen_options(request_id, self.default_max_new)
+        return parse_gen_options(request_id, self.default_max_new)
+
+    async def _result_or_abort(self, fut, context):
+        """Map a COMPLETED worker future to the shared status ladder
+        (both fronts route every terminal outcome through here, so a
+        streaming caller and a unary caller always see the same gRPC code
+        for the same server condition): cancelled -> UNAVAILABLE
+        (server-side abandon), ValueError -> INVALID_ARGUMENT (caller
+        error), other exceptions -> UNAVAILABLE (worker death/shutdown).
+        Returns the result on success."""
+        if fut.cancelled():
+            await context.abort(grpc.StatusCode.UNAVAILABLE,
+                                "LM server shut down")
+        exc = fut.exception()
+        if isinstance(exc, ValueError):
+            await context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(exc))
+        if exc is not None:
+            await context.abort(grpc.StatusCode.UNAVAILABLE, str(exc))
+        return fut.result()
+
+    async def _submit_and_await(self, ids, request_id: str, context):
+        """Unary submit/await: preflight, wait with the request deadline
+        (-> DEADLINE_EXCEEDED), client RPC cancellation re-raised for
+        grpc.aio, all terminal outcomes mapped by _result_or_abort."""
+        max_new, seed = await self._preflight(request_id, context)
         fut = self.worker.submit(
             np.asarray(ids, np.int32).reshape(-1), max_new, seed)
         try:
-            return await asyncio.wait_for(
+            await asyncio.wait_for(
                 asyncio.wrap_future(fut), timeout=self.request_timeout)
-        except ValueError as e:
-            # submit-side validation (overlong prompt, budget) — caller error
-            await context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
-        except RuntimeError as e:
-            # worker died mid-request or server shut down — server fault
-            await context.abort(grpc.StatusCode.UNAVAILABLE, str(e))
-        except asyncio.CancelledError:
-            if fut.cancelled():
-                # server-side abandon (non-drain shutdown) — server fault
-                await context.abort(grpc.StatusCode.UNAVAILABLE,
-                                    "LM server shut down")
-            raise  # client cancelled the RPC: let grpc.aio handle it
         except asyncio.TimeoutError:
             await context.abort(
                 grpc.StatusCode.DEADLINE_EXCEEDED,
                 f"generation exceeded {self.request_timeout}s")
+        except asyncio.CancelledError:
+            if not fut.cancelled():
+                raise  # client cancelled the RPC: let grpc.aio handle it
+        except Exception:  # noqa: BLE001 — the future itself holds the
+            pass           # outcome; _result_or_abort maps it
+        return await self._result_or_abort(fut, context)
 
     async def _validated_prompt(self, request: pb.TensorRequest, context):
         """Decode + validate the raw-id prompt (shared by the unary and
@@ -347,11 +363,7 @@ class LMServer:
         The unary SendTensor front stays untouched for reference
         wire-compat (wire.proto)."""
         prompt = await self._validated_prompt(request, context)
-        if not self.worker.is_alive():
-            await context.abort(
-                grpc.StatusCode.UNAVAILABLE,
-                "LM batcher worker is not running (died or shut down)")
-        max_new, seed = parse_gen_options(request.request_id, self.default_max_new)
+        max_new, seed = await self._preflight(request.request_id, context)
         loop = asyncio.get_running_loop()
         q: "asyncio.Queue" = asyncio.Queue()
         cancel_evt = threading.Event()
@@ -392,16 +404,7 @@ class LMServer:
                             np.asarray([val], np.int32)),
                     )
                     continue
-                f = val
-                if f.cancelled():
-                    await context.abort(grpc.StatusCode.UNAVAILABLE,
-                                        "LM server shut down")
-                exc = f.exception()
-                if isinstance(exc, ValueError):
-                    await context.abort(grpc.StatusCode.INVALID_ARGUMENT,
-                                        str(exc))
-                if exc is not None:
-                    await context.abort(grpc.StatusCode.UNAVAILABLE, str(exc))
+                await self._result_or_abort(val, context)
                 return
         except asyncio.CancelledError:
             # the client went away: free the slot at the next step boundary
@@ -420,10 +423,15 @@ class LMServer:
         b = self.batcher
         text = request.message_text
         if self.tokenizer is None or text == "!stats":
+            prefix = ""
+            if b._prefix_cache is not None:
+                prefix = (f", prefix cache: {b.prefix_hits} hits / "
+                          f"{b.prefill_chunks_run} chunks run / "
+                          f"{len(b._prefix_cache)} entries")
             return pb.MessageReply(
                 confirmation_text=(
                     f"[lm] pool: {b.n_active}/{b.slots} slots active, "
-                    f"{len(b.results)} unclaimed results"))
+                    f"{len(b.results)} unclaimed results" + prefix))
         ids = self.tokenizer.encode(text)
         if not ids:
             await context.abort(grpc.StatusCode.INVALID_ARGUMENT,

@@ -156,7 +156,7 @@ class ContinuousBatcher:
                  top_p: Optional[float] = None,
                  compute_dtype=None, eos_id: Optional[int] = None, seed: int = 0,
                  ffn=None, kv_dtype=None, family=None,
-                 attn_kernel: bool = False):
+                 attn_kernel: bool = False, prefix_cache: int = 0):
         self.cfg = cfg
         self.prepared = prepared
         self.slots = slots
@@ -207,6 +207,26 @@ class ContinuousBatcher:
         self._next_rid = 0
         self._slot_req: List[Optional[dict]] = [None] * slots
         self.results: Dict[int, np.ndarray] = {}
+
+        # prefix cache (`prefix_cache` = LRU entry count; 0 disables):
+        # requests sharing a prompt prefix (system prompts) skip
+        # re-prefilling identical chunks. Keyed by the TOKEN BYTES of every
+        # completed full-chunk boundary (K/V at a position depends on all
+        # tokens before it, so only whole prefixes are reusable); the value
+        # is a COPY of the transient row cache after that chunk plus the
+        # chunk's last logit row (enough to sample the first token when
+        # the whole prompt hits). Copies are mandatory — the live row is
+        # donated through the chunk loop. Memory per entry = one row cache
+        # (L, 1, H, row_len, D) x2 in the cache dtype; size the capacity
+        # to HBM. Same three compiled programs: hits/puts are host
+        # bookkeeping + device-to-device copies, never new jit shapes.
+        from collections import OrderedDict
+
+        self._prefix_cache: "Optional[OrderedDict]" = (
+            OrderedDict() if prefix_cache > 0 else None)
+        self._prefix_cap = prefix_cache
+        self.prefix_hits = 0       # submissions that reused >= 1 chunk
+        self.prefill_chunks_run = 0  # chunk programs actually executed
 
         def decode_step(prepared, cache, pos, tok, active, keys):
             """Advance every active slot one token."""
@@ -321,11 +341,51 @@ class ContinuousBatcher:
         padded[0, : len(prompt)] = prompt
         row = self._new_row()
         logits = None
-        for c in range(n_chunks):
+        start_chunk = 0
+        if self._prefix_cache is not None:
+            # longest cached full-chunk prefix of this prompt (tail-padded
+            # partial chunks are never cacheable — their K/V rows hold
+            # garbage beyond the true length)
+            for c in range(len(prompt) // p_pad, 0, -1):
+                hit = self._prefix_cache.get(prompt[: c * p_pad].tobytes())
+                if hit is None:
+                    continue
+                self._prefix_cache.move_to_end(prompt[: c * p_pad].tobytes())
+                cached_row, last_logit_row = hit
+                # copy out: the live row is donated through the chunk loop
+                # and must not invalidate the cached entry
+                row = jax.tree.map(jnp.copy, cached_row)
+                if c == n_chunks:
+                    # whole prompt cached: rebuild a chunk-shaped logits
+                    # array with the stored last row in place (position
+                    # p_pad-1 == the true last prompt token of an exact
+                    # full-chunk prompt) so _prefill_finish keeps its one
+                    # compiled shape
+                    logits = jnp.zeros(
+                        (1, p_pad, last_logit_row.shape[-1]),
+                        last_logit_row.dtype,
+                    ).at[0, p_pad - 1].set(last_logit_row)
+                start_chunk = c
+                self.prefix_hits += 1
+                break
+        for c in range(start_chunk, n_chunks):
             logits, row = self._prefill_chunk(
                 self.prepared, row,
                 jnp.asarray(padded[:, c * p_pad:(c + 1) * p_pad]), c * p_pad,
             )
+            self.prefill_chunks_run += 1
+            if self._prefix_cache is not None and (c + 1) * p_pad <= len(prompt):
+                key = prompt[: (c + 1) * p_pad].tobytes()
+                # scan-resistant insertion: evict the current LRU first,
+                # then park the NEW entry at the LRU end — only a HIT
+                # promotes to MRU. A long novel prompt therefore cycles
+                # its own one-shot chunks through the LRU slot instead of
+                # flushing the hot shared-prefix entries it never matches.
+                while len(self._prefix_cache) >= self._prefix_cap:
+                    self._prefix_cache.popitem(last=False)
+                self._prefix_cache[key] = (
+                    jax.tree.map(jnp.copy, row), jnp.copy(logits[0, -1]))
+                self._prefix_cache.move_to_end(key, last=False)
         last_local = len(prompt) - 1 - (n_chunks - 1) * p_pad
         self.cache, first = self._prefill_finish(
             self.cache, row, logits, last_local, slot, prefill_key,
