@@ -1,0 +1,79 @@
+"""Cached-attention kernel vs einsum across cache lengths — find the
+crossover.
+
+RESULTS.md's attnkernel rows showed the Pallas kernel LOSING at S=256
+(0.73x bf16, 0.58x int8): at short context the cache stream is a few MB
+against ~250 MB of weights per decode step, and the kernel's grid
+dispatch (B*H programs per layer per step) costs more than it saves.
+The kernel's case is long context, where the cache stream dominates the
+step. This probe times the ATTENTION OP alone (not the full decode) at
+decode shapes (T=1) across S, bf16 and int8, kernel vs einsum reference,
+to locate the crossover for an `attn_kernel="auto"` policy.
+
+Usage: python benchmarks/attn_kernel_probe.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+import jax
+import jax.numpy as jnp
+
+from dnn_tpu.ops.pallas.cached_attention import (
+    cached_attention, reference_cached_attention,
+)
+from dnn_tpu.utils.timing import device_time
+
+B, H, D = 8, 12, 64
+
+
+def main():
+    rng = jax.random.PRNGKey(0)
+    for s_len in (256, 1024, 4096, 16384):
+        kq, kk, kv = jax.random.split(rng, 3)
+        q = jax.random.normal(kq, (B, H, 1, D), jnp.bfloat16)
+        k = jax.random.normal(kk, (B, H, s_len, D), jnp.bfloat16)
+        v = jax.random.normal(kv, (B, H, s_len, D), jnp.bfloat16)
+        pos = jnp.full((B,), s_len - 1, jnp.int32)  # cache fully live
+
+        kern = jax.jit(lambda *a: cached_attention(*a))
+        ref = jax.jit(lambda *a: reference_cached_attention(*a))
+        dt_k = device_time(kern, q, k, v, pos, n1=50, n2=200, trials=5)
+        dt_r = device_time(ref, q, k, v, pos, n1=50, n2=200, trials=5)
+
+        ki = jnp.clip(jnp.round(k.astype(jnp.float32) * 20), -127, 127
+                      ).astype(jnp.int8)
+        vi = jnp.clip(jnp.round(v.astype(jnp.float32) * 20), -127, 127
+                      ).astype(jnp.int8)
+        sc = jnp.full((B, H, s_len), 0.05, jnp.float32)
+        kern_q = jax.jit(lambda qq, kk_, vv, pp, s1, s2: cached_attention(
+            qq, kk_, vv, pp, ks=s1, vs=s2))
+        ref_q = jax.jit(lambda qq, kk_, vv, pp, s1, s2:
+                        reference_cached_attention(qq, kk_, vv, pp,
+                                                   ks=s1, vs=s2))
+        dt_kq = device_time(kern_q, q, ki, vi, pos, sc, sc,
+                            n1=50, n2=200, trials=5)
+        dt_rq = device_time(ref_q, q, ki, vi, pos, sc, sc,
+                            n1=50, n2=200, trials=5)
+
+        cache_mb = 2 * B * H * s_len * D * 2 / 1e6
+        print(json.dumps({
+            "s": s_len, "cache_mb_bf16": round(cache_mb, 1),
+            "bf16_kernel_us": round(dt_k * 1e6, 1),
+            "bf16_einsum_us": round(dt_r * 1e6, 1),
+            "bf16_speedup": round(dt_r / dt_k, 3),
+            "int8_kernel_us": round(dt_kq * 1e6, 1),
+            "int8_einsum_us": round(dt_rq * 1e6, 1),
+            "int8_speedup": round(dt_rq / dt_kq, 3),
+        }), flush=True)
+
+
+if __name__ == "__main__":
+    main()

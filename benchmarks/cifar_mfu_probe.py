@@ -1,0 +1,118 @@
+"""CIFAR CNN MFU experiments — close (or explain) the gap to the roofline cap.
+
+The measured forward sits well below the model's own roofline cap
+(benchmarks/RESULTS.md row 1; BASELINE.md's arithmetic-intensity argument
+puts the cap around 22% MFU at B=256 — the CNN streams too many
+activation bytes per FLOP for the MXU to stay busy). This probe times
+controlled variants to find which structural lever moves the number:
+
+  1. batch scaling (256..4096): amortize fixed overheads, give XLA bigger
+     GEMM tiles per conv, and raise arithmetic intensity (the weight
+     stream amortizes over more images — the roofline cap itself grows
+     with batch);
+  2. input-channel padding 3->8 on conv1 (zero-padded kernel rows are
+     mathematically inert): whether the degenerate cin=3 contraction is
+     what starves the first conv;
+  3. conv-segment-only timing, to locate the time between the conv pair
+     and the fc pair.
+
+Each exact variant asserts numerical parity with the baseline forward
+before its number is accepted. The chip sits behind a tunnel whose sync
+jitter reaches tens of ms, so rep counts here are large (the slope
+method's two points must be separated by >> the jitter).
+
+Usage: python benchmarks/cifar_mfu_probe.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dnn_tpu.models import cifar
+from dnn_tpu.utils.flops import cifar_forward_flops, mfu
+from dnn_tpu.utils.timing import device_time
+
+
+def _emit(**row):
+    print(json.dumps(row), flush=True)
+
+
+def _ips(fn, *args, batch):
+    dt = device_time(fn, *args, n1=100, n2=400, trials=5)
+    return batch / dt
+
+
+def main():
+    params = cifar.init(jax.random.PRNGKey(0))
+    base_fn = jax.jit(cifar.make_apply(compute_dtype=jnp.bfloat16))
+    flops1 = cifar_forward_flops(1)
+
+    # -- 1. batch scaling ---------------------------------------------------
+    for batch in (256, 1024, 2048, 4096):
+        x = cifar.example_input(batch_size=batch)
+        ips = _ips(base_fn, params, x, batch=batch)
+        _emit(variant=f"baseline_b{batch}", images_per_sec=round(ips, 1),
+              mfu=round(mfu(flops1, ips) or 0, 4))
+
+    batch = 1024
+    x = cifar.example_input(batch_size=batch)
+    ref = np.asarray(base_fn(params, x))
+
+    # -- 2. conv1 input channels padded 3 -> 8 ------------------------------
+    # zero-pad the image's channel axis and conv1's kernel input axis; the
+    # extra contraction terms are 0*w = 0, so outputs are bit-identical.
+    pad_params = dict(params)
+    pad_params["conv1"] = {
+        "kernel": jnp.pad(params["conv1"]["kernel"],
+                          ((0, 0), (0, 0), (0, 5), (0, 0))),
+        "bias": params["conv1"]["bias"],
+    }
+
+    @jax.jit
+    def padded_fn(p, xx):
+        xx = jnp.pad(xx, ((0, 0), (0, 0), (0, 0), (0, 5)))
+        return cifar.make_apply(compute_dtype=jnp.bfloat16)(p, xx)
+
+    np.testing.assert_allclose(np.asarray(padded_fn(pad_params, x)), ref,
+                               atol=2e-2, rtol=2e-2)
+    ips = _ips(padded_fn, pad_params, x, batch=batch)
+    _emit(variant=f"cin_pad8_b{batch}", images_per_sec=round(ips, 1),
+          mfu=round(mfu(flops1, ips) or 0, 4))
+
+    # -- 3. segment split: convs only vs fcs only ---------------------------
+    @jax.jit
+    def convs_fn(p, xx):
+        xx = xx.astype(jnp.bfloat16)
+        h = cifar._seg_conv1(p, xx, compute_dtype=jnp.bfloat16)
+        return cifar._seg_conv2(p, h, compute_dtype=jnp.bfloat16)
+
+    flat = np.asarray(convs_fn(params, x))
+
+    @jax.jit
+    def fcs_fn(p, hh):
+        h2 = cifar._seg_fc1(p, hh, compute_dtype=jnp.bfloat16)
+        return cifar._seg_fc2(p, h2, compute_dtype=jnp.bfloat16)
+
+    hh = jnp.asarray(flat)
+    ips_c = _ips(convs_fn, params, x, batch=batch)
+    ips_f = _ips(fcs_fn, params, hh, batch=batch)
+    _emit(variant=f"convs_only_b{batch}", images_per_sec=round(ips_c, 1),
+          share_of_forward_pct=round(100 * (batch / ips_c)
+                                     / (batch / ips_c + batch / ips_f), 1))
+    _emit(variant=f"fcs_only_b{batch}", images_per_sec=round(ips_f, 1),
+          share_of_forward_pct=round(100 * (batch / ips_f)
+                                     / (batch / ips_c + batch / ips_f), 1))
+
+
+if __name__ == "__main__":
+    main()

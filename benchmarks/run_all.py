@@ -72,12 +72,15 @@ def run_device_section():
 
     spec = get_model("cifar_cnn")
     params = spec.init(jax.random.PRNGKey(0))
-    batch = 256
+    # B=1024: below ~1024 images a forward is so short (<0.2 ms) that the
+    # tunnel's dispatch floor dominates and the row measures host
+    # overhead, not the chip (benchmarks/cifar_mfu_probe.py batch sweep)
+    batch = 1024
     x = jnp.asarray(spec.example_input(batch_size=batch))
     fn = jax.jit(cifar.make_apply(compute_dtype=jnp.bfloat16))
     # the CIFAR CNN is sub-ms per batch: needs many reps per sample or the
     # slope drowns in sync jitter
-    dt = device_time(fn, params, x, n1=20, n2=100, trials=5)
+    dt = device_time(fn, params, x, n1=100, n2=400, trials=5)
     ips = batch / dt
     cifar_row = _with_mfu({}, cifar_forward_flops(1), ips)
     # the CNN's arithmetic intensity (~60 FLOPs/byte) is far below the TPU
@@ -134,7 +137,48 @@ def run_device_section():
               value=round(tps, 1), platform=platform, batch=b, seq=s,
               logits="bf16",
               **_with_mfu({}, llama_forward_flops(ll_cfg, b, s) / (b * s), tps))
-        del ll_prep  # 2.2 GB of bf16 weights — free before the decode rows
+
+        # TinyLlama decode matrix — the GQA bandwidth claim, measured.
+        # The cache is stored at KV-head width (llama.init_cache):
+        # KV*D = 256 floats/position/layer vs the model width 2048, so at
+        # equal batch/seq TinyLlama streams 8x fewer cache bytes per step
+        # than an MHA model of its width. Rows mirror the GPT-2 matrix
+        # below (same batch/new_tokens) so bytes/token and MBU are
+        # directly comparable across families.
+        from dnn_tpu.quant import param_bytes as _pb
+        from dnn_tpu.quant import quantize_tree
+        from dnn_tpu.utils.flops import mbu as _mbu
+
+        db, dprompt, dnew = 8, 16, 128
+        d_ids = jax.random.randint(jax.random.PRNGKey(4), (db, dprompt), 0,
+                                   ll_cfg.vocab_size, dtype=jnp.int32)
+        d_smax = dprompt + dnew
+        ll_cache_elems = (2 * ll_cfg.n_layer * db
+                          * ll_cfg.n_kv_head * ll_cfg.head_dim * d_smax)
+        ll_q = quantize_tree(ll_prep)
+        rng_d = jax.random.PRNGKey(5)
+        for name, weights, kvd, itemsize in (
+                ("w_bf16_kv_bf16", ll_prep, jnp.bfloat16, 2),
+                ("w_int8_kv_int8", ll_q, "int8", 1)):
+            gfn = llama.make_generate(
+                ll_cfg, max_new_tokens=dnew, compute_dtype=jnp.bfloat16,
+                kv_dtype=kvd)
+            dt = device_time(gfn, weights, d_ids, rng_d, n1=1, n2=3)
+            tps = db * dnew / dt
+            # int8 cache rides per-(position, kv-head) f32 scales for K
+            # and V: cache_elems / head_dim scale entries x 4 bytes
+            bpt = (_pb(weights) + ll_cache_elems * itemsize
+                   + (ll_cache_elems // ll_cfg.head_dim * 4
+                      if kvd == "int8" else 0)) / db
+            row = {"bytes_per_token_mb": round(bpt / 1e6, 2)}
+            u = _mbu(bpt, tps)
+            if u is not None:
+                row["mbu"] = round(u, 4)
+            _emit(results, config=f"tinyllama_decode_{name}",
+                  metric="tokens_per_sec", value=round(tps, 1),
+                  platform=platform, batch=db, new_tokens=dnew, **row)
+        del ll_q
+        del ll_prep  # 2.2 GB of bf16 weights — free before the GPT rows
 
     # KV-cache generation throughput (the serving path the reference lacks)
     from dnn_tpu.runtime import generate as gen
@@ -398,6 +442,37 @@ def run_cpu_mesh_section():
                "steps and ring hops (per-sub-step dispatch + dynamic "
                "chunk gather dominate on CPU); the bubble win needs "
                "stage COMPUTE to dominate, i.e. real chips + real models")
+
+    # LLaMA seq-sharded decode on a 4-device "seq" mesh: each device owns
+    # a contiguous block of cache positions at GQA KV-head width; decode
+    # steps combine per-shard attention with the exact distributed online
+    # softmax (llama.make_generate_seq_sharded). Parity-guarded against
+    # the solo decoder before the number is published; cpu-mesh value
+    # validates the machinery, not the speed.
+    from dnn_tpu.models import llama
+    from dnn_tpu.parallel.mesh import SEQ_AXIS
+
+    ll_cfg = llama.PRESETS["llama-test"]
+    ll_p = gpt.prepare_stacked(
+        llama.init(jax.random.PRNGKey(0), ll_cfg), ll_cfg)
+    smesh = make_mesh({SEQ_AXIS: 4}, jax.devices()[:4])
+    lb, lt, lnew = 2, 8, 16
+    l_ids = jax.random.randint(jax.random.PRNGKey(2), (lb, lt), 0,
+                               ll_cfg.vocab_size, dtype=jnp.int32)
+    l_rng = jax.random.PRNGKey(3)
+    gen_seq = llama.make_generate_seq_sharded(
+        ll_cfg, smesh, max_new_tokens=lnew)
+    np.testing.assert_array_equal(
+        np.asarray(gen_seq(ll_p, l_ids, l_rng)),
+        np.asarray(llama.make_generate(ll_cfg, max_new_tokens=lnew)(
+            ll_p, l_ids, l_rng)))
+    dt = device_time(gen_seq, ll_p, l_ids, l_rng, n1=1, n2=3)
+    _emit(results, config="llama_seq_sharded_decode",
+          metric="tokens_per_sec", value=round(lb * lnew / dt, 1),
+          platform="cpu-mesh", batch=lb, new_tokens=lnew, seq_shards=4,
+          note="each shard holds ceil(S_max/4) cache positions at "
+               "KV-head width; token-parity with the solo decoder "
+               "asserted in-run")
 
     # p50 inter-stage hop latency (relay executor, device-to-device)
     stages = spec.partition(2)

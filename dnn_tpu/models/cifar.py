@@ -81,7 +81,24 @@ def init(rng, dtype=jnp.float32):
 
 
 def _seg_conv1(params, x, compute_dtype=None):
-    return max_pool2d(relu(conv2d(params["conv1"], x, compute_dtype=compute_dtype)))
+    # Input channels padded 3 -> 8 before the conv: XLA's TPU conv emitter
+    # handles the degenerate cin=3 contraction poorly — the zero-pad
+    # measures ~2x forward throughput on a v5e (19.7% -> 39.1% MFU at
+    # B=1024, benchmarks/cifar_mfu_probe.py). Zero kernel rows contribute
+    # exact zeros to the accumulation, so outputs are bit-identical in
+    # every dtype; params keep the reference's (3, 32) kernel shape
+    # (cifar_model_parts.py:9) so checkpoints are unaffected.
+    kernel = params["conv1"]["kernel"]
+    # TPU-only: other backends' conv emitters don't share the degenerate-
+    # cin penalty, so they'd pay the extra MACs for nothing. Resolved at
+    # trace time (jit traces per backend), so each backend compiles its
+    # own consistent branch.
+    pad = max(0, 8 - kernel.shape[2]) if jax.default_backend() == "tpu" else 0
+    if pad:
+        kernel = jnp.pad(kernel, ((0, 0), (0, 0), (0, pad), (0, 0)))
+        x = jnp.pad(x, ((0, 0), (0, 0), (0, 0), (0, pad)))
+    padded = {"kernel": kernel, "bias": params["conv1"]["bias"]}
+    return max_pool2d(relu(conv2d(padded, x, compute_dtype=compute_dtype)))
 
 
 def _seg_conv2(params, x, compute_dtype=None):

@@ -103,7 +103,13 @@ def cifar_forward_bytes(batch: int, *, dtype_bytes: int = 2) -> float:
     arithmetic intensity (~60 FLOPs/byte) sits far below a v5e's ridge
     point (~240 FLOPs/byte): the model is HBM-BOUND at any batch size,
     and its MFU ceiling is intensity/ridge (~24%), not 100%. The bench
-    row reports this cap next to the measured MFU (VERDICT r2 weak #3)."""
+    row reports this cap next to the measured MFU (VERDICT r2 weak #3).
+
+    The cap is CONSERVATIVE: it charges every op boundary a full HBM
+    round trip, but XLA keeps some producer->consumer tiles in VMEM (the
+    conv1-padded forward measures ~39% MFU at B=1024 on a v5e —
+    benchmarks/cifar_mfu_probe.py), so `roofline_frac` can legitimately
+    exceed 1.0."""
     act = dtype_bytes * (
         32 * 32 * 3          # input read by conv1
         + 32 * 32 * 32 * 2   # conv1 write + pool1 read
