@@ -27,7 +27,7 @@ import jax
 import jax.numpy as jnp
 
 from dnn_tpu.ops.pallas.cached_attention import (
-    cached_attention, reference_cached_attention,
+    decode_attention, reference_decode_attention,
 )
 from dnn_tpu.utils.timing import device_time
 
@@ -43,25 +43,25 @@ def main():
         v = jax.random.normal(kv, (B, H, s_len, D), jnp.bfloat16)
         pos = jnp.full((B,), s_len - 1, jnp.int32)  # cache fully live
 
-        kern = jax.jit(lambda *a: cached_attention(*a))
-        ref = jax.jit(lambda *a: reference_cached_attention(*a))
-        dt_k = device_time(kern, q, k, v, pos, n1=50, n2=200, trials=5)
-        dt_r = device_time(ref, q, k, v, pos, n1=50, n2=200, trials=5)
+        kern = jax.jit(lambda *a: decode_attention(*a))
+        ref = jax.jit(lambda *a: reference_decode_attention(*a))
+        dt_k = device_time(kern, q, k, v, pos, n1=100, n2=400, trials=5)
+        dt_r = device_time(ref, q, k, v, pos, n1=100, n2=400, trials=5)
 
         ki = jnp.clip(jnp.round(k.astype(jnp.float32) * 20), -127, 127
                       ).astype(jnp.int8)
         vi = jnp.clip(jnp.round(v.astype(jnp.float32) * 20), -127, 127
                       ).astype(jnp.int8)
         sc = jnp.full((B, H, s_len), 0.05, jnp.float32)
-        kern_q = jax.jit(lambda qq, kk_, vv, pp, s1, s2: cached_attention(
+        kern_q = jax.jit(lambda qq, kk_, vv, pp, s1, s2: decode_attention(
             qq, kk_, vv, pp, ks=s1, vs=s2))
         ref_q = jax.jit(lambda qq, kk_, vv, pp, s1, s2:
-                        reference_cached_attention(qq, kk_, vv, pp,
+                        reference_decode_attention(qq, kk_, vv, pp,
                                                    ks=s1, vs=s2))
         dt_kq = device_time(kern_q, q, ki, vi, pos, sc, sc,
-                            n1=50, n2=200, trials=5)
+                            n1=100, n2=400, trials=5)
         dt_rq = device_time(ref_q, q, ki, vi, pos, sc, sc,
-                            n1=50, n2=200, trials=5)
+                            n1=100, n2=400, trials=5)
 
         cache_mb = 2 * B * H * s_len * D * 2 / 1e6
         print(json.dumps({
@@ -69,9 +69,11 @@ def main():
             "bf16_kernel_us": round(dt_k * 1e6, 1),
             "bf16_einsum_us": round(dt_r * 1e6, 1),
             "bf16_speedup": round(dt_r / dt_k, 3),
+            "bf16_kernel_gbps": round(cache_mb / 1e3 / dt_k, 1),
             "int8_kernel_us": round(dt_kq * 1e6, 1),
             "int8_einsum_us": round(dt_rq * 1e6, 1),
             "int8_speedup": round(dt_rq / dt_kq, 3),
+            "int8_kernel_gbps": round(cache_mb / 2e3 / dt_kq, 1),
         }), flush=True)
 
 

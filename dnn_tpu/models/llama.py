@@ -280,9 +280,17 @@ def _block_with_cache(bp, x, layer_cache, start_pos, *, cfg: LlamaConfig,
     q, k, v = _qkv_rope(bp, h, start_pos + jnp.arange(t), cfg=cfg,
                         compute_dtype=compute_dtype)
     layer_cache = codec.write(layer_cache, k, v, start_pos)
-    pos_limit = start_pos + jnp.arange(t)
     qg = q.reshape(b, kv, g * t, cfg.head_dim)
-    yg = codec.attend(qg, layer_cache, jnp.tile(pos_limit, g))
+    if t == 1:
+        # decode step: the folded group rows all share the slot's limit —
+        # exactly attend_rows' contract, which streams through the Pallas
+        # decode kernel when the codec carries use_kernel
+        yg = codec.attend_rows(
+            qg, layer_cache,
+            jnp.broadcast_to(jnp.asarray(start_pos, jnp.int32), (b,)))
+    else:
+        pos_limit = start_pos + jnp.arange(t)
+        yg = codec.attend(qg, layer_cache, jnp.tile(pos_limit, g))
     y = yg.reshape(b, cfg.n_head, t, cfg.head_dim)
     x = x + linear(bp["attn"]["o"], merge_heads(y.astype(x.dtype)),
                    compute_dtype=compute_dtype)
@@ -301,10 +309,10 @@ def init_cache(cfg: LlamaConfig, batch: int, max_len: int, dtype=jnp.float32):
 
 
 def forward_with_cache(prepared, ids, cache, start_pos, *, cfg: LlamaConfig,
-                       compute_dtype=None):
+                       compute_dtype=None, attn_kernel=False):
     from dnn_tpu.runtime.kvcache import codec_for_cache
 
-    codec = codec_for_cache(cache)
+    codec = codec_for_cache(cache, use_kernel=attn_kernel)
     x = embedding(prepared["wte"], ids)
     if compute_dtype is not None:
         x = x.astype(compute_dtype)
@@ -325,9 +333,10 @@ def forward_with_cache(prepared, ids, cache, start_pos, *, cfg: LlamaConfig,
 def make_generate(cfg: LlamaConfig, *, max_new_tokens: int,
                   temperature: float = 0.0, top_k: Optional[int] = None,
                   top_p: Optional[float] = None,
-                  compute_dtype=None, kv_dtype=None):
+                  compute_dtype=None, kv_dtype=None, attn_kernel=False):
     """Jitted generate(prepared, ids, rng) — same contract as the GPT
-    family's decoder, including kv_dtype (f32/bf16/"int8") cache storage."""
+    family's decoder, including kv_dtype (f32/bf16/"int8") cache storage
+    and attn_kernel (Pallas streaming cache attention on decode steps)."""
     from dnn_tpu.runtime.generate import _sample
 
     if max_new_tokens < 1:
@@ -344,7 +353,8 @@ def make_generate(cfg: LlamaConfig, *, max_new_tokens: int,
         cache_dtype = kv_dtype if kv_dtype is not None else (compute_dtype or jnp.float32)
         cache = init_cache(cfg, b, s_max, cache_dtype)
         logits, cache = forward_with_cache(
-            prepared, ids, cache, 0, cfg=cfg, compute_dtype=compute_dtype)
+            prepared, ids, cache, 0, cfg=cfg, compute_dtype=compute_dtype,
+            attn_kernel=attn_kernel)
         rng, sub = jax.random.split(rng)
         tok = _sample(logits[:, -1], sub, temperature=temperature,
                       top_k=top_k, top_p=top_p)
@@ -353,7 +363,7 @@ def make_generate(cfg: LlamaConfig, *, max_new_tokens: int,
             cache, tok, rng = carry
             logits, cache = forward_with_cache(
                 prepared, tok[:, None], cache, t + i, cfg=cfg,
-                compute_dtype=compute_dtype)
+                compute_dtype=compute_dtype, attn_kernel=attn_kernel)
             rng, sub = jax.random.split(rng)
             nxt = _sample(logits[:, -1], sub, temperature=temperature,
                           top_k=top_k, top_p=top_p)
@@ -568,9 +578,12 @@ class LlamaFamilyRows:
     q (B, H, 1, D) -> (B, KV, G, D) — since every group row shares its
     slot's position limit."""
 
-    def __init__(self, cfg: LlamaConfig, *, compute_dtype=None):
+    def __init__(self, cfg: LlamaConfig, *, compute_dtype=None,
+                 attn_kernel: bool = False):
         self.cfg = cfg
         self.compute_dtype = compute_dtype
+        # picked up by ContinuousBatcher for the decode-rows codec too
+        self.attn_kernel = attn_kernel
 
     def init_cache(self, batch, max_len, dtype):
         return init_cache(self.cfg, batch, max_len, dtype)
@@ -578,7 +591,7 @@ class LlamaFamilyRows:
     def prefill(self, prepared, padded, row_cache, start_pos=0):
         return forward_with_cache(
             prepared, padded, row_cache, start_pos, cfg=self.cfg,
-            compute_dtype=self.compute_dtype)
+            compute_dtype=self.compute_dtype, attn_kernel=self.attn_kernel)
 
     def _block_rows(self, bp, x, layer_cache, pos, write, codec):
         cfg, compute_dtype = self.cfg, self.compute_dtype

@@ -230,3 +230,210 @@ def cached_attention(q, k, v, pos, *, ks=None, vs=None, block_q=128,
     out = _kernel_call(q3, k3, v3, pos1d, ks3, vs3, block_q=block_q,
                        block_s=block_s, interpret=interpret)
     return out.reshape(b, h, t, d)
+
+
+# ----------------------------------------------------------------------
+# decode-specialized kernel (T=1 steps; all query rows share the slot's
+# position limit)
+# ----------------------------------------------------------------------
+#
+# Why the general kernel above fails at decode: with block_q=1 its grid is
+# (B*H, 1, S/128) — thousands of programs each DMAing a 128-row cache tile
+# (~32 KB), a latency-bound pipeline that measured 23x SLOWER than the XLA
+# einsum at S=4096 (benchmarks/attn_kernel_probe.py). Decode attention is
+# pure bandwidth: the right shape is FEW programs streaming BIG blocks.
+# This kernel folds all heads into one program — grid (B, S/block_s),
+# each step DMAing an (Hk, block_s, D) K and V slab (hundreds of KB) —
+# and clamps the cache index map at the slot's live limit, so blocks past
+# `pos` are never fetched (Pallas skips the copy when consecutive grid
+# steps map to the same block): per-step traffic scales with the ACTIVE
+# context, not the allocation.
+#
+# MEASURED VERDICT (v5e, benchmarks/attn_kernel_probe.py, B=8 H=12 D=64):
+# this shape wins at moderate context (1.8x at S=256, 1.2x at S=1024 bf16)
+# but XLA's einsum decode attention is already near-bandwidth-optimal on
+# this chip — 600-700 GB/s at S=16384 INCLUDING the fused int8 dequant
+# (int8 runs 1.7x faster than bf16 einsum, i.e. the byte reduction is
+# fully realized with no materialized float cache) — while this kernel
+# tops out ~200 GB/s: with D=64 the cache block's minor dim fills only
+# half of the 128 VMEM lanes, so every DMA moves half-empty tiles.
+# Consequence: `attn_kernel` stays OFF by default; the einsum is the
+# decode hot path, and this kernel is (a) the runtime-position chunked
+# prefill program (which flash_attention.py cannot express) and (b) the
+# 1-byte-read guarantee should a future XLA stop fusing the int8 upcast.
+#
+# The query is (B, Hk, R, D): R rows per KV head, ALL sharing their
+# slot's limit pos[b]. R=1 is plain MHA decode; R=G covers GQA's folded
+# query groups (models/llama.py decode) — the fold that the general
+# kernel's +row masking contract had to exclude.
+
+
+def reference_decode_attention(q, k, v, pos, *, ks=None, vs=None):
+    """q (B, Hk, R, D) decode rows; every row of slot b attends cache
+    columns <= pos[b]. k/v (B, Hk, S, D) float — or int8 with ks/vs
+    (B, Hk, S) scales. Returns (B, Hk, R, D) f32. Identical math to
+    FloatKV/Int8KV.attend_rows' einsum (dnn_tpu/runtime/kvcache.py)."""
+    d = q.shape[-1]
+    s = jnp.einsum("bhrd,bhsd->bhrs", q.astype(jnp.float32),
+                   k.astype(jnp.float32),
+                   preferred_element_type=jnp.float32)
+    if ks is not None:
+        s = s * ks[:, :, None, :]
+    s = s / jnp.sqrt(d)
+    cols = jnp.arange(k.shape[2])
+    s = jnp.where(cols[None, None, None, :] <= pos[:, None, None, None],
+                  s, _NEG_BIG)
+    p = jax.nn.softmax(s, axis=-1)
+    if vs is not None:
+        p = p * vs[:, :, None, :]
+    return jnp.einsum("bhrs,bhsd->bhrd", p, v.astype(jnp.float32),
+                      preferred_element_type=jnp.float32)
+
+
+def _decode_attn_kernel(pos_ref, q_ref, k_ref, v_ref, *rest,
+                        scale, block_s, quant):
+    from jax.experimental import pallas as pl
+
+    if quant:
+        ks_ref, vs_ref, o_ref, m_scr, l_scr, acc_scr = rest
+    else:
+        ks_ref = vs_ref = None
+        o_ref, m_scr, l_scr, acc_scr = rest
+
+    si = pl.program_id(1)
+    ns = pl.num_programs(1)
+
+    @pl.when(si == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, _NEG_BIG)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    pos = pos_ref[pl.program_id(0)]
+    # blocks past the live limit: index map re-targets them at the limit
+    # block (no DMA — see _decode_call) and compute is skipped here
+    live = si * block_s <= pos
+
+    @pl.when(live)
+    def _step():
+        q = q_ref[0].astype(jnp.float32)   # (Hk, R, d)
+        k = k_ref[0].astype(jnp.float32)   # (Hk, block_s, d)
+        hk, r, d = q.shape
+        s = jax.lax.dot_general(
+            q, k, (((2,), (2,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32,
+        )  # (Hk, R, block_s)
+        if quant:
+            s = s * ks_ref[0][:, None, :]
+        s = s * scale
+        s2 = s.reshape(hk * r, block_s)
+        cols = jax.lax.broadcasted_iota(
+            jnp.int32, (hk * r, block_s), 1) + si * block_s
+        s2 = jnp.where(cols <= pos, s2, _NEG_BIG)
+
+        m_prev = m_scr[:, :1]
+        m_new = jnp.maximum(m_prev, s2.max(axis=-1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s2 - m_new)  # (Hk*R, block_s)
+        if quant:
+            # V scales broadcast over the R query rows of each KV head
+            pv = p.reshape(hk, r, block_s) * vs_ref[0][:, None, :]
+        else:
+            pv = p.reshape(hk, r, block_s)
+        v = v_ref[0].astype(jnp.float32)   # (Hk, block_s, d)
+        out = jax.lax.dot_general(
+            pv, v, (((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32,
+        )  # (Hk, R, d)
+        l_new = l_scr[:, :1] * alpha + p.sum(axis=-1, keepdims=True)
+        acc_scr[...] = acc_scr[...] * alpha + out.reshape(hk * r, d)
+        m_scr[...] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[...] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    @pl.when(si == ns - 1)
+    def _finish():
+        hk, r, d = q_ref.shape[1:]
+        o_ref[0] = (acc_scr[...] / l_scr[:, :1]).reshape(hk, r, d) \
+            .astype(o_ref.dtype)
+
+
+def _decode_call(q, k, v, pos1d, ks, vs, *, block_s, interpret):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, hk, r, d = q.shape
+    s_len = k.shape[2]
+    ns = s_len // block_s
+    quant = ks is not None
+    kernel = functools.partial(
+        _decode_attn_kernel, scale=1.0 / (d ** 0.5), block_s=block_s,
+        quant=quant,
+    )
+
+    # cache blocks clamp their index at the slot's last LIVE block:
+    # consecutive grid steps past the limit map to the same block, and the
+    # Pallas TPU pipeline skips the copy when a block index repeats —
+    # dead allocation is never streamed.
+    def _cache_map(bi, si, p):
+        return (bi, 0, jnp.minimum(si, p[bi] // block_s), 0)
+
+    def _scale_map(bi, si, p):
+        return (bi, 0, jnp.minimum(si, p[bi] // block_s))
+
+    qspec = pl.BlockSpec((1, hk, r, d), lambda bi, si, p: (bi, 0, 0, 0))
+    cspec = pl.BlockSpec((1, hk, block_s, d), _cache_map)
+    in_specs = [qspec, cspec, cspec]
+    args = [q, k, v]
+    if quant:
+        in_specs += [pl.BlockSpec((1, hk, block_s), _scale_map)] * 2
+        args += [ks, vs]
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(b, ns),
+        in_specs=in_specs,
+        out_specs=qspec,
+        scratch_shapes=[
+            pltpu.VMEM((hk * r, 128), jnp.float32),  # running row max
+            pltpu.VMEM((hk * r, 128), jnp.float32),  # running row sum
+            pltpu.VMEM((hk * r, d), jnp.float32),    # output accumulator
+        ],
+    )
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, hk, r, d), jnp.float32),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary")
+        ),
+        interpret=interpret,
+    )(pos1d, *args)
+
+
+def decode_attention(q, k, v, pos, *, ks=None, vs=None, block_s=512,
+                     interpret=None):
+    """Decode-step cache attention (see the section comment above).
+
+    q (B, Hk, R, D) — R query rows per KV head, all attending columns
+    <= pos[b] of their slot; k/v (B, Hk, S, D) float or int8 with ks/vs
+    (B, Hk, S) scales; pos (B,) int32. Returns (B, Hk, R, D) f32.
+
+    Dispatches to the Pallas streaming kernel on TPU when S tiles by a
+    {512, 256, 128} block; otherwise runs the identical-math reference.
+    `interpret=True` forces the kernel in interpreter mode (CPU CI)."""
+    on_tpu = jax.default_backend() == "tpu"
+    if interpret is None:
+        if not on_tpu:
+            return reference_decode_attention(q, k, v, pos, ks=ks, vs=vs)
+        interpret = False
+    s_len = k.shape[2]
+    for bs in (block_s, 256, 128):
+        if s_len % bs == 0:
+            block_s = bs
+            break
+    else:
+        return reference_decode_attention(q, k, v, pos, ks=ks, vs=vs)
+    pos1d = pos.astype(jnp.int32)
+    ks_f = ks.astype(jnp.float32) if ks is not None else None
+    vs_f = vs.astype(jnp.float32) if vs is not None else None
+    return _decode_call(q, k, v, pos1d, ks_f, vs_f, block_s=block_s,
+                        interpret=interpret)

@@ -98,12 +98,23 @@ class FloatKV(_KernelDispatch):
         (generate.py's _block_with_cache does). The kernel path engages
         ONLY with it — call sites with folded/tiled row limits (the LLaMA
         GQA group trick, llama.py) never pass base, so use_kernel can't
-        silently mis-mask them; they fall through to the einsum."""
+        silently mis-mask them; they fall through to the einsum (or, for
+        T==1 folded rows, route via attend_rows' decode kernel)."""
         if self.use_kernel and base is not None:
-            from dnn_tpu.ops.pallas.cached_attention import cached_attention
+            from dnn_tpu.ops.pallas.cached_attention import (
+                cached_attention, decode_attention,
+            )
 
+            pos_b = jnp.broadcast_to(base, (q.shape[0],))
+            if q.shape[2] == 1:
+                # decode step: the heads-folded streaming kernel (few
+                # programs, big DMAs) — the general kernel's block_q=1
+                # grid measured 23x slower (ops/pallas/cached_attention)
+                return decode_attention(
+                    q, c["k"], c["v"], pos_b,
+                    interpret=self._interp()).astype(c["v"].dtype)
             return cached_attention(
-                q, c["k"], c["v"], jnp.broadcast_to(base, (q.shape[0],)),
+                q, c["k"], c["v"], pos_b,
                 interpret=self._interp()).astype(c["v"].dtype)
         d = q.shape[-1]
         s = jnp.einsum("bhtd,bhsd->bhts", q, c["k"]).astype(jnp.float32) / jnp.sqrt(d)
@@ -124,15 +135,13 @@ class FloatKV(_KernelDispatch):
                 "v": jnp.where(w, v_new, c["v"])}
 
     def attend_rows(self, q, c, pos):
-        """q (B,H,1,D); each row masked to keys at positions <= its own
-        pos (B,)."""
-        # kernel contract: exactly one query row per slot (the kernel adds
-        # +row to each slot's limit — T>1 callers fold GQA groups into the
-        # row axis with SHARED limits, which must take the einsum)
-        if self.use_kernel and q.shape[2] == 1:
-            from dnn_tpu.ops.pallas.cached_attention import cached_attention
+        """q (B, H, R, D); every row of slot b masked to keys at positions
+        <= pos[b]. R=1 is plain per-slot decode; R=G is the LLaMA GQA fold
+        (all group rows share their slot's limit — llama.LlamaFamilyRows)."""
+        if self.use_kernel:
+            from dnn_tpu.ops.pallas.cached_attention import decode_attention
 
-            return cached_attention(q, c["k"], c["v"], pos,
+            return decode_attention(q, c["k"], c["v"], pos,
                                     interpret=self._interp()) \
                 .astype(c["v"].dtype)
         d = q.shape[-1]
@@ -189,10 +198,17 @@ class Int8KV(_KernelDispatch):
         # `base` marks the pos_limit == base + arange(T) contract (see
         # FloatKV.attend) — kernel path only with it
         if self.use_kernel and base is not None:
-            from dnn_tpu.ops.pallas.cached_attention import cached_attention
+            from dnn_tpu.ops.pallas.cached_attention import (
+                cached_attention, decode_attention,
+            )
 
+            pos_b = jnp.broadcast_to(base, (q.shape[0],))
+            if q.shape[2] == 1:  # decode step: streaming kernel
+                return decode_attention(
+                    q, c["k"], c["v"], pos_b, ks=c["ks"], vs=c["vs"],
+                    interpret=self._interp())
             return cached_attention(
-                q, c["k"], c["v"], jnp.broadcast_to(base, (q.shape[0],)),
+                q, c["k"], c["v"], pos_b,
                 ks=c["ks"], vs=c["vs"], interpret=self._interp())
         d = q.shape[-1]
         # scores in f32; the per-position K scale lands on the score matrix
@@ -229,11 +245,11 @@ class Int8KV(_KernelDispatch):
         return {kk: jnp.where(gates[kk], new[kk], c[kk]) for kk in c}
 
     def attend_rows(self, q, c, pos):
-        # one query row per slot only (see FloatKV.attend_rows)
-        if self.use_kernel and q.shape[2] == 1:
-            from dnn_tpu.ops.pallas.cached_attention import cached_attention
+        # shared-limit decode rows, any R (see FloatKV.attend_rows)
+        if self.use_kernel:
+            from dnn_tpu.ops.pallas.cached_attention import decode_attention
 
-            return cached_attention(q, c["k"], c["v"], pos,
+            return decode_attention(q, c["k"], c["v"], pos,
                                     ks=c["ks"], vs=c["vs"],
                                     interpret=self._interp())
         d = q.shape[-1]

@@ -14,7 +14,9 @@ import numpy as np
 from dnn_tpu.models import gpt
 from dnn_tpu.ops.pallas.cached_attention import (
     cached_attention,
+    decode_attention,
     reference_cached_attention,
+    reference_decode_attention,
 )
 
 RNG = np.random.default_rng(0)
@@ -74,6 +76,116 @@ def test_kernel_nontiling_falls_back():
     want = reference_cached_attention(q, k, v, pos)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                atol=1e-6, rtol=1e-6)
+
+
+# ----------------------------------------------------------------------
+# decode-specialized kernel (heads folded into one program per slot;
+# clamped index map skips dead cache blocks)
+# ----------------------------------------------------------------------
+
+
+def test_decode_kernel_r1_positions_span_blocks():
+    """R=1 (plain MHA decode rows) at positions inside the first block,
+    mid-buffer, and the last column — incl. limits that leave most blocks
+    dead (the clamped index map must not corrupt the live prefix)."""
+    B, H, S, D = 4, 4, 512, 64
+    q = _rand((B, H, 1, D))
+    k, v = _rand((B, H, S, D)), _rand((B, H, S, D))
+    pos = jnp.asarray([3, 127, 128, 511], jnp.int32)
+    for cast in (jnp.float32, jnp.bfloat16):
+        want = reference_decode_attention(q, k.astype(cast), v.astype(cast),
+                                          pos)
+        got = decode_attention(q, k.astype(cast), v.astype(cast), pos,
+                               interpret=True)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   atol=1e-5, rtol=1e-5)
+
+
+def test_decode_kernel_gqa_rows_share_limit():
+    """R=G>1 (the LLaMA GQA fold): every group row of a slot shares the
+    slot's limit — the case the general kernel's +row contract excludes."""
+    B, KV, G, S, D = 2, 2, 4, 256, 64
+    q = _rand((B, KV, G, D))
+    k, v = _rand((B, KV, S, D)), _rand((B, KV, S, D))
+    pos = jnp.asarray([9, 255], jnp.int32)
+    want = reference_decode_attention(q, k, v, pos)
+    got = decode_attention(q, k, v, pos, interpret=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=1e-5, rtol=1e-5)
+
+
+def test_decode_kernel_int8_scales():
+    B, H, S, D = 2, 4, 256, 64
+    q = _rand((B, H, 1, D))
+    kq = jnp.asarray(RNG.integers(-127, 128, (B, H, S, D)), jnp.int8)
+    vq = jnp.asarray(RNG.integers(-127, 128, (B, H, S, D)), jnp.int8)
+    ks = jnp.asarray(RNG.uniform(0.005, 0.02, (B, H, S)), jnp.float32)
+    vs = jnp.asarray(RNG.uniform(0.005, 0.02, (B, H, S)), jnp.float32)
+    pos = jnp.asarray([7, 200], jnp.int32)
+    want = reference_decode_attention(q, kq, vq, pos, ks=ks, vs=vs)
+    got = decode_attention(q, kq, vq, pos, ks=ks, vs=vs, interpret=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=1e-4, rtol=1e-4)
+
+
+def test_decode_kernel_block_size_fallback():
+    """S=128 engages the 128 block; S=96 doesn't tile -> reference path."""
+    B, H, D = 2, 2, 64
+    for S in (128, 96):
+        q = _rand((B, H, 1, D))
+        k, v = _rand((B, H, S, D)), _rand((B, H, S, D))
+        pos = jnp.asarray([5, S - 1], jnp.int32)
+        want = reference_decode_attention(q, k, v, pos)
+        got = decode_attention(q, k, v, pos,
+                               interpret=True if S == 128 else None)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   atol=1e-5, rtol=1e-5)
+
+
+def test_llama_generate_with_kernel_matches_einsum():
+    """LLaMA solo decode (GQA fold through attend_rows) with
+    attn_kernel='interpret': greedy tokens equal the einsum path. Cache
+    length 120+8=128 tiles the kernel's 128 block so decode steps really
+    run it (llama-test's block_size=64 cache would silently fall back)."""
+    from dnn_tpu.models import llama
+
+    cfg = llama.LlamaConfig(block_size=256, vocab_size=256, n_layer=2,
+                            n_head=4, n_kv_head=2, n_embd=64, d_ff=128)
+    prepared = gpt.prepare_stacked(
+        llama.init(jax.random.PRNGKey(0), cfg), cfg)
+    prompt = jax.random.randint(jax.random.PRNGKey(1), (2, 120), 0,
+                                cfg.vocab_size, dtype=jnp.int32)
+    want = llama.make_generate(cfg, max_new_tokens=8)(
+        prepared, prompt, jax.random.PRNGKey(2))
+    got = llama.make_generate(cfg, max_new_tokens=8,
+                              attn_kernel="interpret")(
+        prepared, prompt, jax.random.PRNGKey(2))
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_llama_batcher_with_kernel_matches_einsum():
+    """LlamaFamilyRows(attn_kernel='interpret') through the
+    ContinuousBatcher: R=G decode rows hit the decode kernel; tokens equal
+    the plain batcher."""
+    from dnn_tpu.models import llama
+    from dnn_tpu.runtime.serving import ContinuousBatcher
+
+    cfg = llama.LlamaConfig(block_size=256, vocab_size=256, n_layer=2,
+                            n_head=4, n_kv_head=2, n_embd=64, d_ff=128)
+    prepared = gpt.prepare_stacked(
+        llama.init(jax.random.PRNGKey(3), cfg), cfg)
+    prompt = np.asarray(jax.random.randint(
+        jax.random.PRNGKey(4), (100,), 0, cfg.vocab_size, dtype=jnp.int32))
+
+    def run(**kw):
+        # max_len 128 tiles the decode kernel's 128 block
+        srv = ContinuousBatcher(
+            cfg, prepared, slots=2, max_len=128, prompt_pad=128,
+            family=llama.LlamaFamilyRows(cfg, **kw))
+        rid = srv.submit(prompt, max_new_tokens=6)
+        return srv.drain()[rid]
+
+    np.testing.assert_array_equal(run(attn_kernel="interpret"), run())
 
 
 # ----------------------------------------------------------------------
