@@ -179,6 +179,75 @@ def shard_pytree(tree, mesh: Mesh, specs):
     return jax.device_put(tree, specs_to_shardings(mesh, specs))
 
 
+def _spec_with_data_axis(spec, leaf, n_data: int, data_axis: str):
+    """Insert `data_axis` into the first UNSHARDED dimension of `leaf`
+    whose size tiles the data-axis extent; the existing (tp) entries are
+    kept. No candidate dimension -> the spec is returned unchanged (the
+    leaf stays replicated over data)."""
+    entries = list(spec) if spec is not None else []
+    entries += [None] * (leaf.ndim - len(entries))
+    for ax in range(leaf.ndim):
+        if entries[ax] is None and leaf.shape[ax] % n_data == 0 \
+                and leaf.shape[ax] >= n_data:
+            entries[ax] = data_axis
+            break
+    return P(*entries)
+
+
+def zero1_opt_state_specs(opt_state, params, param_specs, mesh: Mesh,
+                          *, data_axis: str = DATA_AXIS):
+    """ZeRO-1: PartitionSpecs that shard the OPTIMIZER STATE over the data
+    axis (DeepSpeed stage-1 / optax-style state partitioning, built as
+    GSPMD annotations instead of manual scatter/gather code). Param-shaped
+    subtrees of the state (adam mu/nu, momentum buffers, ...) take their
+    param's tp spec PLUS `data_axis` on the first free dimension — the
+    moments live sliced 1/n per data column, and XLA derives the ZeRO
+    collective schedule (reduce-scatter the grads into the update, shard
+    the elementwise update math, all-gather the applied updates) from the
+    shardings alone. Scalar leaves (step counts) replicate.
+
+    `opt_state` may be a real state or `jax.eval_shape(optimizer.init,
+    params)` output — only the tree structure and leaf shapes are read."""
+    n_data = mesh.shape[data_axis]
+    pdef = jax.tree.structure(params)
+
+    def rec(node):
+        try:
+            if jax.tree.structure(node) == pdef:
+                # is_leaf: P is a tuple subclass — without the guard the
+                # traversal would descend INTO each PartitionSpec
+                return jax.tree.map(
+                    lambda spec, leaf: _spec_with_data_axis(
+                        spec, leaf, n_data, data_axis),
+                    param_specs, node,
+                    is_leaf=lambda x: isinstance(x, P),
+                )
+        except Exception:  # structure() can reject exotic nodes — treat
+            pass           # them per-field below
+        if hasattr(node, "_fields"):  # optax NamedTuple states
+            return type(node)(*(rec(getattr(node, f)) for f in node._fields))
+        if isinstance(node, (list, tuple)):
+            return type(node)(rec(c) for c in node)
+        if isinstance(node, dict):
+            return {k: rec(v) for k, v in node.items()}
+        return P()  # scalar leaf (count etc.): replicated
+
+    return rec(opt_state)
+
+
+def init_zero1_opt_state(optimizer, params, param_specs, mesh: Mesh,
+                         *, data_axis: str = DATA_AXIS):
+    """Build the optimizer state directly INTO its ZeRO-1 shardings (no
+    full-replica materialization). Returns (opt_state, opt_specs)."""
+    shapes = jax.eval_shape(optimizer.init, params)
+    specs = zero1_opt_state_specs(shapes, params, param_specs, mesh,
+                                  data_axis=data_axis)
+    opt_state = jax.jit(
+        optimizer.init, out_shardings=specs_to_shardings(mesh, specs)
+    )(params)
+    return opt_state, specs
+
+
 def make_sharded_train_step(
     loss_fn: Callable,
     optimizer: optax.GradientTransformation,
@@ -186,14 +255,25 @@ def make_sharded_train_step(
     param_specs,
     *,
     batch_axis: str = DATA_AXIS,
+    zero1: bool = False,
 ):
     """dp x tp train step. Params must be placed with `shard_pytree(params,
     mesh, param_specs)`; the batch is sharded over `batch_axis` here. The
     returned step keeps params/opt_state shardings stable across calls (no
     resharding churn), and gradient all-reduce over "data" plus tp
-    collectives over "model" are inserted by GSPMD."""
+    collectives over "model" are inserted by GSPMD.
+
+    `zero1=True` additionally pins the optimizer state to its ZeRO-1
+    shardings (zero1_opt_state_specs): adam moments live 1/n-sliced over
+    the data axis instead of replicated — pass a state built by
+    `init_zero1_opt_state` (a replicated one is resharded on first
+    step). Loss/params stay numerically identical to zero1=False; only
+    memory and the collective schedule change."""
     param_shardings = specs_to_shardings(mesh, param_specs)
     batch_sharding = NamedSharding(mesh, P(batch_axis))
+    # ZeRO-1 opt-state specs depend on the state's tree structure, which
+    # only exists inside the traced step — resolved once, at first trace
+    opt_sharding_cache = {}
 
     @jax.jit
     def step(params, opt_state, batch):
@@ -201,6 +281,15 @@ def make_sharded_train_step(
         loss, grads = jax.value_and_grad(loss_fn)(params, batch)
         grads = jax.lax.with_sharding_constraint(grads, param_shardings)
         updates, opt_state = optimizer.update(grads, opt_state, params)
+        if zero1:
+            if "specs" not in opt_sharding_cache:
+                # tracers carry shape/structure — all the spec builder reads
+                opt_sharding_cache["specs"] = specs_to_shardings(
+                    mesh, zero1_opt_state_specs(
+                        opt_state, params, param_specs, mesh,
+                        data_axis=batch_axis))
+            opt_state = jax.lax.with_sharding_constraint(
+                opt_state, opt_sharding_cache["specs"])
         params = optax.apply_updates(params, updates)
         params = jax.lax.with_sharding_constraint(params, param_shardings)
         return params, opt_state, loss

@@ -92,6 +92,58 @@ def test_sharded_step_matches_unsharded(params, tokens):
     )
 
 
+def test_zero1_opt_state_sharded_and_parity(params, tokens):
+    """ZeRO-1 (zero1=True + init_zero1_opt_state): adam moments live
+    1/n-sliced over the data axis — measurably smaller per-device shards —
+    while params after N steps match the replicated-state run."""
+    import optax as _optax
+
+    apply_fn = gpt.make_apply(CFG)
+    opt = _optax.adamw(1e-3)
+
+    def loss_fn(p, batch):
+        return train.next_token_loss(apply_fn, p, batch)
+
+    mesh = make_mesh({DATA_AXIS: 2, MODEL_AXIS: 4})
+    specs = train.gpt_tp_specs(params)
+    p_sh = train.shard_pytree(params, mesh, specs)
+
+    # replicated-optimizer reference (same mesh, same tp)
+    step_ref = train.make_sharded_train_step(loss_fn, opt, mesh, specs)
+    p_a, s_a = p_sh, opt.init(p_sh)
+    for _ in range(3):
+        p_a, s_a, l_a = step_ref(p_a, s_a, tokens)
+
+    # ZeRO-1 run
+    s_z, opt_specs = train.init_zero1_opt_state(opt, p_sh, specs, mesh)
+    step_z = train.make_sharded_train_step(loss_fn, opt, mesh, specs,
+                                           zero1=True)
+    p_b, s_b = p_sh, s_z
+    for _ in range(3):
+        p_b, s_b, l_b = step_z(p_b, s_b, tokens)
+
+    np.testing.assert_allclose(float(l_b), float(l_a), rtol=1e-5)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=2e-5),
+        p_b, p_a,
+    )
+
+    # the moments really are sharded over "data": an unsharded-by-tp leaf
+    # (layer norm scale: tp spec P()) gains the data axis on dim 0...
+    from jax.sharding import PartitionSpec as P
+
+    mu = s_b[0].mu  # ScaleByAdamState of adamw's chain
+    assert mu["h_0"]["mlp"]["fc"]["kernel"].sharding.spec == P(
+        DATA_AXIS, MODEL_AXIS)
+    assert mu["wte"]["embedding"].sharding.spec == P(MODEL_AXIS, DATA_AXIS)
+    # ...and each device holds 1/2 of what the replicated run holds
+    leaf = mu["h_0"]["mlp"]["fc"]["kernel"]
+    full = s_a[0].mu["h_0"]["mlp"]["fc"]["kernel"]
+    assert (leaf.addressable_shards[0].data.size
+            == full.addressable_shards[0].data.size // 2)
+
+
 def test_tp_specs_shard_expected_leaves(params):
     specs = train.gpt_tp_specs(params)
     from jax.sharding import PartitionSpec as P
