@@ -41,6 +41,21 @@ def pipeline_budget(num_parts: int, *, margin: float = 30.0) -> float:
     return PER_STAGE_BUDGET_S * num_parts + margin
 
 
+
+def _gen_rid(max_new_tokens, seed, temperature, top_k, top_p):
+    """Encode generation options into the request_id the LM daemon parses
+    (lm_server.parse_gen_options): positional max_new/seed, then named
+    t=/k=/p= sampling overrides."""
+    rid = f"gen:{max_new_tokens}" + (f":{seed}" if seed is not None else "")
+    if temperature is not None:
+        rid += f":t={temperature}"
+    if top_k is not None:
+        rid += f":k={top_k}"
+    if top_p is not None:
+        rid += f":p={top_p}"
+    return rid
+
+
 class NodeClient:
     """Sync client for a NodeService endpoint (ours or a reference node's —
     the wire protocol is identical)."""
@@ -140,15 +155,19 @@ class NodeClient:
         *,
         max_new_tokens: int = 32,
         seed: Optional[int] = None,
+        temperature: Optional[float] = None,
+        top_k: Optional[int] = None,
+        top_p: Optional[float] = None,
         timeout: float = 120.0,
     ) -> np.ndarray:
         """Client path for the LM daemon (dnn_tpu/runtime/lm_server.py):
         prompt token ids -> generated tokens. Options ride the request_id
-        as "gen:max_new[:seed]" — the same wire message a reference-built
-        client would send, just with an integer payload. A request is
-        self-contained (prompt + options), so the transport-level retries
-        in send_tensor stay safe here."""
-        rid = f"gen:{max_new_tokens}" + (f":{seed}" if seed is not None else "")
+        as "gen:max_new[:seed][:t=..][:k=..][:p=..]" — the same wire
+        message a reference-built client would send, just with an integer
+        payload. Sampling overrides are per request (None = server
+        defaults). A request is self-contained (prompt + options), so the
+        transport-level retries in send_tensor stay safe here."""
+        rid = _gen_rid(max_new_tokens, seed, temperature, top_k, top_p)
         status, result = self.send_tensor(
             np.asarray(prompt_ids, np.int32).reshape(-1),
             request_id=rid, timeout=timeout,
@@ -163,6 +182,9 @@ class NodeClient:
         *,
         max_new_tokens: int = 32,
         seed: Optional[int] = None,
+        temperature: Optional[float] = None,
+        top_k: Optional[int] = None,
+        top_p: Optional[float] = None,
         timeout: float = 120.0,
     ):
         """Streaming client for the LM daemon's GenerateStream RPC: yields
@@ -171,7 +193,7 @@ class NodeClient:
         decode slot at its next step boundary — a disconnected client never
         decodes on to its budget. NOT retried: a stream is stateful (tokens
         already delivered), unlike the self-contained unary generate()."""
-        rid = f"gen:{max_new_tokens}" + (f":{seed}" if seed is not None else "")
+        rid = _gen_rid(max_new_tokens, seed, temperature, top_k, top_p)
         call = self._channel.unary_stream(
             f"/{SERVICE_NAME}/GenerateStream",
             request_serializer=pb.TensorRequest.SerializeToString,
@@ -197,13 +219,16 @@ class NodeClient:
         *,
         max_new_tokens: int = 32,
         seed: Optional[int] = None,
+        temperature: Optional[float] = None,
+        top_k: Optional[int] = None,
+        top_p: Optional[float] = None,
         timeout: float = 120.0,
     ) -> str:
         """Text client for a tokenizer-equipped LM daemon: the prompt rides
         SendMessage's message_text, generation options ride sender_id as
-        "gen:max_new[:seed]", and the reply is the generated continuation
-        (dnn_tpu/runtime/lm_server.LMServer.SendMessage)."""
-        rid = f"gen:{max_new_tokens}" + (f":{seed}" if seed is not None else "")
+        "gen:max_new[:seed][:t=..][:k=..][:p=..]", and the reply is the
+        generated continuation (lm_server.LMServer.SendMessage)."""
+        rid = _gen_rid(max_new_tokens, seed, temperature, top_k, top_p)
         return self.send_message(rid, prompt, timeout=timeout)
 
     def close(self):

@@ -79,6 +79,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--max_len", type=int, default=None,
                    help="--serve_lm: max sequence length per slot "
                         "(default: model block_size)")
+    p.add_argument("--paged_blocks", type=int, default=0,
+                   help="--serve_lm: paged KV cache — shared pool of this "
+                        "many blocks instead of per-slot dense caches "
+                        "(0 = dense; see runtime/paged_kvcache.py)")
+    p.add_argument("--block_len", type=int, default=16,
+                   help="--serve_lm: positions per paged-cache block")
     p.add_argument("--prefix_cache", type=int, default=0,
                    help="--serve_lm: prefix-cache capacity (LRU entries); "
                         "requests sharing a prompt prefix skip re-prefilling "
@@ -340,6 +346,7 @@ def _serve_lm(engine: PipelineEngine, args) -> int:
             compute_dtype=engine.compute_dtype, seed=args.seed, ffn=ffn,
             family=family, default_max_new=args.generate or 32,
             tokenizer=tokenizer, prefix_cache=args.prefix_cache,
+            paged_blocks=args.paged_blocks, block_len=args.block_len,
         ))
     except KeyboardInterrupt:
         log.info("shutting down")

@@ -148,6 +148,51 @@ def _sample(logits, rng, *, temperature: float, top_k: Optional[int],
     return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
 
 
+def _sample_rows(logits, keys, *, temperature, top_k, top_p):
+    """Per-ROW sampling for the slot pool: every row carries its own
+    request's parameters. logits (B, V); keys (B, 2) uint32; temperature
+    (B,) f32 (0 = greedy); top_k (B,) int32 (0 = off, clamped to
+    TOP_P_PREFILTER_K); top_p (B,) f32 (outside (0, 1) = off).
+
+    Row i with uniform parameters reproduces `_sample`'s draw for the same
+    key bit-for-bit — same thresholds (the k-th-largest value and the
+    nucleus cutoff are computed by the same ops) and the same categorical
+    call shape — so a request in a mixed pool samples exactly what it
+    would in a single-request server (tests/test_serving_options.py).
+    An all-greedy pool skips the filter math at runtime (real lax.cond at
+    the top level of the step program, not a select)."""
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+    def do_sample(_):
+        k_cap = min(TOP_P_PREFILTER_K, logits.shape[-1])
+        safe_t = jnp.where(temperature > 0, temperature, 1.0)
+        lg = logits / safe_t[:, None]
+        # per-row top-k: threshold at the row's k-th largest value
+        vals = lax.top_k(lg, k_cap)[0]  # (B, k_cap) descending
+        k_idx = jnp.clip(top_k, 1, k_cap) - 1
+        kth = jnp.take_along_axis(vals, k_idx[:, None], axis=-1)
+        lg = jnp.where((top_k[:, None] > 0) & (lg < kth), _NEG_BIG, lg)
+        # per-row nucleus: the _sample prefilter with a row-wise p
+        pvals = lax.top_k(lg, k_cap)[0]
+        lse = jax.scipy.special.logsumexp(lg, axis=-1, keepdims=True)
+        probs = jnp.exp(pvals - lse)
+        cum = jnp.cumsum(probs, axis=-1)
+        keep = (cum - probs) < top_p[:, None]
+        n_keep = jnp.maximum(keep.sum(axis=-1), 1)
+        thresh = jnp.take_along_axis(pvals, (n_keep - 1)[:, None], axis=-1)
+        p_on = (top_p > 0) & (top_p < 1.0)
+        lg = jnp.where(p_on[:, None] & (lg < thresh), _NEG_BIG, lg)
+        # mirror the pool's per-row call shape (categorical over (1, V))
+        # so draws match the uniform-parameter _sample vmap exactly
+        return jax.vmap(
+            lambda l, k: jax.random.categorical(k, l[None, :], axis=-1)[0]
+        )(lg, keys).astype(jnp.int32)
+
+    sampled = lax.cond(jnp.any(temperature > 0.0), do_sample,
+                       lambda _: greedy, operand=None)
+    return jnp.where(temperature > 0.0, sampled, greedy)
+
+
 def _embed_at(aux, ids, start_pos, *, compute_dtype):
     """Token+position embedding for ids (B, T) at absolute positions
     [start_pos, start_pos+T) — the incremental-decode counterpart of

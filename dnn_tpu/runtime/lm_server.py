@@ -54,27 +54,41 @@ __all__ = ["LMServer", "serve_lm", "start_lm_server_in_background",
 
 
 def parse_gen_options(request_id: str, default_max_new: int):
-    """'gen[:max_new[:seed]]' -> (max_new, seed). Only the literal 'gen'
-    prefix carries options — any other request_id (e.g. a reference
-    client's tracing id like 'req:1234') gets the server defaults instead
-    of being reinterpreted as a token budget. Unparseable segments fall
-    back to defaults (seed None = derive from the request id, the
-    batcher's own convention)."""
-    max_new, seed = default_max_new, None
+    """'gen[:max_new[:seed]][:t=TEMP][:k=TOPK][:p=TOPP]' ->
+    (max_new, seed, opts). Only the literal 'gen' prefix carries options —
+    any other request_id (e.g. a reference client's tracing id like
+    'req:1234') gets the server defaults instead of being reinterpreted as
+    a token budget. Positional segments are max_new then seed; named
+    `key=value` segments (per-request sampling overrides, forwarded to
+    ContinuousBatcher.submit) may appear anywhere after the prefix.
+    Unparseable segments fall back to defaults (seed None = derive from
+    the request id, the batcher's own convention)."""
+    max_new, seed, opts = default_max_new, None, {}
     parts = (request_id or "").split(":")
     if parts[0] != "gen":
-        return max_new, seed
-    if len(parts) >= 2:
+        return max_new, seed, opts
+    named = {"t": ("temperature", float), "k": ("top_k", int),
+             "p": ("top_p", float)}
+    pos = 0
+    for seg in parts[1:]:
+        if "=" in seg:
+            key, _, val = seg.partition("=")
+            if key in named:
+                name, conv = named[key]
+                try:
+                    opts[name] = conv(val)
+                except ValueError:
+                    pass
+            continue
+        pos += 1
         try:
-            max_new = max(1, int(parts[1]))
+            if pos == 1:
+                max_new = max(1, int(seg))
+            elif pos == 2:
+                seed = int(seg)
         except ValueError:
             pass
-    if len(parts) >= 3:
-        try:
-            seed = int(parts[2])
-        except ValueError:
-            pass
-    return max_new, seed
+    return max_new, seed, opts
 
 
 class _BatcherWorker(threading.Thread):
@@ -95,11 +109,18 @@ class _BatcherWorker(threading.Thread):
         self._dead: "Exception | None" = None
         # rid -> {"fut", "on_token", "cancel_evt"}
         self._futures = {}
+        # paged back-pressure: a request the batcher could not admit for
+        # TRANSIENT lack of pool blocks (paged_kvcache.InsufficientBlocks)
+        # waits here — retried ahead of the queue once decodes retire —
+        # instead of failing its caller
+        self._held = None
 
     def submit(self, prompt: np.ndarray, max_new: int, seed, *,
-               on_token=None, cancel_evt=None):
-        """Queue a request. `on_token(tok)` (optional) fires from the
-        worker thread for every token as it commits — the streaming hook.
+               opts=None, on_token=None, cancel_evt=None):
+        """Queue a request. `opts` (optional dict) forwards per-request
+        sampling overrides to ContinuousBatcher.submit (temperature /
+        top_k / top_p). `on_token(tok)` (optional) fires from the worker
+        thread for every token as it commits — the streaming hook.
         `cancel_evt` (optional threading.Event) set by the caller retires
         the request's slot at the next step boundary; its future resolves
         cancelled."""
@@ -110,7 +131,8 @@ class _BatcherWorker(threading.Thread):
             if self._dead is not None:
                 fut.set_exception(self._dead)
                 return fut
-            self.q.put((prompt, max_new, seed, on_token, cancel_evt, fut))
+            self.q.put((prompt, max_new, seed, opts, on_token, cancel_evt,
+                        fut))
         return fut
 
     def stop(self, *, drain: bool = True):
@@ -141,15 +163,26 @@ class _BatcherWorker(threading.Thread):
 
     # ------------------------------------------------------------------
 
-    def _admit(self, prompt, max_new, seed, on_token, cancel_evt, fut):
+    def _admit(self, prompt, max_new, seed, opts, on_token, cancel_evt,
+               fut) -> bool:
+        """Admit one queued request. Returns False when the request was
+        HELD BACK (paged pool transiently full) — the admission loop must
+        then stop pulling more work until blocks free."""
+        from dnn_tpu.runtime.paged_kvcache import InsufficientBlocks
+
         if cancel_evt is not None and cancel_evt.is_set():
             fut.cancel()  # cancelled while still queued: never admit
-            return
+            return True
         try:
-            rid = self.batcher.submit(prompt, max_new, seed=seed)
+            rid = self.batcher.submit(prompt, max_new, seed=seed,
+                                      **(opts or {}))
+        except InsufficientBlocks:
+            self._held = (prompt, max_new, seed, opts, on_token,
+                          cancel_evt, fut)
+            return False
         except Exception as e:  # noqa: BLE001 — validation errors belong to
             fut.set_exception(e)  # the submitting request, not the loop
-            return
+            return True
         self._futures[rid] = {"fut": fut, "on_token": on_token,
                               "cancel_evt": cancel_evt}
         if on_token is not None:
@@ -157,6 +190,7 @@ class _BatcherWorker(threading.Thread):
             first = self.batcher.first_token(rid)
             if first is not None:
                 self._emit_token(rid, first)
+        return True
 
     def _emit_token(self, rid, tok):
         rec = self._futures.get(rid)
@@ -176,14 +210,22 @@ class _BatcherWorker(threading.Thread):
         for rid, rec in list(self._futures.items()):
             evt = rec["cancel_evt"]
             if evt is not None and evt.is_set():
-                self.batcher.cancel(rid)
+                if self.batcher.cancel(rid):
+                    try:  # drop the cancelled record — nobody claims it
+                        self.batcher.claim(rid)
+                    except KeyError:
+                        pass
                 del self._futures[rid]
                 rec["fut"].cancel()
 
     def _publish_done(self):
         b = self.batcher
         for rid in [r for r in self._futures if r in b.results]:
-            self._futures.pop(rid)["fut"].set_result(b.results.pop(rid))
+            # claim (not read) releases the batcher's per-request
+            # bookkeeping — results, finish reason, logprobs — so a
+            # long-lived daemon's dicts don't grow without bound
+            tokens, _reason, _lps = b.claim(rid)
+            self._futures.pop(rid)["fut"].set_result(tokens)
 
     def _shutdown_drain_queue(self):
         """Final drain-path exit step, under _lock: mark dead and fail any
@@ -195,6 +237,9 @@ class _BatcherWorker(threading.Thread):
         with self._lock:
             if self._dead is None:
                 self._dead = RuntimeError("LM server shutting down")
+            if self._held is not None:
+                (*_h, held_fut), self._held = self._held, None
+                held_fut.set_exception(self._dead)
             while True:
                 try:
                     *_rest, fut = self.q.get_nowait()
@@ -209,6 +254,10 @@ class _BatcherWorker(threading.Thread):
                 if not rec["fut"].done():
                     rec["fut"].set_exception(exc)
             self._futures.clear()
+            if self._held is not None:
+                (*_h, held_fut), self._held = self._held, None
+                if not held_fut.done():
+                    held_fut.set_exception(exc)
             while True:
                 try:
                     *_rest, fut = self.q.get_nowait()
@@ -224,9 +273,12 @@ class _BatcherWorker(threading.Thread):
                     for rec in self._futures.values():
                         rec["fut"].cancel()
                     self._futures.clear()
+                    if self._held is not None:
+                        (*_h, held_fut), self._held = self._held, None
+                        held_fut.cancel()
                 return
             self._process_cancels()  # step boundary: free cancelled slots
-            if b.n_active == 0 and self.q.empty():
+            if b.n_active == 0 and self.q.empty() and self._held is None:
                 if self._stop_evt.is_set():
                     self._shutdown_drain_queue()
                     return
@@ -235,8 +287,16 @@ class _BatcherWorker(threading.Thread):
                 except queue.Empty:
                     continue
             while b.free_slots():
+                if self._held is not None:
+                    # retry the held-back request before new work; still
+                    # short on blocks -> keep holding, stop admitting
+                    item, self._held = self._held, None
+                    if not self._admit(*item):
+                        break
+                    continue
                 try:
-                    self._admit(*self.q.get_nowait())
+                    if not self._admit(*self.q.get_nowait()):
+                        break
                 except queue.Empty:
                     break
             try:
@@ -308,9 +368,10 @@ class LMServer:
         """Unary submit/await: preflight, wait with the request deadline
         (-> DEADLINE_EXCEEDED), client RPC cancellation re-raised for
         grpc.aio, all terminal outcomes mapped by _result_or_abort."""
-        max_new, seed = await self._preflight(request_id, context)
+        max_new, seed, opts = await self._preflight(request_id, context)
         fut = self.worker.submit(
-            np.asarray(ids, np.int32).reshape(-1), max_new, seed)
+            np.asarray(ids, np.int32).reshape(-1), max_new, seed,
+            opts=opts)
         try:
             await asyncio.wait_for(
                 asyncio.wrap_future(fut), timeout=self.request_timeout)
@@ -363,7 +424,8 @@ class LMServer:
         The unary SendTensor front stays untouched for reference
         wire-compat (wire.proto)."""
         prompt = await self._validated_prompt(request, context)
-        max_new, seed = await self._preflight(request.request_id, context)
+        max_new, seed, opts = await self._preflight(request.request_id,
+                                                    context)
         loop = asyncio.get_running_loop()
         q: "asyncio.Queue" = asyncio.Queue()
         cancel_evt = threading.Event()
@@ -373,7 +435,7 @@ class LMServer:
 
         fut = self.worker.submit(
             np.asarray(prompt, np.int32).reshape(-1), max_new, seed,
-            on_token=on_token, cancel_evt=cancel_evt)
+            opts=opts, on_token=on_token, cancel_evt=cancel_evt)
 
         def _done(f):
             # fires in the worker thread AFTER any on_token calls for this

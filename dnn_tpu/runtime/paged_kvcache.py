@@ -1,0 +1,209 @@
+"""Paged KV cache: a shared block pool + per-slot block tables.
+
+The dense codecs (dnn_tpu/runtime/kvcache.py) reserve `max_len` cache
+positions per slot — a pool of S slots costs S x max_len positions of HBM
+whether requests use them or not. This module stores K/V in fixed-size
+POSITION BLOCKS drawn from one shared pool, with each slot holding a
+small int32 table mapping its logical block index -> physical pool block
+(the vLLM design, rebuilt TPU-style: the pool and tables are plain
+static-shaped arrays, block lookup is a gather, block write is a scatter
+— no dynamic shapes anywhere, so the serving runtime keeps its
+fixed-program-count compile story).
+
+What this buys a serving pool (tests/test_paged.py measures both):
+  * admission by ACTUAL length — a pool sized for 2 full-length requests
+    admits 4+ short ones concurrently (sum of ceil(len/bp) blocks, not
+    slots x max_len);
+  * allocation/free at block granularity per request lifetime, host-side
+    (a free-list of ints — no device work to retire a request).
+
+Layout (per K and per V, mirroring the dense cache's (L, B, H, S, D)):
+
+    pool   (L, n_blocks, H, block_len, D)
+    tables (L, B, max_blocks)  int32   -- replicated over L so the decode
+                                          scan over layers peels tables
+                                          alongside the pool leaves
+    pos    (B,)                        -- slot lengths, as in dense
+
+The codec interface matches FloatKV (write_rows / attend_rows /
+install_row), so GPTFamilyRows / LlamaFamilyRows decode through it
+unchanged. Attention gathers the slot's blocks into a (B, H, S_max, D)
+view and runs the identical masked einsum — the reference math is the
+dense codec's, so token parity is exact. (A Pallas paged-attention kernel
+would instead feed the table through the scalar-prefetch index map of
+ops/pallas/cached_attention._decode_call, reading blocks straight from
+the pool; the einsum path is the correctness baseline.)
+
+No counterpart exists in the reference framework (its only state is a
+per-request activation, /root/reference/node.py:45-105 — no cache at
+all); this is part of the modern-serving surface built on top of parity.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+_NEG_BIG = -1e30
+
+__all__ = ["PagedKV", "BlockAllocator", "InsufficientBlocks",
+           "init_paged_cache"]
+
+
+class InsufficientBlocks(RuntimeError):
+    """The pool cannot currently satisfy an admission — a TRANSIENT
+    condition (blocks free as running requests retire), distinct from the
+    permanent no-free-slot/never-fits errors: queueing fronts (the LM
+    daemon worker) catch this and hold the request back instead of
+    failing it."""
+
+
+class BlockAllocator:
+    """Host-side free-list over pool block ids. Block 0 is RESERVED as the
+    junk target: 0-initialized / unowned table entries point at it, so
+    install scribbles and inactive-slot decode writes land there instead
+    of aliasing a live block; its content is never attended (the per-row
+    position mask stops at each slot's length)."""
+
+    def __init__(self, n_blocks: int):
+        if n_blocks < 2:
+            raise ValueError("need at least 2 blocks (block 0 is reserved)")
+        self.n_blocks = n_blocks
+        self._free: List[int] = list(range(1, n_blocks))
+
+    @property
+    def n_free(self) -> int:
+        return len(self._free)
+
+    def alloc(self, n: int) -> Optional[List[int]]:
+        """n block ids, or None if the pool can't satisfy the request
+        (caller decides whether to queue or reject)."""
+        if n > len(self._free):
+            return None
+        taken, self._free = self._free[:n], self._free[n:]
+        return taken
+
+    def free(self, blocks: List[int]):
+        for b in blocks:
+            if b == 0 or b >= self.n_blocks:
+                raise ValueError(f"bad block id {b}")
+        self._free.extend(blocks)
+
+
+def init_paged_cache(cfg, slots: int, max_len: int, *, n_blocks: int,
+                     block_len: int = 16, dtype=jnp.float32):
+    """Pool + tables pytree for `slots` decode rows of up to `max_len`
+    positions each, sharing `n_blocks` physical blocks of `block_len`
+    positions. The pytree rides the same lax.scan-over-layers as the
+    dense cache (leading L on every leaf)."""
+    if max_len % block_len:
+        raise ValueError(f"max_len {max_len} must tile block_len {block_len}")
+    head_dim = cfg.n_embd // cfg.n_head
+    nb_max = max_len // block_len
+    shape = (cfg.n_layer, n_blocks, cfg.n_head, block_len, head_dim)
+    return {
+        "k": jnp.zeros(shape, dtype),
+        "v": jnp.zeros(shape, dtype),
+        "tables": jnp.zeros((cfg.n_layer, slots, nb_max), jnp.int32),
+    }
+
+
+class PagedKV:
+    """Codec over the paged pytree — same call surface the batcher's
+    decode/install paths use on the dense codecs (kvcache.FloatKV)."""
+
+    def __init__(self, block_len: int):
+        self.block_len = block_len
+
+    # --- decode-row paths (per-layer views: pool (n_blocks, H, bp, D),
+    #     tables (B, nb_max)) ------------------------------------------
+
+    def write_rows(self, c, k, v, pos, write_gate):
+        """k/v (B, H, 1, D) at per-slot positions pos (B,); write_gate (B,)
+        keeps inactive slots' LIVE state untouched. Physical target: block
+        tables[b, pos//bp], row pos%bp — one scatter per leaf.
+
+        Gated-off slots are ROUTED TO the reserved junk block (0, row 0)
+        rather than restored-in-place: a retired slot's stale table can
+        point at a block since REALLOCATED to another request, and a
+        duplicate scatter index (stale restore vs the new owner's write)
+        has unspecified winner — the restore could resurrect the old
+        request's K/V inside the new one's cache. Junk-block collisions
+        between gated slots are harmless (block 0 is never owned, never
+        attended live)."""
+        bp = self.block_len
+        blk = jnp.take_along_axis(
+            c["tables"], (pos // bp)[:, None], axis=1)[:, 0]  # (B,)
+        row = pos % bp
+        blk = jnp.where(write_gate, blk, 0)
+        row = jnp.where(write_gate, row, 0)
+        return {
+            "k": c["k"].at[blk, :, row].set(k[:, :, 0].astype(c["k"].dtype)),
+            "v": c["v"].at[blk, :, row].set(v[:, :, 0].astype(c["v"].dtype)),
+            "tables": c["tables"],
+        }
+
+    def gather_view(self, c):
+        """(B, H, S_max, D) dense view of every slot's logical cache —
+        the einsum attention baseline (a paged Pallas kernel would skip
+        this materialization)."""
+        pool = c["k"], c["v"]
+        tables = c["tables"]  # (B, nb_max)
+        b, nb = tables.shape
+        out = []
+        for leaf in pool:
+            g = jnp.take(leaf, tables.reshape(-1), axis=0)  # (B*nb, H, bp, D)
+            _, h, bp, d = g.shape
+            g = g.reshape(b, nb, h, bp, d).transpose(0, 2, 1, 3, 4)
+            out.append(g.reshape(b, h, nb * bp, d))
+        return out
+
+    def attend_rows(self, q, c, pos):
+        """q (B, H, R, D); every row of slot b attends logical positions
+        <= pos[b] (identical math to kvcache.FloatKV.attend_rows on the
+        gathered view)."""
+        k, v = self.gather_view(c)
+        d = q.shape[-1]
+        s = jnp.einsum("bhtd,bhsd->bhts", q.astype(jnp.float32),
+                       k.astype(jnp.float32),
+                       preferred_element_type=jnp.float32) / jnp.sqrt(d)
+        cols = jnp.arange(k.shape[2])
+        mask = cols[None, None, None, :] <= pos[:, None, None, None]
+        s = jnp.where(mask, s, _NEG_BIG)
+        p = jax.nn.softmax(s, axis=-1)
+        return jnp.einsum("bhts,bhsd->bhtd", p.astype(jnp.float32),
+                          v.astype(jnp.float32),
+                          preferred_element_type=jnp.float32) \
+            .astype(c["v"].dtype)
+
+    # --- prefill install (full-cache view: pool (L, n_blocks, H, bp, D),
+    #     tables (L, B, nb_max)) ---------------------------------------
+
+    def install_row(self, cache, row, slot_tables):
+        """Scatter a finished transient row cache (the dense chunked-
+        prefill output, leaves (L, 1, H, row_len, D)) into the slot's
+        blocks. `slot_tables` (L, nb_max) is the slot's table. ALL nb_max
+        logical blocks install unconditionally (one compiled program for
+        every prompt length): table entries the slot does not own point at
+        the reserved junk block 0, whose content is never attended live
+        (the per-row position mask), so scribbling it is harmless."""
+        bp = self.block_len
+        out = {"tables": cache["tables"]}
+        blk_ids = slot_tables[0]  # (nb_max,) — tables replicate over L
+        nb_max = blk_ids.shape[0]
+        for kk in ("k", "v"):
+            r = row[kk][:, 0]  # (L, H, row_len, D)
+            l_, h, rl, d = r.shape
+            blocks = r.reshape(l_, h, rl // bp, bp, d)[:, :, :nb_max]
+            blocks = blocks.transpose(0, 2, 1, 3, 4)  # (L, nb_max, H, bp, D)
+            out[kk] = cache[kk].at[:, blk_ids].set(
+                blocks.astype(cache[kk].dtype))
+        return out
+
+
+def codec_is_paged(cache) -> bool:
+    return isinstance(cache, dict) and "tables" in cache

@@ -54,7 +54,7 @@ from dnn_tpu.ops.attention import merge_heads
 from dnn_tpu.ops.nn import gelu, layer_norm, linear
 from dnn_tpu.runtime.generate import (
     _qkv_heads,
-    _sample,
+    _sample_rows,
     forward_with_cache,
     init_cache,
 )
@@ -156,7 +156,9 @@ class ContinuousBatcher:
                  top_p: Optional[float] = None,
                  compute_dtype=None, eos_id: Optional[int] = None, seed: int = 0,
                  ffn=None, kv_dtype=None, family=None,
-                 attn_kernel: bool = False, prefix_cache: int = 0):
+                 attn_kernel: bool = False, prefix_cache: int = 0,
+                 logprobs_k: int = 0,
+                 paged_blocks: int = 0, block_len: int = 16):
         self.cfg = cfg
         self.prepared = prepared
         self.slots = slots
@@ -164,6 +166,16 @@ class ContinuousBatcher:
         self.prompt_pad = prompt_pad or min(64, self.max_len)
         self.eos_id = eos_id
         self._seed = seed
+        # constructor values become the per-request DEFAULTS; submit() may
+        # override any of them per request (per-slot parameter vectors
+        # below — same compiled step program for every mix)
+        self._default_temp = float(temperature)
+        self._default_topk = int(top_k) if top_k else 0
+        self._default_topp = float(top_p) if top_p else 0.0
+        # logprobs_k > 0 compiles the step/finish programs to also emit
+        # the chosen token's logprob + the top-k (ids, logprobs) per step;
+        # a CONSTRUCTION-time choice so the program count stays fixed
+        self._logprobs_k = int(logprobs_k)
         # `family` supplies the model-specific cache/prefill/decode hooks
         # (default: the GPT block family; LLaMA passes LlamaFamilyRows).
         # With an explicit family, the model math runs at the FAMILY's
@@ -191,22 +203,64 @@ class ContinuousBatcher:
         # compute_dtype; "int8" = quantized cache, kvcache.Int8KV)
         cache_dtype = kv_dtype if kv_dtype is not None else (compute_dtype or jnp.float32)
 
-        # device state (functional updates)
-        self.cache = self.family.init_cache(slots, self.max_len, cache_dtype)
-        codec = codec_for_cache(
-            self.cache,
-            use_kernel=getattr(self.family, "attn_kernel", False))
+        # device state (functional updates). paged_blocks > 0 swaps the
+        # per-slot dense cache for the shared block pool + per-slot block
+        # tables (runtime/paged_kvcache.py): admission is then by ACTUAL
+        # request length (sum of blocks), not slots x max_len.
+        self._paged = int(paged_blocks) > 0
+        self._allocator = None
+        if self._paged:
+            from dnn_tpu.runtime.paged_kvcache import (
+                BlockAllocator, PagedKV, init_paged_cache,
+            )
+
+            if family is not None:
+                raise ValueError(
+                    "paged_blocks currently supports the default GPT "
+                    "family only (the pool layout is built from cfg head "
+                    "geometry)")
+            if kv_dtype == "int8":
+                raise ValueError(
+                    "paged_blocks with an int8 cache is not implemented "
+                    "(the pool has no scale blocks yet)")
+            if self.max_len % block_len:
+                raise ValueError(
+                    f"max_len {self.max_len} must tile block_len "
+                    f"{block_len}")
+            if self.prompt_pad % block_len:
+                raise ValueError(
+                    f"prompt_pad {self.prompt_pad} must tile block_len "
+                    f"{block_len} (prefill rows install whole blocks)")
+            self.cache = init_paged_cache(
+                cfg, slots, self.max_len, n_blocks=paged_blocks,
+                block_len=block_len, dtype=cache_dtype)
+            self._allocator = BlockAllocator(paged_blocks)
+            self._block_len = block_len
+            codec = PagedKV(block_len)
+        else:
+            self.cache = self.family.init_cache(slots, self.max_len,
+                                                cache_dtype)
+            codec = codec_for_cache(
+                self.cache,
+                use_kernel=getattr(self.family, "attn_kernel", False))
         self.pos = jnp.zeros((slots,), jnp.int32)      # next write position
         self.tok = jnp.zeros((slots,), jnp.int32)      # last sampled token
         self.active = jnp.zeros((slots,), bool)
         # per-slot rng keys: each request's stream derives from
         # (server seed, request id) alone — pool-independent sampling
         self.keys = jnp.zeros((slots, 2), jnp.uint32)
+        # per-slot sampling parameters (set at submit; plain dynamic args
+        # of the one decode program — no recompiles across mixes)
+        self._temp = jnp.zeros((slots,), jnp.float32)
+        self._topk = jnp.zeros((slots,), jnp.int32)
+        self._topp = jnp.zeros((slots,), jnp.float32)
 
         # host bookkeeping
         self._next_rid = 0
         self._slot_req: List[Optional[dict]] = [None] * slots
         self.results: Dict[int, np.ndarray] = {}
+        self.finish_reasons: Dict[int, str] = {}
+        self.token_logprobs: Dict[int, dict] = {}
 
         # prefix cache (`prefix_cache` = LRU entry count; 0 disables):
         # requests sharing a prompt prefix (system prompts) skip
@@ -228,21 +282,38 @@ class ContinuousBatcher:
         self.prefix_hits = 0       # submissions that reused >= 1 chunk
         self.prefill_chunks_run = 0  # chunk programs actually executed
 
-        def decode_step(prepared, cache, pos, tok, active, keys):
-            """Advance every active slot one token."""
+        logprobs_k = self._logprobs_k
+
+        def _lp_outputs(logits, chosen):
+            """(chosen logprob (B,), top-k logprobs (B, K), ids (B, K))
+            from the step's logits — only compiled in when the server was
+            constructed with logprobs_k > 0."""
+            lsm = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+            chosen_lp = jnp.take_along_axis(lsm, chosen[:, None], axis=-1)[:, 0]
+            top_lp, top_ids = lax.top_k(lsm, logprobs_k)
+            return chosen_lp, top_lp, top_ids.astype(jnp.int32)
+
+        def decode_step(prepared, cache, pos, tok, active, keys,
+                        temp, tk, tp):
+            """Advance every active slot one token (per-slot sampling
+            parameters — see _sample_rows)."""
             logits, new_cache = self.family.decode_rows(
                 prepared, cache, tok, pos, active, codec)
             # advance each slot's own stream; sample each row with its key
             split = jax.vmap(jax.random.split)(keys)  # (B, 2, 2)
             new_keys, subs = split[:, 0], split[:, 1]
-            nxt = jax.vmap(
-                lambda lg, k: _sample(lg[None, :], k, temperature=temperature,
-                                      top_k=top_k, top_p=top_p)[0]
-            )(logits, subs)
+            # inactive slots sample greedy (result discarded below): a
+            # RETIRED sampled request's stale temperature must not keep
+            # an otherwise-greedy pool on the filtered-sampling branch
+            nxt = _sample_rows(logits, subs,
+                               temperature=jnp.where(active, temp, 0.0),
+                               top_k=tk, top_p=tp)
             nxt = jnp.where(active, nxt, tok)
             new_keys = jnp.where(active[:, None], new_keys, keys)
-            return (new_cache, pos + active.astype(jnp.int32),
-                    nxt, new_keys)
+            out = (new_cache, pos + active.astype(jnp.int32), nxt, new_keys)
+            if logprobs_k:
+                out += _lp_outputs(logits, nxt)
+            return out
 
         def prefill_chunk(prepared, row, chunk, chunk_start):
             """One (1, prompt_pad) chunk of a prompt into the slot-row
@@ -252,24 +323,32 @@ class ContinuousBatcher:
             K/V that the per-row position mask never attends."""
             return self.family.prefill(prepared, chunk, row, chunk_start)
 
-        def prefill_finish(cache, row, logits, last_local, slot, rng):
+        def prefill_finish(cache, row, logits, last_local, slot, rng,
+                           temp, tk, tp):
             """Sample the first token from the final chunk's true-last
             logit row and install the finished row cache into `slot`."""
-            first = _sample(
-                logits[:, last_local][0:1], rng,
-                temperature=temperature, top_k=top_k, top_p=top_p,
+            lg = logits[:, last_local][0:1]  # (1, V)
+            first = _sample_rows(
+                lg, rng[None], temperature=temp[None], top_k=tk[None],
+                top_p=tp[None],
             )[0]
             # the row cache is chunk-rounded (possibly > max_len); only
             # its first max_len positions install — the overhang holds
             # nothing but tail-pad garbage (real prompt tokens always fit
             # inside max_len by the submit() budget check)
-            cache = {
-                kk: lax.dynamic_update_slice_in_dim(
-                    cache[kk],
-                    lax.slice_in_dim(row[kk], 0, self.max_len, axis=3),
-                    slot, axis=1)
-                for kk in cache
-            }
+            if self._paged:
+                cache = codec.install_row(
+                    cache, row, cache["tables"][:, slot])
+            else:
+                cache = {
+                    kk: lax.dynamic_update_slice_in_dim(
+                        cache[kk],
+                        lax.slice_in_dim(row[kk], 0, self.max_len, axis=3),
+                        slot, axis=1)
+                    for kk in cache
+                }
+            if logprobs_k:
+                return (cache, first) + _lp_outputs(lg, first[None])
             return cache, first
 
         # the transient slot-row cache rounds max_len UP to whole chunks:
@@ -297,13 +376,31 @@ class ContinuousBatcher:
         return sum(r is not None for r in self._slot_req)
 
     def submit(self, prompt, max_new_tokens: int,
-               seed: Optional[int] = None) -> int:
+               seed: Optional[int] = None, *,
+               temperature: Optional[float] = None,
+               top_k: Optional[int] = None,
+               top_p: Optional[float] = None,
+               stop: Optional[list] = None,
+               logprobs: bool = False) -> int:
         """Prefill `prompt` (1-D int array) into a free slot; returns the
         request id. The first token is sampled during prefill and counts
         toward max_new_tokens. `seed` names the request's private rng
         stream (default: the request id) — a seeded sampled request
         reproduces the same tokens regardless of pool contents or arrival
-        order."""
+        order.
+
+        Per-request options (None = the server constructor's defaults;
+        the pool mixes them freely within the same compiled programs):
+        `temperature` (0 = greedy), `top_k` (clamped to the static
+        prefilter width, generate.TOP_P_PREFILTER_K), `top_p` (nucleus);
+        `stop` — list of token-id sequences: generation retires when the
+        emitted stream ends with any of them, the result is trimmed to
+        exclude the match, and `finish_reasons[rid]` records "stop"
+        (vs "eos" / "length" — the reference has no stop mechanism at
+        all, its one forward can't, node.py:137-200); `logprobs=True`
+        records the chosen token's logprob and the top-k alternatives per
+        step into `token_logprobs[rid]` (server must be constructed with
+        logprobs_k > 0)."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if len(prompt) == 0:
             raise ValueError("prompt must have at least one token")
@@ -315,100 +412,220 @@ class ContinuousBatcher:
                 f"prompt {len(prompt)} + max_new_tokens {max_new_tokens} "
                 f"exceeds max_len {self.max_len}"
             )
+        from dnn_tpu.runtime.generate import TOP_P_PREFILTER_K
+
+        temp = self._default_temp if temperature is None else float(temperature)
+        tk = self._default_topk if top_k is None else int(top_k)
+        tp = self._default_topp if top_p is None else float(top_p)
+        if temp < 0:
+            raise ValueError(f"temperature must be >= 0, got {temp}")
+        if tk < 0:
+            raise ValueError(f"top_k must be >= 0, got {tk}")
+        if not 0.0 <= tp <= 1.0:
+            raise ValueError(f"top_p must be in [0, 1], got {tp}")
+        tk = min(tk, TOP_P_PREFILTER_K)
+        stop_seqs = []
+        for s in (stop or []):
+            s = np.asarray(s, np.int32).reshape(-1)
+            if len(s) == 0:
+                raise ValueError("empty stop sequence")
+            stop_seqs.append(s)
+        if logprobs and not self._logprobs_k:
+            raise ValueError(
+                "logprobs requested but the server was constructed with "
+                "logprobs_k=0")
         try:
             slot = self._slot_req.index(None)
         except ValueError:
             raise RuntimeError("no free slot; call step()/drain() first") from None
 
-        rid = self._next_rid
-        self._next_rid += 1
-        # this request's private stream: (server seed, namespace, request
-        # seed) — independent of what else is in the pool or when this
-        # arrived. The namespace fold keeps auto-assigned rids and explicit
-        # seeds from colliding (rid=3 vs seed=3 must be distinct streams).
-        base = jax.random.fold_in(
-            jax.random.PRNGKey(self._seed), 0 if seed is None else 1
-        )
-        req_key = jax.random.fold_in(base, rid if seed is None else seed)
-        prefill_key, slot_key = jax.random.split(req_key)
+        paged_taken = None
+        if self._paged:
+            from dnn_tpu.runtime.paged_kvcache import InsufficientBlocks
 
-        # chunked prefill: full prompt_pad-sized chunks + one padded tail,
-        # each at its absolute start position — prompts of ANY length (up
-        # to max_len - max_new) reuse the one compiled chunk program
-        p_pad = self.prompt_pad
-        n_chunks = -(-len(prompt) // p_pad)
-        padded = np.zeros((1, n_chunks * p_pad), np.int32)
-        padded[0, : len(prompt)] = prompt
-        row = self._new_row()
-        logits = None
-        start_chunk = 0
-        if self._prefix_cache is not None:
-            # longest cached full-chunk prefix of this prompt (tail-padded
-            # partial chunks are never cacheable — their K/V rows hold
-            # garbage beyond the true length)
-            for c in range(len(prompt) // p_pad, 0, -1):
-                hit = self._prefix_cache.get(prompt[: c * p_pad].tobytes())
-                if hit is None:
-                    continue
-                self._prefix_cache.move_to_end(prompt[: c * p_pad].tobytes())
-                cached_row, last_logit_row = hit
-                # copy out: the live row is donated through the chunk loop
-                # and must not invalidate the cached entry
-                row = jax.tree.map(jnp.copy, cached_row)
-                if c == n_chunks:
-                    # whole prompt cached: rebuild a chunk-shaped logits
-                    # array with the stored last row in place (position
-                    # p_pad-1 == the true last prompt token of an exact
-                    # full-chunk prompt) so _prefill_finish keeps its one
-                    # compiled shape
-                    logits = jnp.zeros(
-                        (1, p_pad, last_logit_row.shape[-1]),
-                        last_logit_row.dtype,
-                    ).at[0, p_pad - 1].set(last_logit_row)
-                start_chunk = c
-                self.prefix_hits += 1
-                break
-        for c in range(start_chunk, n_chunks):
-            logits, row = self._prefill_chunk(
-                self.prepared, row,
-                jnp.asarray(padded[:, c * p_pad:(c + 1) * p_pad]), c * p_pad,
+            # admission by ACTUAL length: this request holds
+            # ceil((prompt + budget) / block_len) pool blocks for its
+            # lifetime — a free slot alone is not enough
+            bp = self._block_len
+            n_need = -(-(len(prompt) + max_new_tokens) // bp)
+            if n_need > self._allocator.n_blocks - 1:
+                # permanent: this request can NEVER fit the pool — fail it
+                # (a transient InsufficientBlocks would wait forever)
+                raise ValueError(
+                    f"request needs {n_need} blocks but the pool only has "
+                    f"{self._allocator.n_blocks - 1} allocatable")
+            paged_taken = self._allocator.alloc(n_need)
+            if paged_taken is None:
+                raise InsufficientBlocks(
+                    f"insufficient free cache blocks: need {n_need}, have "
+                    f"{self._allocator.n_free} "
+                    f"(pool {self._allocator.n_blocks}, block {bp} pos)")
+            nb_max = self.cache["tables"].shape[-1]
+            ids_row = np.zeros((nb_max,), np.int32)
+            ids_row[:n_need] = paged_taken
+            self.cache["tables"] = self.cache["tables"].at[:, slot].set(
+                jnp.asarray(ids_row))
+
+        try:
+            rid = self._next_rid
+            self._next_rid += 1
+            # this request's private stream: (server seed, namespace, request
+            # seed) — independent of what else is in the pool or when this
+            # arrived. The namespace fold keeps auto-assigned rids and explicit
+            # seeds from colliding (rid=3 vs seed=3 must be distinct streams).
+            base = jax.random.fold_in(
+                jax.random.PRNGKey(self._seed), 0 if seed is None else 1
             )
-            self.prefill_chunks_run += 1
-            if self._prefix_cache is not None and (c + 1) * p_pad <= len(prompt):
-                key = prompt[: (c + 1) * p_pad].tobytes()
-                # scan-resistant insertion: evict the current LRU first,
-                # then park the NEW entry at the LRU end — only a HIT
-                # promotes to MRU. A long novel prompt therefore cycles
-                # its own one-shot chunks through the LRU slot instead of
-                # flushing the hot shared-prefix entries it never matches.
-                while len(self._prefix_cache) >= self._prefix_cap:
-                    self._prefix_cache.popitem(last=False)
-                self._prefix_cache[key] = (
-                    jax.tree.map(jnp.copy, row), jnp.copy(logits[0, -1]))
-                self._prefix_cache.move_to_end(key, last=False)
-        last_local = len(prompt) - 1 - (n_chunks - 1) * p_pad
-        self.cache, first = self._prefill_finish(
-            self.cache, row, logits, last_local, slot, prefill_key,
-        )
-        first = int(first)
-        self.pos = self.pos.at[slot].set(len(prompt))
-        self.tok = self.tok.at[slot].set(first)
-        self.active = self.active.at[slot].set(True)
-        self.keys = self.keys.at[slot].set(slot_key)
-        self._slot_req[slot] = {"rid": rid, "emitted": [first],
-                                "budget": max_new_tokens}
-        self._retire_if_done(slot)
-        return rid
+            req_key = jax.random.fold_in(base, rid if seed is None else seed)
+            prefill_key, slot_key = jax.random.split(req_key)
+
+            # chunked prefill: full prompt_pad-sized chunks + one padded tail,
+            # each at its absolute start position — prompts of ANY length (up
+            # to max_len - max_new) reuse the one compiled chunk program
+            p_pad = self.prompt_pad
+            n_chunks = -(-len(prompt) // p_pad)
+            padded = np.zeros((1, n_chunks * p_pad), np.int32)
+            padded[0, : len(prompt)] = prompt
+            row = self._new_row()
+            logits = None
+            start_chunk = 0
+            if self._prefix_cache is not None:
+                # longest cached full-chunk prefix of this prompt (tail-padded
+                # partial chunks are never cacheable — their K/V rows hold
+                # garbage beyond the true length)
+                for c in range(len(prompt) // p_pad, 0, -1):
+                    hit = self._prefix_cache.get(prompt[: c * p_pad].tobytes())
+                    if hit is None:
+                        continue
+                    self._prefix_cache.move_to_end(prompt[: c * p_pad].tobytes())
+                    cached_row, last_logit_row = hit
+                    # copy out: the live row is donated through the chunk loop
+                    # and must not invalidate the cached entry
+                    row = jax.tree.map(jnp.copy, cached_row)
+                    if c == n_chunks:
+                        # whole prompt cached: rebuild a chunk-shaped logits
+                        # array with the stored last row in place (position
+                        # p_pad-1 == the true last prompt token of an exact
+                        # full-chunk prompt) so _prefill_finish keeps its one
+                        # compiled shape
+                        logits = jnp.zeros(
+                            (1, p_pad, last_logit_row.shape[-1]),
+                            last_logit_row.dtype,
+                        ).at[0, p_pad - 1].set(last_logit_row)
+                    start_chunk = c
+                    self.prefix_hits += 1
+                    break
+            for c in range(start_chunk, n_chunks):
+                logits, row = self._prefill_chunk(
+                    self.prepared, row,
+                    jnp.asarray(padded[:, c * p_pad:(c + 1) * p_pad]), c * p_pad,
+                )
+                self.prefill_chunks_run += 1
+                if self._prefix_cache is not None and (c + 1) * p_pad <= len(prompt):
+                    key = prompt[: (c + 1) * p_pad].tobytes()
+                    # scan-resistant insertion: evict the current LRU first,
+                    # then park the NEW entry at the LRU end — only a HIT
+                    # promotes to MRU. A long novel prompt therefore cycles
+                    # its own one-shot chunks through the LRU slot instead of
+                    # flushing the hot shared-prefix entries it never matches.
+                    while len(self._prefix_cache) >= self._prefix_cap:
+                        self._prefix_cache.popitem(last=False)
+                    self._prefix_cache[key] = (
+                        jax.tree.map(jnp.copy, row), jnp.copy(logits[0, -1]))
+                    self._prefix_cache.move_to_end(key, last=False)
+            last_local = len(prompt) - 1 - (n_chunks - 1) * p_pad
+            t_arr = jnp.float32(temp)
+            k_arr = jnp.int32(tk)
+            p_arr = jnp.float32(tp)
+            fin = self._prefill_finish(
+                self.cache, row, logits, last_local, slot, prefill_key,
+                t_arr, k_arr, p_arr,
+            )
+            if self._logprobs_k:
+                self.cache, first, c_lp, t_lp, t_ids = fin
+            else:
+                self.cache, first = fin
+            first = int(first)
+            self.pos = self.pos.at[slot].set(len(prompt))
+            self.tok = self.tok.at[slot].set(first)
+            self.active = self.active.at[slot].set(True)
+            self.keys = self.keys.at[slot].set(slot_key)
+            self._temp = self._temp.at[slot].set(temp)
+            self._topk = self._topk.at[slot].set(tk)
+            self._topp = self._topp.at[slot].set(tp)
+            req = {"rid": rid, "emitted": [first], "budget": max_new_tokens,
+                   "stop": stop_seqs, "logprobs": logprobs and self._logprobs_k,
+                   "blocks": paged_taken}
+            if req["logprobs"]:
+                req["lp"] = [float(np.asarray(c_lp)[0])]
+                req["lp_top"] = [(np.asarray(t_ids)[0], np.asarray(t_lp)[0])]
+            self._slot_req[slot] = req
+            self._retire_if_done(slot)
+            return rid
+        except BaseException:
+            # a failure ANYWHERE in the prefill path must return this
+            # request's pool blocks (and un-point its table row) or the
+            # pool shrinks permanently on every such failure
+            if paged_taken:
+                self._allocator.free(paged_taken)
+                self.cache["tables"] = \
+                    self.cache["tables"].at[:, slot].set(0)
+            raise
+
+    @staticmethod
+    def _stop_match(emitted: list, stop_seqs: list):
+        """Length of the stop sequence the emitted stream ends with, else 0."""
+        for s in stop_seqs:
+            n = len(s)
+            if len(emitted) >= n and emitted[-n:] == list(map(int, s)):
+                return n
+        return 0
 
     def _retire_if_done(self, slot: int):
         req = self._slot_req[slot]
-        done = len(req["emitted"]) >= req["budget"] or (
-            self.eos_id is not None and req["emitted"][-1] == self.eos_id
-        )
-        if done:
-            self.results[req["rid"]] = np.asarray(req["emitted"], np.int32)
-            self._slot_req[slot] = None
-            self.active = self.active.at[slot].set(False)
+        reason = None
+        if self.eos_id is not None and req["emitted"][-1] == self.eos_id:
+            reason = "eos"
+        elif (n_stop := self._stop_match(req["emitted"], req["stop"])):
+            reason = "stop"
+        elif len(req["emitted"]) >= req["budget"]:
+            reason = "length"
+        if reason is None:
+            return
+        emitted = req["emitted"]
+        if reason == "stop":
+            emitted = emitted[:-n_stop]  # the match itself is not returned
+        rid = req["rid"]
+        self.results[rid] = np.asarray(emitted, np.int32)
+        self.finish_reasons[rid] = reason
+        if req["logprobs"]:
+            n = len(emitted)
+            self.token_logprobs[rid] = {
+                "chosen": np.asarray(req["lp"][:n], np.float32),
+                "top_ids": np.stack([t[0] for t in req["lp_top"][:n]])
+                if n else np.zeros((0, self._logprobs_k), np.int32),
+                "top_logprobs": np.stack([t[1] for t in req["lp_top"][:n]])
+                if n else np.zeros((0, self._logprobs_k), np.float32),
+            }
+        if req["blocks"]:
+            self._allocator.free(req["blocks"])
+        self._slot_req[slot] = None
+        self.active = self.active.at[slot].set(False)
+
+    def claim(self, rid: int):
+        """Pop a finished (or cancelled) request's COMPLETE record —
+        (tokens or None, finish_reason, token_logprobs or None) —
+        releasing all host-side bookkeeping for it. Long-running servers
+        (the LM daemon) must claim rather than read `results` directly,
+        or the per-request dicts grow without bound. A cancelled rid
+        yields (None, "cancelled", None). KeyError for an
+        unknown/unfinished rid."""
+        tokens = self.results.pop(rid, None)
+        reason = self.finish_reasons.pop(rid, None)
+        lps = self.token_logprobs.pop(rid, None)
+        if tokens is None and reason is None:
+            raise KeyError(rid)
+        return tokens, reason or "length", lps
 
     def first_token(self, rid: int):
         """The token sampled during a request's prefill (the first entry of
@@ -432,20 +649,37 @@ class ContinuousBatcher:
         an unknown/already-claimed rid."""
         for slot, req in enumerate(self._slot_req):
             if req is not None and req["rid"] == rid:
+                if req["blocks"]:
+                    self._allocator.free(req["blocks"])
                 self._slot_req[slot] = None
                 self.active = self.active.at[slot].set(False)
+                self.finish_reasons[rid] = "cancelled"
                 return True
-        return self.results.pop(rid, None) is not None
+        if rid in self.results:
+            # cancelling an already-finished, unclaimed request drops its
+            # WHOLE record (reason + logprobs too, or they leak forever)
+            del self.results[rid]
+            self.finish_reasons.pop(rid, None)
+            self.token_logprobs.pop(rid, None)
+            return True
+        return False
 
     def step(self) -> Dict[int, int]:
         """One decode step for every active slot. Returns {rid: new_token}
         for slots that advanced; finished requests move to .results."""
         if self.n_active == 0:
             return {}
-        self.cache, self.pos, self.tok, self.keys = self._decode(
+        res = self._decode(
             self.prepared, self.cache, self.pos, self.tok, self.active,
-            self.keys,
+            self.keys, self._temp, self._topk, self._topp,
         )
+        if self._logprobs_k:
+            (self.cache, self.pos, self.tok, self.keys,
+             c_lp, t_lp, t_ids) = res
+            c_lp, t_lp, t_ids = (np.asarray(c_lp), np.asarray(t_lp),
+                                 np.asarray(t_ids))
+        else:
+            self.cache, self.pos, self.tok, self.keys = res
         toks = np.asarray(self.tok)
         out = {}
         for slot, req in enumerate(self._slot_req):
@@ -453,6 +687,9 @@ class ContinuousBatcher:
                 continue
             token = int(toks[slot])
             req["emitted"].append(token)
+            if req["logprobs"]:
+                req["lp"].append(float(c_lp[slot]))
+                req["lp_top"].append((t_ids[slot], t_lp[slot]))
             out[req["rid"]] = token
             self._retire_if_done(slot)
         return out

@@ -35,16 +35,24 @@ def lm_server():
 
 
 def test_parse_gen_options():
-    assert parse_gen_options("gen:12:7", 32) == (12, 7)
-    assert parse_gen_options("gen:12", 32) == (12, None)
-    assert parse_gen_options("gen", 32) == (32, None)
-    assert parse_gen_options("", 32) == (32, None)
-    assert parse_gen_options("whatever:junk:x", 32) == (32, None)
-    assert parse_gen_options("gen:0", 32) == (1, None)  # floored at 1
+    assert parse_gen_options("gen:12:7", 32) == (12, 7, {})
+    assert parse_gen_options("gen:12", 32) == (12, None, {})
+    assert parse_gen_options("gen", 32) == (32, None, {})
+    assert parse_gen_options("", 32) == (32, None, {})
+    assert parse_gen_options("whatever:junk:x", 32) == (32, None, {})
+    assert parse_gen_options("gen:0", 32) == (1, None, {})  # floored at 1
+    # named per-request sampling overrides, any position after the prefix
+    assert parse_gen_options("gen:12:7:t=0.9:k=40:p=0.95", 32) == (
+        12, 7, {"temperature": 0.9, "top_k": 40, "top_p": 0.95})
+    assert parse_gen_options("gen:t=1.5", 32) == (
+        32, None, {"temperature": 1.5})
+    assert parse_gen_options("gen:12:t=0.5:99", 32) == (
+        12, 99, {"temperature": 0.5})  # positional continues past named
+    assert parse_gen_options("gen:t=bogus:x=1", 32) == (32, None, {})
     # only the literal 'gen' prefix carries options: a foreign client's
     # tracing id must NOT be reinterpreted as a token budget
-    assert parse_gen_options("req:1234", 32) == (32, None)
-    assert parse_gen_options("cifar_pipe_2node_001", 32) == (32, None)
+    assert parse_gen_options("req:1234", 32) == (32, None, {})
+    assert parse_gen_options("cifar_pipe_2node_001", 32) == (32, None, {})
 
 
 def test_health_and_pool_stats(lm_server):
@@ -63,6 +71,27 @@ def test_generate_matches_solo_decode(lm_server):
     c.close()
     want = np.asarray(make_generate(CFG, max_new_tokens=n_new)(
         prepared, prompt[None, :], jax.random.PRNGKey(0)))[0]
+    np.testing.assert_array_equal(got, want)
+
+
+def test_per_request_sampling_over_the_wire(lm_server):
+    """temperature/top_k/top_p ride the request_id; a seeded sampled
+    request over gRPC equals the same request submitted to a local
+    batcher directly."""
+    from dnn_tpu.runtime.serving import ContinuousBatcher
+
+    prepared = lm_server
+    prompt = np.array([5, 3, 7, 1, 2], np.int32)
+    c = NodeClient(f"127.0.0.1:{PORT}")
+    got = c.generate(prompt, max_new_tokens=6, seed=17, temperature=0.8,
+                     top_k=9, top_p=0.9)
+    c.close()
+    # server fixture: slots=3, max_len=64, seed default 0
+    local = ContinuousBatcher(CFG, prepared, slots=3, max_len=64,
+                              prompt_pad=16)
+    rid = local.submit(prompt, 6, seed=17, temperature=0.8, top_k=9,
+                       top_p=0.9)
+    want = local.drain()[rid]
     np.testing.assert_array_equal(got, want)
 
 

@@ -1,0 +1,236 @@
+"""Per-request serving options: sampling parameters, stop sequences,
+finish reasons, logprobs.
+
+The reference's serving story is one stateless forward per request
+(/root/reference/node.py:137-200) — none of these exist there. The tests
+pin the contract that makes per-request options safe in a POOL: a request
+samples exactly what it would in a single-request server (the per-row
+sampler reproduces the uniform-parameter path draw-for-draw), and the
+host-side features (stop, reasons, logprobs) never disturb neighbors."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dnn_tpu.models import gpt
+from dnn_tpu.runtime.generate import _sample, _sample_rows, make_generate
+from dnn_tpu.runtime.serving import ContinuousBatcher
+
+CFG = gpt.GPTConfig(block_size=96, vocab_size=128, n_layer=2, n_head=4,
+                    n_embd=64)
+
+
+def _prepared(seed=0):
+    return gpt.prepare_stacked(gpt.init(jax.random.PRNGKey(seed), CFG), CFG)
+
+
+def _prompt(seed, n=8):
+    return np.asarray(jax.random.randint(
+        jax.random.PRNGKey(seed), (n,), 0, CFG.vocab_size, dtype=jnp.int32))
+
+
+# ----------------------------------------------------------------------
+# the sampler itself
+# ----------------------------------------------------------------------
+
+def test_sample_rows_matches_sample_draw_for_draw():
+    """Uniform parameters + the same per-row keys -> _sample_rows
+    reproduces the pool's vmapped _sample exactly (greedy and sampled,
+    with and without each filter)."""
+    logits = jnp.asarray(
+        np.random.default_rng(0).standard_normal((5, 128)) * 3, jnp.float32)
+    keys = jax.vmap(jax.random.PRNGKey)(jnp.arange(5, dtype=jnp.uint32))
+    for t, k, p in ((0.0, None, None), (0.8, None, None), (1.0, 7, None),
+                    (0.9, None, 0.85), (1.1, 11, 0.7)):
+        if t == 0.0:
+            want = _sample(logits, keys[0], temperature=0.0, top_k=k,
+                           top_p=p)
+        else:
+            want = jax.vmap(
+                lambda lg, kk: _sample(lg[None, :], kk, temperature=t,
+                                       top_k=k, top_p=p)[0]
+            )(logits, keys)
+        got = _sample_rows(
+            logits, keys,
+            temperature=jnp.full((5,), t, jnp.float32),
+            top_k=jnp.full((5,), k or 0, jnp.int32),
+            top_p=jnp.full((5,), p or 0.0, jnp.float32))
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_sample_rows_mixes_parameters_per_row():
+    """Each row follows ITS OWN parameters: greedy rows equal argmax while
+    sampled rows equal their solo draw, in the same call."""
+    logits = jnp.asarray(
+        np.random.default_rng(1).standard_normal((4, 128)) * 3, jnp.float32)
+    keys = jax.vmap(jax.random.PRNGKey)(jnp.arange(4, dtype=jnp.uint32))
+    got = np.asarray(_sample_rows(
+        logits, keys,
+        temperature=jnp.asarray([0.0, 0.9, 0.0, 1.2], jnp.float32),
+        top_k=jnp.asarray([0, 5, 0, 0], jnp.int32),
+        top_p=jnp.asarray([0.0, 0.0, 0.0, 0.9], jnp.float32)))
+    assert got[0] == int(jnp.argmax(logits[0]))
+    assert got[2] == int(jnp.argmax(logits[2]))
+    want1 = _sample(logits[1][None], keys[1], temperature=0.9, top_k=5)[0]
+    want3 = _sample(logits[3][None], keys[3], temperature=1.2, top_k=None,
+                    top_p=0.9)[0]
+    assert got[1] == int(want1) and got[3] == int(want3)
+
+
+# ----------------------------------------------------------------------
+# the pool
+# ----------------------------------------------------------------------
+
+def test_mixed_pool_greedy_matches_solo_generate():
+    """A greedy request decoding NEXT TO a sampled request produces the
+    same tokens as solo make_generate."""
+    prepared = _prepared()
+    prompt = _prompt(1)
+    n_new = 6
+    want = np.asarray(make_generate(CFG, max_new_tokens=n_new)(
+        prepared, jnp.asarray(prompt)[None], jax.random.PRNGKey(0)))[0]
+
+    srv = ContinuousBatcher(CFG, prepared, slots=2, max_len=64)
+    rid_g = srv.submit(prompt, max_new_tokens=n_new)  # server default greedy
+    srv.submit(_prompt(2), max_new_tokens=n_new, temperature=0.9,
+               top_k=20, seed=7)
+    out = srv.drain()
+    np.testing.assert_array_equal(out[rid_g], want)
+    assert srv.finish_reasons[rid_g] == "length"
+
+
+def test_seeded_sampled_request_pool_independent_with_overrides():
+    """A sampled request with per-request overrides reproduces its own
+    token stream regardless of what shares the pool."""
+    prepared = _prepared(3)
+    prompt = _prompt(4)
+    kw = dict(max_new_tokens=7, seed=11, temperature=0.8, top_k=12,
+              top_p=0.95)
+
+    srv_a = ContinuousBatcher(CFG, prepared, slots=1, max_len=64)
+    ra = srv_a.submit(prompt, **kw)
+    alone = srv_a.drain()[ra]
+
+    srv_b = ContinuousBatcher(CFG, prepared, slots=3, max_len=64)
+    srv_b.submit(_prompt(5), max_new_tokens=9, temperature=1.3, seed=1)
+    rb = srv_b.submit(prompt, **kw)
+    srv_b.submit(_prompt(6), max_new_tokens=3)
+    crowded = srv_b.drain()[rb]
+    np.testing.assert_array_equal(alone, crowded)
+
+
+def test_per_request_overrides_server_defaults():
+    """Server-default sampled pool; one request overrides to greedy."""
+    prepared = _prepared()
+    prompt = _prompt(1)
+    want = np.asarray(make_generate(CFG, max_new_tokens=5)(
+        prepared, jnp.asarray(prompt)[None], jax.random.PRNGKey(0)))[0]
+    srv = ContinuousBatcher(CFG, prepared, slots=1, max_len=64,
+                            temperature=1.0, top_k=10)
+    rid = srv.submit(prompt, max_new_tokens=5, temperature=0.0)
+    np.testing.assert_array_equal(srv.drain()[rid], want)
+
+
+def test_stop_sequence_trims_and_reports():
+    """Learn a (seeded, sampled) continuation, then stop on one of its
+    bigrams: the result ends just before the EARLIEST match and the reason
+    is 'stop'."""
+    prepared = _prepared()
+    prompt = _prompt(1)
+    kw = dict(max_new_tokens=8, seed=5, temperature=1.0)
+    srv0 = ContinuousBatcher(CFG, prepared, slots=1, max_len=64)
+    rid0 = srv0.submit(prompt, **kw)
+    full = srv0.drain()[rid0]
+    stop = full[3:5]
+    # earliest end position whose tail matches the bigram (a degenerate
+    # stream may repeat it before position 4)
+    first_end = next(i for i in range(1, len(full))
+                     if (full[i - 1:i + 1] == stop).all())
+
+    srv = ContinuousBatcher(CFG, prepared, slots=1, max_len=64)
+    rid = srv.submit(prompt, stop=[stop], **kw)
+    got = srv.drain()[rid]
+    np.testing.assert_array_equal(got, full[:first_end - 1])
+    assert srv.finish_reasons[rid] == "stop"
+
+
+def test_stop_on_first_token_yields_empty_result():
+    prepared = _prepared()
+    prompt = _prompt(1)
+    srv0 = ContinuousBatcher(CFG, prepared, slots=1, max_len=64)
+    rid0 = srv0.submit(prompt, max_new_tokens=3)
+    full = srv0.drain()[rid0]
+
+    srv = ContinuousBatcher(CFG, prepared, slots=1, max_len=64)
+    rid = srv.submit(prompt, max_new_tokens=3, stop=[full[:1]])
+    got = srv.drain()[rid]
+    assert len(got) == 0 and srv.finish_reasons[rid] == "stop"
+
+
+def test_eos_reason_and_cancel_reason():
+    prepared = _prepared()
+    prompt = _prompt(1)
+    srv0 = ContinuousBatcher(CFG, prepared, slots=1, max_len=64)
+    rid0 = srv0.submit(prompt, max_new_tokens=4)
+    full = srv0.drain()[rid0]
+
+    eos = int(full[1])
+    first_eos = next(i for i, t in enumerate(full) if int(t) == eos)
+    srv = ContinuousBatcher(CFG, prepared, slots=2, max_len=64, eos_id=eos)
+    rid = srv.submit(prompt, max_new_tokens=8)
+    assert srv.drain()[rid].tolist() == full[:first_eos + 1].tolist()
+    assert srv.finish_reasons[rid] == "eos"
+
+    srv2 = ContinuousBatcher(CFG, prepared, slots=1, max_len=64)
+    rid2 = srv2.submit(prompt, max_new_tokens=8)
+    assert srv2.cancel(rid2)
+    assert srv2.finish_reasons[rid2] == "cancelled"
+
+
+def test_logprobs_recorded_for_greedy():
+    """Greedy + logprobs: the chosen token IS the top-1 alternative, its
+    logprob matches, rows are one per emitted token."""
+    prepared = _prepared()
+    prompt = _prompt(1)
+    srv = ContinuousBatcher(CFG, prepared, slots=1, max_len=64,
+                            logprobs_k=4)
+    rid = srv.submit(prompt, max_new_tokens=5, logprobs=True)
+    toks = srv.drain()[rid]
+    lp = srv.token_logprobs[rid]
+    assert lp["chosen"].shape == (5,)
+    assert lp["top_ids"].shape == (5, 4) and lp["top_logprobs"].shape == (5, 4)
+    np.testing.assert_array_equal(lp["top_ids"][:, 0], toks)
+    np.testing.assert_allclose(lp["chosen"], lp["top_logprobs"][:, 0],
+                               rtol=1e-6)
+    assert (lp["chosen"] <= 0).all()
+    # descending alternatives
+    assert (np.diff(lp["top_logprobs"], axis=1) <= 1e-6).all()
+
+
+def test_logprobs_server_tokens_unchanged():
+    """Compiling the logprobs outputs must not perturb decode itself."""
+    prepared = _prepared()
+    prompt = _prompt(1)
+    plain = ContinuousBatcher(CFG, prepared, slots=1, max_len=64)
+    rid_p = plain.submit(prompt, max_new_tokens=6)
+    want = plain.drain()[rid_p]
+    srv = ContinuousBatcher(CFG, prepared, slots=1, max_len=64,
+                            logprobs_k=2)
+    rid_s = srv.submit(prompt, max_new_tokens=6)
+    got = srv.drain()[rid_s]
+    np.testing.assert_array_equal(got, want)
+
+
+def test_option_validation():
+    prepared = _prepared()
+    srv = ContinuousBatcher(CFG, prepared, slots=1, max_len=64)
+    with pytest.raises(ValueError, match="logprobs"):
+        srv.submit(_prompt(1), max_new_tokens=2, logprobs=True)
+    with pytest.raises(ValueError, match="temperature"):
+        srv.submit(_prompt(1), max_new_tokens=2, temperature=-1.0)
+    with pytest.raises(ValueError, match="top_p"):
+        srv.submit(_prompt(1), max_new_tokens=2, top_p=1.5)
+    with pytest.raises(ValueError, match="stop"):
+        srv.submit(_prompt(1), max_new_tokens=2, stop=[[]])
+    assert srv.free_slots() == 1  # failed submits must not leak slots
