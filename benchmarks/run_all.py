@@ -180,6 +180,47 @@ def run_device_section():
         del ll_q
         del ll_prep  # 2.2 GB of bf16 weights — free before the GPT rows
 
+    # Training step (fwd + bwd + adamw update) — nothing else in the table
+    # measures the backward pass. bf16 compute, f32 params/optimizer, the
+    # single-chip form of train.make_train_step (the dp x tp and pipeline
+    # steps run the same loss; their numbers belong to the cpu-mesh legs).
+    import optax
+
+    from dnn_tpu.train import cross_entropy
+    from dnn_tpu.utils.flops import gpt_train_step_flops
+
+    t_cfg = gpt.PRESETS["gpt2"]
+    t_prep = gpt.prepare_stacked(gpt.init(jax.random.PRNGKey(0), t_cfg), t_cfg)
+    t_apply = gpt.make_apply_stacked(t_cfg, compute_dtype=jnp.bfloat16)
+
+    def t_loss(p, batch):
+        inp, tgt = batch
+        return cross_entropy(t_apply(p, inp), tgt)
+
+    t_opt = optax.adamw(1e-4)
+    t_state = t_opt.init(t_prep)
+    from dnn_tpu.train import make_train_step
+
+    t_step = make_train_step(t_loss, t_opt)
+    tb, ts = 8, 512
+    t_inp = jax.random.randint(jax.random.PRNGKey(1), (tb, ts), 0,
+                               t_cfg.vocab_size, dtype=jnp.int32)
+    t_tgt = jax.random.randint(jax.random.PRNGKey(2), (tb, ts), 0,
+                               t_cfg.vocab_size, dtype=jnp.int32)
+
+    def t_run(p, s, b):  # time the whole step; params/state update discarded
+        p2, s2, loss = t_step(p, s, b)
+        return loss
+
+    dt = device_time(t_run, t_prep, t_state, (t_inp, t_tgt), n1=1, n2=3)
+    tps = tb * ts / dt
+    _emit(results, config="gpt2_train_step", metric="tokens_per_sec",
+          value=round(tps, 1), platform=platform, batch=tb, seq=ts,
+          optimizer="adamw",
+          **_with_mfu({}, gpt_train_step_flops(t_cfg, tb, ts) / (tb * ts),
+                      tps))
+    del t_prep, t_state
+
     # KV-cache generation throughput (the serving path the reference lacks)
     from dnn_tpu.runtime import generate as gen
 
@@ -305,6 +346,51 @@ def run_device_section():
           note=f"top_p=0.9 via top-{gen.TOP_P_PREFILTER_K} prefilter "
                "(bit-identical to the full-vocab filter when the nucleus "
                "fits inside k)")
+
+    # Continuous-batching END-TO-END serving throughput: mixed-length
+    # prompts through the slot pool (chunked prefill + per-row decode +
+    # retirement), wall-clock including the host-side scheduler — the
+    # number a serving user actually gets, vs the pure-device decode rows
+    # above. TPU-only: the wall-clock of the host loop on a CPU backend
+    # measures nothing interesting.
+    if platform == "tpu":
+        import time as _time
+
+        from dnn_tpu.runtime.serving import ContinuousBatcher
+
+        sb_new = 64
+        # ONE batcher for warmup + timed round: the three step programs
+        # are per-instance jit closures, so a fresh instance would
+        # recompile inside the timed window and the row would measure
+        # XLA, not serving
+        srv = ContinuousBatcher(cfg, bf16_prepared, slots=8,
+                                max_len=256, prompt_pad=128,
+                                kv_dtype=jnp.bfloat16,
+                                compute_dtype=jnp.bfloat16)
+
+        def _serve_round():
+            rng_np = jax.random.PRNGKey(9)
+            rids = []
+            for i in range(24):
+                plen = 16 + (i * 7) % 112  # mixed 16..121
+                p = jax.random.randint(jax.random.fold_in(rng_np, i),
+                                       (plen,), 0, cfg.vocab_size,
+                                       dtype=jnp.int32)
+                rids.append(srv.submit(
+                    jnp.asarray(p), max_new_tokens=sb_new))
+            out = srv.drain()
+            return sum(len(out[r]) for r in rids)
+
+        _serve_round()  # compile the three programs
+        t0 = _time.perf_counter()
+        total = _serve_round()
+        dt = _time.perf_counter() - t0
+        _emit(results, config="gpt2_serving_e2e", metric="tokens_per_sec",
+              value=round(total / dt, 1), platform=platform, slots=8,
+              requests=24, new_tokens_per_req=sb_new,
+              note="wall-clock drain of 24 mixed-length requests through "
+                   "the continuous batcher (chunked prefill + decode + "
+                   "host scheduler)")
     return results
 
 
