@@ -376,6 +376,10 @@ def run_device_section():
                 p = jax.random.randint(jax.random.fold_in(rng_np, i),
                                        (plen,), 0, cfg.vocab_size,
                                        dtype=jnp.int32)
+                # 24 requests over 8 slots: decode until a slot retires,
+                # then admit — the continuous-batching arrival pattern
+                while srv.free_slots() == 0:
+                    srv.step()
                 rids.append(srv.submit(
                     jnp.asarray(p), max_new_tokens=sb_new))
             out = srv.drain()

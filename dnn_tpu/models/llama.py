@@ -584,6 +584,8 @@ class LlamaFamilyRows:
         self.compute_dtype = compute_dtype
         # picked up by ContinuousBatcher for the decode-rows codec too
         self.attn_kernel = attn_kernel
+        # paged-pool head width: the cache stores KV heads (GQA)
+        self.kv_heads = cfg.n_kv_head
 
     def init_cache(self, batch, max_len, dtype):
         return init_cache(self.cfg, batch, max_len, dtype)

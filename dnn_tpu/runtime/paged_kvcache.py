@@ -74,37 +74,64 @@ class BlockAllocator:
             raise ValueError("need at least 2 blocks (block 0 is reserved)")
         self.n_blocks = n_blocks
         self._free: List[int] = list(range(1, n_blocks))
+        # block id -> reference count. SHARING (paged prefix cache): a
+        # block may be held by several slots plus a prefix-cache entry at
+        # once; it returns to the free list when the last holder lets go.
+        self._rc = {}
 
     @property
     def n_free(self) -> int:
         return len(self._free)
 
     def alloc(self, n: int) -> Optional[List[int]]:
-        """n block ids, or None if the pool can't satisfy the request
-        (caller decides whether to queue or reject)."""
+        """n fresh block ids (each at refcount 1), or None if the pool
+        can't satisfy the request (caller decides whether to queue or
+        reject)."""
         if n > len(self._free):
             return None
         taken, self._free = self._free[:n], self._free[n:]
+        for b in taken:
+            self._rc[b] = 1
         return taken
 
+    def ref(self, blocks: List[int]):
+        """Take an additional reference on live blocks (prefix sharing)."""
+        for b in blocks:
+            if self._rc.get(b, 0) < 1:
+                raise ValueError(f"ref on non-live block {b}")
+            self._rc[b] += 1
+
     def free(self, blocks: List[int]):
+        """Release one reference per listed block; blocks whose last
+        reference drops return to the free list."""
         for b in blocks:
             if b == 0 or b >= self.n_blocks:
                 raise ValueError(f"bad block id {b}")
-        self._free.extend(blocks)
+            rc = self._rc.get(b, 0)
+            if rc < 1:
+                raise ValueError(f"free of non-live block {b}")
+            if rc == 1:
+                del self._rc[b]
+                self._free.append(b)
+            else:
+                self._rc[b] = rc - 1
 
 
 def init_paged_cache(cfg, slots: int, max_len: int, *, n_blocks: int,
-                     block_len: int = 16, dtype=jnp.float32):
+                     block_len: int = 16, dtype=jnp.float32,
+                     kv_heads: Optional[int] = None):
     """Pool + tables pytree for `slots` decode rows of up to `max_len`
     positions each, sharing `n_blocks` physical blocks of `block_len`
     positions. The pytree rides the same lax.scan-over-layers as the
-    dense cache (leading L on every leaf)."""
+    dense cache (leading L on every leaf). `kv_heads` overrides the
+    pool's head width — GQA families store KV heads, not query heads
+    (llama.init_cache's narrowing, here applied to the pool)."""
     if max_len % block_len:
         raise ValueError(f"max_len {max_len} must tile block_len {block_len}")
     head_dim = cfg.n_embd // cfg.n_head
+    heads = kv_heads if kv_heads is not None else cfg.n_head
     nb_max = max_len // block_len
-    shape = (cfg.n_layer, n_blocks, cfg.n_head, block_len, head_dim)
+    shape = (cfg.n_layer, n_blocks, heads, block_len, head_dim)
     return {
         "k": jnp.zeros(shape, dtype),
         "v": jnp.zeros(shape, dtype),
@@ -183,17 +210,17 @@ class PagedKV:
     # --- prefill install (full-cache view: pool (L, n_blocks, H, bp, D),
     #     tables (L, B, nb_max)) ---------------------------------------
 
-    def install_row(self, cache, row, slot_tables):
+    def install_row(self, cache, row, blk_ids):
         """Scatter a finished transient row cache (the dense chunked-
-        prefill output, leaves (L, 1, H, row_len, D)) into the slot's
-        blocks. `slot_tables` (L, nb_max) is the slot's table. ALL nb_max
-        logical blocks install unconditionally (one compiled program for
-        every prompt length): table entries the slot does not own point at
-        the reserved junk block 0, whose content is never attended live
-        (the per-row position mask), so scribbling it is harmless."""
+        prefill output, leaves (L, 1, H, row_len, D)) into the physical
+        blocks `blk_ids` (nb_max,). ALL nb_max logical blocks install
+        unconditionally (one compiled program for every prompt length):
+        entries the request must not write — unowned tail AND shared
+        prefix blocks (another request's live data!) — are routed to the
+        reserved junk block 0, whose content is never attended live (the
+        per-row position mask), so scribbling it is harmless."""
         bp = self.block_len
         out = {"tables": cache["tables"]}
-        blk_ids = slot_tables[0]  # (nb_max,) — tables replicate over L
         nb_max = blk_ids.shape[0]
         for kk in ("k", "v"):
             r = row[kk][:, 0]  # (L, H, row_len, D)

@@ -214,11 +214,6 @@ class ContinuousBatcher:
                 BlockAllocator, PagedKV, init_paged_cache,
             )
 
-            if family is not None:
-                raise ValueError(
-                    "paged_blocks currently supports the default GPT "
-                    "family only (the pool layout is built from cfg head "
-                    "geometry)")
             if kv_dtype == "int8":
                 raise ValueError(
                     "paged_blocks with an int8 cache is not implemented "
@@ -231,12 +226,33 @@ class ContinuousBatcher:
                 raise ValueError(
                     f"prompt_pad {self.prompt_pad} must tile block_len "
                     f"{block_len} (prefill rows install whole blocks)")
+            # pool head width follows the FAMILY's cache (GQA families
+            # store KV heads — llama.LlamaFamilyRows sets kv_heads)
             self.cache = init_paged_cache(
                 cfg, slots, self.max_len, n_blocks=paged_blocks,
-                block_len=block_len, dtype=cache_dtype)
+                block_len=block_len, dtype=cache_dtype,
+                kv_heads=getattr(self.family, "kv_heads", None))
             self._allocator = BlockAllocator(paged_blocks)
             self._block_len = block_len
             codec = PagedKV(block_len)
+
+            def gather_row(cache, ids_row):
+                """Rebuild a transient prefill row from pool blocks (the
+                prefix-hit path: remaining chunks attend the shared
+                prefix through this row). Junk beyond the prefix is never
+                attended (chunk attention masks at its positions)."""
+                out = {}
+                for kk in ("k", "v"):
+                    g = jnp.take(cache[kk], ids_row, axis=1)
+                    l_, nb, h, bl, d = g.shape  # (L, nb_max, H, bp, D)
+                    r = g.transpose(0, 2, 1, 3, 4).reshape(l_, h, nb * bl, d)
+                    pad = self._row_len - nb * bl
+                    if pad:
+                        r = jnp.pad(r, ((0, 0), (0, 0), (0, pad), (0, 0)))
+                    out[kk] = r[:, None]  # (L, 1, H, row_len, D)
+                return out
+
+            self._gather_row = jax.jit(gather_row)
         else:
             self.cache = self.family.init_cache(slots, self.max_len,
                                                 cache_dtype)
@@ -324,9 +340,12 @@ class ContinuousBatcher:
             return self.family.prefill(prepared, chunk, row, chunk_start)
 
         def prefill_finish(cache, row, logits, last_local, slot, rng,
-                           temp, tk, tp):
+                           temp, tk, tp, install_ids):
             """Sample the first token from the final chunk's true-last
-            logit row and install the finished row cache into `slot`."""
+            logit row and install the finished row cache into `slot`.
+            `install_ids` (paged mode): the per-logical-block physical
+            install targets — shared prefix blocks routed to junk block 0
+            (dense mode receives an empty placeholder)."""
             lg = logits[:, last_local][0:1]  # (1, V)
             first = _sample_rows(
                 lg, rng[None], temperature=temp[None], top_k=tk[None],
@@ -337,8 +356,7 @@ class ContinuousBatcher:
             # nothing but tail-pad garbage (real prompt tokens always fit
             # inside max_len by the submit() budget check)
             if self._paged:
-                cache = codec.install_row(
-                    cache, row, cache["tables"][:, slot])
+                cache = codec.install_row(cache, row, install_ids)
             else:
                 cache = {
                     kk: lax.dynamic_update_slice_in_dim(
@@ -439,13 +457,29 @@ class ContinuousBatcher:
         except ValueError:
             raise RuntimeError("no free slot; call step()/drain() first") from None
 
-        paged_taken = None
+        # longest cached full-chunk prefix (host lookup; shared by the
+        # dense copy path and the paged block-sharing path below)
+        p_pad = self.prompt_pad
+        n_chunks = -(-len(prompt) // p_pad)
+        hit_c, hit_entry = 0, None
+        if self._prefix_cache is not None:
+            for c in range(len(prompt) // p_pad, 0, -1):
+                e = self._prefix_cache.get(prompt[: c * p_pad].tobytes())
+                if e is not None:
+                    self._prefix_cache.move_to_end(
+                        prompt[: c * p_pad].tobytes())
+                    hit_c, hit_entry = c, e
+                    break
+
+        paged_taken, install_ids, n_shared = None, None, 0
         if self._paged:
             from dnn_tpu.runtime.paged_kvcache import InsufficientBlocks
 
             # admission by ACTUAL length: this request holds
             # ceil((prompt + budget) / block_len) pool blocks for its
-            # lifetime — a free slot alone is not enough
+            # lifetime — a free slot alone is not enough. A prefix hit is
+            # COPY-FREE: the entry's blocks are shared by reference
+            # (refcounted), so only the tail is allocated.
             bp = self._block_len
             n_need = -(-(len(prompt) + max_new_tokens) // bp)
             if n_need > self._allocator.n_blocks - 1:
@@ -454,17 +488,27 @@ class ContinuousBatcher:
                 raise ValueError(
                     f"request needs {n_need} blocks but the pool only has "
                     f"{self._allocator.n_blocks - 1} allocatable")
-            paged_taken = self._allocator.alloc(n_need)
-            if paged_taken is None:
+            shared_ids = list(hit_entry[0])[:n_need] if hit_c else []
+            n_shared = len(shared_ids)
+            owned = self._allocator.alloc(n_need - n_shared)
+            if owned is None:
                 raise InsufficientBlocks(
-                    f"insufficient free cache blocks: need {n_need}, have "
-                    f"{self._allocator.n_free} "
+                    f"insufficient free cache blocks: need "
+                    f"{n_need - n_shared}, have {self._allocator.n_free} "
                     f"(pool {self._allocator.n_blocks}, block {bp} pos)")
+            if shared_ids:
+                self._allocator.ref(shared_ids)
+            paged_taken = shared_ids + owned
             nb_max = self.cache["tables"].shape[-1]
             ids_row = np.zeros((nb_max,), np.int32)
             ids_row[:n_need] = paged_taken
             self.cache["tables"] = self.cache["tables"].at[:, slot].set(
                 jnp.asarray(ids_row))
+            # install must NOT touch shared blocks (another request's live
+            # prefix): their install targets are routed to junk block 0
+            inst = ids_row.copy()
+            inst[:n_shared] = 0
+            install_ids = jnp.asarray(inst)
 
         try:
             rid = self._next_rid
@@ -482,39 +526,39 @@ class ContinuousBatcher:
             # chunked prefill: full prompt_pad-sized chunks + one padded tail,
             # each at its absolute start position — prompts of ANY length (up
             # to max_len - max_new) reuse the one compiled chunk program
-            p_pad = self.prompt_pad
-            n_chunks = -(-len(prompt) // p_pad)
             padded = np.zeros((1, n_chunks * p_pad), np.int32)
             padded[0, : len(prompt)] = prompt
             row = self._new_row()
             logits = None
             start_chunk = 0
-            if self._prefix_cache is not None:
-                # longest cached full-chunk prefix of this prompt (tail-padded
-                # partial chunks are never cacheable — their K/V rows hold
-                # garbage beyond the true length)
-                for c in range(len(prompt) // p_pad, 0, -1):
-                    hit = self._prefix_cache.get(prompt[: c * p_pad].tobytes())
-                    if hit is None:
-                        continue
-                    self._prefix_cache.move_to_end(prompt[: c * p_pad].tobytes())
-                    cached_row, last_logit_row = hit
-                    # copy out: the live row is donated through the chunk loop
-                    # and must not invalidate the cached entry
-                    row = jax.tree.map(jnp.copy, cached_row)
-                    if c == n_chunks:
-                        # whole prompt cached: rebuild a chunk-shaped logits
-                        # array with the stored last row in place (position
-                        # p_pad-1 == the true last prompt token of an exact
-                        # full-chunk prompt) so _prefill_finish keeps its one
-                        # compiled shape
-                        logits = jnp.zeros(
-                            (1, p_pad, last_logit_row.shape[-1]),
-                            last_logit_row.dtype,
-                        ).at[0, p_pad - 1].set(last_logit_row)
-                    start_chunk = c
-                    self.prefix_hits += 1
-                    break
+            if hit_c:
+                start_chunk = hit_c
+                self.prefix_hits += 1
+                last_logit_row = hit_entry[1]
+                if self._paged:
+                    # copy-free hit: the slot's table already points at
+                    # the entry's shared blocks. The transient row
+                    # rebuilds from the pool ONLY when remaining chunks
+                    # still need the prefix for their attention.
+                    if hit_c < n_chunks:
+                        row = self._gather_row(
+                            self.cache, self.cache["tables"][0, slot])
+                else:
+                    # dense hit: copy out — the live row is donated
+                    # through the chunk loop and must not invalidate the
+                    # cached entry
+                    row = jax.tree.map(jnp.copy, hit_entry[0])
+                if hit_c == n_chunks:
+                    # whole prompt cached: rebuild a chunk-shaped logits
+                    # array with the stored last row in place (position
+                    # p_pad-1 == the true last prompt token of an exact
+                    # full-chunk prompt) so _prefill_finish keeps its one
+                    # compiled shape
+                    logits = jnp.zeros(
+                        (1, p_pad, last_logit_row.shape[-1]),
+                        last_logit_row.dtype,
+                    ).at[0, p_pad - 1].set(last_logit_row)
+            put_candidates = []
             for c in range(start_chunk, n_chunks):
                 logits, row = self._prefill_chunk(
                     self.prepared, row,
@@ -523,13 +567,20 @@ class ContinuousBatcher:
                 self.prefill_chunks_run += 1
                 if self._prefix_cache is not None and (c + 1) * p_pad <= len(prompt):
                     key = prompt[: (c + 1) * p_pad].tobytes()
+                    if self._paged:
+                        # block-sharing entries point at THIS request's
+                        # blocks, which only hold data after the install —
+                        # record now, create after _prefill_finish
+                        put_candidates.append(
+                            (c + 1, key, jnp.copy(logits[0, -1])))
+                        continue
                     # scan-resistant insertion: evict the current LRU first,
                     # then park the NEW entry at the LRU end — only a HIT
                     # promotes to MRU. A long novel prompt therefore cycles
                     # its own one-shot chunks through the LRU slot instead of
                     # flushing the hot shared-prefix entries it never matches.
                     while len(self._prefix_cache) >= self._prefix_cap:
-                        self._prefix_cache.popitem(last=False)
+                        self._evict_prefix_entry()
                     self._prefix_cache[key] = (
                         jax.tree.map(jnp.copy, row), jnp.copy(logits[0, -1]))
                     self._prefix_cache.move_to_end(key, last=False)
@@ -540,7 +591,24 @@ class ContinuousBatcher:
             fin = self._prefill_finish(
                 self.cache, row, logits, last_local, slot, prefill_key,
                 t_arr, k_arr, p_arr,
+                install_ids if install_ids is not None
+                else jnp.zeros((0,), jnp.int32),
             )
+            if self._paged and put_candidates:
+                # create the block-sharing entries now that the install has
+                # populated this request's owned blocks. Each entry takes
+                # its own REFERENCE on the prefix blocks (shared + owned),
+                # so the blocks outlive the request until eviction.
+                nbp = p_pad // self._block_len
+                for c1, key, logit_row in put_candidates:
+                    if key in self._prefix_cache:
+                        continue
+                    while len(self._prefix_cache) >= self._prefix_cap:
+                        self._evict_prefix_entry()
+                    ids_prefix = [int(x) for x in paged_taken[: c1 * nbp]]
+                    self._allocator.ref(ids_prefix)
+                    self._prefix_cache[key] = (tuple(ids_prefix), logit_row)
+                    self._prefix_cache.move_to_end(key, last=False)
             if self._logprobs_k:
                 self.cache, first, c_lp, t_lp, t_ids = fin
             else:
@@ -571,6 +639,14 @@ class ContinuousBatcher:
                 self.cache["tables"] = \
                     self.cache["tables"].at[:, slot].set(0)
             raise
+
+    def _evict_prefix_entry(self):
+        """Drop the LRU prefix entry; paged entries release their block
+        references (blocks still shared by live slots survive via
+        refcount until those retire)."""
+        _, entry = self._prefix_cache.popitem(last=False)
+        if self._paged:
+            self._allocator.free(list(entry[0]))
 
     @staticmethod
     def _stop_match(emitted: list, stop_seqs: list):

@@ -132,14 +132,39 @@ def test_paged_validation():
     with pytest.raises(ValueError, match="tile block_len"):
         ContinuousBatcher(CFG, prepared, slots=2, max_len=60,
                           prompt_pad=16, paged_blocks=8, block_len=16)
+
+
+def test_paged_llama_gqa_matches_dense():
+    """The LLaMA family through the paged pool: the pool stores KV heads
+    (GQA width — family.kv_heads) and the folded-group attend rides the
+    same gather; tokens equal the dense LLaMA batcher."""
     from dnn_tpu.models import llama
+
     lcfg = llama.PRESETS["llama-test"]
     lprep = gpt.prepare_stacked(llama.init(jax.random.PRNGKey(0), lcfg),
                                 lcfg)
-    with pytest.raises(ValueError, match="GPT family"):
-        ContinuousBatcher(lcfg, lprep, slots=2, max_len=64, prompt_pad=16,
-                          paged_blocks=8, block_len=16,
-                          family=llama.LlamaFamilyRows(lcfg))
+
+    def run(paged):
+        extra = dict(paged_blocks=12, block_len=16) if paged else {}
+        srv = ContinuousBatcher(
+            lcfg, lprep, slots=2, max_len=64, prompt_pad=16,
+            family=llama.LlamaFamilyRows(lcfg), **extra)
+        r1 = srv.submit(_prompt(70, 12) % lcfg.vocab_size,
+                        max_new_tokens=6)
+        r2 = srv.submit(_prompt(71, 30) % lcfg.vocab_size,
+                        max_new_tokens=8, seed=4, temperature=0.9,
+                        top_k=7)
+        out = srv.drain()
+        return [out[r] for r in (r1, r2)]
+
+    # the paged pool really is KV-head narrow
+    from dnn_tpu.runtime.paged_kvcache import init_paged_cache
+    pool = init_paged_cache(lcfg, 2, 64, n_blocks=12, block_len=16,
+                            kv_heads=lcfg.n_kv_head)
+    assert pool["k"].shape[2] == lcfg.n_kv_head
+
+    for a, b in zip(run(True), run(False)):
+        np.testing.assert_array_equal(a, b)
 
 
 def test_worker_holds_back_on_block_exhaustion():
@@ -195,6 +220,83 @@ def test_claim_and_cancel_release_bookkeeping():
     srv.drain()
     assert srv.cancel(rid3)
     assert rid3 not in srv.results and rid3 not in srv.finish_reasons
+
+
+def test_paged_prefix_sharing_copy_free():
+    """Prefix cache in paged mode shares BLOCKS by refcount instead of
+    copying rows: a second request with the same prompt allocates only
+    its tail, prefill skips the shared chunks, and tokens match the dense
+    prefix-cache server."""
+    prepared = _prepared()
+    prompt = _prompt(80, 32)  # 2 full chunks (pad 16) -> 2 shared blocks
+    tail_a = np.concatenate([prompt, _prompt(81, 3)])
+
+    def run(paged):
+        extra = dict(paged_blocks=20, block_len=16) if paged else {}
+        srv = ContinuousBatcher(CFG, prepared, slots=4, max_len=64,
+                                prompt_pad=16, prefix_cache=8, **extra)
+        r1 = srv.submit(prompt, max_new_tokens=6)
+        chunks_after_first = srv.prefill_chunks_run
+        r2 = srv.submit(prompt, max_new_tokens=9, seed=2, temperature=0.8)
+        r3 = srv.submit(tail_a, max_new_tokens=5)
+        out = srv.drain()
+        return ([out[r] for r in (r1, r2, r3)], srv.prefix_hits,
+                srv.prefill_chunks_run - chunks_after_first, srv)
+
+    (toks_p, hits_p, extra_chunks_p, srv_p) = run(True)
+    (toks_d, hits_d, extra_chunks_d, _) = run(False)
+    for a, b in zip(toks_p, toks_d):
+        np.testing.assert_array_equal(a, b)
+    assert hits_p == hits_d == 2          # r2 whole-prompt, r3 partial
+    assert extra_chunks_p == extra_chunks_d == 1  # only r3's tail chunk
+
+
+def test_paged_prefix_block_accounting():
+    """The memory claim, measured on the allocator: a same-prompt second
+    request consumes ONLY its tail block; after both retire, just the
+    entry-pinned prefix blocks stay out of the free list."""
+    prepared = _prepared()
+    srv = ContinuousBatcher(CFG, prepared, slots=4, max_len=64,
+                            prompt_pad=16, prefix_cache=8,
+                            paged_blocks=20, block_len=16)
+    prompt = _prompt(82, 32)          # needs 2 blocks; +16 new -> 3 total
+    assert srv._allocator.n_free == 19
+    r1 = srv.submit(prompt, max_new_tokens=16)
+    assert srv._allocator.n_free == 16            # 3 allocated
+    r2 = srv.submit(prompt, max_new_tokens=16)    # whole-prefix hit
+    assert srv._allocator.n_free == 15            # tail block ONLY
+    srv.drain()
+    # slots returned their references; the two prefix entries (1-chunk and
+    # 2-chunk) still pin the 2 distinct prefix blocks
+    assert srv._allocator.n_free == 17
+    # hit entries survive retirement: a third request still shares
+    r3 = srv.submit(prompt, max_new_tokens=16)
+    assert srv._allocator.n_free == 16
+    out = srv.drain()
+    assert len(out[r3]) == 16
+
+
+def test_paged_prefix_eviction_under_sharing():
+    """Evicting an entry whose blocks a live slot still uses must not
+    recycle those blocks until the slot retires — and tokens stay
+    correct throughout."""
+    prepared = _prepared()
+    srv = ContinuousBatcher(CFG, prepared, slots=4, max_len=64,
+                            prompt_pad=16, prefix_cache=1,  # tiny LRU
+                            paged_blocks=24, block_len=16)
+    p1 = _prompt(83, 16)
+    r1 = srv.submit(p1, max_new_tokens=12)        # entry for p1 parked
+    free_mid = srv._allocator.n_free
+    # a different prompt's entry evicts p1's (cap 1) while r1 is LIVE
+    r2 = srv.submit(_prompt(84, 16), max_new_tokens=12)
+    # the eviction dropped the ENTRY's reference only: r1 still holds its
+    # prefix block, so the only free-list movement is r2's 2 new blocks —
+    # a buggy evict that recycled the shared block would show free_mid - 1
+    assert srv._allocator.n_free == free_mid - 2
+    out = srv.drain()
+    assert len(out[r1]) == 12 and len(out[r2]) == 12
+    # after retirement: only the surviving entry's 1 block stays pinned
+    assert srv._allocator.n_free == 22
 
 
 def test_allocator_contract():
