@@ -95,26 +95,35 @@ class BlockAllocator:
         return taken
 
     def ref(self, blocks: List[int]):
-        """Take an additional reference on live blocks (prefix sharing)."""
+        """Take an additional reference on live blocks (prefix sharing).
+        Validates the WHOLE list before mutating: a bad id mid-list must
+        not leave earlier refcounts raised (callers treat ref/free as
+        atomic when unwinding)."""
         for b in blocks:
             if self._rc.get(b, 0) < 1:
                 raise ValueError(f"ref on non-live block {b}")
+        for b in blocks:
             self._rc[b] += 1
 
     def free(self, blocks: List[int]):
-        """Release one reference per listed block; blocks whose last
-        reference drops return to the free list."""
-        for b in blocks:
-            if b == 0 or b >= self.n_blocks:
-                raise ValueError(f"bad block id {b}")
-            rc = self._rc.get(b, 0)
-            if rc < 1:
+        """Release one reference per listed occurrence; blocks whose last
+        reference drops return to the free list. Validates the WHOLE list
+        (including duplicate occurrences against the refcount) before
+        mutating, so a bad id can never leave the allocator half-freed —
+        callers unwind by re-freeing lists and must not double-decrement."""
+        from collections import Counter
+
+        counts = Counter(blocks)
+        for b, n in counts.items():
+            if b == 0 or b >= self.n_blocks or self._rc.get(b, 0) < n:
                 raise ValueError(f"free of non-live block {b}")
-            if rc == 1:
+        for b, n in counts.items():
+            rc = self._rc[b] - n
+            if rc == 0:
                 del self._rc[b]
                 self._free.append(b)
             else:
-                self._rc[b] = rc - 1
+                self._rc[b] = rc
 
 
 def init_paged_cache(cfg, slots: int, max_len: int, *, n_blocks: int,
@@ -125,17 +134,29 @@ def init_paged_cache(cfg, slots: int, max_len: int, *, n_blocks: int,
     positions. The pytree rides the same lax.scan-over-layers as the
     dense cache (leading L on every leaf). `kv_heads` overrides the
     pool's head width — GQA families store KV heads, not query heads
-    (llama.init_cache's narrowing, here applied to the pool)."""
+    (llama.init_cache's narrowing, here applied to the pool).
+    dtype="int8" builds the quantized pool: int8 K/V blocks plus
+    per-(position, head) f32 scale blocks, the paged form of
+    kvcache.Int8KV's layout."""
     if max_len % block_len:
         raise ValueError(f"max_len {max_len} must tile block_len {block_len}")
     head_dim = cfg.n_embd // cfg.n_head
     heads = kv_heads if kv_heads is not None else cfg.n_head
     nb_max = max_len // block_len
     shape = (cfg.n_layer, n_blocks, heads, block_len, head_dim)
+    tables = jnp.zeros((cfg.n_layer, slots, nb_max), jnp.int32)
+    if dtype == "int8":
+        return {
+            "k": jnp.zeros(shape, jnp.int8),
+            "v": jnp.zeros(shape, jnp.int8),
+            "ks": jnp.ones(shape[:-1], jnp.float32),
+            "vs": jnp.ones(shape[:-1], jnp.float32),
+            "tables": tables,
+        }
     return {
         "k": jnp.zeros(shape, dtype),
         "v": jnp.zeros(shape, dtype),
-        "tables": jnp.zeros((cfg.n_layer, slots, nb_max), jnp.int32),
+        "tables": tables,
     }
 
 
@@ -152,7 +173,9 @@ class PagedKV:
     def write_rows(self, c, k, v, pos, write_gate):
         """k/v (B, H, 1, D) at per-slot positions pos (B,); write_gate (B,)
         keeps inactive slots' LIVE state untouched. Physical target: block
-        tables[b, pos//bp], row pos%bp — one scatter per leaf.
+        tables[b, pos//bp], row pos%bp — one scatter per leaf. An int8
+        pool quantizes the incoming rows first (kvcache._quantize_rows)
+        and scatters the per-(position, head) scales alongside.
 
         Gated-off slots are ROUTED TO the reserved junk block (0, row 0)
         rather than restored-in-place: a retired slot's stale table can
@@ -168,44 +191,66 @@ class PagedKV:
         row = pos % bp
         blk = jnp.where(write_gate, blk, 0)
         row = jnp.where(write_gate, row, 0)
-        return {
-            "k": c["k"].at[blk, :, row].set(k[:, :, 0].astype(c["k"].dtype)),
-            "v": c["v"].at[blk, :, row].set(v[:, :, 0].astype(c["v"].dtype)),
-            "tables": c["tables"],
-        }
+        out = {"tables": c["tables"]}
+        if "ks" in c:
+            from dnn_tpu.runtime.kvcache import _quantize_rows
 
-    def gather_view(self, c):
-        """(B, H, S_max, D) dense view of every slot's logical cache —
+            kq, ks = _quantize_rows(k)  # (B,H,1,D), (B,H,1)
+            vq, vs = _quantize_rows(v)
+            out["k"] = c["k"].at[blk, :, row].set(kq[:, :, 0])
+            out["v"] = c["v"].at[blk, :, row].set(vq[:, :, 0])
+            out["ks"] = c["ks"].at[blk, :, row].set(ks[:, :, 0])
+            out["vs"] = c["vs"].at[blk, :, row].set(vs[:, :, 0])
+            return out
+        out["k"] = c["k"].at[blk, :, row].set(k[:, :, 0].astype(c["k"].dtype))
+        out["v"] = c["v"].at[blk, :, row].set(v[:, :, 0].astype(c["v"].dtype))
+        return out
+
+    def gather_view(self, c, names=("k", "v")):
+        """Dense (B, H, S_max, ...) views of every slot's logical cache —
         the einsum attention baseline (a paged Pallas kernel would skip
-        this materialization)."""
-        pool = c["k"], c["v"]
+        this materialization). Handles K/V blocks (…, bp, D) and scale
+        blocks (…, bp) alike."""
         tables = c["tables"]  # (B, nb_max)
         b, nb = tables.shape
         out = []
-        for leaf in pool:
-            g = jnp.take(leaf, tables.reshape(-1), axis=0)  # (B*nb, H, bp, D)
-            _, h, bp, d = g.shape
-            g = g.reshape(b, nb, h, bp, d).transpose(0, 2, 1, 3, 4)
-            out.append(g.reshape(b, h, nb * bp, d))
+        for name in names:
+            leaf = c[name]
+            g = jnp.take(leaf, tables.reshape(-1), axis=0)  # (B*nb, H, bp[, D])
+            h, bp = g.shape[1], g.shape[2]
+            rest = g.shape[3:]
+            g = g.reshape(b, nb, h, bp, *rest)
+            g = jnp.moveaxis(g, 1, 2)  # (B, H, nb, bp[, D])
+            out.append(g.reshape(b, h, nb * bp, *rest))
         return out
 
     def attend_rows(self, q, c, pos):
         """q (B, H, R, D); every row of slot b attends logical positions
-        <= pos[b] (identical math to kvcache.FloatKV.attend_rows on the
-        gathered view)."""
-        k, v = self.gather_view(c)
+        <= pos[b] (identical math to kvcache.FloatKV/Int8KV.attend_rows
+        on the gathered view — int8 pools fold their per-position scales
+        onto the score/probability matrices, never a float cache copy)."""
+        quant = "ks" in c
+        if quant:
+            k, v, ks, vs = self.gather_view(c, ("k", "v", "ks", "vs"))
+        else:
+            k, v = self.gather_view(c)
         d = q.shape[-1]
         s = jnp.einsum("bhtd,bhsd->bhts", q.astype(jnp.float32),
                        k.astype(jnp.float32),
-                       preferred_element_type=jnp.float32) / jnp.sqrt(d)
+                       preferred_element_type=jnp.float32)
+        if quant:
+            s = s * ks[:, :, None, :]
+        s = s / jnp.sqrt(d)
         cols = jnp.arange(k.shape[2])
         mask = cols[None, None, None, :] <= pos[:, None, None, None]
         s = jnp.where(mask, s, _NEG_BIG)
         p = jax.nn.softmax(s, axis=-1)
-        return jnp.einsum("bhts,bhsd->bhtd", p.astype(jnp.float32),
-                          v.astype(jnp.float32),
-                          preferred_element_type=jnp.float32) \
-            .astype(c["v"].dtype)
+        if quant:
+            p = p * vs[:, :, None, :]
+        out = jnp.einsum("bhts,bhsd->bhtd", p.astype(jnp.float32),
+                         v.astype(jnp.float32),
+                         preferred_element_type=jnp.float32)
+        return out if quant else out.astype(c["v"].dtype)
 
     # --- prefill install (full-cache view: pool (L, n_blocks, H, bp, D),
     #     tables (L, B, nb_max)) ---------------------------------------
@@ -222,11 +267,14 @@ class PagedKV:
         bp = self.block_len
         out = {"tables": cache["tables"]}
         nb_max = blk_ids.shape[0]
-        for kk in ("k", "v"):
-            r = row[kk][:, 0]  # (L, H, row_len, D)
-            l_, h, rl, d = r.shape
-            blocks = r.reshape(l_, h, rl // bp, bp, d)[:, :, :nb_max]
-            blocks = blocks.transpose(0, 2, 1, 3, 4)  # (L, nb_max, H, bp, D)
+        for kk in cache:
+            if kk == "tables":
+                continue
+            r = row[kk][:, 0]  # (L, H, row_len[, D]) — scales have no D
+            l_, h, rl = r.shape[:3]
+            rest = r.shape[3:]
+            blocks = r.reshape(l_, h, rl // bp, bp, *rest)[:, :, :nb_max]
+            blocks = jnp.moveaxis(blocks, 2, 1)  # (L, nb_max, H, bp[, D])
             out[kk] = cache[kk].at[:, blk_ids].set(
                 blocks.astype(cache[kk].dtype))
         return out

@@ -214,10 +214,6 @@ class ContinuousBatcher:
                 BlockAllocator, PagedKV, init_paged_cache,
             )
 
-            if kv_dtype == "int8":
-                raise ValueError(
-                    "paged_blocks with an int8 cache is not implemented "
-                    "(the pool has no scale blocks yet)")
             if self.max_len % block_len:
                 raise ValueError(
                     f"max_len {self.max_len} must tile block_len "
@@ -240,16 +236,22 @@ class ContinuousBatcher:
                 """Rebuild a transient prefill row from pool blocks (the
                 prefix-hit path: remaining chunks attend the shared
                 prefix through this row). Junk beyond the prefix is never
-                attended (chunk attention masks at its positions)."""
+                attended (chunk attention masks at its positions).
+                Rank-agnostic: K/V blocks (…, bp, D) and int8 scale
+                blocks (…, bp) alike."""
                 out = {}
-                for kk in ("k", "v"):
+                for kk in cache:
+                    if kk == "tables":
+                        continue
                     g = jnp.take(cache[kk], ids_row, axis=1)
-                    l_, nb, h, bl, d = g.shape  # (L, nb_max, H, bp, D)
-                    r = g.transpose(0, 2, 1, 3, 4).reshape(l_, h, nb * bl, d)
+                    l_, nb, h, bl = g.shape[:4]  # (L, nb_max, H, bp[, D])
+                    rest = g.shape[4:]
+                    r = jnp.moveaxis(g, 1, 2).reshape(l_, h, nb * bl, *rest)
                     pad = self._row_len - nb * bl
                     if pad:
-                        r = jnp.pad(r, ((0, 0), (0, 0), (0, pad), (0, 0)))
-                    out[kk] = r[:, None]  # (L, 1, H, row_len, D)
+                        r = jnp.pad(r, [(0, 0), (0, 0), (0, pad)]
+                                    + [(0, 0)] * len(rest))
+                    out[kk] = r[:, None]  # (L, 1, H, row_len[, D])
                 return out
 
             self._gather_row = jax.jit(gather_row)
@@ -490,14 +492,33 @@ class ContinuousBatcher:
                     f"{self._allocator.n_blocks - 1} allocatable")
             shared_ids = list(hit_entry[0])[:n_need] if hit_c else []
             n_shared = len(shared_ids)
-            owned = self._allocator.alloc(n_need - n_shared)
-            if owned is None:
-                raise InsufficientBlocks(
-                    f"insufficient free cache blocks: need "
-                    f"{n_need - n_shared}, have {self._allocator.n_free} "
-                    f"(pool {self._allocator.n_blocks}, block {bp} pos)")
+            # ref the shared prefix BEFORE any eviction below can run:
+            # the hit entry itself may be evicted while we hunt for tail
+            # blocks, and without our reference its blocks could recycle
+            # into this very allocation (aliasing the prefix)
             if shared_ids:
                 self._allocator.ref(shared_ids)
+            try:
+                owned = self._allocator.alloc(n_need - n_shared)
+                while owned is None and self._prefix_cache:
+                    # entry-pinned blocks must never starve admission
+                    # (livelock: entries only evict on insertion, which
+                    # needs a successful prefill): evict LRU entries until
+                    # the tail fits. Entries whose blocks live slots still
+                    # share free nothing (refcount) — keep evicting.
+                    self._evict_prefix_entry()
+                    owned = self._allocator.alloc(n_need - n_shared)
+                if owned is None:
+                    raise InsufficientBlocks(
+                        f"insufficient free cache blocks: need "
+                        f"{n_need - n_shared}, have "
+                        f"{self._allocator.n_free} "
+                        f"(pool {self._allocator.n_blocks}, block {bp} "
+                        f"pos)")
+            except BaseException:
+                if shared_ids:
+                    self._allocator.free(shared_ids)
+                raise
             paged_taken = shared_ids + owned
             nb_max = self.cache["tables"].shape[-1]
             ids_row = np.zeros((nb_max,), np.int32)

@@ -127,11 +127,33 @@ def test_recycled_blocks_are_clean_for_tokens():
 
 def test_paged_validation():
     prepared = _prepared()
-    with pytest.raises(ValueError, match="int8"):
-        _mk(prepared, paged=True, kv_dtype="int8")
     with pytest.raises(ValueError, match="tile block_len"):
         ContinuousBatcher(CFG, prepared, slots=2, max_len=60,
                           prompt_pad=16, paged_blocks=8, block_len=16)
+
+
+def test_paged_int8_matches_dense_int8():
+    """int8 paged pool (quantized K/V blocks + per-position scale blocks):
+    the quantization math is the dense Int8KV's row recipe on both paths,
+    so tokens match the dense int8 batcher exactly — including through a
+    shared-prefix hit (scale blocks shared alongside)."""
+    prepared = _prepared()
+    prompt = _prompt(90, 32)
+
+    def run(paged):
+        extra = dict(paged_blocks=20, block_len=16) if paged else {}
+        srv = ContinuousBatcher(CFG, prepared, slots=3, max_len=64,
+                                prompt_pad=16, kv_dtype="int8",
+                                prefix_cache=4, **extra)
+        r1 = srv.submit(prompt, max_new_tokens=7)
+        r2 = srv.submit(prompt, max_new_tokens=9, seed=5,
+                        temperature=0.9, top_k=13)  # prefix hit
+        r3 = srv.submit(_prompt(91, 10), max_new_tokens=5)
+        out = srv.drain()
+        return [out[r] for r in (r1, r2, r3)]
+
+    for a, b in zip(run(True), run(False)):
+        np.testing.assert_array_equal(a, b)
 
 
 def test_paged_llama_gqa_matches_dense():
@@ -297,6 +319,40 @@ def test_paged_prefix_eviction_under_sharing():
     assert len(out[r1]) == 12 and len(out[r2]) == 12
     # after retirement: only the surviving entry's 1 block stays pinned
     assert srv._allocator.n_free == 22
+
+
+def test_entry_pinned_blocks_evict_instead_of_wedging():
+    """Prefix entries pin blocks after their requests retire; a new novel
+    request must EVICT entries to fit rather than raise forever (the
+    livelock: entries only evicted on insertion, insertion needs a
+    successful prefill)."""
+    prepared = _prepared()
+    srv = ContinuousBatcher(CFG, prepared, slots=4, max_len=64,
+                            prompt_pad=16, prefix_cache=8,
+                            paged_blocks=8, block_len=16)  # 7 allocatable
+    # three distinct 2-chunk prompts, drained: entries pin 2 blocks each
+    for s in (100, 101, 102):
+        rid = srv.submit(_prompt(s, 32), max_new_tokens=16)
+        srv.drain()
+    assert srv._allocator.n_free <= 1  # nearly everything entry-pinned
+    # a novel request needing 3 blocks must evict its way in
+    rid = srv.submit(_prompt(103, 32), max_new_tokens=16)
+    assert len(srv.drain()[rid]) == 16
+
+
+def test_allocator_atomic_free():
+    from dnn_tpu.runtime.paged_kvcache import BlockAllocator
+
+    a = BlockAllocator(6)
+    got = a.alloc(3)
+    with pytest.raises(ValueError):
+        a.free([got[0], 0])          # bad id mid-list...
+    assert a.n_free == 2             # ...must not half-free
+    with pytest.raises(ValueError):
+        a.free([got[0], got[0]])     # duplicate beyond refcount
+    assert a.n_free == 2
+    a.free(got)
+    assert a.n_free == 5
 
 
 def test_allocator_contract():
