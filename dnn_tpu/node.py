@@ -79,6 +79,16 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--max_len", type=int, default=None,
                    help="--serve_lm: max sequence length per slot "
                         "(default: model block_size)")
+    p.add_argument("--draft_model", default=None,
+                   help="--serve_lm: model-zoo name of a DRAFT model — "
+                        "enables speculative continuous batching (each "
+                        "step commits up to spec_k+1 tokens per slot; "
+                        "runtime/serving_spec.py)")
+    p.add_argument("--draft_weights", default=None,
+                   help="--serve_lm: checkpoint for the draft model "
+                        "(.pth/npz/safetensors; random init if omitted)")
+    p.add_argument("--spec_k", type=int, default=4,
+                   help="--serve_lm: draft proposals per speculative step")
     p.add_argument("--paged_blocks", type=int, default=0,
                    help="--serve_lm: paged KV cache — shared pool of this "
                         "many blocks instead of per-slot dense caches "
@@ -337,9 +347,55 @@ def _serve_lm(engine: PipelineEngine, args) -> int:
             log.error("tokenizer setup failed: %s", e)
             return 1
     prepared = prepare_stacked(engine.params, cfg)
+    spec_kwargs = {}
+    if args.draft_model:
+        # speculative serving: load/init the draft family from the zoo
+        import jax as _jax
+
+        from dnn_tpu.registry import get_model
+
+        try:
+            d_spec = get_model(args.draft_model)
+            d_cfg = d_spec.config
+            if d_cfg is None or not isinstance(d_cfg, GPTConfig) or \
+                    isinstance(d_cfg, GPTMoEConfig):
+                raise ValueError(
+                    f"--draft_model must name a dense GPT-family zoo "
+                    f"entry, got '{args.draft_model}'")
+            if d_cfg.vocab_size != cfg.vocab_size:
+                raise ValueError(
+                    f"draft vocab {d_cfg.vocab_size} != target vocab "
+                    f"{cfg.vocab_size}")
+            if args.draft_weights:
+                from dnn_tpu.io import checkpoint as ckpt
+
+                sd = ckpt.load_checkpoint(args.draft_weights)
+                if ckpt.is_native_flat(sd):
+                    d_params = ckpt.flat_to_params(sd)
+                elif d_spec.convert_state_dict is not None:
+                    d_params = d_spec.convert_state_dict(sd)
+                else:
+                    raise ValueError(
+                        f"draft checkpoint {args.draft_weights} is in a "
+                        f"foreign layout and '{args.draft_model}' has no "
+                        "converter")
+            else:
+                log.warning("no --draft_weights; draft uses random init "
+                            "(wiring/testing only — a random draft "
+                            "accepts ~nothing)")
+                d_params = d_spec.init(_jax.random.PRNGKey(0))
+            spec_kwargs = {
+                "draft_cfg": d_cfg,
+                "draft_prepared": prepare_stacked(d_params, d_cfg),
+                "spec_k": args.spec_k,
+            }
+        except Exception as e:  # noqa: BLE001 — CLI boundary
+            log.error("draft model setup failed: %s", e)
+            return 1
     try:
         asyncio.run(serve_lm(
             cfg, prepared, port=me.port, slots=args.slots,
+            **spec_kwargs,
             max_len=args.max_len, prompt_pad=args.prompt_pad,
             temperature=args.temperature, top_k=args.top_k,
             top_p=args.top_p,

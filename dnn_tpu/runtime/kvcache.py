@@ -134,6 +134,27 @@ class FloatKV(_KernelDispatch):
         return {"k": jnp.where(w, k_new, c["k"]),
                 "v": jnp.where(w, v_new, c["v"])}
 
+    def attend_rows_causal(self, q, c, pos):
+        """q (B, H, T, D) VERIFY blocks: row t of slot b attends cache
+        columns <= pos[b] + t (per-row positions AND within-block
+        causality — the speculative verify chunk's masking, which neither
+        attend (shared batch limits) nor attend_rows (shared row limit)
+        expresses). Op-and-dtype recipe mirrors attend_rows exactly —
+        score einsum in the operand dtype, f32 softmax, probs cast to the
+        cache dtype — so a greedy verify reproduces the step-by-step
+        decode's argmax even under bf16 compute (the spec batcher's
+        token-identity contract)."""
+        d = q.shape[-1]
+        s = jnp.einsum("bhtd,bhsd->bhts", q, c["k"]).astype(jnp.float32) \
+            / jnp.sqrt(d)
+        cols = jnp.arange(c["k"].shape[2])
+        rows = jnp.arange(q.shape[2])
+        limit = pos[:, None, None, None] + rows[None, None, :, None]
+        s = jnp.where(cols[None, None, None, :] <= limit, s, _NEG_BIG)
+        p = jax.nn.softmax(s, axis=-1)
+        return jnp.einsum("bhts,bhsd->bhtd", p.astype(c["v"].dtype),
+                          c["v"])
+
     def attend_rows(self, q, c, pos):
         """q (B, H, R, D); every row of slot b masked to keys at positions
         <= pos[b]. R=1 is plain per-slot decode; R=G is the LLaMA GQA fold
@@ -243,6 +264,24 @@ class Int8KV(_KernelDispatch):
                  "ks": write_gate[:, None, None],
                  "vs": write_gate[:, None, None]}
         return {kk: jnp.where(gates[kk], new[kk], c[kk]) for kk in c}
+
+    def attend_rows_causal(self, q, c, pos):
+        # per-row causal verify blocks (see FloatKV.attend_rows_causal);
+        # scales fold exactly as in attend_rows' recipe
+        d = q.shape[-1]
+        s = jnp.einsum("bhtd,bhsd->bhts", q.astype(jnp.float32),
+                       c["k"].astype(jnp.float32),
+                       preferred_element_type=jnp.float32)
+        s = s * c["ks"][:, :, None, :] / jnp.sqrt(d)
+        cols = jnp.arange(c["k"].shape[2])
+        rows = jnp.arange(q.shape[2])
+        limit = pos[:, None, None, None] + rows[None, None, :, None]
+        s = jnp.where(cols[None, None, None, :] <= limit, s, _NEG_BIG)
+        p = jax.nn.softmax(s, axis=-1)
+        p = p * c["vs"][:, :, None, :]
+        return jnp.einsum("bhts,bhsd->bhtd", p,
+                          c["v"].astype(jnp.float32),
+                          preferred_element_type=jnp.float32)
 
     def attend_rows(self, q, c, pos):
         # shared-limit decode rows, any R (see FloatKV.attend_rows)

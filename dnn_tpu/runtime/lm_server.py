@@ -310,7 +310,13 @@ class _BatcherWorker(threading.Thread):
                 self._fail_all(RuntimeError(f"LM batcher worker died: {e}"))
                 return
             for rid, tok in stepped.items():  # streaming: tokens as they
-                self._emit_token(rid, tok)    # commit, before done-publish
+                # commit, before done-publish; the speculative batcher
+                # commits a LIST of tokens per step (serving_spec.py)
+                if isinstance(tok, (list, tuple)):
+                    for t in tok:
+                        self._emit_token(rid, t)
+                else:
+                    self._emit_token(rid, tok)
             self._publish_done()  # submit alone can retire (budget == 1)
 
 
@@ -325,8 +331,19 @@ class LMServer:
 
     def __init__(self, cfg, prepared, *, default_max_new: int = 32,
                  request_timeout: float = 120.0, tokenizer=None,
+                 draft_cfg=None, draft_prepared=None, spec_k: int = 4,
                  **batcher_kwargs):
-        self.batcher = ContinuousBatcher(cfg, prepared, **batcher_kwargs)
+        if draft_cfg is not None:
+            # speculative serving: the slot pool advances up to spec_k+1
+            # tokens per device step (runtime/serving_spec.py)
+            from dnn_tpu.runtime.serving_spec import SpeculativeBatcher
+
+            self.batcher = SpeculativeBatcher(
+                cfg, prepared, draft_cfg, draft_prepared, spec_k=spec_k,
+                **batcher_kwargs)
+        else:
+            self.batcher = ContinuousBatcher(cfg, prepared,
+                                             **batcher_kwargs)
         self.default_max_new = default_max_new
         self.request_timeout = request_timeout
         # optional text front (dnn_tpu/io/tokenizer.py): with it,

@@ -114,6 +114,46 @@ class GPTFamilyRows:
             compute_dtype=self.compute_dtype, ffn=self.ffn,
             attn_kernel=self.attn_kernel)
 
+    def verify_rows(self, prepared, cache, chunk, pos, active, codec):
+        """A (B, T) token block at PER-ROW start positions pos (B,):
+        writes K/V for positions pos..pos+T-1 of each active row, attends
+        with per-row within-block causality (codec.attend_rows_causal),
+        returns (logits (B, T, V), cache). The speculative batcher's
+        target-scoring / draft-sync program — row t's logits predict the
+        token at position pos+t+1."""
+        cfg, compute_dtype = self.cfg, self.compute_dtype
+        b, t = chunk.shape
+        positions = pos[:, None] + jnp.arange(t)  # (B, T)
+        x = jnp.take(prepared["wte"]["embedding"], chunk, axis=0) + \
+            jnp.take(prepared["wpe"]["embedding"], positions, axis=0)
+        if compute_dtype is not None:
+            x = x.astype(compute_dtype)
+
+        def layer(carry, layer_in):
+            bp, layer_cache = layer_in
+            h = layer_norm(bp["ln_1"], carry, eps=cfg.ln_eps)
+            q, kk, vv = _qkv_heads(bp, h, cfg=cfg,
+                                   compute_dtype=compute_dtype)
+            layer_cache = codec.write_rows(layer_cache, kk, vv, pos, active)
+            y = codec.attend_rows_causal(q, layer_cache, pos)
+            carry = carry + linear(bp["attn"]["proj"],
+                                   merge_heads(y.astype(carry.dtype)),
+                                   compute_dtype=compute_dtype)
+            h = layer_norm(bp["ln_2"], carry, eps=cfg.ln_eps)
+            if self.ffn is None:
+                m = linear(bp["mlp"]["proj"],
+                           gelu(linear(bp["mlp"]["fc"], h,
+                                       compute_dtype=compute_dtype)),
+                           compute_dtype=compute_dtype)
+            else:
+                m = self.ffn(bp, h).astype(carry.dtype)
+            return carry + m, layer_cache
+
+        x, new_cache = lax.scan(layer, x, (prepared["blocks"], cache))
+        logits = head(prepared, x.astype(jnp.float32), cfg=cfg,
+                      compute_dtype=compute_dtype)
+        return logits, new_cache
+
     def decode_rows(self, prepared, cache, tok, pos, active, codec):
         """One per-slot decode step: tok/pos/active (B,) ->
         (logits (B, V), cache)."""

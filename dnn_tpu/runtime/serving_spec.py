@@ -1,0 +1,316 @@
+"""Speculative CONTINUOUS batching: draft-assisted decode inside the slot
+pool.
+
+`runtime/speculative.py` breaks decode's serial chain for ONE stream (its
+batch-1 check points here for throughput); this module lifts the same
+construction into the continuous batcher, where it was the one serving
+feature that didn't compose (README's composition matrix). The insight
+that makes it fit: the batcher already tracks PER-ROW positions, and the
+solo design's core trick — "variable acceptance exists only as an
+integer, never as a shape" — vectorizes to a (B,) integer: every step,
+ALL active slots propose k draft tokens, the target verifies every row's
+k+1 positions in one forward, and each slot commits its own m+1 <= k+1
+tokens. Static shapes throughout; rejected proposals roll back by not
+advancing that row's position (their stale cache entries sit beyond the
+per-row attention limit, exactly as in the solo loop and the chunked
+prefill's tail pad).
+
+Per step, one compiled program (`spec_step`) runs:
+  1. draft sync: idempotent re-feed of each row's previous verify chunk
+     at its old positions (fills exactly the draft-cache entries that
+     could be missing; recomputing present ones is a no-op);
+  2. k draft decode steps propose (B, k) tokens (greedy, or sampled from
+     the draft's filtered distribution with each slot's own rng stream);
+  3. one target verify over the (B, k+1) chunks [last, p1..pk] at
+     per-row positions (GPTFamilyRows.verify_rows);
+  4. per-row acceptance — greedy: longest prefix where the draft matches
+     the target's argmax (output tokens ARE the target's picks, so
+     greedy results are token-identical to the plain batcher: the parity
+     contract tests/test_serving_spec.py pins); sampled: the
+     rejection-sampling construction of Leviathan et al. 2023 (accept
+     with min(1, p_t/p_d), resample the first rejection from the
+     normalized residual, bonus sample when all accepted), vectorized
+     over rows;
+  5. per-row commit: pos += m+1 (inactive rows 0), last = w[m], and the
+     (B, k+1) committed-token block + (B,) counts return to the host,
+     which appends each slot's tokens (budget/stop/eos checks run per
+     token, so a mid-chunk stop retires the slot and discards the rest).
+
+Restrictions (all checked at construction/submit): GPT-family target and
+draft with equal vocabularies (the families only need matching vocabs —
+configs may differ), float caches (the solo module's reasoning: chunked
+re-feeds would re-quantize int8 rows differently from the oracle path),
+dense (non-paged) pool, server-level temperature/top_k (the rejection
+math runs one distribution transform for the whole pool; per-request
+sampling overrides are the dense batcher's feature), prompts of at least
+k+1 tokens (the first sync chunk re-feeds the prompt tail), and
+len(prompt) + max_new + k <= max_len (verify writes up to k positions of
+scratch beyond the last committed token).
+
+The reference framework has no decode at all (SURVEY §3.2); this is the
+deepest point of the serving stack built beyond it.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from dnn_tpu.models.gpt import GPTConfig, prepare_stacked  # noqa: F401
+from dnn_tpu.runtime.kvcache import codec_for_cache
+from dnn_tpu.runtime.serving import ContinuousBatcher, GPTFamilyRows
+# the ONE sampling transform shared with the solo speculative loop:
+# rejection sampling is only exact when draft and target use the
+# identical transform, so both paths must import the same function
+from dnn_tpu.runtime.speculative import _probs
+
+__all__ = ["SpeculativeBatcher"]
+
+
+class SpeculativeBatcher(ContinuousBatcher):
+    """ContinuousBatcher whose step() advances every active slot by UP TO
+    k+1 tokens per call via draft-model speculation. Submit/retire/stop/
+    finish-reason surfaces are inherited unchanged."""
+
+    def __init__(self, cfg: GPTConfig, prepared, draft_cfg: GPTConfig,
+                 draft_prepared, *, spec_k: int = 4, **kw):
+        if cfg.vocab_size != draft_cfg.vocab_size:
+            raise ValueError(
+                f"draft vocab {draft_cfg.vocab_size} != target vocab "
+                f"{cfg.vocab_size}")
+        for bad in ("family", "ffn", "paged_blocks", "logprobs_k",
+                    "attn_kernel", "top_p"):
+            if kw.get(bad):
+                raise ValueError(
+                    f"SpeculativeBatcher does not support {bad}=")
+        if kw.get("kv_dtype") == "int8":
+            raise ValueError(
+                "SpeculativeBatcher pins float caches (chunked re-feeds "
+                "would re-quantize int8 rows differently from the oracle "
+                "path — see runtime/speculative.py)")
+        super().__init__(cfg, prepared, **kw)
+        self.spec_k = int(spec_k)
+        if self.spec_k < 1:
+            raise ValueError(f"spec_k must be >= 1, got {spec_k}")
+        self.draft_cfg = draft_cfg
+        self.draft_prepared = draft_prepared
+        self._temperature = float(kw.get("temperature", 0.0) or 0.0)
+        self._top_k_opt = kw.get("top_k")
+        self._greedy = self._temperature == 0.0
+
+        k = self.spec_k
+        cache_dtype = self.cache["k"].dtype
+        d_family = GPTFamilyRows(draft_cfg,
+                                 compute_dtype=self.family.compute_dtype)
+        # the draft needs the same scratch headroom past max_len the
+        # target gets via the submit budget check (verify/propose write
+        # up to k positions beyond the last committed token)
+        self.d_cache = d_family.init_cache(self.slots, self.max_len,
+                                           cache_dtype)
+        self._d_family = d_family
+        d_codec = codec_for_cache(self.d_cache)
+        t_codec = codec_for_cache(self.cache)
+        t_family = self.family
+
+        # per-slot draft-sync chunk: the previous verify block + its start
+        self.prev_chunk = jnp.zeros((self.slots, k + 1), jnp.int32)
+        self.prev_pos = jnp.zeros((self.slots,), jnp.int32)
+        # acceptance telemetry
+        self.spec_steps = 0
+        self.spec_proposed = 0
+        self.spec_accepted = 0
+
+        greedy = self._greedy
+        temperature, top_k = self._temperature, self._top_k_opt
+
+        def spec_step(t_prepared, d_prepared, t_cache, d_cache, tok, pos,
+                      active, keys, prev_chunk, prev_pos):
+            b = tok.shape[0]
+            # 1. draft sync (write-only; logits discarded)
+            _, d_cache = d_family.verify_rows(
+                d_prepared, d_cache, prev_chunk, prev_pos, active, d_codec)
+
+            # 2. k draft proposal steps
+            def d_step(carry, i):
+                cache, last, kk = carry
+                logits, cache = d_family.decode_rows(
+                    d_prepared, cache, last, pos + i, active, d_codec)
+                if greedy:
+                    nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                    rows = jnp.zeros((b, 1), jnp.float32)  # placeholder
+                    new_k = kk
+                else:
+                    split = jax.vmap(jax.random.split)(kk)
+                    new_k, subs = split[:, 0], split[:, 1]
+                    rows = _probs(logits, temperature=temperature,
+                                  top_k=top_k)  # (B, V)
+                    nxt = jax.vmap(
+                        lambda r, s: jax.random.categorical(s, jnp.log(r))
+                    )(rows, subs).astype(jnp.int32)
+                nxt = jnp.where(active, nxt, last)
+                return (cache, nxt, new_k), (nxt, rows)
+
+            (d_cache, _, keys), (props_t, d_rows_t) = lax.scan(
+                d_step, (d_cache, tok, keys), jnp.arange(k))
+            props = jnp.moveaxis(props_t, 0, 1)      # (B, k)
+            d_rows = jnp.moveaxis(d_rows_t, 0, 1)    # (B, k, V) or (B,k,1)
+
+            # 3. target verify over [last, p1..pk]
+            chunk = jnp.concatenate([tok[:, None], props], axis=1)
+            t_logits, t_cache = t_family.verify_rows(
+                t_prepared, t_cache, chunk, pos, active, t_codec)
+            rows = t_logits  # (B, k+1, V); row i predicts pos+i+1
+
+            if greedy:
+                t_toks = jnp.argmax(rows, axis=-1).astype(jnp.int32)
+                match = props == t_toks[:, :k]
+                m = jnp.where(match.all(axis=1), k,
+                              jnp.argmax(~match, axis=1)).astype(jnp.int32)
+                w = t_toks  # (B, k+1): committed tokens ARE target picks
+            else:
+                split = jax.vmap(lambda kk: jax.random.split(kk, 3))(keys)
+                keys, r_acc, r_rep = split[:, 0], split[:, 1], split[:, 2]
+                t_dist = _probs(rows, temperature=temperature, top_k=top_k)
+                idx = jnp.arange(k)
+                t_probs = jnp.take_along_axis(
+                    t_dist[:, :k], props[:, :, None], axis=2)[..., 0]
+                d_probs = jnp.take_along_axis(
+                    d_rows, props[:, :, None], axis=2)[..., 0]
+                ratio = t_probs / jnp.maximum(d_probs, 1e-30)
+                u = jax.vmap(lambda r: jax.random.uniform(r, (k,)))(r_acc)
+                accept = u < jnp.minimum(ratio, 1.0)  # (B, k)
+                m = jnp.where(accept.all(axis=1), k,
+                              jnp.argmax(~accept, axis=1)).astype(jnp.int32)
+                d_row_m = jnp.where(
+                    (m < k)[:, None],
+                    jnp.take_along_axis(
+                        d_rows, jnp.minimum(m, k - 1)[:, None, None],
+                        axis=1)[:, 0],
+                    jnp.zeros_like(d_rows[:, 0]))
+                t_row_m = jnp.take_along_axis(
+                    t_dist, m[:, None, None], axis=1)[:, 0]
+                resid = jnp.maximum(t_row_m - d_row_m, 0.0)
+                z = resid.sum(axis=-1, keepdims=True)
+                resid = jnp.where(z > 0, resid / jnp.maximum(z, 1e-30),
+                                  t_row_m)
+                rep = jax.vmap(
+                    lambda r, s: jax.random.categorical(s, jnp.log(r))
+                )(resid, r_rep).astype(jnp.int32)
+                props_ext = jnp.concatenate(
+                    [props, jnp.zeros((b, 1), jnp.int32)], axis=1)
+                w = jnp.where(jnp.arange(k + 1)[None, :] == m[:, None],
+                              rep[:, None], props_ext)
+
+            committed = jnp.where(active, m + 1, 0)
+            last = jnp.take_along_axis(w, m[:, None], axis=1)[:, 0]
+            last = jnp.where(active, last, tok)
+            new_prev_chunk = jnp.where(active[:, None], chunk, prev_chunk)
+            new_prev_pos = jnp.where(active, pos, prev_pos)
+            return (t_cache, d_cache, last, pos + committed, keys,
+                    new_prev_chunk, new_prev_pos, w, m)
+
+        self._spec_step = jax.jit(spec_step, donate_argnums=(2, 3))
+
+        # draft-side chunked prefill (the target side reuses the parent's
+        # programs); the install is the parent's dense slice-install shape
+        def d_prefill_chunk(prepared, row, chunk, chunk_start):
+            return d_family.prefill(prepared, chunk, row, chunk_start)
+
+        def d_install(cache, row, slot):
+            return {
+                kk: lax.dynamic_update_slice_in_dim(
+                    cache[kk],
+                    lax.slice_in_dim(row[kk], 0, self.max_len, axis=3),
+                    slot, axis=1)
+                for kk in cache
+            }
+
+        self._d_prefill_chunk = jax.jit(d_prefill_chunk,
+                                        donate_argnums=(1,))
+        self._d_install = jax.jit(d_install, donate_argnums=(0, 1))
+
+    # ------------------------------------------------------------------
+
+    def submit(self, prompt, max_new_tokens: int,
+               seed: Optional[int] = None, **opts) -> int:
+        for bad in ("temperature", "top_k", "top_p", "logprobs"):
+            # explicit-None check: temperature=0.0 / top_k=0 are real
+            # overrides and must be rejected too, not slip past truthiness
+            if opts.get(bad) is not None and opts.get(bad) is not False:
+                raise ValueError(
+                    "SpeculativeBatcher uses the server-level sampling "
+                    f"configuration; per-request {bad}= is the dense "
+                    "batcher's feature")
+        prompt_arr = np.asarray(prompt, np.int32).reshape(-1)
+        k = self.spec_k
+        if len(prompt_arr) < k + 1:
+            raise ValueError(
+                f"prompt length {len(prompt_arr)} < spec_k+1 ({k + 1}) — "
+                "the first draft-sync chunk re-feeds the prompt tail")
+        if len(prompt_arr) + max_new_tokens + k > self.max_len:
+            raise ValueError(
+                f"prompt {len(prompt_arr)} + max_new {max_new_tokens} + "
+                f"spec_k {k} exceeds max_len {self.max_len} (the verify "
+                "chunk writes up to k scratch positions)")
+        rid = super().submit(prompt_arr, max_new_tokens, seed=seed, **opts)
+        # slot the parent picked; a budget-1 request already retired at
+        # submit (the prefill-sampled token was its whole budget) and
+        # needs no draft state at all
+        slot = next((i for i, r in enumerate(self._slot_req)
+                     if r is not None and r["rid"] == rid), None)
+        if slot is None:
+            return rid
+        # draft prefill: same chunk loop as the parent, through the draft
+        p_pad = self.prompt_pad
+        n_chunks = -(-len(prompt_arr) // p_pad)
+        padded = np.zeros((1, n_chunks * p_pad), np.int32)
+        padded[0, : len(prompt_arr)] = prompt_arr
+        d_row = self._d_family.init_cache(
+            1, self._row_len, self.d_cache["k"].dtype)
+        for c in range(n_chunks):
+            _, d_row = self._d_prefill_chunk(
+                self.draft_prepared, d_row,
+                jnp.asarray(padded[:, c * p_pad:(c + 1) * p_pad]),
+                c * p_pad)
+        self.d_cache = self._d_install(self.d_cache, d_row, slot)
+        # first sync chunk: the prompt's own tail at its own positions —
+        # an exact no-op re-feed
+        tail = prompt_arr[-(k + 1):]
+        self.prev_chunk = self.prev_chunk.at[slot].set(jnp.asarray(tail))
+        self.prev_pos = self.prev_pos.at[slot].set(
+            len(prompt_arr) - (k + 1))
+        return rid
+
+    def step(self):
+        """One speculative step: every active slot advances by its own
+        1..k+1 committed tokens. Returns {rid: [tokens...]}."""
+        if self.n_active == 0:
+            return {}
+        (self.cache, self.d_cache, self.tok, self.pos, self.keys,
+         self.prev_chunk, self.prev_pos, w, m) = self._spec_step(
+            self.prepared, self.draft_prepared, self.cache, self.d_cache,
+            self.tok, self.pos, self.active, self.keys,
+            self.prev_chunk, self.prev_pos)
+        w_np, m_np = np.asarray(w), np.asarray(m)
+        self.spec_steps += 1
+        out = {}
+        for slot, req in enumerate(self._slot_req):
+            if req is None:
+                continue
+            n_commit = int(m_np[slot]) + 1
+            self.spec_proposed += self.spec_k
+            self.spec_accepted += int(m_np[slot])
+            toks = [int(t) for t in w_np[slot, :n_commit]]
+            emitted = []
+            for t in toks:
+                req["emitted"].append(t)
+                emitted.append(t)
+                self._retire_if_done(slot)
+                if self._slot_req[slot] is None:
+                    break  # budget/stop/eos hit mid-chunk: rest discarded
+            out[req["rid"]] = emitted
+        return out

@@ -1,0 +1,191 @@
+"""Speculative continuous batching (runtime/serving_spec.py).
+
+Parity contract: GREEDY spec-batcher output is token-identical to the
+plain continuous batcher — acceptance only changes how many serial steps
+it took, never the tokens (the solo speculative module's guarantee,
+lifted to per-slot acceptance counts). Sampled mode is seeded-
+deterministic and budget-exact; a draft that IS the target accepts
+everything."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dnn_tpu.models import gpt
+from dnn_tpu.runtime.serving import ContinuousBatcher
+from dnn_tpu.runtime.serving_spec import SpeculativeBatcher
+
+TCFG = gpt.GPTConfig(block_size=128, vocab_size=128, n_layer=3, n_head=4,
+                     n_embd=64)
+DCFG = gpt.GPTConfig(block_size=128, vocab_size=128, n_layer=1, n_head=2,
+                     n_embd=32)
+
+
+def _prep(cfg, seed=0):
+    return gpt.prepare_stacked(gpt.init(jax.random.PRNGKey(seed), cfg), cfg)
+
+
+def _prompt(seed, n=8):
+    return np.asarray(jax.random.randint(
+        jax.random.PRNGKey(seed), (n,), 0, TCFG.vocab_size,
+        dtype=jnp.int32))
+
+
+@pytest.fixture(scope="module")
+def models():
+    return _prep(TCFG), _prep(DCFG, seed=1)
+
+
+def test_greedy_spec_matches_plain_batcher(models):
+    """Mixed-length pool, staggered arrival: every request's greedy
+    tokens equal the plain batcher's."""
+    tprep, dprep = models
+    reqs = [(_prompt(1, 9), 10), (_prompt(2, 17), 7), (_prompt(3, 6), 12)]
+
+    def run(spec):
+        if spec:
+            srv = SpeculativeBatcher(TCFG, tprep, DCFG, dprep, spec_k=3,
+                                     slots=2, max_len=64, prompt_pad=16)
+        else:
+            srv = ContinuousBatcher(TCFG, tprep, slots=2, max_len=64,
+                                    prompt_pad=16)
+        r1 = srv.submit(*reqs[0][:1], max_new_tokens=reqs[0][1])
+        r2 = srv.submit(reqs[1][0], max_new_tokens=reqs[1][1])
+        srv.step()  # staggered: r3 arrives mid-decode once a slot frees
+        while srv.free_slots() == 0:
+            srv.step()
+        r3 = srv.submit(reqs[2][0], max_new_tokens=reqs[2][1])
+        out = srv.drain()
+        return [out[r] for r in (r1, r2, r3)]
+
+    for a, b in zip(run(True), run(False)):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_greedy_spec_matches_plain_bf16(models):
+    """Token identity holds under bf16 compute too: the verify block's
+    attention mirrors attend_rows' op/dtype recipe exactly."""
+    tprep, dprep = models
+
+    def run(spec):
+        kw = dict(slots=1, max_len=64, prompt_pad=16,
+                  compute_dtype=jnp.bfloat16)
+        srv = (SpeculativeBatcher(TCFG, tprep, DCFG, dprep, spec_k=3, **kw)
+               if spec else ContinuousBatcher(TCFG, tprep, **kw))
+        rid = srv.submit(_prompt(15, 9), max_new_tokens=8)
+        return srv.drain()[rid]
+
+    np.testing.assert_array_equal(run(True), run(False))
+
+
+def test_budget_exact_and_reasons(models):
+    tprep, dprep = models
+    srv = SpeculativeBatcher(TCFG, tprep, DCFG, dprep, spec_k=4, slots=2,
+                             max_len=64, prompt_pad=16)
+    rid = srv.submit(_prompt(4, 8), max_new_tokens=6)
+    out = srv.drain()
+    assert len(out[rid]) == 6  # mid-chunk overshoot discarded
+    assert srv.finish_reasons[rid] == "length"
+
+
+def test_stop_sequence_mid_chunk(models):
+    """A stop hit inside a committed chunk retires the slot and trims
+    exactly as the plain batcher does."""
+    tprep, dprep = models
+    plain = ContinuousBatcher(TCFG, tprep, slots=1, max_len=64,
+                              prompt_pad=16)
+    rid0 = plain.submit(_prompt(5, 8), max_new_tokens=8)
+    full = plain.drain()[rid0]
+    stop = full[2:4]
+    first_end = next(i for i in range(1, len(full))
+                     if (full[i - 1:i + 1] == stop).all())
+
+    srv = SpeculativeBatcher(TCFG, tprep, DCFG, dprep, spec_k=4, slots=1,
+                             max_len=64, prompt_pad=16)
+    rid = srv.submit(_prompt(5, 8), max_new_tokens=8, stop=[stop])
+    got = srv.drain()[rid]
+    np.testing.assert_array_equal(got, full[:first_end - 1])
+    assert srv.finish_reasons[rid] == "stop"
+
+
+def test_self_draft_accepts_everything(models):
+    """Draft == target: every proposal matches, acceptance rate is 1 and
+    each step commits k+1 tokens."""
+    tprep, _ = models
+    srv = SpeculativeBatcher(TCFG, tprep, TCFG, tprep, spec_k=3, slots=1,
+                             max_len=64, prompt_pad=16)
+    rid = srv.submit(_prompt(6, 8), max_new_tokens=12)
+    out = srv.drain()
+    assert len(out[rid]) == 12
+    assert srv.spec_accepted == srv.spec_proposed  # all accepted
+    # 11 post-prefill tokens in ceil(11/4) = 3 steps
+    assert srv.spec_steps == 3
+
+
+def test_sampled_seeded_deterministic(models):
+    tprep, dprep = models
+    def run():
+        srv = SpeculativeBatcher(TCFG, tprep, DCFG, dprep, spec_k=3,
+                                 slots=2, max_len=64, prompt_pad=16,
+                                 temperature=0.9, top_k=20)
+        r1 = srv.submit(_prompt(7, 9), max_new_tokens=8, seed=11)
+        r2 = srv.submit(_prompt(8, 7), max_new_tokens=6, seed=12)
+        out = srv.drain()
+        return out[r1], out[r2]
+
+    a1, a2 = run()
+    b1, b2 = run()
+    np.testing.assert_array_equal(a1, b1)
+    np.testing.assert_array_equal(a2, b2)
+    assert len(a1) == 8 and len(a2) == 6
+    assert (a1 >= 0).all() and (a1 < TCFG.vocab_size).all()
+
+
+def test_spec_daemon_matches_dense_daemon(models):
+    """The LM daemon with draft_cfg serves through the SpeculativeBatcher:
+    greedy unary AND streaming results over gRPC equal the dense daemon's
+    (the worker emits each committed token of a multi-token step)."""
+    from dnn_tpu.comm.client import NodeClient
+    from dnn_tpu.runtime.lm_server import start_lm_server_in_background
+
+    tprep, dprep = models
+    prompt = np.asarray(_prompt(20, 10))
+
+    t1, stop1 = start_lm_server_in_background(
+        TCFG, tprep, port=59291, slots=2, max_len=64, prompt_pad=16)
+    t2, stop2 = start_lm_server_in_background(
+        TCFG, tprep, port=59292, slots=2, max_len=64, prompt_pad=16,
+        draft_cfg=DCFG, draft_prepared=dprep, spec_k=3)
+    try:
+        c1, c2 = NodeClient("127.0.0.1:59291"), NodeClient("127.0.0.1:59292")
+        want = c1.generate(prompt, max_new_tokens=8)
+        got = c2.generate(prompt, max_new_tokens=8)
+        np.testing.assert_array_equal(got, want)
+        streamed = list(c2.generate_stream(prompt, max_new_tokens=8))
+        np.testing.assert_array_equal(np.asarray(streamed, np.int32), want)
+        c1.close()
+        c2.close()
+    finally:
+        stop1()
+        stop2()
+
+
+def test_validation(models):
+    tprep, dprep = models
+    with pytest.raises(ValueError, match="vocab"):
+        bad = gpt.GPTConfig(block_size=64, vocab_size=99, n_layer=1,
+                            n_head=2, n_embd=32)
+        SpeculativeBatcher(TCFG, tprep, bad, _prep(bad), slots=1,
+                           max_len=64)
+    with pytest.raises(ValueError, match="int8"):
+        SpeculativeBatcher(TCFG, tprep, DCFG, dprep, slots=1, max_len=64,
+                           kv_dtype="int8")
+    srv = SpeculativeBatcher(TCFG, tprep, DCFG, dprep, spec_k=4, slots=1,
+                             max_len=32, prompt_pad=16)
+    with pytest.raises(ValueError, match="spec_k"):
+        srv.submit(_prompt(9, 3), max_new_tokens=4)   # prompt < k+1
+    with pytest.raises(ValueError, match="exceeds max_len"):
+        srv.submit(_prompt(9, 16), max_new_tokens=16)  # 16+16+4 > 32
+    with pytest.raises(ValueError, match="per-request"):
+        srv.submit(_prompt(9, 8), max_new_tokens=4, temperature=0.5)
