@@ -93,6 +93,13 @@ class SpeculativeBatcher(ContinuousBatcher):
                 "would re-quantize int8 rows differently from the oracle "
                 "path — see runtime/speculative.py)")
         super().__init__(cfg, prepared, **kw)
+        if draft_cfg.block_size < self.max_len:
+            # draft positions run to max_len-1 (submit's budget check);
+            # past its wpe table the position gather would silently clamp
+            # and acceptance would collapse with no error anywhere
+            raise ValueError(
+                f"draft block_size {draft_cfg.block_size} < max_len "
+                f"{self.max_len}; shrink max_len or use a longer draft")
         self.spec_k = int(spec_k)
         if self.spec_k < 1:
             raise ValueError(f"spec_k must be >= 1, got {spec_k}")

@@ -73,6 +73,29 @@ def test_scale_commutation_is_exact():
                                rtol=1e-5, atol=1e-5)
 
 
+def test_int8_attend_rows_causal_matches_dequantized():
+    """The codec protocol is uniform: Int8KV implements the per-row causal
+    verify variant too (unreachable from SpeculativeBatcher, which pins
+    float caches — this direct test is what keeps the method honest). The
+    scale folding must equal FloatKV on the explicitly dequantized cache,
+    exactly, for every row's causal limit."""
+    b, h, s, d, t = 2, 3, 16, 8, 4
+    k = jax.random.normal(jax.random.PRNGKey(1), (b, h, s, d))
+    v = jax.random.normal(jax.random.PRNGKey(2), (b, h, s, d))
+    q = jax.random.normal(jax.random.PRNGKey(3), (b, h, t, d))
+    kq, ks = _quantize_rows(k)
+    vq, vs = _quantize_rows(v)
+    cache = {"k": kq, "v": vq, "ks": ks, "vs": vs}
+    pos = jnp.array([3, 7])  # first causal row per batch entry
+    got = Int8KV().attend_rows_causal(q, cache, pos)
+
+    deq_k = kq.astype(jnp.float32) * ks[..., None]
+    deq_v = vq.astype(jnp.float32) * vs[..., None]
+    want = FloatKV().attend_rows_causal(q, {"k": deq_k, "v": deq_v}, pos)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+
+
 def test_int8_prefill_logits_close():
     _, prepared = _prepared()
     ids = jax.random.randint(jax.random.PRNGKey(4), (2, 16), 0, CFG.vocab_size)
