@@ -1,0 +1,172 @@
+"""LoRA — low-rank adaptation for parameter-efficient fine-tuning.
+
+The reference is inference-only (readme.md:112) and its only notion of
+weights is a monolithic `.pth` loaded per node (node.py:294-317); it has
+no fine-tuning story at all. This module adds the modern one on top of
+this framework's pure-pytree models, TPU-first:
+
+  * Adapters are a SEPARATE small pytree (a flat {path: {"a", "b"}}
+    dict), not a model rewrite — any family (GPT, LLaMA, MoE) and any
+    layout (per-layer `h_i` or stacked `prepare_stacked` / pipeline
+    stage-stacked) is adaptable, because merging is a tree operation:
+    W + (alpha/r) * a @ b, batched over any leading stack axes by
+    jnp.matmul broadcasting.
+  * Training closes over the FROZEN base params and differentiates only
+    the adapter tree — `jax.grad` over a pytree of a few M parameters
+    while the base stays donated/placed wherever it already lives
+    (replicated, tp-sharded, fsdp-sharded: the merge is elementwise in
+    the base, so GSPMD keeps the base's sharding and replicates the tiny
+    adapter math).
+  * Serving merges once (`merge_lora`) and runs the standard decode
+    paths — zero inference-time overhead, the way LoRA is deployed.
+
+b is zero-initialized, so at init the adapted model IS the base model
+(merge == identity); a uses a 1/sqrt(rank)-scaled normal.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Iterable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+# Kernel-bearing key names eligible for adaptation, per family:
+#   GPT  (models/gpt.py):   qkv, proj (attn + mlp), fc
+#   LLaMA (models/llama.py): q, k, v, o, gate, up, down
+# Embeddings / lm_head / norms are excluded by default (standard LoRA
+# practice: adapt the linear maps, freeze everything else).
+DEFAULT_TARGETS = ("qkv", "proj", "fc", "q", "k", "v", "o", "gate", "up",
+                   "down")
+
+
+def _path_keys(path) -> Tuple[str, ...]:
+    return tuple(
+        str(getattr(p, "key", getattr(p, "name", p))) for p in path
+    )
+
+
+def _path_str(path) -> str:
+    return "/".join(_path_keys(path))
+
+
+def _is_target(path, leaf, targets) -> bool:
+    keys = _path_keys(path)
+    # a weight kernel: last-two-dims matmul operand ("kernel" leaf or a
+    # bare 2D+ array under a target name), never a bias/scale vector
+    if leaf.ndim < 2:
+        return False
+    if keys and keys[-1] not in ("kernel",) and keys[-1] not in targets:
+        return False
+    return bool(set(keys) & set(targets))
+
+
+def init_lora(rng, params, *, rank: int, targets: Iterable[str] = DEFAULT_TARGETS,
+              dtype=jnp.float32) -> Dict[str, Dict[str, jax.Array]]:
+    """Build the adapter tree for `params`: {path: {"a": (..., in, r),
+    "b": (..., r, out)}} for every targeted kernel leaf. Leading stack
+    axes (layer stacks from `prepare_stacked`, stage stacks from the
+    pipeline layout) are preserved, so one adapter tree fits whichever
+    layout the base params are in. b = 0 -> merge is the identity at
+    init."""
+    if rank < 1:
+        raise ValueError(f"rank must be >= 1, got {rank}")
+    flat = jax.tree_util.tree_flatten_with_path(params)[0]
+    adapters: Dict[str, Dict[str, jax.Array]] = {}
+    keys = jax.random.split(rng, max(len(flat), 1))
+    for (path, leaf), key in zip(flat, keys):
+        if not _is_target(path, leaf, tuple(targets)):
+            continue
+        *lead, d_in, d_out = leaf.shape
+        a = jax.random.normal(key, (*lead, d_in, rank), dtype) / jnp.sqrt(
+            jnp.asarray(rank, dtype))
+        b = jnp.zeros((*lead, rank, d_out), dtype)
+        adapters[_path_str(path)] = {"a": a, "b": b}
+    if not adapters:
+        raise ValueError(
+            f"no param leaf matched targets {tuple(targets)}; "
+            "check the param tree's key names")
+    return adapters
+
+
+def lora_scaling(adapters, *, alpha: Optional[float] = None) -> float:
+    """alpha/rank, the standard LoRA scale (alpha defaults to rank, i.e.
+    scale 1.0 — rank is read off the adapter shapes)."""
+    if not adapters:
+        raise ValueError("empty adapter dict (nothing was loaded/built)")
+    any_ad = next(iter(adapters.values()))
+    rank = any_ad["a"].shape[-1]
+    return float(alpha if alpha is not None else rank) / float(rank)
+
+
+def merge_lora(params, adapters, *, alpha: Optional[float] = None):
+    """W + (alpha/r) a @ b on every adapted leaf; all other leaves pass
+    through untouched. Pure tree op — jit-safe, grads flow into
+    `adapters` (and not into `params` when the caller differentiates only
+    the adapter argument), and leading stack axes batch via matmul
+    broadcasting."""
+    scale = lora_scaling(adapters, alpha=alpha)
+    consumed = set()
+
+    def merge_leaf(path, w):
+        ad = adapters.get(_path_str(path))
+        if ad is None:
+            return w
+        consumed.add(_path_str(path))
+        delta = jnp.matmul(ad["a"], ad["b"]) * scale
+        return w + delta.astype(w.dtype)
+
+    merged = jax.tree_util.tree_map_with_path(merge_leaf, params)
+    unused = set(adapters) - consumed
+    if unused:
+        # a layout/key mismatch (per-layer adapters onto stacked params,
+        # or a foreign model's artifact) must not become a silent
+        # identity merge that serves the un-tuned model
+        raise ValueError(
+            f"{len(unused)} adapter entries matched no param leaf "
+            f"(layout mismatch?): {sorted(unused)[:3]}...")
+    return merged
+
+
+def make_lora_loss(loss_fn: Callable, base_params, *,
+                   alpha: Optional[float] = None) -> Callable:
+    """(adapters, batch) -> scalar, with `base_params` frozen in the
+    closure. Feed to train.make_train_step / make_sharded_train_step —
+    the optimizer then sees ONLY the adapter tree (its state is
+    adapter-sized, the parameter-efficiency half of LoRA's pitch)."""
+
+    def lora_loss(adapters, batch):
+        return loss_fn(merge_lora(base_params, adapters, alpha=alpha), batch)
+
+    return lora_loss
+
+
+def save_lora(path: str, adapters) -> None:
+    """Adapters -> one npz (keys '<leaf path>:a' / ':b'). The artifact is
+    the only thing a fine-tune ships — base weights stay wherever the
+    base checkpoint lives."""
+    import numpy as np
+
+    from dnn_tpu.io.checkpoint import save_npz
+
+    flat = {}
+    for k, ab in adapters.items():
+        flat[f"{k}:a"] = np.asarray(ab["a"])
+        flat[f"{k}:b"] = np.asarray(ab["b"])
+    save_npz(path, flat)
+
+
+def load_lora(path: str) -> Dict[str, Dict[str, Any]]:
+    from dnn_tpu.io.checkpoint import load_npz
+
+    flat = load_npz(path)
+    out: Dict[str, Dict[str, Any]] = {}
+    for k, v in flat.items():
+        leaf_path, _, which = k.rpartition(":")
+        if which not in ("a", "b"):
+            raise ValueError(f"malformed LoRA npz key: {k}")
+        out.setdefault(leaf_path, {})[which] = jnp.asarray(v)
+    for k, ab in out.items():
+        if set(ab) != {"a", "b"}:
+            raise ValueError(f"LoRA npz missing half of {k}: has {set(ab)}")
+    return out

@@ -190,8 +190,8 @@ def _spec_with_data_axis(spec, leaf, n_data: int, data_axis: str):
         if entries[ax] is None and leaf.shape[ax] % n_data == 0 \
                 and leaf.shape[ax] >= n_data:
             entries[ax] = data_axis
-            break
-    return P(*entries)
+            return P(*entries)
+    return spec if spec is not None else P()  # unchanged, as documented
 
 
 def zero1_opt_state_specs(opt_state, params, param_specs, mesh: Mesh,
