@@ -52,13 +52,15 @@ def _path_str(path) -> str:
 
 def _is_target(path, leaf, targets) -> bool:
     keys = _path_keys(path)
-    # a weight kernel: last-two-dims matmul operand ("kernel" leaf or a
-    # bare 2D+ array under a target name), never a bias/scale vector
+    # a weight: last-two-dims matmul/gather operand, never a bias/scale
+    # vector; "kernel"/"embedding" leaves under a targeted name count, as
+    # does a bare 2D+ array whose own key is the target (so explicitly
+    # requesting e.g. "wte" adapts the embedding table)
     if leaf.ndim < 2:
         return False
-    if keys and keys[-1] not in ("kernel",) and keys[-1] not in targets:
+    if not (set(keys) & set(targets)):
         return False
-    return bool(set(keys) & set(targets))
+    return keys[-1] in ("kernel", "embedding") or keys[-1] in targets
 
 
 def init_lora(rng, params, *, rank: int, targets: Iterable[str] = DEFAULT_TARGETS,
@@ -141,10 +143,12 @@ def make_lora_loss(loss_fn: Callable, base_params, *,
     return lora_loss
 
 
-def save_lora(path: str, adapters) -> None:
-    """Adapters -> one npz (keys '<leaf path>:a' / ':b'). The artifact is
-    the only thing a fine-tune ships — base weights stay wherever the
-    base checkpoint lives."""
+def save_lora(path: str, adapters, *, alpha: Optional[float] = None) -> None:
+    """Adapters -> one npz (keys '<leaf path>:a' / ':b'; '__alpha__' when
+    a non-default alpha was trained with — the merge scale is part of the
+    artifact, or a loader would silently apply the adapters at the wrong
+    strength). The artifact is the only thing a fine-tune ships — base
+    weights stay wherever the base checkpoint lives."""
     import numpy as np
 
     from dnn_tpu.io.checkpoint import save_npz
@@ -153,13 +157,21 @@ def save_lora(path: str, adapters) -> None:
     for k, ab in adapters.items():
         flat[f"{k}:a"] = np.asarray(ab["a"])
         flat[f"{k}:b"] = np.asarray(ab["b"])
+    if alpha is not None:
+        flat["__alpha__"] = np.asarray(float(alpha), np.float32)
     save_npz(path, flat)
 
 
-def load_lora(path: str) -> Dict[str, Dict[str, Any]]:
+def load_lora(path: str) -> Tuple[Dict[str, Dict[str, Any]], Optional[float]]:
+    """npz -> (adapters, alpha). `alpha` is None when the artifact was
+    saved without one (trained at the default alpha=rank); pass it
+    through: `merge_lora(params, adapters, alpha=alpha)`."""
     from dnn_tpu.io.checkpoint import load_npz
 
     flat = load_npz(path)
+    alpha = None
+    if "__alpha__" in flat:
+        alpha = float(flat.pop("__alpha__"))
     out: Dict[str, Dict[str, Any]] = {}
     for k, v in flat.items():
         leaf_path, _, which = k.rpartition(":")
@@ -169,4 +181,4 @@ def load_lora(path: str) -> Dict[str, Dict[str, Any]]:
     for k, ab in out.items():
         if set(ab) != {"a", "b"}:
             raise ValueError(f"LoRA npz missing half of {k}: has {set(ab)}")
-    return out
+    return out, alpha

@@ -1,0 +1,149 @@
+"""Beam-search decoding over the KV cache.
+
+The reference has no decoding at all (its GPT partitions emit one
+stateless forward's logits, /root/reference/partitions/gpt_model_parts.py:36-50);
+this framework's sampling surfaces (greedy/temperature/top-k/top-p,
+runtime/generate.py) cover the stochastic side. Beam search is the
+deterministic search-side complement — the standard method when the goal
+is the highest-likelihood sequence rather than a sample.
+
+TPU-first shape of the implementation:
+  * beams are BATCH ROWS: the (B, K) beam grid runs as B*K cache rows
+    through the same `forward_with_cache` program the samplers use — the
+    MXU sees one (B*K, 1) decode matmul per step, not K small ones;
+  * one `lax.scan` drives all steps; every shape is static (beam
+    reordering is a gather on the batch axis, token history is a
+    preallocated (B, K, T) buffer updated in place);
+  * hypothesis scoring is f32 log-softmax; finished beams (optional
+    `eos_id`) are frozen by masking their continuation row to
+    "EOS carries 0 logprob, everything else -inf" — scores stay exact
+    with no dynamic beam retirement;
+  * final selection applies the GNMT length penalty
+    ((5 + len) / 6) ** alpha (alpha = 0 disables it).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from dnn_tpu.models.gpt import GPTConfig
+from dnn_tpu.runtime.generate import forward_with_cache, init_cache
+
+_NEG_BIG = -1e30
+
+
+def _length_penalty(lengths, alpha: float):
+    if alpha == 0.0:
+        return jnp.ones_like(lengths, jnp.float32)
+    return ((5.0 + lengths.astype(jnp.float32)) / 6.0) ** alpha
+
+
+def make_beam_generate(cfg: GPTConfig, *, max_new_tokens: int, beam_size: int,
+                       eos_id: Optional[int] = None,
+                       length_penalty: float = 0.0,
+                       compute_dtype=None, kv_dtype=None,
+                       return_all: bool = False):
+    """Build a jitted beam_generate(prepared, ids) for the GPT family.
+
+    Returns the best hypothesis per batch row, (B, max_new_tokens) int32
+    (positions after an EOS are filled with `eos_id`), or with
+    `return_all=True` the full grid ((B, K, max_new_tokens) tokens,
+    (B, K) length-penalized scores) sorted best-first. Deterministic —
+    no rng argument. `beam_size=1` reproduces greedy `make_generate`
+    token-for-token (same argmax over the same logits)."""
+    if max_new_tokens < 1:
+        raise ValueError(f"max_new_tokens must be >= 1, got {max_new_tokens}")
+    if beam_size < 1:
+        raise ValueError(f"beam_size must be >= 1, got {beam_size}")
+    k = beam_size
+
+    @functools.partial(jax.jit, static_argnames=())
+    def beam_generate(prepared, ids):
+        b, t = ids.shape
+        s_max = t + max_new_tokens
+        if s_max > cfg.block_size:
+            raise ValueError(
+                f"prompt {t} + max_new_tokens {max_new_tokens} exceeds "
+                f"block_size {cfg.block_size}")
+        v = cfg.vocab_size
+        cache_dtype = kv_dtype if kv_dtype is not None else (
+            compute_dtype or jnp.float32)
+
+        # prefill once per batch row, then tile the written cache K ways —
+        # beams share the prompt's K/V, so prompt compute is paid once,
+        # not beam_size times
+        cache = init_cache(cfg, b, s_max, cache_dtype)
+        logits, cache = forward_with_cache(
+            prepared, ids, cache, 0, cfg=cfg, compute_dtype=compute_dtype)
+        cache = jax.tree.map(lambda c: jnp.repeat(c, k, axis=1), cache)
+        logp0 = jax.nn.log_softmax(
+            logits[:, -1].astype(jnp.float32), axis=-1)  # (B, V)
+
+        # first expansion: top-k over the vocab seeds the beams
+        scores, tok = lax.top_k(logp0, k)  # (B, K), (B, K)
+        tok = tok.astype(jnp.int32)
+        if eos_id is not None:
+            finished = tok == eos_id
+        else:
+            finished = jnp.zeros((b, k), bool)
+        lengths = jnp.ones((b, k), jnp.int32)
+        hist = jnp.zeros((b, k, max_new_tokens), jnp.int32)
+        hist = hist.at[:, :, 0].set(tok)
+
+        def step(carry, i):
+            cache, scores, tok, hist, finished, lengths = carry
+            logits, cache = forward_with_cache(
+                prepared, tok.reshape(b * k, 1), cache, t + i, cfg=cfg,
+                compute_dtype=compute_dtype)
+            logp = jax.nn.log_softmax(
+                logits[:, -1].astype(jnp.float32), axis=-1).reshape(b, k, v)
+            if eos_id is not None:
+                # frozen beams: only the EOS continuation, at zero cost —
+                # their total score is exact and never re-penalized
+                frozen = jnp.full((v,), _NEG_BIG).at[eos_id].set(0.0)
+                logp = jnp.where(finished[:, :, None], frozen[None, None, :],
+                                 logp)
+            total = scores[:, :, None] + logp  # (B, K, V)
+            scores, flat_idx = lax.top_k(total.reshape(b, k * v), k)
+            parent = (flat_idx // v).astype(jnp.int32)   # (B, K)
+            tok = (flat_idx % v).astype(jnp.int32)
+
+            # reorder everything beam-indexed by its parent
+            rows = (jnp.arange(b)[:, None] * k + parent).reshape(-1)
+            cache = jax.tree.map(lambda c: jnp.take(c, rows, axis=1), cache)
+            gather = lambda x: jnp.take_along_axis(  # noqa: E731
+                x, parent if x.ndim == 2 else parent[:, :, None], axis=1)
+            hist = jnp.take_along_axis(
+                hist, parent[:, :, None], axis=1)
+            finished = gather(finished)
+            lengths = gather(lengths)
+
+            if eos_id is not None:
+                lengths = jnp.where(finished, lengths, lengths + 1)
+                finished = finished | (tok == eos_id)
+            else:
+                lengths = lengths + 1
+            hist = hist.at[:, :, i + 1].set(tok)
+            return (cache, scores, tok, hist, finished, lengths), None
+
+        if max_new_tokens > 1:
+            (cache, scores, tok, hist, finished, lengths), _ = lax.scan(
+                step, (cache, scores, tok, hist, finished, lengths),
+                jnp.arange(max_new_tokens - 1))
+
+        # positions past a beam's EOS already hold eos_id (the frozen
+        # expansion can only emit it), so no post-hoc padding is needed
+        final = scores / _length_penalty(lengths, length_penalty)
+        order = jnp.argsort(-final, axis=1)  # best-first
+        hist = jnp.take_along_axis(hist, order[:, :, None], axis=1)
+        final = jnp.take_along_axis(final, order, axis=1)
+        if return_all:
+            return hist, final
+        return hist[:, 0]
+
+    return beam_generate

@@ -63,17 +63,58 @@ def next_token_loss(apply_fn: Callable, params, tokens, *, ignore_index=None):
 # generic step
 # --------------------------------------------------------------------------
 
-def make_train_step(loss_fn: Callable, optimizer: optax.GradientTransformation):
+def make_train_step(loss_fn: Callable, optimizer: optax.GradientTransformation,
+                    *, accum_steps: int = 1):
     """(params, opt_state, batch) -> (params, opt_state, loss). `loss_fn`
     is (params, batch) -> scalar. Jit-compiled; shardings of the inputs
-    propagate (pass pre-sharded params for dp/tp/pp)."""
+    propagate (pass pre-sharded params for dp/tp/pp).
+
+    `accum_steps > 1` runs gradient accumulation: the batch's leading axis
+    splits into `accum_steps` microbatches, a `lax.scan` accumulates
+    grads (one resident grad buffer + one microbatch's activations at a
+    time — the single-device analog of the pipeline schedules'
+    microbatching), and the optimizer applies their mean. Exact vs the
+    full-batch step when the loss is a uniform mean over examples
+    (cross_entropy without ignore_index); with masked losses the
+    mean-of-means weights microbatches equally, the usual accumulation
+    semantics."""
+    if accum_steps < 1:
+        raise ValueError(f"accum_steps must be >= 1, got {accum_steps}")
+
+    if accum_steps == 1:
+        @jax.jit
+        def step(params, opt_state, batch):
+            loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+            updates, opt_state = optimizer.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, loss
+
+        return step
 
     @jax.jit
     def step(params, opt_state, batch):
-        loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+        def split(x):
+            n = x.shape[0]
+            if n % accum_steps:
+                raise ValueError(
+                    f"batch leading dim {n} not divisible by "
+                    f"accum_steps {accum_steps}")
+            return x.reshape(accum_steps, n // accum_steps, *x.shape[1:])
+
+        micro = jax.tree.map(split, batch)
+
+        def body(carry, mb):
+            loss_sum, grads = carry
+            l, g = jax.value_and_grad(loss_fn)(params, mb)
+            return (loss_sum + l, jax.tree.map(jnp.add, grads, g)), None
+
+        zeros = jax.tree.map(jnp.zeros_like, params)
+        (loss_sum, grads), _ = jax.lax.scan(body, (0.0, zeros), micro)
+        scale = 1.0 / accum_steps
+        grads = jax.tree.map(lambda g: g * scale, grads)
         updates, opt_state = optimizer.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
-        return params, opt_state, loss
+        return params, opt_state, loss_sum * scale
 
     return step
 

@@ -1,0 +1,61 @@
+"""Gradient accumulation: microbatched steps == the full-batch step.
+
+With a uniform-mean loss (cross_entropy, no ignore_index) and equal-size
+microbatches, mean-of-microbatch-grads IS the full-batch grad, so the
+accumulated step must match the plain step to fp tolerance — params,
+opt state, and loss alike.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from dnn_tpu import train
+from dnn_tpu.models import gpt
+
+CFG = gpt.GPTConfig(block_size=32, vocab_size=128, n_layer=2, n_head=4,
+                    n_embd=32)
+
+
+@pytest.fixture(scope="module")
+def setup():
+    params = gpt.init(jax.random.PRNGKey(0), CFG)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (8, 17), 0,
+                                CFG.vocab_size, dtype=jnp.int32)
+    apply_fn = gpt.make_apply(CFG)
+
+    def loss_fn(p, batch):
+        return train.next_token_loss(apply_fn, p, batch)
+
+    return params, tokens, loss_fn
+
+
+@pytest.mark.parametrize("accum", [2, 4])
+def test_accum_matches_full_batch(setup, accum):
+    params, tokens, loss_fn = setup
+    opt = optax.adamw(1e-3)
+    full = train.make_train_step(loss_fn, opt)
+    acc = train.make_train_step(loss_fn, opt, accum_steps=accum)
+
+    p1, s1, l1 = full(params, opt.init(params), tokens)
+    p2, s2, l2 = acc(params, opt.init(params), tokens)
+    np.testing.assert_allclose(float(l2), float(l1), rtol=1e-5)
+    for a, b in zip(jax.tree.leaves(p1), jax.tree.leaves(p2)):
+        np.testing.assert_allclose(np.asarray(b), np.asarray(a),
+                                   rtol=1e-4, atol=1e-6)
+
+
+def test_indivisible_batch_raises(setup):
+    params, tokens, loss_fn = setup
+    opt = optax.sgd(1e-2)
+    step = train.make_train_step(loss_fn, opt, accum_steps=3)
+    with pytest.raises(ValueError, match="not divisible"):
+        step(params, opt.init(params), tokens)  # 8 % 3 != 0
+
+
+def test_rejects_bad_accum(setup):
+    _, _, loss_fn = setup
+    with pytest.raises(ValueError, match="accum_steps"):
+        train.make_train_step(loss_fn, optax.sgd(1e-2), accum_steps=0)

@@ -141,13 +141,40 @@ def test_save_load_roundtrip(tmp_path, base):
     ad = jax.tree.map(lambda x: x + 0.5, ad)  # non-trivial b
     path = os.path.join(tmp_path, "adapter.npz")
     lora.save_lora(path, ad)
-    back = lora.load_lora(path)
+    back, alpha = lora.load_lora(path)
+    assert alpha is None  # default-alpha artifact carries no override
     assert set(back) == set(ad)
     for k in ad:
         np.testing.assert_array_equal(np.asarray(back[k]["a"]),
                                       np.asarray(ad[k]["a"]))
         np.testing.assert_array_equal(np.asarray(back[k]["b"]),
                                       np.asarray(ad[k]["b"]))
+
+
+def test_alpha_survives_roundtrip(tmp_path, base):
+    """An adapter trained at non-default alpha must merge at the SAME
+    strength after save/load — the scale is part of the artifact."""
+    params, tokens = base
+    ad = lora.init_lora(jax.random.PRNGKey(2), params, rank=4)
+    ad = jax.tree.map(lambda x: x + 0.1, ad)
+    path = os.path.join(tmp_path, "adapter.npz")
+    lora.save_lora(path, ad, alpha=16)
+    back, alpha = lora.load_lora(path)
+    assert alpha == 16.0
+    want = gpt.make_apply(CFG)(lora.merge_lora(params, ad, alpha=16), tokens)
+    got = gpt.make_apply(CFG)(lora.merge_lora(params, back, alpha=alpha),
+                              tokens)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_explicit_embedding_target(base):
+    """Explicitly targeting 'wte' adapts the embedding table (default
+    targets exclude it)."""
+    params, _ = base
+    ad = lora.init_lora(jax.random.PRNGKey(2), params, rank=4,
+                        targets=("wte", "qkv"))
+    assert "wte/embedding" in ad
+    assert sum(1 for k in ad if "qkv" in k) == CFG.n_layer
 
 
 def test_layout_mismatch_raises(base):
