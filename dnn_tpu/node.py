@@ -68,6 +68,19 @@ def build_parser() -> argparse.ArgumentParser:
                         "--serve_lm")
     p.add_argument("--seed", type=int, default=0,
                    help="Sampling rng seed for --generate")
+    p.add_argument("--beam", type=int, default=None, metavar="K",
+                   help="--generate: deterministic beam search with K beams "
+                        "instead of sampling (dense GPT family; "
+                        "runtime/beam.py)")
+    p.add_argument("--eos_id", type=int, default=None,
+                   help="--beam: end-of-sequence token id (finished beams "
+                        "freeze; output pads with it)")
+    p.add_argument("--length_penalty", type=float, default=0.0,
+                   help="--beam: GNMT length-penalty alpha (0 = off)")
+    p.add_argument("--lora", default=None, metavar="NPZ",
+                   help="LoRA adapter artifact (dnn_tpu.lora.save_lora) "
+                        "merged into the model weights at load — every "
+                        "mode then serves the fine-tuned model")
     p.add_argument("--serve", action="store_true",
                    help="Host this node's stage behind gRPC (reference-interop mode)")
     p.add_argument("--serve_lm", action="store_true",
@@ -217,7 +230,8 @@ def main(argv=None) -> int:
     # engine in stage role so an 8-part config serves fine from a 1-device
     # host; full role only when this process drives the whole pipeline.
     try:
-        engine = PipelineEngine(config, role="stage" if args.serve else "full")
+        engine = PipelineEngine(config, role="stage" if args.serve else "full",
+                                lora_path=args.lora)
     except Exception as e:  # noqa: BLE001 — CLI boundary: checkpoint loads
         # raise FileNotFoundError/unpickling errors etc.; exit with a clean
         # one-liner like the reference does for every config problem
@@ -432,14 +446,25 @@ def _generate_local(engine: PipelineEngine, args) -> int:
     else:
         ids = [0]
     try:
-        toks = engine.generate(
-            np.asarray([ids], np.int32),
-            max_new_tokens=args.generate,
-            temperature=args.temperature,
-            top_k=args.top_k,
-            top_p=args.top_p,
-            rng=jax.random.PRNGKey(args.seed),
-        )
+        if args.beam is not None:
+            # any explicit --beam takes the deterministic path (beam 1 ==
+            # greedy; invalid K surfaces beam.py's own validation)
+            toks = engine.generate_beam(
+                np.asarray([ids], np.int32),
+                max_new_tokens=args.generate,
+                beam_size=args.beam,
+                eos_id=args.eos_id,
+                length_penalty=args.length_penalty,
+            )
+        else:
+            toks = engine.generate(
+                np.asarray([ids], np.int32),
+                max_new_tokens=args.generate,
+                temperature=args.temperature,
+                top_k=args.top_k,
+                top_p=args.top_p,
+                rng=jax.random.PRNGKey(args.seed),
+            )
     except (ValueError, RuntimeError) as e:
         log.error("generation failed: %s", e)
         return 1

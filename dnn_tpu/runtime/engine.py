@@ -82,6 +82,7 @@ class PipelineEngine:
         devices=None,
         rng_seed: int = 0,
         role: str = "full",
+        lora_path: Optional[str] = None,
     ):
         if role not in ("full", "stage"):
             raise ValueError(f"role must be full|stage, got {role}")
@@ -113,6 +114,18 @@ class PipelineEngine:
             self.stages = list(self.spec.partition(config.num_parts))
 
         self.params = params if params is not None else self._load_params(rng_seed)
+        if lora_path:
+            # merge-once LoRA deployment: base checkpoint + adapter npz ->
+            # adapted weights, then every runtime below (stage slices,
+            # stacked decode, gRPC edge) serves the tuned model at zero
+            # inference-time overhead (dnn_tpu/lora.py)
+            from dnn_tpu import lora as _lora
+
+            adapters, alpha = _lora.load_lora(lora_path)
+            self.params = _lora.merge_lora(self.params, adapters, alpha=alpha)
+            log.info("merged LoRA adapters from %s (%d sites%s)",
+                     lora_path, len(adapters),
+                     f", alpha={alpha}" if alpha is not None else "")
         self.devices = list(devices) if devices is not None else _pick_devices(config.device_type)
 
         # compiled-once per-stage programs (the unit the gRPC edge serves)
@@ -415,24 +428,18 @@ class PipelineEngine:
         stateless forward's logits, gpt_model_parts.py:36-50, and cannot
         decode). Other runtimes fall back to the single-program KV-cache
         decoder; both are token-for-token identical."""
-        from dnn_tpu.models.gpt import GPTConfig, prepare_stacked
+        from dnn_tpu.models.gpt import GPTConfig
         from dnn_tpu.models.gpt_moe import GPTMoEConfig
         from dnn_tpu.runtime.generate import make_generate, make_pipeline_generate
 
         cfg = self.spec.config
-        if self.role == "stage":
-            raise RuntimeError(
-                "generation needs the full pipeline; this engine was built "
-                "with role='stage' (serves one part)"
-            )
+        self._require_full_role()
         default_rng = jax.random.PRNGKey(0)
 
         def single_program(gen):
             """Shared tail for every single-program family decoder: cache
             the prepared layout once, default the rng."""
-            if not hasattr(self, "_prepared_single"):
-                self._prepared_single = prepare_stacked(self.params, cfg)
-            prepared = self._prepared_single
+            prepared = self._prepared()
             return lambda ids, rng=None: gen(
                 prepared, ids, default_rng if rng is None else rng
             )
@@ -479,6 +486,28 @@ class PipelineEngine:
             top_k=top_k, top_p=top_p, compute_dtype=self.compute_dtype,
         ))
 
+    def _require_full_role(self):
+        if self.role == "stage":
+            raise RuntimeError(
+                "generation needs the full pipeline; this engine was built "
+                "with role='stage' (serves one part)"
+            )
+
+    def _prepared(self):
+        """The stacked decode layout, built once per engine."""
+        if not hasattr(self, "_prepared_single"):
+            from dnn_tpu.models.gpt import prepare_stacked
+
+            self._prepared_single = prepare_stacked(self.params,
+                                                    self.spec.config)
+        return self._prepared_single
+
+    def _gen_cache(self) -> dict:
+        cache = getattr(self, "_generators", None)
+        if cache is None:
+            cache = self._generators = {}
+        return cache
+
     def generate(self, ids, *, max_new_tokens: int, temperature: float = 0.0,
                  top_k: Optional[int] = None, top_p: Optional[float] = None,
                  rng=None) -> jax.Array:
@@ -486,15 +515,40 @@ class PipelineEngine:
         (max_new_tokens, temperature, top_k) so repeated serving calls reuse
         the jitted program."""
         key = (max_new_tokens, temperature, top_k, top_p)
-        cache = getattr(self, "_generators", None)
-        if cache is None:
-            cache = self._generators = {}
+        cache = self._gen_cache()
         if key not in cache:
             cache[key] = self.make_generator(
                 max_new_tokens=max_new_tokens, temperature=temperature,
                 top_k=top_k, top_p=top_p,
             )
         return cache[key](jnp.asarray(ids, jnp.int32), rng)
+
+    def generate_beam(self, ids, *, max_new_tokens: int, beam_size: int,
+                      eos_id: Optional[int] = None,
+                      length_penalty: float = 0.0) -> jax.Array:
+        """Deterministic beam-search decode on this engine's weights
+        (runtime/beam.py; dense GPT family only — the beams run as batch
+        rows through the single-program KV-cache decoder). Compiled
+        programs cache per parameter tuple like `generate`."""
+        from dnn_tpu.models.gpt import GPTConfig
+        from dnn_tpu.runtime.beam import make_beam_generate
+
+        cfg = self.spec.config
+        self._require_full_role()
+        if type(cfg) is not GPTConfig:
+            raise ValueError(
+                f"beam search requires a dense GPT-family model; "
+                f"'{self.config.model}' has config {type(cfg).__name__}")
+        key = ("beam", max_new_tokens, beam_size, eos_id, length_penalty)
+        cache = self._gen_cache()
+        if key not in cache:
+            prepared = self._prepared()
+            gen = make_beam_generate(
+                cfg, max_new_tokens=max_new_tokens, beam_size=beam_size,
+                eos_id=eos_id, length_penalty=length_penalty,
+                compute_dtype=self.compute_dtype)
+            cache[key] = lambda i: gen(prepared, i)
+        return cache[key](jnp.asarray(ids, jnp.int32))
 
     # ------------------------------------------------------------------
     # observability (SURVEY §5: the reference has none — prints only)
