@@ -258,6 +258,7 @@ def run_device_section():
     head_dim = cfg.n_embd  # per layer: H * D = C
     cache_elems = 2 * cfg.n_layer * b * head_dim * s_max  # K and V
     q_prepared = quantize_gpt(prepared)
+    q4_prepared = quantize_gpt(prepared, bits=4)  # group-wise int4
     bf16_prepared = _to_bf16(prepared)
     variants = (
         # kv dtype must be EXPLICIT f32 for the baseline: with kv=None,
@@ -267,6 +268,13 @@ def run_device_section():
         ("w_bf16_kv_bf16", bf16_prepared, jnp.bfloat16, 2),
         ("w_int8_kv_bf16", q_prepared, jnp.bfloat16, 2),
         ("w_int8_kv_int8", q_prepared, "int8", 1),
+        # int4 weights (dnn_tpu/quant.py quantize_tensor_int4): halves
+        # the weight-byte term again IF the S4 operand read really packs
+        # two-per-byte on this chip — this row is the measurement that
+        # decides (param_bytes charges 0.5 B/wt; a tok/s that does not
+        # beat int8 falsifies the packing assumption, which the docs
+        # state as a claim-to-measure, not a fact)
+        ("w_int4_kv_int8", q4_prepared, "int8", 1),
     )
     for name, weights, kv, cache_itemsize in variants:
         gfn = gen.make_generate(
@@ -586,22 +594,81 @@ def run_cpu_mesh_section():
 # ----------------------------------------------------------------------
 
 def _run_subprocess(section, extra_env):
+    """Run one section, STREAMING its row lines so a mid-run death keeps
+    every completed measurement. Two hard-won lessons encoded here:
+      * 1800 s proved too tight once the device section grew the decode
+        matrix + train/serving rows and anything competed for the single
+        host core during compilation — the timeout is now 3600 s and
+        env-overridable (DNN_BENCH_SECTION_TIMEOUT);
+      * a timeout used to discard the whole section's stdout AND the
+        parent's kill of a child mid-device-op can wedge the TPU tunnel
+        for a long time afterward (jax.devices() hanging past 300 s) —
+        so rows are captured as they are emitted (_emit flushes one JSON
+        line per row), and on timeout the completed rows are returned
+        with an explicit truncation marker instead of being thrown away."""
+    import threading
+
     env = dict(os.environ, **extra_env)
-    # 1800 s proved too tight once the device section grew the decode
-    # matrix + train/serving rows AND anything else competes for host
-    # CPUs during compilation (a concurrent pytest run cost this exact
-    # timeout once); overridable for constrained sessions
-    proc = subprocess.run(
-        [sys.executable, os.path.abspath(__file__), "--section", section],
-        capture_output=True, text=True, env=env, cwd=REPO,
-        timeout=int(os.environ.get("DNN_BENCH_SECTION_TIMEOUT", "3600")),
+    proc = subprocess.Popen(
+        [sys.executable, "-u", os.path.abspath(__file__),
+         "--section", section],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        env=env, cwd=REPO,
     )
+    out_lines, err_chunks = [], []
+
+    def _drain(stream, sink):
+        for line in stream:
+            sink.append(line)
+
+    threads = [
+        threading.Thread(target=_drain, args=(proc.stdout, out_lines),
+                         daemon=True),
+        threading.Thread(target=_drain, args=(proc.stderr, err_chunks),
+                         daemon=True),
+    ]
+    for t in threads:
+        t.start()
+    timeout = int(os.environ.get("DNN_BENCH_SECTION_TIMEOUT", "3600"))
+    timed_out = False
+    try:
+        proc.wait(timeout=timeout)
+    except subprocess.TimeoutExpired:
+        timed_out = True
+        proc.kill()  # best-effort; D-state children cannot be reaped —
+        # the daemon reader threads are abandoned rather than joined hard
+    for t in threads:
+        t.join(timeout=30)
+    rows = []
+    for l in out_lines:
+        if not l.startswith("{"):
+            continue
+        try:
+            rows.append(json.loads(l))
+        except json.JSONDecodeError:
+            pass  # SIGKILL mid-write truncates the final line; skip it
+    if timed_out:
+        if not rows:
+            raise RuntimeError(
+                f"section {section} timed out after {timeout}s with no "
+                f"completed rows")
+        print(f"[run_all] section {section} timed out after {timeout}s; "
+              f"keeping {len(rows)} completed rows. Child stderr tail "
+              f"(where it hung):\n" + "".join(err_chunks[-30:]),
+              file=sys.stderr)
+        rows.append({
+            "config": f"{section}_section", "metric": "truncated",
+            "value": True, "platform": "meta",
+            "note": (f"section killed at {timeout}s mid-run; the rows "
+                     "above are complete measurements, later configs are "
+                     "missing"),
+        })
+        return rows
     if proc.returncode != 0:
-        print(proc.stdout)
-        print(proc.stderr, file=sys.stderr)
+        print("".join(out_lines))
+        print("".join(err_chunks), file=sys.stderr)
         raise RuntimeError(f"section {section} failed")
-    return [json.loads(l) for l in proc.stdout.splitlines()
-            if l.startswith("{")]
+    return rows
 
 
 def _provenance():

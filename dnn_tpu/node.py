@@ -253,6 +253,13 @@ def main(argv=None) -> int:
                 jax.default_backend(),
             )
 
+    if args.generate is None and (args.beam is not None
+                                  or args.eos_id is not None
+                                  or args.length_penalty != 0.0):
+        log.error("--beam/--eos_id/--length_penalty only apply to "
+                  "--generate; pass --generate N")
+        return 1
+
     if args.serve_lm:
         return _serve_lm(engine, args)
 

@@ -83,6 +83,9 @@ def linear(params, x, *, compute_dtype=None, accum_dtype=None):
     Reference: torch nn.Linear (/root/reference/cifar_model_parts.py:12-13).
     """
     if "q" in params:
+        if params["q"].dtype == jnp.int4:
+            return _linear_int4(params, x, compute_dtype=compute_dtype,
+                                accum_dtype=accum_dtype)
         return _linear_int8(params, x, compute_dtype=compute_dtype,
                             accum_dtype=accum_dtype)
     kernel = params["kernel"]
@@ -128,6 +131,37 @@ def _linear_int8(params, x, *, compute_dtype=None, accum_dtype=None):
     )
     # scale is (..., 1, out); drop the kept contraction axis for broadcast
     out = out * params["scale"][..., 0, :].astype(acc)
+    bias = params.get("bias")
+    if bias is not None:
+        out = out + bias.astype(out.dtype)
+    if accum_dtype is None and compute_dtype is not None:
+        out = out.astype(orig_dtype)
+    return out
+
+
+def _linear_int4(params, x, *, compute_dtype=None, accum_dtype=None):
+    """Weight-only GROUP-WISE int4 dense layer (dnn_tpu/quant.py
+    quantize_tensor_int4): q (in, out) native jnp.int4, scale
+    (in/group, out) f32. Group scales do not commute with the full
+    contraction, so the dot runs batched per group —
+    out = sum_G (x_G @ q_G) * scale_G — which XLA lowers to one batched
+    MXU matmul plus an epilogue multiply-and-reduce on the (small)
+    per-group outputs; the s4->compute convert fuses into the operand
+    read, so kernel HBM traffic is 0.5 bytes/weight. Stacked (L, ...)
+    trees arrive here already layer-sliced by the blocks scan, exactly
+    like the int8 path."""
+    q, scale = params["q"], params["scale"]
+    orig_dtype = x.dtype
+    cd = compute_dtype if compute_dtype is not None else x.dtype
+    acc = accum_dtype if accum_dtype is not None else cd
+    in_dim, out_dim = q.shape[-2], q.shape[-1]
+    g_count = scale.shape[-2]
+    gsz = in_dim // g_count
+    qg = q.reshape(g_count, gsz, out_dim)
+    xg = x.reshape(*x.shape[:-1], g_count, gsz)
+    out = jnp.einsum("...gi,gio->...go", xg.astype(cd), qg.astype(cd),
+                     preferred_element_type=acc)
+    out = (out * scale.astype(acc)).sum(axis=-2)
     bias = params.get("bias")
     if bias is not None:
         out = out + bias.astype(out.dtype)

@@ -313,3 +313,21 @@ def test_cli_lora_merge(tmp_path, capsys):
     tuned_path = str(tmp_path / "tuned.npz")
     lora.save_lora(tuned_path, tuned)
     assert run(tuned_path) != base  # adapters actually change the model
+
+
+def test_cli_beam_requires_generate(tmp_path):
+    """Beam-only flags without --generate must error, not be dropped."""
+    from dnn_tpu.node import main
+
+    cfg = {
+        "nodes": [{"id": "n0", "part_index": 0}],
+        "num_parts": 1,
+        "model": "gpt2-test",
+        "device_type": "cpu",
+    }
+    cfg_path = tmp_path / "config.json"
+    cfg_path.write_text(json.dumps(cfg))
+    assert main(["--node_id", "n0", "--config", str(cfg_path),
+                 "--beam", "4"]) == 1
+    assert main(["--node_id", "n0", "--config", str(cfg_path),
+                 "--eos_id", "7"]) == 1

@@ -1,0 +1,113 @@
+"""Group-wise int4 weight-only quantization.
+
+Contracts:
+  * the group-batched apply (ops.nn._linear_int4) equals matmul against
+    the explicitly dequantized kernel — the group decomposition is
+    algebra, not approximation;
+  * group-wise scales beat per-column scales on quantization error (the
+    reason int4 needs groups at all);
+  * the quantized tree drops into the standard forward/decode paths via
+    the shared linear dispatch, at half int8's kernel bytes;
+  * indivisible group sizes are rejected.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dnn_tpu import quant
+from dnn_tpu.models import gpt
+from dnn_tpu.ops.nn import linear
+
+CFG = gpt.GPTConfig(block_size=48, vocab_size=128, n_layer=2, n_head=4,
+                    n_embd=64)  # n_embd divisible by the test group sizes
+
+
+def test_apply_equals_dequantized_matmul():
+    w = jax.random.normal(jax.random.PRNGKey(0), (128, 96))
+    b = jax.random.normal(jax.random.PRNGKey(1), (96,))
+    x = jax.random.normal(jax.random.PRNGKey(2), (4, 128))
+    q, scale = quant.quantize_tensor_int4(w, group=32)
+    assert q.dtype == jnp.int4 and scale.shape == (4, 96)
+
+    got = linear({"q": q, "scale": scale, "bias": b}, x)
+    # dequantize explicitly: per-group scale broadcast over its 32 rows
+    deq = (q.astype(jnp.float32).reshape(4, 32, 96)
+           * scale[:, None, :]).reshape(128, 96)
+    want = x @ deq + b
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_groupwise_beats_per_column():
+    # heavy-tailed weights (outliers) are where groups matter
+    w = jax.random.t(jax.random.PRNGKey(0), df=3.0, shape=(512, 64))
+
+    def rms_err(q, scale, group):
+        deq = (q.astype(jnp.float32).reshape(512 // group, group, 64)
+               * scale[:, None, :]).reshape(512, 64)
+        # RMS, not max: the worst-case group still contains the global
+        # outlier, so max error cannot improve — groups win by giving
+        # every OTHER group a tight scale
+        return float(jnp.sqrt(jnp.mean((deq - w) ** 2)))
+
+    q64, s64 = quant.quantize_tensor_int4(w, group=64)
+    q512, s512 = quant.quantize_tensor_int4(w, group=512)  # == per-column
+    # measured ~1.8x RMS improvement on df=3 tails; assert a solid margin
+    assert rms_err(q64, s64, 64) < 0.7 * rms_err(q512, s512, 512)
+
+
+def test_gpt_int4_forward_and_decode():
+    params = gpt.init(jax.random.PRNGKey(0), CFG)
+    prepared = gpt.prepare_stacked(params, CFG)
+    q4 = quant.quantize_gpt(prepared, bits=4, int4_group=32)
+    ids = jax.random.randint(jax.random.PRNGKey(1), (2, 9), 0,
+                             CFG.vocab_size, dtype=jnp.int32)
+
+    ref = gpt.make_apply_stacked(CFG)(prepared, ids)
+    got = gpt.make_apply_stacked(CFG)(q4, ids)
+    # int4 is lossy; the contract is "same prediction class", checked as
+    # high logit correlation rather than closeness
+    c = np.corrcoef(np.asarray(ref).ravel(), np.asarray(got).ravel())[0, 1]
+    assert c > 0.98, c
+
+    from dnn_tpu.runtime.generate import make_generate
+
+    toks = make_generate(CFG, max_new_tokens=5)(
+        q4, ids[:, :5], jax.random.PRNGKey(2))
+    assert np.asarray(toks).shape == (2, 5)
+
+    # bytes at REAL model dims (the toy model's 32-row groups carry ~12%
+    # f32-scale overhead that blurs the ratio): a gpt2-small mlp.fc
+    # kernel at the default group lands near the ideal 0.5625 bytes/wt
+    # (0.5 int4 + 4/64 scale) vs int8's ~1.005
+    w = jnp.zeros((768, 3072))
+    q4k, s4k = quant.quantize_tensor_int4(w)
+    q8k, s8k = quant.quantize_tensor(w)
+    b4 = quant.param_bytes({"q": q4k, "scale": s4k})
+    b8 = quant.param_bytes({"q": q8k, "scale": s8k})
+    bf = quant.param_bytes({"kernel": w})
+    assert b4 < 0.60 * b8, (b4, b8)
+    assert b8 < 0.27 * bf, (b8, bf)
+
+
+def test_indivisible_group_rejected():
+    w = jnp.ones((100, 8))
+    with pytest.raises(ValueError, match="not divisible"):
+        quant.quantize_tensor_int4(w, group=64)
+
+
+def test_stacked_scales_slice_with_scan():
+    """Stacked (L, in, out) kernels quantize to (L, G, out) scales; the
+    blocks scan slices both in lockstep (same contract as int8)."""
+    w = jax.random.normal(jax.random.PRNGKey(0), (3, 128, 64))
+    q, scale = quant.quantize_tensor_int4(w, group=32)
+    assert q.shape == (3, 128, 64) and scale.shape == (3, 4, 64)
+    x = jax.random.normal(jax.random.PRNGKey(1), (2, 128))
+    for layer in range(3):
+        got = linear({"q": q[layer], "scale": scale[layer]}, x)
+        deq = (q[layer].astype(jnp.float32).reshape(4, 32, 64)
+               * scale[layer][:, None, :]).reshape(128, 64)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(x @ deq),
+                                   rtol=1e-5, atol=1e-5)
