@@ -62,6 +62,13 @@ class LlamaConfig:
     d_ff: int = 5632
     rope_theta: float = 10000.0
     rms_eps: float = 1e-5
+    # Mistral-class sliding-window attention: each position attends only
+    # the previous `sliding_window` positions (None = dense causal).
+    # Dense forwards band-mask; cached decode either window-masks a
+    # full-length cache (batcher/pipeline) or stores a rolling ring of
+    # exactly `sliding_window` positions (solo generate) — both
+    # attention-equivalent (runtime/kvcache.py docstring).
+    sliding_window: Optional[int] = None
 
     @property
     def head_dim(self):
@@ -81,6 +88,18 @@ PRESETS = {
     # tiny config for tests / CPU-mesh CI (GQA 2:1, 4 layers)
     "llama-test": LlamaConfig(block_size=64, vocab_size=256, n_layer=4,
                               n_head=4, n_kv_head=2, n_embd=64, d_ff=128),
+    # Mistral-7B-v0.1 shape: the LLaMA block with GQA 4:1 and a 4096-token
+    # sliding window (the architecture's long-context claim: cache and
+    # attention cost are O(window), not O(seq))
+    "mistral-7b": LlamaConfig(block_size=32768, vocab_size=32000,
+                              n_layer=32, n_head=32, n_kv_head=8,
+                              n_embd=4096, d_ff=14336,
+                              rope_theta=10000.0, sliding_window=4096),
+    # tiny sliding-window config for tests (window far below block_size
+    # so CI exercises the wrap)
+    "mistral-test": LlamaConfig(block_size=64, vocab_size=256, n_layer=4,
+                                n_head=4, n_kv_head=2, n_embd=64, d_ff=128,
+                                sliding_window=16),
 }
 
 
@@ -175,15 +194,20 @@ def _gqa_scores_attend(q, k, v, mask_fn):
 
 
 def _dense_attn(bp, h, *, cfg: LlamaConfig, compute_dtype):
-    """Default attention: local causal GQA over the whole (B, T, C) h."""
+    """Default attention: local causal GQA over the whole (B, T, C) h,
+    band-limited to cfg.sliding_window when set."""
     t = h.shape[1]
     q, k, v = _qkv_rope(bp, h, jnp.arange(t), cfg=cfg,
                         compute_dtype=compute_dtype)
     rows = jnp.arange(t)
 
     def causal(s):
-        return jnp.where(rows[None, None, None, :, None] >=
-                         rows[None, None, None, None, :], s, _NEG_BIG)
+        qr = rows[None, None, None, :, None]
+        kr = rows[None, None, None, None, :]
+        keep = qr >= kr
+        if cfg.sliding_window is not None:
+            keep &= kr > qr - cfg.sliding_window
+        return jnp.where(keep, s, _NEG_BIG)
 
     y = _gqa_scores_attend(q, k, v, causal)
     return linear(bp["attn"]["o"], merge_heads(y.astype(h.dtype)),
@@ -309,10 +333,11 @@ def init_cache(cfg: LlamaConfig, batch: int, max_len: int, dtype=jnp.float32):
 
 
 def forward_with_cache(prepared, ids, cache, start_pos, *, cfg: LlamaConfig,
-                       compute_dtype=None, attn_kernel=False):
+                       compute_dtype=None, attn_kernel=False, rolling=False):
     from dnn_tpu.runtime.kvcache import codec_for_cache
 
-    codec = codec_for_cache(cache, use_kernel=attn_kernel)
+    codec = codec_for_cache(cache, use_kernel=attn_kernel,
+                            window=cfg.sliding_window, rolling=rolling)
     x = embedding(prepared["wte"], ids)
     if compute_dtype is not None:
         x = x.astype(compute_dtype)
@@ -330,13 +355,39 @@ def forward_with_cache(prepared, ids, cache, start_pos, *, cfg: LlamaConfig,
     return logits, new_cache
 
 
+def _ring_from_prompt(prompt_cache, t: int, w: int):
+    """Gather a prompt-length cache's live sliding-window band into a
+    w-slot ring: slot j takes position ``a_j = (t-1) - ((t-1-j) % w)``
+    (the latest prompt position congruent to j), zeroed where no such
+    position exists (a_j < 0 — short prompts). Decode steps then keep
+    writing positions t, t+1, ... at ``pos % w``; kvcache's ring
+    predicate recovers exactly this occupancy at every later step."""
+    from dnn_tpu.runtime.kvcache import ring_positions
+
+    a = ring_positions(t - 1, w)  # (w,) absolute position per ring slot
+    src = jnp.clip(a, 0, t - 1)
+    out = {}
+    for kk, leaf in prompt_cache.items():  # leaves (L, B, KV, S[, D])
+        g = jnp.take(leaf, src, axis=3)
+        live = (a >= 0).reshape((1, 1, 1, w) + (1,) * (leaf.ndim - 4))
+        out[kk] = jnp.where(live, g, jnp.zeros_like(g))
+    return out
+
+
 def make_generate(cfg: LlamaConfig, *, max_new_tokens: int,
                   temperature: float = 0.0, top_k: Optional[int] = None,
                   top_p: Optional[float] = None,
                   compute_dtype=None, kv_dtype=None, attn_kernel=False):
     """Jitted generate(prepared, ids, rng) — same contract as the GPT
     family's decoder, including kv_dtype (f32/bf16/"int8") cache storage
-    and attn_kernel (Pallas streaming cache attention on decode steps)."""
+    and attn_kernel (Pallas streaming cache attention on decode steps).
+
+    Sliding-window configs whose total stream exceeds the window decode
+    on a ROLLING cache: prefill runs window-masked on a transient
+    prompt-length cache, its live band is gathered into a
+    `sliding_window`-slot ring, and every decode step reads/writes only
+    the ring — cache bytes per step are O(window) regardless of how long
+    the stream runs (the Mistral architecture's decode claim)."""
     from dnn_tpu.runtime.generate import _sample
 
     if max_new_tokens < 1:
@@ -351,10 +402,21 @@ def make_generate(cfg: LlamaConfig, *, max_new_tokens: int,
                 f"prompt {t} + max_new_tokens {max_new_tokens} exceeds "
                 f"block_size {cfg.block_size}")
         cache_dtype = kv_dtype if kv_dtype is not None else (compute_dtype or jnp.float32)
-        cache = init_cache(cfg, b, s_max, cache_dtype)
-        logits, cache = forward_with_cache(
-            prepared, ids, cache, 0, cfg=cfg, compute_dtype=compute_dtype,
-            attn_kernel=attn_kernel)
+        w = cfg.sliding_window
+        rolling = w is not None and s_max > w
+        if rolling:
+            # transient prompt-length cache (window-masked attends), then
+            # the live band moves into the ring
+            prompt_cache = init_cache(cfg, b, t, cache_dtype)
+            logits, prompt_cache = forward_with_cache(
+                prepared, ids, prompt_cache, 0, cfg=cfg,
+                compute_dtype=compute_dtype)
+            cache = _ring_from_prompt(prompt_cache, t, w)
+        else:
+            cache = init_cache(cfg, b, s_max, cache_dtype)
+            logits, cache = forward_with_cache(
+                prepared, ids, cache, 0, cfg=cfg, compute_dtype=compute_dtype,
+                attn_kernel=attn_kernel)
         rng, sub = jax.random.split(rng)
         tok = _sample(logits[:, -1], sub, temperature=temperature,
                       top_k=top_k, top_p=top_p)
@@ -363,7 +425,8 @@ def make_generate(cfg: LlamaConfig, *, max_new_tokens: int,
             cache, tok, rng = carry
             logits, cache = forward_with_cache(
                 prepared, tok[:, None], cache, t + i, cfg=cfg,
-                compute_dtype=compute_dtype, attn_kernel=attn_kernel)
+                compute_dtype=compute_dtype,
+                attn_kernel=attn_kernel and not rolling, rolling=rolling)
             rng, sub = jax.random.split(rng)
             nxt = _sample(logits[:, -1], sub, temperature=temperature,
                           top_k=top_k, top_p=top_p)
@@ -397,6 +460,12 @@ def make_apply_seq_parallel(cfg: LlamaConfig, mesh, *, axis_name=None,
     from dnn_tpu.parallel.mesh import SEQ_AXIS
     from dnn_tpu.parallel.ring_attention import ring_attention_local
 
+    if cfg.sliding_window is not None:
+        raise ValueError(
+            "sequence-parallel forward computes full causal attention; "
+            "sliding-window configs are not supported on this path "
+            "(a banded ring schedule could skip out-of-window hops — "
+            "not implemented)")
     axis = axis_name or SEQ_AXIS
 
     def local_fn(prepared, ids_local):
@@ -466,6 +535,10 @@ def make_generate_seq_sharded(cfg: LlamaConfig, mesh, *, max_new_tokens: int,
 
     if max_new_tokens < 1:
         raise ValueError(f"max_new_tokens must be >= 1, got {max_new_tokens}")
+    if cfg.sliding_window is not None:
+        raise ValueError(
+            "sequence-sharded decode keeps full history shards; "
+            "sliding-window configs are not supported on this path")
     axis = axis_name or SEQ_AXIS
     n = mesh.shape[axis]
     kv, g, hd = cfg.n_kv_head, cfg.n_head // cfg.n_kv_head, cfg.head_dim
@@ -586,6 +659,10 @@ class LlamaFamilyRows:
         self.attn_kernel = attn_kernel
         # paged-pool head width: the cache stores KV heads (GQA)
         self.kv_heads = cfg.n_kv_head
+        # picked up by ContinuousBatcher: sliding-window masking over the
+        # slot pool's full-length cache (storage unchanged — the pool is
+        # shared across slots, so the ring form doesn't apply here)
+        self.window = cfg.sliding_window
 
     def init_cache(self, batch, max_len, dtype):
         return init_cache(self.cfg, batch, max_len, dtype)
@@ -657,7 +734,8 @@ class LlamaPipelineFamily:
         return _block_with_cache(
             bp, x, layer_cache, start_pos, cfg=self.cfg,
             compute_dtype=self.compute_dtype,
-            codec=codec_for_cache(layer_cache))
+            codec=codec_for_cache(layer_cache,
+                                  window=self.cfg.sliding_window))
 
     def embed(self, aux, ids, start_pos):
         x = embedding(aux["wte"], ids)
@@ -735,10 +813,12 @@ def make_partition(cfg: LlamaConfig, *, compute_dtype=None):
 
 def to_hf_config(cfg: LlamaConfig, *, tie_word_embeddings: bool = False,
                  **overrides):
-    """The one LlamaConfig -> transformers.LlamaConfig mapping (tests, the
+    """The one LlamaConfig -> transformers config mapping (tests, the
     HF-serve example, and any converter round-trip share it — the field
-    list must not fork). Requires transformers; extra kwargs pass through
-    (e.g. attn_implementation="eager")."""
+    list must not fork). Sliding-window configs map to
+    transformers.MistralConfig (the HF class that implements the window);
+    dense ones to LlamaConfig. Requires transformers; extra kwargs pass
+    through (e.g. attn_implementation="eager")."""
     import transformers
 
     kw = dict(
@@ -746,9 +826,14 @@ def to_hf_config(cfg: LlamaConfig, *, tie_word_embeddings: bool = False,
         intermediate_size=cfg.d_ff, num_hidden_layers=cfg.n_layer,
         num_attention_heads=cfg.n_head, num_key_value_heads=cfg.n_kv_head,
         max_position_embeddings=cfg.block_size, rope_theta=cfg.rope_theta,
-        rms_norm_eps=cfg.rms_eps, attention_bias=False, mlp_bias=False,
+        rms_norm_eps=cfg.rms_eps,
         tie_word_embeddings=tie_word_embeddings,
     )
+    if cfg.sliding_window is not None:
+        kw.update(sliding_window=cfg.sliding_window, head_dim=cfg.head_dim)
+        kw.update(overrides)  # after defaults: overrides must win
+        return transformers.MistralConfig(**kw)
+    kw.update(attention_bias=False, mlp_bias=False)
     kw.update(overrides)
     return transformers.LlamaConfig(**kw)
 

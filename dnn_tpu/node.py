@@ -259,6 +259,13 @@ def main(argv=None) -> int:
         log.error("--beam/--eos_id/--length_penalty only apply to "
                   "--generate; pass --generate N")
         return 1
+    if args.generate is not None and args.beam is None and (
+            args.eos_id is not None or args.length_penalty != 0.0):
+        # the sampling decode path has no EOS/length-penalty support —
+        # error rather than silently dropping the flags
+        log.error("--eos_id/--length_penalty apply to beam search only; "
+                  "pass --beam K alongside --generate")
+        return 1
 
     if args.serve_lm:
         return _serve_lm(engine, args)

@@ -28,9 +28,25 @@ A codec is three functions over a PER-LAYER cache pytree (every leaf
 carries a leading L axis at rest; `lax.scan` peels it): `init`, `write`,
 `attend`. `generate.forward_with_cache` threads whichever codec matches
 its cache, so the same decode loop serves f32, bf16, and int8 caches.
+
+**Sliding windows** (Mistral-class models) come in two forms:
+
+  * `window=` on the standard codecs adds a LOWER-bound mask — key
+    positions <= limit - window are dropped — over an ordinary
+    full-length cache. Storage is unchanged; every runtime (batcher,
+    pipeline stages, chunked prefill) gets window semantics for free.
+  * `RollingFloatKV` / `RollingInt8KV` store only `window` positions as
+    a ring buffer (write at ``pos % window``): the solo decode loop's
+    memory win — cache bytes are O(window) however long the stream runs.
+    Ring slot j holds absolute position ``a_j = p - ((p - j) % W)`` at
+    step p; masking ``a_j >= 0`` is exactly "written and in-window", so
+    the two forms are attention-equivalent (pinned in
+    tests/test_sliding_window.py).
 """
 
 from __future__ import annotations
+
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
@@ -38,19 +54,43 @@ from jax import lax
 
 _NEG_BIG = -1e30
 
-__all__ = ["FloatKV", "Int8KV", "codec_for_cache"]
+__all__ = ["FloatKV", "Int8KV", "RollingFloatKV", "RollingInt8KV",
+           "codec_for_cache"]
 
 
 class _KernelDispatch:
     """Shared use_kernel plumbing: True engages the Pallas path with its
     own TPU/tiling dispatch; the string "interpret" forces the kernel in
     Pallas interpreter mode (CPU CI runs the REAL kernel logic inside the
-    full decode loop instead of silently falling back to the einsum)."""
+    full decode loop instead of silently falling back to the einsum).
+
+    Also hosts THE window predicate: every attend variant of every codec
+    masks through `_band_keep` / `_rows_keep`, so the sliding-window
+    band-edge semantics live in exactly one place."""
 
     use_kernel = False
+    window: Optional[int] = None
 
     def _interp(self):
         return True if self.use_kernel == "interpret" else None
+
+    def _band_keep(self, cols, limit):
+        """Causal upper bound (cols <= limit) plus the optional
+        sliding-window lower bound (cols > limit - window); broadcasts
+        over whatever shapes the caller aligned."""
+        keep = cols <= limit
+        if self.window is not None:
+            keep &= cols > limit - self.window
+        return keep
+
+    def _rows_keep(self, c, pos):
+        """(B, 1, 1, S) keep-mask for shared-limit decode rows at per-slot
+        positions pos (B,). _RingStorage overrides this with the ring
+        occupancy predicate — that override is the ONLY masking
+        difference between a rolling codec and its base."""
+        cols = jnp.arange(c["k"].shape[2])
+        return self._band_keep(cols[None, None, None, :],
+                               pos[:, None, None, None])
 
 
 def _rows_update(cache, new, pos):
@@ -68,11 +108,17 @@ class FloatKV(_KernelDispatch):
     cached-attention kernel (dnn_tpu/ops/pallas/cached_attention.py):
     online-softmax streaming of the cache with runtime position limits —
     one compiled program for every chunk start and slot position. Falls
-    back to the einsum path off-TPU or when shapes don't tile."""
+    back to the einsum path off-TPU or when shapes don't tile.
 
-    def __init__(self, dtype=jnp.float32, use_kernel: bool = False):
+    `window=W` adds the sliding-window lower bound: key positions
+    <= limit - W are masked in every attend variant (the kernel has no
+    window support, so a window forces the einsum path)."""
+
+    def __init__(self, dtype=jnp.float32, use_kernel: bool = False,
+                 window: Optional[int] = None):
         self.dtype = dtype
         self.use_kernel = use_kernel
+        self.window = window
 
     def init(self, cfg, batch: int, max_len: int):
         shape = (cfg.n_layer, batch, cfg.n_head, max_len,
@@ -100,7 +146,7 @@ class FloatKV(_KernelDispatch):
         GQA group trick, llama.py) never pass base, so use_kernel can't
         silently mis-mask them; they fall through to the einsum (or, for
         T==1 folded rows, route via attend_rows' decode kernel)."""
-        if self.use_kernel and base is not None:
+        if self.use_kernel and base is not None and self.window is None:
             from dnn_tpu.ops.pallas.cached_attention import (
                 cached_attention, decode_attention,
             )
@@ -119,8 +165,9 @@ class FloatKV(_KernelDispatch):
         d = q.shape[-1]
         s = jnp.einsum("bhtd,bhsd->bhts", q, c["k"]).astype(jnp.float32) / jnp.sqrt(d)
         cols = jnp.arange(c["k"].shape[2])
-        s = jnp.where(cols[None, None, None, :] <= pos_limit[None, None, :, None],
-                      s, _NEG_BIG)
+        keep = self._band_keep(cols[None, None, None, :],
+                               pos_limit[None, None, :, None])
+        s = jnp.where(keep, s, _NEG_BIG)
         p = jax.nn.softmax(s, axis=-1)
         return jnp.einsum("bhts,bhsd->bhtd", p.astype(c["v"].dtype), c["v"])
 
@@ -150,7 +197,8 @@ class FloatKV(_KernelDispatch):
         cols = jnp.arange(c["k"].shape[2])
         rows = jnp.arange(q.shape[2])
         limit = pos[:, None, None, None] + rows[None, None, :, None]
-        s = jnp.where(cols[None, None, None, :] <= limit, s, _NEG_BIG)
+        keep = self._band_keep(cols[None, None, None, :], limit)
+        s = jnp.where(keep, s, _NEG_BIG)
         p = jax.nn.softmax(s, axis=-1)
         return jnp.einsum("bhts,bhsd->bhtd", p.astype(c["v"].dtype),
                           c["v"])
@@ -159,7 +207,7 @@ class FloatKV(_KernelDispatch):
         """q (B, H, R, D); every row of slot b masked to keys at positions
         <= pos[b]. R=1 is plain per-slot decode; R=G is the LLaMA GQA fold
         (all group rows share their slot's limit — llama.LlamaFamilyRows)."""
-        if self.use_kernel:
+        if self.use_kernel and self.window is None:
             from dnn_tpu.ops.pallas.cached_attention import decode_attention
 
             return decode_attention(q, c["k"], c["v"], pos,
@@ -167,9 +215,7 @@ class FloatKV(_KernelDispatch):
                 .astype(c["v"].dtype)
         d = q.shape[-1]
         s = jnp.einsum("bhtd,bhsd->bhts", q, c["k"]).astype(jnp.float32) / jnp.sqrt(d)
-        cols = jnp.arange(c["k"].shape[2])
-        mask = cols[None, None, None, :] <= pos[:, None, None, None]
-        s = jnp.where(mask, s, _NEG_BIG)
+        s = jnp.where(self._rows_keep(c, pos), s, _NEG_BIG)
         p = jax.nn.softmax(s, axis=-1)
         return jnp.einsum("bhts,bhsd->bhtd", p.astype(c["v"].dtype), c["v"])
 
@@ -190,10 +236,14 @@ class Int8KV(_KernelDispatch):
     `use_kernel=True`: the Pallas cached-attention kernel streams the
     int8 bytes straight from HBM and folds the scales inside VMEM — the
     1-byte read becomes a guarantee instead of an XLA fusion hope (see
-    dnn_tpu/ops/pallas/cached_attention.py)."""
+    dnn_tpu/ops/pallas/cached_attention.py).
 
-    def __init__(self, use_kernel: bool = False):
+    `window=W`: sliding-window lower bound, exactly as FloatKV's."""
+
+    def __init__(self, use_kernel: bool = False,
+                 window: Optional[int] = None):
         self.use_kernel = use_kernel
+        self.window = window
 
     def init(self, cfg, batch: int, max_len: int):
         shape = (cfg.n_layer, batch, cfg.n_head, max_len,
@@ -218,7 +268,7 @@ class Int8KV(_KernelDispatch):
     def attend(self, q, c, pos_limit, base=None):
         # `base` marks the pos_limit == base + arange(T) contract (see
         # FloatKV.attend) — kernel path only with it
-        if self.use_kernel and base is not None:
+        if self.use_kernel and base is not None and self.window is None:
             from dnn_tpu.ops.pallas.cached_attention import (
                 cached_attention, decode_attention,
             )
@@ -239,8 +289,9 @@ class Int8KV(_KernelDispatch):
                        preferred_element_type=jnp.float32)
         s = s * c["ks"][:, :, None, :] / jnp.sqrt(d)
         cols = jnp.arange(c["k"].shape[2])
-        s = jnp.where(cols[None, None, None, :] <= pos_limit[None, None, :, None],
-                      s, _NEG_BIG)
+        keep = self._band_keep(cols[None, None, None, :],
+                               pos_limit[None, None, :, None])
+        s = jnp.where(keep, s, _NEG_BIG)
         p = jax.nn.softmax(s, axis=-1)
         # fold the V scale into the (small) probability matrix, then
         # contract against the raw int8 values
@@ -276,7 +327,8 @@ class Int8KV(_KernelDispatch):
         cols = jnp.arange(c["k"].shape[2])
         rows = jnp.arange(q.shape[2])
         limit = pos[:, None, None, None] + rows[None, None, :, None]
-        s = jnp.where(cols[None, None, None, :] <= limit, s, _NEG_BIG)
+        keep = self._band_keep(cols[None, None, None, :], limit)
+        s = jnp.where(keep, s, _NEG_BIG)
         p = jax.nn.softmax(s, axis=-1)
         p = p * c["vs"][:, :, None, :]
         return jnp.einsum("bhts,bhsd->bhtd", p,
@@ -285,7 +337,7 @@ class Int8KV(_KernelDispatch):
 
     def attend_rows(self, q, c, pos):
         # shared-limit decode rows, any R (see FloatKV.attend_rows)
-        if self.use_kernel:
+        if self.use_kernel and self.window is None:
             from dnn_tpu.ops.pallas.cached_attention import decode_attention
 
             return decode_attention(q, c["k"], c["v"], pos,
@@ -296,19 +348,134 @@ class Int8KV(_KernelDispatch):
                        c["k"].astype(jnp.float32),
                        preferred_element_type=jnp.float32)
         s = s * c["ks"][:, :, None, :] / jnp.sqrt(d)
-        cols = jnp.arange(c["k"].shape[2])
-        mask = cols[None, None, None, :] <= pos[:, None, None, None]
-        s = jnp.where(mask, s, _NEG_BIG)
+        s = jnp.where(self._rows_keep(c, pos), s, _NEG_BIG)
         p = jax.nn.softmax(s, axis=-1)
         p = p * c["vs"][:, :, None, :]
         return jnp.einsum("bhts,bhsd->bhtd", p, c["v"].astype(jnp.float32),
                           preferred_element_type=jnp.float32)
 
 
-def codec_for_cache(cache, use_kernel: bool = False):
+def ring_positions(pos, w: int):
+    """Absolute position held by ring slot j at stream position `pos`:
+    ``a_j = pos - ((pos - j) % w)`` — the latest position congruent to j
+    that is <= pos. Negative means "slot not yet written". Broadcasts
+    over pos's shape, appending a (w,) axis. The SINGLE source of truth
+    for ring occupancy: both Rolling codecs' masks and the prompt->ring
+    gather (llama._ring_from_prompt) derive from it."""
+    pos = jnp.asarray(pos)
+    j = jnp.arange(w)
+    return pos[..., None] - jnp.mod(pos[..., None] - j, w)
+
+
+class _RingStorage:
+    """Shared rolling-ring discipline, mixed over a base codec: only
+    `window` positions are stored, a write lands at ``pos % window``, and
+    attends mask ring slot j by ``ring_positions(pos, W) >= 0`` —
+    "written and inside the live band" in one predicate (keys are stored
+    already rotated at their absolute positions, so relative RoPE
+    geometry is untouched by the wrap).
+
+    Decode-oriented: multi-row attends (prefill chunks, speculative
+    verify blocks) belong on a full-length cache with `window=` masking —
+    prefill there, then gather the live band into the ring
+    (llama.make_generate's rolling path does exactly this). A multi-row
+    ring attend would let early query rows see slots their own future
+    already overwrote, so it is rejected rather than mis-masked."""
+
+    def init(self, cfg, batch: int, max_len: int):
+        # `max_len` is the stream bound; storage is the window
+        del max_len
+        return super().init(cfg, batch, self.window)
+
+    def attend(self, q, c, pos_limit, base=None):
+        if q.shape[2] != 1:
+            raise ValueError(
+                "rolling cache attends single decode rows only — prefill "
+                "on a full-length cache with window= masking, then gather "
+                "the live band (llama.make_generate's rolling path)")
+        del base
+        return self.attend_rows(
+            q, c, jnp.broadcast_to(pos_limit[0], (q.shape[0],)))
+
+    def write_rows(self, c, k, v, pos, write_gate):
+        w = c["k"].shape[2]
+        return super().write_rows(c, k, v, jnp.mod(pos, w), write_gate)
+
+    def attend_rows_causal(self, q, c, pos):
+        raise ValueError(
+            "speculative verify blocks need a full-length cache — rolling "
+            "storage cannot express per-row history beyond the ring")
+
+    def _rows_keep(self, c, pos):
+        """Ring occupancy replaces the band mask — the one masking
+        difference vs the base codec (see _KernelDispatch._rows_keep)."""
+        return (ring_positions(pos, c["k"].shape[2]) >= 0)[:, None, None, :]
+
+    @staticmethod
+    def _ring_scatter(c, new, start_pos, w: int):
+        """Write rows at absolute positions [start_pos, start_pos+t) into
+        their ring slots; only the last min(t, w) rows survive the wrap,
+        and their slots are distinct — a plain scatter."""
+        t = next(iter(new.values())).shape[2]
+        if t == 1:
+            slot = jnp.mod(start_pos, w)
+            return {kk: lax.dynamic_update_slice_in_dim(
+                c[kk], new[kk], slot, axis=2) for kk in new}
+        m = min(t, w)
+        slots = jnp.mod(start_pos + jnp.arange(t - m, t), w)
+        return {kk: c[kk].at[:, :, slots].set(new[kk][:, :, t - m:])
+                for kk in new}
+
+
+class RollingFloatKV(_RingStorage, FloatKV):
+    """Ring-buffer float cache for sliding-window decode (see
+    _RingStorage for the storage discipline and contract)."""
+
+    def __init__(self, dtype=jnp.float32, window: Optional[int] = None):
+        if window is None or window < 1:
+            raise ValueError(
+                f"rolling cache needs a positive window, got {window}")
+        super().__init__(dtype, use_kernel=False, window=window)
+
+    def write(self, c, k, v, start_pos):
+        w = c["k"].shape[2]
+        return self._ring_scatter(
+            c, {"k": k.astype(c["k"].dtype), "v": v.astype(c["v"].dtype)},
+            start_pos, w)
+    # attend_rows: FloatKV's einsum with _RingStorage._rows_keep
+
+
+class RollingInt8KV(_RingStorage, Int8KV):
+    """Ring-buffer int8 cache: _RingStorage's discipline with Int8KV's
+    per-row scales."""
+
+    def __init__(self, window: Optional[int] = None):
+        if window is None or window < 1:
+            raise ValueError(
+                f"rolling cache needs a positive window, got {window}")
+        super().__init__(use_kernel=False, window=window)
+
+    def write(self, c, k, v, start_pos):
+        w = c["k"].shape[2]
+        kq, ks = _quantize_rows(k)
+        vq, vs = _quantize_rows(v)
+        return self._ring_scatter(
+            c, {"k": kq, "v": vq, "ks": ks, "vs": vs}, start_pos, w)
+    # attend_rows: Int8KV's scaled einsum with _RingStorage._rows_keep
+
+
+def codec_for_cache(cache, use_kernel: bool = False,
+                    window: Optional[int] = None, rolling: bool = False):
     """Infer the codec from a cache pytree's structure (int8 caches carry
     scale leaves). `use_kernel` opts attend/attend_rows into the Pallas
-    cached-attention kernel (TPU; einsum fallback elsewhere)."""
+    cached-attention kernel (TPU; einsum fallback elsewhere). `window`
+    adds the sliding-window lower bound; `rolling=True` additionally
+    treats the cache as a `window`-length ring buffer (rolling cannot be
+    inferred from structure — a ring leaf looks like a short cache)."""
+    if rolling:
+        if "ks" in cache:
+            return RollingInt8KV(window=window)
+        return RollingFloatKV(cache["k"].dtype, window=window)
     if "ks" in cache:
-        return Int8KV(use_kernel=use_kernel)
-    return FloatKV(cache["k"].dtype, use_kernel=use_kernel)
+        return Int8KV(use_kernel=use_kernel, window=window)
+    return FloatKV(cache["k"].dtype, use_kernel=use_kernel, window=window)

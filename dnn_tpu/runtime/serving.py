@@ -250,6 +250,11 @@ class ContinuousBatcher:
         self._paged = int(paged_blocks) > 0
         self._allocator = None
         if self._paged:
+            if getattr(self.family, "window", None) is not None:
+                raise ValueError(
+                    "sliding-window families are not supported with the "
+                    "paged pool (PagedKV attends causal-only; use the "
+                    "dense per-slot cache, which window-masks)")
             from dnn_tpu.runtime.paged_kvcache import (
                 BlockAllocator, PagedKV, init_paged_cache,
             )
@@ -300,7 +305,8 @@ class ContinuousBatcher:
                                                 cache_dtype)
             codec = codec_for_cache(
                 self.cache,
-                use_kernel=getattr(self.family, "attn_kernel", False))
+                use_kernel=getattr(self.family, "attn_kernel", False),
+                window=getattr(self.family, "window", None))
         self.pos = jnp.zeros((slots,), jnp.int32)      # next write position
         self.tok = jnp.zeros((slots,), jnp.int32)      # last sampled token
         self.active = jnp.zeros((slots,), bool)

@@ -23,6 +23,16 @@ def _params(seed=0):
     return llama.init(jax.random.PRNGKey(seed), CFG)
 
 
+def test_to_hf_config_overrides_win():
+    """Explicit overrides must replace the mapping's defaults (the
+    documented pass-through contract), not collide with them."""
+    transformers = pytest.importorskip("transformers")
+
+    c = llama.to_hf_config(CFG, attention_bias=True)
+    assert isinstance(c, transformers.LlamaConfig)
+    assert c.attention_bias is True
+
+
 def test_hf_llama_logit_parity():
     torch = pytest.importorskip("torch")
     transformers = pytest.importorskip("transformers")
