@@ -42,10 +42,12 @@ def pipeline_budget(num_parts: int, *, margin: float = 30.0) -> float:
 
 
 
-def _gen_rid(max_new_tokens, seed, temperature, top_k, top_p):
+def _gen_rid(max_new_tokens, seed, temperature, top_k, top_p,
+             adapter=None):
     """Encode generation options into the request_id the LM daemon parses
     (lm_server.parse_gen_options): positional max_new/seed, then named
-    t=/k=/p= sampling overrides."""
+    t=/k=/p= sampling overrides and a= (the per-request LoRA adapter
+    index of a multi-adapter server)."""
     rid = f"gen:{max_new_tokens}" + (f":{seed}" if seed is not None else "")
     if temperature is not None:
         rid += f":t={temperature}"
@@ -53,6 +55,8 @@ def _gen_rid(max_new_tokens, seed, temperature, top_k, top_p):
         rid += f":k={top_k}"
     if top_p is not None:
         rid += f":p={top_p}"
+    if adapter is not None:
+        rid += f":a={adapter}"
     return rid
 
 
@@ -158,16 +162,18 @@ class NodeClient:
         temperature: Optional[float] = None,
         top_k: Optional[int] = None,
         top_p: Optional[float] = None,
+        adapter: Optional[int] = None,
         timeout: float = 120.0,
     ) -> np.ndarray:
         """Client path for the LM daemon (dnn_tpu/runtime/lm_server.py):
         prompt token ids -> generated tokens. Options ride the request_id
-        as "gen:max_new[:seed][:t=..][:k=..][:p=..]" — the same wire
+        as "gen:max_new[:seed][:t=..][:k=..][:p=..][:a=..]" — the same wire
         message a reference-built client would send, just with an integer
         payload. Sampling overrides are per request (None = server
         defaults). A request is self-contained (prompt + options), so the
         transport-level retries in send_tensor stay safe here."""
-        rid = _gen_rid(max_new_tokens, seed, temperature, top_k, top_p)
+        rid = _gen_rid(max_new_tokens, seed, temperature, top_k, top_p,
+                       adapter)
         status, result = self.send_tensor(
             np.asarray(prompt_ids, np.int32).reshape(-1),
             request_id=rid, timeout=timeout,
@@ -185,6 +191,7 @@ class NodeClient:
         temperature: Optional[float] = None,
         top_k: Optional[int] = None,
         top_p: Optional[float] = None,
+        adapter: Optional[int] = None,
         timeout: float = 120.0,
     ):
         """Streaming client for the LM daemon's GenerateStream RPC: yields
@@ -193,7 +200,8 @@ class NodeClient:
         decode slot at its next step boundary — a disconnected client never
         decodes on to its budget. NOT retried: a stream is stateful (tokens
         already delivered), unlike the self-contained unary generate()."""
-        rid = _gen_rid(max_new_tokens, seed, temperature, top_k, top_p)
+        rid = _gen_rid(max_new_tokens, seed, temperature, top_k, top_p,
+                       adapter)
         call = self._channel.unary_stream(
             f"/{SERVICE_NAME}/GenerateStream",
             request_serializer=pb.TensorRequest.SerializeToString,
@@ -222,13 +230,15 @@ class NodeClient:
         temperature: Optional[float] = None,
         top_k: Optional[int] = None,
         top_p: Optional[float] = None,
+        adapter: Optional[int] = None,
         timeout: float = 120.0,
     ) -> str:
         """Text client for a tokenizer-equipped LM daemon: the prompt rides
         SendMessage's message_text, generation options ride sender_id as
-        "gen:max_new[:seed][:t=..][:k=..][:p=..]", and the reply is the
+        "gen:max_new[:seed][:t=..][:k=..][:p=..][:a=..]", and the reply is the
         generated continuation (lm_server.LMServer.SendMessage)."""
-        rid = _gen_rid(max_new_tokens, seed, temperature, top_k, top_p)
+        rid = _gen_rid(max_new_tokens, seed, temperature, top_k, top_p,
+                       adapter)
         return self.send_message(rid, prompt, timeout=timeout)
 
     def close(self):

@@ -143,6 +143,151 @@ def make_lora_loss(loss_fn: Callable, base_params, *,
     return lora_loss
 
 
+def adapters_to_stacked(adapters, n_layer: int):
+    """Per-layer adapter paths (``h_i/...``, the training layout) -> the
+    `prepare_stacked` serving layout (``blocks/...`` with a leading L
+    axis). Lets artifacts trained against per-layer params serve through
+    `lora_view` without retraining. Non-block paths (wte etc.) pass
+    through unchanged — their layout is identical in both forms."""
+    out: Dict[str, Dict[str, jax.Array]] = {}
+    groups: Dict[str, Dict[int, dict]] = {}
+    for path, ab in adapters.items():
+        keys = path.split("/")
+        if keys[0].startswith("h_") and keys[0][2:].isdigit():
+            groups.setdefault("/".join(keys[1:]), {})[int(keys[0][2:])] = ab
+        else:
+            out[path] = ab
+    for rest, by_layer in groups.items():
+        if set(by_layer) != set(range(n_layer)):
+            raise ValueError(
+                f"adapter covers layers {sorted(by_layer)} of {rest} but "
+                f"the model has {n_layer} — a partial stack would "
+                "silently zero the missing layers")
+        out["blocks/" + rest] = {
+            "a": jnp.stack([by_layer[i]["a"] for i in range(n_layer)]),
+            "b": jnp.stack([by_layer[i]["b"] for i in range(n_layer)]),
+        }
+    return out
+
+
+def stack_loras(adapter_list, *, alphas=None):
+    """N separate adapter trees (several fine-tunes of ONE base, same
+    structure and rank) -> one multi-adapter tree {path: {"a": (N+1, ...,
+    in, r), "b": (N+1, ..., r, out)}} with each adapter's merge scale
+    (alpha_i / r) folded into its b slab and an ALL-ZERO adapter at
+    index 0 — the base model, selected by requests that name no adapter.
+    Feed to `lora_view` for per-request adapter serving
+    (ContinuousBatcher(lora_adapters=...))."""
+    if not adapter_list:
+        raise ValueError("adapter_list must name at least one adapter")
+    if alphas is not None and len(alphas) != len(adapter_list):
+        raise ValueError(
+            f"{len(alphas)} alphas for {len(adapter_list)} adapters")
+    paths = set(adapter_list[0])
+    for i, ad in enumerate(adapter_list[1:], 1):
+        if set(ad) != paths:
+            raise ValueError(
+                f"adapter {i} targets different leaves than adapter 0: "
+                f"{sorted(set(ad) ^ paths)[:3]}...")
+    out: Dict[str, Dict[str, jax.Array]] = {}
+    for p in sorted(paths):
+        a0, b0 = adapter_list[0][p]["a"], adapter_list[0][p]["b"]
+        a_stack, b_stack = [jnp.zeros_like(a0)], [jnp.zeros_like(b0)]
+        for i, ad in enumerate(adapter_list):
+            if ad[p]["a"].shape != a0.shape or ad[p]["b"].shape != b0.shape:
+                raise ValueError(
+                    f"adapter {i} shape mismatch at {p}: "
+                    f"{ad[p]['a'].shape}/{ad[p]['b'].shape} vs "
+                    f"{a0.shape}/{b0.shape}")
+            scale = lora_scaling(
+                ad, alpha=None if alphas is None else alphas[i])
+            a_stack.append(ad[p]["a"])
+            b_stack.append(ad[p]["b"] * scale)
+        out[p] = {"a": jnp.stack(a_stack), "b": jnp.stack(b_stack)}
+    return out
+
+
+def lora_view(params, stacked, sel, *, transposed: bool = False):
+    """Attach per-slot adapter selection to a param tree: for every path
+    in `stacked` (a `stack_loras` result), the dict HOLDING that kernel
+    leaf gains a {"lora": {a, b, sel}} entry that ops.nn.linear applies
+    as a low-rank delta on top of its base matmul (float or quantized —
+    the base leaf is untouched, so one set of base weights serves every
+    adapter).
+
+    `sel` is the (B, N+1) one-hot adapter choice per batch row (row 0 of
+    the stack is the all-zero base adapter). Leaves under a leading
+    layer-stack axis (the `prepare_stacked` serving layout) get the
+    adapter axis transposed behind the layer axis and sel broadcast to
+    (L, B, N+1), so `lax.scan` over the blocks peels both together.
+
+    Pure tree surgery on the host — no weight copies; rebuilt whenever
+    the slot->adapter assignment changes (shape-stable, so the jitted
+    decode program never recompiles). `transposed=True` marks a stack
+    already passed through `transpose_lora_stack` (serving callers do
+    the moveaxis once instead of per view).
+
+    Only LINEAR leaves can be served this way — the delta applies inside
+    ops.nn.linear. An embedding-targeted adapter (path ending in
+    "embedding", which jnp.take-based lookups would silently ignore) is
+    rejected, mirroring merge_lora's no-silent-identity guard."""
+    sel = jnp.asarray(sel)
+
+    def _attach(node, keys, ab):
+        # keys[-1] is the kernel leaf's own name ("kernel" — or "q" after
+        # weight quantization); the lora entry rides its PARENT dict
+        if len(keys) < 2:
+            raise ValueError(
+                f"adapter path {'/'.join(keys)!r} names no containing dict")
+        k = keys[0]
+        if not isinstance(node, dict) or k not in node:
+            raise ValueError(
+                f"adapter path segment {k!r} not found in params (layout "
+                f"mismatch? keys: "
+                f"{sorted(node)[:6] if isinstance(node, dict) else type(node)})")
+        out = dict(node)
+        if len(keys) == 2:
+            child = dict(node[k])
+            a, b = ab["a"], ab["b"]
+            if a.ndim == 4:  # layer-stacked leaf
+                if not transposed:
+                    a = jnp.moveaxis(a, 0, 1)  # (N, L, ..) -> (L, N, ..)
+                    b = jnp.moveaxis(b, 0, 1)
+                s = jnp.broadcast_to(sel, (a.shape[0],) + sel.shape)
+            else:
+                s = sel
+            child["lora"] = {"a": a, "b": b, "sel": s}
+            out[k] = child
+        else:
+            out[k] = _attach(node[k], keys[1:], ab)
+        return out
+
+    view = params
+    for path, ab in stacked.items():
+        if path.split("/")[-1] == "embedding":
+            raise ValueError(
+                f"adapter targets the embedding table ({path}); per-request "
+                "serving applies deltas inside linear layers only — an "
+                "embedding adapter would be silently ignored. Merge it "
+                "(merge_lora) or retrain with linear targets.")
+        view = _attach(view, path.split("/"), ab)
+    return view
+
+
+def transpose_lora_stack(stacked):
+    """One-time serving prep of a `stack_loras` result: layer-stacked
+    slabs moved to scan order ((N, L, ...) -> (L, N, ...)) ONCE, so every
+    subsequent `lora_view(..., transposed=True)` is pure host-side dict
+    surgery with no device transposes (the per-submit fast path)."""
+    out = {}
+    for path, ab in stacked.items():
+        a, b = ab["a"], ab["b"]
+        if a.ndim == 4:
+            a, b = jnp.moveaxis(a, 0, 1), jnp.moveaxis(b, 0, 1)
+        out[path] = {"a": a, "b": b}
+    return out
+
+
 def save_lora(path: str, adapters, *, alpha: Optional[float] = None) -> None:
     """Adapters -> one npz (keys '<leaf path>:a' / ':b'; '__alpha__' when
     a non-default alpha was trained with — the merge scale is part of the

@@ -81,6 +81,14 @@ def build_parser() -> argparse.ArgumentParser:
                    help="LoRA adapter artifact (dnn_tpu.lora.save_lora) "
                         "merged into the model weights at load — every "
                         "mode then serves the fine-tuned model")
+    p.add_argument("--serve_adapter", action="append", default=None,
+                   metavar="NPZ",
+                   help="--serve_lm: serve this LoRA adapter PER REQUEST "
+                        "alongside the base model (repeatable; requests "
+                        "pick one with the a=IDX request-id option, "
+                        "0-based in flag order). Unlike --lora, the base "
+                        "weights stay unmerged — one pool serves every "
+                        "adapter mix")
     p.add_argument("--serve", action="store_true",
                    help="Host this node's stage behind gRPC (reference-interop mode)")
     p.add_argument("--serve_lm", action="store_true",
@@ -266,6 +274,12 @@ def main(argv=None) -> int:
         log.error("--eos_id/--length_penalty apply to beam search only; "
                   "pass --beam K alongside --generate")
         return 1
+    if args.serve_adapter and not args.serve_lm:
+        # per-request adapters exist only in the LM daemon's slot pool —
+        # error rather than silently serving the base model
+        log.error("--serve_adapter applies to --serve_lm only; to serve a "
+                  "single merged fine-tune in other modes use --lora")
+        return 1
 
     if args.serve_lm:
         return _serve_lm(engine, args)
@@ -375,6 +389,23 @@ def _serve_lm(engine: PipelineEngine, args) -> int:
             log.error("tokenizer setup failed: %s", e)
             return 1
     prepared = prepare_stacked(engine.params, cfg)
+    lora_kwargs = {}
+    if args.serve_adapter:
+        from dnn_tpu.lora import adapters_to_stacked, load_lora
+
+        try:
+            ads, alphas = [], []
+            for path in args.serve_adapter:
+                ad, alpha = load_lora(path)
+                if any(p.split("/")[0].startswith("h_") for p in ad):
+                    # training layout -> the prepared serving layout
+                    ad = adapters_to_stacked(ad, cfg.n_layer)
+                ads.append(ad)
+                alphas.append(alpha)
+            lora_kwargs = {"lora_adapters": ads, "lora_alphas": alphas}
+        except Exception as e:  # noqa: BLE001 — CLI boundary
+            log.error("--serve_adapter setup failed: %s", e)
+            return 1
     spec_kwargs = {}
     if args.draft_model:
         # speculative serving: load/init the draft family from the zoo
@@ -431,6 +462,7 @@ def _serve_lm(engine: PipelineEngine, args) -> int:
             family=family, default_max_new=args.generate or 32,
             tokenizer=tokenizer, prefix_cache=args.prefix_cache,
             paged_blocks=args.paged_blocks, block_len=args.block_len,
+            **lora_kwargs,
         ))
     except KeyboardInterrupt:
         log.info("shutting down")

@@ -80,14 +80,23 @@ def linear(params, x, *, compute_dtype=None, accum_dtype=None):
     funnels through this function, so quantized checkpoints work
     everywhere without per-path plumbing.
 
+    A `"lora"` entry ({a, b, sel} — built by lora.lora_view for
+    per-request multi-adapter serving) adds the selected low-rank delta
+    on top of whichever base path ran — float or quantized (the
+    QLoRA-style combination: int8 base weights + per-slot float
+    adapters).
+
     Reference: torch nn.Linear (/root/reference/cifar_model_parts.py:12-13).
     """
+    lora = params.get("lora")
     if "q" in params:
-        if params["q"].dtype == jnp.int4:
-            return _linear_int4(params, x, compute_dtype=compute_dtype,
-                                accum_dtype=accum_dtype)
-        return _linear_int8(params, x, compute_dtype=compute_dtype,
-                            accum_dtype=accum_dtype)
+        base = (_linear_int4 if params["q"].dtype == jnp.int4
+                else _linear_int8)
+        out = base(params, x, compute_dtype=compute_dtype,
+                   accum_dtype=accum_dtype)
+        if lora is not None:
+            out = out + _lora_delta(lora, x, compute_dtype).astype(out.dtype)
+        return out
     kernel = params["kernel"]
     orig_dtype = x.dtype
     if compute_dtype is not None:
@@ -104,9 +113,28 @@ def linear(params, x, *, compute_dtype=None, accum_dtype=None):
     bias = params.get("bias")
     if bias is not None:
         out = out + bias.astype(out.dtype)
+    if lora is not None:
+        out = out + _lora_delta(lora, x, compute_dtype).astype(out.dtype)
     if accum_dtype is None and compute_dtype is not None:
         out = out.astype(orig_dtype)
     return out
+
+
+def _lora_delta(lora, x, compute_dtype):
+    """Per-slot low-rank delta for multi-adapter serving (see
+    lora.lora_view): x (B, T, C) against adapter stacks a (N, C, r) /
+    b (N, r, O), selected per batch row by the one-hot sel (B, N).
+
+    Computed for ALL N adapters then masked by sel — N x the (tiny)
+    rank-r flops, but no gather of weight-sized operands and no dynamic
+    shapes: the TPU-friendly trade at serving-realistic N. The one-hot
+    contraction folds into each einsum, so what actually runs is two
+    batched rank-r matmuls."""
+    a, b, sel = lora["a"], lora["b"], lora["sel"]
+    dt = compute_dtype if compute_dtype is not None else x.dtype
+    sel = sel.astype(dt)
+    xa = jnp.einsum("btc,ncr,bn->btr", x.astype(dt), a.astype(dt), sel)
+    return jnp.einsum("btr,nro,bn->bto", xa, b.astype(dt), sel)
 
 
 def _linear_int8(params, x, *, compute_dtype=None, accum_dtype=None):

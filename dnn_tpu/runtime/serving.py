@@ -198,10 +198,31 @@ class ContinuousBatcher:
                  ffn=None, kv_dtype=None, family=None,
                  attn_kernel: bool = False, prefix_cache: int = 0,
                  logprobs_k: int = 0,
-                 paged_blocks: int = 0, block_len: int = 16):
+                 paged_blocks: int = 0, block_len: int = 16,
+                 lora_adapters=None, lora_alphas=None):
         self.cfg = cfg
         self.prepared = prepared
         self.slots = slots
+        # multi-LoRA serving: `lora_adapters` is a list of adapter trees
+        # (lora.init_lora/load_lora against THIS prepared layout); each
+        # request picks one by index at submit(adapter=i) or serves the
+        # base model by default. One set of base weights, per-slot
+        # low-rank deltas applied inside ops.nn.linear via a param VIEW
+        # (lora.lora_view) — the compiled step programs are shared by
+        # every adapter mix.
+        self._lora = None
+        self._n_adapters = 0
+        if lora_adapters:
+            from dnn_tpu.lora import stack_loras, transpose_lora_stack
+
+            # transpose layer-stacked slabs to scan order ONCE — per-view
+            # construction below is then pure host dict surgery
+            self._lora = transpose_lora_stack(
+                stack_loras(list(lora_adapters), alphas=lora_alphas))
+            self._n_adapters = len(lora_adapters)
+        self._aid = np.zeros((slots,), np.int32)  # 0 = base model
+        self._decode_view = None
+        self._pf_views: dict = {}  # aid -> memoized single-row prefill view
         self.max_len = min(max_len or cfg.block_size, cfg.block_size)
         self.prompt_pad = prompt_pad or min(64, self.max_len)
         self.eos_id = eos_id
@@ -431,6 +452,35 @@ class ContinuousBatcher:
         self._decode = jax.jit(decode_step, donate_argnums=(1,))
         self._prefill_chunk = jax.jit(prefill_chunk, donate_argnums=(1,))
         self._prefill_finish = jax.jit(prefill_finish, donate_argnums=(0, 1))
+        # the decode step's param argument: a lora_view when multi-LoRA is
+        # on (rebuilt whenever a slot's adapter assignment changes — same
+        # structure, so the same compiled program), plain prepared when off
+        self._decode_view = self._lora_prepared(self._aid)
+
+    # ------------------------------------------------------------------
+
+    def _lora_prepared(self, aids):
+        """Param view selecting each row's adapter (lora.lora_view);
+        plain prepared when multi-LoRA is off. `aids` indexes the stacked
+        adapter axis (0 = the all-zero base adapter)."""
+        if self._lora is None:
+            return self.prepared
+        from dnn_tpu.lora import lora_view
+
+        sel = jax.nn.one_hot(jnp.asarray(aids, jnp.int32),
+                             self._n_adapters + 1, dtype=jnp.float32)
+        return lora_view(self.prepared, self._lora, sel, transposed=True)
+
+    def _lora_prefill_view(self, aid: int):
+        """Memoized single-row prefill view for one adapter id — at most
+        N+1 builds over the server's lifetime, then pure dict reuse."""
+        if self._lora is None:
+            return self.prepared
+        view = self._pf_views.get(aid)
+        if view is None:
+            view = self._lora_prepared(np.asarray([aid], np.int32))
+            self._pf_views[aid] = view
+        return view
 
     # ------------------------------------------------------------------
 
@@ -447,7 +497,8 @@ class ContinuousBatcher:
                top_k: Optional[int] = None,
                top_p: Optional[float] = None,
                stop: Optional[list] = None,
-               logprobs: bool = False) -> int:
+               logprobs: bool = False,
+               adapter: Optional[int] = None) -> int:
         """Prefill `prompt` (1-D int array) into a free slot; returns the
         request id. The first token is sampled during prefill and counts
         toward max_new_tokens. `seed` names the request's private rng
@@ -466,7 +517,10 @@ class ContinuousBatcher:
         all, its one forward can't, node.py:137-200); `logprobs=True`
         records the chosen token's logprob and the top-k alternatives per
         step into `token_logprobs[rid]` (server must be constructed with
-        logprobs_k > 0)."""
+        logprobs_k > 0); `adapter` — index into the constructor's
+        `lora_adapters` list (None = the base model): this request's
+        prefill and every decode step apply that adapter's low-rank
+        delta while other slots apply theirs."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if len(prompt) == 0:
             raise ValueError("prompt must have at least one token")
@@ -500,22 +554,37 @@ class ContinuousBatcher:
             raise ValueError(
                 "logprobs requested but the server was constructed with "
                 "logprobs_k=0")
+        aid = 0
+        if adapter is not None:
+            if self._lora is None:
+                raise ValueError(
+                    "adapter= requires lora_adapters at construction")
+            if not 0 <= int(adapter) < self._n_adapters:
+                raise ValueError(
+                    f"adapter {adapter} out of range "
+                    f"[0, {self._n_adapters})")
+            aid = int(adapter) + 1  # stack row 0 is the base model
         try:
             slot = self._slot_req.index(None)
         except ValueError:
             raise RuntimeError("no free slot; call step()/drain() first") from None
 
         # longest cached full-chunk prefix (host lookup; shared by the
-        # dense copy path and the paged block-sharing path below)
+        # dense copy path and the paged block-sharing path below).
+        # K/V rows depend on the WEIGHTS that produced them, so prefix
+        # entries are keyed by (adapter, tokens) — a base-model prefix
+        # must never serve an adapted request or vice versa.
         p_pad = self.prompt_pad
+        key_ns = np.int32(aid).tobytes()
         n_chunks = -(-len(prompt) // p_pad)
         hit_c, hit_entry = 0, None
         if self._prefix_cache is not None:
             for c in range(len(prompt) // p_pad, 0, -1):
-                e = self._prefix_cache.get(prompt[: c * p_pad].tobytes())
+                e = self._prefix_cache.get(
+                    key_ns + prompt[: c * p_pad].tobytes())
                 if e is not None:
                     self._prefix_cache.move_to_end(
-                        prompt[: c * p_pad].tobytes())
+                        key_ns + prompt[: c * p_pad].tobytes())
                     hit_c, hit_entry = c, e
                     break
 
@@ -626,14 +695,15 @@ class ContinuousBatcher:
                         last_logit_row.dtype,
                     ).at[0, p_pad - 1].set(last_logit_row)
             put_candidates = []
+            pf_prepared = self._lora_prefill_view(aid)
             for c in range(start_chunk, n_chunks):
                 logits, row = self._prefill_chunk(
-                    self.prepared, row,
+                    pf_prepared, row,
                     jnp.asarray(padded[:, c * p_pad:(c + 1) * p_pad]), c * p_pad,
                 )
                 self.prefill_chunks_run += 1
                 if self._prefix_cache is not None and (c + 1) * p_pad <= len(prompt):
-                    key = prompt[: (c + 1) * p_pad].tobytes()
+                    key = key_ns + prompt[: (c + 1) * p_pad].tobytes()
                     if self._paged:
                         # block-sharing entries point at THIS request's
                         # blocks, which only hold data after the install —
@@ -688,6 +758,9 @@ class ContinuousBatcher:
             self._temp = self._temp.at[slot].set(temp)
             self._topk = self._topk.at[slot].set(tk)
             self._topp = self._topp.at[slot].set(tp)
+            if self._lora is not None and self._aid[slot] != aid:
+                self._aid[slot] = aid
+                self._decode_view = self._lora_prepared(self._aid)
             req = {"rid": rid, "emitted": [first], "budget": max_new_tokens,
                    "stop": stop_seqs, "logprobs": logprobs and self._logprobs_k,
                    "blocks": paged_taken}
@@ -813,7 +886,7 @@ class ContinuousBatcher:
         if self.n_active == 0:
             return {}
         res = self._decode(
-            self.prepared, self.cache, self.pos, self.tok, self.active,
+            self._decode_view, self.cache, self.pos, self.tok, self.active,
             self.keys, self._temp, self._topk, self._topp,
         )
         if self._logprobs_k:

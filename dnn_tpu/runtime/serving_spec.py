@@ -83,7 +83,7 @@ class SpeculativeBatcher(ContinuousBatcher):
                 f"draft vocab {draft_cfg.vocab_size} != target vocab "
                 f"{cfg.vocab_size}")
         for bad in ("family", "ffn", "paged_blocks", "logprobs_k",
-                    "attn_kernel", "top_p"):
+                    "attn_kernel", "top_p", "lora_adapters"):
             if kw.get(bad):
                 raise ValueError(
                     f"SpeculativeBatcher does not support {bad}=")

@@ -49,6 +49,8 @@ def test_parse_gen_options():
     assert parse_gen_options("gen:12:t=0.5:99", 32) == (
         12, 99, {"temperature": 0.5})  # positional continues past named
     assert parse_gen_options("gen:t=bogus:x=1", 32) == (32, None, {})
+    # per-request LoRA adapter selection (multi-adapter serving)
+    assert parse_gen_options("gen:8:a=1", 32) == (8, None, {"adapter": 1})
     # only the literal 'gen' prefix carries options: a foreign client's
     # tracing id must NOT be reinterpreted as a token budget
     assert parse_gen_options("req:1234", 32) == (32, None, {})

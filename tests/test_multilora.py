@@ -1,0 +1,275 @@
+"""Multi-LoRA serving: per-request adapter selection in the continuous
+batcher.
+
+The contract extends the batcher's core one (a request's stream equals
+its solo run): a request naming adapter i must produce EXACTLY the
+tokens of a solo run on `merge_lora(base, adapter_i)` — whatever mix of
+adapters shares the pool — and base requests must be bit-identical to a
+server with no adapters at all. The view mechanism (lora.lora_view +
+the delta inside ops.nn.linear) is also checked at the op level against
+the merge, including over an int8-quantized base (the QLoRA-style
+deployment: one quantized base, float adapters per tenant).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dnn_tpu import lora
+from dnn_tpu.models import gpt, llama
+from dnn_tpu.ops.nn import linear
+from dnn_tpu.runtime.generate import make_generate
+from dnn_tpu.runtime.serving import ContinuousBatcher
+
+CFG = gpt.PRESETS["gpt2-test"]
+
+
+def _adapters(prepared, seeds, rank=4):
+    """Random NON-trivial adapters against the prepared layout (init_lora
+    zeroes b, which would make every test a tautology — randomize it)."""
+    out = []
+    for s in seeds:
+        ad = lora.init_lora(jax.random.PRNGKey(s), prepared, rank=rank)
+        # randomize the b half so the adapter actually changes the model
+        ks = jax.random.split(jax.random.PRNGKey(100 + s), len(ad))
+        for (p, ab), k in zip(sorted(ad.items()), ks):
+            ab["b"] = jax.random.normal(k, ab["b"].shape) * 0.02
+        out.append(ad)
+    return out
+
+
+@pytest.fixture(scope="module")
+def setup():
+    params = gpt.init(jax.random.PRNGKey(0), CFG)
+    prepared = gpt.prepare_stacked(params, CFG)
+    adapters = _adapters(prepared, seeds=(1, 2))
+    return prepared, adapters
+
+
+def _solo(cfg, prepared, prompt, n):
+    fn = make_generate(cfg, max_new_tokens=n)
+    out = fn(prepared, jnp.asarray(prompt, jnp.int32)[None, :],
+             jax.random.PRNGKey(9))
+    return np.asarray(out)[0]
+
+
+def test_adapter_request_matches_solo_merged(setup):
+    prepared, adapters = setup
+    prompt = np.arange(1, 9) % CFG.vocab_size
+    srv = ContinuousBatcher(CFG, prepared, slots=2, max_len=64,
+                            prompt_pad=16, lora_adapters=adapters)
+    rid = srv.submit(prompt, max_new_tokens=10, adapter=0)
+    res = srv.drain()
+    merged = lora.merge_lora(prepared, adapters[0])
+    np.testing.assert_array_equal(res[rid], _solo(CFG, merged, prompt, 10))
+
+
+def test_mixed_pool_each_adapter_isolated(setup):
+    """Base + two different adapters decode TOGETHER; each stream equals
+    its own solo reference — the feature's whole point."""
+    prepared, adapters = setup
+    p1 = (np.arange(1, 7) * 3) % CFG.vocab_size
+    p2 = (np.arange(1, 10) * 5) % CFG.vocab_size
+    p3 = (np.arange(1, 5) * 7) % CFG.vocab_size
+    srv = ContinuousBatcher(CFG, prepared, slots=3, max_len=64,
+                            prompt_pad=16, lora_adapters=adapters)
+    r1 = srv.submit(p1, max_new_tokens=8, adapter=0)
+    r2 = srv.submit(p2, max_new_tokens=8, adapter=1)
+    r3 = srv.submit(p3, max_new_tokens=8)  # base model
+    res = srv.drain()
+    np.testing.assert_array_equal(
+        res[r1], _solo(CFG, lora.merge_lora(prepared, adapters[0]), p1, 8))
+    np.testing.assert_array_equal(
+        res[r2], _solo(CFG, lora.merge_lora(prepared, adapters[1]), p2, 8))
+    np.testing.assert_array_equal(res[r3], _solo(CFG, prepared, p3, 8))
+
+
+def test_base_requests_identical_to_plain_server(setup):
+    """lora_adapters= must not perturb base-model requests at all."""
+    prepared, adapters = setup
+    prompt = np.arange(2, 11) % CFG.vocab_size
+    with_lora = ContinuousBatcher(CFG, prepared, slots=2, max_len=64,
+                                  prompt_pad=16, lora_adapters=adapters)
+    plain = ContinuousBatcher(CFG, prepared, slots=2, max_len=64,
+                              prompt_pad=16)
+    ra = with_lora.submit(prompt, max_new_tokens=9)
+    rb = plain.submit(prompt, max_new_tokens=9)
+    np.testing.assert_array_equal(with_lora.drain()[ra], plain.drain()[rb])
+
+
+def test_slot_reuse_across_adapters(setup):
+    """A slot that served adapter 0 must serve adapter 1 (and base)
+    correctly afterwards — no stale delta leaks through reuse."""
+    prepared, adapters = setup
+    prompt = np.arange(1, 8) % CFG.vocab_size
+    srv = ContinuousBatcher(CFG, prepared, slots=1, max_len=64,
+                            prompt_pad=16, lora_adapters=adapters)
+    r0 = srv.submit(prompt, max_new_tokens=6, adapter=0)
+    res0 = dict(srv.drain())
+    r1 = srv.submit(prompt, max_new_tokens=6, adapter=1)
+    res1 = dict(srv.drain())
+    r2 = srv.submit(prompt, max_new_tokens=6)
+    res2 = dict(srv.drain())
+    np.testing.assert_array_equal(
+        res0[r0], _solo(CFG, lora.merge_lora(prepared, adapters[0]), prompt, 6))
+    np.testing.assert_array_equal(
+        res1[r1], _solo(CFG, lora.merge_lora(prepared, adapters[1]), prompt, 6))
+    np.testing.assert_array_equal(res2[r2], _solo(CFG, prepared, prompt, 6))
+
+
+def test_llama_family_multilora():
+    lcfg = llama.PRESETS["llama-test"]
+    params = llama.init(jax.random.PRNGKey(3), lcfg)
+    prepared = gpt.prepare_stacked(params, lcfg)
+    adapters = _adapters(prepared, seeds=(4,))
+    prompt = np.array([5, 3, 7, 1, 2])
+    srv = ContinuousBatcher(lcfg, prepared, slots=2, max_len=32,
+                            prompt_pad=8, family=llama.LlamaFamilyRows(lcfg),
+                            lora_adapters=adapters)
+    rid = srv.submit(prompt, max_new_tokens=6, adapter=0)
+    res = srv.drain()
+    merged = lora.merge_lora(prepared, adapters[0])
+    want = np.asarray(llama.make_generate(lcfg, max_new_tokens=6)(
+        merged, jnp.asarray(prompt, jnp.int32)[None, :],
+        jax.random.PRNGKey(0)))[0]
+    np.testing.assert_array_equal(res[rid], want)
+
+
+def test_prefix_cache_keys_by_adapter(setup):
+    """K/V depends on the weights that produced it: an adapted request
+    must not reuse a base-model prefix entry (or vice versa), while a
+    same-adapter resubmission must hit."""
+    prepared, adapters = setup
+    prompt = np.arange(1, 33) % CFG.vocab_size  # two full 16-chunks
+    srv = ContinuousBatcher(CFG, prepared, slots=1, max_len=64,
+                            prompt_pad=16, lora_adapters=adapters,
+                            prefix_cache=8)
+    r_base = srv.submit(prompt, max_new_tokens=4)
+    srv.drain()
+    assert srv.prefix_hits == 0
+    r_ad = srv.submit(prompt, max_new_tokens=4, adapter=0)
+    srv.drain()
+    assert srv.prefix_hits == 0, "adapted request reused a base prefix!"
+    r_ad2 = srv.submit(prompt, max_new_tokens=4, adapter=0)
+    res = srv.drain()
+    assert srv.prefix_hits == 1, "same-adapter resubmission should hit"
+    merged = lora.merge_lora(prepared, adapters[0])
+    np.testing.assert_array_equal(res[r_ad2],
+                                  _solo(CFG, merged, prompt, 4))
+
+
+def test_quantized_base_with_adapter_op_level(setup):
+    """QLoRA-style: the delta applies on top of an int8 base linear —
+    linear(quantized + lora view) == linear(quantized) + x @ a @ b."""
+    from dnn_tpu.quant import quantize_tensor
+
+    rng = np.random.RandomState(0)
+    w = jnp.asarray(rng.randn(32, 48), jnp.float32)
+    x = jnp.asarray(rng.randn(2, 3, 32), jnp.float32)
+    a = jnp.asarray(rng.randn(2, 32, 4), jnp.float32) * 0.1  # N=2 adapters
+    b = jnp.asarray(rng.randn(2, 4, 48), jnp.float32) * 0.1
+    sel = jnp.asarray([[1.0, 0.0], [0.0, 1.0]])  # row 0 -> ad 0, row 1 -> ad 1
+    q, scale = quantize_tensor(w)
+    qp = {"q": q, "scale": scale}
+    base = linear(qp, x)
+    got = linear({**qp, "lora": {"a": a, "b": b, "sel": sel}}, x)
+    want = base + jnp.stack([x[0] @ a[0] @ b[0], x[1] @ a[1] @ b[1]])
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=1e-5, rtol=1e-5)
+
+
+def test_adapter_validation(setup):
+    prepared, adapters = setup
+    srv = ContinuousBatcher(CFG, prepared, slots=1, max_len=32,
+                            prompt_pad=8, lora_adapters=adapters)
+    with pytest.raises(ValueError, match="out of range"):
+        srv.submit(np.array([1, 2]), max_new_tokens=2, adapter=2)
+    plain = ContinuousBatcher(CFG, prepared, slots=1, max_len=32,
+                              prompt_pad=8)
+    with pytest.raises(ValueError, match="lora_adapters"):
+        plain.submit(np.array([1, 2]), max_new_tokens=2, adapter=0)
+
+
+def test_speculative_rejects_lora(setup):
+    from dnn_tpu.runtime.serving_spec import SpeculativeBatcher
+
+    prepared, adapters = setup
+    with pytest.raises(ValueError, match="lora_adapters"):
+        SpeculativeBatcher(CFG, prepared, CFG, prepared,
+                           lora_adapters=adapters)
+
+
+def test_trained_artifact_serves_through_stacked_layout(tmp_path):
+    """The full deployment round trip: adapters trained against PER-LAYER
+    params (the training layout), saved/loaded as npz, converted with
+    adapters_to_stacked, served per-request — tokens equal the offline
+    merge of the original artifact."""
+    params = gpt.init(jax.random.PRNGKey(5), CFG)
+    per_layer = lora.init_lora(jax.random.PRNGKey(6), params, rank=4)
+    ks = jax.random.split(jax.random.PRNGKey(7), len(per_layer))
+    for (p, ab), k in zip(sorted(per_layer.items()), ks):
+        ab["b"] = jax.random.normal(k, ab["b"].shape) * 0.02
+    f = str(tmp_path / "ad.npz")
+    lora.save_lora(f, per_layer, alpha=8.0)
+    loaded, alpha = lora.load_lora(f)
+    assert alpha == 8.0
+    stacked_ad = lora.adapters_to_stacked(loaded, CFG.n_layer)
+
+    prepared = gpt.prepare_stacked(params, CFG)
+    prompt = np.arange(3, 11) % CFG.vocab_size
+    srv = ContinuousBatcher(CFG, prepared, slots=1, max_len=48,
+                            prompt_pad=16, lora_adapters=[stacked_ad],
+                            lora_alphas=[alpha])
+    rid = srv.submit(prompt, max_new_tokens=8, adapter=0)
+    got = srv.drain()[rid]
+    # offline reference: merge in the TRAINING layout, then stack
+    merged = gpt.prepare_stacked(
+        lora.merge_lora(params, per_layer, alpha=alpha), CFG)
+    np.testing.assert_array_equal(got, _solo(CFG, merged, prompt, 8))
+
+
+def test_adapters_to_stacked_rejects_partial():
+    params = gpt.init(jax.random.PRNGKey(8), CFG)
+    per_layer = lora.init_lora(jax.random.PRNGKey(9), params, rank=2)
+    partial = {k: v for k, v in per_layer.items() if not k.startswith("h_0")}
+    with pytest.raises(ValueError, match="covers layers"):
+        lora.adapters_to_stacked(partial, CFG.n_layer)
+
+
+def test_stack_loras_validation(setup):
+    prepared, adapters = setup
+    with pytest.raises(ValueError, match="at least one"):
+        lora.stack_loras([])
+    bad = {k: v for k, v in list(adapters[0].items())[:-1]}
+    with pytest.raises(ValueError, match="different leaves"):
+        lora.stack_loras([adapters[0], bad])
+
+
+def test_embedding_adapter_rejected_for_serving(setup):
+    """An embedding-targeted adapter cannot be applied per-request (the
+    delta lives in linear layers); the view must refuse rather than
+    silently serve base embeddings."""
+    prepared, _ = setup
+    ad = lora.init_lora(jax.random.PRNGKey(11), prepared, rank=2,
+                        targets=("wte",))
+    stacked = lora.stack_loras([ad])
+    sel = jnp.asarray([[1.0, 0.0]])
+    with pytest.raises(ValueError, match="embedding"):
+        lora.lora_view(prepared, stacked, sel)
+
+
+def test_cli_serve_adapter_requires_serve_lm(tmp_path):
+    """--serve_adapter outside --serve_lm must error, not silently serve
+    the base model (the CLI's no-silent-drop rule)."""
+    import json
+
+    from dnn_tpu.node import main
+
+    cfg = {"nodes": [{"id": "n0", "part_index": 0}], "num_parts": 1,
+           "model": "gpt2-test"}
+    cfg_path = tmp_path / "config.json"
+    cfg_path.write_text(json.dumps(cfg))
+    rc = main(["--node_id", "n0", "--config", str(cfg_path),
+               "--generate", "4", "--serve_adapter", "whatever.npz"])
+    assert rc == 1
