@@ -43,11 +43,11 @@ def pipeline_budget(num_parts: int, *, margin: float = 30.0) -> float:
 
 
 def _gen_rid(max_new_tokens, seed, temperature, top_k, top_p,
-             adapter=None):
+             adapter=None, min_p=None, repetition_penalty=None):
     """Encode generation options into the request_id the LM daemon parses
     (lm_server.parse_gen_options): positional max_new/seed, then named
-    t=/k=/p= sampling overrides and a= (the per-request LoRA adapter
-    index of a multi-adapter server)."""
+    t=/k=/p=/m=/r= sampling overrides and a= (the per-request LoRA
+    adapter index of a multi-adapter server)."""
     rid = f"gen:{max_new_tokens}" + (f":{seed}" if seed is not None else "")
     if temperature is not None:
         rid += f":t={temperature}"
@@ -55,6 +55,10 @@ def _gen_rid(max_new_tokens, seed, temperature, top_k, top_p,
         rid += f":k={top_k}"
     if top_p is not None:
         rid += f":p={top_p}"
+    if min_p is not None:
+        rid += f":m={min_p}"
+    if repetition_penalty is not None:
+        rid += f":r={repetition_penalty}"
     if adapter is not None:
         rid += f":a={adapter}"
     return rid
@@ -162,18 +166,20 @@ class NodeClient:
         temperature: Optional[float] = None,
         top_k: Optional[int] = None,
         top_p: Optional[float] = None,
+        min_p: Optional[float] = None,
+        repetition_penalty: Optional[float] = None,
         adapter: Optional[int] = None,
         timeout: float = 120.0,
     ) -> np.ndarray:
         """Client path for the LM daemon (dnn_tpu/runtime/lm_server.py):
         prompt token ids -> generated tokens. Options ride the request_id
-        as "gen:max_new[:seed][:t=..][:k=..][:p=..][:a=..]" — the same wire
+        as "gen:max_new[:seed][:t=..][:k=..][:p=..][:m=..][:r=..][:a=..]" — the same wire
         message a reference-built client would send, just with an integer
         payload. Sampling overrides are per request (None = server
         defaults). A request is self-contained (prompt + options), so the
         transport-level retries in send_tensor stay safe here."""
         rid = _gen_rid(max_new_tokens, seed, temperature, top_k, top_p,
-                       adapter)
+                       adapter, min_p, repetition_penalty)
         status, result = self.send_tensor(
             np.asarray(prompt_ids, np.int32).reshape(-1),
             request_id=rid, timeout=timeout,
@@ -191,6 +197,8 @@ class NodeClient:
         temperature: Optional[float] = None,
         top_k: Optional[int] = None,
         top_p: Optional[float] = None,
+        min_p: Optional[float] = None,
+        repetition_penalty: Optional[float] = None,
         adapter: Optional[int] = None,
         timeout: float = 120.0,
     ):
@@ -201,7 +209,7 @@ class NodeClient:
         decodes on to its budget. NOT retried: a stream is stateful (tokens
         already delivered), unlike the self-contained unary generate()."""
         rid = _gen_rid(max_new_tokens, seed, temperature, top_k, top_p,
-                       adapter)
+                       adapter, min_p, repetition_penalty)
         call = self._channel.unary_stream(
             f"/{SERVICE_NAME}/GenerateStream",
             request_serializer=pb.TensorRequest.SerializeToString,
@@ -230,15 +238,17 @@ class NodeClient:
         temperature: Optional[float] = None,
         top_k: Optional[int] = None,
         top_p: Optional[float] = None,
+        min_p: Optional[float] = None,
+        repetition_penalty: Optional[float] = None,
         adapter: Optional[int] = None,
         timeout: float = 120.0,
     ) -> str:
         """Text client for a tokenizer-equipped LM daemon: the prompt rides
         SendMessage's message_text, generation options ride sender_id as
-        "gen:max_new[:seed][:t=..][:k=..][:p=..][:a=..]", and the reply is the
+        "gen:max_new[:seed][:t=..][:k=..][:p=..][:m=..][:r=..][:a=..]", and the reply is the
         generated continuation (lm_server.LMServer.SendMessage)."""
         rid = _gen_rid(max_new_tokens, seed, temperature, top_k, top_p,
-                       adapter)
+                       adapter, min_p, repetition_penalty)
         return self.send_message(rid, prompt, timeout=timeout)
 
     def close(self):

@@ -66,6 +66,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--top_p", type=float, default=None,
                    help="Nucleus (top-p) sampling cutoff for --generate / "
                         "--serve_lm")
+    p.add_argument("--min_p", type=float, default=None,
+                   help="--serve_lm: drop tokens below min_p x the top "
+                        "token's probability (per-request m= overrides)")
+    p.add_argument("--repetition_penalty", type=float, default=None,
+                   help="--serve_lm: HF-style repetition penalty over each "
+                        "request's tokens (per-request r= overrides)")
     p.add_argument("--seed", type=int, default=0,
                    help="Sampling rng seed for --generate")
     p.add_argument("--beam", type=int, default=None, metavar="K",
@@ -280,6 +286,10 @@ def main(argv=None) -> int:
         log.error("--serve_adapter applies to --serve_lm only; to serve a "
                   "single merged fine-tune in other modes use --lora")
         return 1
+    if (args.min_p is not None or args.repetition_penalty is not None) \
+            and not args.serve_lm:
+        log.error("--min_p/--repetition_penalty apply to --serve_lm only")
+        return 1
 
     if args.serve_lm:
         return _serve_lm(engine, args)
@@ -457,7 +467,8 @@ def _serve_lm(engine: PipelineEngine, args) -> int:
             **spec_kwargs,
             max_len=args.max_len, prompt_pad=args.prompt_pad,
             temperature=args.temperature, top_k=args.top_k,
-            top_p=args.top_p,
+            top_p=args.top_p, min_p=args.min_p,
+            repetition_penalty=args.repetition_penalty,
             compute_dtype=engine.compute_dtype, seed=args.seed, ffn=ffn,
             family=family, default_max_new=args.generate or 32,
             tokenizer=tokenizer, prefix_cache=args.prefix_cache,

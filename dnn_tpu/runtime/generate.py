@@ -111,19 +111,35 @@ def forward_with_cache(prepared, ids, cache, start_pos, *, cfg: GPTConfig,
     return logits, new_cache
 
 
+def apply_repetition_penalty(logits, seen, penalty):
+    """CTRL-style repetition penalty on RAW logits (HF semantics, applied
+    before temperature): for tokens already in the sequence (`seen`,
+    (..., V) bool), a positive logit is divided by `penalty` and a
+    negative one multiplied — both push repeated tokens down when
+    penalty > 1. Pure elementwise select: O(V), static shapes."""
+    pen = jnp.where(logits > 0, logits / penalty, logits * penalty)
+    return jnp.where(seen, pen, logits)
+
+
 def _sample(logits, rng, *, temperature: float, top_k: Optional[int],
-            top_p: Optional[float] = None):
+            top_p: Optional[float] = None, min_p: Optional[float] = None):
     """logits (B, V) -> token ids (B,). temperature=0 is greedy; top_k
-    truncates to the k highest logits; top_p (nucleus) keeps the smallest
-    set of tokens whose probability mass reaches p — both static-shape
-    (sort + threshold, no dynamic vocab slicing) and composable (top_k
-    filter first, then the nucleus over what remains)."""
+    truncates to the k highest logits; min_p drops tokens whose
+    probability is below min_p x the top token's (a sort-free relative
+    cutoff — one max + one compare); top_p (nucleus) keeps the smallest
+    set of tokens whose probability mass reaches p. All static-shape
+    (threshold masks, no dynamic vocab slicing) and composable, applied
+    in that order."""
     if temperature == 0.0:
         return jnp.argmax(logits, axis=-1).astype(jnp.int32)
     logits = logits / temperature
     if top_k is not None:
         kth = lax.top_k(logits, top_k)[0][..., -1:]
         logits = jnp.where(logits < kth, _NEG_BIG, logits)
+    if min_p is not None:
+        # prob_i >= min_p * prob_max  <=>  logit_i >= logit_max + log(min_p)
+        mx = jnp.max(logits, axis=-1, keepdims=True)
+        logits = jnp.where(logits < mx + jnp.log(min_p), _NEG_BIG, logits)
     if top_p is not None:
         # The nucleus threshold can only fall inside the highest-probability
         # tokens, so rank just TOP_P_PREFILTER_K candidates (lax.top_k,
@@ -148,11 +164,12 @@ def _sample(logits, rng, *, temperature: float, top_k: Optional[int],
     return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
 
 
-def _sample_rows(logits, keys, *, temperature, top_k, top_p):
+def _sample_rows(logits, keys, *, temperature, top_k, top_p, min_p=None):
     """Per-ROW sampling for the slot pool: every row carries its own
     request's parameters. logits (B, V); keys (B, 2) uint32; temperature
     (B,) f32 (0 = greedy); top_k (B,) int32 (0 = off, clamped to
-    TOP_P_PREFILTER_K); top_p (B,) f32 (outside (0, 1) = off).
+    TOP_P_PREFILTER_K); top_p (B,) f32 (outside (0, 1) = off); min_p
+    (B,) f32 (outside (0, 1] = off; None skips the filter entirely).
 
     Row i with uniform parameters reproduces `_sample`'s draw for the same
     key bit-for-bit — same thresholds (the k-th-largest value and the
@@ -172,6 +189,16 @@ def _sample_rows(logits, keys, *, temperature, top_k, top_p):
         k_idx = jnp.clip(top_k, 1, k_cap) - 1
         kth = jnp.take_along_axis(vals, k_idx[:, None], axis=-1)
         lg = jnp.where((top_k[:, None] > 0) & (lg < kth), _NEG_BIG, lg)
+        if min_p is not None:
+            # per-row relative cutoff (see _sample): rows with min_p
+            # outside (0, 1] pass through untouched (1.0 = keep only
+            # tokens tied with the max, matching _sample's threshold)
+            m_on = (min_p > 0) & (min_p <= 1.0)
+            safe_mp = jnp.where(m_on, min_p, 0.5)
+            mx = jnp.max(lg, axis=-1, keepdims=True)
+            lg = jnp.where(
+                m_on[:, None] & (lg < mx + jnp.log(safe_mp)[:, None]),
+                _NEG_BIG, lg)
         # per-row nucleus: the _sample prefilter with a row-wise p
         pvals = lax.top_k(lg, k_cap)[0]
         lse = jax.scipy.special.logsumexp(lg, axis=-1, keepdims=True)
@@ -411,6 +438,8 @@ def make_pipeline_generate(cfg: GPTConfig, mesh, *, max_new_tokens: int,
 
 def make_generate(cfg: GPTConfig, *, max_new_tokens: int, temperature: float = 0.0,
                   top_k: Optional[int] = None, top_p: Optional[float] = None,
+                  min_p: Optional[float] = None,
+                  repetition_penalty: Optional[float] = None,
                   compute_dtype=None, ffn=None, kv_dtype=None,
                   attn_kernel: bool = False):
     """Build a jitted generate(prepared, ids, rng) -> (B, max_new_tokens).
@@ -423,10 +452,18 @@ def make_generate(cfg: GPTConfig, *, max_new_tokens: int, temperature: float = 0
     jnp.bfloat16 halves cache bandwidth, "int8" quarters it
     (dnn_tpu/runtime/kvcache.py). `attn_kernel=True` streams the cache
     through the Pallas attention kernel on TPU (fused int8 dequant; einsum
-    fallback elsewhere).
+    fallback elsewhere). `min_p` drops tokens below min_p x the top
+    probability; `repetition_penalty` (HF/CTRL semantics) penalizes every
+    token already in the sequence — when set, a (B, V) seen-mask rides
+    the decode carry (scatter per step; only materialized when the
+    penalty is on, so the default program is unchanged).
     """
     if max_new_tokens < 1:
         raise ValueError(f"max_new_tokens must be >= 1, got {max_new_tokens}")
+    if repetition_penalty is not None and repetition_penalty <= 0:
+        raise ValueError(
+            f"repetition_penalty must be > 0, got {repetition_penalty}")
+    pen_on = repetition_penalty is not None and repetition_penalty != 1.0
 
     @functools.partial(jax.jit, static_argnames=())
     def generate(prepared, ids, rng):
@@ -446,22 +483,37 @@ def make_generate(cfg: GPTConfig, *, max_new_tokens: int, temperature: float = 0
             ffn=ffn, attn_kernel=attn_kernel,
         )
         rng, sub = jax.random.split(rng)
-        tok = _sample(logits[:, -1], sub, temperature=temperature, top_k=top_k, top_p=top_p)
+
+        seen = None
+        if pen_on:
+            seen = jnp.zeros((b, cfg.vocab_size), bool)
+            seen = seen.at[jnp.arange(b)[:, None], ids].set(True)
+
+        def pick(lg, seen, sub):
+            if pen_on:
+                lg = apply_repetition_penalty(lg, seen, repetition_penalty)
+            tok = _sample(lg, sub, temperature=temperature, top_k=top_k,
+                          top_p=top_p, min_p=min_p)
+            if pen_on:
+                seen = seen.at[jnp.arange(b), tok].set(True)
+            return tok, seen
+
+        tok, seen = pick(logits[:, -1], seen, sub)
 
         def step(carry, i):
             # carry token tok_i sits at sequence position t + i
-            cache, tok, rng = carry
+            cache, tok, rng, seen = carry
             logits, cache = forward_with_cache(
                 prepared, tok[:, None], cache, t + i, cfg=cfg,
                 compute_dtype=compute_dtype, ffn=ffn,
                 attn_kernel=attn_kernel,
             )
             rng, sub = jax.random.split(rng)
-            nxt = _sample(logits[:, -1], sub, temperature=temperature, top_k=top_k, top_p=top_p)
-            return (cache, nxt, rng), tok
+            nxt, seen = pick(logits[:, -1], seen, sub)
+            return (cache, nxt, rng, seen), tok
 
-        (_, last, _), toks = lax.scan(
-            step, (cache, tok, rng), jnp.arange(max_new_tokens - 1)
+        (_, last, _, _), toks = lax.scan(
+            step, (cache, tok, rng, seen), jnp.arange(max_new_tokens - 1)
         )
         toks = jnp.moveaxis(toks, 0, 1)  # (B, max_new_tokens-1)
         return jnp.concatenate([toks, last[:, None]], axis=1)

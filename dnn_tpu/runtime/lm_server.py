@@ -54,8 +54,9 @@ __all__ = ["LMServer", "serve_lm", "start_lm_server_in_background",
 
 
 def parse_gen_options(request_id: str, default_max_new: int):
-    """'gen[:max_new[:seed]][:t=TEMP][:k=TOPK][:p=TOPP][:a=ADAPTER]' ->
-    (max_new, seed, opts). Only the literal 'gen' prefix carries options —
+    """'gen[:max_new[:seed]][:t=TEMP][:k=TOPK][:p=TOPP][:m=MINP]
+    [:r=REPPEN][:a=ADAPTER]' -> (max_new, seed, opts). Only the literal
+    'gen' prefix carries options —
     any other request_id (e.g. a reference client's tracing id like
     'req:1234') gets the server defaults instead of being reinterpreted as
     a token budget. Positional segments are max_new then seed; named
@@ -68,7 +69,8 @@ def parse_gen_options(request_id: str, default_max_new: int):
     if parts[0] != "gen":
         return max_new, seed, opts
     named = {"t": ("temperature", float), "k": ("top_k", int),
-             "p": ("top_p", float), "a": ("adapter", int)}
+             "p": ("top_p", float), "a": ("adapter", int),
+             "m": ("min_p", float), "r": ("repetition_penalty", float)}
     pos = 0
     for seg in parts[1:]:
         if "=" in seg:

@@ -55,6 +55,7 @@ from dnn_tpu.ops.nn import gelu, layer_norm, linear
 from dnn_tpu.runtime.generate import (
     _qkv_heads,
     _sample_rows,
+    apply_repetition_penalty,
     forward_with_cache,
     init_cache,
 )
@@ -193,7 +194,8 @@ class ContinuousBatcher:
     def __init__(self, cfg: GPTConfig, prepared, *, slots: int = 4,
                  max_len: Optional[int] = None, prompt_pad: Optional[int] = None,
                  temperature: float = 0.0, top_k: Optional[int] = None,
-                 top_p: Optional[float] = None,
+                 top_p: Optional[float] = None, min_p: Optional[float] = None,
+                 repetition_penalty: Optional[float] = None,
                  compute_dtype=None, eos_id: Optional[int] = None, seed: int = 0,
                  ffn=None, kv_dtype=None, family=None,
                  attn_kernel: bool = False, prefix_cache: int = 0,
@@ -233,6 +235,9 @@ class ContinuousBatcher:
         self._default_temp = float(temperature)
         self._default_topk = int(top_k) if top_k else 0
         self._default_topp = float(top_p) if top_p else 0.0
+        self._default_minp = float(min_p) if min_p else 0.0
+        self._default_rep = (float(repetition_penalty)
+                             if repetition_penalty else 1.0)
         # logprobs_k > 0 compiles the step/finish programs to also emit
         # the chosen token's logprob + the top-k (ids, logprobs) per step;
         # a CONSTRUCTION-time choice so the program count stays fixed
@@ -339,6 +344,12 @@ class ContinuousBatcher:
         self._temp = jnp.zeros((slots,), jnp.float32)
         self._topk = jnp.zeros((slots,), jnp.int32)
         self._topp = jnp.zeros((slots,), jnp.float32)
+        self._minp = jnp.zeros((slots,), jnp.float32)
+        self._rep = jnp.ones((slots,), jnp.float32)  # 1.0 = no penalty
+        # per-slot vocabulary seen-mask for the repetition penalty: prompt
+        # tokens scatter in at submit, each committed token per step.
+        # slots x V bools — trivial next to one block of K/V
+        self._seen = jnp.zeros((slots, cfg.vocab_size), bool)
 
         # host bookkeeping
         self._next_rid = 0
@@ -379,24 +390,38 @@ class ContinuousBatcher:
             return chosen_lp, top_lp, top_ids.astype(jnp.int32)
 
         def decode_step(prepared, cache, pos, tok, active, keys,
-                        temp, tk, tp):
+                        temp, tk, tp, mp, rep, seen):
             """Advance every active slot one token (per-slot sampling
-            parameters — see _sample_rows)."""
+            parameters — see _sample_rows; `rep`/`seen` drive the
+            repetition penalty, `mp` the min-p cutoff)."""
             logits, new_cache = self.family.decode_rows(
                 prepared, cache, tok, pos, active, codec)
+            # repetition penalty on raw logits (HF order: before the
+            # temperature/filters inside _sample_rows); rows at the
+            # neutral 1.0 pass through bit-identically. ONE formula for
+            # solo and pool paths: generate.apply_repetition_penalty
+            b = logits.shape[0]
+            rp_on = rep != 1.0
+            lg = apply_repetition_penalty(
+                logits, rp_on[:, None] & seen, rep[:, None])
             # advance each slot's own stream; sample each row with its key
             split = jax.vmap(jax.random.split)(keys)  # (B, 2, 2)
             new_keys, subs = split[:, 0], split[:, 1]
             # inactive slots sample greedy (result discarded below): a
             # RETIRED sampled request's stale temperature must not keep
             # an otherwise-greedy pool on the filtered-sampling branch
-            nxt = _sample_rows(logits, subs,
+            nxt = _sample_rows(lg, subs,
                                temperature=jnp.where(active, temp, 0.0),
-                               top_k=tk, top_p=tp)
+                               top_k=tk, top_p=tp, min_p=mp)
             nxt = jnp.where(active, nxt, tok)
             new_keys = jnp.where(active[:, None], new_keys, keys)
-            out = (new_cache, pos + active.astype(jnp.int32), nxt, new_keys)
+            seen_upd = seen.at[jnp.arange(b), nxt].set(True)
+            new_seen = jnp.where(active[:, None], seen_upd, seen)
+            out = (new_cache, pos + active.astype(jnp.int32), nxt, new_keys,
+                   new_seen)
             if logprobs_k:
+                # logprobs report the MODEL's distribution (pre-penalty,
+                # pre-temperature — the usual serving-API convention)
                 out += _lp_outputs(logits, nxt)
             return out
 
@@ -409,16 +434,21 @@ class ContinuousBatcher:
             return self.family.prefill(prepared, chunk, row, chunk_start)
 
         def prefill_finish(cache, row, logits, last_local, slot, rng,
-                           temp, tk, tp, install_ids):
+                           temp, tk, tp, mp, rep, seen_row, install_ids):
             """Sample the first token from the final chunk's true-last
             logit row and install the finished row cache into `slot`.
-            `install_ids` (paged mode): the per-logical-block physical
-            install targets — shared prefix blocks routed to junk block 0
-            (dense mode receives an empty placeholder)."""
+            `seen_row` (V,) marks the prompt's tokens so the repetition
+            penalty applies to the FIRST sample too. `install_ids` (paged
+            mode): the per-logical-block physical install targets — shared
+            prefix blocks routed to junk block 0 (dense mode receives an
+            empty placeholder)."""
             lg = logits[:, last_local][0:1]  # (1, V)
+            raw = lg
+            lg = apply_repetition_penalty(
+                lg, (rep != 1.0) & seen_row[None, :], rep)
             first = _sample_rows(
                 lg, rng[None], temperature=temp[None], top_k=tk[None],
-                top_p=tp[None],
+                top_p=tp[None], min_p=mp[None],
             )[0]
             # the row cache is chunk-rounded (possibly > max_len); only
             # its first max_len positions install — the overhang holds
@@ -435,7 +465,8 @@ class ContinuousBatcher:
                     for kk in cache
                 }
             if logprobs_k:
-                return (cache, first) + _lp_outputs(lg, first[None])
+                # raw model distribution, as in decode_step
+                return (cache, first) + _lp_outputs(raw, first[None])
             return cache, first
 
         # the transient slot-row cache rounds max_len UP to whole chunks:
@@ -449,7 +480,7 @@ class ContinuousBatcher:
         # whole (L, B, H, S, D) cache (hundreds of MB of HBM traffic per
         # step at real sizes). The call sites reassign from the results,
         # so the donated inputs are never reused.
-        self._decode = jax.jit(decode_step, donate_argnums=(1,))
+        self._decode = jax.jit(decode_step, donate_argnums=(1, 11))
         self._prefill_chunk = jax.jit(prefill_chunk, donate_argnums=(1,))
         self._prefill_finish = jax.jit(prefill_finish, donate_argnums=(0, 1))
         # the decode step's param argument: a lora_view when multi-LoRA is
@@ -496,6 +527,8 @@ class ContinuousBatcher:
                temperature: Optional[float] = None,
                top_k: Optional[int] = None,
                top_p: Optional[float] = None,
+               min_p: Optional[float] = None,
+               repetition_penalty: Optional[float] = None,
                stop: Optional[list] = None,
                logprobs: bool = False,
                adapter: Optional[int] = None) -> int:
@@ -509,7 +542,10 @@ class ContinuousBatcher:
         Per-request options (None = the server constructor's defaults;
         the pool mixes them freely within the same compiled programs):
         `temperature` (0 = greedy), `top_k` (clamped to the static
-        prefilter width, generate.TOP_P_PREFILTER_K), `top_p` (nucleus);
+        prefilter width, generate.TOP_P_PREFILTER_K), `top_p` (nucleus),
+        `min_p` (drop tokens below min_p x the top probability),
+        `repetition_penalty` (HF/CTRL semantics over this request's
+        prompt + generated tokens, tracked in a per-slot seen-mask);
         `stop` — list of token-id sequences: generation retires when the
         emitted stream ends with any of them, the result is trimmed to
         exclude the match, and `finish_reasons[rid]` records "stop"
@@ -537,12 +573,19 @@ class ContinuousBatcher:
         temp = self._default_temp if temperature is None else float(temperature)
         tk = self._default_topk if top_k is None else int(top_k)
         tp = self._default_topp if top_p is None else float(top_p)
+        mp = self._default_minp if min_p is None else float(min_p)
+        rp = (self._default_rep if repetition_penalty is None
+              else float(repetition_penalty))
         if temp < 0:
             raise ValueError(f"temperature must be >= 0, got {temp}")
         if tk < 0:
             raise ValueError(f"top_k must be >= 0, got {tk}")
         if not 0.0 <= tp <= 1.0:
             raise ValueError(f"top_p must be in [0, 1], got {tp}")
+        if not 0.0 <= mp <= 1.0:
+            raise ValueError(f"min_p must be in [0, 1], got {mp}")
+        if rp <= 0:
+            raise ValueError(f"repetition_penalty must be > 0, got {rp}")
         tk = min(tk, TOP_P_PREFILTER_K)
         stop_seqs = []
         for s in (stop or []):
@@ -725,9 +768,13 @@ class ContinuousBatcher:
             t_arr = jnp.float32(temp)
             k_arr = jnp.int32(tk)
             p_arr = jnp.float32(tp)
+            seen_np = np.zeros((self.cfg.vocab_size,), bool)
+            seen_np[prompt] = True
+            seen_row = jnp.asarray(seen_np)
             fin = self._prefill_finish(
                 self.cache, row, logits, last_local, slot, prefill_key,
-                t_arr, k_arr, p_arr,
+                t_arr, k_arr, p_arr, jnp.float32(mp), jnp.float32(rp),
+                seen_row,
                 install_ids if install_ids is not None
                 else jnp.zeros((0,), jnp.int32),
             )
@@ -758,6 +805,10 @@ class ContinuousBatcher:
             self._temp = self._temp.at[slot].set(temp)
             self._topk = self._topk.at[slot].set(tk)
             self._topp = self._topp.at[slot].set(tp)
+            self._minp = self._minp.at[slot].set(mp)
+            self._rep = self._rep.at[slot].set(rp)
+            self._seen = self._seen.at[slot].set(
+                seen_row.at[first].set(True))
             if self._lora is not None and self._aid[slot] != aid:
                 self._aid[slot] = aid
                 self._decode_view = self._lora_prepared(self._aid)
@@ -887,15 +938,16 @@ class ContinuousBatcher:
             return {}
         res = self._decode(
             self._decode_view, self.cache, self.pos, self.tok, self.active,
-            self.keys, self._temp, self._topk, self._topp,
+            self.keys, self._temp, self._topk, self._topp, self._minp,
+            self._rep, self._seen,
         )
         if self._logprobs_k:
-            (self.cache, self.pos, self.tok, self.keys,
+            (self.cache, self.pos, self.tok, self.keys, self._seen,
              c_lp, t_lp, t_ids) = res
             c_lp, t_lp, t_ids = (np.asarray(c_lp), np.asarray(t_lp),
                                  np.asarray(t_ids))
         else:
-            self.cache, self.pos, self.tok, self.keys = res
+            self.cache, self.pos, self.tok, self.keys, self._seen = res
         toks = np.asarray(self.tok)
         out = {}
         for slot, req in enumerate(self._slot_req):

@@ -83,7 +83,8 @@ class SpeculativeBatcher(ContinuousBatcher):
                 f"draft vocab {draft_cfg.vocab_size} != target vocab "
                 f"{cfg.vocab_size}")
         for bad in ("family", "ffn", "paged_blocks", "logprobs_k",
-                    "attn_kernel", "top_p", "lora_adapters"):
+                    "attn_kernel", "top_p", "min_p", "repetition_penalty",
+                    "lora_adapters"):
             if kw.get(bad):
                 raise ValueError(
                     f"SpeculativeBatcher does not support {bad}=")
@@ -244,7 +245,8 @@ class SpeculativeBatcher(ContinuousBatcher):
 
     def submit(self, prompt, max_new_tokens: int,
                seed: Optional[int] = None, **opts) -> int:
-        for bad in ("temperature", "top_k", "top_p", "logprobs"):
+        for bad in ("temperature", "top_k", "top_p", "min_p",
+                    "repetition_penalty", "logprobs"):
             # explicit-None check: temperature=0.0 / top_k=0 are real
             # overrides and must be rejected too, not slip past truthiness
             if opts.get(bad) is not None and opts.get(bad) is not False:

@@ -1,0 +1,202 @@
+"""min-p sampling and repetition penalty (serving sampler extras).
+
+The reference has no sampling at all (argmax over one forward,
+/root/reference/node.py:61); these are the modern serving knobs layered
+onto the framework's samplers. Contracts: min-p restricts the support to
+tokens within min_p x the top probability (sort-free threshold,
+bit-identical to no-op when off); the repetition penalty follows HF/CTRL
+semantics over each request's own tokens, tracked per slot; and every
+knob composes with the pool's per-row mixing without changing any other
+request's stream.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dnn_tpu.models import gpt
+from dnn_tpu.runtime.generate import (
+    _sample,
+    _sample_rows,
+    apply_repetition_penalty,
+    make_generate,
+)
+from dnn_tpu.runtime.serving import ContinuousBatcher
+
+CFG = gpt.PRESETS["gpt2-test"]
+
+
+def _prepared(seed=0):
+    return gpt.prepare_stacked(gpt.init(jax.random.PRNGKey(seed), CFG), CFG)
+
+
+def _prompt(seed, n=6):
+    return np.asarray(jax.random.randint(
+        jax.random.PRNGKey(seed), (n,), 0, CFG.vocab_size, dtype=jnp.int32))
+
+
+# ----------------------------------------------------------------------
+# op level
+# ----------------------------------------------------------------------
+
+def test_repetition_penalty_math():
+    """HF semantics: positive seen logits divide, negative multiply,
+    unseen untouched."""
+    logits = jnp.asarray([[2.0, -1.0, 3.0, -4.0]])
+    seen = jnp.asarray([[True, True, False, False]])
+    out = np.asarray(apply_repetition_penalty(logits, seen, 2.0))
+    np.testing.assert_allclose(out, [[1.0, -2.0, 3.0, -4.0]])
+
+
+def test_min_p_restricts_support():
+    """Every draw must come from tokens with prob >= min_p x max prob."""
+    rng = np.random.default_rng(0)
+    logits = jnp.asarray(rng.standard_normal((1, 64)) * 3, jnp.float32)
+    probs = np.asarray(jax.nn.softmax(logits, axis=-1))[0]
+    min_p = 0.2
+    allowed = set(np.nonzero(probs >= min_p * probs.max())[0])
+    assert 1 <= len(allowed) < 64  # the test must actually restrict
+    keys = jax.vmap(jax.random.PRNGKey)(jnp.arange(200, dtype=jnp.uint32))
+    draws = jax.vmap(
+        lambda k: _sample(logits, k, temperature=1.0, top_k=None,
+                          min_p=min_p)[0])(keys)
+    assert set(np.asarray(draws).tolist()) <= allowed
+
+
+def test_tiny_min_p_is_identity():
+    """A min_p below every relative probability must not perturb draws."""
+    logits = jnp.asarray(
+        np.random.default_rng(1).standard_normal((1, 64)), jnp.float32)
+    k = jax.random.PRNGKey(3)
+    a = _sample(logits, k, temperature=0.9, top_k=None)
+    b = _sample(logits, k, temperature=0.9, top_k=None, min_p=1e-12)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_min_p_one_keeps_only_max_ties_in_both_paths():
+    """The strictest legal setting (min_p=1.0) must behave identically in
+    _sample and _sample_rows: only tokens tied with the max survive."""
+    logits = jnp.asarray([[0.0, 5.0, 5.0, -2.0]], jnp.float32)
+    keys = jax.vmap(jax.random.PRNGKey)(jnp.arange(50, dtype=jnp.uint32))
+    solo = jax.vmap(lambda k: _sample(logits, k, temperature=1.0,
+                                      top_k=None, min_p=1.0)[0])(keys)
+    rows = jax.vmap(lambda k: _sample_rows(
+        logits, k[None],
+        temperature=jnp.ones((1,), jnp.float32),
+        top_k=jnp.zeros((1,), jnp.int32),
+        top_p=jnp.zeros((1,), jnp.float32),
+        min_p=jnp.ones((1,), jnp.float32))[0])(keys)
+    assert set(np.asarray(solo).tolist()) <= {1, 2}
+    np.testing.assert_array_equal(np.asarray(solo), np.asarray(rows))
+
+
+def test_sample_rows_min_p_matches_sample():
+    """Per-row min_p reproduces the solo _sample draw for the same key,
+    mixed with off rows in one call."""
+    logits = jnp.asarray(
+        np.random.default_rng(2).standard_normal((3, 128)) * 3, jnp.float32)
+    keys = jax.vmap(jax.random.PRNGKey)(jnp.arange(3, dtype=jnp.uint32))
+    got = np.asarray(_sample_rows(
+        logits, keys,
+        temperature=jnp.asarray([1.0, 1.0, 1.0], jnp.float32),
+        top_k=jnp.zeros((3,), jnp.int32),
+        top_p=jnp.zeros((3,), jnp.float32),
+        min_p=jnp.asarray([0.3, 0.0, 0.05], jnp.float32)))
+    for i, mp in enumerate((0.3, None, 0.05)):
+        want = _sample(logits[i][None], keys[i], temperature=1.0,
+                       top_k=None, min_p=mp)[0]
+        assert got[i] == int(want), i
+
+
+# ----------------------------------------------------------------------
+# decode loops
+# ----------------------------------------------------------------------
+
+def test_greedy_repetition_penalty_suppresses_repeats():
+    """With a heavy penalty a greedy stream cannot re-emit a token (its
+    positive logit collapses); the unpenalized stream on the same weights
+    repeats — the knob's observable purpose."""
+    prepared = _prepared(seed=4)
+    prompt = _prompt(5, n=4)
+    n_new = 12
+    plain = np.asarray(make_generate(CFG, max_new_tokens=n_new)(
+        prepared, jnp.asarray(prompt)[None], jax.random.PRNGKey(0)))[0]
+    assert len(set(plain.tolist())) < n_new, (
+        "test premise: the unpenalized greedy stream should repeat "
+        "(pick another seed)")
+    pen = np.asarray(make_generate(CFG, max_new_tokens=n_new,
+                                   repetition_penalty=50.0)(
+        prepared, jnp.asarray(prompt)[None], jax.random.PRNGKey(0)))[0]
+    assert len(set(pen.tolist())) > len(set(plain.tolist()))
+
+
+def test_batcher_matches_solo_with_penalty():
+    """The batcher's per-slot seen-mask path == make_generate's carry
+    path (two independent trackers, one definition), greedy."""
+    prepared = _prepared(seed=6)
+    prompt = _prompt(7, n=5)
+    n_new = 10
+    want = np.asarray(make_generate(CFG, max_new_tokens=n_new,
+                                    repetition_penalty=1.8)(
+        prepared, jnp.asarray(prompt)[None], jax.random.PRNGKey(0)))[0]
+    srv = ContinuousBatcher(CFG, prepared, slots=2, max_len=64,
+                            prompt_pad=16)
+    rid = srv.submit(prompt, max_new_tokens=n_new, repetition_penalty=1.8)
+    np.testing.assert_array_equal(srv.drain()[rid], want)
+
+
+def test_penalized_request_does_not_disturb_neighbors():
+    """A penalty/min_p request next to a plain greedy one leaves the
+    plain stream bit-identical to solo."""
+    prepared = _prepared(seed=8)
+    prompt = _prompt(9, n=5)
+    n_new = 8
+    want = np.asarray(make_generate(CFG, max_new_tokens=n_new)(
+        prepared, jnp.asarray(prompt)[None], jax.random.PRNGKey(0)))[0]
+    srv = ContinuousBatcher(CFG, prepared, slots=3, max_len=64,
+                            prompt_pad=16)
+    rid = srv.submit(prompt, max_new_tokens=n_new)
+    srv.submit(_prompt(10), max_new_tokens=n_new, repetition_penalty=3.0,
+               temperature=0.9, min_p=0.1, seed=5)
+    np.testing.assert_array_equal(srv.drain()[rid], want)
+
+
+def test_seeded_min_p_request_pool_independent():
+    """A seeded sampled request with min_p + penalty reproduces its own
+    stream regardless of pool contents."""
+    prepared = _prepared(seed=11)
+    prompt = _prompt(12, n=5)
+    kw = dict(max_new_tokens=7, seed=13, temperature=0.9, min_p=0.15,
+              repetition_penalty=1.4)
+    srv_a = ContinuousBatcher(CFG, prepared, slots=1, max_len=64)
+    ra = srv_a.submit(prompt, **kw)
+    alone = srv_a.drain()[ra]
+    srv_b = ContinuousBatcher(CFG, prepared, slots=3, max_len=64)
+    srv_b.submit(_prompt(14), max_new_tokens=9, temperature=1.2, seed=1)
+    rb = srv_b.submit(prompt, **kw)
+    srv_b.submit(_prompt(15), max_new_tokens=3)
+    np.testing.assert_array_equal(alone, srv_b.drain()[rb])
+
+
+def test_option_validation():
+    prepared = _prepared()
+    srv = ContinuousBatcher(CFG, prepared, slots=1, max_len=32)
+    with pytest.raises(ValueError, match="min_p"):
+        srv.submit(_prompt(0), max_new_tokens=2, min_p=1.5)
+    with pytest.raises(ValueError, match="repetition_penalty"):
+        srv.submit(_prompt(0), max_new_tokens=2, repetition_penalty=0.0)
+    with pytest.raises(ValueError, match="repetition_penalty"):
+        make_generate(CFG, max_new_tokens=2, repetition_penalty=-1.0)
+
+
+def test_speculative_rejects_extras():
+    from dnn_tpu.runtime.serving_spec import SpeculativeBatcher
+
+    prepared = _prepared()
+    srv = SpeculativeBatcher(CFG, prepared, CFG, prepared, slots=1,
+                             max_len=32)
+    with pytest.raises(ValueError, match="min_p"):
+        srv.submit(_prompt(0), max_new_tokens=2, min_p=0.2)
+    with pytest.raises(ValueError, match="repetition_penalty"):
+        srv.submit(_prompt(0), max_new_tokens=2, repetition_penalty=2.0)
