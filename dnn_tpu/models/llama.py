@@ -69,6 +69,17 @@ class LlamaConfig:
     # exactly `sliding_window` positions (solo generate) — both
     # attention-equivalent (runtime/kvcache.py docstring).
     sliding_window: Optional[int] = None
+    # Long-context RoPE scaling (set block_size to the EXTENDED length):
+    #   "linear" — positions divided by rope_scale before the tables
+    #     (position interpolation; HF rope_scaling type "linear");
+    #   "ntk" — theta multiplied by rope_scale^(d/(d-2)) (NTK-aware base
+    #     stretch: high frequencies keep local resolution, low
+    #     frequencies interpolate).
+    # Every RoPE site goes through _rope_tables, so the dense forward,
+    # cached/ring decode, batcher rows, and seq-parallel ring all scale
+    # identically.
+    rope_scaling: Optional[str] = None
+    rope_scale: float = 1.0
 
     @property
     def head_dim(self):
@@ -151,6 +162,36 @@ def init(rng, cfg: LlamaConfig = PRESETS["llama-test"], dtype=jnp.float32):
 # forward
 # --------------------------------------------------------------------------
 
+def _rope_tables(cfg: LlamaConfig, positions):
+    """cos/sin at `positions` with the config's long-context scaling
+    applied — the ONE place scaling happens, shared by every attention
+    path (dense, cached decode, batcher rows, seq-parallel ring)."""
+    theta = cfg.rope_theta
+    if cfg.rope_scaling is None:
+        if cfg.rope_scale != 1.0:
+            # the likely long-context typo: factor set, type forgotten —
+            # serving an unscaled model here would silently collapse
+            # quality past the trained range
+            raise ValueError(
+                f"rope_scale={cfg.rope_scale} has no effect without "
+                "rope_scaling='linear' or 'ntk'")
+        return rope_cos_sin(positions, cfg.head_dim, theta=theta)
+    if cfg.rope_scaling not in ("linear", "ntk"):
+        raise ValueError(
+            f"unknown rope_scaling {cfg.rope_scaling!r} "
+            "(expected 'linear' or 'ntk')")
+    if cfg.rope_scale == 1.0:
+        return rope_cos_sin(positions, cfg.head_dim, theta=theta)
+    if cfg.rope_scale < 1.0:
+        raise ValueError(f"rope_scale must be >= 1, got {cfg.rope_scale}")
+    if cfg.rope_scaling == "linear":
+        positions = positions.astype(jnp.float32) / cfg.rope_scale
+    else:  # "ntk"
+        d = cfg.head_dim
+        theta = theta * cfg.rope_scale ** (d / (d - 2))
+    return rope_cos_sin(positions, cfg.head_dim, theta=theta)
+
+
 def _qkv_rope(bp, h, positions, *, cfg: LlamaConfig, compute_dtype):
     """Project h (B, T, C) and rotate q/k at absolute `positions` (T,).
     Returns q (B, H, T, D), k/v (B, KV, T, D) — KV heads stay narrow."""
@@ -160,7 +201,7 @@ def _qkv_rope(bp, h, positions, *, cfg: LlamaConfig, compute_dtype):
                     cfg.n_kv_head)
     v = split_heads(linear(bp["attn"]["v"], h, compute_dtype=compute_dtype),
                     cfg.n_kv_head)
-    cos, sin = rope_cos_sin(positions, cfg.head_dim, theta=cfg.rope_theta)
+    cos, sin = _rope_tables(cfg, positions)
     return apply_rope(q, cos, sin), apply_rope(k, cos, sin), v
 
 
@@ -683,7 +724,7 @@ class LlamaFamilyRows:
                         kv)
         v = split_heads(linear(bp["attn"]["v"], h, compute_dtype=compute_dtype),
                         kv)
-        cos, sin = rope_cos_sin(pos, d, theta=cfg.rope_theta)  # (B, D)
+        cos, sin = _rope_tables(cfg, pos)  # (B, D)
         cos, sin = cos[:, None, None, :], sin[:, None, None, :]
         q, k = apply_rope(q, cos, sin), apply_rope(k, cos, sin)
         layer_cache = codec.write_rows(layer_cache, k, v, pos, write)
@@ -829,6 +870,15 @@ def to_hf_config(cfg: LlamaConfig, *, tie_word_embeddings: bool = False,
         rms_norm_eps=cfg.rms_eps,
         tie_word_embeddings=tie_word_embeddings,
     )
+    if cfg.rope_scaling == "linear" and cfg.rope_scale != 1.0:
+        kw["rope_scaling"] = {"rope_type": "linear",
+                              "factor": cfg.rope_scale}
+    elif cfg.rope_scaling == "ntk" and cfg.rope_scale != 1.0:
+        # transformers has no STATIC ntk type (its "dynamic" rescales
+        # with runtime length) — an equivalent HF config is theta
+        # pre-multiplied, which we emit rather than a silent mismatch
+        kw["rope_theta"] = cfg.rope_theta * cfg.rope_scale ** (
+            cfg.head_dim / (cfg.head_dim - 2))
     if cfg.sliding_window is not None:
         kw.update(sliding_window=cfg.sliding_window, head_dim=cfg.head_dim)
         kw.update(overrides)  # after defaults: overrides must win

@@ -463,6 +463,10 @@ def make_generate(cfg: GPTConfig, *, max_new_tokens: int, temperature: float = 0
     if repetition_penalty is not None and repetition_penalty <= 0:
         raise ValueError(
             f"repetition_penalty must be > 0, got {repetition_penalty}")
+    if min_p is not None and not 0.0 <= min_p <= 1.0:
+        # min_p > 1 would mask EVERY token (threshold above the max
+        # logit) and categorical would then draw uniformly — reject loud
+        raise ValueError(f"min_p must be in [0, 1], got {min_p}")
     pen_on = repetition_penalty is not None and repetition_penalty != 1.0
 
     @functools.partial(jax.jit, static_argnames=())

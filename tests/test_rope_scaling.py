@@ -1,0 +1,132 @@
+"""Long-context RoPE scaling (linear position interpolation + NTK-aware
+base stretch) for the LLaMA family.
+
+Cross-checks: scale 1 is a bit-exact no-op; linear scaling matches
+transformers' rope_scaling={"rope_type": "linear"} logits; the NTK form
+matches an HF model whose theta is pre-multiplied by scale^(d/(d-2));
+and the cached decode (the path serving actually runs) stays
+token-identical to the dense forward under scaling — every RoPE site
+goes through one table builder (llama._rope_tables).
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dnn_tpu.models import gpt, llama
+
+BASE = llama.PRESETS["llama-test"]
+
+
+def _params(seed=0, cfg=BASE):
+    return llama.init(jax.random.PRNGKey(seed), cfg)
+
+
+def test_scale_one_is_identity():
+    params = _params()
+    ids = jax.random.randint(jax.random.PRNGKey(1), (2, 12), 0,
+                             BASE.vocab_size)
+    want = np.asarray(llama.make_apply(BASE)(params, ids))
+    for kind in ("linear", "ntk"):
+        cfg = dataclasses.replace(BASE, rope_scaling=kind, rope_scale=1.0)
+        got = np.asarray(llama.make_apply(cfg)(params, ids))
+        np.testing.assert_array_equal(got, want)
+
+
+def test_unknown_scaling_rejected():
+    cfg = dataclasses.replace(BASE, rope_scaling="yarn", rope_scale=2.0)
+    with pytest.raises(ValueError, match="rope_scaling"):
+        llama.make_apply(cfg)(_params(), jnp.zeros((1, 4), jnp.int32))
+    bad = dataclasses.replace(BASE, rope_scaling="linear", rope_scale=0.5)
+    with pytest.raises(ValueError, match="rope_scale"):
+        llama.make_apply(bad)(_params(), jnp.zeros((1, 4), jnp.int32))
+    # factor set but type forgotten — the likely long-context typo
+    half = dataclasses.replace(BASE, rope_scale=4.0)
+    with pytest.raises(ValueError, match="no effect"):
+        llama.make_apply(half)(_params(), jnp.zeros((1, 4), jnp.int32))
+
+
+def test_solo_min_p_validated():
+    from dnn_tpu.models import gpt as gpt_mod
+    from dnn_tpu.runtime.generate import make_generate
+
+    with pytest.raises(ValueError, match="min_p"):
+        make_generate(gpt_mod.PRESETS["gpt2-test"], max_new_tokens=2,
+                      min_p=1.5)
+
+
+@pytest.mark.parametrize("kind", ["linear", "ntk"])
+def test_hf_parity_under_scaling(kind):
+    torch = pytest.importorskip("torch")
+    transformers = pytest.importorskip("transformers")
+
+    # extended context: 2x the trained block size via scaling
+    cfg = dataclasses.replace(BASE, block_size=BASE.block_size * 2,
+                              rope_scaling=kind, rope_scale=2.0)
+    hf_cfg = llama.to_hf_config(cfg, attn_implementation="eager")
+    if kind == "linear":
+        assert hf_cfg.rope_scaling["factor"] == 2.0
+    else:
+        assert hf_cfg.rope_theta > cfg.rope_theta  # pre-multiplied base
+    torch.manual_seed(0)
+    model = transformers.LlamaForCausalLM(hf_cfg).eval()
+    sd = {k: v.numpy() for k, v in model.state_dict().items()}
+
+    from dnn_tpu.io.checkpoint import llama_params_from_state_dict
+
+    params = llama_params_from_state_dict(sd)
+    t = BASE.block_size + 16  # past the ORIGINAL context length
+    ids = np.random.RandomState(1).randint(0, cfg.vocab_size, (2, t))
+    with torch.no_grad():
+        want = model(torch.from_numpy(ids)).logits.numpy()
+    got = np.asarray(llama.make_apply(cfg)(params, jnp.asarray(ids)))
+    np.testing.assert_allclose(got, want, atol=2e-3, rtol=2e-3)
+    np.testing.assert_array_equal(got.argmax(-1), want.argmax(-1))
+
+
+@pytest.mark.parametrize("kind", ["linear", "ntk"])
+def test_cached_decode_matches_dense_under_scaling(kind):
+    """Greedy cached decode past the original context == full dense
+    recompute — the decode path's per-position tables scale exactly like
+    the prefill's."""
+    cfg = dataclasses.replace(BASE, block_size=BASE.block_size * 2,
+                              rope_scaling=kind, rope_scale=2.0)
+    params = _params(seed=3, cfg=cfg)
+    prepared = gpt.prepare_stacked(params, cfg)
+    apply_fn = llama.make_apply(cfg)
+    t = BASE.block_size - 2  # prompt near the original limit
+    ids = jax.random.randint(jax.random.PRNGKey(4), (1, t), 0,
+                             cfg.vocab_size)
+    n_new = 8  # decode crosses the original block_size
+    got = np.asarray(llama.make_generate(cfg, max_new_tokens=n_new)(
+        prepared, ids, jax.random.PRNGKey(0)))
+    cur = np.asarray(ids)
+    want = []
+    for _ in range(n_new):
+        logits = apply_fn(params, jnp.asarray(cur))
+        nxt = np.asarray(jnp.argmax(logits[:, -1], -1)).astype(np.int32)
+        want.append(nxt)
+        cur = np.concatenate([cur, nxt[:, None]], axis=1)
+    np.testing.assert_array_equal(got, np.stack(want, axis=1))
+
+
+def test_batcher_scaled_matches_solo():
+    """The batcher's per-slot rope (LlamaFamilyRows._block_rows) uses the
+    same scaled tables as the solo decoder."""
+    from dnn_tpu.runtime.serving import ContinuousBatcher
+
+    cfg = dataclasses.replace(BASE, rope_scaling="linear", rope_scale=2.0)
+    params = _params(seed=5, cfg=cfg)
+    prepared = gpt.prepare_stacked(params, cfg)
+    prompt = np.array([5, 3, 7, 1, 2])
+    srv = ContinuousBatcher(cfg, prepared, slots=2, max_len=32,
+                            prompt_pad=8, family=llama.LlamaFamilyRows(cfg))
+    rid = srv.submit(prompt, max_new_tokens=6)
+    got = srv.drain()[rid]
+    want = np.asarray(llama.make_generate(cfg, max_new_tokens=6)(
+        prepared, jnp.asarray(prompt, jnp.int32)[None, :],
+        jax.random.PRNGKey(0)))[0]
+    np.testing.assert_array_equal(got, want)
