@@ -181,6 +181,56 @@ def run_device_section():
         del ll_q
         del ll_prep  # 2.2 GB of bf16 weights — free before the GPT rows
 
+        # Sliding-window ring decode (models/llama.py rolling path) — the
+        # Mistral-class long-context claim, measured as a mechanism bench:
+        # at s_max = 3x the window the ring streams W cache positions per
+        # step while the dense cache streams s_max. GQA caches are small
+        # next to the weights (the matrix above shows why), so the
+        # comparison runs an MHA-width variant (n_kv_head = n_head) of
+        # the TinyLlama shape where the cache is ~half the decode traffic
+        # — random-init throughput probe, labeled as such.
+        import dataclasses as _dc
+
+        swb, swprompt, swnew, sww = 8, 1024, 512, 512
+        sw_smax = swprompt + swnew
+        mha_cfg = _dc.replace(ll_cfg, n_kv_head=ll_cfg.n_head,
+                              block_size=2048)
+        sw_prep = gpt.prepare_stacked(
+            llama.init(jax.random.PRNGKey(7), mha_cfg, dtype=jnp.bfloat16),
+            mha_cfg)
+        sw_ids = jax.random.randint(jax.random.PRNGKey(8), (swb, swprompt),
+                                    0, mha_cfg.vocab_size, dtype=jnp.int32)
+        for name, cfg_v, cache_pos in (
+                ("dense", mha_cfg, sw_smax),
+                ("ring", _dc.replace(mha_cfg, sliding_window=sww), sww)):
+            gfn = llama.make_generate(
+                cfg_v, max_new_tokens=swnew, compute_dtype=jnp.bfloat16,
+                kv_dtype=jnp.bfloat16)
+            # the 1024-token prefill would dilute a whole-call rate (the
+            # prompt=16 matrix rows can ignore this; here it is ~10% of
+            # the call): subtract a max_new=1 run so tps counts DECODE
+            # steps against decode time
+            gfn1 = llama.make_generate(
+                cfg_v, max_new_tokens=1, compute_dtype=jnp.bfloat16,
+                kv_dtype=jnp.bfloat16)
+            dt_full = device_time(gfn, sw_prep, sw_ids, rng_d, n1=1, n2=2)
+            dt_pre = device_time(gfn1, sw_prep, sw_ids, rng_d, n1=1, n2=2)
+            dt = max(dt_full - dt_pre, 1e-9)
+            tps = swb * (swnew - 1) / dt
+            cache_bytes = (2 * cfg_v.n_layer * swb * cfg_v.n_kv_head
+                           * cfg_v.head_dim * cache_pos) * 2
+            bpt = (_pb(sw_prep) + cache_bytes) / swb
+            row = {"bytes_per_token_mb": round(bpt / 1e6, 2)}
+            u = _mbu(bpt, tps)
+            if u is not None:
+                row["mbu"] = round(u, 4)
+            _emit(results, config=f"llama_mha_longctx_decode_{name}",
+                  metric="tokens_per_sec", value=round(tps, 1),
+                  platform=platform, batch=swb, prompt=swprompt,
+                  new_tokens=swnew,
+                  window=(sww if cfg_v.sliding_window else 0), **row)
+        del sw_prep
+
     # Training step (fwd + bwd + adamw update) — nothing else in the table
     # measures the backward pass. bf16 compute, f32 params/optimizer, the
     # single-chip form of train.make_train_step (the dp x tp and pipeline

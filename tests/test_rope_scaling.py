@@ -49,15 +49,6 @@ def test_unknown_scaling_rejected():
         llama.make_apply(half)(_params(), jnp.zeros((1, 4), jnp.int32))
 
 
-def test_solo_min_p_validated():
-    from dnn_tpu.models import gpt as gpt_mod
-    from dnn_tpu.runtime.generate import make_generate
-
-    with pytest.raises(ValueError, match="min_p"):
-        make_generate(gpt_mod.PRESETS["gpt2-test"], max_new_tokens=2,
-                      min_p=1.5)
-
-
 @pytest.mark.parametrize("kind", ["linear", "ntk"])
 def test_hf_parity_under_scaling(kind):
     torch = pytest.importorskip("torch")

@@ -184,6 +184,8 @@ def test_option_validation():
     srv = ContinuousBatcher(CFG, prepared, slots=1, max_len=32)
     with pytest.raises(ValueError, match="min_p"):
         srv.submit(_prompt(0), max_new_tokens=2, min_p=1.5)
+    with pytest.raises(ValueError, match="min_p"):
+        make_generate(CFG, max_new_tokens=2, min_p=1.5)  # solo path too
     with pytest.raises(ValueError, match="repetition_penalty"):
         srv.submit(_prompt(0), max_new_tokens=2, repetition_penalty=0.0)
     with pytest.raises(ValueError, match="repetition_penalty"):
