@@ -200,6 +200,114 @@ def latest_checkpoint(ckpt_dir: str) -> Optional[Tuple[str, int]]:
     return best
 
 
+class AsyncCheckpointer:
+    """Overlap checkpoint IO with training.
+
+    `save()` snapshots the state to HOST memory synchronously (the
+    device-to-host copies — cheap next to the npz serialization + disk
+    write) and hands the copies to one background writer thread running
+    the same atomic `save_train_state`. The training loop keeps stepping
+    while the write happens; the snapshot copy also makes saving safe
+    under buffer donation (the step may invalidate the device buffers the
+    moment it runs — the host copy is already taken).
+
+    One writer, bounded in-flight count: at most `max_pending` snapshots
+    exist between enqueue and commit — the (max_pending+1)-th `save()`
+    BLOCKS before even taking its host copy (backpressure: checkpoints
+    are ordered, and a train loop outrunning the disk should feel it
+    rather than accumulate multi-GB host copies). A failed write
+    re-raises on the NEXT `save()`/`wait()` call, so errors surface in
+    the loop that caused them. Call `wait()` before reading
+    `latest_checkpoint` (or exiting) — a checkpoint is visible only
+    after its writer-side atomic rename.
+    """
+
+    def __init__(self, max_pending: int = 1):
+        import queue
+        import threading
+
+        if max_pending < 1:
+            raise ValueError("max_pending must be >= 1")
+        self._q: "queue.Queue" = queue.Queue()
+        # bounds snapshots alive (queued + being written), not queue slots
+        # — a maxsize'd queue alone under-counts the one the worker holds
+        self._slots = threading.Semaphore(max_pending)
+        self._err: Optional[BaseException] = None
+        self._err_lock = threading.Lock()
+        self._closed = False
+
+        def run():
+            while True:
+                item = self._q.get()
+                try:
+                    if item is None:
+                        return
+                    ckpt_dir, step, host_state, compress = item
+                    try:
+                        save_train_state(ckpt_dir, step, host_state,
+                                         compress_bf16=compress)
+                    except BaseException as e:  # noqa: BLE001 — held for caller
+                        with self._err_lock:
+                            if self._err is None:
+                                self._err = e
+                    finally:
+                        self._slots.release()
+                finally:
+                    self._q.task_done()
+
+        self._worker = threading.Thread(target=run, daemon=True,
+                                        name="ckpt-writer")
+        self._worker.start()
+
+    def _raise_pending(self):
+        with self._err_lock:
+            err, self._err = self._err, None
+        if err is not None:
+            raise RuntimeError("async checkpoint write failed") from err
+
+    def save(self, ckpt_dir: str, step: int, state, *,
+             compress_bf16: bool = False) -> None:
+        """Snapshot `state` to host and enqueue the write. Blocks only for
+        the device-to-host copies (and for queue space when the previous
+        write is still in flight)."""
+        if self._closed:
+            raise RuntimeError("AsyncCheckpointer is closed")
+        self._raise_pending()
+        self._slots.acquire()  # backpressure BEFORE the host copy
+        try:
+            # np.array (not asarray): numpy leaves and zero-copy
+            # CPU-backed jax.Arrays must be REAL copies, or an in-place /
+            # donated update could mutate the snapshot mid-write
+            host_state = jax.tree_util.tree_map(
+                lambda x: np.array(x, copy=True), state)
+            self._q.put((ckpt_dir, step, host_state, compress_bf16))
+        except BaseException:
+            self._slots.release()
+            raise
+
+    def wait(self) -> None:
+        """Block until every enqueued write has committed (atomic rename
+        done); re-raise the first failure if any write died."""
+        self._q.join()
+        self._raise_pending()
+
+    def close(self) -> None:
+        """Drain outstanding writes and stop the worker. Idempotent."""
+        if self._closed:
+            return
+        self._q.join()
+        self._closed = True
+        self._q.put(None)
+        self._worker.join(timeout=60)
+        self._raise_pending()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
 def cleanup_old_checkpoints(ckpt_dir: str, keep: int = 3) -> int:
     """Delete all but the newest `keep` COMPLETE checkpoints (npz+manifest
     pairs — the same completeness rule latest_checkpoint applies), plus any

@@ -214,3 +214,71 @@ def test_fit_resume_matches_uninterrupted():
         ),
         final, ref_state,
     )
+
+
+# ----------------------------------------------------------------------
+# async checkpointing
+# ----------------------------------------------------------------------
+
+def test_async_save_snapshot_semantics(tmp_path):
+    """The write captures the state AT save() time: later updates (or
+    donation invalidating the device buffers) cannot leak in, and the
+    restored tree equals the snapshot bit-for-bit."""
+    from dnn_tpu.io.train_ckpt import AsyncCheckpointer
+
+    state0 = {"w": jnp.arange(8.0), "step": jnp.int32(0)}
+    with AsyncCheckpointer() as ck:
+        ck.save(str(tmp_path), 1, state0)
+        # the caller immediately moves on (as a train loop would)
+        state1 = jax.tree.map(lambda x: x + 100, state0)
+        ck.save(str(tmp_path), 2, state1)
+        ck.wait()
+        got1, s1 = restore_train_state(str(tmp_path), state0, step=1)
+        got2, s2 = restore_train_state(str(tmp_path), state0, step=2)
+    _assert_trees_equal(got1, state0)
+    _assert_trees_equal(got2, state1)
+    path, step = latest_checkpoint(str(tmp_path))
+    assert step == 2
+
+
+def test_async_error_surfaces_on_wait(tmp_path):
+    """A writer-side failure must raise in the caller's loop, not vanish
+    in the background thread."""
+    from dnn_tpu.io.train_ckpt import AsyncCheckpointer
+
+    blocker = tmp_path / "not_a_dir"
+    blocker.write_text("file where the ckpt dir should go")
+    ck = AsyncCheckpointer()
+    ck.save(str(blocker), 1, {"w": jnp.ones((2,))})
+    with pytest.raises(RuntimeError, match="async checkpoint"):
+        ck.wait()
+    # the checkpointer recovers: a good save afterwards works
+    ck.save(str(tmp_path), 2, {"w": jnp.ones((2,))})
+    ck.close()
+    assert latest_checkpoint(str(tmp_path))[1] == 2
+
+
+def test_async_close_is_idempotent_and_rejects_after(tmp_path):
+    from dnn_tpu.io.train_ckpt import AsyncCheckpointer
+
+    ck = AsyncCheckpointer()
+    ck.save(str(tmp_path), 5, {"w": jnp.zeros((3,))})
+    ck.close()
+    ck.close()
+    with pytest.raises(RuntimeError, match="closed"):
+        ck.save(str(tmp_path), 6, {"w": jnp.zeros((3,))})
+    assert latest_checkpoint(str(tmp_path))[1] == 5
+
+
+def test_async_save_copies_numpy_leaves(tmp_path):
+    """Host-side (numpy) leaves must be COPIED at save() time — an
+    in-place mutation after save() cannot leak into the checkpoint."""
+    from dnn_tpu.io.train_ckpt import AsyncCheckpointer
+
+    w = np.arange(6.0)
+    with AsyncCheckpointer() as ck:
+        ck.save(str(tmp_path), 1, {"w": w})
+        w[:] = -1.0  # in-place, after save
+        ck.wait()
+    got, _ = restore_train_state(str(tmp_path), {"w": np.zeros(6)}, step=1)
+    np.testing.assert_array_equal(got["w"], np.arange(6.0))
