@@ -43,7 +43,8 @@ def pipeline_budget(num_parts: int, *, margin: float = 30.0) -> float:
 
 
 def _gen_rid(max_new_tokens, seed, temperature, top_k, top_p,
-             adapter=None, min_p=None, repetition_penalty=None):
+             adapter=None, min_p=None, repetition_penalty=None,
+             logit_bias=None):
     """Encode generation options into the request_id the LM daemon parses
     (lm_server.parse_gen_options): positional max_new/seed, then named
     t=/k=/p=/m=/r= sampling overrides and a= (the per-request LoRA
@@ -59,6 +60,10 @@ def _gen_rid(max_new_tokens, seed, temperature, top_k, top_p,
         rid += f":m={min_p}"
     if repetition_penalty is not None:
         rid += f":r={repetition_penalty}"
+    if logit_bias:
+        pairs = ",".join(f"{int(t)}~{float(v)}"
+                         for t, v in logit_bias.items())
+        rid += f":b={pairs}"
     if adapter is not None:
         rid += f":a={adapter}"
     return rid
@@ -168,18 +173,19 @@ class NodeClient:
         top_p: Optional[float] = None,
         min_p: Optional[float] = None,
         repetition_penalty: Optional[float] = None,
+        logit_bias: Optional[dict] = None,
         adapter: Optional[int] = None,
         timeout: float = 120.0,
     ) -> np.ndarray:
         """Client path for the LM daemon (dnn_tpu/runtime/lm_server.py):
         prompt token ids -> generated tokens. Options ride the request_id
-        as "gen:max_new[:seed][:t=..][:k=..][:p=..][:m=..][:r=..][:a=..]" — the same wire
+        as "gen:max_new[:seed][:t=..][:k=..][:p=..][:m=..][:r=..][:b=..][:a=..]" — the same wire
         message a reference-built client would send, just with an integer
         payload. Sampling overrides are per request (None = server
         defaults). A request is self-contained (prompt + options), so the
         transport-level retries in send_tensor stay safe here."""
         rid = _gen_rid(max_new_tokens, seed, temperature, top_k, top_p,
-                       adapter, min_p, repetition_penalty)
+                       adapter, min_p, repetition_penalty, logit_bias)
         status, result = self.send_tensor(
             np.asarray(prompt_ids, np.int32).reshape(-1),
             request_id=rid, timeout=timeout,
@@ -199,6 +205,7 @@ class NodeClient:
         top_p: Optional[float] = None,
         min_p: Optional[float] = None,
         repetition_penalty: Optional[float] = None,
+        logit_bias: Optional[dict] = None,
         adapter: Optional[int] = None,
         timeout: float = 120.0,
     ):
@@ -209,7 +216,7 @@ class NodeClient:
         decodes on to its budget. NOT retried: a stream is stateful (tokens
         already delivered), unlike the self-contained unary generate()."""
         rid = _gen_rid(max_new_tokens, seed, temperature, top_k, top_p,
-                       adapter, min_p, repetition_penalty)
+                       adapter, min_p, repetition_penalty, logit_bias)
         call = self._channel.unary_stream(
             f"/{SERVICE_NAME}/GenerateStream",
             request_serializer=pb.TensorRequest.SerializeToString,
@@ -240,15 +247,16 @@ class NodeClient:
         top_p: Optional[float] = None,
         min_p: Optional[float] = None,
         repetition_penalty: Optional[float] = None,
+        logit_bias: Optional[dict] = None,
         adapter: Optional[int] = None,
         timeout: float = 120.0,
     ) -> str:
         """Text client for a tokenizer-equipped LM daemon: the prompt rides
         SendMessage's message_text, generation options ride sender_id as
-        "gen:max_new[:seed][:t=..][:k=..][:p=..][:m=..][:r=..][:a=..]", and the reply is the
+        "gen:max_new[:seed][:t=..][:k=..][:p=..][:m=..][:r=..][:b=..][:a=..]", and the reply is the
         generated continuation (lm_server.LMServer.SendMessage)."""
         rid = _gen_rid(max_new_tokens, seed, temperature, top_k, top_p,
-                       adapter, min_p, repetition_penalty)
+                       adapter, min_p, repetition_penalty, logit_bias)
         return self.send_message(rid, prompt, timeout=timeout)
 
     def close(self):

@@ -25,6 +25,7 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 from dnn_tpu.models.gpt import GPTConfig, head
@@ -109,6 +110,28 @@ def forward_with_cache(prepared, ids, cache, start_pos, *, cfg: GPTConfig,
     logits = head(prepared, x.astype(jnp.float32), cfg=cfg,
                   compute_dtype=compute_dtype)
     return logits, new_cache
+
+
+def logit_bias_row(logit_bias, vocab_size: int):
+    """{token_id: additive bias} -> a dense (V,) f32 row (None -> None).
+    The OpenAI-style knob: +big forces a token, -big (e.g. -100) bans it
+    — applied to logits AFTER the repetition penalty, BEFORE
+    temperature/filters, so bans bind for greedy rows too. Validates ids
+    against the vocab (a silently-clipped id would bias the wrong
+    token)."""
+    if not logit_bias:
+        return None
+    row = np.zeros((vocab_size,), np.float32)
+    for tok, val in logit_bias.items():
+        t = int(tok)
+        if not 0 <= t < vocab_size:
+            raise ValueError(
+                f"logit_bias token id {t} outside [0, {vocab_size})")
+        v = float(val)
+        if not np.isfinite(v):
+            raise ValueError(f"logit_bias value for {t} not finite: {v}")
+        row[t] = v
+    return jnp.asarray(row)
 
 
 def apply_repetition_penalty(logits, seen, penalty):
@@ -440,6 +463,7 @@ def make_generate(cfg: GPTConfig, *, max_new_tokens: int, temperature: float = 0
                   top_k: Optional[int] = None, top_p: Optional[float] = None,
                   min_p: Optional[float] = None,
                   repetition_penalty: Optional[float] = None,
+                  logit_bias=None,
                   compute_dtype=None, ffn=None, kv_dtype=None,
                   attn_kernel: bool = False):
     """Build a jitted generate(prepared, ids, rng) -> (B, max_new_tokens).
@@ -456,7 +480,9 @@ def make_generate(cfg: GPTConfig, *, max_new_tokens: int, temperature: float = 0
     probability; `repetition_penalty` (HF/CTRL semantics) penalizes every
     token already in the sequence — when set, a (B, V) seen-mask rides
     the decode carry (scatter per step; only materialized when the
-    penalty is on, so the default program is unchanged).
+    penalty is on, so the default program is unchanged). `logit_bias`
+    ({token_id: additive bias}) forces or bans specific tokens — applied
+    after the penalty, before the filters, binding for greedy too.
     """
     if max_new_tokens < 1:
         raise ValueError(f"max_new_tokens must be >= 1, got {max_new_tokens}")
@@ -467,6 +493,7 @@ def make_generate(cfg: GPTConfig, *, max_new_tokens: int, temperature: float = 0
         # min_p > 1 would mask EVERY token (threshold above the max
         # logit) and categorical would then draw uniformly — reject loud
         raise ValueError(f"min_p must be in [0, 1], got {min_p}")
+    bias_row = logit_bias_row(logit_bias, cfg.vocab_size)
     pen_on = repetition_penalty is not None and repetition_penalty != 1.0
 
     @functools.partial(jax.jit, static_argnames=())
@@ -496,6 +523,8 @@ def make_generate(cfg: GPTConfig, *, max_new_tokens: int, temperature: float = 0
         def pick(lg, seen, sub):
             if pen_on:
                 lg = apply_repetition_penalty(lg, seen, repetition_penalty)
+            if bias_row is not None:
+                lg = lg + bias_row
             tok = _sample(lg, sub, temperature=temperature, top_k=top_k,
                           top_p=top_p, min_p=min_p)
             if pen_on:

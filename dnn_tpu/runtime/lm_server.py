@@ -55,8 +55,8 @@ __all__ = ["LMServer", "serve_lm", "start_lm_server_in_background",
 
 def parse_gen_options(request_id: str, default_max_new: int):
     """'gen[:max_new[:seed]][:t=TEMP][:k=TOPK][:p=TOPP][:m=MINP]
-    [:r=REPPEN][:a=ADAPTER]' -> (max_new, seed, opts). Only the literal
-    'gen' prefix carries options —
+    [:r=REPPEN][:b=ID~VAL,ID~VAL][:a=ADAPTER]' -> (max_new, seed, opts).
+    Only the literal 'gen' prefix carries options —
     any other request_id (e.g. a reference client's tracing id like
     'req:1234') gets the server defaults instead of being reinterpreted as
     a token budget. Positional segments are max_new then seed; named
@@ -68,9 +68,19 @@ def parse_gen_options(request_id: str, default_max_new: int):
     parts = (request_id or "").split(":")
     if parts[0] != "gen":
         return max_new, seed, opts
+    def _parse_bias(val: str) -> dict:
+        # "ID~VAL,ID~VAL" — ":" is the segment separator, so pairs ride
+        # "~" within one segment
+        out = {}
+        for pair in val.split(","):
+            tok, _, v = pair.partition("~")
+            out[int(tok)] = float(v)
+        return out
+
     named = {"t": ("temperature", float), "k": ("top_k", int),
              "p": ("top_p", float), "a": ("adapter", int),
-             "m": ("min_p", float), "r": ("repetition_penalty", float)}
+             "m": ("min_p", float), "r": ("repetition_penalty", float),
+             "b": ("logit_bias", _parse_bias)}
     pos = 0
     for seg in parts[1:]:
         if "=" in seg:

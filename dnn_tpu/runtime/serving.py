@@ -53,11 +53,13 @@ from dnn_tpu.models.gpt import GPTConfig, head
 from dnn_tpu.ops.attention import merge_heads
 from dnn_tpu.ops.nn import gelu, layer_norm, linear
 from dnn_tpu.runtime.generate import (
+    TOP_P_PREFILTER_K,
     _qkv_heads,
     _sample_rows,
     apply_repetition_penalty,
     forward_with_cache,
     init_cache,
+    logit_bias_row,
 )
 from dnn_tpu.runtime.kvcache import codec_for_cache
 
@@ -350,6 +352,8 @@ class ContinuousBatcher:
         # tokens scatter in at submit, each committed token per step.
         # slots x V bools — trivial next to one block of K/V
         self._seen = jnp.zeros((slots, cfg.vocab_size), bool)
+        # per-slot additive logit bias (OpenAI-style force/ban); zeros off
+        self._bias = jnp.zeros((slots, cfg.vocab_size), jnp.float32)
 
         # host bookkeeping
         self._next_rid = 0
@@ -390,10 +394,11 @@ class ContinuousBatcher:
             return chosen_lp, top_lp, top_ids.astype(jnp.int32)
 
         def decode_step(prepared, cache, pos, tok, active, keys,
-                        temp, tk, tp, mp, rep, seen):
+                        temp, tk, tp, mp, rep, seen, bias):
             """Advance every active slot one token (per-slot sampling
             parameters — see _sample_rows; `rep`/`seen` drive the
-            repetition penalty, `mp` the min-p cutoff)."""
+            repetition penalty, `mp` the min-p cutoff, `bias` (B, V) the
+            per-slot additive logit bias)."""
             logits, new_cache = self.family.decode_rows(
                 prepared, cache, tok, pos, active, codec)
             # repetition penalty on raw logits (HF order: before the
@@ -403,7 +408,7 @@ class ContinuousBatcher:
             b = logits.shape[0]
             rp_on = rep != 1.0
             lg = apply_repetition_penalty(
-                logits, rp_on[:, None] & seen, rep[:, None])
+                logits, rp_on[:, None] & seen, rep[:, None]) + bias
             # advance each slot's own stream; sample each row with its key
             split = jax.vmap(jax.random.split)(keys)  # (B, 2, 2)
             new_keys, subs = split[:, 0], split[:, 1]
@@ -434,7 +439,8 @@ class ContinuousBatcher:
             return self.family.prefill(prepared, chunk, row, chunk_start)
 
         def prefill_finish(cache, row, logits, last_local, slot, rng,
-                           temp, tk, tp, mp, rep, seen_row, install_ids):
+                           temp, tk, tp, mp, rep, seen_row, bias_row,
+                           install_ids):
             """Sample the first token from the final chunk's true-last
             logit row and install the finished row cache into `slot`.
             `seen_row` (V,) marks the prompt's tokens so the repetition
@@ -445,7 +451,7 @@ class ContinuousBatcher:
             lg = logits[:, last_local][0:1]  # (1, V)
             raw = lg
             lg = apply_repetition_penalty(
-                lg, (rep != 1.0) & seen_row[None, :], rep)
+                lg, (rep != 1.0) & seen_row[None, :], rep) + bias_row[None, :]
             first = _sample_rows(
                 lg, rng[None], temperature=temp[None], top_k=tk[None],
                 top_p=tp[None], min_p=mp[None],
@@ -529,6 +535,7 @@ class ContinuousBatcher:
                top_p: Optional[float] = None,
                min_p: Optional[float] = None,
                repetition_penalty: Optional[float] = None,
+               logit_bias: Optional[dict] = None,
                stop: Optional[list] = None,
                logprobs: bool = False,
                adapter: Optional[int] = None) -> int:
@@ -545,7 +552,9 @@ class ContinuousBatcher:
         prefilter width, generate.TOP_P_PREFILTER_K), `top_p` (nucleus),
         `min_p` (drop tokens below min_p x the top probability),
         `repetition_penalty` (HF/CTRL semantics over this request's
-        prompt + generated tokens, tracked in a per-slot seen-mask);
+        prompt + generated tokens, tracked in a per-slot seen-mask),
+        `logit_bias` ({token_id: additive bias} — +big forces, -big
+        bans, binding for greedy rows too);
         `stop` — list of token-id sequences: generation retires when the
         emitted stream ends with any of them, the result is trimmed to
         exclude the match, and `finish_reasons[rid]` records "stop"
@@ -568,8 +577,6 @@ class ContinuousBatcher:
                 f"prompt {len(prompt)} + max_new_tokens {max_new_tokens} "
                 f"exceeds max_len {self.max_len}"
             )
-        from dnn_tpu.runtime.generate import TOP_P_PREFILTER_K
-
         temp = self._default_temp if temperature is None else float(temperature)
         tk = self._default_topk if top_k is None else int(top_k)
         tp = self._default_topp if top_p is None else float(top_p)
@@ -586,6 +593,9 @@ class ContinuousBatcher:
             raise ValueError(f"min_p must be in [0, 1], got {mp}")
         if rp <= 0:
             raise ValueError(f"repetition_penalty must be > 0, got {rp}")
+        b_row = logit_bias_row(logit_bias, self.cfg.vocab_size)
+        if b_row is None:
+            b_row = jnp.zeros((self.cfg.vocab_size,), jnp.float32)
         tk = min(tk, TOP_P_PREFILTER_K)
         stop_seqs = []
         for s in (stop or []):
@@ -774,7 +784,7 @@ class ContinuousBatcher:
             fin = self._prefill_finish(
                 self.cache, row, logits, last_local, slot, prefill_key,
                 t_arr, k_arr, p_arr, jnp.float32(mp), jnp.float32(rp),
-                seen_row,
+                seen_row, b_row,
                 install_ids if install_ids is not None
                 else jnp.zeros((0,), jnp.int32),
             )
@@ -809,6 +819,7 @@ class ContinuousBatcher:
             self._rep = self._rep.at[slot].set(rp)
             self._seen = self._seen.at[slot].set(
                 seen_row.at[first].set(True))
+            self._bias = self._bias.at[slot].set(b_row)
             if self._lora is not None and self._aid[slot] != aid:
                 self._aid[slot] = aid
                 self._decode_view = self._lora_prepared(self._aid)
@@ -939,7 +950,7 @@ class ContinuousBatcher:
         res = self._decode(
             self._decode_view, self.cache, self.pos, self.tok, self.active,
             self.keys, self._temp, self._topk, self._topp, self._minp,
-            self._rep, self._seen,
+            self._rep, self._seen, self._bias,
         )
         if self._logprobs_k:
             (self.cache, self.pos, self.tok, self.keys, self._seen,

@@ -246,7 +246,7 @@ class SpeculativeBatcher(ContinuousBatcher):
     def submit(self, prompt, max_new_tokens: int,
                seed: Optional[int] = None, **opts) -> int:
         for bad in ("temperature", "top_k", "top_p", "min_p",
-                    "repetition_penalty", "logprobs"):
+                    "repetition_penalty", "logit_bias", "logprobs"):
             # explicit-None check: temperature=0.0 / top_k=0 are real
             # overrides and must be rejected too, not slip past truthiness
             if opts.get(bad) is not None and opts.get(bad) is not False:

@@ -51,6 +51,10 @@ def test_parse_gen_options():
     assert parse_gen_options("gen:t=bogus:x=1", 32) == (32, None, {})
     # per-request LoRA adapter selection (multi-adapter serving)
     assert parse_gen_options("gen:8:a=1", 32) == (8, None, {"adapter": 1})
+    # logit bias pairs ride "~" inside one segment (":" separates segments)
+    assert parse_gen_options("gen:8:b=5~-100,7~2.5", 32) == (
+        8, None, {"logit_bias": {5: -100.0, 7: 2.5}})
+    assert parse_gen_options("gen:8:b=garbage", 32) == (8, None, {})
     # only the literal 'gen' prefix carries options: a foreign client's
     # tracing id must NOT be reinterpreted as a token budget
     assert parse_gen_options("req:1234", 32) == (32, None, {})

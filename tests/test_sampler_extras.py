@@ -202,3 +202,65 @@ def test_speculative_rejects_extras():
         srv.submit(_prompt(0), max_new_tokens=2, min_p=0.2)
     with pytest.raises(ValueError, match="repetition_penalty"):
         srv.submit(_prompt(0), max_new_tokens=2, repetition_penalty=2.0)
+
+
+# ----------------------------------------------------------------------
+# logit bias
+# ----------------------------------------------------------------------
+
+def test_logit_bias_bans_the_greedy_choice():
+    """Banning the token greedy would pick forces the runner-up — in the
+    solo decoder AND the batcher, identically."""
+    prepared = _prepared(seed=20)
+    prompt = _prompt(21, n=5)
+    plain = np.asarray(make_generate(CFG, max_new_tokens=1)(
+        prepared, jnp.asarray(prompt)[None], jax.random.PRNGKey(0)))[0]
+    banned = int(plain[0])
+    bias = {banned: -1e9}
+    solo = np.asarray(make_generate(CFG, max_new_tokens=6,
+                                    logit_bias=bias)(
+        prepared, jnp.asarray(prompt)[None], jax.random.PRNGKey(0)))[0]
+    assert solo[0] != banned
+    assert banned not in solo.tolist()
+    srv = ContinuousBatcher(CFG, prepared, slots=2, max_len=64,
+                            prompt_pad=16)
+    rid = srv.submit(prompt, max_new_tokens=6, logit_bias=bias)
+    np.testing.assert_array_equal(srv.drain()[rid], solo)
+
+
+def test_logit_bias_forces_a_token():
+    """+big on one token makes every step emit it (greedy and sampled)."""
+    prepared = _prepared(seed=22)
+    prompt = _prompt(23, n=4)
+    tok = 7
+    srv = ContinuousBatcher(CFG, prepared, slots=2, max_len=64,
+                            prompt_pad=16)
+    r1 = srv.submit(prompt, max_new_tokens=5, logit_bias={tok: 1e9})
+    r2 = srv.submit(prompt, max_new_tokens=5, temperature=1.0, seed=3,
+                    logit_bias={tok: 1e9})
+    res = srv.drain()
+    assert res[r1].tolist() == [tok] * 5
+    assert res[r2].tolist() == [tok] * 5
+
+
+def test_logit_bias_does_not_disturb_neighbors():
+    prepared = _prepared(seed=24)
+    prompt = _prompt(25, n=5)
+    want = np.asarray(make_generate(CFG, max_new_tokens=6)(
+        prepared, jnp.asarray(prompt)[None], jax.random.PRNGKey(0)))[0]
+    srv = ContinuousBatcher(CFG, prepared, slots=2, max_len=64,
+                            prompt_pad=16)
+    rid = srv.submit(prompt, max_new_tokens=6)
+    srv.submit(_prompt(26), max_new_tokens=6, logit_bias={3: 1e9})
+    np.testing.assert_array_equal(srv.drain()[rid], want)
+
+
+def test_logit_bias_validation():
+    prepared = _prepared()
+    srv = ContinuousBatcher(CFG, prepared, slots=1, max_len=32)
+    with pytest.raises(ValueError, match="logit_bias"):
+        srv.submit(_prompt(0), max_new_tokens=2,
+                   logit_bias={CFG.vocab_size: -100.0})
+    with pytest.raises(ValueError, match="not finite"):
+        srv.submit(_prompt(0), max_new_tokens=2,
+                   logit_bias={3: float("nan")})
