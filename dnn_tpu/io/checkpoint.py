@@ -316,7 +316,10 @@ def llama_params_from_state_dict(sd: Dict[str, np.ndarray],
     input_layernorm / post_attention_layernorm / model.norm / lm_head) to
     this framework's LLaMA param pytree (dnn_tpu/models/llama.py). Every
     projection is a plain torch Linear, so each kernel takes the usual
-    (out, in) -> (in, out) transpose; RMSNorm weights map to 'scale'."""
+    (out, in) -> (in, out) transpose; RMSNorm weights map to 'scale'.
+    Qwen2-class checkpoints (same layout + q/k/v projection BIASES) pass
+    through unchanged: any present `*_proj.bias` rides along as a 'bias'
+    leaf, which ops.nn.linear applies wherever the kernel goes."""
     # HF prefixes everything but lm_head with "model."
     sd = {(k[len("model."):] if k.startswith("model.") else k): v
           for k, v in sd.items()}
@@ -330,21 +333,27 @@ def llama_params_from_state_dict(sd: Dict[str, np.ndarray],
         "wte": {"embedding": sd["embed_tokens.weight"]},
         "ln_f": {"scale": sd["norm.weight"]},
     }
+    def _proj(key):
+        out = {"kernel": _t_linear(sd[key + ".weight"])}
+        if key + ".bias" in sd:  # Qwen2-class q/k/v biases
+            out["bias"] = sd[key + ".bias"]
+        return out
+
     for i in range(n_layer):
         p = f"layers.{i}."
         params[f"h_{i}"] = {
             "ln_1": {"scale": sd[p + "input_layernorm.weight"]},
             "attn": {
-                "q": {"kernel": _t_linear(sd[p + "self_attn.q_proj.weight"])},
-                "k": {"kernel": _t_linear(sd[p + "self_attn.k_proj.weight"])},
-                "v": {"kernel": _t_linear(sd[p + "self_attn.v_proj.weight"])},
-                "o": {"kernel": _t_linear(sd[p + "self_attn.o_proj.weight"])},
+                "q": _proj(p + "self_attn.q_proj"),
+                "k": _proj(p + "self_attn.k_proj"),
+                "v": _proj(p + "self_attn.v_proj"),
+                "o": _proj(p + "self_attn.o_proj"),
             },
             "ln_2": {"scale": sd[p + "post_attention_layernorm.weight"]},
             "mlp": {
-                "gate": {"kernel": _t_linear(sd[p + "mlp.gate_proj.weight"])},
-                "up": {"kernel": _t_linear(sd[p + "mlp.up_proj.weight"])},
-                "down": {"kernel": _t_linear(sd[p + "mlp.down_proj.weight"])},
+                "gate": _proj(p + "mlp.gate_proj"),
+                "up": _proj(p + "mlp.up_proj"),
+                "down": _proj(p + "mlp.down_proj"),
             },
         }
     # lm_head: explicit if present, else tied to the embedding

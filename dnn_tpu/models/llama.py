@@ -80,6 +80,11 @@ class LlamaConfig:
     # identically.
     rope_scaling: Optional[str] = None
     rope_scale: float = 1.0
+    # Qwen2-class q/k/v projection biases (o and the MLP stay bias-free).
+    # ops.nn.linear applies any "bias" leaf it finds, so the flag only
+    # affects init and the HF config mapping — converted checkpoints
+    # carry their biases regardless.
+    attn_bias: bool = False
 
     @property
     def head_dim(self):
@@ -111,6 +116,17 @@ PRESETS = {
     "mistral-test": LlamaConfig(block_size=64, vocab_size=256, n_layer=4,
                                 n_head=4, n_kv_head=2, n_embd=64, d_ff=128,
                                 sliding_window=16),
+    # Qwen2-7B shape: the LLaMA block with q/k/v biases, GQA 7:1, long
+    # rope base
+    "qwen2-7b": LlamaConfig(block_size=32768, vocab_size=152064,
+                            n_layer=28, n_head=28, n_kv_head=4,
+                            n_embd=3584, d_ff=18944,
+                            rope_theta=1_000_000.0, rms_eps=1e-6,
+                            attn_bias=True),
+    # tiny biased config for tests
+    "qwen2-test": LlamaConfig(block_size=64, vocab_size=256, n_layer=4,
+                              n_head=4, n_kv_head=2, n_embd=64, d_ff=128,
+                              attn_bias=True),
 }
 
 
@@ -125,12 +141,19 @@ def _kernel(key, shape, dtype, std=0.02):
 def init_block(key, cfg: LlamaConfig, dtype=jnp.float32):
     c, d = cfg.n_embd, cfg.head_dim
     ks = jax.random.split(key, 7)
+
+    def _qkv(k, shape):
+        p = _kernel(k, shape, dtype)
+        if cfg.attn_bias:
+            p["bias"] = jnp.zeros((shape[-1],), dtype)
+        return p
+
     return {
         "ln_1": {"scale": jnp.ones((c,), dtype)},
         "attn": {
-            "q": _kernel(ks[0], (c, cfg.n_head * d), dtype),
-            "k": _kernel(ks[1], (c, cfg.n_kv_head * d), dtype),
-            "v": _kernel(ks[2], (c, cfg.n_kv_head * d), dtype),
+            "q": _qkv(ks[0], (c, cfg.n_head * d)),
+            "k": _qkv(ks[1], (c, cfg.n_kv_head * d)),
+            "v": _qkv(ks[2], (c, cfg.n_kv_head * d)),
             "o": _kernel(ks[3], (cfg.n_head * d, c), dtype,
                          std=0.02 / (2 * cfg.n_layer) ** 0.5),
         },
@@ -858,8 +881,9 @@ def to_hf_config(cfg: LlamaConfig, *, tie_word_embeddings: bool = False,
     HF-serve example, and any converter round-trip share it — the field
     list must not fork). Sliding-window configs map to
     transformers.MistralConfig (the HF class that implements the window);
-    dense ones to LlamaConfig. Requires transformers; extra kwargs pass
-    through (e.g. attn_implementation="eager")."""
+    attn_bias configs to Qwen2Config (the HF class with q/k/v biases);
+    dense bias-free ones to LlamaConfig. Requires transformers; extra
+    kwargs pass through (e.g. attn_implementation="eager")."""
     import transformers
 
     kw = dict(
@@ -880,9 +904,18 @@ def to_hf_config(cfg: LlamaConfig, *, tie_word_embeddings: bool = False,
         kw["rope_theta"] = cfg.rope_theta * cfg.rope_scale ** (
             cfg.head_dim / (cfg.head_dim - 2))
     if cfg.sliding_window is not None:
+        if cfg.attn_bias:
+            raise ValueError(
+                "attn_bias + sliding_window has no single HF class "
+                "(MistralConfig is bias-free, Qwen2Config's window "
+                "support differs) — map this config by hand")
         kw.update(sliding_window=cfg.sliding_window, head_dim=cfg.head_dim)
         kw.update(overrides)  # after defaults: overrides must win
         return transformers.MistralConfig(**kw)
+    if cfg.attn_bias:
+        # Qwen2's sliding window is OFF unless use_sliding_window is set
+        kw.update(overrides)
+        return transformers.Qwen2Config(**kw)
     kw.update(attention_bias=False, mlp_bias=False)
     kw.update(overrides)
     return transformers.LlamaConfig(**kw)

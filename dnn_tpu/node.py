@@ -473,6 +473,9 @@ def _serve_lm(engine: PipelineEngine, args) -> int:
             family=family, default_max_new=args.generate or 32,
             tokenizer=tokenizer, prefix_cache=args.prefix_cache,
             paged_blocks=args.paged_blocks, block_len=args.block_len,
+            # the daemon's clients choose options per request, so the
+            # per-slot bias capability is on at this edge
+            allow_logit_bias=True,
             **lora_kwargs,
         ))
     except KeyboardInterrupt:

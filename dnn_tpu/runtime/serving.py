@@ -203,7 +203,8 @@ class ContinuousBatcher:
                  attn_kernel: bool = False, prefix_cache: int = 0,
                  logprobs_k: int = 0,
                  paged_blocks: int = 0, block_len: int = 16,
-                 lora_adapters=None, lora_alphas=None):
+                 lora_adapters=None, lora_alphas=None,
+                 allow_logit_bias: bool = False):
         self.cfg = cfg
         self.prepared = prepared
         self.slots = slots
@@ -352,8 +353,17 @@ class ContinuousBatcher:
         # tokens scatter in at submit, each committed token per step.
         # slots x V bools — trivial next to one block of K/V
         self._seen = jnp.zeros((slots, cfg.vocab_size), bool)
-        # per-slot additive logit bias (OpenAI-style force/ban); zeros off
-        self._bias = jnp.zeros((slots, cfg.vocab_size), jnp.float32)
+        # per-slot additive logit bias (OpenAI-style force/ban) — a
+        # CONSTRUCTION-time capability like logprobs_k: the dense
+        # (slots, V) buffer and its per-step add only exist when
+        # allow_logit_bias=True (at large-vocab, many-slot servers the
+        # buffer alone is tens of MB), so the default programs/memory
+        # are unchanged. The LM daemon enables it (its clients choose
+        # options per request).
+        self._allow_bias = bool(allow_logit_bias)
+        self._bias = (jnp.zeros((slots, cfg.vocab_size), jnp.float32)
+                      if self._allow_bias
+                      else jnp.zeros((slots, 0), jnp.float32))
 
         # host bookkeeping
         self._next_rid = 0
@@ -408,7 +418,9 @@ class ContinuousBatcher:
             b = logits.shape[0]
             rp_on = rep != 1.0
             lg = apply_repetition_penalty(
-                logits, rp_on[:, None] & seen, rep[:, None]) + bias
+                logits, rp_on[:, None] & seen, rep[:, None])
+            if self._allow_bias:
+                lg = lg + bias
             # advance each slot's own stream; sample each row with its key
             split = jax.vmap(jax.random.split)(keys)  # (B, 2, 2)
             new_keys, subs = split[:, 0], split[:, 1]
@@ -451,7 +463,9 @@ class ContinuousBatcher:
             lg = logits[:, last_local][0:1]  # (1, V)
             raw = lg
             lg = apply_repetition_penalty(
-                lg, (rep != 1.0) & seen_row[None, :], rep) + bias_row[None, :]
+                lg, (rep != 1.0) & seen_row[None, :], rep)
+            if self._allow_bias:
+                lg = lg + bias_row[None, :]
             first = _sample_rows(
                 lg, rng[None], temperature=temp[None], top_k=tk[None],
                 top_p=tp[None], min_p=mp[None],
@@ -593,9 +607,15 @@ class ContinuousBatcher:
             raise ValueError(f"min_p must be in [0, 1], got {mp}")
         if rp <= 0:
             raise ValueError(f"repetition_penalty must be > 0, got {rp}")
+        if logit_bias and not self._allow_bias:
+            raise ValueError(
+                "logit_bias requires allow_logit_bias=True at construction "
+                "(the per-slot bias buffer is a construction-time choice)")
         b_row = logit_bias_row(logit_bias, self.cfg.vocab_size)
         if b_row is None:
-            b_row = jnp.zeros((self.cfg.vocab_size,), jnp.float32)
+            b_row = jnp.zeros(
+                (self.cfg.vocab_size if self._allow_bias else 0,),
+                jnp.float32)
         tk = min(tk, TOP_P_PREFILTER_K)
         stop_seqs = []
         for s in (stop or []):

@@ -249,11 +249,15 @@ class SpeculativeBatcher(ContinuousBatcher):
                     "repetition_penalty", "logit_bias", "logprobs"):
             # explicit-None check: temperature=0.0 / top_k=0 are real
             # overrides and must be rejected too, not slip past truthiness
-            if opts.get(bad) is not None and opts.get(bad) is not False:
-                raise ValueError(
-                    "SpeculativeBatcher uses the server-level sampling "
-                    f"configuration; per-request {bad}= is the dense "
-                    "batcher's feature")
+            # — but an EMPTY logit_bias dict is a no-op everywhere else
+            # and must not hard-fail only here
+            v = opts.get(bad)
+            if v is None or v is False or (isinstance(v, dict) and not v):
+                continue
+            raise ValueError(
+                "SpeculativeBatcher uses the server-level sampling "
+                f"configuration; per-request {bad}= is the dense "
+                "batcher's feature")
         prompt_arr = np.asarray(prompt, np.int32).reshape(-1)
         k = self.spec_k
         if len(prompt_arr) < k + 1:

@@ -1,0 +1,120 @@
+"""Qwen2 family: the LLaMA block with q/k/v projection biases.
+
+The bias rides as a plain "bias" leaf that ops.nn.linear applies
+wherever the kernel goes, so every runtime (stateless forward, cached
+decode, batcher rows, partitions) inherits it with no per-path plumbing
+— these tests pin that claim against HF Qwen2ForCausalLM and the
+framework's own cross-path parity contracts.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dnn_tpu.models import gpt, llama
+
+CFG = llama.PRESETS["qwen2-test"]  # L=4, H=4, KV=2, C=64, V=256, biased
+
+
+def _params(seed=0):
+    return llama.init(jax.random.PRNGKey(seed), CFG)
+
+
+def test_init_carries_qkv_biases_only():
+    p = _params()
+    blk = p["h_0"]
+    for k in ("q", "k", "v"):
+        assert "bias" in blk["attn"][k], k
+    assert "bias" not in blk["attn"]["o"]
+    for k in ("gate", "up", "down"):
+        assert "bias" not in blk["mlp"][k], k
+
+
+def test_hf_qwen2_logit_parity():
+    torch = pytest.importorskip("torch")
+    transformers = pytest.importorskip("transformers")
+
+    hf_cfg = llama.to_hf_config(CFG, attn_implementation="eager")
+    assert isinstance(hf_cfg, transformers.Qwen2Config)
+    torch.manual_seed(0)
+    model = transformers.Qwen2ForCausalLM(hf_cfg).eval()
+    sd = {k: v.numpy() for k, v in model.state_dict().items()}
+    assert any(k.endswith("q_proj.bias") for k in sd), "premise: biased ckpt"
+
+    from dnn_tpu.io.checkpoint import llama_params_from_state_dict
+
+    params = llama_params_from_state_dict(sd)
+    ids = np.random.RandomState(1).randint(0, CFG.vocab_size, (2, 12))
+    with torch.no_grad():
+        want = model(torch.from_numpy(ids)).logits.numpy()
+    got = np.asarray(llama.make_apply(CFG)(params, jnp.asarray(ids)))
+    np.testing.assert_allclose(got, want, atol=2e-3, rtol=2e-3)
+    np.testing.assert_array_equal(got.argmax(-1), want.argmax(-1))
+
+
+def test_biases_change_the_output():
+    """The bias leaves must actually act (a silently-dropped bias would
+    still pass structural checks)."""
+    p = _params(seed=1)
+    ids = jax.random.randint(jax.random.PRNGKey(2), (1, 8), 0,
+                             CFG.vocab_size)
+    base = np.asarray(llama.make_apply(CFG)(p, ids))
+    bumped = jax.tree_util.tree_map_with_path(
+        lambda path, x: x + 0.5 if "bias" in str(path[-1]) else x, p)
+    moved = np.asarray(llama.make_apply(CFG)(bumped, ids))
+    assert np.abs(base - moved).max() > 0
+
+
+def test_incremental_decode_matches_full_recompute():
+    params = _params(seed=3)
+    prepared = gpt.prepare_stacked(params, CFG)
+    apply_fn = llama.make_apply(CFG)
+    ids = jax.random.randint(jax.random.PRNGKey(4), (2, 8), 0,
+                             CFG.vocab_size)
+    n_new = 6
+    got = np.asarray(llama.make_generate(CFG, max_new_tokens=n_new)(
+        prepared, ids, jax.random.PRNGKey(0)))
+    cur = np.asarray(ids)
+    want = []
+    for _ in range(n_new):
+        logits = apply_fn(params, jnp.asarray(cur))
+        nxt = np.asarray(jnp.argmax(logits[:, -1], -1)).astype(np.int32)
+        want.append(nxt)
+        cur = np.concatenate([cur, nxt[:, None]], axis=1)
+    np.testing.assert_array_equal(got, np.stack(want, axis=1))
+
+
+def test_partition_composes_to_full_model():
+    params = _params(seed=5)
+    ids = jax.random.randint(jax.random.PRNGKey(6), (2, 8), 0,
+                             CFG.vocab_size)
+    want = np.asarray(llama.make_apply(CFG)(params, ids))
+    x = ids
+    for st in llama.make_partition(CFG)(2):
+        x = st.apply(st.slice_params(params), x)
+    np.testing.assert_allclose(np.asarray(x), want, atol=1e-4, rtol=1e-4)
+
+
+def test_batcher_matches_solo_decode():
+    from dnn_tpu.runtime.serving import ContinuousBatcher
+
+    params = _params(seed=7)
+    prepared = gpt.prepare_stacked(params, CFG)
+    prompt = np.array([5, 3, 7, 1, 2])
+    srv = ContinuousBatcher(CFG, prepared, slots=2, max_len=32,
+                            prompt_pad=8, family=llama.LlamaFamilyRows(CFG))
+    rid = srv.submit(prompt, max_new_tokens=6)
+    got = srv.drain()[rid]
+    want = np.asarray(llama.make_generate(CFG, max_new_tokens=6)(
+        prepared, jnp.asarray(prompt, jnp.int32)[None, :],
+        jax.random.PRNGKey(0)))[0]
+    np.testing.assert_array_equal(got, want)
+
+
+def test_qwen2_preset_registered():
+    from dnn_tpu.registry import get_model
+
+    spec = get_model("qwen2-7b")
+    assert spec.config.attn_bias
+    assert spec.config.n_kv_head == 4

@@ -223,7 +223,7 @@ def test_logit_bias_bans_the_greedy_choice():
     assert solo[0] != banned
     assert banned not in solo.tolist()
     srv = ContinuousBatcher(CFG, prepared, slots=2, max_len=64,
-                            prompt_pad=16)
+                            prompt_pad=16, allow_logit_bias=True)
     rid = srv.submit(prompt, max_new_tokens=6, logit_bias=bias)
     np.testing.assert_array_equal(srv.drain()[rid], solo)
 
@@ -234,7 +234,7 @@ def test_logit_bias_forces_a_token():
     prompt = _prompt(23, n=4)
     tok = 7
     srv = ContinuousBatcher(CFG, prepared, slots=2, max_len=64,
-                            prompt_pad=16)
+                            prompt_pad=16, allow_logit_bias=True)
     r1 = srv.submit(prompt, max_new_tokens=5, logit_bias={tok: 1e9})
     r2 = srv.submit(prompt, max_new_tokens=5, temperature=1.0, seed=3,
                     logit_bias={tok: 1e9})
@@ -249,7 +249,7 @@ def test_logit_bias_does_not_disturb_neighbors():
     want = np.asarray(make_generate(CFG, max_new_tokens=6)(
         prepared, jnp.asarray(prompt)[None], jax.random.PRNGKey(0)))[0]
     srv = ContinuousBatcher(CFG, prepared, slots=2, max_len=64,
-                            prompt_pad=16)
+                            prompt_pad=16, allow_logit_bias=True)
     rid = srv.submit(prompt, max_new_tokens=6)
     srv.submit(_prompt(26), max_new_tokens=6, logit_bias={3: 1e9})
     np.testing.assert_array_equal(srv.drain()[rid], want)
@@ -257,10 +257,17 @@ def test_logit_bias_does_not_disturb_neighbors():
 
 def test_logit_bias_validation():
     prepared = _prepared()
-    srv = ContinuousBatcher(CFG, prepared, slots=1, max_len=32)
+    srv = ContinuousBatcher(CFG, prepared, slots=1, max_len=32,
+                            allow_logit_bias=True)
     with pytest.raises(ValueError, match="logit_bias"):
         srv.submit(_prompt(0), max_new_tokens=2,
                    logit_bias={CFG.vocab_size: -100.0})
     with pytest.raises(ValueError, match="not finite"):
         srv.submit(_prompt(0), max_new_tokens=2,
                    logit_bias={3: float("nan")})
+    plain = ContinuousBatcher(CFG, prepared, slots=1, max_len=32)
+    with pytest.raises(ValueError, match="allow_logit_bias"):
+        plain.submit(_prompt(0), max_new_tokens=2, logit_bias={3: -1.0})
+    # an EMPTY dict is a no-op, not an error — on both server kinds
+    rid = plain.submit(_prompt(0), max_new_tokens=2, logit_bias={})
+    assert len(plain.drain()[rid]) == 2
