@@ -474,8 +474,10 @@ def _serve_lm(engine: PipelineEngine, args) -> int:
             tokenizer=tokenizer, prefix_cache=args.prefix_cache,
             paged_blocks=args.paged_blocks, block_len=args.block_len,
             # the daemon's clients choose options per request, so the
-            # per-slot bias capability is on at this edge
-            allow_logit_bias=True,
+            # per-slot bias capability is on at this edge — except for
+            # speculative serving, whose batcher rejects per-request
+            # bias anyway (the buffer would be dead weight)
+            allow_logit_bias=not spec_kwargs,
             **lora_kwargs,
         ))
     except KeyboardInterrupt:

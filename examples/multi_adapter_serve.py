@@ -1,0 +1,106 @@
+"""Multi-LoRA end-to-end example: fine-tune N adapters on one base, serve
+them all from ONE pool with per-request selection.
+
+The reference has no fine-tuning and serves exactly one model per process
+(/root/reference/node.py:294-325 loads a single .pth). This script runs
+the modern multi-tenant loop the rebuild supports, TPU-first:
+
+  1. INIT a small GPT base (random weights stand in for a pretrained
+     checkpoint — no network in this sandbox);
+  2. FINE-TUNE two LoRA adapters on two synthetic "tenant tasks" (task A:
+     always continue with token sequence A; task B: with sequence B) —
+     only the adapter trees train (`lora.make_lora_loss`), the base stays
+     frozen;
+  3. SAVE both adapters as npz artifacts (`lora.save_lora`) — the only
+     thing a fine-tune ships;
+  4. SERVE base + both adapters from one ContinuousBatcher
+     (`lora_adapters=[...]`): requests pick an adapter per call, streams
+     decode CONCURRENTLY in the same slot pool, and each adapted stream
+     provably behaves like its tenant's fine-tune while the base stream
+     stays untouched.
+
+Run:  python examples/multi_adapter_serve.py
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from dnn_tpu import lora, train
+from dnn_tpu.models import gpt
+from dnn_tpu.runtime.serving import ContinuousBatcher
+
+CFG = gpt.PRESETS["gpt2-test"]
+PROMPT = np.array([11, 12, 13, 14], np.int32)
+
+
+def tenant_batch(target_token: int, *, batch: int = 8, seed: int = 0):
+    """A tenant's 'task': whatever the prompt, continue with its token."""
+    rng = np.random.RandomState(seed)
+    inp = rng.randint(0, CFG.vocab_size, (batch, 12)).astype(np.int32)
+    tgt = np.full_like(inp, target_token)
+    return jnp.asarray(inp), jnp.asarray(tgt)
+
+
+def finetune_adapter(prepared, apply_fn, target_token: int, *, steps=60,
+                     rank=8, seed=0):
+    """LoRA-only training: the optimizer sees the adapter tree alone."""
+    adapters = lora.init_lora(jax.random.PRNGKey(seed), prepared, rank=rank)
+
+    def loss_fn(params, batch):
+        inp, tgt = batch
+        return train.cross_entropy(apply_fn(params, inp), tgt)
+
+    lora_loss = lora.make_lora_loss(loss_fn, prepared)
+    opt = optax.adamw(3e-3)
+    step = train.make_train_step(lora_loss, opt)
+    state = opt.init(adapters)
+    for i in range(steps):
+        adapters, state, loss = step(
+            adapters, state, tenant_batch(target_token, seed=seed * 1000 + i))
+    print(f"  tenant token {target_token}: final loss {float(loss):.4f}")
+    return adapters
+
+
+def main():
+    params = gpt.init(jax.random.PRNGKey(0), CFG)
+    prepared = gpt.prepare_stacked(params, CFG)
+    apply_fn = gpt.make_apply_stacked(CFG)
+
+    print("[1] fine-tuning two tenant adapters (base frozen)...")
+    ad_a = finetune_adapter(prepared, apply_fn, target_token=42, seed=1)
+    ad_b = finetune_adapter(prepared, apply_fn, target_token=99, seed=2)
+
+    out_dir = tempfile.mkdtemp(prefix="multi_adapter_")
+    pa, pb = os.path.join(out_dir, "a.npz"), os.path.join(out_dir, "b.npz")
+    lora.save_lora(pa, ad_a)
+    lora.save_lora(pb, ad_b)
+    print(f"[2] adapters saved: {pa}, {pb}")
+
+    loaded = [lora.load_lora(p)[0] for p in (pa, pb)]
+    srv = ContinuousBatcher(CFG, prepared, slots=3, max_len=32,
+                            prompt_pad=8, lora_adapters=loaded)
+    r_a = srv.submit(PROMPT, max_new_tokens=6, adapter=0)
+    r_b = srv.submit(PROMPT, max_new_tokens=6, adapter=1)
+    r_base = srv.submit(PROMPT, max_new_tokens=6)
+    res = srv.drain()
+    print(f"[3] one pool, three tenants, same prompt {PROMPT.tolist()}:")
+    print(f"    adapter A -> {res[r_a].tolist()}  (trained toward 42)")
+    print(f"    adapter B -> {res[r_b].tolist()}  (trained toward 99)")
+    print(f"    base      -> {res[r_base].tolist()}")
+
+    assert (res[r_a] == 42).all(), "tenant A's fine-tune should dominate"
+    assert (res[r_b] == 99).all(), "tenant B's fine-tune should dominate"
+    assert not (res[r_base] == 42).any() and not (res[r_base] == 99).any(), \
+        "the base stream must not inherit any tenant's tuning"
+    print("[4] per-request isolation holds: each stream follows ITS adapter")
+
+
+if __name__ == "__main__":
+    main()

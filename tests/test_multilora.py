@@ -200,6 +200,31 @@ def test_speculative_rejects_lora(setup):
                            lora_adapters=adapters)
 
 
+def test_multilora_over_tp_sharded_base(setup):
+    """Multi-LoRA composes with tensor parallelism: the base weights stay
+    4-way model-sharded (gpt_tp_specs_stacked placement) while the tiny
+    replicated adapter deltas apply per slot — GSPMD partitions the step
+    programs from the base leaf shardings, and every stream still equals
+    the unsharded merged reference."""
+    from dnn_tpu import train
+    from dnn_tpu.parallel.mesh import MODEL_AXIS, make_mesh
+
+    prepared, adapters = setup
+    mesh = make_mesh({MODEL_AXIS: 4}, jax.devices()[:4])
+    specs = train.gpt_tp_specs_stacked(prepared)
+    tp_prep = train.shard_pytree(prepared, mesh, specs)
+
+    prompt = np.arange(1, 9) % CFG.vocab_size
+    srv = ContinuousBatcher(CFG, tp_prep, slots=2, max_len=64,
+                            prompt_pad=16, lora_adapters=adapters)
+    r0 = srv.submit(prompt, max_new_tokens=8, adapter=0)
+    r1 = srv.submit(prompt, max_new_tokens=8)  # base, same pool
+    res = srv.drain()
+    merged = lora.merge_lora(prepared, adapters[0])
+    np.testing.assert_array_equal(res[r0], _solo(CFG, merged, prompt, 8))
+    np.testing.assert_array_equal(res[r1], _solo(CFG, prepared, prompt, 8))
+
+
 def test_trained_artifact_serves_through_stacked_layout(tmp_path):
     """The full deployment round trip: adapters trained against PER-LAYER
     params (the training layout), saved/loaded as npz, converted with

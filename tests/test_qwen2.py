@@ -52,6 +52,20 @@ def test_hf_qwen2_logit_parity():
     np.testing.assert_allclose(got, want, atol=2e-3, rtol=2e-3)
     np.testing.assert_array_equal(got.argmax(-1), want.argmax(-1))
 
+    # greedy-generate parity: the cached decode trajectory (bias applied
+    # at every step's projections) matches transformers' generate
+    prompt = np.random.RandomState(2).randint(0, CFG.vocab_size, (1, 10))
+    n_new = 12
+    with torch.no_grad():
+        hf_out = model.generate(torch.from_numpy(prompt),
+                                max_new_tokens=n_new, do_sample=False,
+                                pad_token_id=0)
+    want_toks = hf_out.numpy()[0, 10:]
+    prepared = gpt.prepare_stacked(params, CFG)
+    got_toks = np.asarray(llama.make_generate(CFG, max_new_tokens=n_new)(
+        prepared, jnp.asarray(prompt), jax.random.PRNGKey(0)))[0]
+    np.testing.assert_array_equal(got_toks, want_toks)
+
 
 def test_biases_change_the_output():
     """The bias leaves must actually act (a silently-dropped bias would
