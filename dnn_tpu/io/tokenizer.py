@@ -57,6 +57,15 @@ class ByteTokenizer:
                 # fabricated 0x00/0xFF byte
         return bytes(raw).decode("utf-8", errors="replace")
 
+    def vocab_bytes(self) -> List[bytes]:
+        """Token id -> the bytes that token emits — the vocab map
+        constrained decoding compiles its token table over
+        (runtime/constrain.TokenConstraint). Ids outside the byte range
+        map to b"", which the constraint engine bans outright."""
+        return [bytes([i - self.offset])
+                if self.offset <= i < self.offset + 256 else b""
+                for i in range(self.vocab_size)]
+
 
 def load_hf_tokenizer(path: str):
     """Adapter over a local HF tokenizer directory: returns an object with

@@ -476,8 +476,10 @@ def _serve_lm(engine: PipelineEngine, args) -> int:
             # the daemon's clients choose options per request, so the
             # per-slot bias capability is on at this edge — except for
             # speculative serving, whose batcher rejects per-request
-            # bias anyway (the buffer would be dead weight)
+            # bias anyway (the buffer would be dead weight); constraints
+            # (JSON mode, j=) share the buffer and the same gate
             allow_logit_bias=not spec_kwargs,
+            allow_constraints=not spec_kwargs,
             **lora_kwargs,
         ))
     except KeyboardInterrupt:

@@ -66,31 +66,50 @@ class _KernelDispatch:
 
     Also hosts THE window predicate: every attend variant of every codec
     masks through `_band_keep` / `_rows_keep`, so the sliding-window
-    band-edge semantics live in exactly one place."""
+    band-edge semantics live in exactly one place. Each attend variant
+    additionally accepts a per-call `window=` override — a TRACED scalar
+    is allowed, which is how per-LAYER windows (Gemma-2's alternating
+    local/global attention) ride one scanned block body: the caller
+    threads a (L,) window array through its layer scan and passes each
+    layer's value here (a "no window" layer passes cfg.block_size, which
+    makes the lower bound vacuous). A traced override disables the Pallas
+    kernel path for that call (the kernel masks causally only).
+
+    `softcap` (Gemma-2 attn_logit_softcapping) bounds scores to
+    (-cap, cap) via cap*tanh(s/cap) BEFORE masking — also einsum-only."""
 
     use_kernel = False
     window: Optional[int] = None
+    softcap: Optional[float] = None
 
     def _interp(self):
         return True if self.use_kernel == "interpret" else None
 
-    def _band_keep(self, cols, limit):
+    def _cap(self, s):
+        """Apply attention-logit softcapping (identity when unset)."""
+        if self.softcap is not None:
+            s = self.softcap * jnp.tanh(s / self.softcap)
+        return s
+
+    def _band_keep(self, cols, limit, window=None):
         """Causal upper bound (cols <= limit) plus the optional
         sliding-window lower bound (cols > limit - window); broadcasts
-        over whatever shapes the caller aligned."""
+        over whatever shapes the caller aligned. `window` overrides the
+        codec's static window (may be traced — see class docstring)."""
+        w = window if window is not None else self.window
         keep = cols <= limit
-        if self.window is not None:
-            keep &= cols > limit - self.window
+        if w is not None:
+            keep &= cols > limit - w
         return keep
 
-    def _rows_keep(self, c, pos):
+    def _rows_keep(self, c, pos, window=None):
         """(B, 1, 1, S) keep-mask for shared-limit decode rows at per-slot
         positions pos (B,). _RingStorage overrides this with the ring
         occupancy predicate — that override is the ONLY masking
         difference between a rolling codec and its base."""
         cols = jnp.arange(c["k"].shape[2])
         return self._band_keep(cols[None, None, None, :],
-                               pos[:, None, None, None])
+                               pos[:, None, None, None], window)
 
 
 def _rows_update(cache, new, pos):
@@ -115,10 +134,12 @@ class FloatKV(_KernelDispatch):
     window support, so a window forces the einsum path)."""
 
     def __init__(self, dtype=jnp.float32, use_kernel: bool = False,
-                 window: Optional[int] = None):
+                 window: Optional[int] = None,
+                 softcap: Optional[float] = None):
         self.dtype = dtype
         self.use_kernel = use_kernel
         self.window = window
+        self.softcap = softcap
 
     def init(self, cfg, batch: int, max_len: int):
         shape = (cfg.n_layer, batch, cfg.n_head, max_len,
@@ -135,7 +156,7 @@ class FloatKV(_KernelDispatch):
                 c["v"], v.astype(c["v"].dtype), start_pos, axis=2),
         }
 
-    def attend(self, q, c, pos_limit, base=None):
+    def attend(self, q, c, pos_limit, base=None, window=None):
         """q (B,H,T,D) against the full cache, masking key positions >
         their row's limit (pos_limit (T,)).
 
@@ -146,7 +167,8 @@ class FloatKV(_KernelDispatch):
         GQA group trick, llama.py) never pass base, so use_kernel can't
         silently mis-mask them; they fall through to the einsum (or, for
         T==1 folded rows, route via attend_rows' decode kernel)."""
-        if self.use_kernel and base is not None and self.window is None:
+        if (self.use_kernel and base is not None and self.window is None
+                and window is None and self.softcap is None):
             from dnn_tpu.ops.pallas.cached_attention import (
                 cached_attention, decode_attention,
             )
@@ -164,9 +186,10 @@ class FloatKV(_KernelDispatch):
                 interpret=self._interp()).astype(c["v"].dtype)
         d = q.shape[-1]
         s = jnp.einsum("bhtd,bhsd->bhts", q, c["k"]).astype(jnp.float32) / jnp.sqrt(d)
+        s = self._cap(s)
         cols = jnp.arange(c["k"].shape[2])
         keep = self._band_keep(cols[None, None, None, :],
-                               pos_limit[None, None, :, None])
+                               pos_limit[None, None, :, None], window)
         s = jnp.where(keep, s, _NEG_BIG)
         p = jax.nn.softmax(s, axis=-1)
         return jnp.einsum("bhts,bhsd->bhtd", p.astype(c["v"].dtype), c["v"])
@@ -181,7 +204,7 @@ class FloatKV(_KernelDispatch):
         return {"k": jnp.where(w, k_new, c["k"]),
                 "v": jnp.where(w, v_new, c["v"])}
 
-    def attend_rows_causal(self, q, c, pos):
+    def attend_rows_causal(self, q, c, pos, window=None):
         """q (B, H, T, D) VERIFY blocks: row t of slot b attends cache
         columns <= pos[b] + t (per-row positions AND within-block
         causality — the speculative verify chunk's masking, which neither
@@ -194,20 +217,22 @@ class FloatKV(_KernelDispatch):
         d = q.shape[-1]
         s = jnp.einsum("bhtd,bhsd->bhts", q, c["k"]).astype(jnp.float32) \
             / jnp.sqrt(d)
+        s = self._cap(s)
         cols = jnp.arange(c["k"].shape[2])
         rows = jnp.arange(q.shape[2])
         limit = pos[:, None, None, None] + rows[None, None, :, None]
-        keep = self._band_keep(cols[None, None, None, :], limit)
+        keep = self._band_keep(cols[None, None, None, :], limit, window)
         s = jnp.where(keep, s, _NEG_BIG)
         p = jax.nn.softmax(s, axis=-1)
         return jnp.einsum("bhts,bhsd->bhtd", p.astype(c["v"].dtype),
                           c["v"])
 
-    def attend_rows(self, q, c, pos):
+    def attend_rows(self, q, c, pos, window=None):
         """q (B, H, R, D); every row of slot b masked to keys at positions
         <= pos[b]. R=1 is plain per-slot decode; R=G is the LLaMA GQA fold
         (all group rows share their slot's limit — llama.LlamaFamilyRows)."""
-        if self.use_kernel and self.window is None:
+        if (self.use_kernel and self.window is None and window is None
+                and self.softcap is None):
             from dnn_tpu.ops.pallas.cached_attention import decode_attention
 
             return decode_attention(q, c["k"], c["v"], pos,
@@ -215,7 +240,8 @@ class FloatKV(_KernelDispatch):
                 .astype(c["v"].dtype)
         d = q.shape[-1]
         s = jnp.einsum("bhtd,bhsd->bhts", q, c["k"]).astype(jnp.float32) / jnp.sqrt(d)
-        s = jnp.where(self._rows_keep(c, pos), s, _NEG_BIG)
+        s = self._cap(s)
+        s = jnp.where(self._rows_keep(c, pos, window), s, _NEG_BIG)
         p = jax.nn.softmax(s, axis=-1)
         return jnp.einsum("bhts,bhsd->bhtd", p.astype(c["v"].dtype), c["v"])
 
@@ -241,9 +267,11 @@ class Int8KV(_KernelDispatch):
     `window=W`: sliding-window lower bound, exactly as FloatKV's."""
 
     def __init__(self, use_kernel: bool = False,
-                 window: Optional[int] = None):
+                 window: Optional[int] = None,
+                 softcap: Optional[float] = None):
         self.use_kernel = use_kernel
         self.window = window
+        self.softcap = softcap
 
     def init(self, cfg, batch: int, max_len: int):
         shape = (cfg.n_layer, batch, cfg.n_head, max_len,
@@ -265,10 +293,11 @@ class Int8KV(_KernelDispatch):
             "vs": lax.dynamic_update_slice_in_dim(c["vs"], vs, start_pos, axis=2),
         }
 
-    def attend(self, q, c, pos_limit, base=None):
+    def attend(self, q, c, pos_limit, base=None, window=None):
         # `base` marks the pos_limit == base + arange(T) contract (see
         # FloatKV.attend) — kernel path only with it
-        if self.use_kernel and base is not None and self.window is None:
+        if (self.use_kernel and base is not None and self.window is None
+                and window is None and self.softcap is None):
             from dnn_tpu.ops.pallas.cached_attention import (
                 cached_attention, decode_attention,
             )
@@ -288,9 +317,10 @@ class Int8KV(_KernelDispatch):
                        c["k"].astype(jnp.float32),
                        preferred_element_type=jnp.float32)
         s = s * c["ks"][:, :, None, :] / jnp.sqrt(d)
+        s = self._cap(s)
         cols = jnp.arange(c["k"].shape[2])
         keep = self._band_keep(cols[None, None, None, :],
-                               pos_limit[None, None, :, None])
+                               pos_limit[None, None, :, None], window)
         s = jnp.where(keep, s, _NEG_BIG)
         p = jax.nn.softmax(s, axis=-1)
         # fold the V scale into the (small) probability matrix, then
@@ -316,7 +346,7 @@ class Int8KV(_KernelDispatch):
                  "vs": write_gate[:, None, None]}
         return {kk: jnp.where(gates[kk], new[kk], c[kk]) for kk in c}
 
-    def attend_rows_causal(self, q, c, pos):
+    def attend_rows_causal(self, q, c, pos, window=None):
         # per-row causal verify blocks (see FloatKV.attend_rows_causal);
         # scales fold exactly as in attend_rows' recipe
         d = q.shape[-1]
@@ -324,10 +354,11 @@ class Int8KV(_KernelDispatch):
                        c["k"].astype(jnp.float32),
                        preferred_element_type=jnp.float32)
         s = s * c["ks"][:, :, None, :] / jnp.sqrt(d)
+        s = self._cap(s)
         cols = jnp.arange(c["k"].shape[2])
         rows = jnp.arange(q.shape[2])
         limit = pos[:, None, None, None] + rows[None, None, :, None]
-        keep = self._band_keep(cols[None, None, None, :], limit)
+        keep = self._band_keep(cols[None, None, None, :], limit, window)
         s = jnp.where(keep, s, _NEG_BIG)
         p = jax.nn.softmax(s, axis=-1)
         p = p * c["vs"][:, :, None, :]
@@ -335,9 +366,10 @@ class Int8KV(_KernelDispatch):
                           c["v"].astype(jnp.float32),
                           preferred_element_type=jnp.float32)
 
-    def attend_rows(self, q, c, pos):
+    def attend_rows(self, q, c, pos, window=None):
         # shared-limit decode rows, any R (see FloatKV.attend_rows)
-        if self.use_kernel and self.window is None:
+        if (self.use_kernel and self.window is None and window is None
+                and self.softcap is None):
             from dnn_tpu.ops.pallas.cached_attention import decode_attention
 
             return decode_attention(q, c["k"], c["v"], pos,
@@ -348,7 +380,8 @@ class Int8KV(_KernelDispatch):
                        c["k"].astype(jnp.float32),
                        preferred_element_type=jnp.float32)
         s = s * c["ks"][:, :, None, :] / jnp.sqrt(d)
-        s = jnp.where(self._rows_keep(c, pos), s, _NEG_BIG)
+        s = self._cap(s)
+        s = jnp.where(self._rows_keep(c, pos, window), s, _NEG_BIG)
         p = jax.nn.softmax(s, axis=-1)
         p = p * c["vs"][:, :, None, :]
         return jnp.einsum("bhts,bhsd->bhtd", p, c["v"].astype(jnp.float32),
@@ -387,13 +420,13 @@ class _RingStorage:
         del max_len
         return super().init(cfg, batch, self.window)
 
-    def attend(self, q, c, pos_limit, base=None):
+    def attend(self, q, c, pos_limit, base=None, window=None):
         if q.shape[2] != 1:
             raise ValueError(
                 "rolling cache attends single decode rows only — prefill "
                 "on a full-length cache with window= masking, then gather "
                 "the live band (llama.make_generate's rolling path)")
-        del base
+        del base, window
         return self.attend_rows(
             q, c, jnp.broadcast_to(pos_limit[0], (q.shape[0],)))
 
@@ -401,14 +434,17 @@ class _RingStorage:
         w = c["k"].shape[2]
         return super().write_rows(c, k, v, jnp.mod(pos, w), write_gate)
 
-    def attend_rows_causal(self, q, c, pos):
+    def attend_rows_causal(self, q, c, pos, window=None):
         raise ValueError(
             "speculative verify blocks need a full-length cache — rolling "
             "storage cannot express per-row history beyond the ring")
 
-    def _rows_keep(self, c, pos):
+    def _rows_keep(self, c, pos, window=None):
         """Ring occupancy replaces the band mask — the one masking
-        difference vs the base codec (see _KernelDispatch._rows_keep)."""
+        difference vs the base codec (see _KernelDispatch._rows_keep).
+        A per-call window override makes no sense on a ring (storage IS
+        the window) and is ignored."""
+        del window
         return (ring_positions(pos, c["k"].shape[2]) >= 0)[:, None, None, :]
 
     @staticmethod
@@ -465,17 +501,24 @@ class RollingInt8KV(_RingStorage, Int8KV):
 
 
 def codec_for_cache(cache, use_kernel: bool = False,
-                    window: Optional[int] = None, rolling: bool = False):
+                    window: Optional[int] = None, rolling: bool = False,
+                    softcap: Optional[float] = None):
     """Infer the codec from a cache pytree's structure (int8 caches carry
     scale leaves). `use_kernel` opts attend/attend_rows into the Pallas
     cached-attention kernel (TPU; einsum fallback elsewhere). `window`
     adds the sliding-window lower bound; `rolling=True` additionally
     treats the cache as a `window`-length ring buffer (rolling cannot be
-    inferred from structure — a ring leaf looks like a short cache)."""
+    inferred from structure — a ring leaf looks like a short cache).
+    `softcap` is Gemma-2's attention-logit softcapping (einsum paths
+    only; no rolling support — Gemma-2 alternates local/global layers,
+    so its decode never rolls)."""
     if rolling:
+        if softcap is not None:
+            raise ValueError("softcap is not supported on rolling caches")
         if "ks" in cache:
             return RollingInt8KV(window=window)
         return RollingFloatKV(cache["k"].dtype, window=window)
     if "ks" in cache:
-        return Int8KV(use_kernel=use_kernel, window=window)
-    return FloatKV(cache["k"].dtype, use_kernel=use_kernel, window=window)
+        return Int8KV(use_kernel=use_kernel, window=window, softcap=softcap)
+    return FloatKV(cache["k"].dtype, use_kernel=use_kernel, window=window,
+                   softcap=softcap)

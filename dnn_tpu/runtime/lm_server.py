@@ -55,7 +55,8 @@ __all__ = ["LMServer", "serve_lm", "start_lm_server_in_background",
 
 def parse_gen_options(request_id: str, default_max_new: int):
     """'gen[:max_new[:seed]][:t=TEMP][:k=TOPK][:p=TOPP][:m=MINP]
-    [:r=REPPEN][:b=ID~VAL,ID~VAL][:a=ADAPTER]' -> (max_new, seed, opts).
+    [:r=REPPEN][:b=ID~VAL,ID~VAL][:a=ADAPTER][:j=JSONDEPTH]'
+    -> (max_new, seed, opts).
     Only the literal 'gen' prefix carries options —
     any other request_id (e.g. a reference client's tracing id like
     'req:1234') gets the server defaults instead of being reinterpreted as
@@ -80,7 +81,11 @@ def parse_gen_options(request_id: str, default_max_new: int):
     named = {"t": ("temperature", float), "k": ("top_k", int),
              "p": ("top_p", float), "a": ("adapter", int),
              "m": ("min_p", float), "r": ("repetition_penalty", float),
-             "b": ("logit_bias", _parse_bias)}
+             "b": ("logit_bias", _parse_bias),
+             # JSON mode: constrain the completion to a JSON value nested
+             # up to DEPTH levels (runtime/constrain.json_regex); resolved
+             # to a compiled TokenConstraint in LMServer._preflight
+             "j": ("json_depth", int)}
     pos = 0
     for seg in parts[1:]:
         if "=" in seg:
@@ -361,8 +366,34 @@ class LMServer:
         # optional text front (dnn_tpu/io/tokenizer.py): with it,
         # SendMessage serves prompt text -> generated text
         self.tokenizer = tokenizer
+        # JSON-mode constraints are per-(depth) compile-once artifacts —
+        # the token table is vocab-sized work shared by every request
+        self._constraint_cache: dict = {}
         self.worker = _BatcherWorker(self.batcher)
         self.worker.start()
+
+    _MAX_JSON_DEPTH = 3  # regex expansion grows with depth; bound it
+
+    def json_constraint(self, depth: int):
+        """Compile-once TokenConstraint for a depth-bounded JSON value
+        (the gen option ':j=DEPTH'). Returns None when the server's
+        tokenizer exposes no token->bytes map (constraints need one).
+        Raises ValueError for an out-of-range depth."""
+        depth = int(depth)
+        if not 0 <= depth <= self._MAX_JSON_DEPTH:
+            raise ValueError(
+                f"json depth must be in [0, {self._MAX_JSON_DEPTH}], "
+                f"got {depth}")
+        vb = getattr(self.tokenizer, "vocab_bytes", None)
+        if vb is None:
+            return None
+        c = self._constraint_cache.get(depth)
+        if c is None:
+            from dnn_tpu.runtime.constrain import TokenConstraint, json_regex
+
+            c = TokenConstraint.from_regex(json_regex(depth), vb())
+            self._constraint_cache[depth] = c
+        return c
 
     # --- RPC implementations (names/signatures fixed by the protocol) ---
 
@@ -373,7 +404,25 @@ class LMServer:
             await context.abort(
                 grpc.StatusCode.UNAVAILABLE,
                 "LM batcher worker is not running (died or shut down)")
-        return parse_gen_options(request_id, self.default_max_new)
+        max_new, seed, opts = parse_gen_options(request_id,
+                                                self.default_max_new)
+        if "json_depth" in opts:
+            try:
+                # first use per depth compiles an (S, V) token table —
+                # vocab-sized host work that must not block the event
+                # loop (every concurrent RPC stalls behind _preflight)
+                c = await asyncio.to_thread(self.json_constraint,
+                                            opts.pop("json_depth"))
+            except ValueError as e:
+                await context.abort(grpc.StatusCode.INVALID_ARGUMENT,
+                                    str(e))
+            if c is None:
+                await context.abort(
+                    grpc.StatusCode.INVALID_ARGUMENT,
+                    "JSON mode (j=) needs a server tokenizer with a "
+                    "token->bytes map (io/tokenizer.ByteTokenizer)")
+            opts["constraint"] = c
+        return max_new, seed, opts
 
     async def _result_or_abort(self, fut, context):
         """Map a COMPLETED worker future to the shared status ladder

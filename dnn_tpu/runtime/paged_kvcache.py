@@ -224,11 +224,16 @@ class PagedKV:
             out.append(g.reshape(b, h, nb * bp, *rest))
         return out
 
-    def attend_rows(self, q, c, pos):
+    def attend_rows(self, q, c, pos, window=None):
         """q (B, H, R, D); every row of slot b attends logical positions
         <= pos[b] (identical math to kvcache.FloatKV/Int8KV.attend_rows
         on the gathered view — int8 pools fold their per-position scales
-        onto the score/probability matrices, never a float cache copy)."""
+        onto the score/probability matrices, never a float cache copy).
+        The pool is causal-only: windowed/alt-window families are
+        rejected at batcher construction (paged_ok), so a non-None
+        `window` here is a programming error."""
+        if window is not None:
+            raise ValueError("PagedKV attends causal-only (no window)")
         quant = "ks" in c
         if quant:
             k, v, ks, vs = self.gather_view(c, ("k", "v", "ks", "vs"))

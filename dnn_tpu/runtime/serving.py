@@ -193,6 +193,13 @@ class ContinuousBatcher:
         srv.drain()      # run to completion -> {rid: np.ndarray tokens}
     """
 
+    # class-level capability: variants that commit >1 token per step
+    # (SpeculativeBatcher) override this to False — per-token grammar
+    # masks cannot gate a verified chunk. A class attribute (not an
+    # instance flag set around super().__init__) so there is no
+    # initialization-order hazard to refactor away.
+    _constraints_ok = True
+
     def __init__(self, cfg: GPTConfig, prepared, *, slots: int = 4,
                  max_len: Optional[int] = None, prompt_pad: Optional[int] = None,
                  temperature: float = 0.0, top_k: Optional[int] = None,
@@ -204,7 +211,8 @@ class ContinuousBatcher:
                  logprobs_k: int = 0,
                  paged_blocks: int = 0, block_len: int = 16,
                  lora_adapters=None, lora_alphas=None,
-                 allow_logit_bias: bool = False):
+                 allow_logit_bias: bool = False,
+                 allow_constraints: bool = False):
         self.cfg = cfg
         self.prepared = prepared
         self.slots = slots
@@ -279,11 +287,12 @@ class ContinuousBatcher:
         self._paged = int(paged_blocks) > 0
         self._allocator = None
         if self._paged:
-            if getattr(self.family, "window", None) is not None:
+            if (getattr(self.family, "window", None) is not None
+                    or not getattr(self.family, "paged_ok", True)):
                 raise ValueError(
-                    "sliding-window families are not supported with the "
-                    "paged pool (PagedKV attends causal-only; use the "
-                    "dense per-slot cache, which window-masks)")
+                    "sliding-window / softcapped families are not supported "
+                    "with the paged pool (PagedKV attends causal-only; use "
+                    "the dense per-slot cache, which window-masks)")
             from dnn_tpu.runtime.paged_kvcache import (
                 BlockAllocator, PagedKV, init_paged_cache,
             )
@@ -335,7 +344,8 @@ class ContinuousBatcher:
             codec = codec_for_cache(
                 self.cache,
                 use_kernel=getattr(self.family, "attn_kernel", False),
-                window=getattr(self.family, "window", None))
+                window=getattr(self.family, "window", None),
+                softcap=getattr(self.family, "softcap", None))
         self.pos = jnp.zeros((slots,), jnp.int32)      # next write position
         self.tok = jnp.zeros((slots,), jnp.int32)      # last sampled token
         self.active = jnp.zeros((slots,), bool)
@@ -360,7 +370,15 @@ class ContinuousBatcher:
         # buffer alone is tens of MB), so the default programs/memory
         # are unchanged. The LM daemon enables it (its clients choose
         # options per request).
-        self._allow_bias = bool(allow_logit_bias)
+        # constrained decoding (runtime/constrain.TokenConstraint) rides
+        # the SAME per-slot bias buffer: the host advances each request's
+        # DFA state per committed token and refreshes its row — the
+        # compiled programs never change. allow_constraints therefore
+        # also allocates the buffer; the user-facing logit_bias submit
+        # option stays gated on allow_logit_bias alone.
+        self._allow_user_bias = bool(allow_logit_bias)
+        self._allow_constraints = bool(allow_constraints)
+        self._allow_bias = self._allow_user_bias or self._allow_constraints
         self._bias = (jnp.zeros((slots, cfg.vocab_size), jnp.float32)
                       if self._allow_bias
                       else jnp.zeros((slots, 0), jnp.float32))
@@ -552,7 +570,8 @@ class ContinuousBatcher:
                logit_bias: Optional[dict] = None,
                stop: Optional[list] = None,
                logprobs: bool = False,
-               adapter: Optional[int] = None) -> int:
+               adapter: Optional[int] = None,
+               constraint=None) -> int:
         """Prefill `prompt` (1-D int array) into a free slot; returns the
         request id. The first token is sampled during prefill and counts
         toward max_new_tokens. `seed` names the request's private rng
@@ -579,7 +598,13 @@ class ContinuousBatcher:
         logprobs_k > 0); `adapter` — index into the constructor's
         `lora_adapters` list (None = the base model): this request's
         prefill and every decode step apply that adapter's low-rank
-        delta while other slots apply theirs."""
+        delta while other slots apply theirs; `constraint` — a
+        runtime/constrain.TokenConstraint (compiled regex/JSON grammar):
+        every emitted token is masked to the grammar's continuations,
+        EOS is only reachable in accepting states, and when a match
+        completes with no possible continuation the request retires with
+        finish_reason "constraint" (server must be constructed with
+        allow_constraints=True)."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if len(prompt) == 0:
             raise ValueError("prompt must have at least one token")
@@ -607,15 +632,51 @@ class ContinuousBatcher:
             raise ValueError(f"min_p must be in [0, 1], got {mp}")
         if rp <= 0:
             raise ValueError(f"repetition_penalty must be > 0, got {rp}")
-        if logit_bias and not self._allow_bias:
+        if logit_bias and not self._allow_user_bias:
             raise ValueError(
                 "logit_bias requires allow_logit_bias=True at construction "
                 "(the per-slot bias buffer is a construction-time choice)")
+        if constraint is not None:
+            if not self._allow_constraints:
+                raise ValueError(
+                    "constraint= requires allow_constraints=True at "
+                    "construction (the per-slot bias buffer is a "
+                    "construction-time choice)")
+            if not self._constraints_ok:
+                raise ValueError(
+                    "this batcher variant commits multiple tokens per "
+                    "step and cannot honor per-token constraints")
+            if constraint.vocab_size != self.cfg.vocab_size:
+                raise ValueError(
+                    f"constraint compiled for vocab "
+                    f"{constraint.vocab_size} != model vocab "
+                    f"{self.cfg.vocab_size}")
+            if (self.eos_id is not None
+                    and constraint.allowed[:, self.eos_id].any()):
+                # the eos override in mask_row would ban a byte token the
+                # grammar NEEDS (and an emitted one would retire as "eos"
+                # mid-match) — fail fast instead of either wrong behavior
+                raise ValueError(
+                    f"eos_id {self.eos_id} maps to bytes this constraint's "
+                    "grammar can consume; serve constrained requests with "
+                    "a dedicated special token as eos")
         b_row = logit_bias_row(logit_bias, self.cfg.vocab_size)
         if b_row is None:
             b_row = jnp.zeros(
                 (self.cfg.vocab_size if self._allow_bias else 0,),
                 jnp.float32)
+        user_row = None
+        if constraint is not None:
+            # keep the USER's bias separate: every DFA advance re-adds it
+            # under the fresh grammar mask (one host copy, constrained
+            # requests only)
+            user_row = np.asarray(b_row, np.float32)
+            c_mask = constraint.mask_row(constraint.start, self.eos_id)
+            if not (c_mask == 0.0).any():
+                raise ValueError(
+                    "constraint permits no first token (empty language "
+                    "over this vocab)")
+            b_row = jnp.asarray(user_row + c_mask)
         tk = min(tk, TOP_P_PREFILTER_K)
         stop_seqs = []
         for s in (stop or []):
@@ -846,10 +907,18 @@ class ContinuousBatcher:
             req = {"rid": rid, "emitted": [first], "budget": max_new_tokens,
                    "stop": stop_seqs, "logprobs": logprobs and self._logprobs_k,
                    "blocks": paged_taken}
+            if constraint is not None:
+                req["constraint"] = constraint
+                req["c_state"] = constraint.start
+                req["user_bias"] = user_row
             if req["logprobs"]:
                 req["lp"] = [float(np.asarray(c_lp)[0])]
                 req["lp_top"] = [(np.asarray(t_ids)[0], np.asarray(t_lp)[0])]
             self._slot_req[slot] = req
+            if constraint is not None:
+                row = self._constraint_advance(slot, first)
+                if row is not None:
+                    self._bias = self._bias.at[slot].set(jnp.asarray(row))
             self._retire_if_done(slot)
             return rid
         except BaseException:
@@ -879,6 +948,32 @@ class ContinuousBatcher:
                 return n
         return 0
 
+    def _constraint_advance(self, slot: int, token: int):
+        """Walk a constrained slot's DFA over the token it just committed.
+        Returns the slot's refreshed bias row (np, user bias + new mask)
+        for the caller to install — step() batches all slots' rows into
+        ONE device update, submit() installs its single row directly —
+        or None when no refresh is needed. Sets `c_done` when the match
+        is complete with no possible continuation (retires as
+        "constraint" — the grammar, not the budget, ended the stream)."""
+        req = self._slot_req[slot]
+        c = req.get("constraint")
+        if c is None or (self.eos_id is not None and token == self.eos_id):
+            return None
+        ns = c.advance(req["c_state"], token)
+        if ns < 0:
+            # unreachable when masking works (the sampled token was
+            # allowed); defensive stop rather than emitting off-grammar
+            req["c_done"] = True
+            return None
+        req["c_state"] = ns
+        if not c.has_continuation(ns) and (
+                self.eos_id is None or not c.is_accepting(ns)):
+            # nothing can extend the match and EOS can't express the stop
+            req["c_done"] = True
+            return None
+        return req["user_bias"] + c.mask_row(ns, self.eos_id)
+
     def _retire_if_done(self, slot: int):
         req = self._slot_req[slot]
         reason = None
@@ -886,6 +981,8 @@ class ContinuousBatcher:
             reason = "eos"
         elif (n_stop := self._stop_match(req["emitted"], req["stop"])):
             reason = "stop"
+        elif req.get("c_done"):
+            reason = "constraint"
         elif len(req["emitted"]) >= req["budget"]:
             reason = "length"
         if reason is None:
@@ -981,6 +1078,7 @@ class ContinuousBatcher:
             self.cache, self.pos, self.tok, self.keys, self._seen = res
         toks = np.asarray(self.tok)
         out = {}
+        bias_updates = []  # (slot, np row) — flushed as ONE device update
         for slot, req in enumerate(self._slot_req):
             if req is None:
                 continue
@@ -990,7 +1088,18 @@ class ContinuousBatcher:
                 req["lp"].append(float(c_lp[slot]))
                 req["lp_top"].append((t_ids[slot], t_lp[slot]))
             out[req["rid"]] = token
+            if "constraint" in req:
+                row = self._constraint_advance(slot, token)
+                if row is not None:
+                    bias_updates.append((slot, row))
             self._retire_if_done(slot)
+        if bias_updates:
+            # one batched device update per step however many slots are
+            # constrained (a per-slot .at[].set would rebuild the whole
+            # (slots, V) buffer once per slot)
+            idx = jnp.asarray([s for s, _ in bias_updates], jnp.int32)
+            rows = jnp.asarray(np.stack([r for _, r in bias_updates]))
+            self._bias = self._bias.at[idx].set(rows)
         return out
 
     def drain(self) -> Dict[int, np.ndarray]:

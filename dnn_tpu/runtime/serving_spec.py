@@ -76,6 +76,10 @@ class SpeculativeBatcher(ContinuousBatcher):
     k+1 tokens per call via draft-model speculation. Submit/retire/stop/
     finish-reason surfaces are inherited unchanged."""
 
+    # a verified chunk commits up to k+1 tokens in one device call —
+    # per-token grammar masks cannot gate it (submit rejects constraint=)
+    _constraints_ok = False
+
     def __init__(self, cfg: GPTConfig, prepared, draft_cfg: GPTConfig,
                  draft_prepared, *, spec_k: int = 4, **kw):
         if cfg.vocab_size != draft_cfg.vocab_size:
