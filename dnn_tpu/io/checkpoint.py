@@ -310,7 +310,9 @@ def gpt_params_from_state_dict(sd: Dict[str, np.ndarray], n_layer: Optional[int]
 
 
 def llama_params_from_state_dict(sd: Dict[str, np.ndarray],
-                                 n_layer: Optional[int] = None):
+                                 n_layer: Optional[int] = None,
+                                 post_norms: bool = False,
+                                 tied_head: str = "materialize"):
     """Convert an HF LlamaForCausalLM state dict (model.embed_tokens /
     model.layers.N.self_attn.{q,k,v,o}_proj / mlp.{gate,up,down}_proj /
     input_layernorm / post_attention_layernorm / model.norm / lm_head) to
@@ -319,7 +321,19 @@ def llama_params_from_state_dict(sd: Dict[str, np.ndarray],
     (out, in) -> (in, out) transpose; RMSNorm weights map to 'scale'.
     Qwen2-class checkpoints (same layout + q/k/v projection BIASES) pass
     through unchanged: any present `*_proj.bias` rides along as a 'bias'
-    leaf, which ops.nn.linear applies wherever the kernel goes."""
+    leaf, which ops.nn.linear applies wherever the kernel goes.
+
+    Gemma checkpoints share the layout (GemmaForCausalLM); the two
+    divergences are opt-in:
+      * `post_norms=True` (Gemma-2): `post_attention_layernorm` is the
+        POST-attention norm (-> post_ln_1) and the pre-MLP norm is
+        `pre_feedforward_layernorm` (-> ln_2), with
+        `post_feedforward_layernorm` -> post_ln_2. Under the default
+        (LLaMA/Gemma-1), `post_attention_layernorm` IS the pre-MLP norm.
+      * `tied_head="omit"`: tied-embedding checkpoints produce a pytree
+        with NO lm_head leaf (llama.head projects through wte.T — true
+        sharing, no V x C transpose copy); the default materializes the
+        transpose for untied model code."""
     # HF prefixes everything but lm_head with "model."
     sd = {(k[len("model."):] if k.startswith("model.") else k): v
           for k, v in sd.items()}
@@ -341,7 +355,7 @@ def llama_params_from_state_dict(sd: Dict[str, np.ndarray],
 
     for i in range(n_layer):
         p = f"layers.{i}."
-        params[f"h_{i}"] = {
+        blk = {
             "ln_1": {"scale": sd[p + "input_layernorm.weight"]},
             "attn": {
                 "q": _proj(p + "self_attn.q_proj"),
@@ -349,18 +363,40 @@ def llama_params_from_state_dict(sd: Dict[str, np.ndarray],
                 "v": _proj(p + "self_attn.v_proj"),
                 "o": _proj(p + "self_attn.o_proj"),
             },
-            "ln_2": {"scale": sd[p + "post_attention_layernorm.weight"]},
             "mlp": {
                 "gate": _proj(p + "mlp.gate_proj"),
                 "up": _proj(p + "mlp.up_proj"),
                 "down": _proj(p + "mlp.down_proj"),
             },
         }
+        if post_norms:  # Gemma-2 block: 4 norms, names shift meaning
+            blk["post_ln_1"] = {
+                "scale": sd[p + "post_attention_layernorm.weight"]}
+            blk["ln_2"] = {
+                "scale": sd[p + "pre_feedforward_layernorm.weight"]}
+            blk["post_ln_2"] = {
+                "scale": sd[p + "post_feedforward_layernorm.weight"]}
+        else:
+            blk["ln_2"] = {
+                "scale": sd[p + "post_attention_layernorm.weight"]}
+        params[f"h_{i}"] = blk
     # lm_head: explicit if present, else tied to the embedding
     # (LLaMA-3.2/Gemma-class models tie; TinyLlama-1.1B ships
     # tie_word_embeddings=false with an explicit lm_head.weight, as do
     # the 7B-class models)
-    if "lm_head.weight" in sd:
+    if tied_head == "omit":
+        # tied pytree: llama.head projects through wte.embedding.T. Tied
+        # HF models still EXPORT an lm_head.weight alias of the embedding
+        # in state_dict() — verify it really is the same tensor rather
+        # than silently dropping a genuinely different head.
+        if "lm_head.weight" in sd and not np.array_equal(
+                np.asarray(sd["lm_head.weight"]),
+                np.asarray(sd["embed_tokens.weight"])):
+            raise ValueError(
+                "tied_head='omit' but the checkpoint's lm_head.weight "
+                "differs from embed_tokens.weight — this model is not "
+                "tied; convert with tied_head='materialize'")
+    elif "lm_head.weight" in sd:
         params["lm_head"] = {"kernel": _t_linear(sd["lm_head.weight"])}
     else:
         params["lm_head"] = {

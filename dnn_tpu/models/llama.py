@@ -85,9 +85,47 @@ class LlamaConfig:
     # affects init and the HF config mapping — converted checkpoints
     # carry their biases regardless.
     attn_bias: bool = False
+    # ---- Gemma-family architecture switches (all default off, so every
+    # pre-Gemma preset is bit-identical to before they existed) ----
+    # Gemma decouples head_dim from n_embd/n_head (e.g. 2048/8 heads but
+    # d=256); None keeps the LLaMA relation.
+    head_dim_override: Optional[int] = None
+    # RMSNorm scales by (1 + w) — Gemma checkpoints store zero-centered
+    # norm weights (ops.nn.rms_norm plus_one).
+    norm_plus_one: bool = False
+    # MLP gate nonlinearity: "silu" (LLaMA SwiGLU) or "gelu_tanh"
+    # (Gemma GeGLU — torch gelu_pytorch_tanh == jax.nn.gelu approximate).
+    mlp_act: str = "silu"
+    # Tied input/output embeddings: params carry NO lm_head leaf; head()
+    # projects through wte.embedding.T (true weight sharing — one copy in
+    # HBM, and a training gradient that flows to the single table).
+    tie_word_embeddings: bool = False
+    # Gemma scales token embeddings by sqrt(n_embd) at input.
+    embed_scale: bool = False
+    # Gemma-2: attention scores divide by sqrt(query_scale) instead of
+    # sqrt(head_dim) (HF query_pre_attn_scalar). Folded into q after RoPE
+    # (q *= sqrt(head_dim/query_scale)) so every attention path — dense,
+    # cached, per-row — inherits it through its existing 1/sqrt(d).
+    query_scale: Optional[float] = None
+    # Gemma-2 logit softcaps: s -> cap * tanh(s / cap) on attention
+    # scores (before masking) and on the final lm_head logits.
+    attn_softcap: Optional[float] = None
+    final_softcap: Optional[float] = None
+    # Gemma-2 block shape: RMSNorms AFTER attention and after the MLP
+    # (applied to the branch output before its residual add), in addition
+    # to the usual pre-norms — param leaves post_ln_1 / post_ln_2.
+    post_norms: bool = False
+    # Gemma-2 alternating attention: EVEN layers use sliding_window,
+    # ODD layers attend globally (matches HF Gemma2's layer pattern).
+    # Implemented by threading a per-layer window through the block scan
+    # (kvcache._KernelDispatch docstring); a global layer's entry is
+    # block_size, which makes the band's lower bound vacuous.
+    alt_window: bool = False
 
     @property
     def head_dim(self):
+        if self.head_dim_override is not None:
+            return self.head_dim_override
         return self.n_embd // self.n_head
 
 
@@ -127,7 +165,61 @@ PRESETS = {
     "qwen2-test": LlamaConfig(block_size=64, vocab_size=256, n_layer=4,
                               n_head=4, n_kv_head=2, n_embd=64, d_ff=128,
                               attn_bias=True),
+    # Gemma-2B shape: (1+w) RMSNorm, GeGLU, tied + sqrt(C)-scaled
+    # embeddings, MQA with head_dim decoupled from n_embd/n_head
+    "gemma-2b": LlamaConfig(block_size=8192, vocab_size=256000,
+                            n_layer=18, n_head=8, n_kv_head=1,
+                            n_embd=2048, d_ff=16384,
+                            head_dim_override=256, rms_eps=1e-6,
+                            norm_plus_one=True, mlp_act="gelu_tanh",
+                            tie_word_embeddings=True, embed_scale=True),
+    # Gemma-7B shape (MHA, same block recipe)
+    "gemma-7b": LlamaConfig(block_size=8192, vocab_size=256000,
+                            n_layer=28, n_head=16, n_kv_head=16,
+                            n_embd=3072, d_ff=24576,
+                            head_dim_override=256, rms_eps=1e-6,
+                            norm_plus_one=True, mlp_act="gelu_tanh",
+                            tie_word_embeddings=True, embed_scale=True),
+    # tiny Gemma-1 config for tests (MQA + head_dim override exercised)
+    "gemma-test": LlamaConfig(block_size=64, vocab_size=256, n_layer=4,
+                              n_head=4, n_kv_head=1, n_embd=64, d_ff=128,
+                              head_dim_override=32, rms_eps=1e-6,
+                              norm_plus_one=True, mlp_act="gelu_tanh",
+                              tie_word_embeddings=True, embed_scale=True),
+    # Gemma-2-9B shape: Gemma block + post-norms, logit softcaps,
+    # query_pre_attn_scalar, alternating 4096-window/global layers
+    "gemma2-9b": LlamaConfig(block_size=8192, vocab_size=256000,
+                             n_layer=42, n_head=16, n_kv_head=8,
+                             n_embd=3584, d_ff=14336,
+                             head_dim_override=256, rms_eps=1e-6,
+                             norm_plus_one=True, mlp_act="gelu_tanh",
+                             tie_word_embeddings=True, embed_scale=True,
+                             post_norms=True, query_scale=256.0,
+                             attn_softcap=50.0, final_softcap=30.0,
+                             sliding_window=4096, alt_window=True),
+    # tiny Gemma-2 config for tests: window far below block_size and
+    # query_scale != head_dim so every switch actually acts
+    "gemma2-test": LlamaConfig(block_size=64, vocab_size=256, n_layer=4,
+                               n_head=4, n_kv_head=2, n_embd=64, d_ff=128,
+                               head_dim_override=32, rms_eps=1e-6,
+                               norm_plus_one=True, mlp_act="gelu_tanh",
+                               tie_word_embeddings=True, embed_scale=True,
+                               post_norms=True, query_scale=64.0,
+                               attn_softcap=50.0, final_softcap=30.0,
+                               sliding_window=16, alt_window=True),
 }
+
+
+def layer_windows(cfg: LlamaConfig):
+    """Per-layer sliding-window array for alternating-attention configs:
+    (L,) int32, cfg.sliding_window on EVEN layers, block_size (a vacuous
+    band bound — positions never reach it) on ODD/global layers. None for
+    uniform-attention configs, which keep the static codec window."""
+    if not (cfg.alt_window and cfg.sliding_window is not None):
+        return None
+    return jnp.asarray(
+        [cfg.sliding_window if i % 2 == 0 else cfg.block_size
+         for i in range(cfg.n_layer)], jnp.int32)
 
 
 # --------------------------------------------------------------------------
@@ -148,8 +240,11 @@ def init_block(key, cfg: LlamaConfig, dtype=jnp.float32):
             p["bias"] = jnp.zeros((shape[-1],), dtype)
         return p
 
-    return {
-        "ln_1": {"scale": jnp.ones((c,), dtype)},
+    # Gemma norms init at ZERO ((1+w) scaling makes 0 the identity);
+    # plain RMSNorm inits at one
+    norm_init = jnp.zeros if cfg.norm_plus_one else jnp.ones
+    blk = {
+        "ln_1": {"scale": norm_init((c,), dtype)},
         "attn": {
             "q": _qkv(ks[0], (c, cfg.n_head * d)),
             "k": _qkv(ks[1], (c, cfg.n_kv_head * d)),
@@ -157,7 +252,7 @@ def init_block(key, cfg: LlamaConfig, dtype=jnp.float32):
             "o": _kernel(ks[3], (cfg.n_head * d, c), dtype,
                          std=0.02 / (2 * cfg.n_layer) ** 0.5),
         },
-        "ln_2": {"scale": jnp.ones((c,), dtype)},
+        "ln_2": {"scale": norm_init((c,), dtype)},
         "mlp": {
             "gate": _kernel(ks[4], (c, cfg.d_ff), dtype),
             "up": _kernel(ks[5], (c, cfg.d_ff), dtype),
@@ -165,17 +260,25 @@ def init_block(key, cfg: LlamaConfig, dtype=jnp.float32):
                             std=0.02 / (2 * cfg.n_layer) ** 0.5),
         },
     }
+    if cfg.post_norms:
+        blk["post_ln_1"] = {"scale": norm_init((c,), dtype)}
+        blk["post_ln_2"] = {"scale": norm_init((c,), dtype)}
+    return blk
 
 
 def init(rng, cfg: LlamaConfig = PRESETS["llama-test"], dtype=jnp.float32):
     keys = jax.random.split(rng, cfg.n_layer + 3)
     c = cfg.n_embd
+    norm_init = jnp.zeros if cfg.norm_plus_one else jnp.ones
     params = {
         "wte": {"embedding": (jax.random.normal(keys[0], (cfg.vocab_size, c))
                               * 0.02).astype(dtype)},
-        "ln_f": {"scale": jnp.ones((c,), dtype)},
-        "lm_head": _kernel(keys[1], (c, cfg.vocab_size), dtype),
+        "ln_f": {"scale": norm_init((c,), dtype)},
     }
+    if not cfg.tie_word_embeddings:
+        # tied configs carry NO lm_head leaf — head() projects through
+        # wte.embedding.T (one table in HBM, shared gradient)
+        params["lm_head"] = _kernel(keys[1], (c, cfg.vocab_size), dtype)
     for i in range(cfg.n_layer):
         params[f"h_{i}"] = init_block(keys[2 + i], cfg, dtype)
     return params
@@ -215,6 +318,31 @@ def _rope_tables(cfg: LlamaConfig, positions):
     return rope_cos_sin(positions, cfg.head_dim, theta=theta)
 
 
+def _norm(p, x, cfg: LlamaConfig):
+    """The family's RMSNorm: cfg.rms_eps, (1+w) scaling for Gemma
+    (norm_plus_one). EVERY norm site in this module goes through here."""
+    return rms_norm(p, x, eps=cfg.rms_eps, plus_one=cfg.norm_plus_one)
+
+
+def _mlp_act(cfg: LlamaConfig):
+    if cfg.mlp_act == "silu":
+        return silu
+    if cfg.mlp_act == "gelu_tanh":  # Gemma GeGLU (gelu_pytorch_tanh)
+        from dnn_tpu.ops.nn import gelu
+        return gelu
+    raise ValueError(f"unknown mlp_act {cfg.mlp_act!r}")
+
+
+def _q_rescale(q, cfg: LlamaConfig):
+    """Fold Gemma-2's query_pre_attn_scalar into q: every attention path
+    divides scores by sqrt(head_dim), so scaling q by
+    sqrt(head_dim/query_scale) makes the effective divisor
+    sqrt(query_scale) with zero per-path plumbing."""
+    if cfg.query_scale is not None:
+        q = q * jnp.asarray((cfg.head_dim / cfg.query_scale) ** 0.5, q.dtype)
+    return q
+
+
 def _qkv_rope(bp, h, positions, *, cfg: LlamaConfig, compute_dtype):
     """Project h (B, T, C) and rotate q/k at absolute `positions` (T,).
     Returns q (B, H, T, D), k/v (B, KV, T, D) — KV heads stay narrow."""
@@ -225,26 +353,40 @@ def _qkv_rope(bp, h, positions, *, cfg: LlamaConfig, compute_dtype):
     v = split_heads(linear(bp["attn"]["v"], h, compute_dtype=compute_dtype),
                     cfg.n_kv_head)
     cos, sin = _rope_tables(cfg, positions)
-    return apply_rope(q, cos, sin), apply_rope(k, cos, sin), v
+    return _q_rescale(apply_rope(q, cos, sin), cfg), apply_rope(k, cos, sin), v
 
 
 def _mlp_residual(bp, x, *, cfg: LlamaConfig, compute_dtype):
-    """Post-attention half of every block: RMSNorm + SwiGLU MLP, residual.
-    ONE definition shared by the stateless forward, the cached decode, and
-    the per-slot batcher path — their parity contracts depend on these
-    never diverging."""
-    h = rms_norm(bp["ln_2"], x, eps=cfg.rms_eps)
+    """Post-attention half of every block: RMSNorm + gated MLP (SwiGLU or
+    Gemma's GeGLU), Gemma-2 post-MLP norm, residual. ONE definition shared
+    by the stateless forward, the cached decode, and the per-slot batcher
+    path — their parity contracts depend on these never diverging."""
+    h = _norm(bp["ln_2"], x, cfg)
+    act = _mlp_act(cfg)
     m = linear(bp["mlp"]["down"],
-               silu(linear(bp["mlp"]["gate"], h, compute_dtype=compute_dtype))
+               act(linear(bp["mlp"]["gate"], h, compute_dtype=compute_dtype))
                * linear(bp["mlp"]["up"], h, compute_dtype=compute_dtype),
                compute_dtype=compute_dtype)
+    if cfg.post_norms:
+        m = _norm(bp["post_ln_2"], m, cfg)
     return x + m.astype(x.dtype)
 
 
-def _gqa_scores_attend(q, k, v, mask_fn):
+def _attn_out_residual(bp, x, o, cfg: LlamaConfig):
+    """Attention branch output -> residual add, through Gemma-2's
+    post-attention norm when configured. `o` is the o-projected branch
+    output in x's dtype."""
+    if cfg.post_norms:
+        o = _norm(bp["post_ln_1"], o, cfg)
+    return x + o.astype(x.dtype)
+
+
+def _gqa_scores_attend(q, k, v, mask_fn, softcap=None):
     """Grouped attention: q (B, H, T, D) vs k/v (B, KV, S, D) with
     H = G * KV. Folds the group into the row dim so einsums run at KV
-    heads; `mask_fn(scores (B, KV, G, T, S)) -> masked scores`."""
+    heads; `mask_fn(scores (B, KV, G, T, S)) -> masked scores`;
+    `softcap` bounds scores via cap*tanh(s/cap) BEFORE masking
+    (Gemma-2 attn_logit_softcapping)."""
     b, h, t, d = q.shape
     kv = k.shape[1]
     g = h // kv
@@ -252,41 +394,60 @@ def _gqa_scores_attend(q, k, v, mask_fn):
     s = jnp.einsum("bkgtd,bksd->bkgts", qg.astype(jnp.float32),
                    k.astype(jnp.float32),
                    preferred_element_type=jnp.float32) / jnp.sqrt(d)
+    if softcap is not None:
+        s = softcap * jnp.tanh(s / softcap)
     p = jax.nn.softmax(mask_fn(s), axis=-1)
     y = jnp.einsum("bkgts,bksd->bkgtd", p, v.astype(jnp.float32))
     return y.reshape(b, h, t, d)
 
 
-def _dense_attn(bp, h, *, cfg: LlamaConfig, compute_dtype):
+def _dense_attn(bp, h, *, cfg: LlamaConfig, compute_dtype, window=None):
     """Default attention: local causal GQA over the whole (B, T, C) h,
-    band-limited to cfg.sliding_window when set."""
+    band-limited to cfg.sliding_window when set. `window` overrides the
+    config's window for this call (traced allowed) — the per-layer hook
+    alternating-attention configs thread through blocks_scan."""
     t = h.shape[1]
     q, k, v = _qkv_rope(bp, h, jnp.arange(t), cfg=cfg,
                         compute_dtype=compute_dtype)
     rows = jnp.arange(t)
+    w = window if window is not None else cfg.sliding_window
 
     def causal(s):
         qr = rows[None, None, None, :, None]
         kr = rows[None, None, None, None, :]
         keep = qr >= kr
-        if cfg.sliding_window is not None:
-            keep &= kr > qr - cfg.sliding_window
+        if w is not None:
+            keep &= kr > qr - w
         return jnp.where(keep, s, _NEG_BIG)
 
-    y = _gqa_scores_attend(q, k, v, causal)
+    y = _gqa_scores_attend(q, k, v, causal, softcap=cfg.attn_softcap)
     return linear(bp["attn"]["o"], merge_heads(y.astype(h.dtype)),
                   compute_dtype=compute_dtype)
 
 
-def block_apply(bp, x, *, cfg: LlamaConfig, compute_dtype=None, attn_fn=None):
-    """Pre-RMSNorm block: GQA attention + SwiGLU MLP, both residual.
+def block_apply(bp, x, *, cfg: LlamaConfig, compute_dtype=None, attn_fn=None,
+                window=None):
+    """Pre-RMSNorm block: GQA attention + gated MLP, both residual
+    (Gemma-2 additionally norms each branch output — post_norms).
     `attn_fn(bp, h)` overrides the attention (the sequence-parallel ring
-    plugs in here — same hook pattern as gpt._block_core)."""
+    plugs in here — same hook pattern as gpt._block_core); `window` is
+    the per-layer window override for the default dense attention."""
     fn = attn_fn or (lambda bp2, h: _dense_attn(
-        bp2, h, cfg=cfg, compute_dtype=compute_dtype))
-    h = rms_norm(bp["ln_1"], x, eps=cfg.rms_eps)
-    x = x + fn(bp, h)
+        bp2, h, cfg=cfg, compute_dtype=compute_dtype, window=window))
+    h = _norm(bp["ln_1"], x, cfg)
+    x = _attn_out_residual(bp, x, fn(bp, h), cfg)
     return _mlp_residual(bp, x, cfg=cfg, compute_dtype=compute_dtype)
+
+
+def _scaled_embed(p, ids, cfg: LlamaConfig):
+    """Token lookup + Gemma's sqrt(C) input scaling — the ONE definition
+    every path (dense forward, cached decode, batcher rows, seq-parallel,
+    pipeline embed hook) must share, or their parity contracts break on
+    embed_scale configs."""
+    e = embedding(p["wte"], ids)
+    if cfg.embed_scale:
+        e = e * jnp.asarray(cfg.n_embd ** 0.5, e.dtype)
+    return e
 
 
 def embed(params, idx, *, cfg: LlamaConfig):
@@ -294,30 +455,49 @@ def embed(params, idx, *, cfg: LlamaConfig):
     if t > cfg.block_size:
         raise ValueError(
             f"Cannot forward: sequence length {t} > block_size {cfg.block_size}")
-    return embedding(params["wte"], idx)  # positions live in RoPE, not here
+    return _scaled_embed(params, idx, cfg)  # positions live in RoPE
 
 
 def head(params, x, *, cfg: LlamaConfig, compute_dtype=None, logits_dtype=None):
-    x = rms_norm(params["ln_f"], x, eps=cfg.rms_eps)
-    if compute_dtype is None:
-        out = linear(params["lm_head"], x)
+    x = _norm(params["ln_f"], x, cfg)
+    if "lm_head" in params:
+        lm = params["lm_head"]
     else:
-        out = linear(params["lm_head"], x, compute_dtype=compute_dtype,
+        # tied embeddings (Gemma, LLaMA-3.2-1B class): project through the
+        # input table's transpose — XLA folds the transpose into the dot
+        lm = {"kernel": params["wte"]["embedding"].T}
+    if compute_dtype is None:
+        out = linear(lm, x)
+    else:
+        out = linear(lm, x, compute_dtype=compute_dtype,
                      accum_dtype=jnp.float32)
+    if cfg.final_softcap is not None:  # Gemma-2 final_logit_softcapping
+        out = cfg.final_softcap * jnp.tanh(out / cfg.final_softcap)
     return out if logits_dtype is None else out.astype(logits_dtype)
 
 
-def blocks_scan(stacked, x, *, cfg, compute_dtype, remat=False, attn_fn=None):
-    block = (lambda bp, carry: block_apply(bp, carry, cfg=cfg,
-                                           compute_dtype=compute_dtype,
-                                           attn_fn=attn_fn))
+def blocks_scan(stacked, x, *, cfg, compute_dtype, remat=False, attn_fn=None,
+                windows=None):
+    """Scan the stacked blocks. `windows` is the per-layer window array
+    for alternating-attention configs ((L',) — already sliced to this
+    stack's layer range); None scans without the extra input."""
+    block = (lambda bp, carry, window=None: block_apply(
+        bp, carry, cfg=cfg, compute_dtype=compute_dtype,
+        attn_fn=attn_fn, window=window))
     if remat:
         block = jax.checkpoint(block)
 
-    def body(carry, bp):
-        return block(bp, carry), None
+    if windows is None:
+        def body(carry, bp):
+            return block(bp, carry), None
 
-    out, _ = jax.lax.scan(body, x, stacked)
+        out, _ = jax.lax.scan(body, x, stacked)
+    else:
+        def body_w(carry, xs):
+            bp, w = xs
+            return block(bp, carry, w), None
+
+        out, _ = jax.lax.scan(body_w, x, (stacked, windows))
     return out
 
 
@@ -328,7 +508,7 @@ def make_apply(cfg: LlamaConfig, *, compute_dtype=None, remat=False):
             x = x.astype(compute_dtype)
         stacked = gpt.stack_blocks(params, range(cfg.n_layer))
         x = blocks_scan(stacked, x, cfg=cfg, compute_dtype=compute_dtype,
-                         remat=remat)
+                         remat=remat, windows=layer_windows(cfg))
         return head(params, x.astype(jnp.float32), cfg=cfg,
                     compute_dtype=compute_dtype)
 
@@ -345,7 +525,8 @@ def make_apply_stacked(cfg: LlamaConfig, *, compute_dtype=None,
         if compute_dtype is not None:
             x = x.astype(compute_dtype)
         x = blocks_scan(prepared["blocks"], x, cfg=cfg,
-                         compute_dtype=compute_dtype, remat=remat)
+                         compute_dtype=compute_dtype, remat=remat,
+                         windows=layer_windows(cfg))
         return head(prepared, x.astype(jnp.float32), cfg=cfg,
                     compute_dtype=compute_dtype, logits_dtype=logits_dtype)
 
@@ -357,14 +538,16 @@ def make_apply_stacked(cfg: LlamaConfig, *, compute_dtype=None,
 # --------------------------------------------------------------------------
 
 def _block_with_cache(bp, x, layer_cache, start_pos, *, cfg: LlamaConfig,
-                      compute_dtype, codec):
+                      compute_dtype, codec, window=None):
     """Block over x (B, T, C) at absolute positions [start_pos,
     start_pos+T), writing ROTATED k (and v) into the narrow KV-head cache.
     GQA against the cache rides the same codec.attend as the GPT family by
-    folding the q group into the row dim and tiling pos_limit."""
+    folding the q group into the row dim and tiling pos_limit. `window`
+    overrides the codec's window for this layer (the alternating-attention
+    per-layer value — traced allowed)."""
     b, t, c = x.shape
     kv, g = cfg.n_kv_head, cfg.n_head // cfg.n_kv_head
-    h = rms_norm(bp["ln_1"], x, eps=cfg.rms_eps)
+    h = _norm(bp["ln_1"], x, cfg)
     q, k, v = _qkv_rope(bp, h, start_pos + jnp.arange(t), cfg=cfg,
                         compute_dtype=compute_dtype)
     layer_cache = codec.write(layer_cache, k, v, start_pos)
@@ -375,13 +558,16 @@ def _block_with_cache(bp, x, layer_cache, start_pos, *, cfg: LlamaConfig,
         # decode kernel when the codec carries use_kernel
         yg = codec.attend_rows(
             qg, layer_cache,
-            jnp.broadcast_to(jnp.asarray(start_pos, jnp.int32), (b,)))
+            jnp.broadcast_to(jnp.asarray(start_pos, jnp.int32), (b,)),
+            window=window)
     else:
         pos_limit = start_pos + jnp.arange(t)
-        yg = codec.attend(qg, layer_cache, jnp.tile(pos_limit, g))
+        yg = codec.attend(qg, layer_cache, jnp.tile(pos_limit, g),
+                          window=window)
     y = yg.reshape(b, cfg.n_head, t, cfg.head_dim)
-    x = x + linear(bp["attn"]["o"], merge_heads(y.astype(x.dtype)),
-                   compute_dtype=compute_dtype)
+    o = linear(bp["attn"]["o"], merge_heads(y.astype(x.dtype)),
+               compute_dtype=compute_dtype)
+    x = _attn_out_residual(bp, x, o, cfg)
     return _mlp_residual(bp, x, cfg=cfg, compute_dtype=compute_dtype), layer_cache
 
 
@@ -400,20 +586,33 @@ def forward_with_cache(prepared, ids, cache, start_pos, *, cfg: LlamaConfig,
                        compute_dtype=None, attn_kernel=False, rolling=False):
     from dnn_tpu.runtime.kvcache import codec_for_cache
 
+    wins = layer_windows(cfg)  # (L,) for alternating configs, else None
     codec = codec_for_cache(cache, use_kernel=attn_kernel,
-                            window=cfg.sliding_window, rolling=rolling)
-    x = embedding(prepared["wte"], ids)
+                            window=None if wins is not None
+                            else cfg.sliding_window,
+                            rolling=rolling, softcap=cfg.attn_softcap)
+    x = _scaled_embed(prepared, ids, cfg)
     if compute_dtype is not None:
         x = x.astype(compute_dtype)
 
-    def layer(carry, layer_in):
-        bp, layer_cache = layer_in
-        y, layer_cache = _block_with_cache(
-            bp, carry, layer_cache, start_pos, cfg=cfg,
-            compute_dtype=compute_dtype, codec=codec)
-        return y, layer_cache
+    if wins is None:
+        def layer(carry, layer_in):
+            bp, layer_cache = layer_in
+            y, layer_cache = _block_with_cache(
+                bp, carry, layer_cache, start_pos, cfg=cfg,
+                compute_dtype=compute_dtype, codec=codec)
+            return y, layer_cache
 
-    x, new_cache = lax.scan(layer, x, (prepared["blocks"], cache))
+        x, new_cache = lax.scan(layer, x, (prepared["blocks"], cache))
+    else:
+        def layer_w(carry, layer_in):
+            bp, layer_cache, w = layer_in
+            y, layer_cache = _block_with_cache(
+                bp, carry, layer_cache, start_pos, cfg=cfg,
+                compute_dtype=compute_dtype, codec=codec, window=w)
+            return y, layer_cache
+
+        x, new_cache = lax.scan(layer_w, x, (prepared["blocks"], cache, wins))
     logits = head(prepared, x.astype(jnp.float32), cfg=cfg,
                   compute_dtype=compute_dtype)
     return logits, new_cache
@@ -467,7 +666,10 @@ def make_generate(cfg: LlamaConfig, *, max_new_tokens: int,
                 f"block_size {cfg.block_size}")
         cache_dtype = kv_dtype if kv_dtype is not None else (compute_dtype or jnp.float32)
         w = cfg.sliding_window
-        rolling = w is not None and s_max > w
+        # alternating configs (Gemma-2) keep GLOBAL layers, so the cache
+        # can never roll down to the window — full-length cache with the
+        # per-layer band handled inside forward_with_cache
+        rolling = w is not None and s_max > w and not cfg.alt_window
         if rolling:
             # transient prompt-length cache (window-masked attends), then
             # the live band moves into the ring
@@ -530,13 +732,17 @@ def make_apply_seq_parallel(cfg: LlamaConfig, mesh, *, axis_name=None,
             "sliding-window configs are not supported on this path "
             "(a banded ring schedule could skip out-of-window hops — "
             "not implemented)")
+    if cfg.attn_softcap is not None:
+        raise ValueError(
+            "attention softcapping is not supported on the ring-attention "
+            "path (the online-softmax hop combine assumes raw scores)")
     axis = axis_name or SEQ_AXIS
 
     def local_fn(prepared, ids_local):
         b, t_local = ids_local.shape
         my = lax.axis_index(axis)
         pos = my * t_local + jnp.arange(t_local)  # global positions
-        x = embedding(prepared["wte"], ids_local)
+        x = _scaled_embed(prepared, ids_local, cfg)
         if compute_dtype is not None:
             x = x.astype(compute_dtype)
         kv, g, d = cfg.n_kv_head, cfg.n_head // cfg.n_kv_head, cfg.head_dim
@@ -603,6 +809,11 @@ def make_generate_seq_sharded(cfg: LlamaConfig, mesh, *, max_new_tokens: int,
         raise ValueError(
             "sequence-sharded decode keeps full history shards; "
             "sliding-window configs are not supported on this path")
+    if cfg.attn_softcap is not None:
+        raise ValueError(
+            "attention softcapping is not supported on the seq-sharded "
+            "decode path (the distributed online-softmax combines raw "
+            "per-shard score stats)")
     axis = axis_name or SEQ_AXIS
     n = mesh.shape[axis]
     kv, g, hd = cfg.n_kv_head, cfg.n_head // cfg.n_kv_head, cfg.head_dim
@@ -635,7 +846,7 @@ def make_generate_seq_sharded(cfg: LlamaConfig, mesh, *, max_new_tokens: int,
                       top_k=top_k, top_p=top_p)
 
         def block_step(bp, x, lc_k, lc_v, p):
-            h = rms_norm(bp["ln_1"], x, eps=cfg.rms_eps)
+            h = _norm(bp["ln_1"], x, cfg)
             q, k, v = _qkv_rope(bp, h, p + jnp.arange(1), cfg=cfg,
                                 compute_dtype=compute_dtype)
             p_loc = jnp.clip(p - lo, 0, sd - 1)
@@ -653,14 +864,15 @@ def make_generate_seq_sharded(cfg: LlamaConfig, mesh, *, max_new_tokens: int,
             g_o = lax.psum(o * w[..., None], axis)
             y = g_o / jnp.maximum(g_l, 1e-30)[..., None]
             y = y.reshape(b, cfg.n_head, 1, hd)
-            x = x + linear(bp["attn"]["o"], merge_heads(y.astype(x.dtype)),
-                           compute_dtype=compute_dtype)
+            o = linear(bp["attn"]["o"], merge_heads(y.astype(x.dtype)),
+                       compute_dtype=compute_dtype)
+            x = _attn_out_residual(bp, x, o, cfg)
             return (_mlp_residual(bp, x, cfg=cfg,
                                   compute_dtype=compute_dtype),
                     lc_k, lc_v)
 
         def decode_one(local, tok, rng, p):
-            x = embedding(prepared["wte"], tok[:, None])
+            x = _scaled_embed(prepared, tok[:, None], cfg)
             if compute_dtype is not None:
                 x = x.astype(compute_dtype)
 
@@ -725,8 +937,17 @@ class LlamaFamilyRows:
         self.kv_heads = cfg.n_kv_head
         # picked up by ContinuousBatcher: sliding-window masking over the
         # slot pool's full-length cache (storage unchanged — the pool is
-        # shared across slots, so the ring form doesn't apply here)
-        self.window = cfg.sliding_window
+        # shared across slots, so the ring form doesn't apply here).
+        # Alternating-window configs (Gemma-2) keep the CODEC dense and
+        # thread the per-layer window through the block scan instead.
+        self._wins = layer_windows(cfg)
+        self.window = None if self._wins is not None else cfg.sliding_window
+        # Gemma-2 attention softcapping rides the codec (serving builds
+        # the decode codec from this attr)
+        self.softcap = cfg.attn_softcap
+        # the paged pool attends causal-only (no band masking)
+        self.paged_ok = (cfg.sliding_window is None
+                         and cfg.attn_softcap is None)
 
     def init_cache(self, batch, max_len, dtype):
         return init_cache(self.cfg, batch, max_len, dtype)
@@ -736,11 +957,12 @@ class LlamaFamilyRows:
             prepared, padded, row_cache, start_pos, cfg=self.cfg,
             compute_dtype=self.compute_dtype, attn_kernel=self.attn_kernel)
 
-    def _block_rows(self, bp, x, layer_cache, pos, write, codec):
+    def _block_rows(self, bp, x, layer_cache, pos, write, codec,
+                    window=None):
         cfg, compute_dtype = self.cfg, self.compute_dtype
         b = x.shape[0]
         kv, g, d = cfg.n_kv_head, cfg.n_head // cfg.n_kv_head, cfg.head_dim
-        h = rms_norm(bp["ln_1"], x, eps=cfg.rms_eps)
+        h = _norm(bp["ln_1"], x, cfg)
         q = split_heads(linear(bp["attn"]["q"], h, compute_dtype=compute_dtype),
                         cfg.n_head)
         k = split_heads(linear(bp["attn"]["k"], h, compute_dtype=compute_dtype),
@@ -750,27 +972,39 @@ class LlamaFamilyRows:
         cos, sin = _rope_tables(cfg, pos)  # (B, D)
         cos, sin = cos[:, None, None, :], sin[:, None, None, :]
         q, k = apply_rope(q, cos, sin), apply_rope(k, cos, sin)
+        q = _q_rescale(q, cfg)
         layer_cache = codec.write_rows(layer_cache, k, v, pos, write)
         qg = q.reshape(b, kv, g, d)  # group rows share the slot's limit
-        y = codec.attend_rows(qg, layer_cache, pos)
+        y = codec.attend_rows(qg, layer_cache, pos, window=window)
         y = y.reshape(b, cfg.n_head, 1, d)
-        x = x + linear(bp["attn"]["o"], merge_heads(y.astype(x.dtype)),
-                       compute_dtype=compute_dtype)
+        o = linear(bp["attn"]["o"], merge_heads(y.astype(x.dtype)),
+                   compute_dtype=compute_dtype)
+        x = _attn_out_residual(bp, x, o, cfg)
         return (_mlp_residual(bp, x, cfg=cfg, compute_dtype=compute_dtype),
                 layer_cache)
 
     def decode_rows(self, prepared, cache, tok, pos, active, codec):
-        x = embedding(prepared["wte"], tok[:, None])  # (B, 1, C)
+        x = _scaled_embed(prepared, tok[:, None], self.cfg)  # (B, 1, C)
         if self.compute_dtype is not None:
             x = x.astype(self.compute_dtype)
 
-        def layer(carry, layer_in):
-            bp, layer_cache = layer_in
-            y, layer_cache = self._block_rows(
-                bp, carry, layer_cache, pos, active, codec)
-            return y, layer_cache
+        if self._wins is None:
+            def layer(carry, layer_in):
+                bp, layer_cache = layer_in
+                y, layer_cache = self._block_rows(
+                    bp, carry, layer_cache, pos, active, codec)
+                return y, layer_cache
 
-        x, new_cache = lax.scan(layer, x, (prepared["blocks"], cache))
+            x, new_cache = lax.scan(layer, x, (prepared["blocks"], cache))
+        else:
+            def layer_w(carry, layer_in):
+                bp, layer_cache, w = layer_in
+                y, layer_cache = self._block_rows(
+                    bp, carry, layer_cache, pos, active, codec, window=w)
+                return y, layer_cache
+
+            x, new_cache = lax.scan(
+                layer_w, x, (prepared["blocks"], cache, self._wins))
         logits = head(prepared, x.astype(jnp.float32), cfg=self.cfg,
                       compute_dtype=self.compute_dtype)
         return logits[:, -1], new_cache
@@ -782,6 +1016,11 @@ class LlamaPipelineFamily:
     KV-head width, RoPE at the ring's absolute positions."""
 
     def __init__(self, cfg: LlamaConfig, *, compute_dtype=None, kv_dtype=None):
+        if cfg.alt_window:
+            raise ValueError(
+                "alternating-window configs (Gemma-2) are not supported on "
+                "the pipeline decode path: the stage scan has no per-layer "
+                "window channel (use the solo decoder or the batcher)")
         self.cfg = cfg
         self.compute_dtype = compute_dtype
         self.kv_dtype = kv_dtype  # None follows compute_dtype; "int8" quantizes
@@ -799,10 +1038,11 @@ class LlamaPipelineFamily:
             bp, x, layer_cache, start_pos, cfg=self.cfg,
             compute_dtype=self.compute_dtype,
             codec=codec_for_cache(layer_cache,
-                                  window=self.cfg.sliding_window))
+                                  window=self.cfg.sliding_window,
+                                  softcap=self.cfg.attn_softcap))
 
     def embed(self, aux, ids, start_pos):
-        x = embedding(aux["wte"], ids)
+        x = _scaled_embed(aux, ids, self.cfg)
         if self.compute_dtype is not None:
             x = x.astype(self.compute_dtype)
         return x
@@ -842,13 +1082,22 @@ def make_partition(cfg: LlamaConfig, *, compute_dtype=None):
     def partition(num_parts):
         ranges = gpt.layer_ranges(cfg.n_layer, num_parts)
         stages = []
+        wins = layer_windows(cfg)
         for p, (lo, hi) in enumerate(ranges):
             is_first, is_last = p == 0, p == num_parts - 1
             param_keys = tuple(f"h_{i}" for i in range(lo, hi))
             if is_first:
                 param_keys = ("wte",) + param_keys
             if is_last:
-                param_keys = param_keys + ("ln_f", "lm_head")
+                param_keys = param_keys + ("ln_f",)
+                if cfg.tie_word_embeddings:
+                    # tied head projects through the embedding table — the
+                    # LAST stage needs wte too (both stages then hold a
+                    # copy, the standard tied-embeddings PP trade)
+                    if not is_first:
+                        param_keys = param_keys + ("wte",)
+                else:
+                    param_keys = param_keys + ("lm_head",)
 
             def stage_fn(params, x, _lo=lo, _hi=hi, _first=is_first, _last=is_last):
                 if _first:
@@ -858,7 +1107,9 @@ def make_partition(cfg: LlamaConfig, *, compute_dtype=None):
                 if _hi > _lo:
                     stacked = gpt.stack_blocks(params, range(_lo, _hi))
                     x = blocks_scan(stacked, x, cfg=cfg,
-                                     compute_dtype=compute_dtype)
+                                     compute_dtype=compute_dtype,
+                                     windows=None if wins is None
+                                     else wins[_lo:_hi])
                 if _last:
                     x = head(params, x.astype(jnp.float32), cfg=cfg,
                              compute_dtype=compute_dtype)
@@ -892,8 +1143,23 @@ def to_hf_config(cfg: LlamaConfig, *, tie_word_embeddings: bool = False,
         num_attention_heads=cfg.n_head, num_key_value_heads=cfg.n_kv_head,
         max_position_embeddings=cfg.block_size, rope_theta=cfg.rope_theta,
         rms_norm_eps=cfg.rms_eps,
-        tie_word_embeddings=tie_word_embeddings,
+        tie_word_embeddings=tie_word_embeddings or cfg.tie_word_embeddings,
     )
+    if cfg.norm_plus_one:
+        # Gemma family: (1+w) norms, GeGLU, scaled+tied embeddings
+        kw.update(head_dim=cfg.head_dim,
+                  hidden_activation="gelu_pytorch_tanh")
+        if cfg.post_norms:  # Gemma-2
+            kw.update(
+                query_pre_attn_scalar=cfg.query_scale or cfg.head_dim,
+                attn_logit_softcapping=cfg.attn_softcap,
+                final_logit_softcapping=cfg.final_softcap,
+                sliding_window=cfg.sliding_window,
+            )
+            kw.update(overrides)
+            return transformers.Gemma2Config(**kw)
+        kw.update(overrides)
+        return transformers.GemmaConfig(**kw)
     if cfg.rope_scaling == "linear" and cfg.rope_scale != 1.0:
         kw["rope_scaling"] = {"rope_type": "linear",
                               "factor": cfg.rope_scale}
@@ -925,7 +1191,9 @@ def _register(name: str, cfg: LlamaConfig):
     def convert(sd, _cfg=cfg):
         from dnn_tpu.io.checkpoint import llama_params_from_state_dict
 
-        return llama_params_from_state_dict(sd, n_layer=_cfg.n_layer)
+        return llama_params_from_state_dict(
+            sd, n_layer=_cfg.n_layer, post_norms=_cfg.post_norms,
+            tied_head="omit" if _cfg.tie_word_embeddings else "materialize")
 
     register_model(ModelSpec(
         name=name,

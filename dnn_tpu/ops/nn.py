@@ -238,9 +238,16 @@ def silu(x):
     return jax.nn.silu(x)
 
 
-def rms_norm(params, x, *, eps=1e-6):
+def rms_norm(params, x, *, eps=1e-6, plus_one=False):
     """RMSNorm over the last dim (LLaMA-family normalization: no mean
-    subtraction, no bias — torch LlamaRMSNorm semantics, f32 statistics)."""
+    subtraction, no bias — torch LlamaRMSNorm semantics, f32 statistics).
+
+    `plus_one=True` scales by (1 + w) instead of w — the Gemma-family
+    convention (torch GemmaRMSNorm), whose checkpoints store the scale
+    as a zero-centered delta."""
     x32 = x.astype(jnp.float32)
     y = x32 * lax.rsqrt(jnp.mean(x32 * x32, axis=-1, keepdims=True) + eps)
-    return (y * params["scale"].astype(jnp.float32)).astype(x.dtype)
+    scale = params["scale"].astype(jnp.float32)
+    if plus_one:
+        scale = 1.0 + scale
+    return (y * scale).astype(x.dtype)

@@ -1,0 +1,275 @@
+"""Gemma family (1 and 2): the LLaMA block with (1+w) RMSNorm, GeGLU,
+tied + sqrt(C)-scaled embeddings, decoupled head_dim — and, for Gemma-2,
+post-branch norms, attention/final logit softcapping, query_pre_attn
+scaling, and ALTERNATING local/global attention layers.
+
+Every switch is a LlamaConfig field, so the whole serving/decode surface
+(solo generate, batcher rows, partitions) inherits Gemma with no new
+runtime code; these tests pin that against HF GemmaForCausalLM /
+Gemma2ForCausalLM and the framework's own cross-path parity contracts.
+The reference has no Gemma (its only LM is the GPT-2 wrapper family,
+/root/reference/partitions/gpt_model_parts.py) — this widens the zoo
+beyond it.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dnn_tpu.models import gpt, llama
+
+G1 = llama.PRESETS["gemma-test"]    # MQA, head_dim 32 != 64/4
+G2 = llama.PRESETS["gemma2-test"]   # + post-norms, softcaps, alt window
+
+
+def _params(cfg, seed=0):
+    return llama.init(jax.random.PRNGKey(seed), cfg)
+
+
+# ----------------------------------------------------------------------
+# structure
+# ----------------------------------------------------------------------
+
+def test_init_structure():
+    p1 = _params(G1)
+    assert "lm_head" not in p1, "tied configs carry no lm_head leaf"
+    assert "post_ln_1" not in p1["h_0"]
+    p2 = _params(G2)
+    assert "lm_head" not in p2
+    assert set(p2["h_0"]) >= {"ln_1", "post_ln_1", "ln_2", "post_ln_2"}
+    # head_dim decoupled from n_embd/n_head
+    assert p1["h_0"]["attn"]["q"]["kernel"].shape == (
+        G1.n_embd, G1.n_head * 32)
+    assert p1["h_0"]["attn"]["k"]["kernel"].shape == (
+        G1.n_embd, G1.n_kv_head * 32)
+
+
+def test_every_switch_acts():
+    """Each Gemma switch must change the logits of an otherwise-identical
+    config (a silently-ignored flag would still pass structural tests)."""
+    import dataclasses
+
+    ids = np.random.RandomState(0).randint(0, G2.vocab_size, (1, 24))
+    p = _params(G2, seed=3)
+
+    def logits(cfg):
+        return np.asarray(llama.make_apply(cfg)(p, jnp.asarray(ids)))
+
+    base = logits(G2)
+    # softcaps compare against a TIGHT cap (at 50/30 on random-init-scale
+    # scores, cap*tanh(s/cap) is numerically ~identity — the off-vs-on
+    # delta would drown in noise, a tight cap visibly saturates)
+    for field, value in [("embed_scale", False), ("norm_plus_one", False),
+                         ("query_scale", None), ("attn_softcap", 0.5),
+                         ("final_softcap", 0.1), ("mlp_act", "silu"),
+                         ("alt_window", False)]:
+        changed = dataclasses.replace(G2, **{field: value})
+        assert np.abs(logits(changed) - base).max() > 1e-6, field
+
+
+# ----------------------------------------------------------------------
+# HF parity
+# ----------------------------------------------------------------------
+
+def _hf_parity(cfg, hf_cls_name, prompt_len=10, n_new=10):
+    torch = pytest.importorskip("torch")
+    transformers = pytest.importorskip("transformers")
+
+    hf_cfg = llama.to_hf_config(cfg, attn_implementation="eager")
+    assert type(hf_cfg).__name__ == hf_cls_name.replace("ForCausalLM",
+                                                        "Config")
+    torch.manual_seed(0)
+    model = getattr(transformers, hf_cls_name)(hf_cfg).eval()
+    assert hf_cfg.tie_word_embeddings, "premise: Gemma ties embeddings"
+    sd = {k: v.numpy() for k, v in model.state_dict().items()}
+
+    from dnn_tpu.io.checkpoint import llama_params_from_state_dict
+
+    params = llama_params_from_state_dict(
+        sd, post_norms=cfg.post_norms, tied_head="omit")
+    assert "lm_head" not in params
+
+    # full-sequence logits — long enough that Gemma-2's window (16) bands
+    # the even layers while odd layers attend globally
+    t = 3 * (cfg.sliding_window or 8) // 2
+    ids = np.random.RandomState(1).randint(0, cfg.vocab_size, (2, t))
+    with torch.no_grad():
+        want = model(torch.from_numpy(ids)).logits.numpy()
+    got = np.asarray(llama.make_apply(cfg)(params, jnp.asarray(ids)))
+    np.testing.assert_allclose(got, want, atol=3e-3, rtol=3e-3)
+    np.testing.assert_array_equal(got.argmax(-1), want.argmax(-1))
+
+    # greedy cached-decode trajectory matches transformers.generate
+    prompt = np.random.RandomState(2).randint(0, cfg.vocab_size,
+                                              (1, prompt_len))
+    with torch.no_grad():
+        hf_out = model.generate(torch.from_numpy(prompt),
+                                max_new_tokens=n_new, do_sample=False,
+                                pad_token_id=0)
+    want_toks = hf_out.numpy()[0, prompt_len:]
+    prepared = gpt.prepare_stacked(params, cfg)
+    got_toks = np.asarray(llama.make_generate(cfg, max_new_tokens=n_new)(
+        prepared, jnp.asarray(prompt), jax.random.PRNGKey(0)))[0]
+    np.testing.assert_array_equal(got_toks, want_toks)
+
+
+def test_hf_gemma1_parity():
+    _hf_parity(G1, "GemmaForCausalLM")
+
+
+def test_hf_gemma2_parity():
+    """Pins the full Gemma-2 recipe — post-norms, both softcaps,
+    query_pre_attn_scalar, tied scaled embeddings AND the alternating
+    window pattern (even layers local, odd global) — against HF eager
+    attention, including a prompt long enough to band the window."""
+    _hf_parity(G2, "Gemma2ForCausalLM", prompt_len=24, n_new=10)
+
+
+# ----------------------------------------------------------------------
+# cross-path parity inside the framework
+# ----------------------------------------------------------------------
+
+def test_partition_parity_gemma1():
+    p = _params(G1, seed=5)
+    ids = np.random.RandomState(3).randint(0, G1.vocab_size, (2, 16))
+    want = np.asarray(llama.make_apply(G1)(p, jnp.asarray(ids)))
+    for parts in (2, 3):
+        stages = llama.make_partition(G1)(parts)
+        # last stage of a tied config must carry wte for the head
+        assert "wte" in stages[-1].param_keys
+        x = jnp.asarray(ids)
+        for st in stages:
+            x = st.apply(st.slice_params(p), x)
+        np.testing.assert_allclose(np.asarray(x), want, atol=1e-5,
+                                   rtol=1e-5)
+
+
+def test_partition_parity_gemma2_alt_window():
+    """Stage boundaries must slice the per-layer window array with the
+    layer range — a stage starting at an odd layer still alternates
+    correctly."""
+    p = _params(G2, seed=6)
+    ids = np.random.RandomState(4).randint(0, G2.vocab_size, (1, 24))
+    want = np.asarray(llama.make_apply(G2)(p, jnp.asarray(ids)))
+    stages = llama.make_partition(G2)(3)  # ranges split at odd offsets
+    x = jnp.asarray(ids)
+    for st in stages:
+        x = st.apply(st.slice_params(p), x)
+    np.testing.assert_allclose(np.asarray(x), want, atol=1e-5, rtol=1e-5)
+
+
+def test_generate_matches_stepwise_dense_forward():
+    """Greedy cached decode == argmax-stepping the stateless forward —
+    the cache path's per-layer window masking must agree with the dense
+    band mask on BOTH layer parities."""
+    cfg = G2
+    p = _params(cfg, seed=7)
+    prepared = gpt.prepare_stacked(p, cfg)
+    apply = llama.make_apply(cfg)
+    prompt = np.random.RandomState(5).randint(0, cfg.vocab_size, (1, 20))
+    n_new = 12  # crosses the window boundary (20 + 12 > 16)
+    ids = list(prompt[0])
+    for _ in range(n_new):
+        logits = np.asarray(apply(p, jnp.asarray([ids])))
+        ids.append(int(logits[0, -1].argmax()))
+    want = np.asarray(ids[len(prompt[0]):])
+    got = np.asarray(llama.make_generate(cfg, max_new_tokens=n_new)(
+        prepared, jnp.asarray(prompt), jax.random.PRNGKey(0)))[0]
+    np.testing.assert_array_equal(got, want)
+
+
+def test_int8_cache_decode_gemma2():
+    """The quantized cache composes with softcap + per-layer windows (the
+    codec applies scales, then caps, then bands)."""
+    cfg = G2
+    p = _params(cfg, seed=8)
+    prepared = gpt.prepare_stacked(p, cfg)
+    prompt = np.random.RandomState(6).randint(0, cfg.vocab_size, (1, 12))
+    f32 = np.asarray(llama.make_generate(cfg, max_new_tokens=8)(
+        prepared, jnp.asarray(prompt), jax.random.PRNGKey(0)))[0]
+    q = np.asarray(llama.make_generate(cfg, max_new_tokens=8,
+                                       kv_dtype="int8")(
+        prepared, jnp.asarray(prompt), jax.random.PRNGKey(0)))[0]
+    # int8 rounding may perturb late tokens; the head of the trajectory
+    # must agree (same contract the LLaMA int8 tests pin)
+    assert (f32[:4] == q[:4]).all()
+
+
+def test_batcher_matches_solo_generate():
+    """ContinuousBatcher greedy decode == solo make_generate for Gemma-2:
+    per-slot positions, softcapped codec, per-layer windows."""
+    from dnn_tpu.runtime.serving import ContinuousBatcher
+
+    cfg = G2
+    p = _params(cfg, seed=9)
+    prepared = gpt.prepare_stacked(p, cfg)
+    rs = np.random.RandomState(7)
+    prompts = [rs.randint(0, cfg.vocab_size, (n,)) for n in (9, 14, 20)]
+    n_new = 10
+
+    solo = llama.make_generate(cfg, max_new_tokens=n_new)
+    want = {}
+    for i, pr in enumerate(prompts):
+        want[i] = np.asarray(solo(prepared, jnp.asarray([pr]),
+                                  jax.random.PRNGKey(0)))[0]
+
+    b = ContinuousBatcher(cfg, prepared, slots=3, max_len=cfg.block_size,
+                          prompt_pad=8, family=llama.LlamaFamilyRows(cfg))
+    rids = [b.submit(np.asarray(pr), max_new_tokens=n_new)
+            for pr in prompts]
+    b.drain()
+    for i, rid in enumerate(rids):
+        np.testing.assert_array_equal(np.asarray(b.results[rid]), want[i])
+
+
+def test_paged_pool_rejects_gemma2():
+    from dnn_tpu.runtime.serving import ContinuousBatcher
+
+    p = _params(G2, seed=1)
+    prepared = gpt.prepare_stacked(p, G2)
+    with pytest.raises(ValueError, match="paged"):
+        ContinuousBatcher(G2, prepared, slots=2, max_len=64,
+                          family=llama.LlamaFamilyRows(G2),
+                          paged_blocks=8, block_len=8)
+
+
+def test_pipeline_decode_rejects_alt_window():
+    with pytest.raises(ValueError, match="alternating"):
+        llama.LlamaPipelineFamily(G2)
+
+
+def test_seq_paths_reject_softcap():
+    import dataclasses
+
+    from dnn_tpu.parallel.mesh import SEQ_AXIS, make_mesh
+
+    mesh = make_mesh({SEQ_AXIS: jax.device_count()})
+    # windowless-but-softcapped variant hits the softcap check directly
+    capped = dataclasses.replace(G2, sliding_window=None, alt_window=False)
+    with pytest.raises(ValueError, match="softcap"):
+        llama.make_apply_seq_parallel(capped, mesh)
+    with pytest.raises(ValueError, match="softcap"):
+        llama.make_generate_seq_sharded(capped, mesh, max_new_tokens=4)
+
+
+def test_gemma1_pipeline_generate_parity():
+    """Gemma-1 (uniform attention) rides the pipeline decode unchanged:
+    token parity with the solo decoder over a 2-stage mesh."""
+    from dnn_tpu.parallel.mesh import STAGE_AXIS, make_mesh
+
+    cfg = G1
+    p = _params(cfg, seed=11)
+    prepared = gpt.prepare_stacked(p, cfg)
+    prompt = np.random.RandomState(8).randint(0, cfg.vocab_size, (1, 8))
+    n_new = 8
+    want = np.asarray(llama.make_generate(cfg, max_new_tokens=n_new)(
+        prepared, jnp.asarray(prompt), jax.random.PRNGKey(0)))
+    mesh = make_mesh({STAGE_AXIS: 2}, jax.devices()[:2])
+    from dnn_tpu.runtime.generate import prepare_pipeline_stacked
+
+    stage_blocks, aux = prepare_pipeline_stacked(prepared, cfg, mesh)
+    gen = llama.make_pipeline_generate(cfg, mesh, max_new_tokens=n_new)
+    got = np.asarray(gen(stage_blocks, aux, jnp.asarray(prompt),
+                         jax.random.PRNGKey(0)))
+    np.testing.assert_array_equal(got, want)
