@@ -427,26 +427,29 @@ def run_device_section():
                                 kv_dtype=jnp.bfloat16,
                                 compute_dtype=jnp.bfloat16)
 
-        def _serve_round():
-            rng_np = jax.random.PRNGKey(9)
+        def _serve_round(srv_x, n_requests, plen_fn, constraint=None,
+                         key=9):
+            """Admit-when-a-slot-frees over the pool, then drain — the
+            continuous-batching arrival pattern, shared by the e2e and
+            constrained-tax rows."""
+            rng_np = jax.random.PRNGKey(key)
             rids = []
-            for i in range(24):
-                plen = 16 + (i * 7) % 112  # mixed 16..121
+            for i in range(n_requests):
                 p = jax.random.randint(jax.random.fold_in(rng_np, i),
-                                       (plen,), 0, cfg.vocab_size,
+                                       (plen_fn(i),), 0, cfg.vocab_size,
                                        dtype=jnp.int32)
-                # 24 requests over 8 slots: decode until a slot retires,
-                # then admit — the continuous-batching arrival pattern
-                while srv.free_slots() == 0:
-                    srv.step()
-                rids.append(srv.submit(
-                    jnp.asarray(p), max_new_tokens=sb_new))
-            out = srv.drain()
+                while srv_x.free_slots() == 0:
+                    srv_x.step()
+                rids.append(srv_x.submit(
+                    jnp.asarray(p), max_new_tokens=sb_new,
+                    constraint=constraint))
+            out = srv_x.drain()
             return sum(len(out[r]) for r in rids)
 
-        _serve_round()  # compile the three programs
+        mixed_plen = lambda i: 16 + (i * 7) % 112  # noqa: E731 — 16..121
+        _serve_round(srv, 24, mixed_plen)  # compile the three programs
         t0 = _time.perf_counter()
-        total = _serve_round()
+        total = _serve_round(srv, 24, mixed_plen)
         dt = _time.perf_counter() - t0
         _emit(results, config="gpt2_serving_e2e", metric="tokens_per_sec",
               value=round(total / dt, 1), platform=platform, slots=8,
@@ -454,6 +457,42 @@ def run_device_section():
               note="wall-clock drain of 24 mixed-length requests through "
                    "the continuous batcher (chunked prefill + decode + "
                    "host scheduler)")
+
+        # Constrained-decoding tax: every slot carries a grammar, so each
+        # step pays the host-side DFA advance + one batched (slots, V)
+        # bias update. The [0-9]+ grammar (2 DFA states) isolates the
+        # PER-STEP mechanism cost — table compile is a one-time artifact
+        # outside the timed window.
+        from dnn_tpu.runtime.constrain import TokenConstraint, byte_vocab
+
+        cons = TokenConstraint.from_regex(r"[0-9]+",
+                                          byte_vocab(cfg.vocab_size))
+
+        tps_c = {}
+        for name, con in (("off", None), ("on", cons)):
+            # one batcher per leg, REUSED for warmup + timed round (fresh
+            # instances would recompile inside the timed window — same
+            # lesson as the serving_e2e row). Both legs run with the bias
+            # buffer enabled, so the delta isolates the per-step host DFA
+            # walk + batched bias update, not the device-side bias add.
+            srv_c = ContinuousBatcher(
+                cfg, bf16_prepared, slots=8, max_len=256, prompt_pad=128,
+                kv_dtype=jnp.bfloat16, compute_dtype=jnp.bfloat16,
+                allow_constraints=True, temperature=1.0)
+            _serve_round(srv_c, 16, lambda i: 32, constraint=con,
+                         key=11)  # compile/warm
+            t0 = _time.perf_counter()
+            total = _serve_round(srv_c, 16, lambda i: 32, constraint=con,
+                                 key=11)
+            tps_c[name] = total / (_time.perf_counter() - t0)
+        c_overhead = tps_c["off"] / tps_c["on"] - 1.0
+        _emit(results, config="gpt2_serving_constrained_tax",
+              metric="overhead_pct", value=round(c_overhead * 100, 2),
+              platform=platform, slots=8,
+              tps_unconstrained=round(tps_c["off"], 1),
+              tps_constrained=round(tps_c["on"], 1),
+              note="all 8 slots grammar-constrained ([0-9]+): per-step "
+                   "DFA advance + one batched bias-row device update")
     return results
 
 

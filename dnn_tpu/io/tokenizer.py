@@ -22,9 +22,9 @@ Two implementations behind one two-method protocol
 
 from __future__ import annotations
 
-from typing import List, Sequence
+from typing import List, Optional, Sequence
 
-__all__ = ["ByteTokenizer", "load_hf_tokenizer"]
+__all__ = ["ByteTokenizer", "hf_vocab_bytes", "load_hf_tokenizer"]
 
 
 class ByteTokenizer:
@@ -57,20 +57,88 @@ class ByteTokenizer:
                 # fabricated 0x00/0xFF byte
         return bytes(raw).decode("utf-8", errors="replace")
 
-    def vocab_bytes(self) -> List[bytes]:
+    def vocab_bytes(self, vocab_size: Optional[int] = None) -> List[bytes]:
         """Token id -> the bytes that token emits — the vocab map
         constrained decoding compiles its token table over
         (runtime/constrain.TokenConstraint). Ids outside the byte range
-        map to b"", which the constraint engine bans outright."""
+        map to b"", which the constraint engine bans outright. Pass the
+        MODEL's `vocab_size` when it differs (padded embedding table)."""
+        size = vocab_size or self.vocab_size
         return [bytes([i - self.offset])
                 if self.offset <= i < self.offset + 256 else b""
-                for i in range(self.vocab_size)]
+                for i in range(size)]
+
+
+def _byte_level_alphabet():
+    """The GPT-2 byte-level BPE printable-alias table: byte value ->
+    the unicode char that stands for it inside vocab token STRINGS
+    (the public bytes_to_unicode construction — printable bytes map to
+    themselves, the rest to 256+n aliases)."""
+    bs = (list(range(ord("!"), ord("~") + 1))
+          + list(range(0xA1, 0xAC + 1)) + list(range(0xAE, 0xFF + 1)))
+    cs = bs[:]
+    n = 0
+    for b in range(256):
+        if b not in bs:
+            bs.append(b)
+            cs.append(256 + n)
+            n += 1
+    return {chr(c): b for b, c in zip(bs, cs)}
+
+
+def hf_vocab_bytes(tok, vocab_size: Optional[int] = None) -> List[bytes]:
+    """Best-effort token-id -> EMITTED-BYTES map for a HuggingFace
+    tokenizer — the vocab map constrained decoding needs
+    (runtime/constrain.TokenConstraint) for real BPE/SentencePiece
+    models, where one token is several bytes.
+
+    Handles the two dominant conventions, DETECTED ONCE PER VOCAB (a
+    per-token guess would mis-decode SentencePiece pieces that happen to
+    consist of alias-alphabet chars — 'é' must become its UTF-8 bytes,
+    not the Latin-1 byte the alias table maps it to):
+      * SentencePiece (LLaMA family) — any '▁'-marked or '<0xNN>' piece
+        in the vocab: '▁' prefixes a space, '<0xNN>' pieces are raw
+        bytes, everything else is UTF-8 text;
+      * otherwise byte-level BPE (GPT-2/RoBERTa family): vocab strings
+        use the bytes_to_unicode alias alphabet, inverted char-by-char.
+    Special tokens and anything unmappable map to b"" (banned by the
+    constraint engine — a grammar can never need them; EOS is handled
+    separately by mask_row). Pass the MODEL's `vocab_size` when its
+    embedding table is padded past the tokenizer vocab — the padding ids
+    map to b""."""
+    vocab = tok.get_vocab()  # {token_string: id}
+    size = vocab_size or max(vocab.values()) + 1
+    out = [b""] * size
+    specials = set(getattr(tok, "all_special_tokens", []) or [])
+
+    def _is_byte_piece(s):
+        return s.startswith("<0x") and s.endswith(">") and len(s) == 6
+
+    sentencepiece = any("▁" in s or _is_byte_piece(s) for s in vocab)
+    alias = None if sentencepiece else _byte_level_alphabet()
+    for s, tid in vocab.items():
+        if tid >= size or s in specials:
+            continue
+        if sentencepiece:
+            if _is_byte_piece(s):
+                try:
+                    out[tid] = bytes([int(s[3:5], 16)])
+                except ValueError:
+                    pass
+                continue
+            out[tid] = s.replace("▁", " ").encode("utf-8")
+        elif all(ch in alias for ch in s):
+            out[tid] = bytes(alias[ch] for ch in s)
+        # non-alias strings in a byte-level vocab (added specials) stay b""
+    return out
 
 
 def load_hf_tokenizer(path: str):
     """Adapter over a local HF tokenizer directory: returns an object with
     the same encode/decode protocol (no special tokens added on encode;
-    specials skipped on decode — the daemon serves raw continuations)."""
+    specials skipped on decode — the daemon serves raw continuations),
+    plus `vocab_bytes()` so constrained decoding / the daemon's JSON mode
+    work over the real vocab."""
     from transformers import AutoTokenizer
 
     tok = AutoTokenizer.from_pretrained(path, local_files_only=True)
@@ -85,5 +153,9 @@ def load_hf_tokenizer(path: str):
         @staticmethod
         def decode(ids: Sequence[int]) -> str:
             return tok.decode(list(ids), skip_special_tokens=True)
+
+        @staticmethod
+        def vocab_bytes(vocab_size: Optional[int] = None) -> List[bytes]:
+            return hf_vocab_bytes(tok, vocab_size)
 
     return _HF()

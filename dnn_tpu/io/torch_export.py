@@ -185,3 +185,59 @@ def gpt_state_dict_from_params(params, *, layout: str = "conv1d") -> Dict[str, n
     # lm_head is stored (out, in) by both HF and nanoGPT (nn.Linear)
     sd["lm_head.weight"] = _np(params["lm_head"]["kernel"]).T
     return sd
+
+
+def llama_state_dict_from_params(params) -> Dict[str, np.ndarray]:
+    """Framework LLaMA-family params -> an HF `LlamaForCausalLM`-style
+    state dict ("model."-prefixed), loadable by every family that shares
+    the layout (LLaMA/TinyLlama/Mistral/Qwen2/Gemma/Gemma-2). Inverse of
+    checkpoint.llama_params_from_state_dict:
+
+      * projections transpose back to torch's (out, in); any q/k/v
+        'bias' leaves (Qwen2) ride along;
+      * a 'post_ln_1' leaf in the blocks (Gemma-2) switches the norm
+        naming — post_attention_layernorm becomes the POST-attention
+        norm and the pre-MLP norm exports as pre_feedforward_layernorm —
+        detected from the pytree itself, no flag;
+      * tied pytrees (no 'lm_head' leaf — Gemma, LLaMA-3.2 class) export
+        NO lm_head.weight: HF reties from the embedding when the config
+        says tie_word_embeddings.
+
+    The full fine-tune-and-hand-back loop: convert an HF checkpoint in,
+    train with this framework, export here, `torch.load` on the other
+    side."""
+
+    def _lin(p, leaf):
+        sd[p + ".weight"] = _np(leaf["kernel"]).T
+        if "bias" in leaf:  # Qwen2-class q/k/v biases
+            sd[p + ".bias"] = _np(leaf["bias"])
+
+    sd: Dict[str, np.ndarray] = {
+        "model.embed_tokens.weight": _np(params["wte"]["embedding"]),
+        "model.norm.weight": _np(params["ln_f"]["scale"]),
+    }
+    n_layer = sum(1 for k in params if k.startswith("h_"))
+    for i in range(n_layer):
+        bp = params[f"h_{i}"]
+        p = f"model.layers.{i}."
+        sd[p + "input_layernorm.weight"] = _np(bp["ln_1"]["scale"])
+        _lin(p + "self_attn.q_proj", bp["attn"]["q"])
+        _lin(p + "self_attn.k_proj", bp["attn"]["k"])
+        _lin(p + "self_attn.v_proj", bp["attn"]["v"])
+        _lin(p + "self_attn.o_proj", bp["attn"]["o"])
+        _lin(p + "mlp.gate_proj", bp["mlp"]["gate"])
+        _lin(p + "mlp.up_proj", bp["mlp"]["up"])
+        _lin(p + "mlp.down_proj", bp["mlp"]["down"])
+        if "post_ln_1" in bp:  # Gemma-2 block: 4 norms, shifted names
+            sd[p + "post_attention_layernorm.weight"] = \
+                _np(bp["post_ln_1"]["scale"])
+            sd[p + "pre_feedforward_layernorm.weight"] = \
+                _np(bp["ln_2"]["scale"])
+            sd[p + "post_feedforward_layernorm.weight"] = \
+                _np(bp["post_ln_2"]["scale"])
+        else:
+            sd[p + "post_attention_layernorm.weight"] = \
+                _np(bp["ln_2"]["scale"])
+    if "lm_head" in params:
+        sd["lm_head.weight"] = _np(params["lm_head"]["kernel"]).T
+    return sd

@@ -536,6 +536,30 @@ def json_regex(max_depth: int = 2) -> str:
     return value
 
 
+_META = set("\\.[](){}*+?|")
+
+
+def regex_escape(text: str) -> str:
+    """Escape `text` so it matches literally under this module's regex
+    subset (the analog of re.escape for compile_regex)."""
+    return "".join("\\" + ch if ch in _META else ch for ch in text)
+
+
+def choice_regex(options: Sequence[str]) -> str:
+    """A regex matching exactly one of `options` verbatim — the
+    enum/classifier constraint ("answer with one of these labels"):
+
+        c = TokenConstraint.from_regex(
+            choice_regex(["positive", "negative", "neutral"]), vocab)
+
+    Greedy decode then picks the highest-likelihood label prefix-by
+    -prefix; sampling stays proportional within the allowed set."""
+    opts = [o for o in options]
+    if not opts:
+        raise ValueError("choice_regex needs at least one option")
+    return "(" + "|".join(regex_escape(o) for o in opts) + ")"
+
+
 def byte_vocab(vocab_size: int) -> List[bytes]:
     """The trivial byte-level vocab (token i == byte i for i < 256,
     empty for the rest) — what the tests and byte-tokenizer models use."""

@@ -391,7 +391,11 @@ class LMServer:
         if c is None:
             from dnn_tpu.runtime.constrain import TokenConstraint, json_regex
 
-            c = TokenConstraint.from_regex(json_regex(depth), vb())
+            # compile over the MODEL's vocab size: padded embedding
+            # tables (model vocab > tokenizer vocab) must still match
+            # the batcher's vocab check, with padding ids banned
+            c = TokenConstraint.from_regex(
+                json_regex(depth), vb(self.batcher.cfg.vocab_size))
             self._constraint_cache[depth] = c
         return c
 

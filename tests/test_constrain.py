@@ -236,6 +236,33 @@ def test_constraint_composes_with_user_logit_bias():
     assert bytes(int(t) for t in srv.results[rid]) == b"bbb"
 
 
+def test_choice_constraint_picks_exactly_one_label():
+    """The enum/classifier pattern: output is VERBATIM one of the
+    options, across several sampled requests."""
+    from dnn_tpu.runtime.constrain import choice_regex, regex_escape
+
+    options = ["positive", "negative", "neutral(ish)"]  # metachars too
+    pattern = choice_regex(options)
+    dfa = compile_regex(pattern)
+    for o in options:
+        assert match(dfa, o.encode())
+    assert not match(dfa, b"positiv")
+    assert not match(dfa, b"neutralXishX"), "metachars match literally"
+    assert pyre.fullmatch(pyre.escape("a.b{c"),
+                          "a.b{c") and match(
+        compile_regex(regex_escape("a.b{c")), b"a.b{c")
+
+    srv = _batcher(temperature=1.0, slots=3)
+    c = TokenConstraint.from_regex(pattern, byte_vocab(CFG.vocab_size))
+    rids = [srv.submit(np.asarray([11, 12]), max_new_tokens=32, seed=s,
+                       constraint=c) for s in (1, 2, 3)]
+    srv.drain()
+    for rid in rids:
+        text = bytes(int(t) for t in srv.results[rid]).decode()
+        assert text in options, text
+        assert srv.finish_reasons[rid] == "constraint"
+
+
 def test_lm_server_json_mode_wiring():
     """The daemon's ':j=DEPTH' gen option: parse -> compile-once
     constraint over the tokenizer's byte vocab -> constrained submit
@@ -270,6 +297,78 @@ def test_lm_server_json_mode_wiring():
         assert srv2.json_constraint(1) is None
     finally:
         srv2.close()
+
+
+def test_hf_vocab_bytes_sentencepiece_convention():
+    """Convention is detected ONCE per vocab: a SentencePiece piece made
+    of alias-alphabet chars ('é') must yield its UTF-8 bytes, not the
+    Latin-1 byte the BPE alias table would give; '<0xNN>' pieces are raw
+    bytes; padding ids beyond the tokenizer map to b""."""
+    from dnn_tpu.io.tokenizer import hf_vocab_bytes
+
+    class FakeSP:
+        all_special_tokens = ["<s>"]
+
+        @staticmethod
+        def get_vocab():
+            return {"<s>": 0, "▁caf": 1, "é": 2, "<0x0A>": 3, "hello": 4}
+
+    vb = hf_vocab_bytes(FakeSP())
+    assert vb[0] == b""                       # special: banned
+    assert vb[1] == " caf".encode()
+    assert vb[2] == "é".encode("utf-8")       # b'\xc3\xa9', NOT b'\xe9'
+    assert vb[3] == b"\n"
+    assert vb[4] == b"hello"
+    vb2 = hf_vocab_bytes(FakeSP(), vocab_size=10)
+    assert len(vb2) == 10 and vb2[9] == b""   # padded embedding table
+
+
+def test_hf_vocab_bytes_real_bpe_constrained_decode():
+    """Constrained decoding over a REAL byte-level BPE vocabulary
+    (multi-byte tokens), not just the byte tokenizer: hf_vocab_bytes
+    inverts the GPT-2 alias alphabet, and a grammar holds token streams
+    whose tokens span several grammar bytes at once."""
+    import dataclasses
+
+    tokenizers = pytest.importorskip("tokenizers")
+    transformers = pytest.importorskip("transformers")
+
+    from dnn_tpu.io.tokenizer import hf_vocab_bytes
+
+    bpe = tokenizers.implementations.ByteLevelBPETokenizer()
+    corpus = (['{"name": "value", "count": 123, "flag": true}'] * 40
+              + ["hello world, plain text with spaces"] * 40)
+    bpe.train_from_iterator(corpus, vocab_size=300, min_frequency=1)
+    fast = transformers.PreTrainedTokenizerFast(
+        tokenizer_object=bpe._tokenizer)
+    vb = hf_vocab_bytes(fast)
+
+    # THE invariant constraints rely on: concatenating a real encoding's
+    # token bytes reproduces the text's utf-8 bytes exactly
+    for text in ['{"count": 42}', "hello world", '{"flag": true}']:
+        ids = fast.encode(text)
+        assert b"".join(vb[i] for i in ids) == text.encode(), text
+
+    V = len(vb)
+    cfg = dataclasses.replace(CFG, vocab_size=V)
+    from dnn_tpu.runtime.serving import ContinuousBatcher
+
+    params = llama.init(jax.random.PRNGKey(3), cfg)
+    prepared = gpt.prepare_stacked(params, cfg)
+    srv = ContinuousBatcher(cfg, prepared, slots=2, max_len=cfg.block_size,
+                            prompt_pad=8, family=llama.LlamaFamilyRows(cfg),
+                            allow_constraints=True, temperature=1.0)
+    c = TokenConstraint.from_regex(r"\{\"count\": [0-9]{1,3}\}", vb)
+    # multi-byte tokens must be usable: the grammar's fixed prefix
+    # ('{"count": ') is in-corpus, so merged tokens cover it
+    assert any(len(vb[t]) > 1 and c.allowed[:, t].any() for t in range(V))
+    rid = srv.submit(np.asarray(fast.encode("hello world")),
+                     max_new_tokens=32, seed=5, constraint=c)
+    srv.drain()
+    text = b"".join(vb[int(t)] for t in srv.results[rid]).decode()
+    obj = json.loads(text)
+    assert set(obj) == {"count"}
+    assert srv.finish_reasons[rid] == "constraint"
 
 
 def test_speculative_batcher_rejects_constraints():

@@ -156,3 +156,67 @@ def test_gpt_export_loads_into_transformers(tmp_path):
     with torch.no_grad():
         theirs = hf(torch.from_numpy(ids)).logits.numpy()
     np.testing.assert_allclose(ours, theirs, atol=2e-3, rtol=1e-3)
+
+
+def test_llama_family_export_import_is_identity():
+    """llama_state_dict_from_params must invert
+    llama_params_from_state_dict for every block variant: plain GQA,
+    Qwen2 biases, Gemma-2 post-norms + tied head."""
+    from dnn_tpu.io.checkpoint import llama_params_from_state_dict
+    from dnn_tpu.io.torch_export import llama_state_dict_from_params
+    from dnn_tpu.models import llama
+
+    for name in ("llama-test", "qwen2-test", "gemma2-test"):
+        cfg = llama.PRESETS[name]
+        params = llama.init(jax.random.PRNGKey(3), cfg)
+        sd = llama_state_dict_from_params(params)
+        if cfg.tie_word_embeddings:
+            assert "lm_head.weight" not in sd, name
+        if cfg.attn_bias:
+            assert "model.layers.0.self_attn.q_proj.bias" in sd, name
+        back = llama_params_from_state_dict(
+            sd, n_layer=cfg.n_layer, post_norms=cfg.post_norms,
+            tied_head="omit" if cfg.tie_word_embeddings
+            else "materialize")
+        _tree_equal(params, back)
+
+
+def test_llama_family_export_loads_into_transformers(tmp_path):
+    """The fine-tune-and-hand-back loop: export framework params to a
+    .pth, torch.load into the matching HF class, logits must agree —
+    including the Gemma-2 tied head (HF reties in-place on load) and
+    Qwen2 biases."""
+    import transformers
+
+    from dnn_tpu.io.torch_export import (
+        llama_state_dict_from_params,
+        save_pth,
+    )
+    from dnn_tpu.models import gpt as _gpt  # noqa: F401 (family helpers)
+    from dnn_tpu.models import llama
+
+    for name, cls_name in (("qwen2-test", "Qwen2ForCausalLM"),
+                           ("gemma2-test", "Gemma2ForCausalLM")):
+        cfg = llama.PRESETS[name]
+        params = llama.init(jax.random.PRNGKey(4), cfg)
+        sd = llama_state_dict_from_params(params)
+        path = str(tmp_path / f"{name}.pth")
+        save_pth(path, sd)
+
+        hf = getattr(transformers, cls_name)(
+            llama.to_hf_config(cfg, attn_implementation="eager")).eval()
+        missing, unexpected = hf.load_state_dict(
+            torch.load(path, map_location="cpu", weights_only=True),
+            strict=False)
+        assert not unexpected, (name, unexpected)
+        # tied models may report lm_head missing; it shares the
+        # embedding's storage, which the load just overwrote in place
+        assert all("lm_head" in m or "rotary" in m for m in missing), \
+            (name, missing)
+
+        ids = np.random.RandomState(5).randint(0, cfg.vocab_size, (2, 12))
+        ours = np.asarray(llama.make_apply(cfg)(params,
+                                                ids.astype(np.int32)))
+        with torch.no_grad():
+            theirs = hf(torch.from_numpy(ids)).logits.numpy()
+        np.testing.assert_allclose(ours, theirs, atol=3e-3, rtol=3e-3)
