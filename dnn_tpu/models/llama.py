@@ -983,6 +983,77 @@ class LlamaFamilyRows:
         return (_mlp_residual(bp, x, cfg=cfg, compute_dtype=compute_dtype),
                 layer_cache)
 
+    def verify_rows(self, prepared, cache, chunk, pos, active, codec):
+        """A (B, T) token block at PER-ROW start positions pos (B,) —
+        the speculative batcher's target-scoring / draft-sync program
+        (see runtime/serving.GPTFamilyRows.verify_rows): writes ROTATED
+        K/V for positions pos..pos+T-1 of each active row, attends GQA
+        with per-row within-block causality, row t's logits predict the
+        token at position pos+t+1.
+
+        Restrictions match the speculative batcher's: float caches
+        (attention reads the cache leaves directly — the codec handles
+        the write gate) and dense attention (no window/softcap; those
+        families are rejected at batcher construction). The score/probs
+        dtype recipe mirrors kvcache.FloatKV.attend_rows exactly, so a
+        greedy verify reproduces the step-by-step decode's argmax even
+        under bf16 compute (the spec batcher's token-identity
+        contract)."""
+        cfg, compute_dtype = self.cfg, self.compute_dtype
+        if cfg.sliding_window is not None or cfg.attn_softcap is not None:
+            raise ValueError(
+                "speculative verify supports dense-attention LLaMA-family "
+                "configs only (no sliding window / softcap)")
+        b, t = chunk.shape
+        kv, g, hd = cfg.n_kv_head, cfg.n_head // cfg.n_kv_head, cfg.head_dim
+        positions = pos[:, None] + jnp.arange(t)  # (B, T)
+        x = _scaled_embed(prepared, chunk, cfg)
+        if compute_dtype is not None:
+            x = x.astype(compute_dtype)
+        # loop-invariant: one table for all layers (a scan body would
+        # recompute it per layer — JAX does not hoist out of scan)
+        cos, sin = _rope_tables(cfg, positions)  # (B, T, D)
+        cos_, sin_ = cos[:, None], sin[:, None]  # broadcast over heads
+
+        def layer(carry, layer_in):
+            bp, lc = layer_in
+            h = _norm(bp["ln_1"], carry, cfg)
+            q = split_heads(linear(bp["attn"]["q"], h,
+                                   compute_dtype=compute_dtype), cfg.n_head)
+            kk = split_heads(linear(bp["attn"]["k"], h,
+                                    compute_dtype=compute_dtype), kv)
+            vv = split_heads(linear(bp["attn"]["v"], h,
+                                    compute_dtype=compute_dtype), kv)
+            q, kk = apply_rope(q, cos_, sin_), apply_rope(kk, cos_, sin_)
+            q = _q_rescale(q, cfg)
+            lc = codec.write_rows(lc, kk, vv, pos, active)
+            # GQA per-row causal attend on the float cache: fold the
+            # group NEXT TO the row dim (5-D scores) so each row keeps
+            # its own within-block limit — the 4-D fold used by decode
+            # (all rows share one limit) cannot express this
+            ck, cv = lc["k"], lc["v"]  # (B, KV, S, D)
+            qg = q.reshape(b, kv, g, t, hd)
+            s = jnp.einsum("bkgtd,bksd->bkgts", qg,
+                           ck).astype(jnp.float32) / jnp.sqrt(hd)
+            cols = jnp.arange(ck.shape[2])
+            limit = (pos[:, None, None, None, None]
+                     + jnp.arange(t)[None, None, None, :, None])
+            s = jnp.where(cols[None, None, None, None, :] <= limit, s,
+                          _NEG_BIG)
+            p = jax.nn.softmax(s, axis=-1)
+            y = jnp.einsum("bkgts,bksd->bkgtd", p.astype(cv.dtype), cv)
+            y = y.reshape(b, cfg.n_head, t, hd)
+            o = linear(bp["attn"]["o"], merge_heads(y.astype(carry.dtype)),
+                       compute_dtype=compute_dtype)
+            carry = _attn_out_residual(bp, carry, o, cfg)
+            return (_mlp_residual(bp, carry, cfg=cfg,
+                                  compute_dtype=compute_dtype), lc)
+
+        x, new_cache = lax.scan(layer, x, (prepared["blocks"], cache))
+        logits = head(prepared, x.astype(jnp.float32), cfg=cfg,
+                      compute_dtype=compute_dtype)
+        return logits, new_cache
+
     def decode_rows(self, prepared, cache, tok, pos, active, codec):
         x = _scaled_embed(prepared, tok[:, None], self.cfg)  # (B, 1, C)
         if self.compute_dtype is not None:

@@ -393,9 +393,19 @@ class LMServer:
 
             # compile over the MODEL's vocab size: padded embedding
             # tables (model vocab > tokenizer vocab) must still match
-            # the batcher's vocab check, with padding ids banned
-            c = TokenConstraint.from_regex(
-                json_regex(depth), vb(self.batcher.cfg.vocab_size))
+            # the batcher's vocab check, with padding ids banned.
+            # Tolerate zero-arg vocab_bytes() adapters (the protocol
+            # predates the size parameter) by padding/trimming here.
+            model_v = self.batcher.cfg.vocab_size
+            try:
+                vocab = vb(model_v)
+            except TypeError:
+                vocab = list(vb())
+            if len(vocab) < model_v:
+                vocab = list(vocab) + [b""] * (model_v - len(vocab))
+            elif len(vocab) > model_v:
+                vocab = list(vocab)[:model_v]
+            c = TokenConstraint.from_regex(json_regex(depth), vocab)
             self._constraint_cache[depth] = c
         return c
 

@@ -36,14 +36,17 @@ Per step, one compiled program (`spec_step`) runs:
      which appends each slot's tokens (budget/stop/eos checks run per
      token, so a mid-chunk stop retires the slot and discards the rest).
 
-Restrictions (all checked at construction/submit): GPT-family target and
-draft with equal vocabularies (the families only need matching vocabs —
-configs may differ), float caches (the solo module's reasoning: chunked
-re-feeds would re-quantize int8 rows differently from the oracle path),
-dense (non-paged) pool, server-level temperature/top_k (the rejection
-math runs one distribution transform for the whole pool; per-request
-sampling overrides are the dense batcher's feature), prompts of at least
-k+1 tokens (the first sync chunk re-feeds the prompt tail), and
+Restrictions (all checked at construction/submit): target and draft
+with equal vocabularies — any FAMILY pair works (GPT default; pass
+family=/draft_family= adapters with verify_rows, e.g.
+llama.LlamaFamilyRows, including cross-family GPT-draft-for-LLaMA
+-target), as long as both attend dense (no sliding window / softcap);
+float caches (the solo module's reasoning: chunked re-feeds would
+re-quantize int8 rows differently from the oracle path), dense
+(non-paged) pool, server-level temperature/top_k (the rejection math
+runs one distribution transform for the whole pool; per-request
+sampling overrides are the dense batcher's feature), prompts of at
+least k+1 tokens (the first sync chunk re-feeds the prompt tail), and
 len(prompt) + max_new + k <= max_len (verify writes up to k positions of
 scratch beyond the last committed token).
 
@@ -81,12 +84,13 @@ class SpeculativeBatcher(ContinuousBatcher):
     _constraints_ok = False
 
     def __init__(self, cfg: GPTConfig, prepared, draft_cfg: GPTConfig,
-                 draft_prepared, *, spec_k: int = 4, **kw):
+                 draft_prepared, *, spec_k: int = 4, draft_family=None,
+                 **kw):
         if cfg.vocab_size != draft_cfg.vocab_size:
             raise ValueError(
                 f"draft vocab {draft_cfg.vocab_size} != target vocab "
                 f"{cfg.vocab_size}")
-        for bad in ("family", "ffn", "paged_blocks", "logprobs_k",
+        for bad in ("ffn", "paged_blocks", "logprobs_k",
                     "attn_kernel", "top_p", "min_p", "repetition_penalty",
                     "lora_adapters"):
             if kw.get(bad):
@@ -116,8 +120,34 @@ class SpeculativeBatcher(ContinuousBatcher):
 
         k = self.spec_k
         cache_dtype = self.cache["k"].dtype
-        d_family = GPTFamilyRows(draft_cfg,
-                                 compute_dtype=self.family.compute_dtype)
+        # family adapters generalize the pair beyond GPT: any adapter
+        # with verify_rows (llama.LlamaFamilyRows included) serves as
+        # target (kw family=) or draft (draft_family=) — cross-family
+        # pairs only need matching vocabularies. Windowed/softcapped
+        # families are rejected: the spec codecs attend dense.
+        if draft_family is None and not isinstance(draft_cfg, GPTConfig):
+            # defaulting a LLaMA-class draft onto the GPT adapter would
+            # fail deep inside the jitted spec_step trace (missing wpe,
+            # no ln_eps) — fail at construction with the fix instead
+            raise ValueError(
+                f"draft_cfg is {type(draft_cfg).__name__}, not GPTConfig "
+                "— pass draft_family= (e.g. llama.LlamaFamilyRows("
+                "draft_cfg)) for non-GPT drafts")
+        d_family = draft_family or GPTFamilyRows(
+            draft_cfg, compute_dtype=self.family.compute_dtype)
+        for fam, which in ((self.family, "target"), (d_family, "draft")):
+            if (getattr(fam, "window", None) is not None
+                    or getattr(fam, "softcap", None) is not None
+                    or getattr(fam, "_wins", None) is not None):
+                raise ValueError(
+                    f"speculative serving supports dense-attention "
+                    f"families only (the {which} family has a sliding "
+                    "window or attention softcap)")
+            if not hasattr(fam, "verify_rows"):
+                raise ValueError(
+                    f"the {which} family adapter has no verify_rows — "
+                    "speculative serving needs the per-row block-verify "
+                    "program")
         # the draft needs the same scratch headroom past max_len the
         # target gets via the submit budget check (verify/propose write
         # up to k positions beyond the last committed token)

@@ -7,6 +7,8 @@ lifted to per-slot acceptance counts). Sampled mode is seeded-
 deterministic and budget-exact; a draft that IS the target accepts
 everything."""
 
+import dataclasses
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -189,3 +191,103 @@ def test_validation(models):
         srv.submit(_prompt(9, 16), max_new_tokens=16)  # 16+16+4 > 32
     with pytest.raises(ValueError, match="per-request"):
         srv.submit(_prompt(9, 8), max_new_tokens=4, temperature=0.5)
+
+
+# ----------------------------------------------------------------------
+# family-adapter speculation (LLaMA targets/drafts)
+# ----------------------------------------------------------------------
+
+def test_llama_family_speculative_greedy_parity():
+    """A LLaMA target + LLaMA draft through the speculative batcher must
+    be token-identical to the plain batcher on the same target — GQA
+    verify (per-row within-block causality on the KV-width cache) is the
+    program under test."""
+    from dnn_tpu.models import llama
+    from dnn_tpu.runtime.serving import ContinuousBatcher
+
+    cfg = llama.PRESETS["llama-test"]
+    d_cfg = dataclasses.replace(cfg, n_layer=1)
+    t_params = llama.init(jax.random.PRNGKey(21), cfg)
+    d_params = llama.init(jax.random.PRNGKey(22), d_cfg)
+    tprep = gpt.prepare_stacked(t_params, cfg)
+    dprep = gpt.prepare_stacked(d_params, d_cfg)
+
+    prompts = [np.arange(5, 13) % cfg.vocab_size,
+               np.asarray([3, 1, 4, 1, 5, 9, 2, 6])]
+    n_new = 9
+
+    plain = ContinuousBatcher(cfg, tprep, slots=2, max_len=64,
+                              prompt_pad=8,
+                              family=llama.LlamaFamilyRows(cfg))
+    want = {i: plain.submit(p, max_new_tokens=n_new)
+            for i, p in enumerate(prompts)}
+    plain.drain()
+
+    spec = SpeculativeBatcher(
+        cfg, tprep, d_cfg, dprep, spec_k=3, slots=2, max_len=64,
+        prompt_pad=8, family=llama.LlamaFamilyRows(cfg),
+        draft_family=llama.LlamaFamilyRows(d_cfg))
+    got = {i: spec.submit(p, max_new_tokens=n_new)
+           for i, p in enumerate(prompts)}
+    spec.drain()
+    for i in want:
+        np.testing.assert_array_equal(spec.results[got[i]],
+                                      plain.results[want[i]])
+    assert spec.spec_accepted >= 0  # telemetry intact
+
+
+def test_cross_family_gpt_draft_llama_target():
+    """Cross-family speculation: a GPT-2 draft proposes for a LLaMA
+    target (matching vocabs is the only requirement); greedy output must
+    equal the target-only decode."""
+    from dnn_tpu.models import llama
+    from dnn_tpu.runtime.serving import ContinuousBatcher
+
+    cfg = llama.PRESETS["llama-test"]
+    d_cfg = gpt.PRESETS["gpt2-test"]  # also V=256
+    assert d_cfg.vocab_size == cfg.vocab_size
+    t_params = llama.init(jax.random.PRNGKey(23), cfg)
+    d_params = gpt.init(jax.random.PRNGKey(24), d_cfg)
+    tprep = gpt.prepare_stacked(t_params, cfg)
+    dprep = gpt.prepare_stacked(d_params, d_cfg)
+
+    prompt = np.asarray([7, 7, 3, 2, 9, 11])
+    n_new = 8
+    plain = ContinuousBatcher(cfg, tprep, slots=1, max_len=64,
+                              prompt_pad=8,
+                              family=llama.LlamaFamilyRows(cfg))
+    rid_w = plain.submit(prompt, max_new_tokens=n_new)
+    plain.drain()
+
+    spec = SpeculativeBatcher(cfg, tprep, d_cfg, dprep, spec_k=2,
+                              slots=1, max_len=64, prompt_pad=8,
+                              family=llama.LlamaFamilyRows(cfg))
+    rid_g = spec.submit(prompt, max_new_tokens=n_new)
+    spec.drain()
+    np.testing.assert_array_equal(spec.results[rid_g],
+                                  plain.results[rid_w])
+
+
+def test_spec_rejects_windowed_family():
+    from dnn_tpu.models import llama
+
+    cfg = llama.PRESETS["mistral-test"]
+    params = llama.init(jax.random.PRNGKey(25), cfg)
+    prep = gpt.prepare_stacked(params, cfg)
+    with pytest.raises(ValueError, match="dense-attention"):
+        SpeculativeBatcher(cfg, prep, cfg, prep, spec_k=2, slots=1,
+                           max_len=48, prompt_pad=8,
+                           family=llama.LlamaFamilyRows(cfg),
+                           draft_family=llama.LlamaFamilyRows(cfg))
+
+
+def test_spec_requires_explicit_draft_family_for_non_gpt_draft():
+    from dnn_tpu.models import llama
+
+    cfg = llama.PRESETS["llama-test"]
+    params = llama.init(jax.random.PRNGKey(26), cfg)
+    prep = gpt.prepare_stacked(params, cfg)
+    with pytest.raises(ValueError, match="draft_family"):
+        SpeculativeBatcher(cfg, prep, cfg, prep, spec_k=2, slots=1,
+                           max_len=48, prompt_pad=8,
+                           family=llama.LlamaFamilyRows(cfg))
