@@ -194,6 +194,21 @@ class NodeClient:
             raise RuntimeError(f"LM server returned no tokens: {status}")
         return np.asarray(result, np.int32)
 
+    def embed(self, prompt_ids, *, pooling: str = "mean",
+              timeout: float = 60.0) -> np.ndarray:
+        """Embedding endpoint of the LM daemon: prompt token ids -> the
+        pooled final hidden state (f32 (C,)). `pooling` is "mean" (masked
+        average over real tokens) or "last" (final token's state). Same
+        wire message as everything else — the request_id "embed[:pool]"
+        selects the endpoint (runtime/lm_server.SendTensor)."""
+        status, result = self.send_tensor(
+            np.asarray(prompt_ids, np.int32).reshape(-1),
+            request_id=f"embed:{pooling}", timeout=timeout,
+        )
+        if result is None:
+            raise RuntimeError(f"LM server returned no embedding: {status}")
+        return np.asarray(result, np.float32)
+
     def generate_stream(
         self,
         prompt_ids,

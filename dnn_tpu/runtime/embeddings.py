@@ -1,0 +1,94 @@
+"""Embedding extraction: pooled hidden states as a serving product.
+
+The reference can only argmax-classify (node.py:186-192); a modern
+serving stack also EXPORTS representations — retrieval, clustering,
+reranking all consume the final hidden state rather than logits. This
+module is that endpoint's compute: the model's stacked forward minus the
+lm_head, normed and pooled.
+
+Design notes:
+  * `hidden == HF last_hidden_state`: both GPT-2 and the LLaMA family
+    apply their final norm at the top of the stack (transformers
+    GPT2Model.ln_f / LlamaModel.norm), so parity tests compare directly
+    (tests/test_embeddings.py).
+  * Padding is FREE under causal attention: pad tokens sit after the
+    real ones and real positions never attend forward, so hidden states
+    of real tokens are pad-invariant; pooling masks with the true
+    `lengths`. This is what lets the daemon pad prompts up to a chunk
+    multiple and reuse ONE compiled program per padded length.
+  * Pooling: "mean" (masked average — the standard sentence-embedding
+    choice), "last" (final real token — decoder-LM convention), "none"
+    (the full (B, T, C) hidden sequence).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["make_embed"]
+
+
+def _hidden_fn(cfg, compute_dtype):
+    """Family dispatch on the config type — the same auto-detection the
+    registry uses (LLaMA-family configs are LlamaConfig instances; GPT
+    configs are GPTConfig)."""
+    from dnn_tpu.models import gpt, llama
+
+    if isinstance(cfg, llama.LlamaConfig):
+        from dnn_tpu.ops.nn import rms_norm
+
+        def hidden(prepared, ids):
+            x = llama.embed(prepared, ids, cfg=cfg)
+            if compute_dtype is not None:
+                x = x.astype(compute_dtype)
+            x = llama.blocks_scan(prepared["blocks"], x, cfg=cfg,
+                                  compute_dtype=compute_dtype,
+                                  windows=llama.layer_windows(cfg))
+            return rms_norm(prepared["ln_f"], x.astype(jnp.float32),
+                            eps=cfg.rms_eps, plus_one=cfg.norm_plus_one)
+
+        return hidden
+
+    from dnn_tpu.ops.nn import layer_norm
+
+    def hidden(prepared, ids):
+        x = gpt.embed(prepared, ids, cfg=cfg)
+        if compute_dtype is not None:
+            x = x.astype(compute_dtype)
+        x = gpt.blocks_scan(prepared["blocks"], x, cfg=cfg,
+                            compute_dtype=compute_dtype)
+        return layer_norm(prepared["ln_f"], x.astype(jnp.float32),
+                          eps=cfg.ln_eps)
+
+    return hidden
+
+
+def make_embed(cfg, *, pooling: str = "mean", compute_dtype=None):
+    """Jitted `embed(prepared, ids, lengths) -> (B, C) f32` (or
+    (B, T, C) for pooling="none").
+
+    `ids` (B, T) may be padded past each row's true length; `lengths`
+    (B,) marks the real extents — pad content is irrelevant (causal
+    attention; see module docstring). Works for any registered GPT- or
+    LLaMA-family config, Gemma's alternating windows included."""
+    if pooling not in ("mean", "last", "none"):
+        raise ValueError(
+            f"pooling must be mean|last|none, got {pooling!r}")
+    hidden = _hidden_fn(cfg, compute_dtype)
+
+    @jax.jit
+    def embed(prepared, ids, lengths):
+        h = hidden(prepared, ids)  # (B, T, C) f32
+        if pooling == "none":
+            return h
+        t = ids.shape[1]
+        lengths_ = jnp.asarray(lengths, jnp.int32)
+        if pooling == "mean":
+            mask = (jnp.arange(t)[None, :] < lengths_[:, None])
+            s = (h * mask[..., None]).sum(axis=1)
+            return s / jnp.maximum(lengths_, 1)[:, None]
+        idx = jnp.clip(lengths_ - 1, 0, t - 1)  # "last"
+        return jnp.take_along_axis(h, idx[:, None, None], axis=1)[:, 0]
+
+    return embed

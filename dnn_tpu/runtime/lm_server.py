@@ -369,6 +369,9 @@ class LMServer:
         # JSON-mode constraints are per-(depth) compile-once artifacts —
         # the token table is vocab-sized work shared by every request
         self._constraint_cache: dict = {}
+        # embedding endpoint: one make_embed per pooling (jit caches per
+        # padded-length shape underneath)
+        self._embed_fns: dict = {}
         self.worker = _BatcherWorker(self.batcher)
         self.worker.start()
 
@@ -499,9 +502,65 @@ class LMServer:
                 f"[{prompt.min()}, {prompt.max()}]")
         return prompt
 
+    def _embed_prompt(self, prompt: np.ndarray, pooling: str) -> np.ndarray:
+        """Pooled hidden-state embedding of one prompt
+        (runtime/embeddings.make_embed). Prompts pad up to a prompt_pad
+        multiple — pad content is free under causal attention, so ONE
+        jitted program per (pooling, padded length) serves every request
+        of that bucket. Runs concurrently with the decode worker (JAX
+        serializes device execution); called via asyncio.to_thread so
+        the event loop never blocks on device time."""
+        cfg = self.batcher.cfg
+        if getattr(self.batcher.family, "ffn", None) is not None:
+            # the extractor builds the family's STANDARD block forward;
+            # an ffn-overridden family (MoE serving) has a different
+            # block pytree — reject cleanly instead of KeyError-ing
+            # inside the trace
+            raise ValueError(
+                "the embedding endpoint does not support ffn-overridden "
+                "families (MoE daemon)")
+        t = int(prompt.size)
+        if t < 1:
+            raise ValueError("embedding needs at least one token")
+        if t > cfg.block_size:
+            raise ValueError(
+                f"prompt length {t} > block_size {cfg.block_size}")
+        fn = self._embed_fns.get(pooling)
+        if fn is None:
+            from dnn_tpu.runtime.embeddings import make_embed
+
+            fn = make_embed(cfg, pooling=pooling,
+                            compute_dtype=self.batcher.family.compute_dtype)
+            self._embed_fns[pooling] = fn
+        p_pad = self.batcher.prompt_pad
+        padded_len = min(-(-t // p_pad) * p_pad, cfg.block_size)
+        ids = np.zeros((1, max(padded_len, t)), np.int32)
+        ids[0, :t] = prompt.reshape(-1)
+        out = fn(self.batcher.prepared, ids, np.asarray([t], np.int32))
+        return np.asarray(out[0], np.float32)
+
     async def SendTensor(self, request: pb.TensorRequest, context) -> pb.TensorResponse:
         prompt = await self._validated_prompt(request, context)
-        tokens = await self._submit_and_await(prompt, request.request_id, context)
+        rid = request.request_id or ""
+        if rid == "embed" or rid.startswith("embed:"):
+            # embedding endpoint: 'embed[:mean|last]' returns the pooled
+            # final hidden state instead of generated tokens
+            pooling = rid.split(":", 1)[1] if ":" in rid else "mean"
+            if pooling not in ("mean", "last"):
+                await context.abort(
+                    grpc.StatusCode.INVALID_ARGUMENT,
+                    f"embed pooling must be mean|last, got {pooling!r}")
+            try:
+                vec = await asyncio.to_thread(
+                    self._embed_prompt, np.asarray(prompt), pooling)
+            except ValueError as e:
+                await context.abort(grpc.StatusCode.INVALID_ARGUMENT,
+                                    str(e))
+            return pb.TensorResponse(
+                status=f"[lm] ok: embedding dim {vec.shape[-1]}",
+                result_tensor=_tensor_msg(vec),
+            )
+        tokens = await self._submit_and_await(prompt, rid, context)
         return pb.TensorResponse(
             status=f"[lm] ok: {len(tokens)} tokens",
             result_tensor=_tensor_msg(np.asarray(tokens, np.int32)),

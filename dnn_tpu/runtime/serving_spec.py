@@ -136,9 +136,11 @@ class SpeculativeBatcher(ContinuousBatcher):
         d_family = draft_family or GPTFamilyRows(
             draft_cfg, compute_dtype=self.family.compute_dtype)
         for fam, which in ((self.family, "target"), (d_family, "draft")):
-            if (getattr(fam, "window", None) is not None
-                    or getattr(fam, "softcap", None) is not None
-                    or getattr(fam, "_wins", None) is not None):
+            # paged_ok is the family's "attends plain causal" capability
+            # flag (False for window/softcap/alt-window configs —
+            # llama.LlamaFamilyRows) — exactly the condition the dense
+            # spec codecs need; absent attribute (GPT) means True
+            if not getattr(fam, "paged_ok", True):
                 raise ValueError(
                     f"speculative serving supports dense-attention "
                     f"families only (the {which} family has a sliding "

@@ -1,0 +1,132 @@
+"""Embedding extraction (runtime/embeddings.py) and the daemon's embed
+endpoint: hidden == HF last_hidden_state, pooling masks pads exactly,
+and the gRPC front returns the same vectors the library computes.
+
+The reference can only argmax-classify (node.py:186-192); representation
+export is capability built beyond it."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dnn_tpu.models import gpt, llama
+from dnn_tpu.runtime.embeddings import make_embed
+
+LCFG = llama.PRESETS["llama-test"]
+GCFG = gpt.PRESETS["gpt2-test"]
+
+
+def _lprep(seed=0):
+    p = llama.init(jax.random.PRNGKey(seed), LCFG)
+    return gpt.prepare_stacked(p, LCFG)
+
+
+def test_hidden_matches_hf_last_hidden_state():
+    torch = pytest.importorskip("torch")
+    transformers = pytest.importorskip("transformers")
+
+    hf_cfg = llama.to_hf_config(LCFG, attn_implementation="eager")
+    torch.manual_seed(0)
+    model = transformers.LlamaForCausalLM(hf_cfg).eval()
+    sd = {k: v.numpy() for k, v in model.state_dict().items()}
+    from dnn_tpu.io.checkpoint import llama_params_from_state_dict
+
+    params = llama_params_from_state_dict(sd)
+    prepared = gpt.prepare_stacked(params, LCFG)
+    ids = np.random.RandomState(0).randint(0, LCFG.vocab_size, (2, 10))
+    with torch.no_grad():
+        want = model.model(torch.from_numpy(ids)).last_hidden_state.numpy()
+    got = np.asarray(make_embed(LCFG, pooling="none")(
+        prepared, ids.astype(np.int32), np.asarray([10, 10], np.int32)))
+    np.testing.assert_allclose(got, want, atol=2e-4, rtol=2e-4)
+
+
+def test_gemma2_hidden_matches_hf():
+    """The extractor rides every family switch — alternating windows,
+    post-norms, (1+w) norms, embed scaling."""
+    torch = pytest.importorskip("torch")
+    transformers = pytest.importorskip("transformers")
+
+    cfg = llama.PRESETS["gemma2-test"]
+    hf_cfg = llama.to_hf_config(cfg, attn_implementation="eager")
+    torch.manual_seed(1)
+    model = transformers.Gemma2ForCausalLM(hf_cfg).eval()
+    sd = {k: v.numpy() for k, v in model.state_dict().items()}
+    from dnn_tpu.io.checkpoint import llama_params_from_state_dict
+
+    params = llama_params_from_state_dict(sd, post_norms=True,
+                                          tied_head="omit")
+    prepared = gpt.prepare_stacked(params, cfg)
+    ids = np.random.RandomState(1).randint(0, cfg.vocab_size, (1, 24))
+    with torch.no_grad():
+        want = model.model(torch.from_numpy(ids)).last_hidden_state.numpy()
+    got = np.asarray(make_embed(cfg, pooling="none")(
+        prepared, ids.astype(np.int32), np.asarray([24], np.int32)))
+    np.testing.assert_allclose(got, want, atol=2e-4, rtol=2e-4)
+
+
+def test_pooling_masks_pads_exactly():
+    """Pad invariance (causal attention) + pooling correctness: a padded
+    batch row pools to the same vector as its unpadded solo run."""
+    prepared = _lprep()
+    rs = np.random.RandomState(2)
+    a = rs.randint(0, LCFG.vocab_size, (7,))
+    b = rs.randint(0, LCFG.vocab_size, (12,))
+    ids = np.zeros((2, 12), np.int32)
+    ids[0, :7] = a
+    ids[0, 7:] = 99  # junk pads — must not matter
+    ids[1] = b
+    lengths = np.asarray([7, 12], np.int32)
+
+    for pooling in ("mean", "last"):
+        fn = make_embed(LCFG, pooling=pooling)
+        batch = np.asarray(fn(prepared, ids, lengths))
+        solo_a = np.asarray(fn(prepared, a[None].astype(np.int32),
+                               np.asarray([7], np.int32)))[0]
+        np.testing.assert_allclose(batch[0], solo_a, atol=1e-5, rtol=1e-5)
+
+    # mean really is the masked mean of the "none" hidden states
+    h = np.asarray(make_embed(LCFG, pooling="none")(prepared, ids, lengths))
+    want_mean = h[0, :7].mean(axis=0)
+    got_mean = np.asarray(make_embed(LCFG, pooling="mean")(
+        prepared, ids, lengths))[0]
+    np.testing.assert_allclose(got_mean, want_mean, atol=1e-5, rtol=1e-5)
+    # last picks position length-1
+    got_last = np.asarray(make_embed(LCFG, pooling="last")(
+        prepared, ids, lengths))[0]
+    np.testing.assert_allclose(got_last, h[0, 6], atol=1e-6)
+
+
+def test_daemon_embed_endpoint():
+    """Over real gRPC: NodeClient.embed == library make_embed on the
+    same prepared params; bad pooling is INVALID_ARGUMENT."""
+    import grpc
+
+    from dnn_tpu.comm.client import NodeClient
+    from dnn_tpu.runtime.lm_server import start_lm_server_in_background
+
+    params = gpt.init(jax.random.PRNGKey(3), GCFG)
+    prepared = gpt.prepare_stacked(params, GCFG)
+    port = 59277
+    t, stop = start_lm_server_in_background(
+        GCFG, prepared, port=port, slots=2, max_len=64, prompt_pad=16,
+        default_max_new=4)
+    try:
+        client = NodeClient(f"127.0.0.1:{port}")
+        assert client.wait_healthy(deadline=60)
+        prompt = np.asarray([5, 3, 8, 13, 2], np.int32)
+        for pooling in ("mean", "last"):
+            got = client.embed(prompt, pooling=pooling)
+            padded = np.zeros((1, 16), np.int32)
+            padded[0, :5] = prompt
+            want = np.asarray(make_embed(GCFG, pooling=pooling)(
+                prepared, padded, np.asarray([5], np.int32)))[0]
+            np.testing.assert_allclose(got, want, atol=1e-5, rtol=1e-5)
+        # generation still works on the same server (endpoint dispatch)
+        toks = client.generate(prompt, max_new_tokens=4)
+        assert len(toks) == 4
+        with pytest.raises(grpc.RpcError):
+            client.embed(prompt, pooling="bogus")
+    finally:
+        stop()
