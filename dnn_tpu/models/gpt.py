@@ -251,6 +251,25 @@ def make_apply(cfg: GPTConfig, *, use_flash=False, compute_dtype=None, remat=Fal
     return apply
 
 
+def make_hidden_stacked(cfg: GPTConfig, *, compute_dtype=None):
+    """Final-normed hidden states over the prepare_stacked layout —
+    make_apply_stacked minus the lm_head projection (== HF
+    GPT2Model.last_hidden_state). The embedding endpoint's forward
+    (runtime/embeddings.py); kept HERE so it can never drift from the
+    logits forward below."""
+
+    def hidden(prepared, idx):
+        x = embed(prepared, idx, cfg=cfg)
+        if compute_dtype is not None:
+            x = x.astype(compute_dtype)
+        x = blocks_scan(prepared["blocks"], x, cfg=cfg,
+                        compute_dtype=compute_dtype)
+        return layer_norm(prepared["ln_f"], x.astype(jnp.float32),
+                          eps=cfg.ln_eps)
+
+    return hidden
+
+
 def make_apply_stacked(cfg: GPTConfig, *, use_flash=False, compute_dtype=None,
                        remat=False, logits_dtype=None):
     """Forward over `prepare_stacked` params: zero per-call restacking.

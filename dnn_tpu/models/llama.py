@@ -515,6 +515,26 @@ def make_apply(cfg: LlamaConfig, *, compute_dtype=None, remat=False):
     return apply
 
 
+def make_hidden_stacked(cfg: LlamaConfig, *, compute_dtype=None):
+    """Final-normed hidden states over the prepare_stacked layout —
+    make_apply_stacked minus the lm_head projection (== HF
+    LlamaModel/GemmaModel.last_hidden_state, every family switch
+    included). The embedding endpoint's forward
+    (runtime/embeddings.py); kept HERE so it can never drift from the
+    logits forward above."""
+
+    def hidden(prepared, idx):
+        x = embed(prepared, idx, cfg=cfg)
+        if compute_dtype is not None:
+            x = x.astype(compute_dtype)
+        x = blocks_scan(prepared["blocks"], x, cfg=cfg,
+                        compute_dtype=compute_dtype,
+                        windows=layer_windows(cfg))
+        return _norm(prepared["ln_f"], x.astype(jnp.float32), cfg)
+
+    return hidden
+
+
 def make_apply_stacked(cfg: LlamaConfig, *, compute_dtype=None,
                        logits_dtype=None, remat=False):
     """Forward over the prepare_stacked layout (gpt.prepare_stacked works

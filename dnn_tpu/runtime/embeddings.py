@@ -30,38 +30,14 @@ __all__ = ["make_embed"]
 
 
 def _hidden_fn(cfg, compute_dtype):
-    """Family dispatch on the config type — the same auto-detection the
-    registry uses (LLaMA-family configs are LlamaConfig instances; GPT
-    configs are GPTConfig)."""
+    """Family dispatch on the config type (LLaMA-family configs are
+    LlamaConfig instances; GPT configs are GPTConfig). Each family OWNS
+    its hidden-state forward (make_hidden_stacked, defined next to its
+    logits forward so the two cannot drift)."""
     from dnn_tpu.models import gpt, llama
 
-    if isinstance(cfg, llama.LlamaConfig):
-        from dnn_tpu.ops.nn import rms_norm
-
-        def hidden(prepared, ids):
-            x = llama.embed(prepared, ids, cfg=cfg)
-            if compute_dtype is not None:
-                x = x.astype(compute_dtype)
-            x = llama.blocks_scan(prepared["blocks"], x, cfg=cfg,
-                                  compute_dtype=compute_dtype,
-                                  windows=llama.layer_windows(cfg))
-            return rms_norm(prepared["ln_f"], x.astype(jnp.float32),
-                            eps=cfg.rms_eps, plus_one=cfg.norm_plus_one)
-
-        return hidden
-
-    from dnn_tpu.ops.nn import layer_norm
-
-    def hidden(prepared, ids):
-        x = gpt.embed(prepared, ids, cfg=cfg)
-        if compute_dtype is not None:
-            x = x.astype(compute_dtype)
-        x = gpt.blocks_scan(prepared["blocks"], x, cfg=cfg,
-                            compute_dtype=compute_dtype)
-        return layer_norm(prepared["ln_f"], x.astype(jnp.float32),
-                          eps=cfg.ln_eps)
-
-    return hidden
+    family = llama if isinstance(cfg, llama.LlamaConfig) else gpt
+    return family.make_hidden_stacked(cfg, compute_dtype=compute_dtype)
 
 
 def make_embed(cfg, *, pooling: str = "mean", compute_dtype=None):

@@ -59,6 +59,96 @@ def next_token_loss(apply_fn: Callable, params, tokens, *, ignore_index=None):
     return cross_entropy(logits, tokens[:, 1:], ignore_index=ignore_index)
 
 
+def make_eval_step(apply_fn: Callable, *,
+                   ignore_index: Optional[int] = None):
+    """Jitted per-batch evaluation step: (params, tokens (B, T)) ->
+    (nll_sum, n_tokens) over the batch's non-ignored next-token targets.
+    Build ONCE and reuse across evaluate() calls — a periodic in-training
+    eval that rebuilt it would re-trace and re-compile the full forward
+    every time."""
+
+    @jax.jit
+    def step(params, tokens):
+        logits = apply_fn(params, tokens[:, :-1]).astype(jnp.float32)
+        targets = tokens[:, 1:]
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        nll = -jnp.take_along_axis(logp, targets[..., None],
+                                   axis=-1)[..., 0]
+        if ignore_index is None:
+            mask = jnp.ones_like(nll)
+        else:
+            mask = (targets != ignore_index).astype(jnp.float32)
+        return jnp.sum(nll * mask), jnp.sum(mask)
+
+    return step
+
+
+def evaluate(apply_fn: Callable, params, batch_iter, *,
+             ignore_index: Optional[int] = None, eval_step=None):
+    """Held-out evaluation: TOKEN-WEIGHTED mean next-token loss and
+    perplexity over an iterable of (B, T) token batches (per-token
+    accumulation — a mean of per-batch means would bias the result
+    whenever batches carry different non-ignored token counts, which
+    ignore_index padding makes routine). Batches may differ in shape
+    (each new shape compiles its own program). Pass a prebuilt
+    `eval_step` (make_eval_step) when evaluating repeatedly — the
+    default builds a fresh one per call. Returns {"loss", "perplexity",
+    "batches", "tokens"}. The counterpart to fit() the reference cannot
+    express — it has no loss at all (inference-only, SURVEY §5)."""
+    step = eval_step or make_eval_step(apply_fn,
+                                       ignore_index=ignore_index)
+    total, tokens, n = 0.0, 0.0, 0
+    for batch in batch_iter:
+        s, m = step(params, jnp.asarray(batch))
+        total += float(s)
+        tokens += float(m)
+        n += 1
+    if n == 0:
+        raise ValueError("evaluate needs at least one batch")
+    mean = total / max(tokens, 1.0)
+    return {"loss": mean, "perplexity": float(jnp.exp(mean)),
+            "batches": n, "tokens": int(tokens)}
+
+
+def distill_loss(student_apply: Callable, teacher_logits, student_params,
+                 tokens, *, temperature: float = 2.0, alpha: float = 0.5,
+                 ignore_index: Optional[int] = None):
+    """Knowledge distillation: alpha * KL(teacher_T || student_T) * T^2
+    + (1-alpha) * CE(student, next tokens) — the Hinton construction
+    with the standard T^2 gradient rescale.
+
+    `teacher_logits` (B, T-1, V) are PRECOMPUTED from the same tokens
+    (run the teacher once per batch outside the student's grad;
+    different-family teachers work — only vocabs must match, the same
+    contract as speculative decoding). Wrap with functools.partial into
+    make_train_step's loss_fn signature:
+
+        step = make_train_step(
+            lambda p, batch: distill_loss(
+                student.apply, batch["teacher_logits"], p,
+                batch["tokens"]),
+            optimizer)
+    """
+    if not 0.0 <= alpha <= 1.0:
+        raise ValueError(f"alpha must be in [0, 1], got {alpha}")
+    s_logits = student_apply(student_params, tokens[:, :-1])
+    s_logits = s_logits.astype(jnp.float32)
+    t_logits = teacher_logits.astype(jnp.float32)
+    t_p = jax.nn.softmax(t_logits / temperature, axis=-1)
+    s_logp = jax.nn.log_softmax(s_logits / temperature, axis=-1)
+    t_logp = jax.nn.log_softmax(t_logits / temperature, axis=-1)
+    kl = jnp.sum(t_p * (t_logp - s_logp), axis=-1)  # (B, T-1)
+    targets = tokens[:, 1:]
+    if ignore_index is not None:
+        mask = (targets != ignore_index).astype(jnp.float32)
+        kl_mean = jnp.sum(kl * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+    else:
+        kl_mean = jnp.mean(kl)
+    soft = kl_mean * temperature ** 2
+    hard = cross_entropy(s_logits, targets, ignore_index=ignore_index)
+    return alpha * soft + (1.0 - alpha) * hard
+
+
 # --------------------------------------------------------------------------
 # generic step
 # --------------------------------------------------------------------------

@@ -1,0 +1,113 @@
+"""evaluate() (held-out loss/perplexity) and distill_loss (teacher ->
+student knowledge distillation) — the train-side helpers the
+inference-only reference has no counterpart for (SURVEY §5)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from dnn_tpu import train
+from dnn_tpu.models import gpt, llama
+
+CFG = gpt.PRESETS["gpt2-test"]
+
+
+def _tokens(rs, n, b=2, t=16):
+    return [rs.randint(0, CFG.vocab_size, (b, t)) for _ in range(n)]
+
+
+def test_evaluate_matches_manual_mean():
+    params = gpt.init(jax.random.PRNGKey(0), CFG)
+    apply = gpt.make_apply(CFG)
+    batches = _tokens(np.random.RandomState(0), 3)
+    out = train.evaluate(apply, params, iter(batches))
+    # uniform shapes, no masking: token-weighted == mean of batch means
+    want = float(np.mean([
+        float(train.next_token_loss(apply, params, jnp.asarray(b)))
+        for b in batches]))
+    assert out["batches"] == 3
+    assert out["tokens"] == 3 * 2 * 15
+    assert out["loss"] == pytest.approx(want, rel=1e-6)
+    assert out["perplexity"] == pytest.approx(float(np.exp(want)), rel=1e-5)
+    with pytest.raises(ValueError, match="at least one"):
+        train.evaluate(apply, params, iter([]))
+
+
+def test_evaluate_is_token_weighted_under_masking():
+    """Batches with different non-pad token counts weight by TOKENS, not
+    by batch (a mean of means would bias toward the short batch)."""
+    pad = 0
+    params = gpt.init(jax.random.PRNGKey(1), CFG)
+    apply = gpt.make_apply(CFG)
+    rs = np.random.RandomState(2)
+    short = rs.randint(1, CFG.vocab_size, (1, 16))
+    short[0, 4:] = pad  # 3 non-pad targets
+    full = rs.randint(1, CFG.vocab_size, (1, 16))  # 15 targets
+    step = train.make_eval_step(apply, ignore_index=pad)
+    sums = [tuple(map(float, step(params, jnp.asarray(b))))
+            for b in (short, full)]
+    want = sum(s for s, _ in sums) / sum(m for _, m in sums)
+    out = train.evaluate(apply, params, iter([short, full]),
+                         ignore_index=pad, eval_step=step)
+    assert out["tokens"] == int(sum(m for _, m in sums))
+    assert out["loss"] == pytest.approx(want, rel=1e-6)
+    # and it differs from the biased mean-of-means
+    biased = np.mean([s / m for s, m in sums])
+    assert abs(out["loss"] - biased) > 1e-6
+
+
+def test_distill_reduces_kl_to_teacher():
+    """A few distillation steps must move the student's distribution
+    toward the teacher's (average KL drops), and alpha=0 must equal the
+    plain CE loss."""
+    t_cfg = CFG
+    s_cfg = gpt.GPTConfig(block_size=32, vocab_size=CFG.vocab_size,
+                          n_layer=1, n_head=2, n_embd=32)
+    teacher = gpt.init(jax.random.PRNGKey(1), t_cfg)
+    student = gpt.init(jax.random.PRNGKey(2), s_cfg)
+    t_apply, s_apply = gpt.make_apply(t_cfg), gpt.make_apply(s_cfg)
+
+    tokens = jnp.asarray(
+        np.random.RandomState(3).randint(0, CFG.vocab_size, (4, 16)))
+    t_logits = t_apply(teacher, tokens[:, :-1])
+
+    def kl_now(sp):
+        s = jax.nn.log_softmax(
+            s_apply(sp, tokens[:, :-1]).astype(jnp.float32), -1)
+        t = jax.nn.log_softmax(t_logits.astype(jnp.float32), -1)
+        return float(jnp.mean(jnp.sum(jnp.exp(t) * (t - s), -1)))
+
+    loss_fn = lambda p, batch: train.distill_loss(  # noqa: E731
+        s_apply, t_logits, p, batch, temperature=1.0, alpha=1.0)
+    opt = optax.adam(1e-2)
+    step = train.make_train_step(loss_fn, opt)
+    state = opt.init(student)
+    before = kl_now(student)
+    for _ in range(12):
+        student, state, _ = step(student, state, tokens)
+    assert kl_now(student) < before * 0.9, "distillation must reduce KL"
+
+    # alpha=0 is the plain hard loss
+    hard = train.distill_loss(s_apply, t_logits, student, tokens, alpha=0.0)
+    want = train.next_token_loss(s_apply, student, tokens)
+    assert float(hard) == pytest.approx(float(want), rel=1e-6)
+
+
+def test_distill_cross_family_teacher():
+    """A LLaMA teacher distills into a GPT student — only the vocabs
+    must match (the speculative-decoding contract, reused)."""
+    l_cfg = llama.PRESETS["llama-test"]
+    assert l_cfg.vocab_size == CFG.vocab_size
+    teacher = llama.init(jax.random.PRNGKey(4), l_cfg)
+    tokens = jnp.asarray(
+        np.random.RandomState(5).randint(0, CFG.vocab_size, (2, 12)))
+    t_logits = llama.make_apply(l_cfg)(teacher, tokens[:, :-1])
+    student = gpt.init(jax.random.PRNGKey(6), CFG)
+    loss = train.distill_loss(gpt.make_apply(CFG), t_logits, student,
+                              tokens, temperature=2.0, alpha=0.5)
+    assert np.isfinite(float(loss))
+    with pytest.raises(ValueError, match="alpha"):
+        train.distill_loss(gpt.make_apply(CFG), t_logits, student, tokens,
+                           alpha=1.5)
