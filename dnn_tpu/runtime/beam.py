@@ -34,6 +34,19 @@ from jax import lax
 from dnn_tpu.models.gpt import GPTConfig
 from dnn_tpu.runtime.generate import forward_with_cache, init_cache
 
+
+def _family_fns(cfg):
+    """(forward_with_cache, init_cache) for the config's family — the
+    beam loop itself is family-agnostic (cache leaves reorder by their
+    shared (L, B, H, S[, D]) batch axis), so LLaMA-family configs
+    (Gemma's per-layer windows included — handled inside
+    llama.forward_with_cache) ride the same search."""
+    from dnn_tpu.models import llama
+
+    if isinstance(cfg, llama.LlamaConfig):
+        return llama.forward_with_cache, llama.init_cache
+    return forward_with_cache, init_cache
+
 _NEG_BIG = -1e30
 
 
@@ -61,6 +74,7 @@ def make_beam_generate(cfg: GPTConfig, *, max_new_tokens: int, beam_size: int,
     if beam_size < 1:
         raise ValueError(f"beam_size must be >= 1, got {beam_size}")
     k = beam_size
+    fwd, mk_cache = _family_fns(cfg)
 
     @functools.partial(jax.jit, static_argnames=())
     def beam_generate(prepared, ids):
@@ -77,8 +91,8 @@ def make_beam_generate(cfg: GPTConfig, *, max_new_tokens: int, beam_size: int,
         # prefill once per batch row, then tile the written cache K ways —
         # beams share the prompt's K/V, so prompt compute is paid once,
         # not beam_size times
-        cache = init_cache(cfg, b, s_max, cache_dtype)
-        logits, cache = forward_with_cache(
+        cache = mk_cache(cfg, b, s_max, cache_dtype)
+        logits, cache = fwd(
             prepared, ids, cache, 0, cfg=cfg, compute_dtype=compute_dtype)
         cache = jax.tree.map(lambda c: jnp.repeat(c, k, axis=1), cache)
         logp0 = jax.nn.log_softmax(
@@ -97,7 +111,7 @@ def make_beam_generate(cfg: GPTConfig, *, max_new_tokens: int, beam_size: int,
 
         def step(carry, i):
             cache, scores, tok, hist, finished, lengths = carry
-            logits, cache = forward_with_cache(
+            logits, cache = fwd(
                 prepared, tok.reshape(b * k, 1), cache, t + i, cfg=cfg,
                 compute_dtype=compute_dtype)
             logp = jax.nn.log_softmax(

@@ -41,15 +41,24 @@ from dnn_tpu.parallel.pipeline import (
 # losses
 # --------------------------------------------------------------------------
 
-def cross_entropy(logits, targets, *, ignore_index: Optional[int] = None):
-    """Token-level cross entropy, mean over non-ignored positions.
-    logits (..., V) f32; targets (...) int."""
+def _token_nll(logits, targets, ignore_index: Optional[int]):
+    """Per-token negative log-likelihood and its keep-mask — THE loss
+    primitive cross_entropy and make_eval_step both build on (one
+    definition, so train and eval math cannot drift)."""
     logits = logits.astype(jnp.float32)
     logp = jax.nn.log_softmax(logits, axis=-1)
     nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
     if ignore_index is None:
-        return jnp.mean(nll)
-    mask = (targets != ignore_index).astype(jnp.float32)
+        mask = jnp.ones_like(nll)
+    else:
+        mask = (targets != ignore_index).astype(jnp.float32)
+    return nll, mask
+
+
+def cross_entropy(logits, targets, *, ignore_index: Optional[int] = None):
+    """Token-level cross entropy, mean over non-ignored positions.
+    logits (..., V) f32; targets (...) int."""
+    nll, mask = _token_nll(logits, targets, ignore_index)
     return jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
 
 
@@ -69,15 +78,8 @@ def make_eval_step(apply_fn: Callable, *,
 
     @jax.jit
     def step(params, tokens):
-        logits = apply_fn(params, tokens[:, :-1]).astype(jnp.float32)
-        targets = tokens[:, 1:]
-        logp = jax.nn.log_softmax(logits, axis=-1)
-        nll = -jnp.take_along_axis(logp, targets[..., None],
-                                   axis=-1)[..., 0]
-        if ignore_index is None:
-            mask = jnp.ones_like(nll)
-        else:
-            mask = (targets != ignore_index).astype(jnp.float32)
+        nll, mask = _token_nll(apply_fn(params, tokens[:, :-1]),
+                               tokens[:, 1:], ignore_index)
         return jnp.sum(nll * mask), jnp.sum(mask)
 
     return step
@@ -105,7 +107,13 @@ def evaluate(apply_fn: Callable, params, batch_iter, *,
         n += 1
     if n == 0:
         raise ValueError("evaluate needs at least one batch")
-    mean = total / max(tokens, 1.0)
+    if tokens == 0:
+        # an all-ignored dataset would otherwise score a perfect-looking
+        # loss 0 / ppl 1
+        raise ValueError(
+            "evaluate saw no non-ignored target tokens (every position "
+            f"matched ignore_index={ignore_index})")
+    mean = total / tokens
     return {"loss": mean, "perplexity": float(jnp.exp(mean)),
             "batches": n, "tokens": int(tokens)}
 
@@ -131,6 +139,10 @@ def distill_loss(student_apply: Callable, teacher_logits, student_params,
     """
     if not 0.0 <= alpha <= 1.0:
         raise ValueError(f"alpha must be in [0, 1], got {alpha}")
+    if temperature <= 0.0:
+        raise ValueError(
+            f"temperature must be > 0, got {temperature} (logits divide "
+            "by it)")
     s_logits = student_apply(student_params, tokens[:, :-1])
     s_logits = s_logits.astype(jnp.float32)
     t_logits = teacher_logits.astype(jnp.float32)

@@ -116,3 +116,38 @@ def test_rejects_bad_args(setup):
         make_beam_generate(CFG, max_new_tokens=4, beam_size=0)
     with pytest.raises(ValueError, match="max_new_tokens"):
         make_beam_generate(CFG, max_new_tokens=0, beam_size=2)
+
+
+def test_beam_llama_family_and_gemma2():
+    """Beam search rides the LLaMA family (and Gemma-2's per-layer
+    windows) through _family_fns: beam_size=1 == greedy make_generate,
+    and the best beam's sum-logprob >= greedy's."""
+    from dnn_tpu.models import llama
+
+    for name in ("llama-test", "gemma2-test"):
+        cfg = llama.PRESETS[name]
+        params = llama.init(jax.random.PRNGKey(31), cfg)
+        prepared = gpt.prepare_stacked(params, cfg)
+        prompt = jnp.asarray(
+            np.random.RandomState(32).randint(0, cfg.vocab_size, (1, 12)))
+        n_new = 8
+        greedy = np.asarray(llama.make_generate(cfg, max_new_tokens=n_new)(
+            prepared, prompt, jax.random.PRNGKey(0)))
+        b1 = np.asarray(make_beam_generate(cfg, max_new_tokens=n_new,
+                                           beam_size=1)(prepared, prompt))
+        np.testing.assert_array_equal(b1, greedy, err_msg=name)
+
+        toks, scores = make_beam_generate(
+            cfg, max_new_tokens=n_new, beam_size=4,
+            return_all=True)(prepared, prompt)
+
+        def seq_logprob(seq):
+            ids = np.concatenate([np.asarray(prompt)[0], seq])
+            logits = np.asarray(llama.make_apply(cfg)(
+                params, jnp.asarray(ids[None, :-1])))[0]
+            lp = jax.nn.log_softmax(jnp.asarray(logits), axis=-1)
+            steps = range(len(ids) - n_new - 1, len(ids) - 1)
+            return float(sum(lp[i, ids[i + 1]] for i in steps))
+
+        assert seq_logprob(np.asarray(toks)[0, 0]) >= \
+            seq_logprob(greedy[0]) - 1e-4, name

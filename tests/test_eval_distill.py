@@ -111,3 +111,16 @@ def test_distill_cross_family_teacher():
     with pytest.raises(ValueError, match="alpha"):
         train.distill_loss(gpt.make_apply(CFG), t_logits, student, tokens,
                            alpha=1.5)
+
+
+def test_guards():
+    params = gpt.init(jax.random.PRNGKey(7), CFG)
+    apply = gpt.make_apply(CFG)
+    tokens = jnp.asarray(np.full((1, 8), 5, np.int64))
+    with pytest.raises(ValueError, match="temperature"):
+        train.distill_loss(apply, apply(params, tokens[:, :-1]), params,
+                           tokens, temperature=0.0)
+    # every target == ignore_index: error, not a perfect score
+    with pytest.raises(ValueError, match="non-ignored"):
+        train.evaluate(apply, params, iter([np.asarray(tokens)]),
+                       ignore_index=5)
