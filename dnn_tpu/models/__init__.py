@@ -4,3 +4,4 @@ from dnn_tpu.models import gpt  # noqa: F401
 from dnn_tpu.models import mlp  # noqa: F401
 from dnn_tpu.models import gpt_moe  # noqa: F401
 from dnn_tpu.models import llama  # noqa: F401
+from dnn_tpu.models import llama_moe  # noqa: F401

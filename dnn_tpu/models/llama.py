@@ -128,6 +128,16 @@ class LlamaConfig:
             return self.head_dim_override
         return self.n_embd // self.n_head
 
+    def default_ffn(self, compute_dtype=None):
+        """The config's MLP-override hook, resolved by every runtime
+        entry point when no explicit `ffn` is passed (forward_with_cache,
+        make_apply*, make_hidden_stacked, LlamaFamilyRows) — so
+        dispatch-by-config call sites (beam, speculative, embeddings)
+        work for MoE subclasses without knowing about them. None = the
+        dense gated MLP; MixtralConfig (models/llama_moe.py) overrides
+        this to return its expert hook."""
+        return None
+
 
 PRESETS = {
     # TinyLlama-1.1B shape — the smallest real open-weight GQA model
@@ -230,7 +240,12 @@ def _kernel(key, shape, dtype, std=0.02):
     return {"kernel": (jax.random.normal(key, shape) * std).astype(dtype)}
 
 
-def init_block(key, cfg: LlamaConfig, dtype=jnp.float32):
+def init_block(key, cfg: LlamaConfig, dtype=jnp.float32, *,
+               include_mlp: bool = True):
+    """`include_mlp=False` builds the attention/norm half only — MoE
+    families (llama_moe) add their expert stacks instead of allocating
+    dense MLP weights just to delete them (22 GB of transient garbage at
+    mixtral-8x7b scale)."""
     c, d = cfg.n_embd, cfg.head_dim
     ks = jax.random.split(key, 7)
 
@@ -253,20 +268,22 @@ def init_block(key, cfg: LlamaConfig, dtype=jnp.float32):
                          std=0.02 / (2 * cfg.n_layer) ** 0.5),
         },
         "ln_2": {"scale": norm_init((c,), dtype)},
-        "mlp": {
+    }
+    if include_mlp:
+        blk["mlp"] = {
             "gate": _kernel(ks[4], (c, cfg.d_ff), dtype),
             "up": _kernel(ks[5], (c, cfg.d_ff), dtype),
             "down": _kernel(ks[6], (cfg.d_ff, c), dtype,
                             std=0.02 / (2 * cfg.n_layer) ** 0.5),
-        },
-    }
+        }
     if cfg.post_norms:
         blk["post_ln_1"] = {"scale": norm_init((c,), dtype)}
         blk["post_ln_2"] = {"scale": norm_init((c,), dtype)}
     return blk
 
 
-def init(rng, cfg: LlamaConfig = PRESETS["llama-test"], dtype=jnp.float32):
+def init(rng, cfg: LlamaConfig = PRESETS["llama-test"], dtype=jnp.float32,
+         *, include_mlp: bool = True):
     keys = jax.random.split(rng, cfg.n_layer + 3)
     c = cfg.n_embd
     norm_init = jnp.zeros if cfg.norm_plus_one else jnp.ones
@@ -280,7 +297,8 @@ def init(rng, cfg: LlamaConfig = PRESETS["llama-test"], dtype=jnp.float32):
         # wte.embedding.T (one table in HBM, shared gradient)
         params["lm_head"] = _kernel(keys[1], (c, cfg.vocab_size), dtype)
     for i in range(cfg.n_layer):
-        params[f"h_{i}"] = init_block(keys[2 + i], cfg, dtype)
+        params[f"h_{i}"] = init_block(keys[2 + i], cfg, dtype,
+                                      include_mlp=include_mlp)
     return params
 
 
@@ -356,17 +374,23 @@ def _qkv_rope(bp, h, positions, *, cfg: LlamaConfig, compute_dtype):
     return _q_rescale(apply_rope(q, cos, sin), cfg), apply_rope(k, cos, sin), v
 
 
-def _mlp_residual(bp, x, *, cfg: LlamaConfig, compute_dtype):
+def _mlp_residual(bp, x, *, cfg: LlamaConfig, compute_dtype, ffn=None):
     """Post-attention half of every block: RMSNorm + gated MLP (SwiGLU or
     Gemma's GeGLU), Gemma-2 post-MLP norm, residual. ONE definition shared
     by the stateless forward, the cached decode, and the per-slot batcher
-    path — their parity contracts depend on these never diverging."""
+    path — their parity contracts depend on these never diverging.
+    `ffn(bp, h)` overrides the MLP (the Mixtral MoE hook —
+    models/llama_moe.py; same convention as the GPT family's ffn)."""
     h = _norm(bp["ln_2"], x, cfg)
-    act = _mlp_act(cfg)
-    m = linear(bp["mlp"]["down"],
-               act(linear(bp["mlp"]["gate"], h, compute_dtype=compute_dtype))
-               * linear(bp["mlp"]["up"], h, compute_dtype=compute_dtype),
-               compute_dtype=compute_dtype)
+    if ffn is not None:
+        m = ffn(bp, h)
+    else:
+        act = _mlp_act(cfg)
+        m = linear(bp["mlp"]["down"],
+                   act(linear(bp["mlp"]["gate"], h,
+                              compute_dtype=compute_dtype))
+                   * linear(bp["mlp"]["up"], h, compute_dtype=compute_dtype),
+                   compute_dtype=compute_dtype)
     if cfg.post_norms:
         m = _norm(bp["post_ln_2"], m, cfg)
     return x + m.astype(x.dtype)
@@ -426,17 +450,19 @@ def _dense_attn(bp, h, *, cfg: LlamaConfig, compute_dtype, window=None):
 
 
 def block_apply(bp, x, *, cfg: LlamaConfig, compute_dtype=None, attn_fn=None,
-                window=None):
+                window=None, ffn=None):
     """Pre-RMSNorm block: GQA attention + gated MLP, both residual
     (Gemma-2 additionally norms each branch output — post_norms).
     `attn_fn(bp, h)` overrides the attention (the sequence-parallel ring
     plugs in here — same hook pattern as gpt._block_core); `window` is
-    the per-layer window override for the default dense attention."""
+    the per-layer window override for the default dense attention;
+    `ffn(bp, h)` overrides the MLP (Mixtral MoE)."""
     fn = attn_fn or (lambda bp2, h: _dense_attn(
         bp2, h, cfg=cfg, compute_dtype=compute_dtype, window=window))
     h = _norm(bp["ln_1"], x, cfg)
     x = _attn_out_residual(bp, x, fn(bp, h), cfg)
-    return _mlp_residual(bp, x, cfg=cfg, compute_dtype=compute_dtype)
+    return _mlp_residual(bp, x, cfg=cfg, compute_dtype=compute_dtype,
+                         ffn=ffn)
 
 
 def _scaled_embed(p, ids, cfg: LlamaConfig):
@@ -477,13 +503,14 @@ def head(params, x, *, cfg: LlamaConfig, compute_dtype=None, logits_dtype=None):
 
 
 def blocks_scan(stacked, x, *, cfg, compute_dtype, remat=False, attn_fn=None,
-                windows=None):
+                windows=None, ffn=None):
     """Scan the stacked blocks. `windows` is the per-layer window array
     for alternating-attention configs ((L',) — already sliced to this
-    stack's layer range); None scans without the extra input."""
+    stack's layer range); None scans without the extra input. `ffn`
+    overrides every block's MLP (Mixtral MoE)."""
     block = (lambda bp, carry, window=None: block_apply(
         bp, carry, cfg=cfg, compute_dtype=compute_dtype,
-        attn_fn=attn_fn, window=window))
+        attn_fn=attn_fn, window=window, ffn=ffn))
     if remat:
         block = jax.checkpoint(block)
 
@@ -501,14 +528,17 @@ def blocks_scan(stacked, x, *, cfg, compute_dtype, remat=False, attn_fn=None,
     return out
 
 
-def make_apply(cfg: LlamaConfig, *, compute_dtype=None, remat=False):
+def make_apply(cfg: LlamaConfig, *, compute_dtype=None, remat=False,
+               ffn=None):
+    ffn = ffn or cfg.default_ffn(compute_dtype)
+
     def apply(params, idx):
         x = embed(params, idx, cfg=cfg)
         if compute_dtype is not None:
             x = x.astype(compute_dtype)
         stacked = gpt.stack_blocks(params, range(cfg.n_layer))
         x = blocks_scan(stacked, x, cfg=cfg, compute_dtype=compute_dtype,
-                         remat=remat, windows=layer_windows(cfg))
+                         remat=remat, windows=layer_windows(cfg), ffn=ffn)
         return head(params, x.astype(jnp.float32), cfg=cfg,
                     compute_dtype=compute_dtype)
 
@@ -523,13 +553,15 @@ def make_hidden_stacked(cfg: LlamaConfig, *, compute_dtype=None):
     (runtime/embeddings.py); kept HERE so it can never drift from the
     logits forward above."""
 
+    ffn = cfg.default_ffn(compute_dtype)
+
     def hidden(prepared, idx):
         x = embed(prepared, idx, cfg=cfg)
         if compute_dtype is not None:
             x = x.astype(compute_dtype)
         x = blocks_scan(prepared["blocks"], x, cfg=cfg,
                         compute_dtype=compute_dtype,
-                        windows=layer_windows(cfg))
+                        windows=layer_windows(cfg), ffn=ffn)
         return _norm(prepared["ln_f"], x.astype(jnp.float32), cfg)
 
     return hidden
@@ -540,13 +572,15 @@ def make_apply_stacked(cfg: LlamaConfig, *, compute_dtype=None,
     """Forward over the prepare_stacked layout (gpt.prepare_stacked works
     unchanged — it only needs h_i keys and cfg.n_layer)."""
 
+    ffn = cfg.default_ffn(compute_dtype)
+
     def apply(prepared, idx):
         x = embed(prepared, idx, cfg=cfg)
         if compute_dtype is not None:
             x = x.astype(compute_dtype)
         x = blocks_scan(prepared["blocks"], x, cfg=cfg,
                          compute_dtype=compute_dtype, remat=remat,
-                         windows=layer_windows(cfg))
+                         windows=layer_windows(cfg), ffn=ffn)
         return head(prepared, x.astype(jnp.float32), cfg=cfg,
                     compute_dtype=compute_dtype, logits_dtype=logits_dtype)
 
@@ -558,7 +592,7 @@ def make_apply_stacked(cfg: LlamaConfig, *, compute_dtype=None,
 # --------------------------------------------------------------------------
 
 def _block_with_cache(bp, x, layer_cache, start_pos, *, cfg: LlamaConfig,
-                      compute_dtype, codec, window=None):
+                      compute_dtype, codec, window=None, ffn=None):
     """Block over x (B, T, C) at absolute positions [start_pos,
     start_pos+T), writing ROTATED k (and v) into the narrow KV-head cache.
     GQA against the cache rides the same codec.attend as the GPT family by
@@ -588,7 +622,8 @@ def _block_with_cache(bp, x, layer_cache, start_pos, *, cfg: LlamaConfig,
     o = linear(bp["attn"]["o"], merge_heads(y.astype(x.dtype)),
                compute_dtype=compute_dtype)
     x = _attn_out_residual(bp, x, o, cfg)
-    return _mlp_residual(bp, x, cfg=cfg, compute_dtype=compute_dtype), layer_cache
+    return (_mlp_residual(bp, x, cfg=cfg, compute_dtype=compute_dtype,
+                          ffn=ffn), layer_cache)
 
 
 def init_cache(cfg: LlamaConfig, batch: int, max_len: int, dtype=jnp.float32):
@@ -603,9 +638,11 @@ def init_cache(cfg: LlamaConfig, batch: int, max_len: int, dtype=jnp.float32):
 
 
 def forward_with_cache(prepared, ids, cache, start_pos, *, cfg: LlamaConfig,
-                       compute_dtype=None, attn_kernel=False, rolling=False):
+                       compute_dtype=None, attn_kernel=False, rolling=False,
+                       ffn=None):
     from dnn_tpu.runtime.kvcache import codec_for_cache
 
+    ffn = ffn or cfg.default_ffn(compute_dtype)
     wins = layer_windows(cfg)  # (L,) for alternating configs, else None
     codec = codec_for_cache(cache, use_kernel=attn_kernel,
                             window=None if wins is not None
@@ -620,7 +657,7 @@ def forward_with_cache(prepared, ids, cache, start_pos, *, cfg: LlamaConfig,
             bp, layer_cache = layer_in
             y, layer_cache = _block_with_cache(
                 bp, carry, layer_cache, start_pos, cfg=cfg,
-                compute_dtype=compute_dtype, codec=codec)
+                compute_dtype=compute_dtype, codec=codec, ffn=ffn)
             return y, layer_cache
 
         x, new_cache = lax.scan(layer, x, (prepared["blocks"], cache))
@@ -629,7 +666,8 @@ def forward_with_cache(prepared, ids, cache, start_pos, *, cfg: LlamaConfig,
             bp, layer_cache, w = layer_in
             y, layer_cache = _block_with_cache(
                 bp, carry, layer_cache, start_pos, cfg=cfg,
-                compute_dtype=compute_dtype, codec=codec, window=w)
+                compute_dtype=compute_dtype, codec=codec, window=w,
+                ffn=ffn)
             return y, layer_cache
 
         x, new_cache = lax.scan(layer_w, x, (prepared["blocks"], cache, wins))
@@ -660,7 +698,8 @@ def _ring_from_prompt(prompt_cache, t: int, w: int):
 def make_generate(cfg: LlamaConfig, *, max_new_tokens: int,
                   temperature: float = 0.0, top_k: Optional[int] = None,
                   top_p: Optional[float] = None,
-                  compute_dtype=None, kv_dtype=None, attn_kernel=False):
+                  compute_dtype=None, kv_dtype=None, attn_kernel=False,
+                  ffn=None):
     """Jitted generate(prepared, ids, rng) — same contract as the GPT
     family's decoder, including kv_dtype (f32/bf16/"int8") cache storage
     and attn_kernel (Pallas streaming cache attention on decode steps).
@@ -696,13 +735,13 @@ def make_generate(cfg: LlamaConfig, *, max_new_tokens: int,
             prompt_cache = init_cache(cfg, b, t, cache_dtype)
             logits, prompt_cache = forward_with_cache(
                 prepared, ids, prompt_cache, 0, cfg=cfg,
-                compute_dtype=compute_dtype)
+                compute_dtype=compute_dtype, ffn=ffn)
             cache = _ring_from_prompt(prompt_cache, t, w)
         else:
             cache = init_cache(cfg, b, s_max, cache_dtype)
             logits, cache = forward_with_cache(
                 prepared, ids, cache, 0, cfg=cfg, compute_dtype=compute_dtype,
-                attn_kernel=attn_kernel)
+                attn_kernel=attn_kernel, ffn=ffn)
         rng, sub = jax.random.split(rng)
         tok = _sample(logits[:, -1], sub, temperature=temperature,
                       top_k=top_k, top_p=top_p)
@@ -712,7 +751,8 @@ def make_generate(cfg: LlamaConfig, *, max_new_tokens: int,
             logits, cache = forward_with_cache(
                 prepared, tok[:, None], cache, t + i, cfg=cfg,
                 compute_dtype=compute_dtype,
-                attn_kernel=attn_kernel and not rolling, rolling=rolling)
+                attn_kernel=attn_kernel and not rolling, rolling=rolling,
+                ffn=ffn)
             rng, sub = jax.random.split(rng)
             nxt = _sample(logits[:, -1], sub, temperature=temperature,
                           top_k=top_k, top_p=top_p)
@@ -756,6 +796,11 @@ def make_apply_seq_parallel(cfg: LlamaConfig, mesh, *, axis_name=None,
         raise ValueError(
             "attention softcapping is not supported on the ring-attention "
             "path (the online-softmax hop combine assumes raw scores)")
+    if cfg.default_ffn() is not None:
+        raise ValueError(
+            "MoE configs are not supported on the sequence-parallel path "
+            "(per-shard routing groups would diverge from the dense "
+            "routing — EP x SP composition is follow-on work)")
     axis = axis_name or SEQ_AXIS
 
     def local_fn(prepared, ids_local):
@@ -834,6 +879,10 @@ def make_generate_seq_sharded(cfg: LlamaConfig, mesh, *, max_new_tokens: int,
             "attention softcapping is not supported on the seq-sharded "
             "decode path (the distributed online-softmax combines raw "
             "per-shard score stats)")
+    if cfg.default_ffn() is not None:
+        raise ValueError(
+            "MoE configs are not supported on the seq-sharded decode "
+            "path (its inline block body has no ffn hook)")
     axis = axis_name or SEQ_AXIS
     n = mesh.shape[axis]
     kv, g, hd = cfg.n_kv_head, cfg.n_head // cfg.n_kv_head, cfg.head_dim
@@ -948,11 +997,16 @@ class LlamaFamilyRows:
     slot's position limit."""
 
     def __init__(self, cfg: LlamaConfig, *, compute_dtype=None,
-                 attn_kernel: bool = False):
+                 attn_kernel: bool = False, ffn=None):
         self.cfg = cfg
         self.compute_dtype = compute_dtype
         # picked up by ContinuousBatcher for the decode-rows codec too
         self.attn_kernel = attn_kernel
+        # MLP override (Mixtral MoE — llama_moe.make_ffn); rides every
+        # path of this adapter: prefill, decode rows, verify rows.
+        # Resolved from the config when not passed, so
+        # LlamaFamilyRows(mixtral_cfg) just works.
+        self.ffn = ffn or cfg.default_ffn(compute_dtype)
         # paged-pool head width: the cache stores KV heads (GQA)
         self.kv_heads = cfg.n_kv_head
         # picked up by ContinuousBatcher: sliding-window masking over the
@@ -975,7 +1029,8 @@ class LlamaFamilyRows:
     def prefill(self, prepared, padded, row_cache, start_pos=0):
         return forward_with_cache(
             prepared, padded, row_cache, start_pos, cfg=self.cfg,
-            compute_dtype=self.compute_dtype, attn_kernel=self.attn_kernel)
+            compute_dtype=self.compute_dtype, attn_kernel=self.attn_kernel,
+            ffn=self.ffn)
 
     def _block_rows(self, bp, x, layer_cache, pos, write, codec,
                     window=None):
@@ -1000,7 +1055,8 @@ class LlamaFamilyRows:
         o = linear(bp["attn"]["o"], merge_heads(y.astype(x.dtype)),
                    compute_dtype=compute_dtype)
         x = _attn_out_residual(bp, x, o, cfg)
-        return (_mlp_residual(bp, x, cfg=cfg, compute_dtype=compute_dtype),
+        return (_mlp_residual(bp, x, cfg=cfg, compute_dtype=compute_dtype,
+                              ffn=self.ffn),
                 layer_cache)
 
     def verify_rows(self, prepared, cache, chunk, pos, active, codec):
@@ -1067,7 +1123,8 @@ class LlamaFamilyRows:
                        compute_dtype=compute_dtype)
             carry = _attn_out_residual(bp, carry, o, cfg)
             return (_mlp_residual(bp, carry, cfg=cfg,
-                                  compute_dtype=compute_dtype), lc)
+                                  compute_dtype=compute_dtype,
+                                  ffn=self.ffn), lc)
 
         x, new_cache = lax.scan(layer, x, (prepared["blocks"], cache))
         logits = head(prepared, x.astype(jnp.float32), cfg=cfg,
@@ -1112,6 +1169,11 @@ class LlamaPipelineFamily:
                 "alternating-window configs (Gemma-2) are not supported on "
                 "the pipeline decode path: the stage scan has no per-layer "
                 "window channel (use the solo decoder or the batcher)")
+        if cfg.default_ffn() is not None:
+            raise ValueError(
+                "MoE configs are not supported on this pipeline decode "
+                "path (MoE pipeline decode is runtime/generate_moe's "
+                "machinery)")
         self.cfg = cfg
         self.compute_dtype = compute_dtype
         self.kv_dtype = kv_dtype  # None follows compute_dtype; "int8" quantizes
@@ -1170,6 +1232,8 @@ def make_pipeline_generate(cfg: LlamaConfig, mesh, *, max_new_tokens: int,
 # --------------------------------------------------------------------------
 
 def make_partition(cfg: LlamaConfig, *, compute_dtype=None):
+    part_ffn = cfg.default_ffn(compute_dtype)
+
     def partition(num_parts):
         ranges = gpt.layer_ranges(cfg.n_layer, num_parts)
         stages = []
@@ -1200,7 +1264,7 @@ def make_partition(cfg: LlamaConfig, *, compute_dtype=None):
                     x = blocks_scan(stacked, x, cfg=cfg,
                                      compute_dtype=compute_dtype,
                                      windows=None if wins is None
-                                     else wins[_lo:_hi])
+                                     else wins[_lo:_hi], ffn=part_ffn)
                 if _last:
                     x = head(params, x.astype(jnp.float32), cfg=cfg,
                              compute_dtype=compute_dtype)

@@ -129,9 +129,50 @@ def load_balance_loss(aux) -> jax.Array:
     return e * jnp.sum(aux["load"] * aux["importance"], axis=-1).mean()
 
 
+def init_moe_gated(rng, n_embd: int, n_experts: int, d_ff: int,
+                   dtype=jnp.float32):
+    """Param pytree for a GATED (SwiGLU) MoE FFN layer — the Mixtral
+    expert shape: per-expert gate/up/down projections, no biases.
+    Expert-major stacking exactly as init_moe (EP shards the leading
+    axis; the dense path batches over it)."""
+    kr, kg, ku, kd = jax.random.split(rng, 4)
+    scale_in = 1.0 / math.sqrt(n_embd)
+    scale_out = 1.0 / math.sqrt(d_ff)
+    return {
+        "router": {"kernel": jax.random.normal(
+            kr, (n_embd, n_experts), dtype) * scale_in},
+        "wg": jax.random.normal(kg, (n_experts, n_embd, d_ff), dtype) * scale_in,
+        "wu": jax.random.normal(ku, (n_experts, n_embd, d_ff), dtype) * scale_in,
+        "wd": jax.random.normal(kd, (n_experts, d_ff, n_embd), dtype) * scale_out,
+    }
+
+
+def _expert_ffn_gated(params, expert_in, *, compute_dtype):
+    """(E, cap, D) tokens through each expert's SwiGLU —
+    silu(x@wg) * (x@wu) @ wd, one batched matmul triple (the Mixtral
+    expert). Same dtype recipe as _expert_ffn: f32 accumulation,
+    operands in compute_dtype."""
+    wg, wu, wd = params["wg"], params["wu"], params["wd"]
+    x = expert_in
+    if compute_dtype is not None:
+        x = x.astype(compute_dtype)
+        wg, wu, wd = (w.astype(compute_dtype) for w in (wg, wu, wd))
+    g = jnp.einsum("ecd,edf->ecf", x, wg,
+                   preferred_element_type=jnp.float32)
+    u = jnp.einsum("ecd,edf->ecf", x, wu,
+                   preferred_element_type=jnp.float32)
+    h = jax.nn.silu(g) * u
+    if compute_dtype is not None:
+        h = h.astype(compute_dtype)
+    return jnp.einsum("ecf,efd->ecd", h, wd,
+                      preferred_element_type=jnp.float32)  # f32
+
+
 def _expert_ffn(params, expert_in, *, activation, compute_dtype):
     """(E, cap, D) tokens through each expert's 2-layer FFN, one batched
-    matmul pair. Accumulate in f32, ride operands in compute_dtype.
+    matmul pair — or, when the params carry the gated stack ("wg"), the
+    SwiGLU expert (_expert_ffn_gated; `activation` is then unused).
+    Accumulate in f32, ride operands in compute_dtype.
 
     Accepts int8 weight-only-quantized expert stacks (dnn_tpu/quant.py):
     `wi`/`wo` as int8 with per-(expert, out-channel) `wi_scale`/`wo_scale`
@@ -141,6 +182,9 @@ def _expert_ffn(params, expert_in, *, activation, compute_dtype):
     the experts' HBM traffic at 1 byte/weight — MoE decode is the most
     weight-bandwidth-bound path in the framework (E experts' weights
     stream for one token's worth of FLOPs)."""
+    if "wg" in params:
+        return _expert_ffn_gated(params, expert_in,
+                                 compute_dtype=compute_dtype)
     wi, bi, wo, bo = params["wi"], params["bi"], params["wo"], params["bo"]
     wi_scale, wo_scale = params.get("wi_scale"), params.get("wo_scale")
     x = expert_in
@@ -181,7 +225,7 @@ def moe_ffn(params, x, *, top_k: int = 2, capacity_factor: float = 1.25,
     if n_tok % groups:
         raise ValueError(f"B*T={n_tok} not divisible by groups={groups}")
     s = n_tok // groups
-    e = params["wi"].shape[0]
+    e = params["wg" if "wg" in params else "wi"].shape[0]
     capacity = moe_capacity(s, e, top_k, capacity_factor)
 
     xg = x.reshape(groups, s, d)
@@ -261,7 +305,7 @@ def make_moe_ffn_ep(mesh: Mesh, *, top_k: int = 2, capacity_factor: float = 1.25
         b, t, d = x.shape
         if b % n:
             raise ValueError(f"batch {b} not divisible by expert-axis size {n}")
-        e = params["wi"].shape[0]
+        e = params["wg" if "wg" in params else "wi"].shape[0]
         if e % n:
             raise ValueError(f"{e} experts not divisible by expert-axis size {n}")
         s = (b // n) * t

@@ -511,14 +511,18 @@ class LMServer:
         serializes device execution); called via asyncio.to_thread so
         the event loop never blocks on device time."""
         cfg = self.batcher.cfg
-        if getattr(self.batcher.family, "ffn", None) is not None:
-            # the extractor builds the family's STANDARD block forward;
-            # an ffn-overridden family (MoE serving) has a different
-            # block pytree — reject cleanly instead of KeyError-ing
-            # inside the trace
+        if (getattr(self.batcher.family, "ffn", None) is not None
+                and getattr(cfg, "default_ffn", lambda **_: None)()
+                is None):
+            # the extractor resolves CONFIG-carried MLP overrides
+            # (Mixtral's default_ffn) itself; an ffn set only on the
+            # family adapter (the GPT-MoE daemon) has no hook in the
+            # extractor's block forward — reject cleanly instead of
+            # KeyError-ing inside the trace
             raise ValueError(
                 "the embedding endpoint does not support ffn-overridden "
-                "families (MoE daemon)")
+                "families whose config carries no default_ffn (the "
+                "GPT-MoE daemon)")
         t = int(prompt.size)
         if t < 1:
             raise ValueError("embedding needs at least one token")
