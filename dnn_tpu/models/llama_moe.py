@@ -126,6 +126,87 @@ def family_rows(cfg: MixtralConfig, *, compute_dtype=None,
                                  attn_kernel=attn_kernel)
 
 
+def make_apply_ep(cfg: MixtralConfig, mesh, *, axis_name: Optional[str] = None,
+                  compute_dtype=None):
+    """Expert-parallel Mixtral forward over `mesh`'s expert axis — the
+    GShard fabric (parallel/moe.moe_ffn_local: two all_to_alls move
+    tokens to their experts' owners and back over ICI) under the llama
+    block via the ffn hook.
+
+    apply(params, ids): ids (B, T), B divisible by the axis size; the
+    batch shards over the expert axis (each device's local batch is its
+    routing group), expert stacks shard on their E axis, attention/norm
+    weights replicate. Identical math to the dense forward with
+    `make_ffn(cfg, groups=n)` — the parity contract
+    tests/test_mixtral.py pins (same as the GPT-MoE family's)."""
+    from jax.sharding import PartitionSpec as P
+
+    from dnn_tpu.parallel.mesh import EXPERT_AXIS
+    from dnn_tpu.parallel.moe import moe_capacity, moe_ffn_local
+
+    axis = axis_name or EXPERT_AXIS
+    n = mesh.shape[axis]
+    if cfg.n_expert % n:
+        raise ValueError(
+            f"n_expert={cfg.n_expert} not divisible by axis size {n}")
+
+    def local_fn(prep_local, ids_local):
+        x = llama._scaled_embed(prep_local, ids_local, cfg)
+        if compute_dtype is not None:
+            x = x.astype(compute_dtype)
+        b_local, t = ids_local.shape
+        s = b_local * t  # this device's tokens = one routing group
+        capacity = moe_capacity(s, cfg.n_expert, cfg.router_top_k,
+                                cfg.capacity_factor)
+
+        def ep_ffn(bp, h):
+            d = h.shape[-1]
+            return moe_ffn_local(
+                bp["moe"], h.reshape(-1, d), top_k=cfg.router_top_k,
+                capacity=capacity, axis_name=axis,
+                compute_dtype=compute_dtype,
+            ).reshape(h.shape).astype(h.dtype)
+
+        x = llama.blocks_scan(prep_local["blocks"], x, cfg=cfg,
+                              compute_dtype=compute_dtype, ffn=ep_ffn,
+                              windows=llama.layer_windows(cfg))
+        return llama.head(prep_local, x.astype(jnp.float32), cfg=cfg,
+                          compute_dtype=compute_dtype)
+
+    def _spec_for(path, leaf):
+        # derived from the ACTUAL pytree, so config variants the init
+        # supports (attn_bias leaves, post-norms, tied/no-lm_head) shard
+        # correctly instead of tripping a hardcoded-structure mismatch:
+        # only the expert stacks shard (stacked blocks carry a leading L,
+        # so E is axis 1); everything else replicates
+        keys = [p.key for p in path if hasattr(p, "key")]
+        if "moe" in keys and keys and keys[-1] in ("wg", "wu", "wd"):
+            return P(None, axis)
+        return P()
+
+    def apply(params, ids):
+        b = ids.shape[0]
+        if b % n:
+            raise ValueError(
+                f"batch {b} not divisible by expert-axis size {n}")
+        if "blocks" in params:
+            prepared = params
+        else:
+            prepared = {k: v for k, v in params.items()
+                        if not k.startswith("h_")}
+            prepared["blocks"] = gpt.stack_blocks(params,
+                                                  range(cfg.n_layer))
+        param_specs = jax.tree_util.tree_map_with_path(_spec_for, prepared)
+        return jax.shard_map(
+            local_fn, mesh=mesh,
+            in_specs=(param_specs, P(axis)),
+            out_specs=P(axis),
+            check_vma=False,
+        )(prepared, ids)
+
+    return apply
+
+
 # --------------------------------------------------------------------------
 # HF conversion
 # --------------------------------------------------------------------------

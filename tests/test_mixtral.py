@@ -162,3 +162,48 @@ def test_config_resolved_hook_reaches_every_dispatcher():
         llama.make_apply_seq_parallel(CFG, mesh)
     with pytest.raises(ValueError, match="MoE"):
         llama.LlamaPipelineFamily(CFG)
+
+
+def test_ep_matches_grouped_dense():
+    """Expert-parallel Mixtral over the expert axis == the dense forward
+    with matching routing groups (the GShard parity contract, llama-MoE
+    edition): tokens cross devices via all_to_all, logits must be
+    identical."""
+    from dnn_tpu.models import llama
+    from dnn_tpu.parallel.mesh import EXPERT_AXIS, make_mesh
+
+    n = 4
+    assert CFG.n_expert % n == 0
+    mesh = make_mesh({EXPERT_AXIS: n}, jax.devices()[:n])
+    p = _params(seed=12)
+    ids = np.random.RandomState(13).randint(0, CFG.vocab_size, (n * 2, 8))
+
+    want = np.asarray(llama.make_apply(
+        CFG, ffn=llama_moe.make_ffn(CFG, groups=n))(p, jnp.asarray(ids)))
+    got = np.asarray(llama_moe.make_apply_ep(CFG, mesh)(
+        p, jnp.asarray(ids)))
+    np.testing.assert_allclose(got, want, atol=2e-5, rtol=2e-5)
+
+    with pytest.raises(ValueError, match="divisible"):
+        llama_moe.make_apply_ep(CFG, mesh)(p, jnp.asarray(ids[:3]))
+
+
+def test_ep_handles_config_variants():
+    """The EP spec derives from the real pytree: a q/k/v-biased Mixtral
+    variant (extra bias leaves) shards and matches the grouped dense
+    forward instead of tripping a hardcoded-structure mismatch."""
+    from dnn_tpu.models import llama
+    from dnn_tpu.parallel.mesh import EXPERT_AXIS, make_mesh
+
+    biased = dataclasses.replace(CFG, attn_bias=True)
+    n = 4
+    mesh = make_mesh({EXPERT_AXIS: n}, jax.devices()[:n])
+    p = llama_moe.init(jax.random.PRNGKey(14), biased)
+    assert "bias" in p["h_0"]["attn"]["q"]
+    ids = np.random.RandomState(15).randint(0, biased.vocab_size, (n, 8))
+    want = np.asarray(llama.make_apply(
+        biased, ffn=llama_moe.make_ffn(biased, groups=n))(
+        p, jnp.asarray(ids)))
+    got = np.asarray(llama_moe.make_apply_ep(biased, mesh)(
+        p, jnp.asarray(ids)))
+    np.testing.assert_allclose(got, want, atol=2e-5, rtol=2e-5)
