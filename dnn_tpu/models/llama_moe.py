@@ -180,7 +180,9 @@ def make_apply_ep(cfg: MixtralConfig, mesh, *, axis_name: Optional[str] = None,
         # only the expert stacks shard (stacked blocks carry a leading L,
         # so E is axis 1); everything else replicates
         keys = [p.key for p in path if hasattr(p, "key")]
-        if "moe" in keys and keys and keys[-1] in ("wg", "wu", "wd"):
+        if "moe" in keys and keys and keys[-1] in (
+                "wg", "wu", "wd",
+                "wg_scale", "wu_scale", "wd_scale"):  # int8 stacks
             return P(None, axis)
         return P()
 

@@ -151,21 +151,38 @@ def _expert_ffn_gated(params, expert_in, *, compute_dtype):
     """(E, cap, D) tokens through each expert's SwiGLU —
     silu(x@wg) * (x@wu) @ wd, one batched matmul triple (the Mixtral
     expert). Same dtype recipe as _expert_ffn: f32 accumulation,
-    operands in compute_dtype."""
+    operands in compute_dtype.
+
+    Accepts int8 weight-only-quantized stacks (quant.quantize_tree):
+    per-(expert, out-channel) `*_scale` factors fold as exact epilogue
+    multiplies on the f32 accumulators; the int8->compute convert fuses
+    into the einsum operand read — 1 byte/weight of expert HBM traffic,
+    the bandwidth win MoE decode exists for."""
     wg, wu, wd = params["wg"], params["wu"], params["wd"]
+    sg, su, sd = (params.get(k) for k in ("wg_scale", "wu_scale",
+                                          "wd_scale"))
     x = expert_in
-    if compute_dtype is not None:
-        x = x.astype(compute_dtype)
-        wg, wu, wd = (w.astype(compute_dtype) for w in (wg, wu, wd))
+    cd = compute_dtype if compute_dtype is not None else (
+        jnp.float32 if wg.dtype == jnp.int8 else None)
+    if cd is not None:
+        x = x.astype(cd)
+        wg, wu, wd = (w.astype(cd) for w in (wg, wu, wd))
     g = jnp.einsum("ecd,edf->ecf", x, wg,
                    preferred_element_type=jnp.float32)
     u = jnp.einsum("ecd,edf->ecf", x, wu,
                    preferred_element_type=jnp.float32)
+    if sg is not None:
+        g = g * sg  # (E, 1, F) broadcasts over capacity
+    if su is not None:
+        u = u * su
     h = jax.nn.silu(g) * u
-    if compute_dtype is not None:
-        h = h.astype(compute_dtype)
-    return jnp.einsum("ecf,efd->ecd", h, wd,
-                      preferred_element_type=jnp.float32)  # f32
+    if cd is not None:
+        h = h.astype(cd)
+    out = jnp.einsum("ecf,efd->ecd", h, wd,
+                     preferred_element_type=jnp.float32)  # f32
+    if sd is not None:
+        out = out * sd
+    return out
 
 
 def _expert_ffn(params, expert_in, *, activation, compute_dtype):

@@ -154,20 +154,24 @@ def quantize_tree(params, *, should_quantize: Optional[Callable] = None,
                     return quantize_linear(node, bits=bits,
                                            int4_group=int4_group)
                 return node
-            if (
-                "wi" in node and "wo" in node
-                and hasattr(node["wi"], "ndim") and node["wi"].ndim == 3
-            ):
-                # MoE expert stacks stay int8: their epilogue dequant is
-                # per-(expert, channel) (parallel/moe.py) and the routed
-                # FFN has no group-wise apply path — int4 here would need
-                # its own dispatch for <0.2x the win int4 buys the dense
-                # kernels (experts are already 1/E-sharded per device)
-                out = {k: walk(v, f"{path}/{k}") for k, v in node.items()
-                       if k not in ("wi", "wo")}
-                out["wi"], out["wi_scale"] = quantize_tensor(node["wi"])
-                out["wo"], out["wo_scale"] = quantize_tensor(node["wo"])
-                return out
+            # MoE expert stacks (2-layer wi/wo or gated Mixtral
+            # wg/wu/wd) stay int8: their epilogue dequant is
+            # per-(expert, channel) (parallel/moe.py) and the routed FFN
+            # has no group-wise apply path — int4 here would need its
+            # own dispatch for <0.2x the win int4 buys the dense kernels
+            # (experts are already 1/E-sharded per device). ndim 3 is
+            # the raw (E, in, out) stack, 4 the prepare_stacked form
+            # with its leading L — quantize_tensor's axis=-2 scale is
+            # per-(..., channel) either way.
+            for ks in (("wi", "wo"), ("wg", "wu", "wd")):
+                if all(k in node and hasattr(node[k], "ndim")
+                       and node[k].ndim in (3, 4) for k in ks):
+                    out = {k: walk(v, f"{path}/{k}")
+                           for k, v in node.items() if k not in ks}
+                    for kk in ks:
+                        out[kk], out[kk + "_scale"] = quantize_tensor(
+                            node[kk])
+                    return out
             return {k: walk(v, f"{path}/{k}") for k, v in node.items()}
         return node
 

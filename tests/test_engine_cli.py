@@ -331,3 +331,28 @@ def test_cli_beam_requires_generate(tmp_path):
                  "--beam", "4"]) == 1
     assert main(["--node_id", "n0", "--config", str(cfg_path),
                  "--eos_id", "7"]) == 1
+
+
+def test_cli_generate_mixtral(tmp_path, capsys):
+    """The CLI serves Mixtral with zero family-specific wiring: the
+    engine's LlamaConfig dispatch catches the subclass and the config
+    resolves its own expert hook (default_ffn)."""
+    from dnn_tpu.node import main
+
+    cfg = {
+        "nodes": [{"id": "n0", "part_index": 0}],
+        "num_parts": 1,
+        "model": "mixtral-test",
+        "device_type": "cpu",
+        "runtime": "spmd",
+    }
+    cfg_path = tmp_path / "mixtral.json"
+    cfg_path.write_text(json.dumps(cfg))
+    rc = main(["--node_id", "n0", "--config", str(cfg_path),
+               "--generate", "4", "--prompt_ids", "5,6,7"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "GENERATED TOKENS:" in out
+    toks = [int(t) for t in
+            out.split("GENERATED TOKENS:")[1].split("*")[0].strip().split(",")]
+    assert len(toks) == 4
