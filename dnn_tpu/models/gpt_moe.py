@@ -164,21 +164,18 @@ def make_apply_ep(cfg: GPTMoEConfig, mesh, *, axis_name: str = EXPERT_AXIS,
     if cfg.n_experts % n:
         raise ValueError(f"n_experts={cfg.n_experts} not divisible by axis size {n}")
 
-    moe_spec = {"router": {"kernel": P()},
-                "wi": P(None, axis_name), "bi": P(None, axis_name),
-                "wo": P(None, axis_name), "bo": P(None, axis_name)}
-    block_spec = {
-        "ln_1": {"scale": P(), "bias": P()},
-        "attn": {"qkv": {"kernel": P(), "bias": P()},
-                 "proj": {"kernel": P(), "bias": P()}},
-        "ln_2": {"scale": P(), "bias": P()},
-        "moe": moe_spec,
-    }
-    param_specs = {
-        "wte": {"embedding": P()}, "wpe": {"embedding": P()},
-        "ln_f": {"scale": P(), "bias": P()}, "lm_head": {"kernel": P()},
-        "blocks": block_spec,  # stacked: leading L axis, E axis shifted by 1
-    }
+    def _spec_for(path, leaf):
+        # derived from the ACTUAL pytree (same approach as
+        # llama_moe.make_apply_ep), so int8-quantized trees — expert
+        # *_scale leaves, {q, scale} attention linears — shard correctly
+        # instead of tripping a hardcoded-structure mismatch. Only the
+        # expert stacks shard (stacked blocks carry a leading L, so E is
+        # axis 1); the router and everything else replicate.
+        keys = [p.key for p in path if hasattr(p, "key")]
+        if "moe" in keys and keys and keys[-1] in (
+                "wi", "wo", "bi", "bo", "wi_scale", "wo_scale"):
+            return P(None, axis_name)
+        return P()
 
     def local_fn(prep_local, ids_local):
         x = gpt.embed(prep_local, ids_local, cfg=cfg)
@@ -213,6 +210,7 @@ def make_apply_ep(cfg: GPTMoEConfig, mesh, *, axis_name: str = EXPERT_AXIS,
         else:
             prepared = {k: v for k, v in params.items() if not k.startswith("h_")}
             prepared["blocks"] = gpt.stack_blocks(params, range(cfg.n_layer))
+        param_specs = jax.tree_util.tree_map_with_path(_spec_for, prepared)
         return jax.shard_map(
             local_fn, mesh=mesh,
             in_specs=(param_specs, P(axis_name)),

@@ -164,8 +164,14 @@ def quantize_tree(params, *, should_quantize: Optional[Callable] = None,
             # with its leading L — quantize_tensor's axis=-2 scale is
             # per-(..., channel) either way.
             for ks in (("wi", "wo"), ("wg", "wu", "wd")):
+                # dtype/scale guards make this IDEMPOTENT like the
+                # kernel->q rename: re-quantizing an int8 stack would
+                # overwrite its real scales with ~1.0 (amax of int8
+                # values) and silently corrupt the model
                 if all(k in node and hasattr(node[k], "ndim")
-                       and node[k].ndim in (3, 4) for k in ks):
+                       and node[k].ndim in (3, 4)
+                       and node[k].dtype != jnp.int8
+                       and (k + "_scale") not in node for k in ks):
                     out = {k: walk(v, f"{path}/{k}")
                            for k, v in node.items() if k not in ks}
                     for kk in ks:

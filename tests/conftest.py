@@ -33,6 +33,25 @@ def _cpu_mesh_guard():
     assert len(jax.devices()) >= 8, f"expected >=8 virtual devices, got {jax.devices()}"
 
 
+@pytest.fixture(scope="module", autouse=True)
+def _drop_compile_caches_between_modules():
+    """Free each module's compiled executables when it finishes.
+
+    A single pytest process otherwise accumulates every jitted program
+    of ~500 tests (plus the device buffers their closures pin); late in
+    the run an XLA CPU compile can then die with a hard SIGSEGV inside
+    backend_compile_and_load — observed reproducibly at ~85% of the
+    suite, while the same test passes in isolation. Clearing BETWEEN
+    modules (never within) keeps intra-module contracts intact — e.g.
+    the serving tests' jit-cache-size regression checks — at the cost of
+    recompiling tiny shared helpers per module."""
+    yield
+    import gc
+
+    jax.clear_caches()
+    gc.collect()
+
+
 @pytest.fixture(scope="session")
 def devices():
     return jax.devices()

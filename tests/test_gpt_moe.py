@@ -124,3 +124,23 @@ def test_ep_accepts_prepared_params(moe_setup):
     raw = np.asarray(ep(params, ids))
     prepped = np.asarray(ep(prepare_stacked(params, cfg), ids))
     np.testing.assert_array_equal(raw, prepped)
+
+
+def test_ep_int8_expert_stacks():
+    """EP over a quantize_tree'd GPT-MoE tree: the pytree-derived spec
+    shards the wi/wo stacks AND their scale leaves — parity with the
+    grouped dense forward on the quantized params."""
+    from dnn_tpu import quant
+    from dnn_tpu.parallel.mesh import EXPERT_AXIS, make_mesh
+
+    cfg = gpt_moe.PRESETS["gpt2-moe-test"]
+    n = min(4, cfg.n_experts)
+    mesh = make_mesh({EXPERT_AXIS: n}, jax.devices()[:n])
+    params = gpt_moe.init(jax.random.PRNGKey(30), cfg)
+    q = quant.quantize_tree(params)
+    assert q["h_0"]["moe"]["wi"].dtype == jnp.int8
+    ids = np.random.RandomState(31).randint(0, cfg.vocab_size, (n, 8))
+    want = np.asarray(gpt_moe.make_apply(cfg, groups=n)(
+        q, jnp.asarray(ids)))
+    got = np.asarray(gpt_moe.make_apply_ep(cfg, mesh)(q, jnp.asarray(ids)))
+    np.testing.assert_allclose(got, want, atol=2e-5, rtol=2e-5)

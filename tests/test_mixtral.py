@@ -256,3 +256,21 @@ def test_int8_expert_stacks():
     got = np.asarray(llama_moe.make_apply_ep(CFG, mesh)(
         q, jnp.asarray(np.tile(ids, (2, 1)))))
     np.testing.assert_allclose(got, want, atol=2e-5, rtol=2e-5)
+
+
+def test_quantize_tree_idempotent_on_expert_stacks():
+    """Re-quantizing an already-int8 tree must be a no-op — without the
+    dtype/scale guard it would overwrite the real expert scales with
+    ~1.0 (amax of int8) and silently corrupt the model."""
+    from dnn_tpu import quant
+
+    p = _params(seed=20)
+    q1 = quant.quantize_tree(p)
+    q2 = quant.quantize_tree(q1)
+    s1 = q1["h_0"]["moe"]["wg_scale"]
+    s2 = q2["h_0"]["moe"]["wg_scale"]
+    np.testing.assert_array_equal(np.asarray(s1), np.asarray(s2))
+    ids = np.random.RandomState(21).randint(0, CFG.vocab_size, (1, 8))
+    np.testing.assert_array_equal(
+        np.asarray(llama_moe.make_apply(CFG)(q1, jnp.asarray(ids))),
+        np.asarray(llama_moe.make_apply(CFG)(q2, jnp.asarray(ids))))
