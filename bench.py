@@ -135,6 +135,47 @@ def _backend_alive(deadlines_s=(90.0, 180.0, 300.0),
     return False
 
 
+def _last_good_tpu_reference(path=None):
+    """The most recent COMMITTED on-chip headline from benchmarks/
+    RESULTS.md, or None. Round-4 lesson: the chip answered the builder's
+    session and wedged before the driver's, so BENCH_r04.json carried
+    only the CPU fallback even though an on-chip table existed from hours
+    earlier. When the probe ladder exhausts, this echo rides along on the
+    fallback row (labeled, provenance-stamped — never mixed into the
+    fresh measurement) so a wedged-chip round still surfaces a
+    TPU-credible number."""
+    import os
+    import re
+
+    if path is None:
+        path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "benchmarks", "RESULTS.md")
+    try:
+        with open(path) as f:
+            text = f.read()
+    except OSError:
+        return None
+    head = re.search(r"Generated at commit `([^`]+)` on ([^;]+); "
+                     r"device-section platform: ([^.\n]+)", text)
+    if not head or "tpu" not in head.group(3):
+        return None  # no on-chip table to echo
+    row = re.search(r"\| gpt2_fwd \| tokens_per_sec \| ([0-9.]+) \| "
+                    r"([0-9.]+%|—) \| tpu \|", text)
+    if not row:
+        return None
+    ref = {
+        "metric": "gpt2_fwd_tokens_per_sec_per_chip",
+        "value": float(row.group(1)),
+        "commit": head.group(1),
+        "date": head.group(2).strip(),
+        "note": "last committed on-chip measurement (benchmarks/"
+                "RESULTS.md), NOT measured this run",
+    }
+    if row.group(2) != "—":
+        ref["mfu"] = round(float(row.group(2).rstrip("%")) / 100, 4)
+    return ref
+
+
 def main():
     fell_back = not _backend_alive()
     if fell_back:
@@ -176,6 +217,12 @@ def main():
     row["platform"] = jax.default_backend()
     if fell_back:
         row["note"] = "default backend unresponsive; CPU fallback"
+    if on_cpu:
+        # a CPU-substrate round still surfaces the last committed on-chip
+        # headline (distinctly labeled) so no round ships perf-blind
+        ref = _last_good_tpu_reference()
+        if ref is not None:
+            row["stale_tpu_reference"] = ref
     print(json.dumps(row))
 
 

@@ -105,7 +105,16 @@ def hf_vocab_bytes(tok, vocab_size: Optional[int] = None) -> List[bytes]:
     constraint engine — a grammar can never need them; EOS is handled
     separately by mask_row). Pass the MODEL's `vocab_size` when its
     embedding table is padded past the tokenizer vocab — the padding ids
-    map to b""."""
+    map to b"".
+
+    Known best-effort divergence (SentencePiece): '▁' is mapped to a
+    space UNCONDITIONALLY, but SP detokenization strips the leading
+    space of the FIRST piece — so a '▁'-prefixed token at position 0
+    contributes b" x..." here while the decoded text starts with "x...".
+    A grammar anchored at string start therefore cannot be satisfied by
+    '▁'-prefixed first tokens even when the decoded text would match;
+    write such grammars to tolerate one leading space (e.g. prefix with
+    ' ?'), or serve byte-level vocabs where the map is exact."""
     vocab = tok.get_vocab()  # {token_string: id}
     size = vocab_size or max(vocab.values()) + 1
     out = [b""] * size

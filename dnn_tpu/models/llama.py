@@ -225,8 +225,14 @@ def layer_windows(cfg: LlamaConfig):
     (L,) int32, cfg.sliding_window on EVEN layers, block_size (a vacuous
     band bound — positions never reach it) on ODD/global layers. None for
     uniform-attention configs, which keep the static codec window."""
-    if not (cfg.alt_window and cfg.sliding_window is not None):
+    if not cfg.alt_window:
         return None
+    if cfg.sliding_window is None:
+        # silently returning None would make every layer attend globally —
+        # a misconfigured Gemma-2-style preset must fail loudly, not degrade
+        raise ValueError(
+            "alt_window=True requires sliding_window to be set: alternating "
+            "window/global layers need a window width for the even layers")
     return jnp.asarray(
         [cfg.sliding_window if i % 2 == 0 else cfg.block_size
          for i in range(cfg.n_layer)], jnp.int32)

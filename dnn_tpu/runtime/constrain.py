@@ -479,8 +479,10 @@ class TokenConstraint:
     -> (V,) f32 additive row (0 allowed / -1e30 banned) with EOS allowed
     exactly in accepting states. The eos override assumes eos_id is a
     SPECIAL token the grammar can never consume — the serving layer
-    rejects submissions where `allowed[:, eos_id]` is true anywhere
-    (ContinuousBatcher.submit)."""
+    rejects submissions where `allowed[:, eos_id]` is true in any
+    REACHABLE state (ContinuousBatcher.submit; `reachable` below —
+    states only enterable mid-token can never host a decode step, so
+    eos aliasing there is harmless)."""
 
     def __init__(self, dfa: Dfa, vocab: Sequence[bytes]):
         self.dfa = dfa
@@ -489,6 +491,28 @@ class TokenConstraint:
         self.allowed = self.table >= 0  # (S, V) bool
         self.accepting = dfa.accepting
         self.start = 0
+        self._reachable: Optional[np.ndarray] = None
+
+    @property
+    def reachable(self) -> np.ndarray:
+        """(S,) bool: states reachable from start via TOKEN transitions.
+        The subset construction can mint byte-DFA states no whole token
+        ever lands on; guards that quantify over states (e.g. the serving
+        layer's eos check) must ignore those or they reject grammars on
+        behavior that can never occur."""
+        if self._reachable is None:
+            seen = np.zeros(self.table.shape[0], bool)
+            stack = [self.start]
+            seen[self.start] = True
+            while stack:
+                s = stack.pop()
+                row = self.table[s]
+                for t in np.unique(row[row >= 0]):
+                    if not seen[t]:
+                        seen[t] = True
+                        stack.append(int(t))
+            self._reachable = seen
+        return self._reachable
 
     @classmethod
     def from_regex(cls, pattern: str, vocab: Sequence[bytes]

@@ -652,10 +652,19 @@ class ContinuousBatcher:
                     f"{constraint.vocab_size} != model vocab "
                     f"{self.cfg.vocab_size}")
             if (self.eos_id is not None
-                    and constraint.allowed[:, self.eos_id].any()):
+                    and constraint.allowed[constraint.reachable,
+                                           self.eos_id].any()):
                 # the eos override in mask_row would ban a byte token the
                 # grammar NEEDS (and an emitted one would retire as "eos"
-                # mid-match) — fail fast instead of either wrong behavior
+                # mid-match) — fail fast instead of either wrong behavior.
+                # Quantified over REACHABLE states only: multi-byte (BPE)
+                # tokens can jump OVER byte-DFA states, leaving states no
+                # token path ever enters — eos aliasing confined to those
+                # is harmless. (On single-byte vocabs every state is token
+                # -reachable and the quantifier changes nothing: a byte
+                # vocab whose eos_id is a grammar-consumable byte is still
+                # rejected — use an eos outside the grammar's alphabet,
+                # e.g. below ByteTokenizer's offset.)
                 raise ValueError(
                     f"eos_id {self.eos_id} maps to bytes this constraint's "
                     "grammar can consume; serve constrained requests with "

@@ -1,0 +1,53 @@
+"""Round-trip pin between run_all.write_results_md and bench.py's
+stale-TPU echo parser: the echo scrapes RESULTS.md, so any format drift
+in the writer must break THIS test, not silently return None and ship a
+perf-blind round (the exact failure the echo exists to prevent)."""
+
+import importlib.util
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load(name, rel):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(REPO, rel))
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[name] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+bench = _load("_bench_echo_bench", "bench.py")
+run_all = _load("_bench_echo_run_all", os.path.join("benchmarks",
+                                                    "run_all.py"))
+
+
+def test_echo_round_trips_write_results_md(tmp_path):
+    rows = [
+        {"config": "cifar_cnn_fwd", "metric": "images_per_sec",
+         "value": 100.0, "platform": "tpu", "batch": 1024},
+        {"config": "gpt2_fwd", "metric": "tokens_per_sec",
+         "value": 454770.9, "mfu": 0.614, "platform": "tpu",
+         "batch": 8, "seq": 512},
+    ]
+    path = tmp_path / "RESULTS.md"
+    run_all.write_results_md(rows, str(path))
+
+    ref = bench._last_good_tpu_reference(str(path))
+    assert ref is not None, "echo parser lost the writer's format"
+    assert ref["value"] == 454770.9
+    assert ref["mfu"] == 0.614
+    assert ref["commit"]  # provenance stamp present
+    assert "NOT measured this run" in ref["note"]
+
+
+def test_echo_refuses_cpu_only_tables(tmp_path):
+    """A table whose device section ran on CPU must NOT be echoed as a
+    TPU reference."""
+    rows = [{"config": "gpt2_fwd", "metric": "tokens_per_sec",
+             "value": 1234.5, "platform": "cpu", "batch": 8, "seq": 512}]
+    path = tmp_path / "RESULTS.md"
+    run_all.write_results_md(rows, str(path))
+    assert bench._last_good_tpu_reference(str(path)) is None

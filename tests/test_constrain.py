@@ -85,7 +85,9 @@ def test_token_table_multibyte_tokens():
     assert c.advance(s_a, 1) >= 0      # b continues
     s_abc = c.advance(s, 3)
     assert c.is_accepting(s_abc)
-    assert not c.has_continuation(s_abc) or True  # 'abc' then nothing? b* ended by c
+    # 'abc' consumed the closing c: under ab*c no byte may follow, so no
+    # token can continue from this state
+    assert not c.has_continuation(s_abc)
 
 
 def test_json_regex_accepts_real_json():
@@ -223,6 +225,26 @@ def test_constraint_rejects_grammar_relevant_eos():
     c = TokenConstraint.from_regex(r"[xy]{3}", byte_vocab(CFG.vocab_size))
     with pytest.raises(ValueError, match="eos"):
         srv.submit(np.asarray([1, 2]), max_new_tokens=5, constraint=c)
+
+
+def test_constraint_accepts_eos_aliased_only_in_unreachable_states():
+    """BPE-style multi-byte tokens jump over byte-DFA states; eos bytes
+    consumable ONLY in those token-unreachable states must not trip the
+    submit guard (regression pin for the reachable-quantified check —
+    reverting to `allowed[:, eos_id].any()` breaks this)."""
+    vocab = [b"ab", b"b"] + [b""] * (CFG.vocab_size - 2)
+    c = TokenConstraint.from_regex(r"ab", vocab)
+    # the post-'a' byte state exists (it consumes b"b", token 1) but no
+    # token walk from start lands on it — token b"ab" jumps over it
+    unreachable = ~c.reachable
+    assert c.allowed[unreachable, 1].any()
+    assert not c.allowed[c.reachable, 1].any()
+    srv = _batcher(eos_id=1)
+    rid = srv.submit(np.asarray([3, 4]), max_new_tokens=4, constraint=c)
+    srv.drain()
+    toks = [int(t) for t in srv.results[rid]]
+    assert [t for t in toks if t != 1] == [0]  # b"ab" (eos may trail)
+    assert srv.finish_reasons[rid] in ("eos", "constraint")
 
 
 def test_constraint_composes_with_user_logit_bias():
