@@ -126,6 +126,28 @@ def family_rows(cfg: MixtralConfig, *, compute_dtype=None,
                                  attn_kernel=attn_kernel)
 
 
+def _ep_param_spec(path, leaf, *, axis, stage_axis=None):
+    """PartitionSpec for one param leaf under expert parallelism, derived
+    from the ACTUAL pytree (config variants — attn_bias, post-norms,
+    tied/no-lm_head — shard correctly instead of tripping a hardcoded
+    structure): only the expert stacks shard on their E axis; everything
+    else replicates (or shards over `stage_axis` for pipeline stage
+    blocks, whose leaves carry a leading (S, per_stage, ...) so E sits at
+    index 2)."""
+    from jax.sharding import PartitionSpec as P
+
+    keys = [p.key for p in path if hasattr(p, "key")]
+    expert_leaf = "moe" in keys and keys and keys[-1] in (
+        "wg", "wu", "wd", "wg_scale", "wu_scale", "wd_scale")
+    if stage_axis is not None:
+        if expert_leaf:
+            return P(stage_axis, None, axis)
+        return P(stage_axis)
+    if expert_leaf:
+        return P(None, axis)
+    return P()
+
+
 def make_apply_ep(cfg: MixtralConfig, mesh, *, axis_name: Optional[str] = None,
                   compute_dtype=None):
     """Expert-parallel Mixtral forward over `mesh`'s expert axis — the
@@ -173,32 +195,14 @@ def make_apply_ep(cfg: MixtralConfig, mesh, *, axis_name: Optional[str] = None,
         return llama.head(prep_local, x.astype(jnp.float32), cfg=cfg,
                           compute_dtype=compute_dtype)
 
-    def _spec_for(path, leaf):
-        # derived from the ACTUAL pytree, so config variants the init
-        # supports (attn_bias leaves, post-norms, tied/no-lm_head) shard
-        # correctly instead of tripping a hardcoded-structure mismatch:
-        # only the expert stacks shard (stacked blocks carry a leading L,
-        # so E is axis 1); everything else replicates
-        keys = [p.key for p in path if hasattr(p, "key")]
-        if "moe" in keys and keys and keys[-1] in (
-                "wg", "wu", "wd",
-                "wg_scale", "wu_scale", "wd_scale"):  # int8 stacks
-            return P(None, axis)
-        return P()
-
     def apply(params, ids):
         b = ids.shape[0]
         if b % n:
             raise ValueError(
                 f"batch {b} not divisible by expert-axis size {n}")
-        if "blocks" in params:
-            prepared = params
-        else:
-            prepared = {k: v for k, v in params.items()
-                        if not k.startswith("h_")}
-            prepared["blocks"] = gpt.stack_blocks(params,
-                                                  range(cfg.n_layer))
-        param_specs = jax.tree_util.tree_map_with_path(_spec_for, prepared)
+        prepared = _as_prepared(params, cfg)
+        param_specs = jax.tree_util.tree_map_with_path(
+            lambda p, leaf: _ep_param_spec(p, leaf, axis=axis), prepared)
         return jax.shard_map(
             local_fn, mesh=mesh,
             in_specs=(param_specs, P(axis)),
@@ -207,6 +211,285 @@ def make_apply_ep(cfg: MixtralConfig, mesh, *, axis_name: Optional[str] = None,
         )(prepared, ids)
 
     return apply
+
+
+def _as_prepared(params, cfg):
+    """Accept either the raw h_i layout or the stacked-blocks layout."""
+    if "blocks" in params:
+        return params
+    prepared = {k: v for k, v in params.items() if not k.startswith("h_")}
+    prepared["blocks"] = gpt.stack_blocks(params, range(cfg.n_layer))
+    return prepared
+
+
+def make_generate_ep(cfg: MixtralConfig, mesh, *, max_new_tokens: int,
+                     temperature: float = 0.0,
+                     sample_top_k: Optional[int] = None,
+                     compute_dtype=None, kv_dtype=None,
+                     axis_name: Optional[str] = None):
+    """Expert-parallel Mixtral KV-cache generation over `mesh`'s expert
+    axis — the serving form of make_apply_ep: the WHOLE generate (prefill
+    + lax.scan decode) is one shard_map program; batch and its KV cache
+    shard over the expert axis (each device's local batch is its routing
+    group, so the cache lives with the tokens it serves), expert stacks
+    shard on E, and tokens reach their experts via all_to_all inside
+    every prefill and decode-step forward
+    (parallel/moe.moe_ffn_local).
+
+    generate(params, ids, rng): ids (B, T), B divisible by the axis size.
+    Greedy output equals the solo decoder with `make_ffn(cfg,
+    groups=axis_size)` token-for-token (same per-column routing groups —
+    the GPT-MoE family's EP parity contract, generate_moe.py, extended to
+    this family); sampled output folds the device index into the rng
+    stream, matching in distribution rather than draw-for-draw."""
+    from jax import lax
+    from jax.sharding import PartitionSpec as P
+
+    from dnn_tpu.parallel.mesh import EXPERT_AXIS
+    from dnn_tpu.parallel.moe import moe_capacity, moe_ffn_local
+    from dnn_tpu.runtime.generate import _sample
+
+    if max_new_tokens < 1:
+        raise ValueError(f"max_new_tokens must be >= 1, got {max_new_tokens}")
+    axis = axis_name or EXPERT_AXIS
+    n = mesh.shape[axis]
+    if cfg.n_expert % n:
+        raise ValueError(
+            f"n_expert={cfg.n_expert} not divisible by axis size {n}")
+
+    def per_device(prep_local, ids_local, rng):
+        b, t = ids_local.shape  # local batch = this device's routing group
+        s_max = t + max_new_tokens
+        cache_dtype = kv_dtype if kv_dtype is not None else (
+            compute_dtype or jnp.float32)
+        cache = llama.init_cache(cfg, b, s_max, cache_dtype)
+
+        def ffn_for(tokens_per_group):
+            capacity = moe_capacity(tokens_per_group, cfg.n_expert,
+                                    cfg.router_top_k, cfg.capacity_factor)
+
+            def ffn(bp, h):
+                d = h.shape[-1]
+                return moe_ffn_local(
+                    bp["moe"], h.reshape(-1, d), top_k=cfg.router_top_k,
+                    capacity=capacity, axis_name=axis,
+                    compute_dtype=compute_dtype,
+                ).reshape(h.shape).astype(h.dtype)
+
+            return ffn
+
+        logits, cache = llama.forward_with_cache(
+            prep_local, ids_local, cache, 0, cfg=cfg,
+            compute_dtype=compute_dtype, ffn=ffn_for(b * t))
+        rng = jax.random.fold_in(rng, lax.axis_index(axis))
+        rng, sub = jax.random.split(rng)
+        tok = _sample(logits[:, -1], sub, temperature=temperature,
+                      top_k=sample_top_k)
+        step_ffn = ffn_for(b)
+
+        def step(carry, i):
+            cache, tok, rng = carry
+            logits, cache = llama.forward_with_cache(
+                prep_local, tok[:, None], cache, t + i, cfg=cfg,
+                compute_dtype=compute_dtype, ffn=step_ffn)
+            rng, sub = jax.random.split(rng)
+            nxt = _sample(logits[:, -1], sub, temperature=temperature,
+                          top_k=sample_top_k)
+            return (cache, nxt, rng), tok
+
+        (_, last, _), toks = lax.scan(
+            step, (cache, tok, rng), jnp.arange(max_new_tokens - 1))
+        toks = jnp.moveaxis(toks, 0, 1)
+        return jnp.concatenate([toks, last[:, None]], axis=1)
+
+    @jax.jit
+    def generate(params, ids, rng):
+        b, t = ids.shape
+        if b % n:
+            raise ValueError(
+                f"batch {b} not divisible by expert-axis size {n}")
+        if t + max_new_tokens > cfg.block_size:
+            raise ValueError(
+                f"prompt {t} + max_new_tokens {max_new_tokens} exceeds "
+                f"block_size {cfg.block_size}")
+        prepared = _as_prepared(params, cfg)
+        param_specs = jax.tree_util.tree_map_with_path(
+            lambda p, leaf: _ep_param_spec(p, leaf, axis=axis), prepared)
+        return jax.shard_map(
+            per_device, mesh=mesh,
+            in_specs=(param_specs, P(axis), P()),
+            out_specs=P(axis),
+            check_vma=False,
+        )(prepared, ids, rng)
+
+    return generate
+
+
+def make_pipeline_generate_ep(cfg: MixtralConfig, mesh, *,
+                              max_new_tokens: int,
+                              temperature: float = 0.0,
+                              sample_top_k: Optional[int] = None,
+                              compute_dtype=None, kv_dtype=None,
+                              stage_axis: Optional[str] = None,
+                              expert_axis: Optional[str] = None):
+    """EP x PP 2D Mixtral decode over a {stage, expert} mesh — the llama
+    -family mirror of generate_moe.make_pipeline_generate_moe_ep: layers
+    shard over the STAGE axis (the ppermute decode ring, KV-head-width
+    stage cache shards), each stage's expert stacks shard over the EXPERT
+    axis, tokens reach their experts via all_to_all WITHIN the stage row
+    while the hidden state rides the stage ring — both collectives per
+    decode step, each on its own mesh axis.
+
+    generate(stage_blocks, aux, ids, rng): `stage_blocks` from
+    runtime.generate.prepare_pipeline_stacked (expert leaves are
+    re-placed over the expert axis here); ids (B, T), B divisible by the
+    expert-axis size. Greedy output equals the solo decoder with
+    `make_ffn(cfg, groups=n_exp)` token-for-token.
+
+    Same deliberate schedule duplication as the GPT EP x PP decoder (see
+    generate_moe.py's NOTE): the capacity-dependent ffn (one compiled
+    program for the prefill chunk, another for decode steps) cannot ride
+    the one-block-function family-adapter protocol."""
+    from jax import lax
+    from jax.sharding import NamedSharding
+    from jax.sharding import PartitionSpec as P
+
+    from dnn_tpu.parallel.mesh import EXPERT_AXIS, STAGE_AXIS
+    from dnn_tpu.parallel.moe import moe_capacity, moe_ffn_local
+    from dnn_tpu.runtime.generate import _sample
+    from dnn_tpu.runtime.kvcache import codec_for_cache
+
+    if max_new_tokens < 1:
+        raise ValueError(f"max_new_tokens must be >= 1, got {max_new_tokens}")
+    if cfg.alt_window:
+        raise ValueError(
+            "alternating-window configs are not supported on the pipeline "
+            "decode path (no per-layer window channel in the stage scan)")
+    s_axis = stage_axis or STAGE_AXIS
+    e_axis = expert_axis or EXPERT_AXIS
+    num_stages = mesh.shape[s_axis]
+    n_exp = mesh.shape[e_axis]
+    if cfg.n_layer % num_stages:
+        raise ValueError(
+            f"n_layer {cfg.n_layer} not divisible by {num_stages} stages")
+    if cfg.n_expert % n_exp:
+        raise ValueError(
+            f"n_expert {cfg.n_expert} not divisible by expert axis {n_exp}")
+    per_stage = cfg.n_layer // num_stages
+    perm = [(i, (i + 1) % num_stages) for i in range(num_stages)]
+
+    def _place(stage_blocks):
+        specs = jax.tree_util.tree_map_with_path(
+            lambda p, leaf: _ep_param_spec(p, leaf, axis=e_axis,
+                                           stage_axis=s_axis), stage_blocks)
+        return jax.device_put(
+            stage_blocks,
+            jax.tree.map(lambda s: NamedSharding(mesh, s), specs,
+                         is_leaf=lambda x: isinstance(x, P)),
+        ), specs
+
+    def per_device(stage_blocks, aux, ids_local, rng):
+        local = jax.tree.map(lambda p: p[0], stage_blocks)  # (per, ...)
+        d = lax.axis_index(s_axis)
+        b, t = ids_local.shape  # local batch = this expert column's group
+        s_max = t + max_new_tokens
+        cache_dtype = kv_dtype if kv_dtype is not None else (
+            compute_dtype or jnp.float32)
+        stage_cfg = dataclasses.replace(cfg, n_layer=per_stage)
+        cache = llama.init_cache(stage_cfg, b, s_max, cache_dtype)
+        codec = codec_for_cache(cache, window=cfg.sliding_window,
+                                softcap=cfg.attn_softcap)
+
+        def ffn_for(tokens_per_group):
+            capacity = moe_capacity(tokens_per_group, cfg.n_expert,
+                                    cfg.router_top_k, cfg.capacity_factor)
+
+            def ffn(bp, h):
+                dd = h.shape[-1]
+                return moe_ffn_local(
+                    bp["moe"], h.reshape(-1, dd), top_k=cfg.router_top_k,
+                    capacity=capacity, axis_name=e_axis,
+                    compute_dtype=compute_dtype,
+                ).reshape(h.shape).astype(h.dtype)
+
+            return ffn
+
+        def ring_pass(x, cache, start_pos, ffn):
+            def sub(carry, s):
+                h, cache = carry
+
+                def layer(carry2, layer_in):
+                    bp, layer_cache = layer_in
+                    return llama._block_with_cache(
+                        bp, carry2, layer_cache, start_pos, cfg=cfg,
+                        compute_dtype=compute_dtype, codec=codec, ffn=ffn)
+
+                h2, cache2 = lax.scan(layer, h, (local, cache))
+                active = d == s
+                cache = jax.tree.map(
+                    lambda new, old: jnp.where(active, new, old),
+                    cache2, cache)
+                h = lax.ppermute(h2, s_axis, perm)
+                return (h, cache), None
+
+            (h, cache), _ = lax.scan(sub, (x, cache), jnp.arange(num_stages))
+            return h, cache
+
+        def sample_last(h, sub_rng):
+            logits = llama.head(aux, h[:, -1:].astype(jnp.float32), cfg=cfg,
+                                compute_dtype=compute_dtype)
+            tok = _sample(logits[:, -1], sub_rng, temperature=temperature,
+                          top_k=sample_top_k)
+            return lax.psum(
+                jnp.where(d == 0, tok, jnp.zeros_like(tok)), s_axis)
+
+        rng = jax.random.fold_in(rng, lax.axis_index(e_axis))
+        x = llama._scaled_embed(aux, ids_local, cfg)
+        if compute_dtype is not None:
+            x = x.astype(compute_dtype)
+        h, cache = ring_pass(x, cache, 0, ffn_for(b * t))
+        rng, sub = jax.random.split(rng)
+        tok = sample_last(h, sub)
+        step_ffn = ffn_for(b)
+
+        def step(carry, i):
+            cache, tok, rng = carry
+            x = llama._scaled_embed(aux, tok[:, None], cfg)
+            if compute_dtype is not None:
+                x = x.astype(compute_dtype)
+            h, cache = ring_pass(x, cache, t + i, step_ffn)
+            rng, sub = jax.random.split(rng)
+            nxt = sample_last(h, sub)
+            return (cache, nxt, rng), tok
+
+        (_, last, _), toks = lax.scan(
+            step, (cache, tok, rng), jnp.arange(max_new_tokens - 1))
+        toks = jnp.moveaxis(toks, 0, 1)
+        return jnp.concatenate([toks, last[:, None]], axis=1)
+
+    compiled = {}  # one jitted program per param-tree structure
+
+    def generate(stage_blocks, aux, ids, rng):
+        b, t = ids.shape
+        if b % n_exp:
+            raise ValueError(
+                f"batch {b} not divisible by expert-axis size {n_exp}")
+        if t + max_new_tokens > cfg.block_size:
+            raise ValueError(
+                f"prompt {t} + max_new_tokens {max_new_tokens} exceeds "
+                f"block_size {cfg.block_size}")
+        placed, specs = _place(stage_blocks)
+        key = jax.tree_util.tree_structure(stage_blocks)
+        if key not in compiled:
+            compiled[key] = jax.jit(jax.shard_map(
+                per_device, mesh=mesh,
+                in_specs=(specs, P(), P(e_axis), P()),
+                out_specs=P(e_axis),
+                check_vma=False,
+            ))
+        return compiled[key](placed, aux, ids, rng)
+
+    return generate
 
 
 # --------------------------------------------------------------------------

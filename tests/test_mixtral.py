@@ -188,6 +188,60 @@ def test_ep_matches_grouped_dense():
         llama_moe.make_apply_ep(CFG, mesh)(p, jnp.asarray(ids[:3]))
 
 
+def test_ep_decode_matches_solo_grouped():
+    """EP KV-cache generation == the solo decoder with matching routing
+    groups, token-for-token (greedy) — the GPT-MoE family's EP decode
+    parity contract (tests/test_generate_moe.py) extended to Mixtral."""
+    from dnn_tpu.models import llama
+    from dnn_tpu.parallel.mesh import EXPERT_AXIS, make_mesh
+
+    n = 4
+    mesh = make_mesh({EXPERT_AXIS: n}, jax.devices()[:n])
+    p = _params(seed=16)
+    prepared = gpt.prepare_stacked(p, CFG)
+    prompt = np.random.RandomState(17).randint(0, CFG.vocab_size, (n * 2, 6))
+    n_new = 5
+    want = np.asarray(llama.make_generate(
+        CFG, max_new_tokens=n_new, ffn=llama_moe.make_ffn(CFG, groups=n))(
+        prepared, jnp.asarray(prompt), jax.random.PRNGKey(18)))
+    got = np.asarray(llama_moe.make_generate_ep(
+        CFG, mesh, max_new_tokens=n_new)(
+        prepared, jnp.asarray(prompt), jax.random.PRNGKey(18)))
+    np.testing.assert_array_equal(got, want)
+
+    with pytest.raises(ValueError, match="divisible"):
+        llama_moe.make_generate_ep(CFG, mesh, max_new_tokens=2)(
+            prepared, jnp.asarray(prompt[:3]), jax.random.PRNGKey(0))
+
+
+def test_ep_pp_decode_matches_solo_grouped():
+    """EP x PP 2D Mixtral decode ({stage, expert} mesh: all_to_all expert
+    dispatch inside every stage-ring sub-step) == the solo decoder with
+    matching routing groups, token-for-token."""
+    from dnn_tpu.models import llama
+    from dnn_tpu.parallel.mesh import EXPERT_AXIS, STAGE_AXIS, make_mesh
+    from dnn_tpu.runtime.generate import prepare_pipeline_stacked
+
+    stages, n_exp = 3, 2  # n_layer=3 stages x 2 expert columns
+    assert CFG.n_layer % stages == 0 and CFG.n_expert % n_exp == 0
+    mesh = make_mesh({STAGE_AXIS: stages, EXPERT_AXIS: n_exp},
+                     jax.devices()[:stages * n_exp])
+    p = _params(seed=19)
+    prepared = gpt.prepare_stacked(p, CFG)
+    stage_blocks, aux = prepare_pipeline_stacked(prepared, CFG, mesh)
+    prompt = np.random.RandomState(20).randint(0, CFG.vocab_size,
+                                               (n_exp * 2, 6))
+    n_new = 5
+    want = np.asarray(llama.make_generate(
+        CFG, max_new_tokens=n_new,
+        ffn=llama_moe.make_ffn(CFG, groups=n_exp))(
+        prepared, jnp.asarray(prompt), jax.random.PRNGKey(21)))
+    got = np.asarray(llama_moe.make_pipeline_generate_ep(
+        CFG, mesh, max_new_tokens=n_new)(
+        stage_blocks, aux, jnp.asarray(prompt), jax.random.PRNGKey(21)))
+    np.testing.assert_array_equal(got, want)
+
+
 def test_ep_handles_config_variants():
     """The EP spec derives from the real pytree: a q/k/v-biased Mixtral
     variant (extra bias leaves) shards and matches the grouped dense
