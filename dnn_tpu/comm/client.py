@@ -274,5 +274,49 @@ class NodeClient:
                        adapter, min_p, repetition_penalty, logit_bias)
         return self.send_message(rid, prompt, timeout=timeout)
 
+    def generate_text_stream(
+        self,
+        prompt: str,
+        tokenizer,
+        *,
+        max_new_tokens: int = 32,
+        seed: Optional[int] = None,
+        temperature: Optional[float] = None,
+        top_k: Optional[int] = None,
+        top_p: Optional[float] = None,
+        min_p: Optional[float] = None,
+        repetition_penalty: Optional[float] = None,
+        logit_bias: Optional[dict] = None,
+        adapter: Optional[int] = None,
+        timeout: float = 120.0,
+    ):
+        """Streaming TEXT client: encode the prompt with `tokenizer`
+        (which must match the daemon's — the ids ride GenerateStream),
+        yield UTF-8-safe text chunks as tokens commit. A multi-byte
+        character split across BPE pieces is held until complete
+        (io/tokenizer.stream_detokenizer), so the concatenation of the
+        yielded chunks equals the one-shot decode of the full stream
+        byte-for-byte for prefix-monotone tokenizers (ByteTokenizer and
+        this package's HF adapter; see StreamingDetokenizer's docstring
+        for the cleanup-rewriting caveat) — the text form of the serving
+        edge the reference's unary SendTensor could never express
+        (node_service.proto:7). Abandoning the iterator cancels the RPC
+        (frees the server's decode slot), same as generate_stream."""
+        from dnn_tpu.io.tokenizer import stream_detokenizer
+
+        det = stream_detokenizer(tokenizer)
+        for tok in self.generate_stream(
+                tokenizer.encode(prompt), max_new_tokens=max_new_tokens,
+                seed=seed, temperature=temperature, top_k=top_k,
+                top_p=top_p, min_p=min_p,
+                repetition_penalty=repetition_penalty,
+                logit_bias=logit_bias, adapter=adapter, timeout=timeout):
+            chunk = det.push(tok)
+            if chunk:
+                yield chunk
+        tail = det.flush()
+        if tail:
+            yield tail
+
     def close(self):
         self._channel.close()

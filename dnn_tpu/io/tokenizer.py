@@ -24,7 +24,8 @@ from __future__ import annotations
 
 from typing import List, Optional, Sequence
 
-__all__ = ["ByteTokenizer", "hf_vocab_bytes", "load_hf_tokenizer"]
+__all__ = ["ByteTokenizer", "StreamingDetokenizer",
+           "stream_detokenizer", "hf_vocab_bytes", "load_hf_tokenizer"]
 
 
 class ByteTokenizer:
@@ -67,6 +68,127 @@ class ByteTokenizer:
         return [bytes([i - self.offset])
                 if self.offset <= i < self.offset + 256 else b""
                 for i in range(size)]
+
+
+def _utf8_complete_prefix(b) -> int:
+    """Length of the longest prefix of `b` that ends on a UTF-8 sequence
+    boundary — the split point at which chunked decoding equals whole
+    -buffer decoding. Only a trailing INCOMPLETE sequence (a lead byte
+    still waiting for continuation bytes) is held back; orphan
+    continuation bytes and invalid leads can never become valid later,
+    so they flow through (decoded to U+FFFD, exactly as a one-shot
+    decode would)."""
+    n = len(b)
+    i, k = n - 1, 0
+    while i >= 0 and k < 3 and (b[i] & 0xC0) == 0x80:
+        i -= 1
+        k += 1
+    if i < 0:
+        return n  # nothing but continuations — invalid either way
+    lead = b[i]
+    if lead >= 0xF0:
+        need = 4
+    elif lead >= 0xE0:
+        need = 3
+    elif lead >= 0xC0:
+        need = 2
+    else:
+        need = 1  # ASCII or invalid lead — complete at this byte
+    return i if i + need > n else n
+
+
+class _ByteStreamingDetokenizer:
+    """Byte-exact incremental detokenizer for ByteTokenizer streams:
+    O(1) per token, emits only complete UTF-8 sequences (a multi-byte
+    character split across tokens never surfaces as partial garbage).
+    Invariant: ``"".join(push(t) for t) + flush() == tok.decode(ids)``
+    byte-for-byte — pinned in tests/test_tokenizer.py."""
+
+    def __init__(self, tok: "ByteTokenizer"):
+        self._tok = tok
+        self._buf = bytearray()
+
+    def push(self, token_id: int) -> str:
+        j = int(token_id) - self._tok.offset
+        if 0 <= j < 256:
+            self._buf.append(j)
+        else:
+            self._buf += b"\xef\xbf\xbd"  # U+FFFD, as decode() does
+        cut = _utf8_complete_prefix(self._buf)
+        if cut == 0:
+            return ""
+        chunk = bytes(self._buf[:cut]).decode("utf-8", errors="replace")
+        del self._buf[:cut]
+        return chunk
+
+    def flush(self) -> str:
+        chunk = bytes(self._buf).decode("utf-8", errors="replace")
+        self._buf.clear()
+        return chunk
+
+
+class StreamingDetokenizer:
+    """Tokenizer-agnostic incremental detokenizer: works over anything
+    with ``decode(ids) -> str`` (the HF adapter included, whose BPE
+    pieces may be partial UTF-8 sequences).
+
+    Strategy (the HF TextStreamer construction): keep all ids, decode
+    the full stream, emit the text that GREW since the last emission —
+    holding back whenever the decode ends in U+FFFD, because a later
+    token may complete the partial character (a genuine replacement
+    character is released by the next clean decode, or by flush()).
+    Cost is O(n) decode per token (O(n^2) per stream) — bounded by
+    max_new_tokens; use ByteTokenizer's byte-exact streamer (via
+    `stream_detokenizer`) for the O(n) path.
+
+    Invariant: ``"".join(chunks) + flush() == decode(all_ids)`` for any
+    PREFIX-MONOTONE decode (decode(ids + [t]) extends decode(ids)) —
+    true of byte-concatenation decoders (byte-level BPE, ByteTokenizer,
+    this module's HF adapter). A non-monotone decode (e.g. HF
+    clean_up_tokenization_spaces collapsing "word " + "." -> "word.")
+    cannot stream exactly — emitted text can never be retracted; this
+    class detects the prefix violation, stops emitting, and lets
+    flush() emit everything past the longest common prefix (no
+    duplicated characters, possibly a small divergence at the
+    boundary)."""
+
+    def __init__(self, tok):
+        self._tok = tok
+        self._ids: List[int] = []
+        self._done = ""  # text already yielded
+
+    def push(self, token_id: int) -> str:
+        self._ids.append(int(token_id))
+        text = self._tok.decode(self._ids)
+        if text.endswith("�"):
+            return ""  # possibly a split multi-byte piece — wait
+        if not text.startswith(self._done):
+            return ""  # non-monotone decode — hold for flush()
+        chunk = text[len(self._done):]
+        self._done = text
+        return chunk
+
+    def flush(self) -> str:
+        text = self._tok.decode(self._ids)
+        if text.startswith(self._done):
+            chunk = text[len(self._done):]
+        else:
+            n = 0  # longest common prefix with what already went out
+            for a, b in zip(text, self._done):
+                if a != b:
+                    break
+                n += 1
+            chunk = text[n:]
+        self._done = text
+        return chunk
+
+
+def stream_detokenizer(tok):
+    """The right incremental detokenizer for `tok`: byte-exact O(1)/token
+    for ByteTokenizer, decode-diff for everything else."""
+    if isinstance(tok, ByteTokenizer):
+        return _ByteStreamingDetokenizer(tok)
+    return StreamingDetokenizer(tok)
 
 
 def _byte_level_alphabet():

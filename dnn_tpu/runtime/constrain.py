@@ -27,12 +27,14 @@ Design (the outlines/guided-decoding construction, TPU-shaped):
      exactly in accepting states, so a sampled stop always yields a
      complete match.
 
-  Cost note: the per-step host->device traffic is one (V,) f32 row per
-  CONSTRAINED slot per step (~200 KB at GPT-2 vocab). At very large
-  vocab x slot products the scale-up path is keeping the (S, V) allowed
-  table device-resident and indexing it by a per-slot state vector
-  inside the decode program — the table here is already exactly that
-  array, so the jump is mechanical.
+  Cost note: the serving layer keeps each grammar's (S, V) allowed
+  table DEVICE-RESIDENT (uploaded once per grammar into a bool row
+  pool, `mask_table` below) and indexes it with a per-slot DFA-state
+  vector inside the compiled decode program — per-step host->device
+  traffic is one int32 per slot (the state vector), not a (V,) f32 row
+  per constrained slot (~200 KB at GPT-2 vocab, the round-4 design
+  this replaced). The host still walks the DFA (one int per committed
+  token) for finish detection; the device never waits on it.
 
 Bounded-depth JSON ("JSON mode") ships as `json_regex(max_depth)`:
 regular languages cannot nest unboundedly, so the value grammar is
@@ -533,6 +535,16 @@ class TokenConstraint:
         if eos_id is not None:
             row[eos_id] = 0.0 if self.accepting[state] else NEG_BIG
         return row
+
+    def mask_table(self, eos_id: Optional[int]) -> np.ndarray:
+        """(S, V) bool: mask_row's allowed-set for EVERY state at once —
+        the device-resident form (True = allowed; the decode program
+        turns it into 0/-1e30 after a per-slot row gather). EOS column
+        overridden exactly as mask_row does."""
+        tab = self.allowed.copy()
+        if eos_id is not None:
+            tab[:, eos_id] = self.accepting.astype(bool)
+        return tab
 
 
 # ----------------------------------------------------------------------

@@ -350,6 +350,15 @@ class LMServer:
                  request_timeout: float = 120.0, tokenizer=None,
                  draft_cfg=None, draft_prepared=None, spec_k: int = 4,
                  **batcher_kwargs):
+        if (batcher_kwargs.get("allow_constraints")
+                and "constraint_rows" not in batcher_kwargs):
+            # the daemon's JSON mode goes up to depth _MAX_JSON_DEPTH=3,
+            # whose byte DFA has 3519 states — the batcher's device mask
+            # pool must hold it (serving.ContinuousBatcher constraint_
+            # rows; bool bytes = rows x vocab, ~181 MB at GPT-2 vocab).
+            # Operators who never serve deep JSON can pass a smaller
+            # constraint_rows explicitly.
+            batcher_kwargs["constraint_rows"] = 3600
         if draft_cfg is not None:
             # speculative serving: the slot pool advances up to spec_k+1
             # tokens per device step (runtime/serving_spec.py)

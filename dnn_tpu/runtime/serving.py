@@ -54,6 +54,7 @@ from dnn_tpu.ops.attention import merge_heads
 from dnn_tpu.ops.nn import gelu, layer_norm, linear
 from dnn_tpu.runtime.generate import (
     TOP_P_PREFILTER_K,
+    _NEG_BIG,
     _qkv_heads,
     _sample_rows,
     apply_repetition_penalty,
@@ -212,7 +213,8 @@ class ContinuousBatcher:
                  paged_blocks: int = 0, block_len: int = 16,
                  lora_adapters=None, lora_alphas=None,
                  allow_logit_bias: bool = False,
-                 allow_constraints: bool = False):
+                 allow_constraints: bool = False,
+                 constraint_rows: int = 1024):
         self.cfg = cfg
         self.prepared = prepared
         self.slots = slots
@@ -370,18 +372,39 @@ class ContinuousBatcher:
         # buffer alone is tens of MB), so the default programs/memory
         # are unchanged. The LM daemon enables it (its clients choose
         # options per request).
-        # constrained decoding (runtime/constrain.TokenConstraint) rides
-        # the SAME per-slot bias buffer: the host advances each request's
-        # DFA state per committed token and refreshes its row — the
-        # compiled programs never change. allow_constraints therefore
-        # also allocates the buffer; the user-facing logit_bias submit
-        # option stays gated on allow_logit_bias alone.
         self._allow_user_bias = bool(allow_logit_bias)
         self._allow_constraints = bool(allow_constraints)
-        self._allow_bias = self._allow_user_bias or self._allow_constraints
+        self._allow_bias = self._allow_user_bias
         self._bias = (jnp.zeros((slots, cfg.vocab_size), jnp.float32)
                       if self._allow_bias
                       else jnp.zeros((slots, 0), jnp.float32))
+        # constrained decoding (runtime/constrain.TokenConstraint) rides a
+        # DEVICE-RESIDENT mask-table pool: each grammar's (S, V) allowed
+        # table uploads ONCE into `_ctable` (bool rows; row 0 reserved
+        # all-True = unconstrained), and the decode program gathers each
+        # slot's current row by the per-slot state vector `_crow` — the
+        # only per-step host->device constraint traffic is that (slots,)
+        # int32 vector (the host walks the DFA one int per committed
+        # token for finish detection). `constraint_rows` bounds the pool
+        # (bool bytes: rows x vocab — 1024 x 50257 ≈ 51 MB); entries are
+        # refcounted by live slots and evicted LRU when unreferenced.
+        self._ctab_rows = int(constraint_rows) if self._allow_constraints \
+            else 0
+        if self._allow_constraints:
+            if self._ctab_rows < 2:
+                raise ValueError(
+                    f"constraint_rows must be >= 2, got {constraint_rows}")
+            self._ctable = jnp.ones(
+                (self._ctab_rows, cfg.vocab_size), jnp.bool_)
+            from collections import OrderedDict as _OD
+
+            # id(constraint) -> {"off", "n", "refs", "c"} in LRU order
+            self._ctab_entries: dict = _OD()
+        else:
+            self._ctable = jnp.ones((1, 0), jnp.bool_)
+        self._crow_np = np.zeros((slots,), np.int32)
+        self._crow = jnp.asarray(self._crow_np)
+        self._crow_dirty = False
 
         # host bookkeeping
         self._next_rid = 0
@@ -422,11 +445,14 @@ class ContinuousBatcher:
             return chosen_lp, top_lp, top_ids.astype(jnp.int32)
 
         def decode_step(prepared, cache, pos, tok, active, keys,
-                        temp, tk, tp, mp, rep, seen, bias):
+                        temp, tk, tp, mp, rep, seen, bias, crow, ctable):
             """Advance every active slot one token (per-slot sampling
             parameters — see _sample_rows; `rep`/`seen` drive the
             repetition penalty, `mp` the min-p cutoff, `bias` (B, V) the
-            per-slot additive logit bias)."""
+            per-slot additive logit bias, `crow` (B,) the per-slot
+            constraint-table row index into the device-resident bool
+            mask pool `ctable` — row 0 is the reserved all-allowed
+            row, so unconstrained slots add nothing)."""
             logits, new_cache = self.family.decode_rows(
                 prepared, cache, tok, pos, active, codec)
             # repetition penalty on raw logits (HF order: before the
@@ -439,6 +465,8 @@ class ContinuousBatcher:
                 logits, rp_on[:, None] & seen, rep[:, None])
             if self._allow_bias:
                 lg = lg + bias
+            if self._allow_constraints:
+                lg = jnp.where(ctable[crow], lg, _NEG_BIG)
             # advance each slot's own stream; sample each row with its key
             split = jax.vmap(jax.random.split)(keys)  # (B, 2, 2)
             new_keys, subs = split[:, 0], split[:, 1]
@@ -470,20 +498,24 @@ class ContinuousBatcher:
 
         def prefill_finish(cache, row, logits, last_local, slot, rng,
                            temp, tk, tp, mp, rep, seen_row, bias_row,
-                           install_ids):
+                           install_ids, crow, ctable):
             """Sample the first token from the final chunk's true-last
             logit row and install the finished row cache into `slot`.
             `seen_row` (V,) marks the prompt's tokens so the repetition
             penalty applies to the FIRST sample too. `install_ids` (paged
             mode): the per-logical-block physical install targets — shared
             prefix blocks routed to junk block 0 (dense mode receives an
-            empty placeholder)."""
+            empty placeholder). `crow` (scalar) indexes this request's
+            start-state row in the constraint mask pool (0 =
+            unconstrained) so the FIRST token obeys the grammar too."""
             lg = logits[:, last_local][0:1]  # (1, V)
             raw = lg
             lg = apply_repetition_penalty(
                 lg, (rep != 1.0) & seen_row[None, :], rep)
             if self._allow_bias:
                 lg = lg + bias_row[None, :]
+            if self._allow_constraints:
+                lg = jnp.where(ctable[crow][None, :], lg, _NEG_BIG)
             first = _sample_rows(
                 lg, rng[None], temperature=temp[None], top_k=tk[None],
                 top_p=tp[None], min_p=mp[None],
@@ -674,18 +706,22 @@ class ContinuousBatcher:
             b_row = jnp.zeros(
                 (self.cfg.vocab_size if self._allow_bias else 0,),
                 jnp.float32)
-        user_row = None
+        c_off = None
         if constraint is not None:
-            # keep the USER's bias separate: every DFA advance re-adds it
-            # under the fresh grammar mask (one host copy, constrained
-            # requests only)
-            user_row = np.asarray(b_row, np.float32)
-            c_mask = constraint.mask_row(constraint.start, self.eos_id)
-            if not (c_mask == 0.0).any():
+            # a grammar matching ONLY the empty string is legal when eos
+            # can express it (accepting start + eos override): the first
+            # sample is forced to eos and the request retires with a
+            # valid empty match
+            if not (constraint.allowed[constraint.start].any()
+                    or (self.eos_id is not None
+                        and constraint.is_accepting(constraint.start))):
                 raise ValueError(
                     "constraint permits no first token (empty language "
                     "over this vocab)")
-            b_row = jnp.asarray(user_row + c_mask)
+            # upload the grammar's mask table once (pool hit = free);
+            # the user's logit_bias rides self._bias unchanged — the
+            # device composes bias + table row per step
+            c_off = self._ctab_register(constraint)
         tk = min(tk, TOP_P_PREFILTER_K)
         stop_seqs = []
         for s in (stop or []):
@@ -877,6 +913,9 @@ class ContinuousBatcher:
                 seen_row, b_row,
                 install_ids if install_ids is not None
                 else jnp.zeros((0,), jnp.int32),
+                jnp.int32(0 if c_off is None
+                          else c_off + constraint.start),
+                self._ctable,
             )
             if self._paged and put_candidates:
                 # create the block-sharing entries now that the install has
@@ -919,25 +958,26 @@ class ContinuousBatcher:
             if constraint is not None:
                 req["constraint"] = constraint
                 req["c_state"] = constraint.start
-                req["user_bias"] = user_row
+                req["c_off"] = c_off
             if req["logprobs"]:
                 req["lp"] = [float(np.asarray(c_lp)[0])]
                 req["lp_top"] = [(np.asarray(t_ids)[0], np.asarray(t_lp)[0])]
             self._slot_req[slot] = req
             if constraint is not None:
-                row = self._constraint_advance(slot, first)
-                if row is not None:
-                    self._bias = self._bias.at[slot].set(jnp.asarray(row))
+                self._constraint_advance(slot, first)
             self._retire_if_done(slot)
             return rid
         except BaseException:
             # a failure ANYWHERE in the prefill path must return this
             # request's pool blocks (and un-point its table row) or the
-            # pool shrinks permanently on every such failure
+            # pool shrinks permanently on every such failure — same for
+            # its constraint-table reference
             if paged_taken:
                 self._allocator.free(paged_taken)
                 self.cache["tables"] = \
                     self.cache["tables"].at[:, slot].set(0)
+            if c_off is not None:
+                self._ctab_release(constraint)
             raise
 
     def _evict_prefix_entry(self):
@@ -957,31 +997,83 @@ class ContinuousBatcher:
                 return n
         return 0
 
+    def _ctab_register(self, c) -> int:
+        """Place a constraint's (S, V) mask table in the device pool,
+        returning its row offset. A pool hit just bumps the refcount; a
+        miss allocates a gap (evicting LRU unreferenced entries as
+        needed) and uploads the bool table ONCE. Raises when the grammar
+        cannot fit even an empty pool — size `constraint_rows` to the
+        grammar set (json_regex(2) needs ~900 rows)."""
+        key = id(c)
+        e = self._ctab_entries.get(key)
+        if e is not None:
+            e["refs"] += 1
+            self._ctab_entries.move_to_end(key)
+            return e["off"]
+        n = c.table.shape[0]
+        if n > self._ctab_rows - 1:
+            raise ValueError(
+                f"constraint has {n} DFA states but the device mask pool "
+                f"holds {self._ctab_rows - 1} rows — construct the server "
+                f"with constraint_rows >= {n + 1}")
+
+        def _free_gap():
+            # first gap >= n after reserved row 0, between sorted entries
+            taken = sorted((v["off"], v["off"] + v["n"])
+                           for v in self._ctab_entries.values())
+            at = 1
+            for lo, hi in taken:
+                if lo - at >= n:
+                    return at
+                at = max(at, hi)
+            return at if self._ctab_rows - at >= n else None
+
+        off = _free_gap()
+        while off is None:
+            victim = next((k for k, v in self._ctab_entries.items()
+                           if v["refs"] == 0), None)
+            if victim is None:
+                raise ValueError(
+                    f"constraint mask pool exhausted: {n} rows needed, "
+                    f"all {self._ctab_rows} occupied by live requests — "
+                    "construct the server with a larger constraint_rows")
+            del self._ctab_entries[victim]
+            off = _free_gap()
+        self._ctable = self._ctable.at[off:off + n].set(
+            jnp.asarray(c.mask_table(self.eos_id)))
+        self._ctab_entries[key] = {"off": off, "n": n, "refs": 1, "c": c}
+        return off
+
+    def _ctab_release(self, c):
+        e = self._ctab_entries.get(id(c))
+        if e is not None and e["refs"] > 0:
+            e["refs"] -= 1  # entry stays cached for reuse until evicted
+
     def _constraint_advance(self, slot: int, token: int):
-        """Walk a constrained slot's DFA over the token it just committed.
-        Returns the slot's refreshed bias row (np, user bias + new mask)
-        for the caller to install — step() batches all slots' rows into
-        ONE device update, submit() installs its single row directly —
-        or None when no refresh is needed. Sets `c_done` when the match
-        is complete with no possible continuation (retires as
+        """Walk a constrained slot's DFA over the token it just committed
+        and point the slot's device state-row at the new state (the
+        (slots,) int32 vector is flushed once per step — the only
+        per-step host->device constraint traffic). Sets `c_done` when the
+        match is complete with no possible continuation (retires as
         "constraint" — the grammar, not the budget, ended the stream)."""
         req = self._slot_req[slot]
         c = req.get("constraint")
         if c is None or (self.eos_id is not None and token == self.eos_id):
-            return None
+            return
         ns = c.advance(req["c_state"], token)
         if ns < 0:
             # unreachable when masking works (the sampled token was
             # allowed); defensive stop rather than emitting off-grammar
             req["c_done"] = True
-            return None
+            return
         req["c_state"] = ns
         if not c.has_continuation(ns) and (
                 self.eos_id is None or not c.is_accepting(ns)):
             # nothing can extend the match and EOS can't express the stop
             req["c_done"] = True
-            return None
-        return req["user_bias"] + c.mask_row(ns, self.eos_id)
+            return
+        self._crow_np[slot] = req["c_off"] + ns
+        self._crow_dirty = True
 
     def _retire_if_done(self, slot: int):
         req = self._slot_req[slot]
@@ -1013,8 +1105,20 @@ class ContinuousBatcher:
             }
         if req["blocks"]:
             self._allocator.free(req["blocks"])
+        self._release_slot_constraint(slot, req)
         self._slot_req[slot] = None
         self.active = self.active.at[slot].set(False)
+
+    def _release_slot_constraint(self, slot: int, req: dict):
+        """Drop a retiring slot's constraint: refcount down, device
+        state-row back to the reserved all-allowed row 0."""
+        c = req.get("constraint")
+        if c is None:
+            return
+        self._ctab_release(c)
+        if self._crow_np[slot] != 0:
+            self._crow_np[slot] = 0
+            self._crow_dirty = True
 
     def claim(self, rid: int):
         """Pop a finished (or cancelled) request's COMPLETE record —
@@ -1055,6 +1159,7 @@ class ContinuousBatcher:
             if req is not None and req["rid"] == rid:
                 if req["blocks"]:
                     self._allocator.free(req["blocks"])
+                self._release_slot_constraint(slot, req)
                 self._slot_req[slot] = None
                 self.active = self.active.at[slot].set(False)
                 self.finish_reasons[rid] = "cancelled"
@@ -1073,10 +1178,13 @@ class ContinuousBatcher:
         for slots that advanced; finished requests move to .results."""
         if self.n_active == 0:
             return {}
+        if self._crow_dirty:
+            self._crow = jnp.asarray(self._crow_np)
+            self._crow_dirty = False
         res = self._decode(
             self._decode_view, self.cache, self.pos, self.tok, self.active,
             self.keys, self._temp, self._topk, self._topp, self._minp,
-            self._rep, self._seen, self._bias,
+            self._rep, self._seen, self._bias, self._crow, self._ctable,
         )
         if self._logprobs_k:
             (self.cache, self.pos, self.tok, self.keys, self._seen,
@@ -1087,7 +1195,6 @@ class ContinuousBatcher:
             self.cache, self.pos, self.tok, self.keys, self._seen = res
         toks = np.asarray(self.tok)
         out = {}
-        bias_updates = []  # (slot, np row) — flushed as ONE device update
         for slot, req in enumerate(self._slot_req):
             if req is None:
                 continue
@@ -1098,17 +1205,10 @@ class ContinuousBatcher:
                 req["lp_top"].append((t_ids[slot], t_lp[slot]))
             out[req["rid"]] = token
             if "constraint" in req:
-                row = self._constraint_advance(slot, token)
-                if row is not None:
-                    bias_updates.append((slot, row))
+                # host DFA walk updates the (slots,) state vector only;
+                # the mask rows themselves live on device (_ctable)
+                self._constraint_advance(slot, token)
             self._retire_if_done(slot)
-        if bias_updates:
-            # one batched device update per step however many slots are
-            # constrained (a per-slot .at[].set would rebuild the whole
-            # (slots, V) buffer once per slot)
-            idx = jnp.asarray([s for s, _ in bias_updates], jnp.int32)
-            rows = jnp.asarray(np.stack([r for _, r in bias_updates]))
-            self._bias = self._bias.at[idx].set(rows)
         return out
 
     def drain(self) -> Dict[int, np.ndarray]:

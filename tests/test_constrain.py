@@ -258,6 +258,90 @@ def test_constraint_composes_with_user_logit_bias():
     assert bytes(int(t) for t in srv.results[rid]) == b"bbb"
 
 
+def test_empty_string_grammar_serves_empty_match():
+    """A grammar matching ONLY the empty string is legal when eos can
+    express it: the first sample is forced to eos and the request
+    retires with a valid empty match. Without an eos there is no way to
+    express it — rejected."""
+    c = TokenConstraint.from_regex(r"", byte_vocab(CFG.vocab_size))
+    assert not c.allowed[c.start].any() and c.is_accepting(c.start)
+    srv = _batcher(eos_id=0)
+    rid = srv.submit(np.asarray([5]), max_new_tokens=4, constraint=c)
+    srv.drain()
+    assert [t for t in srv.results[rid] if t != 0] == []
+    assert srv.finish_reasons[rid] == "eos"
+
+    srv2 = _batcher(eos_id=None)
+    with pytest.raises(ValueError, match="no first token"):
+        srv2.submit(np.asarray([5]), max_new_tokens=4, constraint=c)
+
+
+def test_constraint_table_pool_hit_refcount_eviction():
+    """The device mask pool uploads each grammar ONCE (pool hit on
+    resubmit), keeps unreferenced entries cached, and evicts them LRU
+    when space runs out."""
+    srv = _batcher(constraint_rows=12)
+    v = byte_vocab(CFG.vocab_size)
+    c1 = TokenConstraint.from_regex(r"[ab]{3}", v)
+    n1 = c1.table.shape[0]
+    rid = srv.submit(np.asarray([1]), max_new_tokens=8, constraint=c1)
+    assert len(srv._ctab_entries) == 1
+    e1 = srv._ctab_entries[id(c1)]
+    assert e1["refs"] == 1 and e1["n"] == n1 and e1["off"] >= 1
+    srv.drain()
+    assert e1["refs"] == 0  # retired; entry stays cached
+    assert srv.finish_reasons[rid] == "constraint"
+
+    srv.submit(np.asarray([1]), max_new_tokens=8, constraint=c1)
+    assert len(srv._ctab_entries) == 1 and e1["refs"] == 1  # pool hit
+    srv.drain()
+
+    # fill the pool with fresh grammars until c1's entry must evict
+    fillers = [TokenConstraint.from_regex(r"[cd]{%d}" % k, v)
+               for k in (3, 4)]
+    for f in fillers:
+        srv.submit(np.asarray([1]), max_new_tokens=10, constraint=f)
+        srv.drain()
+    assert id(c1) not in srv._ctab_entries, "LRU entry should have evicted"
+
+
+def test_constraint_pool_rejects_oversized_and_exhausted():
+    srv = _batcher(constraint_rows=8)
+    v = byte_vocab(CFG.vocab_size)
+    big = TokenConstraint.from_regex(r"[ab]{20}", v)
+    assert big.table.shape[0] > 7
+    with pytest.raises(ValueError, match="constraint_rows"):
+        srv.submit(np.asarray([1]), max_new_tokens=4, constraint=big)
+
+    # two LIVE grammars that cannot coexist in an 8-row pool: the second
+    # submit must fail loudly (no unreferenced entry to evict)
+    c1 = TokenConstraint.from_regex(r"[ab]{4}", v)
+    c2 = TokenConstraint.from_regex(r"[cd]{4}", v)
+    assert c1.table.shape[0] + c2.table.shape[0] > 7
+    srv.submit(np.asarray([1]), max_new_tokens=8, constraint=c1)  # live
+    with pytest.raises(ValueError, match="exhausted"):
+        srv.submit(np.asarray([2]), max_new_tokens=8, constraint=c2)
+    srv.drain()
+
+
+def test_constraints_need_no_bias_buffer():
+    """Device-resident tables removed the constraint path's dependence
+    on the (slots, V) bias buffer: an allow_constraints-only server
+    keeps the zero-width buffer (memory win) and the per-slot state
+    vector mirrors the host DFA walk."""
+    srv = _batcher(slots=2)
+    assert srv._bias.shape == (2, 0)
+    c = TokenConstraint.from_regex(r"[ab]{4}", byte_vocab(CFG.vocab_size))
+    srv.submit(np.asarray([1]), max_new_tokens=2, constraint=c)
+    srv.step()
+    off = srv._ctab_entries[id(c)]["off"]
+    req = srv._slot_req[0]
+    if req is not None:  # still live: device row tracks the host state
+        assert int(np.asarray(srv._crow)[0]) == off + req["c_state"]
+    srv.drain()
+    assert int(srv._crow_np[0]) == 0  # released back to the zero row
+
+
 def test_choice_constraint_picks_exactly_one_label():
     """The enum/classifier pattern: output is VERBATIM one of the
     options, across several sampled requests."""

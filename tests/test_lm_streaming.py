@@ -41,6 +41,31 @@ def test_stream_matches_unary_generate():
         stop()
 
 
+def test_text_stream_matches_one_shot_decode():
+    """generate_text_stream: the concatenated UTF-8-safe chunks equal
+    the one-shot decode of the same token stream byte-for-byte (the
+    detokenizer holds split multi-byte pieces until complete)."""
+    from dnn_tpu.io.tokenizer import ByteTokenizer
+
+    port = 59336
+    tok = ByteTokenizer(CFG.vocab_size)
+    t, stop = start_lm_server_in_background(
+        CFG, _prepared(), port=port, slots=2, max_len=64, prompt_pad=8,
+        default_max_new=8, tokenizer=tok, temperature=1.0)
+    try:
+        c = NodeClient(f"127.0.0.1:{port}")
+        prompt = "héllo 🙂"
+        ids = list(c.generate_stream(np.asarray(tok.encode(prompt),
+                                                np.int32),
+                                     max_new_tokens=24, seed=11))
+        text = "".join(c.generate_text_stream(prompt, tok,
+                                              max_new_tokens=24, seed=11))
+        assert text == tok.decode(ids)  # same seed -> same stream
+        c.close()
+    finally:
+        stop()
+
+
 def test_stream_tokens_arrive_incrementally():
     """The stream is really per-token: the first token arrives well before
     the full generation completes (not one buffered burst at the end)."""

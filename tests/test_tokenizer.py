@@ -25,6 +25,78 @@ def test_byte_tokenizer_roundtrip():
         ByteTokenizer(100)
 
 
+def test_streaming_detok_byte_exact():
+    """Byte streamer: pushing one id at a time yields exactly the
+    one-shot decode, and a multi-byte char split across pushes never
+    surfaces as partial garbage."""
+    from dnn_tpu.io.tokenizer import stream_detokenizer
+
+    tok = ByteTokenizer(300, offset=2)
+    text = "héllo wörld 🙂 ∑x"
+    ids = tok.encode(text)
+    det = stream_detokenizer(tok)
+    chunks = [det.push(i) for i in ids]
+    assert "".join(chunks) + det.flush() == tok.decode(ids) == text
+    # mid-emoji pushes emit nothing (the 4-byte char is held complete)
+    e_ids = tok.encode("🙂")
+    det2 = stream_detokenizer(tok)
+    assert [det2.push(i) for i in e_ids[:-1]] == ["", "", ""]
+    assert det2.push(e_ids[-1]) == "🙂"
+    # out-of-range ids degrade to U+FFFD exactly as decode() does
+    det3 = stream_detokenizer(tok)
+    bad = [0, 1, 299]
+    assert "".join(det3.push(i) for i in bad) + det3.flush() \
+        == tok.decode(bad)
+
+
+def test_streaming_detok_generic_multibyte_pieces():
+    """The decode-diff streamer holds back BPE pieces that END mid
+    -character: a vocab whose tokens split an emoji's bytes across two
+    pieces still streams byte-identically to the one-shot decode."""
+    from dnn_tpu.io.tokenizer import StreamingDetokenizer
+
+    pieces = [b"a", b"\xf0\x9f", b"\x98\x80", b" ok", b"\xc3"]
+
+    class _Toy:
+        @staticmethod
+        def decode(ids):
+            return b"".join(pieces[i] for i in ids).decode(
+                "utf-8", errors="replace")
+
+    det = StreamingDetokenizer(_Toy())
+    assert det.push(0) == "a"
+    assert det.push(1) == ""        # partial emoji held
+    assert det.push(2) == "😀"      # completed
+    assert det.push(3) == " ok"
+    assert det.push(4) == ""        # dangling lead byte
+    assert det.flush() == "�"       # never completed -> replacement
+    ids = [0, 1, 2, 3, 4]
+    det2 = StreamingDetokenizer(_Toy())
+    assert "".join(det2.push(i) for i in ids) + det2.flush() \
+        == _Toy.decode(ids)
+
+
+def test_streaming_detok_non_monotone_never_duplicates():
+    """A decode that REWRITES earlier text (HF cleanup collapsing
+    'word ' + '.' -> 'word.') cannot stream exactly; the streamer must
+    detect it, never duplicate already-emitted characters, and converge
+    via flush()."""
+    from dnn_tpu.io.tokenizer import StreamingDetokenizer
+
+    class _Cleanup:  # piece 0 = "word ", piece 1 = "." with cleanup
+        @staticmethod
+        def decode(ids):
+            raw = "".join(["word ", "."][i] for i in ids)
+            return raw.replace(" .", ".")
+
+    det = StreamingDetokenizer(_Cleanup())
+    out = det.push(0)          # "word "
+    out += det.push(1)         # decode shrank to "word." — held
+    out += det.flush()
+    assert "word" in out and out.count("word") == 1
+    assert out.endswith(".")
+
+
 def test_text_endpoint_matches_id_endpoint():
     prepared = gpt.prepare_stacked(gpt.init(jax.random.PRNGKey(0), CFG), CFG)
     tok = ByteTokenizer(CFG.vocab_size)
