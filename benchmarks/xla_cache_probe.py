@@ -1,0 +1,105 @@
+"""Probe for the XLA CPU compile-cache growth pathology.
+
+The full test suite segfaults XLA's CPU compiler at ~85% of a single
+-process run unless compiled executables drop between modules
+(tests/conftest.py). This probe tries to isolate the mechanism from
+pytest by compiling an endless stream of DISTINCT programs (unique
+shapes so nothing cache-hits) and reporting RSS + compile count.
+
+MEASURED FINDING (2026-07-31, this jaxlib build): 6000 distinct TINY
+single-device programs survive with flat RSS (~0.9 GB) — raw program
+COUNT with small programs does not reproduce the crash. The suite's
+failure involves its actual program population: 8-virtual-device SPMD
+programs (shard_map + collectives), donated buffers, long scans —
+i.e. compiled-artifact VOLUME and linker/constant pools, not table
+entries. `--spmd` compiles distinct 8-device shard_map programs to get
+closer to that population. Until a minimal form reproduces, the
+suite-scale evidence stands on its own: the between-modules
+`jax.clear_caches()` fixture is load-bearing, and the serving daemon's
+CompileCacheGuard (dnn_tpu/utils/xla_cache.py) bounds the same
+accumulation for week-long processes.
+
+Run manually (NOT part of the suite):
+    JAX_PLATFORMS=cpu python benchmarks/xla_cache_probe.py --limit 6000
+    XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python benchmarks/xla_cache_probe.py --spmd --limit 2000
+    JAX_PLATFORMS=cpu python benchmarks/xla_cache_probe.py --clear-every 256
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import resource
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:  # script lives in benchmarks/
+    sys.path.insert(0, REPO)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--limit", type=int, default=10_000,
+                    help="stop after N distinct programs (if still alive)")
+    ap.add_argument("--clear-every", type=int, default=0,
+                    help="jax.clear_caches() every N programs (0 = never "
+                         "— the accumulating configuration)")
+    ap.add_argument("--spmd", action="store_true",
+                    help="compile distinct 8-device shard_map programs "
+                         "(closer to the suite's program population)")
+    ap.add_argument("--report-every", type=int, default=200)
+    args = ap.parse_args()
+
+    if args.spmd:
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "--xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8"
+            ).strip()
+
+    import jax
+    import jax.numpy as jnp
+
+    jax.config.update("jax_platforms", "cpu")
+
+    if args.spmd:
+        from jax.sharding import PartitionSpec as P
+
+        from dnn_tpu.parallel.mesh import DATA_AXIS, make_mesh
+
+        mesh = make_mesh({DATA_AXIS: 8})
+
+    for i in range(1, args.limit + 1):
+        # unique shape per iteration -> a fresh compile every time
+        n = 8 + (i % 509)  # co-prime walk: shapes repeat only mod 509
+        m = 8 + (i // 509)
+
+        if args.spmd:
+            def body(x, _m=m):
+                import jax.lax as lax
+
+                y = (x @ x.T) * _m + jnp.tanh(x).sum()
+                return lax.psum(y, DATA_AXIS)
+
+            f = jax.jit(jax.shard_map(body, mesh=mesh,
+                                      in_specs=P(DATA_AXIS),
+                                      out_specs=P(), check_vma=False))
+            f(jnp.ones((8, n), jnp.float32)).block_until_ready()
+        else:
+            @jax.jit
+            def f(x, _m=m):
+                return (x @ x.T) * _m + jnp.tanh(x).sum()
+
+            f(jnp.ones((n, n), jnp.float32)).block_until_ready()
+        if args.clear_every and i % args.clear_every == 0:
+            jax.clear_caches()
+        if i % args.report_every == 0:
+            rss_mb = resource.getrusage(
+                resource.RUSAGE_SELF).ru_maxrss / 1024
+            print(f"{i} programs, rss={rss_mb:.0f} MB", flush=True)
+    print(f"survived {args.limit} programs", flush=True)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -112,9 +112,21 @@ class _BatcherWorker(threading.Thread):
     """The one thread that talks to the device. Owns the ContinuousBatcher;
     everyone else submits (prompt, max_new, seed, future) through a queue."""
 
-    def __init__(self, batcher: ContinuousBatcher):
+    def __init__(self, batcher: ContinuousBatcher,
+                 compile_cache_budget: int = 512):
         super().__init__(daemon=True, name="lm-batcher")
         self.batcher = batcher
+        # guard against unbounded XLA compile-cache growth (the suite's
+        # segfault pathology — utils/xla_cache.py): counts the batcher's
+        # compiled programs and clears ALL caches at the idle boundary
+        # when the budget trips. A steady server (three programs) never
+        # reaches 512; shape-churning workloads (many prompt buckets,
+        # adapters, pooling variants) do, and recompile after the clear.
+        from dnn_tpu.utils.xla_cache import CompileCacheGuard
+
+        self.cache_guard = CompileCacheGuard(compile_cache_budget)
+        for fn in batcher.jit_programs():  # spec variants add their own
+            self.cache_guard.register(fn)
         self.q: "queue.Queue" = queue.Queue()
         self._stop_evt = threading.Event()
         self._abandon = False
@@ -299,6 +311,13 @@ class _BatcherWorker(threading.Thread):
                 if self._stop_evt.is_set():
                     self._shutdown_drain_queue()
                     return
+                # SAFE BOUNDARY: nothing in flight, nothing queued — the
+                # only place the worker may drop compiled executables.
+                # Bounds the week-long daemon against the compile-cache
+                # growth pathology that segfaults XLA's CPU compiler in
+                # the test suite (utils/xla_cache.py has the story);
+                # cleared programs recompile transparently on next use.
+                self.cache_guard.maybe_clear()
                 try:
                     self._admit(*self.q.get(timeout=0.1))
                 except queue.Empty:
@@ -349,6 +368,7 @@ class LMServer:
     def __init__(self, cfg, prepared, *, default_max_new: int = 32,
                  request_timeout: float = 120.0, tokenizer=None,
                  draft_cfg=None, draft_prepared=None, spec_k: int = 4,
+                 compile_cache_budget: int = 512,
                  **batcher_kwargs):
         if (batcher_kwargs.get("allow_constraints")
                 and "constraint_rows" not in batcher_kwargs):
@@ -381,7 +401,10 @@ class LMServer:
         # embedding endpoint: one make_embed per pooling (jit caches per
         # padded-length shape underneath)
         self._embed_fns: dict = {}
-        self.worker = _BatcherWorker(self.batcher)
+        self.worker = _BatcherWorker(
+            self.batcher, compile_cache_budget=compile_cache_budget)
+        # lazily-created program families count toward the compile budget
+        self.worker.cache_guard.register(lambda: self._embed_fns.values())
         self.worker.start()
 
     _MAX_JSON_DEPTH = 3  # regex expansion grows with depth; bound it

@@ -558,6 +558,16 @@ class ContinuousBatcher:
         # structure, so the same compiled program), plain prepared when off
         self._decode_view = self._lora_prepared(self._aid)
 
+    def jit_programs(self):
+        """The batcher's compiled entry points — what a long-lived server
+        counts toward its compile-cache budget (lm_server's
+        CompileCacheGuard). Variants with extra programs
+        (SpeculativeBatcher) extend this."""
+        fns = [self._decode, self._prefill_chunk, self._prefill_finish]
+        if self._paged:
+            fns.append(self._gather_row)
+        return fns
+
     # ------------------------------------------------------------------
 
     def _lora_prepared(self, aids):
@@ -1035,8 +1045,9 @@ class ContinuousBatcher:
             if victim is None:
                 raise ValueError(
                     f"constraint mask pool exhausted: {n} rows needed, "
-                    f"all {self._ctab_rows} occupied by live requests — "
-                    "construct the server with a larger constraint_rows")
+                    f"all {self._ctab_rows - 1} allocatable rows occupied "
+                    "by live requests — construct the server with a "
+                    "larger constraint_rows")
             del self._ctab_entries[victim]
             off = _free_gap()
         self._ctable = self._ctable.at[off:off + n].set(

@@ -92,7 +92,11 @@ class SpeculativeBatcher(ContinuousBatcher):
                 f"{cfg.vocab_size}")
         for bad in ("ffn", "paged_blocks", "logprobs_k",
                     "attn_kernel", "top_p", "min_p", "repetition_penalty",
-                    "lora_adapters"):
+                    "lora_adapters", "allow_constraints"):
+            # allow_constraints would allocate the (constraint_rows, V)
+            # device mask pool for a batcher that rejects every
+            # constrained submit (_constraints_ok=False) — fail at
+            # construction, not per request
             if kw.get(bad):
                 raise ValueError(
                     f"SpeculativeBatcher does not support {bad}=")
@@ -278,6 +282,14 @@ class SpeculativeBatcher(ContinuousBatcher):
         self._d_install = jax.jit(d_install, donate_argnums=(0, 1))
 
     # ------------------------------------------------------------------
+
+    def jit_programs(self):
+        """Parent programs plus the spec path's own — a speculative
+        daemon's compile-cache budget must count the programs it
+        actually churns (_d_prefill_chunk recompiles per prompt-length
+        bucket, exactly like the parent's chunk program)."""
+        return super().jit_programs() + [
+            self._spec_step, self._d_prefill_chunk, self._d_install]
 
     def submit(self, prompt, max_new_tokens: int,
                seed: Optional[int] = None, **opts) -> int:

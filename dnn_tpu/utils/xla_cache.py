@@ -1,0 +1,83 @@
+"""Bounding XLA compile-cache growth in long-lived processes.
+
+Observed pathology (this environment's jaxlib CPU build): one process
+that keeps compiling DISTINCT programs eventually segfaults inside the
+XLA CPU compiler — the full test suite (600+ tests, several programs
+each) dies at ~85% unless compiled executables drop between modules
+(tests/conftest.py's between-modules `jax.clear_caches()` fixture).
+`benchmarks/xla_cache_probe.py` probes minimal forms: 6000 distinct
+TINY programs do NOT crash (flat RSS — the trigger is the suite's
+program population, SPMD collectives/donation/scans, not raw count),
+so the suite-scale evidence is the operative fact. A long-lived
+serving daemon that keeps admitting new program shapes (models,
+adapters, pooling variants, padded-length buckets) accumulates the
+same compiled-artifact volume over days.
+
+This module is the daemon-side guard: count the entries of the
+process's OWN jitted entry points (`fn._cache_size()`, the same counter
+tests/test_prefix_cache.py pins) and, when a budget is exceeded, call
+`jax.clear_caches()` at a SAFE BOUNDARY — a moment the caller
+guarantees no compiled program is mid-flight (the LM worker's idle
+point: no active slots, empty queue). Cleared programs recompile
+transparently on next use; steady-state servers (three programs) never
+trip the budget, so the guard costs nothing until the pathology-shaped
+workload appears.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List
+
+__all__ = ["jit_cache_entries", "CompileCacheGuard"]
+
+
+def jit_cache_entries(*fns) -> int:
+    """Total compiled-executable entries across `fns` (0 for anything
+    without a `_cache_size` — plain callables pass through silently, so
+    callers can register hooks without caring which are jitted)."""
+    total = 0
+    for f in fns:
+        size = getattr(f, "_cache_size", None)
+        if callable(size):
+            total += int(size())
+    return total
+
+
+class CompileCacheGuard:
+    """Budgeted `jax.clear_caches()` for a long-lived serving loop.
+
+    `register(fn)` adds a jitted entry point (or a zero-arg callable
+    returning an iterable of them — for lazily-created program families
+    like the daemon's per-pooling embed fns). `maybe_clear()` — call it
+    ONLY at a safe boundary — clears every XLA cache when the registered
+    entry count reaches `budget`. budget <= 0 disables."""
+
+    def __init__(self, budget: int):
+        self.budget = int(budget)
+        self.clears = 0  # observability: soak test + ops metrics
+        self._fns: List[Callable] = []
+
+    def register(self, fn):
+        self._fns.append(fn)
+        return fn
+
+    def _entries(self) -> int:
+        flat = []
+        for f in self._fns:
+            if getattr(f, "_cache_size", None) is None and callable(f):
+                try:
+                    flat.extend(f())
+                    continue
+                except TypeError:
+                    pass  # a plain non-jitted registrant: counts as 0
+            flat.append(f)
+        return jit_cache_entries(*flat)
+
+    def maybe_clear(self) -> bool:
+        if self.budget <= 0 or self._entries() < self.budget:
+            return False
+        import jax
+
+        jax.clear_caches()
+        self.clears += 1
+        return True

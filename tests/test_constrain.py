@@ -478,15 +478,22 @@ def test_hf_vocab_bytes_real_bpe_constrained_decode():
 
 
 def test_speculative_batcher_rejects_constraints():
+    """The speculative batcher commits multiple tokens per step — it
+    rejects allow_constraints at CONSTRUCTION (before allocating the
+    device mask pool it could never use), and constraint= submits on an
+    unconstrained instance fail with the capability error."""
     from dnn_tpu.runtime.serving_spec import SpeculativeBatcher
 
     cfg = gpt.PRESETS["gpt2-test"]
     rng = jax.random.PRNGKey(0)
     params = gpt.init(rng, cfg)
     prepared = gpt.prepare_stacked(params, cfg)
+    with pytest.raises(ValueError, match="allow_constraints"):
+        SpeculativeBatcher(cfg, prepared, cfg, prepared, spec_k=2,
+                           slots=1, max_len=32, prompt_pad=8,
+                           allow_constraints=True)
     srv = SpeculativeBatcher(cfg, prepared, cfg, prepared, spec_k=2,
-                             slots=1, max_len=32, prompt_pad=8,
-                             allow_constraints=True)
+                             slots=1, max_len=32, prompt_pad=8)
     c = TokenConstraint.from_regex(r"a+", byte_vocab(cfg.vocab_size))
     with pytest.raises(ValueError, match="constraint"):
         srv.submit(np.asarray([1, 2, 3]), max_new_tokens=4, constraint=c)

@@ -6,6 +6,7 @@ wire protocol, prompt ids in, generated tokens out, concurrent requests
 sharing the decode pool. Parity oracle is the solo KV-cache decoder."""
 
 import threading
+import time
 
 import jax
 import numpy as np
@@ -145,3 +146,58 @@ def test_bad_prompt_rejected(lm_server):
         # float payload -> INVALID_ARGUMENT (not silently truncated)
         c.send_tensor(np.zeros(4, np.float32), request_id="gen:4")
     c.close()
+
+
+def test_compile_cache_guard_soak():
+    """Soak across the compile-cache guard boundary: with a budget of 1
+    the worker clears ALL XLA caches at every idle point, so each
+    request round recompiles the three programs — the server must keep
+    producing identical (seeded) results through repeated
+    clear+recompile cycles. This is the bounded form of the suite-scale
+    pathology (utils/xla_cache.py): a week-long daemon periodically
+    dropping caches must behave exactly like one that never did."""
+    from dnn_tpu.runtime.lm_server import _BatcherWorker
+    from dnn_tpu.runtime.serving import ContinuousBatcher
+
+    params = gpt.init(jax.random.PRNGKey(0), CFG)
+    prepared = gpt.prepare_stacked(params, CFG)
+    b = ContinuousBatcher(CFG, prepared, slots=2, max_len=48,
+                          prompt_pad=8)
+    w = _BatcherWorker(b, compile_cache_budget=1)
+    w.start()
+    try:
+        prompt = np.array([3, 1, 4, 1, 5], np.int32)
+        want = w.submit(prompt, 6, 7).result(timeout=120)
+        for _ in range(3):
+            # idle gap so the worker reaches its safe boundary and the
+            # budget-1 guard fires before the next admit
+            time.sleep(0.3)
+            got = w.submit(prompt, 6, 7).result(timeout=120)
+            np.testing.assert_array_equal(got, want)
+        assert w.cache_guard.clears >= 1, \
+            "guard never fired despite budget=1"
+    finally:
+        w.stop(drain=False)
+
+
+def test_compile_cache_guard_off_by_default_budget():
+    """A steady server (three compiled programs) must never trip the
+    default budget — the guard costs nothing until the pathology-shaped
+    workload appears."""
+    from dnn_tpu.runtime.lm_server import _BatcherWorker
+    from dnn_tpu.runtime.serving import ContinuousBatcher
+
+    params = gpt.init(jax.random.PRNGKey(0), CFG)
+    prepared = gpt.prepare_stacked(params, CFG)
+    b = ContinuousBatcher(CFG, prepared, slots=2, max_len=48,
+                          prompt_pad=8)
+    w = _BatcherWorker(b)  # default budget
+    w.start()
+    try:
+        prompt = np.array([3, 1, 4, 1, 5], np.int32)
+        w.submit(prompt, 4, 7).result(timeout=120)
+        time.sleep(0.3)
+        w.submit(prompt, 4, 7).result(timeout=120)
+        assert w.cache_guard.clears == 0
+    finally:
+        w.stop(drain=False)
