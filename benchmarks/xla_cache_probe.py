@@ -6,18 +6,19 @@ The full test suite segfaults XLA's CPU compiler at ~85% of a single
 pytest by compiling an endless stream of DISTINCT programs (unique
 shapes so nothing cache-hits) and reporting RSS + compile count.
 
-MEASURED FINDING (2026-07-31, this jaxlib build): 6000 distinct TINY
-single-device programs survive with flat RSS (~0.9 GB) — raw program
-COUNT with small programs does not reproduce the crash. The suite's
-failure involves its actual program population: 8-virtual-device SPMD
-programs (shard_map + collectives), donated buffers, long scans —
-i.e. compiled-artifact VOLUME and linker/constant pools, not table
-entries. `--spmd` compiles distinct 8-device shard_map programs to get
-closer to that population. Until a minimal form reproduces, the
-suite-scale evidence stands on its own: the between-modules
-`jax.clear_caches()` fixture is load-bearing, and the serving daemon's
-CompileCacheGuard (dnn_tpu/utils/xla_cache.py) bounds the same
-accumulation for week-long processes.
+MEASURED FINDINGS (2026-07-31, this jaxlib build): 6000 distinct TINY
+single-device programs survive with flat RSS (~0.9 GB), and 2500
+distinct 8-device shard_map+psum programs (`--spmd`) survive at a flat
+~1.7 GB — neither raw program count nor small SPMD programs reproduce
+the crash. The suite's failure therefore involves its actual program
+population (large multi-buffer programs: donated KV caches, long
+scans, real model weights) — compiled-artifact VOLUME, not table
+entries. Until a minimal form reproduces, the suite-scale evidence
+stands on its own: the between-modules `jax.clear_caches()` fixture is
+load-bearing (removing it reliably segfaults the 600-test run at
+~85%), and the serving daemon's CompileCacheGuard
+(dnn_tpu/utils/xla_cache.py) bounds the same accumulation for
+week-long processes.
 
 Run manually (NOT part of the suite):
     JAX_PLATFORMS=cpu python benchmarks/xla_cache_probe.py --limit 6000

@@ -404,6 +404,53 @@ def llama_params_from_state_dict(sd: Dict[str, np.ndarray],
     return params
 
 
+def phi_params_from_state_dict(sd: Dict[str, np.ndarray],
+                               n_layer: Optional[int] = None):
+    """Convert an HF PhiForCausalLM state dict to this framework's
+    LLaMA-family pytree (models/llama.py parallel_block configs):
+    biased LayerNorms map scale+bias, `self_attn.dense` is the o
+    projection, `mlp.fc1/fc2` are the plain MLP's up/down, and every
+    projection (lm_head included) carries a bias. The parallel block
+    has ONE norm per layer (input_layernorm -> ln_1; no ln_2 leaf)."""
+    sd = {(k[len("model."):] if k.startswith("model.") else k): v
+          for k, v in sd.items()}
+    if n_layer is None:
+        n_layer = 1 + max(
+            int(k.split(".")[1]) for k in sd
+            if k.startswith("layers.") and k.split(".")[1].isdigit()
+        )
+
+    def _proj(key):
+        out = {"kernel": _t_linear(sd[key + ".weight"])}
+        if key + ".bias" in sd:
+            out["bias"] = sd[key + ".bias"]
+        return out
+
+    params = {
+        "wte": {"embedding": sd["embed_tokens.weight"]},
+        "ln_f": {"scale": sd["final_layernorm.weight"],
+                 "bias": sd["final_layernorm.bias"]},
+        "lm_head": _proj("lm_head"),
+    }
+    for i in range(n_layer):
+        p = f"layers.{i}."
+        params[f"h_{i}"] = {
+            "ln_1": {"scale": sd[p + "input_layernorm.weight"],
+                     "bias": sd[p + "input_layernorm.bias"]},
+            "attn": {
+                "q": _proj(p + "self_attn.q_proj"),
+                "k": _proj(p + "self_attn.k_proj"),
+                "v": _proj(p + "self_attn.v_proj"),
+                "o": _proj(p + "self_attn.dense"),
+            },
+            "mlp": {
+                "up": _proj(p + "mlp.fc1"),
+                "down": _proj(p + "mlp.fc2"),
+            },
+        }
+    return params
+
+
 # ----------------------------------------------------------------------
 # native (framework-own) flat format
 # ----------------------------------------------------------------------

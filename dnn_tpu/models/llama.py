@@ -121,6 +121,34 @@ class LlamaConfig:
     # (kvcache._KernelDispatch docstring); a global layer's entry is
     # block_size, which makes the band's lower bound vacuous.
     alt_window: bool = False
+    # ---- Phi-family architecture switches (all default off) ----
+    # LayerNorm (scale + bias, like GPT-2) instead of RMSNorm at every
+    # norm site; rms_eps doubles as the LayerNorm eps.
+    layer_norm: bool = False
+    # Parallel residual (Phi/GPT-J): attention AND MLP both read the
+    # SAME ln_1 output; y = x + attn(h) + mlp(h). No ln_2 exists.
+    parallel_block: bool = False
+    # Partial rotary (Phi): only the first `rotary_dim` dims of each
+    # head rotate; the rest pass through untouched. None = full head.
+    rotary_dim: Optional[int] = None
+    # Phi puts biases on EVERY projection (o/dense, the MLP pair, and
+    # lm_head) — attn_bias covers q/k/v alone (Qwen2).
+    dense_bias: bool = False
+    # False = the plain 2-layer MLP (fc1 -> act -> fc2; params carry
+    # "up"/"down" only, no "gate") instead of the gated SwiGLU/GeGLU.
+    mlp_gated: bool = True
+
+    def __post_init__(self):
+        if self.parallel_block and self.post_norms:
+            raise ValueError(
+                "parallel_block (Phi) and post_norms (Gemma-2) describe "
+                "incompatible residual structures")
+        if self.rotary_dim is not None and (
+                self.rotary_dim % 2 or not
+                0 < self.rotary_dim <= self.head_dim):
+            raise ValueError(
+                f"rotary_dim must be an even value in (0, head_dim="
+                f"{self.head_dim}], got {self.rotary_dim}")
 
     @property
     def head_dim(self):
@@ -217,6 +245,23 @@ PRESETS = {
                                post_norms=True, query_scale=64.0,
                                attn_softcap=50.0, final_softcap=30.0,
                                sliding_window=16, alt_window=True),
+    # Phi-2 shape: parallel residual block (attn + MLP both read ln_1's
+    # output), biased LayerNorms, partial rotary (32 of 80 head dims),
+    # plain gelu MLP, biases on every projection incl. lm_head
+    "phi-2": LlamaConfig(block_size=2048, vocab_size=51200, n_layer=32,
+                         n_head=32, n_kv_head=32, n_embd=2560,
+                         d_ff=10240, rms_eps=1e-5, layer_norm=True,
+                         parallel_block=True, rotary_dim=32,
+                         attn_bias=True, dense_bias=True,
+                         mlp_gated=False, mlp_act="gelu_tanh"),
+    # tiny Phi config for tests (partial_rotary_factor 0.5 on 16-dim
+    # heads so the rotate/pass-through split actually acts)
+    "phi-test": LlamaConfig(block_size=64, vocab_size=256, n_layer=4,
+                            n_head=4, n_kv_head=4, n_embd=64, d_ff=128,
+                            rms_eps=1e-5, layer_norm=True,
+                            parallel_block=True, rotary_dim=8,
+                            attn_bias=True, dense_bias=True,
+                            mlp_gated=False, mlp_act="gelu_tanh"),
 }
 
 
@@ -262,29 +307,50 @@ def init_block(key, cfg: LlamaConfig, dtype=jnp.float32, *,
         return p
 
     # Gemma norms init at ZERO ((1+w) scaling makes 0 the identity);
-    # plain RMSNorm inits at one
+    # plain RMSNorm inits at one. LayerNorm (Phi) adds a bias leaf.
     norm_init = jnp.zeros if cfg.norm_plus_one else jnp.ones
+
+    def _norm_p(shape):
+        p = {"scale": norm_init(shape, dtype)}
+        if cfg.layer_norm:
+            p["bias"] = jnp.zeros(shape, dtype)
+        return p
+
+    def _dense(k, shape, std=0.02):
+        p = _kernel(k, shape, dtype, std=std)
+        if cfg.dense_bias:  # Phi biases every projection
+            p["bias"] = jnp.zeros((shape[-1],), dtype)
+        return p
+
     blk = {
-        "ln_1": {"scale": norm_init((c,), dtype)},
+        "ln_1": _norm_p((c,)),
         "attn": {
             "q": _qkv(ks[0], (c, cfg.n_head * d)),
             "k": _qkv(ks[1], (c, cfg.n_kv_head * d)),
             "v": _qkv(ks[2], (c, cfg.n_kv_head * d)),
-            "o": _kernel(ks[3], (cfg.n_head * d, c), dtype,
-                         std=0.02 / (2 * cfg.n_layer) ** 0.5),
+            "o": _dense(ks[3], (cfg.n_head * d, c),
+                        std=0.02 / (2 * cfg.n_layer) ** 0.5),
         },
-        "ln_2": {"scale": norm_init((c,), dtype)},
     }
+    if not cfg.parallel_block:  # Phi's parallel block has ONE norm
+        blk["ln_2"] = _norm_p((c,))
     if include_mlp:
-        blk["mlp"] = {
-            "gate": _kernel(ks[4], (c, cfg.d_ff), dtype),
-            "up": _kernel(ks[5], (c, cfg.d_ff), dtype),
-            "down": _kernel(ks[6], (cfg.d_ff, c), dtype,
-                            std=0.02 / (2 * cfg.n_layer) ** 0.5),
-        }
+        if cfg.mlp_gated:
+            blk["mlp"] = {
+                "gate": _kernel(ks[4], (c, cfg.d_ff), dtype),
+                "up": _kernel(ks[5], (c, cfg.d_ff), dtype),
+                "down": _kernel(ks[6], (cfg.d_ff, c), dtype,
+                                std=0.02 / (2 * cfg.n_layer) ** 0.5),
+            }
+        else:  # Phi plain MLP: fc1 -> act -> fc2
+            blk["mlp"] = {
+                "up": _dense(ks[5], (c, cfg.d_ff)),
+                "down": _dense(ks[6], (cfg.d_ff, c),
+                               std=0.02 / (2 * cfg.n_layer) ** 0.5),
+            }
     if cfg.post_norms:
-        blk["post_ln_1"] = {"scale": norm_init((c,), dtype)}
-        blk["post_ln_2"] = {"scale": norm_init((c,), dtype)}
+        blk["post_ln_1"] = _norm_p((c,))
+        blk["post_ln_2"] = _norm_p((c,))
     return blk
 
 
@@ -293,15 +359,20 @@ def init(rng, cfg: LlamaConfig = PRESETS["llama-test"], dtype=jnp.float32,
     keys = jax.random.split(rng, cfg.n_layer + 3)
     c = cfg.n_embd
     norm_init = jnp.zeros if cfg.norm_plus_one else jnp.ones
+    ln_f = {"scale": norm_init((c,), dtype)}
+    if cfg.layer_norm:
+        ln_f["bias"] = jnp.zeros((c,), dtype)
     params = {
         "wte": {"embedding": (jax.random.normal(keys[0], (cfg.vocab_size, c))
                               * 0.02).astype(dtype)},
-        "ln_f": {"scale": norm_init((c,), dtype)},
+        "ln_f": ln_f,
     }
     if not cfg.tie_word_embeddings:
         # tied configs carry NO lm_head leaf — head() projects through
         # wte.embedding.T (one table in HBM, shared gradient)
         params["lm_head"] = _kernel(keys[1], (c, cfg.vocab_size), dtype)
+        if cfg.dense_bias:  # Phi: lm_head carries a bias too
+            params["lm_head"]["bias"] = jnp.zeros((cfg.vocab_size,), dtype)
     for i in range(cfg.n_layer):
         params[f"h_{i}"] = init_block(keys[2 + i], cfg, dtype,
                                       include_mlp=include_mlp)
@@ -317,6 +388,7 @@ def _rope_tables(cfg: LlamaConfig, positions):
     applied — the ONE place scaling happens, shared by every attention
     path (dense, cached decode, batcher rows, seq-parallel ring)."""
     theta = cfg.rope_theta
+    d = cfg.rotary_dim or cfg.head_dim  # partial rotary: narrow tables
     if cfg.rope_scaling is None:
         if cfg.rope_scale != 1.0:
             # the likely long-context typo: factor set, type forgotten —
@@ -325,26 +397,30 @@ def _rope_tables(cfg: LlamaConfig, positions):
             raise ValueError(
                 f"rope_scale={cfg.rope_scale} has no effect without "
                 "rope_scaling='linear' or 'ntk'")
-        return rope_cos_sin(positions, cfg.head_dim, theta=theta)
+        return rope_cos_sin(positions, d, theta=theta)
     if cfg.rope_scaling not in ("linear", "ntk"):
         raise ValueError(
             f"unknown rope_scaling {cfg.rope_scaling!r} "
             "(expected 'linear' or 'ntk')")
     if cfg.rope_scale == 1.0:
-        return rope_cos_sin(positions, cfg.head_dim, theta=theta)
+        return rope_cos_sin(positions, d, theta=theta)
     if cfg.rope_scale < 1.0:
         raise ValueError(f"rope_scale must be >= 1, got {cfg.rope_scale}")
     if cfg.rope_scaling == "linear":
         positions = positions.astype(jnp.float32) / cfg.rope_scale
     else:  # "ntk"
-        d = cfg.head_dim
         theta = theta * cfg.rope_scale ** (d / (d - 2))
-    return rope_cos_sin(positions, cfg.head_dim, theta=theta)
+    return rope_cos_sin(positions, d, theta=theta)
 
 
 def _norm(p, x, cfg: LlamaConfig):
-    """The family's RMSNorm: cfg.rms_eps, (1+w) scaling for Gemma
-    (norm_plus_one). EVERY norm site in this module goes through here."""
+    """The family's norm: RMSNorm with cfg.rms_eps ((1+w) scaling for
+    Gemma, norm_plus_one) — or biased LayerNorm for Phi-class configs
+    (layer_norm). EVERY norm site in this module goes through here."""
+    if cfg.layer_norm:
+        from dnn_tpu.ops.nn import layer_norm
+
+        return layer_norm(p, x, eps=cfg.rms_eps)
     return rms_norm(p, x, eps=cfg.rms_eps, plus_one=cfg.norm_plus_one)
 
 
@@ -367,6 +443,19 @@ def _q_rescale(q, cfg: LlamaConfig):
     return q
 
 
+def _rope_apply(x, cos, sin, cfg: LlamaConfig):
+    """apply_rope with the config's partial-rotary slice (Phi): only the
+    first rotary_dim dims of each head rotate, the rest pass through.
+    EVERY q/k rotation site in this module goes through here — the
+    partial slice must never diverge between the dense forward, the
+    cached decode, batcher rows, verify rows, and the seq-parallel
+    paths."""
+    if cfg.rotary_dim is None:
+        return apply_rope(x, cos, sin)
+    rot = apply_rope(x[..., :cfg.rotary_dim], cos, sin)
+    return jnp.concatenate([rot, x[..., cfg.rotary_dim:]], axis=-1)
+
+
 def _qkv_rope(bp, h, positions, *, cfg: LlamaConfig, compute_dtype):
     """Project h (B, T, C) and rotate q/k at absolute `positions` (T,).
     Returns q (B, H, T, D), k/v (B, KV, T, D) — KV heads stay narrow."""
@@ -377,26 +466,38 @@ def _qkv_rope(bp, h, positions, *, cfg: LlamaConfig, compute_dtype):
     v = split_heads(linear(bp["attn"]["v"], h, compute_dtype=compute_dtype),
                     cfg.n_kv_head)
     cos, sin = _rope_tables(cfg, positions)
-    return _q_rescale(apply_rope(q, cos, sin), cfg), apply_rope(k, cos, sin), v
+    return (_q_rescale(_rope_apply(q, cos, sin, cfg), cfg),
+            _rope_apply(k, cos, sin, cfg), v)
+
+
+def _mlp_out(bp, h, *, cfg: LlamaConfig, compute_dtype, ffn=None):
+    """The MLP branch over an already-normed h: gated SwiGLU/GeGLU, the
+    plain 2-layer Phi MLP (mlp_gated=False), or the `ffn` override
+    (Mixtral MoE hook)."""
+    if ffn is not None:
+        return ffn(bp, h)
+    act = _mlp_act(cfg)
+    if not cfg.mlp_gated:
+        return linear(bp["mlp"]["down"],
+                      act(linear(bp["mlp"]["up"], h,
+                                 compute_dtype=compute_dtype)),
+                      compute_dtype=compute_dtype)
+    return linear(bp["mlp"]["down"],
+                  act(linear(bp["mlp"]["gate"], h,
+                             compute_dtype=compute_dtype))
+                  * linear(bp["mlp"]["up"], h, compute_dtype=compute_dtype),
+                  compute_dtype=compute_dtype)
 
 
 def _mlp_residual(bp, x, *, cfg: LlamaConfig, compute_dtype, ffn=None):
-    """Post-attention half of every block: RMSNorm + gated MLP (SwiGLU or
-    Gemma's GeGLU), Gemma-2 post-MLP norm, residual. ONE definition shared
-    by the stateless forward, the cached decode, and the per-slot batcher
-    path — their parity contracts depend on these never diverging.
-    `ffn(bp, h)` overrides the MLP (the Mixtral MoE hook —
+    """Post-attention half of the SEQUENTIAL block: norm + MLP
+    (gated or plain), Gemma-2 post-MLP norm, residual. ONE definition
+    shared by the stateless forward, the cached decode, and the per-slot
+    batcher path — their parity contracts depend on these never
+    diverging. `ffn(bp, h)` overrides the MLP (the Mixtral MoE hook —
     models/llama_moe.py; same convention as the GPT family's ffn)."""
     h = _norm(bp["ln_2"], x, cfg)
-    if ffn is not None:
-        m = ffn(bp, h)
-    else:
-        act = _mlp_act(cfg)
-        m = linear(bp["mlp"]["down"],
-                   act(linear(bp["mlp"]["gate"], h,
-                              compute_dtype=compute_dtype))
-                   * linear(bp["mlp"]["up"], h, compute_dtype=compute_dtype),
-                   compute_dtype=compute_dtype)
+    m = _mlp_out(bp, h, cfg=cfg, compute_dtype=compute_dtype, ffn=ffn)
     if cfg.post_norms:
         m = _norm(bp["post_ln_2"], m, cfg)
     return x + m.astype(x.dtype)
@@ -409,6 +510,22 @@ def _attn_out_residual(bp, x, o, cfg: LlamaConfig):
     if cfg.post_norms:
         o = _norm(bp["post_ln_1"], o, cfg)
     return x + o.astype(x.dtype)
+
+
+def _branches_residual(bp, x, o, h, *, cfg: LlamaConfig, compute_dtype,
+                       ffn=None):
+    """Compose the attention branch output `o` and the MLP into the
+    residual stream — the ONE definition every block body (dense
+    forward, cached decode, batcher rows, verify rows, seq-sharded
+    decode) shares. Sequential (LLaMA): x + o, then ln_2 + MLP +
+    residual. Parallel (Phi, parallel_block): both branches read the
+    SAME ln_1 output `h`; y = x + o + mlp(h), no ln_2."""
+    if cfg.parallel_block:
+        m = _mlp_out(bp, h, cfg=cfg, compute_dtype=compute_dtype, ffn=ffn)
+        return x + o.astype(x.dtype) + m.astype(x.dtype)
+    x = _attn_out_residual(bp, x, o, cfg)
+    return _mlp_residual(bp, x, cfg=cfg, compute_dtype=compute_dtype,
+                         ffn=ffn)
 
 
 def _gqa_scores_attend(q, k, v, mask_fn, softcap=None):
@@ -466,9 +583,8 @@ def block_apply(bp, x, *, cfg: LlamaConfig, compute_dtype=None, attn_fn=None,
     fn = attn_fn or (lambda bp2, h: _dense_attn(
         bp2, h, cfg=cfg, compute_dtype=compute_dtype, window=window))
     h = _norm(bp["ln_1"], x, cfg)
-    x = _attn_out_residual(bp, x, fn(bp, h), cfg)
-    return _mlp_residual(bp, x, cfg=cfg, compute_dtype=compute_dtype,
-                         ffn=ffn)
+    return _branches_residual(bp, x, fn(bp, h), h, cfg=cfg,
+                              compute_dtype=compute_dtype, ffn=ffn)
 
 
 def _scaled_embed(p, ids, cfg: LlamaConfig):
@@ -627,9 +743,9 @@ def _block_with_cache(bp, x, layer_cache, start_pos, *, cfg: LlamaConfig,
     y = yg.reshape(b, cfg.n_head, t, cfg.head_dim)
     o = linear(bp["attn"]["o"], merge_heads(y.astype(x.dtype)),
                compute_dtype=compute_dtype)
-    x = _attn_out_residual(bp, x, o, cfg)
-    return (_mlp_residual(bp, x, cfg=cfg, compute_dtype=compute_dtype,
-                          ffn=ffn), layer_cache)
+    return (_branches_residual(bp, x, o, h, cfg=cfg,
+                               compute_dtype=compute_dtype, ffn=ffn),
+            layer_cache)
 
 
 def init_cache(cfg: LlamaConfig, batch: int, max_len: int, dtype=jnp.float32):
@@ -941,9 +1057,8 @@ def make_generate_seq_sharded(cfg: LlamaConfig, mesh, *, max_new_tokens: int,
             y = y.reshape(b, cfg.n_head, 1, hd)
             o = linear(bp["attn"]["o"], merge_heads(y.astype(x.dtype)),
                        compute_dtype=compute_dtype)
-            x = _attn_out_residual(bp, x, o, cfg)
-            return (_mlp_residual(bp, x, cfg=cfg,
-                                  compute_dtype=compute_dtype),
+            return (_branches_residual(bp, x, o, h, cfg=cfg,
+                                       compute_dtype=compute_dtype),
                     lc_k, lc_v)
 
         def decode_one(local, tok, rng, p):
@@ -1052,7 +1167,7 @@ class LlamaFamilyRows:
                         kv)
         cos, sin = _rope_tables(cfg, pos)  # (B, D)
         cos, sin = cos[:, None, None, :], sin[:, None, None, :]
-        q, k = apply_rope(q, cos, sin), apply_rope(k, cos, sin)
+        q, k = _rope_apply(q, cos, sin, cfg), _rope_apply(k, cos, sin, cfg)
         q = _q_rescale(q, cfg)
         layer_cache = codec.write_rows(layer_cache, k, v, pos, write)
         qg = q.reshape(b, kv, g, d)  # group rows share the slot's limit
@@ -1060,9 +1175,9 @@ class LlamaFamilyRows:
         y = y.reshape(b, cfg.n_head, 1, d)
         o = linear(bp["attn"]["o"], merge_heads(y.astype(x.dtype)),
                    compute_dtype=compute_dtype)
-        x = _attn_out_residual(bp, x, o, cfg)
-        return (_mlp_residual(bp, x, cfg=cfg, compute_dtype=compute_dtype,
-                              ffn=self.ffn),
+        return (_branches_residual(bp, x, o, h, cfg=cfg,
+                                   compute_dtype=compute_dtype,
+                                   ffn=self.ffn),
                 layer_cache)
 
     def verify_rows(self, prepared, cache, chunk, pos, active, codec):
@@ -1106,7 +1221,8 @@ class LlamaFamilyRows:
                                     compute_dtype=compute_dtype), kv)
             vv = split_heads(linear(bp["attn"]["v"], h,
                                     compute_dtype=compute_dtype), kv)
-            q, kk = apply_rope(q, cos_, sin_), apply_rope(kk, cos_, sin_)
+            q, kk = (_rope_apply(q, cos_, sin_, cfg),
+                     _rope_apply(kk, cos_, sin_, cfg))
             q = _q_rescale(q, cfg)
             lc = codec.write_rows(lc, kk, vv, pos, active)
             # GQA per-row causal attend on the float cache: fold the
@@ -1127,10 +1243,9 @@ class LlamaFamilyRows:
             y = y.reshape(b, cfg.n_head, t, hd)
             o = linear(bp["attn"]["o"], merge_heads(y.astype(carry.dtype)),
                        compute_dtype=compute_dtype)
-            carry = _attn_out_residual(bp, carry, o, cfg)
-            return (_mlp_residual(bp, carry, cfg=cfg,
-                                  compute_dtype=compute_dtype,
-                                  ffn=self.ffn), lc)
+            return (_branches_residual(bp, carry, o, h, cfg=cfg,
+                                       compute_dtype=compute_dtype,
+                                       ffn=self.ffn), lc)
 
         x, new_cache = lax.scan(layer, x, (prepared["blocks"], cache))
         logits = head(prepared, x.astype(jnp.float32), cfg=cfg,
@@ -1306,6 +1421,18 @@ def to_hf_config(cfg: LlamaConfig, *, tie_word_embeddings: bool = False,
         rms_norm_eps=cfg.rms_eps,
         tie_word_embeddings=tie_word_embeddings or cfg.tie_word_embeddings,
     )
+    if cfg.parallel_block:
+        # Phi family: parallel residual, biased LayerNorms, partial
+        # rotary, plain gelu MLP (HF "gelu_new" IS the tanh approx).
+        # Reuses kw (the one-mapping contract) — only the eps key
+        # renames and the Phi-specific fields add on top.
+        kw["layer_norm_eps"] = kw.pop("rms_norm_eps")
+        kw.update(
+            partial_rotary_factor=(cfg.rotary_dim or cfg.head_dim)
+            / cfg.head_dim,
+            hidden_act="gelu_new")
+        kw.update(overrides)
+        return transformers.PhiConfig(**kw)
     if cfg.norm_plus_one:
         # Gemma family: (1+w) norms, GeGLU, scaled+tied embeddings
         kw.update(head_dim=cfg.head_dim,
@@ -1350,6 +1477,10 @@ def to_hf_config(cfg: LlamaConfig, *, tie_word_embeddings: bool = False,
 
 def _register(name: str, cfg: LlamaConfig):
     def convert(sd, _cfg=cfg):
+        if _cfg.parallel_block:  # Phi layout (fc1/fc2, dense, LN biases)
+            from dnn_tpu.io.checkpoint import phi_params_from_state_dict
+
+            return phi_params_from_state_dict(sd, n_layer=_cfg.n_layer)
         from dnn_tpu.io.checkpoint import llama_params_from_state_dict
 
         return llama_params_from_state_dict(

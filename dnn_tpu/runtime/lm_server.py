@@ -317,7 +317,13 @@ class _BatcherWorker(threading.Thread):
                 # growth pathology that segfaults XLA's CPU compiler in
                 # the test suite (utils/xla_cache.py has the story);
                 # cleared programs recompile transparently on next use.
-                self.cache_guard.maybe_clear()
+                # A guard failure must never kill the worker (callers
+                # would hang to request_timeout) — serving correctness
+                # does not depend on the clear happening.
+                try:
+                    self.cache_guard.maybe_clear()
+                except Exception:  # noqa: BLE001
+                    log.exception("compile-cache guard failed; continuing")
                 try:
                     self._admit(*self.q.get(timeout=0.1))
                 except queue.Empty:
@@ -401,10 +407,19 @@ class LMServer:
         # embedding endpoint: one make_embed per pooling (jit caches per
         # padded-length shape underneath)
         self._embed_fns: dict = {}
+        # embed calls run device work OUTSIDE the worker thread
+        # (asyncio.to_thread) — the cache guard must not clear while one
+        # is in flight, and must never iterate _embed_fns mid-insert
+        self._embed_inflight = 0
+        self._embed_lock = threading.Lock()
         self.worker = _BatcherWorker(
             self.batcher, compile_cache_budget=compile_cache_budget)
         # lazily-created program families count toward the compile budget
-        self.worker.cache_guard.register(lambda: self._embed_fns.values())
+        # (snapshot copy: the guard runs on the worker thread)
+        self.worker.cache_guard.register(
+            lambda: list(self._embed_fns.values()))
+        self.worker.cache_guard.add_busy_check(
+            lambda: self._embed_inflight > 0)
         self.worker.start()
 
     _MAX_JSON_DEPTH = 3  # regex expansion grows with depth; bound it
@@ -572,8 +587,17 @@ class LMServer:
         padded_len = min(-(-t // p_pad) * p_pad, cfg.block_size)
         ids = np.zeros((1, max(padded_len, t)), np.int32)
         ids[0, :t] = prompt.reshape(-1)
-        out = fn(self.batcher.prepared, ids, np.asarray([t], np.int32))
-        return np.asarray(out[0], np.float32)
+        # in-flight marker: the worker's cache guard must not
+        # jax.clear_caches() while this thread is inside the program
+        with self._embed_lock:
+            self._embed_inflight += 1
+        try:
+            out = fn(self.batcher.prepared, ids,
+                     np.asarray([t], np.int32))
+            return np.asarray(out[0], np.float32)
+        finally:
+            with self._embed_lock:
+                self._embed_inflight -= 1
 
     async def SendTensor(self, request: pb.TensorRequest, context) -> pb.TensorResponse:
         prompt = await self._validated_prompt(request, context)

@@ -47,18 +47,29 @@ class CompileCacheGuard:
     """Budgeted `jax.clear_caches()` for a long-lived serving loop.
 
     `register(fn)` adds a jitted entry point (or a zero-arg callable
-    returning an iterable of them — for lazily-created program families
-    like the daemon's per-pooling embed fns). `maybe_clear()` — call it
-    ONLY at a safe boundary — clears every XLA cache when the registered
-    entry count reaches `budget`. budget <= 0 disables."""
+    returning a LIST of them — for lazily-created program families like
+    the daemon's per-pooling embed fns; return a snapshot copy, not a
+    live dict view, so the guard never iterates a structure another
+    thread is inserting into). `add_busy_check(fn)` adds a zero-arg
+    predicate; while any returns True the guard holds off — device work
+    that runs OUTSIDE the calling loop (the daemon's embed endpoint
+    runs on asyncio.to_thread) must register one, or a clear could land
+    mid-flight on that thread. `maybe_clear()` — call it ONLY at a safe
+    boundary — clears every XLA cache when the registered entry count
+    reaches `budget`. budget <= 0 disables."""
 
     def __init__(self, budget: int):
         self.budget = int(budget)
         self.clears = 0  # observability: soak test + ops metrics
         self._fns: List[Callable] = []
+        self._busy: List[Callable] = []
 
     def register(self, fn):
         self._fns.append(fn)
+        return fn
+
+    def add_busy_check(self, fn):
+        self._busy.append(fn)
         return fn
 
     def _entries(self) -> int:
@@ -76,6 +87,8 @@ class CompileCacheGuard:
     def maybe_clear(self) -> bool:
         if self.budget <= 0 or self._entries() < self.budget:
             return False
+        if any(b() for b in self._busy):
+            return False  # device work in flight on another thread
         import jax
 
         jax.clear_caches()

@@ -1,0 +1,150 @@
+"""Phi family: the parallel-residual block (Phi-2 shape) on the LLaMA
+machinery — biased LayerNorms, partial rotary, plain gelu MLP, biases on
+every projection.
+
+The switches ride the same one-definition helpers every other family
+uses (_norm, _rope_apply, _branches_residual), so the dense forward,
+cached decode, batcher rows, and partitions inherit them with no
+per-path plumbing — pinned here against HF PhiForCausalLM and the
+framework's own cross-path parity contracts.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dnn_tpu.models import gpt, llama
+
+CFG = llama.PRESETS["phi-test"]  # L=4, H=4 (MHA), C=64, rotary 8 of 16
+
+
+def _params(seed=0):
+    return llama.init(jax.random.PRNGKey(seed), CFG)
+
+
+def test_structure():
+    p = _params()
+    blk = p["h_0"]
+    assert "ln_2" not in blk, "parallel block has ONE norm"
+    assert "bias" in blk["ln_1"] and "bias" in p["ln_f"]  # LayerNorm
+    assert "gate" not in blk["mlp"], "plain MLP: fc1/fc2 only"
+    for k in ("up", "down"):
+        assert "bias" in blk["mlp"][k], k
+    assert "bias" in blk["attn"]["o"] and "bias" in p["lm_head"]
+    assert CFG.rotary_dim == 8 and CFG.head_dim == 16
+
+
+def test_config_validation():
+    import dataclasses
+
+    with pytest.raises(ValueError, match="incompatible"):
+        dataclasses.replace(CFG, post_norms=True)
+    with pytest.raises(ValueError, match="rotary_dim"):
+        dataclasses.replace(CFG, rotary_dim=7)  # odd
+    with pytest.raises(ValueError, match="rotary_dim"):
+        dataclasses.replace(CFG, rotary_dim=32)  # > head_dim
+
+
+def test_partial_rotary_leaves_tail_dims_unrotated():
+    """The pass-through half is the whole point of partial rotary: a
+    position change must not touch dims >= rotary_dim of q/k."""
+    p = _params()
+    bp = gpt.prepare_stacked(p, CFG)["blocks"]
+    blk = jax.tree.map(lambda a: a[0], bp)
+    h = jax.random.normal(jax.random.PRNGKey(1), (1, 1, CFG.n_embd))
+    q0, k0, _ = llama._qkv_rope(blk, h, jnp.asarray([0]), cfg=CFG,
+                                compute_dtype=None)
+    q9, k9, _ = llama._qkv_rope(blk, h, jnp.asarray([9]), cfg=CFG,
+                                compute_dtype=None)
+    d = CFG.rotary_dim
+    assert not np.allclose(np.asarray(q0)[..., :d], np.asarray(q9)[..., :d])
+    np.testing.assert_array_equal(np.asarray(q0)[..., d:],
+                                  np.asarray(q9)[..., d:])
+    np.testing.assert_array_equal(np.asarray(k0)[..., d:],
+                                  np.asarray(k9)[..., d:])
+
+
+def test_hf_phi_logit_parity():
+    torch = pytest.importorskip("torch")
+    transformers = pytest.importorskip("transformers")
+
+    hf_cfg = llama.to_hf_config(CFG, attn_implementation="eager")
+    assert isinstance(hf_cfg, transformers.PhiConfig)
+    assert hf_cfg.partial_rotary_factor == 0.5
+    torch.manual_seed(0)
+    model = transformers.PhiForCausalLM(hf_cfg).eval()
+    sd = {k: v.numpy() for k, v in model.state_dict().items()}
+    assert any(k.endswith("self_attn.dense.bias") for k in sd)
+
+    from dnn_tpu.io.checkpoint import phi_params_from_state_dict
+
+    params = phi_params_from_state_dict(sd)
+    ids = np.random.RandomState(1).randint(0, CFG.vocab_size, (2, 12))
+    with torch.no_grad():
+        want = model(torch.from_numpy(ids)).logits.numpy()
+    got = np.asarray(llama.make_apply(CFG)(params, jnp.asarray(ids)))
+    np.testing.assert_allclose(got, want, atol=2e-3, rtol=2e-3)
+    np.testing.assert_array_equal(got.argmax(-1), want.argmax(-1))
+
+    # greedy-generate parity: the cached decode (partial rotary at
+    # cache positions, parallel residual per step) matches HF generate
+    prompt = np.random.RandomState(2).randint(0, CFG.vocab_size, (1, 10))
+    n_new = 12
+    with torch.no_grad():
+        hf_out = model.generate(torch.from_numpy(prompt),
+                                max_new_tokens=n_new, do_sample=False,
+                                pad_token_id=0)
+    want_toks = hf_out.numpy()[0, 10:]
+    prepared = gpt.prepare_stacked(params, CFG)
+    got_toks = np.asarray(llama.make_generate(CFG, max_new_tokens=n_new)(
+        prepared, jnp.asarray(prompt), jax.random.PRNGKey(0)))[0]
+    np.testing.assert_array_equal(got_toks, want_toks)
+
+
+def test_generate_matches_stepwise_forward():
+    p = _params(seed=3)
+    prepared = gpt.prepare_stacked(p, CFG)
+    apply = llama.make_apply(CFG)
+    prompt = np.random.RandomState(4).randint(0, CFG.vocab_size, (1, 8))
+    ids = list(prompt[0])
+    for _ in range(8):
+        logits = np.asarray(apply(p, jnp.asarray([ids])))
+        ids.append(int(logits[0, -1].argmax()))
+    want = np.asarray(ids[8:])
+    got = np.asarray(llama.make_generate(CFG, max_new_tokens=8)(
+        prepared, jnp.asarray(prompt), jax.random.PRNGKey(0)))[0]
+    np.testing.assert_array_equal(got, want)
+
+
+def test_batcher_matches_solo():
+    from dnn_tpu.runtime.serving import ContinuousBatcher
+
+    p = _params(seed=5)
+    prepared = gpt.prepare_stacked(p, CFG)
+    prompts = [np.asarray([3, 1, 4, 1, 5]), np.asarray([9, 2, 6])]
+    n_new = 7
+    solo = llama.make_generate(CFG, max_new_tokens=n_new)
+    want = [np.asarray(solo(prepared, jnp.asarray(pr[None]),
+                            jax.random.PRNGKey(0)))[0] for pr in prompts]
+    srv = ContinuousBatcher(CFG, prepared, slots=2, max_len=CFG.block_size,
+                            prompt_pad=8,
+                            family=llama.LlamaFamilyRows(CFG))
+    rids = [srv.submit(pr, max_new_tokens=n_new) for pr in prompts]
+    srv.drain()
+    for rid, w in zip(rids, want):
+        np.testing.assert_array_equal(srv.results[rid], w)
+
+
+def test_registry_and_partition_compose():
+    from dnn_tpu.registry import get_model
+
+    spec = get_model("phi-test")
+    params = spec.init(jax.random.PRNGKey(0))
+    x = np.random.RandomState(0).randint(0, CFG.vocab_size, (2, 8))
+    full = np.asarray(spec.apply(params, jnp.asarray(x)))
+    stages = spec.partition(2)
+    h = jnp.asarray(x)
+    for st in stages:
+        h = st.apply(st.slice_params(params), h)
+    np.testing.assert_allclose(np.asarray(h), full, atol=1e-4, rtol=1e-4)
