@@ -212,11 +212,15 @@ def llama_state_dict_from_params(params) -> Dict[str, np.ndarray]:
         if "bias" in leaf:  # Qwen2-class q/k/v biases
             sd[p + ".bias"] = _np(leaf["bias"])
 
+    n_layer = sum(1 for k in params if k.startswith("h_"))
+    if n_layer and "ln_2" not in params["h_0"]:
+        # Phi layout (parallel block: one norm per layer, fc1/fc2,
+        # dense) exports through its own branch
+        return phi_state_dict_from_params(params)
     sd: Dict[str, np.ndarray] = {
         "model.embed_tokens.weight": _np(params["wte"]["embedding"]),
         "model.norm.weight": _np(params["ln_f"]["scale"]),
     }
-    n_layer = sum(1 for k in params if k.startswith("h_"))
     for i in range(n_layer):
         bp = params[f"h_{i}"]
         p = f"model.layers.{i}."
@@ -240,4 +244,39 @@ def llama_state_dict_from_params(params) -> Dict[str, np.ndarray]:
                 _np(bp["ln_2"]["scale"])
     if "lm_head" in params:
         sd["lm_head.weight"] = _np(params["lm_head"]["kernel"]).T
+    return sd
+
+
+def phi_state_dict_from_params(params) -> Dict[str, np.ndarray]:
+    """Framework Phi params (parallel block — models/llama.py
+    parallel_block configs) -> an HF `PhiForCausalLM`-style state dict;
+    inverse of checkpoint.phi_params_from_state_dict. Biased LayerNorms
+    export weight+bias, the o projection exports as `self_attn.dense`,
+    the plain MLP as `mlp.fc1/fc2`, and lm_head keeps its bias —
+    the same fine-tune-and-hand-back loop the LLaMA exporter gives."""
+
+    def _lin(p, leaf):
+        sd[p + ".weight"] = _np(leaf["kernel"]).T
+        if "bias" in leaf:
+            sd[p + ".bias"] = _np(leaf["bias"])
+
+    sd: Dict[str, np.ndarray] = {
+        "model.embed_tokens.weight": _np(params["wte"]["embedding"]),
+        "model.final_layernorm.weight": _np(params["ln_f"]["scale"]),
+        "model.final_layernorm.bias": _np(params["ln_f"]["bias"]),
+    }
+    n_layer = sum(1 for k in params if k.startswith("h_"))
+    for i in range(n_layer):
+        bp = params[f"h_{i}"]
+        p = f"model.layers.{i}."
+        sd[p + "input_layernorm.weight"] = _np(bp["ln_1"]["scale"])
+        sd[p + "input_layernorm.bias"] = _np(bp["ln_1"]["bias"])
+        _lin(p + "self_attn.q_proj", bp["attn"]["q"])
+        _lin(p + "self_attn.k_proj", bp["attn"]["k"])
+        _lin(p + "self_attn.v_proj", bp["attn"]["v"])
+        _lin(p + "self_attn.dense", bp["attn"]["o"])
+        _lin(p + "mlp.fc1", bp["mlp"]["up"])
+        _lin(p + "mlp.fc2", bp["mlp"]["down"])
+    if "lm_head" in params:
+        _lin("lm_head", params["lm_head"])
     return sd

@@ -908,12 +908,13 @@ def make_apply_seq_parallel(cfg: LlamaConfig, mesh, *, axis_name=None,
     from dnn_tpu.parallel.mesh import SEQ_AXIS
     from dnn_tpu.parallel.ring_attention import ring_attention_local
 
-    if cfg.sliding_window is not None:
+    if cfg.alt_window:
         raise ValueError(
-            "sequence-parallel forward computes full causal attention; "
-            "sliding-window configs are not supported on this path "
-            "(a banded ring schedule could skip out-of-window hops — "
-            "not implemented)")
+            "alternating-window configs (Gemma-2) are not supported on "
+            "the sequence-parallel path: blocks share one attention "
+            "body, and the per-layer window channel is not threaded "
+            "through the ring (uniform sliding_window IS supported — "
+            "the banded ring schedule)")
     if cfg.attn_softcap is not None:
         raise ValueError(
             "attention softcapping is not supported on the ring-attention "
@@ -938,7 +939,11 @@ def make_apply_seq_parallel(cfg: LlamaConfig, mesh, *, axis_name=None,
             q, k, v = _qkv_rope(bp, h, pos, cfg=cfg,
                                 compute_dtype=compute_dtype)
             qg = q.reshape(b, kv, g * t_local, d)  # fold group into rows
-            y = ring_attention_local(qg, k, v, axis_name=axis, causal=True)
+            # sliding-window configs ride the banded ring: the band's
+            # lower bound masks per block AND the ring stops after the
+            # live hops (parallel/ring_attention.py)
+            y = ring_attention_local(qg, k, v, axis_name=axis, causal=True,
+                                     window=cfg.sliding_window)
             y = y.reshape(b, cfg.n_head, t_local, d)
             return linear(bp["attn"]["o"], merge_heads(y.astype(h.dtype)),
                           compute_dtype=compute_dtype)

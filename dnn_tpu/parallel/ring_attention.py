@@ -15,6 +15,14 @@ Causality is resolved block-wise from ring positions: a K/V block that
 originated at a later shard is fully masked, the diagonal block gets the
 triangular mask, earlier blocks attend fully. All devices run the same
 program (SPMD); dead blocks cost one masked matmul rather than a branch.
+
+Sliding windows (Mistral-class) ride a BANDED ring schedule: the mask
+adds the lower bound `q_pos - k_pos < window`, and — because a block
+more than ceil(window / T_local) hops old is out-of-window for EVERY
+query on every shard — the ring stops after that many hops instead of
+circulating all n blocks: compute AND ICI cost drop from O(T) to
+O(window) per shard, the seq-parallel form of the rolling cache's
+decode win.
 """
 
 from __future__ import annotations
@@ -48,16 +56,21 @@ def _block_attend(q, k, v, m, l, acc, mask):
     return m_new, l_new, acc_new
 
 
-def ring_attention_local(q, k, v, *, axis_name: str = SEQ_AXIS, causal: bool = True):
+def ring_attention_local(q, k, v, *, axis_name: str = SEQ_AXIS,
+                         causal: bool = True, window=None):
     """Per-device body (call inside shard_map). q/k/v are the local sequence
     shards, (B, H, T_local, D); returns the local output shard.
 
     GQA-aware: q's row dim may be G * T_local with k/v at T_local and KV
     heads (the group folded into rows — see llama._gqa_scores_attend);
     each group of rows then shares its position's causal mask, i.e. the
-    triangular mask tiles G times down the rows. K/V rotate the ring at
-    KV-head width — the narrow blocks are GQA's ICI-bandwidth win here,
-    exactly as the narrow cache is its HBM win at decode."""
+    masks tile G times down the rows. K/V rotate the ring at KV-head
+    width — the narrow blocks are GQA's ICI-bandwidth win here, exactly
+    as the narrow cache is its HBM win at decode.
+
+    `window` (static int, causal only) adds the sliding-window lower
+    bound AND shortens the ring to its live hops (module docstring —
+    out-of-window blocks are never fetched)."""
     n = lax.axis_size(axis_name)
     my = lax.axis_index(axis_name)
     t_kv = k.shape[2]
@@ -65,20 +78,31 @@ def ring_attention_local(q, k, v, *, axis_name: str = SEQ_AXIS, causal: bool = T
     if q.shape[2] != g * t_kv:
         raise ValueError(
             f"q rows {q.shape[2]} must be a multiple of K/V rows {t_kv}")
+    if window is not None:
+        if not causal:
+            raise ValueError("window= requires causal=True")
+        if window < 1:
+            raise ValueError(f"window must be >= 1, got {window}")
     qf = q.astype(jnp.float32)
 
-    tri = jnp.tile(jnp.tril(jnp.ones((t_kv, t_kv), dtype=bool)), (g, 1))
     full = jnp.ones((g * t_kv, t_kv), dtype=bool)
+    # absolute positions resolve every block-wise mask: local query row r
+    # (group fold repeats positions every t_kv rows) sits at
+    # my*t_kv + r%t_kv; block i's keys originated at shard (my-i) mod n.
+    # Blocks from LATER shards come out fully masked by delta < 0 alone
+    # (their positions all exceed the local queries') — no special case.
+    q_pos = my * t_kv + (jnp.arange(g * t_kv) % t_kv)
 
     def _mask_for(i):
-        # this K/V block originated at shard (my - i) mod n
         src = (my - i) % n
         if not causal:
             return full
-        # src == my: diagonal (triangular); src < my: past (full);
-        # src > my: future (dead). Select via where on the mask.
-        mask = jnp.where(src == my, tri, full)
-        return jnp.logical_and(mask, (src <= my)[..., None, None])
+        k_pos = src * t_kv + jnp.arange(t_kv)
+        delta = q_pos[:, None] - k_pos[None, :]  # (Gq rows, Tk)
+        keep = delta >= 0
+        if window is not None:
+            keep = jnp.logical_and(keep, delta < window)
+        return keep
 
     def step(carry, i):
         k_cur, v_cur, m, l, acc = carry
@@ -90,6 +114,15 @@ def ring_attention_local(q, k, v, *, axis_name: str = SEQ_AXIS, causal: bool = T
         v_nxt = lax.ppermute(v_cur, axis_name, [(j, (j + 1) % n) for j in range(n)])
         return (k_nxt, v_nxt, m, l, acc), None
 
+    # banded schedule: block i's MINIMUM query-key delta is
+    # (i-1)*t_kv + 1 (newest local query vs the block's newest key), so
+    # the block is fully out-of-window as soon as that reaches `window`
+    # — live hops = ceil((window-1)/t_kv) + 1, capped at n (static
+    # count: same program on all devices, just a shorter scan).
+    n_live = n
+    if window is not None and causal:
+        n_live = min(n, -(-(window - 1) // t_kv) + 1)
+
     b, h, t_q, d = q.shape
     init = (
         k, v,
@@ -97,24 +130,30 @@ def ring_attention_local(q, k, v, *, axis_name: str = SEQ_AXIS, causal: bool = T
         jnp.zeros((b, h, t_q, 1), jnp.float32),
         jnp.zeros((b, h, t_q, d), jnp.float32),
     )
-    # scan the first n-1 blocks (each followed by a rotation), then attend
-    # the final block outside the loop — its rotation would be dead weight
-    # (one wasted ICI hop per K and V per call, and per backward).
-    (k_last, v_last, m, l, acc), _ = lax.scan(step, init, jnp.arange(n - 1))
-    m, l, acc = _block_attend(qf, k_last, v_last, m, l, acc, _mask_for(n - 1))
+    # scan the first n_live-1 blocks (each followed by a rotation), then
+    # attend the final live block outside the loop — its rotation would
+    # be dead weight (one wasted ICI hop per K and V per call, and per
+    # backward).
+    (k_last, v_last, m, l, acc), _ = lax.scan(step, init,
+                                              jnp.arange(n_live - 1))
+    m, l, acc = _block_attend(qf, k_last, v_last, m, l, acc,
+                              _mask_for(n_live - 1))
     # fully-masked rows (none exist for causal self-attention since the
     # diagonal block always contributes) would have l == 0; guard anyway.
     return (acc / jnp.maximum(l, 1e-30)).astype(q.dtype)
 
 
-def ring_attention(q, k, v, *, mesh: Mesh, axis_name: str = SEQ_AXIS, causal: bool = True):
+def ring_attention(q, k, v, *, mesh: Mesh, axis_name: str = SEQ_AXIS,
+                   causal: bool = True, window=None):
     """Sharded entry: q/k/v are global (B, H, T, D) arrays; T is split over
     `axis_name`. Output is the full attention result, identical (up to
-    float error) to dnn_tpu.ops.pallas.flash_attention.reference_attention."""
+    float error) to dnn_tpu.ops.pallas.flash_attention.reference_attention
+    (band-masked when `window` is set — the banded ring schedule)."""
     n = mesh.shape[axis_name]
     if q.shape[2] % n != 0:
         raise ValueError(f"sequence length {q.shape[2]} not divisible by ring size {n}")
-    body = functools.partial(ring_attention_local, axis_name=axis_name, causal=causal)
+    body = functools.partial(ring_attention_local, axis_name=axis_name,
+                             causal=causal, window=window)
     spec = P(None, None, axis_name, None)
     return jax.shard_map(
         body, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec, check_vma=False

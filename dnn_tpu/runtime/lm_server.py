@@ -409,9 +409,11 @@ class LMServer:
         self._embed_fns: dict = {}
         # embed calls run device work OUTSIDE the worker thread
         # (asyncio.to_thread) — the cache guard must not clear while one
-        # is in flight, and must never iterate _embed_fns mid-insert
+        # is in flight, and must never iterate _embed_fns mid-insert.
+        # The inflight transitions happen under guard.lock (the guard's
+        # check+clear is atomic under it), closing the race where an
+        # embed enters its program between the check and the clear.
         self._embed_inflight = 0
-        self._embed_lock = threading.Lock()
         self.worker = _BatcherWorker(
             self.batcher, compile_cache_budget=compile_cache_budget)
         # lazily-created program families count toward the compile budget
@@ -588,15 +590,18 @@ class LMServer:
         ids = np.zeros((1, max(padded_len, t)), np.int32)
         ids[0, :t] = prompt.reshape(-1)
         # in-flight marker: the worker's cache guard must not
-        # jax.clear_caches() while this thread is inside the program
-        with self._embed_lock:
+        # jax.clear_caches() while this thread is inside the program —
+        # transitions under guard.lock make the guard's check+clear
+        # atomic against them (utils/xla_cache.py)
+        guard = self.worker.cache_guard
+        with guard.lock:
             self._embed_inflight += 1
         try:
             out = fn(self.batcher.prepared, ids,
                      np.asarray([t], np.int32))
             return np.asarray(out[0], np.float32)
         finally:
-            with self._embed_lock:
+            with guard.lock:
                 self._embed_inflight -= 1
 
     async def SendTensor(self, request: pb.TensorRequest, context) -> pb.TensorResponse:

@@ -53,16 +53,26 @@ class CompileCacheGuard:
     thread is inserting into). `add_busy_check(fn)` adds a zero-arg
     predicate; while any returns True the guard holds off — device work
     that runs OUTSIDE the calling loop (the daemon's embed endpoint
-    runs on asyncio.to_thread) must register one, or a clear could land
-    mid-flight on that thread. `maybe_clear()` — call it ONLY at a safe
-    boundary — clears every XLA cache when the registered entry count
-    reaches `budget`. budget <= 0 disables."""
+    runs on asyncio.to_thread) must register one AND flip the state it
+    reads under `guard.lock` (the check and the clear run atomically
+    under it, so a correctly-locked transition can never slip between
+    them). `maybe_clear()` — call it ONLY at a safe boundary — clears
+    every XLA cache when the registered entry count reaches `budget`.
+    budget <= 0 disables."""
 
     def __init__(self, budget: int):
+        import threading
+
         self.budget = int(budget)
         self.clears = 0  # observability: soak test + ops metrics
         self._fns: List[Callable] = []
         self._busy: List[Callable] = []
+        # check+clear run atomically under this lock; out-of-loop device
+        # work must flip its busy state UNDER THE SAME LOCK (the
+        # daemon's embed path does), or the busy check could pass just
+        # before the work enters its program and the clear land mid-
+        # flight anyway
+        self.lock = threading.Lock()
 
     def register(self, fn):
         self._fns.append(fn)
@@ -87,10 +97,11 @@ class CompileCacheGuard:
     def maybe_clear(self) -> bool:
         if self.budget <= 0 or self._entries() < self.budget:
             return False
-        if any(b() for b in self._busy):
-            return False  # device work in flight on another thread
-        import jax
+        with self.lock:  # atomic with the busy transitions (see __init__)
+            if any(b() for b in self._busy):
+                return False  # device work in flight on another thread
+            import jax
 
-        jax.clear_caches()
-        self.clears += 1
-        return True
+            jax.clear_caches()
+            self.clears += 1
+            return True

@@ -136,6 +136,33 @@ def test_batcher_matches_solo():
         np.testing.assert_array_equal(srv.results[rid], w)
 
 
+def test_torch_export_round_trips_to_hf():
+    """Fine-tune-and-hand-back: framework Phi params export to an HF
+    PhiForCausalLM state dict that loads cleanly and reproduces this
+    framework's logits."""
+    torch = pytest.importorskip("torch")
+    transformers = pytest.importorskip("transformers")
+
+    from dnn_tpu.io.torch_export import llama_state_dict_from_params
+
+    p = _params(seed=7)
+    sd = llama_state_dict_from_params(p)  # auto-detects the Phi layout
+    assert "model.layers.0.self_attn.dense.weight" in sd
+    assert "model.final_layernorm.bias" in sd and "lm_head.bias" in sd
+    model = transformers.PhiForCausalLM(
+        llama.to_hf_config(CFG, attn_implementation="eager")).eval()
+    missing, unexpected = model.load_state_dict(
+        {k: torch.from_numpy(np.ascontiguousarray(v))
+         for k, v in sd.items()}, strict=False)
+    assert not unexpected, unexpected
+    assert all("rotary_emb" in m for m in missing), missing  # buffers
+    ids = np.random.RandomState(8).randint(0, CFG.vocab_size, (2, 10))
+    with torch.no_grad():
+        want = model(torch.from_numpy(ids)).logits.numpy()
+    got = np.asarray(llama.make_apply(CFG)(p, jnp.asarray(ids)))
+    np.testing.assert_allclose(got, want, atol=2e-3, rtol=2e-3)
+
+
 def test_registry_and_partition_compose():
     from dnn_tpu.registry import get_model
 

@@ -202,12 +202,30 @@ def test_paged_pool_rejects_window_families():
                           paged_blocks=8, block_len=8)
 
 
-def test_seq_parallel_rejects_window():
+def test_seq_parallel_banded_ring_matches_dense():
+    """Sliding-window configs now ride the BANDED ring on the
+    sequence-parallel forward (parallel/ring_attention.py): the band's
+    lower bound masks per ring block and out-of-window hops are skipped
+    — logits must match the dense band-masked forward. At t=32 over a
+    4-ring, t_local=8 and window=16 gives live hops
+    ceil(15/8)+1 = 3 < 4, so the hop-skip is genuinely exercised."""
+    from dnn_tpu.parallel.mesh import make_mesh
+
+    mesh = make_mesh({"seq": 4})
+    params = _params(seed=13)
+    prepared = gpt.prepare_stacked(params, CFG)
+    t = 32  # window 16 spans 3 of the 4 shards' blocks
+    ids = np.random.RandomState(14).randint(0, CFG.vocab_size, (2, t))
+    want = np.asarray(llama.make_apply(CFG)(params, jnp.asarray(ids)))
+    got = np.asarray(llama.make_apply_seq_parallel(CFG, mesh)(
+        prepared, jnp.asarray(ids)))
+    np.testing.assert_allclose(got, want, atol=2e-4, rtol=2e-4)
+
+
+def test_seq_sharded_decode_rejects_window():
     from dnn_tpu.parallel.mesh import make_mesh
 
     mesh = make_mesh({"seq": 2})
-    with pytest.raises(ValueError, match="sliding-window"):
-        llama.make_apply_seq_parallel(CFG, mesh)
     with pytest.raises(ValueError, match="sliding-window"):
         llama.make_generate_seq_sharded(CFG, mesh, max_new_tokens=4)
 
