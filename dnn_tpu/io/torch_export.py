@@ -187,6 +187,14 @@ def gpt_state_dict_from_params(params, *, layout: str = "conv1d") -> Dict[str, n
     return sd
 
 
+def _export_lin(sd: Dict[str, np.ndarray], p: str, leaf):
+    """One linear leaf -> torch (out, in) weight + optional bias — the
+    shared export form for every HF-style state dict below."""
+    sd[p + ".weight"] = _np(leaf["kernel"]).T
+    if "bias" in leaf:
+        sd[p + ".bias"] = _np(leaf["bias"])
+
+
 def llama_state_dict_from_params(params) -> Dict[str, np.ndarray]:
     """Framework LLaMA-family params -> an HF `LlamaForCausalLM`-style
     state dict ("model."-prefixed), loadable by every family that shares
@@ -208,9 +216,7 @@ def llama_state_dict_from_params(params) -> Dict[str, np.ndarray]:
     side."""
 
     def _lin(p, leaf):
-        sd[p + ".weight"] = _np(leaf["kernel"]).T
-        if "bias" in leaf:  # Qwen2-class q/k/v biases
-            sd[p + ".bias"] = _np(leaf["bias"])
+        _export_lin(sd, p, leaf)  # Qwen2-class q/k/v biases ride along
 
     n_layer = sum(1 for k in params if k.startswith("h_"))
     if n_layer and "ln_2" not in params["h_0"]:
@@ -256,9 +262,7 @@ def phi_state_dict_from_params(params) -> Dict[str, np.ndarray]:
     the same fine-tune-and-hand-back loop the LLaMA exporter gives."""
 
     def _lin(p, leaf):
-        sd[p + ".weight"] = _np(leaf["kernel"]).T
-        if "bias" in leaf:
-            sd[p + ".bias"] = _np(leaf["bias"])
+        _export_lin(sd, p, leaf)
 
     sd: Dict[str, np.ndarray] = {
         "model.embed_tokens.weight": _np(params["wte"]["embedding"]),

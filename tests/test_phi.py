@@ -136,6 +136,26 @@ def test_batcher_matches_solo():
         np.testing.assert_array_equal(srv.results[rid], w)
 
 
+def test_pipeline_decode_matches_solo():
+    """The parallel block + partial rotary ride the generic pipeline
+    decode (stage-ring ppermute, per-stage cache shards) unchanged —
+    token parity with the solo decoder on the 4-stage mesh."""
+    from dnn_tpu.parallel.mesh import STAGE_AXIS, make_mesh
+    from dnn_tpu.runtime.generate import prepare_pipeline_stacked
+
+    mesh = make_mesh({STAGE_AXIS: 4}, jax.devices()[:4])
+    p = _params(seed=6)
+    prepared = gpt.prepare_stacked(p, CFG)
+    stage_blocks, aux = prepare_pipeline_stacked(prepared, CFG, mesh)
+    prompt = np.random.RandomState(7).randint(0, CFG.vocab_size, (2, 5))
+    want = np.asarray(llama.make_generate(CFG, max_new_tokens=6)(
+        prepared, jnp.asarray(prompt), jax.random.PRNGKey(1)))
+    got = np.asarray(llama.make_pipeline_generate(
+        CFG, mesh, max_new_tokens=6)(
+        stage_blocks, aux, jnp.asarray(prompt), jax.random.PRNGKey(1)))
+    np.testing.assert_array_equal(got, want)
+
+
 def test_torch_export_round_trips_to_hf():
     """Fine-tune-and-hand-back: framework Phi params export to an HF
     PhiForCausalLM state dict that loads cleanly and reproduces this
