@@ -48,6 +48,14 @@ class MixtralConfig(llama.LlamaConfig):
     # >= n_expert guarantees no token ever drops (parity configs);
     # serving configs trade capacity for static-shape efficiency
     capacity_factor: float = 8.0
+    # ---- Qwen2-MoE-class switches (defaults = Mixtral semantics) ----
+    # Always-on SHARED expert (DeepSeek/Qwen-MoE recipe): a dense SwiGLU
+    # of width d_shared whose output, scaled by a per-token sigmoid gate
+    # (shared_expert_gate), adds to the routed experts' output.
+    d_shared: Optional[int] = None
+    # True (Mixtral): renormalize the selected top-k router weights.
+    # False (Qwen2-MoE norm_topk_prob=false): keep raw softmax probs.
+    router_norm_topk: bool = True
 
     def default_ffn(self, compute_dtype=None):
         """The config-resolved MLP override every llama runtime entry
@@ -70,7 +78,76 @@ PRESETS = {
                                   n_embd=64, d_ff=128,
                                   n_expert=4, router_top_k=2,
                                   capacity_factor=4.0),
+    # Qwen1.5-MoE-A2.7B shape: Qwen2 attention (q/k/v biases), 60
+    # fine-grained experts top-4 with RAW softmax weights
+    # (norm_topk_prob=false), plus the always-on sigmoid-gated shared
+    # expert — the modern shared-expert MoE recipe
+    "qwen15-moe-a2.7b": MixtralConfig(block_size=8192, vocab_size=151936,
+                                      n_layer=24, n_head=16, n_kv_head=16,
+                                      n_embd=2048, d_ff=1408,
+                                      rope_theta=1_000_000.0,
+                                      rms_eps=1e-6, attn_bias=True,
+                                      n_expert=60, router_top_k=4,
+                                      # no-drop (>= n_expert): the HF
+                                      # parity convention; serving can
+                                      # size it down (capacity trade)
+                                      capacity_factor=60.0,
+                                      d_shared=5632,
+                                      router_norm_topk=False),
+    # tiny shared-expert config for tests (every switch acts: biases,
+    # raw top-k weights, shared expert + gate)
+    "qwen2moe-test": MixtralConfig(block_size=64, vocab_size=256,
+                                   n_layer=3, n_head=4, n_kv_head=2,
+                                   n_embd=64, d_ff=32, attn_bias=True,
+                                   n_expert=4, router_top_k=2,
+                                   capacity_factor=4.0, d_shared=96,
+                                   router_norm_topk=False),
 }
+
+
+def _shared_expert_out(moe_p, h, *, compute_dtype=None):
+    """The always-on shared expert (Qwen2-MoE / DeepSeek recipe): a
+    dense SwiGLU over h, scaled per token by sigmoid(h @ shared_gate).
+    Adds to the ROUTED output — identical math on the dense-grouped and
+    EP paths (the shared weights replicate; only routed experts
+    shard)."""
+    from dnn_tpu.ops.nn import linear, silu
+
+    sp = moe_p["shared"]
+    s = linear(sp["down"],
+               silu(linear(sp["gate"], h, compute_dtype=compute_dtype))
+               * linear(sp["up"], h, compute_dtype=compute_dtype),
+               compute_dtype=compute_dtype)
+    g = jax.nn.sigmoid(
+        linear(moe_p["shared_gate"], h,
+               compute_dtype=compute_dtype).astype(jnp.float32))
+    return (g * s.astype(jnp.float32)).astype(h.dtype)
+
+
+def _local_ep_ffn(cfg: MixtralConfig, *, axis: str, capacity: int,
+                  compute_dtype=None):
+    """The per-device EP ffn closure every expert-parallel builder
+    installs (make_apply_ep, make_generate_ep, make_pipeline_generate_ep
+    — ONE definition so a new MoE switch cannot silently diverge
+    between them): routed experts via moe_ffn_local (all_to_all over
+    `axis`), plus the locally-computed shared expert for d_shared
+    configs (its weights replicate; only routed experts shard)."""
+    from dnn_tpu.parallel.moe import moe_ffn_local
+
+    def ffn(bp, h):
+        d = h.shape[-1]
+        out = moe_ffn_local(
+            bp["moe"], h.reshape(-1, d), top_k=cfg.router_top_k,
+            capacity=capacity, axis_name=axis,
+            compute_dtype=compute_dtype,
+            normalize=cfg.router_norm_topk,
+        ).reshape(h.shape).astype(h.dtype)
+        if cfg.d_shared:
+            out = out + _shared_expert_out(bp["moe"], h,
+                                           compute_dtype=compute_dtype)
+        return out
+
+    return ffn
 
 
 def make_ffn(cfg: MixtralConfig, *, compute_dtype=None, groups: int = 1):
@@ -79,9 +156,14 @@ def make_ffn(cfg: MixtralConfig, *, compute_dtype=None, groups: int = 1):
     token-identical decode (1 everywhere by default)."""
 
     def ffn(bp, h):
-        return moe_ffn(bp["moe"], h, top_k=cfg.router_top_k,
-                       capacity_factor=cfg.capacity_factor, groups=groups,
-                       compute_dtype=compute_dtype)
+        out = moe_ffn(bp["moe"], h, top_k=cfg.router_top_k,
+                      capacity_factor=cfg.capacity_factor, groups=groups,
+                      compute_dtype=compute_dtype,
+                      normalize=cfg.router_norm_topk)
+        if cfg.d_shared:
+            out = out + _shared_expert_out(bp["moe"], h,
+                                           compute_dtype=compute_dtype)
+        return out
 
     return ffn
 
@@ -89,13 +171,31 @@ def make_ffn(cfg: MixtralConfig, *, compute_dtype=None, groups: int = 1):
 def init(rng, cfg: MixtralConfig = PRESETS["mixtral-test"],
          dtype=jnp.float32):
     """llama.init minus the dense MLPs (include_mlp=False — no transient
-    dense weights at 8x7b scale), plus each block's gated expert
-    stack."""
+    dense weights at 8x7b scale), plus each block's gated expert stack
+    (and, for d_shared configs, the always-on shared expert + its
+    sigmoid gate)."""
+    import math
+
     params = llama.init(rng, cfg, dtype, include_mlp=False)
     keys = jax.random.split(jax.random.fold_in(rng, 7), cfg.n_layer)
     for i in range(cfg.n_layer):
-        params[f"h_{i}"]["moe"] = init_moe_gated(
-            keys[i], cfg.n_embd, cfg.n_expert, cfg.d_ff, dtype)
+        moe = init_moe_gated(keys[i], cfg.n_embd, cfg.n_expert, cfg.d_ff,
+                             dtype)
+        if cfg.d_shared:
+            ks = jax.random.split(jax.random.fold_in(keys[i], 1), 4)
+            si = 1.0 / math.sqrt(cfg.n_embd)
+            so = 1.0 / math.sqrt(cfg.d_shared)
+            moe["shared"] = {
+                "gate": {"kernel": (jax.random.normal(
+                    ks[0], (cfg.n_embd, cfg.d_shared)) * si).astype(dtype)},
+                "up": {"kernel": (jax.random.normal(
+                    ks[1], (cfg.n_embd, cfg.d_shared)) * si).astype(dtype)},
+                "down": {"kernel": (jax.random.normal(
+                    ks[2], (cfg.d_shared, cfg.n_embd)) * so).astype(dtype)},
+            }
+            moe["shared_gate"] = {"kernel": (jax.random.normal(
+                ks[3], (cfg.n_embd, 1)) * si).astype(dtype)}
+        params[f"h_{i}"]["moe"] = moe
     return params
 
 
@@ -181,13 +281,8 @@ def make_apply_ep(cfg: MixtralConfig, mesh, *, axis_name: Optional[str] = None,
         capacity = moe_capacity(s, cfg.n_expert, cfg.router_top_k,
                                 cfg.capacity_factor)
 
-        def ep_ffn(bp, h):
-            d = h.shape[-1]
-            return moe_ffn_local(
-                bp["moe"], h.reshape(-1, d), top_k=cfg.router_top_k,
-                capacity=capacity, axis_name=axis,
-                compute_dtype=compute_dtype,
-            ).reshape(h.shape).astype(h.dtype)
+        ep_ffn = _local_ep_ffn(cfg, axis=axis, capacity=capacity,
+                               compute_dtype=compute_dtype)
 
         x = llama.blocks_scan(prep_local["blocks"], x, cfg=cfg,
                               compute_dtype=compute_dtype, ffn=ep_ffn,
@@ -267,16 +362,8 @@ def make_generate_ep(cfg: MixtralConfig, mesh, *, max_new_tokens: int,
         def ffn_for(tokens_per_group):
             capacity = moe_capacity(tokens_per_group, cfg.n_expert,
                                     cfg.router_top_k, cfg.capacity_factor)
-
-            def ffn(bp, h):
-                d = h.shape[-1]
-                return moe_ffn_local(
-                    bp["moe"], h.reshape(-1, d), top_k=cfg.router_top_k,
-                    capacity=capacity, axis_name=axis,
-                    compute_dtype=compute_dtype,
-                ).reshape(h.shape).astype(h.dtype)
-
-            return ffn
+            return _local_ep_ffn(cfg, axis=axis, capacity=capacity,
+                                 compute_dtype=compute_dtype)
 
         logits, cache = llama.forward_with_cache(
             prep_local, ids_local, cache, 0, cfg=cfg,
@@ -403,16 +490,8 @@ def make_pipeline_generate_ep(cfg: MixtralConfig, mesh, *,
         def ffn_for(tokens_per_group):
             capacity = moe_capacity(tokens_per_group, cfg.n_expert,
                                     cfg.router_top_k, cfg.capacity_factor)
-
-            def ffn(bp, h):
-                dd = h.shape[-1]
-                return moe_ffn_local(
-                    bp["moe"], h.reshape(-1, dd), top_k=cfg.router_top_k,
-                    capacity=capacity, axis_name=e_axis,
-                    compute_dtype=compute_dtype,
-                ).reshape(h.shape).astype(h.dtype)
-
-            return ffn
+            return _local_ep_ffn(cfg, axis=e_axis, capacity=capacity,
+                                 compute_dtype=compute_dtype)
 
         def ring_pass(x, cache, start_pos, ffn):
             def sub(carry, s):
@@ -497,15 +576,20 @@ def make_pipeline_generate_ep(cfg: MixtralConfig, mesh, *,
 # --------------------------------------------------------------------------
 
 def params_from_state_dict(sd, *, n_layer: Optional[int] = None):
-    """HF MixtralForCausalLM state dict -> this pytree. Attention/norm/
-    embed leaves ride checkpoint.llama_params_from_state_dict's mapping;
-    each layer's block_sparse_moe converts here: gate.weight (E, D) ->
-    router kernel (D, E); experts.i.{w1,w3,w2}.weight ((F, D)/(F, D)/
-    (D, F) torch Linear layouts) stack expert-major to wg/wu/wd."""
+    """HF MixtralForCausalLM OR Qwen2MoeForCausalLM state dict -> this
+    pytree (layout auto-detected from the keys). Attention/norm/embed
+    leaves ride checkpoint.llama_params_from_state_dict's mapping; each
+    layer's MoE converts here: the router weight (E, D) -> kernel
+    (D, E); per-expert SwiGLU triples stack expert-major to wg/wu/wd
+    (Mixtral: block_sparse_moe.experts.i.{w1,w3,w2}; Qwen2-MoE:
+    mlp.experts.i.{gate,up,down}_proj, plus mlp.shared_expert.* and the
+    sigmoid shared_expert_gate)."""
     import numpy as np
 
     sd = {(k[len("model."):] if k.startswith("model.") else k): v
           for k, v in sd.items()}
+    if any(".mlp.experts." in k for k in sd):
+        return _qwen2_moe_from_sd(sd, n_layer=n_layer)
     if n_layer is None:
         n_layer = 1 + max(
             int(k.split(".")[1]) for k in sd
@@ -548,9 +632,86 @@ def params_from_state_dict(sd, *, n_layer: Optional[int] = None):
     return params
 
 
+def _qwen2_moe_from_sd(sd, *, n_layer: Optional[int] = None):
+    """Qwen2MoeForCausalLM layout (already model.-stripped): routed
+    experts under mlp.experts.i.{gate,up,down}_proj, router under
+    mlp.gate, shared expert + its scalar gate alongside."""
+    import numpy as np
+
+    if n_layer is None:
+        n_layer = 1 + max(
+            int(k.split(".")[1]) for k in sd
+            if k.startswith("layers.") and k.split(".")[1].isdigit())
+
+    # attention/norms/embed via the llama converter on a filtered dict
+    # (it requires mlp.* keys; feed it per-layer aliases pointing at one
+    # expert, then overwrite — the Mixtral converter's trick)
+    base_keys = {k: v for k, v in sd.items() if ".mlp." not in k}
+    for i in range(n_layer):
+        p = f"layers.{i}."
+        e0 = p + "mlp.experts.0."
+        base_keys[p + "mlp.gate_proj.weight"] = sd[e0 + "gate_proj.weight"]
+        base_keys[p + "mlp.up_proj.weight"] = sd[e0 + "up_proj.weight"]
+        base_keys[p + "mlp.down_proj.weight"] = sd[e0 + "down_proj.weight"]
+    from dnn_tpu.io.checkpoint import llama_params_from_state_dict
+
+    params = llama_params_from_state_dict(base_keys, n_layer=n_layer)
+
+    def _t(w):  # torch Linear (out, in) -> (in, out)
+        return np.ascontiguousarray(np.asarray(w).T)
+
+    for i in range(n_layer):
+        p = f"layers.{i}.mlp."
+        n_expert = 1 + max(
+            int(k[len(p + "experts."):].split(".")[0]) for k in sd
+            if k.startswith(p + "experts."))
+        blk = dict(params[f"h_{i}"])
+        del blk["mlp"]
+        blk["moe"] = {
+            "router": {"kernel": _t(sd[p + "gate.weight"])},
+            "wg": np.stack([_t(sd[f"{p}experts.{e}.gate_proj.weight"])
+                            for e in range(n_expert)]),
+            "wu": np.stack([_t(sd[f"{p}experts.{e}.up_proj.weight"])
+                            for e in range(n_expert)]),
+            "wd": np.stack([_t(sd[f"{p}experts.{e}.down_proj.weight"])
+                            for e in range(n_expert)]),
+            "shared": {
+                "gate": {"kernel": _t(sd[p + "shared_expert.gate_proj"
+                                         ".weight"])},
+                "up": {"kernel": _t(sd[p + "shared_expert.up_proj"
+                                       ".weight"])},
+                "down": {"kernel": _t(sd[p + "shared_expert.down_proj"
+                                         ".weight"])},
+            },
+            "shared_gate": {
+                "kernel": _t(sd[p + "shared_expert_gate.weight"])},
+        }
+        params[f"h_{i}"] = blk
+    return params
+
+
 def to_hf_config(cfg: MixtralConfig, **overrides):
-    """transformers.MixtralConfig for parity tests."""
+    """transformers.MixtralConfig (or Qwen2MoeConfig for shared-expert
+    configs) for parity tests."""
     import transformers
+
+    if cfg.d_shared:
+        return transformers.Qwen2MoeConfig(
+            vocab_size=cfg.vocab_size, hidden_size=cfg.n_embd,
+            intermediate_size=cfg.d_ff,
+            moe_intermediate_size=cfg.d_ff,
+            shared_expert_intermediate_size=cfg.d_shared,
+            num_hidden_layers=cfg.n_layer,
+            num_attention_heads=cfg.n_head,
+            num_key_value_heads=cfg.n_kv_head,
+            max_position_embeddings=cfg.block_size,
+            rope_theta=cfg.rope_theta, rms_norm_eps=cfg.rms_eps,
+            num_experts=cfg.n_expert,
+            num_experts_per_tok=cfg.router_top_k,
+            norm_topk_prob=cfg.router_norm_topk,
+            decoder_sparse_step=1,  # every layer sparse (this pytree)
+            tie_word_embeddings=cfg.tie_word_embeddings,
+            **overrides)
 
     kw = dict(
         vocab_size=cfg.vocab_size, hidden_size=cfg.n_embd,

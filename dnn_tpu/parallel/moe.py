@@ -229,7 +229,7 @@ def _group_dispatch(params, xg, *, top_k, capacity, normalize):
 
 def moe_ffn(params, x, *, top_k: int = 2, capacity_factor: float = 1.25,
             groups: int = 1, activation=gelu, compute_dtype=None,
-            return_aux: bool = False):
+            return_aux: bool = False, normalize: bool = True):
     """Dense (single-program) MoE FFN: (B, T, D) -> (B, T, D).
 
     Tokens are routed in `groups` independent groups (B*T must divide by
@@ -246,9 +246,12 @@ def moe_ffn(params, x, *, top_k: int = 2, capacity_factor: float = 1.25,
     capacity = moe_capacity(s, e, top_k, capacity_factor)
 
     xg = x.reshape(groups, s, d)
+    # normalize=False (Qwen2-MoE norm_topk_prob) keeps the RAW softmax
+    # probabilities as combine weights instead of renormalizing the
+    # selected top-k (Mixtral's convention)
     dispatch, combine, aux = jax.vmap(
         lambda g: _group_dispatch(params, g, top_k=top_k, capacity=capacity,
-                                  normalize=True)
+                                  normalize=normalize)
     )(xg)
 
     expert_in = jnp.einsum("gsec,gsd->gecd", dispatch,
@@ -264,7 +267,8 @@ def moe_ffn(params, x, *, top_k: int = 2, capacity_factor: float = 1.25,
 
 
 def moe_ffn_local(params_local, xg, *, top_k, capacity, axis_name,
-                  activation=gelu, compute_dtype=None):
+                  activation=gelu, compute_dtype=None,
+                  normalize: bool = True):
     """Per-device EP body (call inside shard_map): this device's group
     (S, D) + its shard of the experts -> (S, D).
 
@@ -273,7 +277,8 @@ def moe_ffn_local(params_local, xg, *, top_k, capacity, axis_name,
     AllToAll over ICI, replacing the reference's per-hop gRPC sends."""
     dispatch, combine, _aux = _group_dispatch(
         # router weights are replicated; only expert weights are sharded
-        params_local, xg, top_k=top_k, capacity=capacity, normalize=True,
+        params_local, xg, top_k=top_k, capacity=capacity,
+        normalize=normalize,
     )
     expert_in = jnp.einsum("sec,sd->ecd", dispatch, xg.astype(jnp.float32))
     if compute_dtype is not None:
