@@ -1250,7 +1250,10 @@ def _run_device_configs(state):
     a per-config failure row."""
     attempts = int(os.environ.get("DNN_BENCH_CONFIG_ATTEMPTS", "2"))
     backoff = int(os.environ.get("DNN_BENCH_CONFIG_BACKOFF", "45"))
-    timeout = int(os.environ.get("DNN_BENCH_CONFIG_TIMEOUT", "1200"))
+    # 1800 s: the longctx config alone compiles six decode programs
+    # (3 legs x full+prefill-1) at 20-40 s each on a cold chip before
+    # its timed runs even start
+    timeout = int(os.environ.get("DNN_BENCH_CONFIG_TIMEOUT", "1800"))
     for name, _, _ in DEVICE_CONFIGS:
         key = f"device:{name}"
         if state.done.get(key) == "ok":
