@@ -1142,10 +1142,17 @@ class LlamaFamilyRows:
         # thread the per-layer window through the block scan instead.
         self._wins = layer_windows(cfg)
         self.window = None if self._wins is not None else cfg.sliding_window
+        # alt-window configs keep window=None (per-layer channel) — the
+        # paged batcher needs the distinction to reject them explicitly
+        self.alt_window = cfg.alt_window
         # Gemma-2 attention softcapping rides the codec (serving builds
         # the decode codec from this attr)
         self.softcap = cfg.attn_softcap
-        # the paged pool attends causal-only (no band masking)
+        # "attends plain dense causal" — what the SPECULATIVE verifier
+        # requires (its codecs attend dense; serving_spec checks this
+        # flag). The paged pool no longer keys on it: it gates on
+        # softcap/alt_window directly and band-masks uniform windows
+        # itself (runtime/paged_kvcache.PagedKV window=).
         self.paged_ok = (cfg.sliding_window is None
                          and cfg.attn_softcap is None)
 

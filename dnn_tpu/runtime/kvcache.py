@@ -55,7 +55,21 @@ from jax import lax
 _NEG_BIG = -1e30
 
 __all__ = ["FloatKV", "Int8KV", "RollingFloatKV", "RollingInt8KV",
-           "codec_for_cache"]
+           "band_keep", "codec_for_cache"]
+
+
+def band_keep(cols, limit, window):
+    """THE sliding-window band predicate: causal upper bound
+    (cols <= limit) plus the optional lower bound
+    (cols > limit - window). Every codec that band-masks — the dense
+    codecs via _KernelDispatch._band_keep AND the paged pool
+    (runtime/paged_kvcache.PagedKV) — goes through here, so the
+    boundary semantics can never diverge between them. Broadcasts over
+    whatever shapes the caller aligned; `window` may be traced."""
+    keep = cols <= limit
+    if window is not None:
+        keep &= cols > limit - window
+    return keep
 
 
 class _KernelDispatch:
@@ -92,15 +106,11 @@ class _KernelDispatch:
         return s
 
     def _band_keep(self, cols, limit, window=None):
-        """Causal upper bound (cols <= limit) plus the optional
-        sliding-window lower bound (cols > limit - window); broadcasts
-        over whatever shapes the caller aligned. `window` overrides the
-        codec's static window (may be traced — see class docstring)."""
-        w = window if window is not None else self.window
-        keep = cols <= limit
-        if w is not None:
-            keep &= cols > limit - w
-        return keep
+        """The shared band predicate (module-level band_keep) with the
+        codec's static window as the default; `window` overrides it
+        (may be traced — see class docstring)."""
+        return band_keep(cols, limit,
+                         window if window is not None else self.window)
 
     def _rows_keep(self, c, pos, window=None):
         """(B, 1, 1, S) keep-mask for shared-limit decode rows at per-slot

@@ -161,11 +161,20 @@ def init_paged_cache(cfg, slots: int, max_len: int, *, n_blocks: int,
 
 
 class PagedKV:
-    """Codec over the paged pytree — same call surface the batcher's
-    decode/install paths use on the dense codecs (kvcache.FloatKV)."""
+    """Codec over the shared block pool (see module docstring).
 
-    def __init__(self, block_len: int):
+    Same call surface the batcher's decode/install paths use on the
+    dense codecs (kvcache.FloatKV).
+
+    `window=W` (Mistral-class sliding windows) adds the band's lower
+    bound to attend_rows — positions <= pos - W never attend — and is
+    what lets the SERVING layer reclaim fully-rolled-out blocks while a
+    request still runs (ContinuousBatcher._free_rolled_blocks): a long
+    windowed stream holds O(window) pool blocks, not O(stream)."""
+
+    def __init__(self, block_len: int, window: Optional[int] = None):
         self.block_len = block_len
+        self.window = window
 
     # --- decode-row paths (per-layer views: pool (n_blocks, H, bp, D),
     #     tables (B, nb_max)) ------------------------------------------
@@ -228,12 +237,17 @@ class PagedKV:
         """q (B, H, R, D); every row of slot b attends logical positions
         <= pos[b] (identical math to kvcache.FloatKV/Int8KV.attend_rows
         on the gathered view — int8 pools fold their per-position scales
-        onto the score/probability matrices, never a float cache copy).
-        The pool is causal-only: windowed/alt-window families are
-        rejected at batcher construction (paged_ok), so a non-None
-        `window` here is a programming error."""
+        onto the score/probability matrices, never a float cache copy),
+        band-limited by the codec's `window` when set. A per-call
+        `window` override is the dense codecs' per-LAYER channel
+        (alt-window configs) — those are rejected at batcher
+        construction for paged pools, so an override here is a
+        programming error."""
         if window is not None:
-            raise ValueError("PagedKV attends causal-only (no window)")
+            raise ValueError(
+                "PagedKV has no per-layer window channel (alt-window "
+                "families are rejected for paged pools); set the codec's "
+                "window at construction")
         quant = "ks" in c
         if quant:
             k, v, ks, vs = self.gather_view(c, ("k", "v", "ks", "vs"))
@@ -246,8 +260,11 @@ class PagedKV:
         if quant:
             s = s * ks[:, :, None, :]
         s = s / jnp.sqrt(d)
+        from dnn_tpu.runtime.kvcache import band_keep
+
         cols = jnp.arange(k.shape[2])
-        mask = cols[None, None, None, :] <= pos[:, None, None, None]
+        mask = band_keep(cols[None, None, None, :],
+                         pos[:, None, None, None], self.window)
         s = jnp.where(mask, s, _NEG_BIG)
         p = jax.nn.softmax(s, axis=-1)
         if quant:

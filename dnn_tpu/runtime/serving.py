@@ -288,13 +288,23 @@ class ContinuousBatcher:
         # request length (sum of blocks), not slots x max_len.
         self._paged = int(paged_blocks) > 0
         self._allocator = None
+        self._paged_window = None
         if self._paged:
-            if (getattr(self.family, "window", None) is not None
-                    or not getattr(self.family, "paged_ok", True)):
+            fam_window = getattr(self.family, "window", None)
+            if (getattr(self.family, "softcap", None) is not None
+                    or getattr(self.family, "alt_window", False)):
                 raise ValueError(
-                    "sliding-window / softcapped families are not supported "
-                    "with the paged pool (PagedKV attends causal-only; use "
-                    "the dense per-slot cache, which window-masks)")
+                    "softcapped / alternating-window families are not "
+                    "supported with the paged pool (PagedKV has no "
+                    "softcap or per-layer window channel; use the dense "
+                    "per-slot cache)")
+            if fam_window is not None and prefix_cache > 0:
+                raise ValueError(
+                    "windowed paged pools do not compose with the prefix "
+                    "cache: rolled-out blocks are reclaimed mid-request, "
+                    "which would free blocks a prefix entry still shares "
+                    "— serve windowed families with prefix_cache=0")
+            self._paged_window = fam_window
             from dnn_tpu.runtime.paged_kvcache import (
                 BlockAllocator, PagedKV, init_paged_cache,
             )
@@ -315,7 +325,7 @@ class ContinuousBatcher:
                 kv_heads=getattr(self.family, "kv_heads", None))
             self._allocator = BlockAllocator(paged_blocks)
             self._block_len = block_len
-            codec = PagedKV(block_len)
+            codec = PagedKV(block_len, window=fam_window)
 
             def gather_row(cache, ids_row):
                 """Rebuild a transient prefill row from pool blocks (the
@@ -964,7 +974,8 @@ class ContinuousBatcher:
                 self._decode_view = self._lora_prepared(self._aid)
             req = {"rid": rid, "emitted": [first], "budget": max_new_tokens,
                    "stop": stop_seqs, "logprobs": logprobs and self._logprobs_k,
-                   "blocks": paged_taken}
+                   "blocks": paged_taken, "prompt_len": len(prompt),
+                   "freed": 0}
             if constraint is not None:
                 req["constraint"] = constraint
                 req["c_state"] = constraint.start
@@ -975,17 +986,29 @@ class ContinuousBatcher:
             self._slot_req[slot] = req
             if constraint is not None:
                 self._constraint_advance(slot, first)
+            # a prompt longer than the window rolls blocks out at install
+            self._free_rolled_blocks(slot)
             self._retire_if_done(slot)
             return rid
         except BaseException:
             # a failure ANYWHERE in the prefill path must return this
             # request's pool blocks (and un-point its table row) or the
             # pool shrinks permanently on every such failure — same for
-            # its constraint-table reference
+            # its constraint-table reference. For windowed pools,
+            # _free_rolled_blocks may ALREADY have returned the rolled
+            # -out prefix (it runs before _retire_if_done): free only
+            # the remainder, and release the slot if the req landed.
             if paged_taken:
-                self._allocator.free(paged_taken)
+                req_now = self._slot_req[slot]
+                skip = (req_now["freed"]
+                        if isinstance(req_now, dict)
+                        and req_now.get("blocks") is paged_taken else 0)
+                self._allocator.free(paged_taken[skip:])
                 self.cache["tables"] = \
                     self.cache["tables"].at[:, slot].set(0)
+            if self._slot_req[slot] is not None:
+                self._slot_req[slot] = None
+                self.active = self.active.at[slot].set(False)
             if c_off is not None:
                 self._ctab_release(constraint)
             raise
@@ -1060,6 +1083,30 @@ class ContinuousBatcher:
         if e is not None and e["refs"] > 0:
             e["refs"] -= 1  # entry stays cached for reuse until evicted
 
+    def _free_rolled_blocks(self, slot: int):
+        """Windowed paged pools reclaim FULLY rolled-out blocks while
+        the request still runs: block j (positions [j*bp, (j+1)*bp)) is
+        dead once its last position <= attend_limit - window — the band
+        mask excludes it at this and every later step, so its physical
+        block returns to the allocator (a long stream holds O(window)
+        blocks, the pool form of the rolling cache's win) and its table
+        entry points at junk block 0, whose content the mask never
+        admits. No-op for dense/unwindowed pools."""
+        w = self._paged_window
+        req = self._slot_req[slot]
+        if w is None or req is None or not req["blocks"]:
+            return
+        bp = self._block_len
+        limit = req["prompt_len"] + len(req["emitted"]) - 1
+        n_dead = min(max(0, limit - w + 1) // bp, len(req["blocks"]))
+        freed = req["freed"]
+        if n_dead <= freed:
+            return
+        self._allocator.free(req["blocks"][freed:n_dead])
+        self.cache["tables"] = \
+            self.cache["tables"].at[:, slot, freed:n_dead].set(0)
+        req["freed"] = n_dead
+
     def _constraint_advance(self, slot: int, token: int):
         """Walk a constrained slot's DFA over the token it just committed
         and point the slot's device state-row at the new state (the
@@ -1115,7 +1162,8 @@ class ContinuousBatcher:
                 if n else np.zeros((0, self._logprobs_k), np.float32),
             }
         if req["blocks"]:
-            self._allocator.free(req["blocks"])
+            # windowed pools already reclaimed the rolled-out prefix
+            self._allocator.free(req["blocks"][req["freed"]:])
         self._release_slot_constraint(slot, req)
         self._slot_req[slot] = None
         self.active = self.active.at[slot].set(False)
@@ -1169,7 +1217,7 @@ class ContinuousBatcher:
         for slot, req in enumerate(self._slot_req):
             if req is not None and req["rid"] == rid:
                 if req["blocks"]:
-                    self._allocator.free(req["blocks"])
+                    self._allocator.free(req["blocks"][req["freed"]:])
                 self._release_slot_constraint(slot, req)
                 self._slot_req[slot] = None
                 self.active = self.active.at[slot].set(False)
@@ -1219,6 +1267,7 @@ class ContinuousBatcher:
                 # host DFA walk updates the (slots,) state vector only;
                 # the mask rows themselves live on device (_ctable)
                 self._constraint_advance(slot, token)
+            self._free_rolled_blocks(slot)  # windowed pools reclaim
             self._retire_if_done(slot)
         return out
 

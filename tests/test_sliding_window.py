@@ -191,15 +191,27 @@ def test_batcher_windowed_matches_solo_decode():
         np.testing.assert_array_equal(results[rid], want)
 
 
-def test_paged_pool_rejects_window_families():
+def test_paged_pool_serves_window_families():
+    """Windowed families now ride the paged pool (PagedKV band-masks;
+    the batcher reclaims rolled-out blocks — tests/test_paged.py pins
+    the full parity/reclaim contract). Token parity vs the dense
+    windowed batcher on a short stream here as the family-level pin."""
     from dnn_tpu.runtime.serving import ContinuousBatcher
 
     params = _params(seed=12)
     prepared = gpt.prepare_stacked(params, CFG)
-    with pytest.raises(ValueError, match="sliding-window"):
-        ContinuousBatcher(CFG, prepared, slots=2, max_len=32, prompt_pad=8,
-                          family=llama.LlamaFamilyRows(CFG),
-                          paged_blocks=8, block_len=8)
+    prompt = np.asarray([3, 1, 4, 1, 5, 9, 2, 6], np.int32)
+    outs = {}
+    for paged in (False, True):
+        extra = dict(paged_blocks=12, block_len=8) if paged else {}
+        srv = ContinuousBatcher(CFG, prepared, slots=2, max_len=48,
+                                prompt_pad=8,
+                                family=llama.LlamaFamilyRows(CFG),
+                                **extra)
+        rid = srv.submit(prompt, max_new_tokens=24)  # past window=16
+        srv.drain()
+        outs[paged] = srv.results[rid]
+    np.testing.assert_array_equal(outs[False], outs[True])
 
 
 def test_seq_parallel_banded_ring_matches_dense():
