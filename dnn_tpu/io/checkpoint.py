@@ -369,6 +369,11 @@ def llama_params_from_state_dict(sd: Dict[str, np.ndarray],
                 "down": _proj(p + "mlp.down_proj"),
             },
         }
+        if p + "self_attn.q_norm.weight" in sd:  # Qwen3-class qk_norm
+            blk["attn"]["q_norm"] = {
+                "scale": sd[p + "self_attn.q_norm.weight"]}
+            blk["attn"]["k_norm"] = {
+                "scale": sd[p + "self_attn.k_norm.weight"]}
         if post_norms:  # Gemma-2 block: 4 norms, names shift meaning
             blk["post_ln_1"] = {
                 "scale": sd[p + "post_attention_layernorm.weight"]}

@@ -235,6 +235,11 @@ def llama_state_dict_from_params(params) -> Dict[str, np.ndarray]:
         _lin(p + "self_attn.k_proj", bp["attn"]["k"])
         _lin(p + "self_attn.v_proj", bp["attn"]["v"])
         _lin(p + "self_attn.o_proj", bp["attn"]["o"])
+        if "q_norm" in bp["attn"]:  # Qwen3-class qk_norm
+            sd[p + "self_attn.q_norm.weight"] = \
+                _np(bp["attn"]["q_norm"]["scale"])
+            sd[p + "self_attn.k_norm.weight"] = \
+                _np(bp["attn"]["k_norm"]["scale"])
         _lin(p + "mlp.gate_proj", bp["mlp"]["gate"])
         _lin(p + "mlp.up_proj", bp["mlp"]["up"])
         _lin(p + "mlp.down_proj", bp["mlp"]["down"])

@@ -137,6 +137,10 @@ class LlamaConfig:
     # False = the plain 2-layer MLP (fc1 -> act -> fc2; params carry
     # "up"/"down" only, no "gate") instead of the gated SwiGLU/GeGLU.
     mlp_gated: bool = True
+    # Qwen3/OLMo-2-class per-head q/k RMSNorm: normalize each head's
+    # D-vector (weights shape (head_dim,), leaves attn.q_norm/k_norm)
+    # BEFORE RoPE — the training-stability recipe replacing qkv biases.
+    qk_norm: bool = False
 
     def __post_init__(self):
         if self.parallel_block and self.post_norms:
@@ -262,6 +266,20 @@ PRESETS = {
                             parallel_block=True, rotary_dim=8,
                             attn_bias=True, dense_bias=True,
                             mlp_gated=False, mlp_act="gelu_tanh"),
+    # Qwen3-8B shape: the LLaMA block with per-head q/k RMSNorm
+    # (qk_norm — replaces Qwen2's projection biases), GQA 4:1, decoupled
+    # head_dim, long rope base
+    "qwen3-8b": LlamaConfig(block_size=40960, vocab_size=151936,
+                            n_layer=36, n_head=32, n_kv_head=8,
+                            n_embd=4096, d_ff=12288,
+                            head_dim_override=128,
+                            rope_theta=1_000_000.0, rms_eps=1e-6,
+                            qk_norm=True),
+    # tiny qk-norm config for tests
+    "qwen3-test": LlamaConfig(block_size=64, vocab_size=256, n_layer=4,
+                              n_head=4, n_kv_head=2, n_embd=64, d_ff=128,
+                              head_dim_override=32, rms_eps=1e-6,
+                              qk_norm=True),
 }
 
 
@@ -332,6 +350,9 @@ def init_block(key, cfg: LlamaConfig, dtype=jnp.float32, *,
                         std=0.02 / (2 * cfg.n_layer) ** 0.5),
         },
     }
+    if cfg.qk_norm:  # Qwen3-class per-head q/k norms over head_dim
+        blk["attn"]["q_norm"] = {"scale": jnp.ones((d,), dtype)}
+        blk["attn"]["k_norm"] = {"scale": jnp.ones((d,), dtype)}
     if not cfg.parallel_block:  # Phi's parallel block has ONE norm
         blk["ln_2"] = _norm_p((c,))
     if include_mlp:
@@ -456,6 +477,18 @@ def _rope_apply(x, cos, sin, cfg: LlamaConfig):
     return jnp.concatenate([rot, x[..., cfg.rotary_dim:]], axis=-1)
 
 
+def _qk_normed(bp, q, k, cfg: LlamaConfig):
+    """Qwen3-class per-head q/k RMSNorm (over head_dim, BEFORE RoPE) —
+    the ONE definition every q/k projection site shares (_qkv_rope, the
+    batcher's _block_rows, verify_rows), or the paths' parity contracts
+    would diverge on qk_norm configs. Identity when the switch is
+    off."""
+    if not cfg.qk_norm:
+        return q, k
+    return (rms_norm(bp["attn"]["q_norm"], q, eps=cfg.rms_eps),
+            rms_norm(bp["attn"]["k_norm"], k, eps=cfg.rms_eps))
+
+
 def _qkv_rope(bp, h, positions, *, cfg: LlamaConfig, compute_dtype):
     """Project h (B, T, C) and rotate q/k at absolute `positions` (T,).
     Returns q (B, H, T, D), k/v (B, KV, T, D) — KV heads stay narrow."""
@@ -465,6 +498,7 @@ def _qkv_rope(bp, h, positions, *, cfg: LlamaConfig, compute_dtype):
                     cfg.n_kv_head)
     v = split_heads(linear(bp["attn"]["v"], h, compute_dtype=compute_dtype),
                     cfg.n_kv_head)
+    q, k = _qk_normed(bp, q, k, cfg)
     cos, sin = _rope_tables(cfg, positions)
     return (_q_rescale(_rope_apply(q, cos, sin, cfg), cfg),
             _rope_apply(k, cos, sin, cfg), v)
@@ -1177,6 +1211,7 @@ class LlamaFamilyRows:
                         kv)
         v = split_heads(linear(bp["attn"]["v"], h, compute_dtype=compute_dtype),
                         kv)
+        q, k = _qk_normed(bp, q, k, cfg)
         cos, sin = _rope_tables(cfg, pos)  # (B, D)
         cos, sin = cos[:, None, None, :], sin[:, None, None, :]
         q, k = _rope_apply(q, cos, sin, cfg), _rope_apply(k, cos, sin, cfg)
@@ -1233,6 +1268,7 @@ class LlamaFamilyRows:
                                     compute_dtype=compute_dtype), kv)
             vv = split_heads(linear(bp["attn"]["v"], h,
                                     compute_dtype=compute_dtype), kv)
+            q, kk = _qk_normed(bp, q, kk, cfg)
             q, kk = (_rope_apply(q, cos_, sin_, cfg),
                      _rope_apply(kk, cos_, sin_, cfg))
             q = _q_rescale(q, cfg)
@@ -1469,6 +1505,17 @@ def to_hf_config(cfg: LlamaConfig, *, tie_word_embeddings: bool = False,
         # pre-multiplied, which we emit rather than a silent mismatch
         kw["rope_theta"] = cfg.rope_theta * cfg.rope_scale ** (
             cfg.head_dim / (cfg.head_dim - 2))
+    if cfg.qk_norm:
+        # Qwen3: per-head q/k RMSNorm, bias-free, decoupled head_dim
+        if cfg.attn_bias or cfg.sliding_window is not None:
+            # no shipped preset combines these; emit an error rather
+            # than a silently-dropped field (this function's convention)
+            raise ValueError(
+                "qk_norm with attn_bias/sliding_window has no direct "
+                "Qwen3Config mapping here — map this config by hand")
+        kw.update(head_dim=cfg.head_dim, attention_bias=False)
+        kw.update(overrides)
+        return transformers.Qwen3Config(**kw)
     if cfg.sliding_window is not None:
         if cfg.attn_bias:
             raise ValueError(

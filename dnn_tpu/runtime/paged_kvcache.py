@@ -48,6 +48,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
+from dnn_tpu.runtime.kvcache import band_keep
+
 _NEG_BIG = -1e30
 
 __all__ = ["PagedKV", "BlockAllocator", "InsufficientBlocks",
@@ -260,8 +262,6 @@ class PagedKV:
         if quant:
             s = s * ks[:, :, None, :]
         s = s / jnp.sqrt(d)
-        from dnn_tpu.runtime.kvcache import band_keep
-
         cols = jnp.arange(k.shape[2])
         mask = band_keep(cols[None, None, None, :],
                          pos[:, None, None, None], self.window)

@@ -1006,9 +1006,11 @@ class ContinuousBatcher:
                 self._allocator.free(paged_taken[skip:])
                 self.cache["tables"] = \
                     self.cache["tables"].at[:, slot].set(0)
-            if self._slot_req[slot] is not None:
-                self._slot_req[slot] = None
-                self.active = self.active.at[slot].set(False)
+            # the slot was free at entry, so it must end inactive on ANY
+            # failure — active may have been set before the req landed,
+            # and a True-active/None-req slot would spin drain() forever
+            self._slot_req[slot] = None
+            self.active = self.active.at[slot].set(False)
             if c_off is not None:
                 self._ctab_release(constraint)
             raise

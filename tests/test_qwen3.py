@@ -1,0 +1,112 @@
+"""Qwen3 family: the LLaMA block with per-head q/k RMSNorm (qk_norm),
+replacing Qwen2's projection biases.
+
+The norms ride the one _qk_normed helper shared by every q/k projection
+site (dense forward via _qkv_rope, batcher rows, verify rows), so all
+runtime paths inherit them — pinned against HF Qwen3ForCausalLM and the
+framework's own cross-path parity contracts."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dnn_tpu.models import gpt, llama
+
+CFG = llama.PRESETS["qwen3-test"]  # L=4, GQA 2:1, head_dim 32, qk_norm
+
+
+def _params(seed=0):
+    return llama.init(jax.random.PRNGKey(seed), CFG)
+
+
+def test_structure():
+    p = _params()
+    attn = p["h_0"]["attn"]
+    assert attn["q_norm"]["scale"].shape == (CFG.head_dim,)
+    assert attn["k_norm"]["scale"].shape == (CFG.head_dim,)
+    assert "bias" not in attn["q"]  # qk_norm replaces the biases
+
+
+def test_hf_qwen3_parity():
+    torch = pytest.importorskip("torch")
+    transformers = pytest.importorskip("transformers")
+
+    hf_cfg = llama.to_hf_config(CFG, attn_implementation="eager")
+    assert isinstance(hf_cfg, transformers.Qwen3Config)
+    torch.manual_seed(0)
+    model = transformers.Qwen3ForCausalLM(hf_cfg).eval()
+    sd = {k: v.numpy() for k, v in model.state_dict().items()}
+    assert any(k.endswith("q_norm.weight") for k in sd)
+
+    from dnn_tpu.io.checkpoint import llama_params_from_state_dict
+
+    params = llama_params_from_state_dict(sd)
+    ids = np.random.RandomState(1).randint(0, CFG.vocab_size, (2, 12))
+    with torch.no_grad():
+        want = model(torch.from_numpy(ids)).logits.numpy()
+    got = np.asarray(llama.make_apply(CFG)(params, jnp.asarray(ids)))
+    np.testing.assert_allclose(got, want, atol=2e-3, rtol=2e-3)
+    np.testing.assert_array_equal(got.argmax(-1), want.argmax(-1))
+
+    # greedy cached decode == HF generate (q/k normed at every step's
+    # positions, before RoPE)
+    prompt = np.random.RandomState(2).randint(0, CFG.vocab_size, (1, 10))
+    n_new = 12
+    with torch.no_grad():
+        hf_out = model.generate(torch.from_numpy(prompt),
+                                max_new_tokens=n_new, do_sample=False,
+                                pad_token_id=0)
+    want_toks = hf_out.numpy()[0, 10:]
+    prepared = gpt.prepare_stacked(params, CFG)
+    got_toks = np.asarray(llama.make_generate(CFG, max_new_tokens=n_new)(
+        prepared, jnp.asarray(prompt), jax.random.PRNGKey(0)))[0]
+    np.testing.assert_array_equal(got_toks, want_toks)
+
+
+def test_batcher_matches_solo():
+    from dnn_tpu.runtime.serving import ContinuousBatcher
+
+    p = _params(seed=3)
+    prepared = gpt.prepare_stacked(p, CFG)
+    prompts = [np.asarray([3, 1, 4, 1, 5]), np.asarray([9, 2, 6])]
+    n_new = 7
+    solo = llama.make_generate(CFG, max_new_tokens=n_new)
+    want = [np.asarray(solo(prepared, jnp.asarray(pr[None]),
+                            jax.random.PRNGKey(0)))[0] for pr in prompts]
+    srv = ContinuousBatcher(CFG, prepared, slots=2,
+                            max_len=CFG.block_size, prompt_pad=8,
+                            family=llama.LlamaFamilyRows(CFG))
+    rids = [srv.submit(pr, max_new_tokens=n_new) for pr in prompts]
+    srv.drain()
+    for rid, w in zip(rids, want):
+        np.testing.assert_array_equal(srv.results[rid], w)
+
+
+def test_torch_export_round_trips():
+    torch = pytest.importorskip("torch")
+    transformers = pytest.importorskip("transformers")
+
+    from dnn_tpu.io.torch_export import llama_state_dict_from_params
+
+    p = _params(seed=4)
+    sd = llama_state_dict_from_params(p)
+    assert "model.layers.0.self_attn.q_norm.weight" in sd
+    model = transformers.Qwen3ForCausalLM(
+        llama.to_hf_config(CFG, attn_implementation="eager")).eval()
+    missing, unexpected = model.load_state_dict(
+        {k: torch.from_numpy(np.ascontiguousarray(v))
+         for k, v in sd.items()}, strict=False)
+    assert not unexpected, unexpected
+    ids = np.random.RandomState(5).randint(0, CFG.vocab_size, (2, 10))
+    with torch.no_grad():
+        want = model(torch.from_numpy(ids)).logits.numpy()
+    got = np.asarray(llama.make_apply(CFG)(p, jnp.asarray(ids)))
+    np.testing.assert_allclose(got, want, atol=2e-3, rtol=2e-3)
+
+
+def test_registry_registered():
+    from dnn_tpu.registry import get_model
+
+    spec = get_model("qwen3-8b")
+    assert spec.config.qk_norm and spec.config.head_dim == 128
