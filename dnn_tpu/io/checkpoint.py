@@ -356,7 +356,6 @@ def llama_params_from_state_dict(sd: Dict[str, np.ndarray],
     for i in range(n_layer):
         p = f"layers.{i}."
         blk = {
-            "ln_1": {"scale": sd[p + "input_layernorm.weight"]},
             "attn": {
                 "q": _proj(p + "self_attn.q_proj"),
                 "k": _proj(p + "self_attn.k_proj"),
@@ -369,12 +368,21 @@ def llama_params_from_state_dict(sd: Dict[str, np.ndarray],
                 "down": _proj(p + "mlp.down_proj"),
             },
         }
-        if p + "self_attn.q_norm.weight" in sd:  # Qwen3-class qk_norm
+        if p + "input_layernorm.weight" in sd:
+            blk["ln_1"] = {"scale": sd[p + "input_layernorm.weight"]}
+        if p + "self_attn.q_norm.weight" in sd:  # Qwen3/OLMo-2 qk_norm
             blk["attn"]["q_norm"] = {
                 "scale": sd[p + "self_attn.q_norm.weight"]}
             blk["attn"]["k_norm"] = {
                 "scale": sd[p + "self_attn.k_norm.weight"]}
-        if post_norms:  # Gemma-2 block: 4 norms, names shift meaning
+        if post_norms and "ln_1" not in blk:
+            # OLMo-2: post-norm-only block — only the two post-branch
+            # norms exist (no ln_1/ln_2 at all)
+            blk["post_ln_1"] = {
+                "scale": sd[p + "post_attention_layernorm.weight"]}
+            blk["post_ln_2"] = {
+                "scale": sd[p + "post_feedforward_layernorm.weight"]}
+        elif post_norms:  # Gemma-2 block: 4 norms, names shift meaning
             blk["post_ln_1"] = {
                 "scale": sd[p + "post_attention_layernorm.weight"]}
             blk["ln_2"] = {

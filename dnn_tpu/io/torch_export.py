@@ -219,9 +219,11 @@ def llama_state_dict_from_params(params) -> Dict[str, np.ndarray]:
         _export_lin(sd, p, leaf)  # Qwen2-class q/k/v biases ride along
 
     n_layer = sum(1 for k in params if k.startswith("h_"))
-    if n_layer and "ln_2" not in params["h_0"]:
-        # Phi layout (parallel block: one norm per layer, fc1/fc2,
-        # dense) exports through its own branch
+    if (n_layer and "ln_2" not in params["h_0"]
+            and "post_ln_1" not in params["h_0"]):
+        # Phi layout (parallel block: ONE norm per layer, fc1/fc2,
+        # dense) exports through its own branch — distinct from OLMo-2,
+        # which also lacks ln_2 but carries the post-branch norms
         return phi_state_dict_from_params(params)
     sd: Dict[str, np.ndarray] = {
         "model.embed_tokens.weight": _np(params["wte"]["embedding"]),
@@ -230,12 +232,13 @@ def llama_state_dict_from_params(params) -> Dict[str, np.ndarray]:
     for i in range(n_layer):
         bp = params[f"h_{i}"]
         p = f"model.layers.{i}."
-        sd[p + "input_layernorm.weight"] = _np(bp["ln_1"]["scale"])
+        if "ln_1" in bp:
+            sd[p + "input_layernorm.weight"] = _np(bp["ln_1"]["scale"])
         _lin(p + "self_attn.q_proj", bp["attn"]["q"])
         _lin(p + "self_attn.k_proj", bp["attn"]["k"])
         _lin(p + "self_attn.v_proj", bp["attn"]["v"])
         _lin(p + "self_attn.o_proj", bp["attn"]["o"])
-        if "q_norm" in bp["attn"]:  # Qwen3-class qk_norm
+        if "q_norm" in bp["attn"]:  # Qwen3/OLMo-2 qk_norm
             sd[p + "self_attn.q_norm.weight"] = \
                 _np(bp["attn"]["q_norm"]["scale"])
             sd[p + "self_attn.k_norm.weight"] = \
@@ -243,7 +246,13 @@ def llama_state_dict_from_params(params) -> Dict[str, np.ndarray]:
         _lin(p + "mlp.gate_proj", bp["mlp"]["gate"])
         _lin(p + "mlp.up_proj", bp["mlp"]["up"])
         _lin(p + "mlp.down_proj", bp["mlp"]["down"])
-        if "post_ln_1" in bp:  # Gemma-2 block: 4 norms, shifted names
+        if "post_ln_1" in bp and "ln_1" not in bp:
+            # OLMo-2: post-norm-only block (two norms, no pre-norms)
+            sd[p + "post_attention_layernorm.weight"] = \
+                _np(bp["post_ln_1"]["scale"])
+            sd[p + "post_feedforward_layernorm.weight"] = \
+                _np(bp["post_ln_2"]["scale"])
+        elif "post_ln_1" in bp:  # Gemma-2 block: 4 norms, shifted names
             sd[p + "post_attention_layernorm.weight"] = \
                 _np(bp["post_ln_1"]["scale"])
             sd[p + "pre_feedforward_layernorm.weight"] = \

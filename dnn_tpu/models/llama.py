@@ -137,16 +137,34 @@ class LlamaConfig:
     # False = the plain 2-layer MLP (fc1 -> act -> fc2; params carry
     # "up"/"down" only, no "gate") instead of the gated SwiGLU/GeGLU.
     mlp_gated: bool = True
-    # Qwen3/OLMo-2-class per-head q/k RMSNorm: normalize each head's
-    # D-vector (weights shape (head_dim,), leaves attn.q_norm/k_norm)
-    # BEFORE RoPE — the training-stability recipe replacing qkv biases.
+    # Qwen3/OLMo-2-class q/k RMSNorm BEFORE RoPE — the training
+    # -stability recipe replacing qkv biases. Width "head" (Qwen3):
+    # each head's D-vector norms independently (weights (head_dim,));
+    # "proj" (OLMo-2): the FULL projected vector norms jointly across
+    # heads (weights (H*D,)/(KV*D,)). Leaves attn.q_norm/k_norm.
     qk_norm: bool = False
+    qk_norm_width: str = "head"
+    # False (OLMo-2): NO pre-norms — attention and the MLP read the RAW
+    # residual stream, and only the post-branch norms exist (requires
+    # post_norms=True; blocks carry post_ln_1/post_ln_2 but no
+    # ln_1/ln_2 leaves).
+    pre_norm: bool = True
 
     def __post_init__(self):
         if self.parallel_block and self.post_norms:
             raise ValueError(
                 "parallel_block (Phi) and post_norms (Gemma-2) describe "
                 "incompatible residual structures")
+        if not self.pre_norm and (not self.post_norms
+                                  or self.parallel_block):
+            raise ValueError(
+                "pre_norm=False (OLMo-2) requires post_norms=True and a "
+                "sequential block — without pre-norms the post-branch "
+                "norms are the only normalization")
+        if self.qk_norm_width not in ("head", "proj"):
+            raise ValueError(
+                f"qk_norm_width must be 'head' or 'proj', got "
+                f"{self.qk_norm_width!r}")
         if self.rotary_dim is not None and (
                 self.rotary_dim % 2 or not
                 0 < self.rotary_dim <= self.head_dim):
@@ -280,6 +298,21 @@ PRESETS = {
                               n_head=4, n_kv_head=2, n_embd=64, d_ff=128,
                               head_dim_override=32, rms_eps=1e-6,
                               qk_norm=True),
+    # OLMo-2-7B shape: POST-norm-only block (attention/MLP read the raw
+    # residual stream; each branch output norms before its residual
+    # add) + full-projection-width q/k norms
+    "olmo2-7b": LlamaConfig(block_size=4096, vocab_size=100352,
+                            n_layer=32, n_head=32, n_kv_head=32,
+                            n_embd=4096, d_ff=11008,
+                            rope_theta=500000.0, rms_eps=1e-6,
+                            qk_norm=True, qk_norm_width="proj",
+                            pre_norm=False, post_norms=True),
+    # tiny OLMo-2 config for tests (GQA so the KV-width k_norm acts)
+    "olmo2-test": LlamaConfig(block_size=64, vocab_size=256, n_layer=4,
+                              n_head=4, n_kv_head=2, n_embd=64, d_ff=128,
+                              rms_eps=1e-5, qk_norm=True,
+                              qk_norm_width="proj", pre_norm=False,
+                              post_norms=True),
 }
 
 
@@ -350,11 +383,18 @@ def init_block(key, cfg: LlamaConfig, dtype=jnp.float32, *,
                         std=0.02 / (2 * cfg.n_layer) ** 0.5),
         },
     }
-    if cfg.qk_norm:  # Qwen3-class per-head q/k norms over head_dim
-        blk["attn"]["q_norm"] = {"scale": jnp.ones((d,), dtype)}
-        blk["attn"]["k_norm"] = {"scale": jnp.ones((d,), dtype)}
+    if cfg.qk_norm:
+        # "head" (Qwen3): per-head over head_dim; "proj" (OLMo-2): the
+        # full projected width, jointly across heads
+        qn = d if cfg.qk_norm_width == "head" else cfg.n_head * d
+        kn = d if cfg.qk_norm_width == "head" else cfg.n_kv_head * d
+        blk["attn"]["q_norm"] = {"scale": jnp.ones((qn,), dtype)}
+        blk["attn"]["k_norm"] = {"scale": jnp.ones((kn,), dtype)}
     if not cfg.parallel_block:  # Phi's parallel block has ONE norm
         blk["ln_2"] = _norm_p((c,))
+    if not cfg.pre_norm:  # OLMo-2: only the post-branch norms exist
+        del blk["ln_1"]
+        del blk["ln_2"]
     if include_mlp:
         if cfg.mlp_gated:
             blk["mlp"] = {
@@ -477,14 +517,32 @@ def _rope_apply(x, cos, sin, cfg: LlamaConfig):
     return jnp.concatenate([rot, x[..., cfg.rotary_dim:]], axis=-1)
 
 
+def _pre_normed(bp, x, cfg: LlamaConfig):
+    """The block input the branches read: ln_1(x) for pre-norm blocks
+    (LLaMA and every descendant), the RAW residual stream for OLMo-2's
+    post-norm-only block (pre_norm=False). ONE definition for every
+    block body."""
+    if not cfg.pre_norm:
+        return x
+    return _norm(bp["ln_1"], x, cfg)
+
+
 def _qk_normed(bp, q, k, cfg: LlamaConfig):
-    """Qwen3-class per-head q/k RMSNorm (over head_dim, BEFORE RoPE) —
-    the ONE definition every q/k projection site shares (_qkv_rope, the
-    batcher's _block_rows, verify_rows), or the paths' parity contracts
-    would diverge on qk_norm configs. Identity when the switch is
+    """q/k RMSNorm BEFORE RoPE — the ONE definition every q/k projection
+    site shares (_qkv_rope, the batcher's _block_rows, verify_rows), or
+    the paths' parity contracts would diverge on qk_norm configs.
+    Inputs arrive head-split ((B, H, T, D) / (B, KV, T, D)); width
+    "head" (Qwen3) norms each D-vector, width "proj" (OLMo-2) norms the
+    merged (H*D,)/(KV*D,) vector jointly across heads (merge -> norm ->
+    split — XLA folds the transposes). Identity when the switch is
     off."""
     if not cfg.qk_norm:
         return q, k
+    if cfg.qk_norm_width == "proj":
+        hq, hk = q.shape[1], k.shape[1]
+        q2 = rms_norm(bp["attn"]["q_norm"], merge_heads(q), eps=cfg.rms_eps)
+        k2 = rms_norm(bp["attn"]["k_norm"], merge_heads(k), eps=cfg.rms_eps)
+        return split_heads(q2, hq), split_heads(k2, hk)
     return (rms_norm(bp["attn"]["q_norm"], q, eps=cfg.rms_eps),
             rms_norm(bp["attn"]["k_norm"], k, eps=cfg.rms_eps))
 
@@ -530,7 +588,7 @@ def _mlp_residual(bp, x, *, cfg: LlamaConfig, compute_dtype, ffn=None):
     batcher path — their parity contracts depend on these never
     diverging. `ffn(bp, h)` overrides the MLP (the Mixtral MoE hook —
     models/llama_moe.py; same convention as the GPT family's ffn)."""
-    h = _norm(bp["ln_2"], x, cfg)
+    h = x if not cfg.pre_norm else _norm(bp["ln_2"], x, cfg)
     m = _mlp_out(bp, h, cfg=cfg, compute_dtype=compute_dtype, ffn=ffn)
     if cfg.post_norms:
         m = _norm(bp["post_ln_2"], m, cfg)
@@ -616,7 +674,7 @@ def block_apply(bp, x, *, cfg: LlamaConfig, compute_dtype=None, attn_fn=None,
     `ffn(bp, h)` overrides the MLP (Mixtral MoE)."""
     fn = attn_fn or (lambda bp2, h: _dense_attn(
         bp2, h, cfg=cfg, compute_dtype=compute_dtype, window=window))
-    h = _norm(bp["ln_1"], x, cfg)
+    h = _pre_normed(bp, x, cfg)
     return _branches_residual(bp, x, fn(bp, h), h, cfg=cfg,
                               compute_dtype=compute_dtype, ffn=ffn)
 
@@ -757,7 +815,7 @@ def _block_with_cache(bp, x, layer_cache, start_pos, *, cfg: LlamaConfig,
     per-layer value — traced allowed)."""
     b, t, c = x.shape
     kv, g = cfg.n_kv_head, cfg.n_head // cfg.n_kv_head
-    h = _norm(bp["ln_1"], x, cfg)
+    h = _pre_normed(bp, x, cfg)
     q, k, v = _qkv_rope(bp, h, start_pos + jnp.arange(t), cfg=cfg,
                         compute_dtype=compute_dtype)
     layer_cache = codec.write(layer_cache, k, v, start_pos)
@@ -1076,7 +1134,7 @@ def make_generate_seq_sharded(cfg: LlamaConfig, mesh, *, max_new_tokens: int,
                       top_k=top_k, top_p=top_p)
 
         def block_step(bp, x, lc_k, lc_v, p):
-            h = _norm(bp["ln_1"], x, cfg)
+            h = _pre_normed(bp, x, cfg)
             q, k, v = _qkv_rope(bp, h, p + jnp.arange(1), cfg=cfg,
                                 compute_dtype=compute_dtype)
             p_loc = jnp.clip(p - lo, 0, sd - 1)
@@ -1204,7 +1262,7 @@ class LlamaFamilyRows:
         cfg, compute_dtype = self.cfg, self.compute_dtype
         b = x.shape[0]
         kv, g, d = cfg.n_kv_head, cfg.n_head // cfg.n_kv_head, cfg.head_dim
-        h = _norm(bp["ln_1"], x, cfg)
+        h = _pre_normed(bp, x, cfg)
         q = split_heads(linear(bp["attn"]["q"], h, compute_dtype=compute_dtype),
                         cfg.n_head)
         k = split_heads(linear(bp["attn"]["k"], h, compute_dtype=compute_dtype),
@@ -1261,7 +1319,7 @@ class LlamaFamilyRows:
 
         def layer(carry, layer_in):
             bp, lc = layer_in
-            h = _norm(bp["ln_1"], carry, cfg)
+            h = _pre_normed(bp, carry, cfg)
             q = split_heads(linear(bp["attn"]["q"], h,
                                    compute_dtype=compute_dtype), cfg.n_head)
             kk = split_heads(linear(bp["attn"]["k"], h,
@@ -1505,14 +1563,30 @@ def to_hf_config(cfg: LlamaConfig, *, tie_word_embeddings: bool = False,
         # pre-multiplied, which we emit rather than a silent mismatch
         kw["rope_theta"] = cfg.rope_theta * cfg.rope_scale ** (
             cfg.head_dim / (cfg.head_dim - 2))
-    if cfg.qk_norm:
-        # Qwen3: per-head q/k RMSNorm, bias-free, decoupled head_dim
-        if cfg.attn_bias or cfg.sliding_window is not None:
-            # no shipped preset combines these; emit an error rather
-            # than a silently-dropped field (this function's convention)
+    if not cfg.pre_norm:
+        # OLMo-2: post-norm-only block. HF Olmo2 hard-codes proj-width
+        # q/k norms, no decoupled head_dim, no biases, no window —
+        # anything else has no Olmo2Config mapping; emit an error
+        # rather than a silently-dropped field (this function's
+        # convention)
+        if (not (cfg.qk_norm and cfg.qk_norm_width == "proj")
+                or cfg.head_dim_override is not None or cfg.attn_bias
+                or cfg.sliding_window is not None):
             raise ValueError(
-                "qk_norm with attn_bias/sliding_window has no direct "
-                "Qwen3Config mapping here — map this config by hand")
+                "pre_norm=False maps to Olmo2Config only with "
+                "qk_norm=True/qk_norm_width='proj' and no "
+                "head_dim_override/attn_bias/sliding_window — map this "
+                "config by hand")
+        kw.update(overrides)
+        return transformers.Olmo2Config(**kw)
+    if cfg.qk_norm:
+        # Qwen3: PER-HEAD q/k RMSNorm, bias-free, decoupled head_dim
+        if (cfg.attn_bias or cfg.sliding_window is not None
+                or cfg.qk_norm_width != "head"):
+            raise ValueError(
+                "qk_norm with attn_bias/sliding_window/proj-width norms "
+                "has no direct Qwen3Config mapping here — map this "
+                "config by hand")
         kw.update(head_dim=cfg.head_dim, attention_bias=False)
         kw.update(overrides)
         return transformers.Qwen3Config(**kw)

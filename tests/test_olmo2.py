@@ -1,0 +1,126 @@
+"""OLMo-2 family: the POST-norm-only block — attention and the MLP read
+the RAW residual stream, each branch output RMS-norms before its
+residual add (no ln_1/ln_2 leaves at all) — plus full-projection-width
+q/k norms (qk_norm_width="proj": the merged (H*D,) vector norms jointly
+across heads, unlike Qwen3's per-head norm).
+
+Both switches ride the shared helpers (_pre_normed, _qk_normed), so the
+dense forward, cached decode, and batcher rows inherit them — pinned
+against HF Olmo2ForCausalLM and the framework's own contracts."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dnn_tpu.models import gpt, llama
+
+CFG = llama.PRESETS["olmo2-test"]  # L=4, GQA 2:1, post-norm-only
+
+
+def _params(seed=0):
+    return llama.init(jax.random.PRNGKey(seed), CFG)
+
+
+def test_structure():
+    p = _params()
+    blk = p["h_0"]
+    assert "ln_1" not in blk and "ln_2" not in blk
+    assert "post_ln_1" in blk and "post_ln_2" in blk
+    d = CFG.head_dim
+    assert blk["attn"]["q_norm"]["scale"].shape == (CFG.n_head * d,)
+    assert blk["attn"]["k_norm"]["scale"].shape == (CFG.n_kv_head * d,)
+
+
+def test_config_validation():
+    import dataclasses
+
+    with pytest.raises(ValueError, match="post_norms"):
+        dataclasses.replace(CFG, post_norms=False)
+    with pytest.raises(ValueError, match="qk_norm_width"):
+        dataclasses.replace(CFG, qk_norm_width="banana")
+
+
+def test_hf_olmo2_parity():
+    torch = pytest.importorskip("torch")
+    transformers = pytest.importorskip("transformers")
+
+    hf_cfg = llama.to_hf_config(CFG, attn_implementation="eager")
+    assert isinstance(hf_cfg, transformers.Olmo2Config)
+    torch.manual_seed(0)
+    model = transformers.Olmo2ForCausalLM(hf_cfg).eval()
+    sd = {k: v.numpy() for k, v in model.state_dict().items()}
+    assert not any("input_layernorm" in k for k in sd)
+
+    from dnn_tpu.io.checkpoint import llama_params_from_state_dict
+
+    params = llama_params_from_state_dict(sd, post_norms=True)
+    ids = np.random.RandomState(1).randint(0, CFG.vocab_size, (2, 12))
+    with torch.no_grad():
+        want = model(torch.from_numpy(ids)).logits.numpy()
+    got = np.asarray(llama.make_apply(CFG)(params, jnp.asarray(ids)))
+    np.testing.assert_allclose(got, want, atol=2e-3, rtol=2e-3)
+    np.testing.assert_array_equal(got.argmax(-1), want.argmax(-1))
+
+    # greedy cached decode == HF generate (raw-stream branches + proj
+    # -width qk norms at every step)
+    prompt = np.random.RandomState(2).randint(0, CFG.vocab_size, (1, 10))
+    n_new = 12
+    with torch.no_grad():
+        hf_out = model.generate(torch.from_numpy(prompt),
+                                max_new_tokens=n_new, do_sample=False,
+                                pad_token_id=0)
+    want_toks = hf_out.numpy()[0, 10:]
+    prepared = gpt.prepare_stacked(params, CFG)
+    got_toks = np.asarray(llama.make_generate(CFG, max_new_tokens=n_new)(
+        prepared, jnp.asarray(prompt), jax.random.PRNGKey(0)))[0]
+    np.testing.assert_array_equal(got_toks, want_toks)
+
+
+def test_batcher_matches_solo():
+    from dnn_tpu.runtime.serving import ContinuousBatcher
+
+    p = _params(seed=3)
+    prepared = gpt.prepare_stacked(p, CFG)
+    prompts = [np.asarray([3, 1, 4, 1, 5]), np.asarray([9, 2, 6])]
+    n_new = 7
+    solo = llama.make_generate(CFG, max_new_tokens=n_new)
+    want = [np.asarray(solo(prepared, jnp.asarray(pr[None]),
+                            jax.random.PRNGKey(0)))[0] for pr in prompts]
+    srv = ContinuousBatcher(CFG, prepared, slots=2,
+                            max_len=CFG.block_size, prompt_pad=8,
+                            family=llama.LlamaFamilyRows(CFG))
+    rids = [srv.submit(pr, max_new_tokens=n_new) for pr in prompts]
+    srv.drain()
+    for rid, w in zip(rids, want):
+        np.testing.assert_array_equal(srv.results[rid], w)
+
+
+def test_torch_export_round_trips():
+    torch = pytest.importorskip("torch")
+    transformers = pytest.importorskip("transformers")
+
+    from dnn_tpu.io.torch_export import llama_state_dict_from_params
+
+    p = _params(seed=4)
+    sd = llama_state_dict_from_params(p)
+    assert "model.layers.0.post_feedforward_layernorm.weight" in sd
+    assert "model.layers.0.input_layernorm.weight" not in sd
+    model = transformers.Olmo2ForCausalLM(
+        llama.to_hf_config(CFG, attn_implementation="eager")).eval()
+    missing, unexpected = model.load_state_dict(
+        {k: torch.from_numpy(np.ascontiguousarray(v))
+         for k, v in sd.items()}, strict=False)
+    assert not unexpected, unexpected
+    ids = np.random.RandomState(5).randint(0, CFG.vocab_size, (2, 10))
+    with torch.no_grad():
+        want = model(torch.from_numpy(ids)).logits.numpy()
+    got = np.asarray(llama.make_apply(CFG)(p, jnp.asarray(ids)))
+    np.testing.assert_allclose(got, want, atol=2e-3, rtol=2e-3)
+
+
+def test_registry_registered():
+    from dnn_tpu.registry import get_model
+
+    spec = get_model("olmo2-7b")
+    assert not spec.config.pre_norm and spec.config.qk_norm
