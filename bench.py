@@ -176,6 +176,80 @@ def _last_good_tpu_reference(path=None):
     return ref
 
 
+def _previous_round_ratio(repo_dir=None):
+    """The latest committed round's vs_baseline (BENCH_r*.json), for
+    drift detection: the r4->r5 ratio swing (0.97 -> 0.84) went two
+    rounds uninterrogated because nothing echoed the history next to the
+    fresh number. Returns {"round", "vs_baseline"} or None."""
+    import os
+    import re
+
+    repo_dir = repo_dir or os.path.dirname(os.path.abspath(__file__))
+    best = None
+    for name in os.listdir(repo_dir):
+        m = re.fullmatch(r"BENCH_r(\d+)\.json", name)
+        if not m:
+            continue
+        rnd = int(m.group(1))
+        if best is None or rnd > best[0]:
+            best = (rnd, name)
+    if best is None:
+        return None
+    try:
+        with open(os.path.join(repo_dir, best[1])) as f:
+            obj = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None
+    if not isinstance(obj, dict):
+        return None
+    row = obj
+    if "vs_baseline" not in row and isinstance(obj.get("tail"), str):
+        # driver format: the bench's printed JSON line rides inside the
+        # captured "tail" text — take the last parseable line
+        row = {}
+        for line in obj["tail"].splitlines():
+            if line.startswith("{"):
+                try:
+                    row = json.loads(line)
+                except json.JSONDecodeError:
+                    pass
+    ratio = row.get("vs_baseline")
+    if ratio is None:
+        return None
+    return {"round": best[0], "vs_baseline": ratio,
+            "metric": row.get("metric")}
+
+
+def _refresh_results_table():
+    """On a HEALTHY TPU probe, auto-invoke the full suite with resume
+    semantics and regenerate RESULTS.md + the README table — the first
+    healthy-chip session refreshes the canonical artifact with zero
+    human judgment (VERDICT r5 next-round #1). Runs AFTER the headline
+    JSON line is printed, so a wedge mid-suite can never cost the round
+    its number; all child output goes to stderr. Disable with
+    DNN_BENCH_AUTORUN=0."""
+    import os
+    import subprocess
+    import sys
+
+    if os.environ.get("DNN_BENCH_AUTORUN", "1") == "0":
+        return
+    run_all = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "benchmarks", "run_all.py")
+    timeout = int(os.environ.get("DNN_BENCH_AUTORUN_TIMEOUT", "14400"))
+    print("[bench] healthy backend: refreshing benchmarks/RESULTS.md via "
+          "run_all.py --resume", file=sys.stderr)
+    try:
+        rc = subprocess.call([sys.executable, run_all, "--resume"],
+                             stdout=sys.stderr, stderr=sys.stderr,
+                             timeout=timeout)
+        print(f"[bench] run_all --resume exited rc={rc}", file=sys.stderr)
+    except subprocess.TimeoutExpired:
+        print(f"[bench] run_all --resume exceeded {timeout}s; partial "
+              "rows persist in benchmarks/.bench_rows.jsonl for the next "
+              "--resume", file=sys.stderr)
+
+
 def main():
     fell_back = not _backend_alive()
     if fell_back:
@@ -187,13 +261,42 @@ def main():
     # healthy CPU backend yet must still take the light timing path AND
     # the cpu-marked metric key below
     on_cpu = jax.default_backend() == "cpu"
-    ours = bench_ours(light=on_cpu)
+    baseline_fn, metric = None, None
     try:
-        baseline = bench_torch_cpu()
+        import torch  # noqa: F401 — probe only; bench_torch_cpu imports
+        import transformers  # noqa: F401
+
+        baseline_fn = bench_torch_cpu
         metric = "gpt2_fwd_tokens_per_sec_per_chip_vs_torch_cpu"
     except Exception:
-        baseline = bench_jax_cpu()
+        baseline_fn = bench_jax_cpu
         metric = "gpt2_fwd_tokens_per_sec_per_chip_vs_jax_cpu"
+    # A-B-A-B interleave, median of >= 3 pairs (VERDICT r5 weak #3): the
+    # ratio previously paired ONE repo measurement with ONE baseline
+    # measurement taken after it, so host-load drift between the two
+    # swung the headline ~15% round-over-round. Interleaving puts both
+    # legs under the same load regime and the per-pair ratios expose the
+    # remaining noise as an explicit spread instead of silent drift.
+    pairs = []
+    while len(pairs) < 3:
+        a = bench_ours(light=on_cpu)
+        try:
+            b = baseline_fn()
+        except Exception:
+            if baseline_fn is bench_jax_cpu:
+                raise  # no further fallback
+            # torch present but broke mid-run: switch baselines AND
+            # discard earlier pairs — a median over mixed torch/jax
+            # denominators under one metric key is exactly the
+            # cross-substrate comparison the key exists to prevent
+            baseline_fn = bench_jax_cpu
+            metric = "gpt2_fwd_tokens_per_sec_per_chip_vs_jax_cpu"
+            pairs = []
+            continue
+        pairs.append((a, b))
+    ratios = sorted(a / b for a, b in pairs)
+    ours = sorted(a for a, _ in pairs)[len(pairs) // 2]
+    vs_baseline = ratios[len(ratios) // 2]
     if on_cpu:
         # distinct key: a CPU-substrate number must never be compared
         # against TPU rounds under the headline metric name — whether we
@@ -203,8 +306,15 @@ def main():
         "metric": metric,
         "value": round(ours, 1),
         "unit": "tokens/s",
-        "vs_baseline": round(ours / baseline, 2),
+        "vs_baseline": round(vs_baseline, 2),
+        # spread of the interleaved per-pair ratios (max - min): the
+        # uncertainty the single-shot ratio used to hide
+        "vs_baseline_spread": round(ratios[-1] - ratios[0], 3),
+        "vs_baseline_pairs": [round(r, 3) for r in ratios],
     }
+    prev = _previous_round_ratio()
+    if prev is not None:
+        row["vs_baseline_prev_round"] = prev
     # MFU: the round-over-round "fast on TPU" number (vs_baseline only says
     # "faster than the reference's CPU substrate"). Omitted off-TPU.
     from dnn_tpu.models import gpt
@@ -223,7 +333,11 @@ def main():
         ref = _last_good_tpu_reference()
         if ref is not None:
             row["stale_tpu_reference"] = ref
-    print(json.dumps(row))
+    print(json.dumps(row), flush=True)
+    if not on_cpu:
+        # headline is safely out; now spend the healthy chip on the full
+        # canonical table (resume semantics — only missing/failed configs)
+        _refresh_results_table()
 
 
 if __name__ == "__main__":

@@ -886,6 +886,266 @@ def dev_beam_vs_greedy():
     return results
 
 
+@device_config("decode_bucketing")
+def dev_decode_bucketing():
+    # Length-aware bucketed decode (runtime/decode_buckets.py), measured
+    # where it matters: a serving-style max_len allocation decoded at a
+    # live position <= max_len/8. The unbucketed leg is the SAME host
+    #-dispatched decoder with a single max_len bucket, so the delta
+    # isolates the cache-view length; greedy token identity between the
+    # two programs is asserted in-run (bucket-boundary crossings
+    # included). CPU-runnable: the win is bytes-per-step proportionality,
+    # not a chip feature.
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from dnn_tpu.models import gpt
+    from dnn_tpu.runtime.decode_buckets import make_bucketed_generate
+    from dnn_tpu.utils.timing import device_time
+
+    results = []
+    cfg = gpt.GPTConfig(block_size=1024, vocab_size=512, n_layer=4,
+                        n_head=8, n_embd=256)
+    prepared = gpt.prepare_stacked(gpt.init(jax.random.PRNGKey(0), cfg),
+                                   cfg)
+    b, prompt_len, new_tokens, max_len = 8, 16, 56, 1024
+    # live positions run 16..71 — all <= max_len/8 = 128; the bucketed
+    # leg crosses the 64-bucket edge mid-decode (parity must hold there)
+    ids = jax.random.randint(jax.random.PRNGKey(1), (b, prompt_len), 0,
+                             cfg.vocab_size, dtype=jnp.int32)
+    rng = jax.random.PRNGKey(2)
+    legs = {}
+    for name_l, buckets in (("bucketed", None), ("unbucketed", (max_len,))):
+        # attn_kernel pinned OFF for both legs: on TPU the "auto" policy
+        # would route only the max_len-sized unbucketed leg through the
+        # Pallas kernel and the A/B would no longer isolate the cache
+        # -view length (the kernel-vs-einsum A/B is its own config,
+        # gpt2_decode_attnkernel)
+        gen = make_bucketed_generate(
+            cfg, max_len=max_len, max_new_tokens=new_tokens,
+            buckets=buckets, attn_kernel=False)
+        gen1 = make_bucketed_generate(
+            cfg, max_len=max_len, max_new_tokens=1, buckets=buckets,
+            attn_kernel=False)
+        toks = np.asarray(gen(prepared, ids, rng))
+        # subtract a max_new=1 run so the rate charges DECODE steps
+        # against decode time (the longctx config's technique)
+        dt_full = device_time(gen, prepared, ids, rng, n1=1, n2=3)
+        dt_pre = device_time(gen1, prepared, ids, rng, n1=1, n2=3)
+        dt = max(dt_full - dt_pre, 1e-9)
+        legs[name_l] = {"toks": toks, "dt": dt,
+                        "tps": b * (new_tokens - 1) / dt,
+                        "buckets": gen.buckets}
+    np.testing.assert_array_equal(
+        legs["bucketed"]["toks"], legs["unbucketed"]["toks"],
+        err_msg="bucketed decode diverged from the unbucketed program")
+    # modeled cache bytes/step: mean live bucket vs the full allocation
+    # (f32 K+V, all layers)
+    per_pos = 2 * cfg.n_layer * b * cfg.n_embd * 4
+    steps = range(prompt_len + 1, prompt_len + new_tokens)
+    ladder = legs["bucketed"]["buckets"]
+    mean_bucket = sum(next(x for x in ladder if x >= s) for s in steps) \
+        / len(steps)
+    _emit(results, config="decode_bucketing",
+          metric="decode_speedup_at_live_le_max_len_div_8",
+          value=round(legs["unbucketed"]["dt"] / legs["bucketed"]["dt"], 3),
+          platform=_platform(), batch=b, prompt=prompt_len,
+          new_tokens=new_tokens, max_len=max_len,
+          buckets=str(ladder),
+          tps_bucketed=round(legs["bucketed"]["tps"], 1),
+          tps_unbucketed=round(legs["unbucketed"]["tps"], 1),
+          modeled_cache_mb_per_step_bucketed=round(
+              per_pos * mean_bucket / 1e6, 2),
+          modeled_cache_mb_per_step_unbucketed=round(
+              per_pos * max_len / 1e6, 2),
+          note="greedy token identity bucketed==unbucketed asserted "
+               "in-run, incl. a bucket-edge crossing")
+    return results
+
+
+# --- platform-independent legs of the former tpu_only configs (VERDICT
+# r5 weak #2): acceptance rates and RELATIVE costs are properties of the
+# models/algorithms, not the chip — measured on whatever backend this
+# host resolves, at shapes small enough for a CPU leg. The tpu_only
+# wall-clock twins above keep the absolute numbers. ---
+
+def _small_gpt():
+    from dnn_tpu.models import gpt
+
+    return gpt.GPTConfig(block_size=512, vocab_size=512, n_layer=4,
+                         n_head=4, n_embd=128)
+
+
+@device_config("speculative_relative")
+def dev_speculative_relative():
+    # Acceptance rate + relative speedup of quantized self-draft
+    # speculation (greedy + sampled) — the pair property the tpu_only
+    # config left unmeasured for two rounds.
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from dnn_tpu.quant import quantize_gpt
+    from dnn_tpu.runtime import generate as gen
+    from dnn_tpu.runtime.speculative import make_speculative_generate
+    from dnn_tpu.utils.timing import device_time
+
+    results = []
+    from dnn_tpu.models import gpt
+
+    cfg = _small_gpt()
+    prepared = gpt.prepare_stacked(gpt.init(jax.random.PRNGKey(0), cfg),
+                                   cfg)
+    q8 = quantize_gpt(prepared)
+    prompt_len, new_tokens, k = 32, 64, 4
+    ids = jax.random.randint(jax.random.PRNGKey(1), (1, prompt_len), 0,
+                             cfg.vocab_size, dtype=jnp.int32)
+    rng = jax.random.PRNGKey(2)
+    for mode, temp in (("greedy", 0.0), ("sampled", 1.0)):
+        gfn = gen.make_generate(cfg, max_new_tokens=new_tokens,
+                                temperature=temp)
+        dt_plain = device_time(gfn, prepared, ids, rng, n1=1, n2=3)
+        sfn = make_speculative_generate(
+            cfg, cfg, max_new_tokens=new_tokens, k=k, temperature=temp,
+            return_stats=True)
+        toks, stats = sfn(prepared, q8, ids, rng)
+        jax.block_until_ready(toks)
+        accept = float(stats["accepted"]) / max(float(stats["proposed"]), 1)
+        if temp == 0.0:
+            np.testing.assert_array_equal(
+                np.asarray(toks), np.asarray(gfn(prepared, ids, rng)),
+                err_msg="speculative greedy diverged from plain greedy")
+
+        def run(tw, dw, ii, rr, _s=sfn):
+            t, _ = _s(tw, dw, ii, rr)
+            return t
+
+        dt_spec = device_time(run, prepared, q8, ids, rng, n1=1, n2=3)
+        _emit(results, config=f"speculative_relative_{mode}",
+              metric="speedup_vs_plain",
+              value=round(dt_plain / dt_spec, 3), platform=_platform(),
+              k=k, new_tokens=new_tokens,
+              acceptance_rate=round(accept, 4),
+              note="int8 self-draft on a small random-init GPT; "
+                   "acceptance is a pair property, speedup is relative "
+                   "on this host's backend")
+    return results
+
+
+@device_config("beam_vs_greedy_relative")
+def dev_beam_vs_greedy_relative():
+    # beam k=4 cost per committed token RELATIVE to greedy — meaningful
+    # as a ratio on any backend.
+    import jax
+    import jax.numpy as jnp
+
+    from dnn_tpu.models import gpt
+    from dnn_tpu.runtime import generate as gen
+    from dnn_tpu.runtime.beam import make_beam_generate
+    from dnn_tpu.utils.timing import device_time
+
+    results = []
+    cfg = _small_gpt()
+    prepared = gpt.prepare_stacked(gpt.init(jax.random.PRNGKey(0), cfg),
+                                   cfg)
+    b, prompt_len, new_tokens, k = 4, 16, 32, 4
+    ids = jax.random.randint(jax.random.PRNGKey(1), (b, prompt_len), 0,
+                             cfg.vocab_size, dtype=jnp.int32)
+    rng = jax.random.PRNGKey(2)
+    gfn = gen.make_generate(cfg, max_new_tokens=new_tokens)
+    dt_g = device_time(gfn, prepared, ids, rng, n1=1, n2=3)
+    bfn = make_beam_generate(cfg, max_new_tokens=new_tokens, beam_size=k)
+    dt_b = device_time(bfn, prepared, ids, n1=1, n2=3)
+    _emit(results, config="beam_vs_greedy_relative",
+          metric="beam_cost_ratio", value=round(dt_b / dt_g, 3),
+          platform=_platform(), batch=b, beam_size=k,
+          new_tokens=new_tokens,
+          note="relative cost of beam_size=4 per committed token on "
+               "this host's backend (small random-init GPT)")
+    return results
+
+
+@device_config("mixtral_vs_dense_relative")
+def dev_mixtral_vs_dense_relative():
+    # MoE decode vs its active-FLOPs dense equivalent, as a RELATIVE
+    # tokens/s ratio — the routing tax is an algorithmic property.
+    import jax
+    import jax.numpy as jnp
+
+    from dnn_tpu.models import gpt, llama, llama_moe
+    from dnn_tpu.utils.timing import device_time
+
+    results = []
+    mx_cfg = llama_moe.PRESETS["mixtral-test"]
+    dense_cfg = llama.LlamaConfig(
+        block_size=mx_cfg.block_size, vocab_size=mx_cfg.vocab_size,
+        n_layer=mx_cfg.n_layer, n_head=mx_cfg.n_head,
+        n_kv_head=mx_cfg.n_kv_head, n_embd=mx_cfg.n_embd,
+        d_ff=mx_cfg.router_top_k * mx_cfg.d_ff)
+    b, prompt_len, new_tokens = 8, 8, 32
+    ids = jax.random.randint(jax.random.PRNGKey(1), (b, prompt_len), 0,
+                             mx_cfg.vocab_size, dtype=jnp.int32)
+    rng = jax.random.PRNGKey(2)
+    mx_prep = gpt.prepare_stacked(
+        llama_moe.init(jax.random.PRNGKey(0), mx_cfg), mx_cfg)
+    dense_prep = gpt.prepare_stacked(
+        llama.init(jax.random.PRNGKey(0), dense_cfg), dense_cfg)
+    mx_fn = llama_moe.make_generate(mx_cfg, max_new_tokens=new_tokens)
+    dn_fn = llama.make_generate(dense_cfg, max_new_tokens=new_tokens)
+    dt_mx = device_time(mx_fn, mx_prep, ids, rng, n1=1, n2=3)
+    dt_dn = device_time(dn_fn, dense_prep, ids, rng, n1=1, n2=3)
+    _emit(results, config="mixtral_vs_dense_relative",
+          metric="moe_vs_dense_decode_ratio",
+          value=round(dt_dn / dt_mx, 3), platform=_platform(), batch=b,
+          new_tokens=new_tokens, experts=f"{mx_cfg.n_expert}x "
+          f"top-{mx_cfg.router_top_k}",
+          tps_moe=round(b * new_tokens / dt_mx, 1),
+          tps_dense=round(b * new_tokens / dt_dn, 1),
+          note="dense twin at router_top_k*d_ff = the MoE's ACTIVE "
+               "FLOPs per token; >1 means MoE decodes faster than its "
+               "dense equivalent on this backend")
+    return results
+
+
+@device_config("serving_constrained_tax_relative")
+def dev_serving_constrained_tax_relative():
+    # constrained-decoding tax as a ratio: per-step host DFA advance +
+    # device mask gather vs the same pool unconstrained.
+    import time as _time
+
+    import jax
+
+    from dnn_tpu.models import gpt
+    from dnn_tpu.runtime.constrain import TokenConstraint, byte_vocab
+    from dnn_tpu.runtime.serving import ContinuousBatcher
+
+    results = []
+    cfg = _small_gpt()
+    prepared = gpt.prepare_stacked(gpt.init(jax.random.PRNGKey(0), cfg),
+                                   cfg)
+    cons = TokenConstraint.from_regex(r"[0-9]+", byte_vocab(cfg.vocab_size))
+    tps_c = {}
+    for name, con in (("off", None), ("on", cons)):
+        srv_c = ContinuousBatcher(
+            cfg, prepared, slots=4, max_len=64, prompt_pad=16,
+            allow_constraints=True, temperature=1.0)
+        _serve_round(srv_c, cfg, 16, 8, lambda i: 12, constraint=con,
+                     key=11)  # compile/warm
+        t0 = _time.perf_counter()
+        total = _serve_round(srv_c, cfg, 16, 8, lambda i: 12,
+                             constraint=con, key=11)
+        tps_c[name] = total / (_time.perf_counter() - t0)
+    _emit(results, config="serving_constrained_tax_relative",
+          metric="overhead_pct",
+          value=round((tps_c["off"] / tps_c["on"] - 1.0) * 100, 2),
+          platform=_platform(), slots=4,
+          note="all slots grammar-constrained ([0-9]+) vs none, same "
+               "allow_constraints=True pool — the marginal per-step cost "
+               "of a live grammar, as a backend-relative ratio")
+    return results
+
+
 def run_device_config(name):
     """Child-process entry: run exactly one device config."""
     for cfg_name, fn, tpu_only in DEVICE_CONFIGS:
@@ -1396,6 +1656,53 @@ def write_results_md(rows, path):
         f.write("\n".join(lines) + "\n")
 
 
+README_BEGIN = "<!-- PERF_TABLE:BEGIN (generated by benchmarks/run_all.py --sync-readme) -->"
+README_END = "<!-- PERF_TABLE:END -->"
+
+
+def sync_readme(results_path=None, readme_path=None):
+    """Regenerate README.md's performance table FROM benchmarks/
+    RESULTS.md (between the PERF_TABLE markers): the measurement
+    commit/date are stamped from the table's own provenance header, and
+    a staleness warning is emitted whenever HEAD differs from the bench
+    commit — no hand-copied (hence silently aging) numbers in the README
+    (VERDICT r5 weak #6/#8)."""
+    results_path = results_path or os.path.join(REPO, "benchmarks",
+                                                "RESULTS.md")
+    readme_path = readme_path or os.path.join(REPO, "README.md")
+    import re
+
+    with open(results_path) as f:
+        results = f.read()
+    head = re.search(r"Generated at commit `([^`]+)` on ([^;]+);", results)
+    bench_rev, bench_date = (head.group(1), head.group(2).strip()) if head \
+        else ("unknown", "unknown")
+    table = [l for l in results.splitlines() if l.startswith("|")]
+    cur_rev, _ = _provenance()
+    lines = [README_BEGIN, "",
+             f"Measured at commit `{bench_rev}` ({bench_date}); generated "
+             "from `benchmarks/RESULTS.md` — do not hand-edit this "
+             "section.", ""]
+    if cur_rev.replace("-dirty", "") != bench_rev.replace("-dirty", ""):
+        lines += [
+            f"> **Staleness warning:** HEAD is `{cur_rev}` but these "
+            f"numbers were measured at `{bench_rev}` — re-run "
+            "`python benchmarks/run_all.py` (or let a healthy-chip "
+            "`bench.py` run refresh them) before quoting.", ""]
+    lines += table + ["", README_END]
+    with open(readme_path) as f:
+        readme = f.read()
+    if README_BEGIN not in readme or README_END not in readme:
+        raise SystemExit(
+            f"README markers not found; add {README_BEGIN!r} and "
+            f"{README_END!r} around the perf table once")
+    pre = readme.split(README_BEGIN)[0]
+    post = readme.split(README_END, 1)[1]
+    with open(readme_path, "w") as f:
+        f.write(pre + "\n".join(lines) + post)
+    return readme_path
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--section", choices=["device", "cpu_mesh"])
@@ -1405,8 +1712,14 @@ def main():
                          "benchmarks/.bench_rows.jsonl")
     ap.add_argument("--out",
                     default=os.path.join(REPO, "benchmarks", "RESULTS.md"))
+    ap.add_argument("--sync-readme", action="store_true",
+                    help="regenerate README.md's perf table from the "
+                         "existing RESULTS.md and exit (no measuring)")
     args = ap.parse_args()
 
+    if args.sync_readme:
+        print(f"synced {sync_readme(results_path=args.out)}")
+        return
     if args.section == "device":
         if args.config:
             run_device_config(args.config)
@@ -1425,7 +1738,8 @@ def main():
     _run_device_configs(state)
     _run_cpu_mesh(state)
     write_results_md(state.all_rows(), args.out)
-    print(f"wrote {args.out}")
+    sync_readme(results_path=args.out)
+    print(f"wrote {args.out} (+ README perf table)")
 
 
 if __name__ == "__main__":

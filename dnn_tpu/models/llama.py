@@ -852,7 +852,7 @@ def init_cache(cfg: LlamaConfig, batch: int, max_len: int, dtype=jnp.float32):
 
 
 def forward_with_cache(prepared, ids, cache, start_pos, *, cfg: LlamaConfig,
-                       compute_dtype=None, attn_kernel=False, rolling=False,
+                       compute_dtype=None, attn_kernel="auto", rolling=False,
                        ffn=None):
     from dnn_tpu.runtime.kvcache import codec_for_cache
 
@@ -912,7 +912,7 @@ def _ring_from_prompt(prompt_cache, t: int, w: int):
 def make_generate(cfg: LlamaConfig, *, max_new_tokens: int,
                   temperature: float = 0.0, top_k: Optional[int] = None,
                   top_p: Optional[float] = None,
-                  compute_dtype=None, kv_dtype=None, attn_kernel=False,
+                  compute_dtype=None, kv_dtype=None, attn_kernel="auto",
                   ffn=None):
     """Jitted generate(prepared, ids, rng) — same contract as the GPT
     family's decoder, including kv_dtype (f32/bf16/"int8") cache storage
@@ -965,7 +965,8 @@ def make_generate(cfg: LlamaConfig, *, max_new_tokens: int,
             logits, cache = forward_with_cache(
                 prepared, tok[:, None], cache, t + i, cfg=cfg,
                 compute_dtype=compute_dtype,
-                attn_kernel=attn_kernel and not rolling, rolling=rolling,
+                attn_kernel=False if rolling else attn_kernel,
+                rolling=rolling,
                 ffn=ffn)
             rng, sub = jax.random.split(rng)
             nxt = _sample(logits[:, -1], sub, temperature=temperature,
@@ -1116,9 +1117,12 @@ def make_generate_seq_sharded(cfg: LlamaConfig, mesh, *, max_new_tokens: int,
         # prefill: full forward over a transient prompt-length KV-width
         # cache; each device gathers its own position columns
         prompt_cache = init_cache(cfg, b, t, compute_dtype or jnp.float32)
+        # attn_kernel pinned off: this forward runs INSIDE shard_map,
+        # where the "auto" policy's Pallas engagement is untested — the
+        # sharded path keeps the einsum unconditionally
         logits, prompt_cache = forward_with_cache(
             prepared, ids, prompt_cache, 0, cfg=cfg,
-            compute_dtype=compute_dtype)
+            compute_dtype=compute_dtype, attn_kernel=False)
         gpos = lo + jnp.arange(sd)
         in_prompt = gpos < t
         local = {
@@ -1215,7 +1219,7 @@ class LlamaFamilyRows:
     slot's position limit."""
 
     def __init__(self, cfg: LlamaConfig, *, compute_dtype=None,
-                 attn_kernel: bool = False, ffn=None):
+                 attn_kernel="auto", ffn=None):
         self.cfg = cfg
         self.compute_dtype = compute_dtype
         # picked up by ContinuousBatcher for the decode-rows codec too

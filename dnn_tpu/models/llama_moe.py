@@ -207,7 +207,7 @@ def make_apply(cfg: MixtralConfig, *, compute_dtype=None, remat=False):
 def make_generate(cfg: MixtralConfig, *, max_new_tokens: int,
                   temperature: float = 0.0, top_k: Optional[int] = None,
                   top_p: Optional[float] = None, compute_dtype=None,
-                  kv_dtype=None, attn_kernel=False):
+                  kv_dtype=None, attn_kernel="auto"):
     """llama.make_generate with the MoE hook (config-resolved) — prefill
     routes (B, T) tokens, each decode step routes (B, 1); same KV-width
     GQA cache, same attn_kernel/kv_dtype options."""
@@ -218,7 +218,7 @@ def make_generate(cfg: MixtralConfig, *, max_new_tokens: int,
 
 
 def family_rows(cfg: MixtralConfig, *, compute_dtype=None,
-                attn_kernel: bool = False):
+                attn_kernel="auto"):
     """ContinuousBatcher adapter: LlamaFamilyRows resolves the MoE hook
     from the config — prefill chunks, per-slot decode rows, and
     speculative verify all route through the experts."""
@@ -367,7 +367,8 @@ def make_generate_ep(cfg: MixtralConfig, mesh, *, max_new_tokens: int,
 
         logits, cache = llama.forward_with_cache(
             prep_local, ids_local, cache, 0, cfg=cfg,
-            compute_dtype=compute_dtype, ffn=ffn_for(b * t))
+            compute_dtype=compute_dtype, ffn=ffn_for(b * t),
+            attn_kernel=False)  # inside shard_map: keep the einsum
         rng = jax.random.fold_in(rng, lax.axis_index(axis))
         rng, sub = jax.random.split(rng)
         tok = _sample(logits[:, -1], sub, temperature=temperature,
@@ -378,7 +379,8 @@ def make_generate_ep(cfg: MixtralConfig, mesh, *, max_new_tokens: int,
             cache, tok, rng = carry
             logits, cache = llama.forward_with_cache(
                 prep_local, tok[:, None], cache, t + i, cfg=cfg,
-                compute_dtype=compute_dtype, ffn=step_ffn)
+                compute_dtype=compute_dtype, ffn=step_ffn,
+                attn_kernel=False)
             rng, sub = jax.random.split(rng)
             nxt = _sample(logits[:, -1], sub, temperature=temperature,
                           top_k=sample_top_k)

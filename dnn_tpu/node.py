@@ -127,6 +127,12 @@ def build_parser() -> argparse.ArgumentParser:
                         "requests sharing a prompt prefix skip re-prefilling "
                         "identical chunks. 0 disables (default). Each entry "
                         "holds one transient row cache in HBM")
+    p.add_argument("--decode_buckets", action="store_true",
+                   help="--serve_lm: length-aware bucketed decode — the "
+                        "dense slot-pool cache grows bucket-by-bucket so "
+                        "decode bytes/step track the LIVE context "
+                        "instead of max_len (runtime/decode_buckets.py; "
+                        "dense pools only)")
     p.add_argument("--prompt_pad", type=int, default=None,
                    help="--serve_lm: prompt padding bucket (one prefill "
                         "compilation; default min(64, max_len))")
@@ -473,6 +479,7 @@ def _serve_lm(engine: PipelineEngine, args) -> int:
             family=family, default_max_new=args.generate or 32,
             tokenizer=tokenizer, prefix_cache=args.prefix_cache,
             paged_blocks=args.paged_blocks, block_len=args.block_len,
+            decode_buckets=args.decode_buckets,
             # the daemon's clients choose options per request, so the
             # per-slot bias capability is on at this edge — except for
             # speculative serving, whose batcher rejects per-request

@@ -1,0 +1,13 @@
+"""Version-compat helpers shared by the Pallas kernels."""
+
+from __future__ import annotations
+
+
+def _compiler_params(pltpu, **kw):
+    """jax renamed TPUCompilerParams -> CompilerParams across releases;
+    resolve whichever this jax ships (the kernels are otherwise
+    version-agnostic, and the interpret-mode CI path must not die on the
+    name)."""
+    cls = getattr(pltpu, "CompilerParams", None) or \
+        getattr(pltpu, "TPUCompilerParams")
+    return cls(**kw)

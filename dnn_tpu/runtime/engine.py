@@ -418,7 +418,8 @@ class PipelineEngine:
 
     def make_generator(self, *, max_new_tokens: int, temperature: float = 0.0,
                        top_k: Optional[int] = None,
-                       top_p: Optional[float] = None):
+                       top_p: Optional[float] = None,
+                       attn_kernel="auto"):
         """Build `generate(ids, rng=None) -> (B, max_new_tokens)` on this
         engine's weights. On the spmd runtime with the GPT stacked layout,
         decode runs PIPELINE-PARALLEL: each stage keeps its KV-cache shard
@@ -427,7 +428,11 @@ class PipelineEngine:
         capability the reference's partitions stop short of (they emit one
         stateless forward's logits, gpt_model_parts.py:36-50, and cannot
         decode). Other runtimes fall back to the single-program KV-cache
-        decoder; both are token-for-token identical."""
+        decoder; both are token-for-token identical. `attn_kernel` is the
+        cache-attention routing policy for the single-program decoders
+        (kvcache._KernelDispatch): the default "auto" streams
+        long-context decode through the Pallas position-clamped kernel
+        on TPU and stays on the einsum path everywhere else."""
         from dnn_tpu.models.gpt import GPTConfig
         from dnn_tpu.models.gpt_moe import GPTMoEConfig
         from dnn_tpu.runtime.generate import make_generate, make_pipeline_generate
@@ -463,6 +468,7 @@ class PipelineEngine:
             return single_program(llama.make_generate(
                 cfg, max_new_tokens=max_new_tokens, temperature=temperature,
                 top_k=top_k, top_p=top_p, compute_dtype=self.compute_dtype,
+                attn_kernel=attn_kernel,
             ))
         if type(cfg) is not GPTConfig:
             # exact match: the KV-cache decoder assumes dense-GPT block
@@ -484,6 +490,7 @@ class PipelineEngine:
         return single_program(make_generate(
             cfg, max_new_tokens=max_new_tokens, temperature=temperature,
             top_k=top_k, top_p=top_p, compute_dtype=self.compute_dtype,
+            attn_kernel=attn_kernel,
         ))
 
     def _require_full_role(self):

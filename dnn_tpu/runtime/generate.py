@@ -86,7 +86,7 @@ def _block_with_cache(bp, x, layer_cache, start_pos, *, cfg: GPTConfig,
 
 
 def forward_with_cache(prepared, ids, cache, start_pos, *, cfg: GPTConfig,
-                       compute_dtype=None, ffn=None, attn_kernel=False):
+                       compute_dtype=None, ffn=None, attn_kernel="auto"):
     """Forward ids (B, T) at positions [start_pos, start_pos+T) through all
     layers (scan over the stacked blocks), updating the cache. Returns
     (logits (B, T, V), cache). The cache format picks the storage codec:
@@ -94,7 +94,11 @@ def forward_with_cache(prepared, ids, cache, start_pos, *, cfg: GPTConfig,
     (init_cache(..., dtype="int8")). `attn_kernel=True` runs cache
     attention through the Pallas streaming kernel
     (dnn_tpu/ops/pallas/cached_attention.py) — decode steps AND prefill
-    chunks alike, one compiled program regardless of position."""
+    chunks alike, one compiled program regardless of position; the
+    default "auto" engages that kernel only on TPU against caches of
+    >= kvcache.AUTO_KERNEL_MIN_S positions (length-aware dispatch: the
+    long-context regime where clamped streaming beats reading the full
+    allocation) and is the plain einsum everywhere else."""
     codec = codec_for_cache(cache, use_kernel=attn_kernel)
     x = _embed_at(prepared, ids, start_pos, compute_dtype=compute_dtype)
 
@@ -465,7 +469,7 @@ def make_generate(cfg: GPTConfig, *, max_new_tokens: int, temperature: float = 0
                   repetition_penalty: Optional[float] = None,
                   logit_bias=None,
                   compute_dtype=None, ffn=None, kv_dtype=None,
-                  attn_kernel: bool = False):
+                  attn_kernel="auto"):
     """Build a jitted generate(prepared, ids, rng) -> (B, max_new_tokens).
 
     `prepared` is the stacked layout from `gpt.prepare_stacked`. The prompt
@@ -476,7 +480,9 @@ def make_generate(cfg: GPTConfig, *, max_new_tokens: int, temperature: float = 0
     jnp.bfloat16 halves cache bandwidth, "int8" quarters it
     (dnn_tpu/runtime/kvcache.py). `attn_kernel=True` streams the cache
     through the Pallas attention kernel on TPU (fused int8 dequant; einsum
-    fallback elsewhere). `min_p` drops tokens below min_p x the top
+    fallback elsewhere); the default "auto" engages it only for
+    long-context caches on TPU (kvcache.AUTO_KERNEL_MIN_S), False forces
+    the einsum. `min_p` drops tokens below min_p x the top
     probability; `repetition_penalty` (HF/CTRL semantics) penalizes every
     token already in the sequence — when set, a (B, V) seen-mask rides
     the decode carry (scatter per step; only materialized when the

@@ -365,7 +365,8 @@ def make_generate_moe_ep(cfg: GPTMoEConfig, mesh, *, max_new_tokens: int,
 
         logits, cache = forward_with_cache(
             prep_local, ids_local, cache, 0, cfg=cfg,
-            compute_dtype=compute_dtype, ffn=ffn_for(b * t))
+            compute_dtype=compute_dtype, ffn=ffn_for(b * t),
+            attn_kernel=False)  # inside shard_map: keep the einsum
         # per-device stream: local rows sample locally (greedy ignores rng)
         rng = jax.random.fold_in(rng, lax.axis_index(axis_name))
         rng, sub = jax.random.split(rng)
@@ -378,7 +379,8 @@ def make_generate_moe_ep(cfg: GPTMoEConfig, mesh, *, max_new_tokens: int,
             cache, tok, rng = carry
             logits, cache = forward_with_cache(
                 prep_local, tok[:, None], cache, t + i, cfg=cfg,
-                compute_dtype=compute_dtype, ffn=step_ffn)
+                compute_dtype=compute_dtype, ffn=step_ffn,
+                attn_kernel=False)
             rng, sub = jax.random.split(rng)
             nxt = _sample(logits[:, -1], sub, temperature=temperature,
                           top_k=sample_top_k)

@@ -120,9 +120,12 @@ def make_generate_seq_sharded(cfg: GPTConfig, mesh, *, max_new_tokens: int,
         # beyond the prompt (or beyond s_max on the ragged last shard)
         # zero out and stay masked until decode writes them. ----
         prompt_cache = init_cache(cfg, b, t, compute_dtype or jnp.float32)
+        # attn_kernel pinned off: this forward runs INSIDE shard_map,
+        # where the "auto" policy's Pallas engagement is untested (same
+        # pin as every other shard_map call site)
         logits, prompt_cache = forward_with_cache(
             prepared, ids, prompt_cache, 0, cfg=cfg,
-            compute_dtype=compute_dtype)
+            compute_dtype=compute_dtype, attn_kernel=False)
         g = lo + jnp.arange(sd)          # my global positions
         in_prompt = g < t
         local = {

@@ -55,7 +55,20 @@ from jax import lax
 _NEG_BIG = -1e30
 
 __all__ = ["FloatKV", "Int8KV", "RollingFloatKV", "RollingInt8KV",
-           "band_keep", "codec_for_cache"]
+           "band_keep", "codec_for_cache", "AUTO_KERNEL_MIN_S"]
+
+# `use_kernel="auto"` threshold: below this many cache positions the XLA
+# einsum path is at least as fast as the Pallas streaming kernel on every
+# measured shape (benchmarks/attn_kernel_probe.py: einsum is
+# near-bandwidth-optimal at short/moderate context, and the bucketed
+# decode path — runtime/decode_buckets.py — keeps the allocation tracking
+# the live length anyway). At or above it, a decode step against a LONG
+# preallocated cache routes through the position-clamped kernel
+# (ops/pallas/cached_attention.decode_attention), whose index-map clamp
+# makes bytes/step proportional to the live position instead of the
+# allocation — the regime behind the 13%-MBU long-context row
+# (BASELINE.md). Heuristic, to be refined when the chip can re-measure.
+AUTO_KERNEL_MIN_S = 1024
 
 
 def band_keep(cols, limit, window):
@@ -74,9 +87,13 @@ def band_keep(cols, limit, window):
 
 class _KernelDispatch:
     """Shared use_kernel plumbing: True engages the Pallas path with its
-    own TPU/tiling dispatch; the string "interpret" forces the kernel in
-    Pallas interpreter mode (CPU CI runs the REAL kernel logic inside the
-    full decode loop instead of silently falling back to the einsum).
+    own TPU/tiling dispatch; the string "auto" engages it ONLY on a TPU
+    backend AND only against caches of at least AUTO_KERNEL_MIN_S
+    positions (the length-aware policy: long-context decode streams
+    through the position-clamped kernel, everything else stays on the
+    einsum / bucketed-XLA path); the string "interpret" forces the kernel
+    in Pallas interpreter mode (CPU CI runs the REAL kernel logic inside
+    the full decode loop instead of silently falling back to the einsum).
 
     Also hosts THE window predicate: every attend variant of every codec
     masks through `_band_keep` / `_rows_keep`, so the sliding-window
@@ -98,6 +115,17 @@ class _KernelDispatch:
 
     def _interp(self):
         return True if self.use_kernel == "interpret" else None
+
+    def _kernel_on(self, c) -> bool:
+        """Resolve the use_kernel mode against a concrete cache: True/
+        "interpret" are unconditional, "auto" is the length-aware policy
+        (TPU backend AND cache length >= AUTO_KERNEL_MIN_S — see the
+        class docstring). Tiling/window/softcap guards stay with each
+        attend variant's call site."""
+        if self.use_kernel == "auto":
+            return (jax.default_backend() == "tpu"
+                    and c["k"].shape[2] >= AUTO_KERNEL_MIN_S)
+        return bool(self.use_kernel)
 
     def _cap(self, s):
         """Apply attention-logit softcapping (identity when unset)."""
@@ -143,7 +171,7 @@ class FloatKV(_KernelDispatch):
     <= limit - W are masked in every attend variant (the kernel has no
     window support, so a window forces the einsum path)."""
 
-    def __init__(self, dtype=jnp.float32, use_kernel: bool = False,
+    def __init__(self, dtype=jnp.float32, use_kernel=False,
                  window: Optional[int] = None,
                  softcap: Optional[float] = None):
         self.dtype = dtype
@@ -177,7 +205,7 @@ class FloatKV(_KernelDispatch):
         GQA group trick, llama.py) never pass base, so use_kernel can't
         silently mis-mask them; they fall through to the einsum (or, for
         T==1 folded rows, route via attend_rows' decode kernel)."""
-        if (self.use_kernel and base is not None and self.window is None
+        if (self._kernel_on(c) and base is not None and self.window is None
                 and window is None and self.softcap is None):
             from dnn_tpu.ops.pallas.cached_attention import (
                 cached_attention, decode_attention,
@@ -241,7 +269,7 @@ class FloatKV(_KernelDispatch):
         """q (B, H, R, D); every row of slot b masked to keys at positions
         <= pos[b]. R=1 is plain per-slot decode; R=G is the LLaMA GQA fold
         (all group rows share their slot's limit — llama.LlamaFamilyRows)."""
-        if (self.use_kernel and self.window is None and window is None
+        if (self._kernel_on(c) and self.window is None and window is None
                 and self.softcap is None):
             from dnn_tpu.ops.pallas.cached_attention import decode_attention
 
@@ -276,7 +304,7 @@ class Int8KV(_KernelDispatch):
 
     `window=W`: sliding-window lower bound, exactly as FloatKV's."""
 
-    def __init__(self, use_kernel: bool = False,
+    def __init__(self, use_kernel=False,
                  window: Optional[int] = None,
                  softcap: Optional[float] = None):
         self.use_kernel = use_kernel
@@ -306,7 +334,7 @@ class Int8KV(_KernelDispatch):
     def attend(self, q, c, pos_limit, base=None, window=None):
         # `base` marks the pos_limit == base + arange(T) contract (see
         # FloatKV.attend) — kernel path only with it
-        if (self.use_kernel and base is not None and self.window is None
+        if (self._kernel_on(c) and base is not None and self.window is None
                 and window is None and self.softcap is None):
             from dnn_tpu.ops.pallas.cached_attention import (
                 cached_attention, decode_attention,
@@ -378,7 +406,7 @@ class Int8KV(_KernelDispatch):
 
     def attend_rows(self, q, c, pos, window=None):
         # shared-limit decode rows, any R (see FloatKV.attend_rows)
-        if (self.use_kernel and self.window is None and window is None
+        if (self._kernel_on(c) and self.window is None and window is None
                 and self.softcap is None):
             from dnn_tpu.ops.pallas.cached_attention import decode_attention
 
@@ -510,12 +538,16 @@ class RollingInt8KV(_RingStorage, Int8KV):
     # attend_rows: Int8KV's scaled einsum with _RingStorage._rows_keep
 
 
-def codec_for_cache(cache, use_kernel: bool = False,
+def codec_for_cache(cache, use_kernel=False,
                     window: Optional[int] = None, rolling: bool = False,
                     softcap: Optional[float] = None):
     """Infer the codec from a cache pytree's structure (int8 caches carry
     scale leaves). `use_kernel` opts attend/attend_rows into the Pallas
-    cached-attention kernel (TPU; einsum fallback elsewhere). `window`
+    cached-attention kernel (TPU; einsum fallback elsewhere): False/True
+    as before, "auto" = the length-aware policy (kernel only on TPU
+    against caches >= AUTO_KERNEL_MIN_S positions — long-context decode
+    streams through the position-clamped kernel, short caches stay on
+    the einsum), "interpret" = kernel in Pallas interpreter mode. `window`
     adds the sliding-window lower bound; `rolling=True` additionally
     treats the cache as a `window`-length ring buffer (rolling cannot be
     inferred from structure — a ring leaf looks like a short cache).

@@ -369,7 +369,15 @@ class LMServer:
     kwargs pass through (slots, max_len, prompt_pad, temperature, top_k,
     top_p, compute_dtype, eos_id, seed, ffn, kv_dtype, family — `ffn` is
     how the MoE family serves,
-    dnn_tpu/runtime/generate_moe.moe_cache_ffn)."""
+    dnn_tpu/runtime/generate_moe.moe_cache_ffn). Two of them shape the
+    daemon's decode-bandwidth story (both length-aware, both default-on
+    or opt-in as noted): `attn_kernel` defaults to "auto" — long-context
+    cache attention streams through the position-clamped Pallas kernel
+    on TPU, the einsum elsewhere (runtime/kvcache.AUTO_KERNEL_MIN_S) —
+    and `decode_buckets=True` grows the dense pool bucket-by-bucket so
+    decode bytes/step track the pool's LIVE context instead of max_len
+    (runtime/decode_buckets.py; dense pools only — paged pools are
+    already length-proportional)."""
 
     def __init__(self, cfg, prepared, *, default_max_new: int = 32,
                  request_timeout: float = 120.0, tokenizer=None,

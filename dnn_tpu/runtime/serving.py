@@ -98,12 +98,14 @@ class GPTFamilyRows:
     KV-head-width cache; MoE stays a GPT block with `ffn` overridden)."""
 
     def __init__(self, cfg, *, compute_dtype=None, ffn=None,
-                 attn_kernel: bool = False):
+                 attn_kernel="auto"):
         self.cfg = cfg
         self.compute_dtype = compute_dtype
         self.ffn = ffn
-        # route cache attention (prefill chunks + decode rows) through the
-        # Pallas streaming kernel (ops/pallas/cached_attention.py)
+        # cache-attention routing (prefill chunks + decode rows): True =
+        # always the Pallas streaming kernel, False = always the einsum,
+        # "auto" (default) = the length-aware policy — kernel only on TPU
+        # against caches >= kvcache.AUTO_KERNEL_MIN_S positions
         self.attn_kernel = attn_kernel
 
     def init_cache(self, batch, max_len, dtype):
@@ -208,7 +210,8 @@ class ContinuousBatcher:
                  repetition_penalty: Optional[float] = None,
                  compute_dtype=None, eos_id: Optional[int] = None, seed: int = 0,
                  ffn=None, kv_dtype=None, family=None,
-                 attn_kernel: bool = False, prefix_cache: int = 0,
+                 attn_kernel="auto", prefix_cache: int = 0,
+                 decode_buckets=False,
                  logprobs_k: int = 0,
                  paged_blocks: int = 0, block_len: int = 16,
                  lora_adapters=None, lora_alphas=None,
@@ -265,7 +268,7 @@ class ContinuousBatcher:
             if ffn is not None:
                 raise ValueError(
                     "pass ffn on the family adapter, not alongside family=")
-            if attn_kernel:
+            if attn_kernel not in ("auto", False):
                 raise ValueError(
                     "pass attn_kernel on the family adapter, not alongside "
                     "family= (the adapter owns its attention path)")
@@ -287,6 +290,33 @@ class ContinuousBatcher:
         # tables (runtime/paged_kvcache.py): admission is then by ACTUAL
         # request length (sum of blocks), not slots x max_len.
         self._paged = int(paged_blocks) > 0
+        # decode bucketing (runtime/decode_buckets.py): the dense pool is
+        # allocated at the smallest ladder bucket covering the longest
+        # LIVE position and grown bucket-by-bucket as sequences advance,
+        # so decode bytes/step track the pool's live context instead of
+        # the max_len allocation. Opt-in (`decode_buckets=True` for the
+        # power-of-two ladder, or an explicit ascending tuple): a
+        # bucketed pool compiles its three programs once PER LIVE BUCKET
+        # — a bounded relaxation of the three-program contract.
+        self._buckets = None
+        self._cache_len = self.max_len
+        if decode_buckets:
+            if self._paged:
+                raise ValueError(
+                    "decode_buckets applies to the dense per-slot cache; "
+                    "the paged pool is already length-proportional "
+                    "(blocks held track actual request length)")
+            from dnn_tpu.runtime.decode_buckets import (
+                bucket_ladder, normalize_ladder, pad_cache_to,
+            )
+
+            self._buckets = (bucket_ladder(self.max_len)
+                             if decode_buckets is True
+                             else normalize_ladder(decode_buckets,
+                                                   self.max_len))
+            self._cache_len = self._buckets[0]
+            # no donation: a pad's output never fits the input buffer
+            self._grow_cache = jax.jit(pad_cache_to, static_argnums=(1,))
         self._allocator = None
         self._paged_window = None
         if self._paged:
@@ -351,11 +381,19 @@ class ContinuousBatcher:
 
             self._gather_row = jax.jit(gather_row)
         else:
-            self.cache = self.family.init_cache(slots, self.max_len,
+            self.cache = self.family.init_cache(slots, self._cache_len,
                                                 cache_dtype)
+            use_k = getattr(self.family, "attn_kernel", False)
+            if self._buckets is not None and use_k == "auto":
+                # bucketing IS the length-aware path: letting "auto"
+                # switch einsum -> kernel when the pool grows past
+                # AUTO_KERNEL_MIN_S would change attention
+                # implementations mid-stream and break the bucketed==
+                # unbucketed token-identity contract
+                use_k = False
             codec = codec_for_cache(
                 self.cache,
-                use_kernel=getattr(self.family, "attn_kernel", False),
+                use_kernel=use_k,
                 window=getattr(self.family, "window", None),
                 softcap=getattr(self.family, "softcap", None))
         self.pos = jnp.zeros((slots,), jnp.int32)      # next write position
@@ -530,17 +568,19 @@ class ContinuousBatcher:
                 lg, rng[None], temperature=temp[None], top_k=tk[None],
                 top_p=tp[None], min_p=mp[None],
             )[0]
-            # the row cache is chunk-rounded (possibly > max_len); only
-            # its first max_len positions install — the overhang holds
-            # nothing but tail-pad garbage (real prompt tokens always fit
-            # inside max_len by the submit() budget check)
+            # the row cache is chunk-rounded (possibly > the pool); only
+            # the pool's own position count installs — the overhang holds
+            # nothing but tail-pad garbage (real prompt tokens always fit:
+            # submit() bounds the prompt by max_len and, on a bucketed
+            # pool, grows the pool past the prompt before finishing)
             if self._paged:
                 cache = codec.install_row(cache, row, install_ids)
             else:
                 cache = {
                     kk: lax.dynamic_update_slice_in_dim(
                         cache[kk],
-                        lax.slice_in_dim(row[kk], 0, self.max_len, axis=3),
+                        lax.slice_in_dim(row[kk], 0, cache[kk].shape[3],
+                                         axis=3),
                         slot, axis=1)
                     for kk in cache
                 }
@@ -576,6 +616,8 @@ class ContinuousBatcher:
         fns = [self._decode, self._prefill_chunk, self._prefill_finish]
         if self._paged:
             fns.append(self._gather_row)
+        if self._buckets is not None:
+            fns.append(self._grow_cache)
         return fns
 
     # ------------------------------------------------------------------
@@ -845,6 +887,11 @@ class ContinuousBatcher:
             inst[:n_shared] = 0
             install_ids = jnp.asarray(inst)
 
+        if self._buckets is not None:
+            # the installed prompt must fit the pool AND the first decode
+            # write (at position len(prompt)) must have a column
+            self._ensure_cache_len(len(prompt) + 1)
+
         try:
             rid = self._next_rid
             self._next_rid += 1
@@ -1014,6 +1061,20 @@ class ContinuousBatcher:
             if c_off is not None:
                 self._ctab_release(constraint)
             raise
+
+    def _ensure_cache_len(self, need: int):
+        """Grow the bucketed dense pool to the smallest ladder bucket
+        covering `need` live positions (no-op when already covered, or on
+        unbucketed pools). Grow-only by design: shrinking mid-flight
+        would thrash the jit cache on every retire; an idle server that
+        wants the small allocation back reconstructs."""
+        if self._buckets is None or need <= self._cache_len:
+            return
+        from dnn_tpu.runtime.decode_buckets import bucket_for
+
+        target = bucket_for(self._buckets, need)
+        self.cache = self._grow_cache(self.cache, target)
+        self._cache_len = target
 
     def _evict_prefix_entry(self):
         """Drop the LRU prefix entry; paged entries release their block
@@ -1239,6 +1300,12 @@ class ContinuousBatcher:
         for slots that advanced; finished requests move to .results."""
         if self.n_active == 0:
             return {}
+        if self._buckets is not None:
+            # this step writes each active slot's next position
+            # (prompt_len + emitted-so-far); cover the furthest one
+            self._ensure_cache_len(max(
+                req["prompt_len"] + len(req["emitted"])
+                for req in self._slot_req if req is not None))
         if self._crow_dirty:
             self._crow = jnp.asarray(self._crow_np)
             self._crow_dirty = False

@@ -92,14 +92,25 @@ class SpeculativeBatcher(ContinuousBatcher):
                 f"{cfg.vocab_size}")
         for bad in ("ffn", "paged_blocks", "logprobs_k",
                     "attn_kernel", "top_p", "min_p", "repetition_penalty",
-                    "lora_adapters", "allow_constraints"):
+                    "lora_adapters", "allow_constraints",
+                    # the verify programs re-trace per cache shape; a
+                    # growing bucketed pool would multiply them per bucket
+                    # — untested composition, rejected until measured
+                    "decode_buckets"):
             # allow_constraints would allocate the (constraint_rows, V)
             # device mask pool for a batcher that rejects every
             # constrained submit (_constraints_ok=False) — fail at
             # construction, not per request
-            if kw.get(bad):
+            val = kw.get(bad)
+            if val and not (bad == "attn_kernel" and val == "auto"):
+                # "auto" is ContinuousBatcher's default mode, not an
+                # opt-in: spelling the default out loud is not an error
                 raise ValueError(
                     f"SpeculativeBatcher does not support {bad}=")
+        # ...but the unsupported kernel path must also not sneak in via
+        # the "auto" default on long pools (max_len >= AUTO_KERNEL_MIN_S
+        # on TPU would engage it): pin the einsum explicitly
+        kw["attn_kernel"] = False
         if kw.get("kv_dtype") == "int8":
             raise ValueError(
                 "SpeculativeBatcher pins float caches (chunked re-feeds "

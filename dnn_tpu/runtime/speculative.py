@@ -67,10 +67,13 @@ def _cached_lm(cfg, compute_dtype):
     if isinstance(cfg, LlamaConfig):
         from dnn_tpu.models import llama
 
+        # attn_kernel pinned off: the speculative rewind/verify loop
+        # has always run (and is only tested) on the einsum path —
+        # mirrors SpeculativeBatcher's explicit pin (serving_spec.py)
         return (lambda b, n: llama.init_cache(cfg, b, n),
                 lambda prepared, ids, cache, pos: llama.forward_with_cache(
                     prepared, ids, cache, pos, cfg=cfg,
-                    compute_dtype=compute_dtype))
+                    compute_dtype=compute_dtype, attn_kernel=False))
     ffn = None
     if isinstance(cfg, GPTMoEConfig):
         # MoE subclasses GPTConfig, so it MUST be caught before the dense
@@ -82,7 +85,8 @@ def _cached_lm(cfg, compute_dtype):
     return (lambda b, n: init_cache(cfg, b, n),
             lambda prepared, ids, cache, pos, _ffn=ffn: forward_with_cache(
                 prepared, ids, cache, pos, cfg=cfg,
-                compute_dtype=compute_dtype, ffn=_ffn))
+                compute_dtype=compute_dtype, ffn=_ffn,
+                attn_kernel=False))
 
 
 def _probs(logits, *, temperature: float, top_k: Optional[int]):

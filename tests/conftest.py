@@ -33,9 +33,93 @@ def _cpu_mesh_guard():
     assert len(jax.devices()) >= 8, f"expected >=8 virtual devices, got {jax.devices()}"
 
 
+# Measured wall-clock per test module (seconds, full suite on the 2-core
+# CI host — regenerate with `pytest --durations=0` and summing per file).
+# The tier-1 gate runs under a FIXED TIME BUDGET (ROADMAP.md: 870 s via
+# `timeout`), far less than the ~36 min the whole suite takes here, so
+# execution ORDER decides how much of the suite the budget certifies.
+# Alphabetical order spent the window on a handful of compile-heavy mesh/
+# kernel integration modules early in the alphabet; running cheapest
+# modules first maximizes tests-verified-per-budget, and truncation then
+# falls on the slowest integration tail (which the unbudgeted full run
+# still covers). Nothing is deselected — every test remains collected
+# and runs when the budget allows.
+_MODULE_COST_S = {
+    "test_interop_reference": 0.1, "test_config": 0.2, "test_data": 0.2,
+    "test_checkpoint": 0.4, "test_bench_echo": 0.5,
+    "test_run_all_state": 0.5, "test_flops": 0.6,
+    "test_native_loader": 0.7, "test_native": 0.8, "test_hlo_audit": 3.4,
+    "test_metrics": 3.7, "test_models_cifar": 4.6, "test_multihost": 4.6,
+    "test_comm": 5.7, "test_models_mlp": 7.3, "test_tokenizer": 7.8,
+    "test_param_placement": 8.7, "test_qwen3": 9.6,
+    "test_torch_export": 11.1, "test_models_gpt": 11.4,
+    "test_grad_accum": 12.9, "test_train_ckpt": 14.3, "test_remat": 14.6,
+    "test_qwen2": 14.7, "test_olmo2": 14.8, "test_tp_generate": 15.6,
+    "test_pipeline": 16.5, "test_seq_parallel": 17.0,
+    "test_generate": 17.7, "test_eval_distill": 17.8, "test_fsdp": 18.2,
+    "test_dp_pp": 18.3, "test_int4": 18.6, "test_prefix_cache": 19.7,
+    "test_rope_scaling": 20.4, "test_lm_server_failures": 20.6,
+    "test_generate_seq": 20.8, "test_pipeline_dtypes": 22.2,
+    "test_phi": 22.3, "test_train_serve_example": 23.1, "test_lora": 23.1,
+    "test_qwen2_moe": 23.2, "test_composition": 23.3,
+    "test_pipeline_generate": 23.3, "test_ulysses": 24.1,
+    "test_quant": 24.3, "test_kvcache": 24.7, "test_lm_streaming": 27.4,
+    "test_beam": 28.9, "test_flash_attention": 28.9, "test_moe": 29.3,
+    "test_interleaved": 33.5, "test_sampler_extras": 33.6,
+    "test_gpt_moe": 34.4, "test_generate_moe": 34.6, "test_train": 35.2,
+    "test_constrain": 35.4, "test_engine_cli": 37.0,
+    "test_cached_attention": 37.4, "test_serving": 37.6,
+    "test_serving_options": 37.6, "test_decode_buckets": 39.9,
+    "test_ring_attention": 39.9, "test_gemma": 40.5,
+    "test_embeddings": 44.4, "test_audit": 50.6, "test_lm_server": 52.1,
+    "test_serving_spec": 53.1, "test_multilora": 57.9,
+    "test_sliding_window": 58.0, "test_tp_pp": 59.9,
+    "test_speculative": 62.4, "test_paged": 64.2,
+    "test_models_llama": 67.1, "test_mixtral": 79.4, "test_1f1b": 88.0,
+    "test_graft_entry": 224.6,
+}
+_DEFAULT_COST_S = 25.0  # unmeasured/new modules slot in mid-pack
+
+
+def pytest_collection_modifyitems(config, items):
+    """Cheapest-module-first execution order (see _MODULE_COST_S).
+    Stable sort keyed per MODULE, so tests within a module stay
+    contiguous and in their original relative order (module-scoped
+    fixtures and intra-module contracts are untouched)."""
+    def key(item):
+        # nodeid, not item.module: never forces an import here
+        mod = item.nodeid.split("::", 1)[0].rsplit("/", 1)[-1]
+        if mod.endswith(".py"):
+            mod = mod[:-3]
+        return (_MODULE_COST_S.get(mod, _DEFAULT_COST_S), mod)
+
+    items.sort(key=key)
+
+
+def _rss_gb() -> float:
+    """Current resident set of this process, GB. Non-Linux hosts fall
+    back to getrusage peak RSS; an unreadable RSS returns inf so the
+    gate FAILS CLOSED (clears every module — the old, safe behavior)."""
+    try:
+        with open("/proc/self/statm") as f:
+            pages = int(f.read().split()[1])
+        return pages * os.sysconf("SC_PAGE_SIZE") / 1e9
+    except (OSError, ValueError, IndexError):
+        pass
+    try:
+        import resource
+        import sys
+
+        ru = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+        # ru_maxrss is KB on Linux, bytes on macOS
+        return ru / 1e9 if sys.platform == "darwin" else ru / 1e6
+    except Exception:  # noqa: BLE001 — no RSS signal at all
+        return float("inf")
+
+
 @pytest.fixture(scope="module", autouse=True)
 def _drop_compile_caches_between_modules():
-    """Free each module's compiled executables when it finishes.
+    """Free compiled executables between modules WHEN MEMORY IS HIGH.
 
     A single pytest process otherwise accumulates every jitted program
     of ~500 tests (plus the device buffers their closures pin); late in
@@ -43,13 +127,20 @@ def _drop_compile_caches_between_modules():
     backend_compile_and_load — observed reproducibly at ~85% of the
     suite, while the same test passes in isolation. Clearing BETWEEN
     modules (never within) keeps intra-module contracts intact — e.g.
-    the serving tests' jit-cache-size regression checks — at the cost of
-    recompiling tiny shared helpers per module."""
-    yield
-    import gc
+    the serving tests' jit-cache-size regression checks.
 
-    jax.clear_caches()
-    gc.collect()
+    Gated on actual resident memory (default 3 GB, override with
+    DNN_TEST_CLEAR_RSS_GB; 0 = clear every module, the old behavior):
+    an unconditional clear forced every module to recompile the shared
+    helpers, costing the time-budgeted tier-1 run a large slice of its
+    window for protection that is only needed near the memory ceiling."""
+    yield
+    threshold = float(os.environ.get("DNN_TEST_CLEAR_RSS_GB", "3"))
+    if _rss_gb() >= threshold:
+        import gc
+
+        jax.clear_caches()
+        gc.collect()
 
 
 @pytest.fixture(scope="session")

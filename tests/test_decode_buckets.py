@@ -1,0 +1,160 @@
+"""Decode bucketing (runtime/decode_buckets.py): token-identity parity
+between the bucketed cache-view programs and the unbucketed allocation —
+solo host-loop decoder AND ContinuousBatcher pool, f32/bf16/int8 caches,
+with sequences growing THROUGH a bucket edge mid-decode (the boundary the
+masking argument must hold at)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dnn_tpu.models import gpt
+from dnn_tpu.runtime import generate as gen
+from dnn_tpu.runtime.decode_buckets import (
+    bucket_for,
+    bucket_ladder,
+    make_bucketed_generate,
+    normalize_ladder,
+    pad_cache_to,
+)
+from dnn_tpu.runtime.serving import ContinuousBatcher
+
+CFG = gpt.GPTConfig(block_size=256, vocab_size=128, n_layer=2, n_head=2,
+                    n_embd=32)
+
+
+@pytest.fixture(scope="module")
+def setup():
+    params = gpt.init(jax.random.PRNGKey(0), CFG)
+    return gpt.prepare_stacked(params, CFG)
+
+
+def test_ladder_shapes():
+    assert bucket_ladder(1536, 64) == (64, 128, 256, 512, 1024, 1536)
+    assert bucket_ladder(64, 64) == (64,)
+    assert bucket_for((64, 128, 256), 64) == 64
+    assert bucket_for((64, 128, 256), 65) == 128
+    with pytest.raises(ValueError, match="exceed"):
+        bucket_for((64,), 65)
+    # explicit ladders: ascending enforced, max_len always the top rung
+    assert normalize_ladder((16, 32), 96) == (16, 32, 96)
+    assert normalize_ladder((16, 200), 96) == (16, 96)
+    with pytest.raises(ValueError, match="ascend"):
+        normalize_ladder((32, 16), 96)
+
+
+def test_pad_cache_grows_position_axis_only():
+    cache = gen.init_cache(CFG, 2, 16, "int8")
+    grown = pad_cache_to(cache, 48)
+    assert grown["k"].shape == cache["k"].shape[:3] + (48,) + \
+        cache["k"].shape[4:]
+    assert grown["ks"].shape == cache["ks"].shape[:3] + (48,)
+    np.testing.assert_array_equal(np.asarray(grown["k"][:, :, :, :16]),
+                                  np.asarray(cache["k"]))
+    with pytest.raises(ValueError, match="shrink"):
+        pad_cache_to(cache, 8)
+
+
+@pytest.mark.parametrize("kv_dtype", [None, jnp.bfloat16, "int8"],
+                         ids=["f32", "bf16", "int8"])
+def test_solo_bucketed_greedy_parity_through_edge(setup, kv_dtype):
+    """Greedy tokens are identical bucketed vs unbucketed vs the scan
+    decoder, with the sequence growing through the 16- and 32-bucket
+    edges mid-decode (prompt 10 + 30 new -> live 10..40)."""
+    prepared = setup
+    ids = jax.random.randint(jax.random.PRNGKey(1), (2, 10), 0,
+                             CFG.vocab_size, dtype=jnp.int32)
+    rng = jax.random.PRNGKey(2)
+    kw = dict(max_len=128, max_new_tokens=30, kv_dtype=kv_dtype)
+    bucketed = make_bucketed_generate(CFG, buckets=(16, 32, 64), **kw)
+    assert bucketed.buckets == (16, 32, 64, 128)
+    unbucketed = make_bucketed_generate(CFG, buckets=(128,), **kw)
+    got = np.asarray(bucketed(prepared, ids, rng))
+    np.testing.assert_array_equal(got,
+                                  np.asarray(unbucketed(prepared, ids, rng)))
+    # and against the lax.scan decoder (its cache is allocated at
+    # prompt+new, a THIRD allocation size — masking makes all three agree)
+    scan_fn = gen.make_generate(CFG, max_new_tokens=30, kv_dtype=kv_dtype)
+    np.testing.assert_array_equal(got, np.asarray(scan_fn(prepared, ids,
+                                                          rng)))
+
+
+def test_solo_bucketed_sampled_parity(setup):
+    """rng discipline matches the scan decoder split-for-split, so even
+    SAMPLED streams agree draw-for-draw."""
+    prepared = setup
+    ids = jax.random.randint(jax.random.PRNGKey(3), (2, 6), 0,
+                             CFG.vocab_size, dtype=jnp.int32)
+    rng = jax.random.PRNGKey(4)
+    bucketed = make_bucketed_generate(
+        CFG, max_len=128, max_new_tokens=20, buckets=(8, 16, 32),
+        temperature=1.0, top_k=8)
+    scan_fn = gen.make_generate(CFG, max_new_tokens=20, temperature=1.0,
+                                top_k=8)
+    np.testing.assert_array_equal(np.asarray(bucketed(prepared, ids, rng)),
+                                  np.asarray(scan_fn(prepared, ids, rng)))
+
+
+def test_solo_rejects_overflow(setup):
+    bucketed = make_bucketed_generate(CFG, max_len=32, max_new_tokens=30)
+    with pytest.raises(ValueError, match="exceeds"):
+        bucketed(setup, jnp.zeros((1, 8), jnp.int32), jax.random.PRNGKey(0))
+
+
+@pytest.mark.parametrize("kv_dtype", [None, jnp.bfloat16, "int8"],
+                         ids=["f32", "bf16", "int8"])
+def test_batcher_bucketed_parity(setup, kv_dtype):
+    """A bucketed pool (batcher + mixed-length prompts, decode crossing
+    the 32- and 64-bucket edges) emits exactly the unbucketed pool's
+    tokens."""
+    prepared = setup
+
+    def run(decode_buckets):
+        srv = ContinuousBatcher(CFG, prepared, slots=3, max_len=96,
+                                prompt_pad=16, kv_dtype=kv_dtype,
+                                decode_buckets=decode_buckets)
+        prompts = [np.arange(1, 12) % CFG.vocab_size,
+                   (np.arange(1, 30) * 3) % CFG.vocab_size,
+                   np.arange(1, 5)]
+        rids = [srv.submit(p, max_new_tokens=24) for p in prompts]
+        out = srv.drain()
+        return [np.asarray(out[r]) for r in rids], srv
+
+    base, _ = run(False)
+    buck, srv = run(True)
+    assert srv._buckets == (64, 96)
+    # the pool grew past its first bucket (prompt 29 + 24 new -> live 53
+    # fits 64; three slots at pos<=52... the long prompt's decode crosses)
+    for a, b in zip(base, buck):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_batcher_bucketed_grows_through_edge(setup):
+    """Pin the growth mechanics: a pool starting at its smallest bucket
+    ends at a larger one after decoding past the edge, and a late-join
+    request on the grown pool still matches its solo decode."""
+    prepared = setup
+    srv = ContinuousBatcher(CFG, prepared, slots=2, max_len=128,
+                            prompt_pad=16, decode_buckets=(32, 48, 128))
+    assert srv._cache_len == 32
+    r1 = srv.submit(np.arange(1, 20) % CFG.vocab_size, max_new_tokens=20)
+    while srv.n_active:
+        srv.step()
+    assert srv._cache_len == 48  # live ran to 39 -> grew past the 32 edge
+    r2 = srv.submit(np.arange(1, 8) % CFG.vocab_size, max_new_tokens=8)
+    out = srv.drain()
+    solo = ContinuousBatcher(CFG, prepared, slots=2, max_len=128,
+                             prompt_pad=16)
+    s1 = solo.submit(np.arange(1, 20) % CFG.vocab_size, max_new_tokens=20)
+    s2 = solo.submit(np.arange(1, 8) % CFG.vocab_size, max_new_tokens=8)
+    sout = solo.drain()
+    np.testing.assert_array_equal(out[r1], sout[s1])
+    np.testing.assert_array_equal(out[r2], sout[s2])
+
+
+def test_batcher_bucketed_rejects_paged(setup):
+    with pytest.raises(ValueError, match="paged"):
+        ContinuousBatcher(CFG, setup, slots=2, max_len=64, prompt_pad=16,
+                          paged_blocks=8, block_len=16,
+                          decode_buckets=True)
