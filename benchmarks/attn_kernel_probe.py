@@ -37,7 +37,9 @@ B, H, D = 8, 12, 64
 def main():
     rng = jax.random.PRNGKey(0)
     for s_len in (256, 1024, 4096, 16384):
-        kq, kk, kv = jax.random.split(rng, 3)
+        # per-length keys (fold_in): the old split of the never-rebound
+        # base key handed every s_len the SAME q/k/v draws (TPU003)
+        kq, kk, kv = jax.random.split(jax.random.fold_in(rng, s_len), 3)
         q = jax.random.normal(kq, (B, H, 1, D), jnp.bfloat16)
         k = jax.random.normal(kk, (B, H, s_len, D), jnp.bfloat16)
         v = jax.random.normal(kv, (B, H, s_len, D), jnp.bfloat16)

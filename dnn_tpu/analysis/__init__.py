@@ -1,0 +1,30 @@
+"""Trace/shard-safety static analyzer.
+
+Two passes over the framework (and over user model code, via CLI paths):
+
+  * AST lint (analysis/lint.py) — rules TPU001..TPU006 over source text:
+    traced-value Python branching, implicit host transfers, PRNG key
+    reuse, use-after-donation, loop-scalar recompile hazards, and
+    divergent collectives across SPMD branches. No jax import needed.
+  * program pass (analysis/program.py) — rules PRG001..PRG004 over the
+    REAL entrypoints' jaxprs/lowerings: collective-sequence consistency
+    across pipeline stage programs, allocation-sized baked constants,
+    cache-donation coverage, and a recompile census with the bucketed
+    decode's ladder bound. Device-free (eval_shape avals), CPU-only.
+
+Gate: `python -m dnn_tpu.analysis` — exits nonzero on any finding not in
+analysis/baseline.json; baselined findings are enumerated (never hidden)
+and each carries a one-line justification. See README "Static analysis".
+"""
+
+from dnn_tpu.analysis.findings import (  # noqa: F401
+    Finding,
+    RULES,
+    diff_against_baseline,
+    load_baseline,
+    render_finding,
+)
+from dnn_tpu.analysis.lint import lint_paths, lint_source  # noqa: F401
+
+__all__ = ["Finding", "RULES", "lint_paths", "lint_source",
+           "load_baseline", "diff_against_baseline", "render_finding"]

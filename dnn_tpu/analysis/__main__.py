@@ -1,0 +1,180 @@
+"""CLI + CI gate: `python -m dnn_tpu.analysis`.
+
+Runs the AST lint over the package (plus any extra paths) and the
+device-free program pass over the real entrypoints, diffs everything
+against analysis/baseline.json, and exits nonzero on any NEW finding.
+Baselined findings are printed (enumerated, not hidden) with their
+justification; baseline entries that no longer fire are reported stale.
+
+The pass is CPU-only by design: before jax loads we force the cpu
+platform with 8 virtual host devices (the same harness tests/conftest.py
+uses), so the program pass traces the mesh entrypoints on any host —
+including CI runners and hosts whose TPU tunnel is wedged.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+
+def _force_cpu():
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "--xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+    if "jax" in sys.modules:
+        # env alone is too late once jax is imported; backend init is
+        # lazy though, so the config route still lands (conftest.py's
+        # trick, reused here for in-process callers like the test suite)
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+
+
+def main(argv=None) -> int:
+    import dnn_tpu
+    from dnn_tpu.analysis.findings import (
+        RULES,
+        assign_occurrences,
+        diff_against_baseline,
+        load_baseline,
+        render_finding,
+    )
+    from dnn_tpu.analysis.lint import lint_paths
+
+    pkg_dir = os.path.dirname(os.path.abspath(dnn_tpu.__file__))
+    repo_root = os.path.dirname(pkg_dir)
+    default_baseline = os.path.join(pkg_dir, "analysis", "baseline.json")
+
+    ap = argparse.ArgumentParser(
+        prog="python -m dnn_tpu.analysis",
+        description="trace/shard-safety static analyzer (AST lint + "
+                    "device-free jaxpr program checks)")
+    ap.add_argument("paths", nargs="*",
+                    help="files/dirs to lint (default: the dnn_tpu "
+                         "package)")
+    ap.add_argument("--baseline", default=default_baseline,
+                    help="suppression file (default: "
+                         "dnn_tpu/analysis/baseline.json)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="ignore the baseline (report everything as new)")
+    ap.add_argument("--no-program", action="store_true",
+                    help="skip the jaxpr program pass (pure AST lint — "
+                         "no jax import)")
+    ap.add_argument("--max-len", type=int, default=128,
+                    help="cache allocation the decode census sweeps to "
+                         "(default 128; benchmarks/STUDIES.md §7 records "
+                         "1024)")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="machine-readable report on stdout")
+    ap.add_argument("--list-rules", action="store_true")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="rewrite the baseline from current findings "
+                         "(justifications of kept entries are preserved; "
+                         "new entries get a fill-me-in marker)")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for rule, (title, desc) in RULES.items():
+            print(f"{rule}  {title}\n    {desc}")
+        return 0
+
+    lint_targets = args.paths or [pkg_dir]
+    findings = list(lint_paths(lint_targets, repo_root=repo_root))
+
+    program_report = None
+    if not args.no_program:
+        _force_cpu()
+        from dnn_tpu.analysis.program import run_program_audit
+
+        program_report, prog_findings = run_program_audit(
+            max_len=args.max_len)
+        findings = assign_occurrences(findings + list(prog_findings))
+
+    entries = []
+    if not args.no_baseline and os.path.exists(args.baseline):
+        entries = load_baseline(args.baseline)
+    new, suppressed, stale = diff_against_baseline(findings, entries)
+
+    if args.write_baseline:
+        kept = {e["fingerprint"]: e for e in entries}
+        out = {"suppressions": [
+            kept.get(f.fingerprint, {
+                "fingerprint": f.fingerprint,
+                "rule": f.rule, "path": f.path, "snippet": f.snippet,
+                "justification": "(unjustified — explain why this "
+                                 "finding stays, or fix it)",
+            }) for f in findings]}
+        with open(args.baseline, "w") as fh:
+            json.dump(out, fh, indent=2)
+            fh.write("\n")
+        print(f"wrote {len(findings)} entries to {args.baseline}")
+        return 0
+
+    if args.as_json:
+        print(json.dumps({
+            "new": [vars(f) | {"fingerprint": f.fingerprint} for f in new],
+            "suppressed": [vars(f) | {"fingerprint": f.fingerprint}
+                           for f in suppressed],
+            "stale_baseline": stale,
+            "program_report": program_report,
+        }, indent=2, default=str))
+        return 1 if new else 0
+
+    if program_report is not None:
+        dec = program_report.get("decode", {})
+        print("program pass:")
+        print(f"  decode donation: "
+              f"{dec.get('donation', {}).get('aliased')}/"
+              f"{dec.get('donation', {}).get('expected')} cache buffers "
+              "aliased")
+        bc = dec.get("bucketed_census", {})
+        nc = dec.get("naive_census", {})
+        print(f"  bucketed decode census: {bc.get('programs')} programs "
+              f"for {bc.get('calls')} steps (ladder bound "
+              f"{bc.get('bound')}; naive exact-length dispatch: "
+              f"{nc.get('programs')})")
+        pipe = program_report.get("pipeline", {})
+        print(f"  pipeline stage collective signature: "
+              f"{pipe.get('collective_signature')}")
+        eng = program_report.get("engine", {})
+        print(f"  engine[{eng.get('runtime')}] batch census: "
+              f"{eng.get('batch_census', {}).get('programs')} programs "
+              f"/ {eng.get('batch_census', {}).get('calls')} batch "
+              "shapes")
+    if suppressed:
+        just = {e["fingerprint"]: e.get("justification", "")
+                for e in entries}
+        print(f"\n{len(suppressed)} baseline-suppressed finding(s) "
+              "(known, justified, NOT hidden):")
+        for f in suppressed:
+            print(f"  {f.path}:{f.line} {f.rule} — "
+                  f"{just.get(f.fingerprint, '')}")
+    if stale:
+        print(f"\n{len(stale)} stale baseline entr(y/ies) — the finding "
+              "no longer fires; delete from baseline.json:")
+        for e in stale:
+            print(f"  {e['fingerprint']} ({e.get('path', '?')})")
+    if new:
+        print(f"\n{len(new)} NEW finding(s):")
+        for f in new:
+            print(render_finding(f))
+        print("\nFAIL: new findings above are not in the baseline. Fix "
+              "them, or (with a written justification) add them to "
+              f"{args.baseline}.")
+        return 1
+    print(f"\nOK: no new findings ({len(findings)} total, "
+          f"{len(suppressed)} baselined).")
+    return 0
+
+
+if __name__ == "__main__":
+    try:
+        sys.exit(main())
+    except BrokenPipeError:  # `... | head` closed stdout mid-report
+        os.dup2(os.open(os.devnull, os.O_WRONLY), sys.stdout.fileno())
+        sys.exit(0)

@@ -1,0 +1,167 @@
+"""Findings, rule registry, and the baseline suppression gate.
+
+A Finding is one hazard at one source location. Its fingerprint is
+line-number-free on purpose (rule + repo-relative path + the stripped
+source line + an occurrence counter for identical lines), so a checked-in
+baseline survives unrelated edits above a finding but dies with the line
+it suppresses — a stale suppression is reported, never silently kept.
+
+The baseline file (analysis/baseline.json) is the explicit list of
+findings HEAD is allowed to carry. Every entry must name its fingerprint
+and a one-line justification; `python -m dnn_tpu.analysis` exits nonzero
+on any finding NOT in the baseline (a new hazard) and warns on any
+baseline entry that no longer fires (a stale suppression to delete).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+from typing import Dict, List, Sequence, Tuple
+
+__all__ = ["Finding", "RULES", "assign_occurrences", "load_baseline",
+           "diff_against_baseline", "render_finding"]
+
+# rule id -> (title, one-line description for the CLI/README table)
+RULES: Dict[str, Tuple[str, str]] = {
+    "TPU001": (
+        "traced-value Python branching",
+        "Python `if`/`while` on a traced value inside a jitted/traced "
+        "function — raises ConcretizationTypeError at trace time or forces "
+        "a host sync; use lax.cond/jnp.where/lax.while_loop.",
+    ),
+    "TPU002": (
+        "implicit host transfer",
+        "float()/int()/bool()/.item()/.tolist()/np.asarray() on a traced "
+        "value inside a traced function — a device->host sync (or trace "
+        "error) on the hot path; keep the value on device (jnp.*).",
+    ),
+    "TPU003": (
+        "PRNG key reuse",
+        "the same PRNG key consumed by more than one jax.random draw "
+        "without an intervening split/fold_in — correlated 'randomness'; "
+        "split first: `key, sub = jax.random.split(key)`.",
+    ),
+    "TPU004": (
+        "use after donation",
+        "a buffer passed at a donate_argnums position is read again after "
+        "the call — donated buffers are invalidated (XLA may already have "
+        "overwritten them); rebind from the call's result instead.",
+    ),
+    "TPU005": (
+        "recompile hazard (raw scalar / static arg in loop)",
+        "a Python value derived from a loop variable reaches a jitted "
+        "callable raw (weak-type churn recompiles silently when call "
+        "sites disagree; pin with jnp.int32(...)/jnp.asarray(...)) or at "
+        "a static_argnums position (one compile per distinct value).",
+    ),
+    "TPU006": (
+        "divergent collectives across SPMD branches",
+        "branches of lax.cond/lax.switch (or a Python if/else) inside a "
+        "shard_map/pmap body issue different collective sequences — ranks "
+        "taking different branches deadlock the program (SPMD requires "
+        "identical collective order on every rank).",
+    ),
+    # program-pass (jaxpr-level) findings — same gate, different detector
+    "PRG001": (
+        "divergent collectives across compiled branches",
+        "a cond/switch in a lowered program has branches with different "
+        "collective sequences (jaxpr walk — catches dynamically built "
+        "branch lists the AST pass cannot resolve).",
+    ),
+    "PRG002": (
+        "allocation-sized constant baked into program",
+        "a jaxpr constant at allocation scale (closed-over concrete "
+        "array) — the compiled program carries a private copy per "
+        "compilation instead of taking the buffer as an argument.",
+    ),
+    "PRG003": (
+        "donation coverage gap",
+        "a decode-step program does not alias (donate) its cache inputs "
+        "to outputs — every step then pays a full cache copy.",
+    ),
+    "PRG004": (
+        "recompile census over bound",
+        "a shape sweep of an entrypoint compiles more distinct programs "
+        "than its documented bound (e.g. the bucket ladder length).",
+    ),
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    rule: str
+    path: str  # repo-relative (or "<program>" for program-pass findings)
+    line: int
+    message: str
+    snippet: str  # stripped source line (fingerprint component)
+    occurrence: int = 0  # disambiguates identical (rule, path, snippet)
+
+    @property
+    def fingerprint(self) -> str:
+        h = hashlib.sha1(
+            f"{self.rule}|{self.path}|{self.snippet}|{self.occurrence}"
+            .encode()).hexdigest()[:12]
+        return f"{self.rule}:{self.path}:{h}"
+
+
+def render_finding(f: Finding) -> str:
+    title = RULES.get(f.rule, ("?", ""))[0]
+    loc = f"{f.path}:{f.line}" if f.line else f.path
+    out = f"{loc}: {f.rule} [{title}] {f.message}"
+    if f.snippet:
+        out += f"\n    | {f.snippet}"
+    out += f"\n    fingerprint: {f.fingerprint}"
+    return out
+
+
+def assign_occurrences(findings: Sequence[Finding]) -> List[Finding]:
+    """Number identical (rule, path, snippet) findings 0..n-1 in source
+    order so each gets a distinct, stable fingerprint."""
+    seen: Dict[Tuple[str, str, str], int] = {}
+    out = []
+    for f in sorted(findings, key=lambda f: (f.path, f.line, f.rule)):
+        k = (f.rule, f.path, f.snippet)
+        n = seen.get(k, 0)
+        seen[k] = n + 1
+        out.append(dataclasses.replace(f, occurrence=n))
+    return out
+
+
+def load_baseline(path) -> List[dict]:
+    """Read the suppression file: a list of {fingerprint, justification}
+    entries (extra keys — rule/path/snippet — are informational). Every
+    entry MUST carry a non-empty justification; an unexplained
+    suppression is a config error, not a finding to hide."""
+    with open(path) as f:
+        data = json.load(f)
+    entries = data["suppressions"] if isinstance(data, dict) else data
+    for e in entries:
+        if not e.get("fingerprint"):
+            raise ValueError(f"baseline entry missing fingerprint: {e}")
+        if not str(e.get("justification", "")).strip():
+            raise ValueError(
+                f"baseline entry {e['fingerprint']} has no justification — "
+                "every suppressed finding must say why it stays")
+    return entries
+
+
+def diff_against_baseline(findings: Sequence[Finding], entries: Sequence[dict]):
+    """(new_findings, suppressed_findings, stale_entries). A baseline
+    entry suppresses at most one finding with its fingerprint; anything
+    beyond the baselined count is new."""
+    budget: Dict[str, int] = {}
+    for e in entries:
+        budget[e["fingerprint"]] = budget.get(e["fingerprint"], 0) + 1
+    new, suppressed = [], []
+    for f in findings:
+        if budget.get(f.fingerprint, 0) > 0:
+            budget[f.fingerprint] -= 1
+            suppressed.append(f)
+        else:
+            new.append(f)
+    fired = {f.fingerprint for f in suppressed}
+    stale = [e for e in entries
+             if e["fingerprint"] not in fired]
+    return new, suppressed, stale

@@ -1,0 +1,757 @@
+"""AST lint pass: trace/shard-safety rules TPU001-TPU006.
+
+Pure `ast` — no jax import, no tracing, no devices — so the whole ~22k-LoC
+package lints in well under a second and the pass can run on user model
+code that does not even import cleanly on this host.
+
+What counts as a *traced function* (the scope of TPU001/TPU002/TPU006):
+
+  * a def decorated with jit/pjit/pmap/vmap/grad/shard_map (bare or via
+    functools.partial(jax.jit, ...));
+  * a def whose NAME is passed to a jax transform / control-flow combinator
+    (jax.jit(f), lax.scan(step, ...), jax.shard_map(body, ...), ...);
+  * any def lexically nested inside a traced function (closures traced
+    with their parent).
+
+Helpers called by traced code but neither decorated, passed, nor nested
+(ordinary module-level functions) are NOT treated as traced: whole-package
+interprocedural analysis would drown the signal in false positives. The
+jaxpr-level program pass (analysis/program.py) covers the composed
+programs those helpers end up in.
+
+Taint discipline: a traced function's parameters are traced values
+(minus static_argnums/static_argnames); `.shape/.ndim/.dtype/.size`,
+`len()`, `isinstance()` and `is`-comparisons launder taint (they are
+Python-static under jit). Statements are processed in source order, and
+loop bodies are processed TWICE so second-iteration hazards (key reuse,
+use-after-donation of a buffer donated in iteration one) surface without
+a fixpoint engine.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from dnn_tpu.analysis.findings import Finding, assign_occurrences
+
+__all__ = ["lint_source", "lint_paths", "iter_python_files"]
+
+# jax transforms / combinators whose function-valued args are traced
+_TRACERS = {
+    "jit", "pjit", "pmap", "vmap", "grad", "value_and_grad", "jacfwd",
+    "jacrev", "hessian", "scan", "cond", "switch", "while_loop",
+    "fori_loop", "associative_scan", "checkpoint", "remat", "eval_shape",
+    "make_jaxpr", "named_call", "pallas_call", "custom_jvp", "custom_vjp",
+    "linearize", "vjp", "jvp",
+}
+_SPMD = {"shard_map", "pmap"}
+# attributes/calls that read Python-static metadata off a traced value
+_STATIC_ATTRS = {"shape", "ndim", "dtype", "size", "itemsize", "weak_type",
+                 "sharding", "aval", "nbytes"}
+_STATIC_CALLS = {"len", "isinstance", "type", "hasattr", "callable", "id",
+                 "repr", "str", "format"}
+_STATIC_CALL_ATTRS = {"shape", "ndim", "result_type", "issubdtype", "dtype",
+                      "tree_structure"}
+# device->host converters (TPU002)
+_HOST_BUILTINS = {"float", "int", "bool", "complex"}
+_HOST_NP_FNS = {"asarray", "array", "ascontiguousarray", "copy"}
+_HOST_METHODS = {"item", "tolist", "__array__"}
+# jax.random draws; split also CONSUMES its key (the entropy moves into
+# the children — drawing from the parent afterwards correlates streams)
+# but yields fresh keys. fold_in(key, data) is NON-consuming on purpose:
+# deriving per-step keys from one base key with varying data is the
+# documented idiom (fold_in(key, i) in a loop must not flag).
+_KEY_MAKERS = {"PRNGKey", "key", "split", "fold_in", "clone",
+               "wrap_key_data"}
+_KEY_NONCONSUMING = {"PRNGKey", "key", "fold_in", "wrap_key_data",
+                     "key_data", "key_impl", "default_prng_impl"}
+_COLLECTIVES = {"psum", "ppermute", "all_gather", "all_to_all",
+                "psum_scatter", "pmean", "pmin", "pmax", "pbroadcast",
+                "all_gather_invariant", "axis_index_groups"}
+# arg-wrapping namespaces that pin a committed dtype (TPU005 clean form)
+_WRAP_PREFIXES = ("jnp.", "jax.", "np.", "numpy.")
+
+
+def _callee(call: ast.Call) -> str:
+    """Dotted name of a call target ('jax.random.split'); '' if dynamic."""
+    try:
+        return ast.unparse(call.func)
+    except Exception:  # pragma: no cover - unparse of exotic nodes
+        return ""
+
+
+def _last(name: str) -> str:
+    return name.rsplit(".", 1)[-1]
+
+
+def _literal_indices(node) -> Tuple[int, ...]:
+    """donate_argnums/static_argnums keyword literal -> tuple of ints."""
+    try:
+        v = ast.literal_eval(node)
+    except (ValueError, SyntaxError):
+        return ()
+    if isinstance(v, int):
+        return (v,)
+    if isinstance(v, (tuple, list)):
+        return tuple(int(i) for i in v if isinstance(i, int))
+    return ()
+
+
+def _literal_names(node) -> Tuple[str, ...]:
+    try:
+        v = ast.literal_eval(node)
+    except (ValueError, SyntaxError):
+        return ()
+    if isinstance(v, str):
+        return (v,)
+    if isinstance(v, (tuple, list)):
+        return tuple(s for s in v if isinstance(s, str))
+    return ()
+
+
+class _JitInfo:
+    """What we know about one jitted callable (by its bound name)."""
+
+    def __init__(self, donate=(), static=(), static_names=()):
+        self.donate = tuple(donate)
+        self.static = tuple(static)
+        self.static_names = tuple(static_names)
+
+
+def _jit_call_info(call: ast.Call) -> Optional[_JitInfo]:
+    """_JitInfo for `jax.jit(f, ...)` / `functools.partial(jax.jit, ...)`
+    call nodes; None if the call is not a jit wrapper."""
+    name = _last(_callee(call))
+    inner = None
+    if name == "partial" and call.args:
+        first = call.args[0]
+        if isinstance(first, (ast.Name, ast.Attribute)) and \
+                _last(ast.unparse(first)) in ("jit", "pjit"):
+            inner = call
+    if name in ("jit", "pjit"):
+        inner = call
+    if inner is None:
+        return None
+    donate = static = ()
+    static_names = ()
+    for kw in inner.keywords:
+        if kw.arg == "donate_argnums":
+            donate = _literal_indices(kw.value)
+        elif kw.arg == "static_argnums":
+            static = _literal_indices(kw.value)
+        elif kw.arg == "static_argnames":
+            static_names = _literal_names(kw.value)
+    return _JitInfo(donate, static, static_names)
+
+
+class _ModuleIndex(ast.NodeVisitor):
+    """One walk over the module: traced/spmd function names, jitted
+    callables (with donation/static info), and a parent chain for defs."""
+
+    def __init__(self):
+        self.traced_names: Set[str] = set()
+        self.spmd_names: Set[str] = set()
+        # decorated defs, tracked by NODE identity (name-based marking
+        # would poison same-named siblings elsewhere in the module)
+        self.traced_nodes: Dict[int, _JitInfo] = {}
+        self.spmd_nodes: Set[int] = set()
+        # bound-name (possibly dotted, e.g. 'self._decode') -> _JitInfo
+        self.jitted: Dict[str, _JitInfo] = {}
+        # traced function name -> _JitInfo (for static-param untainting)
+        self.traced_info: Dict[str, _JitInfo] = {}
+
+    def visit_Call(self, node: ast.Call):
+        name = _last(_callee(node))
+        if name in _TRACERS or name in _SPMD:
+            info = _jit_call_info(node) or _JitInfo()
+            for a in node.args:
+                targets = a.elts if isinstance(a, (ast.List, ast.Tuple)) \
+                    else [a]
+                for t in targets:
+                    if isinstance(t, ast.Name):
+                        self.traced_names.add(t.id)
+                        self.traced_info.setdefault(t.id, info)
+                        if name in _SPMD:
+                            self.spmd_names.add(t.id)
+        self.generic_visit(node)
+
+    def visit_Assign(self, node: ast.Assign):
+        if isinstance(node.value, ast.Call):
+            info = _jit_call_info(node.value)
+            # `f = jax.jit(g, ...)`: f is a jitted callable (donation and
+            # static args apply at f's call sites); partial(...) alone
+            # (no wrapped fn yet) binds at decoration, not here
+            if info is not None and _last(_callee(node.value)) != "partial":
+                for t in node.targets:
+                    if isinstance(t, (ast.Name, ast.Attribute)):
+                        self.jitted[ast.unparse(t)] = info
+        self.generic_visit(node)
+
+    def _visit_def(self, node):
+        for dec in node.decorator_list:
+            if isinstance(dec, (ast.Name, ast.Attribute)) and \
+                    _last(ast.unparse(dec)) in (_TRACERS | _SPMD):
+                info = _JitInfo()
+            elif isinstance(dec, ast.Call):
+                info = _jit_call_info(dec)
+                if info is None and \
+                        _last(_callee(dec)) not in (_TRACERS | _SPMD):
+                    continue
+                info = info or _JitInfo()
+            else:
+                continue
+            self.traced_nodes[id(node)] = info
+            self.jitted.setdefault(node.name, info)
+            dec_name = _last(ast.unparse(dec)) if isinstance(
+                dec, (ast.Name, ast.Attribute)) else _last(_callee(dec))
+            if dec_name in _SPMD:
+                self.spmd_nodes.add(id(node))
+        self.generic_visit(node)
+
+    visit_FunctionDef = _visit_def
+    visit_AsyncFunctionDef = _visit_def
+
+
+def _walk_functions(tree):
+    """Yield (funcdef, ancestors) for every def, outermost first."""
+    stack = [(tree, [])]
+    while stack:
+        node, anc = stack.pop()
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield child, anc
+                stack.append((child, anc + [child]))
+            else:
+                stack.append((child, anc))
+
+
+# ----------------------------------------------------------------------
+# expression taint
+# ----------------------------------------------------------------------
+
+def _expr_tainted(node, tainted: Set[str]) -> bool:
+    if isinstance(node, ast.Name):
+        return node.id in tainted
+    if isinstance(node, ast.Attribute):
+        if node.attr in _STATIC_ATTRS:
+            return False
+        return _expr_tainted(node.value, tainted)
+    if isinstance(node, ast.Subscript):
+        return _expr_tainted(node.value, tainted) or \
+            _expr_tainted(node.slice, tainted)
+    if isinstance(node, ast.Call):
+        callee = _callee(node)
+        if _last(callee) in _STATIC_CALLS or \
+                _last(callee) in _STATIC_CALL_ATTRS:
+            return False
+        if any(_expr_tainted(a, tainted) for a in node.args):
+            return True
+        if any(_expr_tainted(kw.value, tainted) for kw in node.keywords):
+            return True
+        # method call on a tainted object (x.astype(...), x.sum())
+        if isinstance(node.func, ast.Attribute):
+            return _expr_tainted(node.func.value, tainted)
+        return False
+    if isinstance(node, ast.Compare):
+        if all(isinstance(op, (ast.Is, ast.IsNot)) for op in node.ops):
+            return False
+        return _expr_tainted(node.left, tainted) or \
+            any(_expr_tainted(c, tainted) for c in node.comparators)
+    if isinstance(node, (ast.BinOp,)):
+        return _expr_tainted(node.left, tainted) or \
+            _expr_tainted(node.right, tainted)
+    if isinstance(node, ast.UnaryOp):
+        return _expr_tainted(node.operand, tainted)
+    if isinstance(node, ast.BoolOp):
+        return any(_expr_tainted(v, tainted) for v in node.values)
+    if isinstance(node, ast.IfExp):
+        return _expr_tainted(node.test, tainted) or \
+            _expr_tainted(node.body, tainted) or \
+            _expr_tainted(node.orelse, tainted)
+    if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+        return any(_expr_tainted(e, tainted) for e in node.elts)
+    if isinstance(node, ast.Dict):
+        return any(_expr_tainted(v, tainted)
+                   for v in list(node.keys) + list(node.values) if v)
+    if isinstance(node, ast.Starred):
+        return _expr_tainted(node.value, tainted)
+    if isinstance(node, ast.Slice):
+        return any(_expr_tainted(p, tainted)
+                   for p in (node.lower, node.upper, node.step) if p)
+    return False
+
+
+def _target_names(target) -> List[str]:
+    """Flat bound names of an assignment target (dotted for attributes)."""
+    if isinstance(target, (ast.Name, ast.Attribute)):
+        try:
+            return [ast.unparse(target)]
+        except Exception:  # pragma: no cover
+            return []
+    if isinstance(target, (ast.Tuple, ast.List)):
+        out = []
+        for e in target.elts:
+            out.extend(_target_names(e))
+        return out
+    if isinstance(target, ast.Starred):
+        return _target_names(target.value)
+    return []
+
+
+def _collective_sequence(node) -> Tuple[str, ...]:
+    """Ordered collective-call names in a subtree (source order)."""
+    out = []
+    for n in ast.walk(node):
+        if isinstance(n, ast.Call):
+            name = _last(_callee(n))
+            if name in _COLLECTIVES:
+                out.append(name)
+    return tuple(out)
+
+
+# ----------------------------------------------------------------------
+# per-function checker
+# ----------------------------------------------------------------------
+
+class _FunctionChecker:
+    def __init__(self, fn, path: str, src_lines: List[str],
+                 index: _ModuleIndex, *, traced: bool, spmd: bool,
+                 local_defs: Dict[str, ast.AST]):
+        self.fn = fn
+        self.path = path
+        self.src_lines = src_lines
+        self.index = index
+        self.traced = traced
+        self.spmd = spmd
+        self.local_defs = local_defs
+        self.findings: List[Finding] = []
+        self._flagged: Set[Tuple[str, int]] = set()
+
+        self.tainted: Set[str] = set()
+        if traced:
+            info = index.traced_nodes.get(id(fn)) or \
+                index.traced_info.get(fn.name) or _JitInfo()
+            args = fn.args
+            pos = list(args.posonlyargs) + list(args.args)
+            for i, a in enumerate(pos):
+                if i in info.static or a.arg in info.static_names:
+                    continue
+                self.tainted.add(a.arg)
+            for a in args.kwonlyargs:
+                if a.arg not in info.static_names:
+                    self.tainted.add(a.arg)
+            if args.vararg:
+                self.tainted.add(args.vararg.arg)
+        self.loopd: Set[str] = set()   # loop-derived Python values
+        self.keys_live: Set[str] = set()
+        self.keys_consumed: Dict[str, int] = {}
+        self.donated: Dict[str, int] = {}  # expr string -> donation line
+
+    # -- emission ------------------------------------------------------
+
+    def _flag(self, rule: str, node, message: str):
+        line = getattr(node, "lineno", 0)
+        if (rule, line) in self._flagged:
+            return
+        self._flagged.add((rule, line))
+        snippet = ""
+        if 0 < line <= len(self.src_lines):
+            snippet = self.src_lines[line - 1].strip()
+        self.findings.append(Finding(
+            rule=rule, path=self.path, line=line, message=message,
+            snippet=snippet))
+
+    # -- driver --------------------------------------------------------
+
+    def run(self):
+        self._process_body(self.fn.body, in_loop=False)
+        return self.findings
+
+    def _process_body(self, body, *, in_loop: bool):
+        for stmt in body:
+            self._process_stmt(stmt, in_loop=in_loop)
+
+    def _process_stmt(self, stmt, *, in_loop: bool):
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return  # nested defs are checked as their own functions
+        if isinstance(stmt, (ast.If,)):
+            if self.traced and _expr_tainted(stmt.test, self.tainted):
+                self._flag(
+                    "TPU001", stmt,
+                    "Python `if` on a traced value inside a traced "
+                    "function; use jnp.where / lax.cond")
+            if self.spmd:
+                self._check_python_branch_collectives(stmt)
+            self._scan_exprs(stmt.test, in_loop)
+            self._process_body(stmt.body, in_loop=in_loop)
+            self._process_body(stmt.orelse, in_loop=in_loop)
+            return
+        if isinstance(stmt, ast.While):
+            if self.traced and _expr_tainted(stmt.test, self.tainted):
+                self._flag(
+                    "TPU001", stmt,
+                    "Python `while` on a traced value inside a traced "
+                    "function; use lax.while_loop")
+            self._scan_exprs(stmt.test, in_loop)
+            for name in self._augassigned_names(stmt.body):
+                self.loopd.add(name)
+            for _ in range(2):
+                self._process_body(stmt.body, in_loop=True)
+            self._process_body(stmt.orelse, in_loop=in_loop)
+            return
+        if isinstance(stmt, ast.For):
+            # iterating a tainted value is NOT flagged: statically a dict
+            # of arrays (legal, common) and an array (unroll hazard) are
+            # indistinguishable, and the dict form dominates real code
+            self._scan_exprs(stmt.iter, in_loop)
+            # loop-derived (TPU005) taint only for PYTHON-SCALAR
+            # induction vars — range()/enumerate() counters; iterating
+            # data yields arrays, whose dtypes are already committed
+            loopd_targets = []
+            if isinstance(stmt.iter, ast.Call):
+                it_name = _last(_callee(stmt.iter))
+                targets = _target_names(stmt.target)
+                if it_name == "range":
+                    loopd_targets = targets
+                elif it_name == "enumerate" and targets:
+                    loopd_targets = targets[:1]
+            for name in _target_names(stmt.target):
+                self.tainted.discard(name)
+                self.loopd.discard(name)
+            for name in loopd_targets:
+                self.loopd.add(name)
+            for _ in range(2):
+                self._process_body(stmt.body, in_loop=True)
+            self._process_body(stmt.orelse, in_loop=in_loop)
+            return
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            for item in stmt.items:
+                self._scan_exprs(item.context_expr, in_loop)
+                if item.optional_vars is not None:
+                    self._rebind(_target_names(item.optional_vars), None)
+            self._process_body(stmt.body, in_loop=in_loop)
+            return
+        if isinstance(stmt, ast.Try):
+            self._process_body(stmt.body, in_loop=in_loop)
+            for h in stmt.handlers:
+                self._process_body(h.body, in_loop=in_loop)
+            self._process_body(stmt.orelse, in_loop=in_loop)
+            self._process_body(stmt.finalbody, in_loop=in_loop)
+            return
+
+        # --- straight-line statements ---
+        value = None
+        targets: List[str] = []
+        if isinstance(stmt, ast.Assign):
+            value = stmt.value
+            for t in stmt.targets:
+                targets.extend(_target_names(t))
+        elif isinstance(stmt, ast.AnnAssign):
+            value = stmt.value
+            targets = _target_names(stmt.target)
+        elif isinstance(stmt, ast.AugAssign):
+            value = stmt.value
+            targets = _target_names(stmt.target)
+            if in_loop:
+                self.loopd.update(targets)
+        elif isinstance(stmt, (ast.Expr, ast.Return)):
+            value = stmt.value
+        elif isinstance(stmt, (ast.Assert, ast.Raise)):
+            if isinstance(stmt, ast.Assert) and self.traced and \
+                    _expr_tainted(stmt.test, self.tainted):
+                self._flag(
+                    "TPU001", stmt,
+                    "assert on a traced value inside a traced function; "
+                    "use checkify or a host-side check")
+            for child in ast.iter_child_nodes(stmt):
+                self._scan_exprs(child, in_loop)
+            return
+        else:
+            for child in ast.iter_child_nodes(stmt):
+                if isinstance(child, ast.expr):
+                    self._scan_exprs(child, in_loop)
+            return
+
+        if value is not None:
+            self._scan_exprs(value, in_loop)
+        if targets:
+            self._rebind(targets, value)
+
+    # -- assignment bookkeeping ---------------------------------------
+
+    def _rebind(self, targets: List[str], value):
+        value_tainted = value is not None and \
+            _expr_tainted(value, self.tainted)
+        value_loopd = value is not None and self._loopd_tainted(value)
+        is_key = value is not None and self._is_key_expr(value)
+        for name in targets:
+            self.donated.pop(name, None)
+            if value_tainted:
+                self.tainted.add(name)
+            else:
+                self.tainted.discard(name)
+            if value_loopd:
+                self.loopd.add(name)
+            else:
+                self.loopd.discard(name)
+            if is_key and "." not in name:
+                self.keys_live.add(name)
+                self.keys_consumed.pop(name, None)
+            else:
+                self.keys_live.discard(name)
+                self.keys_consumed.pop(name, None)
+
+    def _loopd_tainted(self, value) -> bool:
+        """Loop-derived HOST-scalar taint. Unlike traced-value taint,
+        calls to jitted callables and dtype-pinning wrappers are
+        barriers: their results are committed device arrays, not raw
+        Python scalars, so they cannot churn weak types downstream."""
+        if isinstance(value, ast.Call):
+            callee = _callee(value)
+            if callee in self.index.jitted or self._dtype_pinned(value):
+                return False
+        return _expr_tainted(value, self.loopd)
+
+    def _is_key_expr(self, value) -> bool:
+        if isinstance(value, ast.Call):
+            callee = _callee(value)
+            if "random" in callee and _last(callee) in _KEY_MAKERS:
+                return True
+        if isinstance(value, (ast.Tuple, ast.List)):
+            return any(self._is_key_expr(e) for e in value.elts)
+        if isinstance(value, ast.Subscript):
+            return self._is_key_expr(value.value)
+        return False
+
+    def _augassigned_names(self, body) -> List[str]:
+        out = []
+        for n in ast.walk(ast.Module(body=list(body), type_ignores=[])):
+            if isinstance(n, ast.AugAssign):
+                out.extend(_target_names(n.target))
+        return out
+
+    # -- expression scan (calls, uses) --------------------------------
+
+    def _scan_exprs(self, node, in_loop: bool):
+        if node is None:
+            return
+        # use-after-donation: check BEFORE this statement's own donations
+        if self.donated:
+            for n in ast.walk(node):
+                if isinstance(n, (ast.Name, ast.Attribute)) and \
+                        not isinstance(getattr(n, "ctx", None), ast.Store):
+                    try:
+                        key = ast.unparse(n)
+                    except Exception:  # pragma: no cover
+                        continue
+                    if key in self.donated:
+                        self._flag(
+                            "TPU004", n,
+                            f"`{key}` used after being donated at line "
+                            f"{self.donated[key]} (donate_argnums "
+                            "invalidates the buffer)")
+        for n in ast.walk(node):
+            if isinstance(n, ast.Call):
+                self._check_call(n, in_loop)
+            elif isinstance(n, ast.IfExp) and self.traced and \
+                    _expr_tainted(n.test, self.tainted):
+                self._flag(
+                    "TPU001", n,
+                    "Python conditional expression on a traced value; "
+                    "use jnp.where")
+
+    def _check_call(self, call: ast.Call, in_loop: bool):
+        callee = _callee(call)
+        name = _last(callee)
+
+        # TPU002: host transfers on traced values
+        if self.traced:
+            if name in _HOST_BUILTINS and callee == name and call.args and \
+                    _expr_tainted(call.args[0], self.tainted):
+                self._flag(
+                    "TPU002", call,
+                    f"{name}() on a traced value forces a host transfer "
+                    "(ConcretizationTypeError under jit); keep it on "
+                    "device")
+            elif name in _HOST_NP_FNS and \
+                    callee.split(".")[0] in ("np", "numpy") and call.args \
+                    and _expr_tainted(call.args[0], self.tainted):
+                self._flag(
+                    "TPU002", call,
+                    f"{callee}() on a traced value materializes on host; "
+                    "use jnp.asarray or keep the jax array")
+            elif name in _HOST_METHODS and \
+                    isinstance(call.func, ast.Attribute) and \
+                    _expr_tainted(call.func.value, self.tainted):
+                self._flag(
+                    "TPU002", call,
+                    f".{name}() on a traced value forces a host sync")
+
+        # TPU003: key reuse
+        if "random" in callee and name not in _KEY_NONCONSUMING:
+            for a in call.args:
+                if isinstance(a, ast.Name) and a.id in self.keys_live:
+                    if a.id in self.keys_consumed:
+                        self._flag(
+                            "TPU003", call,
+                            f"PRNG key `{a.id}` reused (first consumed at "
+                            f"line {self.keys_consumed[a.id]}) without "
+                            "split/fold_in — draws are correlated")
+                    else:
+                        self.keys_consumed[a.id] = call.lineno
+
+        # TPU004 donations + TPU005 recompile hazards at jitted call sites
+        info = self.index.jitted.get(callee)
+        if info is not None:
+            for i in info.donate:
+                if i < len(call.args) and \
+                        isinstance(call.args[i], (ast.Name, ast.Attribute)):
+                    try:
+                        key = ast.unparse(call.args[i])
+                    except Exception:  # pragma: no cover
+                        continue
+                    self.donated[key] = call.lineno
+            if in_loop:
+                for i, a in enumerate(call.args):
+                    if not _expr_tainted(a, self.loopd):
+                        continue
+                    if i in info.static:
+                        self._flag(
+                            "TPU005", call,
+                            f"loop-varying value at static_argnums "
+                            f"position {i} of jitted `{callee}` — one "
+                            "recompile per distinct value")
+                    elif not self._dtype_pinned(a):
+                        self._flag(
+                            "TPU005", call,
+                            f"raw Python scalar derived from a loop "
+                            f"variable passed to jitted `{callee}` — "
+                            "weak-type churn recompiles silently; pin "
+                            "with jnp.int32(...)/jnp.asarray(...)")
+
+        # TPU006: divergent collectives across lax.cond/lax.switch branches
+        if self.spmd and name in ("cond", "switch"):
+            self._check_branch_collectives(call, name)
+
+    def _dtype_pinned(self, node) -> bool:
+        """True when the arg is wrapped in a dtype-pinning constructor
+        (jnp.int32(i), jnp.asarray(i), np.float32(x))."""
+        if isinstance(node, ast.Call):
+            callee = _callee(node)
+            return any(callee.startswith(p) for p in _WRAP_PREFIXES)
+        return False
+
+    # -- TPU006 helpers ------------------------------------------------
+
+    def _resolve_branch(self, node):
+        if isinstance(node, ast.Lambda):
+            return node
+        if isinstance(node, ast.Name):
+            return self.local_defs.get(node.id)
+        return None
+
+    def _check_branch_collectives(self, call: ast.Call, kind: str):
+        if kind == "cond" and len(call.args) >= 3:
+            branch_nodes = [call.args[1], call.args[2]]
+        elif kind == "switch" and len(call.args) >= 2 and \
+                isinstance(call.args[1], (ast.List, ast.Tuple)):
+            branch_nodes = list(call.args[1].elts)
+        else:
+            return
+        resolved = [self._resolve_branch(b) for b in branch_nodes]
+        if any(r is None for r in resolved) or len(resolved) < 2:
+            return  # dynamically built branches: program pass covers these
+        seqs = [_collective_sequence(r) for r in resolved]
+        if len(set(seqs)) > 1:
+            detail = " vs ".join(
+                "(" + (", ".join(s) or "none") + ")" for s in seqs)
+            self._flag(
+                "TPU006", call,
+                f"lax.{kind} branches inside an SPMD body issue different "
+                f"collective sequences {detail} — ranks diverging on the "
+                "predicate deadlock")
+
+    def _check_python_branch_collectives(self, stmt: ast.If):
+        body_seq = _collective_sequence(
+            ast.Module(body=list(stmt.body), type_ignores=[]))
+        else_seq = _collective_sequence(
+            ast.Module(body=list(stmt.orelse), type_ignores=[]))
+        if body_seq != else_seq and (body_seq or else_seq):
+            self._flag(
+                "TPU006", stmt,
+                f"Python if/else inside an SPMD body traces different "
+                f"collective sequences ({', '.join(body_seq) or 'none'}) "
+                f"vs ({', '.join(else_seq) or 'none'}) — call sites "
+                "specializing differently produce rank-divergent "
+                "programs")
+
+
+# ----------------------------------------------------------------------
+# entry points
+# ----------------------------------------------------------------------
+
+def lint_source(src: str, path: str = "<string>") -> List[Finding]:
+    """Lint one module's source. `path` is recorded on findings
+    (repo-relative for real files)."""
+    try:
+        tree = ast.parse(src)
+    except SyntaxError as e:
+        return [Finding(rule="TPU000", path=path, line=e.lineno or 0,
+                        message=f"syntax error: {e.msg}", snippet="")]
+    src_lines = src.splitlines()
+    index = _ModuleIndex()
+    index.visit(tree)
+
+    findings: List[Finding] = []
+    for fn, ancestors in _walk_functions(tree):
+        chain = ancestors + [fn]
+
+        def _is_traced(node):
+            return id(node) in index.traced_nodes or \
+                node.name in index.traced_names
+        traced = any(_is_traced(n) for n in chain)
+        spmd = any(id(n) in index.spmd_nodes or n.name in index.spmd_names
+                   for n in chain)
+        # sibling + ancestor-scope defs, for TPU006 branch resolution
+        local_defs: Dict[str, ast.AST] = {}
+        for scope in ancestors + [fn]:
+            for child in ast.walk(scope):
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)):
+                    local_defs[child.name] = child
+        checker = _FunctionChecker(
+            fn, path, src_lines, index, traced=traced, spmd=spmd,
+            local_defs=local_defs)
+        findings.extend(checker.run())
+    return assign_occurrences(findings)
+
+
+def iter_python_files(root: str):
+    """Lintable .py files under `root` (skips caches and generated pb2)."""
+    if os.path.isfile(root):
+        yield root
+        return
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = [d for d in dirnames
+                       if d not in ("__pycache__", ".git")]
+        for f in sorted(filenames):
+            if f.endswith(".py") and not f.endswith("_pb2.py"):
+                yield os.path.join(dirpath, f)
+
+
+def lint_paths(paths: Sequence[str], repo_root: Optional[str] = None
+               ) -> List[Finding]:
+    """Lint every .py file under `paths`; finding paths are relative to
+    `repo_root` (default: cwd) so fingerprints are machine-independent."""
+    repo_root = repo_root or os.getcwd()
+    findings: List[Finding] = []
+    for p in paths:
+        for f in iter_python_files(p):
+            rel = os.path.relpath(os.path.abspath(f),
+                                  os.path.abspath(repo_root))
+            with open(f, encoding="utf-8") as fh:
+                findings.extend(lint_source(fh.read(), rel.replace(
+                    os.sep, "/")))
+    return assign_occurrences(findings)

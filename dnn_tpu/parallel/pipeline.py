@@ -20,7 +20,14 @@ sequential parts, run each part on a different device, relay activations"
 
 Heterogeneous stages are uniformized for SPMD by flattening + zero-padding
 activations to one (microbatch, F) buffer and `lax.switch`-ing on the
-stage coordinate. The buffer dtype follows the payloads (see
+stage coordinate. The SPMD contract this relies on — every switch branch
+(= every stage program) issues the IDENTICAL collective sequence, else
+ranks deadlock — is enforced statically: the analyzer's program pass
+walks the traced pipeline's jaxpr and compares branch collective
+signatures (dnn_tpu/analysis/program.check_branch_collectives, PRG001;
+pinned by tests/test_analysis.py::test_pipeline_audit_collectives_consistent),
+so a stage fn that grows a psum the others lack fails CI before it can
+hang a mesh. The buffer dtype follows the payloads (see
 _buffer_dtype): single-dtype pipelines ride natively (bf16 hops cost bf16
 bytes over ICI), mixed pipelines use an f32 carrier with integer payloads
 bitcast in (exact for all of int32, not just ints < 2^24). Homogeneous

@@ -945,7 +945,8 @@ class ContinuousBatcher:
             for c in range(start_chunk, n_chunks):
                 logits, row = self._prefill_chunk(
                     pf_prepared, row,
-                    jnp.asarray(padded[:, c * p_pad:(c + 1) * p_pad]), c * p_pad,
+                    jnp.asarray(padded[:, c * p_pad:(c + 1) * p_pad]),
+                    jnp.int32(c * p_pad),
                 )
                 self.prefill_chunks_run += 1
                 if self._prefix_cache is not None and (c + 1) * p_pad <= len(prompt):

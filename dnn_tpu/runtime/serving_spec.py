@@ -347,7 +347,7 @@ class SpeculativeBatcher(ContinuousBatcher):
             _, d_row = self._d_prefill_chunk(
                 self.draft_prepared, d_row,
                 jnp.asarray(padded[:, c * p_pad:(c + 1) * p_pad]),
-                c * p_pad)
+                jnp.int32(c * p_pad))
         self.d_cache = self._d_install(self.d_cache, d_row, slot)
         # first sync chunk: the prompt's own tail at its own positions —
         # an exact no-op re-feed

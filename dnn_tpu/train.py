@@ -480,7 +480,16 @@ def make_sharded_train_step(
 
 def init_sharded(init_fn: Callable, rng, mesh: Mesh, specs_fn: Callable = gpt_tp_specs):
     """Init params directly into their tp shardings (no full-replica
-    materialization on one device): eval_shape -> out_shardings -> jit."""
+    materialization on one device): eval_shape -> out_shardings -> jit.
+
+    Caveat (this jax's legacy threefry, jax_threefry_partitionable
+    False): GSPMD may partition the random-bit generation along the
+    output shardings, in which case values differ from an un-jitted
+    `init_fn(rng)` — whether they do depends on the op layout (GPT's qkv
+    init happens to match, LLaMA's fused init does not). Values are
+    deterministic for a fixed (key, mesh, specs); treat them as "a"
+    random init, not "the" `init_fn(rng)` init. Under partitionable
+    threefry (newer-jax default) the two agree bitwise."""
     shapes = jax.eval_shape(init_fn, rng)
     specs = specs_fn(shapes)
     params = jax.jit(init_fn, out_shardings=specs_to_shardings(mesh, specs))(rng)

@@ -57,6 +57,25 @@ def assert_pure(fn: Callable, *args):
     return out
 
 
+def assert_collectives_consistent(fn: Callable, *args):
+    """Static third leg of the audit triad: trace `fn(*args)` (abstract —
+    nothing executes; args may be ShapeDtypeStructs) and require every
+    cond/switch in the program to issue IDENTICAL collective sequences
+    across its branches. This is the SPMD no-deadlock precondition the
+    runtime checks above cannot see: a rank-divergent branch hangs a
+    real mesh instead of producing a comparable wrong answer. Jaxpr walk
+    by dnn_tpu/analysis/program.check_branch_collectives."""
+    from dnn_tpu.analysis.program import check_branch_collectives
+
+    closed = jax.make_jaxpr(fn)(*args)
+    findings = check_branch_collectives(closed, getattr(
+        fn, "__name__", "<fn>"))
+    if findings:
+        raise AssertionError(
+            "divergent collective sequences across SPMD branches:\n" +
+            "\n".join(f.message for f in findings))
+
+
 def assert_deterministic_and_pure(fn: Callable, *args, runs: int = 3):
     assert_pure(fn, *args)
     return assert_deterministic(fn, *args, runs=runs)

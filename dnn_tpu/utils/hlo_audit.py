@@ -40,7 +40,8 @@ import jax
 import jax.numpy as jnp
 
 __all__ = ["lowered_text", "op_result_sizes", "count_cache_sized",
-           "gpt_decode_step", "llama_decode_step", "audit_decode_step"]
+           "count_aliased", "gpt_decode_step", "llama_decode_step",
+           "audit_decode_step"]
 
 # `%3 = stablehlo.transpose %2 ... -> tensor<8x12x64x256xf32>` (the last
 # tensor<...> on the line is the result type; rank-0 tensors have no dims)
@@ -89,6 +90,16 @@ def op_result_sizes(text: str):
                     n *= int(d)
             rows.append((m.group(2), n))
     return rows
+
+
+def count_aliased(text: str) -> int:
+    """Donated-input count in StableHLO program text: jit emits one
+    `tf.aliasing_output` attribute per input buffer it aliases to an
+    output. An arg passed via donate_argnums but NOT counted here was
+    unusable (no shape/dtype-matching output) — the runtime pays a full
+    copy of it per call. Consumed by the analyzer's donation-coverage
+    check (dnn_tpu/analysis/program.donation_report)."""
+    return text.count("tf.aliasing_output")
 
 
 def count_cache_sized(text: str, min_elems: int,

@@ -53,6 +53,8 @@ _MODULE_COST_S = {
     "test_comm": 5.7, "test_models_mlp": 7.3, "test_tokenizer": 7.8,
     "test_param_placement": 8.7, "test_qwen3": 9.6,
     "test_torch_export": 11.1, "test_models_gpt": 11.4,
+    "test_analysis": 13.7,  # the static-analyzer gate: cheap, CPU-only,
+    # and placed early so the tier-1 budget always certifies it
     "test_grad_accum": 12.9, "test_train_ckpt": 14.3, "test_remat": 14.6,
     "test_qwen2": 14.7, "test_olmo2": 14.8, "test_tp_generate": 15.6,
     "test_pipeline": 16.5, "test_seq_parallel": 17.0,

@@ -121,7 +121,18 @@ def test_rejects_bad_args(setup):
 def test_beam_llama_family_and_gemma2():
     """Beam search rides the LLaMA family (and Gemma-2's per-layer
     windows) through _family_fns: beam_size=1 == greedy make_generate,
-    and the best beam's sum-logprob >= greedy's."""
+    returned beams are score-sorted, and the best beam's rescored
+    sum-logprob tracks greedy's.
+
+    The old form asserted best-beam >= greedy - 1e-4, which is NOT a
+    theorem: beam search is inadmissible — a kept prefix that outscores
+    the greedy prefix mid-decode can finish worse, so the pruned greedy
+    path may beat every surviving beam. On gemma2-test's random weights
+    (near-flat logits, constant pruning pressure) that is exactly what
+    happens, deterministically: best beam -40.703 vs greedy -40.618.
+    The bound below allows the documented inadmissibility gap while
+    still catching real scoring regressions (sign errors, wrong-step
+    gathers land whole nats away)."""
     from dnn_tpu.models import llama
 
     for name in ("llama-test", "gemma2-test"):
@@ -140,6 +151,9 @@ def test_beam_llama_family_and_gemma2():
         toks, scores = make_beam_generate(
             cfg, max_new_tokens=n_new, beam_size=4,
             return_all=True)(prepared, prompt)
+        # internal scores come back best-first
+        s = np.asarray(scores)[0]
+        assert (np.diff(s) <= 1e-6).all(), name
 
         def seq_logprob(seq):
             ids = np.concatenate([np.asarray(prompt)[0], seq])
@@ -149,5 +163,7 @@ def test_beam_llama_family_and_gemma2():
             steps = range(len(ids) - n_new - 1, len(ids) - 1)
             return float(sum(lp[i, ids[i + 1]] for i in steps))
 
+        # inadmissibility slack: 0.25 nats over 8 steps (observed gap
+        # 0.084 on gemma2-test); a scoring bug is orders louder
         assert seq_logprob(np.asarray(toks)[0, 0]) >= \
-            seq_logprob(greedy[0]) - 1e-4, name
+            seq_logprob(greedy[0]) - 0.25, name

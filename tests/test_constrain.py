@@ -170,7 +170,10 @@ def test_json_mode_end_to_end():
     """A bounded JSON grammar forces a parseable object from a RANDOM
     model under sampling — the 'JSON mode' aha in one test."""
     srv = _batcher(temperature=1.0)
-    pattern = r"\{\"k\": (true|false|[0-9]{1,3})\}"
+    # no leading zeros: [0-9]{1,3} admits "002", which regex-matches but
+    # is not a legal JSON number — the constraint engine faithfully
+    # produced it and json.loads rightly refused (the old failure)
+    pattern = r"\{\"k\": (true|false|0|[1-9][0-9]{0,2})\}"
     c = TokenConstraint.from_regex(pattern, byte_vocab(CFG.vocab_size))
     rid = srv.submit(np.asarray([10, 20]), max_new_tokens=24, seed=7,
                      constraint=c)

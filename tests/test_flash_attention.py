@@ -37,10 +37,10 @@ def test_attention_use_flash_flag():
     from dnn_tpu.ops.attention import causal_self_attention
 
     c, n_head = 32, 2
-    key = jax.random.PRNGKey(1)
+    k_qkv, k_proj = jax.random.split(jax.random.PRNGKey(1))
     params = {
-        "qkv": {"kernel": jax.random.normal(key, (c, 3 * c)) * 0.05, "bias": jnp.zeros((3 * c,))},
-        "proj": {"kernel": jax.random.normal(key, (c, c)) * 0.05, "bias": jnp.zeros((c,))},
+        "qkv": {"kernel": jax.random.normal(k_qkv, (c, 3 * c)) * 0.05, "bias": jnp.zeros((3 * c,))},
+        "proj": {"kernel": jax.random.normal(k_proj, (c, c)) * 0.05, "bias": jnp.zeros((c,))},
     }
     x = jax.random.normal(jax.random.PRNGKey(2), (2, 16, c))
     y_flash = causal_self_attention(params, x, n_head=n_head, use_flash=True)

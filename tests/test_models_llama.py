@@ -297,7 +297,12 @@ def test_llama_tensor_parallel_train_step(devices):
     p1, _, loss = sstep(p_sh, opt.init(p_sh), tokens)
     jax.block_until_ready(p1)
 
-    params = llama.init(jax.random.PRNGKey(20), CFG)
+    # reference params = the SHARDED init's values, gathered — not a
+    # fresh llama.init: under this jax's legacy (non-partitionable)
+    # threefry, jit-with-out_shardings generates different random values
+    # than the un-jitted init (see train.init_sharded's docstring), and
+    # this test pins TRAIN-STEP parity, not RNG-partitioning semantics
+    params = jax.tree.map(np.asarray, p_sh)
     want = train.next_token_loss(apply_fn, params, tokens)
     assert float(loss) == pytest.approx(float(want), rel=1e-4)
 
