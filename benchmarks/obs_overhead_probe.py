@@ -1,0 +1,159 @@
+"""Observability overhead probe: instrumented vs DNN_TPU_OBS=off decode.
+
+The obs layer promises near-zero tax on the hot serving path (ISSUE 3
+satellite: < 2% on a decode step). This probe measures it honestly:
+
+  * one ContinuousBatcher, pool kept full of TRACED requests (the
+    worst-case instrumented path: per-step metrics + span bookkeeping);
+  * PER-STEP interleave: the gate alternates on EVERY step and each
+    step is timed individually; the two populations' medians are then
+    compared. This is the third methodology this probe went through,
+    each graduation forced by a measured artifact — (1) few multi-step
+    leg pairs read "39%" of pure scheduler noise; (2) leg-level A/B let
+    request retirements phase-lock with the leg cadence, parking cheap
+    empty-pool steps in one population (a reproducible ~20% phantom);
+    (3) even retirement-safe, position-balanced legs swung ±10% between
+    IDENTICAL-work legs on this host. Adjacent-step interleaving puts
+    both populations under the same load burst at millisecond
+    granularity, and the median kills the remaining outliers;
+  * the gate flips at RUNTIME (obs.set_enabled) — producers re-check
+    per call, so an OFF step runs the identical code path with every
+    metric/span site degraded to its one-None-check form;
+  * timed steps only ever advance a FULL pool: the pool refills
+    (untimed) before a request's budget could retire it mid-sequence,
+    and every step syncs on the committed tokens (step() pulls
+    self.tok to host), so wall time is device-honest.
+
+Standalone:  python benchmarks/obs_overhead_probe.py [--assert]
+             (--assert exits 1 when overhead >= 2%)
+Suite row:   benchmarks/run_all.py config `obs_overhead` (cpu-runnable).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+# script lives in benchmarks/; import dnn_tpu from the repo root
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+STEPS = 1500  # timed steps PER population (on/off alternate step-wise)
+SLOTS = 4
+PROMPT = 8
+
+
+def _build():
+    import jax
+
+    from dnn_tpu.models import gpt
+    from dnn_tpu.runtime.serving import ContinuousBatcher
+
+    # 4L/256d: a ~2-3 ms CPU decode step. Deliberately NOT the tiniest
+    # test preset — at 0.6 ms/step the comparison measures icache/branch
+    # noise (±5% between IDENTICAL legs), and no real serving config
+    # steps that fast; this size keeps the probe honest AND cpu-cheap.
+    cfg = gpt.GPTConfig(block_size=64, vocab_size=512, n_layer=4,
+                        n_head=4, n_embd=256)
+    prepared = gpt.prepare_stacked(gpt.init(jax.random.PRNGKey(0), cfg),
+                                   cfg)
+    return ContinuousBatcher(cfg, prepared, slots=SLOTS,
+                             max_len=cfg.block_size, prompt_pad=16)
+
+
+def _fill(srv, traced: bool):
+    """Fill every free slot; traced legs parent each request's spans
+    under a throwaway root (the served path's shape)."""
+    import numpy as np
+
+    from dnn_tpu import obs
+
+    roots = []
+    while srv.free_slots():
+        root = obs.start_span("bench.request") if traced else None
+        srv.submit(np.arange(1, PROMPT + 1), srv.max_len - PROMPT - 1,
+                   trace=root)
+        if root is not None:
+            roots.append(root)
+    return roots
+
+
+def _drain_slots(srv, roots):
+    for req in list(srv._slot_req):
+        if req is not None:
+            srv.cancel(req["rid"])
+    for r in roots:
+        r.end()
+    srv.results.clear()
+    srv.finish_reasons.clear()
+
+
+def measure() -> dict:
+    from dnn_tpu import obs
+
+    was = obs.enabled()
+    srv = _build()
+    obs.set_enabled(True)
+    roots = _fill(srv, traced=True)
+    left = srv.max_len - PROMPT - 2  # decode steps before any retire
+    for _ in range(10):  # compile + absorb first-dispatch overheads
+        srv.step()
+    left -= 10
+    on_t, off_t = [], []
+    try:
+        for i in range(2 * STEPS):
+            if left < 1:
+                # refill OUTSIDE the timed steps, before any request's
+                # budget could retire it mid-sequence (empty/partial
+                # pools step cheaper and would bias whichever
+                # population they land in)
+                obs.set_enabled(True)
+                _drain_slots(srv, roots)
+                roots = _fill(srv, traced=True)
+                left = srv.max_len - PROMPT - 2
+                srv.step()  # settle dispatch after the refill
+                left -= 1
+            on = i % 2 == 0
+            obs.set_enabled(on)
+            t0 = time.perf_counter()
+            srv.step()
+            (on_t if on else off_t).append(time.perf_counter() - t0)
+            left -= 1
+    finally:
+        obs.set_enabled(was)
+    on_t.sort()
+    off_t.sort()
+    med_on = on_t[len(on_t) // 2]
+    med_off = off_t[len(off_t) // 2]
+    return {
+        "overhead_frac": med_on / med_off - 1.0,
+        "step_ms_on": round(med_on * 1e3, 4),
+        "step_ms_off": round(med_off * 1e3, 4),
+        # per-population spread (p10..p90), the noise the medians tame
+        "step_ms_on_p10_p90": [round(on_t[len(on_t) // 10] * 1e3, 4),
+                               round(on_t[-1 - len(on_t) // 10] * 1e3, 4)],
+        "step_ms_off_p10_p90": [round(off_t[len(off_t) // 10] * 1e3, 4),
+                                round(off_t[-1 - len(off_t) // 10] * 1e3,
+                                      4)],
+        "steps_per_population": STEPS, "slots": SLOTS,
+    }
+
+
+def main(argv=None) -> int:
+    args = set(argv if argv is not None else sys.argv[1:])
+    row = measure()
+    row["ok"] = row["overhead_frac"] < 0.02
+    print(json.dumps(row), flush=True)
+    if "--assert" in args and not row["ok"]:
+        print(f"FAIL: observability overhead "
+              f"{row['overhead_frac'] * 100:.2f}% >= 2% budget",
+              file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -18,6 +18,7 @@ from typing import Optional
 import grpc
 import numpy as np
 
+from dnn_tpu import obs
 from dnn_tpu.comm import wire_pb2 as pb
 from dnn_tpu.comm.service import (
     PER_STAGE_BUDGET_S,
@@ -27,6 +28,7 @@ from dnn_tpu.comm.service import (
     _tensor_msg,
 )
 from dnn_tpu.io.serialization import PayloadCorruptError
+from dnn_tpu.utils.metrics import labeled
 
 log = logging.getLogger("dnn_tpu.comm")
 
@@ -97,9 +99,15 @@ class NodeClient:
             request_serializer=pb.MessageRequest.SerializeToString,
             response_deserializer=pb.MessageReply.FromString,
         )
-        return call(
-            pb.MessageRequest(sender_id=sender_id, message_text=text), timeout=timeout
-        ).confirmation_text
+        # trace tag rides sender_id (the text front's request_id analog)
+        with obs.start_span("rpc.SendMessage", parent=obs.current_span(),
+                            target=self.address) as sp:
+            return call(
+                pb.MessageRequest(
+                    sender_id=obs.tag_request_id(sender_id, sp),
+                    message_text=text),
+                timeout=timeout,
+            ).confirmation_text
 
     def wait_healthy(self, deadline: float = 30.0, interval: float = 0.5) -> bool:
         """Poll HealthCheck until it answers healthy or `deadline` seconds
@@ -127,40 +135,83 @@ class NodeClient:
         failures (RETRYABLE_CODES) are retried up to `retries` times with
         exponential backoff; the pipeline is stateless per request, so a
         resend is safe. `timeout` is the OVERALL budget across all attempts
-        and backoff sleeps, not a per-attempt deadline."""
+        and backoff sleeps, not a per-attempt deadline.
+
+        Observability: the call runs under an `rpc.SendTensor` span
+        (parented to the ambient obs span when one is active), and the
+        span's trace rides to the server as a `tr=` request_id segment —
+        wire-compatible (every peer treats request_id as opaque; our
+        servers parse and continue the trace). Per-attempt latency and
+        payload bytes land in the shared registry; each retry bumps
+        `comm.retries_total{target=...}` and logs the trace id so a
+        backoff storm is attributable to the requests living through it."""
         call = self._channel.unary_unary(
             f"/{SERVICE_NAME}/SendTensor",
             request_serializer=pb.TensorRequest.SerializeToString,
             response_deserializer=pb.TensorResponse.FromString,
         )
-        request = pb.TensorRequest(request_id=request_id, tensor=_tensor_msg(arr))
+        sp = obs.start_span("rpc.SendTensor", parent=obs.current_span(),
+                            target=self.address)
+        request = pb.TensorRequest(
+            request_id=obs.tag_request_id(request_id, sp),
+            tensor=_tensor_msg(arr))
+        m = obs.metrics()
         deadline = time.monotonic() + timeout
         attempt = 0
-        while True:
-            remaining = deadline - time.monotonic()
-            try:
-                resp = call(request, timeout=max(remaining, 0.001))
-                # decode INSIDE the loop: a crc32c mismatch on the response
-                # is transient corruption, and resending is as safe as for a
-                # transport failure.
-                result = (
-                    _tensor_arr(resp.result_tensor)
-                    if resp.HasField("result_tensor") else None
-                )
-                return resp.status, result
-            except (grpc.RpcError, PayloadCorruptError) as e:
-                code = e.code() if isinstance(e, grpc.RpcError) else None
-                retryable = isinstance(e, PayloadCorruptError) or code in RETRYABLE_CODES
-                delay = backoff * (2 ** attempt)
-                out_of_budget = deadline - time.monotonic() <= delay
-                if not retryable or attempt >= retries or out_of_budget:
-                    raise
-                log.warning(
-                    "send_tensor to %s failed (%s), retry %d/%d in %.2fs",
-                    self.address, code or e, attempt + 1, retries, delay,
-                )
-                time.sleep(delay)
-                attempt += 1
+        try:
+            while True:
+                remaining = deadline - time.monotonic()
+                t_try = time.perf_counter()
+                if m is not None:
+                    # per ATTEMPT: retries resend the payload, and the
+                    # counter must match the bytes actually on the wire
+                    # (and the server's direction="in" count)
+                    m.inc(labeled("comm.payload_bytes_total",
+                                  direction="out"), request.ByteSize())
+                try:
+                    resp = call(request, timeout=max(remaining, 0.001))
+                    if m is not None:
+                        m.observe_hist(
+                            labeled("comm.rpc_latency_seconds",
+                                    method="SendTensor", role="client"),
+                            time.perf_counter() - t_try)
+                        m.inc(labeled("comm.payload_bytes_total",
+                                      direction="in"), resp.ByteSize())
+                    # decode INSIDE the loop: a crc32c mismatch on the
+                    # response is transient corruption, and resending is as
+                    # safe as for a transport failure.
+                    result = (
+                        _tensor_arr(resp.result_tensor)
+                        if resp.HasField("result_tensor") else None
+                    )
+                    sp.set(attempts=attempt + 1)
+                    return resp.status, result
+                except (grpc.RpcError, PayloadCorruptError) as e:
+                    code = e.code() if isinstance(e, grpc.RpcError) else None
+                    if m is not None and \
+                            code == grpc.StatusCode.DEADLINE_EXCEEDED:
+                        m.inc(labeled("comm.deadline_exceeded_total",
+                                          target=self.address))
+                    retryable = isinstance(e, PayloadCorruptError) \
+                        or code in RETRYABLE_CODES
+                    delay = backoff * (2 ** attempt)
+                    out_of_budget = deadline - time.monotonic() <= delay
+                    if not retryable or attempt >= retries or out_of_budget:
+                        sp.set(error=str(code or e), attempts=attempt + 1)
+                        raise
+                    if m is not None:
+                        m.inc(labeled("comm.retries_total",
+                                          target=self.address))
+                    log.warning(
+                        "send_tensor to %s failed (%s), retry %d/%d in "
+                        "%.2fs [trace=%s]",
+                        self.address, code or e, attempt + 1, retries,
+                        delay, sp.trace_id or "-",
+                    )
+                    time.sleep(delay)
+                    attempt += 1
+        finally:
+            sp.end()
 
     def generate(
         self,
@@ -237,19 +288,25 @@ class NodeClient:
             request_serializer=pb.TensorRequest.SerializeToString,
             response_deserializer=pb.TensorResponse.FromString,
         )
+        sp = obs.start_span("rpc.GenerateStream",
+                            parent=obs.current_span(),
+                            target=self.address)
         stream = call(
             pb.TensorRequest(
-                request_id=rid,
+                request_id=obs.tag_request_id(rid, sp),
                 tensor=_tensor_msg(
                     np.asarray(prompt_ids, np.int32).reshape(-1))),
             timeout=timeout,
         )
+        n = 0
         try:
             for resp in stream:
                 if resp.HasField("result_tensor"):
+                    n += 1
                     yield int(_tensor_arr(resp.result_tensor)[0])
         finally:
             stream.cancel()  # no-op on a finished stream
+            sp.end(tokens=n)
 
     def generate_text(
         self,

@@ -29,12 +29,14 @@ from typing import Optional
 import grpc
 import numpy as np
 
+from dnn_tpu import obs
 from dnn_tpu.comm import wire_pb2 as pb
 from dnn_tpu.io.serialization import (
     PayloadCorruptError,
     decode_tensor,
     encode_tensor,
 )
+from dnn_tpu.utils.metrics import labeled
 
 log = logging.getLogger("dnn_tpu.comm")
 
@@ -116,6 +118,16 @@ class StageServer:
     async def SendTensor(self, request: pb.TensorRequest, context) -> pb.TensorResponse:
         nid = self.node.id
         result_msg = None
+        t_handler = time.perf_counter()
+        m = obs.metrics()
+        if m is not None:
+            m.inc(labeled("comm.payload_bytes_total", direction="in",
+                          stage=nid), request.ByteSize())
+        # continue the sender's trace (or start fresh); the tree crosses
+        # every relay hop because _forward re-tags the request_id it
+        # forwards with its own span
+        root = obs.continue_or_start("stage.request", request.request_id,
+                                     stage=nid, part=self.part_index)
         try:
             try:
                 x = _tensor_arr(request.tensor)
@@ -124,15 +136,20 @@ class StageServer:
                 # retry loop sees DATA_LOSS and resends — transient wire
                 # corruption must not become a terminal pipeline error.
                 log.warning("corrupt payload on %s: %s", nid, e)
+                root.end(error="payload_corrupt")
                 await context.abort(grpc.StatusCode.DATA_LOSS, str(e))
-            y = np.asarray(self.engine.run_stage(self.part_index, x))
+            with root.child("stage.compute", part=self.part_index):
+                # np.asarray forces device completion — the span measures
+                # the stage's real compute, not its dispatch
+                y = np.asarray(self.engine.run_stage(self.part_index, x))
             if self.is_last:
                 pred = int(np.argmax(y))
                 log.info("final stage done (node %s), prediction=%d", nid, pred)
                 status = f"[{nid}] Processing complete. Prediction: {pred}"
                 result_msg = _tensor_msg(y)
             else:
-                resp = await self._forward(request.request_id, y)
+                resp = await self._forward(request.request_id, y,
+                                           parent=root)
                 status = f"[{nid}] Forwarded. Next node status: {resp.status}"
                 if resp.HasField("result_tensor"):
                     result_msg = resp.result_tensor
@@ -144,7 +161,18 @@ class StageServer:
         except Exception as e:  # noqa: BLE001 — status-string relay, like node.py:96-100
             log.exception("error processing tensor on %s", nid)
             status = f"[{nid}] Error: {e}"
-        return pb.TensorResponse(status=status, result_tensor=result_msg)
+        finally:
+            root.end()
+        if m is not None:
+            m.observe_hist(
+                labeled("comm.rpc_latency_seconds", method="SendTensor",
+                        role="server", stage=nid),
+                time.perf_counter() - t_handler)
+        resp_msg = pb.TensorResponse(status=status, result_tensor=result_msg)
+        if m is not None:
+            m.inc(labeled("comm.payload_bytes_total", direction="out",
+                          stage=nid), resp_msg.ByteSize())
+        return resp_msg
 
     async def HealthCheck(self, request: pb.Empty, context) -> pb.HealthCheckResponse:
         return pb.HealthCheckResponse(is_healthy=True)
@@ -160,6 +188,7 @@ class StageServer:
     async def _forward(
         self, request_id: str, y: np.ndarray, *, retries: int = 2,
         backoff: float = 0.2, timeout: Optional[float] = None,
+        parent=None,
     ) -> pb.TensorResponse:
         """Relay downstream with bounded retries on transient failures,
         reusing the shared channel across attempts (gRPC reconnects a broken
@@ -178,8 +207,20 @@ class StageServer:
         status always has time to ride back up before any upstream
         deadline fires. DEADLINE_EXCEEDED itself is not retryable (see
         RETRYABLE_CODES): the expired budget already covered the whole
-        remaining pipeline."""
-        request = pb.TensorRequest(request_id=request_id, tensor=_tensor_msg(y))
+        remaining pipeline.
+
+        The relayed request_id is RE-TAGGED with this hop's span
+        (obs.tag_request_id), so the downstream stage's spans nest under
+        this hop's `rpc.forward` — one tree per request across the whole
+        chain; retries count into comm.retries_total{stage=...} with the
+        trace id in the log line, so a backoff storm is visible and
+        attributable instead of silent."""
+        sp = obs.start_span("rpc.forward", parent=parent,
+                            target=self.next_address)
+        request = pb.TensorRequest(
+            request_id=obs.tag_request_id(request_id, sp)
+            if sp else request_id,
+            tensor=_tensor_msg(y))
         if self._next_channel is None:
             self._next_channel = grpc.aio.insecure_channel(self.next_address)
         call = self._next_channel.unary_unary(
@@ -193,26 +234,56 @@ class StageServer:
             )
         deadline = time.monotonic() + timeout
         attempt = 0
-        while True:
-            remaining = deadline - time.monotonic()
-            try:
-                return await call(request, timeout=max(remaining, 0.001))
-            except grpc.aio.AioRpcError as e:
-                # NOTE: the shared channel is deliberately NOT closed between
-                # attempts — other requests may have calls in flight on it,
-                # and gRPC reconnects a broken channel on the next call anyway.
-                delay = backoff * (2 ** attempt)
-                out_of_budget = deadline - time.monotonic() <= delay
-                if e.code() not in RETRYABLE_CODES or attempt >= retries \
-                        or out_of_budget:
-                    raise
-                log.warning(
-                    "forward %s -> %s failed (%s), retry %d/%d in %.2fs",
-                    self.node.id, self.next_address, e.code(),
-                    attempt + 1, retries, delay,
-                )
-                await asyncio.sleep(delay)
-                attempt += 1
+        m = obs.metrics()
+        try:
+            while True:
+                remaining = deadline - time.monotonic()
+                t_try = time.perf_counter()
+                if m is not None:
+                    # per ATTEMPT, like the edge client: relayed bytes
+                    # must reconcile with the downstream stage's
+                    # direction="in" count even through retries
+                    m.inc(labeled("comm.payload_bytes_total",
+                                  direction="out", stage=self.node.id),
+                          request.ByteSize())
+                try:
+                    resp = await call(request, timeout=max(remaining, 0.001))
+                    if m is not None:
+                        m.observe_hist(
+                            labeled("comm.rpc_latency_seconds",
+                                    method="forward", role="client",
+                                    stage=self.node.id),
+                            time.perf_counter() - t_try)
+                    sp.set(attempts=attempt + 1)
+                    return resp
+                except grpc.aio.AioRpcError as e:
+                    # NOTE: the shared channel is deliberately NOT closed
+                    # between attempts — other requests may have calls in
+                    # flight on it, and gRPC reconnects a broken channel on
+                    # the next call anyway.
+                    if m is not None and \
+                            e.code() == grpc.StatusCode.DEADLINE_EXCEEDED:
+                        m.inc(labeled("comm.deadline_exceeded_total",
+                                      stage=self.node.id))
+                    delay = backoff * (2 ** attempt)
+                    out_of_budget = deadline - time.monotonic() <= delay
+                    if e.code() not in RETRYABLE_CODES or attempt >= retries \
+                            or out_of_budget:
+                        sp.set(error=str(e.code()), attempts=attempt + 1)
+                        raise
+                    if m is not None:
+                        m.inc(labeled("comm.retries_total",
+                                      stage=self.node.id))
+                    log.warning(
+                        "forward %s -> %s failed (%s), retry %d/%d in "
+                        "%.2fs [trace=%s]",
+                        self.node.id, self.next_address, e.code(),
+                        attempt + 1, retries, delay, sp.trace_id or "-",
+                    )
+                    await asyncio.sleep(delay)
+                    attempt += 1
+        finally:
+            sp.end()
 
     async def close(self):
         if self._next_channel is not None:
@@ -259,9 +330,15 @@ def _handlers(servicer: StageServer):
     return grpc.method_handlers_generic_handler(SERVICE_NAME, handlers)
 
 
-async def serve_stage(engine, node_id: str, *, port: Optional[int] = None):
+async def serve_stage(engine, node_id: str, *, port: Optional[int] = None,
+                      metrics_port: Optional[int] = None):
     """Start the gRPC server for this node's stage and block until
-    termination (the rebuild of serve(), node.py:114-133)."""
+    termination (the rebuild of serve(), node.py:114-133).
+    `metrics_port` (None = off, 0 = ephemeral) additionally serves the
+    observability endpoint — GET /metrics (Prometheus text format:
+    per-stage RPC latency, payload bytes, retry/deadline counters, XLA
+    compile telemetry), /trace (Chrome-trace JSON) — over stdlib HTTP."""
+    obs.install_compile_telemetry()
     servicer = StageServer(engine, node_id)
     server = grpc.aio.server()
     server.add_generic_rpc_handlers((_handlers(servicer),))
@@ -271,6 +348,9 @@ async def serve_stage(engine, node_id: str, *, port: Optional[int] = None):
         # grpc reports bind failure as port 0, not an exception (the
         # reference prints-and-exits on the same failure, node.py:124-126)
         raise RuntimeError(f"failed to bind gRPC server to {listen}")
+    metrics_srv = None
+    if metrics_port is not None:
+        metrics_srv = obs.serve_metrics(port=metrics_port)
     log.info("gRPC stage server %s listening on %s (part %d)",
              node_id, listen, servicer.part_index)
     await server.start()
@@ -279,6 +359,8 @@ async def serve_stage(engine, node_id: str, *, port: Optional[int] = None):
     finally:
         await servicer.close()
         await server.stop(grace=1)
+        if metrics_srv is not None:
+            metrics_srv.close()
 
 
 def start_stage_server_in_background(engine, node_id: str, *, port: Optional[int] = None):

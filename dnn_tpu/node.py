@@ -143,6 +143,12 @@ def build_parser() -> argparse.ArgumentParser:
                         "prompt text -> generated text")
     p.add_argument("--process_id", type=int, default=None,
                    help="This host's process id for multi-host (config 'distributed') runs")
+    p.add_argument("--metrics_port", type=int, default=None,
+                   help="--serve/--serve_lm: also serve the observability "
+                        "endpoint on this port over plain HTTP — GET "
+                        "/metrics (Prometheus text format), /trace "
+                        "(Chrome-trace JSON of recent request spans), "
+                        "/healthz (dnn_tpu/obs; 0 = ephemeral port)")
     p.add_argument("--log_level", default="INFO")
     return p
 
@@ -304,7 +310,8 @@ def main(argv=None) -> int:
         from dnn_tpu.comm.service import serve_stage
 
         async def _run():
-            tasks = [asyncio.create_task(serve_stage(engine, args.node_id))]
+            tasks = [asyncio.create_task(serve_stage(
+                engine, args.node_id, metrics_port=args.metrics_port))]
             if me.part_index == 0 and args.input_image:
                 tasks.append(asyncio.create_task(
                     _initiate_edge(engine, args.node_id, args.input_image)
@@ -477,6 +484,7 @@ def _serve_lm(engine: PipelineEngine, args) -> int:
             repetition_penalty=args.repetition_penalty,
             compute_dtype=engine.compute_dtype, seed=args.seed, ffn=ffn,
             family=family, default_max_new=args.generate or 32,
+            metrics_port=args.metrics_port,
             tokenizer=tokenizer, prefix_cache=args.prefix_cache,
             paged_blocks=args.paged_blocks, block_len=args.block_len,
             decode_buckets=args.decode_buckets,

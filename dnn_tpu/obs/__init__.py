@@ -1,0 +1,113 @@
+"""dnn_tpu.obs — observability for the serving stack.
+
+The reference's only observability is ad-hoc stdout prints (SURVEY §5:
+"Tracing/profiling: ABSENT"); PRs 1-2 built the perf and correctness
+legs, this package builds the eyes. Three coordinated layers share one
+registry and one span collector:
+
+  * request tracing (obs/trace.py): per-request span trees — queue wait,
+    admission, prefill, per-bucket decode, detokenize, per-hop RPC —
+    propagated across the wire on the existing `request_id` field and
+    exportable as JSONL / Chrome-trace JSON (`python -m dnn_tpu.obs
+    trace`, or GET /trace on the metrics endpoint);
+  * metrics (utils/metrics.py grown for this layer): counters, gauges,
+    quantile summaries and histograms, rendered in Prometheus text
+    format and served from a stdlib-HTTP `/metrics` endpoint
+    (obs/http.py) attached to the LM daemon and the stage servers;
+  * compile telemetry (obs/compile_watch.py): a jax.monitoring listener
+    counting XLA compilations and compile-seconds into the same registry
+    — the RUNTIME cross-check of the static recompile census (PRG004,
+    dnn_tpu/analysis): a live recompile storm is a counter, not a stall.
+
+Gate: DNN_TPU_OBS=off (or 0/false) disables everything — producers see
+`metrics()` return None and `start_span` return the free NULL_SPAN. The
+gate is re-checked per call, so benchmarks can flip it at runtime
+(`set_enabled`) to measure the instrumentation tax (benchmarks/
+obs_overhead_probe.py pins it < 2% of a decode step).
+
+Import cost: this package imports stdlib + utils.metrics only; jax is
+touched lazily inside install_compile_telemetry().
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+from dnn_tpu.obs.trace import (  # noqa: F401 — the package's public API
+    NULL_SPAN,
+    Span,
+    TraceCollector,
+    collector,
+    continue_or_start,
+    current_span,
+    new_trace_id,
+    parse_wire_tag,
+    record_span,
+    span,
+    spans_to_chrome,
+    start_span,
+    strip_wire_tag,
+    tag_request_id,
+)
+
+__all__ = [
+    "enabled", "set_enabled", "metrics", "collector", "span",
+    "start_span", "record_span", "current_span", "continue_or_start",
+    "tag_request_id", "parse_wire_tag", "strip_wire_tag", "new_trace_id",
+    "NULL_SPAN", "Span", "TraceCollector", "spans_to_chrome",
+    "install_compile_telemetry", "serve_metrics",
+]
+
+_enabled = os.environ.get("DNN_TPU_OBS", "on").lower() not in (
+    "off", "0", "false", "no")
+
+
+def enabled() -> bool:
+    return _enabled
+
+
+def set_enabled(on: bool):
+    """Runtime toggle (benchmarks, tests). Producers re-check per call,
+    so flipping takes effect immediately — no reconstruction needed."""
+    global _enabled
+    _enabled = bool(on)
+
+
+def metrics():
+    """The shared registry (utils.metrics.default_metrics) when
+    observability is on, else None — hot paths guard with one `is not
+    None` check and skip all bookkeeping when off."""
+    if not _enabled:
+        return None
+    from dnn_tpu.utils.metrics import default_metrics
+
+    return default_metrics
+
+
+_install_lock = threading.Lock()
+_compile_installed = False
+
+
+def install_compile_telemetry() -> bool:
+    """Install the jax.monitoring compile listener once per process
+    (idempotent — every engine/server constructor calls this). Returns
+    True when the listener is active. See obs/compile_watch.py."""
+    global _compile_installed
+    with _install_lock:
+        if _compile_installed:
+            return True
+        from dnn_tpu.obs.compile_watch import _install
+
+        _compile_installed = _install()
+        return _compile_installed
+
+
+def serve_metrics(port: int = 0, host: str = "127.0.0.1"):
+    """Start the /metrics + /trace HTTP endpoint on a daemon thread;
+    returns the MetricsHTTPServer (`.port` for port=0 ephemeral binds,
+    `.close()` to stop; loopback by default — pass host="0.0.0.0" to
+    expose to a scrape fleet). See obs/http.py."""
+    from dnn_tpu.obs.http import MetricsHTTPServer
+
+    return MetricsHTTPServer(port=port, host=host)

@@ -1,0 +1,147 @@
+"""CLI: `python -m dnn_tpu.obs trace ...` — trace tooling.
+
+    python -m dnn_tpu.obs trace --selftest
+        In-process smoke of the whole span pipeline (nested spans,
+        cross-thread explicit parents, wire-tag round-trip, JSONL and
+        Chrome-trace export, Prometheus render) with schema validation;
+        exit 0 on success. Wired into tier-1 (tests/test_obs.py).
+
+    python -m dnn_tpu.obs trace --jsonl spans.jsonl --out chrome.json \
+        [--id TRACE_ID]
+        Convert a JSONL span dump (the /trace.jsonl endpoint's format,
+        or TraceCollector.dump_jsonl) into Chrome-trace JSON for
+        Perfetto / chrome://tracing.
+
+No jax import anywhere on these paths — the tooling works on any host.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import threading
+import time
+
+
+def _selftest() -> int:
+    from dnn_tpu import obs
+
+    obs.set_enabled(True)
+    col = obs.TraceCollector(capacity=256)
+    # route this selftest's spans into a private collector so a shared
+    # process (the test suite) keeps its ring clean
+    import dnn_tpu.obs.trace as _t
+
+    saved = _t._collector
+    _t._collector = col
+    try:
+        with obs.span("request", kind="selftest") as root:
+            with obs.span("prefill", chunks=2):
+                time.sleep(0.001)
+            # cross-thread child via explicit parent (the batcher-worker
+            # pattern)
+            def worker():
+                s = obs.start_span("decode", parent=root, bucket=64)
+                time.sleep(0.001)
+                s.end(tokens=3)
+
+            t = threading.Thread(target=worker)
+            t.start()
+            t.join()
+            # wire round-trip: tag -> parse -> remote child
+            rid = obs.tag_request_id("gen:8", root)
+            parsed = obs.parse_wire_tag(rid)
+            assert parsed is not None and parsed[0] == root.trace_id, rid
+            assert obs.strip_wire_tag(rid) == "gen:8", rid
+            remote = obs.start_span("rpc.remote", trace_id=parsed[0],
+                                    parent_id=parsed[1])
+            remote.end()
+
+        spans = col.spans(root.trace_id)
+        names = {s.name for s in spans}
+        assert names == {"request", "prefill", "decode", "rpc.remote"}, names
+        by_name = {s.name: s for s in spans}
+        for child in ("prefill", "decode", "rpc.remote"):
+            assert by_name[child].parent_id == root.span_id, child
+            assert by_name[child].trace_id == root.trace_id, child
+        assert by_name["request"].parent_id is None
+
+        # JSONL: one valid object per line, schema keys present
+        lines = [json.loads(ln) for ln in
+                 col.jsonl(root.trace_id).splitlines()]
+        assert len(lines) == 4
+        for d in lines:
+            assert {"trace_id", "span_id", "parent_id", "name", "ts",
+                    "dur", "tid", "attrs"} <= set(d), d
+            assert d["dur"] >= 0.0
+
+        # Chrome trace: X events with µs timestamps + thread metadata
+        ct = col.chrome_trace(root.trace_id)
+        xs = [e for e in ct["traceEvents"] if e.get("ph") == "X"]
+        ms = [e for e in ct["traceEvents"] if e.get("ph") == "M"]
+        assert len(xs) == 4 and ms, ct
+        for e in xs:
+            assert e["ts"] > 0 and e["dur"] >= 0
+            assert e["args"]["trace_id"] == root.trace_id
+
+        # Prometheus render smoke (the other export surface)
+        from dnn_tpu.utils.metrics import Metrics, labeled, render_prometheus
+
+        m = Metrics()
+        m.inc(labeled("selftest_total", leg="trace"))
+        m.observe("selftest_seconds", 0.001)
+        text = render_prometheus(m)
+        assert "# TYPE selftest_total counter" in text
+        assert 'selftest_total{leg="trace"} 1' in text
+    finally:
+        _t._collector = saved
+    print(f"obs selftest ok: {len(spans)} spans, 1 trace "
+          f"({root.trace_id}), chrome+jsonl+prometheus schemas valid")
+    return 0
+
+
+def _convert(jsonl_path: str, out_path: str, trace_id=None) -> int:
+    from dnn_tpu.obs.trace import spans_to_chrome
+
+    dicts = []
+    with open(jsonl_path) as f:
+        for ln in f:
+            ln = ln.strip()
+            if not ln:
+                continue
+            d = json.loads(ln)
+            if trace_id is None or d.get("trace_id") == trace_id:
+                dicts.append(d)
+    chrome = spans_to_chrome(dicts)
+    with open(out_path, "w") as f:
+        json.dump(chrome, f)
+    n = sum(1 for e in chrome["traceEvents"] if e.get("ph") == "X")
+    print(f"wrote {out_path}: {n} spans"
+          + (f" (trace {trace_id})" if trace_id else ""))
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="python -m dnn_tpu.obs")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    tr = sub.add_parser("trace", help="trace export tooling")
+    tr.add_argument("--selftest", action="store_true",
+                    help="in-process span-pipeline smoke; exit 0 on pass")
+    tr.add_argument("--jsonl", help="input JSONL span dump to convert")
+    tr.add_argument("--out", help="output Chrome-trace JSON path")
+    tr.add_argument("--id", dest="trace_id", default=None,
+                    help="restrict conversion to one trace id")
+    args = ap.parse_args(argv)
+
+    if args.cmd == "trace":
+        if args.selftest:
+            return _selftest()
+        if args.jsonl and args.out:
+            return _convert(args.jsonl, args.out, args.trace_id)
+        ap.error("trace needs --selftest or --jsonl FILE --out FILE")
+    return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

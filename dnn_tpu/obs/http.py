@@ -1,0 +1,118 @@
+"""Stdlib-HTTP observability endpoint: /metrics, /trace, /healthz.
+
+Attached to the LM daemon (runtime/lm_server.LMServer(metrics_port=...))
+and the stage servers (comm/service.serve_stage(metrics_port=...)) — a
+ThreadingHTTPServer on a daemon thread, zero dependencies, so any
+Prometheus scraper or a plain curl can watch the serving stack:
+
+    GET /metrics       Prometheus text format (utils.metrics
+                       render_prometheus over the shared registry)
+    GET /healthz       200 "ok" (liveness — an optional `healthy`
+                       callable downgrades to 503 when it returns False)
+    GET /trace         Chrome-trace JSON of collected spans; ?id=<trace>
+                       filters to one request's tree (load the response
+                       in Perfetto / chrome://tracing)
+    GET /trace.jsonl   the same spans as JSONL (one span per line)
+    GET /traces        the distinct trace ids currently in the ring
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Optional
+from urllib.parse import parse_qs, urlparse
+
+log = logging.getLogger("dnn_tpu.obs")
+
+
+class MetricsHTTPServer:
+    """Serve the shared registry + span collector (or explicit ones) over
+    HTTP. port=0 binds an ephemeral port — read `.port` after init.
+
+    Binds LOOPBACK by default: the endpoint is unauthenticated and
+    /trace exposes per-request timelines, so wider exposure (a scrape
+    fleet) is an explicit `host="0.0.0.0"` opt-in, not a default."""
+
+    def __init__(self, *, port: int = 0, host: str = "127.0.0.1",
+                 registry=None, collector=None,
+                 healthy: Optional[Callable[[], bool]] = None):
+        from dnn_tpu import obs
+        from dnn_tpu.utils import metrics as _metrics
+
+        self._registry = registry if registry is not None \
+            else _metrics.default_metrics
+        self._collector = collector if collector is not None \
+            else obs.collector()
+        self._healthy = healthy
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):  # route to logging, not stderr
+                log.debug("metrics http: " + fmt, *args)
+
+            def _send(self, code: int, body: str, ctype: str):
+                data = body.encode()
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def do_GET(self):
+                try:
+                    url = urlparse(self.path)
+                    if url.path == "/metrics":
+                        self._send(200, _metrics.render_prometheus(
+                            outer._registry),
+                            "text/plain; version=0.0.4; charset=utf-8")
+                    elif url.path == "/healthz":
+                        ok = outer._healthy() if outer._healthy else True
+                        self._send(200 if ok else 503,
+                                   "ok\n" if ok else "unhealthy\n",
+                                   "text/plain; charset=utf-8")
+                    elif url.path == "/trace":
+                        q = parse_qs(url.query)
+                        tid = q.get("id", [None])[0]
+                        self._send(200, json.dumps(
+                            outer._collector.chrome_trace(tid)),
+                            "application/json")
+                    elif url.path == "/trace.jsonl":
+                        q = parse_qs(url.query)
+                        tid = q.get("id", [None])[0]
+                        self._send(200, outer._collector.jsonl(tid),
+                                   "application/jsonl")
+                    elif url.path == "/traces":
+                        self._send(200, json.dumps(
+                            outer._collector.trace_ids()),
+                            "application/json")
+                    else:
+                        self._send(404, "not found\n",
+                                   "text/plain; charset=utf-8")
+                except BrokenPipeError:  # scraper hung up mid-response
+                    pass
+                except Exception:  # noqa: BLE001 — one bad request must
+                    # not kill the observer thread
+                    log.exception("metrics endpoint request failed")
+                    try:
+                        self._send(500, "internal error\n",
+                                   "text/plain; charset=utf-8")
+                    except Exception:  # noqa: BLE001
+                        pass
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self._httpd.daemon_threads = True
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True,
+            name=f"obs-metrics-http:{self.port}")
+        self._thread.start()
+        log.info("observability endpoint on http://%s:%d/metrics",
+                 host or "0.0.0.0", self.port)
+
+    def close(self):
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._thread.join(timeout=5)

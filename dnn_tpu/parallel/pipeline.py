@@ -103,16 +103,26 @@ class RelayExecutor:
             self.last_stage_times = None
             return x
 
+        from dnn_tpu import obs
+        from dnn_tpu.utils.metrics import labeled
         from dnn_tpu.utils.tracing import device_sync
 
         stages = []
-        for fn, params, dev in zip(self.stage_fns, self.stage_params, self.devices):
+        m = obs.metrics()
+        for i, (fn, params, dev) in enumerate(
+                zip(self.stage_fns, self.stage_params, self.devices)):
             xd = jax.device_put(x, dev)
             device_sync(xd)
             t1 = time.perf_counter()
             x = fn(params, xd)
             device_sync(x)
-            stages.append(time.perf_counter() - t1)
+            dt = time.perf_counter() - t1
+            stages.append(dt)
+            if m is not None:
+                # per-stage compute in the shared registry — the relay
+                # runtime's contribution to the /metrics breakdown
+                m.observe(labeled("relay.stage_compute_seconds", stage=i),
+                          dt)
         self.last_stage_times = stages
         return x
 
@@ -154,6 +164,13 @@ class RelayExecutor:
             # clamp: on fast transports the slope can jitter below zero,
             # which is pure measurement noise, not a latency
             hops.append(max(0.0, (run(n2) - run(n1)) / (n2 - n1) / 2.0))
+        from dnn_tpu import obs
+        from dnn_tpu.utils.metrics import labeled
+
+        m = obs.metrics()
+        if m is not None:
+            for i, h in enumerate(hops, start=1):
+                m.observe(labeled("relay.hop_seconds", hop=i), h)
         return hops
 
 

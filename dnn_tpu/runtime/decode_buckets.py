@@ -227,15 +227,36 @@ def make_bucketed_generate(cfg, *, max_len: int, max_new_tokens: int,
         tok = _sample(logits_last, sub, temperature=temperature,
                       top_k=top_k, top_p=top_p, min_p=min_p)
         toks = [tok]
+        # bucket dispatch/grow tallied locally and flushed to the obs
+        # registry AFTER the loop — the decode loop itself stays free of
+        # per-step lock traffic (dnn_tpu/obs overhead budget)
+        dispatch: dict = {}
+        grows = 0
         for i in range(max_new_tokens - 1):
             pos = t + i  # this step's cache-write position
             nb = bucket_for(ladder, pos + 1)
             if nb != n:
                 cache = _grow(cache, nb)
                 n = nb
+                grows += 1
             cache, tok, rng = _step(prepared, cache, tok,
                                     jnp.int32(pos), rng)
+            dispatch[n] = dispatch.get(n, 0) + 1
             toks.append(tok)
+        from dnn_tpu import obs
+
+        m = obs.metrics()
+        if m is not None:
+            from dnn_tpu.utils.metrics import labeled
+
+            # same metric family as ContinuousBatcher (the README's
+            # documented names): bucket-ladder activity is one concept
+            # whether the pool or the solo decoder drives it
+            for bk, cnt in dispatch.items():
+                m.inc(labeled("serving.decode_bucket_dispatch_total",
+                              bucket=bk), cnt)
+            if grows:
+                m.inc("serving.decode_bucket_grow_total", grows)
         return jnp.stack(toks, axis=1)
 
     generate.buckets = ladder

@@ -86,6 +86,13 @@ class PipelineEngine:
     ):
         if role not in ("full", "stage"):
             raise ValueError(f"role must be full|stage, got {role}")
+        # runtime compile telemetry (dnn_tpu/obs): every XLA compile this
+        # engine triggers — construction-time stage jits and any later
+        # shape churn — lands in jax_compilations_total, the live
+        # cross-check of the static recompile census (analysis PRG004)
+        from dnn_tpu import obs
+
+        obs.install_compile_telemetry()
         self.config = config
         self.role = role
         self.spec = get_model(config.model)
@@ -409,8 +416,16 @@ class PipelineEngine:
 
     def predict(self, x) -> int:
         """Client-path final step: argmax over the last stage's output
-        (node.py:61, 190-192)."""
-        return int(np.argmax(np.asarray(self.run(x))))
+        (node.py:61, 190-192). Spanned end-to-end (the np.asarray pull
+        forces device completion, so the span is honest wall time)."""
+        from dnn_tpu import obs
+
+        with obs.span("engine.predict", runtime=self.runtime):
+            pred = int(np.argmax(np.asarray(self.run(x))))
+        m = obs.metrics()
+        if m is not None:
+            m.inc("engine.predicts_total")
+        return pred
 
     # ------------------------------------------------------------------
     # autoregressive generation (GPT family)
@@ -621,4 +636,13 @@ class PipelineEngine:
             result["inter_stage_hop_p50_s"] = snap["latency"]["inter_stage_hop"]["p50"]
         if "stage_compute" in snap["latency"]:
             result["stage_compute_p50_s"] = snap["latency"]["stage_compute"]["p50"]
+        # mirror the headline gauges into the shared obs registry so a
+        # /metrics scrape of a long-lived server reflects the last
+        # measured pipeline numbers too
+        from dnn_tpu import obs
+
+        m_obs = obs.metrics()
+        if m_obs is not None:
+            m_obs.set("engine.items_per_sec", result["items_per_sec"])
+            m_obs.set("engine.step_latency_p50_seconds", step["p50"])
         return result

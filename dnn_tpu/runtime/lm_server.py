@@ -33,11 +33,13 @@ import asyncio
 import logging
 import queue
 import threading
-from typing import Optional
+import time
+from typing import Any, NamedTuple, Optional
 
 import grpc
 import numpy as np
 
+from dnn_tpu import obs
 from dnn_tpu.comm import wire_pb2 as pb
 from dnn_tpu.comm.service import (
     PayloadCorruptError,
@@ -64,7 +66,9 @@ def parse_gen_options(request_id: str, default_max_new: int):
     `key=value` segments (per-request sampling overrides, forwarded to
     ContinuousBatcher.submit) may appear anywhere after the prefix.
     Unparseable segments fall back to defaults (seed None = derive from
-    the request id, the batcher's own convention)."""
+    the request id, the batcher's own convention). Unknown named
+    segments are skipped — in particular `tr=...`, the obs layer's trace
+    tag (dnn_tpu/obs.tag_request_id), rides through here untouched."""
     max_new, seed, opts = default_max_new, None, {}
     parts = (request_id or "").split(":")
     if parts[0] != "gen":
@@ -108,6 +112,22 @@ def parse_gen_options(request_id: str, default_max_new: int):
     return max_new, seed, opts
 
 
+class _QueuedRequest(NamedTuple):
+    """One request waiting for the batcher worker — named fields so the
+    submit/admit/hold/drain sites stay self-describing (the tuple form
+    needed every unpack edited in lockstep per added field)."""
+
+    prompt: Any
+    max_new: int
+    seed: Any
+    opts: Optional[dict]
+    on_token: Any
+    cancel_evt: Any
+    trace: Any
+    t_q: float  # perf_counter at enqueue — the queue-wait clock
+    fut: Any
+
+
 class _BatcherWorker(threading.Thread):
     """The one thread that talks to the device. Owns the ContinuousBatcher;
     everyone else submits (prompt, max_new, seed, future) through a queue."""
@@ -145,14 +165,16 @@ class _BatcherWorker(threading.Thread):
         self._held = None
 
     def submit(self, prompt: np.ndarray, max_new: int, seed, *,
-               opts=None, on_token=None, cancel_evt=None):
+               opts=None, on_token=None, cancel_evt=None, trace=None):
         """Queue a request. `opts` (optional dict) forwards per-request
         sampling overrides to ContinuousBatcher.submit (temperature /
         top_k / top_p). `on_token(tok)` (optional) fires from the worker
         thread for every token as it commits — the streaming hook.
         `cancel_evt` (optional threading.Event) set by the caller retires
         the request's slot at the next step boundary; its future resolves
-        cancelled."""
+        cancelled. `trace` (optional obs span) parents this request's
+        span tree: the worker records queue_wait at admission and the
+        batcher hangs admit/prefill/decode spans under it."""
         import concurrent.futures
 
         fut = concurrent.futures.Future()
@@ -160,8 +182,16 @@ class _BatcherWorker(threading.Thread):
             if self._dead is not None:
                 fut.set_exception(self._dead)
                 return fut
-            self.q.put((prompt, max_new, seed, opts, on_token, cancel_evt,
-                        fut))
+            self.q.put(_QueuedRequest(prompt, max_new, seed, opts,
+                                      on_token, cancel_evt, trace,
+                                      time.perf_counter(), fut))
+            m = obs.metrics()
+            if m is not None:
+                # CALLABLE gauge: the shutdown/failure paths drain the
+                # queue with bare get_nowait(), so a stored depth would
+                # freeze at its pre-drain value — qsize reads fresh at
+                # every scrape instead
+                m.set_fn("serving.queue_depth", self.q.qsize)
         return fut
 
     def stop(self, *, drain: bool = True):
@@ -177,10 +207,9 @@ class _BatcherWorker(threading.Thread):
                     self._dead = RuntimeError("LM server shut down")
                 while True:
                     try:
-                        *_rest, fut = self.q.get_nowait()
+                        self.q.get_nowait().fut.cancel()
                     except queue.Empty:
                         break
-                    fut.cancel()
             elif self._dead is None:
                 # drain path: mark dead BEFORE signaling stop so a submit
                 # racing the loop's final pool-empty/queue-empty check fails
@@ -192,29 +221,42 @@ class _BatcherWorker(threading.Thread):
 
     # ------------------------------------------------------------------
 
-    def _admit(self, prompt, max_new, seed, opts, on_token, cancel_evt,
-               fut) -> bool:
+    def _admit(self, item: _QueuedRequest) -> bool:
         """Admit one queued request. Returns False when the request was
         HELD BACK (paged pool transiently full) — the admission loop must
-        then stop pulling more work until blocks free."""
+        then stop pulling more work until blocks free (`t_q` is preserved
+        through holds, so the recorded queue wait spans until the attempt
+        that actually admits)."""
         from dnn_tpu.runtime.paged_kvcache import InsufficientBlocks
 
-        if cancel_evt is not None and cancel_evt.is_set():
-            fut.cancel()  # cancelled while still queued: never admit
+        if item.cancel_evt is not None and item.cancel_evt.is_set():
+            item.fut.cancel()  # cancelled while still queued: never admit
             return True
+        wait = time.perf_counter() - item.t_q
         try:
-            rid = self.batcher.submit(prompt, max_new, seed=seed,
-                                      **(opts or {}))
+            rid = self.batcher.submit(item.prompt, item.max_new,
+                                      seed=item.seed, trace=item.trace,
+                                      **(item.opts or {}))
         except InsufficientBlocks:
-            self._held = (prompt, max_new, seed, opts, on_token,
-                          cancel_evt, fut)
+            self._held = item
             return False
         except Exception as e:  # noqa: BLE001 — validation errors belong to
-            fut.set_exception(e)  # the submitting request, not the loop
+            item.fut.set_exception(e)  # the submitting request, not the loop
             return True
-        self._futures[rid] = {"fut": fut, "on_token": on_token,
-                              "cancel_evt": cancel_evt}
-        if on_token is not None:
+        m = obs.metrics()
+        if m is not None:
+            m.observe("serving.queue_wait_seconds", wait)
+            # end-to-end TTFT: enqueue -> first token (sampled during the
+            # batcher's prefill, which submit() just completed)
+            m.observe("serving.ttft_seconds",
+                      time.perf_counter() - item.t_q)
+            m.set_fn("serving.queue_depth", self.q.qsize)
+        if item.trace:
+            obs.record_span("queue_wait", item.t_q, wait,
+                            parent=item.trace)
+        self._futures[rid] = {"fut": item.fut, "on_token": item.on_token,
+                              "cancel_evt": item.cancel_evt}
+        if item.on_token is not None:
             # the first token samples during prefill (batcher.submit)
             first = self.batcher.first_token(rid)
             if first is not None:
@@ -267,14 +309,13 @@ class _BatcherWorker(threading.Thread):
             if self._dead is None:
                 self._dead = RuntimeError("LM server shutting down")
             if self._held is not None:
-                (*_h, held_fut), self._held = self._held, None
-                held_fut.set_exception(self._dead)
+                held, self._held = self._held, None
+                held.fut.set_exception(self._dead)
             while True:
                 try:
-                    *_rest, fut = self.q.get_nowait()
+                    self.q.get_nowait().fut.set_exception(self._dead)
                 except queue.Empty:
                     return
-                fut.set_exception(self._dead)
 
     def _fail_all(self, exc):
         with self._lock:
@@ -284,15 +325,14 @@ class _BatcherWorker(threading.Thread):
                     rec["fut"].set_exception(exc)
             self._futures.clear()
             if self._held is not None:
-                (*_h, held_fut), self._held = self._held, None
-                if not held_fut.done():
-                    held_fut.set_exception(exc)
+                held, self._held = self._held, None
+                if not held.fut.done():
+                    held.fut.set_exception(exc)
             while True:
                 try:
-                    *_rest, fut = self.q.get_nowait()
+                    self.q.get_nowait().fut.set_exception(exc)
                 except queue.Empty:
                     return
-                fut.set_exception(exc)
 
     def run(self):
         b = self.batcher
@@ -303,8 +343,8 @@ class _BatcherWorker(threading.Thread):
                         rec["fut"].cancel()
                     self._futures.clear()
                     if self._held is not None:
-                        (*_h, held_fut), self._held = self._held, None
-                        held_fut.cancel()
+                        held, self._held = self._held, None
+                        held.fut.cancel()
                 return
             self._process_cancels()  # step boundary: free cancelled slots
             if b.n_active == 0 and self.q.empty() and self._held is None:
@@ -325,7 +365,7 @@ class _BatcherWorker(threading.Thread):
                 except Exception:  # noqa: BLE001
                     log.exception("compile-cache guard failed; continuing")
                 try:
-                    self._admit(*self.q.get(timeout=0.1))
+                    self._admit(self.q.get(timeout=0.1))
                 except queue.Empty:
                     continue
             while b.free_slots():
@@ -333,11 +373,11 @@ class _BatcherWorker(threading.Thread):
                     # retry the held-back request before new work; still
                     # short on blocks -> keep holding, stop admitting
                     item, self._held = self._held, None
-                    if not self._admit(*item):
+                    if not self._admit(item):
                         break
                     continue
                 try:
-                    if not self._admit(*self.q.get_nowait()):
+                    if not self._admit(self.q.get_nowait()):
                         break
                 except queue.Empty:
                     break
@@ -377,13 +417,53 @@ class LMServer:
     and `decode_buckets=True` grows the dense pool bucket-by-bucket so
     decode bytes/step track the pool's LIVE context instead of max_len
     (runtime/decode_buckets.py; dense pools only — paged pools are
-    already length-proportional)."""
+    already length-proportional).
+
+    Observability (dnn_tpu/obs): every request gets a span tree (queue
+    wait, admit, prefill, per-bucket decode; trace id continued from a
+    client's `tr=` request_id tag), the pool exports TTFT / inter-token
+    / occupancy / queue-depth metrics, and a jax.monitoring listener
+    counts XLA compiles. `metrics_port` (None = no endpoint; 0 =
+    ephemeral) serves it all over stdlib HTTP: GET /metrics (Prometheus
+    text), /trace (Chrome-trace JSON, ?id= for one request), /healthz."""
 
     def __init__(self, cfg, prepared, *, default_max_new: int = 32,
                  request_timeout: float = 120.0, tokenizer=None,
                  draft_cfg=None, draft_prepared=None, spec_k: int = 4,
                  compile_cache_budget: int = 512,
+                 metrics_port: Optional[int] = None,
                  **batcher_kwargs):
+        # observability first: the compile listener must be live before
+        # the batcher's first program compiles, so jax_compilations_total
+        # counts the daemon's own warmup too (dnn_tpu/obs)
+        obs.install_compile_telemetry()
+        self.metrics_server = None
+        if metrics_port is not None:
+            from dnn_tpu.obs.http import MetricsHTTPServer
+
+            # /metrics + /trace endpoint; /healthz mirrors HealthCheck
+            self.metrics_server = MetricsHTTPServer(
+                port=metrics_port,
+                healthy=lambda: (w := getattr(self, "worker", None))
+                is not None and w.is_alive())
+        try:
+            self._init_rest(
+                cfg, prepared, default_max_new=default_max_new,
+                request_timeout=request_timeout, tokenizer=tokenizer,
+                draft_cfg=draft_cfg, draft_prepared=draft_prepared,
+                spec_k=spec_k, compile_cache_budget=compile_cache_budget,
+                **batcher_kwargs)
+        except BaseException:
+            # a failed construction (bad batcher kwargs) must release the
+            # already-bound endpoint, or a retry hits EADDRINUSE forever
+            if self.metrics_server is not None:
+                self.metrics_server.close()
+                self.metrics_server = None
+            raise
+
+    def _init_rest(self, cfg, prepared, *, default_max_new,
+                   request_timeout, tokenizer, draft_cfg, draft_prepared,
+                   spec_k, compile_cache_budget, **batcher_kwargs):
         if (batcher_kwargs.get("allow_constraints")
                 and "constraint_rows" not in batcher_kwargs):
             # the daemon's JSON mode goes up to depth _MAX_JSON_DEPTH=3,
@@ -469,6 +549,13 @@ class LMServer:
             self._constraint_cache[depth] = c
         return c
 
+    def _request_span(self, request_id: str, **attrs):
+        """Root span for one served request: a client that tagged its
+        request_id (obs.tag_request_id — the `tr=` segment rides the
+        existing wire field) gets its trace CONTINUED across the process
+        boundary; untagged requests start fresh. NULL_SPAN when off."""
+        return obs.continue_or_start("lm.request", request_id, **attrs)
+
     # --- RPC implementations (names/signatures fixed by the protocol) ---
 
     async def _preflight(self, request_id: str, context):
@@ -516,27 +603,51 @@ class LMServer:
             await context.abort(grpc.StatusCode.UNAVAILABLE, str(exc))
         return fut.result()
 
-    async def _submit_and_await(self, ids, request_id: str, context):
+    async def _submit_and_await(self, ids, request_id: str, context,
+                                root=None):
         """Unary submit/await: preflight, wait with the request deadline
         (-> DEADLINE_EXCEEDED), client RPC cancellation re-raised for
-        grpc.aio, all terminal outcomes mapped by _result_or_abort."""
-        max_new, seed, opts = await self._preflight(request_id, context)
-        fut = self.worker.submit(
-            np.asarray(ids, np.int32).reshape(-1), max_new, seed,
-            opts=opts)
+        grpc.aio, all terminal outcomes mapped by _result_or_abort.
+        `root` — an already-created request span whose ending the CALLER
+        owns (SendMessage appends a detokenize child after the tokens
+        come back); None creates and ends one here."""
+        own_root = root is None
+        if own_root:
+            root = self._request_span(request_id, method="SendTensor")
+        fut = None
         try:
-            await asyncio.wait_for(
-                asyncio.wrap_future(fut), timeout=self.request_timeout)
-        except asyncio.TimeoutError:
-            await context.abort(
-                grpc.StatusCode.DEADLINE_EXCEEDED,
-                f"generation exceeded {self.request_timeout}s")
-        except asyncio.CancelledError:
-            if not fut.cancelled():
-                raise  # client cancelled the RPC: let grpc.aio handle it
-        except Exception:  # noqa: BLE001 — the future itself holds the
-            pass           # outcome; _result_or_abort maps it
-        return await self._result_or_abort(fut, context)
+            max_new, seed, opts = await self._preflight(request_id,
+                                                        context)
+            root.set(max_new=max_new,
+                     prompt_len=int(np.asarray(ids).size))
+            fut = self.worker.submit(
+                np.asarray(ids, np.int32).reshape(-1), max_new, seed,
+                opts=opts, trace=root)
+            try:
+                await asyncio.wait_for(
+                    asyncio.wrap_future(fut),
+                    timeout=self.request_timeout)
+            except asyncio.TimeoutError:
+                m = obs.metrics()
+                if m is not None:
+                    m.inc("serving.deadline_exceeded_total")
+                await context.abort(
+                    grpc.StatusCode.DEADLINE_EXCEEDED,
+                    f"generation exceeded {self.request_timeout}s")
+            except asyncio.CancelledError:
+                if not fut.cancelled():
+                    raise  # client cancelled the RPC: grpc.aio handles it
+            except Exception:  # noqa: BLE001 — the future itself holds
+                pass           # the outcome; _result_or_abort maps it
+            return await self._result_or_abort(fut, context)
+        finally:
+            # end-of-span in ALL outcomes — a preflight abort's trace
+            # (the failed request an operator most wants to see) must
+            # still reach the collector, which stores ended spans only
+            if own_root:
+                done = fut is not None and fut.done() \
+                    and not fut.cancelled() and fut.exception() is None
+                root.end(tokens=len(fut.result()) if done else None)
 
     async def _validated_prompt(self, request: pb.TensorRequest, context):
         """Decode + validate the raw-id prompt (shared by the unary and
@@ -615,20 +726,27 @@ class LMServer:
     async def SendTensor(self, request: pb.TensorRequest, context) -> pb.TensorResponse:
         prompt = await self._validated_prompt(request, context)
         rid = request.request_id or ""
-        if rid == "embed" or rid.startswith("embed:"):
+        # a client-side trace tag (tr=...) may ride any request_id; it is
+        # transport metadata, not an option — strip before endpoint parse
+        rid_clean = obs.strip_wire_tag(rid)
+        if rid_clean == "embed" or rid_clean.startswith("embed:"):
             # embedding endpoint: 'embed[:mean|last]' returns the pooled
             # final hidden state instead of generated tokens
-            pooling = rid.split(":", 1)[1] if ":" in rid else "mean"
+            pooling = rid_clean.split(":", 1)[1] if ":" in rid_clean \
+                else "mean"
             if pooling not in ("mean", "last"):
                 await context.abort(
                     grpc.StatusCode.INVALID_ARGUMENT,
                     f"embed pooling must be mean|last, got {pooling!r}")
+            root = self._request_span(rid, method="embed", pooling=pooling)
             try:
                 vec = await asyncio.to_thread(
                     self._embed_prompt, np.asarray(prompt), pooling)
             except ValueError as e:
                 await context.abort(grpc.StatusCode.INVALID_ARGUMENT,
                                     str(e))
+            finally:
+                root.end()
             return pb.TensorResponse(
                 status=f"[lm] ok: embedding dim {vec.shape[-1]}",
                 result_tensor=_tensor_msg(vec),
@@ -648,33 +766,42 @@ class LMServer:
         The unary SendTensor front stays untouched for reference
         wire-compat (wire.proto)."""
         prompt = await self._validated_prompt(request, context)
-        max_new, seed, opts = await self._preflight(request.request_id,
-                                                    context)
-        loop = asyncio.get_running_loop()
-        q: "asyncio.Queue" = asyncio.Queue()
-        cancel_evt = threading.Event()
-
-        def on_token(tok):
-            loop.call_soon_threadsafe(q.put_nowait, ("tok", tok))
-
-        fut = self.worker.submit(
-            np.asarray(prompt, np.int32).reshape(-1), max_new, seed,
-            opts=opts, on_token=on_token, cancel_evt=cancel_evt)
-
-        def _done(f):
-            # fires in the worker thread AFTER any on_token calls for this
-            # request: call_soon_threadsafe preserves that order, so the
-            # "done" sentinel always trails the last token in the queue
-            loop.call_soon_threadsafe(q.put_nowait, ("done", f))
-
-        fut.add_done_callback(_done)
+        root = self._request_span(request.request_id,
+                                  method="GenerateStream")
         n = 0
-        deadline = loop.time() + self.request_timeout
+        cancel_evt = None
         try:
+            max_new, seed, opts = await self._preflight(
+                request.request_id, context)
+            root.set(max_new=max_new, prompt_len=int(prompt.size))
+            loop = asyncio.get_running_loop()
+            q: "asyncio.Queue" = asyncio.Queue()
+            cancel_evt = threading.Event()
+
+            def on_token(tok):
+                loop.call_soon_threadsafe(q.put_nowait, ("tok", tok))
+
+            fut = self.worker.submit(
+                np.asarray(prompt, np.int32).reshape(-1), max_new, seed,
+                opts=opts, on_token=on_token, cancel_evt=cancel_evt,
+                trace=root)
+
+            def _done(f):
+                # fires in the worker thread AFTER any on_token calls for
+                # this request: call_soon_threadsafe preserves that order,
+                # so the "done" sentinel always trails the last token in
+                # the queue
+                loop.call_soon_threadsafe(q.put_nowait, ("done", f))
+
+            fut.add_done_callback(_done)
+            deadline = loop.time() + self.request_timeout
             while True:
                 remaining = deadline - loop.time()
                 if remaining <= 0:
                     cancel_evt.set()
+                    m = obs.metrics()
+                    if m is not None:
+                        m.inc("serving.deadline_exceeded_total")
                     await context.abort(
                         grpc.StatusCode.DEADLINE_EXCEEDED,
                         f"generation exceeded {self.request_timeout}s")
@@ -693,9 +820,13 @@ class LMServer:
                 await self._result_or_abort(val, context)
                 return
         except asyncio.CancelledError:
-            # the client went away: free the slot at the next step boundary
-            cancel_evt.set()
+            # the client went away: free the slot at the next step
+            # boundary (None: cancelled during preflight, nothing queued)
+            if cancel_evt is not None:
+                cancel_evt.set()
             raise
+        finally:
+            root.end(tokens=n)
 
     async def HealthCheck(self, request: pb.Empty, context) -> pb.HealthCheckResponse:
         return pb.HealthCheckResponse(is_healthy=self.worker.is_alive())
@@ -722,13 +853,22 @@ class LMServer:
         if not ids:
             await context.abort(grpc.StatusCode.INVALID_ARGUMENT,
                                 "prompt text tokenized to nothing")
-        tokens = await self._submit_and_await(ids, request.sender_id, context)
-        return pb.MessageReply(
-            confirmation_text=self.tokenizer.decode([int(t) for t in tokens]))
+        root = self._request_span(request.sender_id, method="SendMessage")
+        try:
+            tokens = await self._submit_and_await(
+                ids, request.sender_id, context, root=root)
+            with root.child("detokenize"):  # host-side text assembly
+                reply = self.tokenizer.decode([int(t) for t in tokens])
+        finally:
+            root.end()
+        return pb.MessageReply(confirmation_text=reply)
 
     def close(self):
         self.worker.stop(drain=False)
         self.worker.join(timeout=10)
+        if self.metrics_server is not None:
+            self.metrics_server.close()
+            self.metrics_server = None
 
 
 async def serve_lm(cfg, prepared, *, port: int, **server_kwargs):
@@ -804,4 +944,7 @@ def start_lm_server_in_background(cfg, prepared, *, port: int, **server_kwargs):
         state["servicer"].close()
         t.join(timeout=5)
 
+    # expose the servicer (tests read e.g. the ephemeral metrics_port=0
+    # endpoint via stop.servicer.metrics_server.port)
+    stop.servicer = state["servicer"]
     return t, stop

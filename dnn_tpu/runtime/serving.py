@@ -42,6 +42,7 @@ key sequence.)
 
 from __future__ import annotations
 
+import time
 from typing import Dict, List, Optional
 
 import jax
@@ -49,7 +50,9 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
+from dnn_tpu import obs
 from dnn_tpu.models.gpt import GPTConfig, head
+from dnn_tpu.utils.metrics import Throughput, labeled
 from dnn_tpu.ops.attention import merge_heads
 from dnn_tpu.ops.nn import gelu, layer_norm, linear
 from dnn_tpu.runtime.generate import (
@@ -457,6 +460,34 @@ class ContinuousBatcher:
         # host bookkeeping
         self._next_rid = 0
         self._slot_req: List[Optional[dict]] = [None] * slots
+        # observability (dnn_tpu/obs): windowed tokens/sec for the
+        # serving.tokens_per_sec gauge; all per-step bookkeeping below is
+        # gated on obs.metrics() so DNN_TPU_OBS=off costs one None check
+        self._tps = Throughput()
+        self._bucket_keys: Dict[int, str] = {}
+        # scrape-time callable gauges, (re-)registered with every bulk
+        # update below: the most recently ACTIVE pool owns the series —
+        # a once-only registration would let a dead pool keep reporting,
+        # and would never recover from a registry clear(). WEAKLY bound:
+        # the process-global registry must not pin a closed pool (and
+        # its slots x max_len KV cache) for the process lifetime — a
+        # collected pool's gauges read 0, which is what "no pool" means.
+        import weakref
+
+        pool_ref = weakref.ref(self)
+
+        def _weak_gauge(method_name):
+            def read():
+                pool = pool_ref()
+                return getattr(pool, method_name)() if pool is not None \
+                    else 0.0
+            return read
+
+        self._obs_gauges = {
+            "serving.tokens_per_sec": _weak_gauge("_tps_read"),
+            "serving.batch_occupancy": _weak_gauge("_occupancy_read"),
+            "serving.kv_slot_utilization": _weak_gauge("_kv_util_read"),
+        }
         self.results: Dict[int, np.ndarray] = {}
         self.finish_reasons: Dict[int, str] = {}
         self.token_logprobs: Dict[int, dict] = {}
@@ -665,7 +696,7 @@ class ContinuousBatcher:
                stop: Optional[list] = None,
                logprobs: bool = False,
                adapter: Optional[int] = None,
-               constraint=None) -> int:
+               constraint=None, trace=None) -> int:
         """Prefill `prompt` (1-D int array) into a free slot; returns the
         request id. The first token is sampled during prefill and counts
         toward max_new_tokens. `seed` names the request's private rng
@@ -698,7 +729,12 @@ class ContinuousBatcher:
         EOS is only reachable in accepting states, and when a match
         completes with no possible continuation the request retires with
         finish_reason "constraint" (server must be constructed with
-        allow_constraints=True)."""
+        allow_constraints=True); `trace` — an obs span (dnn_tpu/obs) to
+        parent this request's span tree under: submit records an "admit"
+        span with a nested "prefill", and each step maintains a
+        per-bucket "decode" span until the request retires. None (the
+        default) skips all span work; metrics counters are recorded
+        either way when observability is on."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if len(prompt) == 0:
             raise ValueError("prompt must have at least one token")
@@ -892,6 +928,10 @@ class ContinuousBatcher:
             # write (at position len(prompt)) must have a column
             self._ensure_cache_len(len(prompt) + 1)
 
+        # span tree (only when the caller passed a trace handle): "admit"
+        # covers slot install end-to-end, "prefill" the device work inside
+        adm = trace.child("admit", slot=slot, prompt_len=len(prompt)) \
+            if trace else obs.NULL_SPAN
         try:
             rid = self._next_rid
             self._next_rid += 1
@@ -942,6 +982,12 @@ class ContinuousBatcher:
                     ).at[0, p_pad - 1].set(last_logit_row)
             put_candidates = []
             pf_prepared = self._lora_prefill_view(aid)
+            sp_pf = adm.child("prefill", chunks=n_chunks - start_chunk,
+                              prompt_len=len(prompt))
+            t_pf = time.perf_counter()  # the PREFILL interval only —
+            # submit-entry-to-here is validation/slot/host bookkeeping,
+            # which belongs to the admit span, not this metric
+            chunks_before = self.prefill_chunks_run
             for c in range(start_chunk, n_chunks):
                 logits, row = self._prefill_chunk(
                     pf_prepared, row,
@@ -1004,7 +1050,28 @@ class ContinuousBatcher:
                 self.cache, first, c_lp, t_lp, t_ids = fin
             else:
                 self.cache, first = fin
-            first = int(first)
+            first = int(first)  # blocks until the prefill really finished
+            sp_pf.end()
+            m = obs.metrics()
+            if m is not None:
+                # the FIRST token commits here (sampled during prefill),
+                # so it is credited here — counting only in step() would
+                # under-report every request by one token (and budget-1
+                # requests, which never reach step(), entirely)
+                self._tps.add(1)
+                counters = {
+                    "serving.tokens_total": 1,
+                    "serving.prefill_chunks_total":
+                        self.prefill_chunks_run - chunks_before,
+                }
+                if hit_c:
+                    counters["serving.prefix_hits_total"] = 1
+                m.bulk(
+                    counters=counters,
+                    observations={"serving.prefill_seconds":
+                                  [time.perf_counter() - t_pf]},
+                    gauge_fns=self._obs_gauges,
+                )
             self.pos = self.pos.at[slot].set(len(prompt))
             self.tok = self.tok.at[slot].set(first)
             self.active = self.active.at[slot].set(True)
@@ -1031,6 +1098,9 @@ class ContinuousBatcher:
             if req["logprobs"]:
                 req["lp"] = [float(np.asarray(c_lp)[0])]
                 req["lp_top"] = [(np.asarray(t_ids)[0], np.asarray(t_lp)[0])]
+            if trace:
+                req["trace"] = trace  # step() hangs decode spans off this
+            req["t_last"] = time.perf_counter()  # inter-token clock
             self._slot_req[slot] = req
             if constraint is not None:
                 self._constraint_advance(slot, first)
@@ -1062,6 +1132,8 @@ class ContinuousBatcher:
             if c_off is not None:
                 self._ctab_release(constraint)
             raise
+        finally:
+            adm.end()
 
     def _ensure_cache_len(self, need: int):
         """Grow the bucketed dense pool to the smallest ladder bucket
@@ -1076,6 +1148,9 @@ class ContinuousBatcher:
         target = bucket_for(self._buckets, need)
         self.cache = self._grow_cache(self.cache, target)
         self._cache_len = target
+        m = obs.metrics()
+        if m is not None:
+            m.inc("serving.decode_bucket_grow_total")
 
     def _evict_prefix_entry(self):
         """Drop the LRU prefix entry; paged entries release their block
@@ -1197,6 +1272,93 @@ class ContinuousBatcher:
         self._crow_np[slot] = req["c_off"] + ns
         self._crow_dirty = True
 
+    # ------------------------------------------------------------------
+    # observability helpers (dnn_tpu/obs) — shared by the dense step and
+    # the speculative override (serving_spec.SpeculativeBatcher.step)
+    # ------------------------------------------------------------------
+
+    def _obs_commit(self, req, m, t_now, n_new: int = 1,
+                    samples: Optional[list] = None):
+        """Per-slot bookkeeping after committing `n_new` tokens: the
+        inter-token clock (a speculative chunk spreads its gap over the
+        chunk; samples accumulate into `samples` for the step's ONE bulk
+        registry update) and the per-BUCKET decode span — one child per
+        cache-view rung a request decodes through (a single span on
+        unbucketed pools), closed with token/reason attrs at retire."""
+        if m is not None:
+            tl = req.get("t_last")
+            if tl is not None and samples is not None:
+                samples.append((t_now - tl) / max(n_new, 1))
+            req["t_last"] = t_now
+        else:
+            # gate off: clear the clock so a runtime re-enable
+            # (obs.set_enabled) doesn't observe the whole disabled gap
+            # as one giant inter-token sample
+            req["t_last"] = None
+        tr = req.get("trace")
+        if tr is not None and req.get("b_bucket") != self._cache_len:
+            bs = req.get("b_span")
+            if bs is not None:
+                bs.end(tokens=len(req["emitted"]) - n_new)
+            req["b_span"] = tr.child("decode", bucket=self._cache_len)
+            req["b_bucket"] = self._cache_len
+
+    def _bucket_key(self) -> str:
+        """Memoized labeled() key for the current bucket — the string
+        formatting is measurable on the per-step path."""
+        key = self._bucket_keys.get(self._cache_len)
+        if key is None:
+            key = self._bucket_keys[self._cache_len] = labeled(
+                "serving.decode_bucket_dispatch_total",
+                bucket=self._cache_len)
+        return key
+
+    def _obs_step_end(self, m, n_adv: int, samples: Optional[list] = None):
+        """Pool-level series for one completed device step (`n_adv` =
+        tokens committed across all slots): counters/samples land in ONE
+        bulk registry update, and the pool gauges are CALLABLE — read at
+        scrape time from host state. Both choices are load-bearing:
+        per-series locking measurably taxes a sub-ms CPU decode step
+        (benchmarks/obs_overhead_probe.py), and stored gauges freeze at
+        the last step's value on an idle pool (throughput would never
+        decay, occupancy would report the retired batch forever)."""
+        if m is None:
+            return
+        self._tps.add(n_adv)
+        m.bulk(
+            counters={"serving.decode_steps_total": 1,
+                      "serving.tokens_total": n_adv,
+                      self._bucket_key(): 1},
+            observations={"serving.inter_token_seconds": samples}
+            if samples else None,
+            gauge_fns=self._obs_gauges,
+        )
+
+    def _tps_read(self) -> float:
+        return self._tps.per_sec
+
+    def _occupancy_read(self) -> float:
+        return self.n_active / self.slots
+
+    def _kv_util_read(self) -> float:
+        # live KV positions over the current allocation; reads host
+        # bookkeeping concurrently with the worker — transiently stale
+        # values are fine for a gauge, and CPython list iteration over
+        # `_slot_req` is safe against its element assignments
+        live = sum(r["prompt_len"] + len(r["emitted"])
+                   for r in self._slot_req if r is not None)
+        return live / (self.slots * self._cache_len)
+
+    def _obs_retire(self, req, reason: str):
+        """Close a leaving request's decode span + outcome counter — the
+        one block _retire_if_done and cancel share."""
+        bs = req.get("b_span")
+        if bs is not None:
+            bs.end(tokens=len(req["emitted"]), reason=reason)
+        m = obs.metrics()
+        if m is not None:
+            m.inc(labeled("serving.requests_total", outcome=reason))
+
     def _retire_if_done(self, slot: int):
         req = self._slot_req[slot]
         reason = None
@@ -1231,6 +1393,7 @@ class ContinuousBatcher:
         self._release_slot_constraint(slot, req)
         self._slot_req[slot] = None
         self.active = self.active.at[slot].set(False)
+        self._obs_retire(req, reason)
 
     def _release_slot_constraint(self, slot: int, req: dict):
         """Drop a retiring slot's constraint: refcount down, device
@@ -1286,6 +1449,7 @@ class ContinuousBatcher:
                 self._slot_req[slot] = None
                 self.active = self.active.at[slot].set(False)
                 self.finish_reasons[rid] = "cancelled"
+                self._obs_retire(req, "cancelled")
                 return True
         if rid in self.results:
             # cancelling an already-finished, unclaimed request drops its
@@ -1323,6 +1487,10 @@ class ContinuousBatcher:
         else:
             self.cache, self.pos, self.tok, self.keys, self._seen = res
         toks = np.asarray(self.tok)
+        m = obs.metrics()
+        t_now = time.perf_counter() if m is not None else 0.0
+        n_adv = 0
+        it_samples: list = []
         out = {}
         for slot, req in enumerate(self._slot_req):
             if req is None:
@@ -1333,12 +1501,15 @@ class ContinuousBatcher:
                 req["lp"].append(float(c_lp[slot]))
                 req["lp_top"].append((t_ids[slot], t_lp[slot]))
             out[req["rid"]] = token
+            n_adv += 1
+            self._obs_commit(req, m, t_now, samples=it_samples)
             if "constraint" in req:
                 # host DFA walk updates the (slots,) state vector only;
                 # the mask rows themselves live on device (_ctable)
                 self._constraint_advance(slot, token)
             self._free_rolled_blocks(slot)  # windowed pools reclaim
             self._retire_if_done(slot)
+        self._obs_step_end(m, n_adv, it_samples)
         return out
 
     def drain(self) -> Dict[int, np.ndarray]:

@@ -56,6 +56,7 @@ deepest point of the serving stack built beyond it.
 
 from __future__ import annotations
 
+import time
 from typing import Optional
 
 import jax
@@ -369,6 +370,12 @@ class SpeculativeBatcher(ContinuousBatcher):
             self.prev_chunk, self.prev_pos)
         w_np, m_np = np.asarray(w), np.asarray(m)
         self.spec_steps += 1
+        from dnn_tpu import obs
+
+        obs_m = obs.metrics()
+        t_now = time.perf_counter() if obs_m is not None else 0.0
+        n_adv = 0
+        it_samples: list = []
         out = {}
         for slot, req in enumerate(self._slot_req):
             if req is None:
@@ -384,5 +391,15 @@ class SpeculativeBatcher(ContinuousBatcher):
                 self._retire_if_done(slot)
                 if self._slot_req[slot] is None:
                     break  # budget/stop/eos hit mid-chunk: rest discarded
+            # shared obs bookkeeping (serving.ContinuousBatcher helpers):
+            # the inter-token gap spreads over the committed chunk; the
+            # decode span closes at retire like the dense path. Skipped
+            # for a request that retired mid-chunk — its span is already
+            # closed and must not reopen on a dead slot.
+            n_adv += len(emitted)
+            if self._slot_req[slot] is req:
+                self._obs_commit(req, obs_m, t_now, n_new=len(emitted),
+                                 samples=it_samples)
             out[req["rid"]] = emitted
+        self._obs_step_end(obs_m, n_adv, it_samples)
         return out

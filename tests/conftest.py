@@ -55,6 +55,9 @@ _MODULE_COST_S = {
     "test_torch_export": 11.1, "test_models_gpt": 11.4,
     "test_analysis": 13.7,  # the static-analyzer gate: cheap, CPU-only,
     # and placed early so the tier-1 budget always certifies it
+    "test_obs": 28.0,  # the observability layer (spans, /metrics, compile
+    # telemetry + the `python -m dnn_tpu.obs trace --selftest` CI smoke):
+    # mid-pack cost, certified within the tier-1 budget
     "test_grad_accum": 12.9, "test_train_ckpt": 14.3, "test_remat": 14.6,
     "test_qwen2": 14.7, "test_olmo2": 14.8, "test_tp_generate": 15.6,
     "test_pipeline": 16.5, "test_seq_parallel": 17.0,

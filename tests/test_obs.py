@@ -1,0 +1,517 @@
+"""Observability layer tests (dnn_tpu/obs + the grown utils/metrics).
+
+The acceptance contract this module pins (ISSUE 3): one end-to-end
+generate request through LMServer produces (a) a valid Chrome-trace JSON
+with nested queue/prefill/decode/RPC spans sharing ONE trace id, and
+(b) a /metrics scrape containing TTFT, inter-token quantiles, batch
+occupancy, per-stage RPC latency, and a nonzero jax_compilations_total —
+plus the unit contracts underneath: span-tree nesting, wire-tag
+propagation across an in-process client->stage hop, Prometheus golden
+output, empty-reservoir snapshots, windowed throughput, the compile
+listener firing under jax.jit, and the `python -m dnn_tpu.obs trace
+--selftest` smoke the CI path invokes."""
+
+import json
+import subprocess
+import sys
+import threading
+import urllib.request
+
+import numpy as np
+import pytest
+
+from dnn_tpu import obs
+from dnn_tpu.utils.metrics import (
+    Histogram,
+    LatencyReservoir,
+    Metrics,
+    Throughput,
+    labeled,
+    render_prometheus,
+)
+
+
+# ----------------------------------------------------------------------
+# metrics primitives (satellite: utils/metrics.py sharp edges)
+# ----------------------------------------------------------------------
+
+def test_empty_reservoir_snapshot_is_safe():
+    r = LatencyReservoir()
+    assert r.quantiles() == {}  # no ValueError on an empty reservoir
+    m = Metrics()
+    m.latencies["nothing_yet"] = LatencyReservoir()
+    snap = m.snapshot()  # must not raise
+    assert snap["latency"]["nothing_yet"] == {"count": 0}
+    json.loads(m.json_line())
+
+
+def test_throughput_is_really_windowed():
+    clock = iter([0.0, 1.0, 2.0, 3.0, 100.0, 100.0, 130.0]).__next__
+    t = Throughput(window_s=60.0, now=clock)  # created at t=0
+    t.add(30)   # t=1
+    t.add(30)   # t=2
+    # t=3: 60 items over 3 s of lifetime (pre-warmup under-report, never
+    # an event-span spike)
+    assert t.per_sec == pytest.approx(20.0)
+    # t=100: everything older than t=40 evicted -> rate decays to zero
+    # (the cumulative-since-first-add implementation reported ~0.6 here)
+    assert t.per_sec == 0.0
+    t.add(60)   # t=100
+    # t=130: 60 items over the full 60 s wall window — a burst after an
+    # idle gap must NOT divide by its own ~0 event span (the ~1e9 gauge
+    # spike the wall-window denominator exists to prevent)
+    assert t.per_sec == pytest.approx(1.0)
+
+
+def test_histogram_buckets_cumulative():
+    h = Histogram(buckets=(0.01, 0.1, 1.0))
+    for v in (0.005, 0.05, 0.05, 5.0):
+        h.observe(v)
+    snap = h.snapshot()
+    assert snap["buckets"] == {0.01: 1, 0.1: 3, 1.0: 3}
+    assert snap["count"] == 4
+    assert snap["sum"] == pytest.approx(5.105)
+
+
+def test_callable_gauge_is_fresh_at_render():
+    m = Metrics()
+    vals = iter([1.0, 2.0])
+    m.set_fn("fresh_gauge", lambda: next(vals))
+    assert "fresh_gauge 1" in render_prometheus(m)
+    assert "fresh_gauge 2" in render_prometheus(m)  # re-evaluated
+    m.set_fn("dying_gauge", lambda: 1 / 0)
+    assert m.snapshot()["gauges"]["dying_gauge"] == 0.0  # never breaks
+
+
+def test_bulk_updates_and_gauge_fn_reregistration():
+    m = Metrics()
+    m.bulk(counters={"c_total": 2}, gauges={"g": 1.5},
+           observations={"lat": [0.1, 0.2]},
+           gauge_fns={"fn_g": lambda: 42})
+    snap = m.snapshot()
+    assert snap["counters"]["c_total"] == 2
+    assert snap["gauges"]["g"] == 1.5
+    assert snap["gauges"]["fn_g"] == 42
+    assert snap["latency"]["lat"]["count"] == 2
+    m.clear()
+    assert "fn_g" not in m.snapshot()["gauges"]
+    m.bulk(gauge_fns={"fn_g": lambda: 7})  # every bulk re-registers, so
+    assert m.snapshot()["gauges"]["fn_g"] == 7  # a clear() self-heals
+
+
+def test_labeled_canonical_and_escaped():
+    assert labeled("x_total") == "x_total"
+    assert labeled("x_total", b="2", a="1") == 'x_total{a="1",b="2"}'
+    assert labeled("x", k='say "hi"') == r'x{k="say \"hi\""}'
+
+
+def test_prometheus_golden_output():
+    m = Metrics()
+    m.inc("requests_total", 3)
+    m.inc(labeled("comm.retries_total", stage="node1"))
+    m.set("serving.batch_occupancy", 0.5)
+    m.observe("lat_seconds", 0.01)
+    m.observe("lat_seconds", 0.03)
+    m.observe_hist("h_seconds", 0.05, buckets=(0.01, 0.1))
+    assert render_prometheus(m) == (
+        "# TYPE comm_retries_total counter\n"
+        'comm_retries_total{stage="node1"} 1\n'
+        "# TYPE h_seconds histogram\n"
+        'h_seconds_bucket{le="0.01"} 0\n'
+        'h_seconds_bucket{le="0.1"} 1\n'
+        'h_seconds_bucket{le="+Inf"} 1\n'
+        "h_seconds_sum 0.05\n"
+        "h_seconds_count 1\n"
+        "# TYPE lat_seconds summary\n"
+        'lat_seconds{quantile="0.5"} 0.01\n'
+        'lat_seconds{quantile="0.9"} 0.03\n'
+        'lat_seconds{quantile="0.99"} 0.03\n'
+        "lat_seconds_sum 0.04\n"
+        "lat_seconds_count 2\n"
+        "# TYPE requests_total counter\n"
+        "requests_total 3\n"
+        "# TYPE serving_batch_occupancy gauge\n"
+        "serving_batch_occupancy 0.5\n"
+    )
+
+
+# ----------------------------------------------------------------------
+# span trees + wire propagation
+# ----------------------------------------------------------------------
+
+def test_span_tree_nesting_and_cross_thread_parent():
+    with obs.span("root", kind="test") as root:
+        with obs.span("child_a"):
+            with obs.span("grandchild"):
+                pass
+
+        def worker():
+            s = obs.start_span("child_b", parent=root)
+            s.end(tokens=2)
+
+        t = threading.Thread(target=worker)
+        t.start()
+        t.join()
+    by_name = {s.name: s for s in obs.collector().spans(root.trace_id)}
+    assert set(by_name) == {"root", "child_a", "grandchild", "child_b"}
+    assert by_name["root"].parent_id is None
+    assert by_name["child_a"].parent_id == by_name["root"].span_id
+    assert by_name["grandchild"].parent_id == by_name["child_a"].span_id
+    assert by_name["child_b"].parent_id == by_name["root"].span_id
+    assert by_name["child_b"].attrs["tokens"] == 2
+    assert all(s.dur >= 0 for s in by_name.values())
+
+
+def test_wire_tag_roundtrip_and_option_parser_immunity():
+    from dnn_tpu.runtime.lm_server import parse_gen_options
+
+    root = obs.start_span("req")
+    rid = obs.tag_request_id("gen:12:7", root)
+    root.end()
+    assert obs.parse_wire_tag(rid) == (root.trace_id, root.span_id)
+    assert obs.strip_wire_tag(rid) == "gen:12:7"
+    # the tag must be invisible to the option parser (wire compat)
+    assert parse_gen_options(rid, 32) == (12, 7, {})
+    # untagged ids parse to None, and tagging is a no-op when off
+    assert obs.parse_wire_tag("gen:12") is None
+    assert obs.tag_request_id("gen:12", obs.NULL_SPAN) == "gen:12"
+
+
+def test_disabled_gate_is_free_and_restores():
+    obs.set_enabled(False)
+    try:
+        assert obs.metrics() is None
+        s = obs.start_span("nope")
+        assert s is obs.NULL_SPAN and not s
+        s.child("x").end()
+        with obs.span("nope2") as s2:
+            assert s2 is None
+    finally:
+        obs.set_enabled(True)
+    assert obs.metrics() is not None
+
+
+def test_chrome_trace_schema():
+    with obs.span("outer", a=1) as root:
+        with obs.span("inner"):
+            pass
+    ct = obs.collector().chrome_trace(root.trace_id)
+    xs = [e for e in ct["traceEvents"] if e["ph"] == "X"]
+    assert {e["name"] for e in xs} == {"outer", "inner"}
+    for e in xs:
+        assert e["ts"] > 0 and e["dur"] >= 0 and e["pid"] == 1
+        assert e["args"]["trace_id"] == root.trace_id
+    # JSONL export round-trips through the CLI converter schema
+    lines = [json.loads(ln)
+             for ln in obs.collector().jsonl(root.trace_id).splitlines()]
+    assert len(lines) == 2
+    assert {"trace_id", "span_id", "parent_id", "name", "ts", "dur",
+            "tid", "attrs"} <= set(lines[0])
+
+
+def test_trace_cli_selftest_smoke():
+    # the tier-1 smoke invocation the CI path mandates (ISSUE satellite)
+    out = subprocess.run(
+        [sys.executable, "-m", "dnn_tpu.obs", "trace", "--selftest"],
+        capture_output=True, text=True, timeout=120)
+    assert out.returncode == 0, out.stderr
+    assert "obs selftest ok" in out.stdout
+
+
+def test_trace_cli_jsonl_to_chrome(tmp_path):
+    with obs.span("convertme") as root:
+        pass
+    src = tmp_path / "spans.jsonl"
+    dst = tmp_path / "chrome.json"
+    obs.collector().dump_jsonl(str(src), root.trace_id)
+    out = subprocess.run(
+        [sys.executable, "-m", "dnn_tpu.obs", "trace", "--jsonl", str(src),
+         "--out", str(dst), "--id", root.trace_id],
+        capture_output=True, text=True, timeout=120)
+    assert out.returncode == 0, out.stderr
+    ct = json.loads(dst.read_text())
+    assert [e["name"] for e in ct["traceEvents"]
+            if e["ph"] == "X"] == ["convertme"]
+
+
+# ----------------------------------------------------------------------
+# /metrics endpoint + compile telemetry
+# ----------------------------------------------------------------------
+
+def test_metrics_http_endpoint_scrape():
+    from dnn_tpu.obs.http import MetricsHTTPServer
+
+    reg = Metrics()
+    reg.inc("scrape_me_total", 7)
+    col = obs.TraceCollector()
+    srv = MetricsHTTPServer(port=0, host="127.0.0.1", registry=reg,
+                            collector=col, healthy=lambda: True)
+    try:
+        base = f"http://127.0.0.1:{srv.port}"
+        body = urllib.request.urlopen(base + "/metrics").read().decode()
+        assert "# TYPE scrape_me_total counter" in body
+        assert "scrape_me_total 7" in body
+        assert urllib.request.urlopen(base + "/healthz").status == 200
+        ct = json.loads(urllib.request.urlopen(base + "/trace").read())
+        assert ct["traceEvents"] == []
+        with pytest.raises(urllib.error.HTTPError):
+            urllib.request.urlopen(base + "/nope")
+    finally:
+        srv.close()
+
+
+def test_compile_counter_fires_under_jit():
+    import jax
+    import jax.numpy as jnp
+
+    assert obs.install_compile_telemetry()
+    m = obs.metrics()
+    before = m.counters.get("jax_compilations_total", 0)
+    before_s = m.counters.get("jax_compile_seconds_total", 0.0)
+
+    @jax.jit
+    def f(x):
+        return x * 3 + 1
+
+    f(jnp.ones((7,))).block_until_ready()
+    assert m.counters["jax_compilations_total"] > before
+    assert m.counters["jax_compile_seconds_total"] > before_s
+    # cache hit: no new compile counted
+    mid = m.counters["jax_compilations_total"]
+    f(jnp.ones((7,))).block_until_ready()
+    assert m.counters["jax_compilations_total"] == mid
+
+
+# ----------------------------------------------------------------------
+# batcher instrumentation (direct, no gRPC)
+# ----------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def tiny_gpt():
+    import jax
+
+    from dnn_tpu.models import gpt
+
+    cfg = gpt.GPTConfig(block_size=64, vocab_size=64, n_layer=2, n_head=2,
+                        n_embd=32)
+    prepared = gpt.prepare_stacked(gpt.init(jax.random.PRNGKey(0), cfg),
+                                   cfg)
+    return cfg, prepared
+
+
+def test_batcher_bucket_spans_and_metrics(tiny_gpt):
+    from dnn_tpu.runtime.serving import ContinuousBatcher
+
+    cfg, prepared = tiny_gpt
+    m = obs.metrics()
+    srv = ContinuousBatcher(cfg, prepared, slots=2, max_len=48,
+                            prompt_pad=16, decode_buckets=(16, 32, 48))
+    root = obs.start_span("request")
+    srv.submit(np.arange(1, 9), max_new_tokens=30, trace=root)
+    srv.drain()
+    root.end()
+    spans = obs.collector().spans(root.trace_id)
+    by_name = {}
+    for s in spans:
+        by_name.setdefault(s.name, []).append(s)
+    assert set(by_name) == {"request", "admit", "prefill", "decode"}
+    # admit under request, prefill under admit
+    assert by_name["admit"][0].parent_id == root.span_id
+    assert by_name["prefill"][0].parent_id == by_name["admit"][0].span_id
+    # per-BUCKET decode spans: the request decodes through 16 -> 32 -> 48
+    buckets = sorted(s.attrs["bucket"] for s in by_name["decode"])
+    assert buckets == [16, 32, 48]
+    last = max(by_name["decode"], key=lambda s: s.attrs["bucket"])
+    assert last.attrs["reason"] == "length"
+    assert last.attrs["tokens"] == 30
+    # counters: dispatch per bucket + grows + retirement outcome
+    assert m.counters[labeled("serving.decode_bucket_dispatch_total",
+                              bucket=16)] >= 1
+    assert m.counters[labeled("serving.decode_bucket_dispatch_total",
+                              bucket=48)] >= 1
+    assert m.counters["serving.decode_bucket_grow_total"] >= 2
+    assert m.counters[labeled("serving.requests_total",
+                              outcome="length")] >= 1
+    assert m.latencies["serving.inter_token_seconds"].count >= 29
+
+
+def test_batcher_gauges_do_not_pin_dead_pools(tiny_gpt):
+    import gc
+    import weakref
+
+    from dnn_tpu.runtime.serving import ContinuousBatcher
+    from dnn_tpu.utils.metrics import default_metrics
+
+    cfg, prepared = tiny_gpt
+    srv = ContinuousBatcher(cfg, prepared, slots=1, max_len=32,
+                            prompt_pad=16)
+    srv.submit(np.arange(1, 5), 4)
+    srv.drain()  # registers the weakly-bound callable gauges
+    wr = weakref.ref(srv)
+    del srv
+    gc.collect()
+    # the registry's gauge callables must not keep the pool (and its KV
+    # cache) alive; a collected pool's gauges read 0 at scrape
+    assert wr() is None
+    assert default_metrics.snapshot()["gauges"][
+        "serving.batch_occupancy"] == 0.0
+
+
+def test_batcher_untraced_requests_make_no_spans(tiny_gpt):
+    from dnn_tpu.runtime.serving import ContinuousBatcher
+
+    cfg, prepared = tiny_gpt
+    srv = ContinuousBatcher(cfg, prepared, slots=1, max_len=32,
+                            prompt_pad=16)
+    n_before = len(obs.collector().spans())
+    srv.submit(np.arange(1, 5), max_new_tokens=4)
+    srv.drain()
+    assert len(obs.collector().spans()) == n_before
+
+
+# ----------------------------------------------------------------------
+# end-to-end: client -> stage hop trace propagation
+# ----------------------------------------------------------------------
+
+def test_stage_hop_trace_propagation():
+    from dnn_tpu.comm.client import NodeClient
+    from dnn_tpu.comm.service import start_stage_server_in_background
+    from dnn_tpu.config import TopologyConfig
+    from dnn_tpu.runtime.engine import PipelineEngine
+
+    cfg = TopologyConfig.from_dict({
+        "nodes": [
+            {"id": "node1", "address": "127.0.0.1:59361", "part_index": 0},
+            {"id": "node2", "address": "127.0.0.1:59362", "part_index": 1},
+        ],
+        "num_parts": 2, "model": "cifar_cnn", "runtime": "relay",
+    })
+    engine = PipelineEngine(cfg)
+    t1, stop1 = start_stage_server_in_background(engine, "node1")
+    t2, stop2 = start_stage_server_in_background(engine, "node2")
+    try:
+        x = np.asarray(engine.spec.example_input(batch_size=1))
+        c = NodeClient(cfg.node_by_id("node1").address)
+        with obs.span("client.request") as root:
+            status, result = c.send_tensor(x, request_id="trace_hop_1")
+        c.close()
+    finally:
+        stop1()
+        stop2()
+    assert result is not None
+    spans = obs.collector().spans(root.trace_id)
+    names = sorted(s.name for s in spans)
+    # client RPC span + per-hop forward span + both stages' request and
+    # compute spans — ONE trace id across three "processes"
+    assert names == sorted(["client.request", "rpc.SendTensor",
+                            "stage.request", "stage.compute",
+                            "rpc.forward", "stage.request",
+                            "stage.compute"])
+    stages = {s.attrs["stage"] for s in spans if s.name == "stage.request"}
+    assert stages == {"node1", "node2"}
+    # parent chain crosses the wire: node1's stage.request hangs under
+    # the client's rpc span; node2's under node1's forward span
+    by_id = {s.span_id: s for s in spans}
+    for s in spans:
+        if s.name == "stage.request" and s.attrs["stage"] == "node2":
+            assert by_id[s.parent_id].name == "rpc.forward"
+        if s.name == "rpc.forward":
+            assert by_id[s.parent_id].name == "stage.request"
+
+
+# ----------------------------------------------------------------------
+# end-to-end acceptance: generate through LMServer -> trace + scrape
+# ----------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def lm_obs_server(tiny_gpt):
+    from dnn_tpu.runtime.lm_server import start_lm_server_in_background
+
+    cfg, prepared = tiny_gpt
+    t, stop = start_lm_server_in_background(
+        cfg, prepared, port=59461, slots=2, max_len=64, prompt_pad=16,
+        default_max_new=8, metrics_port=0)
+    yield stop.servicer
+    stop()
+
+
+def test_e2e_generate_trace_and_metrics_scrape(lm_obs_server):
+    from dnn_tpu.comm.client import NodeClient
+
+    c = NodeClient("127.0.0.1:59461")
+    with obs.span("client.generate") as root:
+        toks = c.generate([1, 2, 3, 4], max_new_tokens=10, seed=0)
+    c.close()
+    assert len(toks) == 10
+
+    # (a) one trace id, nested queue/prefill/decode/RPC spans
+    spans = obs.collector().spans(root.trace_id)
+    by_name = {s.name: s for s in spans}
+    assert {"client.generate", "rpc.SendTensor", "lm.request",
+            "queue_wait", "admit", "prefill", "decode"} <= set(by_name)
+    assert by_name["lm.request"].parent_id == \
+        by_name["rpc.SendTensor"].span_id
+    assert by_name["queue_wait"].parent_id == \
+        by_name["lm.request"].span_id
+    assert by_name["prefill"].parent_id == by_name["admit"].span_id
+    assert by_name["decode"].attrs["tokens"] == 10
+    assert by_name["lm.request"].attrs["tokens"] == 10
+    ct = obs.collector().chrome_trace(root.trace_id)
+    xs = [e for e in ct["traceEvents"] if e["ph"] == "X"]
+    assert len(xs) == len(spans)
+    assert {e["args"]["trace_id"] for e in xs} == {root.trace_id}
+
+    # (b) the /metrics scrape — served from the LMServer's own endpoint
+    port = lm_obs_server.metrics_server.port
+    body = urllib.request.urlopen(
+        f"http://127.0.0.1:{port}/metrics").read().decode()
+    for needle in (
+            'serving_ttft_seconds{quantile="0.5"}',
+            'serving_inter_token_seconds{quantile="0.99"}',
+            "serving_batch_occupancy",
+            "serving_queue_wait_seconds_count",
+            "serving_tokens_per_sec",
+            "serving_kv_slot_utilization",
+            "comm_rpc_latency_seconds_bucket",
+            "serving_requests_total{",
+    ):
+        assert needle in body, f"missing {needle!r} in scrape"
+    # nonzero compile counter: the daemon's own programs compiled under
+    # the listener (installed before the batcher's first submit)
+    line = next(ln for ln in body.splitlines()
+                if ln.startswith("jax_compilations_total"))
+    assert float(line.split()[-1]) > 0
+    # the trace endpoint renders this very request's timeline
+    ct2 = json.loads(urllib.request.urlopen(
+        f"http://127.0.0.1:{port}/trace?id={root.trace_id}").read())
+    assert any(e.get("name") == "decode" for e in ct2["traceEvents"])
+
+
+def test_lm_server_releases_metrics_port_on_failed_construction(tiny_gpt):
+    from dnn_tpu.obs.http import MetricsHTTPServer
+    from dnn_tpu.runtime.lm_server import LMServer
+
+    cfg, prepared = tiny_gpt
+    with pytest.raises(ValueError):
+        # invalid batcher kwargs AFTER the endpoint has bound: the
+        # failed construction must release the port, or a retry hits
+        # EADDRINUSE for the rest of the process
+        LMServer(cfg, prepared, metrics_port=59477, slots=1, max_len=32,
+                 prompt_pad=16, allow_constraints=True, constraint_rows=1)
+    srv = MetricsHTTPServer(port=59477, host="127.0.0.1")  # rebinds
+    srv.close()
+
+
+def test_e2e_streaming_and_text_front_spans(lm_obs_server):
+    from dnn_tpu.comm.client import NodeClient
+
+    c = NodeClient("127.0.0.1:59461")
+    with obs.span("client.stream") as root:
+        toks = list(c.generate_stream([1, 2, 3], max_new_tokens=5, seed=1))
+    c.close()
+    assert len(toks) == 5
+    by_name = {s.name: s for s in obs.collector().spans(root.trace_id)}
+    assert {"client.stream", "rpc.GenerateStream", "lm.request",
+            "decode"} <= set(by_name)
+    assert by_name["rpc.GenerateStream"].attrs["tokens"] == 5
+    assert by_name["lm.request"].attrs["method"] == "GenerateStream"
