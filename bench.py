@@ -80,58 +80,47 @@ def bench_jax_cpu():
     return BATCH * SEQ / dt
 
 
-def _probe_once(deadline_s: float) -> bool:
-    """One subprocess probe of the default backend with a hard deadline.
-
-    Round-2 lesson (BENCH_r02.json, rc=1): a wedged TPU plugin hangs at
-    backend init inside the first device op — in-process there is nothing
-    to catch, the whole bench just never returns and the round records a
-    failure instead of a number. The subprocess probe turns "hangs
-    forever" into a detectable timeout so main() can fall back to the CPU
-    backend and still emit an honest JSON line (the metric name gains a
-    cpu_fallback marker so round-over-round comparisons never mix
-    substrates under one key)."""
-    import subprocess
-    import sys
-
-    code = ("import jax, jax.numpy as jnp; "
-            "x = jnp.ones((128,128)) @ jnp.ones((128,128)); "
-            "x.block_until_ready(); print(jax.default_backend())")
-    # Popen + wait(timeout), NOT subprocess.run: run() reaps the child
-    # after kill(), and a probe stuck in uninterruptible device I/O
-    # (D-state inside the wedged driver) cannot be killed until the
-    # syscall returns — run() would hang right here. On timeout we kill
-    # best-effort and move on without waiting for the reap.
-    proc = subprocess.Popen([sys.executable, "-c", code],
-                            stdout=subprocess.DEVNULL,
-                            stderr=subprocess.DEVNULL)
-    try:
-        return proc.wait(timeout=deadline_s) == 0
-    except subprocess.TimeoutExpired:
-        proc.kill()
-        return False
-
-
 def _backend_alive(deadlines_s=(90.0, 180.0, 300.0),
                    backoff_s: float = 30.0) -> bool:
-    """Bounded retry-with-backoff around the probe. Round-3 lesson
-    (BENCH_r03.json): a single probe attempt means one TRANSIENT backend
-    wedge (driver restart, tunnel blip) costs the whole round's TPU
-    headline. Deadlines ESCALATE so a slow-but-healthy cold init (plugin
-    bringup + first-op compile can take minutes) is never mistaken for a
-    wedge: the last attempt allows 300 s, beyond the longest healthy init
-    observed, while a genuinely dead chip still falls back to the honest
-    CPU row in ~11 min worst case."""
+    """Bounded retry-with-backoff around the subprocess device probe —
+    the probe itself is the serving watchdog's
+    (dnn_tpu/obs/watchdog.subprocess_device_probe): one definition of
+    "the chip answered" shared by the bench and the LM daemon's
+    /statusz. Round-2 lesson (BENCH_r02.json, rc=1): a wedged TPU plugin
+    hangs at backend init inside the first device op — in-process there
+    is nothing to catch; the subprocess turns "hangs forever" into a
+    detectable timeout. Round-3 lesson (BENCH_r03.json): a single
+    attempt means one TRANSIENT wedge (driver restart, tunnel blip)
+    costs the round's TPU headline. Deadlines ESCALATE so a
+    slow-but-healthy cold init (plugin bringup + first-op compile can
+    take minutes) is never mistaken for a wedge: the last attempt allows
+    300 s, beyond the longest healthy init observed, while a genuinely
+    dead chip still falls back to the honest CPU row in ~11 min worst
+    case. Every failed attempt lands in the flight ring and the
+    bench.probe_failures_total counter (machine-readable outcomes, not
+    free-text notes — the round driver reads them off the JSON row)."""
     import sys
+
+    from dnn_tpu import obs
+    from dnn_tpu.obs.watchdog import subprocess_device_probe
 
     n = len(deadlines_s)
     for i, deadline in enumerate(deadlines_s):
-        if _probe_once(deadline):
+        ok, detail, _timed_out = subprocess_device_probe(deadline)
+        if ok:
+            if i:  # recovered after failures: record the flap too
+                obs.flight.record("probe_recovered", attempt=i + 1)
             return True
+        m = obs.metrics()
+        if m is not None:
+            m.inc("bench.probe_failures_total")
+        obs.flight.record("probe_fail", attempt=i + 1, attempts=n,
+                          deadline_s=deadline, detail=detail)
         print(f"[bench] backend probe attempt {i + 1}/{n} failed "
-              f"({deadline:.0f}s deadline)", file=sys.stderr)
+              f"({detail})", file=sys.stderr)
         if i + 1 < n:
             time.sleep(backoff_s * (i + 1))
+    obs.flight.record("probe_exhausted", attempts=n)
     return False
 
 
@@ -160,14 +149,23 @@ def _last_good_tpu_reference(path=None):
     if not head or "tpu" not in head.group(3):
         return None  # no on-chip table to echo
     row = re.search(r"\| gpt2_fwd \| tokens_per_sec \| ([0-9.]+) \| "
-                    r"([0-9.]+%|—) \| tpu \|", text)
+                    r"([0-9.]+%|—) \| tpu \| ([^|\n]*)", text)
     if not row:
         return None
+    # a CARRIED row (off-chip refresh cycles re-stamp the table header
+    # with the refresh commit) names its own measurement vintage in a
+    # provenance= detail — that, not the header, is when this number
+    # was actually measured on chip
+    commit, date = head.group(1), head.group(2).strip()
+    carried = re.match(r"provenance=(\S+) ([^,]+)",
+                       row.group(3).strip())
+    if carried:
+        commit, date = carried.group(1), carried.group(2).strip()
     ref = {
         "metric": "gpt2_fwd_tokens_per_sec_per_chip",
         "value": float(row.group(1)),
-        "commit": head.group(1),
-        "date": head.group(2).strip(),
+        "commit": commit,
+        "date": date,
         "note": "last committed on-chip measurement (benchmarks/"
                 "RESULTS.md), NOT measured this run",
     }
@@ -327,12 +325,31 @@ def main():
     row["platform"] = jax.default_backend()
     if fell_back:
         row["note"] = "default backend unresponsive; CPU fallback"
+    from dnn_tpu import obs
+
     if on_cpu:
         # a CPU-substrate round still surfaces the last committed on-chip
         # headline (distinctly labeled) so no round ships perf-blind
         ref = _last_good_tpu_reference()
         if ref is not None:
             row["stale_tpu_reference"] = ref
+            m = obs.metrics()
+            if m is not None:
+                m.inc("bench.stale_tpu_reference_used_total")
+            obs.flight.record("stale_tpu_reference", commit=ref["commit"],
+                              date=ref["date"], value=ref["value"])
+    # the probe/echo outcomes as EVENTS on the row whatever substrate the
+    # round landed on — a TPU round that recovered after a transient
+    # probe failure must still ship the flap machine-readably (the
+    # free-text `note` stays for humans): the round driver can count
+    # probe_fail/probe_recovered/stale_tpu_reference without parsing prose
+    events = obs.flight.recorder().events()
+    outcomes = [e for e in events
+                if e["kind"] in ("probe_fail", "probe_exhausted",
+                                 "probe_recovered",
+                                 "stale_tpu_reference")]
+    if outcomes:
+        row["flight_events"] = outcomes
     print(json.dumps(row), flush=True)
     if not on_cpu:
         # headline is safely out; now spend the healthy chip on the full

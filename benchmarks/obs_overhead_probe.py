@@ -19,6 +19,11 @@ satellite: < 2% on a decode step). This probe measures it honestly:
   * the gate flips at RUNTIME (obs.set_enabled) — producers re-check
     per call, so an OFF step runs the identical code path with every
     metric/span site degraded to its one-None-check form;
+  * the obs v2 surface is in the loop too: a live watchdog heartbeat
+    (both populations — the worker beats regardless of the gate) and a
+    PER-STEP flight-recorder event (ON population only; production
+    records per admission/retirement, so this bounds the flight path
+    from above);
   * timed steps only ever advance a FULL pool: the pool refills
     (untimed) before a request's budget could retire it mid-sequence,
     and every step syncs on the committed tokens (step() pulls
@@ -93,10 +98,21 @@ def _drain_slots(srv, roots):
 
 def measure() -> dict:
     from dnn_tpu import obs
+    from dnn_tpu.obs.watchdog import Watchdog
 
     was = obs.enabled()
     srv = _build()
     obs.set_enabled(True)
+    # v2 surface rides along in the timed loop: a live watchdog (no
+    # device probe — its subprocess would inject real load; the
+    # per-step cost under test is the heartbeat) and a PER-STEP flight
+    # event (denser than production, which records per retirement /
+    # admission — so this bounds the flight path from above). The beat
+    # itself is untimed-gate-independent (the worker beats regardless
+    # of DNN_TPU_OBS) and runs in BOTH populations; flight.record
+    # self-gates, so its cost lands only in the ON population — exactly
+    # the marginal obs tax the contract bounds.
+    wd = Watchdog(period_s=5.0, device_probe=None).start()
     roots = _fill(srv, traced=True)
     left = srv.max_len - PROMPT - 2  # decode steps before any retire
     for _ in range(10):  # compile + absorb first-dispatch overheads
@@ -119,11 +135,14 @@ def measure() -> dict:
             on = i % 2 == 0
             obs.set_enabled(on)
             t0 = time.perf_counter()
+            wd.beat()
+            obs.flight.record("probe_step", i=i)
             srv.step()
             (on_t if on else off_t).append(time.perf_counter() - t0)
             left -= 1
     finally:
         obs.set_enabled(was)
+        wd.close()
     on_t.sort()
     off_t.sort()
     med_on = on_t[len(on_t) // 2]

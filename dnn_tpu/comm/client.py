@@ -202,6 +202,10 @@ class NodeClient:
                     if m is not None:
                         m.inc(labeled("comm.retries_total",
                                           target=self.address))
+                    obs.flight.record(
+                        "rpc_retry", target=self.address,
+                        code=str(code or type(e).__name__),
+                        attempt=attempt + 1, trace_id=sp.trace_id)
                     log.warning(
                         "send_tensor to %s failed (%s), retry %d/%d in "
                         "%.2fs [trace=%s]",

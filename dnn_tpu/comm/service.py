@@ -337,7 +337,10 @@ async def serve_stage(engine, node_id: str, *, port: Optional[int] = None,
     `metrics_port` (None = off, 0 = ephemeral) additionally serves the
     observability endpoint — GET /metrics (Prometheus text format:
     per-stage RPC latency, payload bytes, retry/deadline counters, XLA
-    compile telemetry), /trace (Chrome-trace JSON) — over stdlib HTTP."""
+    compile telemetry, device/host memory gauges), /trace (Chrome-trace
+    JSON), /debugz (flight ring), POST /profilez (on-demand device
+    profile; no auto-trigger — that needs the LM daemon's step loop) —
+    over stdlib HTTP."""
     obs.install_compile_telemetry()
     servicer = StageServer(engine, node_id)
     server = grpc.aio.server()
@@ -350,7 +353,7 @@ async def serve_stage(engine, node_id: str, *, port: Optional[int] = None,
         raise RuntimeError(f"failed to bind gRPC server to {listen}")
     metrics_srv = None
     if metrics_port is not None:
-        metrics_srv = obs.serve_metrics(port=metrics_port)
+        metrics_srv = obs.serve_metrics(metrics_port)
     log.info("gRPC stage server %s listening on %s (part %d)",
              node_id, listen, servicer.part_index)
     await server.start()

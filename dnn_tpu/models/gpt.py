@@ -117,15 +117,21 @@ def init(rng, cfg: GPTConfig = PRESETS["gpt2"], dtype=jnp.float32, tie_lm_head=T
 
 def _block_core(block_params, x, attn_fn, *, cfg: GPTConfig, compute_dtype=None):
     """Pre-LN transformer block with a pluggable attention implementation
-    (local causal MHA, Pallas flash, or sequence-parallel ring)."""
-    h = layer_norm(block_params["ln_1"], x, eps=cfg.ln_eps)
-    x = x + attn_fn(block_params["attn"], h)
-    h = layer_norm(block_params["ln_2"], x, eps=cfg.ln_eps)
-    m = linear(
-        block_params["mlp"]["proj"],
-        gelu(linear(block_params["mlp"]["fc"], h, compute_dtype=compute_dtype)),
-        compute_dtype=compute_dtype,
-    )
+    (local causal MHA, Pallas flash, or sequence-parallel ring).
+
+    The named_scopes are trace-time only (zero runtime cost post-compile):
+    they ride into XLA op metadata so device profiles (POST /profilez,
+    dnn_tpu/obs/profile.py) name attention vs MLP instead of fused-op soup."""
+    with jax.named_scope("gpt.block.attn"):
+        h = layer_norm(block_params["ln_1"], x, eps=cfg.ln_eps)
+        x = x + attn_fn(block_params["attn"], h)
+    with jax.named_scope("gpt.block.mlp"):
+        h = layer_norm(block_params["ln_2"], x, eps=cfg.ln_eps)
+        m = linear(
+            block_params["mlp"]["proj"],
+            gelu(linear(block_params["mlp"]["fc"], h, compute_dtype=compute_dtype)),
+            compute_dtype=compute_dtype,
+        )
     return x + m
 
 
@@ -200,7 +206,8 @@ def embed(params, idx, *, cfg: GPTConfig):
     if t > cfg.block_size:
         raise ValueError(f"Cannot forward: sequence length {t} > block_size {cfg.block_size}")
     pos = jnp.arange(t)
-    return embedding(params["wte"], idx) + embedding(params["wpe"], pos)
+    with jax.named_scope("gpt.embed"):
+        return embedding(params["wte"], idx) + embedding(params["wpe"], pos)
 
 
 def head(params, x, *, cfg: GPTConfig, compute_dtype=None, logits_dtype=None):
@@ -223,13 +230,14 @@ def head(params, x, *, cfg: GPTConfig, compute_dtype=None, logits_dtype=None):
     throughput on v5e (benchmarks/explore_fwd_perf.py). Accumulation is
     still f32; only the stored values are rounded. Default None keeps f32
     logits (the parity-test configuration)."""
-    x = layer_norm(params["ln_f"], x, eps=cfg.ln_eps)
-    if compute_dtype is None:
-        out = linear(params["lm_head"], x)
-    else:
-        out = linear(params["lm_head"], x, compute_dtype=compute_dtype,
-                     accum_dtype=jnp.float32)
-    return out if logits_dtype is None else out.astype(logits_dtype)
+    with jax.named_scope("gpt.head"):
+        x = layer_norm(params["ln_f"], x, eps=cfg.ln_eps)
+        if compute_dtype is None:
+            out = linear(params["lm_head"], x)
+        else:
+            out = linear(params["lm_head"], x, compute_dtype=compute_dtype,
+                         accum_dtype=jnp.float32)
+        return out if logits_dtype is None else out.astype(logits_dtype)
 
 
 def make_apply(cfg: GPTConfig, *, use_flash=False, compute_dtype=None, remat=False):

@@ -674,9 +674,14 @@ def block_apply(bp, x, *, cfg: LlamaConfig, compute_dtype=None, attn_fn=None,
     `ffn(bp, h)` overrides the MLP (Mixtral MoE)."""
     fn = attn_fn or (lambda bp2, h: _dense_attn(
         bp2, h, cfg=cfg, compute_dtype=compute_dtype, window=window))
-    h = _pre_normed(bp, x, cfg)
-    return _branches_residual(bp, x, fn(bp, h), h, cfg=cfg,
-                              compute_dtype=compute_dtype, ffn=ffn)
+    # trace-time scopes: device profiles (obs/profile.py) name the
+    # attention branch vs the residual/MLP compose; zero runtime cost
+    with jax.named_scope("llama.block.attn"):
+        h = _pre_normed(bp, x, cfg)
+        o = fn(bp, h)
+    with jax.named_scope("llama.block.mlp"):
+        return _branches_residual(bp, x, o, h, cfg=cfg,
+                                  compute_dtype=compute_dtype, ffn=ffn)
 
 
 def _scaled_embed(p, ids, cfg: LlamaConfig):
@@ -699,21 +704,23 @@ def embed(params, idx, *, cfg: LlamaConfig):
 
 
 def head(params, x, *, cfg: LlamaConfig, compute_dtype=None, logits_dtype=None):
-    x = _norm(params["ln_f"], x, cfg)
-    if "lm_head" in params:
-        lm = params["lm_head"]
-    else:
-        # tied embeddings (Gemma, LLaMA-3.2-1B class): project through the
-        # input table's transpose — XLA folds the transpose into the dot
-        lm = {"kernel": params["wte"]["embedding"].T}
-    if compute_dtype is None:
-        out = linear(lm, x)
-    else:
-        out = linear(lm, x, compute_dtype=compute_dtype,
-                     accum_dtype=jnp.float32)
-    if cfg.final_softcap is not None:  # Gemma-2 final_logit_softcapping
-        out = cfg.final_softcap * jnp.tanh(out / cfg.final_softcap)
-    return out if logits_dtype is None else out.astype(logits_dtype)
+    with jax.named_scope("llama.head"):
+        x = _norm(params["ln_f"], x, cfg)
+        if "lm_head" in params:
+            lm = params["lm_head"]
+        else:
+            # tied embeddings (Gemma, LLaMA-3.2-1B class): project through
+            # the input table's transpose — XLA folds the transpose into
+            # the dot
+            lm = {"kernel": params["wte"]["embedding"].T}
+        if compute_dtype is None:
+            out = linear(lm, x)
+        else:
+            out = linear(lm, x, compute_dtype=compute_dtype,
+                         accum_dtype=jnp.float32)
+        if cfg.final_softcap is not None:  # Gemma-2 final_logit_softcapping
+            out = cfg.final_softcap * jnp.tanh(out / cfg.final_softcap)
+        return out if logits_dtype is None else out.astype(logits_dtype)
 
 
 def blocks_scan(stacked, x, *, cfg, compute_dtype, remat=False, attn_fn=None,
@@ -815,29 +822,31 @@ def _block_with_cache(bp, x, layer_cache, start_pos, *, cfg: LlamaConfig,
     per-layer value — traced allowed)."""
     b, t, c = x.shape
     kv, g = cfg.n_kv_head, cfg.n_head // cfg.n_kv_head
-    h = _pre_normed(bp, x, cfg)
-    q, k, v = _qkv_rope(bp, h, start_pos + jnp.arange(t), cfg=cfg,
-                        compute_dtype=compute_dtype)
-    layer_cache = codec.write(layer_cache, k, v, start_pos)
-    qg = q.reshape(b, kv, g * t, cfg.head_dim)
-    if t == 1:
-        # decode step: the folded group rows all share the slot's limit —
-        # exactly attend_rows' contract, which streams through the Pallas
-        # decode kernel when the codec carries use_kernel
-        yg = codec.attend_rows(
-            qg, layer_cache,
-            jnp.broadcast_to(jnp.asarray(start_pos, jnp.int32), (b,)),
-            window=window)
-    else:
-        pos_limit = start_pos + jnp.arange(t)
-        yg = codec.attend(qg, layer_cache, jnp.tile(pos_limit, g),
-                          window=window)
-    y = yg.reshape(b, cfg.n_head, t, cfg.head_dim)
-    o = linear(bp["attn"]["o"], merge_heads(y.astype(x.dtype)),
-               compute_dtype=compute_dtype)
-    return (_branches_residual(bp, x, o, h, cfg=cfg,
-                               compute_dtype=compute_dtype, ffn=ffn),
-            layer_cache)
+    with jax.named_scope("llama.block.cached_attn"):
+        h = _pre_normed(bp, x, cfg)
+        q, k, v = _qkv_rope(bp, h, start_pos + jnp.arange(t), cfg=cfg,
+                            compute_dtype=compute_dtype)
+        layer_cache = codec.write(layer_cache, k, v, start_pos)
+        qg = q.reshape(b, kv, g * t, cfg.head_dim)
+        if t == 1:
+            # decode step: the folded group rows all share the slot's
+            # limit — exactly attend_rows' contract, which streams through
+            # the Pallas decode kernel when the codec carries use_kernel
+            yg = codec.attend_rows(
+                qg, layer_cache,
+                jnp.broadcast_to(jnp.asarray(start_pos, jnp.int32), (b,)),
+                window=window)
+        else:
+            pos_limit = start_pos + jnp.arange(t)
+            yg = codec.attend(qg, layer_cache, jnp.tile(pos_limit, g),
+                              window=window)
+        y = yg.reshape(b, cfg.n_head, t, cfg.head_dim)
+        o = linear(bp["attn"]["o"], merge_heads(y.astype(x.dtype)),
+                   compute_dtype=compute_dtype)
+    with jax.named_scope("llama.block.mlp"):
+        return (_branches_residual(bp, x, o, h, cfg=cfg,
+                                   compute_dtype=compute_dtype, ffn=ffn),
+                layer_cache)
 
 
 def init_cache(cfg: LlamaConfig, batch: int, max_len: int, dtype=jnp.float32):

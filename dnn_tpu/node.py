@@ -148,7 +148,17 @@ def build_parser() -> argparse.ArgumentParser:
                         "endpoint on this port over plain HTTP — GET "
                         "/metrics (Prometheus text format), /trace "
                         "(Chrome-trace JSON of recent request spans), "
-                        "/healthz (dnn_tpu/obs; 0 = ephemeral port)")
+                        "/debugz (flight-recorder ring), /statusz "
+                        "(watchdog per-component state), /healthz, POST "
+                        "/profilez?ms=N (on-demand jax.profiler capture) "
+                        "(dnn_tpu/obs; 0 = ephemeral port)")
+    p.add_argument("--watchdog_s", type=float, default=None, metavar="S",
+                   help="--serve_lm: run the hung-device watchdog with "
+                        "this probe period in seconds (subprocess-bounded "
+                        "device probe + decode heartbeat; /healthz "
+                        "degrades ok|degraded|wedged and /statusz carries "
+                        "detail — dnn_tpu/obs/watchdog.py). Off unless "
+                        "given")
     p.add_argument("--log_level", default="INFO")
     return p
 
@@ -292,6 +302,10 @@ def main(argv=None) -> int:
         log.error("--eos_id/--length_penalty apply to beam search only; "
                   "pass --beam K alongside --generate")
         return 1
+    if args.watchdog_s is not None and not args.serve_lm:
+        log.error("--watchdog_s applies to --serve_lm only (the watchdog "
+                  "monitors the LM daemon's decode loop)")
+        return 1
     if args.serve_adapter and not args.serve_lm:
         # per-request adapters exist only in the LM daemon's slot pool —
         # error rather than silently serving the base model
@@ -302,6 +316,16 @@ def main(argv=None) -> int:
             and not args.serve_lm:
         log.error("--min_p/--repetition_penalty apply to --serve_lm only")
         return 1
+
+    if args.serve or args.serve_lm:
+        # black box for the long-lived serving modes: an unhandled crash
+        # dumps the flight-recorder ring to $DNN_TPU_OBS_DIR before the
+        # process dies (dnn_tpu/obs/flight.py; idempotent with the
+        # LMServer's own install)
+        from dnn_tpu import obs
+
+        if obs.enabled():
+            obs.flight.install_crash_dump()
 
     if args.serve_lm:
         return _serve_lm(engine, args)
@@ -485,6 +509,7 @@ def _serve_lm(engine: PipelineEngine, args) -> int:
             compute_dtype=engine.compute_dtype, seed=args.seed, ffn=ffn,
             family=family, default_max_new=args.generate or 32,
             metrics_port=args.metrics_port,
+            watchdog=args.watchdog_s,
             tokenizer=tokenizer, prefix_cache=args.prefix_cache,
             paged_blocks=args.paged_blocks, block_len=args.block_len,
             decode_buckets=args.decode_buckets,

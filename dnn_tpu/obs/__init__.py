@@ -19,14 +19,31 @@ registry and one span collector:
     — the RUNTIME cross-check of the static recompile census (PRG004,
     dnn_tpu/analysis): a live recompile storm is a counter, not a stall.
 
+v2 adds the failure-facing layer on the same substrate:
+
+  * flight recorder (obs/flight.py): a bounded ring of structured
+    events (admissions, evictions, retries, deadline misses, compiles,
+    errors, watchdog firings) — dumped via GET /debugz, `python -m
+    dnn_tpu.obs flight`, and automatically on unhandled crash;
+  * on-demand device profiling (obs/profile.py): POST /profilez drives
+    a programmatic jax.profiler capture into a bounded spool, with an
+    arm-the-next-slow-step auto trigger; host annotations + model
+    named_scopes make the timelines name layers and stages;
+  * memory observability (obs/mem.py): per-device memory_stats, host
+    RSS, and pool watermark gauges through the same registry;
+  * hung-device watchdog (obs/watchdog.py): subprocess-bounded device
+    probes + decode heartbeat staleness -> ok|degraded|wedged on
+    /statusz, with /healthz degrading accordingly.
+
 Gate: DNN_TPU_OBS=off (or 0/false) disables everything — producers see
-`metrics()` return None and `start_span` return the free NULL_SPAN. The
-gate is re-checked per call, so benchmarks can flip it at runtime
-(`set_enabled`) to measure the instrumentation tax (benchmarks/
-obs_overhead_probe.py pins it < 2% of a decode step).
+`metrics()` return None, `start_span` return the free NULL_SPAN, and
+`flight.record` short-circuit on one boolean. The gate is re-checked
+per call, so benchmarks can flip it at runtime (`set_enabled`) to
+measure the instrumentation tax (benchmarks/obs_overhead_probe.py pins
+it < 2% of a decode step, flight + watchdog included).
 
 Import cost: this package imports stdlib + utils.metrics only; jax is
-touched lazily inside install_compile_telemetry().
+touched lazily inside install_compile_telemetry() and obs/profile.
 """
 
 from __future__ import annotations
@@ -51,12 +68,14 @@ from dnn_tpu.obs.trace import (  # noqa: F401 — the package's public API
     tag_request_id,
 )
 
+from dnn_tpu.obs import flight  # noqa: F401 — obs.flight.record(...)
+
 __all__ = [
     "enabled", "set_enabled", "metrics", "collector", "span",
     "start_span", "record_span", "current_span", "continue_or_start",
     "tag_request_id", "parse_wire_tag", "strip_wire_tag", "new_trace_id",
     "NULL_SPAN", "Span", "TraceCollector", "spans_to_chrome",
-    "install_compile_telemetry", "serve_metrics",
+    "install_compile_telemetry", "serve_metrics", "flight",
 ]
 
 _enabled = os.environ.get("DNN_TPU_OBS", "on").lower() not in (
@@ -103,11 +122,27 @@ def install_compile_telemetry() -> bool:
         return _compile_installed
 
 
-def serve_metrics(port: int = 0, host: str = "127.0.0.1"):
-    """Start the /metrics + /trace HTTP endpoint on a daemon thread;
-    returns the MetricsHTTPServer (`.port` for port=0 ephemeral binds,
+def serve_metrics(port: int = 0, host: str = "127.0.0.1", *,
+                  healthy=None, status=None, profiler=None):
+    """Start the observability HTTP endpoint on a daemon thread; returns
+    the MetricsHTTPServer (`.port` for port=0 ephemeral binds,
     `.close()` to stop; loopback by default — pass host="0.0.0.0" to
-    expose to a scrape fleet). See obs/http.py."""
+    expose to a scrape fleet). Serves the full surface — GET /metrics
+    /trace /debugz /statusz /healthz, POST /profilez — and installs the
+    device/host memory gauges (obs/mem.py; no-op with observability
+    off). This is THE construction path: LMServer and comm.serve_stage
+    both go through it, so the public helper cannot drift behind the
+    endpoints the real servers expose. `healthy`/`status` as on
+    MetricsHTTPServer; `profiler` defaults to a fresh
+    obs.profile.Profiler (pass one to enable auto-trigger arming, or
+    False to disable /profilez). See obs/http.py."""
     from dnn_tpu.obs.http import MetricsHTTPServer
+    from dnn_tpu.obs.mem import install_memory_gauges
 
-    return MetricsHTTPServer(port=port, host=host)
+    install_memory_gauges()
+    if profiler is None:
+        from dnn_tpu.obs.profile import Profiler
+
+        profiler = Profiler()
+    return MetricsHTTPServer(port=port, host=host, healthy=healthy,
+                             status=status, profiler=profiler or None)

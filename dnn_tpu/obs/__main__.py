@@ -1,4 +1,4 @@
-"""CLI: `python -m dnn_tpu.obs trace ...` — trace tooling.
+"""CLI: `python -m dnn_tpu.obs {trace,flight} ...` — obs tooling.
 
     python -m dnn_tpu.obs trace --selftest
         In-process smoke of the whole span pipeline (nested spans,
@@ -11,6 +11,15 @@
         Convert a JSONL span dump (the /trace.jsonl endpoint's format,
         or TraceCollector.dump_jsonl) into Chrome-trace JSON for
         Perfetto / chrome://tracing.
+
+    python -m dnn_tpu.obs flight --url http://host:port \
+        [--out ring.jsonl] [--kind KIND] [--trace ID] [--last N]
+        Fetch a running server's flight-recorder ring (GET /debugz,
+        obs/flight.py) and print or save it as JSONL.
+
+    python -m dnn_tpu.obs flight --selftest
+        In-process smoke of the flight ring (record/overflow/filters/
+        crash-dump schema); exit 0 on success.
 
 No jax import anywhere on these paths — the tooling works on any host.
 """
@@ -122,6 +131,52 @@ def _convert(jsonl_path: str, out_path: str, trace_id=None) -> int:
     return 0
 
 
+def _flight_selftest() -> int:
+    from dnn_tpu import obs
+    from dnn_tpu.obs.flight import FlightRecorder
+
+    obs.set_enabled(True)
+    fr = FlightRecorder(capacity=4)
+    for i in range(6):
+        fr.record("probe", i=i)
+    evs = fr.events()
+    assert len(evs) == 4, evs  # bounded: newest 4 survive
+    assert [e["i"] for e in evs] == [2, 3, 4, 5], evs
+    assert [e["seq"] for e in evs] == sorted(e["seq"] for e in evs)
+    fr.record("deadline_miss", trace_id="cafe", rid=7)
+    hit = fr.events(kind="deadline_miss")
+    assert len(hit) == 1 and hit[0]["trace_id"] == "cafe"
+    assert fr.events(trace_id="cafe") == hit
+    assert len(fr.events(last=2)) == 2
+    lines = [json.loads(ln) for ln in fr.jsonl().splitlines()]
+    for d in lines:
+        assert {"seq", "ts", "kind"} <= set(d), d
+    win = fr.window(hit[0]["ts"], before_s=60, after_s=1)
+    assert hit[0] in win and len(win) >= 2  # surrounding events ride along
+    print(f"flight selftest ok: {len(lines)} events, overflow/filters/"
+          "window/schema valid")
+    return 0
+
+
+def _flight_fetch(url: str, out=None, kind=None, trace=None,
+                  last=None) -> int:
+    from urllib.parse import urlencode
+    from urllib.request import urlopen
+
+    q = {k: v for k, v in
+         (("kind", kind), ("trace", trace), ("last", last))
+         if v is not None}
+    full = url.rstrip("/") + "/debugz" + ("?" + urlencode(q) if q else "")
+    body = urlopen(full, timeout=10).read().decode()
+    if out:
+        with open(out, "w") as f:
+            f.write(body)
+        print(f"wrote {out}: {len(body.splitlines())} events")
+    else:
+        sys.stdout.write(body)
+    return 0
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(prog="python -m dnn_tpu.obs")
     sub = ap.add_subparsers(dest="cmd", required=True)
@@ -132,6 +187,16 @@ def main(argv=None) -> int:
     tr.add_argument("--out", help="output Chrome-trace JSON path")
     tr.add_argument("--id", dest="trace_id", default=None,
                     help="restrict conversion to one trace id")
+    fl = sub.add_parser("flight", help="flight-recorder tooling")
+    fl.add_argument("--selftest", action="store_true",
+                    help="in-process flight-ring smoke; exit 0 on pass")
+    fl.add_argument("--url", help="obs endpoint base URL to fetch "
+                                  "/debugz from (http://host:port)")
+    fl.add_argument("--out", help="write the JSONL here instead of stdout")
+    fl.add_argument("--kind", default=None, help="filter by event kind")
+    fl.add_argument("--trace", default=None, help="filter by trace id")
+    fl.add_argument("--last", default=None, type=int,
+                    help="keep only the newest N events")
     args = ap.parse_args(argv)
 
     if args.cmd == "trace":
@@ -140,6 +205,13 @@ def main(argv=None) -> int:
         if args.jsonl and args.out:
             return _convert(args.jsonl, args.out, args.trace_id)
         ap.error("trace needs --selftest or --jsonl FILE --out FILE")
+    if args.cmd == "flight":
+        if args.selftest:
+            return _flight_selftest()
+        if args.url:
+            return _flight_fetch(args.url, args.out, args.kind,
+                                 args.trace, args.last)
+        ap.error("flight needs --selftest or --url URL")
     return 2
 
 

@@ -38,6 +38,12 @@ def _on_duration(name: str, dur: float, **kwargs):
         if name == _COMPILE_KEY:
             m.inc("jax_compilations_total")
             m.inc("jax_compile_seconds_total", dur)
+            # the flight ring keeps compiles next to the admissions/
+            # retirements they interleave with — a post-mortem dump shows
+            # "recompile right before the deadline miss" as adjacency
+            from dnn_tpu.obs import flight
+
+            flight.record("compile", seconds=round(dur, 4))
         elif name == _TRACE_KEY:
             m.inc("jax_trace_seconds_total", dur)
     except Exception:  # noqa: BLE001 — telemetry must never break compiles

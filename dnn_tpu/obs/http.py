@@ -1,19 +1,33 @@
-"""Stdlib-HTTP observability endpoint: /metrics, /trace, /healthz.
+"""Stdlib-HTTP observability endpoint: metrics, traces, flight, status,
+profiling.
 
 Attached to the LM daemon (runtime/lm_server.LMServer(metrics_port=...))
 and the stage servers (comm/service.serve_stage(metrics_port=...)) — a
 ThreadingHTTPServer on a daemon thread, zero dependencies, so any
 Prometheus scraper or a plain curl can watch the serving stack:
 
-    GET /metrics       Prometheus text format (utils.metrics
+    GET  /metrics      Prometheus text format (utils.metrics
                        render_prometheus over the shared registry)
-    GET /healthz       200 "ok" (liveness — an optional `healthy`
-                       callable downgrades to 503 when it returns False)
-    GET /trace         Chrome-trace JSON of collected spans; ?id=<trace>
+    GET  /healthz      liveness, now three-valued: 200 "ok" / 200
+                       "degraded" / 503 "wedged" from the watchdog
+                       (obs/watchdog.py) when one is attached; an
+                       optional `healthy` callable (worker thread
+                       liveness) downgrades to 503 "unhealthy"
+    GET  /statusz      watchdog state with per-component detail (JSON)
+    GET  /debugz       flight-recorder ring as JSONL (obs/flight.py);
+                       ?kind= ?trace= filter, ?last=N keeps newest N
+    GET  /trace        Chrome-trace JSON of collected spans; ?id=<trace>
                        filters to one request's tree (load the response
                        in Perfetto / chrome://tracing)
-    GET /trace.jsonl   the same spans as JSONL (one span per line)
-    GET /traces        the distinct trace ids currently in the ring
+    GET  /trace.jsonl  the same spans as JSONL (one span per line)
+    GET  /traces       the distinct trace ids currently in the ring
+    GET  /profilez     capture spool + auto-trigger arm state (JSON)
+    POST /profilez?ms=N            capture N ms of device+host profile
+                       into the bounded spool (obs/profile.py); returns
+                       the capture path + Perfetto-loadable trace files
+    POST /profilez?auto=1&threshold_ms=T[&ms=N]   arm the auto trigger:
+                       capture the next decode step after one exceeds
+                       T ms (LM daemon only); ?auto=0 disarms
 """
 
 from __future__ import annotations
@@ -29,24 +43,39 @@ log = logging.getLogger("dnn_tpu.obs")
 
 
 class MetricsHTTPServer:
-    """Serve the shared registry + span collector (or explicit ones) over
-    HTTP. port=0 binds an ephemeral port — read `.port` after init.
+    """Serve the shared registry + span collector + flight ring (or
+    explicit ones) over HTTP. port=0 binds an ephemeral port — read
+    `.port` after init.
 
-    Binds LOOPBACK by default: the endpoint is unauthenticated and
-    /trace exposes per-request timelines, so wider exposure (a scrape
-    fleet) is an explicit `host="0.0.0.0"` opt-in, not a default."""
+    Binds LOOPBACK by default: the endpoint is unauthenticated, /trace
+    and /debugz expose per-request timelines, and POST /profilez
+    triggers device work — so wider exposure (a scrape fleet) is an
+    explicit `host="0.0.0.0"` opt-in, not a default.
+
+    `status`: callable -> dict with at least {"state": "ok|degraded|
+    wedged"} (obs/watchdog.Watchdog.status), or None to fall back to
+    the worker-liveness shape built from `healthy`. `profiler`: an
+    obs/profile.Profiler. `flight`: a FlightRecorder (default: the
+    process-wide ring)."""
 
     def __init__(self, *, port: int = 0, host: str = "127.0.0.1",
                  registry=None, collector=None,
-                 healthy: Optional[Callable[[], bool]] = None):
+                 healthy: Optional[Callable[[], bool]] = None,
+                 status: Optional[Callable[[], dict]] = None,
+                 profiler=None, flight=None):
         from dnn_tpu import obs
+        from dnn_tpu.obs import flight as _flight
         from dnn_tpu.utils import metrics as _metrics
 
         self._registry = registry if registry is not None \
             else _metrics.default_metrics
         self._collector = collector if collector is not None \
             else obs.collector()
+        self._flight = flight if flight is not None \
+            else _flight.recorder()
         self._healthy = healthy
+        self._status = status
+        self._profiler = profiler
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -61,26 +90,70 @@ class MetricsHTTPServer:
                 self.end_headers()
                 self.wfile.write(data)
 
+            def _send_json(self, code: int, obj):
+                self._send(code, json.dumps(obj), "application/json")
+
+            def _statusz(self):
+                if outer._status is not None:
+                    s = outer._status()
+                    if s is not None:  # None = "no watchdog: fall back"
+                        return s
+                # no watchdog attached: report the one component every
+                # server has — its worker/liveness callable
+                ok = outer._healthy() if outer._healthy else True
+                return {"state": "ok" if ok else "wedged",
+                        "components": {"worker": {
+                            "state": "ok" if ok else "wedged",
+                            "detail": "serving worker thread liveness"}}}
+
+            def _healthz(self):
+                if outer._healthy is not None and not outer._healthy():
+                    self._send(503, "unhealthy\n",
+                               "text/plain; charset=utf-8")
+                    return
+                state = self._statusz()["state"]
+                self._send(503 if state == "wedged" else 200,
+                           state + "\n", "text/plain; charset=utf-8")
+
             def do_GET(self):
                 try:
                     url = urlparse(self.path)
+                    q = parse_qs(url.query)
                     if url.path == "/metrics":
                         self._send(200, _metrics.render_prometheus(
                             outer._registry),
                             "text/plain; version=0.0.4; charset=utf-8")
                     elif url.path == "/healthz":
-                        ok = outer._healthy() if outer._healthy else True
-                        self._send(200 if ok else 503,
-                                   "ok\n" if ok else "unhealthy\n",
-                                   "text/plain; charset=utf-8")
+                        self._healthz()
+                    elif url.path == "/statusz":
+                        self._send_json(200, self._statusz())
+                    elif url.path == "/debugz":
+                        filters = {}
+                        if "kind" in q:
+                            filters["kind"] = q["kind"][0]
+                        if "trace" in q:
+                            filters["trace_id"] = q["trace"][0]
+                        if "last" in q:
+                            try:
+                                filters["last"] = int(q["last"][0])
+                            except ValueError:
+                                self._send(400, "last must be an int\n",
+                                           "text/plain; charset=utf-8")
+                                return
+                        self._send(200, outer._flight.jsonl(**filters),
+                                   "application/jsonl")
+                    elif url.path == "/profilez":
+                        if outer._profiler is None:
+                            self._send(404, "no profiler attached\n",
+                                       "text/plain; charset=utf-8")
+                        else:
+                            self._send_json(200, outer._profiler.status())
                     elif url.path == "/trace":
-                        q = parse_qs(url.query)
                         tid = q.get("id", [None])[0]
                         self._send(200, json.dumps(
                             outer._collector.chrome_trace(tid)),
                             "application/json")
                     elif url.path == "/trace.jsonl":
-                        q = parse_qs(url.query)
                         tid = q.get("id", [None])[0]
                         self._send(200, outer._collector.jsonl(tid),
                                    "application/jsonl")
@@ -96,6 +169,61 @@ class MetricsHTTPServer:
                 except Exception:  # noqa: BLE001 — one bad request must
                     # not kill the observer thread
                     log.exception("metrics endpoint request failed")
+                    try:
+                        self._send(500, "internal error\n",
+                                   "text/plain; charset=utf-8")
+                    except Exception:  # noqa: BLE001
+                        pass
+
+            def do_POST(self):
+                try:
+                    url = urlparse(self.path)
+                    q = parse_qs(url.query)
+                    if url.path != "/profilez":
+                        self._send(404, "not found\n",
+                                   "text/plain; charset=utf-8")
+                        return
+                    if outer._profiler is None:
+                        self._send(404, "no profiler attached\n",
+                                   "text/plain; charset=utf-8")
+                        return
+                    from dnn_tpu.obs.profile import ProfilerBusy, trace_files
+
+                    if "auto" in q:
+                        arm = q["auto"][0] not in ("0", "false", "off")
+                        if not arm:
+                            outer._profiler.disarm()
+                            self._send_json(200, {"armed": None})
+                            return
+                        try:
+                            outer._profiler.arm_auto(
+                                float(q.get("threshold_ms", ["100"])[0]),
+                                float(q.get("ms", ["0"])[0]))
+                        except ValueError as e:
+                            self._send(400, str(e) + "\n",
+                                       "text/plain; charset=utf-8")
+                            return
+                        self._send_json(200, outer._profiler.status())
+                        return
+                    try:
+                        ms = float(q.get("ms", ["1000"])[0])
+                    except ValueError:
+                        self._send(400, "ms must be a number\n",
+                                   "text/plain; charset=utf-8")
+                        return
+                    try:
+                        path = outer._profiler.capture(ms)
+                    except ProfilerBusy as e:
+                        self._send(409, str(e) + "\n",
+                                   "text/plain; charset=utf-8")
+                        return
+                    self._send_json(200, {
+                        "capture": path, "ms": ms,
+                        "trace_files": trace_files(path)})
+                except BrokenPipeError:
+                    pass
+                except Exception:  # noqa: BLE001
+                    log.exception("profilez request failed")
                     try:
                         self._send(500, "internal error\n",
                                    "text/plain; charset=utf-8")

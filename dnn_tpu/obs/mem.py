@@ -1,0 +1,123 @@
+"""Memory observability: device HBM, host RSS, pool watermarks.
+
+Nothing in the tree accounted for memory before this module: an OOM-ing
+pool read as a mystery crash, and "how close is the KV pool to full"
+had no answer short of a debugger. Three layers, all exported through
+the shared registry as scrape-time CALLABLE gauges (stored gauges
+freeze on idle processes — the PR-3 lesson):
+
+  * per-device `memory_stats()` (`install_memory_gauges`):
+    dnn_tpu_device_bytes_in_use / _peak_bytes_in_use / _bytes_limit,
+    labeled {device=}. Platforms whose client exposes no memory_stats
+    (some CPU builds) simply register nothing — absence is the honest
+    signal. The device list is snapshotted ONCE at install time, after
+    the backend is already up: gauges must never be the thing that
+    first-touches (and possibly hangs on) a wedged backend at scrape
+    time;
+  * host RSS (`process_resident_bytes`): /proc-based with a getrusage
+    fallback — the host-side complement (tokenizer tables, numpy
+    staging, compile cache growth all land here);
+  * pool watermarks, registered by their owners against this module's
+    naming: the paged block pool's used/free/high-water
+    (runtime/paged_kvcache.BlockAllocator grows the accounting;
+    runtime/serving registers the gauges), and the dense pool's KV-slot
+    and active-slot high-waters (runtime/serving).
+
+Everything is a read-only callable evaluated under the registry lock at
+scrape; install is idempotent per registry.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+__all__ = ["rss_bytes", "install_memory_gauges"]
+
+
+def rss_bytes() -> float:
+    """Resident set of this process in bytes; 0.0 when unreadable (a
+    gauge must not raise into the scrape)."""
+    try:
+        with open("/proc/self/statm") as f:
+            pages = int(f.read().split()[1])
+        return float(pages * os.sysconf("SC_PAGE_SIZE"))
+    except (OSError, ValueError, IndexError):
+        pass
+    try:
+        import resource
+        import sys
+
+        ru = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+        # ru_maxrss is KB on Linux, bytes on macOS (peak, not current —
+        # the best a /proc-less host offers)
+        return float(ru if sys.platform == "darwin" else ru * 1024)
+    except Exception:  # noqa: BLE001
+        return 0.0
+
+
+def _device_gauge(dev, key: str):
+    def read() -> float:
+        try:
+            stats = dev.memory_stats()
+            return float(stats.get(key, 0) if stats else 0)
+        except Exception:  # noqa: BLE001 — a dying device must not
+            return 0.0     # break every scrape
+    return read
+
+
+_installed_registries: "set[int]" = set()
+
+
+def install_memory_gauges(registry=None) -> list:
+    """Register the device + host memory gauges on `registry` (default:
+    the shared obs registry). Returns the list of series registered.
+    Idempotent per registry object; safe to call from every server
+    constructor. Must be called AFTER the backend is initialized — it
+    touches jax.devices() exactly once, here, never at scrape time."""
+    from dnn_tpu import obs
+    from dnn_tpu.utils.metrics import labeled
+
+    if registry is None:
+        registry = obs.metrics()
+    if registry is None:  # observability off: nothing to install
+        return []
+    if id(registry) in _installed_registries:
+        return []
+    registered = []
+    registry.set_fn("process_resident_bytes", rss_bytes)
+    registered.append("process_resident_bytes")
+    try:
+        import jax
+
+        devices = list(jax.devices())
+    except Exception:  # noqa: BLE001 — no backend, no device gauges
+        devices = []
+    for dev in devices:
+        try:
+            stats = dev.memory_stats()
+        except Exception:  # noqa: BLE001
+            stats = None
+        if not stats:
+            continue  # platform exposes no memory accounting
+        label = f"{dev.platform}:{dev.id}"
+        for series, key in (
+                ("dnn_tpu_device_bytes_in_use", "bytes_in_use"),
+                ("dnn_tpu_device_peak_bytes_in_use", "peak_bytes_in_use"),
+                ("dnn_tpu_device_bytes_limit", "bytes_limit")):
+            if key not in stats:
+                continue
+            name = labeled(series, device=label)
+            registry.set_fn(name, _device_gauge(dev, key))
+            registered.append(name)
+    _installed_registries.add(id(registry))
+    return registered
+
+
+def reset_for_tests(registry=None):
+    """Forget the idempotence marker so a test can re-install against a
+    fresh registry object reusing a recycled id()."""
+    if registry is None:
+        _installed_registries.clear()
+    else:
+        _installed_registries.discard(id(registry))

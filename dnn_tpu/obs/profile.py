@@ -1,0 +1,327 @@
+"""On-demand device profiling: programmatic jax.profiler capture.
+
+The span collector (obs/trace.py) answers "where did this request's
+milliseconds go" at host granularity; this module answers the next
+question — "what was the DEVICE doing" — with a real `jax.profiler`
+capture (device + host timeline, Perfetto-loadable `*.trace.json.gz`
+under the capture dir) taken from a RUNNING server:
+
+  * `POST /profilez?ms=N` on the obs HTTP endpoint (obs/http.py)
+    captures N milliseconds into a bounded spool directory and returns
+    the capture path — no restart, no TensorBoard session;
+  * `POST /profilez?auto=1&threshold_ms=T[&ms=N]` ARMS the auto
+    trigger: the LM batcher worker captures the next decode step after
+    one exceeds T milliseconds (the p99-breach post-mortem: you never
+    have to be watching when the slow step happens);
+  * `annotation(name)` / `step_annotation(step)` are the obs-gated host
+    span annotations (jax.profiler.TraceAnnotation) that make captures
+    readable — the serving runtime wraps decode steps, prefill chunks
+    and relay stage hops in them, and the models thread
+    `jax.named_scope` through their blocks so TPU timelines name layers
+    too. utils/tracing.py re-exports these (its original span API
+    predates the obs gate and is deprecated).
+
+Capture locking: jax.profiler supports ONE trace at a time per process;
+concurrent `capture()` calls (two curls racing, or a curl racing the
+auto trigger) serialize on a module lock, with the loser failing fast
+(`ProfilerBusy`) rather than corrupting the winner's capture.
+
+The spool is bounded (default 8 captures): oldest captures are deleted
+as new ones land, so a long-lived daemon with a trigger-happy operator
+cannot fill the disk.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import glob
+import os
+import shutil
+import threading
+import time
+from typing import Iterator, Optional
+
+__all__ = ["ProfilerBusy", "capture", "capture_step", "spool_dir",
+           "list_captures", "annotation", "annotation_ctx",
+           "step_annotation", "Profiler"]
+
+
+class ProfilerBusy(RuntimeError):
+    """A capture is already in flight (jax.profiler is single-trace)."""
+
+
+_capture_lock = threading.Lock()
+
+
+def spool_dir() -> str:
+    """$DNN_TPU_OBS_DIR/profiles (obs/flight.default_dump_dir anchors
+    the shared obs artifact root)."""
+    from dnn_tpu.obs.flight import default_dump_dir
+
+    return os.path.join(default_dump_dir(), "profiles")
+
+
+def list_captures(root: Optional[str] = None) -> list:
+    """Capture dirs in the spool, oldest first."""
+    root = root or spool_dir()
+    if not os.path.isdir(root):
+        return []
+    out = [os.path.join(root, d) for d in os.listdir(root)
+           if d.startswith("capture-")]
+    return sorted(out)
+
+
+def _prune(root: str, keep: int):
+    for old in list_captures(root)[:-keep] if keep > 0 else []:
+        shutil.rmtree(old, ignore_errors=True)
+
+
+def trace_files(capture_dir: str) -> list:
+    """The Perfetto-loadable artifacts inside one capture dir."""
+    return sorted(glob.glob(os.path.join(
+        capture_dir, "plugins", "profile", "*", "*.trace.json.gz")))
+
+
+_capturing = False  # read by annotation_ctx: annotations only pay their
+# TraceAnnotation cost while a capture is actually recording
+
+
+def capturing() -> bool:
+    return _capturing
+
+
+@contextlib.contextmanager
+def mark_recording() -> Iterator[None]:
+    """Mark an EXTERNALLY-driven capture (bare jax.profiler.start_trace,
+    a TensorBoard attach) as recording so annotation_ctx emits during
+    it. obs-driven captures (_traced) set the flag themselves; this is
+    the compatibility hook utils/tracing.trace_to wraps its body in so
+    the legacy trace_to + span pattern still yields annotated captures."""
+    global _capturing
+    prev = _capturing
+    _capturing = True
+    try:
+        yield
+    finally:
+        _capturing = prev
+
+
+@contextlib.contextmanager
+def _traced(capture_root: Optional[str], keep: int) -> Iterator[str]:
+    """Exclusive start_trace/stop_trace around the body; yields the
+    capture dir. Raises ProfilerBusy instead of queueing — a capture
+    request against a busy profiler wants a fast 409, not a pile-up."""
+    global _capturing
+    import jax
+
+    if not _capture_lock.acquire(blocking=False):
+        raise ProfilerBusy("a profiler capture is already in flight")
+    try:
+        root = capture_root or spool_dir()
+        path = os.path.join(root, f"capture-{int(time.time() * 1e3):x}")
+        os.makedirs(path, exist_ok=True)
+        jax.profiler.start_trace(path)
+        _capturing = True
+        try:
+            yield path
+        finally:
+            _capturing = False
+            jax.profiler.stop_trace()
+            try:
+                keep_n = int(os.environ["DNN_TPU_OBS_PROFILE_KEEP"])
+            except (KeyError, ValueError):
+                keep_n = keep
+            _prune(root, keep_n)
+    finally:
+        _capture_lock.release()
+
+
+def capture(duration_ms: float = 1000.0, *,
+            capture_root: Optional[str] = None, keep: int = 8) -> str:
+    """Capture `duration_ms` of whatever the process is doing (the
+    serving worker keeps stepping; this thread just sleeps inside the
+    trace). Returns the capture dir; flight-records the capture."""
+    from dnn_tpu.obs import flight
+
+    with _traced(capture_root, keep) as path:
+        time.sleep(max(0.0, float(duration_ms)) / 1e3)
+    flight.record("profile_capture", path=path, ms=float(duration_ms))
+    return path
+
+
+def capture_step(fn, *, capture_root: Optional[str] = None,
+                 keep: int = 8, extra_s: float = 0.0):
+    """Capture exactly one call of `fn` (the auto-trigger's "next decode
+    step") instead of a wall-clock window; `extra_s` extends the trace
+    past the call. Returns (capture_dir, fn's result).
+
+    NOTE the capture wall time is dominated by profiler init + trace
+    EXPORT (stop_trace writes the json.gz + xplane.pb — measured ~10 s
+    for a first capture on this host), during which the calling thread
+    (the batcher worker, for the auto trigger) is stalled: requests
+    queue behind an auto capture. That is the accepted cost of an
+    operator-armed post-mortem, not a steady-state tax.
+
+    Failure contract: ProfilerBusy and `fn`'s OWN exceptions propagate
+    (the caller decides what a failed step means — for the batcher
+    worker it is fatal). Any OTHER profiler-machinery failure — a trace
+    conflict with a bare jax.profiler.start_trace, an unwritable spool,
+    an export error inside stop_trace — must never cost the step: the
+    step runs uninstrumented (setup failure) or its already-computed
+    result is returned (export failure), with (None, result) and a
+    `profile_capture_failed` flight event recording the miss. An armed
+    auto-capture is an observer; it is not allowed to kill the serving
+    loop it observes."""
+    from dnn_tpu.obs import flight
+
+    t0 = time.perf_counter()
+    ran, out, step_err, step_ms, path = False, None, None, None, None
+    try:
+        with _traced(capture_root, keep) as path:
+            t1 = time.perf_counter()
+            try:
+                out = fn()
+                ran = True
+            except Exception as e:
+                step_err = e
+                raise
+            step_ms = round((time.perf_counter() - t1) * 1e3, 3)
+            if extra_s > 0:
+                time.sleep(extra_s)
+    except ProfilerBusy:
+        raise
+    except Exception as e:
+        if step_err is not None:
+            raise  # the step's own failure is the caller's business
+        flight.record("profile_capture_failed", error=str(e)[:200])
+        if not ran:
+            out = fn()
+        return None, out
+    flight.record("profile_capture", path=path, trigger="auto",
+                  step_ms=step_ms,
+                  capture_ms=round((time.perf_counter() - t0) * 1e3, 3))
+    return path, out
+
+
+# ----------------------------------------------------------------------
+# host annotations (the obs-gated successor of utils/tracing.span)
+# ----------------------------------------------------------------------
+
+_NULL_CTX = contextlib.nullcontext()
+_trace_annotation = False  # unresolved; None = profiler unavailable
+
+
+def annotation_ctx(name: str):
+    """HOT-PATH form: returns a jax.profiler.TraceAnnotation (obs on AND
+    an obs-driven capture recording) or a shared nullcontext — a plain
+    call + two checks, no generator. Two measured costs forced this
+    shape: the @contextmanager `annotation` below costs ~30 µs around a
+    jit dispatch (generator machinery + per-call imports), and even a
+    bare TraceAnnotation costs ~6 µs there — both real money against a
+    ms-scale decode step, paid EVERY step for annotations nobody is
+    recording. Gating on `capturing()` (set by _traced during POST
+    /profilez and the auto-trigger) makes the steady state ~0.3 µs; a
+    capture driven outside obs.profile (bare jax.profiler.start_trace)
+    won't see these annotations unless it wraps its body in
+    `mark_recording` (utils/tracing.trace_to does) — prefer
+    obs.profile.capture. The
+    TraceAnnotation class is resolved once, lazily — importing this
+    module still never touches jax."""
+    global _trace_annotation
+    from dnn_tpu import obs
+
+    if not _capturing or not obs.enabled():
+        return _NULL_CTX
+    if _trace_annotation is False:
+        try:
+            from jax.profiler import TraceAnnotation
+
+            _trace_annotation = TraceAnnotation
+        except Exception:  # pragma: no cover - profiler unavailable
+            _trace_annotation = None
+    if _trace_annotation is None:
+        return _NULL_CTX
+    return _trace_annotation(name)
+
+
+@contextlib.contextmanager
+def annotation(name: str) -> Iterator[None]:
+    """Named host-side span, visible in captured profiles. Degrades to
+    nothing when observability is off or the profiler is unavailable —
+    library code annotates unconditionally. Convenient for ms-scale
+    paths (relay stage hops, prefill chunks); per-decode-step code uses
+    `annotation_ctx`."""
+    with annotation_ctx(name):
+        yield
+
+
+@contextlib.contextmanager
+def step_annotation(step: int, name: str = "step") -> Iterator[None]:
+    """Mark one pipeline/training step; XLA profilers group device ops
+    under it. Obs-gated like `annotation`."""
+    from dnn_tpu import obs
+
+    if not obs.enabled():
+        yield
+        return
+    try:
+        import jax
+
+        ctx = jax.profiler.StepTraceAnnotation(name, step_num=step)
+    except Exception:  # pragma: no cover
+        ctx = contextlib.nullcontext()
+    with ctx:
+        yield
+
+
+# ----------------------------------------------------------------------
+# server-side handle (what obs/http.py drives)
+# ----------------------------------------------------------------------
+
+class Profiler:
+    """The /profilez backend: on-demand capture plus (optionally) the
+    auto-trigger arm. `arm_target` is any object with a writable
+    `auto_profile` attribute — the LM batcher worker reads it once per
+    step (one None check) and, when armed, captures the step after the
+    first one that exceeds the threshold."""
+
+    def __init__(self, *, capture_root: Optional[str] = None,
+                 arm_target=None, keep: int = 8):
+        self.capture_root = capture_root or spool_dir()
+        self.keep = keep
+        self._arm_target = arm_target
+
+    def capture(self, duration_ms: float) -> str:
+        return capture(duration_ms, capture_root=self.capture_root,
+                       keep=self.keep)
+
+    @property
+    def can_arm(self) -> bool:
+        return self._arm_target is not None
+
+    def arm_auto(self, threshold_ms: float, duration_ms: float = 0.0):
+        """Arm the next-slow-step auto capture. duration_ms > 0 extends
+        the capture past the triggering step by that wall window (0 =
+        exactly one step)."""
+        if self._arm_target is None:
+            raise ValueError("this endpoint has no step loop to arm "
+                             "(stage servers capture on demand only)")
+        self._arm_target.auto_profile = {
+            "threshold_s": float(threshold_ms) / 1e3,
+            "extra_s": max(0.0, float(duration_ms)) / 1e3,
+            "capture_root": self.capture_root, "keep": self.keep,
+        }
+
+    def disarm(self):
+        if self._arm_target is not None:
+            self._arm_target.auto_profile = None
+
+    def status(self) -> dict:
+        armed = getattr(self._arm_target, "auto_profile", None) \
+            if self._arm_target is not None else None
+        return {
+            "captures": list_captures(self.capture_root),
+            "armed": None if armed is None else {
+                "threshold_ms": armed["threshold_s"] * 1e3,
+                "extra_ms": armed["extra_s"] * 1e3},
+        }

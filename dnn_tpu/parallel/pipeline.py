@@ -46,6 +46,7 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from dnn_tpu.obs.profile import annotation_ctx as _prof_annotation
 from dnn_tpu.parallel.mesh import STAGE_AXIS
 
 
@@ -98,8 +99,16 @@ class RelayExecutor:
 
     def __call__(self, x, *, record_timings: bool = False):
         if not record_timings:
-            for fn, params, dev in zip(self.stage_fns, self.stage_params, self.devices):
-                x = fn(params, jax.device_put(x, dev))
+            for i, (fn, params, dev) in enumerate(
+                    zip(self.stage_fns, self.stage_params, self.devices)):
+                # host annotation per stage hop: a profiler capture
+                # (POST /profilez, obs/profile.py) names each relay stage
+                # on the host track. annotation_ctx, not the generator
+                # `annotation` form — this runs once per hop per decode
+                # step, where the generator shape costs ~30 µs/call even
+                # with nothing recording (STUDIES.md §9)
+                with _prof_annotation(f"relay.stage{i}"):
+                    x = fn(params, jax.device_put(x, dev))
             self.last_stage_times = None
             return x
 
@@ -455,13 +464,16 @@ def spmd_pipeline(
         is_last = i == num_stages - 1
 
         def branch(params_vec, buf):
-            sp = _unpack_stage(params_vec, metas[i]) if sharded else stage_params[i]
-            xin = _unpad(buf, (mb, *in_s[1:]) if len(in_s) > 0 else (mb,), in_dt, buf_dtype)
-            y = fn(sp, xin)
-            if is_last:
-                return (jnp.zeros((mb, width_hop), buf_dtype),
-                        _pad_flat(y, width_out, out_dtype))
-            return _pad_flat(y, width_hop, buf_dtype), jnp.zeros((mb, width_out), out_dtype)
+            # trace-time scope: device timelines (obs/profile.py) name
+            # each pipeline stage's ops instead of one fused switch blob
+            with jax.named_scope(f"pipeline.stage{i}"):
+                sp = _unpack_stage(params_vec, metas[i]) if sharded else stage_params[i]
+                xin = _unpad(buf, (mb, *in_s[1:]) if len(in_s) > 0 else (mb,), in_dt, buf_dtype)
+                y = fn(sp, xin)
+                if is_last:
+                    return (jnp.zeros((mb, width_hop), buf_dtype),
+                            _pad_flat(y, width_out, out_dtype))
+                return _pad_flat(y, width_hop, buf_dtype), jnp.zeros((mb, width_out), out_dtype)
 
         return branch
 

@@ -202,12 +202,16 @@ def make_bucketed_generate(cfg, *, max_len: int, max_new_tokens: int,
     @functools.partial(jax.jit, donate_argnums=(1,))
     def _step(prepared, cache, tok, pos, rng):
         # one compiled program PER BUCKET (cache shape); `pos` is a
-        # traced scalar, so every step of a bucket shares its program
-        logits, cache = _forward(prepared, tok[:, None], cache, pos)
-        rng, sub = jax.random.split(rng)
-        nxt = _sample(logits[:, -1], sub, temperature=temperature,
-                      top_k=top_k, top_p=top_p, min_p=min_p)
-        return cache, nxt, rng
+        # traced scalar, so every step of a bucket shares its program.
+        # The named_scope is trace-time only: device profiles name each
+        # bucket's step program (obs/profile.py)
+        bucket = jax.tree.leaves(cache)[0].shape[_POS_AXIS]
+        with jax.named_scope(f"decode_buckets.step_b{bucket}"):
+            logits, cache = _forward(prepared, tok[:, None], cache, pos)
+            rng, sub = jax.random.split(rng)
+            nxt = _sample(logits[:, -1], sub, temperature=temperature,
+                          top_k=top_k, top_p=top_p, min_p=min_p)
+            return cache, nxt, rng
 
     # no donation: a pad's output never fits the input buffer, and the
     # unusable-donation warning would fire on every bucket crossing

@@ -112,6 +112,17 @@ def parse_gen_options(request_id: str, default_max_new: int):
     return max_new, seed, opts
 
 
+def _fail_future(fut, exc):
+    """set_exception tolerant of a future the caller already abandoned
+    (cancelled via asyncio.wait_for on its deadline) — InvalidStateError
+    out of a cleanup path must never kill the worker."""
+    try:
+        if not fut.done():
+            fut.set_exception(exc)
+    except Exception:  # noqa: BLE001 — done()/set race with a cancel
+        pass
+
+
 class _QueuedRequest(NamedTuple):
     """One request waiting for the batcher worker — named fields so the
     submit/admit/hold/drain sites stay self-describing (the tuple form
@@ -150,6 +161,20 @@ class _BatcherWorker(threading.Thread):
         self.q: "queue.Queue" = queue.Queue()
         self._stop_evt = threading.Event()
         self._abandon = False
+        # watchdog heartbeat (obs/watchdog.py): LMServer points this at
+        # Watchdog.beat — one None check per loop iteration when off.
+        # step_done -> Watchdog.step_done: until the first completed
+        # step, a stale heartbeat is first-compile warmup, not a wedge
+        self.heartbeat = None
+        self.step_done = None
+        # auto-profile arm (obs/profile.py, POST /profilez?auto=1): when
+        # set, the loop times each step and captures the one AFTER the
+        # first that exceeds the threshold; one None check per step when
+        # disarmed
+        self.auto_profile = None
+        self._profile_hit = False
+        self._held_logged = None  # last item whose hold hit the flight
+        # ring — identity-gates the per-retry held_back event
         # _lock serializes submit against the dead-marking in _fail_all /
         # abandon: without it a future enqueued between the worker's final
         # queue drain and thread exit would never resolve (the caller
@@ -238,11 +263,28 @@ class _BatcherWorker(threading.Thread):
                                       seed=item.seed, trace=item.trace,
                                       **(item.opts or {}))
         except InsufficientBlocks:
+            # flight: submit() already recorded pool_exhausted (once per
+            # episode); this is the queueing front's held-back decision —
+            # recorded once per ITEM, not once per retry (the run loop
+            # re-submits the held item every decode step, which at ms
+            # cadence would flood the ring during a long shortage)
+            if item is not self._held_logged:
+                obs.flight.record("held_back", queue_depth=self.q.qsize())
+                self._held_logged = item
             self._held = item
             return False
         except Exception as e:  # noqa: BLE001 — validation errors belong to
-            item.fut.set_exception(e)  # the submitting request, not the loop
+            obs.flight.record("admit_rejected", error=str(e)[:200])
+            # the submitting request, not the loop — and guarded: the
+            # caller may have deadline-cancelled this future while it
+            # queued, and an InvalidStateError here would kill the worker
+            _fail_future(item.fut, e)
             return True
+        obs.flight.record(
+            "admit", rid=rid, queue_wait_ms=round(wait * 1e3, 3),
+            prompt_len=int(np.asarray(item.prompt).size),
+            max_new=item.max_new,
+            trace_id=item.trace.trace_id if item.trace else None)
         m = obs.metrics()
         if m is not None:
             m.observe("serving.queue_wait_seconds", wait)
@@ -296,7 +338,15 @@ class _BatcherWorker(threading.Thread):
             # bookkeeping — results, finish reason, logprobs — so a
             # long-lived daemon's dicts don't grow without bound
             tokens, _reason, _lps = b.claim(rid)
-            self._futures.pop(rid)["fut"].set_result(tokens)
+            fut = self._futures.pop(rid)["fut"]
+            try:
+                fut.set_result(tokens)
+            except Exception:  # noqa: BLE001 — the caller abandoned the
+                # future (a unary deadline abort cancels it through
+                # asyncio.wait_for -> wrap_future); publishing to a
+                # cancelled future raises InvalidStateError and used to
+                # KILL the worker thread — the result is simply dropped
+                pass
 
     def _shutdown_drain_queue(self):
         """Final drain-path exit step, under _lock: mark dead and fail any
@@ -310,10 +360,10 @@ class _BatcherWorker(threading.Thread):
                 self._dead = RuntimeError("LM server shutting down")
             if self._held is not None:
                 held, self._held = self._held, None
-                held.fut.set_exception(self._dead)
+                _fail_future(held.fut, self._dead)
             while True:
                 try:
-                    self.q.get_nowait().fut.set_exception(self._dead)
+                    _fail_future(self.q.get_nowait().fut, self._dead)
                 except queue.Empty:
                     return
 
@@ -321,22 +371,52 @@ class _BatcherWorker(threading.Thread):
         with self._lock:
             self._dead = exc  # submits from here on fail immediately
             for rec in self._futures.values():
-                if not rec["fut"].done():
-                    rec["fut"].set_exception(exc)
+                _fail_future(rec["fut"], exc)
             self._futures.clear()
             if self._held is not None:
                 held, self._held = self._held, None
-                if not held.fut.done():
-                    held.fut.set_exception(exc)
+                _fail_future(held.fut, exc)
             while True:
                 try:
-                    self.q.get_nowait().fut.set_exception(exc)
+                    _fail_future(self.q.get_nowait().fut, exc)
                 except queue.Empty:
                     return
+
+    def _step_pool(self, b):
+        """One pool step, with the auto-profile arm folded in: disarmed
+        (the steady state) this is one None check around b.step().
+        Armed, each step is timed; the step AFTER the first breach runs
+        inside a jax.profiler capture (obs/profile.py) and disarms."""
+        ap = self.auto_profile
+        if ap is None:
+            self._profile_hit = False
+            return b.step()
+        if self._profile_hit:
+            from dnn_tpu.obs.profile import ProfilerBusy, capture_step
+
+            self.auto_profile = None
+            self._profile_hit = False
+            try:
+                path, stepped = capture_step(
+                    b.step, capture_root=ap.get("capture_root"),
+                    keep=ap.get("keep", 8), extra_s=ap.get("extra_s", 0.0))
+                log.info("auto-profile captured slow-step follow-up to %s",
+                         path)
+                return stepped
+            except ProfilerBusy:
+                return b.step()
+        t0 = time.perf_counter()
+        stepped = b.step()
+        if time.perf_counter() - t0 > ap["threshold_s"]:
+            self._profile_hit = True
+        return stepped
 
     def run(self):
         b = self.batcher
         while True:
+            hb = self.heartbeat
+            if hb is not None:
+                hb()
             if self._abandon:
                 with self._lock:
                     for rec in self._futures.values():
@@ -381,16 +461,21 @@ class _BatcherWorker(threading.Thread):
                         break
                 except queue.Empty:
                     break
+            had_active = bool(b.n_active)
             try:
-                stepped = b.step() if b.n_active else {}
+                stepped = self._step_pool(b) if had_active else {}
             except Exception as e:  # noqa: BLE001 — one device-side error
                 # must not leave callers hanging for request_timeout: fail
                 # every pending future fast and die visibly (HealthCheck
                 # reports not-alive; SendTensor aborts UNAVAILABLE)
                 log.exception("batcher worker died; failing %d pending "
                               "requests", len(self._futures))
+                obs.flight.record("worker_died", error=str(e)[:500],
+                                  pending=len(self._futures))
                 self._fail_all(RuntimeError(f"LM batcher worker died: {e}"))
                 return
+            if had_active and (sd := self.step_done) is not None:
+                sd()  # a real step completed: the watchdog is warmed
             for rid, tok in stepped.items():  # streaming: tokens as they
                 # commit, before done-publish; the speculative batcher
                 # commits a LIST of tokens per step (serving_spec.py)
@@ -422,30 +507,52 @@ class LMServer:
     Observability (dnn_tpu/obs): every request gets a span tree (queue
     wait, admit, prefill, per-bucket decode; trace id continued from a
     client's `tr=` request_id tag), the pool exports TTFT / inter-token
-    / occupancy / queue-depth metrics, and a jax.monitoring listener
-    counts XLA compiles. `metrics_port` (None = no endpoint; 0 =
-    ephemeral) serves it all over stdlib HTTP: GET /metrics (Prometheus
-    text), /trace (Chrome-trace JSON, ?id= for one request), /healthz."""
+    / occupancy / queue-depth / memory-watermark metrics, a
+    jax.monitoring listener counts XLA compiles, and serving events
+    (admissions, deadline misses, worker death) feed the flight
+    recorder — dumped automatically on unhandled crash. `metrics_port`
+    (None = no endpoint; 0 = ephemeral) serves it all over stdlib HTTP:
+    GET /metrics (Prometheus text), /trace (Chrome-trace JSON, ?id= for
+    one request), /debugz (flight ring), /statusz (watchdog detail),
+    /healthz, POST /profilez (on-demand jax.profiler capture, ?auto=1
+    arms capture-the-next-slow-step). `watchdog` (None/False = off;
+    True or a period in seconds, or a prebuilt obs.watchdog.Watchdog)
+    runs the hung-device watchdog: subprocess-bounded device probes plus
+    this worker's loop heartbeat decide ok|degraded|wedged."""
 
     def __init__(self, cfg, prepared, *, default_max_new: int = 32,
                  request_timeout: float = 120.0, tokenizer=None,
                  draft_cfg=None, draft_prepared=None, spec_k: int = 4,
                  compile_cache_budget: int = 512,
                  metrics_port: Optional[int] = None,
+                 watchdog=None,
                  **batcher_kwargs):
         # observability first: the compile listener must be live before
         # the batcher's first program compiles, so jax_compilations_total
         # counts the daemon's own warmup too (dnn_tpu/obs)
         obs.install_compile_telemetry()
-        self.metrics_server = None
-        if metrics_port is not None:
-            from dnn_tpu.obs.http import MetricsHTTPServer
+        if obs.enabled():
+            # black box: an unhandled crash anywhere in this process
+            # dumps the flight ring (obs/flight.py) — the daemon is the
+            # thing whose post-mortems matter
+            obs.flight.install_crash_dump()
+            from dnn_tpu.obs.mem import install_memory_gauges
 
-            # /metrics + /trace endpoint; /healthz mirrors HealthCheck
-            self.metrics_server = MetricsHTTPServer(
-                port=metrics_port,
+            install_memory_gauges()
+        self.metrics_server = None
+        self._watchdog = None
+        if metrics_port is not None:
+            from dnn_tpu.obs.profile import Profiler
+
+            # /metrics /trace /debugz /statusz /profilez endpoint;
+            # /healthz mirrors HealthCheck, then degrades through the
+            # watchdog's ok|degraded|wedged when one is attached
+            self.metrics_server = obs.serve_metrics(
+                metrics_port,
                 healthy=lambda: (w := getattr(self, "worker", None))
-                is not None and w.is_alive())
+                is not None and w.is_alive(),
+                status=self._statusz,
+                profiler=Profiler(arm_target=self))
         try:
             self._init_rest(
                 cfg, prepared, default_max_new=default_max_new,
@@ -460,6 +567,59 @@ class LMServer:
                 self.metrics_server.close()
                 self.metrics_server = None
             raise
+        if watchdog:
+            # hung-device watchdog (obs/watchdog.py): `watchdog` is True
+            # (defaults), a float (period seconds), or a prebuilt
+            # Watchdog (tests inject stubbed probes). Wired to the
+            # worker's loop heartbeat + thread liveness, started here —
+            # after _init_rest, so the worker exists to monitor.
+            from dnn_tpu.obs.watchdog import Watchdog
+
+            if isinstance(watchdog, Watchdog):
+                self._watchdog = watchdog
+            else:
+                import functools
+
+                import jax
+
+                from dnn_tpu.obs.watchdog import subprocess_device_probe
+
+                period = 30.0 if watchdog is True else float(watchdog)
+                self._watchdog = Watchdog(
+                    period_s=period,
+                    # floor 6 s: the probe child pays ~4 s of import
+                    # before its first device op — a shorter deadline
+                    # reads a healthy backend as wedged
+                    probe_deadline_s=min(10.0, max(6.0, period / 3)),
+                    # pin the probe to THIS server's backend: a
+                    # cpu-substrate daemon must not answer "is the TPU
+                    # alive" (nor queue behind a chip it never uses)
+                    device_probe=functools.partial(
+                        subprocess_device_probe,
+                        platform=jax.default_backend()))
+            if self._watchdog.alive_check is None:
+                self._watchdog.alive_check = self.worker.is_alive
+            self.worker.heartbeat = self._watchdog.beat
+            self.worker.step_done = self._watchdog.step_done
+            if not self._watchdog._thread.is_alive():
+                self._watchdog.start()
+
+    @property
+    def auto_profile(self):
+        """POST /profilez?auto=1 arm state — delegates to the batcher
+        worker (the thread that times and captures steps)."""
+        return self.worker.auto_profile
+
+    @auto_profile.setter
+    def auto_profile(self, value):
+        self.worker.auto_profile = value
+
+    def _statusz(self):
+        """The /statusz payload: watchdog state when one runs, else None
+        — the HTTP handler then falls back to its worker-liveness shape
+        (one fallback, not two drifting copies; obs/http.py)."""
+        return self._watchdog.status() if self._watchdog is not None \
+            else None
 
     def _init_rest(self, cfg, prepared, *, default_max_new,
                    request_timeout, tokenizer, draft_cfg, draft_prepared,
@@ -620,17 +780,29 @@ class LMServer:
                                                         context)
             root.set(max_new=max_new,
                      prompt_len=int(np.asarray(ids).size))
+            # cancel_evt: a deadline abort must also retire the slot at
+            # the next step boundary — without it the pool decodes on to
+            # the abandoned request's full token budget
+            cancel_evt = threading.Event()
             fut = self.worker.submit(
                 np.asarray(ids, np.int32).reshape(-1), max_new, seed,
-                opts=opts, trace=root)
+                opts=opts, trace=root, cancel_evt=cancel_evt)
             try:
                 await asyncio.wait_for(
                     asyncio.wrap_future(fut),
                     timeout=self.request_timeout)
             except asyncio.TimeoutError:
+                cancel_evt.set()
                 m = obs.metrics()
                 if m is not None:
                     m.inc("serving.deadline_exceeded_total")
+                # the post-mortem record: the dump (/debugz) carries this
+                # event plus whatever surrounded it (admissions, compiles,
+                # watchdog state flips) — the window a stall hides in
+                obs.flight.record(
+                    "deadline_miss", method="SendTensor",
+                    timeout_s=self.request_timeout,
+                    trace_id=root.trace_id if root else None)
                 await context.abort(
                     grpc.StatusCode.DEADLINE_EXCEEDED,
                     f"generation exceeded {self.request_timeout}s")
@@ -802,6 +974,10 @@ class LMServer:
                     m = obs.metrics()
                     if m is not None:
                         m.inc("serving.deadline_exceeded_total")
+                    obs.flight.record(
+                        "deadline_miss", method="GenerateStream",
+                        timeout_s=self.request_timeout, tokens=n,
+                        trace_id=root.trace_id if root else None)
                     await context.abort(
                         grpc.StatusCode.DEADLINE_EXCEEDED,
                         f"generation exceeded {self.request_timeout}s")
@@ -866,6 +1042,9 @@ class LMServer:
     def close(self):
         self.worker.stop(drain=False)
         self.worker.join(timeout=10)
+        if self._watchdog is not None:
+            self._watchdog.close()
+            self._watchdog = None
         if self.metrics_server is not None:
             self.metrics_server.close()
             self.metrics_server = None

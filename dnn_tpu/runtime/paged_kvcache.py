@@ -80,10 +80,22 @@ class BlockAllocator:
         # block may be held by several slots plus a prefix-cache entry at
         # once; it returns to the free list when the last holder lets go.
         self._rc = {}
+        # memory observability (dnn_tpu/obs/mem.py): the pool's
+        # high-water mark — max blocks ever simultaneously in use. "How
+        # close did the pool come to full" is the capacity-planning
+        # number a used-right-now gauge cannot answer after the burst
+        # has passed; the serving layer exports all three as gauges.
+        self.high_water = 0
 
     @property
     def n_free(self) -> int:
         return len(self._free)
+
+    @property
+    def n_used(self) -> int:
+        """Blocks currently held (block 0's permanent reservation is not
+        "use"); n_used + n_free == n_blocks - 1 always."""
+        return self.n_blocks - 1 - len(self._free)
 
     def alloc(self, n: int) -> Optional[List[int]]:
         """n fresh block ids (each at refcount 1), or None if the pool
@@ -94,6 +106,8 @@ class BlockAllocator:
         taken, self._free = self._free[:n], self._free[n:]
         for b in taken:
             self._rc[b] = 1
+        if self.n_used > self.high_water:
+            self.high_water = self.n_used
         return taken
 
     def ref(self, blocks: List[int]):

@@ -1,53 +1,47 @@
-"""Profiling / tracing spans.
+"""Profiling / tracing spans — DEPRECATED shim over dnn_tpu.obs.profile.
 
-The reference has no tracing at all (SURVEY §5 'Tracing/profiling:
-ABSENT'). The TPU-native replacement is `jax.profiler`: named trace
-annotations show up in TensorBoard/Perfetto timelines alongside the XLA
-device ops, and `trace_to(dir)` captures a full device+host profile.
+This module predates the obs layer (dnn_tpu/obs); its profiler-span API
+grew a duplicate in PR 3 and is now unified: `span` / `step_span` are
+re-exports of `obs.profile.annotation` / `step_annotation`, which means
+they RESPECT THE DNN_TPU_OBS GATE (the orphaned originals annotated even
+with observability off). Existing callers keep working unchanged; new
+code should import from `dnn_tpu.obs.profile`, and full captures should
+go through `obs.profile.capture` / POST /profilez rather than the bare
+`trace_to` kept here for compatibility.
 
-All helpers degrade to no-ops if profiling is unavailable, so library code
-can annotate unconditionally.
+`device_sync` / `timed_blocked` are NOT spans — they are the honest
+device-completion barrier the benchmarks are built on — and live on
+here as this module's real content.
 """
 
 from __future__ import annotations
 
 import contextlib
 import time
-from typing import Iterator, Optional
+from typing import Iterator
 
 import jax
 
-
-@contextlib.contextmanager
-def span(name: str) -> Iterator[None]:
-    """Named host-side span, visible in captured profiles."""
-    try:
-        ctx = jax.profiler.TraceAnnotation(name)
-    except Exception:  # pragma: no cover - profiler unavailable
-        ctx = contextlib.nullcontext()
-    with ctx:
-        yield
-
-
-@contextlib.contextmanager
-def step_span(step: int, name: str = "step") -> Iterator[None]:
-    """Mark one pipeline/training step; XLA profilers group device ops
-    under it."""
-    try:
-        ctx = jax.profiler.StepTraceAnnotation(name, step_num=step)
-    except Exception:  # pragma: no cover
-        ctx = contextlib.nullcontext()
-    with ctx:
-        yield
+from dnn_tpu.obs.profile import (  # noqa: F401 — deprecated re-exports
+    annotation as span,
+    step_annotation as step_span,
+)
 
 
 @contextlib.contextmanager
 def trace_to(log_dir: str) -> Iterator[None]:
     """Capture a full profile (host + device) into `log_dir` for
-    TensorBoard / Perfetto."""
+    TensorBoard / Perfetto. Deprecated: prefer obs.profile.capture
+    (bounded spool, busy-locking, flight-logged) for server use."""
+    from dnn_tpu.obs import profile as _profile
+
     jax.profiler.start_trace(log_dir)
     try:
-        yield
+        # the deprecated `span` shim only annotates while a capture is
+        # marked recording (annotation_ctx's hot-path gate) — mark this
+        # legacy capture too, or trace_to + span silently loses spans
+        with _profile.mark_recording():
+            yield
     finally:
         jax.profiler.stop_trace()
 

@@ -43,6 +43,26 @@ def test_echo_round_trips_write_results_md(tmp_path):
     assert "NOT measured this run" in ref["note"]
 
 
+def test_echo_uses_carried_row_provenance(tmp_path):
+    """After an off-chip refresh the table HEADER carries the refresh
+    commit while a carried tpu row names its own measurement vintage in
+    a provenance= detail — the echo must attribute the number to the
+    commit where it was MEASURED, not the one that re-rendered the
+    table."""
+    rows = [
+        {"config": "gpt2_fwd", "metric": "tokens_per_sec",
+         "value": 454770.9, "mfu": 0.614, "platform": "tpu",
+         "provenance": "abc1234 2026-07-31 08:09 UTC",
+         "details": "batch=8, seq=512"},
+    ]
+    path = tmp_path / "RESULTS.md"
+    run_all.write_results_md(rows, str(path))
+    ref = bench._last_good_tpu_reference(str(path))
+    assert ref is not None
+    assert ref["commit"] == "abc1234"
+    assert ref["date"] == "2026-07-31 08:09 UTC"
+
+
 def test_echo_refuses_cpu_only_tables(tmp_path):
     """A table whose device section ran on CPU must NOT be echoed as a
     TPU reference."""

@@ -97,3 +97,89 @@ def test_state_tolerates_torn_final_line(tmp_path):
     st2 = run_all._State(path=path, resume=True)
     assert st2.done == {"device:a": "ok"}
     assert len(st2.all_rows()) == 1
+
+
+def test_seed_state_carries_device_rows_with_provenance(tmp_path):
+    # an off-chip host seeds resume state from a committed RESULTS.md:
+    # on-chip device rows ride along provenance-stamped, their configs
+    # marked ok (so --resume skips re-measuring them on the wrong
+    # substrate), while cpu-mesh rows are dropped for a fresh re-run
+    results = tmp_path / "RESULTS.md"
+    results.write_text(
+        "# Benchmark results (measured)\n\n"
+        "Generated at commit `abc1234` on 2026-07-31 08:09 UTC; "
+        "device-section platform: tpu.\n\n"
+        "| config | metric | value | mfu | platform | details |\n"
+        "|---|---|---|---|---|---|\n"
+        "| gpt2_fwd | tokens_per_sec | 454770.9 | 61.4% | tpu | batch=8 |\n"
+        "| gpt2_train_step | tokens_per_sec | 87266.2 | 35.3% | tpu | |\n"
+        "| cifar_2stage_pipeline | images_per_sec | 21.0 | — | cpu-mesh | |\n")
+    state_path = str(tmp_path / "rows.jsonl")
+    n = run_all.seed_state_from_results(str(results), state_path)
+    assert n == 2  # the cpu-mesh row is NOT carried
+    st = run_all._State(path=state_path, resume=True)
+    # gpt2_fwd rows come from the gpt_fwd config (multi-row mapping)
+    assert st.done == {"device:gpt_fwd": "ok",
+                       "device:gpt2_train_step": "ok"}
+    rows = st.all_rows()
+    assert [r["config"] for r in rows] == ["gpt2_fwd", "gpt2_train_step"]
+    for r in rows:
+        assert r["provenance"] == "abc1234 2026-07-31 08:09 UTC"
+        assert r["platform"] == "tpu"
+    assert rows[0]["mfu"] == 0.614
+
+
+def test_seed_state_drops_markers_and_cpu_rows_keeps_provenance(tmp_path):
+    """Re-seeding from a RESULTS.md that was ITSELF produced by a
+    carried refresh must not (a) freeze failed/skipped marker rows as
+    'ok' — their configs must retry, (b) carry cpu-substrate rows this
+    host can re-measure, or (c) restamp an already-carried row with the
+    newer header commit (old numbers masquerading as fresh, details
+    nesting one level per cycle)."""
+    results = tmp_path / "RESULTS.md"
+    results.write_text(
+        "# Benchmark results (measured)\n\n"
+        "Generated at commit `def5678` on 2026-08-03 15:10 UTC; "
+        "device-section platform: cpu, tpu.\n\n"
+        "| config | metric | value | mfu | platform | details |\n"
+        "|---|---|---|---|---|---|\n"
+        "| gpt2_fwd | tokens_per_sec | 454770.9 | 61.4% | tpu | "
+        "provenance=abc1234 2026-07-31 08:09 UTC, details=batch=8 |\n"
+        "| gpt2_decode_matrix | failed | timeout | — | meta | note=x |\n"
+        "| device_section | truncated | True | — | meta | note=z |\n"
+        "| mixtral_decode | skipped | tpu_only | — | cpu | note=y |\n"
+        "| obs_overhead | overhead_pct | 0.95 | — | cpu | ok=True |\n")
+    state_path = str(tmp_path / "rows.jsonl")
+    n = run_all.seed_state_from_results(str(results), state_path)
+    assert n == 1  # only the on-chip measurement is carried
+    st = run_all._State(path=state_path, resume=True)
+    # failed / skipped / cpu configs are NOT done: --resume re-runs them
+    assert st.done == {"device:gpt_fwd": "ok"}
+    (row,) = st.all_rows()
+    # the ORIGINAL stamp survives the second carry, un-nested
+    assert row["provenance"] == "abc1234 2026-07-31 08:09 UTC"
+    assert row["details"] == "batch=8"
+
+
+def test_seed_state_maps_decode_matrix_rows_to_their_config(tmp_path):
+    # gpt2_decode_matrix emits five gpt2_decode_w_* rows; seeding from a
+    # TPU table must map them back to the config and mark it ok, or an
+    # off-chip --resume re-runs the matrix on CPU and the table renders
+    # the same row names on two substrates
+    results = tmp_path / "RESULTS.md"
+    results.write_text(
+        "# Benchmark results (measured)\n\n"
+        "Generated at commit `abc1234` on 2026-07-31 08:09 UTC; "
+        "device-section platform: tpu.\n\n"
+        "| config | metric | value | mfu | platform | details |\n"
+        "|---|---|---|---|---|---|\n"
+        "| gpt2_decode_w_f32_kv_f32 | tokens_per_sec | 9714.3 | — | tpu "
+        "| batch=8 |\n"
+        "| gpt2_decode_w_int4_kv_int8 | tokens_per_sec | 20512.8 | — | "
+        "tpu | batch=8 |\n")
+    state_path = str(tmp_path / "rows.jsonl")
+    assert run_all.seed_state_from_results(str(results), state_path) == 2
+    st = run_all._State(path=state_path, resume=True)
+    assert st.done == {"device:gpt2_decode_matrix": "ok"}
+    assert [r["config"] for r in st.all_rows()] == [
+        "gpt2_decode_w_f32_kv_f32", "gpt2_decode_w_int4_kv_int8"]
