@@ -31,7 +31,18 @@ satellite: < 2% on a decode step). This probe measures it honestly:
 
 Standalone:  python benchmarks/obs_overhead_probe.py [--assert]
              (--assert exits 1 when overhead >= 2%)
-Suite row:   benchmarks/run_all.py config `obs_overhead` (cpu-runnable).
+             --fleet adds the PR-5 surface to the loop (see below)
+Suite row:   benchmarks/run_all.py configs `obs_overhead` and
+             `fleet_overhead` (both cpu-runnable).
+
+The `--fleet` variant (measure_fleet) prices the fleet-era additions on
+the same per-step interleave: a GoodputTracker on the pool (per-step
+MFU/MBU/SLO window updates — the marginal cost under test) AND a live
+FleetCollector polling this process's real /metrics + /statusz +
+/trace.jsonl endpoint at a 200 ms period in the background of BOTH
+populations (the poller is a separate process in production; running it
+in-process here puts its scrape-time gauge reads and GIL share INSIDE
+the timed window, bounding the deployed configuration from above).
 """
 
 from __future__ import annotations
@@ -96,12 +107,48 @@ def _drain_slots(srv, roots):
     srv.finish_reasons.clear()
 
 
+def measure_fleet() -> dict:
+    """obs_overhead with the fleet-era surface live: goodput tracking on
+    every step + a FleetCollector polling this process's own endpoint
+    throughout the timed loop. Same per-step interleave, same <2%
+    contract — the poller runs in both populations (it polls regardless
+    of the producer gate), the goodput feeds only in the ON one."""
+    from dnn_tpu import obs
+    from dnn_tpu.obs.fleet import FleetCollector
+    from dnn_tpu.obs.goodput import GoodputTracker, SLOConfig, model_cost
+
+    srv = _build()
+    # explicit peaks: utilization gauges must COMPUTE on this CPU host
+    # (scrapes read them), not short-circuit to 0 — price the real path
+    tracker = GoodputTracker(
+        model_cost(srv.cfg), peak_flops=1e12, peak_bytes=1e10,
+        slo=SLOConfig(inter_token_s=0.001, availability=0.999)).install()
+    srv.goodput = tracker
+    endpoint = obs.serve_metrics(0)
+    fleet = FleetCollector(
+        {"self": f"http://127.0.0.1:{endpoint.port}"},
+        interval_s=0.2).start()
+    try:
+        row = _measure_steps(srv)
+    finally:
+        fleet.close()
+        endpoint.close()
+    row["fleet_poll_count"] = fleet._polls
+    row["mfu_live"] = round(tracker.mfu(), 6)
+    row["mbu_live"] = round(tracker.mbu(), 6)
+    return row
+
+
 def measure() -> dict:
+    srv = _build()
+    return _measure_steps(srv)
+
+
+def _measure_steps(srv) -> dict:
     from dnn_tpu import obs
     from dnn_tpu.obs.watchdog import Watchdog
 
     was = obs.enabled()
-    srv = _build()
     obs.set_enabled(True)
     # v2 surface rides along in the timed loop: a live watchdog (no
     # device probe — its subprocess would inject real load; the
@@ -163,7 +210,7 @@ def measure() -> dict:
 
 def main(argv=None) -> int:
     args = set(argv if argv is not None else sys.argv[1:])
-    row = measure()
+    row = measure_fleet() if "--fleet" in args else measure()
     row["ok"] = row["overhead_frac"] < 0.02
     print(json.dumps(row), flush=True)
     if "--assert" in args and not row["ok"]:

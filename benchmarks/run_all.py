@@ -570,6 +570,26 @@ def dev_obs_overhead():
     return results
 
 
+@device_config("fleet_overhead")
+def dev_fleet_overhead():
+    # fleet-era observability tax: the obs_overhead loop with the PR-5
+    # surface live — per-step goodput (MFU/MBU/SLO window) updates on
+    # the pool, and a real FleetCollector polling this process's own
+    # /metrics + /statusz + /trace.jsonl endpoint every 200 ms through
+    # the timed window. Same <2% decode-step contract.
+    from benchmarks.obs_overhead_probe import measure_fleet
+
+    results = []
+    row = measure_fleet()
+    overhead = row.pop("overhead_frac")
+    _emit(results, config="fleet_overhead", metric="overhead_pct",
+          value=round(overhead * 100, 2), platform=_platform(),
+          ok=bool(overhead < 0.02),
+          note="obs_overhead + goodput tracker + in-process fleet "
+               "poller @200ms; contract < 2%", **row)
+    return results
+
+
 def _serve_round(srv_x, cfg, sb_new, n_requests, plen_fn, constraint=None,
                  key=9):
     """Admit-when-a-slot-frees over the pool, then drain — the

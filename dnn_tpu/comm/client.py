@@ -169,7 +169,16 @@ class NodeClient:
                     m.inc(labeled("comm.payload_bytes_total",
                                   direction="out"), request.ByteSize())
                 try:
+                    t_send_wall = time.time() if sp else 0.0
                     resp = call(request, timeout=max(remaining, 0.001))
+                    if sp:
+                        # clock-offset sampling for cross-host trace
+                        # stitching (obs/fleet.py): the SUCCESSFUL
+                        # attempt's wall-clock send/receive window — the
+                        # span's own ts/dur covers retries and backoff
+                        # sleeps, which would bias the NTP-style
+                        # midpoint estimate by seconds
+                        sp.set(cs=t_send_wall, cr=time.time())
                     if m is not None:
                         m.observe_hist(
                             labeled("comm.rpc_latency_seconds",

@@ -247,7 +247,13 @@ class StageServer:
                                   direction="out", stage=self.node.id),
                           request.ByteSize())
                 try:
+                    t_send_wall = time.time() if sp else 0.0
                     resp = await call(request, timeout=max(remaining, 0.001))
+                    if sp:
+                        # clock-offset sampling fields for cross-host
+                        # stitching, as in client.send_tensor: the
+                        # successful attempt's wall-clock window only
+                        sp.set(cs=t_send_wall, cr=time.time())
                     if m is not None:
                         m.observe_hist(
                             labeled("comm.rpc_latency_seconds",

@@ -152,6 +152,40 @@ def build_parser() -> argparse.ArgumentParser:
                         "(watchdog per-component state), /healthz, POST "
                         "/profilez?ms=N (on-demand jax.profiler capture) "
                         "(dnn_tpu/obs; 0 = ephemeral port)")
+    p.add_argument("--fleet_port", type=int, default=None, metavar="PORT",
+                   help="--serve/--serve_lm: ALSO run the fleet "
+                        "collector in this process and serve the merged "
+                        "/fleetz view on this port (dnn_tpu/obs/"
+                        "fleet.py; 0 = ephemeral). Stage endpoints come "
+                        "from --fleet_targets, or from the config's "
+                        "node hosts + --metrics_port when omitted — the "
+                        "convention where every node passes the same "
+                        "--metrics_port")
+    p.add_argument("--fleet_targets", default=None,
+                   help="comma-separated obs endpoint base URLs "
+                        "(http://host:port) for --fleet_port, one per "
+                        "stage")
+    p.add_argument("--fleet_interval", type=float, default=None,
+                   help="--fleet_port: poll period in seconds "
+                        "(default 5)")
+    p.add_argument("--slo_ttft_ms", type=float, default=None,
+                   help="--serve_lm: TTFT objective in ms — 99%% of "
+                        "requests (see --slo_target) must see their "
+                        "first token within it; exported as the "
+                        "dnn_tpu_slo_burn_rate{slo=\"ttft\"} "
+                        "error-budget gauge with a flight event on "
+                        "breach (dnn_tpu/obs/goodput.py)")
+    p.add_argument("--slo_itl_ms", type=float, default=None,
+                   help="--serve_lm: inter-token latency objective in "
+                        "ms (slo=\"inter_token\" burn-rate gauge)")
+    p.add_argument("--slo_avail", type=float, default=None,
+                   help="--serve_lm: availability objective as a "
+                        "success fraction, e.g. 0.999 "
+                        "(slo=\"availability\" burn-rate gauge)")
+    p.add_argument("--slo_target", type=float, default=None,
+                   help="--serve_lm: fraction of requests that must "
+                        "meet each latency objective (default 0.99; "
+                        "needs at least one --slo_* objective)")
     p.add_argument("--watchdog_s", type=float, default=None, metavar="S",
                    help="--serve_lm: run the hung-device watchdog with "
                         "this probe period in seconds (subprocess-bounded "
@@ -306,6 +340,58 @@ def main(argv=None) -> int:
         log.error("--watchdog_s applies to --serve_lm only (the watchdog "
                   "monitors the LM daemon's decode loop)")
         return 1
+    slo_objectives = any(v is not None for v in (
+        args.slo_ttft_ms, args.slo_itl_ms, args.slo_avail))
+    if (slo_objectives or args.slo_target is not None) \
+            and not args.serve_lm:
+        log.error("--slo_* flags apply to --serve_lm only (SLO tracking "
+                  "lives on the LM daemon's request stream)")
+        return 1
+    if args.slo_target is not None and not slo_objectives:
+        # a target without an objective would silently track nothing
+        log.error("--slo_target needs at least one objective "
+                  "(--slo_ttft_ms / --slo_itl_ms / --slo_avail)")
+        return 1
+    if args.fleet_port is not None and not (args.serve or args.serve_lm):
+        log.error("--fleet_port applies to the serving modes; for a "
+                  "standalone collector use `python -m dnn_tpu.obs "
+                  "fleet --serve PORT`")
+        return 1
+    if (args.fleet_targets or args.fleet_interval is not None) \
+            and args.fleet_port is None:
+        # silent no-op would read as "the fleet view is live"
+        log.error("--fleet_targets/--fleet_interval apply only with "
+                  "--fleet_port")
+        return 1
+    fleet_srv = fleet_col = None
+    if args.fleet_port is not None:
+        # fleet collector riding this serving process (obs/fleet.py):
+        # polls every stage's obs endpoint, serves the merged /fleetz
+        from dnn_tpu import obs
+        from dnn_tpu.obs.fleet import FleetCollector, targets_from_config
+
+        try:
+            if args.fleet_targets:
+                targets = [u.strip() for u in args.fleet_targets.split(",")
+                           if u.strip()]
+            elif args.metrics_port:
+                targets = targets_from_config(config, args.metrics_port)
+            else:
+                raise ValueError(
+                    "--fleet_port needs --fleet_targets, or a nonzero "
+                    "--metrics_port to derive them from the config")
+            fleet_col = FleetCollector(
+                targets,
+                interval_s=args.fleet_interval
+                if args.fleet_interval is not None else 5.0).start()
+            fleet_srv = obs.serve_metrics(args.fleet_port,
+                                          fleet=fleet_col)
+            log.info("fleet collector on http://127.0.0.1:%d/fleetz "
+                     "(%d stages)", fleet_srv.port,
+                     len(fleet_col.targets))
+        except Exception as e:  # noqa: BLE001 — CLI boundary
+            log.error("fleet collector setup failed: %s", e)
+            return 1
     if args.serve_adapter and not args.serve_lm:
         # per-request adapters exist only in the LM daemon's slot pool —
         # error rather than silently serving the base model
@@ -498,9 +584,22 @@ def _serve_lm(engine: PipelineEngine, args) -> int:
         except Exception as e:  # noqa: BLE001 — CLI boundary
             log.error("draft model setup failed: %s", e)
             return 1
+    slo = None
+    if any(v is not None for v in (args.slo_ttft_ms, args.slo_itl_ms,
+                                   args.slo_avail)):
+        from dnn_tpu.obs.goodput import SLOConfig
+
+        slo = SLOConfig(
+            ttft_s=args.slo_ttft_ms / 1e3
+            if args.slo_ttft_ms is not None else None,
+            inter_token_s=args.slo_itl_ms / 1e3
+            if args.slo_itl_ms is not None else None,
+            availability=args.slo_avail,
+            target=args.slo_target
+            if args.slo_target is not None else 0.99)
     try:
         asyncio.run(serve_lm(
-            cfg, prepared, port=me.port, slots=args.slots,
+            cfg, prepared, port=me.port, slots=args.slots, slo=slo,
             **spec_kwargs,
             max_len=args.max_len, prompt_pad=args.prompt_pad,
             temperature=args.temperature, top_k=args.top_k,

@@ -35,6 +35,22 @@ v2 adds the failure-facing layer on the same substrate:
     probes + decode heartbeat staleness -> ok|degraded|wedged on
     /statusz, with /healthz degrading accordingly.
 
+v3 adds the CROSS-PROCESS layer — the first obs subsystem that sees the
+whole pipeline instead of one process:
+
+  * fleet collector (obs/fleet.py): polls every stage's /metrics +
+    /statusz + /trace.jsonl, serves the merged view on /fleetz
+    (worst-of health, per-stage percentile tables, fleet totals),
+    estimates per-stage clock offsets NTP-style from the existing RPC
+    spans, and stitches per-hop span trees from different hosts into
+    ONE Perfetto timeline with per-request critical-path and bubble-
+    fraction attribution (`python -m dnn_tpu.obs fleet`);
+  * goodput accounting (obs/goodput.py): live MFU / MBU / goodput
+    tokens-per-sec scrape-time gauges from the decode/prefill step
+    stream + utils/flops.py serving-shape estimates, plus SLO
+    error-budget burn-rate tracking (TTFT / inter-token /
+    availability) with flight events on breach.
+
 Gate: DNN_TPU_OBS=off (or 0/false) disables everything — producers see
 `metrics()` return None, `start_span` return the free NULL_SPAN, and
 `flight.record` short-circuit on one boolean. The gate is re-checked
@@ -123,7 +139,7 @@ def install_compile_telemetry() -> bool:
 
 
 def serve_metrics(port: int = 0, host: str = "127.0.0.1", *,
-                  healthy=None, status=None, profiler=None):
+                  healthy=None, status=None, profiler=None, fleet=None):
     """Start the observability HTTP endpoint on a daemon thread; returns
     the MetricsHTTPServer (`.port` for port=0 ephemeral binds,
     `.close()` to stop; loopback by default — pass host="0.0.0.0" to
@@ -135,7 +151,9 @@ def serve_metrics(port: int = 0, host: str = "127.0.0.1", *,
     endpoints the real servers expose. `healthy`/`status` as on
     MetricsHTTPServer; `profiler` defaults to a fresh
     obs.profile.Profiler (pass one to enable auto-trigger arming, or
-    False to disable /profilez). See obs/http.py."""
+    False to disable /profilez). `fleet` (an obs.fleet.FleetCollector)
+    additionally serves the merged fleet view on /fleetz (JSON;
+    ?format=prom|trace|report). See obs/http.py."""
     from dnn_tpu.obs.http import MetricsHTTPServer
     from dnn_tpu.obs.mem import install_memory_gauges
 
@@ -145,4 +163,5 @@ def serve_metrics(port: int = 0, host: str = "127.0.0.1", *,
 
         profiler = Profiler()
     return MetricsHTTPServer(port=port, host=host, healthy=healthy,
-                             status=status, profiler=profiler or None)
+                             status=status, profiler=profiler or None,
+                             fleet=fleet)

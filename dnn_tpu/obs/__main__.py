@@ -1,4 +1,25 @@
-"""CLI: `python -m dnn_tpu.obs {trace,flight} ...` — obs tooling.
+"""CLI: `python -m dnn_tpu.obs {trace,flight,fleet} ...` — obs tooling.
+
+    python -m dnn_tpu.obs fleet --targets http://h1:9100,http://h2:9100
+        One-shot fleet report: poll every stage's /metrics /statusz
+        /trace.jsonl, print the merged rollup (worst-of health,
+        per-stage percentiles, fleet throughput, clock offsets) and the
+        newest request's critical-path/bubble attribution.
+        --config config.json --metrics_port 9100  derives the targets
+        from the pipeline config instead (every node's host + one
+        shared metrics port). --out stitched.json additionally writes
+        the stitched cross-host Perfetto trace (--id to pick a trace).
+
+    python -m dnn_tpu.obs fleet --targets ... --serve PORT
+        Long-lived collector: poll on --interval (default 5 s) and
+        serve /fleetz (+ /metrics /statusz /healthz with the fleet's
+        worst-of health) until interrupted.
+
+    python -m dnn_tpu.obs fleet --selftest
+        In-process smoke: two real stage HTTP endpoints with injected
+        clock skew, poll, merged rollup, offset recovery, stitched
+        trace, critical-path golden; exit 0 on success. Tier-1 wired
+        (tests/test_obs_fleet.py).
 
     python -m dnn_tpu.obs trace --selftest
         In-process smoke of the whole span pipeline (nested spans,
@@ -177,6 +198,145 @@ def _flight_fetch(url: str, out=None, kind=None, trace=None,
     return 0
 
 
+def _fleet_selftest() -> int:
+    """Two REAL stage HTTP endpoints in-process (private registries +
+    collectors, ±500 ms injected skew on the second), one FleetCollector
+    over them: merged rollup, offset recovery, stitching, critical-path
+    math, and the prom re-export all checked end to end."""
+    import time as _time
+
+    from dnn_tpu import obs
+    from dnn_tpu.obs import trace as _t
+    from dnn_tpu.obs.fleet import FleetCollector, critical_path
+    from dnn_tpu.obs.http import MetricsHTTPServer
+    from dnn_tpu.utils.metrics import Metrics
+
+    obs.set_enabled(True)
+    SKEW = 0.5
+    regA, regB = Metrics(), Metrics()
+    regA.set("serving.tokens_per_sec", 10.0)
+    regB.set("serving.tokens_per_sec", 5.0)
+    colA, colB = obs.TraceCollector(), obs.TraceCollector()
+
+    def mk(col, trace_id, span_id, parent_id, name, ts, dur, **attrs):
+        s = _t.Span(name, trace_id, span_id, parent_id, attrs)
+        s.t0, s.dur, s._done = ts - _t._EPOCH0, dur, True
+        col.add(s)
+
+    now = _time.time()
+    # client hop on A (true timeline), server span on B stamped by a
+    # clock running SKEW ahead
+    mk(colA, "t1", "c1", None, "rpc.forward", now, 0.10,
+       cs=now, cr=now + 0.10)
+    mk(colB, "t1", "s1", "c1", "stage.request", now + 0.02 + SKEW, 0.06,
+       stage="node2")
+    sA = MetricsHTTPServer(port=0, registry=regA, collector=colA,
+                           healthy=lambda: True)
+    sB = MetricsHTTPServer(
+        port=0, registry=regB, collector=colB,
+        status=lambda: {"state": "degraded", "components": {}})
+    try:
+        fc = FleetCollector({"node1": f"http://127.0.0.1:{sA.port}",
+                             "node2": f"http://127.0.0.1:{sB.port}"})
+        fc.poll_once()
+        z = fc.fleetz()
+        assert z["state"] == "degraded", z["state"]  # worst-of rollup
+        assert z["fleet"]["tokens_per_sec"] == 15.0, z["fleet"]
+        assert z["stages"]["node1"]["state"] == "ok"
+        off = z["clock_offsets_s"]["node2"]
+        assert abs(off - SKEW) < 0.1 * SKEW, off  # ±500 ms within 10%
+        ct = fc.stitch("t1")
+        xs = [e for e in ct["traceEvents"] if e.get("ph") == "X"]
+        assert len(xs) == 2, ct
+        pids = {e["args"]["stage"]: e["pid"] for e in xs}
+        assert len(set(pids.values())) == 2, pids  # one track per stage
+        # after correction the server span sits INSIDE the client hop
+        by_name = {e["name"]: e for e in xs}
+        c, s = by_name["rpc.forward"], by_name["stage.request"]
+        assert c["ts"] <= s["ts"] <= s["ts"] + s["dur"] \
+            <= c["ts"] + c["dur"] + 1e3, (c, s)
+        assert "dnn_tpu_fleet_state" in fc.render_prom()
+        rep = fc.request_report("t1")
+        assert rep["spans"] == 2 and 0.0 < rep["bubble_fraction"] < 1.0
+        # critical-path golden: 3 sequential leaves under a 10 ms root
+        # with a 1 ms gap -> bubble exactly 10%
+        g = critical_path([
+            {"span_id": "r", "parent_id": None, "name": "request",
+             "ts": 0.0, "dur": 0.010, "attrs": {}},
+            {"span_id": "a", "parent_id": "r", "name": "compute",
+             "ts": 0.0, "dur": 0.003, "attrs": {"stage": "s0"}},
+            {"span_id": "b", "parent_id": "r", "name": "compute",
+             "ts": 0.004, "dur": 0.003, "attrs": {"stage": "s1"}},
+            {"span_id": "c", "parent_id": "r", "name": "compute",
+             "ts": 0.007, "dur": 0.003, "attrs": {"stage": "s2"}},
+        ])
+        assert abs(g["bubble_fraction"] - 0.1) < 1e-6, g
+        assert [p["stage"] for p in g["path"]] == ["s0", "s1", "s2"], g
+        fc.close()
+    finally:
+        sA.close()
+        sB.close()
+    print(f"fleet selftest ok: rollup worst-of, offset {off:+.3f}s "
+          f"recovered (true {SKEW:+.3f}s), stitch + critical-path/"
+          "bubble golden, prom re-export valid")
+    return 0
+
+
+def _fleet_cmd(args) -> int:
+    from dnn_tpu.obs.fleet import FleetCollector, targets_from_config
+
+    if args.targets:
+        urls = [u.strip() for u in args.targets.split(",") if u.strip()]
+        if args.names:
+            names = [n.strip() for n in args.names.split(",")]
+            if len(names) != len(urls):
+                print("--names must match --targets in count",
+                      file=sys.stderr)
+                return 2
+            targets = dict(zip(names, urls))
+        else:
+            targets = {f"stage{i}" if len(urls) > 1 else "stage0": u
+                       for i, u in enumerate(urls)}
+    elif args.config:
+        if args.metrics_port is None:
+            print("--config needs --metrics_port (the port every node "
+                  "passed to --metrics_port)", file=sys.stderr)
+            return 2
+        targets = targets_from_config(args.config, args.metrics_port)
+    else:
+        print("fleet needs --targets, --config, or --selftest",
+              file=sys.stderr)
+        return 2
+    fc = FleetCollector(targets, interval_s=args.interval)
+    if args.serve is not None:
+        from dnn_tpu import obs
+
+        fc.start()
+        srv = obs.serve_metrics(args.serve, host=args.host, fleet=fc)
+        print(f"fleet collector serving http://{args.host}:{srv.port}"
+              f"/fleetz over {len(targets)} stages "
+              f"(poll every {args.interval:g}s); Ctrl-C to stop")
+        try:
+            while True:
+                time.sleep(3600)
+        except KeyboardInterrupt:
+            pass
+        finally:
+            srv.close()
+            fc.close()
+        return 0
+    fc.poll_once()
+    print(fc.report(args.trace_id))
+    if args.out:
+        chrome = fc.stitch(args.trace_id)
+        with open(args.out, "w") as f:
+            json.dump(chrome, f)
+        n = sum(1 for e in chrome["traceEvents"] if e.get("ph") == "X")
+        print(f"wrote {args.out}: {n} spans across "
+              f"{len(targets)} stages (load in Perfetto)")
+    return 0
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(prog="python -m dnn_tpu.obs")
     sub = ap.add_subparsers(dest="cmd", required=True)
@@ -197,6 +357,34 @@ def main(argv=None) -> int:
     fl.add_argument("--trace", default=None, help="filter by trace id")
     fl.add_argument("--last", default=None, type=int,
                     help="keep only the newest N events")
+    fz = sub.add_parser("fleet", help="cluster-wide aggregation + "
+                        "cross-host trace stitching (obs/fleet.py)")
+    fz.add_argument("--selftest", action="store_true",
+                    help="in-process fleet smoke (two endpoints, "
+                         "injected skew); exit 0 on pass")
+    fz.add_argument("--targets", default=None,
+                    help="comma-separated obs endpoint base URLs "
+                         "(http://host:port), one per stage")
+    fz.add_argument("--names", default=None,
+                    help="comma-separated stage names matching --targets")
+    fz.add_argument("--config", default=None,
+                    help="pipeline config JSON — stages derive from its "
+                         "nodes' hosts + --metrics_port")
+    fz.add_argument("--metrics_port", type=int, default=None,
+                    help="with --config: the obs port every node serves")
+    fz.add_argument("--interval", type=float, default=5.0,
+                    help="--serve poll period in seconds")
+    fz.add_argument("--serve", type=int, default=None, metavar="PORT",
+                    help="run the long-lived collector and serve "
+                         "/fleetz on this port (0 = ephemeral)")
+    fz.add_argument("--host", default="127.0.0.1",
+                    help="--serve bind host (default loopback; "
+                         "0.0.0.0 exposes to the network)")
+    fz.add_argument("--out", default=None,
+                    help="write the stitched cross-host Perfetto JSON "
+                         "here (one-shot mode)")
+    fz.add_argument("--id", dest="trace_id", default=None,
+                    help="restrict the report/stitch to one trace id")
     args = ap.parse_args(argv)
 
     if args.cmd == "trace":
@@ -212,6 +400,10 @@ def main(argv=None) -> int:
             return _flight_fetch(args.url, args.out, args.kind,
                                  args.trace, args.last)
         ap.error("flight needs --selftest or --url URL")
+    if args.cmd == "fleet":
+        if args.selftest:
+            return _fleet_selftest()
+        return _fleet_cmd(args)
     return 2
 
 

@@ -1,0 +1,814 @@
+"""Fleet observability: cross-process aggregation and trace stitching.
+
+The paper's core artifact is a MULTI-PROCESS pipeline (one node per
+stage, activations relayed over gRPC), yet every obs surface built so
+far (/metrics, /statusz, /debugz, /trace) is per-process: a 3-stage
+operator gets three disjoint dashboards on three unsynchronized clocks,
+and no answer to "which stage is the bottleneck, how big is the
+pipeline bubble, and what fraction of peak are we using". This module
+is the control-plane collector that merges them:
+
+  * DISCOVERY + POLLING: stage endpoints come from explicit `targets`
+    (base URLs of each node's obs endpoint) or from the pipeline config
+    (`targets_from_config` — every node's host + one shared metrics
+    port). A daemon thread polls each node's existing /metrics,
+    /statusz, and /trace.jsonl on an interval; nothing new runs on the
+    stages themselves.
+
+  * MERGED VIEW (/fleetz, or the one-shot terminal report): worst-of
+    health rollup (the fleet /healthz), per-stage RPC / decode / queue
+    percentiles side by side, fleet-total throughput, live MFU/MBU per
+    stage (obs/goodput.py gauges), and the estimated clock offsets.
+
+  * CROSS-HOST TRACE STITCHING: every RPC hop already links spans
+    across processes (the server's root span parents under the client's
+    rpc span via the wire tag — obs/trace.py), but each host stamps its
+    spans with ITS OWN clock. The collector estimates per-stage clock
+    offset NTP-style from those very hops: the client span's wall-clock
+    send/receive window (`cs`/`cr` attrs, comm/client.py) brackets the
+    server span, so  offset = server_midpoint - client_midpoint  per
+    hop; the median over hops gives the pair offset, and a BFS over the
+    pair graph anchors every stage to one timeline. `stitch()` then
+    emits ONE Perfetto/Chrome trace with one process track per stage.
+
+  * CRITICAL PATH + BUBBLE: with one request's spans on one corrected
+    timeline, `critical_path()` sweeps the leaf (work) spans from
+    request start to end, yielding the chain of spans that actually
+    gates latency and the BUBBLE FRACTION — the part of the request's
+    wall time no stage was working on it (queueing, wire, scheduling
+    gaps). MPMD pipeline work (arxiv 2412.14374) shows this is *the*
+    actionable signal for pipeline configurations.
+
+Pure stdlib + utils.metrics — no jax anywhere, so the collector runs on
+any operator laptop. CLI: `python -m dnn_tpu.obs fleet` (obs/__main__).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import re
+import threading
+import time
+from collections import defaultdict
+from typing import Dict, List, Optional
+from urllib.request import urlopen
+
+log = logging.getLogger("dnn_tpu.obs")
+
+__all__ = [
+    "FleetCollector", "parse_prometheus", "estimate_offsets",
+    "critical_path", "stitch_spans", "targets_from_config",
+]
+
+# health ranking for the worst-of rollup; "unreachable" sits between
+# degraded and wedged: the stage may be mid-restart (don't page as hard
+# as a confirmed-wedged chip) but the pipeline through it IS down
+_STATE_RANK = {"ok": 0, "degraded": 1, "unreachable": 2, "wedged": 3}
+# map a fleet state onto the watchdog's three-valued vocabulary so the
+# existing /healthz handler (503 on "wedged") serves the fleet too
+_STATE_AS_WATCHDOG = {"ok": "ok", "degraded": "degraded",
+                      "unreachable": "wedged", "wedged": "wedged"}
+
+
+# ----------------------------------------------------------------------
+# Prometheus text parsing (the poller's half of render_prometheus)
+# ----------------------------------------------------------------------
+
+_LINE = re.compile(
+    r'^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{(.*)\})?\s+([^\s]+)\s*$')
+_LABEL = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+
+
+def parse_prometheus(text: str) -> dict:
+    """Prometheus text exposition -> {"types": {family: kind},
+    "samples": [(family, labels_dict, value)]}. Tolerant: malformed
+    lines are skipped (one stage on an older build must not take the
+    fleet view down)."""
+    types: Dict[str, str] = {}
+    samples = []
+    for line in text.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        if line.startswith("#"):
+            parts = line.split()
+            if len(parts) >= 4 and parts[1] == "TYPE":
+                types[parts[2]] = parts[3]
+            continue
+        m = _LINE.match(line)
+        if not m:
+            continue
+        name, _, labels_raw, val = m.groups()
+        try:
+            value = float(val.replace("+Inf", "inf"))
+        except ValueError:
+            continue
+        labels = {}
+        if labels_raw:
+            for lm in _LABEL.finditer(labels_raw):
+                labels[lm.group(1)] = (lm.group(2)
+                                       .replace(r'\"', '"')
+                                       .replace("\\\\", "\\"))
+        samples.append((name, labels, value))
+    return {"types": types, "samples": samples}
+
+
+class _Samples:
+    """Query helper over parsed samples."""
+
+    def __init__(self, parsed: dict):
+        self._samples = parsed["samples"]
+
+    def get(self, family: str, default=None, **labels):
+        for name, labs, v in self._samples:
+            if name == family and all(labs.get(k) == str(w)
+                                      for k, w in labels.items()):
+                return v
+        return default
+
+    def sum(self, family: str, **labels) -> Optional[float]:
+        hit = False
+        total = 0.0
+        for name, labs, v in self._samples:
+            if name == family and all(labs.get(k) == str(w)
+                                      for k, w in labels.items()):
+                hit, total = True, total + v
+        return total if hit else None
+
+    def hist_quantile(self, family: str, q: float,
+                      **labels) -> Optional[float]:
+        """histogram_quantile over `family` (summed across any label
+        sets matching `labels`, `le` excluded) — linear interpolation
+        inside the winning bucket, the Prometheus convention."""
+        buckets: Dict[float, float] = defaultdict(float)
+        for name, labs, v in self._samples:
+            if name != family + "_bucket":
+                continue
+            if not all(labs.get(k) == str(w) for k, w in labels.items()):
+                continue
+            try:
+                le = float(labs.get("le", "").replace("+Inf", "inf"))
+            except ValueError:
+                continue
+            buckets[le] += v
+        if not buckets:
+            return None
+        pairs = sorted(buckets.items())
+        total = pairs[-1][1]  # the +Inf bucket is cumulative total
+        if total <= 0:
+            return None
+        target = q * total
+        prev_le, prev_c = 0.0, 0.0
+        for le, c in pairs:
+            if c >= target:
+                if le == float("inf"):
+                    return prev_le
+                span = c - prev_c
+                frac = (target - prev_c) / span if span else 1.0
+                return prev_le + (le - prev_le) * frac
+            prev_le, prev_c = le, c
+        return prev_le
+
+
+# ----------------------------------------------------------------------
+# clock-offset estimation (NTP-style, from the existing RPC spans)
+# ----------------------------------------------------------------------
+
+_CLIENT_SPAN_NAMES = ("rpc.SendTensor", "rpc.forward",
+                      "rpc.GenerateStream", "rpc.SendMessage")
+
+# leaf spans that measure WAITING, not stage work — critical_path must
+# count their cover as bubble (see its docstring)
+_WAIT_SPAN_NAMES = frozenset({"queue_wait"})
+
+
+def estimate_offsets(spans_by_stage: Dict[str, List[dict]],
+                     anchor: Optional[str] = None) -> Dict[str, float]:
+    """Per-stage clock offset (seconds to SUBTRACT from a stage's span
+    timestamps to land on the anchor stage's timeline).
+
+    Every cross-process hop gives one sample: the client-side rpc span
+    (stage U) brackets the server's root span (stage T, parented under
+    it via the wire tag). With symmetric network delay the server span's
+    midpoint coincides with the client window's midpoint on the TRUE
+    timeline, so  offset(T rel U) = server_mid - client_mid  — the
+    classic NTP midpoint estimate; the error is bounded by the one-way
+    delay asymmetry, far below the multi-ms skew it corrects. The
+    client midpoint prefers the `cs`/`cr` wall-clock attrs (the
+    successful attempt's window, comm/client.py) over the span's ts/dur,
+    which includes retry backoff. Per-pair samples reduce by MEDIAN
+    (kills the retried-hop and GC-pause outliers); a BFS over the pair
+    graph chains offsets for stages the anchor never calls directly."""
+    # span_id -> (stage, span) for client-side rpc spans
+    client_by_id: Dict[str, tuple] = {}
+    for stage, spans in spans_by_stage.items():
+        for s in spans:
+            if s.get("name") in _CLIENT_SPAN_NAMES:
+                client_by_id[s["span_id"]] = (stage, s)
+    pair_samples: Dict[tuple, List[float]] = defaultdict(list)
+    for stage, spans in spans_by_stage.items():
+        for s in spans:
+            p = s.get("parent_id")
+            if not p or p not in client_by_id:
+                continue
+            c_stage, c = client_by_id[p]
+            if c_stage == stage:
+                continue  # same process: same clock, no information
+            attrs = c.get("attrs") or {}
+            cs, cr = attrs.get("cs"), attrs.get("cr")
+            if cs and cr:
+                client_mid = (cs + cr) / 2.0
+            else:
+                client_mid = c["ts"] + (c.get("dur") or 0.0) / 2.0
+            server_mid = s["ts"] + (s.get("dur") or 0.0) / 2.0
+            pair_samples[(c_stage, stage)].append(server_mid - client_mid)
+
+    def med(xs):
+        xs = sorted(xs)
+        n = len(xs)
+        return xs[n // 2] if n % 2 else (xs[n // 2 - 1] + xs[n // 2]) / 2
+
+    # undirected adjacency with directed medians
+    adj: Dict[str, Dict[str, float]] = defaultdict(dict)
+    for (u, t), xs in pair_samples.items():
+        o = med(xs)
+        adj[u][t] = o       # t's clock = u's clock + o
+        adj[t].setdefault(u, -o)
+    stages = list(spans_by_stage)
+    if anchor is None:
+        anchor = stages[0] if stages else None
+    offsets: Dict[str, float] = {}
+    if anchor is None:
+        return offsets
+    offsets[anchor] = 0.0
+    frontier = [anchor]
+    while frontier:
+        u = frontier.pop()
+        for t, o in adj.get(u, {}).items():
+            if t not in offsets:
+                offsets[t] = offsets[u] + o
+                frontier.append(t)
+    for s in stages:  # unlinked stages: no evidence, assume in sync
+        offsets.setdefault(s, 0.0)
+    return offsets
+
+
+# ----------------------------------------------------------------------
+# stitching + critical path
+# ----------------------------------------------------------------------
+
+def stitch_spans(spans_by_stage: Dict[str, List[dict]],
+                 offsets: Optional[Dict[str, float]] = None,
+                 trace_id: Optional[str] = None) -> dict:
+    """Merge per-stage span dumps into ONE Chrome-trace/Perfetto JSON on
+    one corrected timeline: one PROCESS track per stage (pid = stage
+    order, process_name metadata), one thread track per original
+    (stage, tid), every event's args carrying the stage and the offset
+    applied. Spans are deduped by span_id (overlapping polls of a
+    stage's ring re-fetch old spans)."""
+    if offsets is None:
+        offsets = estimate_offsets(spans_by_stage)
+    events = []
+    tid_tracks: Dict[tuple, int] = {}
+    seen: set = set()
+    for pid, (stage, spans) in enumerate(spans_by_stage.items(), 1):
+        events.append({"ph": "M", "pid": pid, "tid": 0,
+                       "name": "process_name",
+                       "args": {"name": f"stage {stage}"}})
+        off = offsets.get(stage, 0.0)
+        for s in spans:
+            if trace_id is not None and s.get("trace_id") != trace_id:
+                continue
+            if s["span_id"] in seen:
+                continue
+            seen.add(s["span_id"])
+            key = (pid, s.get("tid", 0))
+            if key not in tid_tracks:
+                tid_tracks[key] = len(tid_tracks) + 1
+                events.append({
+                    "ph": "M", "pid": pid, "tid": tid_tracks[key],
+                    "name": "thread_name",
+                    "args": {"name": f"{stage} thread {s.get('tid', 0)}"},
+                })
+            events.append({
+                "name": s["name"], "cat": "dnn_tpu_fleet", "ph": "X",
+                "ts": round((s["ts"] - off) * 1e6, 3),
+                "dur": round((s.get("dur") or 0.0) * 1e6, 3),
+                "pid": pid, "tid": tid_tracks[key],
+                "args": {**(s.get("attrs") or {}),
+                         "trace_id": s.get("trace_id"),
+                         "span_id": s["span_id"],
+                         "parent_id": s.get("parent_id"),
+                         "stage": stage,
+                         "clock_offset_s": round(off, 6)},
+            })
+    return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+def critical_path(spans: List[dict]) -> dict:
+    """Critical-path / bubble attribution for ONE request's spans on ONE
+    corrected timeline (apply `estimate_offsets` first for cross-host
+    trees).
+
+    Work = the tree's LEAF spans (a parent span's self-time is
+    coordination around its children), minus spans that MEASURE waiting
+    (`queue_wait` — a leaf by construction, but its whole meaning is
+    "no stage was working yet"; counting it as work would make an
+    overloaded server read bubble-free). The sweep walks from the
+    root's start to its end; at each instant the active leaf reaching
+    furthest is "the" critical span, and instants covered by no leaf
+    are BUBBLE — wall time no stage was working on the request
+    (queueing, wire, scheduler gaps, pipeline stalls). Returns:
+
+        {"total_s", "work_s", "bubble_s", "bubble_fraction",
+         "path": [{"name", "stage", "enter_s", "exit_s"}, ...],
+         "per_stage_busy_s": {stage: s}}
+
+    `enter_s`/`exit_s` are relative to request start; a span appears in
+    `path` only for the segment where it gates progress."""
+    if not spans:
+        return {"total_s": 0.0, "work_s": 0.0, "bubble_s": 0.0,
+                "bubble_fraction": 0.0, "path": [],
+                "per_stage_busy_s": {}}
+    by_id = {s["span_id"]: s for s in spans}
+    has_child: set = set()
+    for s in spans:
+        p = s.get("parent_id")
+        if p in by_id:
+            has_child.add(p)
+    roots = [s for s in spans if s.get("parent_id") not in by_id]
+    root = min(roots, key=lambda s: s["ts"]) if roots \
+        else min(spans, key=lambda s: s["ts"])
+    t0 = root["ts"]
+    t1 = root["ts"] + (root.get("dur") or 0.0)
+    leaves = [s for s in spans
+              if s["span_id"] not in has_child and s is not root
+              and s["name"] not in _WAIT_SPAN_NAMES]
+    if not leaves:
+        leaves = [root]
+    ivs = []
+    for s in leaves:
+        a = max(s["ts"], t0)
+        b = min(s["ts"] + (s.get("dur") or 0.0), t1)
+        if b > a:
+            ivs.append((a, b, s))
+    ivs.sort(key=lambda x: (x[0], -x[1]))
+    per_stage: Dict[str, float] = defaultdict(float)
+    # union coverage for work_s / per-stage busy
+    cur_a = cur_b = None
+    work = 0.0
+    for a, b, s in ivs:
+        stage = (s.get("attrs") or {}).get("stage") \
+            or s.get("_stage") or "?"
+        per_stage[stage] += b - a
+        if cur_b is None or a > cur_b:
+            if cur_b is not None:
+                work += cur_b - cur_a
+            cur_a, cur_b = a, b
+        else:
+            cur_b = max(cur_b, b)
+    if cur_b is not None:
+        work += cur_b - cur_a
+    # greedy chain: at time t, the active interval reaching furthest
+    path = []
+    t = t0
+    i = 0
+    n = len(ivs)
+    while t < t1 and i < n:
+        best = None
+        j = i
+        while j < n and ivs[j][0] <= t + 1e-9:
+            if best is None or ivs[j][1] > best[1]:
+                best = ivs[j]
+            j += 1
+        if best is None or best[1] <= t + 1e-9:
+            # bubble: jump to the next interval's start
+            nxt = ivs[i][0] if ivs[i][0] > t else None
+            for a, b, _ in ivs[i:]:
+                if a > t and b > t:
+                    nxt = a
+                    break
+            if nxt is None:
+                break
+            t = nxt
+            continue
+        a, b, s = best
+        path.append({
+            "name": s["name"],
+            "stage": (s.get("attrs") or {}).get("stage")
+            or s.get("_stage") or "?",
+            "enter_s": round(max(t, a) - t0, 6),
+            "exit_s": round(b - t0, 6),
+        })
+        t = b
+        while i < n and ivs[i][1] <= t + 1e-9:
+            i += 1
+    total = max(t1 - t0, 0.0)
+    work = min(work, total)
+    return {
+        "total_s": round(total, 6),
+        "work_s": round(work, 6),
+        "bubble_s": round(total - work, 6),
+        "bubble_fraction": round(1.0 - work / total, 4) if total else 0.0,
+        "path": path,
+        "per_stage_busy_s": {k: round(v, 6)
+                             for k, v in sorted(per_stage.items())},
+    }
+
+
+# ----------------------------------------------------------------------
+# the collector
+# ----------------------------------------------------------------------
+
+def targets_from_config(config, metrics_port: int) -> Dict[str, str]:
+    """{stage name: obs base URL} from a pipeline TopologyConfig (or a
+    path to one): every node's host + one shared metrics port — the
+    deployment convention where each node passes the same
+    --metrics_port."""
+    if isinstance(config, str):
+        from dnn_tpu.config import TopologyConfig
+
+        config = TopologyConfig.from_json(config)
+    out = {}
+    for node in config.nodes:
+        host = (node.address or "127.0.0.1").rsplit(":", 1)[0]
+        out[node.id] = f"http://{host}:{metrics_port}"
+    if len(set(out.values())) != len(out):
+        # same-host nodes share one derived URL: one endpoint would be
+        # polled under N names and the others silently never — refuse
+        # rather than double-count
+        raise ValueError(
+            "pipeline config derives duplicate obs URLs (multiple nodes "
+            "share a host, so one --metrics_port cannot address them "
+            f"all): {out} — pass explicit per-stage targets instead "
+            "(--fleet_targets / --targets)")
+    return out
+
+
+class FleetCollector:
+    """Poll every stage's obs endpoint; serve the merged view.
+
+    `targets`: {stage name: base URL} (or a list of URLs — names derive
+    from the URLs). `interval_s`: poll period of the daemon thread
+    (`start()`); `poll_once()` polls synchronously (the one-shot report
+    path). All state is swapped atomically under a lock, so /fleetz
+    renders a consistent snapshot while the poller runs."""
+
+    def __init__(self, targets, *, interval_s: float = 5.0,
+                 timeout_s: float = 5.0, span_cap: int = 20000):
+        if isinstance(targets, (list, tuple)):
+            targets = {u.split("//")[-1]: u for u in targets}
+        self.targets: Dict[str, str] = {
+            name: url.rstrip("/") for name, url in targets.items()}
+        if not self.targets:
+            raise ValueError("fleet collector needs at least one target")
+        self.interval_s = float(interval_s)
+        self.timeout_s = float(timeout_s)
+        self._span_cap = int(span_cap)
+        self._lock = threading.Lock()
+        self._snaps: Dict[str, dict] = {}
+        # per-stage span cache keyed by span_id: successive polls of a
+        # stage's bounded ring overlap; the cache keeps the union
+        # (bounded — oldest evicted) so stitching sees whole requests
+        # even when a poll lands mid-request
+        self._spans: Dict[str, Dict[str, dict]] = {
+            name: {} for name in self.targets}
+        # derived-at-poll-time caches: offsets and trace-id ranking only
+        # change when the span caches do, so scrapes (/fleetz every few
+        # seconds) must not recompute them from full span copies
+        self._offsets: Dict[str, float] = {}
+        self._tids: List[str] = []
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self._polls = 0
+
+    # -- polling -------------------------------------------------------
+
+    def _fetch(self, url: str) -> str:
+        with urlopen(url, timeout=self.timeout_s) as r:
+            return r.read().decode()
+
+    def _poll_target(self, name: str, url: str) -> dict:
+        snap = {"url": url, "t": time.time(), "ok": False,
+                "state": "unreachable", "error": None,
+                "statusz": None, "metrics": None}
+        try:
+            snap["statusz"] = json.loads(self._fetch(url + "/statusz"))
+            snap["metrics"] = parse_prometheus(
+                self._fetch(url + "/metrics"))
+            spans = []
+            for ln in self._fetch(url + "/trace.jsonl").splitlines():
+                ln = ln.strip()
+                if ln:
+                    try:
+                        spans.append(json.loads(ln))
+                    except ValueError:
+                        pass
+            with self._lock:
+                # scrape threads snapshot these caches under the same
+                # lock (spans_by_stage) — hold it for the mutation so
+                # the docstring's atomic-swap claim covers spans too
+                cache = self._spans[name]
+                for s in spans:
+                    if "span_id" in s:
+                        cache[s["span_id"]] = s
+                while len(cache) > self._span_cap:
+                    cache.pop(next(iter(cache)))
+            snap["ok"] = True
+            snap["state"] = (snap["statusz"] or {}).get("state", "ok")
+            if snap["state"] not in _STATE_RANK:
+                snap["state"] = "ok"
+        except Exception as e:  # noqa: BLE001 — a down stage is a DATUM
+            snap["error"] = str(e)[:200]  # (unreachable), never a crash
+        return snap
+
+    def poll_once(self) -> dict:
+        """Poll every target (concurrently — one slow stage must not
+        delay the others' freshness) and swap in the new snapshots."""
+        results: Dict[str, dict] = {}
+        threads = []
+
+        def run(name, url):
+            results[name] = self._poll_target(name, url)
+
+        for name, url in self.targets.items():
+            t = threading.Thread(target=run, args=(name, url),
+                                 daemon=True)
+            t.start()
+            threads.append(t)
+        for t in threads:
+            t.join(self.timeout_s * 2 + 5)
+        # recompute the span-derived caches once per poll (a straggler
+        # worker past its join timeout may still be ingesting — snapshot
+        # under the lock it writes under)
+        by_stage = self.spans_by_stage()
+        offs = estimate_offsets(by_stage)
+        counts: Dict[str, int] = defaultdict(int)
+        for spans in by_stage.values():
+            for s in spans:
+                tid = s.get("trace_id")
+                if tid:
+                    counts[tid] += 1
+        tids = [t for t, _ in
+                sorted(counts.items(), key=lambda kv: -kv[1])]
+        with self._lock:
+            self._snaps.update(results)
+            self._offsets = offs
+            self._tids = tids
+            self._polls += 1
+        return results
+
+    def start(self) -> "FleetCollector":
+        def loop():
+            while not self._stop.wait(
+                    0.0 if self._polls == 0 else self.interval_s):
+                try:
+                    self.poll_once()
+                except Exception:  # noqa: BLE001 — keep polling
+                    log.exception("fleet poll failed")
+
+        self._thread = threading.Thread(target=loop, daemon=True,
+                                        name="obs-fleet-poller")
+        self._thread.start()
+        return self
+
+    def close(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+    # -- merged views --------------------------------------------------
+
+    def status(self) -> dict:
+        """Watchdog-shaped status for the fleet endpoint's /statusz +
+        /healthz (obs/http.py expects {"state", "components"}): each
+        stage is a component, the fleet state is the worst of them
+        mapped onto ok|degraded|wedged (unreachable counts as wedged —
+        the pipeline through that stage is down). Never-yet-polled
+        reads degraded, not wedged: a collector that just started has
+        no evidence either way."""
+        with self._lock:
+            snaps = dict(self._snaps)
+        comps = {}
+        worst = "ok"
+        for name in self.targets:
+            snap = snaps.get(name)
+            if snap is None:
+                st, detail = "degraded", "not polled yet"
+            else:
+                st = snap["state"]
+                detail = snap["error"] or f"polled {snap['url']}"
+            comps[name] = {"state": _STATE_AS_WATCHDOG[st],
+                           "raw_state": st, "detail": detail}
+            if _STATE_RANK.get(st, 1) > _STATE_RANK.get(worst, 0):
+                worst = st
+        return {"state": _STATE_AS_WATCHDOG[worst], "fleet_state": worst,
+                "components": comps, "t": time.time()}
+
+    def spans_by_stage(self) -> Dict[str, List[dict]]:
+        with self._lock:
+            return {name: list(cache.values())
+                    for name, cache in self._spans.items()}
+
+    def offsets(self) -> Dict[str, float]:
+        with self._lock:
+            return dict(self._offsets)
+
+    def trace_ids(self) -> List[str]:
+        """Distinct trace ids across the fleet, most-spanned first —
+        the head is the best candidate for a complete stitched request.
+        Computed at poll time (poll_once), not per scrape."""
+        with self._lock:
+            return list(self._tids)
+
+    def stitch(self, trace_id: Optional[str] = None) -> dict:
+        """One Perfetto JSON across all stages on the corrected
+        timeline; `trace_id` restricts to one request."""
+        return stitch_spans(self.spans_by_stage(), self.offsets(),
+                            trace_id=trace_id)
+
+    def request_report(self, trace_id: Optional[str] = None) -> dict:
+        """Critical-path/bubble attribution for one request (default:
+        the most-spanned trace). Spans are flattened across stages with
+        offsets applied and each tagged with its stage."""
+        by_stage = self.spans_by_stage()
+        if trace_id is None:
+            ids = self.trace_ids()
+            if not ids:
+                return {"error": "no traces collected yet"}
+            trace_id = ids[0]
+        offs = self.offsets()
+        flat, seen = [], set()
+        for stage, spans in by_stage.items():
+            off = offs.get(stage, 0.0)
+            for s in spans:
+                if s.get("trace_id") != trace_id or s["span_id"] in seen:
+                    continue
+                seen.add(s["span_id"])
+                c = dict(s)
+                c["ts"] = s["ts"] - off
+                c["_stage"] = stage
+                flat.append(c)
+        rep = critical_path(flat)
+        rep["trace_id"] = trace_id
+        rep["spans"] = len(flat)
+        return rep
+
+    def _stage_row(self, snap: Optional[dict]) -> dict:
+        # never-yet-polled reads degraded (no evidence either way),
+        # matching status() — "unreachable" is reserved for a poll that
+        # actually failed, so a scrape between start() and the first
+        # completed poll can't page as a down stage
+        row = {"state": "degraded" if snap is None else snap["state"],
+               "url": None if snap is None else snap["url"],
+               "error": "not polled yet" if snap is None
+               else snap["error"]}
+        if snap is None or snap["metrics"] is None:
+            return row
+        s = _Samples(snap["metrics"])
+        ms = lambda v: None if v is None else round(v * 1e3, 3)  # noqa: E731
+        row.update({
+            "tokens_per_sec": s.get("serving_tokens_per_sec"),
+            "goodput_tokens_per_sec":
+                s.get("dnn_tpu_goodput_tokens_per_sec"),
+            "mfu": s.get("dnn_tpu_mfu"),
+            "mbu": s.get("dnn_tpu_mbu"),
+            "queue_depth": s.get("serving_queue_depth"),
+            "occupancy": s.get("serving_batch_occupancy"),
+            "requests_total": s.sum("serving_requests_total"),
+            "ttft_p50_ms": ms(s.get("serving_ttft_seconds",
+                                    quantile="0.5")),
+            "ttft_p99_ms": ms(s.get("serving_ttft_seconds",
+                                    quantile="0.99")),
+            "inter_token_p50_ms": ms(s.get("serving_inter_token_seconds",
+                                           quantile="0.5")),
+            "inter_token_p99_ms": ms(s.get("serving_inter_token_seconds",
+                                           quantile="0.99")),
+            "queue_wait_p99_ms": ms(s.get("serving_queue_wait_seconds",
+                                          quantile="0.99")),
+            "rpc_p50_ms": ms(s.hist_quantile("comm_rpc_latency_seconds",
+                                             0.5)),
+            "rpc_p99_ms": ms(s.hist_quantile("comm_rpc_latency_seconds",
+                                             0.99)),
+            "compiles_total": s.get("jax_compilations_total"),
+            "slo_burn": {
+                labs.get("slo"): v
+                for name, labs, v in snap["metrics"]["samples"]
+                if name == "dnn_tpu_slo_burn_rate"} or None,
+        })
+        return row
+
+    def fleetz(self) -> dict:
+        """The merged fleet view (/fleetz): worst-of state, per-stage
+        health + percentile tables side by side, fleet totals, clock
+        offsets, and the current best-known trace ids."""
+        with self._lock:
+            snaps = dict(self._snaps)
+            polls = self._polls
+        stages = {name: self._stage_row(snaps.get(name))
+                  for name in self.targets}
+        status = self.status()
+
+        def total(key):
+            vals = [r[key] for r in stages.values()
+                    if r.get(key) is not None]
+            return round(sum(vals), 3) if vals else None
+
+        return {
+            "state": status["fleet_state"],
+            "stages": stages,
+            "fleet": {
+                "tokens_per_sec": total("tokens_per_sec"),
+                "goodput_tokens_per_sec": total("goodput_tokens_per_sec"),
+                "requests_total": total("requests_total"),
+                "stages_total": len(self.targets),
+                "stages_ok": sum(1 for r in stages.values()
+                                 if r["state"] == "ok"),
+            },
+            "clock_offsets_s": {k: round(v, 6)
+                                for k, v in self.offsets().items()},
+            "trace_ids": self.trace_ids()[:20],
+            "polls": polls,
+            "t": time.time(),
+        }
+
+    def render_prom(self) -> str:
+        """The fleet view re-exported in Prometheus text format (the
+        /fleetz?format=prom passthrough): per-stage up/state plus the
+        fleet totals, so one scrape of the collector covers the fleet's
+        health without N scrape configs."""
+        from dnn_tpu.utils.metrics import Metrics, labeled, \
+            render_prometheus
+
+        z = self.fleetz()
+        m = Metrics()
+        m.set("dnn_tpu_fleet_state",
+              float(_STATE_RANK.get(z["state"], 1)))
+        for key in ("tokens_per_sec", "goodput_tokens_per_sec"):
+            if z["fleet"][key] is not None:
+                m.set(f"dnn_tpu_fleet_{key}", z["fleet"][key])
+        m.set("dnn_tpu_fleet_stages_ok", z["fleet"]["stages_ok"])
+        m.set("dnn_tpu_fleet_stages_total", z["fleet"]["stages_total"])
+        for name, row in z["stages"].items():
+            m.set(labeled("dnn_tpu_fleet_stage_up", stage=name),
+                  1.0 if row["state"] == "ok" else 0.0)
+            m.set(labeled("dnn_tpu_fleet_stage_state", stage=name),
+                  float(_STATE_RANK.get(row["state"], 1)))
+            for key in ("tokens_per_sec", "mfu", "mbu"):
+                if row.get(key) is not None:
+                    m.set(labeled(f"dnn_tpu_fleet_stage_{key}",
+                                  stage=name), row[key])
+        for stage, off in z["clock_offsets_s"].items():
+            m.set(labeled("dnn_tpu_fleet_clock_offset_seconds",
+                          stage=stage), off)
+        return render_prometheus(m)
+
+    # -- the one-shot terminal report ----------------------------------
+
+    def report(self, trace_id: Optional[str] = None) -> str:
+        """Human-readable fleet report (the CLI's default output)."""
+        z = self.fleetz()
+        lines = [f"fleet state: {z['state']}  "
+                 f"({z['fleet']['stages_ok']}/{z['fleet']['stages_total']}"
+                 f" stages ok)"]
+        cols = [("state", 11), ("tokens_per_sec", 9),
+                ("mfu", 7), ("mbu", 7), ("queue_depth", 6),
+                ("ttft_p99_ms", 12), ("inter_token_p99_ms", 13),
+                ("rpc_p99_ms", 11)]
+        hdr = "stage".ljust(14) + "".join(h.rjust(w + 1)
+                                          for h, w in cols)
+        lines.append(hdr)
+
+        def fmt(v, w):
+            if v is None:
+                return "-".rjust(w + 1)
+            if isinstance(v, float):
+                return f"{v:.3g}".rjust(w + 1)
+            return str(v).rjust(w + 1)
+
+        for name, row in z["stages"].items():
+            lines.append(name.ljust(14) + "".join(
+                fmt(row.get(h), w) for h, w in cols))
+        ft = z["fleet"]
+        if ft["tokens_per_sec"] is not None:
+            lines.append(f"fleet total tokens/sec: "
+                         f"{ft['tokens_per_sec']}")
+        offs = {k: v for k, v in z["clock_offsets_s"].items()
+                if abs(v) > 1e-4}
+        if offs:
+            lines.append("clock offsets (s, vs anchor): " + ", ".join(
+                f"{k}={v:+.4f}" for k, v in offs.items()))
+        rep = self.request_report(trace_id)
+        if "error" not in rep:
+            lines.append(
+                f"request {rep['trace_id']}: total "
+                f"{rep['total_s'] * 1e3:.1f} ms, bubble "
+                f"{rep['bubble_fraction'] * 100:.1f}% "
+                f"({rep['bubble_s'] * 1e3:.1f} ms idle)")
+            for seg in rep["path"][:12]:
+                lines.append(
+                    f"  {seg['enter_s'] * 1e3:8.2f}.."
+                    f"{seg['exit_s'] * 1e3:8.2f} ms  "
+                    f"[{seg['stage']}] {seg['name']}")
+        return "\n".join(lines)

@@ -13,9 +13,21 @@ Prometheus scraper or a plain curl can watch the serving stack:
                        (obs/watchdog.py) when one is attached; an
                        optional `healthy` callable (worker thread
                        liveness) downgrades to 503 "unhealthy"
-    GET  /statusz      watchdog state with per-component detail (JSON)
-    GET  /debugz       flight-recorder ring as JSONL (obs/flight.py);
-                       ?kind= ?trace= filter, ?last=N keeps newest N
+    GET  /statusz      watchdog state with per-component detail (JSON;
+                       ?format=prom re-renders it as Prometheus gauges
+                       for scrape-only collectors)
+    GET  /debugz       flight-recorder ring as JSONL (obs/flight.py;
+                       Content-Type application/x-ndjson); ?format=json
+                       returns a proper JSON array (application/json) —
+                       pollers never sniff; ?kind= ?trace= filter,
+                       ?last=N keeps newest N
+    GET  /fleetz       merged fleet view (obs/fleet.py) when a
+                       FleetCollector is attached: worst-of health,
+                       per-stage tables, totals, clock offsets
+                       (?format=prom re-exports it as Prometheus text;
+                       ?format=trace returns the stitched cross-host
+                       Perfetto JSON, ?id=<trace> for one request;
+                       ?format=report the human-readable text)
     GET  /trace        Chrome-trace JSON of collected spans; ?id=<trace>
                        filters to one request's tree (load the response
                        in Perfetto / chrome://tracing)
@@ -41,6 +53,24 @@ from urllib.parse import parse_qs, urlparse
 
 log = logging.getLogger("dnn_tpu.obs")
 
+_STATE_GAUGE = {"ok": 0.0, "degraded": 1.0, "wedged": 2.0}
+
+
+def _status_prom(status: dict) -> str:
+    """Render a /statusz payload (watchdog or fleet shape) as Prometheus
+    gauges: dnn_tpu_status_state 0|1|2 (ok|degraded|wedged) plus one
+    per-component series — the ?format=prom passthrough for collectors
+    that only speak scrapes."""
+    from dnn_tpu.utils.metrics import Metrics, labeled, render_prometheus
+
+    m = Metrics()
+    m.set("dnn_tpu_status_state",
+          _STATE_GAUGE.get(status.get("state"), 1.0))
+    for name, comp in (status.get("components") or {}).items():
+        m.set(labeled("dnn_tpu_status_component_state", component=name),
+              _STATE_GAUGE.get((comp or {}).get("state"), 1.0))
+    return render_prometheus(m)
+
 
 class MetricsHTTPServer:
     """Serve the shared registry + span collector + flight ring (or
@@ -62,7 +92,7 @@ class MetricsHTTPServer:
                  registry=None, collector=None,
                  healthy: Optional[Callable[[], bool]] = None,
                  status: Optional[Callable[[], dict]] = None,
-                 profiler=None, flight=None):
+                 profiler=None, flight=None, fleet=None):
         from dnn_tpu import obs
         from dnn_tpu.obs import flight as _flight
         from dnn_tpu.utils import metrics as _metrics
@@ -76,6 +106,13 @@ class MetricsHTTPServer:
         self._healthy = healthy
         self._status = status
         self._profiler = profiler
+        # fleet collector (obs/fleet.FleetCollector): serves /fleetz;
+        # when no explicit `status` is given the fleet's worst-of
+        # rollup also becomes /statusz + /healthz (503 on a wedged or
+        # unreachable stage — the fleet endpoint's health IS the fleet's)
+        self._fleet = fleet
+        if fleet is not None and status is None:
+            self._status = fleet.status
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -91,7 +128,10 @@ class MetricsHTTPServer:
                 self.wfile.write(data)
 
             def _send_json(self, code: int, obj):
-                self._send(code, json.dumps(obj), "application/json")
+                # default=str: flight events may carry exotic values;
+                # degrading one to its repr beats failing the dump
+                self._send(code, json.dumps(obj, default=str),
+                           "application/json")
 
             def _statusz(self):
                 if outer._status is not None:
@@ -115,6 +155,30 @@ class MetricsHTTPServer:
                 self._send(503 if state == "wedged" else 200,
                            state + "\n", "text/plain; charset=utf-8")
 
+            def _fleetz(self, q):
+                if outer._fleet is None:
+                    self._send(404, "no fleet collector attached\n",
+                               "text/plain; charset=utf-8")
+                    return
+                fmt = q.get("format", ["json"])[0]
+                if fmt == "json":
+                    self._send_json(200, outer._fleet.fleetz())
+                elif fmt == "prom":
+                    self._send(200, outer._fleet.render_prom(),
+                               "text/plain; version=0.0.4; charset=utf-8")
+                elif fmt == "trace":
+                    tid = q.get("id", [None])[0]
+                    self._send(200, json.dumps(outer._fleet.stitch(tid)),
+                               "application/json")
+                elif fmt == "report":
+                    tid = q.get("id", [None])[0]
+                    self._send(200, outer._fleet.report(tid) + "\n",
+                               "text/plain; charset=utf-8")
+                else:
+                    self._send(400, f"unknown format {fmt!r} "
+                               "(json|prom|trace|report)\n",
+                               "text/plain; charset=utf-8")
+
             def do_GET(self):
                 try:
                     url = urlparse(self.path)
@@ -126,7 +190,20 @@ class MetricsHTTPServer:
                     elif url.path == "/healthz":
                         self._healthz()
                     elif url.path == "/statusz":
-                        self._send_json(200, self._statusz())
+                        fmt = q.get("format", ["json"])[0]
+                        if fmt == "prom":
+                            # scrape-only collectors ingest status as
+                            # gauges instead of sniffing JSON
+                            self._send(200,
+                                       _status_prom(self._statusz()),
+                                       "text/plain; version=0.0.4; "
+                                       "charset=utf-8")
+                        elif fmt == "json":
+                            self._send_json(200, self._statusz())
+                        else:
+                            self._send(400, f"unknown format {fmt!r} "
+                                       "(json|prom)\n",
+                                       "text/plain; charset=utf-8")
                     elif url.path == "/debugz":
                         filters = {}
                         if "kind" in q:
@@ -140,8 +217,23 @@ class MetricsHTTPServer:
                                 self._send(400, "last must be an int\n",
                                            "text/plain; charset=utf-8")
                                 return
-                        self._send(200, outer._flight.jsonl(**filters),
-                                   "application/jsonl")
+                        fmt = q.get("format", ["jsonl"])[0]
+                        if fmt == "json":
+                            # a proper JSON array for pollers; the
+                            # JSONL default stays for `obs flight --url`
+                            # and log-shipper tails
+                            self._send_json(200,
+                                            outer._flight.events(**filters))
+                        elif fmt == "jsonl":
+                            self._send(200,
+                                       outer._flight.jsonl(**filters),
+                                       "application/x-ndjson")
+                        else:
+                            self._send(400, f"unknown format {fmt!r} "
+                                       "(jsonl|json)\n",
+                                       "text/plain; charset=utf-8")
+                    elif url.path == "/fleetz":
+                        self._fleetz(q)
                     elif url.path == "/profilez":
                         if outer._profiler is None:
                             self._send(404, "no profiler attached\n",

@@ -173,6 +173,10 @@ class _BatcherWorker(threading.Thread):
         # disarmed
         self.auto_profile = None
         self._profile_hit = False
+        # goodput/SLO tracker (obs/goodput.py): LMServer points this at
+        # its GoodputTracker — _admit feeds the TTFT objective; one
+        # None check when off
+        self.goodput = None
         self._held_logged = None  # last item whose hold hit the flight
         # ring — identity-gates the per-retry held_back event
         # _lock serializes submit against the dead-marking in _fail_all /
@@ -206,6 +210,8 @@ class _BatcherWorker(threading.Thread):
         with self._lock:
             if self._dead is not None:
                 fut.set_exception(self._dead)
+                if (g := self.goodput) is not None:
+                    g.on_outcome(False)  # fast-fails burn availability
                 return fut
             self.q.put(_QueuedRequest(prompt, max_new, seed, opts,
                                       on_token, cancel_evt, trace,
@@ -290,9 +296,11 @@ class _BatcherWorker(threading.Thread):
             m.observe("serving.queue_wait_seconds", wait)
             # end-to-end TTFT: enqueue -> first token (sampled during the
             # batcher's prefill, which submit() just completed)
-            m.observe("serving.ttft_seconds",
-                      time.perf_counter() - item.t_q)
+            ttft = time.perf_counter() - item.t_q
+            m.observe("serving.ttft_seconds", ttft)
             m.set_fn("serving.queue_depth", self.q.qsize)
+            if (g := self.goodput) is not None:
+                g.on_ttft(ttft)  # SLO burn-rate window (obs/goodput.py)
         if item.trace:
             obs.record_span("queue_wait", item.t_q, wait,
                             parent=item.trace)
@@ -370,17 +378,27 @@ class _BatcherWorker(threading.Thread):
     def _fail_all(self, exc):
         with self._lock:
             self._dead = exc  # submits from here on fail immediately
+            failed = len(self._futures)
             for rec in self._futures.values():
                 _fail_future(rec["fut"], exc)
             self._futures.clear()
             if self._held is not None:
                 held, self._held = self._held, None
                 _fail_future(held.fut, exc)
+                failed += 1
             while True:
                 try:
                     _fail_future(self.q.get_nowait().fut, exc)
+                    failed += 1
                 except queue.Empty:
-                    return
+                    break
+        # error-path failures must burn the availability budget too — a
+        # worker death that fails every in-flight request is exactly the
+        # outage the objective exists to page on (retirement-path
+        # outcomes feed from _obs_retire; this path never retires)
+        if (g := self.goodput) is not None:
+            for _ in range(failed):
+                g.on_outcome(False)
 
     def _step_pool(self, b):
         """One pool step, with the auto-profile arm folded in: disarmed
@@ -526,6 +544,7 @@ class LMServer:
                  compile_cache_budget: int = 512,
                  metrics_port: Optional[int] = None,
                  watchdog=None,
+                 goodput=None, slo=None,
                  **batcher_kwargs):
         # observability first: the compile listener must be live before
         # the batcher's first program compiles, so jax_compilations_total
@@ -603,6 +622,36 @@ class LMServer:
             self.worker.step_done = self._watchdog.step_done
             if not self._watchdog._thread.is_alive():
                 self._watchdog.start()
+        # live goodput accounting (obs/goodput.py): dnn_tpu_mfu /
+        # dnn_tpu_mbu / dnn_tpu_goodput_tokens_per_sec scrape-time
+        # gauges + optional SLO burn rates. `goodput` is None (auto:
+        # build from the model config when obs is enabled), False (off),
+        # or a prebuilt GoodputTracker. `slo` is an obs.goodput.
+        # SLOConfig (implies auto-build when goodput is None).
+        self.goodput = None
+        if goodput is None and obs.enabled():
+            from dnn_tpu.obs.goodput import GoodputTracker, model_cost
+
+            try:
+                import jax.numpy as jnp
+
+                # same fallback chain as the batcher's cache allocation
+                # (serving.py: kv_dtype, else the family's resolved
+                # compute_dtype, else f32) — a bf16 server must not have
+                # its MBU KV term priced at f32 width
+                kvb = jnp.dtype(
+                    batcher_kwargs.get("kv_dtype")
+                    or getattr(self.batcher.family, "compute_dtype", None)
+                    or jnp.float32).itemsize
+            except Exception:  # noqa: BLE001 — exotic kv_dtype spec
+                kvb = 2
+            self.goodput = GoodputTracker(
+                model_cost(cfg, prepared, kv_bytes=kvb), slo=slo).install()
+        elif goodput:
+            self.goodput = goodput.install()
+        if self.goodput is not None:
+            self.batcher.goodput = self.goodput
+            self.worker.goodput = self.goodput
 
     @property
     def auto_profile(self):
@@ -796,6 +845,9 @@ class LMServer:
                 m = obs.metrics()
                 if m is not None:
                     m.inc("serving.deadline_exceeded_total")
+                # availability SLO: no direct feed here — the eviction
+                # retires through batcher.cancel -> _obs_retire
+                # ("cancelled"), which counts it against the budget once
                 # the post-mortem record: the dump (/debugz) carries this
                 # event plus whatever surrounded it (admissions, compiles,
                 # watchdog state flips) — the window a stall hides in

@@ -466,6 +466,12 @@ class ContinuousBatcher:
         # gated on obs.metrics() so DNN_TPU_OBS=off costs one None check
         self._tps = Throughput()
         self._bucket_keys: Dict[int, str] = {}
+        # live goodput accounting (obs/goodput.GoodputTracker): fed from
+        # the same obs-gated blocks as the series above, so it costs one
+        # attribute read when unset and nothing when the gate is off.
+        # Set post-construction (`pool.goodput = tracker`) — LMServer
+        # auto-builds one from its model config.
+        self.goodput = None
         # scrape-time callable gauges, (re-)registered with every bulk
         # update below: the most recently ACTIVE pool owns the series —
         # a once-only registration would let a dead pool keep reporting,
@@ -1111,6 +1117,8 @@ class ContinuousBatcher:
                                   [time.perf_counter() - t_pf]},
                     gauge_fns=self._obs_gauges,
                 )
+                if (g := self.goodput) is not None:
+                    g.on_prefill(len(prompt))
             self.pos = self.pos.at[slot].set(len(prompt))
             self.tok = self.tok.at[slot].set(first)
             self.active = self.active.at[slot].set(True)
@@ -1388,6 +1396,13 @@ class ContinuousBatcher:
             if samples else None,
             gauge_fns=self._obs_gauges,
         )
+        if (g := self.goodput) is not None:
+            # live MFU/MBU numerators + the inter-token SLO window
+            # (obs/goodput.py) — `live` is the summed live positions the
+            # high-water bookkeeping above already computed
+            g.on_decode_step(n_adv, live)
+            if samples:
+                g.on_inter_token(samples)
 
     def _tps_read(self) -> float:
         return self._tps.per_sec
@@ -1428,6 +1443,13 @@ class ContinuousBatcher:
         m = obs.metrics()
         if m is not None:
             m.inc(labeled("serving.requests_total", outcome=reason))
+            if (g := self.goodput) is not None:
+                # availability SLO: a natural retirement (eos/stop/
+                # length/constraint) served its caller; "cancelled"
+                # covers both client abandonment and deadline eviction —
+                # count it against the budget (the conservative side: a
+                # burn alert on mass cancellation is signal, not noise)
+                g.on_outcome(ok=reason != "cancelled")
         tr = req.get("trace")
         obs.flight.record("retire", rid=req["rid"], reason=reason,
                           tokens=len(req["emitted"]),

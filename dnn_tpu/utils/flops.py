@@ -41,7 +41,15 @@ _TPU_PEAK_BF16 = (
 def device_peak_flops(device: Optional[jax.Device] = None) -> Optional[float]:
     """bf16 peak FLOPs/s of `device` (default: the first default device), or
     None when unknown (CPU hosts, unrecognized accelerators) — callers omit
-    the mfu field rather than publish a made-up one."""
+    the mfu field rather than publish a made-up one. DNN_TPU_PEAK_FLOPS
+    overrides the table (the opt-in roofline for CPU hosts and
+    accelerators the table doesn't know; utilization numbers against an
+    operator-stated peak beat no numbers at all)."""
+    import os
+
+    env = _env_peak(os.environ.get("DNN_TPU_PEAK_FLOPS"))
+    if env is not None:
+        return env
     if device is None:
         device = jax.devices()[0]
     if device.platform != "tpu":
@@ -51,6 +59,24 @@ def device_peak_flops(device: Optional[jax.Device] = None) -> Optional[float]:
         if sub in kind:
             return peak
     return None
+
+
+def _env_peak(raw) -> Optional[float]:
+    """Parse an operator-stated roofline env var; garbage or <= 0 reads
+    as unset (the degrade-don't-crash rule every env knob follows —
+    DNN_TPU_PEAK_FLOPS=0 must mean "unknown", not ZeroDivisionError in
+    every MFU consumer)."""
+    if not raw:
+        return None
+    try:
+        v = float(raw)
+    except ValueError:
+        import logging
+
+        logging.getLogger("dnn_tpu.utils").warning(
+            "ignoring malformed peak override %r (want a number)", raw)
+        return None
+    return v if v > 0 else None
 
 
 def gpt_forward_flops(cfg, batch: int, seq: int) -> float:
@@ -77,6 +103,89 @@ def llama_forward_flops(cfg, batch: int, seq: int) -> float:
                    + 6 * seq * c * f          # gate + up + down
                    + 4 * seq * seq * c)       # attention score/value
     return float(batch) * (per_seq + 2 * seq * c * v)
+
+
+# ----------------------------------------------------------------------
+# serving-shape accounting (dnn_tpu/obs/goodput.py): one DECODED token's
+# FLOPs and HBM bytes. Decode runs T=1 forwards against a live cache, so
+# the per-token cost depends on the CONTEXT (cache positions attended),
+# not on a full-sequence T^2 charge — these helpers price what the decode
+# program actually executes, which is what live MFU/MBU must divide by.
+# ----------------------------------------------------------------------
+
+def gpt_param_count(cfg) -> float:
+    """Analytic parameter count of the GPT family (models/gpt.py layout:
+    wte V*C + wpe block*C + per layer qkv 3C^2 + attn proj C^2 + mlp
+    8C^2 + biases/norms ~4C, + lm_head V*C materialized untied + ln_f).
+    Within ~0.1% of the real tree at gpt2 shapes — close enough for the
+    weight-streaming MBU denominator."""
+    c, l, v = cfg.n_embd, cfg.n_layer, cfg.vocab_size
+    per_layer = 12 * c * c + 13 * c  # qkv/proj/mlp kernels + their biases
+    # + 2 layernorms (scale+bias)
+    return float(v * c + cfg.block_size * c + l * per_layer
+                 + 2 * c            # ln_f
+                 + v * c)           # lm_head (materialized even when tied)
+
+
+def llama_param_count(cfg) -> float:
+    """Analytic parameter count of the LLaMA family (models/llama.py):
+    embed V*C + per layer q C*(H*D) + k/v 2*C*(KV*D) + o (H*D)*C +
+    SwiGLU 3*C*F + 2 RMSNorm scales, + final norm + lm_head (absent when
+    tie_word_embeddings)."""
+    c, l, v, f = cfg.n_embd, cfg.n_layer, cfg.vocab_size, cfg.d_ff
+    q_width = cfg.n_head * cfg.head_dim
+    kv_width = cfg.n_kv_head * cfg.head_dim
+    per_layer = (c * q_width + 2 * c * kv_width + q_width * c
+                 + 3 * c * f + 2 * c)
+    head = 0 if getattr(cfg, "tie_word_embeddings", False) else v * c
+    return float(v * c + l * per_layer + c + head)
+
+
+def gpt_decode_token_flops(cfg, context: float) -> float:
+    """FLOPs to decode ONE token with `context` live cache positions: the
+    T=1 forward's linear matmuls (24*C^2 per layer: qkv 6C^2 + proj 2C^2
+    + mlp 16C^2, the 2*m*k*n convention at m=1) plus the score/value
+    matmuls against the cache (4*context*C per layer) plus the 2*C*V
+    head. This is what the decode program executes — the live-MFU
+    numerator, NOT the full-T^2 prefill charge."""
+    c, l, v = cfg.n_embd, cfg.n_layer, cfg.vocab_size
+    return l * (24.0 * c * c + 4.0 * context * c) + 2.0 * c * v
+
+
+def llama_decode_token_flops(cfg, context: float) -> float:
+    """LLaMA-family decode-token FLOPs at `context` live positions:
+    q/o 2C*(H*D) each, k/v 2*C*(KV*D) each, SwiGLU 6*C*F, attention
+    4*context*(H*D) (every query head attends the full context — GQA
+    narrows the cache, not the score/value FLOPs), + the 2*C*V head."""
+    c, l, v, f = cfg.n_embd, cfg.n_layer, cfg.vocab_size, cfg.d_ff
+    q_width = cfg.n_head * cfg.head_dim
+    kv_width = cfg.n_kv_head * cfg.head_dim
+    per_layer = (2.0 * c * q_width + 2.0 * 2.0 * c * kv_width
+                 + 2.0 * q_width * c + 6.0 * c * f
+                 + 4.0 * context * q_width)
+    return l * per_layer + 2.0 * c * v
+
+
+def kv_bytes_per_pos(cfg, *, kv_bytes: int = 2) -> float:
+    """HBM bytes one cache POSITION occupies (K + V rows across all
+    layers) — decode streams `context` of these per token, and prefill
+    writes one per prompt position. GQA caches carry n_kv_head*head_dim
+    per row; dense GPT carries C."""
+    kv_width = (cfg.n_kv_head * cfg.head_dim
+                if hasattr(cfg, "n_kv_head") else cfg.n_embd)
+    return float(2 * cfg.n_layer * kv_width * kv_bytes)
+
+
+def decode_step_bytes(weight_bytes: float, kv_live_positions: float,
+                      cfg, *, kv_bytes: int = 2) -> float:
+    """HBM traffic of ONE decode step over a whole slot pool: the weights
+    stream once per STEP (shared by every active row — batching's whole
+    point) plus every live row's cache positions. `weight_bytes` is the
+    total parameter bytes (count the real tree when you have it:
+    goodput.ModelCost.from_prepared); `kv_live_positions` the summed
+    live positions across active slots. The live-MBU numerator."""
+    return float(weight_bytes) + float(kv_live_positions) * \
+        kv_bytes_per_pos(cfg, kv_bytes=kv_bytes)
 
 
 def gpt_train_step_flops(cfg, batch: int, seq: int) -> float:
@@ -164,7 +273,13 @@ _TPU_PEAK_HBM = (
 
 
 def device_peak_hbm_bw(device: Optional[jax.Device] = None) -> Optional[float]:
-    """HBM peak bytes/s of `device`, or None when unknown (CPU hosts)."""
+    """HBM peak bytes/s of `device`, or None when unknown (CPU hosts).
+    DNN_TPU_PEAK_HBM_BW overrides, like DNN_TPU_PEAK_FLOPS above."""
+    import os
+
+    env = _env_peak(os.environ.get("DNN_TPU_PEAK_HBM_BW"))
+    if env is not None:
+        return env
     if device is None:
         device = jax.devices()[0]
     if device.platform != "tpu":

@@ -5,11 +5,21 @@ scattered through every code path (e.g. node.py:38-39, 120-122, 280-290 —
 SURVEY §5 'Metrics / logging': stdout prints only, no levels, no files)
 with stdlib logging: leveled, timestamped, and still carrying the node-id
 prefix so operators see the familiar shape.
+
+JSON mode (`DNN_TPU_LOG=json`, or `setup_logging(fmt="json")`): every
+record becomes one JSON object per line — ts/level/logger/msg plus
+node_id and, when the calling thread is inside an active request span,
+the TRACE ID (dnn_tpu/obs/trace.py) — so fleet-collected logs correlate
+with stitched traces: grep the trace id from /fleetz's request report
+and the matching log lines fall out of every stage's stream. Plain-text
+behavior is unchanged by default.
 """
 
 from __future__ import annotations
 
+import json
 import logging
+import os
 import sys
 from typing import Optional
 
@@ -24,18 +34,57 @@ class _NodeFilter(logging.Filter):
         return True
 
 
-def setup_logging(level: str = "INFO", *, node_id: Optional[str] = None, stream=None):
+class JSONFormatter(logging.Formatter):
+    """One JSON object per record. The active trace id (the contextvar-
+    backed ambient span, obs/trace.current_span) is injected when
+    present — the correlation key between a stage's logs and the
+    fleet's stitched cross-host traces."""
+
+    def format(self, record):
+        out = {
+            "ts": round(record.created, 6),
+            "level": record.levelname,
+            "logger": record.name,
+            "msg": record.getMessage(),
+        }
+        node_id = getattr(record, "node_id", None)
+        if node_id:
+            out["node_id"] = node_id
+        try:
+            from dnn_tpu.obs.trace import current_span
+
+            sp = current_span()
+            if sp is not None and sp.trace_id is not None:
+                out["trace_id"] = sp.trace_id
+        except Exception:  # noqa: BLE001 — logging must never raise
+            pass
+        if record.exc_info:
+            out["exc"] = self.formatException(record.exc_info)
+        return json.dumps(out, default=str)
+
+
+def setup_logging(level: str = "INFO", *, node_id: Optional[str] = None,
+                  stream=None, fmt: Optional[str] = None):
+    """Configure the dnn_tpu logger tree. `fmt` is "text" (default) or
+    "json"; None consults DNN_TPU_LOG (json|text), so operators flip
+    the whole fleet to structured logs with one env var and zero flag
+    plumbing."""
     root = logging.getLogger("dnn_tpu")
     root.setLevel(getattr(logging, str(level).upper(), logging.INFO))
     root.handlers.clear()
     handler = logging.StreamHandler(stream or sys.stderr)
-    prefix = "[%(node_id)s] " if node_id else ""
-    handler.setFormatter(
-        logging.Formatter(
-            f"%(asctime)s %(levelname)s %(name)s: {prefix}%(message)s",
-            datefmt="%H:%M:%S",
+    if fmt is None:
+        fmt = os.environ.get("DNN_TPU_LOG", "text").lower()
+    if fmt == "json":
+        handler.setFormatter(JSONFormatter())
+    else:
+        prefix = "[%(node_id)s] " if node_id else ""
+        handler.setFormatter(
+            logging.Formatter(
+                f"%(asctime)s %(levelname)s %(name)s: {prefix}%(message)s",
+                datefmt="%H:%M:%S",
+            )
         )
-    )
     if node_id:
         handler.addFilter(_NodeFilter(node_id))
     root.addHandler(handler)

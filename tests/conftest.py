@@ -61,6 +61,10 @@ _MODULE_COST_S = {
     "test_obs_v2": 36.0,  # obs v2 (flight recorder, watchdog, /profilez,
     # memory watermarks): the wedged-probe and crash-dump subprocess legs
     # dominate; placed with test_obs inside the tier-1 budget
+    "test_obs_fleet": 21.0,  # fleet layer (cross-host stitching, goodput
+    # MFU/MBU, SLO burn rates + the `obs fleet --selftest` CLI smoke):
+    # cheap HTTP endpoints + one real 2-stage gRPC request, certified
+    # inside the tier-1 budget ahead of the obs integration modules
     "test_grad_accum": 12.9, "test_train_ckpt": 14.3, "test_remat": 14.6,
     "test_qwen2": 14.7, "test_olmo2": 14.8, "test_tp_generate": 15.6,
     "test_pipeline": 16.5, "test_seq_parallel": 17.0,
