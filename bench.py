@@ -325,6 +325,22 @@ def main():
     row["platform"] = jax.default_backend()
     if fell_back:
         row["note"] = "default backend unresponsive; CPU fallback"
+    # live decode goodput (ISSUE 6): every round's row carries the
+    # serving hot path's dnn_tpu_mfu / dnn_tpu_mbu gauges, measured
+    # fresh on this round's substrate (benchmarks/decode_mbu_probe.py,
+    # light leg) — the MBU-gap trend rides BENCH_r*.json automatically,
+    # like stale_tpu_reference already does. Never allowed to cost the
+    # round its headline: any failure lands as a labeled error field.
+    try:
+        from benchmarks.decode_mbu_probe import measure as _mbu_measure
+
+        g = _mbu_measure(light=True)
+        row["decode_goodput"] = {
+            k: g[k] for k in ("mfu", "mbu", "tokens_per_sec",
+                              "rooflines", "platform", "asserted_leg",
+                              "vs_studies_s10")}
+    except Exception as e:  # noqa: BLE001 — headline must survive
+        row["decode_goodput"] = {"error": str(e)[:200]}
     from dnn_tpu import obs
 
     if on_cpu:

@@ -132,6 +132,12 @@ def main(argv=None) -> int:
               f"{dec.get('donation', {}).get('aliased')}/"
               f"{dec.get('donation', {}).get('expected')} cache buffers "
               "aliased")
+        sd = program_report.get("serving_decode", {}).get("variants", {})
+        if sd:
+            parts = [f"{k}={v['aliased']}/{v['expected']}"
+                     for k, v in sd.items()]
+            print("  serving decode donation (aliased/donated, zero "
+                  "cache-sized copies asserted): " + ", ".join(parts))
         bc = dec.get("bucketed_census", {})
         nc = dec.get("naive_census", {})
         print(f"  bucketed decode census: {bc.get('programs')} programs "

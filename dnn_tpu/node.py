@@ -116,10 +116,30 @@ def build_parser() -> argparse.ArgumentParser:
                         "(.pth/npz/safetensors; random init if omitted)")
     p.add_argument("--spec_k", type=int, default=4,
                    help="--serve_lm: draft proposals per speculative step")
+    p.add_argument("--kv", choices=["paged", "dense", "auto"],
+                   default="auto",
+                   help="--serve_lm: KV cache layout. 'auto' (default) "
+                        "serves the PAGED block pool whenever this "
+                        "configuration can page — block-granular "
+                        "admission by actual request length — and falls "
+                        "back to the dense per-slot pool otherwise "
+                        "(recorded as a kv_fallback_dense flight event); "
+                        "'dense' opts out; 'paged' fails loud when "
+                        "paging is impossible")
+    p.add_argument("--kv_dtype", choices=["f32", "bf16", "int8", "int4"],
+                   default=None,
+                   help="--serve_lm: KV cache storage dtype (default: "
+                        "the model's compute dtype). int8/int4 quantize "
+                        "the cache with per-(position, head) scales — "
+                        "4x/8x less cache bandwidth per decode step than "
+                        "f32 (runtime/kvcache.Int8KV/Int4KV; int4 costs "
+                        "more rounding error — see README 'Decode hot "
+                        "path')")
     p.add_argument("--paged_blocks", type=int, default=0,
                    help="--serve_lm: paged KV cache — shared pool of this "
-                        "many blocks instead of per-slot dense caches "
-                        "(0 = dense; see runtime/paged_kvcache.py)")
+                        "many blocks (0 with --kv=paged/auto auto-sizes "
+                        "to the dense pool's capacity; see "
+                        "runtime/paged_kvcache.py)")
     p.add_argument("--block_len", type=int, default=16,
                    help="--serve_lm: positions per paged-cache block")
     p.add_argument("--prefix_cache", type=int, default=0,
@@ -472,6 +492,17 @@ def main(argv=None) -> int:
     return 0
 
 
+def _kv_dtype_arg(name):
+    """--kv_dtype CLI spelling -> the batcher's kv_dtype spec: dtypes for
+    the float widths, the codec strings for the quantized caches
+    (runtime/generate.init_cache dispatches on exactly these)."""
+    if name is None or name in ("int8", "int4"):
+        return name
+    import jax.numpy as jnp
+
+    return {"f32": jnp.float32, "bf16": jnp.bfloat16}[name]
+
+
 def _serve_lm(engine: PipelineEngine, args) -> int:
     """Long-lived LM daemon: the reference's defining serving-process shape
     (node.py:114-133) with the continuous batcher as the workload. Every
@@ -610,6 +641,7 @@ def _serve_lm(engine: PipelineEngine, args) -> int:
             metrics_port=args.metrics_port,
             watchdog=args.watchdog_s,
             tokenizer=tokenizer, prefix_cache=args.prefix_cache,
+            kv=args.kv, kv_dtype=_kv_dtype_arg(args.kv_dtype),
             paged_blocks=args.paged_blocks, block_len=args.block_len,
             decode_buckets=args.decode_buckets,
             # the daemon's clients choose options per request, so the

@@ -73,13 +73,17 @@ class ModelCost:
     kv_bytes_per_pos: float
 
 
-def model_cost(cfg, prepared=None, *, kv_bytes: int = 2,
+def model_cost(cfg, prepared=None, *, kv_bytes: float = 2,
+               kv_dtype=None,
                weight_dtype_bytes: int = 2) -> ModelCost:
     """Build a ModelCost from a model config (GPT or LLaMA family,
     sniffed by attributes — n_kv_head/d_ff means LLaMA layout).
     `prepared` (the served param tree) makes weight_bytes EXACT by
     summing the real leaves; without it the analytic param count x
-    `weight_dtype_bytes` stands in."""
+    `weight_dtype_bytes` stands in. `kv_dtype` (a dtype or the cache
+    codec strings "int8"/"int4") prices the KV term exactly, packed
+    int4 width and quantization scale rows included
+    (utils/flops.kv_bytes_per_pos)."""
     from dnn_tpu.utils import flops as F
 
     if hasattr(cfg, "n_kv_head") and hasattr(cfg, "d_ff"):
@@ -103,7 +107,8 @@ def model_cost(cfg, prepared=None, *, kv_bytes: int = 2,
             pass           # the analytic count, never breaks serving
     return ModelCost(
         flops_per_token=per_tok, prefill_flops=pf, weight_bytes=wbytes,
-        kv_bytes_per_pos=F.kv_bytes_per_pos(cfg, kv_bytes=kv_bytes))
+        kv_bytes_per_pos=F.kv_bytes_per_pos(cfg, kv_bytes=kv_bytes,
+                                            kv_dtype=kv_dtype))
 
 
 @dataclass(frozen=True)

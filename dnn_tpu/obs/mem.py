@@ -32,7 +32,33 @@ from __future__ import annotations
 import os
 from typing import Optional
 
-__all__ = ["rss_bytes", "install_memory_gauges"]
+__all__ = ["rss_bytes", "install_memory_gauges", "logical_nbytes"]
+
+
+def logical_nbytes(tree) -> float:
+    """HBM bytes of a pytree of arrays, pricing SUB-BYTE dtypes at their
+    packed width: a jnp.int4 element is half a byte in the device layout
+    (XLA S4 packs two per byte), while `arr.nbytes` / `itemsize` report
+    the UNPACKED 1-byte host representation — an itemsize walk would
+    overstate an int4 KV pool's memory 2x, which is exactly the class of
+    quantized-cache accounting bug the serving byte gauges
+    (serving.kv_cache_bytes) and utils/flops.py's MBU denominators must
+    not share. Shape/dtype metadata only — never forces a device sync,
+    so it is safe inside scrape-time gauges."""
+    import jax
+
+    total = 0.0
+    for leaf in jax.tree_util.tree_leaves(tree):
+        size = getattr(leaf, "size", None)
+        dt = getattr(leaf, "dtype", None)
+        if size is None or dt is None:
+            continue
+        name = getattr(dt, "name", str(dt))
+        if name in ("int4", "uint4"):
+            total += size * 0.5
+        else:
+            total += size * dt.itemsize
+    return total
 
 
 def rss_bytes() -> float:

@@ -412,6 +412,185 @@ def _decode_call(q, k, v, pos1d, ks, vs, *, block_s, interpret):
     )(pos1d, *args)
 
 
+# ----------------------------------------------------------------------
+# paged flash-decode kernel (block-table cache: runtime/paged_kvcache.py)
+# ----------------------------------------------------------------------
+#
+# The paged pool's einsum baseline MATERIALIZES a dense (B, H, S_max, D)
+# view of every slot's blocks each step (PagedKV.gather_view) — a full
+# logical-cache copy in HBM before attention even starts, which is the
+# one place the paged layout pays bandwidth the dense layout doesn't.
+# This kernel removes the materialization: the slot's block TABLE rides
+# scalar prefetch, and each grid step's index map chases the table to DMA
+# the PHYSICAL block straight from the pool into VMEM. Two clamps do the
+# live-length work:
+#   * logical blocks past the slot's live limit re-target the last live
+#     block (repeated index -> the Pallas pipeline skips the copy), so
+#     per-step traffic scales with each slot's ACTUAL context — the pool
+#     analog of _decode_call's position clamp;
+#   * columns past `pos` are masked inside the online softmax as usual.
+# int8 pools stream their 1-byte payload with the per-(position, head)
+# scales folded in VMEM, exactly like the dense decode kernel. (int4
+# pools stay on the einsum: sub-byte VMEM loads are not wired.)
+
+
+def reference_paged_decode_attention(q, kp, vp, tables, pos, *, ks=None,
+                                     vs=None):
+    """Oracle for the paged kernel: gather the dense view, then the
+    dense decode reference. q (B, Hk, R, D); kp/vp (n_blocks, Hk, bp, D)
+    pool; tables (B, nb_max) int32; pos (B,). Returns (B, Hk, R, D) f32."""
+    b, nb = tables.shape
+    bp = kp.shape[2]
+
+    def view(leaf):
+        g = jnp.take(leaf, tables.reshape(-1), axis=0)
+        hk = g.shape[1]
+        rest = g.shape[3:]
+        g = g.reshape(b, nb, hk, bp, *rest)
+        g = jnp.moveaxis(g, 1, 2)
+        return g.reshape(b, hk, nb * bp, *rest)
+
+    return reference_decode_attention(
+        q, view(kp), view(vp), pos,
+        ks=view(ks) if ks is not None else None,
+        vs=view(vs) if vs is not None else None)
+
+
+def _paged_decode_kernel(pos_ref, tab_ref, q_ref, k_ref, v_ref, *rest,
+                         scale, block_len, quant):
+    from jax.experimental import pallas as pl
+
+    if quant:
+        ks_ref, vs_ref, o_ref, m_scr, l_scr, acc_scr = rest
+    else:
+        ks_ref = vs_ref = None
+        o_ref, m_scr, l_scr, acc_scr = rest
+
+    si = pl.program_id(1)
+    ns = pl.num_programs(1)
+
+    @pl.when(si == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, _NEG_BIG)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    pos = pos_ref[pl.program_id(0)]
+    live = si * block_len <= pos
+
+    @pl.when(live)
+    def _step():
+        q = q_ref[0].astype(jnp.float32)   # (Hk, R, d)
+        k = k_ref[0].astype(jnp.float32)   # (Hk, block_len, d)
+        hk, r, d = q.shape
+        s = jax.lax.dot_general(
+            q, k, (((2,), (2,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32,
+        )  # (Hk, R, block_len)
+        if quant:
+            s = s * ks_ref[0][:, None, :]
+        s = s * scale
+        s2 = s.reshape(hk * r, block_len)
+        cols = jax.lax.broadcasted_iota(
+            jnp.int32, (hk * r, block_len), 1) + si * block_len
+        s2 = jnp.where(cols <= pos, s2, _NEG_BIG)
+
+        m_prev = m_scr[:, :1]
+        m_new = jnp.maximum(m_prev, s2.max(axis=-1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s2 - m_new)
+        if quant:
+            pv = p.reshape(hk, r, block_len) * vs_ref[0][:, None, :]
+        else:
+            pv = p.reshape(hk, r, block_len)
+        v = v_ref[0].astype(jnp.float32)
+        out = jax.lax.dot_general(
+            pv, v, (((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32,
+        )
+        l_new = l_scr[:, :1] * alpha + p.sum(axis=-1, keepdims=True)
+        acc_scr[...] = acc_scr[...] * alpha + out.reshape(hk * r, d)
+        m_scr[...] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[...] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    @pl.when(si == ns - 1)
+    def _finish():
+        hk, r, d = q_ref.shape[1:]
+        o_ref[0] = (acc_scr[...] / l_scr[:, :1]).reshape(hk, r, d) \
+            .astype(o_ref.dtype)
+
+
+def paged_decode_attention(q, kp, vp, tables, pos, *, ks=None, vs=None,
+                           interpret=None):
+    """Fused paged decode attention (see the section comment above).
+
+    q (B, Hk, R, D) — R query rows per KV head, all attending logical
+    columns <= pos[b] of their slot; kp/vp (n_blocks, Hk, bp, D) block
+    pool — float, or int8 with ks/vs (n_blocks, Hk, bp) scales; tables
+    (B, nb_max) int32 logical->physical block map; pos (B,) int32.
+    Returns (B, Hk, R, D) f32, identical math to the gather_view einsum
+    (reference_paged_decode_attention is the oracle).
+
+    Dispatches to the Pallas kernel on TPU; otherwise runs the
+    reference. `interpret=True` forces the kernel in interpreter mode
+    (CPU CI runs the real table-chasing index maps)."""
+    on_tpu = jax.default_backend() == "tpu"
+    if interpret is None:
+        if not on_tpu:
+            return reference_paged_decode_attention(
+                q, kp, vp, tables, pos, ks=ks, vs=vs)
+        interpret = False
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, hk, r, d = q.shape
+    nb_max = tables.shape[1]
+    bp = kp.shape[2]
+    quant = ks is not None
+    kernel = functools.partial(
+        _paged_decode_kernel, scale=1.0 / (d ** 0.5), block_len=bp,
+        quant=quant,
+    )
+
+    # the block table chases through scalar prefetch: logical block si of
+    # slot bi lives at physical pool block tab[bi * nb_max + si], and
+    # blocks past the live limit re-target the last LIVE logical block
+    # (repeated physical index -> no DMA)
+    def _pool_map(bi, si, p, tab):
+        return (tab[bi * nb_max + jnp.minimum(si, p[bi] // bp)], 0, 0, 0)
+
+    def _scale_map(bi, si, p, tab):
+        return (tab[bi * nb_max + jnp.minimum(si, p[bi] // bp)], 0, 0)
+
+    qspec = pl.BlockSpec((1, hk, r, d), lambda bi, si, p, tab: (bi, 0, 0, 0))
+    cspec = pl.BlockSpec((1, hk, bp, d), _pool_map)
+    in_specs = [qspec, cspec, cspec]
+    args = [q, kp, vp]
+    if quant:
+        in_specs += [pl.BlockSpec((1, hk, bp), _scale_map)] * 2
+        args += [ks.astype(jnp.float32), vs.astype(jnp.float32)]
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b, nb_max),
+        in_specs=in_specs,
+        out_specs=qspec,
+        scratch_shapes=[
+            pltpu.VMEM((hk * r, 128), jnp.float32),  # running row max
+            pltpu.VMEM((hk * r, 128), jnp.float32),  # running row sum
+            pltpu.VMEM((hk * r, d), jnp.float32),    # output accumulator
+        ],
+    )
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, hk, r, d), jnp.float32),
+        compiler_params=_compiler_params(
+            pltpu, dimension_semantics=("parallel", "arbitrary")
+        ),
+        interpret=interpret,
+    )(pos.astype(jnp.int32), tables.reshape(-1).astype(jnp.int32), *args)
+
+
 def decode_attention(q, k, v, pos, *, ks=None, vs=None, block_s=512,
                      interpret=None):
     """Decode-step cache attention (see the section comment above).

@@ -194,7 +194,10 @@ def make_bucketed_generate(cfg, *, max_len: int, max_new_tokens: int,
     cache_dtype = (kv_dtype if kv_dtype is not None
                    else (compute_dtype or jnp.float32))
 
-    @jax.jit
+    # donate the prefill cache too: the freshly-initialized allocation is
+    # written once and returned — without aliasing the write is a full
+    # extra copy of the first bucket (same contract as _step's donation)
+    @functools.partial(jax.jit, donate_argnums=(2,))
     def _prefill(prepared, ids, cache):
         logits, cache = _forward(prepared, ids, cache, 0)
         return logits[:, -1], cache

@@ -434,7 +434,7 @@ class PipelineEngine:
     def make_generator(self, *, max_new_tokens: int, temperature: float = 0.0,
                        top_k: Optional[int] = None,
                        top_p: Optional[float] = None,
-                       attn_kernel="auto"):
+                       attn_kernel="auto", kv_dtype=None):
         """Build `generate(ids, rng=None) -> (B, max_new_tokens)` on this
         engine's weights. On the spmd runtime with the GPT stacked layout,
         decode runs PIPELINE-PARALLEL: each stage keeps its KV-cache shard
@@ -447,7 +447,13 @@ class PipelineEngine:
         cache-attention routing policy for the single-program decoders
         (kvcache._KernelDispatch): the default "auto" streams
         long-context decode through the Pallas position-clamped kernel
-        on TPU and stays on the einsum path everywhere else."""
+        on TPU and stays on the einsum path everywhere else. `kv_dtype`
+        picks the cache storage for the single-program decoders (None
+        follows the engine's compute dtype; "int8"/"int4" quantize the
+        cache with per-(position, head) scales — runtime/kvcache.py;
+        the pipeline-parallel ring decoder keeps its stage shards at
+        compute dtype and rejects the override rather than silently
+        ignoring it)."""
         from dnn_tpu.models.gpt import GPTConfig
         from dnn_tpu.models.gpt_moe import GPTMoEConfig
         from dnn_tpu.runtime.generate import make_generate, make_pipeline_generate
@@ -472,6 +478,9 @@ class PipelineEngine:
             # built, so spmd engines fall back to the local program too.
             from dnn_tpu.runtime.generate_moe import make_generate_moe
 
+            if kv_dtype is not None:
+                raise ValueError(
+                    "kv_dtype is not plumbed through the MoE decoder")
             return single_program(make_generate_moe(
                 cfg, max_new_tokens=max_new_tokens, temperature=temperature,
                 sample_top_k=top_k, sample_top_p=top_p,
@@ -483,7 +492,7 @@ class PipelineEngine:
             return single_program(llama.make_generate(
                 cfg, max_new_tokens=max_new_tokens, temperature=temperature,
                 top_k=top_k, top_p=top_p, compute_dtype=self.compute_dtype,
-                attn_kernel=attn_kernel,
+                attn_kernel=attn_kernel, kv_dtype=kv_dtype,
             ))
         if type(cfg) is not GPTConfig:
             # exact match: the KV-cache decoder assumes dense-GPT block
@@ -493,6 +502,11 @@ class PipelineEngine:
                 f"'{self.config.model}' has config {type(cfg).__name__}"
             )
         if self.runtime == "spmd" and self._gpt_stacked_ready():
+            if kv_dtype is not None:
+                raise ValueError(
+                    "kv_dtype applies to the single-program decoders; "
+                    "pass kv_dtype on a family adapter for the "
+                    "pipeline-parallel ring (generate.GPTPipelineFamily)")
             gen = make_pipeline_generate(
                 cfg, self.mesh, max_new_tokens=max_new_tokens,
                 temperature=temperature, top_k=top_k, top_p=top_p,
@@ -505,7 +519,7 @@ class PipelineEngine:
         return single_program(make_generate(
             cfg, max_new_tokens=max_new_tokens, temperature=temperature,
             top_k=top_k, top_p=top_p, compute_dtype=self.compute_dtype,
-            attn_kernel=attn_kernel,
+            attn_kernel=attn_kernel, kv_dtype=kv_dtype,
         ))
 
     def _require_full_role(self):

@@ -31,7 +31,12 @@ from jax import lax
 from dnn_tpu.models.gpt import GPTConfig, head
 from dnn_tpu.ops.attention import merge_heads, split_heads
 from dnn_tpu.ops.nn import gelu, layer_norm, linear
-from dnn_tpu.runtime.kvcache import FloatKV, Int8KV, codec_for_cache
+from dnn_tpu.runtime.kvcache import (
+    FloatKV,
+    Int4KV,
+    Int8KV,
+    codec_for_cache,
+)
 
 _NEG_BIG = -1e30
 
@@ -44,10 +49,13 @@ TOP_P_PREFILTER_K = 256
 
 def init_cache(cfg: GPTConfig, batch: int, max_len: int, dtype=jnp.float32):
     """Preallocated K/V cache, one leading layer axis: (L, B, H, S, D).
-    dtype="int8" builds the quantized cache (per-row scales ride along —
-    dnn_tpu/runtime/kvcache.Int8KV)."""
+    dtype="int8" / "int4" build the quantized caches (per-row scales
+    ride along — dnn_tpu/runtime/kvcache.Int8KV / Int4KV; int4 stores
+    native jnp.int4, two values per byte)."""
     if dtype == "int8":
         return Int8KV().init(cfg, batch, max_len)
+    if dtype == "int4":
+        return Int4KV().init(cfg, batch, max_len)
     return FloatKV(dtype).init(cfg, batch, max_len)
 
 

@@ -54,7 +54,7 @@ from jax import lax
 
 _NEG_BIG = -1e30
 
-__all__ = ["FloatKV", "Int8KV", "RollingFloatKV", "RollingInt8KV",
+__all__ = ["FloatKV", "Int8KV", "Int4KV", "RollingFloatKV", "RollingInt8KV",
            "band_keep", "codec_for_cache", "AUTO_KERNEL_MIN_S"]
 
 # `use_kernel="auto"` threshold: below this many cache positions the XLA
@@ -157,6 +157,28 @@ def _rows_update(cache, new, pos):
     )(cache, new, pos)
 
 
+def _rows_write(cache, new, pos, write_gate):
+    """cache (B,H,S,...) <- new (B,H,T,...) at per-row positions pos (B,)
+    (T=1 decode steps, T=k+1 speculative verify blocks); rows with
+    write_gate False re-write their EXISTING content at pos (a bitwise
+    no-op — gather and scatter share the same clamped start). The gate
+    folds into the (B,H,T,...) written ROWS — one gather + one
+    dynamic-update-slice per leaf — instead of the older
+    full-update-then-cache-sized-select form, whose select materialized
+    a second allocation-sized buffer per leaf per layer even under
+    donation (the CPU-optimized decode step carried 3 cache-sized copies
+    per step from exactly this; the gate-folded form lowers to a true
+    in-place update — asserted by the analysis gate's decode audit,
+    dnn_tpu/analysis/program.audit_serving_decode)."""
+    t = new.shape[2]
+    cur = jax.vmap(
+        lambda c, p: lax.dynamic_slice_in_dim(c, p, t, axis=1)
+    )(cache, pos)
+    gate = write_gate.reshape((-1,) + (1,) * (cache.ndim - 1))
+    rows = jnp.where(gate, new.astype(cache.dtype), cur)
+    return _rows_update(cache, rows, pos)
+
+
 class FloatKV(_KernelDispatch):
     """The plain cache: K/V stored in `dtype` (f32 default, bf16 for
     halved bandwidth).
@@ -236,11 +258,8 @@ class FloatKV(_KernelDispatch):
     # position; `write_gate` (B,) bool keeps inactive slots untouched) ---
 
     def write_rows(self, c, k, v, pos, write_gate):
-        k_new = _rows_update(c["k"], k.astype(c["k"].dtype), pos)
-        v_new = _rows_update(c["v"], v.astype(c["v"].dtype), pos)
-        w = write_gate[:, None, None, None]
-        return {"k": jnp.where(w, k_new, c["k"]),
-                "v": jnp.where(w, v_new, c["v"])}
+        return {"k": _rows_write(c["k"], k, pos, write_gate),
+                "v": _rows_write(c["v"], v, pos, write_gate)}
 
     def attend_rows_causal(self, q, c, pos, window=None):
         """q (B, H, T, D) VERIFY blocks: row t of slot b attends cache
@@ -293,6 +312,21 @@ def _quantize_rows(x):
     return q.astype(jnp.int8), scale
 
 
+def _quantize_rows_int4(x):
+    """x (..., D) -> (int4 (..., D), f32 scales (...,)) — symmetric
+    per-row at 7 levels. The scale grain is the same per-(position, head)
+    row as the int8 codec's (each row is quantized once, at its own
+    write, against its own max — the "per-bucket" scales of the cache
+    recipe: one scale per D-wide bucket), which is what keeps 4-bit
+    rounding bounded: a whole-tensor scale at 7 levels would be
+    useless, a per-row one is the cache analog of quant.py's int4
+    GROUP scheme (quantize_tensor_int4)."""
+    amax = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1)
+    scale = jnp.where(amax > 0, amax / 7.0, 1.0)
+    q = jnp.clip(jnp.round(x.astype(jnp.float32) / scale[..., None]), -7, 7)
+    return q.astype(jnp.int4), scale
+
+
 class Int8KV(_KernelDispatch):
     """int8 K/V with per-(position, head) f32 scales — 4x less cache
     bandwidth per decode step than f32, 2x less than bf16.
@@ -303,6 +337,12 @@ class Int8KV(_KernelDispatch):
     dnn_tpu/ops/pallas/cached_attention.py).
 
     `window=W`: sliding-window lower bound, exactly as FloatKV's."""
+
+    # the quantization recipe, overridden by Int4KV (same layout, 4-bit
+    # payload); every write funnels through _quant so the two codecs
+    # cannot drift
+    _qdtype = jnp.int8
+    _quant = staticmethod(_quantize_rows)
 
     def __init__(self, use_kernel=False,
                  window: Optional[int] = None,
@@ -315,15 +355,15 @@ class Int8KV(_KernelDispatch):
         shape = (cfg.n_layer, batch, cfg.n_head, max_len,
                  cfg.n_embd // cfg.n_head)
         return {
-            "k": jnp.zeros(shape, jnp.int8),
-            "v": jnp.zeros(shape, jnp.int8),
+            "k": jnp.zeros(shape, self._qdtype),
+            "v": jnp.zeros(shape, self._qdtype),
             "ks": jnp.ones(shape[:-1], jnp.float32),
             "vs": jnp.ones(shape[:-1], jnp.float32),
         }
 
     def write(self, c, k, v, start_pos):
-        kq, ks = _quantize_rows(k)
-        vq, vs = _quantize_rows(v)
+        kq, ks = self._quant(k)
+        vq, vs = self._quant(v)
         return {
             "k": lax.dynamic_update_slice_in_dim(c["k"], kq, start_pos, axis=2),
             "v": lax.dynamic_update_slice_in_dim(c["v"], vq, start_pos, axis=2),
@@ -370,19 +410,14 @@ class Int8KV(_KernelDispatch):
     # --- per-row variants (continuous batching) ---
 
     def write_rows(self, c, k, v, pos, write_gate):
-        kq, ks = _quantize_rows(k)   # (B,H,1,D), (B,H,1)
-        vq, vs = _quantize_rows(v)
-        new = {
-            "k": _rows_update(c["k"], kq, pos),
-            "v": _rows_update(c["v"], vq, pos),
-            "ks": _rows_update(c["ks"], ks, pos),
-            "vs": _rows_update(c["vs"], vs, pos),
+        kq, ks = self._quant(k)   # (B,H,1,D), (B,H,1)
+        vq, vs = self._quant(v)
+        return {
+            "k": _rows_write(c["k"], kq, pos, write_gate),
+            "v": _rows_write(c["v"], vq, pos, write_gate),
+            "ks": _rows_write(c["ks"], ks, pos, write_gate),
+            "vs": _rows_write(c["vs"], vs, pos, write_gate),
         }
-        gates = {"k": write_gate[:, None, None, None],
-                 "v": write_gate[:, None, None, None],
-                 "ks": write_gate[:, None, None],
-                 "vs": write_gate[:, None, None]}
-        return {kk: jnp.where(gates[kk], new[kk], c[kk]) for kk in c}
 
     def attend_rows_causal(self, q, c, pos, window=None):
         # per-row causal verify blocks (see FloatKV.attend_rows_causal);
@@ -424,6 +459,36 @@ class Int8KV(_KernelDispatch):
         p = p * c["vs"][:, :, None, :]
         return jnp.einsum("bhts,bhsd->bhtd", p, c["v"].astype(jnp.float32),
                           preferred_element_type=jnp.float32)
+
+
+class Int4KV(Int8KV):
+    """int4 K/V with per-(position, head) f32 scales — 8x less cache
+    payload bandwidth per decode step than f32, 2x less than int8.
+    Storage is NATIVE jnp.int4 (XLA S4: two values per byte in the HBM
+    layout, the same packing quant.py's int4 weights ride).
+
+    Same layout and attend math as Int8KV — only the quantizer (7
+    levels, per-row scales) differs, so every attend variant (scores
+    scaled on the (T, S) matrix, V scales folded into the probability
+    matrix) is inherited verbatim. Einsum-only: the Pallas cached-
+    attention kernel streams 1-byte elements; sub-byte VMEM loads are
+    not wired, so the kernel path stays off whatever `use_kernel` says
+    (the s4->f32 upcast fuses into the XLA dot instead). Accuracy: the
+    parity tests bound per-row int4 rounding (cosine > 0.99 on real
+    decode shapes); prefer int8 when the quality budget is tight —
+    int4 is the bandwidth-endpoint rung of the serving-spec ladder
+    (kv_dtype="int4", composable with the bucket ladder and the paged
+    pool like every other cache dtype)."""
+
+    _qdtype = jnp.int4
+    _quant = staticmethod(_quantize_rows_int4)
+
+    def __init__(self, window: Optional[int] = None,
+                 softcap: Optional[float] = None):
+        super().__init__(use_kernel=False, window=window, softcap=softcap)
+
+    def _kernel_on(self, c) -> bool:
+        return False  # no sub-byte kernel path (see class docstring)
 
 
 def ring_positions(pos, w: int):
@@ -531,8 +596,8 @@ class RollingInt8KV(_RingStorage, Int8KV):
 
     def write(self, c, k, v, start_pos):
         w = c["k"].shape[2]
-        kq, ks = _quantize_rows(k)
-        vq, vs = _quantize_rows(v)
+        kq, ks = self._quant(k)
+        vq, vs = self._quant(v)
         return self._ring_scatter(
             c, {"k": kq, "v": vq, "ks": ks, "vs": vs}, start_pos, w)
     # attend_rows: Int8KV's scaled einsum with _RingStorage._rows_keep
@@ -558,9 +623,15 @@ def codec_for_cache(cache, use_kernel=False,
         if softcap is not None:
             raise ValueError("softcap is not supported on rolling caches")
         if "ks" in cache:
+            if cache["k"].dtype == jnp.int4:
+                raise ValueError(
+                    "rolling int4 caches are not built — roll at int8 "
+                    "(RollingInt8KV) or keep int4 on a full-length cache")
             return RollingInt8KV(window=window)
         return RollingFloatKV(cache["k"].dtype, window=window)
     if "ks" in cache:
+        if cache["k"].dtype == jnp.int4:
+            return Int4KV(window=window, softcap=softcap)
         return Int8KV(use_kernel=use_kernel, window=window, softcap=softcap)
     return FloatKV(cache["k"].dtype, use_kernel=use_kernel, window=window,
                    softcap=softcap)

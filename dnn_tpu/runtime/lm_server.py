@@ -632,21 +632,25 @@ class LMServer:
         if goodput is None and obs.enabled():
             from dnn_tpu.obs.goodput import GoodputTracker, model_cost
 
-            try:
-                import jax.numpy as jnp
+            # same fallback chain as the batcher's cache allocation
+            # (serving.py: kv_dtype, else the family's resolved
+            # compute_dtype, else f32) — a bf16 server must not have its
+            # MBU KV term priced at f32 width, and the QUANTIZED specs
+            # ("int8"/"int4") price their packed payload + f32 scale
+            # rows exactly (utils/flops.kv_bytes_per_pos kv_dtype=;
+            # int4 at jnp.dtype itemsize would overstate 2x and miss
+            # the scales)
+            import jax.numpy as jnp
 
-                # same fallback chain as the batcher's cache allocation
-                # (serving.py: kv_dtype, else the family's resolved
-                # compute_dtype, else f32) — a bf16 server must not have
-                # its MBU KV term priced at f32 width
-                kvb = jnp.dtype(
-                    batcher_kwargs.get("kv_dtype")
-                    or getattr(self.batcher.family, "compute_dtype", None)
-                    or jnp.float32).itemsize
+            kv_spec = (batcher_kwargs.get("kv_dtype")
+                       or getattr(self.batcher.family, "compute_dtype",
+                                  None)
+                       or jnp.float32)
+            try:
+                cost = model_cost(cfg, prepared, kv_dtype=kv_spec)
             except Exception:  # noqa: BLE001 — exotic kv_dtype spec
-                kvb = 2
-            self.goodput = GoodputTracker(
-                model_cost(cfg, prepared, kv_bytes=kvb), slo=slo).install()
+                cost = model_cost(cfg, prepared, kv_bytes=2)
+            self.goodput = GoodputTracker(cost, slo=slo).install()
         elif goodput:
             self.goodput = goodput.install()
         if self.goodput is not None:
@@ -673,6 +677,14 @@ class LMServer:
     def _init_rest(self, cfg, prepared, *, default_max_new,
                    request_timeout, tokenizer, draft_cfg, draft_prepared,
                    spec_k, compile_cache_budget, **batcher_kwargs):
+        # the daemon's DEFAULT cache layout is the paged pool ("auto"
+        # resolves to paged whenever this configuration can page, with a
+        # visible dense fallback — serving.ContinuousBatcher kv=): the
+        # serving path admits by ACTUAL request length instead of
+        # slots x max_len. Callers opt out with kv="dense" (the
+        # --kv=dense CLI fallback) or pin kv="paged" to fail loud when
+        # paging is impossible.
+        batcher_kwargs.setdefault("kv", "auto")
         if (batcher_kwargs.get("allow_constraints")
                 and "constraint_rows" not in batcher_kwargs):
             # the daemon's JSON mode goes up to depth _MAX_JSON_DEPTH=3,

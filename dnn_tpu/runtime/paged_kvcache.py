@@ -151,9 +151,10 @@ def init_paged_cache(cfg, slots: int, max_len: int, *, n_blocks: int,
     dense cache (leading L on every leaf). `kv_heads` overrides the
     pool's head width — GQA families store KV heads, not query heads
     (llama.init_cache's narrowing, here applied to the pool).
-    dtype="int8" builds the quantized pool: int8 K/V blocks plus
-    per-(position, head) f32 scale blocks, the paged form of
-    kvcache.Int8KV's layout."""
+    dtype="int8" / "int4" build the quantized pools: int8/int4 K/V
+    blocks plus per-(position, head) f32 scale blocks, the paged forms
+    of kvcache.Int8KV / Int4KV's layouts (int4 stores native jnp.int4,
+    two values per byte)."""
     if max_len % block_len:
         raise ValueError(f"max_len {max_len} must tile block_len {block_len}")
     head_dim = cfg.n_embd // cfg.n_head
@@ -161,10 +162,11 @@ def init_paged_cache(cfg, slots: int, max_len: int, *, n_blocks: int,
     nb_max = max_len // block_len
     shape = (cfg.n_layer, n_blocks, heads, block_len, head_dim)
     tables = jnp.zeros((cfg.n_layer, slots, nb_max), jnp.int32)
-    if dtype == "int8":
+    if dtype in ("int8", "int4"):
+        qdt = jnp.int8 if dtype == "int8" else jnp.int4
         return {
-            "k": jnp.zeros(shape, jnp.int8),
-            "v": jnp.zeros(shape, jnp.int8),
+            "k": jnp.zeros(shape, qdt),
+            "v": jnp.zeros(shape, qdt),
             "ks": jnp.ones(shape[:-1], jnp.float32),
             "vs": jnp.ones(shape[:-1], jnp.float32),
             "tables": tables,
@@ -186,11 +188,38 @@ class PagedKV:
     bound to attend_rows — positions <= pos - W never attend — and is
     what lets the SERVING layer reclaim fully-rolled-out blocks while a
     request still runs (ContinuousBatcher._free_rolled_blocks): a long
-    windowed stream holds O(window) pool blocks, not O(stream)."""
+    windowed stream holds O(window) pool blocks, not O(stream).
 
-    def __init__(self, block_len: int, window: Optional[int] = None):
+    `use_kernel` routes attend_rows through the fused paged flash-decode
+    kernel (ops/pallas/cached_attention.paged_decode_attention): the
+    slot's block table rides scalar prefetch and each grid step DMAs its
+    PHYSICAL block straight from the pool — no gather_view
+    materialization, per-step traffic clamped at each slot's live
+    length. True/"interpret" are unconditional; "auto" engages it only
+    on TPU against pools whose per-slot logical length reaches
+    kvcache.AUTO_KERNEL_MIN_S (the dense codecs' length-aware policy).
+    Windowed pools and int4 pools stay on the einsum (the kernel masks
+    causally only / sub-byte VMEM loads are not wired)."""
+
+    def __init__(self, block_len: int, window: Optional[int] = None,
+                 use_kernel=False):
         self.block_len = block_len
         self.window = window
+        self.use_kernel = use_kernel
+
+    def _kernel_on(self, c) -> bool:
+        """Resolve use_kernel against a concrete per-layer pool view
+        (pool (n_blocks, H, bp, D), tables (B, nb_max)) — the paged
+        mirror of kvcache._KernelDispatch._kernel_on."""
+        if self.window is not None or c["k"].dtype == jnp.int4:
+            return False
+        if self.use_kernel == "auto":
+            from dnn_tpu.runtime.kvcache import AUTO_KERNEL_MIN_S
+
+            logical = c["tables"].shape[-1] * self.block_len
+            return (jax.default_backend() == "tpu"
+                    and logical >= AUTO_KERNEL_MIN_S)
+        return bool(self.use_kernel)
 
     # --- decode-row paths (per-layer views: pool (n_blocks, H, bp, D),
     #     tables (B, nb_max)) ------------------------------------------
@@ -218,10 +247,15 @@ class PagedKV:
         row = jnp.where(write_gate, row, 0)
         out = {"tables": c["tables"]}
         if "ks" in c:
-            from dnn_tpu.runtime.kvcache import _quantize_rows
+            from dnn_tpu.runtime.kvcache import (
+                _quantize_rows,
+                _quantize_rows_int4,
+            )
 
-            kq, ks = _quantize_rows(k)  # (B,H,1,D), (B,H,1)
-            vq, vs = _quantize_rows(v)
+            quantize = (_quantize_rows_int4 if c["k"].dtype == jnp.int4
+                        else _quantize_rows)
+            kq, ks = quantize(k)  # (B,H,1,D), (B,H,1)
+            vq, vs = quantize(v)
             out["k"] = c["k"].at[blk, :, row].set(kq[:, :, 0])
             out["v"] = c["v"].at[blk, :, row].set(vq[:, :, 0])
             out["ks"] = c["ks"].at[blk, :, row].set(ks[:, :, 0])
@@ -265,6 +299,19 @@ class PagedKV:
                 "families are rejected for paged pools); set the codec's "
                 "window at construction")
         quant = "ks" in c
+        if self._kernel_on(c):
+            from dnn_tpu.ops.pallas.cached_attention import (
+                paged_decode_attention,
+            )
+
+            interp = True if self.use_kernel == "interpret" else None
+            out = paged_decode_attention(
+                q, c["k"], c["v"], c["tables"], pos,
+                ks=c["ks"] if quant else None,
+                vs=c["vs"] if quant else None,
+                interpret=interp)
+            # same output-dtype recipe as the einsum path below
+            return out if quant else out.astype(c["v"].dtype)
         if quant:
             k, v, ks, vs = self.gather_view(c, ("k", "v", "ks", "vs"))
         else:

@@ -102,7 +102,7 @@ class GPTFamilyRows:
     KV-head-width cache; MoE stays a GPT block with `ffn` overridden)."""
 
     def __init__(self, cfg, *, compute_dtype=None, ffn=None,
-                 attn_kernel="auto"):
+                 attn_kernel="auto", unroll_layers: bool = False):
         self.cfg = cfg
         self.compute_dtype = compute_dtype
         self.ffn = ffn
@@ -111,6 +111,17 @@ class GPTFamilyRows:
         # "auto" (default) = the length-aware policy — kernel only on TPU
         # against caches >= kvcache.AUTO_KERNEL_MIN_S positions
         self.attn_kernel = attn_kernel
+        # unroll_layers=True unrolls the DECODE-step layer scan into
+        # straight-line code: the CPU backend then updates each layer's
+        # cache slice truly in place instead of copying the scan-carried
+        # cache state around the while loop (the PR-1 "three full-cache
+        # copies per step" lowering — measured 1.6x step wall-clock at
+        # long context, benchmarks/decode_mbu_probe.py). Costs one body
+        # copy per layer at compile time, so it is opt-in; prefill and
+        # verify keep the scan (not per-token-hot, and the chunk program
+        # compiles per prompt bucket already). TPU while-loops alias
+        # loop state natively, so this knob is a CPU-lowering lever.
+        self.unroll_layers = bool(unroll_layers)
 
     def init_cache(self, batch, max_len, dtype):
         return init_cache(self.cfg, batch, max_len, dtype)
@@ -181,7 +192,9 @@ class GPTFamilyRows:
             )
             return y, layer_cache
 
-        x, new_cache = lax.scan(layer, x, (prepared["blocks"], cache))
+        x, new_cache = lax.scan(layer, x, (prepared["blocks"], cache),
+                                unroll=cfg.n_layer if self.unroll_layers
+                                else 1)
         logits = head(prepared, x.astype(jnp.float32), cfg=cfg,
                       compute_dtype=compute_dtype)
         return logits[:, -1], new_cache
@@ -217,11 +230,13 @@ class ContinuousBatcher:
                  attn_kernel="auto", prefix_cache: int = 0,
                  decode_buckets=False,
                  logprobs_k: int = 0,
+                 kv: Optional[str] = None,
                  paged_blocks: int = 0, block_len: int = 16,
                  lora_adapters=None, lora_alphas=None,
                  allow_logit_bias: bool = False,
                  allow_constraints: bool = False,
-                 constraint_rows: int = 1024):
+                 constraint_rows: int = 1024,
+                 unroll_layers: bool = False):
         self.cfg = cfg
         self.prepared = prepared
         self.slots = slots
@@ -276,6 +291,10 @@ class ContinuousBatcher:
                 raise ValueError(
                     "pass attn_kernel on the family adapter, not alongside "
                     "family= (the adapter owns its attention path)")
+            if unroll_layers:
+                raise ValueError(
+                    "pass unroll_layers on the family adapter, not "
+                    "alongside family= (the adapter owns its layer scan)")
             fam_dtype = getattr(family, "compute_dtype", None)
             if compute_dtype is not None and fam_dtype != compute_dtype:
                 raise ValueError(
@@ -284,10 +303,64 @@ class ContinuousBatcher:
             compute_dtype = fam_dtype
         self.family = family or GPTFamilyRows(
             cfg, compute_dtype=compute_dtype, ffn=ffn,
-            attn_kernel=attn_kernel)
+            attn_kernel=attn_kernel, unroll_layers=unroll_layers)
         # kv_dtype picks the cache storage codec (None follows
         # compute_dtype; "int8" = quantized cache, kvcache.Int8KV)
         cache_dtype = kv_dtype if kv_dtype is not None else (compute_dtype or jnp.float32)
+
+        # `kv` picks the cache layout by NAME — the serving-path selector
+        # ("--kv=paged|dense" at the daemon edge):
+        #   * None (legacy): paged iff paged_blocks > 0 (the pre-flag
+        #     contract, kept for direct constructors and old tests);
+        #   * "dense": the per-slot dense pool, rejecting a contradictory
+        #     paged_blocks;
+        #   * "paged": the block pool; paged_blocks=0 auto-sizes it to
+        #     the dense pool's capacity (slots x max_len positions + the
+        #     reserved junk block), so flipping the flag never shrinks
+        #     admission capacity — it only adds block-granular packing;
+        #   * "auto" (the LMServer default): "paged" whenever this
+        #     configuration can page, else the dense fallback — recorded
+        #     as a `kv_fallback_dense` flight event so the operator can
+        #     see WHY the default didn't engage.
+        if kv not in (None, "dense", "paged", "auto"):
+            raise ValueError(
+                f"kv must be 'paged', 'dense' or 'auto', got {kv!r}")
+        if kv == "dense" and paged_blocks:
+            raise ValueError(
+                "kv='dense' contradicts paged_blocks="
+                f"{paged_blocks}; drop one of them")
+        if kv in ("paged", "auto"):
+            blocker = None
+            if decode_buckets:
+                blocker = ("decode_buckets is a dense-pool feature (the "
+                           "paged pool is already length-proportional)")
+            elif (getattr(self.family, "softcap", None) is not None
+                    or getattr(self.family, "alt_window", False)):
+                blocker = ("softcapped / alternating-window families "
+                           "have no paged channel")
+            elif (getattr(self.family, "window", None) is not None
+                    and prefix_cache > 0):
+                blocker = ("windowed paged pools do not compose with "
+                           "the prefix cache")
+            elif self.max_len % block_len or self.prompt_pad % block_len:
+                blocker = (f"max_len {self.max_len} / prompt_pad "
+                           f"{self.prompt_pad} must tile block_len "
+                           f"{block_len}")
+            if blocker is None:
+                if not paged_blocks:
+                    paged_blocks = slots * (self.max_len // block_len) + 1
+            elif kv == "paged" or paged_blocks:
+                # an explicit paged_blocks is an explicit ask for the
+                # pool — silently discarding its sizing on the auto path
+                # would swap the cache layout under a misconfigured
+                # deployment that used to fail loud here
+                raise ValueError(
+                    f"kv={kv!r}"
+                    + (f" with paged_blocks={paged_blocks}" if paged_blocks
+                       else "")
+                    + f" is not available: {blocker}")
+            else:  # auto, nothing explicit: dense fallback, visibly
+                obs.flight.record("kv_fallback_dense", reason=blocker)
 
         # device state (functional updates). paged_blocks > 0 swaps the
         # per-slot dense cache for the shared block pool + per-slot block
@@ -359,7 +432,14 @@ class ContinuousBatcher:
                 kv_heads=getattr(self.family, "kv_heads", None))
             self._allocator = BlockAllocator(paged_blocks)
             self._block_len = block_len
-            codec = PagedKV(block_len, window=fam_window)
+            # the family's attn_kernel policy routes paged decode through
+            # the fused flash-decode kernel (paged_decode_attention): the
+            # "auto" ladder rung for block pools — TPU + long slots
+            # stream table-chased blocks, everything else stays on the
+            # gather_view einsum (PagedKV._kernel_on)
+            codec = PagedKV(block_len, window=fam_window,
+                            use_kernel=getattr(self.family, "attn_kernel",
+                                               False))
 
             def gather_row(cache, ids_row):
                 """Rebuild a transient prefill row from pool blocks (the
@@ -500,6 +580,12 @@ class ContinuousBatcher:
                 _weak_gauge("_kv_live_hw_read"),
             "serving.active_slots_high_water":
                 _weak_gauge("_active_hw_read"),
+            # allocated KV bytes, QUANTIZATION-AWARE: int8 payloads price
+            # at 1 byte/element and int4 at their packed HALF byte (plus
+            # the f32 scale leaves, which ride the same pytree) — an
+            # itemsize walk would overstate an int4 pool 2x
+            # (obs/mem.logical_nbytes owns the dtype pricing)
+            "serving.kv_cache_bytes": _weak_gauge("_kv_bytes_read"),
         }
         self._kv_live_hw = 0
         self._active_hw = 0
@@ -657,10 +743,21 @@ class ContinuousBatcher:
         # donate the caches: without aliasing, every token would copy the
         # whole (L, B, H, S, D) cache (hundreds of MB of HBM traffic per
         # step at real sizes). The call sites reassign from the results,
-        # so the donated inputs are never reused.
-        self._decode = jax.jit(decode_step, donate_argnums=(1, 11))
+        # so the donated inputs are never reused. Alongside the cache:
+        # every per-slot state vector the step RETURNS (pos, tok, keys,
+        # seen) — `active`, `bias`, `crow` and `ctable` are read-only
+        # through the step (host-updated between calls) and must NOT be
+        # donated. Full aliasing of every donated leaf is a standing
+        # invariant, asserted statically by the analysis gate
+        # (dnn_tpu/analysis/program.audit_serving_decode via
+        # hlo_audit.count_aliased).
+        self._decode = jax.jit(decode_step, donate_argnums=(1, 2, 3, 5, 11))
         self._prefill_chunk = jax.jit(prefill_chunk, donate_argnums=(1,))
-        self._prefill_finish = jax.jit(prefill_finish, donate_argnums=(0, 1))
+        # the transient row (arg 1) is SLICED into the pool, never
+        # returned whole — donating it aliases nothing (an unusable
+        # donation that warned on every prefill); only the pool cache
+        # donation is real
+        self._prefill_finish = jax.jit(prefill_finish, donate_argnums=(0,))
         # the decode step's param argument: a lora_view when multi-LoRA is
         # on (rebuilt whenever a slot's adapter assignment changes — same
         # structure, so the same compiled program), plain prepared when off
@@ -1421,6 +1518,12 @@ class ContinuousBatcher:
 
     def _kv_live_hw_read(self) -> float:
         return float(self._kv_live_hw)
+
+    def _kv_bytes_read(self) -> float:
+        # shape/dtype walk only — a scrape must never force a device sync
+        from dnn_tpu.obs.mem import logical_nbytes
+
+        return logical_nbytes(self.cache)
 
     def _active_hw_read(self) -> float:
         return float(self._active_hw)

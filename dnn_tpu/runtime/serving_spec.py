@@ -50,6 +50,18 @@ least k+1 tokens (the first sync chunk re-feeds the prompt tail), and
 len(prompt) + max_new + k <= max_len (verify writes up to k positions of
 scratch beyond the last committed token).
 
+`decode_buckets=` COMPOSES (ISSUE 6): the target pool grows through
+the ladder exactly as the dense batcher's, the draft pool grows in
+lockstep, and every grow covers the verify chunk's +k scratch
+(_ensure_cache_len). The spec programs re-trace once per ladder rung —
+the same bounded relaxation of the program-count contract the dense
+bucketed step accepted in PR 1 — and greedy token identity to the
+UNBUCKETED spec pool (and hence to the plain batcher) holds by the
+bucket-view argument: a rung differs from the full allocation only in
+columns beyond every row's band limit. Acceptance-weighted tokens/step
+now multiplies the bucketed bytes/step win instead of forfeiting it
+(tests/test_spec_buckets.py pins parity through rung crossings).
+
 The reference framework has no decode at all (SURVEY §3.2); this is the
 deepest point of the serving stack built beyond it.
 """
@@ -91,13 +103,25 @@ class SpeculativeBatcher(ContinuousBatcher):
             raise ValueError(
                 f"draft vocab {draft_cfg.vocab_size} != target vocab "
                 f"{cfg.vocab_size}")
+        if kw.get("kv") == "paged":
+            raise ValueError(
+                "SpeculativeBatcher pins the dense pool (the spec codecs "
+                "attend dense; paged x speculative is not composed)")
+        if kw.get("kv") == "auto":
+            # the serving-path default resolves to dense here — the
+            # parent's auto-paging would hand the spec codecs a block
+            # pool they cannot attend. Recorded like every other auto
+            # fallback (the README's kv contract: a fallback always
+            # leaves a flight event saying why).
+            from dnn_tpu import obs
+
+            obs.flight.record(
+                "kv_fallback_dense",
+                reason="speculative serving pins the dense pool")
+            kw["kv"] = "dense"
         for bad in ("ffn", "paged_blocks", "logprobs_k",
                     "attn_kernel", "top_p", "min_p", "repetition_penalty",
-                    "lora_adapters", "allow_constraints",
-                    # the verify programs re-trace per cache shape; a
-                    # growing bucketed pool would multiply them per bucket
-                    # — untested composition, rejected until measured
-                    "decode_buckets"):
+                    "lora_adapters", "allow_constraints"):
             # allow_constraints would allocate the (constraint_rows, V)
             # device mask pool for a batcher that rejects every
             # constrained submit (_constraints_ok=False) — fail at
@@ -168,8 +192,14 @@ class SpeculativeBatcher(ContinuousBatcher):
                     "program")
         # the draft needs the same scratch headroom past max_len the
         # target gets via the submit budget check (verify/propose write
-        # up to k positions beyond the last committed token)
-        self.d_cache = d_family.init_cache(self.slots, self.max_len,
+        # up to k positions beyond the last committed token). On a
+        # bucketed pool (decode_buckets= now composes — the spec
+        # programs re-trace once per ladder rung, the same bounded
+        # relaxation the dense step accepted in PR 1) the draft cache
+        # starts at the target's first bucket and grows in LOCKSTEP
+        # through _ensure_cache_len, so both sides' verify blocks always
+        # cover pos + k.
+        self.d_cache = d_family.init_cache(self.slots, self._cache_len,
                                            cache_dtype)
         self._d_family = d_family
         d_codec = codec_for_cache(self.d_cache)
@@ -273,10 +303,20 @@ class SpeculativeBatcher(ContinuousBatcher):
             return (t_cache, d_cache, last, pos + committed, keys,
                     new_prev_chunk, new_prev_pos, w, m)
 
-        self._spec_step = jax.jit(spec_step, donate_argnums=(2, 3))
+        # donate BOTH caches and every per-slot vector the step returns
+        # (tok, pos, keys, prev_chunk, prev_pos) — `active` is read-only
+        # through the step and host-updated between calls, so it stays
+        # undonated. Aliasing coverage is asserted by the analysis gate
+        # (analysis/program.audit_serving_decode).
+        self._spec_step = jax.jit(spec_step,
+                                  donate_argnums=(2, 3, 4, 5, 7, 8, 9))
 
         # draft-side chunked prefill (the target side reuses the parent's
-        # programs); the install is the parent's dense slice-install shape
+        # programs); the install is the parent's dense slice-install
+        # shape, clamped at the CACHE's current position count (the
+        # bucketed draft pool may sit below max_len — the row's overhang
+        # holds nothing but tail-pad garbage, exactly as in
+        # serving.prefill_finish)
         def d_prefill_chunk(prepared, row, chunk, chunk_start):
             return d_family.prefill(prepared, chunk, row, chunk_start)
 
@@ -284,16 +324,34 @@ class SpeculativeBatcher(ContinuousBatcher):
             return {
                 kk: lax.dynamic_update_slice_in_dim(
                     cache[kk],
-                    lax.slice_in_dim(row[kk], 0, self.max_len, axis=3),
+                    lax.slice_in_dim(row[kk], 0, cache[kk].shape[3],
+                                     axis=3),
                     slot, axis=1)
                 for kk in cache
             }
 
         self._d_prefill_chunk = jax.jit(d_prefill_chunk,
                                         donate_argnums=(1,))
-        self._d_install = jax.jit(d_install, donate_argnums=(0, 1))
+        # the row (arg 1) is sliced, never returned whole — donating it
+        # would alias nothing (serving.py's prefill_finish lesson)
+        self._d_install = jax.jit(d_install, donate_argnums=(0,))
 
     # ------------------------------------------------------------------
+
+    def _ensure_cache_len(self, need: int):
+        """Bucketed growth with the spec path's scratch headroom: the
+        verify/propose chunk writes up to spec_k positions past the last
+        committed token, so every grow covers `need + k` — and the DRAFT
+        pool grows in lockstep (both sides' chunks write the same
+        positions). The submit budget check (prompt + max_new + k <=
+        max_len) guarantees the padded need never exceeds the ladder
+        top."""
+        if self._buckets is None:
+            return
+        super()._ensure_cache_len(min(need + self.spec_k, self.max_len))
+        d_len = jax.tree.leaves(self.d_cache)[0].shape[3]
+        if d_len < self._cache_len:
+            self.d_cache = self._grow_cache(self.d_cache, self._cache_len)
 
     def jit_programs(self):
         """Parent programs plus the spec path's own — a speculative
@@ -363,6 +421,13 @@ class SpeculativeBatcher(ContinuousBatcher):
         1..k+1 committed tokens. Returns {rid: [tokens...]}."""
         if self.n_active == 0:
             return {}
+        if self._buckets is not None:
+            # this step verifies at pos..pos+k for every active slot
+            # (pos = prompt_len + emitted - 1); _ensure_cache_len adds
+            # the +k scratch itself and grows the draft pool in lockstep
+            self._ensure_cache_len(max(
+                req["prompt_len"] + len(req["emitted"])
+                for req in self._slot_req if req is not None))
         (self.cache, self.d_cache, self.tok, self.pos, self.keys,
          self.prev_chunk, self.prev_pos, w, m) = self._spec_step(
             self.prepared, self.draft_prepared, self.cache, self.d_cache,

@@ -166,13 +166,32 @@ def llama_decode_token_flops(cfg, context: float) -> float:
     return l * per_layer + 2.0 * c * v
 
 
-def kv_bytes_per_pos(cfg, *, kv_bytes: int = 2) -> float:
+def kv_bytes_per_pos(cfg, *, kv_bytes: float = 2,
+                     kv_dtype=None) -> float:
     """HBM bytes one cache POSITION occupies (K + V rows across all
     layers) — decode streams `context` of these per token, and prefill
     writes one per prompt position. GQA caches carry n_kv_head*head_dim
-    per row; dense GPT carries C."""
+    per row; dense GPT carries C.
+
+    `kv_dtype` overrides `kv_bytes` with EXACT accounting for the
+    serving cache specs (runtime/kvcache.py): a dtype prices at its
+    itemsize; the codec strings "int8"/"int4" price the quantized
+    payload (int4 packs two elements per byte — pricing it at the
+    1-byte host itemsize would overstate the MBU denominator 2x) PLUS
+    the per-(position, head) f32 K and V scale rows the quantized
+    codecs stream alongside."""
     kv_width = (cfg.n_kv_head * cfg.head_dim
                 if hasattr(cfg, "n_kv_head") else cfg.n_embd)
+    heads = (cfg.n_kv_head if hasattr(cfg, "n_kv_head") else cfg.n_head)
+    if kv_dtype is not None:
+        name = str(getattr(kv_dtype, "name", kv_dtype))
+        if name in ("int8", "int4"):
+            per_elem = 1.0 if name == "int8" else 0.5
+            return float(2 * cfg.n_layer
+                         * (kv_width * per_elem + heads * 4))
+        import jax.numpy as jnp
+
+        kv_bytes = jnp.dtype(kv_dtype).itemsize
     return float(2 * cfg.n_layer * kv_width * kv_bytes)
 
 

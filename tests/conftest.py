@@ -84,6 +84,11 @@ _MODULE_COST_S = {
     "test_serving_options": 37.6, "test_decode_buckets": 39.9,
     "test_ring_attention": 39.9, "test_gemma": 40.5,
     "test_embeddings": 44.4, "test_audit": 50.6, "test_lm_server": 52.1,
+    "test_decode_hotpath": 36.0,  # ISSUE 6 decode hot path: donation/
+    # aliasing invariant, kv flag, int4 KV, paged flash-decode kernel,
+    # quantized byte accounting — certified inside the tier-1 budget
+    "test_spec_buckets": 36.0,  # speculative x bucketed composition
+    # parity (greedy + sampled, rung crossings, draft-pool lockstep)
     "test_serving_spec": 53.1, "test_multilora": 57.9,
     "test_sliding_window": 58.0, "test_tp_pp": 59.9,
     "test_speculative": 62.4, "test_paged": 64.2,

@@ -1,0 +1,308 @@
+"""ISSUE 6 decode-hot-path contracts: donation/aliasing as an asserted
+invariant, the kv=paged|dense serving flag, int4 quantized KV, the fused
+paged flash-decode kernel, and quantization-aware byte accounting.
+
+The perf claims live in benchmarks/decode_mbu_probe.py and STUDIES §11;
+this module pins the CORRECTNESS surface those claims stand on:
+
+  * every donated leaf of every decode-step program (dense f32/int8/
+    int4, bucketed, paged, speculative) aliases an output, and the
+    StableHLO carries zero cache-sized copies — the static form of
+    "the KV update is in-place", enforced here AND by the analysis gate
+    (analysis/program.audit_serving_decode);
+  * paged-vs-dense token parity under the batcher, through the kv flag's
+    three spellings and the auto-sizing path;
+  * int4 cache parity across layouts (dense == bucketed == paged — one
+    quantizer, three storages) and bounded rounding error vs f32;
+  * the paged decode kernel's interpret-mode parity against the
+    gather_view einsum oracle;
+  * logical_nbytes / kv_bytes_per_pos pricing int4 at its packed half
+    byte plus scale rows (the obs/mem + flops satellite).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dnn_tpu.models import gpt
+from dnn_tpu.runtime.serving import ContinuousBatcher
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    cfg = gpt.GPTConfig(vocab_size=89, block_size=128, n_layer=2,
+                        n_head=2, n_embd=32)
+    prepared = gpt.prepare_stacked(
+        gpt.init(jax.random.PRNGKey(0), cfg), cfg)
+    return cfg, prepared
+
+
+def _run(cfg, prepared, prompt, new_tokens=16, **kw):
+    b = ContinuousBatcher(cfg, prepared, slots=2, max_len=64,
+                          prompt_pad=16, **kw)
+    rid = b.submit(prompt, max_new_tokens=new_tokens)
+    out = b.drain()
+    return np.asarray(out[rid]), b
+
+
+# ----------------------------------------------------------------------
+# donation coverage + zero cache-sized copies (the tentpole invariant)
+# ----------------------------------------------------------------------
+
+def test_serving_decode_fully_aliased_no_cache_copies():
+    from dnn_tpu.analysis.program import audit_serving_decode
+
+    report = audit_serving_decode()
+    assert not report["findings"], [f.message for f in report["findings"]]
+    assert set(report["variants"]) == {
+        "dense_f32", "dense_int8", "dense_int4", "bucketed", "paged",
+        "speculative"}
+    for name, v in report["variants"].items():
+        assert v["aliased"] == v["expected"], (name, v)
+        assert v["cache_sized_ops"] == {}, (name, v)
+
+
+# ----------------------------------------------------------------------
+# the kv flag
+# ----------------------------------------------------------------------
+
+def test_kv_paged_dense_auto_token_parity(tiny):
+    cfg, prepared = tiny
+    prompt = np.arange(1, 13) % 89
+    t_dense, bd = _run(cfg, prepared, prompt, kv="dense")
+    t_paged, bp = _run(cfg, prepared, prompt, kv="paged")
+    t_auto, ba = _run(cfg, prepared, prompt, kv="auto")
+    assert not bd._paged and bp._paged and ba._paged
+    np.testing.assert_array_equal(t_dense, t_paged)
+    np.testing.assert_array_equal(t_dense, t_auto)
+    # auto-sizing preserves the dense pool's capacity (+ junk block 0)
+    assert bp._allocator.n_blocks == 2 * (64 // 16) + 1
+
+
+def test_kv_auto_falls_back_dense_visibly(tiny):
+    cfg, prepared = tiny
+    prompt = np.arange(1, 13) % 89
+    t_dense, _ = _run(cfg, prepared, prompt, kv="dense")
+    # decode_buckets is a dense-pool feature: auto must fall back AND say so
+    t_b, bb = _run(cfg, prepared, prompt, kv="auto", decode_buckets=True)
+    assert not bb._paged and bb._buckets is not None
+    np.testing.assert_array_equal(t_dense, t_b)
+    # indivisible geometry falls back too
+    b2 = ContinuousBatcher(cfg, prepared, slots=2, max_len=60,
+                           prompt_pad=20, kv="auto")
+    assert not b2._paged
+
+
+def test_kv_flag_validation(tiny):
+    cfg, prepared = tiny
+    with pytest.raises(ValueError, match="paged.*dense|dense.*paged"):
+        ContinuousBatcher(cfg, prepared, slots=2, max_len=64, kv="bogus")
+    with pytest.raises(ValueError, match="contradicts"):
+        ContinuousBatcher(cfg, prepared, slots=2, max_len=64,
+                          prompt_pad=16, kv="dense", paged_blocks=8)
+    with pytest.raises(ValueError, match="not available"):
+        ContinuousBatcher(cfg, prepared, slots=2, max_len=64,
+                          prompt_pad=16, kv="paged", decode_buckets=True)
+    # auto must NOT silently discard an EXPLICIT pool sizing: the same
+    # misconfiguration that failed loud pre-flag still fails loud
+    with pytest.raises(ValueError, match="not available"):
+        ContinuousBatcher(cfg, prepared, slots=2, max_len=64,
+                          prompt_pad=16, kv="auto", paged_blocks=8,
+                          decode_buckets=True)
+
+
+# ----------------------------------------------------------------------
+# int4 KV
+# ----------------------------------------------------------------------
+
+def test_int4_same_tokens_across_layouts(tiny):
+    """One quantizer, three storages: dense, bucketed and paged int4
+    caches must emit IDENTICAL tokens (each stores the same quantized
+    rows; attention math is the shared scaled einsum)."""
+    cfg, prepared = tiny
+    prompt = (np.arange(1, 19) * 5) % 89
+    t_dense, _ = _run(cfg, prepared, prompt, new_tokens=40,
+                      kv_dtype="int4")
+    t_buck, _ = _run(cfg, prepared, prompt, new_tokens=40,
+                     kv_dtype="int4", decode_buckets=True)
+    t_paged, _ = _run(cfg, prepared, prompt, new_tokens=40,
+                      kv_dtype="int4", kv="paged")
+    np.testing.assert_array_equal(t_dense, t_buck)
+    np.testing.assert_array_equal(t_dense, t_paged)
+
+
+def test_int4_attend_close_to_float():
+    """Per-row int4 rounding stays bounded: cosine similarity of the
+    attended output vs the f32 codec on the same K/V > 0.99."""
+    from dnn_tpu.runtime.kvcache import FloatKV, Int4KV
+
+    cfg = gpt.GPTConfig(vocab_size=31, block_size=64, n_layer=1,
+                        n_head=2, n_embd=32)
+    key = jax.random.PRNGKey(1)
+    f32 = FloatKV()
+    i4 = Int4KV()
+    cf = jax.tree.map(lambda x: x[0], f32.init(cfg, 2, 48))
+    ci = jax.tree.map(lambda x: x[0], i4.init(cfg, 2, 48))
+    k = jax.random.normal(jax.random.fold_in(key, 1), (2, 2, 40, 16))
+    v = jax.random.normal(jax.random.fold_in(key, 2), (2, 2, 40, 16))
+    cf = f32.write(cf, k, v, 0)
+    ci = i4.write(ci, k, v, 0)
+    assert ci["k"].dtype == jnp.int4
+    q = jax.random.normal(jax.random.fold_in(key, 3), (2, 2, 1, 16))
+    pos = jnp.asarray([20, 39], jnp.int32)
+    of = np.asarray(f32.attend_rows(q, cf, pos)).reshape(-1)
+    oi = np.asarray(i4.attend_rows(q, ci, pos)).reshape(-1)
+    cos = float(np.dot(of, oi)
+                / (np.linalg.norm(of) * np.linalg.norm(oi)))
+    assert cos > 0.99, cos
+
+
+def test_int4_rolling_rejected():
+    from dnn_tpu.runtime.kvcache import Int4KV, codec_for_cache
+
+    cfg = gpt.GPTConfig(vocab_size=31, block_size=64, n_layer=1,
+                        n_head=2, n_embd=32)
+    cache = Int4KV().init(cfg, 1, 16)
+    with pytest.raises(ValueError, match="rolling int4"):
+        codec_for_cache(cache, rolling=True, window=16)
+
+
+# ----------------------------------------------------------------------
+# fused paged flash-decode kernel (interpret mode runs the real index
+# maps on CPU)
+# ----------------------------------------------------------------------
+
+def test_paged_kernel_matches_gather_einsum():
+    from dnn_tpu.ops.pallas.cached_attention import (
+        paged_decode_attention,
+        reference_paged_decode_attention,
+    )
+
+    key = jax.random.PRNGKey(2)
+    B, Hk, D, nb, bp, NB = 3, 2, 16, 4, 16, 12
+    tables = jnp.asarray(
+        np.random.RandomState(0).randint(1, NB, (B, nb)), jnp.int32)
+    pos = jnp.asarray([5, 33, 63], jnp.int32)
+    for r, quant in ((1, False), (4, False), (1, True)):
+        q = jax.random.normal(jax.random.fold_in(key, r), (B, Hk, r, D))
+        if quant:
+            kp = jax.random.randint(
+                jax.random.fold_in(key, 10), (NB, Hk, bp, D), -127, 128,
+                dtype=jnp.int32).astype(jnp.int8)
+            vp = jax.random.randint(
+                jax.random.fold_in(key, 11), (NB, Hk, bp, D), -127, 128,
+                dtype=jnp.int32).astype(jnp.int8)
+            ks = jax.random.uniform(
+                jax.random.fold_in(key, 12), (NB, Hk, bp)) + 0.5
+            vs = jax.random.uniform(
+                jax.random.fold_in(key, 13), (NB, Hk, bp)) + 0.5
+        else:
+            kp = jax.random.normal(
+                jax.random.fold_in(key, 14), (NB, Hk, bp, D))
+            vp = jax.random.normal(
+                jax.random.fold_in(key, 15), (NB, Hk, bp, D))
+            ks = vs = None
+        ref = reference_paged_decode_attention(q, kp, vp, tables, pos,
+                                               ks=ks, vs=vs)
+        out = paged_decode_attention(q, kp, vp, tables, pos, ks=ks,
+                                     vs=vs, interpret=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-5, atol=2e-5)
+
+
+def test_paged_kernel_serving_parity(tiny):
+    """attn_kernel="interpret" on a paged pool runs the REAL kernel
+    inside the decode loop — token-identical to the einsum pool."""
+    from dnn_tpu.runtime.serving import GPTFamilyRows
+
+    cfg, prepared = tiny
+    prompt = np.arange(1, 13) % 89
+    t_ein, _ = _run(cfg, prepared, prompt, kv="paged")
+    fam = GPTFamilyRows(cfg, attn_kernel="interpret")
+    t_ker, bk = _run(cfg, prepared, prompt, kv="paged", family=fam)
+    assert bk._paged
+    np.testing.assert_array_equal(t_ein, t_ker)
+
+
+# ----------------------------------------------------------------------
+# multi-row gated writes (the gate-folded single-scatter form)
+# ----------------------------------------------------------------------
+
+def test_rows_write_multirow_gate_keeps_inactive_rows():
+    """A gated-off slot's cache must be untouched by a T>1 verify-shaped
+    write (the speculative path) — the gate folds into the written rows,
+    not a cache-sized select, and must not smear row 0 over T
+    positions."""
+    from dnn_tpu.runtime.kvcache import FloatKV
+
+    cfg = gpt.GPTConfig(vocab_size=31, block_size=64, n_layer=1,
+                        n_head=2, n_embd=32)
+    codec = FloatKV()
+    c = jax.tree.map(lambda x: x[0], codec.init(cfg, 2, 32))
+    base_k = jax.random.normal(jax.random.PRNGKey(3), c["k"].shape)
+    c = {"k": base_k, "v": base_k + 1}
+    k_new = jnp.ones((2, 2, 3, 16))
+    pos = jnp.asarray([4, 9], jnp.int32)
+    gate = jnp.asarray([True, False])
+    out = codec.write_rows(c, k_new, k_new, pos, gate)
+    # active slot: rows 4..6 overwritten
+    np.testing.assert_array_equal(np.asarray(out["k"][0, :, 4:7]), 1.0)
+    # inactive slot: bitwise untouched everywhere
+    np.testing.assert_array_equal(np.asarray(out["k"][1]),
+                                  np.asarray(base_k[1]))
+
+
+def test_unroll_layers_token_parity(tiny):
+    cfg, prepared = tiny
+    prompt = np.arange(1, 13) % 89
+    t_scan, _ = _run(cfg, prepared, prompt)
+    t_unroll, _ = _run(cfg, prepared, prompt, unroll_layers=True)
+    np.testing.assert_array_equal(t_scan, t_unroll)
+
+
+# ----------------------------------------------------------------------
+# quantization-aware byte accounting (obs/mem + utils/flops satellite)
+# ----------------------------------------------------------------------
+
+def test_logical_nbytes_prices_packed_int4():
+    from dnn_tpu.obs.mem import logical_nbytes
+
+    f32 = {"k": jnp.zeros((4, 8), jnp.float32)}
+    i8 = {"k": jnp.zeros((4, 8), jnp.int8)}
+    i4 = {"k": jnp.zeros((4, 8), jnp.int4)}
+    assert logical_nbytes(f32) == 128.0
+    assert logical_nbytes(i8) == 32.0
+    assert logical_nbytes(i4) == 16.0  # packed half byte, NOT itemsize
+
+
+def test_kv_bytes_per_pos_quantized_exact():
+    from dnn_tpu.utils.flops import kv_bytes_per_pos
+
+    cfg = gpt.GPTConfig(vocab_size=31, block_size=64, n_layer=3,
+                        n_head=4, n_embd=64)
+    # f32 dtype: 2 leaves x L x C x 4 bytes
+    assert kv_bytes_per_pos(cfg, kv_dtype=jnp.float32) == 2 * 3 * 64 * 4
+    # int8: 1-byte payload + per-(position, head) f32 K and V scales
+    assert kv_bytes_per_pos(cfg, kv_dtype="int8") == \
+        2 * 3 * (64 * 1 + 4 * 4)
+    # int4: packed half-byte payload + the same scale rows
+    assert kv_bytes_per_pos(cfg, kv_dtype="int4") == \
+        2 * 3 * (64 * 0.5 + 4 * 4)
+    # legacy kv_bytes path unchanged
+    assert kv_bytes_per_pos(cfg, kv_bytes=2) == 2 * 3 * 64 * 2
+
+
+def test_kv_cache_bytes_gauge_tracks_quantization(tiny):
+    cfg, prepared = tiny
+    _, bf = _run(cfg, prepared, np.arange(1, 5), new_tokens=2)
+    _, b4 = _run(cfg, prepared, np.arange(1, 5), new_tokens=2,
+                 kv_dtype="int4")
+    f32_bytes = bf._kv_bytes_read()
+    i4_bytes = b4._kv_bytes_read()
+    assert f32_bytes > 0
+    # int4 payload is 1/8 of f32; scales push the total a bit above that
+    assert i4_bytes < f32_bytes / 4
+    assert "serving.kv_cache_bytes" in bf._obs_gauges

@@ -1,0 +1,120 @@
+"""Speculative x bucketed decode composition (ISSUE 6).
+
+Before this PR the two length-aware paths were disjoint: the bucket
+ladder shrank decode bytes/step but SpeculativeBatcher rejected
+`decode_buckets=`, so acceptance-weighted tokens/step and
+bytes-proportional-to-live-context could not multiply. The composition's
+correctness argument is the same bucket-view lemma PR 1 proved for the
+dense step — a rung differs from the full allocation only in columns
+beyond every row's band limit — applied to all three spec programs
+(draft sync, draft propose, target verify), plus the +k scratch headroom
+every grow must cover. This module pins:
+
+  * greedy token identity: spec x bucketed == the PLAIN dense batcher
+    (the spec parity contract), through bucket-edge crossings, with the
+    ladder actually exercised (cache grew);
+  * sampled-stream identity: spec x bucketed == spec unbucketed
+    draw-for-draw (same rng discipline, mask-identical rungs);
+  * draft pool lockstep: both caches sit on the same rung after a grow;
+  * the paged pool stays un-composed: kv="paged" rejected, kv="auto"
+    resolves dense.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+import pytest
+
+from dnn_tpu.models import gpt
+from dnn_tpu.runtime.serving import ContinuousBatcher
+from dnn_tpu.runtime.serving_spec import SpeculativeBatcher
+
+
+@pytest.fixture(scope="module")
+def models():
+    cfg = gpt.GPTConfig(vocab_size=89, block_size=256, n_layer=2,
+                        n_head=2, n_embd=32)
+    d_cfg = gpt.GPTConfig(vocab_size=89, block_size=256, n_layer=1,
+                          n_head=2, n_embd=16)
+    key = jax.random.PRNGKey(0)
+    prepared = gpt.prepare_stacked(gpt.init(key, cfg), cfg)
+    d_prepared = gpt.prepare_stacked(
+        gpt.init(jax.random.fold_in(key, 1), d_cfg), d_cfg)
+    return cfg, prepared, d_cfg, d_prepared
+
+
+PROMPT = (np.arange(1, 20) * 3) % 89
+
+
+def test_spec_bucketed_greedy_parity_through_rungs(models):
+    cfg, prepared, d_cfg, d_prepared = models
+    ref = ContinuousBatcher(cfg, prepared, slots=2, max_len=192,
+                            prompt_pad=16)
+    r0 = ref.submit(PROMPT, max_new_tokens=120)
+    t_ref = np.asarray(ref.drain()[r0])
+
+    sp = SpeculativeBatcher(cfg, prepared, d_cfg, d_prepared, spec_k=3,
+                            slots=2, max_len=192, prompt_pad=16,
+                            decode_buckets=True)
+    first_rung = sp._cache_len
+    r1 = sp.submit(PROMPT, max_new_tokens=120)
+    t_sp = np.asarray(sp.drain()[r1])
+    np.testing.assert_array_equal(t_ref, t_sp)
+    # the ladder was exercised: live positions crossed 64 and 128
+    assert sp._buckets == (64, 128, 192)
+    assert sp._cache_len > first_rung
+    # the draft pool grew in lockstep (same rung as the target)
+    d_len = jax.tree.leaves(sp.d_cache)[0].shape[3]
+    assert d_len == sp._cache_len
+    # speculation actually sped things up (something was accepted)
+    assert sp.spec_accepted > 0
+
+
+def test_spec_bucketed_matches_spec_unbucketed_sampled(models):
+    cfg, prepared, d_cfg, d_prepared = models
+
+    def run(**kw):
+        sp = SpeculativeBatcher(cfg, prepared, d_cfg, d_prepared,
+                                spec_k=2, slots=2, max_len=192,
+                                prompt_pad=16, temperature=0.8,
+                                top_k=11, **kw)
+        rid = sp.submit(PROMPT, max_new_tokens=90, seed=7)
+        return np.asarray(sp.drain()[rid])
+
+    t_flat = run()
+    t_buck = run(decode_buckets=True)
+    # bucket rungs are attention-invisible, and the rng discipline is
+    # shared — the SAMPLED stream must agree draw-for-draw
+    np.testing.assert_array_equal(t_flat, t_buck)
+
+
+def test_spec_bucketed_multi_slot_mixed_retirement(models):
+    cfg, prepared, d_cfg, d_prepared = models
+    sp = SpeculativeBatcher(cfg, prepared, d_cfg, d_prepared, spec_k=3,
+                            slots=2, max_len=192, prompt_pad=16,
+                            decode_buckets=True)
+    ra = sp.submit(PROMPT, max_new_tokens=100)
+    rb = sp.submit((PROMPT + 7) % 89, max_new_tokens=30)
+    out = sp.drain()
+    assert len(out[ra]) == 100 and len(out[rb]) == 30
+    # each stream matches its solo run through the plain batcher
+    for rid, prompt, budget in ((ra, PROMPT, 100),
+                                (rb, (PROMPT + 7) % 89, 30)):
+        ref = ContinuousBatcher(cfg, prepared, slots=1, max_len=192,
+                                prompt_pad=16)
+        rr = ref.submit(prompt, max_new_tokens=budget)
+        np.testing.assert_array_equal(np.asarray(ref.drain()[rr]),
+                                      np.asarray(out[rid]))
+
+
+def test_spec_rejects_paged_resolves_auto_dense(models):
+    cfg, prepared, d_cfg, d_prepared = models
+    with pytest.raises(ValueError, match="paged"):
+        SpeculativeBatcher(cfg, prepared, d_cfg, d_prepared,
+                           slots=2, max_len=192, prompt_pad=16,
+                           kv="paged")
+    sp = SpeculativeBatcher(cfg, prepared, d_cfg, d_prepared,
+                            slots=2, max_len=192, prompt_pad=16,
+                            kv="auto")
+    assert not sp._paged  # the serving default resolves dense here
