@@ -341,6 +341,27 @@ def main():
                               "vs_studies_s10")}
     except Exception as e:  # noqa: BLE001 — headline must survive
         row["decode_goodput"] = {"error": str(e)[:200]}
+    # inter-stage transport contract (ISSUE 7): every round's row carries
+    # the relay_transport A-B numbers — negotiated-auto vs nested-grpc
+    # per-hop p50 and the fleet-stitched bubble fraction, measured fresh
+    # on real stage subprocesses (benchmarks/relay_transport_probe.py,
+    # light leg). Error-isolated like decode_goodput: never allowed to
+    # cost the round its headline.
+    try:
+        from benchmarks.relay_transport_probe import measure as _rt_measure
+
+        r = _rt_measure(light=True)
+        row["relay_transport"] = {
+            "hop_p50_ratio": r["hop_p50_ratio"],
+            "bubble_drop": r["bubble_drop"],
+            "vs_studies_s10": r["vs_studies_s10"],
+            "negotiated": r["auto"]["negotiated"],
+            "hop_nested_grpc_p50_ms": r["grpc"]["hop_nested_p50_ms"],
+            "hop_streamed_auto_p50_ms": r["auto"]["hop_streamed_p50_ms"],
+            "ok": r["ok"],
+        }
+    except Exception as e:  # noqa: BLE001 — headline must survive
+        row["relay_transport"] = {"error": str(e)[:200]}
     from dnn_tpu import obs
 
     if on_cpu:

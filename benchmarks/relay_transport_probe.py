@@ -1,0 +1,301 @@
+"""relay_transport probe: A-B nested-gRPC vs negotiated-auto hops.
+
+ISSUE 7's regression contract on the 2-stage cifar config — the exact
+deployment STUDIES.md §10 measured at 75.9% warm bubble: two REAL stage
+server processes plus a client, so the numbers carry true process
+isolation (an in-process simulation shares one GIL and measures
+contention, not transport).
+
+  * leg A (baseline): both stage daemons pinned to `transport="grpc"` —
+    the reference behavior: nested unary chain, serialized payloads,
+    every hop held open for the full downstream latency;
+  * leg B (negotiated-auto): the same stages on `transport="auto"`; the
+    two processes negotiate the shm rung (probe-proven same host, one
+    memcpy per hop, zero serialization) and the streamed Relay path
+    replaces the nested chain (ack-early MPMD overlap).
+
+Everything is read off the EXISTING obs surfaces, never ad-hoc timers:
+
+  * per-hop latency: the node1 daemon's
+    `comm_hop_seconds{stage="node1",transport=,mode=}` summary scraped
+    from its /metrics endpoint — the time the upstream was HELD per
+    microbatch (mode="nested": the full downstream round trip — that is
+    what nested means; mode="streamed": the handoff incl. backpressure
+    stalls). Assert floor: leg B streamed p50 <= 1/5 of leg A nested p50.
+  * bubble fraction: obs.fleet.FleetCollector polling all three
+    processes' /trace.jsonl, NTP-style offset estimation, and
+    critical_path over the stitched request — §10's pipeline, §10's
+    arithmetic. Assert floor: leg B's stitched warm bubble <= 1/2 of
+    leg A's (and reported against the recorded 0.759).
+
+`python -m benchmarks.relay_transport_probe [--assert] [--light]`
+prints one JSON row; --assert exits nonzero when a floor fails (the
+run_all `relay_transport` row and bench.py's round attachment both ride
+`measure()`).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+import urllib.request
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+# NOTE: no module-level JAX_PLATFORMS mutation — importers (bench.py, a
+# TPU-substrate run_all parent) must not have their environment forced
+# to CPU as an import side effect. The stage children pin themselves to
+# CPU in _spawn_stage; the standalone CLI pins its own process in main().
+
+HOP_RATIO_FLOOR = 5.0     # auto per-hop p50 must be <= grpc p50 / 5
+BUBBLE_DROP_FLOOR = 2.0   # auto stitched bubble must be <= grpc / 2
+S10_BUBBLE = 0.759        # STUDIES.md §10 recorded warm bubble (nested)
+
+# (grpc_port1, grpc_port2, metrics_port1, metrics_port2) per leg
+_PORTS = {"grpc": (59491, 59492, 59591, 59592),
+          "auto": (59493, 59494, 59593, 59594)}
+
+_CHILD_SRC = """
+import asyncio, sys
+sys.path.insert(0, {repo!r})
+from dnn_tpu.config import TopologyConfig
+from dnn_tpu.runtime.engine import PipelineEngine
+from dnn_tpu.comm.service import serve_stage
+
+cfg = TopologyConfig.from_dict({cfg!r})
+engine = PipelineEngine(cfg)
+asyncio.run(serve_stage(engine, {node_id!r}, metrics_port={mport},
+                        transport={pref!r}))
+"""
+
+
+def _leg_config(p1: int, p2: int) -> dict:
+    return {
+        "nodes": [
+            {"id": "node1", "address": f"127.0.0.1:{p1}", "part_index": 0},
+            {"id": "node2", "address": f"127.0.0.1:{p2}", "part_index": 1},
+        ],
+        "num_parts": 2, "model": "cifar_cnn", "runtime": "relay",
+        "device_type": "cpu",
+    }
+
+
+def _spawn_stage(tmpdir: str, cfg: dict, node_id: str, mport: int,
+                 pref: str):
+    script = os.path.join(tmpdir, f"stage_{node_id}_{pref}.py")
+    with open(script, "w") as f:
+        f.write(_CHILD_SRC.format(repo=REPO, cfg=cfg, node_id=node_id,
+                                  mport=mport, pref=pref))
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH=REPO + os.pathsep + os.environ.get("PYTHONPATH",
+                                                             ""))
+    env.pop("XLA_FLAGS", None)
+    return subprocess.Popen([sys.executable, script], env=env,
+                            stdout=subprocess.DEVNULL,
+                            stderr=subprocess.DEVNULL)
+
+
+def _wait_up(port: int, deadline: float = 120.0) -> bool:
+    """Fresh channel per poll: an early-failing channel can wedge in
+    reconnect backoff and never see the late bind."""
+    from dnn_tpu.comm.client import NodeClient
+
+    t_end = time.monotonic() + deadline
+    while time.monotonic() < t_end:
+        probe = NodeClient(f"127.0.0.1:{port}")
+        try:
+            if probe.health_check(timeout=2.0):
+                return True
+        finally:
+            probe.close()
+        time.sleep(0.5)
+    return False
+
+
+def _scrape(samples_url: str):
+    from dnn_tpu.obs.fleet import _Samples, parse_prometheus
+
+    with urllib.request.urlopen(samples_url, timeout=10) as r:
+        return _Samples(parse_prometheus(r.read().decode()))
+
+
+def _hop_quantiles(metrics_url: str, mode: str):
+    """(p50_ms, p99_ms, transport) for node1's downstream hop series of
+    the given mode, whatever transport it negotiated."""
+    s = _scrape(metrics_url + "/metrics")
+    for name, labs, _v in s._samples:
+        if name == "comm_hop_seconds" and labs.get("stage") == "node1" \
+                and labs.get("mode") == mode and "quantile" in labs:
+            tr = labs.get("transport")
+            p50 = s.get("comm_hop_seconds", stage="node1", mode=mode,
+                        transport=tr, quantile="0.5")
+            p99 = s.get("comm_hop_seconds", stage="node1", mode=mode,
+                        transport=tr, quantile="0.99")
+            return (round(p50 * 1e3, 3) if p50 is not None else None,
+                    round(p99 * 1e3, 3) if p99 is not None else None, tr)
+    return None, None, None
+
+
+def _measure_leg(pref: str, tmpdir: str, n_unary: int, n_stream: int):
+    import numpy as np
+
+    from dnn_tpu import obs
+    from dnn_tpu.comm.client import NodeClient
+    from dnn_tpu.config import TopologyConfig
+    from dnn_tpu.obs.fleet import FleetCollector
+    from dnn_tpu.runtime.engine import PipelineEngine
+
+    p1, p2, m1, m2 = _PORTS[pref]
+    cfg = _leg_config(p1, p2)
+    children = [
+        _spawn_stage(tmpdir, cfg, "node1", m1, pref),
+        _spawn_stage(tmpdir, cfg, "node2", m2, pref),
+    ]
+    client_srv = None
+    c = None
+    col = None
+    try:
+        for port in (p1, p2):
+            if not _wait_up(port):
+                raise RuntimeError(f"stage on :{port} never came up")
+        # the probe process is the client; its spans are served from its
+        # own obs endpoint so the fleet collector stitches all THREE
+        # processes, §10-style
+        client_srv = obs.serve_metrics(0)
+        local = PipelineEngine(TopologyConfig.from_dict(cfg))
+        x = np.asarray(local.spec.example_input(batch_size=1))
+        c = NodeClient(f"127.0.0.1:{p1}",
+                       transport="grpc" if pref == "grpc" else "auto")
+        # warm: compiles, channels, negotiation, both code paths
+        for _ in range(3):
+            status, result = c.send_tensor(x, request_id="warm")
+            assert result is not None, status
+        c.send_tensors([x] * 2, request_id="warm_s")
+        obs.collector().clear()
+
+        unary_traces = []
+        for i in range(n_unary):
+            with obs.span("relay_probe.request", leg=pref) as sp:
+                status, result = c.send_tensor(x, request_id=f"p{i}")
+            assert result is not None, status
+            unary_traces.append(sp.trace_id)
+        # median of three streams: a single scheduler hiccup on a busy
+        # CI host can double one stream's wall time
+        stream_traces = []
+        for _ in range(3):
+            with obs.span("relay_probe.stream", leg=pref) as sp:
+                outs = c.send_tensors([x] * n_stream, request_id="ps")
+            assert all(r is not None for _, r in outs)
+            stream_traces.append(sp.trace_id)
+
+        targets = {"client": f"http://127.0.0.1:{client_srv.port}",
+                   "node1": f"http://127.0.0.1:{m1}",
+                   "node2": f"http://127.0.0.1:{m2}"}
+        col = FleetCollector(targets, interval_s=3600.0)
+        col.poll_once()
+
+        def bubble(tid):
+            rep = col.request_report(tid)
+            return float(rep.get("bubble_fraction", float("nan")))
+
+        bubbles = sorted(bubble(t) for t in unary_traces)
+        s_bubbles = sorted(bubble(t) for t in stream_traces)
+        nested_p50, nested_p99, tr_n = _hop_quantiles(targets["node1"],
+                                                      "nested")
+        stream_p50, stream_p99, tr_s = _hop_quantiles(targets["node1"],
+                                                      "streamed")
+        return {
+            "negotiated": tr_s or tr_n or "grpc",
+            "hop_nested_p50_ms": nested_p50,
+            "hop_nested_p99_ms": nested_p99,
+            "hop_streamed_p50_ms": stream_p50,
+            "hop_streamed_p99_ms": stream_p99,
+            "bubble_fraction": round(bubbles[len(bubbles) // 2], 4),
+            "bubble_fraction_streamed": round(
+                s_bubbles[len(s_bubbles) // 2], 4),
+        }
+    finally:
+        if col is not None:
+            col.close()
+        if c is not None:
+            c.close()
+        if client_srv is not None:
+            client_srv.close()
+        for child in children:
+            child.terminate()
+        for child in children:
+            try:
+                child.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                child.kill()
+
+
+def measure(light: bool = False) -> dict:
+    """-> one row comparing the two legs. `light` shrinks the sample
+    counts (bench.py's per-round attachment)."""
+    import jax
+
+    n_unary, n_stream = (7, 8) if light else (13, 16)
+    with tempfile.TemporaryDirectory(prefix="relay_transport_") as tmpdir:
+        grpc_leg = _measure_leg("grpc", tmpdir, n_unary, n_stream)
+        auto_leg = _measure_leg("auto", tmpdir, n_unary, n_stream)
+    # the A-B contract: leg A is the reference behavior (nested unary
+    # chain, grpc payloads); leg B is what negotiated-auto actually
+    # serves (shm payloads + the ack-early streamed schedule)
+    hop_a = grpc_leg["hop_nested_p50_ms"]
+    hop_b = auto_leg["hop_streamed_p50_ms"]
+    ratio = (hop_a / hop_b) if hop_a and hop_b else float("nan")
+    bubble_auto = auto_leg["bubble_fraction_streamed"]
+    bubble_grpc = grpc_leg["bubble_fraction"]
+    ok_hop = bool(hop_a and hop_b and hop_b <= hop_a / HOP_RATIO_FLOOR)
+    ok_bubble = bool(bubble_auto <= bubble_grpc / BUBBLE_DROP_FLOOR)
+    return {
+        "grpc": grpc_leg,
+        "auto": auto_leg,
+        "hop_p50_ratio": round(ratio, 2),
+        "bubble_drop": round(bubble_grpc / bubble_auto, 2)
+        if bubble_auto else float("inf"),
+        "vs_studies_s10": {"recorded_bubble": S10_BUBBLE,
+                           "auto_bubble": bubble_auto,
+                           "drop": round(S10_BUBBLE / bubble_auto, 2)
+                           if bubble_auto else float("inf")},
+        "ok": bool(ok_hop and ok_bubble),
+        "ok_hop": ok_hop,
+        "ok_bubble": ok_bubble,
+        "platform": jax.default_backend(),
+    }
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--assert", dest="do_assert", action="store_true",
+                    help="exit nonzero when a floor fails (hop p50 ratio "
+                         f">= {HOP_RATIO_FLOOR}x, bubble drop >= "
+                         f"{BUBBLE_DROP_FLOOR}x)")
+    ap.add_argument("--light", action="store_true",
+                    help="smaller sample counts (the bench round's "
+                         "attachment)")
+    args = ap.parse_args(argv)
+    # standalone CLI: same-host CPU substrate by definition
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    row = measure(light=args.light)
+    print(json.dumps(row), flush=True)
+    if args.do_assert and not row["ok"]:
+        print(f"ASSERT FAILED: hop ratio {row['hop_p50_ratio']} "
+              f"(floor {HOP_RATIO_FLOOR}), bubble drop "
+              f"{row['bubble_drop']} (floor {BUBBLE_DROP_FLOOR})",
+              file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

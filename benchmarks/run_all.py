@@ -590,6 +590,35 @@ def dev_fleet_overhead():
     return results
 
 
+@device_config("relay_transport")
+def dev_relay_transport():
+    # ISSUE 7: the pluggable-transport A-B contract on the 2-stage cifar
+    # config — real stage-server subprocesses, per-hop latency off the
+    # stages' own /metrics summaries and the stitched bubble fraction
+    # off the fleet collector's critical-path arithmetic (never ad-hoc
+    # timers). Asserted floors: negotiated-auto streamed hop p50 <= 1/5
+    # of the nested-grpc hop p50, and the stitched warm bubble <= 1/2 of
+    # the nested leg's (STUDIES §10 recorded 75.9% for the baseline).
+    from benchmarks.relay_transport_probe import (
+        BUBBLE_DROP_FLOOR,
+        HOP_RATIO_FLOOR,
+        measure,
+    )
+
+    results = []
+    row = measure()
+    ok = row.pop("ok")
+    ratio = row.pop("hop_p50_ratio")
+    _emit(results, config="relay_transport", metric="hop_p50_ratio",
+          value=ratio, ok=ok,
+          note=f"negotiated-auto ({row['auto']['negotiated']}+streamed) "
+               f"vs nested-grpc per-hop p50; floors: hop ratio >= "
+               f"{HOP_RATIO_FLOOR:.0f}x, stitched bubble drop >= "
+               f"{BUBBLE_DROP_FLOOR:.0f}x (recorded §10 baseline 75.9%)",
+          **row)
+    return results
+
+
 @device_config("decode_mbu")
 def dev_decode_mbu():
     # ISSUE 6: live MBU of the decode hot path from the goodput gauges,

@@ -147,6 +147,9 @@ def main(argv=None) -> int:
         pipe = program_report.get("pipeline", {})
         print(f"  pipeline stage collective signature: "
               f"{pipe.get('collective_signature')}")
+        tp = program_report.get("transport", {})
+        print(f"  transport hop program ({tp.get('stages')} stages) "
+              f"collective signature: {tp.get('collective_signature')}")
         eng = program_report.get("engine", {})
         print(f"  engine[{eng.get('runtime')}] batch census: "
               f"{eng.get('batch_census', {}).get('programs')} programs "

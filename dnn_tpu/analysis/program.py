@@ -427,6 +427,50 @@ def audit_pipeline_programs(num_stages: int = 2, *, feature: int = 8,
             "stages": num_stages, "findings": findings}
 
 
+def audit_transport_programs(num_stages: int = 4, *, feature: int = 8,
+                             batch: int = 2) -> dict:
+    """Device-transport send/recv programs (comm/transport.py
+    make_hop_program): the compiled ppermute shuttle that moves a
+    mesh-resident activation from stage i to stage i+1 is ONE program
+    switching over the hop index — every switch branch must issue the
+    IDENTICAL collective sequence (one ppermute) or ranks deadlock on a
+    real pod, the same SPMD contract PRG001 enforces on the pipeline's
+    stage switch. Traced abstractly on a real mesh — no compile, no
+    execution."""
+    from jax.sharding import Mesh
+
+    from dnn_tpu.comm.transport import make_hop_program
+    from dnn_tpu.parallel.mesh import STAGE_AXIS
+
+    devs = jax.devices()
+    if len(devs) < num_stages:
+        return {"skipped": f"need {num_stages} devices, have {len(devs)}",
+                "findings": []}
+    mesh = Mesh(np.array(devs[:num_stages]), (STAGE_AXIS,))
+    hop = make_hop_program(mesh, STAGE_AXIS)
+    buf = jnp.zeros((num_stages, batch, feature))
+    closed = jax.make_jaxpr(lambda h, b: hop(h, b))(jnp.int32(0), buf)
+    findings = check_branch_collectives(
+        closed, "comm/transport.make_hop_program")
+    findings += baked_constants(
+        closed, where="comm/transport.make_hop_program")
+    # the traced signature concatenates over the switch's branches (one
+    # branch per hop): it must be exactly one ppermute PER BRANCH — a
+    # branch growing a second collective (or losing its ppermute) is a
+    # deadlock on a real mesh even when the branches still AGREE with
+    # each other (which check_branch_collectives pins above)
+    sig = collective_signature(closed)
+    if tuple(sig) != ("ppermute",) * (num_stages - 1):
+        findings.append(Finding(
+            rule="PRG001", path="comm/transport.make_hop_program", line=0,
+            message=f"transport hop program must issue exactly one "
+                    f"ppermute per hop branch ({num_stages - 1} hops), "
+                    f"traced {list(sig) or 'none'}",
+            snippet=f"stages={num_stages}"))
+    return {"collective_signature": list(sig),
+            "stages": num_stages, "findings": findings}
+
+
 def audit_engine(*, batch_sweep: Sequence[int] = (1, 2, 4, 8)) -> dict:
     """PipelineEngine predict (runtime/engine.py): build the smallest
     registered pipeline model end to end, jaxpr-check its compiled
@@ -491,6 +535,7 @@ def run_program_audit(*, max_len: int = 128) -> Tuple[dict, List[Finding]]:
     report["decode"] = audit_decode_paths(max_len=max_len)
     report["serving_decode"] = audit_serving_decode(max_len=max_len)
     report["pipeline"] = audit_pipeline_programs()
+    report["transport"] = audit_transport_programs()
     report["engine"] = audit_engine()
     for section in report.values():
         findings.extend(section.pop("findings", []))

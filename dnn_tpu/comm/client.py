@@ -12,6 +12,7 @@ on transient transport failures.
 from __future__ import annotations
 
 import logging
+import threading
 import time
 from typing import Optional
 
@@ -19,7 +20,9 @@ import grpc
 import numpy as np
 
 from dnn_tpu import obs
+from dnn_tpu.comm import transport as _tx
 from dnn_tpu.comm import wire_pb2 as pb
+from dnn_tpu.comm import wirecodec as wc
 from dnn_tpu.comm.service import (
     PER_STAGE_BUDGET_S,
     RETRYABLE_CODES,
@@ -33,13 +36,24 @@ from dnn_tpu.utils.metrics import labeled
 log = logging.getLogger("dnn_tpu.comm")
 
 
-def pipeline_budget(num_parts: int, *, margin: float = 30.0) -> float:
+def pipeline_budget(num_parts: int, *, margin: float = 30.0,
+                    transport: str = "grpc", warm: bool = False) -> float:
     """Overall edge-client budget for one pipeline traversal: one per-stage
     slice per part plus a margin. Strictly larger than the first hop's
-    server-side budget (PER_STAGE_BUDGET_S * (num_parts - 1), see
-    StageServer._forward), so a downstream timeout surfaces to the client
-    as an error status from the first stage, never as the client's own
-    DEADLINE_EXCEEDED racing the relay."""
+    server-side budget (transport.hop_budget_s over num_parts - 1 stages,
+    see StageServer._forward), so a downstream timeout surfaces to the
+    client as an error status from the first stage, never as the client's
+    own DEADLINE_EXCEEDED racing the relay. `transport` is the edge hop's
+    NEGOTIATED transport — a device/shm pipeline sheds the gRPC
+    serialization margin per stage (the satellite fix), and `warm=True`
+    additionally drops to the post-compile slice ONLY when the caller
+    knows every downstream hop is warm too: the domination invariant
+    above assumes uniform rungs, so a cold or mixed pipeline must keep
+    the default cold slice (and a pipeline whose downstream rungs fall
+    back to grpc should keep transport="grpc", whose arithmetic is
+    reference-compatible bit-exact)."""
+    if transport in ("device", "shm"):
+        return _tx.hop_budget_s(transport, num_parts, warm=warm) + margin
     return PER_STAGE_BUDGET_S * num_parts + margin
 
 
@@ -73,14 +87,73 @@ def _gen_rid(max_new_tokens, seed, temperature, top_k, top_p,
 
 class NodeClient:
     """Sync client for a NodeService endpoint (ours or a reference node's —
-    the wire protocol is identical)."""
+    the wire protocol is identical).
 
-    def __init__(self, address: str):
+    `transport` sets the hop preference for tensor submissions
+    (comm/transport.py): "auto" (default) negotiates device -> shm ->
+    grpc on first send via a wire-compatible SendMessage handshake —
+    reference peers (and the LM daemon, which declines) land on grpc
+    transparently; explicit "device"/"shm" fail loud when unsatisfiable;
+    "grpc" skips the handshake entirely (byte-identical reference
+    behavior)."""
+
+    def __init__(self, address: str, *, transport: str = "auto"):
         from dnn_tpu.native import native_available
 
         native_available()  # warm the one-time native codec build up front
+        if transport not in _tx.TRANSPORTS:
+            raise ValueError(
+                f"transport must be one of {_tx.TRANSPORTS}, got "
+                f"{transport!r}")
         self.address = address
+        self.transport = transport
         self._channel = grpc.insecure_channel(address)
+        self._negotiated: Optional[_tx.Negotiated] = None
+        self._neg_lock = threading.Lock()
+
+    # -- transport negotiation (comm/transport.py) ----------------------
+
+    def _raw_send_message(self, sender_id: str, text: str,
+                          timeout: float = 10.0) -> str:
+        """Bare SendMessage (no spans/tagging) — the negotiation
+        side-channel."""
+        call = self._channel.unary_unary(
+            f"/{SERVICE_NAME}/SendMessage",
+            request_serializer=pb.MessageRequest.SerializeToString,
+            response_deserializer=pb.MessageReply.FromString,
+        )
+        return call(pb.MessageRequest(sender_id=sender_id,
+                                      message_text=text),
+                    timeout=timeout).confirmation_text
+
+    def _ensure_negotiated(self) -> _tx.Negotiated:
+        """Negotiate once per client. A transport-level RPC failure
+        (endpoint not up yet) returns an UNCACHED grpc verdict — the
+        unary send's own retry loop handles the outage, and the
+        handshake reruns on the next call. TransportMisconfigError
+        (explicit request refused) propagates — fail-loud."""
+        with self._neg_lock:
+            if self._negotiated is not None:
+                return self._negotiated
+            if self.transport == "grpc":
+                self._negotiated = _tx.Negotiated(
+                    "grpc", _tx.GrpcSender(), reason="explicit")
+                return self._negotiated
+            try:
+                neg = _tx.negotiate_over(
+                    self._raw_send_message, transport=self.transport,
+                    target=self.address)
+            except grpc.RpcError as e:
+                code = e.code() if hasattr(e, "code") else None
+                if code == grpc.StatusCode.UNIMPLEMENTED:
+                    # peer has no SendMessage at all: a permanent verdict
+                    self._negotiated = _tx.Negotiated(
+                        "grpc", _tx.GrpcSender(), reason="no SendMessage")
+                    return self._negotiated
+                return _tx.Negotiated("grpc", _tx.GrpcSender(),
+                                      reason=f"hello failed: {code}")
+            self._negotiated = neg
+            return neg
 
     def health_check(self, timeout: float = 5.0) -> bool:
         call = self._channel.unary_unary(
@@ -137,27 +210,37 @@ class NodeClient:
         resend is safe. `timeout` is the OVERALL budget across all attempts
         and backoff sleeps, not a per-attempt deadline.
 
+        The payload rides the NEGOTIATED transport: a device hop hands
+        the array through the in-process mailbox (zero serialization), a
+        shm hop writes it once into a shared ring slot, and the grpc
+        fallback carries the inline zero-copy tensor — byte-identical to
+        the reference wire. Ticket payloads persist until the response
+        lands, so transport-level retries stay safe on every rung.
+
         Observability: the call runs under an `rpc.SendTensor` span
-        (parented to the ambient obs span when one is active), and the
-        span's trace rides to the server as a `tr=` request_id segment —
-        wire-compatible (every peer treats request_id as opaque; our
-        servers parse and continue the trace). Per-attempt latency and
-        payload bytes land in the shared registry; each retry bumps
+        (parented to the ambient obs span when one is active) carrying a
+        `transport` attr, and the span's trace rides to the server as a
+        `tr=` request_id segment — wire-compatible (every peer treats
+        request_id as opaque; our servers parse and continue the trace).
+        Per-attempt latency and payload bytes land in the shared
+        registry (histograms labeled by transport, plus the
+        exact-quantile `comm.hop_seconds` series); each retry bumps
         `comm.retries_total{target=...}` and logs the trace id so a
         backoff storm is attributable to the requests living through it."""
+        neg = self._ensure_negotiated()
         call = self._channel.unary_unary(
             f"/{SERVICE_NAME}/SendTensor",
-            request_serializer=pb.TensorRequest.SerializeToString,
-            response_deserializer=pb.TensorResponse.FromString,
+            request_serializer=wc.serialize_request,
+            response_deserializer=wc.parse_response,
         )
         sp = obs.start_span("rpc.SendTensor", parent=obs.current_span(),
-                            target=self.address)
-        request = pb.TensorRequest(
-            request_id=obs.tag_request_id(request_id, sp),
-            tensor=_tensor_msg(arr))
+                            target=self.address, transport=neg.name)
+        request = neg.sender.make_request(
+            arr, obs.tag_request_id(request_id, sp) if sp else request_id)
         m = obs.metrics()
         deadline = time.monotonic() + timeout
         attempt = 0
+        completed = False
         try:
             while True:
                 remaining = deadline - time.monotonic()
@@ -171,6 +254,7 @@ class NodeClient:
                 try:
                     t_send_wall = time.time() if sp else 0.0
                     resp = call(request, timeout=max(remaining, 0.001))
+                    dt = time.perf_counter() - t_try
                     if sp:
                         # clock-offset sampling for cross-host trace
                         # stitching (obs/fleet.py): the SUCCESSFUL
@@ -182,8 +266,13 @@ class NodeClient:
                     if m is not None:
                         m.observe_hist(
                             labeled("comm.rpc_latency_seconds",
-                                    method="SendTensor", role="client"),
-                            time.perf_counter() - t_try)
+                                    method="SendTensor", role="client",
+                                    transport=neg.name),
+                            dt)
+                        m.observe(labeled("comm.hop_seconds",
+                                          target=self.address,
+                                          transport=neg.name,
+                                          mode="nested"), dt)
                         m.inc(labeled("comm.payload_bytes_total",
                                       direction="in"), resp.ByteSize())
                     # decode INSIDE the loop: a crc32c mismatch on the
@@ -194,6 +283,7 @@ class NodeClient:
                         if resp.HasField("result_tensor") else None
                     )
                     sp.set(attempts=attempt + 1)
+                    completed = True
                     return resp.status, result
                 except (grpc.RpcError, PayloadCorruptError) as e:
                     code = e.code() if isinstance(e, grpc.RpcError) else None
@@ -224,7 +314,116 @@ class NodeClient:
                     time.sleep(delay)
                     attempt += 1
         finally:
+            # ticket payloads (device mailbox entry / shm ring slot)
+            # live until the hop resolves, so retries can resend them
+            if completed:
+                neg.sender.sent_ok(request)
+            else:
+                neg.sender.cleanup(request)
             sp.end()
+
+    def send_tensors(
+        self,
+        arrs,
+        *,
+        request_id: str = "req",
+        timeout: float = 120.0,
+    ):
+        """Submit a SEQUENCE of activations (microbatches) over the
+        streamed Relay path: every item is acked by the first stage as
+        soon as it is accepted, so stage 0 computes microbatch m+1 while
+        the downstream stages work on m — the cross-process MPMD overlap
+        the nested unary chain cannot express. Oversized payloads ride
+        chunked (comm/transport.py CHUNK_BYTES), lifting the unary
+        path's 4 MB gRPC message ceiling.
+
+        Returns [(status, result_or_None), ...] in submission order.
+        NOT retried: the stream is stateful (acks already released
+        payload slots) — callers needing at-least-once fall back to
+        per-item `send_tensor`. Peers without the Relay RPC (reference
+        nodes) degrade to exactly that sequential unary fallback."""
+        arrs = list(arrs)
+        if not arrs:
+            return []
+        neg = self._ensure_negotiated()
+        if neg.relay_known and not neg.relay_ok:
+            # the handshake already said the peer has no Relay RPC
+            # (reference protocol): go straight to the unary chain
+            # instead of paying a doomed probe per call
+            return [self.send_tensor(a, request_id=request_id,
+                                     timeout=timeout) for a in arrs]
+        sp = obs.start_span("rpc.Relay", parent=obs.current_span(),
+                            target=self.address, transport=neg.name,
+                            items=len(arrs))
+        m = obs.metrics()
+        pending = {}
+        send_ts = {}
+        results: dict = {}
+        statuses: dict = {}
+
+        def frames():
+            for seq, arr in enumerate(arrs):
+                req = neg.sender.make_request(
+                    arr, obs.tag_request_id(request_id, sp)
+                    if sp else request_id)
+                pending[seq] = req
+                send_ts[seq] = time.perf_counter()
+                yield from _tx.split_requests(req, seq)
+
+        call = self._channel.stream_stream(
+            f"/{SERVICE_NAME}/Relay",
+            request_serializer=wc.serialize_request,
+            response_deserializer=wc.parse_response,
+        )
+        try:
+            for resp in call(frames(), timeout=timeout):
+                seq = _tx.parse_ack(resp.status)
+                if seq is not None:
+                    req = pending.pop(seq, None)
+                    if req is not None:
+                        neg.sender.sent_ok(req)
+                    if m is not None and seq in send_ts:
+                        # hop latency under the streamed schedule:
+                        # submit -> first-stage accept
+                        dt = time.perf_counter() - send_ts[seq]
+                        m.observe(labeled("comm.hop_ack_seconds",
+                                          target=self.address,
+                                          transport=neg.name), dt)
+                        m.observe_hist(
+                            labeled("comm.rpc_latency_seconds",
+                                    method="Relay", role="client",
+                                    transport=neg.name), dt)
+                    continue
+                seq, human = _tx.parse_result(resp.status)
+                if seq is None or seq < 0:
+                    # stream-level error status: surfaces on every
+                    # not-yet-answered item
+                    raise RuntimeError(
+                        f"relay stream error: {human or resp.status}")
+                statuses[seq] = human
+                results[seq] = (_tensor_arr(resp.result_tensor)
+                                if resp.HasField("result_tensor") else None)
+                if len(results) == len(arrs):
+                    break
+        except grpc.RpcError as e:
+            if e.code() == grpc.StatusCode.UNIMPLEMENTED:
+                # reference peer: sequential unary fallback (idempotent
+                # per item, so the ordinary retry machinery applies)
+                sp.end(fallback="unary")
+                return [self.send_tensor(a, request_id=request_id,
+                                         timeout=timeout) for a in arrs]
+            sp.set(error=str(e.code()))
+            raise
+        finally:
+            for req in pending.values():
+                neg.sender.cleanup(req)
+            pending.clear()
+            sp.end()
+        missing = [i for i in range(len(arrs)) if i not in statuses]
+        if missing:
+            raise RuntimeError(
+                f"relay stream ended without results for items {missing}")
+        return [(statuses[i], results[i]) for i in range(len(arrs))]
 
     def generate(
         self,
@@ -298,14 +497,14 @@ class NodeClient:
                        adapter, min_p, repetition_penalty, logit_bias)
         call = self._channel.unary_stream(
             f"/{SERVICE_NAME}/GenerateStream",
-            request_serializer=pb.TensorRequest.SerializeToString,
-            response_deserializer=pb.TensorResponse.FromString,
+            request_serializer=wc.serialize_request,
+            response_deserializer=wc.parse_response,
         )
         sp = obs.start_span("rpc.GenerateStream",
                             parent=obs.current_span(),
                             target=self.address)
         stream = call(
-            pb.TensorRequest(
+            wc.TensorRequest(
                 request_id=obs.tag_request_id(rid, sp),
                 tensor=_tensor_msg(
                     np.asarray(prompt_ids, np.int32).reshape(-1))),
@@ -389,4 +588,7 @@ class NodeClient:
             yield tail
 
     def close(self):
+        neg, self._negotiated = self._negotiated, None
+        if neg is not None:
+            neg.sender.close()
         self._channel.close()

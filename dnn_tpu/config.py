@@ -84,6 +84,11 @@ class TopologyConfig:
     dtype: str = "float32"
     mesh: Dict[str, int] = dataclasses.field(default_factory=dict)
     distributed: Optional["DistributedConfig"] = None  # multihost job spec
+    # inter-stage hop transport for the gRPC edge deployment (--serve):
+    # "auto" negotiates device -> shm -> grpc per hop at handshake
+    # (comm/transport.py); "grpc" pins the reference wire path; explicit
+    # "device"/"shm" fail loud when the hop cannot satisfy them
+    transport: str = "auto"
 
     # ---- construction ----------------------------------------------------
 
@@ -110,6 +115,7 @@ class TopologyConfig:
             dtype=d.get("dtype", "float32"),
             mesh=dict(d.get("mesh", {})),
             distributed=_parse_distributed(d.get("distributed")),
+            transport=d.get("transport", "auto"),
         )
         cfg.validate()
         return cfg
@@ -145,6 +151,11 @@ class TopologyConfig:
             raise ValueError(
                 "param_placement must be auto|stage|replicated, got "
                 f"'{self.param_placement}'"
+            )
+        if self.transport not in ("auto", "grpc", "shm", "device"):
+            raise ValueError(
+                "transport must be auto|grpc|shm|device, got "
+                f"'{self.transport}'"
             )
 
     # ---- lookups (reference: node.py:234-277) ----------------------------

@@ -158,12 +158,21 @@ def _py_table():
     return _PY_TABLE
 
 
-def _as_buffer(data) -> bytes:
+def _as_buffer(data) -> memoryview:
+    """-> a C-contiguous uint8 memoryview over `data` WITHOUT copying
+    when the input is already contiguous (the comm hot path checksums
+    MB-scale activation views — a bytes() materialization here would be
+    a hidden full payload copy per direction, defeating the zero-copy
+    wire codec). Only non-contiguous inputs materialize."""
     if isinstance(data, np.ndarray):
-        return np.ascontiguousarray(data).tobytes()
-    if isinstance(data, (bytes, bytearray)):
-        return bytes(data)
-    return bytes(memoryview(data))
+        a = data if data.flags.c_contiguous else np.ascontiguousarray(data)
+        # uint8 reinterpret-view: also covers dtypes the buffer
+        # protocol rejects (ml_dtypes bfloat16)
+        return memoryview(a.reshape(-1).view(np.uint8))
+    view = memoryview(data)
+    if not view.c_contiguous:
+        view = memoryview(bytes(view))
+    return view.cast("B") if view.ndim else view.cast("B", (1,))
 
 
 def crc32c(data, seed: int = 0) -> int:
@@ -172,7 +181,10 @@ def crc32c(data, seed: int = 0) -> int:
     buf = _as_buffer(data)
     lib = _lib()
     if lib is not None:
-        return int(lib.dnn_crc32c(buf, len(buf), ctypes.c_uint32(seed)))
+        # pointer pass-through (ctypes won't convert a memoryview to
+        # c_void_p itself; frombuffer is a zero-copy view)
+        ptr = np.frombuffer(buf, np.uint8).ctypes.data if len(buf) else 0
+        return int(lib.dnn_crc32c(ptr, len(buf), ctypes.c_uint32(seed)))
     table = _py_table()
     crc = (~seed) & 0xFFFFFFFF
     for b in buf:

@@ -97,6 +97,17 @@ def build_parser() -> argparse.ArgumentParser:
                         "adapter mix")
     p.add_argument("--serve", action="store_true",
                    help="Host this node's stage behind gRPC (reference-interop mode)")
+    p.add_argument("--transport", choices=["auto", "grpc", "shm", "device"],
+                   default=None,
+                   help="--serve: inter-stage hop transport "
+                        "(comm/transport.py). 'auto' (default, or the "
+                        "config's `transport` key) negotiates "
+                        "device -> shm -> grpc per hop at a "
+                        "wire-compatible handshake — reference peers "
+                        "land on grpc; 'grpc' pins the reference wire "
+                        "path; explicit 'device'/'shm' FAIL LOUD when "
+                        "the hop cannot prove them (same process / "
+                        "same host)")
     p.add_argument("--serve_lm", action="store_true",
                    help="GPT families: run the continuous-batching LM daemon "
                         "on this node's port — SendTensor(prompt ids) answers "
@@ -423,6 +434,15 @@ def main(argv=None) -> int:
         log.error("--min_p/--repetition_penalty apply to --serve_lm only")
         return 1
 
+    if args.transport is not None and not args.serve:
+        # BEFORE the serve_lm dispatch: `--serve_lm --transport shm`
+        # must fail loud here, not silently serve grpc (the LM daemon
+        # declines negotiation — prompt payloads are bytes-tiny)
+        log.error("--transport applies to --serve (the gRPC edge "
+                  "deployment's inter-stage hops); the LM daemon and "
+                  "single-controller runs do not negotiate hops")
+        return 1
+
     if args.serve or args.serve_lm:
         # black box for the long-lived serving modes: an unhandled crash
         # dumps the flight-recorder ring to $DNN_TPU_OBS_DIR before the
@@ -441,7 +461,8 @@ def main(argv=None) -> int:
 
         async def _run():
             tasks = [asyncio.create_task(serve_stage(
-                engine, args.node_id, metrics_port=args.metrics_port))]
+                engine, args.node_id, metrics_port=args.metrics_port,
+                transport=args.transport))]
             if me.part_index == 0 and args.input_image:
                 tasks.append(asyncio.create_task(
                     _initiate_edge(engine, args.node_id, args.input_image)

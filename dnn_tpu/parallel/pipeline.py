@@ -79,7 +79,18 @@ class RelayExecutor:
     on device i, the output is handed to device i+1 (XLA device-to-device
     copy — the rebuilt SendTensor hop), and the final output returns to the
     host (the rebuilt result_tensor response chain, node.py:88-105).
+
+    This IS the `device` rung of the pluggable transport ladder
+    (comm/transport.py), in its same-process form: the hop is a direct
+    `jax.device_put` of the jit output with zero host serialization —
+    what the gRPC edge negotiates per hop when both stages share a
+    process (the mailbox ticket path), this executor does inline. Hop
+    metrics/spans carry the `transport="device"` label so the fleet
+    view compares rungs directly.
     """
+
+    #: negotiated-transport label for this executor's hops
+    transport = "device"
 
     def __init__(self, stage_fns: Sequence[Callable], stage_params: Sequence[Any], devices=None):
         if len(stage_fns) != len(stage_params):
@@ -130,7 +141,8 @@ class RelayExecutor:
             if m is not None:
                 # per-stage compute in the shared registry — the relay
                 # runtime's contribution to the /metrics breakdown
-                m.observe(labeled("relay.stage_compute_seconds", stage=i),
+                m.observe(labeled("relay.stage_compute_seconds", stage=i,
+                                  transport=self.transport),
                           dt)
         self.last_stage_times = stages
         return x
@@ -179,7 +191,8 @@ class RelayExecutor:
         m = obs.metrics()
         if m is not None:
             for i, h in enumerate(hops, start=1):
-                m.observe(labeled("relay.hop_seconds", hop=i), h)
+                m.observe(labeled("relay.hop_seconds", hop=i,
+                                  transport=self.transport), h)
         return hops
 
 

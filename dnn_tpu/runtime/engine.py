@@ -95,6 +95,11 @@ class PipelineEngine:
         obs.install_compile_telemetry()
         self.config = config
         self.role = role
+        # downstream hop preference for the gRPC edge deployment
+        # (role="stage" / --serve): the stage server negotiates
+        # device | shm | grpc per hop at handshake (comm/transport.py);
+        # serve_stage defaults to this resolved value
+        self.transport = config.transport
         self.spec = get_model(config.model)
         if config.num_parts not in self.spec.supported_parts:
             raise ValueError(

@@ -40,7 +40,9 @@ import grpc
 import numpy as np
 
 from dnn_tpu import obs
+from dnn_tpu.comm import transport as _tx
 from dnn_tpu.comm import wire_pb2 as pb
+from dnn_tpu.comm import wirecodec as wc
 from dnn_tpu.comm.service import (
     PayloadCorruptError,
     _handlers,
@@ -983,12 +985,12 @@ class LMServer:
                                     str(e))
             finally:
                 root.end()
-            return pb.TensorResponse(
+            return wc.TensorResponse(
                 status=f"[lm] ok: embedding dim {vec.shape[-1]}",
                 result_tensor=_tensor_msg(vec),
             )
         tokens = await self._submit_and_await(prompt, rid, context)
-        return pb.TensorResponse(
+        return wc.TensorResponse(
             status=f"[lm] ok: {len(tokens)} tokens",
             result_tensor=_tensor_msg(np.asarray(tokens, np.int32)),
         )
@@ -1051,7 +1053,7 @@ class LMServer:
                     continue  # loop re-checks the deadline and aborts
                 if kind == "tok":
                     n += 1
-                    yield pb.TensorResponse(
+                    yield wc.TensorResponse(
                         status=f"[lm] token {n}",
                         result_tensor=_tensor_msg(
                             np.asarray([val], np.int32)),
@@ -1076,7 +1078,15 @@ class LMServer:
         answers with pool stats; with a tokenizer, the message text is a
         PROMPT and the reply is the generated continuation — the job the
         reference defined this RPC for but never gave it (node.py:111-113,
-        no caller). Options ride the sender_id as "gen[:max_new[:seed]]"."""
+        no caller). Options ride the sender_id as "gen[:max_new[:seed]]".
+        Transport negotiation hellos (comm/transport.py) are declined
+        FIRST — prompt payloads are bytes-tiny, so the LM daemon keeps
+        the grpc rung, and a hello must never reach the tokenizer as a
+        "prompt"."""
+        if request.sender_id.startswith(_tx.HELLO_SENDER):
+            return pb.MessageReply(
+                confirmation_text=_tx.decline_hello(
+                    "LM daemon serves grpc only"))
         b = self.batcher
         text = request.message_text
         if self.tokenizer is None or text == "!stats":

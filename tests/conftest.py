@@ -51,6 +51,10 @@ _MODULE_COST_S = {
     "test_native_loader": 0.7, "test_native": 0.8, "test_hlo_audit": 3.4,
     "test_metrics": 3.7, "test_models_cifar": 4.6, "test_multihost": 4.6,
     "test_comm": 5.7, "test_models_mlp": 7.3, "test_tokenizer": 7.8,
+    "test_transport": 14.0,  # ISSUE 7 pluggable transport: wirecodec
+    # goldens vs protobuf, negotiation matrix, grpc|shm|device parity on
+    # a real 2-stage engine, streamed relay, and one real 2-process shm
+    # hop (subprocess) — cheap, certified early in the tier-1 budget
     "test_param_placement": 8.7, "test_qwen3": 9.6,
     "test_torch_export": 11.1, "test_models_gpt": 11.4,
     "test_analysis": 13.7,  # the static-analyzer gate: cheap, CPU-only,

@@ -1,0 +1,530 @@
+"""Pluggable inter-stage transport (comm/transport.py + wirecodec.py).
+
+Covers the ISSUE-7 contract:
+  * zero-copy wire codec golden round-trips against the real protobuf
+    (wire-compat is byte-level, both directions);
+  * activation parity PINNED across grpc | shm | device on the same
+    2-stage engine (same jit programs -> bitwise-identical outputs);
+  * the negotiation fallback matrix — device -> shm -> grpc — with
+    fail-loud explicit misconfig and a flight event on silent fallback;
+  * a REAL 2-process shm hop (subprocess stage server, parent client);
+  * the streamed Relay path (non-nested acks, chunked oversized
+    payloads) and the per-transport deadline budgets;
+  * the device hop program's PRG001 audit (collective-consistent
+    switch branches).
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from dnn_tpu import obs
+from dnn_tpu.comm import transport as tx
+from dnn_tpu.comm import wire_pb2 as pb
+from dnn_tpu.comm import wirecodec as wc
+from dnn_tpu.config import TopologyConfig
+
+
+# ----------------------------------------------------------------------
+# wirecodec: byte-level wire compatibility with protobuf
+# ----------------------------------------------------------------------
+
+@pytest.mark.parametrize("dtype", ["float32", "float16", "int32", "int8"])
+def test_wirecodec_request_golden_vs_protobuf(dtype):
+    arr = (np.random.default_rng(0).standard_normal((3, 5)) * 10).astype(dtype)
+    req = wc.TensorRequest(request_id="gen:32:tr=abc.def",
+                           tensor=wc.make_tensor(arr))
+    data = wc.serialize_request(req)
+    # ours -> protobuf parses identically
+    p = pb.TensorRequest.FromString(data)
+    assert p.request_id == req.request_id
+    assert list(p.tensor.shape) == list(req.tensor.shape)
+    assert p.tensor.dtype == dtype
+    assert bytes(p.tensor.tensor_data) == bytes(req.tensor.tensor_data)
+    assert req.ByteSize() == p.ByteSize()
+    # protobuf -> ours parses identically, zero-copy view out
+    back = wc.parse_request(p.SerializeToString())
+    v = wc.tensor_view(back.tensor)
+    np.testing.assert_array_equal(v, arr)
+    assert not v.flags.writeable  # a VIEW over the wire buffer, no copy
+
+
+def test_wirecodec_response_golden_vs_protobuf():
+    r = wc.TensorResponse(status="[n1] ok",
+                          result_tensor=wc.make_tensor(np.ones((2, 2))))
+    p = pb.TensorResponse.FromString(wc.serialize_response(r))
+    assert p.status == r.status and p.HasField("result_tensor")
+    assert p.ByteSize() == r.ByteSize()
+    # absent optional field round-trips as absent
+    r2 = wc.parse_response(pb.TensorResponse(status="err").SerializeToString())
+    assert not r2.HasField("result_tensor") and r2.status == "err"
+    # pb messages pass through our serializer unchanged
+    assert wc.serialize_response(pb.TensorResponse(status="x")) == \
+        pb.TensorResponse(status="x").SerializeToString()
+
+
+def test_wirecodec_bfloat16_and_scalar():
+    import ml_dtypes
+
+    arr = np.arange(6, dtype=np.float32).astype(ml_dtypes.bfloat16).reshape(2, 3)
+    t = wc.make_tensor(arr)
+    back = wc.parse_request(wc.serialize_request(
+        wc.TensorRequest(request_id="r", tensor=t)))
+    np.testing.assert_array_equal(wc.tensor_view(back.tensor), arr)
+    s = wc.make_tensor(np.float32(3.5))
+    out = wc.tensor_view(wc.parse_request(wc.serialize_request(
+        wc.TensorRequest(tensor=s))).tensor)
+    assert out.shape == () and float(out) == 3.5
+
+
+def test_wirecodec_copied_counter_counts_only_forced_copies():
+    m = obs.metrics()
+    assert m is not None
+    m.clear()
+    # contiguous hot path: zero copied bytes
+    wc.make_tensor(np.arange(1024, dtype=np.float32))
+    snap = m.snapshot()["counters"]
+    assert not any("payload_bytes_copied" in k for k in snap)
+    # non-contiguous input forces a materialization — counted
+    wc.make_tensor(np.arange(64, dtype=np.float32)[::2])
+    snap = m.snapshot()["counters"]
+    copied = [v for k, v in snap.items() if "payload_bytes_copied" in k]
+    assert copied and copied[0] == 32 * 4
+
+
+def test_wirecodec_crc_mismatch_raises():
+    from dnn_tpu.io.serialization import PayloadCorruptError
+    from dnn_tpu.native import native_available
+
+    if not native_available():
+        pytest.skip("crc verification requires the native codec")
+    t = wc.make_tensor(np.arange(8, dtype=np.float32))
+    bad = wc.Tensor(bytes(t.tensor_data), t.shape, t.dtype, t.crc32c ^ 1)
+    with pytest.raises(PayloadCorruptError):
+        wc.tensor_view(bad)
+
+
+# ----------------------------------------------------------------------
+# negotiation matrix (unit level: ladder, proofs, fail-loud, flight)
+# ----------------------------------------------------------------------
+
+def test_negotiate_same_process_picks_device():
+    neg = tx.negotiate_over(lambda sid, txt: tx.answer_hello(txt),
+                            transport="auto", target="t")
+    assert neg.name == "device" and neg.relay_ok
+    neg.sender.close()
+
+
+def _cross_process_answer(sid, txt):
+    """Simulate a same-host peer in ANOTHER process: the proc token
+    differs, so the device rung fails and the shm probe decides."""
+    offer = json.loads(txt)
+    offer["proc"] = "not-this-process"
+    return tx.answer_hello(json.dumps(offer))
+
+
+def test_negotiate_cross_process_same_host_picks_shm():
+    neg = tx.negotiate_over(_cross_process_answer, transport="auto",
+                            target="t")
+    assert neg.name == "shm"
+    neg.sender.close()
+
+
+def test_negotiate_reference_peer_falls_back_to_grpc_with_flight_event():
+    obs.flight.recorder().clear()
+    neg = tx.negotiate_over(lambda sid, txt: "[node2] got msg 'x'",
+                            transport="auto", target="ref:1")
+    assert neg.name == "grpc"
+    assert not neg.relay_ok  # reference peers have no Relay RPC
+    evs = [e for e in obs.flight.recorder().events()
+           if e["kind"] == "transport_fallback"]
+    assert evs and evs[-1]["target"] == "ref:1"
+
+
+def test_negotiate_dnn_decline_keeps_relay_capability():
+    """A dnn_tpu peer on another HOST declines device/shm but still
+    advertises the streamed Relay RPC — the non-nested schedule
+    survives on the grpc rung."""
+    def cross_host(sid, txt):
+        offer = json.loads(txt)
+        offer["proc"] = "other"
+        offer.pop("shm_probe", None)  # unreachable segment = other host
+        return tx.answer_hello(json.dumps(offer))
+
+    neg = tx.negotiate_over(cross_host, transport="auto", target="t")
+    assert neg.name == "grpc" and neg.relay_ok
+
+
+def test_explicit_misconfig_fails_loud():
+    with pytest.raises(tx.TransportMisconfigError):
+        tx.negotiate_over(lambda sid, txt: "[ref] got msg", transport="device")
+    with pytest.raises(tx.TransportMisconfigError):
+        tx.negotiate_over(lambda sid, txt: tx.decline_hello("nope"),
+                          transport="shm")
+
+
+def test_shm_probe_nonce_is_verified():
+    """The shm rung must be PROVEN by the attach+nonce echo, not
+    assumed: a peer that cannot read the probe segment's nonce is
+    refused the rung."""
+    def wrong_nonce(sid, txt):
+        offer = json.loads(txt)
+        offer["proc"] = "other"
+        offer["shm_probe"] = "dnn_tpu_probe_nonexistent"
+        return tx.answer_hello(json.dumps(offer))
+
+    neg = tx.negotiate_over(wrong_nonce, transport="auto")
+    assert neg.name == "grpc"
+
+
+def test_hello_is_wire_compatible_json_over_sendmessage():
+    """The handshake rides the reference's own SendMessage: the offer
+    must be plain JSON text a reference server would log-and-echo
+    without effect."""
+    offer, probe = tx.build_offer("auto")
+    try:
+        parsed = json.loads(json.dumps(offer))
+        assert parsed["v"] == 1 and "want" in parsed
+    finally:
+        tx.close_probe(probe)
+
+
+# ----------------------------------------------------------------------
+# deadline budgets follow the transport
+# ----------------------------------------------------------------------
+
+def test_hop_budget_grpc_matches_reference_arithmetic():
+    from dnn_tpu.comm.client import pipeline_budget
+    from dnn_tpu.comm.service import PER_STAGE_BUDGET_S
+
+    assert tx.hop_budget_s("grpc", 1) == PER_STAGE_BUDGET_S == 30.0
+    assert tx.hop_budget_s("grpc", 3) == 3 * PER_STAGE_BUDGET_S
+    # grpc never shrinks warm: budget arithmetic is part of the
+    # reference-compatible contract
+    assert tx.hop_budget_s("grpc", 2, warm=True) == \
+        tx.hop_budget_s("grpc", 2)
+    assert pipeline_budget(2) == PER_STAGE_BUDGET_S * 2 + 30.0
+
+
+def test_hop_budget_device_hop_sheds_the_grpc_margin():
+    from dnn_tpu.comm.client import pipeline_budget
+
+    # a WARM device/shm hop must not inherit the 30 s gRPC slice
+    assert tx.hop_budget_s("device", 1, warm=True) < \
+        tx.hop_budget_s("grpc", 1) / 5
+    assert tx.hop_budget_s("shm", 1, warm=True) < \
+        tx.hop_budget_s("grpc", 1) / 2
+    # cold hops keep the compile-inclusive compute slice
+    assert tx.hop_budget_s("device", 1) > 20.0
+    assert pipeline_budget(4, transport="device") < pipeline_budget(4)
+
+
+# ----------------------------------------------------------------------
+# chunked relay framing
+# ----------------------------------------------------------------------
+
+def test_split_and_reassemble_chunks_roundtrip():
+    big = np.random.default_rng(1).standard_normal(
+        (600, 1024)).astype(np.float32)  # ~2.4 MB > CHUNK_BYTES
+    req = wc.TensorRequest(request_id="r7", tensor=wc.make_tensor(big))
+    frames = tx.split_requests(req, seq=3)
+    assert len(frames) == -(-big.nbytes // tx.CHUNK_BYTES)
+    asm = tx.ChunkAssembler()
+    done = None
+    for f in frames:
+        # full wire round-trip per frame
+        done = asm.add(wc.parse_request(wc.serialize_request(f)))
+    assert done is not None
+    base, seq, tensor = done
+    assert base == "r7" and seq == 3
+    np.testing.assert_array_equal(wc.tensor_view(tensor), big)
+
+
+def test_small_payload_rides_one_frame_with_seq_tag():
+    req = wc.TensorRequest(request_id="r1",
+                           tensor=wc.make_tensor(np.zeros(4, np.float32)))
+    frames = tx.split_requests(req, seq=5)
+    assert len(frames) == 1
+    base, seq, chunk = tx.parse_seq(frames[0].request_id)
+    assert base == "r1" and seq == 5 and chunk is None
+
+
+def test_out_of_order_chunk_fails_loud():
+    big = np.zeros(900 * 1024, np.float32)  # 3.6 MB -> >= 3 chunks
+    frames = tx.split_requests(
+        wc.TensorRequest(request_id="r", tensor=wc.make_tensor(big)), 0)
+    assert len(frames) >= 3
+    asm = tx.ChunkAssembler()
+    asm.add(frames[0])
+    with pytest.raises(tx.TransportError):
+        asm.add(frames[2])  # skipped frame 1
+
+
+def test_ack_result_status_roundtrip():
+    assert tx.parse_ack(tx.ack_status(7)) == 7
+    assert tx.parse_ack("[n1] ok") is None
+    seq, human = tx.parse_result(tx.result_status(3, "[n2] ok: 1"))
+    assert seq == 3 and human == "[n2] ok: 1"
+    seq, human = tx.parse_result("[n1] plain")
+    assert seq is None and human == "[n1] plain"
+
+
+# ----------------------------------------------------------------------
+# the device hop program: PRG001-consistent compiled send/recv
+# ----------------------------------------------------------------------
+
+def test_hop_program_moves_rows_stage_to_stage():
+    import jax
+    from jax.sharding import Mesh
+
+    from dnn_tpu.parallel.mesh import STAGE_AXIS
+
+    devs = jax.devices()[:4]
+    mesh = Mesh(np.array(devs), (STAGE_AXIS,))
+    hop = tx.make_hop_program(mesh, STAGE_AXIS)
+    buf = np.stack([np.full((2, 3), i, np.float32) for i in range(4)])
+    out = np.asarray(hop(np.int32(0), buf))
+    # hop 0: row 0 lands on stage 1; non-participating ranks read zeros
+    np.testing.assert_array_equal(out[1], buf[0])
+    assert (out[0] == 0).all() and (out[2] == 0).all()
+    out2 = np.asarray(hop(np.int32(2), buf))
+    np.testing.assert_array_equal(out2[3], buf[2])
+
+
+def test_transport_program_audit_is_clean():
+    from dnn_tpu.analysis.program import audit_transport_programs
+
+    report = audit_transport_programs()
+    assert report.get("findings") == []
+    # one ppermute per hop branch, every branch identical (PRG001)
+    assert set(report["collective_signature"]) == {"ppermute"}
+    assert len(report["collective_signature"]) == report["stages"] - 1
+
+
+# ----------------------------------------------------------------------
+# parity across transports on a real 2-stage engine (in-process servers)
+# ----------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def two_stage():
+    from dnn_tpu.comm.service import start_stage_server_in_background
+    from dnn_tpu.runtime.engine import PipelineEngine
+
+    cfg = TopologyConfig.from_dict({
+        "nodes": [
+            {"id": "node1", "address": "127.0.0.1:59451", "part_index": 0},
+            {"id": "node2", "address": "127.0.0.1:59452", "part_index": 1},
+        ],
+        "num_parts": 2, "model": "cifar_cnn", "runtime": "relay",
+        "device_type": "cpu",
+    })
+    engine = PipelineEngine(cfg)
+    t1, stop1 = start_stage_server_in_background(engine, "node1")
+    t2, stop2 = start_stage_server_in_background(engine, "node2")
+    yield cfg, engine
+    stop1()
+    stop2()
+
+
+def _client(cfg, transport):
+    from dnn_tpu.comm.client import NodeClient
+
+    return NodeClient(cfg.node_by_id("node1").address, transport=transport)
+
+
+def test_parity_pinned_across_grpc_shm_device(two_stage):
+    """The SAME activation through the same 2-stage engine over all
+    three transports: outputs must be BITWISE identical (same jit
+    programs, same devices — the transport moves bytes, it must not
+    touch them)."""
+    cfg, engine = two_stage
+    x = np.asarray(engine.spec.example_input(batch_size=1))
+    expect = np.asarray(engine.run(x))
+    outs = {}
+    for name in ("grpc", "shm", "device"):
+        c = _client(cfg, "auto" if name == "device" else name)
+        try:
+            status, result = c.send_tensor(x, request_id=f"parity_{name}")
+            assert c._negotiated.name == name
+            assert result is not None
+            outs[name] = np.asarray(result)
+        finally:
+            c.close()
+    np.testing.assert_allclose(outs["grpc"], expect, atol=1e-5, rtol=1e-5)
+    np.testing.assert_array_equal(outs["grpc"], outs["shm"])
+    np.testing.assert_array_equal(outs["grpc"], outs["device"])
+
+
+def test_relay_stream_parity_and_acks(two_stage):
+    """The streamed (non-nested) path returns the same results as the
+    unary chain, for every microbatch, in order."""
+    cfg, engine = two_stage
+    x = np.asarray(engine.spec.example_input(batch_size=1))
+    expect = np.asarray(engine.run(x))
+    c = _client(cfg, "auto")
+    try:
+        outs = c.send_tensors([x] * 5, request_id="relay_parity")
+        assert len(outs) == 5
+        for status, result in outs:
+            assert "Prediction" in status
+            np.testing.assert_allclose(result, expect, atol=1e-5, rtol=1e-5)
+    finally:
+        c.close()
+
+
+def test_relay_stream_chunked_big_batch(two_stage):
+    """An oversized microbatch (> CHUNK_BYTES) rides the stream in
+    chunks and reassembles exactly — the unary path's 4 MB gRPC ceiling
+    does not apply."""
+    cfg, engine = two_stage
+    x = np.asarray(engine.spec.example_input(batch_size=128))  # ~1.5 MB
+    assert x.nbytes > tx.CHUNK_BYTES
+    expect = np.asarray(engine.run(x))
+    c = _client(cfg, "grpc")  # force inline payloads so chunking engages
+    try:
+        outs = c.send_tensors([x], request_id="relay_chunked")
+        np.testing.assert_allclose(outs[0][1], expect, atol=1e-4, rtol=1e-4)
+    finally:
+        c.close()
+
+
+def test_transport_labels_on_obs_series(two_stage):
+    """Every hop's histogram/series carries the transport label (the
+    fleet collector reads the PR's effect off these)."""
+    cfg, engine = two_stage
+    m = obs.metrics()
+    assert m is not None
+    x = np.asarray(engine.spec.example_input(batch_size=1))
+    for name in ("grpc", "auto"):
+        c = _client(cfg, name)
+        try:
+            c.send_tensor(x, request_id=f"lbl_{name}")
+        finally:
+            c.close()
+    snap = m.snapshot()
+    hists = snap.get("histogram", {})
+    assert any("comm.rpc_latency_seconds" in k and 'transport="grpc"' in k
+               for k in hists)
+    assert any("comm.rpc_latency_seconds" in k and 'transport="device"' in k
+               for k in hists)
+    lats = snap.get("latency", {})
+    assert any(k.startswith("comm.hop_seconds") and 'transport="device"' in k
+               for k in lats)
+
+
+def test_explicit_device_client_fails_loud_against_other_process():
+    """--transport device against a peer that cannot prove same-process
+    must ERROR, not silently degrade (negotiation runs against a fake
+    cross-process answer)."""
+    with pytest.raises(tx.TransportMisconfigError):
+        tx.negotiate_over(_cross_process_answer, transport="device")
+
+
+# ----------------------------------------------------------------------
+# REAL 2-process shm hop: subprocess stage server, parent client
+# ----------------------------------------------------------------------
+
+_CHILD_SRC = """
+import asyncio, sys
+from dnn_tpu.config import TopologyConfig
+from dnn_tpu.runtime.engine import PipelineEngine
+from dnn_tpu.comm.service import serve_stage
+
+cfg = TopologyConfig.from_dict({
+    "nodes": [{"id": "n1", "address": "127.0.0.1:%d", "part_index": 0}],
+    "num_parts": 1, "model": "cifar_cnn", "runtime": "relay",
+    "device_type": "cpu",
+})
+engine = PipelineEngine(cfg)
+asyncio.run(serve_stage(engine, "n1"))
+"""
+
+
+@pytest.mark.timeout(180)
+def test_real_two_process_shm_hop(tmp_path):
+    """The shm rung end-to-end across REAL process boundaries: a
+    subprocess hosts the stage, the parent negotiates auto and must
+    land on shm (device refused: different process; shm proven: same
+    host), and the result matches the parent's local compute (same
+    seed => same random init)."""
+    from dnn_tpu.comm.client import NodeClient
+    from dnn_tpu.runtime.engine import PipelineEngine
+
+    port = 59471
+    script = tmp_path / "shm_stage_child.py"
+    script.write_text(_CHILD_SRC % port)
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH=repo + os.pathsep + os.environ.get("PYTHONPATH",
+                                                             ""))
+    env.pop("XLA_FLAGS", None)  # the child needs no virtual mesh
+    child = subprocess.Popen([sys.executable, str(script)], env=env,
+                             stdout=subprocess.PIPE,
+                             stderr=subprocess.STDOUT)
+
+    def _up(deadline: float) -> bool:
+        # poll with a FRESH channel per attempt: a sync gRPC channel
+        # whose first connects fail while the child is still importing
+        # can wedge in backoff inside this (jax-initialized, many-
+        # threaded) pytest process and never notice the late bind —
+        # observed on this host; a fresh channel sees the server
+        # immediately. The production late-start path is covered by
+        # test_comm's retry test (sub-second delay, same channel).
+        t_end = time.monotonic() + deadline
+        while time.monotonic() < t_end:
+            probe = NodeClient(f"127.0.0.1:{port}")
+            try:
+                if probe.health_check(timeout=2.0):
+                    return True
+            finally:
+                probe.close()
+            time.sleep(1.0)
+        return False
+
+    c = None
+    try:
+        if not _up(120.0):
+            child.terminate()
+            out, _ = child.communicate(timeout=10)
+            pytest.fail("child server never came up; child output:\n"
+                        + out.decode(errors="replace")[-2000:])
+        c = NodeClient(f"127.0.0.1:{port}")
+        cfg = TopologyConfig.from_dict({
+            "nodes": [{"id": "n1", "address": f"127.0.0.1:{port}",
+                       "part_index": 0}],
+            "num_parts": 1, "model": "cifar_cnn", "runtime": "relay",
+            "device_type": "cpu",
+        })
+        local = PipelineEngine(cfg)
+        x = np.asarray(local.spec.example_input(batch_size=1))
+        status, result = c.send_tensor(x, request_id="shm_2proc")
+        assert c._negotiated.name == "shm", (
+            f"expected the shm rung across processes, got "
+            f"{c._negotiated.name} ({c._negotiated.reason})")
+        np.testing.assert_allclose(result, np.asarray(local.run(x)),
+                                   atol=1e-5, rtol=1e-5)
+        # second send reuses the ring slot (release-on-response)
+        status2, result2 = c.send_tensor(x, request_id="shm_2proc_b")
+        np.testing.assert_array_equal(result2, result)
+        # streamed relay longer than the shm ring (4 slots): the
+        # sender's writer thread must block on the ring and resume as
+        # the peer's acks release slots — the backpressure cycle that
+        # deadlocked when ring waits ran on an event loop (see
+        # StageServer._forward_one)
+        outs = c.send_tensors([x] * 6, request_id="shm_2proc_stream")
+        assert len(outs) == 6
+        for _st, r_i in outs:
+            np.testing.assert_array_equal(r_i, result)
+    finally:
+        if c is not None:
+            c.close()
+        child.terminate()
+        try:
+            child.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            child.kill()
