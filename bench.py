@@ -106,7 +106,7 @@ def _backend_alive(deadlines_s=(90.0, 180.0, 300.0),
 
     n = len(deadlines_s)
     for i, deadline in enumerate(deadlines_s):
-        ok, detail, _timed_out = subprocess_device_probe(deadline)
+        ok, detail, timed_out = subprocess_device_probe(deadline)
         if ok:
             if i:  # recovered after failures: record the flap too
                 obs.flight.record("probe_recovered", attempt=i + 1)
@@ -115,9 +115,31 @@ def _backend_alive(deadlines_s=(90.0, 180.0, 300.0),
         if m is not None:
             m.inc("bench.probe_failures_total")
         obs.flight.record("probe_fail", attempt=i + 1, attempts=n,
-                          deadline_s=deadline, detail=detail)
+                          deadline_s=deadline, detail=detail,
+                          timed_out=timed_out)
         print(f"[bench] backend probe attempt {i + 1}/{n} failed "
               f"({detail})", file=sys.stderr)
+        if timed_out and i + 1 < n:
+            # WEDGED (hung probe), not merely unhealthy: invoke the
+            # supervisor's device-restart path — a fresh subprocess
+            # re-initializing the plugin from nothing with the longest
+            # healthy-cold-init deadline — and count its success as the
+            # round's recovery instead of burning the remaining ladder
+            # (the failure shape that cost BENCH_r03–r05 their on-chip
+            # rows). recover_backend records supervisor_device_restart
+            # flight events either way.
+            from dnn_tpu.chaos.supervisor import recover_backend
+
+            r_ok, r_detail = recover_backend(
+                deadline_s=max(deadlines_s))
+            if r_ok:
+                obs.flight.record("probe_recovered", attempt=i + 1,
+                                  via="supervisor_device_restart")
+                print("[bench] backend recovered via supervisor "
+                      "restart path", file=sys.stderr)
+                return True
+            print(f"[bench] supervisor restart path failed "
+                  f"({r_detail})", file=sys.stderr)
         if i + 1 < n:
             time.sleep(backoff_s * (i + 1))
     obs.flight.record("probe_exhausted", attempts=n)
@@ -323,6 +345,15 @@ def main():
     if m is not None:
         row["mfu"] = round(m, 4)
     row["platform"] = jax.default_backend()
+    # provenance (ISSUE 8): round_substrate is the contract-named alias
+    # of platform (the substrate the round ACTUALLY ran on), plus
+    # whether the chip recovered via the supervisor restart path — a
+    # recovered chip yields an on-chip row, never a silent CPU row
+    row["round_substrate"] = row["platform"]
+    from dnn_tpu import obs as _obs_prov
+
+    if _obs_prov.flight.recorder().events(kind="probe_recovered"):
+        row["probe_recovered"] = True
     if fell_back:
         row["note"] = "default backend unresponsive; CPU fallback"
     # live decode goodput (ISSUE 6): every round's row carries the

@@ -1,0 +1,428 @@
+"""chaos_probe: availability + p99 TTFT under injected stage failures.
+
+ISSUE 8's regression contract — resilience as an asserted number, the
+way decode_mbu asserts MBU and relay_transport asserts the bubble drop.
+Open-loop load runs against a REAL 2-stage pipeline (two stage-server
+subprocesses, the STUDIES §10 deployment) while the STANDARD FaultPlan
+(chaos.plan.standard_plan: one stage KILL + one injected WEDGE) fires,
+and each stage runs under a `chaos.supervisor.Supervisor` — the thing
+being measured is the recovery machinery, end to end:
+
+  * the kill (SIGKILL on node2) exercises exit-detection + backoff
+    restart + re-warm;
+  * the wedge (SIGSTOP on node1 — alive but unresponsive, the hung-
+    driver shape) exercises the fresh-connection health poll, the
+    wedged declaration, and the on_wedged=restart policy;
+  * the probe's client runs the ISSUE-8 edge stack: circuit breaker
+    (fast explicit shedding during the outage), fresh-channel rebuild,
+    propagated deadlines.
+
+Asserted floors (--assert exits nonzero when any fails):
+
+  * availability: >= AVAILABILITY_FLOOR (99%) of submitted requests
+    COMPLETED-OR-EXPLICITLY-REJECTED, and ZERO silently lost — every
+    request's outcome is accounted for;
+  * p99 TTFT during recovery (completed requests in the
+    POST_RECOVERY_WINDOW_S after each supervisor_restart event — the
+    "is it really back, warm, at quiet latency?" check) <=
+    TTFT_RATIO_CEIL (10x) the quiet-window p99. The pipeline is unary,
+    so request latency IS TTFT;
+  * event pairing: every injected fault (chaos_inject kill_stage /
+    hang_stage) pairs with its recovery (supervisor_restart for the
+    same stage, later ts) IN THE DUMPED RING — the incident must be
+    reconstructable from the flight recorder alone, so the assertion
+    reads the dump file back, not in-process state.
+
+`python -m benchmarks.chaos_probe [--assert] [--light]` prints one
+JSON row; the full (default) run sustains >= 60 s of open-loop load —
+the acceptance configuration. --light shrinks the timeline for smoke
+use. The run_all `chaos_resilience` row rides `measure()`.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+AVAILABILITY_FLOOR = 0.99   # handled (ok or explicit) / submitted
+TTFT_RATIO_CEIL = 10.0      # recovery-window p99 vs quiet p99
+POST_RECOVERY_WINDOW_S = 10.0
+RECOVERY_DEADLINE_S = 150.0  # per fault: child restart incl. jax import
+
+# (grpc1, grpc2, metrics1, metrics2) — distinct from the relay probe's
+_PORTS = (59495, 59496, 59595, 59596)
+
+_CHILD_SRC = """
+import asyncio, sys
+sys.path.insert(0, {repo!r})
+from dnn_tpu.config import TopologyConfig
+from dnn_tpu.runtime.engine import PipelineEngine
+from dnn_tpu.comm.service import serve_stage
+
+cfg = TopologyConfig.from_dict({cfg!r})
+engine = PipelineEngine(cfg)
+asyncio.run(serve_stage(engine, {node_id!r}, metrics_port={mport},
+                        transport="grpc"))
+"""
+
+
+def _pipeline_config(p1: int, p2: int) -> dict:
+    return {
+        "nodes": [
+            {"id": "node1", "address": f"127.0.0.1:{p1}", "part_index": 0},
+            {"id": "node2", "address": f"127.0.0.1:{p2}", "part_index": 1},
+        ],
+        "num_parts": 2, "model": "cifar_cnn", "runtime": "relay",
+        "device_type": "cpu",
+    }
+
+
+def _spawner(tmpdir: str, cfg: dict, node_id: str, mport: int):
+    script = os.path.join(tmpdir, f"chaos_stage_{node_id}.py")
+    with open(script, "w") as f:
+        f.write(_CHILD_SRC.format(repo=REPO, cfg=cfg, node_id=node_id,
+                                  mport=mport))
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH=REPO + os.pathsep + os.environ.get(
+                   "PYTHONPATH", ""))
+    env.pop("XLA_FLAGS", None)
+
+    def spawn():
+        return subprocess.Popen([sys.executable, script], env=env,
+                                stdout=subprocess.DEVNULL,
+                                stderr=subprocess.DEVNULL)
+
+    return spawn
+
+
+def _p99(lat):
+    lat = sorted(lat)
+    return lat[int(0.99 * (len(lat) - 1))] if lat else None
+
+
+def _wait_stage_up(port: int, deadline_s: float = 150.0) -> bool:
+    from dnn_tpu.comm.client import NodeClient
+
+    t_end = time.monotonic() + deadline_s
+    while time.monotonic() < t_end:
+        probe = NodeClient(f"127.0.0.1:{port}", breaker=False,
+                           transport="grpc")
+        try:
+            if probe.health_check(timeout=2.0):
+                return True
+        finally:
+            probe.close()
+        time.sleep(0.5)
+    return False
+
+
+class _LoadGen:
+    """Open-loop load: one request every 1/rate seconds, regardless of
+    outcomes (the arrival process never waits on the system under
+    test). Every request records exactly one outcome — ok / rejected —
+    or stays None (silently lost: the thing the probe asserts cannot
+    happen)."""
+
+    def __init__(self, client, x, rate_hz: float, req_timeout_s: float,
+                 t0: float):
+        self.client = client
+        self.x = x
+        self.rate = rate_hz
+        self.req_timeout = req_timeout_s
+        self.t0 = t0
+        self.records: list = []
+        self._threads: list = []
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+
+    def start(self):
+        self._thread.start()
+        return self
+
+    def stop(self, join_timeout: float):
+        self._stop.set()
+        self._thread.join(timeout=5)
+        t_end = time.monotonic() + join_timeout
+        for t in self._threads:
+            t.join(timeout=max(t_end - time.monotonic(), 0.1))
+
+    def _one(self, rec):
+        try:
+            status, result = self.client.send_tensor(
+                self.x, request_id=f"chaos{rec['i']}",
+                timeout=self.req_timeout, retries=0)
+            rec["outcome"] = "ok" if result is not None else "rejected"
+            if result is None:
+                rec["error"] = status[:120]
+        except Exception as e:  # noqa: BLE001 — EXPLICIT rejection:
+            # breaker open, UNAVAILABLE, DEADLINE — all accounted
+            rec["outcome"] = "rejected"
+            rec["error"] = f"{type(e).__name__}: {e}"[:120]
+        finally:
+            rec["t_done"] = time.monotonic() - self.t0
+            rec["latency"] = rec["t_done"] - rec["t"]
+
+    def _run(self):
+        i = 0
+        next_at = time.monotonic()
+        while not self._stop.is_set():
+            now = time.monotonic()
+            if now < next_at:
+                time.sleep(min(next_at - now, 0.05))
+                continue
+            next_at += 1.0 / self.rate
+            rec = {"i": i, "t": now - self.t0, "outcome": None}
+            self.records.append(rec)
+            t = threading.Thread(target=self._one, args=(rec,),
+                                 daemon=True)
+            t.start()
+            self._threads.append(t)
+            i += 1
+
+
+def _await_recovery(flight_rec, stage: str, after_ts: float,
+                    deadline_s: float):
+    """Block until a supervisor_restart event for `stage` lands with
+    ts > after_ts; returns the event or None on deadline."""
+    t_end = time.monotonic() + deadline_s
+    while time.monotonic() < t_end:
+        for ev in flight_rec.events(kind="supervisor_restart"):
+            if ev.get("stage") == stage and ev["ts"] > after_ts:
+                return ev
+        time.sleep(0.5)
+    return None
+
+
+def measure(light: bool = False) -> dict:
+    from dnn_tpu import obs
+    from dnn_tpu.chaos.plan import standard_plan
+    from dnn_tpu.chaos.supervisor import Supervisor
+    from dnn_tpu.comm.client import CircuitBreaker, NodeClient
+    from dnn_tpu.config import TopologyConfig
+    from dnn_tpu.runtime.engine import PipelineEngine
+
+    p1, p2, m1, m2 = _PORTS
+    cfg = _pipeline_config(p1, p2)
+    rate_hz = 6.0 if light else 8.0
+    req_timeout = 5.0
+    kill_at = 8.0 if light else 15.0
+    hang_at = 16.0 if light else 40.0
+    wedge_gap = 4.0 if light else 6.0   # after kill-recovery
+    post_w = 6.0 if light else POST_RECOVERY_WINDOW_S
+    plan = standard_plan(kill_at_s=kill_at, hang_at_s=hang_at)
+    flight_rec = obs.flight.recorder()
+
+    def warm_fn(deadline_s: float = 45.0):
+        # recovery is declared only when a REAL request round-trips the
+        # pipeline again — polled: a relayed error STATUS (downstream
+        # gRPC socket not accepting yet — /healthz leads the data port
+        # by a beat) is "not yet", not "failed". Fresh client per
+        # attempt: no stale channel state can mask the recovery.
+        t_end = time.monotonic() + deadline_s
+        last = "no attempt"
+        while time.monotonic() < t_end:
+            wc = NodeClient(f"127.0.0.1:{p1}", breaker=False,
+                            transport="grpc")
+            try:
+                status, result = wc.send_tensor(
+                    x, request_id="warm", timeout=10.0, retries=1)
+                if result is not None:
+                    return
+                last = status
+            except Exception as e:  # noqa: BLE001 — front stage itself
+                last = f"{type(e).__name__}: {e}"  # mid-restart
+            finally:
+                wc.close()
+            time.sleep(0.5)
+        raise RuntimeError(f"warm request failed: {last[:200]}")
+
+    with tempfile.TemporaryDirectory(prefix="chaos_probe_") as tmpdir:
+        sups = {
+            "node1": Supervisor(
+                _spawner(tmpdir, cfg, "node1", m1), name="node1",
+                health_url=f"http://127.0.0.1:{m1}",
+                health_interval_s=1.0, health_timeout_s=2.0,
+                wedged_after=3, on_wedged="restart", warm=warm_fn,
+                backoff_s=0.5, ready_deadline_s=150.0),
+            "node2": Supervisor(
+                _spawner(tmpdir, cfg, "node2", m2), name="node2",
+                health_url=f"http://127.0.0.1:{m2}",
+                health_interval_s=1.0, health_timeout_s=2.0,
+                wedged_after=3, on_wedged="restart", warm=warm_fn,
+                backoff_s=0.5, ready_deadline_s=150.0),
+        }
+        client = None
+        gen = None
+        try:
+            local = PipelineEngine(TopologyConfig.from_dict(cfg))
+            import numpy as np
+
+            x = np.asarray(local.spec.example_input(batch_size=1))
+            for sup in sups.values():
+                sup.start()
+            for port in (p1, p2):
+                if not _wait_stage_up(port):
+                    raise RuntimeError(f"stage on :{port} never came up")
+            # the ISSUE-8 edge stack, tuned so recovery detection after
+            # an outage is bounded by ~2 s of breaker cooldown, not 30
+            client = NodeClient(
+                f"127.0.0.1:{p1}", transport="grpc",
+                breaker=CircuitBreaker(f"127.0.0.1:{p1}", threshold=5,
+                                       cooldown_s=0.5,
+                                       max_cooldown_s=2.0))
+            warm_fn()
+            t0 = time.monotonic()
+            gen = _LoadGen(client, x, rate_hz, req_timeout, t0).start()
+
+            faults = plan.process_faults()
+            incidents = []
+            for fault in faults:
+                # serialize: a fault never fires while the previous
+                # recovery is still in flight (the plan's timeline is a
+                # floor, not a race)
+                while time.monotonic() - t0 < fault.at_s:
+                    time.sleep(0.2)
+                if incidents:
+                    while (time.monotonic() - incidents[-1]["abs_rec"]
+                           < wedge_gap):
+                        time.sleep(0.2)
+                sup = sups[fault.target]
+                ev = obs.flight.record(
+                    "chaos_inject", fault=fault.kind,
+                    target=fault.target,
+                    t_rel=round(time.monotonic() - t0, 3))
+                ts_inject = ev["ts"] if ev else time.time()
+                if fault.kind == "kill_stage":
+                    sup.inject_kill()
+                else:
+                    sup.inject_hang()
+                rec_ev = _await_recovery(flight_rec, fault.target,
+                                         ts_inject, RECOVERY_DEADLINE_S)
+                if rec_ev is None:
+                    raise RuntimeError(
+                        f"no recovery within {RECOVERY_DEADLINE_S}s for "
+                        f"{fault.kind} on {fault.target}")
+                incidents.append({
+                    "fault": fault.kind, "target": fault.target,
+                    "t_inject": round(ts_inject - (time.time()
+                                      - (time.monotonic() - t0)), 3),
+                    "abs_rec": time.monotonic(),
+                    "rec_rel": round(time.monotonic() - t0, 3),
+                    "outage_s": round(rec_ev["ts"] - ts_inject, 2)})
+            # post-recovery observation window (the TTFT-during-recovery
+            # contract), then stop the load
+            time.sleep(post_w + 1.0)
+            run_s = time.monotonic() - t0
+            gen.stop(join_timeout=req_timeout + 10.0)
+        finally:
+            if gen is not None and not gen._stop.is_set():
+                gen.stop(join_timeout=5.0)
+            if client is not None:
+                client.close()
+            for sup in sups.values():
+                sup.stop()
+
+    # ---- ring dump: the assertion input is the ARTIFACT, not memory --
+    dump_path = os.path.join(tempfile.gettempdir(),
+                             f"chaos_probe_ring_{os.getpid()}.jsonl")
+    flight_rec.dump(dump_path)
+    dumped = [json.loads(line) for line in open(dump_path)
+              if line.strip()]
+    injected = [e for e in dumped if e["kind"] == "chaos_inject"
+                and e.get("fault") in ("kill_stage", "hang_stage")]
+    restarts = [e for e in dumped if e["kind"] == "supervisor_restart"]
+    paired = all(
+        any(r.get("stage") == inj.get("target") and r["ts"] > inj["ts"]
+            for r in restarts)
+        for inj in injected)
+
+    # ---- outcome accounting ------------------------------------------
+    records = gen.records
+    total = len(records)
+    ok_n = sum(1 for r in records if r["outcome"] == "ok")
+    rejected_n = sum(1 for r in records if r["outcome"] == "rejected")
+    lost = total - ok_n - rejected_n
+    availability = (ok_n + rejected_n) / total if total else 0.0
+    quiet_lat = [r["latency"] for r in records
+                 if r["outcome"] == "ok" and r.get("t_done", 1e9)
+                 < kill_at]
+    rec_lat = []
+    for inc in incidents:
+        lo, hi = inc["rec_rel"], inc["rec_rel"] + post_w
+        rec_lat += [r["latency"] for r in records
+                    if r["outcome"] == "ok"
+                    and lo <= r.get("t_done", -1) <= hi]
+    quiet_p99 = _p99(quiet_lat)
+    rec_p99 = _p99(rec_lat)
+    ttft_ratio = (rec_p99 / quiet_p99
+                  if quiet_p99 and rec_p99 else float("inf"))
+    ok_avail = availability >= AVAILABILITY_FLOOR and lost == 0
+    ok_ttft = ttft_ratio <= TTFT_RATIO_CEIL
+    slo_burn = (1.0 - availability) / (1.0 - AVAILABILITY_FLOOR) \
+        if total else float("inf")
+    import jax
+
+    return {
+        "requests": total,
+        "completed": ok_n,
+        "explicitly_rejected": rejected_n,
+        "silently_lost": lost,
+        "availability": round(availability, 5),
+        "availability_slo_burn": round(slo_burn, 3),
+        "success_rate": round(ok_n / total, 4) if total else 0.0,
+        "quiet_p99_ms": round(quiet_p99 * 1e3, 2) if quiet_p99 else None,
+        "recovery_p99_ms": round(rec_p99 * 1e3, 2) if rec_p99 else None,
+        "ttft_recovery_ratio": round(ttft_ratio, 2),
+        "incidents": [{k: v for k, v in inc.items() if k != "abs_rec"}
+                      for inc in incidents],
+        "events_paired": paired,
+        "flight_dump": dump_path,
+        "run_s": round(run_s, 1),
+        "open_loop_hz": rate_hz,
+        "ok": bool(ok_avail and ok_ttft and paired),
+        "ok_availability": bool(ok_avail),
+        "ok_ttft": bool(ok_ttft),
+        "ok_paired": bool(paired),
+        "platform": jax.default_backend(),
+    }
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--assert", dest="do_assert", action="store_true",
+                    help="exit nonzero when a floor fails "
+                         f"(availability >= {AVAILABILITY_FLOOR:.0%} "
+                         "with zero silent losses, recovery p99 TTFT "
+                         f"<= {TTFT_RATIO_CEIL:.0f}x quiet, every "
+                         "injected fault paired with its recovery "
+                         "event in the dumped ring)")
+    ap.add_argument("--light", action="store_true",
+                    help="shortened timeline (smoke use; the acceptance "
+                         "configuration is the full >=60s run)")
+    args = ap.parse_args(argv)
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    row = measure(light=args.light)
+    print(json.dumps(row), flush=True)
+    if args.do_assert and not row["ok"]:
+        print(f"ASSERT FAILED: availability={row['availability']} "
+              f"(floor {AVAILABILITY_FLOOR}, lost="
+              f"{row['silently_lost']}), ttft_ratio="
+              f"{row['ttft_recovery_ratio']} (ceil {TTFT_RATIO_CEIL}), "
+              f"paired={row['events_paired']}", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

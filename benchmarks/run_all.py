@@ -644,6 +644,37 @@ def dev_decode_mbu():
     return results
 
 
+@device_config("chaos_resilience")
+def dev_chaos_resilience():
+    # ISSUE 8: availability + p99 TTFT under the STANDARD FaultPlan
+    # (one stage kill + one injected wedge) against a real supervised
+    # 2-stage pipeline with open-loop load — the resilience contract as
+    # a regression-asserted row, like obs_overhead's <2% and
+    # relay_transport's hop floors. Floors: >=99% of requests
+    # completed-or-explicitly-rejected with ZERO silently lost,
+    # post-recovery p99 TTFT <= 10x quiet p99, and every injected fault
+    # paired with its supervisor_restart recovery event in the dumped
+    # flight ring (benchmarks/chaos_probe.py).
+    from benchmarks.chaos_probe import (
+        AVAILABILITY_FLOOR,
+        TTFT_RATIO_CEIL,
+        measure,
+    )
+
+    results = []
+    row = measure()
+    ok = row.pop("ok")
+    avail = row.pop("availability")
+    _emit(results, config="chaos_resilience", metric="availability_pct",
+          value=round(avail * 100, 3), ok=ok,
+          note=f"open-loop load through a supervised 2-stage pipeline "
+               f"under kill+wedge injection; floors: availability >= "
+               f"{AVAILABILITY_FLOOR:.0%} (zero silent losses), "
+               f"recovery p99 TTFT <= {TTFT_RATIO_CEIL:.0f}x quiet, "
+               "inject/recovery flight events paired", **row)
+    return results
+
+
 def _serve_round(srv_x, cfg, sb_new, n_requests, plen_fn, constraint=None,
                  key=9):
     """Admit-when-a-slot-frees over the pool, then drain — the

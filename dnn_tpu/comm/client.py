@@ -20,6 +20,7 @@ import grpc
 import numpy as np
 
 from dnn_tpu import obs
+from dnn_tpu.chaos import inject as _chaos_inject
 from dnn_tpu.comm import transport as _tx
 from dnn_tpu.comm import wire_pb2 as pb
 from dnn_tpu.comm import wirecodec as wc
@@ -29,6 +30,7 @@ from dnn_tpu.comm.service import (
     SERVICE_NAME,
     _tensor_arr,
     _tensor_msg,
+    full_jitter_delay as _backoff_delay,
 )
 from dnn_tpu.io.serialization import PayloadCorruptError
 from dnn_tpu.utils.metrics import labeled
@@ -58,9 +60,105 @@ def pipeline_budget(num_parts: int, *, margin: float = 30.0,
 
 
 
+class CircuitOpenError(RuntimeError):
+    """Raised by a fast-failing client whose breaker is OPEN: the target
+    has failed `threshold` consecutive calls and the cooldown has not
+    elapsed. Callers treat it like UNAVAILABLE without paying the
+    connect timeout + retry ladder per request."""
+
+
+class CircuitBreaker:
+    """Per-target circuit breaker: closed -> (threshold consecutive
+    failures) -> open -> (cooldown) -> half-open (ONE probe call) ->
+    closed on success / open with doubled cooldown on failure. A
+    flapping stage then sheds load in O(1) per request instead of
+    burning a full retry ladder each, and the half-open probe bounds
+    detection of recovery to one cooldown. Thread-safe; state
+    transitions land in the flight ring and the
+    `comm.circuit_state{target=}` gauge (0 closed / 1 half-open / 2
+    open)."""
+
+    _STATE_VAL = {"closed": 0.0, "half_open": 1.0, "open": 2.0}
+
+    def __init__(self, target: str = "", *, threshold: int = 5,
+                 cooldown_s: float = 1.0, max_cooldown_s: float = 30.0):
+        self.target = target
+        self.threshold = int(threshold)
+        self.cooldown_s = float(cooldown_s)
+        self.max_cooldown_s = float(max_cooldown_s)
+        self._lock = threading.Lock()
+        self._state = "closed"
+        self._failures = 0
+        self._opened_at = 0.0
+        self._cooldown = self.cooldown_s
+        m = obs.metrics()
+        if m is not None:
+            m.set_fn(labeled("comm.circuit_state", target=target),
+                     lambda: self._STATE_VAL[self._state])
+
+    @property
+    def state(self) -> str:
+        return self._state
+
+    def release(self):
+        """Give back a HALF-OPEN probe slot without judging it — used
+        when the caller that consumed the slot DELEGATES the actual
+        call elsewhere (send_tensors falling back to per-item
+        send_tensor, which runs its own allow/record cycle). Re-opens
+        with the cooldown already elapsed, so the next allow() hands
+        the probe slot to the delegate immediately; without this the
+        breaker would sit in half_open with no probe in flight and
+        shed 100% of traffic forever."""
+        with self._lock:
+            if self._state == "half_open":
+                self._state = "open"
+                self._opened_at = time.monotonic() - self._cooldown
+
+    def allow(self) -> bool:
+        """True when a call may proceed. In OPEN, flips to HALF-OPEN
+        (allowing exactly one probe) once the cooldown elapses."""
+        with self._lock:
+            if self._state == "closed":
+                return True
+            if self._state == "open":
+                if time.monotonic() - self._opened_at < self._cooldown:
+                    return False
+                self._state = "half_open"
+                obs.flight.record("circuit_half_open", target=self.target)
+                return True
+            # half_open: one probe is already in flight
+            return False
+
+    def record(self, ok: bool):
+        with self._lock:
+            if ok:
+                if self._state != "closed":
+                    obs.flight.record("circuit_close", target=self.target)
+                self._state = "closed"
+                self._failures = 0
+                self._cooldown = self.cooldown_s
+                return
+            self._failures += 1
+            if self._state == "half_open":
+                # failed probe: reopen with a longer cooldown
+                self._state = "open"
+                self._opened_at = time.monotonic()
+                self._cooldown = min(self._cooldown * 2,
+                                     self.max_cooldown_s)
+                obs.flight.record("circuit_reopen", target=self.target,
+                                  cooldown_s=round(self._cooldown, 3))
+            elif self._state == "closed" \
+                    and self._failures >= self.threshold:
+                self._state = "open"
+                self._opened_at = time.monotonic()
+                obs.flight.record("circuit_open", target=self.target,
+                                  failures=self._failures,
+                                  cooldown_s=round(self._cooldown, 3))
+
+
 def _gen_rid(max_new_tokens, seed, temperature, top_k, top_p,
              adapter=None, min_p=None, repetition_penalty=None,
-             logit_bias=None):
+             logit_bias=None, dedup=None):
     """Encode generation options into the request_id the LM daemon parses
     (lm_server.parse_gen_options): positional max_new/seed, then named
     t=/k=/p=/m=/r= sampling overrides and a= (the per-request LoRA
@@ -82,6 +180,11 @@ def _gen_rid(max_new_tokens, seed, temperature, top_k, top_p,
         rid += f":b={pairs}"
     if adapter is not None:
         rid += f":a={adapter}"
+    if dedup is not None:
+        # exactly-once guard: the LM daemon's admission dedups on this
+        # key, so a client-side retry after a drain/requeue can never
+        # run the same generation twice (lm_server parse_gen_options d=)
+        rid += f":d={dedup}"
     return rid
 
 
@@ -95,9 +198,27 @@ class NodeClient:
     reference peers (and the LM daemon, which declines) land on grpc
     transparently; explicit "device"/"shm" fail loud when unsatisfiable;
     "grpc" skips the handshake entirely (byte-identical reference
-    behavior)."""
+    behavior).
 
-    def __init__(self, address: str, *, transport: str = "auto"):
+    Resilience (ISSUE 8): `breaker=True` (default) runs a per-client
+    CircuitBreaker — after `threshold` consecutive terminal send
+    failures the client fails fast (CircuitOpenError) for a cooldown
+    instead of burning the full retry ladder per request, with one
+    half-open probe per cooldown to detect recovery; pass False to
+    disable or a prebuilt CircuitBreaker to share/tune one. A gRPC
+    channel that entered connect backoff is REBUILT (fresh channel)
+    after `rebuild_after` consecutive UNAVAILABLE outcomes: a sync
+    channel whose first connects failed can sit out gRPC's internal
+    reconnect backoff and miss a server that has since come up — the
+    PR 7 lesson the transport test used to work around with a fresh
+    client per poll. Health probes count toward (and benefit from) the
+    rebuild streak but bypass the breaker — they ARE the recovery
+    probe."""
+
+    REBUILD_AFTER = 2  # consecutive UNAVAILABLEs before a fresh channel
+
+    def __init__(self, address: str, *, transport: str = "auto",
+                 breaker=True, rebuild_after: Optional[int] = None):
         from dnn_tpu.native import native_available
 
         native_available()  # warm the one-time native codec build up front
@@ -108,8 +229,64 @@ class NodeClient:
         self.address = address
         self.transport = transport
         self._channel = grpc.insecure_channel(address)
+        self._chan_lock = threading.Lock()
+        self._conn_fail_streak = 0
+        self._last_rebuild = 0.0
+        self.rebuild_after = self.REBUILD_AFTER if rebuild_after is None \
+            else int(rebuild_after)
+        self.channel_rebuilds = 0
+        if breaker is True:
+            self.breaker: Optional[CircuitBreaker] = CircuitBreaker(address)
+        elif breaker:
+            self.breaker = breaker
+        else:
+            self.breaker = None
         self._negotiated: Optional[_tx.Negotiated] = None
         self._neg_lock = threading.Lock()
+
+    # -- channel health (the wedged-backoff rebuild, ISSUE 8 satellite) --
+
+    def _note_conn_result(self, code) -> None:
+        """Track consecutive connect-level failures; at `rebuild_after`
+        the channel is replaced wholesale. Only UNAVAILABLE counts —
+        it is the one code gRPC returns both for a refused connect and
+        for a channel sitting in reconnect backoff; application errors
+        (INVALID_ARGUMENT, DEADLINE on a live server) prove the
+        connection works and reset the streak."""
+        if code != grpc.StatusCode.UNAVAILABLE:
+            self._conn_fail_streak = 0
+            return
+        self._conn_fail_streak += 1
+        if self._conn_fail_streak >= self.rebuild_after:
+            self._rebuild_channel()
+
+    def _rebuild_channel(self):
+        with self._chan_lock:
+            now = time.monotonic()
+            if now - self._last_rebuild < 1.0:
+                # concurrent failing calls all cross the streak at once
+                # during an outage; one fresh channel per second is the
+                # fix — a rebuild storm is not
+                self._conn_fail_streak = 0
+                return
+            self._last_rebuild = now
+            old, self._channel = self._channel, grpc.insecure_channel(
+                self.address)
+            self._conn_fail_streak = 0
+            self.channel_rebuilds += 1
+        try:
+            old.close()  # cancels any straggler calls still parked on
+            # the backoff channel — they were failing anyway
+        except Exception:  # noqa: BLE001 — already-closed channel
+            pass
+        m = obs.metrics()
+        if m is not None:
+            m.inc(labeled("comm.channel_rebuilds_total",
+                          target=self.address))
+        obs.flight.record("channel_rebuild", target=self.address,
+                          rebuilds=self.channel_rebuilds)
+        log.info("rebuilt gRPC channel to %s after %d consecutive "
+                 "connect failures", self.address, self.rebuild_after)
 
     # -- transport negotiation (comm/transport.py) ----------------------
 
@@ -162,8 +339,16 @@ class NodeClient:
             response_deserializer=pb.HealthCheckResponse.FromString,
         )
         try:
-            return bool(call(pb.Empty(), timeout=timeout).is_healthy)
-        except grpc.RpcError:
+            healthy = bool(call(pb.Empty(), timeout=timeout).is_healthy)
+            self._note_conn_result(None)
+            return healthy
+        except grpc.RpcError as e:
+            # a probe that can't CONNECT advances the rebuild streak, so
+            # polling health against a late-starting server self-heals
+            # out of gRPC's internal backoff (wait_healthy needs no
+            # fresh-client workaround anymore)
+            self._note_conn_result(e.code() if hasattr(e, "code")
+                                   else None)
             return False
 
     def send_message(self, sender_id: str, text: str, timeout: float = 5.0) -> str:
@@ -225,18 +410,26 @@ class NodeClient:
         Per-attempt latency and payload bytes land in the shared
         registry (histograms labeled by transport, plus the
         exact-quantile `comm.hop_seconds` series); each retry bumps
-        `comm.retries_total{target=...}` and logs the trace id so a
-        backoff storm is attributable to the requests living through it."""
+        `comm.retries_total{target=...,outcome=<code>}` (full-jitter
+        backoff — see _backoff_delay) and logs the trace id so a
+        backoff storm is attributable to the requests living through
+        it. The remaining budget rides the wire as a `dl=` request_id
+        segment (comm/transport.tag_deadline) for downstream hops to
+        honor, and the client-side circuit breaker (see the class
+        docstring) fails fast when the target is flapping."""
+        if self.breaker is not None and not self.breaker.allow():
+            raise CircuitOpenError(
+                f"circuit open for {self.address}: shedding fast "
+                f"(cooldown {self.breaker._cooldown:.1f}s)")
         neg = self._ensure_negotiated()
-        call = self._channel.unary_unary(
-            f"/{SERVICE_NAME}/SendTensor",
-            request_serializer=wc.serialize_request,
-            response_deserializer=wc.parse_response,
-        )
         sp = obs.start_span("rpc.SendTensor", parent=obs.current_span(),
                             target=self.address, transport=neg.name)
+        # the propagated deadline (dl=) rides the request_id: downstream
+        # hops cap their own forward/retry budgets to what THIS caller
+        # still has, so a chain can never over-spend a dying deadline
+        rid = obs.tag_request_id(request_id, sp) if sp else request_id
         request = neg.sender.make_request(
-            arr, obs.tag_request_id(request_id, sp) if sp else request_id)
+            arr, _tx.tag_deadline(rid, timeout))
         m = obs.metrics()
         deadline = time.monotonic() + timeout
         attempt = 0
@@ -244,6 +437,11 @@ class NodeClient:
         try:
             while True:
                 remaining = deadline - time.monotonic()
+                # refresh the propagated deadline EVERY attempt: after
+                # retries + backoff the wire must advertise what is
+                # actually left, not the original budget — or every
+                # downstream hop over-spends a nearly-dead request
+                request.request_id = _tx.tag_deadline(rid, remaining)
                 t_try = time.perf_counter()
                 if m is not None:
                     # per ATTEMPT: retries resend the payload, and the
@@ -251,7 +449,16 @@ class NodeClient:
                     # (and the server's direction="in" count)
                     m.inc(labeled("comm.payload_bytes_total",
                                   direction="out"), request.ByteSize())
+                # inside the loop: a channel rebuild between attempts
+                # must take effect on the NEXT attempt, not the next
+                # send_tensor call
+                call = self._channel.unary_unary(
+                    f"/{SERVICE_NAME}/SendTensor",
+                    request_serializer=wc.serialize_request,
+                    response_deserializer=wc.parse_response,
+                )
                 try:
+                    _chaos_inject.perturb_rpc("client", self.address)
                     t_send_wall = time.time() if sp else 0.0
                     resp = call(request, timeout=max(remaining, 0.001))
                     dt = time.perf_counter() - t_try
@@ -284,23 +491,33 @@ class NodeClient:
                     )
                     sp.set(attempts=attempt + 1)
                     completed = True
+                    self._note_conn_result(None)
                     return resp.status, result
                 except (grpc.RpcError, PayloadCorruptError) as e:
                     code = e.code() if isinstance(e, grpc.RpcError) else None
+                    self._note_conn_result(code)
                     if m is not None and \
                             code == grpc.StatusCode.DEADLINE_EXCEEDED:
                         m.inc(labeled("comm.deadline_exceeded_total",
                                           target=self.address))
                     retryable = isinstance(e, PayloadCorruptError) \
                         or code in RETRYABLE_CODES
-                    delay = backoff * (2 ** attempt)
-                    out_of_budget = deadline - time.monotonic() <= delay
+                    # full jitter: decorrelates the retry herd so a
+                    # partial outage is not amplified by synchronized
+                    # resends; the out-of-budget check uses the WORST
+                    # CASE delay, so the ladder still respects the
+                    # propagated deadline exactly
+                    worst = backoff * (2 ** attempt)
+                    out_of_budget = deadline - time.monotonic() <= worst
                     if not retryable or attempt >= retries or out_of_budget:
                         sp.set(error=str(code or e), attempts=attempt + 1)
                         raise
+                    delay = _backoff_delay(backoff, attempt)
                     if m is not None:
-                        m.inc(labeled("comm.retries_total",
-                                          target=self.address))
+                        m.inc(labeled(
+                            "comm.retries_total", target=self.address,
+                            outcome=(code.name.lower() if code
+                                     else "payload_corrupt")))
                     obs.flight.record(
                         "rpc_retry", target=self.address,
                         code=str(code or type(e).__name__),
@@ -320,6 +537,8 @@ class NodeClient:
                 neg.sender.sent_ok(request)
             else:
                 neg.sender.cleanup(request)
+            if self.breaker is not None:
+                self.breaker.record(completed)
             sp.end()
 
     def send_tensors(
@@ -345,11 +564,35 @@ class NodeClient:
         arrs = list(arrs)
         if not arrs:
             return []
+        if self.breaker is not None and not self.breaker.allow():
+            raise CircuitOpenError(
+                f"circuit open for {self.address}: shedding fast")
+        # EVERY exit path below must settle the breaker exactly once:
+        # record an outcome, or RELEASE the (possibly half-open) probe
+        # slot when the call is delegated to send_tensor, which runs
+        # its own allow/record cycle — an un-settled half_open slot
+        # would shed all traffic forever
+        recorded = False
+
+        def _breaker_done(ok: bool):
+            nonlocal recorded
+            if self.breaker is not None and not recorded:
+                self.breaker.record(ok)
+            recorded = True
+
+        def _breaker_release():
+            nonlocal recorded
+            if self.breaker is not None and not recorded:
+                self.breaker.release()
+            recorded = True
+
+        request_id = _tx.tag_deadline(request_id, timeout)
         neg = self._ensure_negotiated()
         if neg.relay_known and not neg.relay_ok:
             # the handshake already said the peer has no Relay RPC
             # (reference protocol): go straight to the unary chain
             # instead of paying a doomed probe per call
+            _breaker_release()
             return [self.send_tensor(a, request_id=request_id,
                                      timeout=timeout) for a in arrs]
         sp = obs.start_span("rpc.Relay", parent=obs.current_span(),
@@ -410,9 +653,16 @@ class NodeClient:
                 # reference peer: sequential unary fallback (idempotent
                 # per item, so the ordinary retry machinery applies)
                 sp.end(fallback="unary")
+                _breaker_release()
                 return [self.send_tensor(a, request_id=request_id,
                                          timeout=timeout) for a in arrs]
             sp.set(error=str(e.code()))
+            self._note_conn_result(e.code())
+            _breaker_done(False)
+            raise
+        except Exception:  # noqa: BLE001 — stream-level errors (relay
+            # error status, response corruption) settle the breaker too
+            _breaker_done(False)
             raise
         finally:
             for req in pending.values():
@@ -420,6 +670,7 @@ class NodeClient:
             pending.clear()
             sp.end()
         missing = [i for i in range(len(arrs)) if i not in statuses]
+        _breaker_done(not missing)
         if missing:
             raise RuntimeError(
                 f"relay stream ended without results for items {missing}")
@@ -438,6 +689,7 @@ class NodeClient:
         repetition_penalty: Optional[float] = None,
         logit_bias: Optional[dict] = None,
         adapter: Optional[int] = None,
+        dedup: Optional[str] = None,
         timeout: float = 120.0,
     ) -> np.ndarray:
         """Client path for the LM daemon (dnn_tpu/runtime/lm_server.py):
@@ -446,9 +698,13 @@ class NodeClient:
         message a reference-built client would send, just with an integer
         payload. Sampling overrides are per request (None = server
         defaults). A request is self-contained (prompt + options), so the
-        transport-level retries in send_tensor stay safe here."""
+        transport-level retries in send_tensor stay safe here; `dedup`
+        (an opaque key, rides as d=) makes that at-least-once
+        EXACTLY-once — the daemon's admission joins a retried key to
+        the original request instead of generating twice."""
         rid = _gen_rid(max_new_tokens, seed, temperature, top_k, top_p,
-                       adapter, min_p, repetition_penalty, logit_bias)
+                       adapter, min_p, repetition_penalty, logit_bias,
+                       dedup)
         status, result = self.send_tensor(
             np.asarray(prompt_ids, np.int32).reshape(-1),
             request_id=rid, timeout=timeout,
@@ -485,6 +741,7 @@ class NodeClient:
         repetition_penalty: Optional[float] = None,
         logit_bias: Optional[dict] = None,
         adapter: Optional[int] = None,
+        dedup: Optional[str] = None,
         timeout: float = 120.0,
     ):
         """Streaming client for the LM daemon's GenerateStream RPC: yields
@@ -492,9 +749,12 @@ class NodeClient:
         (break / close / GC) cancels the RPC, which frees the server-side
         decode slot at its next step boundary — a disconnected client never
         decodes on to its budget. NOT retried: a stream is stateful (tokens
-        already delivered), unlike the self-contained unary generate()."""
+        already delivered), unlike the self-contained unary generate() —
+        for the same reason a `dedup` key cannot JOIN a stream; the
+        server accepts and ignores it."""
         rid = _gen_rid(max_new_tokens, seed, temperature, top_k, top_p,
-                       adapter, min_p, repetition_penalty, logit_bias)
+                       adapter, min_p, repetition_penalty, logit_bias,
+                       dedup)
         call = self._channel.unary_stream(
             f"/{SERVICE_NAME}/GenerateStream",
             request_serializer=wc.serialize_request,

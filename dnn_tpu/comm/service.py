@@ -40,6 +40,7 @@ import grpc
 import numpy as np
 
 from dnn_tpu import obs
+from dnn_tpu.chaos import inject as _chaos_inject
 from dnn_tpu.comm import transport as _tx
 from dnn_tpu.comm import wire_pb2 as pb
 from dnn_tpu.comm import wirecodec as wc
@@ -69,6 +70,21 @@ RETRYABLE_CODES = frozenset({
 # spans the entire remaining pipeline (see _forward / pipeline_budget), so
 # when it expires, resending toward the same hung stage can only duplicate
 # every downstream stage's work — the timeout surfaces upward instead.
+
+
+def full_jitter_delay(backoff: float, attempt: int) -> float:
+    """FULL-JITTER exponential backoff: uniform in (0, backoff *
+    2^attempt], shared by the edge client and the stage forward ladder.
+    Deterministic backoff meant every caller that failed together
+    retried together — a retry storm re-spiking the very stage that was
+    recovering; jitter decorrelates the herd. The small floor keeps the
+    delay > 0 so budget checks still terminate ladders. (Uses `random`,
+    never in traced code — chaos-plan determinism lives in
+    chaos/plan.decide, not here.)"""
+    import random
+
+    return max(backoff * (2 ** attempt) * random.random(),
+               backoff * 0.05)
 
 
 def _tensor_msg(arr) -> wc.Tensor:
@@ -151,6 +167,10 @@ class StageServer:
         nid = self.node.id
         result_msg = None
         t_handler = time.perf_counter()
+        # propagated deadline (dl= request_id segment): the remaining
+        # budget the SENDER granted the rest of the pipeline — our
+        # downstream forward must fit inside it minus our own elapsed
+        inbound_dl = _tx.extract_deadline(request.request_id)
         m = obs.metrics()
         if m is not None:
             m.inc(labeled("comm.payload_bytes_total", direction="in",
@@ -193,8 +213,13 @@ class StageServer:
                 status = f"[{nid}] Processing complete. Prediction: {pred}"
                 result_msg = _tensor_msg(y)
             else:
+                remaining_dl = None
+                if inbound_dl is not None:
+                    remaining_dl = inbound_dl - (time.perf_counter()
+                                                 - t_handler)
                 resp = await self._forward(request.request_id, y,
-                                           parent=root)
+                                           parent=root,
+                                           inbound_budget=remaining_dl)
                 status = f"[{nid}] Forwarded. Next node status: {resp.status}"
                 if resp.HasField("result_tensor"):
                     result_msg = resp.result_tensor
@@ -601,7 +626,7 @@ class StageServer:
     async def _forward(
         self, request_id: str, y: np.ndarray, *, retries: int = 2,
         backoff: float = 0.2, timeout: Optional[float] = None,
-        parent=None,
+        parent=None, inbound_budget: Optional[float] = None,
     ):
         """Relay downstream with bounded retries on transient failures,
         reusing the shared channel across attempts (gRPC reconnects a broken
@@ -636,11 +661,25 @@ class StageServer:
         neg = await self._ensure_negotiated()
         sp = obs.start_span("rpc.forward", parent=parent,
                             target=self.next_address, transport=neg.name)
+        downstream = max(self.config.num_parts - self.part_index - 1, 1)
+        if timeout is None:
+            timeout = _tx.hop_budget_s(neg.name, downstream,
+                                       warm=self._hop_warm)
+        if inbound_budget is not None:
+            # never grant downstream more than the sender still has:
+            # the propagated deadline caps the derived budget, so a
+            # nearly-dead request can't spend a fresh full ladder at
+            # every remaining hop (the floor keeps gRPC's deadline
+            # validation happy; an already-expired budget fails fast)
+            timeout = max(min(timeout, inbound_budget), 0.001)
         # non-blocking make when a slot is free; with concurrent
         # in-flight requests the shm ring can fill, and the WAIT must
         # leave the loop free to process the downstream responses that
-        # release slots — so the full make runs on a worker thread
+        # release slots — so the full make runs on a worker thread.
+        # The forwarded request_id re-tags the deadline with what THIS
+        # hop grants (<= what it was granted).
         rid_out = obs.tag_request_id(request_id, sp) if sp else request_id
+        rid_out = _tx.tag_deadline(rid_out, timeout)
         request = neg.sender.make_request_nowait(y, rid_out)
         if request is None:
             request = await asyncio.to_thread(
@@ -652,10 +691,6 @@ class StageServer:
             request_serializer=wc.serialize_request,
             response_deserializer=wc.parse_response,
         )
-        downstream = max(self.config.num_parts - self.part_index - 1, 1)
-        if timeout is None:
-            timeout = _tx.hop_budget_s(neg.name, downstream,
-                                       warm=self._hop_warm)
         deadline = time.monotonic() + timeout
         attempt = 0
         m = obs.metrics()
@@ -664,6 +699,10 @@ class StageServer:
         try:
             while True:
                 remaining = deadline - time.monotonic()
+                # refresh the propagated deadline per attempt (see the
+                # edge client): the wire advertises the budget LEFT
+                request.request_id = _tx.tag_deadline(
+                    rid_out, max(remaining, 0.001))
                 t_try = time.perf_counter()
                 if m is not None:
                     # per ATTEMPT, like the edge client: relayed bytes
@@ -673,6 +712,7 @@ class StageServer:
                                   direction="out", stage=nid),
                           request.ByteSize())
                 try:
+                    _chaos_inject.perturb_rpc("stage", self.next_address)
                     t_send_wall = time.time() if sp else 0.0
                     resp = await call(request, timeout=max(remaining, 0.001))
                     dt = time.perf_counter() - t_try
@@ -699,28 +739,39 @@ class StageServer:
                     completed = True
                     self._hop_warm = True
                     return resp
-                except grpc.aio.AioRpcError as e:
+                except (grpc.RpcError, PayloadCorruptError) as e:
                     # NOTE: the shared channel is deliberately NOT closed
                     # between attempts — other requests may have calls in
                     # flight on it, and gRPC reconnects a broken channel on
-                    # the next call anyway.
+                    # the next call anyway. grpc.RpcError (not the aio
+                    # subclass alone) so injected transport faults walk
+                    # the same ladder real ones do; PayloadCorruptError
+                    # maps to the DATA_LOSS retry policy like the edge
+                    # client's.
+                    code = e.code() if isinstance(e, grpc.RpcError) \
+                        else grpc.StatusCode.DATA_LOSS
                     if m is not None and \
-                            e.code() == grpc.StatusCode.DEADLINE_EXCEEDED:
+                            code == grpc.StatusCode.DEADLINE_EXCEEDED:
                         m.inc(labeled("comm.deadline_exceeded_total",
                                       stage=nid))
-                    delay = backoff * (2 ** attempt)
-                    out_of_budget = deadline - time.monotonic() <= delay
-                    if e.code() not in RETRYABLE_CODES or attempt >= retries \
+                    # full jitter (see client._backoff_delay): the
+                    # budget check uses the worst-case delay so the
+                    # ladder never outlives the propagated deadline
+                    worst = backoff * (2 ** attempt)
+                    out_of_budget = deadline - time.monotonic() <= worst
+                    if code not in RETRYABLE_CODES or attempt >= retries \
                             or out_of_budget:
-                        sp.set(error=str(e.code()), attempts=attempt + 1)
+                        sp.set(error=str(code), attempts=attempt + 1)
                         raise
+                    delay = full_jitter_delay(backoff, attempt)
                     if m is not None:
                         m.inc(labeled("comm.retries_total",
-                                      stage=nid))
+                                      stage=nid,
+                                      outcome=code.name.lower()))
                     log.warning(
                         "forward %s -> %s failed (%s), retry %d/%d in "
                         "%.2fs [trace=%s]",
-                        nid, self.next_address, e.code(),
+                        nid, self.next_address, code,
                         attempt + 1, retries, delay, sp.trace_id or "-",
                     )
                     await asyncio.sleep(delay)

@@ -56,6 +56,7 @@ from typing import Any, Dict, List, Optional, Tuple
 import numpy as np
 
 from dnn_tpu import obs
+from dnn_tpu.chaos import inject as _chaos_inject
 from dnn_tpu.comm import wirecodec as wc
 from dnn_tpu.utils.metrics import labeled
 
@@ -804,6 +805,41 @@ def parse_seq(request_id: str) -> Tuple[str, Optional[int],
     return ":".join(base), seq, chunk
 
 
+_DL_PREFIX = "dl="
+
+
+def tag_deadline(request_id: str, remaining_s: float) -> str:
+    """Append (or replace) the propagated-deadline segment: the
+    REMAINING budget, in seconds, the sender grants the rest of the
+    pipeline. Rides the existing request_id field like the trace tag
+    (`tr=`) and the relay segments (`s=`/`c=`) — opaque to reference
+    peers, skipped by parse_gen_options — so downstream hops can cap
+    their own retry/forward budgets to it instead of over-spending a
+    nearly-dead deadline (comm/client.py, comm/service.py,
+    runtime/lm_server.py all honor it)."""
+    return (f"{strip_deadline(request_id)}:"
+            f"{_DL_PREFIX}{max(float(remaining_s), 0.001):.3f}")
+
+
+def extract_deadline(request_id: str) -> Optional[float]:
+    """The inbound `dl=` budget in seconds, or None when the sender
+    propagated none (reference clients)."""
+    for seg in (request_id or "").split(":"):
+        if seg.startswith(_DL_PREFIX):
+            try:
+                return float(seg[len(_DL_PREFIX):])
+            except ValueError:
+                return None
+    return None
+
+
+def strip_deadline(request_id: str) -> str:
+    if _DL_PREFIX not in (request_id or ""):
+        return request_id
+    return ":".join(seg for seg in request_id.split(":")
+                    if not seg.startswith(_DL_PREFIX))
+
+
 def split_requests(request: wc.TensorRequest, seq: int,
                    chunk_bytes: int = CHUNK_BYTES) -> List[wc.TensorRequest]:
     """One logical send -> the Relay stream's frames. Small payloads and
@@ -844,6 +880,13 @@ class ChunkAssembler:
             ) -> Optional[Tuple[str, int, wc.Tensor]]:
         """-> (base_request_id, seq, whole_tensor) when a logical
         payload completes, else None."""
+        if _chaos_inject.perturb_relay():
+            # injected relay-frame drop: the frame vanishes in
+            # "transit" — the sender's seq never answers, surfacing as
+            # an explicit stream error at the client (never a silent
+            # loss; relay_corrupt raises PayloadCorruptError here
+            # instead, the per-item DATA_LOSS path)
+            return None
         base, seq, chunk = parse_seq(request.request_id)
         seq = 0 if seq is None else seq
         t = request.tensor

@@ -217,6 +217,35 @@ def build_parser() -> argparse.ArgumentParser:
                    help="--serve_lm: fraction of requests that must "
                         "meet each latency objective (default 0.99; "
                         "needs at least one --slo_* objective)")
+    p.add_argument("--chaos", default=None, metavar="PLAN",
+                   help="--serve/--serve_lm: install a fault-injection "
+                        "plan in THIS process (dnn_tpu/chaos; a JSON "
+                        "file path or inline JSON). Deterministic "
+                        "seeded injections — RPC drop/delay/corrupt, "
+                        "relay-frame faults, KV-pool exhaustion, "
+                        "device-step faults, watchdog wedge windows — "
+                        "each recorded as a chaos_inject flight event "
+                        "so the induced incident reconstructs from "
+                        "/debugz")
+    p.add_argument("--on_wedged", choices=["503", "restart", "drain"],
+                   default="503",
+                   help="--serve_lm: policy when the watchdog declares "
+                        "wedged (warm-up grace preserved). '503' "
+                        "(default): passive — /healthz 503s until a "
+                        "human acts. 'restart': exit with code 43 so a "
+                        "supervisor (--supervise, or any process "
+                        "manager) relaunches from the latest "
+                        "checkpoint. 'drain': finish in-flight decodes "
+                        "within the drain grace, hand queued work back "
+                        "retriable, then exit 43. Needs --watchdog_s")
+    p.add_argument("--supervise", action="store_true",
+                   help="run the serving mode as a SUPERVISED CHILD "
+                        "process: this process respawns it on death "
+                        "with exponential backoff + crash-loop cap, "
+                        "and (with --metrics_port) polls its /healthz "
+                        "to catch wedged-but-alive children — the "
+                        "--on_wedged policy then applies from outside "
+                        "too (dnn_tpu/chaos/supervisor.py)")
     p.add_argument("--watchdog_s", type=float, default=None, metavar="S",
                    help="--serve_lm: run the hung-device watchdog with "
                         "this probe period in seconds (subprocess-bounded "
@@ -288,8 +317,16 @@ async def _initiate_edge(engine: PipelineEngine, node_id: str, image_path: str,
 
 
 def main(argv=None) -> int:
+    raw_argv = list(sys.argv[1:] if argv is None else argv)
     args = build_parser().parse_args(argv)
     setup_logging(args.log_level, node_id=args.node_id)
+
+    if args.supervise:
+        if not (args.serve or args.serve_lm):
+            log.error("--supervise applies to the serving modes "
+                      "(--serve / --serve_lm)")
+            return 1
+        return _supervise(args, raw_argv)
 
     try:
         config = TopologyConfig.from_json(args.config)
@@ -434,6 +471,30 @@ def main(argv=None) -> int:
         log.error("--min_p/--repetition_penalty apply to --serve_lm only")
         return 1
 
+    if args.on_wedged != "503" and not args.serve_lm:
+        log.error("--on_wedged applies to --serve_lm (the watchdog's "
+                  "escalation policy) or alongside --supervise")
+        return 1
+    if args.on_wedged != "503" and args.watchdog_s is None:
+        log.error("--on_wedged %s needs --watchdog_s (the watchdog is "
+                  "what declares wedged)", args.on_wedged)
+        return 1
+    if args.chaos is not None:
+        if not (args.serve or args.serve_lm):
+            log.error("--chaos applies to the serving modes (--serve / "
+                      "--serve_lm)")
+            return 1
+        from dnn_tpu import chaos
+
+        try:
+            chaos.install(chaos.FaultPlan.from_cli(args.chaos))
+            log.warning("chaos fault plan INSTALLED (%s) — injected "
+                        "faults will be recorded as chaos_inject "
+                        "flight events", args.chaos[:120])
+        except (ValueError, OSError) as e:
+            log.error("--chaos plan invalid: %s", e)
+            return 1
+
     if args.transport is not None and not args.serve:
         # BEFORE the serve_lm dispatch: `--serve_lm --transport shm`
         # must fail loud here, not silently serve grpc (the LM daemon
@@ -511,6 +572,66 @@ def main(argv=None) -> int:
         log.info("nothing to do for non-initiator node in single-controller mode "
                  "(use --serve for distributed edge mode)")
     return 0
+
+
+def _supervise(args, raw_argv) -> int:
+    """Supervisor-parent mode: spawn the SAME node command (minus
+    --supervise) as a child and keep it alive — restart-with-backoff on
+    death (including the deliberate EXIT_RESTART=43 a wedged-policy
+    escalation uses), crash-loop cap, and — with --metrics_port — a
+    fresh-connection /healthz poll that catches wedged-but-alive
+    children and applies the --on_wedged policy from OUTSIDE the
+    process (a hung process cannot run its own policy). Blocks until
+    Ctrl-C; returns 1 when the child crash-loops."""
+    import subprocess
+    import time as _time
+
+    from dnn_tpu.chaos.supervisor import Supervisor
+
+    child_argv, skip = [], False
+    for a in raw_argv:
+        if skip:
+            skip = False
+            continue
+        if a == "--supervise":
+            continue
+        if not args.serve_lm and (a == "--on_wedged"
+                                  or a.startswith("--on_wedged=")):
+            # the stage server has no in-process wedged policy; the
+            # flag configures THIS supervisor only (both argparse
+            # spellings: '--on_wedged restart' and '--on_wedged=restart')
+            skip = a == "--on_wedged"
+            continue
+        child_argv.append(a)
+    cmd = [sys.executable, "-m", "dnn_tpu.node"] + child_argv
+    health = None
+    if args.metrics_port:
+        health = f"http://127.0.0.1:{args.metrics_port}"
+    elif args.metrics_port == 0:
+        log.warning("--supervise with --metrics_port 0 (ephemeral): "
+                    "the supervisor cannot poll an unknown port — "
+                    "wedged-but-alive children will not be detected")
+    policy = {"503": "none", "restart": "restart",
+              "drain": "drain"}[args.on_wedged]
+    log.info("supervising: %s (health=%s, on_wedged=%s)",
+             " ".join(cmd), health or "process-exit only", policy)
+    sup = Supervisor(lambda: subprocess.Popen(cmd),
+                     name=args.node_id, health_url=health,
+                     on_wedged=policy,
+                     health_interval_s=2.0, health_timeout_s=3.0,
+                     ready_deadline_s=180.0)
+    sup.start()
+    try:
+        while True:
+            if sup.state == "crashloop":
+                log.error("child crash-looped; giving up (see "
+                          "crash_loop flight event)")
+                return 1
+            _time.sleep(1.0)
+    except KeyboardInterrupt:
+        log.info("supervisor shutting down")
+        sup.stop()
+        return 0
 
 
 def _kv_dtype_arg(name):
@@ -650,8 +771,9 @@ def _serve_lm(engine: PipelineEngine, args) -> int:
             target=args.slo_target
             if args.slo_target is not None else 0.99)
     try:
-        asyncio.run(serve_lm(
+        rc = asyncio.run(serve_lm(
             cfg, prepared, port=me.port, slots=args.slots, slo=slo,
+            on_wedged=args.on_wedged,
             **spec_kwargs,
             max_len=args.max_len, prompt_pad=args.prompt_pad,
             temperature=args.temperature, top_k=args.top_k,
@@ -676,10 +798,13 @@ def _serve_lm(engine: PipelineEngine, args) -> int:
         ))
     except KeyboardInterrupt:
         log.info("shutting down")
+        return 0
     except Exception as e:  # noqa: BLE001 — CLI boundary (bind failures etc.)
         log.error("LM serve failed: %s", e)
         return 1
-    return 0
+    # EXIT_RESTART (43) from a wedged-policy escalation rides through to
+    # the supervisor; 0 is a clean (drained) shutdown
+    return rc or 0
 
 
 def _generate_local(engine: PipelineEngine, args) -> int:

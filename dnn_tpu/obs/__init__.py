@@ -139,7 +139,8 @@ def install_compile_telemetry() -> bool:
 
 
 def serve_metrics(port: int = 0, host: str = "127.0.0.1", *,
-                  healthy=None, status=None, profiler=None, fleet=None):
+                  healthy=None, status=None, profiler=None, fleet=None,
+                  drain=None):
     """Start the observability HTTP endpoint on a daemon thread; returns
     the MetricsHTTPServer (`.port` for port=0 ephemeral binds,
     `.close()` to stop; loopback by default — pass host="0.0.0.0" to
@@ -153,7 +154,9 @@ def serve_metrics(port: int = 0, host: str = "127.0.0.1", *,
     obs.profile.Profiler (pass one to enable auto-trigger arming, or
     False to disable /profilez). `fleet` (an obs.fleet.FleetCollector)
     additionally serves the merged fleet view on /fleetz (JSON;
-    ?format=prom|trace|report). See obs/http.py."""
+    ?format=prom|trace|report). `drain` (callable -> dict) enables
+    POST /drainz — connection draining (runtime/lm_server.LMServer
+    passes its handler). See obs/http.py."""
     from dnn_tpu.obs.http import MetricsHTTPServer
     from dnn_tpu.obs.mem import install_memory_gauges
 
@@ -164,4 +167,4 @@ def serve_metrics(port: int = 0, host: str = "127.0.0.1", *,
         profiler = Profiler()
     return MetricsHTTPServer(port=port, host=host, healthy=healthy,
                              status=status, profiler=profiler or None,
-                             fleet=fleet)
+                             fleet=fleet, drain=drain)

@@ -63,11 +63,16 @@ __all__ = [
 
 # health ranking for the worst-of rollup; "unreachable" sits between
 # degraded and wedged: the stage may be mid-restart (don't page as hard
-# as a confirmed-wedged chip) but the pipeline through it IS down
-_STATE_RANK = {"ok": 0, "degraded": 1, "unreachable": 2, "wedged": 3}
+# as a confirmed-wedged chip) but the pipeline through it IS down.
+# "draining" (ISSUE 8: a stage whose admission is closed while
+# in-flight work finishes) ranks with degraded — route around it, but
+# nothing is broken
+_STATE_RANK = {"ok": 0, "degraded": 1, "draining": 1, "unreachable": 2,
+               "wedged": 3}
 # map a fleet state onto the watchdog's three-valued vocabulary so the
 # existing /healthz handler (503 on "wedged") serves the fleet too
 _STATE_AS_WATCHDOG = {"ok": "ok", "degraded": "degraded",
+                      "draining": "degraded",
                       "unreachable": "wedged", "wedged": "wedged"}
 
 

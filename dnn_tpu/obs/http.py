@@ -40,6 +40,11 @@ Prometheus scraper or a plain curl can watch the serving stack:
     POST /profilez?auto=1&threshold_ms=T[&ms=N]   arm the auto trigger:
                        capture the next decode step after one exceeds
                        T ms (LM daemon only); ?auto=0 disarms
+    POST /drainz       connection draining (LM daemon): stop admission,
+                       finish in-flight decodes, hand queued work back
+                       retriable, then exit — 202 + drain state JSON;
+                       idempotent. /healthz reads 503 "draining" while
+                       it runs (runtime/lm_server.LMServer.drain)
 """
 
 from __future__ import annotations
@@ -53,7 +58,8 @@ from urllib.parse import parse_qs, urlparse
 
 log = logging.getLogger("dnn_tpu.obs")
 
-_STATE_GAUGE = {"ok": 0.0, "degraded": 1.0, "wedged": 2.0}
+_STATE_GAUGE = {"ok": 0.0, "degraded": 1.0, "draining": 1.0,
+                "wedged": 2.0}
 
 
 def _status_prom(status: dict) -> str:
@@ -92,7 +98,8 @@ class MetricsHTTPServer:
                  registry=None, collector=None,
                  healthy: Optional[Callable[[], bool]] = None,
                  status: Optional[Callable[[], dict]] = None,
-                 profiler=None, flight=None, fleet=None):
+                 profiler=None, flight=None, fleet=None,
+                 drain: Optional[Callable[[], dict]] = None):
         from dnn_tpu import obs
         from dnn_tpu.obs import flight as _flight
         from dnn_tpu.utils import metrics as _metrics
@@ -111,6 +118,9 @@ class MetricsHTTPServer:
         # rollup also becomes /statusz + /healthz (503 on a wedged or
         # unreachable stage — the fleet endpoint's health IS the fleet's)
         self._fleet = fleet
+        # POST /drainz (connection draining, ISSUE 8): the serving
+        # process's drain kicker — idempotent, returns drain state
+        self._drain = drain
         if fleet is not None and status is None:
             self._status = fleet.status
         outer = self
@@ -152,7 +162,10 @@ class MetricsHTTPServer:
                                "text/plain; charset=utf-8")
                     return
                 state = self._statusz()["state"]
-                self._send(503 if state == "wedged" else 200,
+                # draining is 503 too: a load balancer must stop
+                # routing here while in-flight decodes finish
+                self._send(503 if state in ("wedged", "draining")
+                           else 200,
                            state + "\n", "text/plain; charset=utf-8")
 
             def _fleetz(self, q):
@@ -271,6 +284,15 @@ class MetricsHTTPServer:
                 try:
                     url = urlparse(self.path)
                     q = parse_qs(url.query)
+                    if url.path == "/drainz":
+                        if outer._drain is None:
+                            self._send(404, "no drain handler attached "
+                                       "(stage servers drain via their "
+                                       "supervisor)\n",
+                                       "text/plain; charset=utf-8")
+                            return
+                        self._send_json(202, outer._drain())
+                        return
                     if url.path != "/profilez":
                         self._send(404, "not found\n",
                                    "text/plain; charset=utf-8")

@@ -97,6 +97,23 @@ class Watchdog:
 
     alive_check: optional callable -> bool for the serving worker
     thread; False -> wedged (the work loop is gone).
+
+    on_wedged: optional callable(detail) fired ONCE per wedged EPISODE
+    (latched while the state stays wedged, re-armed when it recovers) —
+    the escalation hook `--on_wedged restart|drain` wires to the
+    supervisor/drain path (runtime/lm_server.py). Fired from the
+    watchdog thread AFTER the state flip, so /statusz already reads
+    wedged when the policy runs; exceptions are swallowed-but-logged
+    (a broken policy must not kill the detector). The first-step
+    warm-up grace rules are unchanged — a cold chip's compile still
+    reads degraded, so the policy can never evict a healthy warming
+    server.
+
+    Chaos hook (dnn_tpu/chaos): when a fault plan with an active
+    `wedge_device` window is installed in this process, the probe
+    round reports that injected wedge (timed_out=True semantics)
+    WITHOUT touching any device — the injection exercises exactly the
+    classification + escalation path a real wedge would.
     """
 
     def __init__(self, *, period_s: float = 30.0,
@@ -104,12 +121,15 @@ class Watchdog:
                  device_probe: "Optional[Callable]" = subprocess_device_probe,
                  heartbeat_stale_s: float = 120.0,
                  alive_check: Optional[Callable[[], bool]] = None,
+                 on_wedged: Optional[Callable[[str], None]] = None,
                  registry=None):
         self.period_s = float(period_s)
         self.probe_deadline_s = float(probe_deadline_s)
         self.device_probe = device_probe
         self.heartbeat_stale_s = float(heartbeat_stale_s)
         self.alive_check = alive_check
+        self.on_wedged = on_wedged
+        self._wedged_latched = False
         self._lock = threading.Lock()
         self._components: dict = {}
         self._t_beat: Optional[float] = None
@@ -212,6 +232,15 @@ class Watchdog:
         which bounds itself): a stubbed/in-process probe that hangs
         leaks exactly one daemon thread and reads as a timeout — and no
         new probe is spawned while the stuck one lives."""
+        from dnn_tpu.chaos import inject as _chaos_inject
+
+        injected = _chaos_inject.wedge_detail()
+        if injected is not None:
+            # chaos wedge_device window: the probe result IS the
+            # injection (structural timed_out semantics) — no device
+            # touched, same classification path as a real hang
+            self._set_component("device", "wedged", injected)
+            return
         if self._probe_thread is not None and self._probe_thread.is_alive():
             self._set_component(
                 "device", "wedged",
@@ -254,11 +283,35 @@ class Watchdog:
             # an in-process hang is caught by the join deadline above)
             self._set_component("device", "degraded", detail)
 
+    def _fire_escalation(self):
+        """Once-per-episode wedged escalation: latched while wedged,
+        re-armed on recovery. Runs AFTER the component flip, so the
+        policy sees consistent /statusz state."""
+        if self.state() == "wedged":
+            if not self._wedged_latched:
+                self._wedged_latched = True
+                cb = self.on_wedged
+                if cb is not None:
+                    detail = "; ".join(
+                        f"{k}: {v['detail']}"
+                        for k, v in self.status()["components"].items()
+                        if v["state"] == "wedged")
+                    try:
+                        cb(detail)
+                    except Exception:  # noqa: BLE001 — a broken policy
+                        import logging
+
+                        logging.getLogger("dnn_tpu.obs").exception(
+                            "on_wedged escalation hook failed")
+        else:
+            self._wedged_latched = False
+
     def _run(self):
         while not self._stop.is_set():
             if self.device_probe is not None:
                 self._run_probe()
             self._check_heartbeat()
+            self._fire_escalation()
             # first round runs immediately (a wedged chip must be
             # reported within ONE period of startup), then period cadence
             self._stop.wait(self.period_s)

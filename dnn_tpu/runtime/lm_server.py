@@ -40,6 +40,7 @@ import grpc
 import numpy as np
 
 from dnn_tpu import obs
+from dnn_tpu.chaos import inject as _chaos_inject
 from dnn_tpu.comm import transport as _tx
 from dnn_tpu.comm import wire_pb2 as pb
 from dnn_tpu.comm import wirecodec as wc
@@ -54,7 +55,21 @@ from dnn_tpu.runtime.serving import ContinuousBatcher
 log = logging.getLogger("dnn_tpu.lm_server")
 
 __all__ = ["LMServer", "serve_lm", "start_lm_server_in_background",
-           "parse_gen_options"]
+           "parse_gen_options", "DrainingError", "EXIT_RESTART"]
+
+#: exit code serve_lm returns when a wedged-policy escalation asked the
+#: SUPERVISOR (node --supervise / chaos.supervisor) to restart this
+#: process — distinct from crash (nonzero) and clean shutdown (0) so an
+#: operator reading the supervisor log can tell policy from accident
+EXIT_RESTART = 43
+
+
+class DrainingError(RuntimeError):
+    """A request rejected because the server is DRAINING: admission is
+    closed, in-flight decodes are finishing, and this request should be
+    retried against another replica. Maps to gRPC UNAVAILABLE — which
+    the edge client's existing retry ladder already treats as
+    retriable — so queued work is handed BACK, never lost."""
 
 
 def parse_gen_options(request_id: str, default_max_new: int):
@@ -88,6 +103,10 @@ def parse_gen_options(request_id: str, default_max_new: int):
              "p": ("top_p", float), "a": ("adapter", int),
              "m": ("min_p", float), "r": ("repetition_penalty", float),
              "b": ("logit_bias", _parse_bias),
+             # exactly-once guard: admission dedups on this opaque key
+             # (LMServer._dedup) so a client retry after a drain or a
+             # worker-death requeue can never run the generation twice
+             "d": ("dedup", str),
              # JSON mode: constrain the completion to a JSON value nested
              # up to DEPTH levels (runtime/constrain.json_regex); resolved
              # to a compiled TokenConstraint in LMServer._preflight
@@ -139,6 +158,7 @@ class _QueuedRequest(NamedTuple):
     trace: Any
     t_q: float  # perf_counter at enqueue — the queue-wait clock
     fut: Any
+    attempts: int = 0  # worker-death requeues consumed (retry budget)
 
 
 class _BatcherWorker(threading.Thread):
@@ -163,6 +183,12 @@ class _BatcherWorker(threading.Thread):
         self.q: "queue.Queue" = queue.Queue()
         self._stop_evt = threading.Event()
         self._abandon = False
+        self._draining = False
+        # worker-death hook (LMServer._on_worker_death): when set, a
+        # step crash hands the surviving work (in-flight + queued
+        # items) to the owner for requeue-or-fail instead of failing
+        # everything — the recovery half of the `worker_died` event
+        self.on_death = None
         # watchdog heartbeat (obs/watchdog.py): LMServer points this at
         # Watchdog.beat — one None check per loop iteration when off.
         # step_done -> Watchdog.step_done: until the first completed
@@ -210,6 +236,15 @@ class _BatcherWorker(threading.Thread):
 
         fut = concurrent.futures.Future()
         with self._lock:
+            if self._draining and self._dead is None:
+                # admission is CLOSED but the pool is still finishing:
+                # hand the request straight back with the retriable
+                # draining status (never enqueue work the drain exit
+                # would have to fail later anyway)
+                fut.set_exception(DrainingError(
+                    "LM server draining: admission closed; retry "
+                    "against another replica"))
+                return fut
             if self._dead is not None:
                 fut.set_exception(self._dead)
                 if (g := self.goodput) is not None:
@@ -226,6 +261,51 @@ class _BatcherWorker(threading.Thread):
                 # every scrape instead
                 m.set_fn("serving.queue_depth", self.q.qsize)
         return fut
+
+    def _resubmit(self, item: _QueuedRequest) -> bool:
+        """Requeue a surviving item from a DEAD predecessor worker,
+        preserving its future / queue clock / attempt count. False when
+        this worker is itself already dead (the caller then fails the
+        item's future)."""
+        with self._lock:
+            if self._dead is not None or self._draining:
+                return False
+            self.q.put(item)
+        return True
+
+    def begin_drain(self):
+        """Connection-draining entry: stop admission NOW, finish
+        in-flight decodes, hand queued-but-unadmitted work back with
+        the retriable draining status, then exit the thread. The run
+        loop notices `_draining` at its next iteration; submit() starts
+        rejecting immediately."""
+        with self._lock:
+            self._draining = True
+        self._stop_evt.set()  # wake a worker parked in q.get(timeout)
+        obs.flight.record("drain_begin", queued=self.q.qsize(),
+                          active=self.batcher.n_active)
+
+    def _drain_handback(self):
+        """Fail every queued (never-admitted) item with the RETRIABLE
+        draining error — the hand-back half of draining. Held-back
+        items never prefilled, so they hand back too."""
+        exc = DrainingError(
+            "LM server draining: request was queued but not admitted; "
+            "retry against another replica")
+        n = 0
+        with self._lock:
+            if self._held is not None:
+                held, self._held = self._held, None
+                _fail_future(held.fut, exc)
+                n += 1
+            while True:
+                try:
+                    _fail_future(self.q.get_nowait().fut, exc)
+                    n += 1
+                except queue.Empty:
+                    break
+        if n:
+            obs.flight.record("drain_handback", requests=n)
 
     def stop(self, *, drain: bool = True):
         """Signal shutdown. drain=True: the loop exits once the pool and
@@ -267,6 +347,11 @@ class _BatcherWorker(threading.Thread):
             return True
         wait = time.perf_counter() - item.t_q
         try:
+            if _chaos_inject.kv_exhaust():
+                # injected pool exhaustion (dnn_tpu/chaos): exercises
+                # the held-back path below exactly as a real full pool
+                raise InsufficientBlocks(
+                    "chaos: injected KV pool exhaustion")
             rid = self.batcher.submit(item.prompt, item.max_new,
                                       seed=item.seed, trace=item.trace,
                                       **(item.opts or {}))
@@ -307,7 +392,12 @@ class _BatcherWorker(threading.Thread):
             obs.record_span("queue_wait", item.t_q, wait,
                             parent=item.trace)
         self._futures[rid] = {"fut": item.fut, "on_token": item.on_token,
-                              "cancel_evt": item.cancel_evt}
+                              "cancel_evt": item.cancel_evt,
+                              # the original submission, kept so a
+                              # worker death can requeue it (attempts
+                              # bounds the retries; lm_server
+                              # _on_worker_death)
+                              "item": item}
         if item.on_token is not None:
             # the first token samples during prefill (batcher.submit)
             first = self.batcher.first_token(rid)
@@ -377,6 +467,31 @@ class _BatcherWorker(threading.Thread):
                 except queue.Empty:
                     return
 
+    def _collect_for_requeue(self):
+        """Death-path collection for the requeue hook: mark this worker
+        dead (so racing submits fail fast) and hand over the surviving
+        work — [(rid, item)] for admitted-but-unfinished requests,
+        [item] for queued/held ones. The futures stay UNRESOLVED; the
+        hook owns their fate (requeue into a successor worker, or
+        fail)."""
+        with self._lock:
+            if self._dead is None:
+                self._dead = RuntimeError("LM batcher worker died")
+            inflight = [(rid, rec["item"])
+                        for rid, rec in self._futures.items()
+                        if rec.get("item") is not None]
+            self._futures.clear()
+            queued = []
+            if self._held is not None:
+                queued.append(self._held)
+                self._held = None
+            while True:
+                try:
+                    queued.append(self.q.get_nowait())
+                except queue.Empty:
+                    break
+        return inflight, queued
+
     def _fail_all(self, exc):
         with self._lock:
             self._dead = exc  # submits from here on fail immediately
@@ -407,6 +522,8 @@ class _BatcherWorker(threading.Thread):
         (the steady state) this is one None check around b.step().
         Armed, each step is timed; the step AFTER the first breach runs
         inside a jax.profiler capture (obs/profile.py) and disarms."""
+        _chaos_inject.step_fault()  # injected device fault: raises at
+        # the scheduled step counter -> the ordinary worker-death path
         ap = self.auto_profile
         if ap is None:
             self._profile_hit = False
@@ -447,7 +564,19 @@ class _BatcherWorker(threading.Thread):
                         held.fut.cancel()
                 return
             self._process_cancels()  # step boundary: free cancelled slots
-            if b.n_active == 0 and self.q.empty() and self._held is None:
+            if self._draining:
+                # connection draining: queued work handed back
+                # retriable, in-flight decodes stepped to completion
+                # below, then a clean exit (submit already rejects)
+                self._drain_handback()
+                if b.n_active == 0:
+                    with self._lock:
+                        if self._dead is None:
+                            self._dead = DrainingError(
+                                "LM server drained and exited")
+                    obs.flight.record("drain_done")
+                    return
+            elif b.n_active == 0 and self.q.empty() and self._held is None:
                 if self._stop_evt.is_set():
                     self._shutdown_drain_queue()
                     return
@@ -468,7 +597,7 @@ class _BatcherWorker(threading.Thread):
                     self._admit(self.q.get(timeout=0.1))
                 except queue.Empty:
                     continue
-            while b.free_slots():
+            while not self._draining and b.free_slots():
                 if self._held is not None:
                     # retry the held-back request before new work; still
                     # short on blocks -> keep holding, stop admitting
@@ -485,13 +614,37 @@ class _BatcherWorker(threading.Thread):
             try:
                 stepped = self._step_pool(b) if had_active else {}
             except Exception as e:  # noqa: BLE001 — one device-side error
-                # must not leave callers hanging for request_timeout: fail
-                # every pending future fast and die visibly (HealthCheck
-                # reports not-alive; SendTensor aborts UNAVAILABLE)
+                # must not leave callers hanging for request_timeout:
+                # either hand the surviving work to the owner's
+                # requeue-or-fail hook (LMServer._on_worker_death spawns
+                # a successor worker), or fail every pending future fast
+                # and die visibly (HealthCheck reports not-alive;
+                # SendTensor aborts UNAVAILABLE)
+                handler = self.on_death
+                obs.flight.record("worker_died", error=str(e)[:500],
+                                  pending=len(self._futures),
+                                  requeue=handler is not None)
+                if handler is not None:
+                    log.exception("batcher worker died; handing %d "
+                                  "in-flight + queued requests to the "
+                                  "requeue hook", len(self._futures))
+                    inflight, queued = self._collect_for_requeue()
+                    try:
+                        handler(e, inflight, queued)
+                        return
+                    except Exception:  # noqa: BLE001 — a broken hook
+                        # must not strand the collected futures
+                        log.exception("worker-death requeue hook failed;"
+                                      " failing survivors")
+                        exc = RuntimeError(
+                            f"LM batcher worker died: {e}")
+                        for _rid, it in inflight:
+                            _fail_future(it.fut, exc)
+                        for it in queued:
+                            _fail_future(it.fut, exc)
+                        return
                 log.exception("batcher worker died; failing %d pending "
                               "requests", len(self._futures))
-                obs.flight.record("worker_died", error=str(e)[:500],
-                                  pending=len(self._futures))
                 self._fail_all(RuntimeError(f"LM batcher worker died: {e}"))
                 return
             if had_active and (sd := self.step_done) is not None:
@@ -547,7 +700,32 @@ class LMServer:
                  metrics_port: Optional[int] = None,
                  watchdog=None,
                  goodput=None, slo=None,
+                 on_wedged: str = "503",
+                 worker_restarts: int = 2,
+                 max_request_retries: int = 1,
+                 drain_grace_s: float = 30.0,
                  **batcher_kwargs):
+        # resilience state (ISSUE 8) before anything that can serve a
+        # request or a scrape: drain flag, wedged-policy escalation
+        # latch, admission dedup, worker-restart bookkeeping
+        if on_wedged not in ("503", "restart", "drain"):
+            raise ValueError(
+                f"on_wedged must be 503|restart|drain, got {on_wedged!r}")
+        self.on_wedged = on_wedged
+        self.worker_restarts = int(worker_restarts)
+        self.max_request_retries = int(max_request_retries)
+        self.drain_grace_s = float(drain_grace_s)
+        self._draining = False
+        self._drain_thread = None
+        self._drain_lock = threading.Lock()
+        self._escalated = threading.Event()
+        self._escalate_reason: Optional[str] = None
+        self._restart_lock = threading.Lock()
+        self._restart_times: list = []
+        self._restart_window_s = 300.0
+        self._dedup_lock = threading.Lock()
+        self._dedup: "dict" = {}   # key -> worker future (insertion-ordered)
+        self._DEDUP_CAP = 512
         # observability first: the compile listener must be live before
         # the batcher's first program compiles, so jax_compilations_total
         # counts the daemon's own warmup too (dnn_tpu/obs)
@@ -571,9 +749,10 @@ class LMServer:
             self.metrics_server = obs.serve_metrics(
                 metrics_port,
                 healthy=lambda: (w := getattr(self, "worker", None))
-                is not None and w.is_alive(),
+                is not None and w.is_alive() and not self._draining,
                 status=self._statusz,
-                profiler=Profiler(arm_target=self))
+                profiler=Profiler(arm_target=self),
+                drain=self._drainz)
         try:
             self._init_rest(
                 cfg, prepared, default_max_new=default_max_new,
@@ -619,9 +798,19 @@ class LMServer:
                         subprocess_device_probe,
                         platform=jax.default_backend()))
             if self._watchdog.alive_check is None:
-                self._watchdog.alive_check = self.worker.is_alive
+                # a LAMBDA over self.worker, not a bound method: the
+                # worker-death requeue path swaps in a successor worker,
+                # and a stale bound is_alive would read the corpse
+                self._watchdog.alive_check = \
+                    lambda: self.worker.is_alive()
             self.worker.heartbeat = self._watchdog.beat
             self.worker.step_done = self._watchdog.step_done
+            if self.on_wedged != "503":
+                # wedged is a POLICY now, not just a 503: the watchdog's
+                # once-per-episode escalation hook fires the restart /
+                # drain path (warm-up grace preserved — the watchdog
+                # never reports wedged before the first completed step)
+                self._watchdog.on_wedged = self._wedged_escalate
             if not self._watchdog._thread.is_alive():
                 self._watchdog.start()
         # live goodput accounting (obs/goodput.py): dnn_tpu_mfu /
@@ -672,9 +861,160 @@ class LMServer:
     def _statusz(self):
         """The /statusz payload: watchdog state when one runs, else None
         — the HTTP handler then falls back to its worker-liveness shape
-        (one fallback, not two drifting copies; obs/http.py)."""
-        return self._watchdog.status() if self._watchdog is not None \
+        (one fallback, not two drifting copies; obs/http.py). A DRAINING
+        server overlays the `draining` state (unless already wedged) so
+        routers/fleet collectors stop sending it work while in-flight
+        decodes finish."""
+        s = self._watchdog.status() if self._watchdog is not None \
             else None
+        if not self._draining:
+            return s
+        s = dict(s) if s is not None else {"state": "ok", "components": {}}
+        comps = dict(s.get("components") or {})
+        comps["drain"] = {"state": "draining",
+                          "detail": "admission closed; finishing "
+                                    "in-flight decodes"}
+        s["components"] = comps
+        if s.get("state") != "wedged":
+            s["state"] = "draining"
+        return s
+
+    # -- resilience: drain / requeue / wedged policy (ISSUE 8) ----------
+
+    def _wedged_escalate(self, detail: str):
+        """Watchdog wedged-episode hook (once per episode; obs/
+        watchdog.py): turn the passive 503 into the configured policy.
+        `restart` exits fast so the process supervisor relaunches from
+        the latest checkpoint; `drain` finishes in-flight work first
+        (on a wedged DEVICE that usually can't finish — the drain grace
+        bounds the wait)."""
+        obs.flight.record("wedged_policy", policy=self.on_wedged,
+                          detail=str(detail)[:300])
+        if self.on_wedged == "drain":
+            self._drainz()
+            # the drain thread sets _escalated when done (or grace out)
+        else:
+            self._escalate(f"wedged: {detail}")
+
+    def _escalate(self, reason: str):
+        self._escalate_reason = reason
+        self._escalated.set()
+
+    def drain(self, grace_s: Optional[float] = None) -> dict:
+        """Connection draining, blocking: stop admission (preflight
+        rejects with UNAVAILABLE "draining" — retriable by the existing
+        client ladder), let in-flight decodes finish, hand queued work
+        back, then the worker exits. Returns a status dict; bounded by
+        `grace_s` (default drain_grace_s) — in-flight work still
+        running at the deadline is abandoned (futures cancel) so a
+        wedged decode cannot hold the drain open forever."""
+        grace = self.drain_grace_s if grace_s is None else float(grace_s)
+        self._draining = True
+        self.worker.begin_drain()
+        self.worker.join(timeout=grace)
+        clean = not self.worker.is_alive()
+        if not clean:
+            # grace expired with decodes still in flight: abandon them
+            # (the supervisor is about to restart us anyway)
+            self.worker.stop(drain=False)
+            self.worker.join(timeout=5)
+        obs.flight.record("drain_exit", clean=clean,
+                          grace_s=round(grace, 3))
+        return {"drained": True, "clean": clean}
+
+    def _drainz(self) -> dict:
+        """POST /drainz handler (and the wedged drain policy's entry):
+        kick a background drain once; report current drain state.
+        Idempotent — repeated POSTs watch the same drain."""
+        with self._drain_lock:
+            if self._drain_thread is None:
+                def _run():
+                    self.drain()
+                    self._escalate("drained")
+
+                obs.flight.record("drainz", source="http_or_policy")
+                self._drain_thread = threading.Thread(
+                    target=_run, daemon=True, name="lm-drain")
+                self._draining = True  # reject admissions immediately
+                self._drain_thread.start()
+        return {"draining": True,
+                "active": self.batcher.n_active,
+                "queued": self.worker.q.qsize(),
+                "worker_alive": self.worker.is_alive()}
+
+    def _on_worker_death(self, exc, inflight, queued):
+        """The batcher worker died mid-step (device fault, injected or
+        real). Instead of failing every in-flight request permanently
+        (the pre-ISSUE-8 behavior), spawn a successor worker and
+        REQUEUE the idempotent survivors: unary requests with retry
+        budget left (`attempts` < max_request_retries) and deadline
+        remaining. Streaming requests (tokens already delivered) and
+        budget-exhausted ones fail fast. Restarts are bounded —
+        `worker_restarts` within a 5-minute window — so a hard-broken
+        device degrades to the old fail-fast shape instead of a
+        requeue loop."""
+        now = time.perf_counter()
+        with self._restart_lock:
+            self._restart_times = [
+                t for t in self._restart_times
+                if now - t <= self._restart_window_s]
+            can_restart = (len(self._restart_times) < self.worker_restarts
+                           and not self._draining)
+            if can_restart:
+                self._restart_times.append(now)
+        items = [(rid, it) for rid, it in inflight] \
+            + [(None, it) for it in queued]
+        fail_exc = RuntimeError(f"LM batcher worker died: {exc}")
+        if not can_restart:
+            obs.flight.record("worker_restart_exhausted",
+                              window_s=self._restart_window_s,
+                              budget=self.worker_restarts,
+                              failed=len(items))
+            for _rid, it in items:
+                _fail_future(it.fut, fail_exc)
+            if (g := self.goodput) is not None:
+                for _ in items:
+                    g.on_outcome(False)
+            return
+        # retire the dead requests' slots host-side: prefill overwrites
+        # device state, so the successor serves from a clean pool
+        for rid, _it in inflight:
+            try:
+                if self.batcher.cancel(rid):
+                    self.batcher.claim(rid)
+            except Exception:  # noqa: BLE001 — slot already retired
+                pass
+        new_worker = self._spawn_worker()
+        if self.goodput is not None:
+            new_worker.goodput = self.goodput
+        old = self.worker
+        new_worker.heartbeat = old.heartbeat
+        new_worker.step_done = old.step_done
+        self.worker = new_worker
+        new_worker.start()
+        requeued = failed = 0
+        for _rid, it in items:
+            ok = (it.on_token is None
+                  and (it.cancel_evt is None or not it.cancel_evt.is_set())
+                  and it.attempts < self.max_request_retries
+                  and now - it.t_q < self.request_timeout)
+            if ok:
+                ok = new_worker._resubmit(
+                    it._replace(attempts=it.attempts + 1))
+            if ok:
+                requeued += 1
+            else:
+                failed += 1
+                _fail_future(it.fut, fail_exc)
+                if (g := self.goodput) is not None:
+                    g.on_outcome(False)
+        obs.flight.record("worker_restart",
+                          restarts=len(self._restart_times),
+                          requeued=requeued, failed=failed,
+                          error=str(exc)[:300])
+        log.warning("batcher worker restarted after death (%s): "
+                    "%d requests requeued, %d failed", exc, requeued,
+                    failed)
 
     def _init_rest(self, cfg, prepared, *, default_max_new,
                    request_timeout, tokenizer, draft_cfg, draft_prepared,
@@ -725,15 +1065,27 @@ class LMServer:
         # check+clear is atomic under it), closing the race where an
         # embed enters its program between the check and the clear.
         self._embed_inflight = 0
-        self.worker = _BatcherWorker(
-            self.batcher, compile_cache_budget=compile_cache_budget)
+        self._compile_cache_budget = compile_cache_budget
+        self.worker = self._spawn_worker()
+        self.worker.start()
+
+    def _spawn_worker(self) -> _BatcherWorker:
+        """Build a batcher worker wired to this server — used at
+        construction AND by the worker-death restart path, so a
+        successor worker can never drift behind the original's hooks
+        (cache-guard registrations, requeue hook)."""
+        worker = _BatcherWorker(
+            self.batcher,
+            compile_cache_budget=self._compile_cache_budget)
         # lazily-created program families count toward the compile budget
         # (snapshot copy: the guard runs on the worker thread)
-        self.worker.cache_guard.register(
+        worker.cache_guard.register(
             lambda: list(self._embed_fns.values()))
-        self.worker.cache_guard.add_busy_check(
+        worker.cache_guard.add_busy_check(
             lambda: self._embed_inflight > 0)
-        self.worker.start()
+        if self.worker_restarts > 0:
+            worker.on_death = self._on_worker_death
+        return worker
 
     _MAX_JSON_DEPTH = 3  # regex expansion grows with depth; bound it
 
@@ -782,8 +1134,16 @@ class LMServer:
     # --- RPC implementations (names/signatures fixed by the protocol) ---
 
     async def _preflight(self, request_id: str, context):
-        """Shared request preflight for both RPC fronts: worker liveness
-        plus option parsing — one place, one status mapping."""
+        """Shared request preflight for both RPC fronts: drain gate,
+        worker liveness, option parsing — one place, one status
+        mapping. A draining server rejects with UNAVAILABLE — the
+        retriable status the edge client's ladder honors — so admission
+        stops without losing anything."""
+        if self._draining:
+            await context.abort(
+                grpc.StatusCode.UNAVAILABLE,
+                "draining: admission closed; retry against another "
+                "replica")
         if not self.worker.is_alive():
             await context.abort(
                 grpc.StatusCode.UNAVAILABLE,
@@ -841,19 +1201,54 @@ class LMServer:
         try:
             max_new, seed, opts = await self._preflight(request_id,
                                                         context)
+            # propagated deadline (dl= segment, comm/transport.py): the
+            # caller's REMAINING budget caps the server-side wait, so a
+            # nearly-dead request can't hold a slot for the full local
+            # request_timeout after its client already gave up
+            inbound_dl = _tx.extract_deadline(request_id)
+            timeout_s = self.request_timeout if inbound_dl is None \
+                else max(min(self.request_timeout, inbound_dl), 0.001)
+            dkey = opts.pop("dedup", None)
             root.set(max_new=max_new,
                      prompt_len=int(np.asarray(ids).size))
             # cancel_evt: a deadline abort must also retire the slot at
             # the next step boundary — without it the pool decodes on to
             # the abandoned request's full token budget
             cancel_evt = threading.Event()
-            fut = self.worker.submit(
-                np.asarray(ids, np.int32).reshape(-1), max_new, seed,
-                opts=opts, trace=root, cancel_evt=cancel_evt)
+            if dkey is not None:
+                # exactly-once admission: a retried dedup key JOINS the
+                # original request's future instead of generating twice
+                # (failed/cancelled entries are replaced — retrying
+                # after a real failure is the point of retrying)
+                with self._dedup_lock:
+                    cached = self._dedup.get(dkey)
+                    if cached is not None and not cached.cancelled() \
+                            and not (cached.done()
+                                     and cached.exception() is not None):
+                        fut = cached
+            joined = fut is not None
+            if joined:
+                obs.flight.record(
+                    "dedup_join", key=str(dkey)[:80],
+                    trace_id=root.trace_id if root else None)
+                root.set(dedup="join")
+            else:
+                fut = self.worker.submit(
+                    np.asarray(ids, np.int32).reshape(-1), max_new, seed,
+                    opts=opts, trace=root, cancel_evt=cancel_evt)
+                if dkey is not None:
+                    with self._dedup_lock:
+                        self._dedup[dkey] = fut
+                        while len(self._dedup) > self._DEDUP_CAP:
+                            self._dedup.pop(next(iter(self._dedup)))
             try:
+                # a JOINED wait is shielded: this caller timing out must
+                # abandon only its own wait, never cancel the original
+                # submitter's future out from under it
+                wrapped = asyncio.wrap_future(fut)
                 await asyncio.wait_for(
-                    asyncio.wrap_future(fut),
-                    timeout=self.request_timeout)
+                    asyncio.shield(wrapped) if joined else wrapped,
+                    timeout=timeout_s)
             except asyncio.TimeoutError:
                 cancel_evt.set()
                 m = obs.metrics()
@@ -867,11 +1262,11 @@ class LMServer:
                 # watchdog state flips) — the window a stall hides in
                 obs.flight.record(
                     "deadline_miss", method="SendTensor",
-                    timeout_s=self.request_timeout,
+                    timeout_s=timeout_s,
                     trace_id=root.trace_id if root else None)
                 await context.abort(
                     grpc.StatusCode.DEADLINE_EXCEEDED,
-                    f"generation exceeded {self.request_timeout}s")
+                    f"generation exceeded {timeout_s}s")
             except asyncio.CancelledError:
                 if not fut.cancelled():
                     raise  # client cancelled the RPC: grpc.aio handles it
@@ -964,9 +1359,11 @@ class LMServer:
     async def SendTensor(self, request: pb.TensorRequest, context) -> pb.TensorResponse:
         prompt = await self._validated_prompt(request, context)
         rid = request.request_id or ""
-        # a client-side trace tag (tr=...) may ride any request_id; it is
-        # transport metadata, not an option — strip before endpoint parse
-        rid_clean = obs.strip_wire_tag(rid)
+        # client-side transport metadata may ride any request_id — the
+        # trace tag (tr=...) and the propagated deadline (dl=...); both
+        # are stripped before endpoint parse (the deadline is honored
+        # inside _submit_and_await, which reads the RAW rid)
+        rid_clean = _tx.strip_deadline(obs.strip_wire_tag(rid))
         if rid_clean == "embed" or rid_clean.startswith("embed:"):
             # embedding endpoint: 'embed[:mean|last]' returns the pooled
             # final hidden state instead of generated tokens
@@ -1011,6 +1408,10 @@ class LMServer:
         try:
             max_new, seed, opts = await self._preflight(
                 request.request_id, context)
+            # streaming requests cannot dedup-join (tokens already
+            # stream to one consumer) — drop the key rather than let it
+            # reach batcher.submit as an unknown kwarg
+            opts.pop("dedup", None)
             root.set(max_new=max_new, prompt_len=int(prompt.size))
             loop = asyncio.get_running_loop()
             q: "asyncio.Queue" = asyncio.Queue()
@@ -1032,7 +1433,10 @@ class LMServer:
                 loop.call_soon_threadsafe(q.put_nowait, ("done", f))
 
             fut.add_done_callback(_done)
-            deadline = loop.time() + self.request_timeout
+            inbound_dl = _tx.extract_deadline(request.request_id)
+            timeout_s = self.request_timeout if inbound_dl is None \
+                else max(min(self.request_timeout, inbound_dl), 0.001)
+            deadline = loop.time() + timeout_s
             while True:
                 remaining = deadline - loop.time()
                 if remaining <= 0:
@@ -1042,11 +1446,11 @@ class LMServer:
                         m.inc("serving.deadline_exceeded_total")
                     obs.flight.record(
                         "deadline_miss", method="GenerateStream",
-                        timeout_s=self.request_timeout, tokens=n,
+                        timeout_s=timeout_s, tokens=n,
                         trace_id=root.trace_id if root else None)
                     await context.abort(
                         grpc.StatusCode.DEADLINE_EXCEEDED,
-                        f"generation exceeded {self.request_timeout}s")
+                        f"generation exceeded {timeout_s}s")
                 try:
                     kind, val = await asyncio.wait_for(q.get(), remaining)
                 except asyncio.TimeoutError:
@@ -1071,7 +1475,10 @@ class LMServer:
             root.end(tokens=n)
 
     async def HealthCheck(self, request: pb.Empty, context) -> pb.HealthCheckResponse:
-        return pb.HealthCheckResponse(is_healthy=self.worker.is_alive())
+        # a DRAINING server reports unhealthy so load balancers and
+        # wait_healthy pollers stop routing to it while it finishes
+        return pb.HealthCheckResponse(
+            is_healthy=self.worker.is_alive() and not self._draining)
 
     async def SendMessage(self, request: pb.MessageRequest, context) -> pb.MessageReply:
         """Text endpoint. "!stats" (or any text without a tokenizer)
@@ -1124,9 +1531,19 @@ class LMServer:
             self.metrics_server = None
 
 
-async def serve_lm(cfg, prepared, *, port: int, **server_kwargs):
+async def serve_lm(cfg, prepared, *, port: int, **server_kwargs) -> int:
     """Start the LM daemon and block until termination — the LM analog of
-    comm.service.serve_stage (reference serve(), node.py:114-133)."""
+    comm.service.serve_stage (reference serve(), node.py:114-133).
+
+    Resilience exits (ISSUE 8): SIGTERM triggers CONNECTION DRAINING —
+    admission closes (UNAVAILABLE "draining", retriable), in-flight
+    decodes finish within the drain grace, queued work hands back —
+    then the server exits cleanly (rc 0). A watchdog wedged-policy
+    escalation (`on_wedged=restart|drain`) exits with EXIT_RESTART (43)
+    so a supervisor (node --supervise / chaos.supervisor) relaunches
+    the process, restoring from the latest good checkpoint."""
+    import signal
+
     servicer = LMServer(cfg, prepared, **server_kwargs)
     server = grpc.aio.server()
     server.add_generic_rpc_handlers((_handlers(servicer),))
@@ -1136,10 +1553,62 @@ async def serve_lm(cfg, prepared, *, port: int, **server_kwargs):
     log.info("gRPC LM server listening on %s (%d slots)", listen,
              servicer.batcher.slots)
     await server.start()
+    loop = asyncio.get_running_loop()
+    sigterm_drained = False
+
+    def _on_sigterm():
+        nonlocal sigterm_drained
+        sigterm_drained = True
+        obs.flight.record("sigterm_drain")
+        log.info("SIGTERM: draining (admission closed, finishing "
+                 "in-flight decodes)")
+        servicer._drainz()  # background drain -> sets the escalation
+
     try:
-        await server.wait_for_termination()
+        loop.add_signal_handler(signal.SIGTERM, _on_sigterm)
+    except (NotImplementedError, ValueError, RuntimeError):
+        pass  # non-main thread / platform without signal support
+    async def _wait_escalated():
+        # bounded waits so cancellation never strands a thread parked
+        # in Event.wait() forever at shutdown
+        while not await asyncio.to_thread(servicer._escalated.wait, 1.0):
+            pass
+
+    esc_task = asyncio.ensure_future(_wait_escalated())
+    term_task = asyncio.ensure_future(server.wait_for_termination())
+    try:
+        await asyncio.wait({esc_task, term_task},
+                           return_when=asyncio.FIRST_COMPLETED)
+        if servicer._escalated.is_set():
+            reason = servicer._escalate_reason or "escalated"
+            log.warning("serve_lm exiting on escalation: %s", reason)
+            if servicer.on_wedged == "restart" and not sigterm_drained \
+                    and not reason.startswith("drained"):
+                # restart policy: no drain — the device is wedged and
+                # in-flight work cannot finish; the supervisor restarts
+                # us from the latest checkpoint
+                return EXIT_RESTART
+            return 0 if sigterm_drained else EXIT_RESTART
+        return 0
     finally:
-        await server.stop(grace=1)
+        # teardown ORDER matters: stop the server FIRST (which lets
+        # wait_for_termination complete on its own), THEN reap the
+        # watcher tasks — cancelling wait_for_termination while stop()
+        # runs makes grpc.aio surface CancelledError out of this
+        # finally, clobbering the escalation return code (the verify
+        # scenario caught exactly that as rc=1 instead of 43/0)
+        esc_task.cancel()
+        try:
+            await server.stop(grace=1)
+        except asyncio.CancelledError:
+            pass
+        for t in (esc_task, term_task):
+            if not t.done():
+                t.cancel()
+            try:
+                await t
+            except BaseException:  # noqa: BLE001 — reaped, not consulted
+                pass
         servicer.close()
 
 

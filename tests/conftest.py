@@ -93,6 +93,11 @@ _MODULE_COST_S = {
     # quantized byte accounting — certified inside the tier-1 budget
     "test_spec_buckets": 36.0,  # speculative x bucketed composition
     # parity (greedy + sampled, rung crossings, draft-pool lockstep)
+    "test_chaos": 42.0,  # ISSUE 8 chaos + self-healing: injection
+    # goldens, supervisor restart/backoff/crash-loop (tiny python -c
+    # children), requeue token parity, drain-under-load, circuit
+    # breaker, corrupted-checkpoint fallback — certified inside the
+    # tier-1 budget with the other serving-resilience modules
     "test_serving_spec": 53.1, "test_multilora": 57.9,
     "test_sliding_window": 58.0, "test_tp_pp": 59.9,
     "test_speculative": 62.4, "test_paged": 64.2,
