@@ -57,6 +57,15 @@ AVAILABILITY_FLOOR = 0.99   # handled (ok or explicit) / submitted
 TTFT_RATIO_CEIL = 10.0      # recovery-window p99 vs quiet p99
 POST_RECOVERY_WINDOW_S = 10.0
 RECOVERY_DEADLINE_S = 150.0  # per fault: child restart incl. jax import
+# loop-lag sanitizer bound (analysis/sanitize.py): the stage children
+# run with DNN_TPU_LOOP_SANITIZE=1 and this probe asserts, from each
+# surviving stage's served /debugz, that no event-loop callback held
+# the loop longer than this. The bound tolerates first-compile GIL
+# stalls on a loaded CI host; a reintroduced blocking-primitive wait
+# (the ShmRing.write deadlock held the loop its full 30 s timeout)
+# blows straight through it — the dynamic backstop for indirections
+# the CON001 AST rule can't see.
+LOOP_LAG_BOUND_MS = 5000.0
 
 # (grpc1, grpc2, metrics1, metrics2) — distinct from the relay probe's
 _PORTS = (59495, 59496, 59595, 59596)
@@ -92,6 +101,7 @@ def _spawner(tmpdir: str, cfg: dict, node_id: str, mport: int):
         f.write(_CHILD_SRC.format(repo=REPO, cfg=cfg, node_id=node_id,
                                   mport=mport))
     env = dict(os.environ, JAX_PLATFORMS="cpu",
+               DNN_TPU_LOOP_SANITIZE="1",
                PYTHONPATH=REPO + os.pathsep + os.environ.get(
                    "PYTHONPATH", ""))
     env.pop("XLA_FLAGS", None)
@@ -323,6 +333,22 @@ def measure(light: bool = False) -> dict:
             time.sleep(post_w + 1.0)
             run_s = time.monotonic() - t0
             gen.stop(join_timeout=req_timeout + 10.0)
+            # loop-lag readback BEFORE the supervisors stop their
+            # children: each surviving stage's /debugz is the artifact
+            # the sanitizer assertion reads (analysis/sanitize.py)
+            from dnn_tpu.analysis import sanitize as _sanitize
+
+            loop_lag = {}
+            for name, mp in (("node1", m1), ("node2", m2)):
+                try:
+                    loop_lag[name] = _sanitize.read_endpoint(
+                        f"http://127.0.0.1:{mp}")
+                except Exception as e:  # noqa: BLE001 — a stage mid-
+                    # restart at readback time fails the assertion
+                    # honestly rather than crashing the probe
+                    loop_lag[name] = {"installed": False,
+                                      "error": f"{type(e).__name__}: "
+                                               f"{e}"[:120]}
         finally:
             if gen is not None and not gen._stop.is_set():
                 gen.stop(join_timeout=5.0)
@@ -367,6 +393,12 @@ def measure(light: bool = False) -> dict:
                   if quiet_p99 and rec_p99 else float("inf"))
     ok_avail = availability >= AVAILABILITY_FLOOR and lost == 0
     ok_ttft = ttft_ratio <= TTFT_RATIO_CEIL
+    # sanitizer bound: every stage must PROVE the sanitizer ran
+    # (loop_sanitize_on in its ring — no vacuous pass) and show no
+    # loop stall past the bound
+    ok_loop = all(
+        ll.get("installed") and ll.get("max_lag_ms", 0.0)
+        <= LOOP_LAG_BOUND_MS for ll in loop_lag.values())
     slo_burn = (1.0 - availability) / (1.0 - AVAILABILITY_FLOOR) \
         if total else float("inf")
     import jax
@@ -388,10 +420,13 @@ def measure(light: bool = False) -> dict:
         "flight_dump": dump_path,
         "run_s": round(run_s, 1),
         "open_loop_hz": rate_hz,
-        "ok": bool(ok_avail and ok_ttft and paired),
+        "loop_lag": loop_lag,
+        "loop_lag_bound_ms": LOOP_LAG_BOUND_MS,
+        "ok": bool(ok_avail and ok_ttft and paired and ok_loop),
         "ok_availability": bool(ok_avail),
         "ok_ttft": bool(ok_ttft),
         "ok_paired": bool(paired),
+        "ok_loop_lag": bool(ok_loop),
         "platform": jax.default_backend(),
     }
 
@@ -419,7 +454,9 @@ def main(argv=None) -> int:
               f"(floor {AVAILABILITY_FLOOR}, lost="
               f"{row['silently_lost']}), ttft_ratio="
               f"{row['ttft_recovery_ratio']} (ceil {TTFT_RATIO_CEIL}), "
-              f"paired={row['events_paired']}", file=sys.stderr)
+              f"paired={row['events_paired']}, "
+              f"loop_lag={row['loop_lag']} (bound "
+              f"{LOOP_LAG_BOUND_MS:.0f} ms)", file=sys.stderr)
         return 1
     return 0
 

@@ -56,6 +56,12 @@ if REPO not in sys.path:
 HOP_RATIO_FLOOR = 5.0     # auto per-hop p50 must be <= grpc p50 / 5
 BUBBLE_DROP_FLOOR = 2.0   # auto stitched bubble must be <= grpc / 2
 S10_BUBBLE = 0.759        # STUDIES.md §10 recorded warm bubble (nested)
+# loop-lag sanitizer bound (analysis/sanitize.py): both legs' stage
+# children run with DNN_TPU_LOOP_SANITIZE=1; the probe reads each
+# stage's /debugz back and asserts no event-loop callback held the
+# loop past this. Sized above first-compile GIL stalls, far below the
+# ShmRing 30 s blocking-wait this exists to catch reintroductions of.
+LOOP_LAG_BOUND_MS = 5000.0
 
 # (grpc_port1, grpc_port2, metrics_port1, metrics_port2) per leg
 _PORTS = {"grpc": (59491, 59492, 59591, 59592),
@@ -93,6 +99,7 @@ def _spawn_stage(tmpdir: str, cfg: dict, node_id: str, mport: int,
         f.write(_CHILD_SRC.format(repo=REPO, cfg=cfg, node_id=node_id,
                                   mport=mport, pref=pref))
     env = dict(os.environ, JAX_PLATFORMS="cpu",
+               DNN_TPU_LOOP_SANITIZE="1",
                PYTHONPATH=REPO + os.pathsep + os.environ.get("PYTHONPATH",
                                                              ""))
     env.pop("XLA_FLAGS", None)
@@ -210,7 +217,22 @@ def _measure_leg(pref: str, tmpdir: str, n_unary: int, n_stream: int):
                                                       "nested")
         stream_p50, stream_p99, tr_s = _hop_quantiles(targets["node1"],
                                                       "streamed")
+        # loop-lag readback off each stage's /debugz while the children
+        # are still up — the sanitizer assertion reads the artifact. A
+        # stage dead at readback time fails the assertion honestly
+        # (installed=False) instead of crashing the probe.
+        from dnn_tpu.analysis import sanitize as _sanitize
+
+        loop_lag = {}
+        for name in ("node1", "node2"):
+            try:
+                loop_lag[name] = _sanitize.read_endpoint(targets[name])
+            except Exception as e:  # noqa: BLE001
+                loop_lag[name] = {"installed": False,
+                                  "error": f"{type(e).__name__}: "
+                                           f"{e}"[:120]}
         return {
+            "loop_lag": loop_lag,
             "negotiated": tr_s or tr_n or "grpc",
             "hop_nested_p50_ms": nested_p50,
             "hop_nested_p99_ms": nested_p99,
@@ -255,7 +277,17 @@ def measure(light: bool = False) -> dict:
     bubble_grpc = grpc_leg["bubble_fraction"]
     ok_hop = bool(hop_a and hop_b and hop_b <= hop_a / HOP_RATIO_FLOOR)
     ok_bubble = bool(bubble_auto <= bubble_grpc / BUBBLE_DROP_FLOOR)
+    # sanitizer bound over BOTH legs' stages: installed (no vacuous
+    # pass) and no loop stall past the bound — the in-run dynamic
+    # check for event-loop-blocking regressions (CON001's companion)
+    ok_loop = all(
+        ll.get("installed") and ll.get("max_lag_ms", 0.0)
+        <= LOOP_LAG_BOUND_MS
+        for leg in (grpc_leg, auto_leg)
+        for ll in leg.get("loop_lag", {}).values())
     return {
+        "loop_lag_bound_ms": LOOP_LAG_BOUND_MS,
+        "ok_loop_lag": ok_loop,
         "grpc": grpc_leg,
         "auto": auto_leg,
         "hop_p50_ratio": round(ratio, 2),
@@ -265,7 +297,7 @@ def measure(light: bool = False) -> dict:
                            "auto_bubble": bubble_auto,
                            "drop": round(S10_BUBBLE / bubble_auto, 2)
                            if bubble_auto else float("inf")},
-        "ok": bool(ok_hop and ok_bubble),
+        "ok": bool(ok_hop and ok_bubble and ok_loop),
         "ok_hop": ok_hop,
         "ok_bubble": ok_bubble,
         "platform": jax.default_backend(),
@@ -291,7 +323,9 @@ def main(argv=None) -> int:
     if args.do_assert and not row["ok"]:
         print(f"ASSERT FAILED: hop ratio {row['hop_p50_ratio']} "
               f"(floor {HOP_RATIO_FLOOR}), bubble drop "
-              f"{row['bubble_drop']} (floor {BUBBLE_DROP_FLOOR})",
+              f"{row['bubble_drop']} (floor {BUBBLE_DROP_FLOOR}), "
+              f"loop lag ok={row['ok_loop_lag']} (bound "
+              f"{LOOP_LAG_BOUND_MS:.0f} ms)",
               file=sys.stderr)
         return 1
     return 0

@@ -644,6 +644,53 @@ def dev_decode_mbu():
     return results
 
 
+@device_config("analysis_gate")
+def dev_analysis_gate():
+    # ISSUE 10: the static-analysis CI gate as a run_all row — wall
+    # time (the gate has a documented time budget: ~11 s CPU) plus the
+    # finding counts, nonzero subprocess exit (an UNJUSTIFIED finding)
+    # recorded as ok=False. Runs the full gate: AST lint (TPU+CON
+    # rules), protocol state-machine pass, jaxpr program pass.
+    results = []
+    t0 = time.perf_counter()
+    rc, stdout, stderr = None, "", ""
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-m", "dnn_tpu.analysis", "--json"],
+            capture_output=True, text=True, cwd=REPO,
+            env=dict(os.environ, JAX_PLATFORMS="cpu",
+                     PYTHONPATH=REPO + os.pathsep
+                     + os.environ.get("PYTHONPATH", "")),
+            timeout=300)
+        rc, stdout, stderr = proc.returncode, proc.stdout, proc.stderr
+    except subprocess.TimeoutExpired as e:
+        # a hung gate must still emit an ok=False row, never lose the
+        # config to an uncaught exception
+        rc, stderr = -1, f"gate exceeded 300s: {e}"
+    wall_s = time.perf_counter() - t0
+    counts = {"new": -1, "suppressed": -1, "stale": -1}
+    try:
+        rep = json.loads(stdout)
+        counts = {"new": len(rep.get("new", ())),
+                  "suppressed": len(rep.get("suppressed", ())),
+                  "stale": len(rep.get("stale_baseline", ()))}
+    except (json.JSONDecodeError, ValueError):
+        pass  # ok=False below carries the failure; stderr in the note
+    _emit(results, config="analysis_gate", metric="gate_wall_s",
+          value=round(wall_s, 2), platform=_platform(),
+          ok=bool(rc == 0),
+          findings_new=counts["new"],
+          findings_suppressed=counts["suppressed"],
+          baseline_stale=counts["stale"],
+          exit_code=rc,
+          note="python -m dnn_tpu.analysis (AST lint TPU001-006 + "
+               "CON001-006, protocol machines PRO001-004, jaxpr "
+               "program pass PRG001-004); nonzero exit = unjustified "
+               "finding" + ("" if rc == 0
+                            else f"; stderr: {stderr[-200:]}"))
+    return results
+
+
 @device_config("chaos_resilience")
 def dev_chaos_resilience():
     # ISSUE 8: availability + p99 TTFT under the STANDARD FaultPlan

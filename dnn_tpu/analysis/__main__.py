@@ -1,10 +1,14 @@
 """CLI + CI gate: `python -m dnn_tpu.analysis`.
 
-Runs the AST lint over the package (plus any extra paths) and the
-device-free program pass over the real entrypoints, diffs everything
-against analysis/baseline.json, and exits nonzero on any NEW finding.
+Runs the AST lint (trace/shard TPU rules + concurrency CON rules) over
+the package (plus any extra paths), the protocol state-machine pass
+over the declared serving machines, and the device-free program pass
+over the real entrypoints, diffs everything against
+analysis/baseline.json, and exits nonzero on any NEW finding.
 Baselined findings are printed (enumerated, not hidden) with their
 justification; baseline entries that no longer fire are reported stale.
+`--diff REV` restricts the lint to package files changed since REV;
+`--format sarif` emits SARIF 2.1.0 for CI annotation.
 
 The pass is CPU-only by design: before jax loads we force the cpu
 platform with 8 virtual host devices (the same harness tests/conftest.py
@@ -17,7 +21,74 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import subprocess
 import sys
+
+
+def changed_files(rev: str, repo_root: str):
+    """Repo-relative .py files changed between `rev` and the working
+    tree (committed + staged + unstaged; deleted files excluded) — the
+    `--diff` CI-annotation mode's file set."""
+    out = subprocess.run(
+        ["git", "-C", repo_root, "diff", "--name-only", rev, "--",
+         "*.py"],
+        capture_output=True, text=True, check=True).stdout
+    files = []
+    for rel in out.splitlines():
+        rel = rel.strip()
+        if rel and os.path.exists(os.path.join(repo_root, rel)):
+            files.append(rel)
+    return files
+
+
+def sarif_report(new, suppressed, entries) -> dict:
+    """SARIF 2.1.0 document for CI annotation (--format sarif): new
+    findings as `error` results, baseline-suppressed ones carried as
+    `note`s with their justification as an external suppression —
+    enumerated, not hidden, same policy as the text report."""
+    from dnn_tpu.analysis.findings import RULES
+
+    just = {e["fingerprint"]: e.get("justification", "") for e in entries}
+    used = sorted({f.rule for f in list(new) + list(suppressed)})
+    rules = [{
+        "id": rule,
+        "shortDescription": {"text": RULES.get(rule, (rule, ""))[0]},
+        "fullDescription": {"text": RULES.get(rule, ("", ""))[1]},
+    } for rule in used]
+    rule_index = {r: i for i, r in enumerate(used)}
+
+    def result(f, *, suppressed_by=None):
+        res = {
+            "ruleId": f.rule,
+            "ruleIndex": rule_index[f.rule],
+            "level": "note" if suppressed_by is not None else "error",
+            "message": {"text": f.message},
+            "locations": [{"physicalLocation": {
+                "artifactLocation": {"uri": f.path,
+                                     "uriBaseId": "SRCROOT"},
+                "region": {"startLine": max(f.line, 1)},
+            }}],
+            "partialFingerprints": {"dnnTpuAnalysis/v1": f.fingerprint},
+        }
+        if suppressed_by is not None:
+            res["suppressions"] = [{"kind": "external",
+                                    "justification": suppressed_by}]
+        return res
+
+    return {
+        "$schema": "https://json.schemastore.org/sarif-2.1.0.json",
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {"driver": {
+                "name": "dnn_tpu.analysis",
+                "informationUri": "dnn_tpu/analysis",
+                "rules": rules,
+            }},
+            "results": [result(f) for f in new] + [
+                result(f, suppressed_by=just.get(f.fingerprint, ""))
+                for f in suppressed],
+        }],
+    }
 
 
 def _force_cpu():
@@ -65,6 +136,22 @@ def main(argv=None) -> int:
     ap.add_argument("--no-program", action="store_true",
                     help="skip the jaxpr program pass (pure AST lint — "
                          "no jax import)")
+    ap.add_argument("--no-protocol", action="store_true",
+                    help="skip the protocol state-machine pass "
+                         "(analysis/protocol.py)")
+    ap.add_argument("--diff", metavar="REV", default=None,
+                    help="changed-files-only mode: lint only the "
+                         "PACKAGE .py files that differ from REV (git "
+                         "diff REV, filtered to dnn_tpu/ — the same "
+                         "scope as the default gate; tests/benchmarks "
+                         "plant hazard fixtures on purpose); implies "
+                         "--no-program and skips stale-baseline "
+                         "reporting (most entries legitimately don't "
+                         "fire on a partial file set)")
+    ap.add_argument("--format", choices=("text", "sarif"),
+                    default="text",
+                    help="report format; sarif emits a SARIF 2.1.0 "
+                         "document on stdout for CI annotation")
     ap.add_argument("--max-len", type=int, default=128,
                     help="cache allocation the decode census sweeps to "
                          "(default 128; benchmarks/STUDIES.md §7 records "
@@ -83,8 +170,34 @@ def main(argv=None) -> int:
             print(f"{rule}  {title}\n    {desc}")
         return 0
 
-    lint_targets = args.paths or [pkg_dir]
+    if args.diff is not None:
+        # changed-files-only (CI annotation on a PR diff): the AST +
+        # concurrency lints are per-file-sound, so a partial file set
+        # is exact for them; the whole-program jaxpr pass is not and
+        # is skipped (run the full gate for it)
+        pkg_rel = os.path.basename(pkg_dir)
+        try:
+            lint_targets = [
+                os.path.join(repo_root, rel)
+                for rel in changed_files(args.diff, repo_root)
+                if rel == pkg_rel or rel.startswith(pkg_rel + "/")]
+        except subprocess.CalledProcessError as e:
+            print(f"--diff {args.diff}: git diff failed: "
+                  f"{e.stderr or e}", file=sys.stderr)
+            return 2
+        args.no_program = True
+    else:
+        lint_targets = args.paths or [pkg_dir]
     findings = list(lint_paths(lint_targets, repo_root=repo_root))
+
+    protocol_report = None
+    if not args.no_protocol:
+        # protocol pass: pure-AST over the declared machines' modules —
+        # whole-repo-sound and cheap, so it runs even in --diff mode
+        from dnn_tpu.analysis.protocol import run_protocol_audit
+
+        protocol_report, proto_findings = run_protocol_audit(repo_root)
+        findings = assign_occurrences(findings + list(proto_findings))
 
     program_report = None
     if not args.no_program:
@@ -115,6 +228,14 @@ def main(argv=None) -> int:
         print(f"wrote {len(findings)} entries to {args.baseline}")
         return 0
 
+    if args.diff is not None:
+        stale = []  # partial file set: silence is expected, not stale
+
+    if args.format == "sarif":
+        print(json.dumps(sarif_report(new, suppressed, entries),
+                         indent=2))
+        return 1 if new else 0
+
     if args.as_json:
         print(json.dumps({
             "new": [vars(f) | {"fingerprint": f.fingerprint} for f in new],
@@ -122,9 +243,16 @@ def main(argv=None) -> int:
                            for f in suppressed],
             "stale_baseline": stale,
             "program_report": program_report,
+            "protocol_report": protocol_report,
         }, indent=2, default=str))
         return 1 if new else 0
 
+    if protocol_report is not None:
+        mk = protocol_report["machines"]
+        print("protocol pass: "
+              + ", ".join(f"{m['name']}({m['states']}s/{m['edges']}e"
+                          f"{'' if m['clean'] else ' DRIFT'})"
+                          for m in mk))
     if program_report is not None:
         dec = program_report.get("decode", {})
         print("program pass:")

@@ -1,0 +1,776 @@
+"""Concurrency-hazard AST pass: rules CON001-CON006.
+
+PRs 4, 7 and 8 each shipped a real concurrency bug in the serving stack
+that only hand review or e2e verify caught: the `ShmRing.write`
+blocking-wait-on-the-event-loop deadlock (PR 7), the
+`set_result`-on-a-cancelled-future InvalidStateError that killed the
+batcher thread (PR 4), and the cancelled `_forward` handler that leaked
+ticket slots until the relay wedged (PR 7 review round 2). This pass
+makes that bug CLASS a CI failure: pure `ast`, no imports of the code
+under analysis, same Finding/fingerprint/baseline machinery as the
+trace/shard lint (analysis/lint.py).
+
+Scope discipline, mirroring lint.py: whole-package interprocedural
+analysis would drown signal in false positives, so reachability is
+resolved PER MODULE — an async def (every grpc.aio handler is one)
+calling a sibling/method whose body blocks is flagged; a helper in
+another module is covered by registering its name in the slow-path
+table (_SLOW_HELPERS) or, at runtime, by the loop-lag sanitizer
+(analysis/sanitize.py), the dynamic companion for blocking calls no
+static pass can see through.
+
+Receiver types are tracked from constructor sites (module scope, class
+`__init__`, locals): `q = queue.Queue()` makes `q.get` a blocking call,
+`self._free = threading.Condition(...)` makes `self._free.wait`
+blocking and associates the condition with its lock. A call that is
+awaited, or whose callee lives under `asyncio.`, is never flagged —
+and passing a blocking function BY REFERENCE to
+`asyncio.to_thread`/`run_in_executor` is the sanctioned fix, which the
+pass naturally accepts because no Call node exists.
+
+Suppression: a line containing a `# conc:` annotation (e.g.
+`# conc: single-writer` for CON005) suppresses CON findings on that
+line — the annotation is the documented claim the rule asks for.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from dnn_tpu.analysis.findings import Finding
+
+__all__ = ["check_source", "BLOCKING_TYPES", "SLOW_HELPERS",
+           "RESOURCE_PAIRS"]
+
+# ----------------------------------------------------------------------
+# registries (extend these when a new blocking helper / resource pair
+# enters the codebase — the tables ARE the interprocedural knowledge)
+# ----------------------------------------------------------------------
+
+# constructor dotted-suffix -> type tag
+BLOCKING_TYPES: Dict[str, str] = {
+    "queue.Queue": "queue", "queue.LifoQueue": "queue",
+    "queue.PriorityQueue": "queue", "queue.SimpleQueue": "queue",
+    "threading.Lock": "lock", "threading.RLock": "lock",
+    "threading.Semaphore": "lock", "threading.BoundedSemaphore": "lock",
+    "threading.Condition": "condition", "threading.Event": "event",
+    "threading.Barrier": "event",
+    "subprocess.Popen": "popen",
+    "ShmRing": "shmring",
+    "concurrent.futures.Future": "future", "futures.Future": "future",
+}
+
+# type tag -> method names that BLOCK the calling thread
+_BLOCKING_METHODS: Dict[str, Set[str]] = {
+    "queue": {"get", "put", "join"},
+    "lock": {"acquire"},
+    "condition": {"wait", "wait_for", "acquire"},
+    "event": {"wait"},
+    "popen": {"wait", "communicate"},
+    "shmring": {"write"},
+    "future": {"result", "exception"},
+}
+
+# dotted suffixes that block regardless of receiver type
+_BLOCKING_CALLS = {
+    "time.sleep", "subprocess.run", "subprocess.check_output",
+    "subprocess.check_call", "subprocess.call",
+    "urllib.request.urlopen", "socket.create_connection",
+}
+
+# registered slow-path helper names (bare method/function name): known
+# to block or run device/host work long enough to stall an event loop,
+# even when this module cannot see their bodies. ShmRing.write is the
+# PR 7 deadlock; device_sync blocks on device completion (so a helper
+# like StageServer._compute_stage that calls it is blocking by
+# propagation); make_request may block on the shm ring (the nowait
+# variant + worker-thread fallback is the sanctioned async form).
+SLOW_HELPERS: Set[str] = {"device_sync", "make_request", "block_until_ready"}
+
+# CON003 resource pairs: (acquire method name, receiver-name substring
+# hint or None, release names, what leaks). The acquire call must be
+# paired with a release inside a `finally` of the same function or an
+# enclosing one — the PR 7/8 lesson: releases on the success/except
+# paths leak under cancellation, only a finally (or context manager)
+# is cancel-safe.
+RESOURCE_PAIRS: List[Tuple[str, Optional[str], Set[str], str]] = [
+    ("allow", "breaker", {"record", "release"},
+     "CircuitBreaker half-open probe slot (an unsettled slot sheds "
+     "traffic forever)"),
+    ("make_request", None, {"sent_ok", "cleanup"},
+     "transport ticket (device mailbox entry / shm ring slot)"),
+    ("make_request_nowait", None, {"sent_ok", "cleanup"},
+     "transport ticket (device mailbox entry / shm ring slot)"),
+    ("write", "ring", {"release"}, "shm ring slot latch"),
+    ("write_nowait", "ring", {"release"}, "shm ring slot latch"),
+    ("put", "MAILBOX", {"drop", "sent_ok"}, "device mailbox entry"),
+    ("acquire", None, {"release"}, "raw lock/semaphore acquisition"),
+]
+_ACQUIRE_NAMES = {p[0] for p in RESOURCE_PAIRS}
+
+_ANNOTATION = "# conc:"
+
+
+def _callee(call: ast.Call) -> str:
+    try:
+        return ast.unparse(call.func)
+    except Exception:  # pragma: no cover — exotic nodes
+        return ""
+
+
+def _last(name: str) -> str:
+    return name.rsplit(".", 1)[-1]
+
+
+def _suffix_match(callee: str, table) -> Optional[str]:
+    """Longest dotted-suffix lookup: 'a.b.c' matches keys 'a.b.c',
+    'b.c', 'c' — returns the matched value (or the key for sets)."""
+    parts = callee.split(".")
+    for i in range(len(parts)):
+        suffix = ".".join(parts[i:])
+        if suffix in table:
+            return table[suffix] if isinstance(table, dict) else suffix
+    return None
+
+
+# ----------------------------------------------------------------------
+# module indexing: types, threads, call graph
+# ----------------------------------------------------------------------
+
+class _ModuleInfo:
+    def __init__(self):
+        # bound name (module/local/self-dotted) -> type tag
+        self.types: Dict[str, str] = {}
+        # condition name -> its constructor's lock arg name (unparsed)
+        self.cond_locks: Dict[str, str] = {}
+        # class name -> set of method names run on a thread
+        self.thread_methods: Dict[str, Set[str]] = {}
+        # class name -> whether it subclasses threading.Thread
+        self.thread_subclass: Set[str] = set()
+
+
+def _type_of_ctor(value) -> Optional[str]:
+    if isinstance(value, ast.Call):
+        return _suffix_match(_callee(value), BLOCKING_TYPES)
+    return None
+
+
+def _index_module(tree: ast.Module) -> _ModuleInfo:
+    info = _ModuleInfo()
+
+    def note_assign(targets, value, *, module_scope: bool):
+        tag = _type_of_ctor(value)
+        if tag is None:
+            return
+        for t in targets:
+            if not isinstance(t, (ast.Name, ast.Attribute)):
+                continue
+            try:
+                name = ast.unparse(t)
+            except Exception:  # pragma: no cover
+                continue
+            # bare names are only trusted at MODULE scope — a local
+            # `fut = Future()` in one function must not type every
+            # other function's same-named variable; dotted (self.X)
+            # attrs are process-lifetime state and index from anywhere
+            if "." not in name and not module_scope:
+                continue
+            info.types[name] = tag
+            if tag == "condition" and isinstance(value, ast.Call) \
+                    and value.args:
+                try:
+                    info.cond_locks[name] = ast.unparse(value.args[0])
+                except Exception:  # pragma: no cover
+                    pass
+
+    top = set(map(id, tree.body))
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign):
+            note_assign(node.targets, node.value,
+                        module_scope=id(node) in top)
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            note_assign([node.target], node.value,
+                        module_scope=id(node) in top)
+        elif isinstance(node, ast.ClassDef):
+            bases = set()
+            for b in node.bases:
+                try:
+                    bases.add(_last(ast.unparse(b)))
+                except Exception:  # pragma: no cover
+                    pass
+            if "Thread" in bases:
+                info.thread_subclass.add(node.name)
+                info.thread_methods.setdefault(node.name, set()).add("run")
+            # Thread(target=self.X) anywhere inside the class body
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Call) and \
+                        _last(_callee(sub)) == "Thread":
+                    for kw in sub.keywords:
+                        if kw.arg == "target" and isinstance(
+                                kw.value, ast.Attribute) and isinstance(
+                                kw.value.value, ast.Name) and \
+                                kw.value.value.id == "self":
+                            info.thread_methods.setdefault(
+                                node.name, set()).add(kw.value.attr)
+    return info
+
+
+def _walk_own(fn):
+    """Walk a function's OWN body: nested function/async-function
+    subtrees are excluded entirely (ast.walk would descend into them;
+    `continue`-ing on the def node alone still yields its children).
+    Nested defs are judged as their own functions."""
+    stack = [fn]
+    while stack:
+        node = stack.pop()
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            yield child
+            stack.append(child)
+
+
+def _called_names(fn) -> Set[str]:
+    """Names this function calls: bare names and `self.X` methods."""
+    out: Set[str] = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call):
+            f = node.func
+            if isinstance(f, ast.Name):
+                out.add(f.id)
+            elif isinstance(f, ast.Attribute) and isinstance(
+                    f.value, ast.Name) and f.value.id == "self":
+                out.add(f.attr)
+    return out
+
+
+# ----------------------------------------------------------------------
+# the checker
+# ----------------------------------------------------------------------
+
+class _Checker:
+    def __init__(self, tree: ast.Module, path: str, src_lines: List[str]):
+        self.tree = tree
+        self.path = path
+        self.src_lines = src_lines
+        self.info = _index_module(tree)
+        self.findings: List[Finding] = []
+        self._flagged: Set[Tuple[str, int]] = set()
+        # every (fn node, enclosing class name or None, ancestors chain)
+        self.functions: List[Tuple[ast.AST, Optional[str], List[ast.AST]]] \
+            = []
+        self._collect_functions()
+        self.local_types = {}  # per-function, rebuilt in _scan_fn
+        self.blocking_fns = self._blocking_closure()
+
+    # -- plumbing ------------------------------------------------------
+
+    def _collect_functions(self):
+        stack: List[Tuple[ast.AST, Optional[str], List[ast.AST]]] = [
+            (self.tree, None, [])]
+        while stack:
+            node, cls, anc = stack.pop()
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)):
+                    self.functions.append((child, cls, anc))
+                    stack.append((child, cls, anc + [child]))
+                elif isinstance(child, ast.ClassDef):
+                    stack.append((child, child.name, anc))
+                else:
+                    stack.append((child, cls, anc))
+
+    def _annotated(self, line: int) -> bool:
+        if 0 < line <= len(self.src_lines):
+            return _ANNOTATION in self.src_lines[line - 1]
+        return False
+
+    def _flag(self, rule: str, node, message: str):
+        line = getattr(node, "lineno", 0)
+        if (rule, line) in self._flagged or self._annotated(line):
+            return
+        self._flagged.add((rule, line))
+        snippet = ""
+        if 0 < line <= len(self.src_lines):
+            snippet = self.src_lines[line - 1].strip()
+        self.findings.append(Finding(rule=rule, path=self.path, line=line,
+                                     message=message, snippet=snippet))
+
+    def _recv_type(self, call: ast.Call, fn_types: Dict[str, str]
+                   ) -> Optional[str]:
+        """Type tag of a method call's receiver, from module/class/local
+        constructor tracking."""
+        if not isinstance(call.func, ast.Attribute):
+            return None
+        try:
+            recv = ast.unparse(call.func.value)
+        except Exception:  # pragma: no cover
+            return None
+        return fn_types.get(recv) or self.info.types.get(recv)
+
+    def _is_blocking_call(self, call: ast.Call,
+                          fn_types: Dict[str, str]) -> Optional[str]:
+        """Reason string when this call blocks the calling thread."""
+        callee = _callee(call)
+        hit = _suffix_match(callee, _BLOCKING_CALLS)
+        if hit is not None:
+            return f"`{hit}` blocks the calling thread"
+        name = _last(callee)
+        if isinstance(call.func, ast.Attribute):
+            tag = self._recv_type(call, fn_types)
+            if tag is not None and name in _BLOCKING_METHODS.get(tag, ()):
+                # Lock.acquire(blocking=False) / q.get_nowait-style
+                # non-blocking forms are fine
+                for kw in call.keywords:
+                    if kw.arg == "blocking" and isinstance(
+                            kw.value, ast.Constant) and \
+                            kw.value.value is False:
+                        return None
+                    if kw.arg == "block" and isinstance(
+                            kw.value, ast.Constant) and \
+                            kw.value.value is False:
+                        return None
+                return (f"`.{name}()` on a {tag} blocks the calling "
+                        "thread")
+        if name in SLOW_HELPERS:
+            return (f"`{name}` is a registered slow-path helper "
+                    "(analysis/concurrency.SLOW_HELPERS)")
+        return None
+
+    def _fn_local_types(self, fn) -> Dict[str, str]:
+        types: Dict[str, str] = {}
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign):
+                tag = _type_of_ctor(node.value)
+                if tag is None:
+                    continue
+                for t in node.targets:
+                    if isinstance(t, (ast.Name, ast.Attribute)):
+                        try:
+                            types[ast.unparse(t)] = tag
+                        except Exception:  # pragma: no cover
+                            pass
+        return types
+
+    def _direct_blocking(self, fn) -> bool:
+        types = self._fn_local_types(fn)
+        for node in _walk_own(fn):  # nested defs judged separately
+            if isinstance(node, ast.Call) and \
+                    self._is_blocking_call(node, types):
+                return True
+        return False
+
+    def _blocking_closure(self) -> Set[str]:
+        """Names of SYNC module functions/methods whose bodies reach a
+        blocking call (direct, or through same-module sync calls)."""
+        sync_fns = {fn.name: fn for fn, _cls, _anc in self.functions
+                    if isinstance(fn, ast.FunctionDef)}
+        blocking = {name for name, fn in sync_fns.items()
+                    if self._direct_blocking(fn)}
+        changed = True
+        while changed:
+            changed = False
+            for name, fn in sync_fns.items():
+                if name in blocking:
+                    continue
+                if _called_names(fn) & blocking:
+                    blocking.add(name)
+                    changed = True
+        return blocking
+
+    # -- driver --------------------------------------------------------
+
+    def run(self) -> List[Finding]:
+        for fn, cls, ancestors in self.functions:
+            self._scan_fn(fn, cls, ancestors)
+        self._check_lock_order()
+        self._check_cross_context_writes()
+        return self.findings
+
+    def _scan_fn(self, fn, cls, ancestors):
+        # only an async def's OWN body is loop context. A sync def
+        # nested inside one is usually exactly the sanctioned fix (a
+        # closure handed to asyncio.to_thread / a worker-thread
+        # callback) and must not flag; if the async body CALLS it
+        # directly, the blocking-closure propagation flags that call
+        # site instead.
+        in_async = isinstance(fn, ast.AsyncFunctionDef)
+        fn_types = self._fn_local_types(fn)
+        awaited: Set[int] = set()
+        for node in _walk_own(fn):
+            if isinstance(node, ast.Await) and isinstance(node.value,
+                                                          ast.Call):
+                awaited.add(id(node.value))
+        for node in _walk_own(fn):  # nested defs get their own pass
+            if not isinstance(node, ast.Call):
+                continue
+            callee = _callee(node)
+            name = _last(callee)
+            # CON001: blocking call reachable from an async body
+            if in_async and id(node) not in awaited \
+                    and not callee.startswith("asyncio."):
+                reason = self._is_blocking_call(node, fn_types)
+                if reason is None and name in self.blocking_fns and (
+                        isinstance(node.func, ast.Name)
+                        or (isinstance(node.func, ast.Attribute)
+                            and isinstance(node.func.value, ast.Name)
+                            and node.func.value.id == "self")):
+                    reason = (f"`{name}` reaches a blocking primitive "
+                              "(same-module call chain)")
+                if reason is not None:
+                    self._flag(
+                        "CON001", node,
+                        f"{reason} on the event loop — every in-flight "
+                        "RPC on this loop stalls behind it (the PR 7 "
+                        "ShmRing.write deadlock shape); await an async "
+                        "form or run it via asyncio.to_thread")
+            # CON002: unguarded Future settle
+            if name in ("set_result", "set_exception") and \
+                    isinstance(node.func, ast.Attribute):
+                if not self._settle_guarded(node, fn):
+                    self._flag(
+                        "CON002", node,
+                        f"`{name}` without a done()/cancelled() guard or "
+                        "enclosing try/except — settling a future its "
+                        "caller already cancelled raises "
+                        "InvalidStateError and kills the settling "
+                        "thread (the PR 4 batcher-worker killer)")
+            # CON003: acquire without finally-release
+            self._check_resource_pair(node, fn, ancestors)
+            # CON006a: notify outside its lock
+            if name in ("notify", "notify_all") and \
+                    isinstance(node.func, ast.Attribute):
+                tag = self._recv_type(node, fn_types)
+                if tag == "condition" and not self._inside_with(node, fn):
+                    self._flag(
+                        "CON006", node,
+                        f"`.{name}()` on a Condition outside any `with` "
+                        "block — notify without holding the lock races "
+                        "the waiter's predicate check (RuntimeError at "
+                        "best, a lost wakeup at worst)")
+            # CON006b: non-daemon thread without a join path
+            if name == "Thread" and callee.split(".")[0] in (
+                    "threading", "Thread"):
+                self._check_thread_lifecycle(node, fn)
+
+    # -- CON002 helpers ------------------------------------------------
+
+    def _settle_guarded(self, call: ast.Call, fn) -> bool:
+        """Guarded when an ancestor `if` tests done()/cancelled() on the
+        same receiver, or the call sits in the BODY of a `try` whose
+        handlers are broad enough to catch InvalidStateError. A settle
+        inside an except handler / else / finally of that try is NOT
+        guarded by it — a handler does not catch exceptions raised in
+        its own body (exactly where cleanup-path settles live)."""
+        try:
+            recv = ast.unparse(call.func.value)
+        except Exception:  # pragma: no cover
+            recv = ""
+        for anc in self._ancestors_of(call, fn):
+            if isinstance(anc, ast.If):
+                try:
+                    test = ast.unparse(anc.test)
+                except Exception:  # pragma: no cover
+                    test = ""
+                if (".done()" in test or ".cancelled()" in test) and \
+                        (not recv or recv in test):
+                    return True
+            if isinstance(anc, ast.Try) and \
+                    self._in_stmt_list(anc.body, call):
+                for h in anc.handlers:
+                    if h.type is None:
+                        return True
+                    try:
+                        ht = ast.unparse(h.type)
+                    except Exception:  # pragma: no cover
+                        continue
+                    if any(t in ht for t in (
+                            "Exception", "BaseException",
+                            "InvalidStateError")):
+                        return True
+        return False
+
+    @staticmethod
+    def _in_stmt_list(stmts, target) -> bool:
+        for s in stmts:
+            for node in ast.walk(s):
+                if node is target:
+                    return True
+        return False
+
+    def _ancestors_of(self, target, fn) -> List[ast.AST]:
+        """Statement ancestors of `target` within `fn` (linear walk —
+        functions are small)."""
+        chain: List[ast.AST] = []
+
+        def visit(node, path):
+            if node is target:
+                chain.extend(path)
+                return True
+            for child in ast.iter_child_nodes(node):
+                if visit(child, path + [node]):
+                    return True
+            return False
+
+        visit(fn, [])
+        return chain
+
+    # -- CON003 helpers ------------------------------------------------
+
+    def _check_resource_pair(self, call: ast.Call, fn, ancestors):
+        if not isinstance(call.func, ast.Attribute):
+            return
+        name = call.func.attr
+        if name not in _ACQUIRE_NAMES:
+            return
+        # the acquire method's own implementation is not a call site
+        if fn.name in _ACQUIRE_NAMES:
+            return
+        try:
+            recv = ast.unparse(call.func.value)
+        except Exception:  # pragma: no cover
+            return
+        for acq, hint, releases, what in RESOURCE_PAIRS:
+            if name != acq:
+                continue
+            if hint is not None and hint.lower() not in recv.lower():
+                continue
+            # non-blocking acquire probes (lock.acquire(blocking=False))
+            # are usually paired with an early return; still require the
+            # finally — the rule is about the RELEASE path
+            if self._released_in_finally(fn, ancestors, releases):
+                return
+            self._flag(
+                "CON003", call,
+                f"`{recv}.{name}()` acquires a {what} but no "
+                f"{'/'.join(sorted(releases))} call appears in a "
+                "`finally` of this function or an enclosing one — a "
+                "cancelled or raising path leaks the resource (the "
+                "PR 7 ticket-slot leak: 4 cancellations wedged the "
+                "ring)")
+            return
+
+    def _released_in_finally(self, fn, ancestors, releases: Set[str]
+                             ) -> bool:
+        for scope in [fn] + list(ancestors):
+            for node in ast.walk(scope):
+                if not isinstance(node, ast.Try) or not node.finalbody:
+                    continue
+                for sub in ast.walk(ast.Module(body=list(node.finalbody),
+                                               type_ignores=[])):
+                    if isinstance(sub, ast.Call) and isinstance(
+                            sub.func, ast.Attribute) and \
+                            sub.func.attr in releases:
+                        return True
+                    if isinstance(sub, ast.Call) and isinstance(
+                            sub.func, ast.Name) and \
+                            sub.func.id in releases:
+                        return True
+        # `with` statements release on exit by construction
+        for scope in [fn] + list(ancestors):
+            for node in ast.walk(scope):
+                if isinstance(node, (ast.With, ast.AsyncWith)):
+                    for item in node.items:
+                        try:
+                            ctx = ast.unparse(item.context_expr)
+                        except Exception:  # pragma: no cover
+                            continue
+                        if any(r in ctx for r in releases):
+                            return True
+        return False
+
+    # -- CON004: lock-order cycles --------------------------------------
+
+    def _lock_name(self, expr, cls: Optional[str]) -> Optional[str]:
+        """Normalized lock identity for a `with X:` context, or None
+        when X is not lock-like. Class-scoped for self attrs so two
+        classes' `self._lock` never alias."""
+        try:
+            name = ast.unparse(expr)
+        except Exception:  # pragma: no cover
+            return None
+        tag = self.info.types.get(name)
+        if tag not in ("lock", "condition"):
+            lowered = name.lower()
+            if not any(k in lowered for k in ("lock", "cond", "_free",
+                                              "mutex")):
+                return None
+        if name.startswith("self."):
+            return f"{cls or '?'}.{name[5:]}"
+        return name
+
+    def _check_lock_order(self):
+        edges: Dict[Tuple[str, str], ast.AST] = {}
+        for fn, cls, _anc in self.functions:
+            stack: List[Tuple[ast.AST, List[str]]] = [(fn, [])]
+            while stack:
+                node, held = stack.pop()
+                for child in ast.iter_child_nodes(node):
+                    if isinstance(child, (ast.FunctionDef,
+                                          ast.AsyncFunctionDef)) and \
+                            child is not fn:
+                        continue
+                    child_held = held
+                    if isinstance(child, (ast.With, ast.AsyncWith)):
+                        names = [self._lock_name(i.context_expr, cls)
+                                 for i in child.items]
+                        names = [n for n in names if n is not None]
+                        for outer in held:
+                            for inner in names:
+                                if outer != inner:
+                                    edges.setdefault((outer, inner), child)
+                        child_held = held + names
+                    stack.append((child, child_held))
+        # cycle detection over the module's lock graph
+        graph: Dict[str, Set[str]] = {}
+        for a, b in edges:
+            graph.setdefault(a, set()).add(b)
+        seen: Set[Tuple[str, str]] = set()
+        for a, b in list(edges):
+            if (b, a) in seen:
+                continue
+            # path b ->* a closes a cycle through edge a -> b
+            stack, visited = [b], set()
+            while stack:
+                cur = stack.pop()
+                if cur == a:
+                    node = edges[(a, b)]
+                    self._flag(
+                        "CON004", node,
+                        f"lock-order cycle: `{a}` is taken before "
+                        f"`{b}` here, but `{b}` is (transitively) taken "
+                        f"before `{a}` elsewhere in this module — two "
+                        "threads interleaving these paths deadlock")
+                    seen.add((a, b))
+                    break
+                if cur in visited:
+                    continue
+                visited.add(cur)
+                stack.extend(graph.get(cur, ()))
+
+    # -- CON005: cross-context unlocked writes --------------------------
+
+    def _check_cross_context_writes(self):
+        by_class: Dict[str, List[Tuple[ast.AST, List[ast.AST]]]] = {}
+        for fn, cls, anc in self.functions:
+            if cls is not None:
+                by_class.setdefault(cls, []).append((fn, anc))
+        for cls, fns in by_class.items():
+            methods = {fn.name: fn for fn, _a in fns}
+            thread_seed = set(self.info.thread_methods.get(cls, ()))
+            if not thread_seed:
+                continue
+            loop_seed = {fn.name for fn, _a in fns
+                         if isinstance(fn, ast.AsyncFunctionDef)}
+            if not loop_seed:
+                continue
+
+            def closure(seed: Set[str]) -> Set[str]:
+                out = set(seed)
+                changed = True
+                while changed:
+                    changed = False
+                    for name in list(out):
+                        fn = methods.get(name)
+                        if fn is None:
+                            continue
+                        for called in _called_names(fn) & set(methods):
+                            if called not in out:
+                                out.add(called)
+                                changed = True
+                return out
+
+            thread_ctx = closure(thread_seed)
+            loop_ctx = closure(loop_seed) - thread_ctx
+            t_writes = self._attr_writes(methods, thread_ctx, cls)
+            l_writes = self._attr_writes(methods, loop_ctx, cls)
+            for attr in set(t_writes) & set(l_writes):
+                node = t_writes[attr]
+                self._flag(
+                    "CON005", node,
+                    f"`self.{attr}` is written from a Thread context "
+                    f"({'/'.join(sorted(n for n in thread_ctx if n in methods))})"
+                    " AND from event-loop-reachable code without a lock"
+                    " — torn/stale writes race; lock both sides or "
+                    "annotate the line `# conc: single-writer` with the"
+                    " single-writer argument")
+
+    def _attr_writes(self, methods, ctx: Set[str], cls: str
+                     ) -> Dict[str, ast.AST]:
+        """Unlocked `self.X = ...` write sites in the given methods."""
+        out: Dict[str, ast.AST] = {}
+        for name in ctx:
+            fn = methods.get(name)
+            if fn is None:
+                continue
+            stack: List[Tuple[ast.AST, bool]] = [(fn, False)]
+            while stack:
+                node, locked = stack.pop()
+                for child in ast.iter_child_nodes(node):
+                    if isinstance(child, (ast.FunctionDef,
+                                          ast.AsyncFunctionDef)) and \
+                            child is not fn:
+                        continue
+                    child_locked = locked
+                    if isinstance(child, (ast.With, ast.AsyncWith)):
+                        if any(self._lock_name(i.context_expr, cls)
+                               for i in child.items):
+                            child_locked = True
+                    if isinstance(child, (ast.Assign, ast.AugAssign)) \
+                            and not child_locked:
+                        targets = child.targets if isinstance(
+                            child, ast.Assign) else [child.target]
+                        for t in targets:
+                            if isinstance(t, ast.Attribute) and \
+                                    isinstance(t.value, ast.Name) and \
+                                    t.value.id == "self" and \
+                                    not self._annotated(child.lineno):
+                                out.setdefault(t.attr, child)
+                    stack.append((child, child_locked))
+        return out
+
+    # -- CON006b helper -------------------------------------------------
+
+    def _check_thread_lifecycle(self, call: ast.Call, fn):
+        for kw in call.keywords:
+            if kw.arg == "daemon" and isinstance(kw.value, ast.Constant) \
+                    and kw.value.value is True:
+                return
+        # a join() call or `.daemon = True` anywhere in the function is
+        # a lifecycle path; otherwise the thread outlives shutdown
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call) and isinstance(
+                    node.func, ast.Attribute) and node.func.attr == "join":
+                return
+            if isinstance(node, ast.Assign):
+                for t in node.targets:
+                    if isinstance(t, ast.Attribute) and \
+                            t.attr == "daemon":
+                        return
+        self._flag(
+            "CON006", call,
+            "non-daemon Thread started without a join path in this "
+            "function — it outlives shutdown and strands interpreter "
+            "exit; pass daemon=True or join it")
+
+    def _inside_with(self, target, fn) -> bool:
+        for anc in self._ancestors_of(target, fn):
+            if isinstance(anc, (ast.With, ast.AsyncWith)):
+                return True
+        return False
+
+
+# ----------------------------------------------------------------------
+# entry point (merged into lint_source by analysis/lint.py)
+# ----------------------------------------------------------------------
+
+def check_source(src: str, path: str = "<string>") -> List[Finding]:
+    """CON001-CON006 findings for one module's source. Occurrence
+    numbering is the CALLER's job (lint.lint_source merges these with
+    the TPU findings before assign_occurrences)."""
+    try:
+        tree = ast.parse(src)
+    except SyntaxError:
+        return []  # lint.py already reports TPU000 for syntax errors
+    return _Checker(tree, path, src.splitlines()).run()

@@ -86,6 +86,74 @@ RULES: Dict[str, Tuple[str, str]] = {
         "a shape sweep of an entrypoint compiles more distinct programs "
         "than its documented bound (e.g. the bucket ladder length).",
     ),
+    # concurrency pass (analysis/concurrency.py) — same gate, the
+    # asyncio/threads/shared-state hazard family PRs 4/7/8 shipped
+    "CON001": (
+        "blocking call on the event loop",
+        "a blocking primitive (Condition.wait, blocking Lock.acquire, "
+        "time.sleep, queue get/put, subprocess wait/communicate, or a "
+        "registered slow-path helper like ShmRing.write) is reachable "
+        "from an async def body — every RPC on that loop stalls behind "
+        "it; await an async form or asyncio.to_thread it.",
+    ),
+    "CON002": (
+        "unguarded Future settle",
+        "Future.set_result/set_exception without a done()/cancelled() "
+        "guard or enclosing try/except — settling a future its caller "
+        "already cancelled raises InvalidStateError and kills the "
+        "settling thread.",
+    ),
+    "CON003": (
+        "acquire without finally-release",
+        "a registered resource pair (transport ticket slot, shm ring "
+        "slot, breaker half-open probe slot, raw lock) is acquired with "
+        "no release in a `finally` — a cancelled or raising path leaks "
+        "it until the pool/ring/breaker wedges.",
+    ),
+    "CON004": (
+        "lock-order cycle",
+        "`with lock:` nesting in this module takes two locks in both "
+        "orders on different paths — two threads interleaving the "
+        "paths deadlock; impose one global order.",
+    ),
+    "CON005": (
+        "cross-context unlocked write",
+        "a mutable attribute is written from a Thread(target=...) "
+        "context AND from event-loop-reachable code without a lock or "
+        "an explicit `# conc: single-writer` annotation.",
+    ),
+    "CON006": (
+        "condition/thread lifecycle misuse",
+        "Condition.notify outside its lock (lost wakeup / "
+        "RuntimeError), or a non-daemon thread started without a join "
+        "path (strands interpreter exit).",
+    ),
+    # protocol pass (analysis/protocol.py): serving state machines as
+    # checked transition tables
+    "PRO001": (
+        "unreachable protocol state",
+        "a declared state of a serving state machine (breaker, drain, "
+        "supervisor, relay window) is unreachable from the initial "
+        "state over the declared edges.",
+    ),
+    "PRO002": (
+        "absorbing non-terminal state",
+        "a non-terminal state has no outgoing edge — once entered, the "
+        "machine is stuck there forever (the 'unsettled half-open "
+        "probe slot sheds traffic forever' shape).",
+    ),
+    "PRO003": (
+        "undeclared protocol transition",
+        "a code transition site (state-attr assignment / flight-event "
+        "record / protocol status call) does not map to any declared "
+        "edge of its machine — the implementation drifted from the "
+        "checked table.",
+    ),
+    "PRO004": (
+        "stale protocol edge",
+        "a declared edge has no code transition site — the table "
+        "promises behavior the implementation no longer has.",
+    ),
 }
 
 

@@ -693,8 +693,9 @@ class _FunctionChecker:
 # ----------------------------------------------------------------------
 
 def lint_source(src: str, path: str = "<string>") -> List[Finding]:
-    """Lint one module's source. `path` is recorded on findings
-    (repo-relative for real files)."""
+    """Lint one module's source: the trace/shard rules (TPU001-006) plus
+    the concurrency pass (CON001-006, analysis/concurrency.py). `path`
+    is recorded on findings (repo-relative for real files)."""
     try:
         tree = ast.parse(src)
     except SyntaxError as e:
@@ -725,6 +726,9 @@ def lint_source(src: str, path: str = "<string>") -> List[Finding]:
             fn, path, src_lines, index, traced=traced, spmd=spmd,
             local_defs=local_defs)
         findings.extend(checker.run())
+    from dnn_tpu.analysis.concurrency import check_source
+
+    findings.extend(check_source(src, path))
     return assign_occurrences(findings)
 
 

@@ -1,0 +1,378 @@
+"""Protocol pass: serving state machines as checked transition tables.
+
+The serving stack carries four small state machines whose bugs have all
+historically been REVIEW-round finds, not CI finds: the client circuit
+breaker (closed -> open -> half-open; PR 8's review round caught a
+half-open probe slot that, once consumed-then-delegated, left the
+breaker shedding 100% of traffic forever), connection draining
+(serving -> draining -> drained), the chaos supervisor's child
+lifecycle (init/up/restarting/crashloop/stopped), and the streamed
+relay's per-microbatch ACCEPT_WINDOW protocol (sent -> accepted ->
+answered).
+
+This module declares each machine as a literal transition table and
+model-checks it, then cross-checks the table against the CODE:
+
+  PRO001  every state reachable from the initial state;
+  PRO002  no absorbing non-terminal state (the "sheds traffic forever"
+          bug class: a state you can enter but never leave);
+  PRO003  every code transition SITE maps to a declared edge — sites
+          are state-attr assignments (`self._state = "open"`),
+          flight-event records (`flight.record("supervisor_restart")`)
+          and protocol status constructors (`ack_status(...)`), found
+          by a pure-AST scan of the machine's module;
+  PRO004  every declared edge has at least one code site (a stale edge
+          promises behavior the implementation no longer has).
+
+Findings ride the same fingerprint/baseline/gate machinery as the lint
+and program passes. Pure stdlib + ast — no jax, no imports of the code
+under check.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from dnn_tpu.analysis.findings import Finding
+
+__all__ = ["Edge", "Machine", "MACHINES", "check_machine",
+           "check_machine_sites", "run_protocol_audit"]
+
+
+@dataclasses.dataclass(frozen=True)
+class Edge:
+    src: str
+    event: str
+    dst: str
+
+
+@dataclasses.dataclass(frozen=True)
+class Machine:
+    """One protocol state machine plus where its code transitions live.
+
+    Site sources (all optional; a machine may use several):
+      * `state_attr` + `cls`: assignments `self.<state_attr> = "lit"`
+        inside class `cls` — each literal must be the initial state or
+        the dst of a declared edge;
+      * `event_kinds`: flight-recorder kinds treated as transition
+        events — each `record("<kind>")` call in `module` must map to a
+        declared edge's event;
+      * `call_events`: function-name -> event map for protocol status
+        constructors (`ack_status` -> "ack").
+    """
+
+    name: str
+    states: Tuple[str, ...]
+    initial: str
+    edges: Tuple[Edge, ...]
+    terminal: Tuple[str, ...] = ()
+    module: str = ""  # repo-relative path scanned for sites
+    cls: str = ""
+    state_attr: str = ""
+    event_kinds: Tuple[str, ...] = ()
+    call_events: Tuple[Tuple[str, str], ...] = ()
+
+
+# ----------------------------------------------------------------------
+# the declared machines — these tables ARE the protocol documentation;
+# edit them together with the code they describe (PRO003/PRO004 enforce
+# the correspondence in both directions)
+# ----------------------------------------------------------------------
+
+BREAKER = Machine(
+    name="circuit_breaker",
+    states=("closed", "open", "half_open"),
+    initial="closed",
+    edges=(
+        # threshold consecutive terminal failures trip the breaker
+        Edge("closed", "circuit_open", "open"),
+        # cooldown elapsed: exactly one probe may proceed
+        Edge("open", "circuit_half_open", "half_open"),
+        # the probe succeeded (record(True) from any non-closed state
+        # closes — success is the universal reset)
+        Edge("half_open", "circuit_close", "closed"),
+        Edge("open", "circuit_close", "closed"),
+        # the probe failed: reopen with a doubled cooldown
+        Edge("half_open", "circuit_reopen", "open"),
+        # the probe slot was consumed but the call DELEGATED elsewhere:
+        # give the slot back un-judged, cooldown pre-elapsed. THIS edge
+        # is the PR 8 review fix — without it half_open had no exit
+        # when the delegate ran its own allow/record cycle, and the
+        # breaker shed 100% of traffic forever (PRO002 on the table
+        # minus this edge reproduces the bug as a model-check failure)
+        Edge("half_open", "release", "open"),
+    ),
+    module="dnn_tpu/comm/client.py",
+    cls="CircuitBreaker",
+    state_attr="_state",
+    event_kinds=("circuit_open", "circuit_half_open", "circuit_close",
+                 "circuit_reopen"),
+)
+
+SUPERVISOR = Machine(
+    name="supervisor",
+    states=("init", "up", "restarting", "crashloop", "stopped"),
+    initial="init",
+    terminal=("stopped",),
+    edges=(
+        Edge("init", "launch", "up"),
+        # child exited / was condemned wedged -> the restart path
+        Edge("up", "stage_down", "restarting"),
+        Edge("up", "stage_wedged", "restarting"),
+        # backoff ladder steps stay inside restarting
+        Edge("restarting", "supervisor_backoff", "restarting"),
+        Edge("restarting", "supervisor_restart", "up"),
+        Edge("restarting", "crash_loop", "crashloop"),
+        # stop() is legal from every live state
+        Edge("init", "stop", "stopped"),
+        Edge("up", "stop", "stopped"),
+        Edge("restarting", "stop", "stopped"),
+        Edge("crashloop", "stop", "stopped"),
+    ),
+    module="dnn_tpu/chaos/supervisor.py",
+    cls="Supervisor",
+    state_attr="state",
+    event_kinds=("stage_down", "stage_wedged", "supervisor_backoff",
+                 "supervisor_restart", "crash_loop"),
+)
+
+DRAIN = Machine(
+    name="drain",
+    states=("serving", "draining", "drained"),
+    initial="serving",
+    terminal=("drained",),
+    edges=(
+        # three entry doors, one state: POST /drainz (and the wedged
+        # drain policy), SIGTERM, and the worker-level begin
+        Edge("serving", "drainz", "draining"),
+        Edge("serving", "sigterm_drain", "draining"),
+        Edge("serving", "drain_begin", "draining"),
+        # queued-but-unadmitted work hands back retriable (stays
+        # draining while in-flight decodes finish)
+        Edge("draining", "drain_handback", "draining"),
+        # the worker finished its pool and exited clean
+        Edge("draining", "drain_done", "drained"),
+        # the blocking drain() observed the exit (clean or grace-out)
+        Edge("draining", "drain_exit", "drained"),
+    ),
+    module="dnn_tpu/runtime/lm_server.py",
+    event_kinds=("drainz", "sigterm_drain", "drain_begin",
+                 "drain_handback", "drain_done", "drain_exit"),
+)
+
+RELAY_WINDOW = Machine(
+    name="relay_accept_window",
+    states=("sent", "accepted", "answered"),
+    initial="sent",
+    terminal=("answered",),
+    edges=(
+        # eager ack: the frame was decoded into the bounded accept
+        # queue — the upstream sender's window advances NOW (and its
+        # payload slot frees); compute happens later
+        Edge("sent", "ack", "accepted"),
+        # the microbatch's result (or its per-item error status, or the
+        # stream-level -1 error) rides back tagged res:<seq>
+        Edge("accepted", "result", "answered"),
+    ),
+    module="dnn_tpu/comm/service.py",
+    call_events=(("ack_status", "ack"), ("result_status", "result")),
+)
+
+MACHINES: Tuple[Machine, ...] = (BREAKER, SUPERVISOR, DRAIN, RELAY_WINDOW)
+
+
+# ----------------------------------------------------------------------
+# model checks (PRO001 / PRO002)
+# ----------------------------------------------------------------------
+
+def check_machine(m: Machine) -> List[Finding]:
+    """Table-only checks: declared-state hygiene, reachability from the
+    initial state, no absorbing non-terminal state."""
+    out: List[Finding] = []
+    path = m.module or f"<machine:{m.name}>"
+
+    def finding(rule, message, snippet):
+        return Finding(rule=rule, path=path, line=0, message=message,
+                       snippet=snippet)
+
+    states = set(m.states)
+    if m.initial not in states:
+        out.append(finding(
+            "PRO001", f"machine `{m.name}`: initial state "
+            f"{m.initial!r} is not a declared state", m.initial))
+    for e in m.edges:
+        for s in (e.src, e.dst):
+            if s not in states:
+                out.append(finding(
+                    "PRO001", f"machine `{m.name}`: edge "
+                    f"{e.src}--{e.event}-->{e.dst} names undeclared "
+                    f"state {s!r}", f"{e.src}:{e.event}:{e.dst}"))
+    # reachability
+    adj: Dict[str, Set[str]] = {}
+    for e in m.edges:
+        adj.setdefault(e.src, set()).add(e.dst)
+    seen = {m.initial}
+    stack = [m.initial]
+    while stack:
+        for nxt in adj.get(stack.pop(), ()):
+            if nxt not in seen:
+                seen.add(nxt)
+                stack.append(nxt)
+    for s in m.states:
+        if s not in seen:
+            out.append(finding(
+                "PRO001", f"machine `{m.name}`: state {s!r} is "
+                "unreachable from the initial state over the declared "
+                "edges", s))
+    # absorbing non-terminal
+    for s in m.states:
+        if s in m.terminal:
+            continue
+        if not adj.get(s):
+            out.append(finding(
+                "PRO002", f"machine `{m.name}`: non-terminal state "
+                f"{s!r} has no outgoing edge — once entered, the "
+                "machine is stuck there forever", s))
+    return out
+
+
+# ----------------------------------------------------------------------
+# code-site cross-check (PRO003 / PRO004)
+# ----------------------------------------------------------------------
+
+def _callee(call: ast.Call) -> str:
+    try:
+        return ast.unparse(call.func)
+    except Exception:  # pragma: no cover
+        return ""
+
+
+def _collect_sites(m: Machine, tree: ast.Module
+                   ) -> List[Tuple[str, str, int, str]]:
+    """-> [(site_kind, token, line, snippet_key)] where site_kind is
+    'state' (assigned state literal), 'event' (flight kind) or 'call'
+    (protocol status constructor's mapped event)."""
+    sites: List[Tuple[str, str, int, str]] = []
+    call_map = dict(m.call_events)
+
+    # locate the class body for state-attr scoping
+    cls_node: Optional[ast.ClassDef] = None
+    if m.cls:
+        for node in ast.walk(tree):
+            if isinstance(node, ast.ClassDef) and node.name == m.cls:
+                cls_node = node
+                break
+    if m.state_attr and cls_node is not None:
+        for node in ast.walk(cls_node):
+            if isinstance(node, ast.Assign) and \
+                    isinstance(node.value, ast.Constant) and \
+                    isinstance(node.value.value, str):
+                for t in node.targets:
+                    if isinstance(t, ast.Attribute) and \
+                            t.attr == m.state_attr and \
+                            isinstance(t.value, ast.Name) and \
+                            t.value.id == "self":
+                        sites.append(("state", node.value.value,
+                                      node.lineno,
+                                      f"{m.state_attr}={node.value.value}"))
+    if m.event_kinds:
+        kinds = set(m.event_kinds)
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Call) and \
+                    _callee(node).endswith("record") and node.args and \
+                    isinstance(node.args[0], ast.Constant) and \
+                    node.args[0].value in kinds:
+                sites.append(("event", node.args[0].value, node.lineno,
+                              f"record:{node.args[0].value}"))
+    if call_map:
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Call):
+                name = _callee(node).rsplit(".", 1)[-1]
+                if name in call_map:
+                    sites.append(("call", call_map[name], node.lineno,
+                                  f"{name}()"))
+    return sites
+
+
+def check_machine_sites(m: Machine, repo_root: str,
+                        src: Optional[str] = None) -> List[Finding]:
+    """Cross-check the machine against its module's code sites. `src`
+    overrides reading `m.module` from disk (tests inject fixtures)."""
+    if not m.module:
+        return []
+    if src is None:
+        path = os.path.join(repo_root, m.module)
+        if not os.path.exists(path):
+            return [Finding(
+                rule="PRO003", path=m.module, line=0,
+                message=f"machine `{m.name}`: module {m.module} not "
+                "found — the table points at code that moved",
+                snippet=m.module)]
+        with open(path, encoding="utf-8") as f:
+            src = f.read()
+    try:
+        tree = ast.parse(src)
+    except SyntaxError:
+        return []  # the lint pass reports TPU000 for this module
+    out: List[Finding] = []
+    sites = _collect_sites(m, tree)
+    dsts = {e.dst for e in m.edges}
+    events = {e.event for e in m.edges}
+    for kind, token, line, key in sites:
+        if kind == "state":
+            if token not in m.states:
+                out.append(Finding(
+                    rule="PRO003", path=m.module, line=line,
+                    message=f"machine `{m.name}`: code assigns "
+                    f"undeclared state {token!r} to "
+                    f"self.{m.state_attr}", snippet=key))
+            elif token != m.initial and token not in dsts:
+                out.append(Finding(
+                    rule="PRO003", path=m.module, line=line,
+                    message=f"machine `{m.name}`: code transitions "
+                    f"into {token!r} but no declared edge lands there",
+                    snippet=key))
+        else:  # event / call sites carry the edge's event token
+            if token not in events:
+                out.append(Finding(
+                    rule="PRO003", path=m.module, line=line,
+                    message=f"machine `{m.name}`: transition site "
+                    f"`{key}` maps to no declared edge event",
+                    snippet=key))
+    # PRO004: declared edges with no site. State-attr machines witness
+    # an edge by its dst assignment; event/call machines by the event.
+    seen_states = {t for k, t, _l, _s in sites if k == "state"}
+    seen_events = {t for k, t, _l, _s in sites if k in ("event", "call")}
+    for e in m.edges:
+        witnessed = e.event in seen_events or (
+            m.state_attr and e.dst in seen_states)
+        if not witnessed:
+            out.append(Finding(
+                rule="PRO004", path=m.module, line=0,
+                message=f"machine `{m.name}`: declared edge "
+                f"{e.src}--{e.event}-->{e.dst} has no code transition "
+                "site — stale table entry or removed behavior",
+                snippet=f"{e.src}:{e.event}:{e.dst}"))
+    return out
+
+
+def run_protocol_audit(repo_root: str, machines: Sequence[Machine] = MACHINES
+                       ) -> Tuple[dict, List[Finding]]:
+    """The full protocol pass: model-check every declared machine and
+    cross-check it against its module. Returns (report, findings) —
+    occurrence assignment is the caller's job (the CLI merges these
+    with the lint/program findings)."""
+    findings: List[Finding] = []
+    report = {"machines": []}
+    for m in machines:
+        f_model = check_machine(m)
+        f_sites = check_machine_sites(m, repo_root)
+        findings.extend(f_model + f_sites)
+        report["machines"].append({
+            "name": m.name, "states": len(m.states),
+            "edges": len(m.edges), "module": m.module,
+            "clean": not (f_model or f_sites)})
+    return report, findings

@@ -96,7 +96,9 @@ class Supervisor:
         self.ready = ready
         self.proc: Optional[subprocess.Popen] = None
         self.restarts = 0
-        self.state = "init"  # init|up|down|restarting|crashloop|stopped
+        # the supervisor state machine is DECLARED (and model-checked)
+        # in analysis/protocol.SUPERVISOR — edit both together
+        self.state = "init"  # init|up|restarting|crashloop|stopped
         self._restart_times: List[float] = []
         self._health_fails = 0
         self._ever_healthy = False  # boot grace: a child still importing

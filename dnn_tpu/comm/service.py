@@ -204,8 +204,15 @@ class StageServer:
                 # measures the stage's real compute, not its dispatch.
                 # The output STAYS device-resident: a device-negotiated
                 # downstream hop hands it on without ever pulling it to
-                # the host (the sender's make_request decides)
-                y = self._compute_stage(x)
+                # the host (the sender's make_request decides).
+                # OFF-LOOP (CON001): _compute_stage blocks on device
+                # completion; running it inline held the event loop for
+                # the full stage compute (first call: the jit compile),
+                # stalling every concurrent RPC — including the Relay
+                # acks that free upstream send windows. The streamed
+                # path already computed via to_thread; the unary path
+                # now matches.
+                y = await asyncio.to_thread(self._compute_stage, x)
             if self.is_last:
                 y = np.asarray(y)
                 pred = int(np.argmax(y))
@@ -876,9 +883,19 @@ async def serve_stage(engine, node_id: str, *, port: Optional[int] = None,
     log.info("gRPC stage server %s listening on %s (part %d, transport=%s)",
              node_id, listen, servicer.part_index, servicer.transport)
     await server.start()
+    # loop-lag sanitizer (analysis/sanitize.py): env-gated tripwire for
+    # blocking calls the AST pass can't see through an indirection —
+    # the transport/chaos probes run their stage children with it on
+    # and assert the bound from the served /debugz. Installed AFTER
+    # startup so the native-codec warm compile doesn't count.
+    from dnn_tpu.analysis import sanitize as _sanitize
+
+    lagmon = _sanitize.maybe_install(where=f"serve_stage:{node_id}")
     try:
         await server.wait_for_termination()
     finally:
+        if lagmon is not None:
+            lagmon.stop()
         await servicer.close()
         await server.stop(grace=1)
         if metrics_srv is not None:

@@ -109,7 +109,18 @@ def install_memory_gauges(registry=None) -> list:
     if registry is None:  # observability off: nothing to install
         return []
     if id(registry) in _installed_registries:
-        return []
+        # the marker alone is not enough: a registry.clear() (test /
+        # benchmark legs reset series wholesale) wipes the installed
+        # gauges while this id stays latched, and every LATER server
+        # on the same registry would then scrape without host/device
+        # memory series — found by the ISSUE-10 tier-1 run as a
+        # deterministic cross-module failure (an LMServer installed,
+        # a transport test cleared, an obs test scraped). The host
+        # gauge is the cheap liveness probe: present means the install
+        # survives; absent means re-install.
+        if "process_resident_bytes" in registry.gauges:
+            return []
+        _installed_registries.discard(id(registry))
     registered = []
     registry.set_fn("process_resident_bytes", rss_bytes)
     registered.append("process_resident_bytes")

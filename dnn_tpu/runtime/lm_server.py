@@ -240,13 +240,16 @@ class _BatcherWorker(threading.Thread):
                 # admission is CLOSED but the pool is still finishing:
                 # hand the request straight back with the retriable
                 # draining status (never enqueue work the drain exit
-                # would have to fail later anyway)
-                fut.set_exception(DrainingError(
+                # would have to fail later anyway). Through the guarded
+                # settle (CON002): the future is fresh here, but every
+                # settle in this module goes through one guarded path —
+                # the unguarded form is exactly the PR 4 worker-killer.
+                _fail_future(fut, DrainingError(
                     "LM server draining: admission closed; retry "
                     "against another replica"))
                 return fut
             if self._dead is not None:
-                fut.set_exception(self._dead)
+                _fail_future(fut, self._dead)
                 if (g := self.goodput) is not None:
                     g.on_outcome(False)  # fast-fails burn availability
                 return fut
@@ -1574,6 +1577,12 @@ async def serve_lm(cfg, prepared, *, port: int, **server_kwargs) -> int:
         while not await asyncio.to_thread(servicer._escalated.wait, 1.0):
             pass
 
+    # loop-lag sanitizer (analysis/sanitize.py): env-gated tripwire for
+    # event-loop-blocking calls the AST pass can't see — verify paths
+    # run with DNN_TPU_LOOP_SANITIZE=1 and read breaches off /debugz
+    from dnn_tpu.analysis import sanitize as _sanitize
+
+    lagmon = _sanitize.maybe_install(where="serve_lm")
     esc_task = asyncio.ensure_future(_wait_escalated())
     term_task = asyncio.ensure_future(server.wait_for_termination())
     try:
@@ -1597,6 +1606,8 @@ async def serve_lm(cfg, prepared, *, port: int, **server_kwargs) -> int:
         # runs makes grpc.aio surface CancelledError out of this
         # finally, clobbering the escalation return code (the verify
         # scenario caught exactly that as rc=1 instead of 43/0)
+        if lagmon is not None:
+            lagmon.stop()
         esc_task.cancel()
         try:
             await server.stop(grace=1)

@@ -59,6 +59,11 @@ _MODULE_COST_S = {
     "test_torch_export": 11.1, "test_models_gpt": 11.4,
     "test_analysis": 13.7,  # the static-analyzer gate: cheap, CPU-only,
     # and placed early so the tier-1 budget always certifies it
+    "test_analysis_concurrency": 8.0,  # ISSUE 10 concurrency-hazard
+    # analyzer: CON rule fixture pairs, the three historical shipped
+    # bugs as fixtures, protocol-table goldens, loop-lag sanitizer,
+    # CLI --diff/sarif — pure AST + tiny asyncio loops, certified
+    # early in the tier-1 budget next to test_analysis
     "test_obs": 28.0,  # the observability layer (spans, /metrics, compile
     # telemetry + the `python -m dnn_tpu.obs trace --selftest` CI smoke):
     # mid-pack cost, certified within the tier-1 budget

@@ -565,6 +565,26 @@ def test_serve_metrics_is_the_full_v2_surface():
         srv.close()
 
 
+def test_memory_gauges_reinstall_after_registry_clear():
+    # regression (ISSUE 10 tier-1 find): install -> registry.clear()
+    # (a test/bench leg resetting series) -> any later server's
+    # install must RE-register, not trust the per-registry id marker —
+    # the latched marker left every later /metrics scrape without
+    # host/device memory series, a deterministic cross-module suite
+    # failure (LMServer installed, a transport test cleared, this
+    # module's surface test scraped)
+    from dnn_tpu.obs.mem import install_memory_gauges
+
+    m = obs.metrics()
+    assert m is not None
+    install_memory_gauges(m)
+    assert "process_resident_bytes" in m.gauges
+    m.clear()
+    assert "process_resident_bytes" not in m.gauges
+    install_memory_gauges(m)  # must self-heal past the id marker
+    assert "process_resident_bytes" in m.gauges
+
+
 def test_pool_exhausted_episode_reopens_after_cancel_frees_blocks(tiny_gpt):
     # the episode latch dedupes per-step retries, but a shortage whose
     # held request is cancelled (never re-admitted) must not suppress
