@@ -24,6 +24,12 @@ satellite: < 2% on a decode step). This probe measures it honestly:
     PER-STEP flight-recorder event (ON population only; production
     records per admission/retirement, so this bounds the flight path
     from above);
+  * the step-timeline clock (ISSUE 11, obs/timeline.StepClock) is
+    attached for BOTH populations the way the LM daemon attaches it:
+    the ON population pays the full phase-mark + end-of-step
+    histogram/gauge bill, the OFF population its one-gate-check
+    degradation — so the new instrumentation is re-priced under the
+    same contract, not presumed free;
   * timed steps only ever advance a FULL pool: the pool refills
     (untimed) before a request's budget could retire it mid-sequence,
     and every step syncs on the committed tokens (step() pulls
@@ -146,10 +152,18 @@ def measure() -> dict:
 
 def _measure_steps(srv) -> dict:
     from dnn_tpu import obs
+    from dnn_tpu.obs.timeline import StepClock
     from dnn_tpu.obs.watchdog import Watchdog
 
     was = obs.enabled()
     obs.set_enabled(True)
+    # step-timeline clock ON (ISSUE 11): the per-phase StepClock rides
+    # the timed loop exactly as the LM daemon attaches it, so the new
+    # instrumentation is priced inside the same <2% contract — in the
+    # OFF population begin() short-circuits on the gate (one enabled()
+    # check), in the ON population every phase mark + the end-of-step
+    # bulk registry update (histograms + gauges) is in the bill
+    srv.step_clock = StepClock().install()
     # v2 surface rides along in the timed loop: a live watchdog (no
     # device probe — its subprocess would inject real load; the
     # per-step cost under test is the heartbeat) and a PER-STEP flight

@@ -722,6 +722,33 @@ def dev_chaos_resilience():
     return results
 
 
+@device_config("step_timeline")
+def dev_step_timeline():
+    # ISSUE 11: step-timeline attribution baseline — the §10/§11 decode
+    # configuration with the StepClock attached. Asserted: phase
+    # accounting (admit/host/dispatch/wait/commit/obs) covers >= 95% of
+    # the externally measured round wall (no unattributed dark time).
+    # Recorded: the host-serialization fraction — the number the item-4
+    # overlap/fusion PR must ratchet DOWN, the way decode_mbu ratchets
+    # up — plus the device-view cross-check from a real profiler
+    # capture analyzed by obs/timeline.analyze().
+    from benchmarks.step_timeline_probe import COVERAGE_FLOOR, measure
+
+    results = []
+    row = measure()
+    ok = row.pop("ok")
+    host_frac = row.pop("host_serialization_fraction")
+    _emit(results, config="step_timeline",
+          metric="host_serialization_pct",
+          value=round(host_frac * 100, 2), platform=_platform(), ok=ok,
+          note=f"share of decode-round wall NOT inside a decode step "
+               f"program (admit convoy + host bookkeeping + commit + "
+               f"obs) on the s10 config; asserted: phase coverage >= "
+               f"{COVERAGE_FLOOR:.0%} of measured wall — the item-4 "
+               "overlap ratchet baseline", **row)
+    return results
+
+
 def _serve_round(srv_x, cfg, sb_new, n_requests, plen_fn, constraint=None,
                  key=9):
     """Admit-when-a-slot-frees over the pool, then drain — the

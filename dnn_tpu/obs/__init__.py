@@ -51,6 +51,19 @@ whole pipeline instead of one process:
     error-budget burn-rate tracking (TTFT / inter-token /
     availability) with flight events on breach.
 
+v4 adds the INTRA-STEP layer — the instrument for the overlap/fusion
+arc (ROADMAP item 4):
+
+  * step-timeline attribution (obs/timeline.py): a per-phase decode-
+    step clock on the serving pool (admit / host / dispatch / wait /
+    commit / obs) with dispatch-slack, sync-tax and host-fraction
+    series on /stepz (+ a Perfetto host-track export), capture
+    analysis over the profiler's spooled artifacts (device busy/idle,
+    host-gap histogram, top ops) aligned to the step axis through
+    profile.py's sidecar meta, and an asserted phase-accounting
+    baseline (benchmarks/step_timeline_probe.py) whose measured
+    host-serialization fraction is the item-4 ratchet (BASELINE.md).
+
 Gate: DNN_TPU_OBS=off (or 0/false) disables everything — producers see
 `metrics()` return None, `start_span` return the free NULL_SPAN, and
 `flight.record` short-circuit on one boolean. The gate is re-checked
@@ -109,15 +122,22 @@ def set_enabled(on: bool):
     _enabled = bool(on)
 
 
+_default_metrics = None  # resolved lazily once: metrics() is on every
+# per-step hot path, and a per-call submodule import is measurable there
+
+
 def metrics():
     """The shared registry (utils.metrics.default_metrics) when
     observability is on, else None — hot paths guard with one `is not
     None` check and skip all bookkeeping when off."""
     if not _enabled:
         return None
-    from dnn_tpu.utils.metrics import default_metrics
+    global _default_metrics
+    if _default_metrics is None:
+        from dnn_tpu.utils.metrics import default_metrics
 
-    return default_metrics
+        _default_metrics = default_metrics
+    return _default_metrics
 
 
 _install_lock = threading.Lock()
@@ -140,7 +160,7 @@ def install_compile_telemetry() -> bool:
 
 def serve_metrics(port: int = 0, host: str = "127.0.0.1", *,
                   healthy=None, status=None, profiler=None, fleet=None,
-                  drain=None):
+                  drain=None, stepclock=None):
     """Start the observability HTTP endpoint on a daemon thread; returns
     the MetricsHTTPServer (`.port` for port=0 ephemeral binds,
     `.close()` to stop; loopback by default — pass host="0.0.0.0" to
@@ -156,7 +176,9 @@ def serve_metrics(port: int = 0, host: str = "127.0.0.1", *,
     additionally serves the merged fleet view on /fleetz (JSON;
     ?format=prom|trace|report). `drain` (callable -> dict) enables
     POST /drainz — connection draining (runtime/lm_server.LMServer
-    passes its handler). See obs/http.py."""
+    passes its handler). `stepclock` (an obs.timeline.StepClock)
+    additionally serves the step-timeline attribution on /stepz (JSON;
+    ?format=prom|trace). See obs/http.py."""
     from dnn_tpu.obs.http import MetricsHTTPServer
     from dnn_tpu.obs.mem import install_memory_gauges
 
@@ -167,4 +189,5 @@ def serve_metrics(port: int = 0, host: str = "127.0.0.1", *,
         profiler = Profiler()
     return MetricsHTTPServer(port=port, host=host, healthy=healthy,
                              status=status, profiler=profiler or None,
-                             fleet=fleet, drain=drain)
+                             fleet=fleet, drain=drain,
+                             stepclock=stepclock)

@@ -1,4 +1,25 @@
-"""CLI: `python -m dnn_tpu.obs {trace,flight,fleet} ...` — obs tooling.
+"""CLI: `python -m dnn_tpu.obs {trace,flight,fleet,timeline} ...` — obs
+tooling.
+
+    python -m dnn_tpu.obs timeline --url http://host:port
+        Fetch a running server's /stepz and print the per-phase
+        decode-step decomposition (admit/host/dispatch/wait/commit/obs
+        with fractions, dispatch-slack, sync-tax, host fraction).
+        --out steps.json additionally writes the last N steps as a
+        Perfetto-loadable host track (?format=trace).
+
+    python -m dnn_tpu.obs timeline PATH
+        Analyze one device capture (a POST /profilez capture dir, or a
+        *.trace.json[.gz] file) with obs/timeline.analyze: per-track
+        busy fractions, device busy/idle, the host-gap histogram
+        between consecutive device ops, top-K ops by device time, and
+        — when the capture's sidecar meta.json is present — its
+        position on the step axis. --json for the raw dict.
+
+    python -m dnn_tpu.obs timeline --selftest
+        In-process smoke: a deterministic StepClock (injected clock)
+        plus a synthetic gzipped Perfetto trace, checked end to end;
+        exit 0 on success. Tier-1 wired (tests/test_obs_timeline.py).
 
     python -m dnn_tpu.obs fleet --targets http://h1:9100,http://h2:9100
         One-shot fleet report: poll every stage's /metrics /statusz
@@ -282,6 +303,142 @@ def _fleet_selftest() -> int:
     return 0
 
 
+def _timeline_selftest() -> int:
+    """Deterministic StepClock (injected clock) + a synthetic gzipped
+    Perfetto capture with a sidecar meta, checked end to end: phase
+    arithmetic, derived series, chrome export, prom render, registry
+    histograms, capture analysis, step alignment, garbage rejection."""
+    import gzip
+    import os
+    import tempfile
+
+    from dnn_tpu import obs
+    from dnn_tpu.obs.timeline import StepClock, analyze
+    from dnn_tpu.utils.metrics import Metrics
+
+    obs.set_enabled(True)
+    t = [100.0]
+    reg = Metrics()
+    clk = StepClock(capacity=8, registry=reg, now=lambda: t[0])
+    for _i in range(3):
+        t[0] += 0.0005  # one admit per iteration, 0.5 ms
+        clk.note_admit(t[0] - 0.0005)
+        rec = clk.begin()
+        assert rec is not None
+        for phase, dt in (("host", 0.001), ("dispatch", 0.002),
+                          ("wait", 0.004), ("commit", 0.001),
+                          ("obs", 0.001)):
+            t[0] += dt
+            clk.mark(rec, phase)
+        clk.end(rec, n_adv=4)
+        t[0] += 0.0005  # inter-step gap: genuinely unattributed
+    s = clk.summary()
+    assert s["window_steps"] == 3 and s["steps_total"] == 3, s
+    # per step: wall 9 ms + 0.5 ms admit; host 3.5 ms, device 6 ms
+    assert abs(s["host_fraction"] - 3.5 / 9.5) < 1e-3, s
+    assert abs(s["dispatch_slack"] - 3.5 / 6.0) < 1e-3, s
+    assert abs(s["sync_tax"] - 4.0 / 9.5) < 1e-3, s
+    assert s["tokens"] == 12, s
+    ct = clk.chrome_trace()
+    xs = [e for e in ct["traceEvents"] if e.get("ph") == "X"]
+    assert len(xs) == 3 * 6, len(xs)  # 5 phases + 1 admit slice / step
+    assert {e["name"] for e in xs} == {"admit", "host", "dispatch",
+                                       "wait", "commit", "obs"}
+    prom = clk.render_prom()
+    assert "dnn_tpu_step_host_fraction" in prom, prom
+    snap = reg.snapshot()
+    assert 'step.phase_seconds{phase="wait"}' in snap["histogram"], snap
+
+    # synthetic capture: one 6 ms device op per step's in-flight window
+    d = tempfile.mkdtemp(prefix="tl-selftest")
+    events = [
+        {"ph": "M", "pid": 7, "name": "process_name",
+         "args": {"name": "/host:CPU"}},
+        {"ph": "M", "pid": 7, "tid": 2, "name": "thread_name",
+         "args": {"name": "tf_XLATfrtCpuClient"}},
+    ]
+    for i in range(3):
+        t0_rel = (0.0005 + 0.010 * i + 0.001) * 1e6  # dispatch start
+        events.append({"ph": "X", "pid": 7, "tid": 2, "name": "fusion.1",
+                       "ts": t0_rel, "dur": 6000.0,
+                       "args": {"hlo_op": "fusion.1",
+                                "hlo_module": "jit_step"}})
+    with gzip.open(os.path.join(d, "vm.trace.json.gz"), "wt") as f:
+        json.dump({"traceEvents": events, "displayTimeUnit": "ns"}, f)
+    with open(os.path.join(d, "meta.json"), "w") as f:
+        json.dump({"perf_begin": 100.0, "perf_end": 100.0305,
+                   "step_begin": 0, "step_end": 3, "backend": "cpu"}, f)
+    a = analyze(d, clock=clk)
+    assert a["device"]["ops"] == 3, a["device"]
+    assert abs(a["device"]["busy_s"] - 0.018) < 1e-6, a["device"]
+    assert a["host_gaps"]["count"] == 2, a["host_gaps"]
+    assert abs(a["host_gaps"]["p50_ms"] - 4.0) < 0.01, a["host_gaps"]
+    assert a["top_ops"][0]["name"] == "fusion.1", a["top_ops"]
+    st = a["steps"]
+    assert st and st["aligned"] and st["n_steps"] == 3, st
+    assert st["steps_in_capture"] == 3, st
+    # each step: 6 ms device busy inside a 9.5 ms attributed wall
+    assert abs(st["device_overlap_frac"] - 18.0 / 28.5) < 1e-3, st
+
+    # garbage and truncated inputs fail loud, not half-parsed
+    bad = os.path.join(d, "garbage.json")
+    with open(bad, "w") as f:
+        f.write("not a trace {{{")
+    for p in (bad,):
+        try:
+            analyze(p)
+            raise AssertionError("garbage input must raise ValueError")
+        except ValueError:
+            pass
+    print("timeline selftest ok: 3 deterministic steps (host fraction "
+          f"{s['host_fraction']:.2%}, slack {s['dispatch_slack']:.2f}, "
+          f"sync tax {s['sync_tax']:.2%}), synthetic capture analyzed "
+          f"(device busy {a['device']['busy_frac']:.1%}, 3 steps "
+          "aligned), garbage rejected")
+    return 0
+
+
+def _timeline_url(url: str, out=None, last=None) -> int:
+    from urllib.request import urlopen
+
+    base = url.rstrip("/") + "/stepz"
+    q = f"?last={last}" if last else ""
+    s = json.loads(urlopen(base + q, timeout=10).read().decode())
+    phases = s.get("phases", {})
+    print(f"steps: {s.get('steps_total')} total, "
+          f"{s.get('window_steps')} in window "
+          f"({s.get('window_wall_s', 0) * 1e3:.1f} ms wall, "
+          f"{s.get('tokens')} tokens)")
+    for p, d in phases.items():
+        print(f"  {p:<9} {d['frac']:7.1%}  {d['mean_ms']:9.3f} ms/step")
+    print(f"host fraction {s.get('host_fraction', 0):.1%} | "
+          f"dispatch slack {s.get('dispatch_slack', 0):.2f} | "
+          f"sync tax {s.get('sync_tax', 0):.1%} | "
+          f"{s.get('steps_per_sec', 0):.1f} steps/s | last step "
+          f"{s.get('last_wall_ms', 0):.2f} ms")
+    if out:
+        trace = urlopen(base + "?format=trace"
+                        + (f"&last={last}" if last else ""),
+                        timeout=10).read().decode()
+        with open(out, "w") as f:
+            f.write(trace)
+        n = sum(1 for e in json.loads(trace)["traceEvents"]
+                if e.get("ph") == "X")
+        print(f"wrote {out}: {n} phase slices (load in Perfetto)")
+    return 0
+
+
+def _timeline_path(path: str, as_json: bool, top: int) -> int:
+    from dnn_tpu.obs.timeline import analyze, render_report
+
+    a = analyze(path, top_k=top)
+    if as_json:
+        print(json.dumps(a, indent=2))
+    else:
+        print(render_report(a))
+    return 0
+
+
 def _fleet_cmd(args) -> int:
     from dnn_tpu.obs.fleet import FleetCollector, targets_from_config
 
@@ -385,6 +542,27 @@ def main(argv=None) -> int:
                          "here (one-shot mode)")
     fz.add_argument("--id", dest="trace_id", default=None,
                     help="restrict the report/stitch to one trace id")
+    tl = sub.add_parser("timeline", help="step-timeline attribution: "
+                        "/stepz fetch + device-capture analysis "
+                        "(obs/timeline.py)")
+    tl.add_argument("path", nargs="?", default=None,
+                    help="capture dir (POST /profilez result) or "
+                         "*.trace.json[.gz] file to analyze")
+    tl.add_argument("--selftest", action="store_true",
+                    help="in-process smoke (deterministic clock + "
+                         "synthetic capture); exit 0 on pass")
+    tl.add_argument("--url", default=None,
+                    help="obs endpoint base URL to fetch /stepz from")
+    tl.add_argument("--out", default=None,
+                    help="with --url: write the step host track "
+                         "(?format=trace Perfetto JSON) here")
+    tl.add_argument("--last", type=int, default=None,
+                    help="bound the /stepz window to the newest N steps")
+    tl.add_argument("--json", action="store_true",
+                    help="print the raw analysis dict instead of the "
+                         "report")
+    tl.add_argument("--top", type=int, default=10,
+                    help="top-K device ops to report (default 10)")
     args = ap.parse_args(argv)
 
     if args.cmd == "trace":
@@ -404,6 +582,15 @@ def main(argv=None) -> int:
         if args.selftest:
             return _fleet_selftest()
         return _fleet_cmd(args)
+    if args.cmd == "timeline":
+        if args.selftest:
+            return _timeline_selftest()
+        if args.url:
+            return _timeline_url(args.url, args.out, args.last)
+        if args.path:
+            return _timeline_path(args.path, args.json, args.top)
+        ap.error("timeline needs --selftest, --url URL, or a capture "
+                 "PATH")
     return 2
 
 

@@ -117,12 +117,19 @@ def recorder() -> FlightRecorder:
     return _recorder
 
 
+_obs = None  # lazy: breaks the obs<->flight import cycle once, not
+# per call — record() sits on per-admission/retirement hot paths
+
+
 def record(kind: str, **fields):
     """The producer entry point: appends to the shared ring when
     observability is on, else a single boolean check and out."""
-    from dnn_tpu import obs
+    global _obs
+    if _obs is None:
+        from dnn_tpu import obs as _o
 
-    if not obs.enabled():
+        _obs = _o
+    if not _obs.enabled():
         return None
     return _recorder.record(kind, **fields)
 

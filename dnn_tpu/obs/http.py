@@ -28,6 +28,14 @@ Prometheus scraper or a plain curl can watch the serving stack:
                        ?format=trace returns the stitched cross-host
                        Perfetto JSON, ?id=<trace> for one request;
                        ?format=report the human-readable text)
+    GET  /stepz        step-timeline attribution (obs/timeline.py) when
+                       a StepClock is attached: per-phase decode-step
+                       decomposition (admit/host/dispatch/wait/commit/
+                       obs), dispatch-slack, sync-tax, host fraction
+                       (JSON; ?format=prom re-renders as gauges,
+                       ?format=trace exports the last N steps as a
+                       Perfetto-loadable host track, ?last=N bounds
+                       the window)
     GET  /trace        Chrome-trace JSON of collected spans; ?id=<trace>
                        filters to one request's tree (load the response
                        in Perfetto / chrome://tracing)
@@ -99,7 +107,8 @@ class MetricsHTTPServer:
                  healthy: Optional[Callable[[], bool]] = None,
                  status: Optional[Callable[[], dict]] = None,
                  profiler=None, flight=None, fleet=None,
-                 drain: Optional[Callable[[], dict]] = None):
+                 drain: Optional[Callable[[], dict]] = None,
+                 stepclock=None):
         from dnn_tpu import obs
         from dnn_tpu.obs import flight as _flight
         from dnn_tpu.utils import metrics as _metrics
@@ -121,6 +130,8 @@ class MetricsHTTPServer:
         # POST /drainz (connection draining, ISSUE 8): the serving
         # process's drain kicker — idempotent, returns drain state
         self._drain = drain
+        # step-timeline clock (obs/timeline.StepClock): serves /stepz
+        self._stepclock = stepclock
         if fleet is not None and status is None:
             self._status = fleet.status
         outer = self
@@ -192,6 +203,38 @@ class MetricsHTTPServer:
                                "(json|prom|trace|report)\n",
                                "text/plain; charset=utf-8")
 
+            def _stepz(self, q):
+                if outer._stepclock is None:
+                    self._send(404, "no step clock attached\n",
+                               "text/plain; charset=utf-8")
+                    return
+                last = None
+                if "last" in q:
+                    try:
+                        last = int(q["last"][0])
+                    except ValueError:
+                        last = 0
+                    if last < 1:
+                        # a negative slice bound would silently invert
+                        # the window (newest-N becomes all-but-oldest-N)
+                        self._send(400, "last must be an int >= 1\n",
+                                   "text/plain; charset=utf-8")
+                        return
+                fmt = q.get("format", ["json"])[0]
+                if fmt == "json":
+                    self._send_json(200, outer._stepclock.summary(last))
+                elif fmt == "prom":
+                    self._send(200, outer._stepclock.render_prom(last),
+                               "text/plain; version=0.0.4; charset=utf-8")
+                elif fmt == "trace":
+                    self._send(200, json.dumps(
+                        outer._stepclock.chrome_trace(last)),
+                        "application/json")
+                else:
+                    self._send(400, f"unknown format {fmt!r} "
+                               "(json|prom|trace)\n",
+                               "text/plain; charset=utf-8")
+
             def do_GET(self):
                 try:
                     url = urlparse(self.path)
@@ -247,6 +290,8 @@ class MetricsHTTPServer:
                                        "text/plain; charset=utf-8")
                     elif url.path == "/fleetz":
                         self._fleetz(q)
+                    elif url.path == "/stepz":
+                        self._stepz(q)
                     elif url.path == "/profilez":
                         if outer._profiler is None:
                             self._send(404, "no profiler attached\n",

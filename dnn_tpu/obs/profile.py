@@ -29,12 +29,20 @@ auto trigger) serialize on a module lock, with the loser failing fast
 The spool is bounded (default 8 captures): oldest captures are deleted
 as new ones land, so a long-lived daemon with a trigger-happy operator
 cannot fill the disk.
+
+Every obs-driven capture writes a sidecar `meta.json` at the capture
+root — monotonic (perf_counter) begin/end, wall-clock bounds, the
+StepClock step-counter range, and the backend — so
+`obs/timeline.analyze()` can place the capture on the decode-step axis
+(which steps the window covers, and how much of each the device was
+busy for).
 """
 
 from __future__ import annotations
 
 import contextlib
 import glob
+import json
 import os
 import shutil
 import threading
@@ -106,6 +114,33 @@ def mark_recording() -> Iterator[None]:
         _capturing = prev
 
 
+def _step_counter() -> Optional[int]:
+    """The active StepClock's step counter (obs/timeline.py), or None
+    when no clock is installed — guarded so a broken clock can never
+    cost a capture."""
+    try:
+        from dnn_tpu.obs.timeline import active_clock
+
+        clk = active_clock()
+        return None if clk is None else int(clk.steps_total)
+    except Exception:  # noqa: BLE001 — meta is best-effort
+        return None
+
+
+def _write_meta(path: str, meta: dict):
+    """Sidecar `meta.json` at the capture root: monotonic begin/end
+    (perf_counter — the clock StepClock records on), wall-clock
+    bounds, the step-counter range, and the backend. This is what lets
+    `timeline.analyze()` place a spooled capture on the step axis —
+    without it a capture floats free of the step stream entirely.
+    Best-effort: an unwritable spool loses the meta, never the trace."""
+    try:
+        with open(os.path.join(path, "meta.json"), "w") as f:
+            json.dump(meta, f)
+    except OSError:
+        pass
+
+
 @contextlib.contextmanager
 def _traced(capture_root: Optional[str], keep: int) -> Iterator[str]:
     """Exclusive start_trace/stop_trace around the body; yields the
@@ -120,13 +155,28 @@ def _traced(capture_root: Optional[str], keep: int) -> Iterator[str]:
         root = capture_root or spool_dir()
         path = os.path.join(root, f"capture-{int(time.time() * 1e3):x}")
         os.makedirs(path, exist_ok=True)
+        try:
+            backend = jax.default_backend()
+        except Exception:  # noqa: BLE001 — a wedged backend still traces
+            backend = None
         jax.profiler.start_trace(path)
+        # perf_begin lands right after start_trace returns: the trace's
+        # ts axis starts ~here, so (perf_counter - perf_begin) maps a
+        # StepClock timestamp onto the capture's microsecond axis
+        meta = {"perf_begin": time.perf_counter(),
+                "t_begin_unix": time.time(),
+                "step_begin": _step_counter(),
+                "backend": backend}
         _capturing = True
         try:
             yield path
         finally:
             _capturing = False
+            meta["perf_end"] = time.perf_counter()
+            meta["t_end_unix"] = time.time()
+            meta["step_end"] = _step_counter()
             jax.profiler.stop_trace()
+            _write_meta(path, meta)
             try:
                 keep_n = int(os.environ["DNN_TPU_OBS_PROFILE_KEEP"])
             except (KeyError, ValueError):

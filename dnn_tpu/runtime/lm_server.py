@@ -743,19 +743,31 @@ class LMServer:
             install_memory_gauges()
         self.metrics_server = None
         self._watchdog = None
+        # step-timeline attribution (obs/timeline.py): the daemon's
+        # decode steps feed a StepClock — /stepz serves the per-phase
+        # decomposition, /statusz gains a `step` component, and the
+        # profiler's sidecar meta records this clock's step-counter
+        # range so a capture aligns to the step axis. Auto-built like
+        # the goodput tracker; off with the obs gate.
+        self.step_clock = None
+        if obs.enabled():
+            from dnn_tpu.obs.timeline import StepClock
+
+            self.step_clock = StepClock().install()
         if metrics_port is not None:
             from dnn_tpu.obs.profile import Profiler
 
-            # /metrics /trace /debugz /statusz /profilez endpoint;
-            # /healthz mirrors HealthCheck, then degrades through the
-            # watchdog's ok|degraded|wedged when one is attached
+            # /metrics /trace /debugz /statusz /stepz /profilez
+            # endpoint; /healthz mirrors HealthCheck, then degrades
+            # through the watchdog's ok|degraded|wedged when attached
             self.metrics_server = obs.serve_metrics(
                 metrics_port,
                 healthy=lambda: (w := getattr(self, "worker", None))
                 is not None and w.is_alive() and not self._draining,
                 status=self._statusz,
                 profiler=Profiler(arm_target=self),
-                drain=self._drainz)
+                drain=self._drainz,
+                stepclock=self.step_clock)
         try:
             self._init_rest(
                 cfg, prepared, default_max_new=default_max_new,
@@ -850,6 +862,8 @@ class LMServer:
         if self.goodput is not None:
             self.batcher.goodput = self.goodput
             self.worker.goodput = self.goodput
+        if self.step_clock is not None:
+            self.batcher.step_clock = self.step_clock
 
     @property
     def auto_profile(self):
@@ -867,9 +881,31 @@ class LMServer:
         (one fallback, not two drifting copies; obs/http.py). A DRAINING
         server overlays the `draining` state (unless already wedged) so
         routers/fleet collectors stop sending it work while in-flight
-        decodes finish."""
+        decodes finish. Once the pool has stepped, a `step` component
+        overlays the step clock's summary (last step duration, host
+        fraction, steps/sec) so an operator can tell slow-but-healthy
+        from wedged without pulling a profile — it reads the SAME
+        worker loop the watchdog's decode heartbeat beats from, so
+        their recency agrees; the component is informational (state
+        "ok"), escalation stays the watchdog's."""
         s = self._watchdog.status() if self._watchdog is not None \
             else None
+        sc = self.step_clock
+        if sc is not None and sc.steps_total:
+            if s is None:
+                # no watchdog: synthesize the handler's worker-liveness
+                # shape here so the step component still has a home
+                alive = (w := getattr(self, "worker", None)) is not None \
+                    and w.is_alive()
+                s = {"state": "ok" if alive else "wedged",
+                     "components": {"worker": {
+                         "state": "ok" if alive else "wedged",
+                         "detail": "serving worker thread liveness"}}}
+            else:
+                s = dict(s)
+            comps = dict(s.get("components") or {})
+            comps["step"] = sc.status_component()
+            s["components"] = comps
         if not self._draining:
             return s
         s = dict(s) if s is not None else {"state": "ok", "components": {}}

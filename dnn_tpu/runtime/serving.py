@@ -552,6 +552,15 @@ class ContinuousBatcher:
         # Set post-construction (`pool.goodput = tracker`) — LMServer
         # auto-builds one from its model config.
         self.goodput = None
+        # step-timeline attribution (obs/timeline.StepClock): splits
+        # every decode step into named phases (admit/host/dispatch/
+        # wait/commit/obs) for the /stepz endpoint and the item-4
+        # host-serialization ratchet. Attached post-construction like
+        # goodput (`pool.step_clock = StepClock().install()` — LMServer
+        # auto-builds one); unset it costs one attribute read per step,
+        # and the clock itself gates on DNN_TPU_OBS (begin() returns
+        # None when off).
+        self.step_clock = None
         # scrape-time callable gauges, (re-)registered with every bulk
         # update below: the most recently ACTIVE pool owns the series —
         # a once-only registration would let a dead pool keep reporting,
@@ -859,6 +868,11 @@ class ContinuousBatcher:
         per-bucket "decode" span until the request retires. None (the
         default) skips all span work; metrics counters are recorded
         either way when observability is on."""
+        # step-timeline: this submit's whole wall (validation, slot
+        # install, prefill chunks, first-token sample) is the "admit"
+        # phase, attached to the NEXT step's record in note_admit
+        _sc = self.step_clock
+        _t_sub = time.perf_counter() if _sc is not None else 0.0
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if len(prompt) == 0:
             raise ValueError("prompt must have at least one token")
@@ -1278,6 +1292,8 @@ class ContinuousBatcher:
             raise
         finally:
             adm.end()
+            if _sc is not None:
+                _sc.note_admit(_t_sub)
 
     def _ensure_cache_len(self, need: int):
         """Grow the bucketed dense pool to the smallest ladder bucket
@@ -1477,12 +1493,17 @@ class ContinuousBatcher:
         self._tps.add(n_adv)
         # memory high-waters, maintained at step end (slots is small, so
         # this stays inside the bulk-update budget): the gauges above
-        # read them at scrape time
-        live = sum(r["prompt_len"] + len(r["emitted"])
-                   for r in self._slot_req if r is not None)
+        # read them at scrape time. One pass over the slots for both
+        # live positions and the active count — this runs every step,
+        # and the obs_overhead contract prices a second genexpr sweep.
+        live = 0
+        n_act = 0
+        for r in self._slot_req:
+            if r is not None:
+                live += r["prompt_len"] + len(r["emitted"])
+                n_act += 1
         if live > self._kv_live_hw:
             self._kv_live_hw = live
-        n_act = self.n_active
         if n_act > self._active_hw:
             self._active_hw = n_act
         m.bulk(
@@ -1666,6 +1687,11 @@ class ContinuousBatcher:
         for slots that advanced; finished requests move to .results."""
         if self.n_active == 0:
             return {}
+        # step-timeline phase clock (obs/timeline.py): rec is None when
+        # no clock is attached OR the obs gate is off — every later
+        # site is one None check
+        sc = self.step_clock
+        rec = sc.begin() if sc is not None else None
         if self._buckets is not None:
             # this step writes each active slot's next position
             # (prompt_len + emitted-so-far); cover the furthest one
@@ -1675,6 +1701,8 @@ class ContinuousBatcher:
         if self._crow_dirty:
             self._crow = jnp.asarray(self._crow_np)
             self._crow_dirty = False
+        if rec is not None:
+            rec.marks.append(("host", time.perf_counter()))
         # host annotation: a POST /profilez capture shows each pool step
         # as a named block on the host track (obs/profile.annotation_ctx
         # — the non-generator form; ~6 µs on / ~0.2 µs off, inside the
@@ -1686,6 +1714,8 @@ class ContinuousBatcher:
                 self._minp, self._rep, self._seen, self._bias, self._crow,
                 self._ctable,
             )
+        if rec is not None:
+            rec.marks.append(("dispatch", time.perf_counter()))
         if self._logprobs_k:
             (self.cache, self.pos, self.tok, self.keys, self._seen,
              c_lp, t_lp, t_ids) = res
@@ -1694,6 +1724,10 @@ class ContinuousBatcher:
         else:
             self.cache, self.pos, self.tok, self.keys, self._seen = res
         toks = np.asarray(self.tok)
+        if rec is not None:
+            # the np.asarray above is the per-token device->host sync:
+            # dispatch-return -> committed-tokens-on-host is the "wait"
+            rec.marks.append(("wait", time.perf_counter()))
         m = obs.metrics()
         t_now = time.perf_counter() if m is not None else 0.0
         n_adv = 0
@@ -1716,7 +1750,12 @@ class ContinuousBatcher:
                 self._constraint_advance(slot, token)
             self._free_rolled_blocks(slot)  # windowed pools reclaim
             self._retire_if_done(slot)
+        if rec is not None:
+            rec.marks.append(("commit", time.perf_counter()))
         self._obs_step_end(m, n_adv, it_samples)
+        if rec is not None:
+            rec.marks.append(("obs", time.perf_counter()))
+            sc.end(rec, n_adv)
         return out
 
     def drain(self) -> Dict[int, np.ndarray]:

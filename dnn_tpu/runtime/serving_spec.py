@@ -421,6 +421,11 @@ class SpeculativeBatcher(ContinuousBatcher):
         1..k+1 committed tokens. Returns {rid: [tokens...]}."""
         if self.n_active == 0:
             return {}
+        # step-timeline clock: same phase protocol as the dense step
+        # (serving.ContinuousBatcher.step) — one speculative step's
+        # "wait" is the draft+verify chunk's device->host sync
+        sc = self.step_clock
+        rec = sc.begin() if sc is not None else None
         if self._buckets is not None:
             # this step verifies at pos..pos+k for every active slot
             # (pos = prompt_len + emitted - 1); _ensure_cache_len adds
@@ -428,12 +433,18 @@ class SpeculativeBatcher(ContinuousBatcher):
             self._ensure_cache_len(max(
                 req["prompt_len"] + len(req["emitted"])
                 for req in self._slot_req if req is not None))
+        if rec is not None:
+            rec.marks.append(("host", time.perf_counter()))
         (self.cache, self.d_cache, self.tok, self.pos, self.keys,
          self.prev_chunk, self.prev_pos, w, m) = self._spec_step(
             self.prepared, self.draft_prepared, self.cache, self.d_cache,
             self.tok, self.pos, self.active, self.keys,
             self.prev_chunk, self.prev_pos)
+        if rec is not None:
+            rec.marks.append(("dispatch", time.perf_counter()))
         w_np, m_np = np.asarray(w), np.asarray(m)
+        if rec is not None:
+            rec.marks.append(("wait", time.perf_counter()))
         self.spec_steps += 1
         from dnn_tpu import obs
 
@@ -466,5 +477,10 @@ class SpeculativeBatcher(ContinuousBatcher):
                 self._obs_commit(req, obs_m, t_now, n_new=len(emitted),
                                  samples=it_samples)
             out[req["rid"]] = emitted
+        if rec is not None:
+            rec.marks.append(("commit", time.perf_counter()))
         self._obs_step_end(obs_m, n_adv, it_samples)
+        if rec is not None:
+            rec.marks.append(("obs", time.perf_counter()))
+            sc.end(rec, n_adv)
         return out

@@ -1,0 +1,480 @@
+"""Step-timeline attribution (ISSUE 11): StepClock phase accounting,
+capture analysis, /stepz, and the sidecar-meta alignment.
+
+Covers the layer's contracts:
+  * phase sums cover the externally measured wall (no dark time);
+  * derived-series arithmetic (dispatch slack, sync tax, host
+    fraction) under a deterministic injected clock;
+  * admit attribution from real submit() calls;
+  * the one-None-check gate (DNN_TPU_OBS off -> begin() is None and a
+    stepped pool records nothing);
+  * analyze() goldens over synthetic Perfetto JSON, including
+    truncated/garbage inputs failing loud;
+  * step<->capture alignment via the profile.py sidecar meta;
+  * /stepz scrape (JSON + ?format=prom + ?format=trace);
+  * CLI smoke (`python -m dnn_tpu.obs timeline --selftest`).
+"""
+
+import gzip
+import json
+import os
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from dnn_tpu import obs
+from dnn_tpu.obs import timeline as tl
+from dnn_tpu.obs.timeline import PHASES, StepClock, analyze
+from dnn_tpu.utils.metrics import Metrics
+
+
+@pytest.fixture(autouse=True)
+def _obs_on():
+    was = obs.enabled()
+    obs.set_enabled(True)
+    yield
+    obs.set_enabled(was)
+
+
+def _fake_clock(**kw):
+    """StepClock on an injected, manually advanced clock."""
+    t = [100.0]
+    clk = StepClock(registry=kw.pop("registry", Metrics()),
+                    now=lambda: t[0], **kw)
+    return clk, t
+
+
+def _drive(clk, t, *, admit=0.0, host=0.001, dispatch=0.002, wait=0.004,
+           commit=0.001, obs_p=0.001, n_adv=4):
+    if admit:
+        t[0] += admit
+        clk.note_admit(t[0] - admit)
+    rec = clk.begin()
+    assert rec is not None
+    for phase, dt in (("host", host), ("dispatch", dispatch),
+                      ("wait", wait), ("commit", commit),
+                      ("obs", obs_p)):
+        t[0] += dt
+        clk.mark(rec, phase)
+    clk.end(rec, n_adv=n_adv)
+    return rec
+
+
+# ----------------------------------------------------------------------
+# StepClock arithmetic (deterministic injected clock)
+# ----------------------------------------------------------------------
+
+def test_derived_series_arithmetic():
+    clk, t = _fake_clock()
+    for _ in range(4):
+        _drive(clk, t, admit=0.0005)
+        t[0] += 0.001  # inter-step gap, deliberately dark
+    s = clk.summary()
+    assert s["window_steps"] == 4 and s["steps_total"] == 4
+    # per step: wall 9.5 ms (9 in-step + 0.5 admit), host 3.5, device 6
+    assert s["host_fraction"] == pytest.approx(3.5 / 9.5, abs=1e-3)
+    assert s["dispatch_slack"] == pytest.approx(3.5 / 6.0, abs=1e-3)
+    assert s["sync_tax"] == pytest.approx(4.0 / 9.5, abs=1e-3)
+    assert s["phases"]["wait"]["mean_ms"] == pytest.approx(4.0, abs=1e-6)
+    assert s["tokens"] == 16
+
+
+def test_dispatch_slack_tracks_injected_device_time():
+    """A slower fake device (longer wait) must LOWER the slack — host
+    work unchanged, more device time to hide it under."""
+    fast, tf = _fake_clock()
+    slow, ts = _fake_clock()
+    _drive(fast, tf, wait=0.002)
+    _drive(slow, ts, wait=0.020)
+    assert slow.dispatch_slack() < fast.dispatch_slack()
+    assert slow.sync_tax() > fast.sync_tax()
+    # exact: host 3 ms over device (2 + dispatch 2) vs (20 + 2)
+    assert fast.dispatch_slack() == pytest.approx(0.003 / 0.004, 1e-6)
+    assert slow.dispatch_slack() == pytest.approx(0.003 / 0.022, 1e-6)
+
+
+def test_ring_bounded_and_records():
+    clk, t = _fake_clock(capacity=4)
+    for _ in range(9):
+        _drive(clk, t)
+    assert clk.steps_total == 9
+    recs = clk.records()
+    assert len(recs) == 4  # bounded
+    assert all(set(r["phases"]) == set(PHASES) - {"admit"} for r in recs)
+    assert clk.records(last=2)[-1]["t0"] == recs[-1]["t0"]
+
+
+def test_registry_histograms_and_gauges_land():
+    reg = Metrics()
+    clk, t = _fake_clock(registry=reg)
+    for _ in range(3):
+        _drive(clk, t)
+    clk.flush()  # batched flush: tests force it (FLUSH_EVERY is 32)
+    snap = reg.snapshot()
+    assert snap["counters"]["step.steps_total"] == 3
+    h = snap["histogram"]['step.phase_seconds{phase="wait"}']
+    assert h["count"] == 3
+    assert snap["histogram"]["step.wall_seconds"]["count"] == 3
+    assert snap["gauges"]["step.host_fraction"] == pytest.approx(
+        3.0 / 9.0, abs=1e-3)  # no admits in this test
+    # render carries the step family for scrapers
+    from dnn_tpu.utils.metrics import render_prometheus
+
+    text = render_prometheus(reg)
+    assert "step_phase_seconds_bucket" in text
+    assert "step.host_fraction".replace(".", "_") in text
+
+
+def test_summary_flushes_pending():
+    """A scrape must never read a stale histogram: summary() flushes
+    the batch even below FLUSH_EVERY."""
+    reg = Metrics()
+    clk, t = _fake_clock(registry=reg)
+    _drive(clk, t)
+    assert reg.snapshot()["counters"].get("step.steps_total") is None
+    clk.summary()
+    assert reg.snapshot()["counters"]["step.steps_total"] == 1
+
+
+def test_chrome_trace_phase_slices():
+    clk, t = _fake_clock()
+    _drive(clk, t, admit=0.0005)
+    _drive(clk, t)
+    ct = clk.chrome_trace()
+    xs = [e for e in ct["traceEvents"] if e.get("ph") == "X"]
+    assert len(xs) == 11  # 5 phases x 2 steps + 1 admit slice
+    names = [e["name"] for e in xs if e["args"].get("step") == 0]
+    assert names[0] == "admit"
+    # in-step slices are contiguous: each starts where the last ended
+    step0 = [e for e in xs if e["args"].get("step") == 0
+             and e["name"] != "admit"]
+    for a, b in zip(step0, step0[1:]):
+        assert b["ts"] == pytest.approx(a["ts"] + a["dur"], abs=1e-3)
+    assert {e["name"] for e in ct["traceEvents"]
+            if e.get("ph") == "M"} == {"process_name", "thread_name"}
+
+
+def test_metrics_bulk_hists():
+    m = Metrics()
+    m.bulk(hists={"x_seconds": [0.1, 0.2]}, hist_buckets=(0.15, 1.0))
+    snap = m.snapshot()["histogram"]["x_seconds"]
+    assert snap["count"] == 2
+    assert snap["buckets"][0.15] == 1  # 0.1 below, 0.2 above
+
+
+# ----------------------------------------------------------------------
+# the instrumented pool (real batcher)
+# ----------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def pool():
+    import jax
+
+    from dnn_tpu.models import gpt
+    from dnn_tpu.runtime.serving import ContinuousBatcher
+
+    cfg = gpt.GPTConfig(block_size=32, vocab_size=128, n_layer=2,
+                        n_head=2, n_embd=64)
+    prepared = gpt.prepare_stacked(gpt.init(jax.random.PRNGKey(0), cfg),
+                                   cfg)
+    return ContinuousBatcher(cfg, prepared, slots=2, max_len=32,
+                             prompt_pad=8)
+
+
+def _round(srv, new_tokens=12):
+    for i in range(srv.slots):
+        srv.submit(np.arange(1, 5), new_tokens, seed=i)
+    srv.drain()
+    srv.results.clear()
+    srv.finish_reasons.clear()
+
+
+def test_phase_sum_covers_measured_wall(pool):
+    """The probe's coverage assertion in miniature: attributed seconds
+    vs an EXTERNAL wall clock around the round. The bound here is
+    loose (0.85) because this pool's sub-ms steps make the python loop
+    glue proportionally larger than the probe's asserted standard
+    config — the 0.95 floor is asserted by step_timeline_probe."""
+    clock = StepClock(capacity=1024)
+    pool.step_clock = clock
+    try:
+        _round(pool)  # warm/compile outside the measured window
+        base = clock.steps_total
+        t0 = time.perf_counter()
+        _round(pool)
+        wall = time.perf_counter() - t0
+        n = clock.steps_total - base
+        assert n >= 10
+        recs = clock.records()[-n:]
+        attributed = sum(r["wall"] for r in recs)
+        assert attributed <= wall * 1.001  # can't attribute time that
+        # didn't pass
+        assert attributed / wall >= 0.85, (attributed, wall)
+        # every in-step phase present on every record
+        for r in recs:
+            assert set(r["phases"]) >= {"host", "dispatch", "wait",
+                                        "commit", "obs"}, r
+    finally:
+        pool.step_clock = None
+
+
+def test_admit_attributed_to_next_step(pool):
+    clock = StepClock(capacity=64)
+    pool.step_clock = clock
+    try:
+        pool.submit(np.arange(1, 5), 4, seed=0)
+        pool.step()
+        recs = clock.records()
+        assert recs, "step must record"
+        first = recs[-1]
+        assert first["phases"].get("admit", 0.0) > 0.0
+        assert first["admit_slices"], first
+        # the admit slice predates the step's own t0
+        a0, a1 = first["admit_slices"][0]
+        assert a0 < a1 <= first["t0"] + 1e-3
+        pool.drain()
+        pool.results.clear()
+        pool.finish_reasons.clear()
+    finally:
+        pool.step_clock = None
+
+
+def test_gate_off_records_nothing(pool):
+    clock = StepClock(capacity=64)
+    pool.step_clock = clock
+    try:
+        obs.set_enabled(False)
+        assert clock.begin() is None  # the one-None-check gate
+        pool.submit(np.arange(1, 5), 4, seed=0)
+        pool.drain()
+        pool.results.clear()
+        pool.finish_reasons.clear()
+        assert clock.steps_total == 0
+        assert clock.records() == []
+        obs.set_enabled(True)  # re-enable takes effect immediately
+        _round(pool, new_tokens=4)
+        assert clock.steps_total > 0
+    finally:
+        pool.step_clock = None
+
+
+def test_statusz_step_component(pool):
+    clock = StepClock(capacity=64)
+    pool.step_clock = clock
+    try:
+        _round(pool, new_tokens=4)
+        comp = clock.status_component()
+        assert comp["state"] == "ok"
+        assert comp["steps_total"] == clock.steps_total
+        assert comp["last_wall_ms"] > 0
+        assert comp["last_step_age_s"] >= 0
+        assert "host fraction" in comp["detail"]
+    finally:
+        pool.step_clock = None
+
+
+# ----------------------------------------------------------------------
+# analyze(): synthetic capture goldens
+# ----------------------------------------------------------------------
+
+def _synthetic_trace(tmp_path, *, gz=True, meta=None, n_steps=3,
+                     step_ms=10.0, busy_ms=6.0, lead_ms=1.5):
+    """One 6 ms device op per 10 ms step, plus track metadata — the
+    deterministic shape the selftest also pins."""
+    events = [
+        {"ph": "M", "pid": 7, "name": "process_name",
+         "args": {"name": "/host:CPU"}},
+        {"ph": "M", "pid": 7, "tid": 2, "name": "thread_name",
+         "args": {"name": "tf_XLATfrtCpuClient"}},
+        {"ph": "M", "pid": 7, "tid": 1, "name": "thread_name",
+         "args": {"name": "python"}},
+    ]
+    for i in range(n_steps):
+        events.append({"ph": "X", "pid": 7, "tid": 2, "name": "fusion.1",
+                       "ts": (lead_ms + step_ms * i) * 1e3,
+                       "dur": busy_ms * 1e3,
+                       "args": {"hlo_op": "fusion.1"}})
+    # a host-python event must NOT count as device time
+    events.append({"ph": "X", "pid": 7, "tid": 1, "name": "step()",
+                   "ts": 0.0, "dur": n_steps * step_ms * 1e3})
+    doc = {"traceEvents": events, "displayTimeUnit": "ns"}
+    name = "vm.trace.json.gz" if gz else "vm.trace.json"
+    p = os.path.join(tmp_path, name)
+    if gz:
+        with gzip.open(p, "wt") as f:
+            json.dump(doc, f)
+    else:
+        with open(p, "w") as f:
+            json.dump(doc, f)
+    if meta is not None:
+        with open(os.path.join(tmp_path, "meta.json"), "w") as f:
+            json.dump(meta, f)
+    return p
+
+
+def test_analyze_synthetic_golden(tmp_path):
+    d = str(tmp_path)
+    _synthetic_trace(d)
+    a = analyze(d)  # dir form resolves the trace file itself
+    assert a["device"]["ops"] == 3
+    assert a["device"]["busy_s"] == pytest.approx(0.018, abs=1e-9)
+    # window = event span (no meta): 1.5 .. 27.5 ms -> 26 ms? no: the
+    # host event spans 0..30 ms, so the window is 30 ms
+    assert a["window_s"] == pytest.approx(0.030, abs=1e-6)
+    assert a["device"]["busy_frac"] == pytest.approx(0.6, abs=1e-3)
+    assert a["host_gaps"]["count"] == 2
+    assert a["host_gaps"]["p50_ms"] == pytest.approx(4.0, abs=1e-3)
+    assert a["top_ops"][0]["name"] == "fusion.1"
+    assert a["top_ops"][0]["frac_of_device"] == pytest.approx(1.0)
+    # host python track exists and is distinct from the device ops
+    assert any("python" in k for k in a["tracks"])
+    assert a["steps"] is None  # no meta -> no step section
+
+
+def test_analyze_plain_json_equals_gzip(tmp_path):
+    d1, d2 = tmp_path / "a", tmp_path / "b"
+    d1.mkdir()
+    d2.mkdir()
+    pg = _synthetic_trace(str(d1), gz=True)
+    pj = _synthetic_trace(str(d2), gz=False)
+    ag, aj = analyze(pg), analyze(pj)
+    for k in ("window_s", "events"):
+        assert ag[k] == aj[k]
+    assert ag["device"] == aj["device"]
+
+
+def test_analyze_rejects_garbage_and_truncated(tmp_path):
+    bad = tmp_path / "bad.json"
+    bad.write_text("definitely { not json")
+    with pytest.raises(ValueError):
+        analyze(str(bad))
+    # truncated gzip: a valid header with a cut-off body
+    good = _synthetic_trace(str(tmp_path))
+    data = open(good, "rb").read()
+    trunc = tmp_path / "trunc.trace.json.gz"
+    trunc.write_bytes(data[: len(data) // 2])
+    with pytest.raises(ValueError):
+        analyze(str(trunc))
+    # valid JSON, wrong shape
+    shape = tmp_path / "shape.json"
+    shape.write_text(json.dumps({"notTraceEvents": []}))
+    with pytest.raises(ValueError):
+        analyze(str(shape))
+    # empty dir
+    empty = tmp_path / "emptydir"
+    empty.mkdir()
+    with pytest.raises(ValueError):
+        analyze(str(empty))
+
+
+def test_step_capture_alignment_via_meta(tmp_path):
+    """Synthetic meta + synthetic clock records: each 10 ms step holds
+    one 6 ms device op -> per-step overlap 6/10, steps_in_capture from
+    the counter range."""
+    d = str(tmp_path)
+    _synthetic_trace(d, meta={"perf_begin": 100.0, "perf_end": 100.032,
+                              "step_begin": 5, "step_end": 8,
+                              "backend": "cpu"})
+    clk, t = _fake_clock()
+    t[0] = 100.0015  # first step entry aligns with the first device op
+    for _ in range(3):
+        _drive(clk, t, host=0.0, dispatch=0.002, wait=0.004,
+               commit=0.002, obs_p=0.002)  # wall 10 ms
+        # no gap: steps are back to back like the synthetic ops
+    a = analyze(d, clock=clk)
+    st = a["steps"]
+    assert st["aligned"] and st["n_steps"] == 3
+    assert st["steps_in_capture"] == 3
+    assert st["backend"] == "cpu"
+    assert st["mean_wall_ms"] == pytest.approx(10.0, abs=1e-3)
+    assert st["mean_device_busy_ms"] == pytest.approx(6.0, abs=1e-2)
+    assert st["device_overlap_frac"] == pytest.approx(0.6, abs=1e-3)
+    # with meta, the window is the ARMED window, not the event span
+    assert a["window_s"] == pytest.approx(0.032, abs=1e-6)
+
+
+def test_real_capture_sidecar_meta_and_alignment(pool, tmp_path):
+    """End to end on a REAL jax.profiler capture: profile.py writes the
+    sidecar meta (perf bounds, step range, backend), and analyze()
+    places the pool's steps inside it."""
+    from dnn_tpu.obs.profile import capture_step
+
+    clock = StepClock(capacity=1024).install()
+    pool.step_clock = clock
+    try:
+        _round(pool, new_tokens=6)  # warm
+        before = clock.steps_total
+        path, _ = capture_step(lambda: _round(pool, new_tokens=6),
+                               capture_root=str(tmp_path))
+        meta = json.load(open(os.path.join(path, "meta.json")))
+        assert meta["step_begin"] == before
+        assert meta["step_end"] == clock.steps_total
+        assert meta["perf_end"] > meta["perf_begin"]
+        assert meta["backend"] == "cpu"
+        a = analyze(path, clock=clock)
+        st = a["steps"]
+        assert st["aligned"], st
+        assert st["n_steps"] == clock.steps_total - before
+        assert 0.0 < st["device_overlap_frac"] <= 1.0
+        assert a["device"]["ops"] > 0
+    finally:
+        pool.step_clock = None
+
+
+# ----------------------------------------------------------------------
+# /stepz + CLI
+# ----------------------------------------------------------------------
+
+def test_stepz_endpoint_json_prom_trace():
+    clk, t = _fake_clock()
+    for _ in range(3):
+        _drive(clk, t, admit=0.0005)
+    srv = obs.serve_metrics(0, stepclock=clk)
+    try:
+        base = f"http://127.0.0.1:{srv.port}/stepz"
+        s = json.loads(urllib.request.urlopen(base, timeout=10).read())
+        assert s["window_steps"] == 3
+        assert s["phases"]["wait"]["mean_ms"] == pytest.approx(4.0)
+        prom = urllib.request.urlopen(base + "?format=prom",
+                                      timeout=10).read().decode()
+        assert "dnn_tpu_step_host_fraction" in prom
+        assert 'dnn_tpu_step_phase_frac{phase="wait"}' in prom
+        ct = json.loads(urllib.request.urlopen(
+            base + "?format=trace&last=2", timeout=10).read())
+        xs = [e for e in ct["traceEvents"] if e.get("ph") == "X"]
+        assert len(xs) == 12  # 2 steps x (5 phases + admit)
+        code = urllib.request.urlopen(
+            base + "?format=nope", timeout=10)
+    except urllib.error.HTTPError as e:
+        assert e.code == 400
+    finally:
+        srv.close()
+
+
+def test_stepz_404_without_clock():
+    srv = obs.serve_metrics(0)
+    try:
+        urllib.request.urlopen(f"http://127.0.0.1:{srv.port}/stepz",
+                               timeout=10)
+        raise AssertionError("expected 404")
+    except urllib.error.HTTPError as e:
+        assert e.code == 404
+    finally:
+        srv.close()
+
+
+def test_cli_selftest_and_path(tmp_path, capsys):
+    from dnn_tpu.obs.__main__ import main
+
+    assert main(["timeline", "--selftest"]) == 0
+    out = capsys.readouterr().out
+    assert "timeline selftest ok" in out
+    p = _synthetic_trace(str(tmp_path))
+    assert main(["timeline", p]) == 0
+    out = capsys.readouterr().out
+    assert "device: busy" in out and "fusion.1" in out
+    assert main(["timeline", p, "--json"]) == 0
+    parsed = json.loads(capsys.readouterr().out)
+    assert parsed["device"]["ops"] == 3
