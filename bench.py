@@ -270,7 +270,27 @@ def _refresh_results_table():
               "--resume", file=sys.stderr)
 
 
-def main():
+def main(argv=None):
+    import sys
+
+    args = list(sys.argv[1:] if argv is None else argv)
+    require = None
+    if "--require-substrate" in args:
+        # contract flag (ROADMAP item 5a prep): the round driver states
+        # the substrate this round's trajectory needs; a probe fallback
+        # then marks the row ok=false and exits nonzero instead of
+        # silently polluting the TPU trend with a CPU number
+        idx = args.index("--require-substrate")
+        try:
+            require = args[idx + 1]
+        except IndexError:
+            print("--require-substrate needs a value (tpu|cpu)",
+                  file=sys.stderr)
+            return 2
+        if require not in ("tpu", "cpu"):
+            print(f"--require-substrate must be tpu|cpu, got "
+                  f"{require!r}", file=sys.stderr)
+            return 2
     fell_back = not _backend_alive()
     if fell_back:
         # default (TPU) backend is wedged: force CPU before first use so
@@ -418,12 +438,28 @@ def main():
                                  "stale_tpu_reference")]
     if outcomes:
         row["flight_events"] = outcomes
+    rc = 0
+    if require is not None:
+        # the substrate contract decides the row's ok — a CPU-fallback
+        # round against --require-substrate tpu is a FAILED row (and a
+        # nonzero exit), never a silently-mislabeled data point
+        row["required_substrate"] = require
+        row["ok"] = row["round_substrate"] == require
+        if not row["ok"]:
+            rc = 1
+            row["note"] = (row.get("note", "") + "; " if row.get("note")
+                           else "") + (
+                f"required substrate '{require}' but the round ran on "
+                f"'{row['round_substrate']}'")
     print(json.dumps(row), flush=True)
     if not on_cpu:
         # headline is safely out; now spend the healthy chip on the full
         # canonical table (resume semantics — only missing/failed configs)
         _refresh_results_table()
+    return rc
 
 
 if __name__ == "__main__":
-    main()
+    import sys
+
+    sys.exit(main())

@@ -24,6 +24,11 @@ satellite: < 2% on a decode step). This probe measures it honestly:
     PER-STEP flight-recorder event (ON population only; production
     records per admission/retirement, so this bounds the flight path
     from above);
+  * interleaved admission (ISSUE 12, `prefill_chunk_tokens`) is LIVE:
+    each refill enqueues its prompts and the first timed steps after it
+    are MIXED steps (decode + folded prefill chunk + fused finish), so
+    the overhaul's new hot path — including the deferred first-token
+    commits — is priced under the same contract;
   * the step-timeline clock (ISSUE 11, obs/timeline.StepClock) is
     attached for BOTH populations the way the LM daemon attaches it:
     the ON population pays the full phase-mark + end-of-step
@@ -82,8 +87,15 @@ def _build():
                         n_head=4, n_embd=256)
     prepared = gpt.prepare_stacked(gpt.init(jax.random.PRNGKey(0), cfg),
                                    cfg)
+    # the ISSUE 12 hot path is what the daemon now serves, so the obs
+    # tax is priced on it: interleaved admissions (prefill_chunk_tokens)
+    # mean every refill's prompts fold into the first timed steps after
+    # it as MIXED steps — the new program shape rides the same <2%
+    # contract. overlap stays off here: the per-step A/B gate flip
+    # needs each timed step's work attributable to that step.
     return ContinuousBatcher(cfg, prepared, slots=SLOTS,
-                             max_len=cfg.block_size, prompt_pad=16)
+                             max_len=cfg.block_size, prompt_pad=16,
+                             prefill_chunk_tokens=16)
 
 
 def _fill(srv, traced: bool):

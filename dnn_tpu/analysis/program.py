@@ -46,7 +46,7 @@ __all__ = [
     "collective_signature", "check_branch_collectives", "baked_constants",
     "donation_report", "recompile_census", "audit_decode_paths",
     "audit_serving_decode", "audit_pipeline_programs", "audit_engine",
-    "run_program_audit",
+    "check_decode_program", "run_program_audit",
 ]
 
 _COLLECTIVE_PRIMS = {
@@ -287,6 +287,42 @@ def audit_decode_paths(cfg=None, *, batch: int = 2,
     }
 
 
+def check_decode_program(name, jit_fn, args, donate_idx, layer_elems,
+                         *, where_prefix: str = "runtime/serving.decode"
+                         ) -> Tuple[dict, List[Finding]]:
+    """Lower ONE serving decode-family program at `args` and apply the
+    ISSUE 6 gate to it: (a) every leaf of every donated arg must be
+    aliased to an output in the StableHLO (an un-aliased donation is a
+    silent full copy per step), and (b) zero cache-sized copies/
+    transposes beyond the aliased in-place update. Module-level so the
+    gate itself is testable: tests/test_overlap.py lowers a
+    deliberately un-aliased mixed-step variant through this helper and
+    asserts the findings fire."""
+    avals = jax.tree.map(
+        lambda x: jax.ShapeDtypeStruct(jnp.shape(x), x.dtype), args)
+    text = jit_fn.lower(*avals).as_text()
+    aliased = count_aliased(text)
+    expected = sum(len(jax.tree.leaves(args[i])) for i in donate_idx)
+    where = f"{where_prefix}[{name}]"
+    findings: List[Finding] = []
+    if aliased < expected:
+        findings.append(Finding(
+            rule="PRG003", path=where, line=0,
+            message=f"only {aliased}/{expected} donated buffers are "
+                    "aliased to outputs — un-aliased donations copy "
+                    "every decode step",
+            snippet=f"{name}: aliased={aliased} expected={expected}"))
+    copies = count_cache_sized(text, layer_elems)
+    if copies:
+        findings.append(Finding(
+            rule="PRG003", path=where, line=0,
+            message=f"decode step materializes cache-sized op(s) "
+                    f"beyond the donated in-place update: {copies}",
+            snippet=f"{name}: {copies}"))
+    return ({"aliased": aliased, "expected": expected,
+             "cache_sized_ops": copies}, findings)
+
+
 def audit_serving_decode(cfg=None, *, slots: int = 2,
                          max_len: int = 128) -> dict:
     """ISSUE 6 donation-coverage GATE over the SERVING decode programs:
@@ -312,28 +348,10 @@ def audit_serving_decode(cfg=None, *, slots: int = 2,
     report: Dict[str, dict] = {}
 
     def lower_and_check(name, jit_fn, args, donate_idx, layer_elems):
-        avals = jax.tree.map(
-            lambda x: jax.ShapeDtypeStruct(jnp.shape(x), x.dtype), args)
-        text = jit_fn.lower(*avals).as_text()
-        aliased = count_aliased(text)
-        expected = sum(len(jax.tree.leaves(args[i])) for i in donate_idx)
-        where = f"runtime/serving.decode[{name}]"
-        if aliased < expected:
-            findings.append(Finding(
-                rule="PRG003", path=where, line=0,
-                message=f"only {aliased}/{expected} donated buffers are "
-                        "aliased to outputs — un-aliased donations copy "
-                        "every decode step",
-                snippet=f"{name}: aliased={aliased} expected={expected}"))
-        copies = count_cache_sized(text, layer_elems)
-        if copies:
-            findings.append(Finding(
-                rule="PRG003", path=where, line=0,
-                message=f"decode step materializes cache-sized op(s) "
-                        f"beyond the donated in-place update: {copies}",
-                snippet=f"{name}: {copies}"))
-        report[name] = {"aliased": aliased, "expected": expected,
-                        "cache_sized_ops": copies}
+        entry, f = check_decode_program(name, jit_fn, args, donate_idx,
+                                        layer_elems)
+        findings.extend(f)
+        report[name] = entry
 
     def batcher_args(b):
         return (b._decode_view, b.cache, b.pos, b.tok, b.active, b.keys,
@@ -373,6 +391,83 @@ def audit_serving_decode(cfg=None, *, slots: int = 2,
     lower_and_check("speculative", sb._spec_step, sp_args,
                     (2, 3, 4, 5, 7, 8, 9),
                     slots * cfg.n_head * max_len * hd)
+
+    # ISSUE 12 — the mixed-step programs: interleaved chunked prefill
+    # folds a prompt chunk into the decode program, and the fused
+    # admission finish installs + samples + scatters slot state on
+    # device. Same gate as every other decode program: FULL aliasing of
+    # every donated leaf, zero cache-sized copies.
+    p_c = 16
+
+    def ilv_args(b):
+        row = b._ilv_new_row()
+        chunk = jnp.zeros((1, p_c), jnp.int32)
+        base = batcher_args(b)
+        m_args = (base[0], base[0]) + base[1:] + (row, chunk,
+                                                  jnp.int32(0))
+        v = cfg.vocab_size
+        nb_max = (b.cache["tables"].shape[-1] if b._paged else 0)
+        f_args = (b.cache, row,
+                  jnp.zeros((1, p_c, v), jnp.float32),
+                  jnp.int32(0), jnp.int32(0),
+                  jnp.zeros((2,), jnp.uint32), jnp.zeros((2,), jnp.uint32),
+                  b.pos, b.tok, b.active, b.keys, b._temp, b._topk,
+                  b._topp, b._minp, b._rep, b._seen, b._bias,
+                  jnp.float32(0), jnp.int32(0), jnp.float32(0),
+                  jnp.float32(0), jnp.float32(1),
+                  jnp.zeros((v,), jnp.bool_),
+                  jnp.zeros((v if b._allow_bias else 0,), jnp.float32),
+                  jnp.int32(8),
+                  jnp.zeros((nb_max,), jnp.int32))
+        return m_args, f_args
+
+    for name, kw in {"mixed_dense": {},
+                     "mixed_paged": {"kv": "paged"},
+                     "mixed_bucketed": {"decode_buckets": True}}.items():
+        b = ContinuousBatcher(cfg, prepared, slots=slots, max_len=max_len,
+                              prompt_pad=16, prefill_chunk_tokens=p_c,
+                              **kw)
+        if b._paged:
+            layer_elems = (b._allocator.n_blocks * cfg.n_head
+                           * b._block_len * hd)
+        else:
+            layer_elems = slots * cfg.n_head * b._cache_len * hd
+        m_args, f_args = ilv_args(b)
+        lower_and_check(name, b._mixed, m_args, b._mixed_donate,
+                        layer_elems)
+        lower_and_check(name + "_finish", b._ilv_finish, f_args,
+                        b._ilv_finish_donate, layer_elems)
+
+    sbm = SpeculativeBatcher(cfg, prepared, cfg, prepared, spec_k=2,
+                             slots=slots, max_len=max_len, prompt_pad=16,
+                             prefill_chunk_tokens=p_c)
+    row = sbm._ilv_new_row()
+    d_row = sbm._d_family.init_cache(1, sbm._ilv_row_len,
+                                     sbm.d_cache["k"].dtype)
+    chunk = jnp.zeros((1, p_c), jnp.int32)
+    spm_args = (sbm.prepared, sbm.draft_prepared, sbm.cache, sbm.d_cache,
+                sbm.tok, sbm.pos, sbm.active, sbm.keys, sbm.prev_chunk,
+                sbm.prev_pos, row, d_row, chunk, jnp.int32(0))
+    spec_elems = slots * cfg.n_head * max_len * hd
+    lower_and_check("mixed_speculative", sbm._spec_mixed, spm_args,
+                    sbm._spec_mixed_donate, spec_elems)
+    v = cfg.vocab_size
+    spf_args = (sbm.cache, sbm.d_cache, row, d_row,
+                jnp.zeros((1, p_c, v), jnp.float32),
+                jnp.int32(0), jnp.int32(0),
+                jnp.zeros((2,), jnp.uint32), jnp.zeros((2,), jnp.uint32),
+                sbm.pos, sbm.tok, sbm.active, sbm.keys, sbm._temp,
+                sbm._topk, sbm._topp, sbm._minp, sbm._rep, sbm._seen,
+                sbm._bias,
+                jnp.float32(0), jnp.int32(0), jnp.float32(0),
+                jnp.float32(0), jnp.float32(1),
+                jnp.zeros((v,), jnp.bool_),
+                jnp.zeros((v if sbm._allow_bias else 0,), jnp.float32),
+                jnp.int32(8), jnp.zeros((0,), jnp.int32),
+                jnp.zeros((sbm.spec_k + 1,), jnp.int32),
+                sbm.prev_chunk, sbm.prev_pos)
+    lower_and_check("mixed_speculative_finish", sbm._spec_ilv_finish,
+                    spf_args, sbm._spec_ilv_finish_donate, spec_elems)
 
     return {"variants": report, "findings": findings}
 

@@ -167,6 +167,30 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--prompt_pad", type=int, default=None,
                    help="--serve_lm: prompt padding bucket (one prefill "
                         "compilation; default min(64, max_len))")
+    p.add_argument("--weights", choices=["f32", "int8"], default="f32",
+                   help="--serve_lm: served weight precision. 'int8' "
+                        "quantizes the model ONCE at startup (symmetric "
+                        "per-output-channel, quant.py) — ~4x fewer "
+                        "weight bytes streamed per decode step; the "
+                        "goodput MBU gauges price the quantized stream "
+                        "exactly (utils/flops.tree_weight_bytes)")
+    p.add_argument("--prefill_chunk_tokens", type=int, default=0,
+                   metavar="N",
+                   help="--serve_lm: interleaved chunked prefill — fold "
+                        "one N-token prompt chunk of an admitting "
+                        "request into each decode step (the mixed "
+                        "program) instead of convoying the whole "
+                        "prefill through submit. 0 (default) keeps the "
+                        "convoy path. Disables JSON-mode constraints "
+                        "(per-token grammar masks need the admission "
+                        "sync the interleave removes)")
+    p.add_argument("--overlap", action="store_true",
+                   help="--serve_lm: double-buffered dispatch — the "
+                        "worker dispatches step N+1's device work "
+                        "before committing step N's tokens, hiding "
+                        "host bookkeeping under the device step "
+                        "(tokens surface one step later). Disables "
+                        "JSON-mode constraints")
     p.add_argument("--tokenizer", default=None,
                    help="--serve_lm: text endpoint tokenizer — 'bytes' "
                         "(UTF-8 bytes as ids; any vocab >= 256) or a LOCAL "
@@ -770,6 +794,12 @@ def _serve_lm(engine: PipelineEngine, args) -> int:
             availability=args.slo_avail,
             target=args.slo_target
             if args.slo_target is not None else 0.99)
+    overlap_opts = bool(args.prefill_chunk_tokens or args.overlap)
+    if overlap_opts:
+        log.info("overlap/interleave serving enabled "
+                 "(prefill_chunk_tokens=%d, overlap=%s): JSON-mode "
+                 "constraints are off on this configuration",
+                 args.prefill_chunk_tokens, args.overlap)
     try:
         rc = asyncio.run(serve_lm(
             cfg, prepared, port=me.port, slots=args.slots, slo=slo,
@@ -787,13 +817,19 @@ def _serve_lm(engine: PipelineEngine, args) -> int:
             kv=args.kv, kv_dtype=_kv_dtype_arg(args.kv_dtype),
             paged_blocks=args.paged_blocks, block_len=args.block_len,
             decode_buckets=args.decode_buckets,
+            weights=args.weights,
+            prefill_chunk_tokens=args.prefill_chunk_tokens,
+            overlap=args.overlap,
             # the daemon's clients choose options per request, so the
             # per-slot bias capability is on at this edge — except for
             # speculative serving, whose batcher rejects per-request
             # bias anyway (the buffer would be dead weight); constraints
-            # (JSON mode, j=) share the buffer and the same gate
+            # (JSON mode, j=) share the buffer and the same gate, and
+            # additionally drop out on the overlap/interleave paths
+            # (per-token grammar masks need the admission/commit syncs
+            # those remove — serving.py documents the restriction)
             allow_logit_bias=not spec_kwargs,
-            allow_constraints=not spec_kwargs,
+            allow_constraints=not spec_kwargs and not overlap_opts,
             **lora_kwargs,
         ))
     except KeyboardInterrupt:

@@ -97,12 +97,11 @@ def model_cost(cfg, prepared=None, *, kv_bytes: float = 2,
     wbytes = params * weight_dtype_bytes
     if prepared is not None:
         try:
-            import jax
-
-            wbytes = float(sum(
-                getattr(x, "size", 0) * getattr(x, "dtype", None).itemsize
-                for x in jax.tree_util.tree_leaves(prepared)
-                if hasattr(x, "dtype")))
+            # device-layout pricing (int8 kernels at 1 byte, int4 at
+            # the packed half byte, scale rows at full width) — the
+            # quantized-weights serving path's MBU denominator must
+            # shrink with the bytes it actually streams
+            wbytes = F.tree_weight_bytes(prepared)
         except Exception:  # noqa: BLE001 — an exotic tree falls back to
             pass           # the analytic count, never breaks serving
     return ModelCost(

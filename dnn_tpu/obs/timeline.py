@@ -119,7 +119,7 @@ class _StepRec:
     scrape thread recomputes the same values it would assign twice."""
 
     __slots__ = ("t0", "t_end", "marks", "n_adv", "wall", "phases",
-                 "admit_slices")
+                 "admit_slices", "mixed")
 
     def __init__(self, t0: float):
         self.t0 = t0
@@ -129,6 +129,10 @@ class _StepRec:
         self.wall = 0.0
         self.phases: "Optional[Dict[str, float]]" = None
         self.admit_slices: list = []
+        # mixed = this step's dispatch folded an interleaved prefill
+        # chunk (serving prefill_chunk_tokens) — /stepz distinguishes
+        # interleaved-prefill steps from pure-decode steps with it
+        self.mixed = False
 
 
 def _fold(rec: _StepRec) -> _StepRec:
@@ -224,12 +228,18 @@ class StepClock:
                 return getattr(c, method)() if c is not None else 0.0
             return read
 
+        # overlap_depth: how many dispatched-but-uncommitted steps the
+        # producer's pipeline holds (0 = classic dispatch→wait→commit;
+        # 1 = the batcher's double-buffered dispatch is live). Set by
+        # the producer with one attr store; scraped like every gauge.
+        self.overlap_depth = 0
         self._gauges = {
             "step.dispatch_slack": _weak("dispatch_slack"),
             "step.sync_tax": _weak("sync_tax"),
             "step.host_fraction": _weak("host_fraction"),
             "step.per_sec": _weak("steps_per_sec"),
             "step.last_wall_ms": _weak("last_wall_ms"),
+            "step.overlap_depth": _weak("_overlap_depth_read"),
         }
 
     def install(self) -> "StepClock":
@@ -387,6 +397,9 @@ class StepClock:
         # full ring may have evicted part of the 60 s window
         return n / max(min(60.0, now - oldest), 1e-9)
 
+    def _overlap_depth_read(self) -> float:
+        return float(self.overlap_depth)
+
     def last_wall_ms(self) -> float:
         with self._lock:
             if not self._ring:
@@ -407,6 +420,7 @@ class StepClock:
         if last:
             recs = recs[-last:]
         return [{"t0": r.t0, "wall": _fold(r).wall, "n_adv": r.n_adv,
+                 "mixed": r.mixed,
                  "phases": dict(r.phases),
                  "admit_slices": list(r.admit_slices),
                  "marks": list(r.marks)} for r in recs]
@@ -420,6 +434,7 @@ class StepClock:
         self.flush()  # scrapes read fresh histograms/counters
         recs, tot, wall, n_adv = self._sums(last)
         n = len(recs)
+        n_mixed = sum(1 for r in recs if r.mixed)
         phases = {}
         for p in PHASES:
             s = tot.get(p, 0.0)
@@ -433,6 +448,13 @@ class StepClock:
             "window_steps": n,
             "window_wall_s": round(wall, 6),
             "tokens": n_adv,
+            # interleaved-prefill steps in the window (the `mixed` tag:
+            # the dispatch folded a prompt chunk into the decode program)
+            "mixed_steps": n_mixed,
+            "mixed_frac": round(n_mixed / n, 4) if n else 0.0,
+            # the producer's dispatch-pipeline depth (0 = no overlap,
+            # 1 = double-buffered dispatch live)
+            "overlap_depth": self.overlap_depth,
             "phases": phases,
             "device_s": round(dev, 6),
             "host_s": round(host, 6),
@@ -473,7 +495,8 @@ class StepClock:
         m = Metrics()
         for k in ("steps_total", "window_steps", "window_wall_s",
                   "host_fraction", "dispatch_slack", "sync_tax",
-                  "steps_per_sec", "last_wall_ms"):
+                  "steps_per_sec", "last_wall_ms", "mixed_steps",
+                  "overlap_depth"):
             m.set(f"dnn_tpu_step_{k}", float(s[k]))
         for p, d in s["phases"].items():
             m.set(labeled("dnn_tpu_step_phase_seconds_total", phase=p),
@@ -513,12 +536,15 @@ class StepClock:
                                "dur": (a1 - a0) * 1e6,
                                "args": {"step": i}})
             t = r.t0
+            args = {"step": i, "n_adv": r.n_adv}
+            if r.mixed:
+                args["mixed"] = True
             for name, tm in r.marks:
                 events.append({"ph": "X", "pid": 1, "tid": 1,
                                "name": name,
                                "ts": (t - origin) * 1e6,
                                "dur": (tm - t) * 1e6,
-                               "args": {"step": i, "n_adv": r.n_adv}})
+                               "args": args})
                 t = tm
         return {"traceEvents": events, "displayTimeUnit": "ms"}
 

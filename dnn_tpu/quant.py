@@ -210,13 +210,9 @@ def param_bytes(tree) -> int:
     """Total HBM bytes of all array leaves (for compression-ratio
     checks). int4 leaves count 0.5 bytes/element — the TPU HBM layout
     packs two S4 values per byte (host-side numpy views pad to one byte,
-    so dtype.itemsize would double-count them)."""
-    total = 0.0
-    for leaf in jax.tree.leaves(tree):
-        if not hasattr(leaf, "dtype"):
-            continue
-        if leaf.dtype.name in ("int4", "uint4"):
-            total += leaf.size * 0.5
-        else:
-            total += leaf.size * leaf.dtype.itemsize
-    return int(total)
+    so dtype.itemsize would double-count them). Delegates to the one
+    canonical pricing walk (utils/flops.tree_weight_bytes — also the
+    serving goodput MBU denominator, so the two can never drift)."""
+    from dnn_tpu.utils.flops import tree_weight_bytes
+
+    return int(tree_weight_bytes(tree))

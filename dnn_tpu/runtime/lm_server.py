@@ -381,31 +381,38 @@ class _BatcherWorker(threading.Thread):
             prompt_len=int(np.asarray(item.prompt).size),
             max_new=item.max_new,
             trace_id=item.trace.trace_id if item.trace else None)
+        # first token: the convoy path samples it during submit()'s
+        # inline prefill; interleaved admission (prefill_chunk_tokens)
+        # defers it to a later mixed step's commit — first_token then
+        # reads None and TTFT is recorded when the rid first appears in
+        # the step loop's output instead
+        first = self.batcher.first_token(rid)
         m = obs.metrics()
         if m is not None:
             m.observe("serving.queue_wait_seconds", wait)
-            # end-to-end TTFT: enqueue -> first token (sampled during the
-            # batcher's prefill, which submit() just completed)
-            ttft = time.perf_counter() - item.t_q
-            m.observe("serving.ttft_seconds", ttft)
             m.set_fn("serving.queue_depth", self.q.qsize)
-            if (g := self.goodput) is not None:
-                g.on_ttft(ttft)  # SLO burn-rate window (obs/goodput.py)
+            if first is not None:
+                # end-to-end TTFT: enqueue -> first token (sampled
+                # during the batcher's prefill, which submit() just ran)
+                ttft = time.perf_counter() - item.t_q
+                m.observe("serving.ttft_seconds", ttft)
+                if (g := self.goodput) is not None:
+                    g.on_ttft(ttft)  # SLO burn-rate window (obs/goodput)
         if item.trace:
             obs.record_span("queue_wait", item.t_q, wait,
                             parent=item.trace)
-        self._futures[rid] = {"fut": item.fut, "on_token": item.on_token,
-                              "cancel_evt": item.cancel_evt,
-                              # the original submission, kept so a
-                              # worker death can requeue it (attempts
-                              # bounds the retries; lm_server
-                              # _on_worker_death)
-                              "item": item}
-        if item.on_token is not None:
-            # the first token samples during prefill (batcher.submit)
-            first = self.batcher.first_token(rid)
-            if first is not None:
-                self._emit_token(rid, first)
+        rec = {"fut": item.fut, "on_token": item.on_token,
+               "cancel_evt": item.cancel_evt,
+               # the original submission, kept so a worker death can
+               # requeue it (attempts bounds the retries; lm_server
+               # _on_worker_death)
+               "item": item}
+        if first is None:
+            rec["ttft_t0"] = item.t_q  # deferred: the run loop records
+            # TTFT at the first committed token
+        self._futures[rid] = rec
+        if item.on_token is not None and first is not None:
+            self._emit_token(rid, first)
         return True
 
     def _emit_token(self, rid, tok):
@@ -580,6 +587,13 @@ class _BatcherWorker(threading.Thread):
                     obs.flight.record("drain_done")
                     return
             elif b.n_active == 0 and self.q.empty() and self._held is None:
+                # overlap mode: the pool emptied with one dispatched
+                # step still uncommitted (its tokens are all past
+                # retirement) — commit it so its bookkeeping (StepClock
+                # record, discarded tokens) never dangles across idle
+                fo = getattr(b, "flush_overlap", None)
+                if fo is not None:
+                    fo()
                 if self._stop_evt.is_set():
                     self._shutdown_drain_queue()
                     return
@@ -654,7 +668,19 @@ class _BatcherWorker(threading.Thread):
                 sd()  # a real step completed: the watchdog is warmed
             for rid, tok in stepped.items():  # streaming: tokens as they
                 # commit, before done-publish; the speculative batcher
-                # commits a LIST of tokens per step (serving_spec.py)
+                # (and an interleaved deferred-first commit) deliver a
+                # LIST of tokens per step
+                rec = self._futures.get(rid)
+                if rec is not None and "ttft_t0" in rec:
+                    # interleaved admission: this is the request's FIRST
+                    # committed token — record the real TTFT now
+                    t0 = rec.pop("ttft_t0")
+                    m = obs.metrics()
+                    if m is not None:
+                        ttft = time.perf_counter() - t0
+                        m.observe("serving.ttft_seconds", ttft)
+                        if (g := self.goodput) is not None:
+                            g.on_ttft(ttft)
                 if isinstance(tok, (list, tuple)):
                     for t in tok:
                         self._emit_token(rid, t)
@@ -707,7 +733,30 @@ class LMServer:
                  worker_restarts: int = 2,
                  max_request_retries: int = 1,
                  drain_grace_s: float = 30.0,
+                 weights: str = "f32",
                  **batcher_kwargs):
+        # weight-only quantized serving (ISSUE 12 satellite — the first
+        # rung of ROADMAP item 4's weight-quant ladder): weights="int8"
+        # quantizes the served tree ONCE at construction (quant.py's
+        # symmetric per-output-channel scheme; every matmul funnels
+        # through ops.nn.linear, which dispatches on the q dtype), so
+        # decode streams ~4x fewer weight bytes per step. The goodput
+        # MBU denominator prices the quantized tree exactly
+        # (utils/flops.tree_weight_bytes) because model_cost below sums
+        # the REAL leaves of the tree the batcher actually serves.
+        if weights not in ("f32", "int8"):
+            raise ValueError(
+                f"weights must be 'f32' or 'int8', got {weights!r}")
+        if weights == "int8":
+            if batcher_kwargs.get("lora_adapters"):
+                raise ValueError(
+                    "weights='int8' does not compose with LoRA serving: "
+                    "lora_view applies low-rank deltas to float kernels, "
+                    "not quantized {q, scale} pairs")
+            from dnn_tpu.quant import quantize_gpt
+
+            prepared = quantize_gpt(prepared, bits=8)
+        self.weights = weights
         # resilience state (ISSUE 8) before anything that can serve a
         # request or a scrape: drain flag, wedged-policy escalation
         # latch, admission dedup, worker-restart bookkeeping

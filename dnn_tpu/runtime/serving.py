@@ -91,6 +91,24 @@ def _decode_block_rows(bp, x, layer_cache, pos, write, *, cfg, compute_dtype,
     return x + m, layer_cache
 
 
+def install_dense_row(cache, row, slot):
+    """Install a finished transient row cache into `slot` of a dense
+    pool, CLAMPED at the pool's own position count — the row is
+    chunk-rounded and may overhang the pool, and a dynamic update whose
+    operand exceeds the target would clamp the start index back onto
+    real positions and corrupt the cache (the prefill_finish lesson).
+    The one shared implementation for every finish/install program
+    (convoy finish, fused interleaved finish, the speculative draft
+    installs) so the clamp invariant cannot drift per path."""
+    return {
+        kk: lax.dynamic_update_slice_in_dim(
+            cache[kk],
+            lax.slice_in_dim(row[kk], 0, cache[kk].shape[3], axis=3),
+            slot, axis=1)
+        for kk in cache
+    }
+
+
 class GPTFamilyRows:
     """The GPT family's per-slot decode hooks — the default
     `ContinuousBatcher` family adapter. A family adapter supplies three
@@ -236,7 +254,9 @@ class ContinuousBatcher:
                  allow_logit_bias: bool = False,
                  allow_constraints: bool = False,
                  constraint_rows: int = 1024,
-                 unroll_layers: bool = False):
+                 unroll_layers: bool = False,
+                 prefill_chunk_tokens: int = 0,
+                 overlap: bool = False):
         self.cfg = cfg
         self.prepared = prepared
         self.slots = slots
@@ -307,6 +327,7 @@ class ContinuousBatcher:
         # kv_dtype picks the cache storage codec (None follows
         # compute_dtype; "int8" = quantized cache, kvcache.Int8KV)
         cache_dtype = kv_dtype if kv_dtype is not None else (compute_dtype or jnp.float32)
+        self._cache_dtype = cache_dtype
 
         # `kv` picks the cache layout by NAME — the serving-path selector
         # ("--kv=paged|dense" at the daemon edge):
@@ -645,15 +666,19 @@ class ContinuousBatcher:
             top_lp, top_ids = lax.top_k(lsm, logprobs_k)
             return chosen_lp, top_lp, top_ids.astype(jnp.int32)
 
-        def decode_step(prepared, cache, pos, tok, active, keys,
-                        temp, tk, tp, mp, rep, seen, bias, crow, ctable):
+        def _decode_core(prepared, cache, pos, tok, active, keys,
+                         temp, tk, tp, mp, rep, seen, bias, crow, ctable):
             """Advance every active slot one token (per-slot sampling
             parameters — see _sample_rows; `rep`/`seen` drive the
             repetition penalty, `mp` the min-p cutoff, `bias` (B, V) the
             per-slot additive logit bias, `crow` (B,) the per-slot
             constraint-table row index into the device-resident bool
             mask pool `ctable` — row 0 is the reserved all-allowed
-            row, so unconstrained slots add nothing)."""
+            row, so unconstrained slots add nothing). Shared by the
+            plain decode step and the MIXED step (decode + one
+            interleaved prefill chunk in the same compiled program),
+            so the two paths' decode math is identical by
+            construction — the mixed==convoy token-parity contract."""
             logits, new_cache = self.family.decode_rows(
                 prepared, cache, tok, pos, active, codec)
             # repetition penalty on raw logits (HF order: before the
@@ -688,6 +713,33 @@ class ContinuousBatcher:
                 # pre-temperature — the usual serving-API convention)
                 out += _lp_outputs(logits, nxt)
             return out
+
+        def decode_step(prepared, cache, pos, tok, active, keys,
+                        temp, tk, tp, mp, rep, seen, bias, crow, ctable):
+            return _decode_core(prepared, cache, pos, tok, active, keys,
+                                temp, tk, tp, mp, rep, seen, bias, crow,
+                                ctable)
+
+        def mixed_step(prepared, pf_prepared, cache, pos, tok, active,
+                       keys, temp, tk, tp, mp, rep, seen, bias, crow,
+                       ctable, row, chunk, chunk_start):
+            """One INTERLEAVED step (ISSUE 12): the decode leg advances
+            every active slot exactly as decode_step, and the same
+            compiled program folds one prompt chunk of an admitting
+            request into its transient row cache — admission rides the
+            decode cadence instead of convoying it behind a separate
+            prefill program. The legs touch disjoint buffers (pool
+            cache vs transient row), so the decode math — and every
+            slot's token stream — is bit-identical to the convoy path.
+            `pf_prepared` is the admitting request's prefill param view
+            (its LoRA adapter when multi-LoRA is on; the same tree as
+            `prepared` otherwise)."""
+            out = _decode_core(prepared, cache, pos, tok, active, keys,
+                               temp, tk, tp, mp, rep, seen, bias, crow,
+                               ctable)
+            pf_logits, new_row = self.family.prefill(
+                pf_prepared, chunk, row, chunk_start)
+            return out + (pf_logits, new_row)
 
         def prefill_chunk(prepared, row, chunk, chunk_start):
             """One (1, prompt_pad) chunk of a prompt into the slot-row
@@ -729,14 +781,7 @@ class ContinuousBatcher:
             if self._paged:
                 cache = codec.install_row(cache, row, install_ids)
             else:
-                cache = {
-                    kk: lax.dynamic_update_slice_in_dim(
-                        cache[kk],
-                        lax.slice_in_dim(row[kk], 0, cache[kk].shape[3],
-                                         axis=3),
-                        slot, axis=1)
-                    for kk in cache
-                }
+                cache = install_dense_row(cache, row, slot)
             if logprobs_k:
                 # raw model distribution, as in decode_step
                 return (cache, first) + _lp_outputs(raw, first[None])
@@ -767,6 +812,153 @@ class ContinuousBatcher:
         # donation that warned on every prefill); only the pool cache
         # donation is real
         self._prefill_finish = jax.jit(prefill_finish, donate_argnums=(0,))
+
+        # --------------------------------------------------------------
+        # overlap & fusion (ISSUE 12): interleaved chunked prefill + the
+        # one-step double-buffered dispatch pipeline
+        # --------------------------------------------------------------
+        # prefill_chunk_tokens > 0 switches ADMISSION from the convoy
+        # path (submit() runs the whole chunk loop + finish inline,
+        # stalling every decode slot for the prefill's duration — the
+        # 0.54 admit fraction PR 10's StepClock measured) to the MIXED
+        # step: submit() only validates, allocates and enqueues, and
+        # each subsequent decode step folds ONE prompt chunk of that
+        # width into the same compiled program. The fused finish then
+        # installs the row, samples the first token ON DEVICE with the
+        # request's own params/rng, and scatters the slot state — one
+        # dispatch, no per-admit device->host sync (the first token's
+        # readback rides the NEXT step's commit).
+        self._ilv = int(prefill_chunk_tokens or 0)
+        if self._ilv < 0:
+            raise ValueError(
+                f"prefill_chunk_tokens must be >= 0, got "
+                f"{prefill_chunk_tokens}")
+        if self._ilv:
+            if self._ilv > self.max_len:
+                raise ValueError(
+                    f"prefill_chunk_tokens {self._ilv} exceeds max_len "
+                    f"{self.max_len} — a chunk wider than the pool can "
+                    "never install")
+            if self._paged and self._ilv % self._block_len:
+                raise ValueError(
+                    f"prefill_chunk_tokens {self._ilv} must tile "
+                    f"block_len {self._block_len} (prefill rows install "
+                    "whole blocks)")
+            if self._allow_constraints:
+                raise ValueError(
+                    "prefill_chunk_tokens does not compose with "
+                    "allow_constraints: the admission DFA must be walked "
+                    "on host before the slot's next dispatch, which is "
+                    "exactly the sync the interleave removes — "
+                    "constrained serving keeps the convoy admission path")
+            if self._prefix_cache is not None:
+                raise ValueError(
+                    "prefill_chunk_tokens does not compose with the "
+                    "prefix cache (entries are keyed/shaped on the convoy "
+                    "path's prompt_pad chunk geometry) — prefix-heavy "
+                    "workloads keep the convoy admission path")
+        # overlap=True runs a ONE-STEP dispatch pipeline: step() DISPATCHES
+        # step N and commits step N-1's tokens, so the host slot loop
+        # (commit/obs, and the next admission's bookkeeping) runs while
+        # the device executes step N — the dispatch_slack headroom the
+        # StepClock measured, actually spent. Tokens surface one step()
+        # call later; drain()/flush_overlap() commit the trailing step.
+        self._overlap = bool(overlap)
+        if self._overlap and self._allow_constraints:
+            raise ValueError(
+                "overlap=True does not compose with allow_constraints: "
+                "dispatching step N+1 before step N's tokens reach the "
+                "host would run the grammar mask one state stale")
+        self._pending_q: List[int] = []   # slots awaiting interleaved
+        # prefill, FIFO (one chunk folds per step)
+        self._inflight = None             # overlap: the dispatched,
+        # not-yet-committed step — (step_idx, token refs, logprob refs)
+        self._step_idx = 0                # monotonically counts dispatches;
+        # install_step gating keys off it (a slot's decode tokens exist
+        # only for steps dispatched AFTER its fused finish)
+        # interleaved transient rows round max_len up to whole chunks of
+        # the INTERLEAVE width (same clamp-protection argument as
+        # _row_len above)
+        self._ilv_row_len = (-(-self.max_len // self._ilv) * self._ilv
+                             if self._ilv else 0)
+        self._ilv_new_row = (
+            (lambda: self.family.init_cache(1, self._ilv_row_len,
+                                            cache_dtype))
+            if self._ilv else None)
+        self._mixed = None
+        self._ilv_finish = None
+        self._ilv_finish_core = None
+        if self._ilv:
+            # donate the decode leg's state exactly as _decode does, plus
+            # the prefill leg's transient row — audited like every other
+            # decode program (analysis/program.audit_serving_decode)
+            self._mixed_donate = (2, 3, 4, 6, 12, 16)
+            self._mixed = jax.jit(mixed_step,
+                                  donate_argnums=self._mixed_donate)
+
+            def ilv_finish(cache, row, logits, last_local, slot, rng,
+                           slot_key, pos, tok, active, keys, temp_v,
+                           tk_v, tp_v, mp_v, rep_v, seen, bias_buf,
+                           t, k, p, mp_, rp, seen_row, b_row,
+                           prompt_len, install_ids):
+                """Fused admission finish: sample the first token from
+                the final chunk's true-last logit row (the request's own
+                temperature/top-k/top-p/min-p/repetition params and rng
+                stream — the same math as the convoy prefill_finish, so
+                sampled streams agree draw-for-draw), install the row
+                cache into `slot`, and scatter EVERY per-slot state
+                vector (pos/tok/active/keys/sampling params/seen/bias)
+                in the same program. Only the sampled token id (+
+                logprobs when compiled in) ever crosses to host, and
+                even that readback is deferred to the next step's
+                commit — admission costs zero blocking syncs."""
+                lg = logits[:, last_local][0:1]  # (1, V)
+                raw = lg
+                lg = apply_repetition_penalty(
+                    lg, (rp != 1.0) & seen_row[None, :], rp)
+                if self._allow_bias:
+                    lg = lg + b_row[None, :]
+                first = _sample_rows(
+                    lg, rng[None], temperature=t[None], top_k=k[None],
+                    top_p=p[None], min_p=mp_[None],
+                )[0]
+                if self._paged:
+                    cache = codec.install_row(cache, row, install_ids)
+                else:
+                    cache = install_dense_row(cache, row, slot)
+                pos = pos.at[slot].set(prompt_len)
+                tok = tok.at[slot].set(first)
+                active = active.at[slot].set(True)
+                keys = keys.at[slot].set(slot_key)
+                temp_v = temp_v.at[slot].set(t)
+                tk_v = tk_v.at[slot].set(k)
+                tp_v = tp_v.at[slot].set(p)
+                mp_v = mp_v.at[slot].set(mp_)
+                rep_v = rep_v.at[slot].set(rp)
+                seen = seen.at[slot].set(seen_row.at[first].set(True))
+                if self._allow_bias:
+                    bias_buf = bias_buf.at[slot].set(b_row)
+                out = (cache, pos, tok, active, keys, temp_v, tk_v,
+                       tp_v, mp_v, rep_v, seen, bias_buf, first)
+                if logprobs_k:
+                    out += _lp_outputs(raw, first[None])
+                return out
+
+            # the speculative variant composes its own fused finish from
+            # this core (serving_spec.SpeculativeBatcher)
+            self._ilv_finish_core = ilv_finish
+            # donate the pool cache and every returned per-slot vector
+            # (active included — the finish RETURNS it, unlike the decode
+            # step where it is host-updated between calls); the transient
+            # row is sliced, never returned whole (the prefill_finish
+            # lesson), and the bias buffer only when it is real
+            donate = [0, 7, 8, 9, 10, 11, 12, 13, 14, 15, 16]
+            if self._allow_bias:
+                donate.append(17)
+            self._ilv_finish_donate = tuple(donate)
+            self._ilv_finish = jax.jit(
+                ilv_finish, donate_argnums=self._ilv_finish_donate)
+
         # the decode step's param argument: a lora_view when multi-LoRA is
         # on (rebuilt whenever a slot's adapter assignment changes — same
         # structure, so the same compiled program), plain prepared when off
@@ -782,6 +974,8 @@ class ContinuousBatcher:
             fns.append(self._gather_row)
         if self._buckets is not None:
             fns.append(self._grow_cache)
+        if self._mixed is not None:
+            fns += [self._mixed, self._ilv_finish]
         return fns
 
     # ------------------------------------------------------------------
@@ -1100,6 +1294,55 @@ class ContinuousBatcher:
             req_key = jax.random.fold_in(base, rid if seed is None else seed)
             prefill_key, slot_key = jax.random.split(req_key)
 
+            if self._ilv:
+                # interleaved admission (ISSUE 12): NO device work here.
+                # The prompt's chunks fold into subsequent decode steps
+                # (mixed_step), the fused finish samples the first token
+                # on device, and its readback rides a later step's
+                # commit — submit() is host bookkeeping only, so the
+                # prefill convoy never forms. rng derivation above is
+                # identical to the convoy path, so greedy AND sampled
+                # streams agree token-for-token across the two paths.
+                p_c = self._ilv
+                n_c = -(-len(prompt) // p_c)
+                padded_i = np.zeros((1, n_c * p_c), np.int32)
+                padded_i[0, : len(prompt)] = prompt
+                seen_np = np.zeros((self.cfg.vocab_size,), bool)
+                seen_np[prompt] = True
+                if self._lora is not None and self._aid[slot] != aid:
+                    self._aid[slot] = aid
+                    self._decode_view = self._lora_prepared(self._aid)
+                req = {"rid": rid, "emitted": [],
+                       "budget": max_new_tokens, "stop": stop_seqs,
+                       "logprobs": logprobs and self._logprobs_k,
+                       "blocks": paged_taken,
+                       "prompt_len": len(prompt), "freed": 0,
+                       "t_last": None,
+                       "pending": {
+                           "padded": padded_i, "n_chunks": n_c,
+                           "next": 0, "row": self._ilv_new_row(),
+                           "aid": aid,
+                           "last_local":
+                               len(prompt) - 1 - (n_c - 1) * p_c,
+                           "prefill_key": prefill_key,
+                           "slot_key": slot_key,
+                           "t": temp, "k": tk, "p": tp, "mp": mp,
+                           "rp": rp,
+                           "seen_row": jnp.asarray(seen_np),
+                           "b_row": b_row,
+                           "install_ids": install_ids
+                           if install_ids is not None
+                           else jnp.zeros((0,), jnp.int32),
+                       }}
+                if req["logprobs"]:
+                    req["lp"] = []
+                    req["lp_top"] = []
+                if trace:
+                    req["trace"] = trace
+                self._slot_req[slot] = req
+                self._pending_q.append(slot)
+                return rid
+
             # chunked prefill: full prompt_pad-sized chunks + one padded tail,
             # each at its absolute start position — prompts of ANY length (up
             # to max_len - max_new) reuse the one compiled chunk program
@@ -1259,6 +1502,13 @@ class ContinuousBatcher:
             if trace:
                 req["trace"] = trace  # step() hangs decode spans off this
             req["t_last"] = time.perf_counter()  # inter-token clock
+            if self._overlap and self._inflight is not None:
+                # the uncommitted in-flight step was dispatched while
+                # this slot was still free: its row of that step's
+                # tokens is garbage and must not commit (the same
+                # install gating the interleaved path uses; the first
+                # token here is already in `emitted`)
+                req["install_step"] = self._step_idx - 1
             self._slot_req[slot] = req
             if constraint is not None:
                 self._constraint_advance(slot, first)
@@ -1651,6 +1901,11 @@ class ContinuousBatcher:
             return int(self.results[rid][0])
         for req in self._slot_req:
             if req is not None and req["rid"] == rid:
+                if not req["emitted"]:
+                    # interleaved admission: still prefilling, or the
+                    # fused finish's first token has not committed yet —
+                    # the caller picks it up from a later step()'s output
+                    return None
                 return int(req["emitted"][0])
         return None
 
@@ -1664,6 +1919,13 @@ class ContinuousBatcher:
         an unknown/already-claimed rid."""
         for slot, req in enumerate(self._slot_req):
             if req is not None and req["rid"] == rid:
+                if req.get("pending") is not None:
+                    # cancelled while its interleaved prefill waited:
+                    # drop the queue entry too (the chunk folder skips
+                    # dead slots defensively, but never growing the
+                    # queue with corpses is cheaper)
+                    self._pending_q = [s for s in self._pending_q
+                                       if s != slot]
                 if req["blocks"]:
                     self._allocator.free(req["blocks"][req["freed"]:])
                     self._pool_exhausted_episode = False  # blocks came free
@@ -1682,74 +1944,119 @@ class ContinuousBatcher:
             return True
         return False
 
-    def step(self) -> Dict[int, int]:
-        """One decode step for every active slot. Returns {rid: new_token}
-        for slots that advanced; finished requests move to .results."""
-        if self.n_active == 0:
-            return {}
-        # step-timeline phase clock (obs/timeline.py): rec is None when
-        # no clock is attached OR the obs gate is off — every later
-        # site is one None check
-        sc = self.step_clock
-        rec = sc.begin() if sc is not None else None
-        if self._buckets is not None:
-            # this step writes each active slot's next position
-            # (prompt_len + emitted-so-far); cover the furthest one
-            self._ensure_cache_len(max(
-                req["prompt_len"] + len(req["emitted"])
-                for req in self._slot_req if req is not None))
-        if self._crow_dirty:
-            self._crow = jnp.asarray(self._crow_np)
-            self._crow_dirty = False
-        if rec is not None:
-            rec.marks.append(("host", time.perf_counter()))
-        # host annotation: a POST /profilez capture shows each pool step
-        # as a named block on the host track (obs/profile.annotation_ctx
-        # — the non-generator form; ~6 µs on / ~0.2 µs off, inside the
-        # <2% obs budget)
-        with _prof_annotation("serving.decode_step"):
-            res = self._decode(
-                self._decode_view, self.cache, self.pos, self.tok,
-                self.active, self.keys, self._temp, self._topk, self._topp,
-                self._minp, self._rep, self._seen, self._bias, self._crow,
-                self._ctable,
-            )
-        if rec is not None:
-            rec.marks.append(("dispatch", time.perf_counter()))
-        if self._logprobs_k:
-            (self.cache, self.pos, self.tok, self.keys, self._seen,
-             c_lp, t_lp, t_ids) = res
-            c_lp, t_lp, t_ids = (np.asarray(c_lp), np.asarray(t_lp),
-                                 np.asarray(t_ids))
-        else:
-            self.cache, self.pos, self.tok, self.keys, self._seen = res
-        toks = np.asarray(self.tok)
-        if rec is not None:
-            # the np.asarray above is the per-token device->host sync:
-            # dispatch-return -> committed-tokens-on-host is the "wait"
-            rec.marks.append(("wait", time.perf_counter()))
+    def _ilv_next(self):
+        """Front pending slot's next-chunk descriptor, or None. Skips
+        (and dequeues) slots whose pending request was cancelled while
+        it waited."""
+        while self._pending_q:
+            slot = self._pending_q[0]
+            req = self._slot_req[slot]
+            if req is None or req.get("pending") is None:
+                self._pending_q.pop(0)
+                continue
+            p = req["pending"]
+            c = p["next"]
+            p_c = self._ilv
+            return {"slot": slot, "req": req, "p": p,
+                    "chunk": jnp.asarray(
+                        p["padded"][:, c * p_c:(c + 1) * p_c]),
+                    "start": jnp.int32(c * p_c),
+                    "last": c + 1 == p["n_chunks"]}
+        return None
+
+    def _ilv_after_chunk(self, ilv, pf_logits, new_row, s_idx):
+        """Bookkeeping after a mixed step's prefill leg: stash the grown
+        row, or — on the final chunk — dispatch the FUSED finish
+        (install + on-device first-token sample + slot-state scatter,
+        one program) and defer the first token's readback to the next
+        step's commit: admission never blocks on a device->host sync."""
+        req, p, slot = ilv["req"], ilv["p"], ilv["slot"]
+        self.prefill_chunks_run += 1
+        m = obs.metrics()
+        if m is not None:
+            m.inc("serving.prefill_chunks_total")
+        if not ilv["last"]:
+            p["row"] = new_row
+            p["next"] += 1
+            return
+        self._pending_q.pop(0)
+        fin = self._ilv_finish(
+            self.cache, new_row, pf_logits,
+            jnp.int32(p["last_local"]), jnp.int32(slot),
+            p["prefill_key"], p["slot_key"],
+            self.pos, self.tok, self.active, self.keys,
+            self._temp, self._topk, self._topp, self._minp, self._rep,
+            self._seen, self._bias,
+            jnp.float32(p["t"]), jnp.int32(p["k"]), jnp.float32(p["p"]),
+            jnp.float32(p["mp"]), jnp.float32(p["rp"]),
+            p["seen_row"], p["b_row"],
+            jnp.int32(req["prompt_len"]), p["install_ids"])
+        (self.cache, self.pos, self.tok, self.active, self.keys,
+         self._temp, self._topk, self._topp, self._minp, self._rep,
+         self._seen, self._bias, first) = fin[:13]
+        req["first_dev"] = (first, fin[13:] if req["logprobs"] else None)
+        req["install_step"] = s_idx
+        del req["pending"]
+
+    def _commit_step(self, s_idx, toks, c_lp, t_lp, t_ids, rec, sc):
+        """Commit one completed step's tokens to host bookkeeping.
+        `s_idx` names the DISPATCH this data came from: a slot whose
+        fused admission finish landed at install_step >= s_idx had no
+        decode leg in that dispatch, so its row of `toks` is garbage
+        and is skipped; the first commit past the install materializes
+        the deferred first token (and its logprobs) ahead of the
+        step's own token. Returns {rid: token | [tokens]} (a list when
+        the deferred first commits together with a decode token)."""
         m = obs.metrics()
         t_now = time.perf_counter() if m is not None else 0.0
         n_adv = 0
         it_samples: list = []
         out = {}
         for slot, req in enumerate(self._slot_req):
-            if req is None:
+            if req is None or req.get("pending") is not None:
                 continue
-            token = int(toks[slot])
-            req["emitted"].append(token)
-            if req["logprobs"]:
-                req["lp"].append(float(c_lp[slot]))
-                req["lp_top"].append((t_ids[slot], t_lp[slot]))
-            out[req["rid"]] = token
-            n_adv += 1
-            self._obs_commit(req, m, t_now, samples=it_samples)
-            if "constraint" in req:
-                # host DFA walk updates the (slots,) state vector only;
-                # the mask rows themselves live on device (_ctable)
-                self._constraint_advance(slot, token)
-            self._free_rolled_blocks(slot)  # windowed pools reclaim
-            self._retire_if_done(slot)
+            inst = req.get("install_step")
+            committed: list = []
+            if inst is not None:
+                if s_idx <= inst:
+                    continue  # this step's dispatch predates the install
+                del req["install_step"]
+                fd = req.pop("first_dev", None)
+                if fd is not None:  # deferred interleaved first token
+                    first, f_lp = fd
+                    tok0 = int(np.asarray(first))
+                    req["emitted"].append(tok0)
+                    if req["logprobs"]:
+                        req["lp"].append(float(np.asarray(f_lp[0])[0]))
+                        req["lp_top"].append(
+                            (np.asarray(f_lp[2])[0],
+                             np.asarray(f_lp[1])[0]))
+                    committed.append(tok0)
+                    if m is not None and (g := self.goodput) is not None:
+                        # prefill goodput is credited when its first
+                        # token commits (the convoy path: at submit)
+                        g.on_prefill(req["prompt_len"])
+                    self._free_rolled_blocks(slot)
+                    self._retire_if_done(slot)
+            if self._slot_req[slot] is req:
+                token = int(toks[slot])
+                req["emitted"].append(token)
+                if req["logprobs"]:
+                    req["lp"].append(float(c_lp[slot]))
+                    req["lp_top"].append((t_ids[slot], t_lp[slot]))
+                committed.append(token)
+                self._obs_commit(req, m, t_now, n_new=len(committed),
+                                 samples=it_samples)
+                if "constraint" in req:
+                    # host DFA walk updates the (slots,) state vector
+                    # only; the mask rows live on device (_ctable)
+                    self._constraint_advance(slot, token)
+                self._free_rolled_blocks(slot)  # windowed pools reclaim
+                self._retire_if_done(slot)
+            if committed:
+                n_adv += len(committed)
+                out[req["rid"]] = (committed[0] if len(committed) == 1
+                                   else committed)
         if rec is not None:
             rec.marks.append(("commit", time.perf_counter()))
         self._obs_step_end(m, n_adv, it_samples)
@@ -1758,8 +2065,166 @@ class ContinuousBatcher:
             sc.end(rec, n_adv)
         return out
 
+    def _lp_host(self, lp_refs):
+        if lp_refs is None:
+            return None, None, None
+        return (np.asarray(lp_refs[0]), np.asarray(lp_refs[1]),
+                np.asarray(lp_refs[2]))
+
+    def _uncommitted_need(self, lag_per_step: int) -> int:
+        """Furthest position count the next dispatch's writes need
+        covered, including tokens the host has NOT committed yet: a
+        deferred interleaved first token, plus `lag_per_step` positions
+        per uncommitted in-flight step under overlap (1 for the dense
+        step, spec_k+1 for a speculative chunk). One definition shared
+        by both step loops — an under-grown bucket silently clamps the
+        device write, so this formula must not drift per batcher.
+        Returns 0 when nothing decodes (pending-only pools)."""
+        need = 0
+        for req in self._slot_req:
+            if req is None or req.get("pending") is not None:
+                continue
+            u = 1 if "first_dev" in req else 0
+            need = max(need, req["prompt_len"] + len(req["emitted"]) + u)
+        if need and self._inflight is not None:
+            need += lag_per_step
+        return need
+
+    def _pipeline_fill_end(self, rec, sc):
+        """Close a step record for a pipeline-FILLING dispatch (the
+        overlap pipeline's first call: a step went out, nothing commits
+        yet) — shared by the dense and speculative step loops so the
+        StepClock phase protocol stays identical across batchers."""
+        if rec is not None:
+            t = time.perf_counter()
+            rec.marks.append(("wait", t))
+            rec.marks.append(("commit", t))
+        self._obs_step_end(obs.metrics(), 0, None)
+        if rec is not None:
+            rec.marks.append(("obs", time.perf_counter()))
+            sc.end(rec, 0)
+        return {}
+
+    def flush_overlap(self) -> Dict[int, int]:
+        """Commit the trailing in-flight step (overlap mode); {} and a
+        no-op otherwise. drain() calls it once the pool empties, and
+        the idle lm_server worker calls it so the final dispatched
+        step's bookkeeping (its StepClock record, tokens past
+        retirement) never dangles across an idle period."""
+        if self._inflight is None:
+            return {}
+        sc = self.step_clock
+        rec = sc.begin() if sc is not None else None
+        p_idx, p_tok, p_lps = self._inflight
+        self._inflight = None
+        toks = np.asarray(p_tok)
+        c_lp, t_lp, t_ids = self._lp_host(p_lps)
+        if rec is not None:
+            rec.marks.append(("wait", time.perf_counter()))
+        return self._commit_step(p_idx, toks, c_lp, t_lp, t_ids, rec, sc)
+
+    def step(self) -> Dict[int, int]:
+        """One decode step for every active slot. Returns {rid: token}
+        for slots that advanced ({rid: [tokens]} when an interleaved
+        admission's deferred first token commits in the same call);
+        finished requests move to .results. With overlap=True the call
+        DISPATCHES step N and commits step N-1 — tokens surface one
+        call later (drain()/flush_overlap() commit the trailing step)."""
+        if self.n_active == 0:
+            return self.flush_overlap()
+        # step-timeline phase clock (obs/timeline.py): rec is None when
+        # no clock is attached OR the obs gate is off — every later
+        # site is one None check
+        sc = self.step_clock
+        rec = sc.begin() if sc is not None else None
+        if self._buckets is not None:
+            # this step writes each active slot's next position; cover
+            # the furthest one, host-uncommitted tokens included
+            # (_uncommitted_need: deferred interleaved firsts + one
+            # position per in-flight step under overlap)
+            need = self._uncommitted_need(1)
+            if need:
+                self._ensure_cache_len(need)
+        if self._crow_dirty:
+            self._crow = jnp.asarray(self._crow_np)
+            self._crow_dirty = False
+        ilv = self._ilv_next() if self._ilv else None
+        if rec is not None:
+            rec.marks.append(("host", time.perf_counter()))
+        # host annotation: a POST /profilez capture shows each pool step
+        # as a named block on the host track (obs/profile.annotation_ctx
+        # — the non-generator form; ~6 µs on / ~0.2 µs off, inside the
+        # <2% obs budget)
+        # one shared positional block for both dispatch forms — the
+        # mixed program's decode leg takes the decode step's exact
+        # argument order (donate_argnums indices align by construction)
+        state = (self.cache, self.pos, self.tok, self.active, self.keys,
+                 self._temp, self._topk, self._topp, self._minp,
+                 self._rep, self._seen, self._bias, self._crow,
+                 self._ctable)
+        with _prof_annotation("serving.decode_step"):
+            if ilv is None:
+                res = self._decode(self._decode_view, *state)
+            else:
+                res = self._mixed(
+                    self._decode_view,
+                    self._lora_prefill_view(ilv["p"]["aid"]), *state,
+                    ilv["p"]["row"], ilv["chunk"], ilv["start"])
+                res, pf_logits, new_row = res[:-2], res[-2], res[-1]
+        # drop the tuple's references to the just-donated buffers NOW:
+        # holding them to frame teardown makes their deletion run after
+        # the step record closes, and deleting a donated-but-pending
+        # buffer blocks on the in-flight computation — measured as ~a
+        # device-step of unattributed dark time per call (the step
+        # timeline probe's coverage assert caught it)
+        del state
+        if rec is not None:
+            rec.marks.append(("dispatch", time.perf_counter()))
+            rec.mixed = ilv is not None
+        lp_refs = None
+        if self._logprobs_k:
+            (self.cache, self.pos, self.tok, self.keys, self._seen,
+             c_lp_d, t_lp_d, t_ids_d) = res
+            lp_refs = (c_lp_d, t_lp_d, t_ids_d)
+        else:
+            self.cache, self.pos, self.tok, self.keys, self._seen = res
+        s_idx = self._step_idx
+        self._step_idx += 1
+        if ilv is not None:
+            self._ilv_after_chunk(ilv, pf_logits, new_row, s_idx)
+        if self._overlap:
+            if sc is not None:
+                sc.overlap_depth = 1
+            # snapshot THIS step's committed tokens before the next
+            # dispatch donates their buffer: jnp.copy enqueues its read
+            # ahead of the donation, and in-order device execution
+            # makes the copied value safe. The logprob outputs are
+            # never fed back (hence never donated) — bare refs suffice.
+            keep = (s_idx, jnp.copy(self.tok), lp_refs)
+            prev, self._inflight = self._inflight, keep
+            if prev is None:
+                return self._pipeline_fill_end(rec, sc)
+            p_idx, p_tok, p_lps = prev
+            toks = np.asarray(p_tok)
+            c_lp, t_lp, t_ids = self._lp_host(p_lps)
+            if rec is not None:
+                # with the pipeline live, "wait" is only the RESIDUAL
+                # unhidden device time of step N-1 — the hiding the
+                # dispatch_slack gauge predicted, verified here
+                rec.marks.append(("wait", time.perf_counter()))
+            return self._commit_step(p_idx, toks, c_lp, t_lp, t_ids,
+                                     rec, sc)
+        toks = np.asarray(self.tok)
+        c_lp, t_lp, t_ids = self._lp_host(lp_refs)
+        if rec is not None:
+            # the np.asarray above is the per-token device->host sync:
+            # dispatch-return -> committed-tokens-on-host is the "wait"
+            rec.marks.append(("wait", time.perf_counter()))
+        return self._commit_step(s_idx, toks, c_lp, t_lp, t_ids, rec, sc)
+
     def drain(self) -> Dict[int, np.ndarray]:
         """Run until every submitted request finishes; returns .results."""
         while self.n_active:
             self.step()
+        self.flush_overlap()
         return self.results

@@ -76,9 +76,11 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
+from dnn_tpu import obs
 from dnn_tpu.models.gpt import GPTConfig, prepare_stacked  # noqa: F401
 from dnn_tpu.runtime.kvcache import codec_for_cache
-from dnn_tpu.runtime.serving import ContinuousBatcher, GPTFamilyRows
+from dnn_tpu.runtime.serving import (ContinuousBatcher, GPTFamilyRows,
+                                     install_dense_row)
 # the ONE sampling transform shared with the solo speculative loop:
 # rejection sampling is only exact when draft and target use the
 # identical transform, so both paths must import the same function
@@ -217,8 +219,8 @@ class SpeculativeBatcher(ContinuousBatcher):
         greedy = self._greedy
         temperature, top_k = self._temperature, self._top_k_opt
 
-        def spec_step(t_prepared, d_prepared, t_cache, d_cache, tok, pos,
-                      active, keys, prev_chunk, prev_pos):
+        def _spec_core(t_prepared, d_prepared, t_cache, d_cache, tok, pos,
+                       active, keys, prev_chunk, prev_pos):
             b = tok.shape[0]
             # 1. draft sync (write-only; logits discarded)
             _, d_cache = d_family.verify_rows(
@@ -303,6 +305,12 @@ class SpeculativeBatcher(ContinuousBatcher):
             return (t_cache, d_cache, last, pos + committed, keys,
                     new_prev_chunk, new_prev_pos, w, m)
 
+        def spec_step(t_prepared, d_prepared, t_cache, d_cache, tok, pos,
+                      active, keys, prev_chunk, prev_pos):
+            return _spec_core(t_prepared, d_prepared, t_cache, d_cache,
+                              tok, pos, active, keys, prev_chunk,
+                              prev_pos)
+
         # donate BOTH caches and every per-slot vector the step returns
         # (tok, pos, keys, prev_chunk, prev_pos) — `active` is read-only
         # through the step and host-updated between calls, so it stays
@@ -310,6 +318,65 @@ class SpeculativeBatcher(ContinuousBatcher):
         # (analysis/program.audit_serving_decode).
         self._spec_step = jax.jit(spec_step,
                                   donate_argnums=(2, 3, 4, 5, 7, 8, 9))
+
+        # interleaved chunked prefill (ISSUE 12), speculative shape: the
+        # spec step program grows BOTH prefill legs — one target chunk
+        # and one draft chunk for the admitting request fold into the
+        # same compiled program as every active slot's draft/verify
+        # round, and the fused finish installs both rows, samples the
+        # first token on device, and seeds the draft-sync state
+        # (prev_chunk/prev_pos) in one dispatch.
+        self._spec_mixed = None
+        self._spec_ilv_finish = None
+        if self._ilv:
+            def spec_mixed(t_prepared, d_prepared, t_cache, d_cache,
+                           tok, pos, active, keys, prev_chunk, prev_pos,
+                           row, d_row, chunk, chunk_start):
+                out = _spec_core(t_prepared, d_prepared, t_cache,
+                                 d_cache, tok, pos, active, keys,
+                                 prev_chunk, prev_pos)
+                pf_logits, new_row = t_family.prefill(
+                    t_prepared, chunk, row, chunk_start)
+                _, new_d_row = d_family.prefill(
+                    d_prepared, chunk, d_row, chunk_start)
+                return out + (pf_logits, new_row, new_d_row)
+
+            self._spec_mixed_donate = (2, 3, 4, 5, 7, 8, 9, 10, 11)
+            self._spec_mixed = jax.jit(
+                spec_mixed, donate_argnums=self._spec_mixed_donate)
+
+            parent_fin = self._ilv_finish_core
+            kk1 = k + 1
+
+            def spec_ilv_finish(cache, d_cache, row, d_row, logits,
+                                last_local, slot, rng, slot_key, pos,
+                                tok, active, keys, temp_v, tk_v, tp_v,
+                                mp_v, rep_v, seen, bias_buf, t, kk_, p,
+                                mp_, rp, seen_row, b_row, prompt_len,
+                                install_ids, tail, prev_chunk,
+                                prev_pos):
+                out = parent_fin(cache, row, logits, last_local, slot,
+                                 rng, slot_key, pos, tok, active, keys,
+                                 temp_v, tk_v, tp_v, mp_v, rep_v, seen,
+                                 bias_buf, t, kk_, p, mp_, rp, seen_row,
+                                 b_row, prompt_len, install_ids)
+                # draft-row install: the one shared clamped install
+                # (serving.install_dense_row)
+                d_cache = install_dense_row(d_cache, d_row, slot)
+                # first sync chunk: the prompt's own tail at its own
+                # positions — an exact no-op re-feed
+                prev_chunk = prev_chunk.at[slot].set(tail)
+                prev_pos = prev_pos.at[slot].set(prompt_len - kk1)
+                return out + (d_cache, prev_chunk, prev_pos)
+
+            donate = [0, 1, 9, 10, 11, 12, 13, 14, 15, 16, 17, 18,
+                      30, 31]
+            if self._allow_bias:
+                donate.append(19)
+            self._spec_ilv_finish_donate = tuple(sorted(donate))
+            self._spec_ilv_finish = jax.jit(
+                spec_ilv_finish,
+                donate_argnums=self._spec_ilv_finish_donate)
 
         # draft-side chunked prefill (the target side reuses the parent's
         # programs); the install is the parent's dense slice-install
@@ -321,14 +388,7 @@ class SpeculativeBatcher(ContinuousBatcher):
             return d_family.prefill(prepared, chunk, row, chunk_start)
 
         def d_install(cache, row, slot):
-            return {
-                kk: lax.dynamic_update_slice_in_dim(
-                    cache[kk],
-                    lax.slice_in_dim(row[kk], 0, cache[kk].shape[3],
-                                     axis=3),
-                    slot, axis=1)
-                for kk in cache
-            }
+            return install_dense_row(cache, row, slot)
 
         self._d_prefill_chunk = jax.jit(d_prefill_chunk,
                                         donate_argnums=(1,))
@@ -358,8 +418,11 @@ class SpeculativeBatcher(ContinuousBatcher):
         daemon's compile-cache budget must count the programs it
         actually churns (_d_prefill_chunk recompiles per prompt-length
         bucket, exactly like the parent's chunk program)."""
-        return super().jit_programs() + [
+        fns = super().jit_programs() + [
             self._spec_step, self._d_prefill_chunk, self._d_install]
+        if self._spec_mixed is not None:
+            fns += [self._spec_mixed, self._spec_ilv_finish]
+        return fns
 
     def submit(self, prompt, max_new_tokens: int,
                seed: Optional[int] = None, **opts) -> int:
@@ -395,6 +458,17 @@ class SpeculativeBatcher(ContinuousBatcher):
                      if r is not None and r["rid"] == rid), None)
         if slot is None:
             return rid
+        if self._ilv:
+            # interleaved admission: the parent enqueued the pending
+            # prefill; attach the draft side — its transient row (grown
+            # chunk-by-chunk in lockstep through spec_mixed) and the
+            # prompt tail the fused finish seeds prev_chunk with
+            p = self._slot_req[slot].get("pending")
+            if p is not None:
+                p["d_row"] = self._d_family.init_cache(
+                    1, self._ilv_row_len, self.d_cache["k"].dtype)
+                p["tail"] = jnp.asarray(prompt_arr[-(k + 1):])
+            return rid
         # draft prefill: same chunk loop as the parent, through the draft
         p_pad = self.prompt_pad
         n_chunks = -(-len(prompt_arr) // p_pad)
@@ -416,57 +490,86 @@ class SpeculativeBatcher(ContinuousBatcher):
             len(prompt_arr) - (k + 1))
         return rid
 
-    def step(self):
-        """One speculative step: every active slot advances by its own
-        1..k+1 committed tokens. Returns {rid: [tokens...]}."""
-        if self.n_active == 0:
-            return {}
-        # step-timeline clock: same phase protocol as the dense step
-        # (serving.ContinuousBatcher.step) — one speculative step's
-        # "wait" is the draft+verify chunk's device->host sync
-        sc = self.step_clock
-        rec = sc.begin() if sc is not None else None
-        if self._buckets is not None:
-            # this step verifies at pos..pos+k for every active slot
-            # (pos = prompt_len + emitted - 1); _ensure_cache_len adds
-            # the +k scratch itself and grows the draft pool in lockstep
-            self._ensure_cache_len(max(
-                req["prompt_len"] + len(req["emitted"])
-                for req in self._slot_req if req is not None))
-        if rec is not None:
-            rec.marks.append(("host", time.perf_counter()))
-        (self.cache, self.d_cache, self.tok, self.pos, self.keys,
-         self.prev_chunk, self.prev_pos, w, m) = self._spec_step(
-            self.prepared, self.draft_prepared, self.cache, self.d_cache,
-            self.tok, self.pos, self.active, self.keys,
-            self.prev_chunk, self.prev_pos)
-        if rec is not None:
-            rec.marks.append(("dispatch", time.perf_counter()))
-        w_np, m_np = np.asarray(w), np.asarray(m)
-        if rec is not None:
-            rec.marks.append(("wait", time.perf_counter()))
-        self.spec_steps += 1
-        from dnn_tpu import obs
+    def _ilv_after_chunk(self, ilv, pf_logits, rows, s_idx):
+        """Speculative override of the interleave bookkeeping: `rows`
+        is the (target row, draft row) pair the spec mixed program
+        returned; the final chunk dispatches the fused finish that
+        installs BOTH rows, samples the first token on device, and
+        seeds the draft-sync state."""
+        req, p, slot = ilv["req"], ilv["p"], ilv["slot"]
+        new_row, new_d_row = rows
+        self.prefill_chunks_run += 1
+        m = obs.metrics()
+        if m is not None:
+            m.inc("serving.prefill_chunks_total")
+        if not ilv["last"]:
+            p["row"], p["d_row"] = new_row, new_d_row
+            p["next"] += 1
+            return
+        self._pending_q.pop(0)
+        fin = self._spec_ilv_finish(
+            self.cache, self.d_cache, new_row, new_d_row, pf_logits,
+            jnp.int32(p["last_local"]), jnp.int32(slot),
+            p["prefill_key"], p["slot_key"],
+            self.pos, self.tok, self.active, self.keys,
+            self._temp, self._topk, self._topp, self._minp, self._rep,
+            self._seen, self._bias,
+            jnp.float32(p["t"]), jnp.int32(p["k"]), jnp.float32(p["p"]),
+            jnp.float32(p["mp"]), jnp.float32(p["rp"]),
+            p["seen_row"], p["b_row"], jnp.int32(req["prompt_len"]),
+            p["install_ids"], p["tail"], self.prev_chunk, self.prev_pos)
+        (self.cache, self.pos, self.tok, self.active, self.keys,
+         self._temp, self._topk, self._topp, self._minp, self._rep,
+         self._seen, self._bias, first) = fin[:13]
+        # the parent core appends logprob outputs only when logprobs_k
+        # is compiled in — the spec batcher bans it, so the tail is
+        # exactly (d_cache, prev_chunk, prev_pos)
+        self.d_cache, self.prev_chunk, self.prev_pos = fin[13:]
+        req["first_dev"] = (first, None)
+        req["install_step"] = s_idx
+        del req["pending"]
 
+    def _commit_spec(self, s_idx, w_np, m_np, rec, sc):
+        """Commit one completed speculative step (the chunk block `w`
+        and acceptance counts `m`), with the same install gating as the
+        dense _commit_step: slots whose fused finish landed at
+        install_step >= s_idx had no verify leg in that dispatch."""
+        self.spec_steps += 1
         obs_m = obs.metrics()
         t_now = time.perf_counter() if obs_m is not None else 0.0
         n_adv = 0
         it_samples: list = []
         out = {}
         for slot, req in enumerate(self._slot_req):
-            if req is None:
+            if req is None or req.get("pending") is not None:
                 continue
-            n_commit = int(m_np[slot]) + 1
-            self.spec_proposed += self.spec_k
-            self.spec_accepted += int(m_np[slot])
-            toks = [int(t) for t in w_np[slot, :n_commit]]
+            inst = req.get("install_step")
             emitted = []
-            for t in toks:
-                req["emitted"].append(t)
-                emitted.append(t)
-                self._retire_if_done(slot)
-                if self._slot_req[slot] is None:
-                    break  # budget/stop/eos hit mid-chunk: rest discarded
+            if inst is not None:
+                if s_idx <= inst:
+                    continue
+                del req["install_step"]
+                fd = req.pop("first_dev", None)
+                if fd is not None:
+                    tok0 = int(np.asarray(fd[0]))
+                    req["emitted"].append(tok0)
+                    emitted.append(tok0)
+                    if obs_m is not None \
+                            and (g := self.goodput) is not None:
+                        g.on_prefill(req["prompt_len"])
+                    self._retire_if_done(slot)
+            if self._slot_req[slot] is req:
+                n_commit = int(m_np[slot]) + 1
+                self.spec_proposed += self.spec_k
+                self.spec_accepted += int(m_np[slot])
+                for t in [int(x) for x in w_np[slot, :n_commit]]:
+                    req["emitted"].append(t)
+                    emitted.append(t)
+                    self._retire_if_done(slot)
+                    if self._slot_req[slot] is None:
+                        break  # budget/stop/eos mid-chunk: rest discarded
+            if not emitted:
+                continue
             # shared obs bookkeeping (serving.ContinuousBatcher helpers):
             # the inter-token gap spreads over the committed chunk; the
             # decode span closes at retire like the dense path. Skipped
@@ -484,3 +587,84 @@ class SpeculativeBatcher(ContinuousBatcher):
             rec.marks.append(("obs", time.perf_counter()))
             sc.end(rec, n_adv)
         return out
+
+    def flush_overlap(self):
+        """Speculative flush: the inflight struct holds the chunk block
+        and acceptance counts (never donated by later dispatches, so
+        bare refs suffice — no copy needed)."""
+        if self._inflight is None:
+            return {}
+        sc = self.step_clock
+        rec = sc.begin() if sc is not None else None
+        s_idx, w_ref, m_ref = self._inflight
+        self._inflight = None
+        w_np, m_np = np.asarray(w_ref), np.asarray(m_ref)
+        if rec is not None:
+            rec.marks.append(("wait", time.perf_counter()))
+        return self._commit_spec(s_idx, w_np, m_np, rec, sc)
+
+    def step(self):
+        """One speculative step: every active slot advances by its own
+        1..k+1 committed tokens. Returns {rid: [tokens...]}. Interleave
+        and overlap compose exactly as in the dense step: a pending
+        admission's chunk folds into the spec program, and overlap=True
+        dispatches step N while committing step N-1."""
+        if self.n_active == 0:
+            return self.flush_overlap()
+        # step-timeline clock: same phase protocol as the dense step
+        # (serving.ContinuousBatcher.step) — one speculative step's
+        # "wait" is the draft+verify chunk's device->host sync
+        sc = self.step_clock
+        rec = sc.begin() if sc is not None else None
+        if self._buckets is not None:
+            # this step verifies at pos..pos+k for every active slot
+            # (pos = prompt_len + emitted - 1); _ensure_cache_len adds
+            # the +k scratch itself and grows the draft pool in
+            # lockstep. Host-uncommitted tokens count too — a deferred
+            # first, plus up to k+1 per in-flight step under overlap
+            # (the shared _uncommitted_need accounting).
+            need = self._uncommitted_need(self.spec_k + 1)
+            if need:
+                self._ensure_cache_len(need)
+        ilv = self._ilv_next() if self._ilv else None
+        if rec is not None:
+            rec.marks.append(("host", time.perf_counter()))
+        if ilv is None:
+            (self.cache, self.d_cache, self.tok, self.pos, self.keys,
+             self.prev_chunk, self.prev_pos, w, m) = self._spec_step(
+                self.prepared, self.draft_prepared, self.cache,
+                self.d_cache, self.tok, self.pos, self.active,
+                self.keys, self.prev_chunk, self.prev_pos)
+        else:
+            p = ilv["p"]
+            (self.cache, self.d_cache, self.tok, self.pos, self.keys,
+             self.prev_chunk, self.prev_pos, w, m, pf_logits, new_row,
+             new_d_row) = self._spec_mixed(
+                self.prepared, self.draft_prepared, self.cache,
+                self.d_cache, self.tok, self.pos, self.active,
+                self.keys, self.prev_chunk, self.prev_pos,
+                p["row"], p["d_row"], ilv["chunk"], ilv["start"])
+        if rec is not None:
+            rec.marks.append(("dispatch", time.perf_counter()))
+            rec.mixed = ilv is not None
+        s_idx = self._step_idx
+        self._step_idx += 1
+        if ilv is not None:
+            self._ilv_after_chunk(ilv, pf_logits, (new_row, new_d_row),
+                                  s_idx)
+        if self._overlap:
+            if sc is not None:
+                sc.overlap_depth = 1
+            keep = (s_idx, w, m)
+            prev, self._inflight = self._inflight, keep
+            if prev is None:
+                return self._pipeline_fill_end(rec, sc)
+            s_prev, w_prev, m_prev = prev
+            w_np, m_np = np.asarray(w_prev), np.asarray(m_prev)
+            if rec is not None:
+                rec.marks.append(("wait", time.perf_counter()))
+            return self._commit_spec(s_prev, w_np, m_np, rec, sc)
+        w_np, m_np = np.asarray(w), np.asarray(m)
+        if rec is not None:
+            rec.marks.append(("wait", time.perf_counter()))
+        return self._commit_spec(s_idx, w_np, m_np, rec, sc)

@@ -166,6 +166,30 @@ def llama_decode_token_flops(cfg, context: float) -> float:
     return l * per_layer + 2.0 * c * v
 
 
+def tree_weight_bytes(tree) -> float:
+    """Total HBM bytes of a parameter pytree's array leaves, priced at
+    the DEVICE layout: int8 at 1 byte/element (quantized kernels),
+    int4/uint4 at their packed HALF byte (host numpy views pad to one
+    byte, so a dtype.itemsize walk would overstate the weight-streaming
+    MBU denominator 2x for int4 trees). The f32 scale rows quantized
+    trees carry are counted at full width — they stream with the
+    weights every decode step. This is THE weight-bytes accounting the
+    serving goodput gauges use (obs/goodput.model_cost), so an
+    LMServer(weights="int8") daemon's MBU prices its quantized stream
+    correctly instead of flattering itself with f32 bytes."""
+    total = 0.0
+    for leaf in jax.tree_util.tree_leaves(tree):
+        dt = getattr(leaf, "dtype", None)
+        if dt is None:
+            continue
+        name = getattr(dt, "name", str(dt))
+        if name in ("int4", "uint4"):
+            total += leaf.size * 0.5
+        else:
+            total += leaf.size * dt.itemsize
+    return float(total)
+
+
 def kv_bytes_per_pos(cfg, *, kv_bytes: float = 2,
                      kv_dtype=None) -> float:
     """HBM bytes one cache POSITION occupies (K + V rows across all

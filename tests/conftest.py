@@ -103,6 +103,12 @@ _MODULE_COST_S = {
     # quantized byte accounting — certified inside the tier-1 budget
     "test_spec_buckets": 36.0,  # speculative x bucketed composition
     # parity (greedy + sampled, rung crossings, draft-pool lockstep)
+    "test_overlap": 50.0,  # ISSUE 12 overlap & fusion: mixed-step token
+    # parity vs the convoy path (dense/paged/bucketed/speculative,
+    # sampled draw-for-draw, mid-decode admission), double-buffer
+    # ordering, fused-sampling logprob agreement, the un-aliased-mixed
+    # gate test, int8-weights serving parity + byte pricing — certified
+    # inside the tier-1 budget with the serving modules
     "test_chaos": 42.0,  # ISSUE 8 chaos + self-healing: injection
     # goldens, supervisor restart/backoff/crash-loop (tiny python -c
     # children), requeue token parity, drain-under-load, circuit

@@ -57,7 +57,10 @@ def test_serving_decode_fully_aliased_no_cache_copies():
 
     report = audit_serving_decode()
     assert not report["findings"], [f.message for f in report["findings"]]
-    assert set(report["variants"]) == {
+    # >= : ISSUE 12 added the mixed-step/fused-finish variants, pinned
+    # by name in tests/test_overlap.py — this gate only requires that
+    # none of the original six ever drop out of the audit
+    assert set(report["variants"]) >= {
         "dense_f32", "dense_int8", "dense_int4", "bucketed", "paged",
         "speculative"}
     for name, v in report["variants"].items():

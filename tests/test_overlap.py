@@ -1,0 +1,445 @@
+"""ISSUE 12 — overlap & fusion: interleaved chunked prefill, the
+double-buffered dispatch pipeline, fused on-device admission sampling,
+and the int8-weights serving rung.
+
+The load-bearing contracts:
+
+  * mixed-step token parity: a batcher admitting through the MIXED
+    program (prefill_chunk_tokens — chunks fold into decode steps, the
+    fused finish samples the first token on device) produces token
+    streams IDENTICAL to the convoy path, greedy and sampled
+    draw-for-draw, across dense/paged/bucketed/speculative pools and
+    for requests admitted mid-decode;
+  * double-buffer ordering: overlap=True never surfaces step N+1's
+    tokens before step N's commit, and drain()/flush_overlap() commit
+    the trailing dispatched step;
+  * fused-sampling logprob agreement: the fused finish's first-token
+    logprobs match the convoy finish's exactly;
+  * the analysis gate extends to the mixed-step programs: full
+    donation aliasing + zero cache-sized copies on HEAD, and a
+    deliberately un-aliased mixed variant FAILS the gate;
+  * int8 weight serving (LMServer weights=): token parity within a
+    cosine bound, and the MBU byte accounting prices the quantized
+    stream (utils/flops.tree_weight_bytes).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from dnn_tpu.models import gpt
+from dnn_tpu.runtime.serving import ContinuousBatcher
+from dnn_tpu.runtime.serving_spec import SpeculativeBatcher
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = gpt.GPTConfig(block_size=64, vocab_size=64, n_layer=2,
+                        n_head=2, n_embd=32)
+    prepared = gpt.prepare_stacked(gpt.init(jax.random.PRNGKey(0), cfg),
+                                   cfg)
+    return cfg, prepared
+
+
+def _serve(cfg, prepared, submits, *, spec=None, **kw):
+    """Run a submission schedule (list of (prompt, max_new, opts,
+    steps_before)) through a batcher; returns {idx: tokens list}."""
+    if spec is not None:
+        srv = SpeculativeBatcher(cfg, prepared, cfg, spec, spec_k=2,
+                                 slots=3, max_len=64, prompt_pad=8, **kw)
+    else:
+        srv = ContinuousBatcher(cfg, prepared, slots=3, max_len=64,
+                                prompt_pad=8, **kw)
+    rids = []
+    for prompt, max_new, opts, steps_before in submits:
+        for _ in range(steps_before):
+            srv.step()
+        rids.append(srv.submit(np.asarray(prompt, np.int32), max_new,
+                               **opts))
+    srv.drain()
+    return [srv.results[r].tolist() for r in rids], srv
+
+
+SCHEDULE = [
+    (range(1, 10), 12, {"seed": 0}, 0),
+    (range(2, 8), 10, {"seed": 1, "temperature": 0.9, "top_k": 5}, 0),
+    # admitted mid-decode: three steps in, while others stream
+    (range(3, 20), 8, {"seed": 2}, 3),
+    # budget-1, admitted once a slot has freed (20 further steps covers
+    # the deferred-commit lag of the interleaved path too): retires on
+    # its first token without ever decoding
+    (range(1, 6), 1, {"seed": 3}, 20),
+]
+
+
+@pytest.mark.parametrize("pool_kw", [
+    {},  # dense
+    {"kv": "paged", "block_len": 8},
+    {"decode_buckets": True},
+])
+def test_mixed_step_token_parity(model, pool_kw):
+    cfg, prepared = model
+    base, _ = _serve(cfg, prepared, SCHEDULE, **pool_kw)
+    mixed, srv = _serve(cfg, prepared, SCHEDULE,
+                        prefill_chunk_tokens=8, **pool_kw)
+    assert mixed == base
+    both, _ = _serve(cfg, prepared, SCHEDULE, prefill_chunk_tokens=8,
+                     overlap=True, **pool_kw)
+    assert both == base
+    # the interleave actually engaged (pendings flowed through steps)
+    assert srv._ilv and srv._mixed is not None
+
+
+def test_mixed_step_sampled_draw_for_draw(model):
+    """Fused on-device admission sampling == the convoy finish,
+    draw-for-draw: same per-request rng streams, same filter math."""
+    cfg, prepared = model
+    sched = [
+        (range(1, 12), 9,
+         {"seed": 11, "temperature": 0.8, "top_p": 0.9,
+          "repetition_penalty": 1.3}, 0),
+        (range(4, 9), 7,
+         {"seed": 12, "temperature": 1.1, "min_p": 0.05}, 2),
+    ]
+    base, _ = _serve(cfg, prepared, sched)
+    mixed, _ = _serve(cfg, prepared, sched, prefill_chunk_tokens=8)
+    assert mixed == base
+    both, _ = _serve(cfg, prepared, sched, prefill_chunk_tokens=8,
+                     overlap=True)
+    assert both == base
+
+
+def test_multi_chunk_interleaved_prompt(model):
+    """A prompt spanning several interleave chunks folds chunk-by-chunk
+    across consecutive steps and still matches the convoy stream."""
+    cfg, prepared = model
+    sched = [(range(1, 30), 10, {"seed": 4}, 0),
+             (range(2, 25), 8, {"seed": 5}, 1)]
+    base, _ = _serve(cfg, prepared, sched)
+    mixed, _ = _serve(cfg, prepared, sched, prefill_chunk_tokens=8)
+    assert mixed == base
+
+
+def test_overlap_ordering_one_step_pipeline(model):
+    """The double buffer's contract: step() call N returns step N-1's
+    tokens — no step N+1 result is ever consumed before step N's
+    commit — and flush_overlap()/drain() commit the trailing step."""
+    cfg, prepared = model
+    srv = ContinuousBatcher(cfg, prepared, slots=2, max_len=64,
+                            prompt_pad=8, overlap=True)
+    ref = ContinuousBatcher(cfg, prepared, slots=2, max_len=64,
+                            prompt_pad=8)
+    r = srv.submit(np.arange(1, 10), 6, seed=0)
+    ref.submit(np.arange(1, 10), 6, seed=0)
+    out1 = srv.step()      # dispatches step 0, pipeline filling
+    assert out1 == {}
+    assert srv._inflight is not None
+    out2 = srv.step()      # dispatches step 1, commits step 0
+    ref1 = ref.step()
+    assert out2 == ref1    # exactly step 0's tokens, one call later
+    # drain commits everything, including the trailing in-flight step
+    srv.drain()
+    ref.drain()
+    assert srv._inflight is None
+    assert srv.results[r].tolist() == ref.results[0].tolist()
+    # an idle flush on a drained pool is a no-op
+    assert srv.flush_overlap() == {}
+
+
+def test_overlap_streams_match_and_flush_idempotent(model):
+    cfg, prepared = model
+    srv = ContinuousBatcher(cfg, prepared, slots=2, max_len=64,
+                            prompt_pad=8, overlap=True,
+                            prefill_chunk_tokens=8)
+    r = srv.submit(np.arange(1, 10), 4, seed=0)
+    seen = []
+    while srv.n_active:
+        out = srv.step()
+        for t in out.values():
+            seen.extend(t if isinstance(t, list) else [t])
+    out = srv.flush_overlap()
+    for t in out.values():
+        seen.extend(t if isinstance(t, list) else [t])
+    assert seen == srv.results[r].tolist()
+
+
+def test_spec_mixed_parity(model):
+    cfg, prepared = model
+    draft = gpt.prepare_stacked(gpt.init(jax.random.PRNGKey(7), cfg),
+                                cfg)
+    sched = [(range(1, 10), 12, {"seed": 0}, 0),
+             (range(3, 14), 9, {"seed": 2}, 2)]
+    plain, _ = _serve(cfg, prepared, sched)
+    spec_base, _ = _serve(cfg, prepared, sched, spec=draft)
+    assert spec_base == plain  # greedy spec == plain batcher (standing)
+    spec_ilv, srv = _serve(cfg, prepared, sched, spec=draft,
+                           prefill_chunk_tokens=8)
+    assert spec_ilv == plain
+    assert srv._spec_mixed is not None
+    spec_both, _ = _serve(cfg, prepared, sched, spec=draft,
+                          prefill_chunk_tokens=8, overlap=True)
+    assert spec_both == plain
+    # sampled spec: mixed vs convoy draw-for-draw (server-level params)
+    s_sched = [(range(1, 10), 8, {"seed": 5}, 0)]
+    kw = {"temperature": 0.8, "top_k": 8}
+    s_base, _ = _serve(cfg, prepared, s_sched, spec=draft, **kw)
+    s_ilv, _ = _serve(cfg, prepared, s_sched, spec=draft,
+                      prefill_chunk_tokens=8, overlap=True, **kw)
+    assert s_ilv == s_base
+
+
+def test_spec_bucketed_mixed_parity(model):
+    cfg, prepared = model
+    draft = gpt.prepare_stacked(gpt.init(jax.random.PRNGKey(7), cfg),
+                                cfg)
+    sched = [(range(1, 10), 26, {"seed": 0}, 0)]
+    base, _ = _serve(cfg, prepared, sched, spec=draft,
+                     decode_buckets=True)
+    mixed, _ = _serve(cfg, prepared, sched, spec=draft,
+                      decode_buckets=True, prefill_chunk_tokens=8,
+                      overlap=True)
+    assert mixed == base
+
+
+def test_fused_sampling_logprob_agreement(model):
+    """The fused finish's first-token logprob record (chosen + top-k)
+    agrees exactly with the convoy finish's, and the per-step records
+    ride the deferred commit unchanged."""
+    cfg, prepared = model
+
+    def lp_run(**kw):
+        srv = ContinuousBatcher(cfg, prepared, slots=2, max_len=64,
+                                prompt_pad=8, logprobs_k=3, **kw)
+        r = srv.submit(np.arange(1, 10), 6, seed=0, logprobs=True)
+        srv.drain()
+        lp = srv.token_logprobs[r]
+        return (srv.results[r].tolist(), lp["chosen"].tolist(),
+                lp["top_ids"].tolist(), lp["top_logprobs"].tolist())
+
+    base = lp_run()
+    assert lp_run(prefill_chunk_tokens=8) == base
+    assert lp_run(prefill_chunk_tokens=8, overlap=True) == base
+
+
+def test_eos_on_deferred_first_token(model):
+    """A request whose FIRST token is eos (forced via logit bias)
+    retires correctly off the deferred commit, discarding the lagged
+    decode token."""
+    cfg, prepared = model
+
+    def run(**kw):
+        srv = ContinuousBatcher(cfg, prepared, slots=2, max_len=64,
+                                prompt_pad=8, eos_id=5,
+                                allow_logit_bias=True, **kw)
+        r = srv.submit(np.arange(1, 10), 8, seed=0,
+                       logit_bias={5: 1e9})
+        srv.drain()
+        return srv.results[r].tolist(), srv.finish_reasons[r]
+
+    base = run()
+    assert base[1] == "eos"
+    assert run(prefill_chunk_tokens=8) == base
+    assert run(prefill_chunk_tokens=8, overlap=True) == base
+
+
+def test_interleave_validations(model):
+    cfg, prepared = model
+    with pytest.raises(ValueError, match="allow_constraints"):
+        ContinuousBatcher(cfg, prepared, slots=2, max_len=64,
+                          prompt_pad=8, prefill_chunk_tokens=8,
+                          allow_constraints=True)
+    with pytest.raises(ValueError, match="allow_constraints"):
+        ContinuousBatcher(cfg, prepared, slots=2, max_len=64,
+                          prompt_pad=8, overlap=True,
+                          allow_constraints=True)
+    with pytest.raises(ValueError, match="prefix cache"):
+        ContinuousBatcher(cfg, prepared, slots=2, max_len=64,
+                          prompt_pad=8, prefill_chunk_tokens=8,
+                          prefix_cache=4)
+    with pytest.raises(ValueError, match="block_len"):
+        ContinuousBatcher(cfg, prepared, slots=2, max_len=64,
+                          prompt_pad=8, kv="paged", block_len=8,
+                          prefill_chunk_tokens=12)
+    with pytest.raises(ValueError, match="max_len"):
+        ContinuousBatcher(cfg, prepared, slots=2, max_len=64,
+                          prompt_pad=8, prefill_chunk_tokens=128)
+
+
+def test_cancel_pending_interleaved_request(model):
+    """Cancelling a request whose prefill is still queued frees its
+    slot (and paged blocks) without a step ever running it."""
+    cfg, prepared = model
+    srv = ContinuousBatcher(cfg, prepared, slots=2, max_len=64,
+                            prompt_pad=8, kv="paged", block_len=8,
+                            prefill_chunk_tokens=8)
+    used0 = srv._allocator.n_used
+    rid = srv.submit(np.arange(1, 10), 6, seed=0)
+    assert srv._pending_q
+    assert srv.cancel(rid)
+    assert not srv._pending_q
+    assert srv._allocator.n_used == used0
+    assert srv.n_active == 0
+    # the pool still serves cleanly afterwards
+    r2 = srv.submit(np.arange(1, 10), 4, seed=1)
+    srv.drain()
+    assert len(srv.results[r2]) == 4
+
+
+def test_audit_covers_mixed_step_programs():
+    """audit_serving_decode extends to the mixed-step programs: every
+    donated leaf aliased, zero cache-sized copies, on HEAD."""
+    from dnn_tpu.analysis.program import audit_serving_decode
+
+    rep = audit_serving_decode()
+    names = set(rep["variants"])
+    for want in ("mixed_dense", "mixed_dense_finish", "mixed_paged",
+                 "mixed_bucketed", "mixed_speculative",
+                 "mixed_speculative_finish"):
+        assert want in names, names
+        v = rep["variants"][want]
+        assert v["aliased"] == v["expected"], (want, v)
+        assert v["cache_sized_ops"] == {}, (want, v)
+    assert rep["findings"] == []
+
+
+def test_audit_gate_fails_unaliased_mixed_variant(model):
+    """The gate actually gates: the REAL mixed-step program re-jitted
+    WITHOUT donation fails the donation-coverage check."""
+    from dnn_tpu.analysis.program import check_decode_program
+
+    cfg, prepared = model
+    b = ContinuousBatcher(cfg, prepared, slots=2, max_len=64,
+                          prompt_pad=8, prefill_chunk_tokens=8)
+    row = b._ilv_new_row()
+    chunk = jnp.zeros((1, 8), jnp.int32)
+    args = (b._decode_view, b._decode_view, b.cache, b.pos, b.tok,
+            b.active, b.keys, b._temp, b._topk, b._topp, b._minp,
+            b._rep, b._seen, b._bias, b._crow, b._ctable,
+            row, chunk, jnp.int32(0))
+    elems = 2 * cfg.n_head * 64 * (cfg.n_embd // cfg.n_head)
+    # HEAD's program passes...
+    _, ok_findings = check_decode_program(
+        "mixed_ok", b._mixed, args, b._mixed_donate, elems)
+    assert ok_findings == []
+    # ...the same function jitted with its donations dropped FAILS
+    bad = jax.jit(b._mixed.__wrapped__)
+    entry, findings = check_decode_program(
+        "mixed_unaliased", bad, args, b._mixed_donate, elems)
+    assert entry["aliased"] == 0
+    assert findings and findings[0].rule == "PRG003"
+
+
+def test_int8_weights_serving_parity_and_bytes(model):
+    """The weight-quant rung: int8 weights through the serving decode
+    path stay token-parity-close (cosine-bound logits; identical
+    greedy streams at this scale), and the byte accounting prices the
+    quantized stream correctly."""
+    from dnn_tpu.obs.goodput import model_cost
+    from dnn_tpu.quant import quantize_gpt
+    from dnn_tpu.utils.flops import tree_weight_bytes
+
+    cfg, prepared = model
+    q = quantize_gpt(prepared, bits=8)
+
+    f_bytes = tree_weight_bytes(prepared)
+    q_bytes = tree_weight_bytes(q)
+    assert q_bytes < 0.55 * f_bytes  # kernels 4x down, embeddings f32
+    # goodput's MBU denominator follows the served tree exactly
+    assert model_cost(cfg, q).weight_bytes == pytest.approx(q_bytes)
+    assert model_cost(cfg, prepared).weight_bytes == \
+        pytest.approx(f_bytes)
+
+    # serving parity: same pool, quantized weights — logits cosine
+    # bound, greedy token stream identical at this model scale
+    def logits_and_tokens(tree):
+        srv = ContinuousBatcher(cfg, tree, slots=2, max_len=64,
+                                prompt_pad=8, logprobs_k=4)
+        r = srv.submit(np.arange(1, 12), 8, seed=0, logprobs=True)
+        srv.drain()
+        lp = srv.token_logprobs[r]
+        return srv.results[r], lp["chosen"]
+
+    toks_f, lp_f = logits_and_tokens(prepared)
+    toks_q, lp_q = logits_and_tokens(q)
+    assert toks_q.tolist() == toks_f.tolist()
+    # chosen-logprob agreement as the scalar parity bound
+    assert float(np.max(np.abs(lp_f - lp_q))) < 0.15
+
+
+def test_int4_packed_weight_pricing():
+    """int4 leaves price at the packed half byte + their scale rows —
+    the itemsize walk would read 2x."""
+    from dnn_tpu.quant import quantize_tensor_int4
+    from dnn_tpu.utils.flops import tree_weight_bytes
+
+    w = jnp.ones((64, 32), jnp.float32)
+    q, scale = quantize_tensor_int4(w, group=64)
+    got = tree_weight_bytes({"q": q, "scale": scale})
+    assert got == pytest.approx(64 * 32 * 0.5 + scale.size * 4)
+
+
+def test_stepclock_mixed_tag_and_overlap_depth(model):
+    """StepClock satellites: interleaved steps carry the `mixed` tag
+    (records + summary + prom), and the overlap_depth gauge reports
+    the producer's pipeline depth."""
+    from dnn_tpu import obs
+    from dnn_tpu.obs.timeline import StepClock
+
+    cfg, prepared = model
+    was = obs.enabled()
+    obs.set_enabled(True)
+    try:
+        srv = ContinuousBatcher(cfg, prepared, slots=2, max_len=64,
+                                prompt_pad=8, prefill_chunk_tokens=8,
+                                overlap=True)
+        clock = StepClock()
+        srv.step_clock = clock
+        srv.submit(np.arange(1, 10), 6, seed=0)
+        srv.drain()
+        recs = clock.records()
+        assert any(r["mixed"] for r in recs)
+        assert any(not r["mixed"] for r in recs)
+        s = clock.summary()
+        assert s["mixed_steps"] >= 1
+        assert 0 < s["mixed_frac"] <= 1
+        assert s["overlap_depth"] == 1
+        prom = clock.render_prom()
+        assert "dnn_tpu_step_mixed_steps" in prom
+        assert "dnn_tpu_step_overlap_depth 1" in prom
+    finally:
+        obs.set_enabled(was)
+
+
+def test_worker_streams_interleaved_and_overlap_tokens(model):
+    """The lm_server worker serves interleaved admissions end to end:
+    the deferred first token streams through on_token, the future
+    resolves with the full budget, and the overlap idle-flush keeps
+    the trailing step from dangling."""
+    from dnn_tpu.runtime.lm_server import _BatcherWorker
+
+    cfg, prepared = model
+    srv = ContinuousBatcher(cfg, prepared, slots=2, max_len=64,
+                            prompt_pad=8, prefill_chunk_tokens=8,
+                            overlap=True)
+    w = _BatcherWorker(srv)
+    w.start()
+    try:
+        streamed = []
+        fut = w.submit(np.arange(1, 10, dtype=np.int32), 6, None,
+                       on_token=streamed.append)
+        out = fut.result(timeout=120)
+        assert len(out) == 6
+        assert streamed == list(out)
+        # idle worker flushed the trailing overlap step
+        deadline = 50
+        while srv._inflight is not None and deadline:
+            import time as _t
+
+            _t.sleep(0.1)
+            deadline -= 1
+        assert srv._inflight is None
+    finally:
+        w.stop()
+        w.join(timeout=10)
