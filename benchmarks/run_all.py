@@ -731,6 +731,47 @@ def dev_chaos_resilience():
     return results
 
 
+@device_config("fleet_serving")
+def dev_fleet_serving():
+    # ISSUE 13: the fleet front door's measured contract — open-loop
+    # load through the router over 2 REAL `node --serve_lm` replica
+    # subprocesses (gpt2), one SIGKILLed mid-measurement. Floors: fleet-leg
+    # availability >= 99% completed-or-explicitly-rejected with ZERO
+    # silently lost, fleet delivered tokens/sec >= 1.5x the unfronted
+    # single-replica leg at the same demand (on a 1-core host the win
+    # is admission-control goodput — the single leg collapses into
+    # admit-then-deadline-cancel waste; on real chips width adds on
+    # top), and the kill paired with its supervisor_restart in the
+    # dumped flight ring. Honors --require-substrate (PR 11's
+    # trajectory contract) via $DNN_TPU_REQUIRE_SUBSTRATE.
+    from benchmarks.fleet_serving_probe import (
+        AVAILABILITY_FLOOR,
+        FLEET_SPEEDUP_FLOOR,
+        measure,
+    )
+
+    results = []
+    row = measure()
+    ok = row.pop("ok")
+    require = os.environ.get("DNN_TPU_REQUIRE_SUBSTRATE")
+    note = (f"router over 2 supervised replica subprocesses, one "
+            f"killed mid-run; floors: availability >= "
+            f"{AVAILABILITY_FLOOR:.0%} (zero silent losses), fleet "
+            f"tokens/sec >= {FLEET_SPEEDUP_FLOOR}x the single-replica "
+            "leg, kill/restart flight events paired")
+    if require:
+        row["required_substrate"] = require
+        if row.get("round_substrate") != require:
+            ok = False
+            note += (f"; required substrate '{require}' but the probe "
+                     f"ran on '{row.get('round_substrate')}'")
+    tps = row.pop("fleet_tokens_per_sec")
+    _emit(results, config="fleet_serving",
+          metric="fleet_tokens_per_sec", value=tps, ok=ok,
+          note=note, **row)
+    return results
+
+
 @device_config("step_timeline")
 def dev_step_timeline():
     # ISSUE 11: step-timeline attribution baseline — the §10/§11 decode
@@ -2037,7 +2078,19 @@ def main():
                          "original provenance) and exit — an off-chip "
                          "host then refreshes only the sections it can "
                          "honestly measure via --resume")
+    ap.add_argument("--require-substrate", choices=["tpu", "cpu"],
+                    default=None,
+                    help="substrate contract (PR 11's bench.py flag, "
+                         "ROADMAP 5a): rows that honor it (the "
+                         "fleet_serving probe) go ok=false when the "
+                         "probe ran elsewhere — propagated to config "
+                         "children via $DNN_TPU_REQUIRE_SUBSTRATE")
     args = ap.parse_args()
+
+    if args.require_substrate:
+        # children inherit the env (both the in-process config path and
+        # the per-config subprocesses _spawn_streaming launches)
+        os.environ["DNN_TPU_REQUIRE_SUBSTRATE"] = args.require_substrate
 
     if args.sync_readme:
         print(f"synced {sync_readme(results_path=args.out)}")

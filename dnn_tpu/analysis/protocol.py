@@ -38,8 +38,8 @@ from typing import Dict, List, Optional, Sequence, Set, Tuple
 
 from dnn_tpu.analysis.findings import Finding
 
-__all__ = ["Edge", "Machine", "MACHINES", "check_machine",
-           "check_machine_sites", "run_protocol_audit"]
+__all__ = ["Edge", "Machine", "MACHINES", "REPLICA", "ROUTER",
+           "check_machine", "check_machine_sites", "run_protocol_audit"]
 
 
 @dataclasses.dataclass(frozen=True)
@@ -181,7 +181,67 @@ RELAY_WINDOW = Machine(
     call_events=(("ack_status", "ack"), ("result_status", "result")),
 )
 
-MACHINES: Tuple[Machine, ...] = (BREAKER, SUPERVISOR, DRAIN, RELAY_WINDOW)
+REPLICA = Machine(
+    name="replica_lifecycle",
+    states=("idle", "warming", "serving", "draining", "dead"),
+    initial="idle",
+    edges=(
+        # ReplicaSet.start() launches the supervised child (attach
+        # mode enters warming too — the probe loop promotes it)
+        Edge("idle", "replica_spawn", "warming"),
+        # first healthy probe: the replica takes traffic
+        Edge("warming", "replica_ready", "serving"),
+        # a child that exits during boot never served
+        Edge("warming", "replica_dead", "dead"),
+        # drain: admission closed via /drainz; in-flight work finishes,
+        # the router's retry-on-sibling picks up the hand-backs
+        Edge("serving", "replica_drain", "draining"),
+        # exit / kill / consecutive health failures
+        Edge("serving", "replica_dead", "dead"),
+        Edge("draining", "replica_dead", "dead"),
+        # the Supervisor relaunched the child (or an attached endpoint
+        # came back): dead is NOT absorbing — without this edge a
+        # one-kill fleet would shrink forever (PRO002 on the table
+        # minus this edge reproduces exactly that as a model failure)
+        Edge("dead", "replica_respawn", "warming"),
+    ),
+    module="dnn_tpu/control/replicaset.py",
+    cls="ReplicaHandle",
+    state_attr="state",
+    event_kinds=("replica_spawn", "replica_ready", "replica_dead",
+                 "replica_drain", "replica_respawn"),
+)
+
+ROUTER = Machine(
+    name="router",
+    states=("init", "serving", "shedding", "draining", "stopped"),
+    initial="init",
+    terminal=("stopped",),
+    edges=(
+        Edge("init", "router_start", "serving"),
+        # SLO-driven admission turned arrivals away (saturated /
+        # burn-rate): an EPISODE state, latched once per episode like
+        # pool_exhausted — not per shed request
+        Edge("serving", "router_shed", "shedding"),
+        Edge("shedding", "router_unshed", "serving"),
+        # SIGTERM / drain(): admission closes UNAVAILABLE, in-flight
+        # forwards finish on their replicas
+        Edge("serving", "router_drain", "draining"),
+        Edge("shedding", "router_drain", "draining"),
+        Edge("serving", "router_stop", "stopped"),
+        Edge("shedding", "router_stop", "stopped"),
+        Edge("draining", "router_stop", "stopped"),
+        Edge("init", "router_stop", "stopped"),
+    ),
+    module="dnn_tpu/control/router.py",
+    cls="Router",
+    state_attr="_state",
+    event_kinds=("router_start", "router_shed", "router_unshed",
+                 "router_drain", "router_stop"),
+)
+
+MACHINES: Tuple[Machine, ...] = (BREAKER, SUPERVISOR, DRAIN,
+                                 RELAY_WINDOW, REPLICA, ROUTER)
 
 
 # ----------------------------------------------------------------------

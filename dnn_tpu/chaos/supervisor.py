@@ -48,11 +48,17 @@ class Supervisor:
 
     `spawn`: callable -> subprocess.Popen (re-invoked for every
     launch; argv closures keep restore/launch decisions in one place).
-    `health_url`: an obs endpoint base (http://host:port) whose
-    /healthz is polled every `health_interval_s` with a
-    `health_timeout_s` hard timeout — each poll opens a FRESH
-    connection, so a previous poll wedged in a dead socket can never
-    mask a recovery (the PR 7 stale-channel lesson, applied here).
+    `health_url`: an obs endpoint base (http://host:port) — or a
+    CALLABLE returning one, resolved fresh per poll, so a child that
+    rebinds an ephemeral port on relaunch stays pollable — whose
+    `health_path` (default /healthz) is polled every
+    `health_interval_s` with a `health_timeout_s` hard timeout; each
+    poll opens a FRESH connection, so a previous poll wedged in a dead
+    socket can never mask a recovery (the PR 7 stale-channel lesson,
+    applied here). The injectable endpoint/path is what lets a fleet
+    spawner (dnn_tpu/control/replicaset.py) supervise N replicas on N
+    distinct metrics ports without subclassing; `drain_path` names the
+    drain kicker the same way (default /drainz).
     `ready`: callable -> bool, polled after launch until the child
     serves (default: health_url reachable); `warm`: optional callable
     run once after ready — a real request through the child, so
@@ -61,7 +67,9 @@ class Supervisor:
 
     def __init__(self, spawn: Callable[[], subprocess.Popen], *,
                  name: str = "stage",
-                 health_url: Optional[str] = None,
+                 health_url=None,
+                 health_path: str = "/healthz",
+                 drain_path: str = "/drainz",
                  health_interval_s: float = 1.0,
                  health_timeout_s: float = 2.0,
                  wedged_after: int = 3,
@@ -81,6 +89,8 @@ class Supervisor:
         self.spawn = spawn
         self.name = name
         self.health_url = health_url
+        self.health_path = health_path
+        self.drain_path = drain_path
         self.health_interval_s = float(health_interval_s)
         self.health_timeout_s = float(health_timeout_s)
         self.wedged_after = int(wedged_after)
@@ -150,14 +160,28 @@ class Supervisor:
 
     # -- internals -----------------------------------------------------
 
+    def _health_base(self) -> Optional[str]:
+        """Resolve the probe base URL: a plain string, or a callable
+        re-evaluated per poll (ephemeral-port children)."""
+        u = self.health_url
+        if callable(u):
+            try:
+                u = u()
+            except Exception:  # noqa: BLE001 — "don't know the URL
+                return None    # yet" reads as not-healthy, not a crash
+        return u
+
     def _healthy_once(self) -> bool:
         import urllib.request
 
+        base = self._health_base()
         if self.health_url is None:
             return True
+        if base is None:
+            return False
         try:
             with urllib.request.urlopen(
-                    self.health_url.rstrip("/") + "/healthz",
+                    base.rstrip("/") + self.health_path,
                     timeout=self.health_timeout_s) as r:
                 return r.status == 200
         except Exception:  # noqa: BLE001 — any failure is "not healthy"
@@ -254,11 +278,12 @@ class Supervisor:
         policy; the caller restarts afterwards either way."""
         import urllib.request
 
-        if self.health_url is None:
+        base = self._health_base()
+        if base is None:
             return False
         try:
             req = urllib.request.Request(
-                self.health_url.rstrip("/") + "/drainz", method="POST",
+                base.rstrip("/") + self.drain_path, method="POST",
                 data=b"")
             with urllib.request.urlopen(
                     req, timeout=self.health_timeout_s) as r:

@@ -728,6 +728,58 @@ class NodeClient:
             raise RuntimeError(f"LM server returned no embedding: {status}")
         return np.asarray(result, np.float32)
 
+    # -- disaggregated prefill/decode (dnn_tpu/control) -----------------
+
+    def prefill_kv(self, prompt_ids, *, timeout: float = 60.0) -> np.ndarray:
+        """Prefill-export endpoint: ask a PREFILL replica to run the
+        prompt's chunk loop and return the packed KV handoff payload
+        (one uint8 tensor — dnn_tpu/control/handoff.py). Hand it to a
+        decode replica with `put_kv` and generate with the matching
+        h=<key> option; the router does all three per request on a
+        role-split fleet."""
+        status, result = self.send_tensor(
+            np.asarray(prompt_ids, np.int32).reshape(-1),
+            request_id="prefill", timeout=timeout,
+        )
+        if result is None:
+            raise RuntimeError(f"LM server returned no KV payload: {status}")
+        return np.asarray(result, np.uint8)
+
+    def put_kv(self, key: str, payload, *, timeout: float = 60.0) -> str:
+        """Stage a prefill replica's KV payload on THIS server under
+        `key` (single-use; consumed by a generate carrying h=<key>).
+        Returns the server's status line; a geometry mismatch raises
+        as INVALID_ARGUMENT."""
+        status, _ = self.send_tensor(
+            np.asarray(payload, np.uint8).reshape(-1),
+            request_id=f"kvput:{key}", timeout=timeout,
+        )
+        return status
+
+    def send_tensor_stream(self, arr, *, request_id: str,
+                           timeout: float = 120.0):
+        """RAW streaming passthrough: submit `arr` on GenerateStream
+        with `request_id` VERBATIM and yield each TensorResponse as it
+        arrives — the router's relay primitive (generate_stream
+        re-encodes options; a front door must forward the original
+        id, dl=/tr=/d= segments and all). Abandoning the iterator
+        cancels the RPC, which frees the upstream decode slot."""
+        call = self._channel.unary_stream(
+            f"/{SERVICE_NAME}/GenerateStream",
+            request_serializer=wc.serialize_request,
+            response_deserializer=wc.parse_response,
+        )
+        stream = call(
+            wc.TensorRequest(
+                request_id=request_id,
+                tensor=_tensor_msg(np.asarray(arr, np.int32).reshape(-1))),
+            timeout=timeout,
+        )
+        try:
+            yield from stream
+        finally:
+            stream.cancel()  # no-op on a finished stream
+
     def generate_stream(
         self,
         prompt_ids,

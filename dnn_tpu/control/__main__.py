@@ -1,0 +1,142 @@
+"""CLI: spawn a whole LM fleet — router + N supervised replicas.
+
+    python -m dnn_tpu.control --port 50550 --replicas 2 --model gpt2 \
+        [--roles both,both | --roles prefill,decode] \
+        [--policy round_robin|least_queue|slo_burn] \
+        [--base_port 50600] [--metrics_base_port 50700] \
+        [--slots 4] [--max_len N] [--kv auto] [--seed 0] \
+        [--metrics_port P] [--replica_arg "--weights=int8" ...]
+
+Each replica is a real `node --serve_lm` child under its own
+`chaos.supervisor.Supervisor` (restart-with-backoff, wedged detection
+against its OWN metrics port); the router serves the NodeService wire
+format on `--port`, so `NodeClient("host:PORT")` — or a reference-built
+client — talks to the fleet unchanged. `--metrics_port` additionally
+serves the router's obs endpoint, whose /fleetz is the ReplicaSet's
+collector view (per-replica role, router queue, shed counts, the
+`dnn_tpu_wanted_replicas` autoscaling gauge).
+
+For routing across ALREADY-RUNNING replicas use `node --route`
+(attach mode, no spawning). Ctrl-C / SIGTERM drains and exits 0.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import logging
+import sys
+import tempfile
+
+from dnn_tpu.control.policy import POLICIES, ROLES
+from dnn_tpu.utils.logging import setup_logging
+
+log = logging.getLogger("dnn_tpu.control")
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="dnn_tpu.control",
+        description="Fleet front door: router + N supervised "
+                    "`node --serve_lm` replicas")
+    p.add_argument("--port", type=int, required=True,
+                   help="router gRPC port (NodeClient points here)")
+    p.add_argument("--model", required=True,
+                   help="model-zoo name every replica serves")
+    p.add_argument("--replicas", type=int, default=2,
+                   help="replica count (ignored when --roles is given)")
+    p.add_argument("--roles", default=None,
+                   help="comma-separated per-replica roles "
+                        "(prefill|decode|both) — a role-split list "
+                        "turns on disaggregated prefill/decode")
+    p.add_argument("--policy", choices=sorted(POLICIES),
+                   default="least_queue")
+    p.add_argument("--base_port", type=int, default=None,
+                   help="first replica gRPC port (default: port+50)")
+    p.add_argument("--metrics_base_port", type=int, default=None,
+                   help="first replica obs port (default: base_port+50)")
+    p.add_argument("--metrics_port", type=int, default=None,
+                   help="router's own obs endpoint (serves /fleetz)")
+    p.add_argument("--slots", type=int, default=4)
+    p.add_argument("--max_len", type=int, default=None)
+    p.add_argument("--kv", choices=["paged", "dense", "auto"],
+                   default="auto")
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--max_inflight", type=int, default=8,
+                   help="router admission bound: outstanding forwards "
+                        "per replica before new arrivals shed")
+    p.add_argument("--shed_burn", type=float, default=None,
+                   help="additionally shed when every candidate's "
+                        "worst SLO burn rate reaches this (needs the "
+                        "replicas to run --slo_* objectives)")
+    p.add_argument("--default_deadline_s", type=float, default=30.0)
+    p.add_argument("--replica_arg", action="append", default=None,
+                   help="extra argv token passed to every replica "
+                        "child (repeatable), e.g. "
+                        "--replica_arg=--weights=int8")
+    p.add_argument("--log_level", default="INFO")
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    setup_logging(args.log_level, node_id="router")
+    if args.roles:
+        roles = [r.strip() for r in args.roles.split(",") if r.strip()]
+    else:
+        roles = ["both"] * args.replicas
+    bad = [r for r in roles if r not in ROLES]
+    if bad or not roles:
+        log.error("--roles must be a non-empty comma list of %s, got %r",
+                  "|".join(ROLES), args.roles)
+        return 1
+    if any(r == "prefill" for r in roles) and \
+            not any(r in ("decode", "both") for r in roles):
+        log.error("a prefill-only fleet can serve no generate request; "
+                  "add a decode/both replica")
+        return 1
+    base_port = args.base_port if args.base_port is not None \
+        else args.port + 50
+    metrics_base = args.metrics_base_port \
+        if args.metrics_base_port is not None else base_port + 50
+    extra = []
+    for tok in args.replica_arg or []:
+        # accept both --replica_arg=--flag=v and --replica_arg --flag v
+        extra += tok.split() if " " in tok else [tok]
+
+    from dnn_tpu.control.replicaset import ReplicaSet
+    from dnn_tpu.control.router import serve_router
+
+    with tempfile.TemporaryDirectory(prefix="dnn_tpu_fleet_") as tmp:
+        try:
+            rset = ReplicaSet.spawn_lm_fleet(
+                tmp, model=args.model, base_port=base_port,
+                metrics_base_port=metrics_base, roles=roles,
+                slots=args.slots, max_len=args.max_len,
+                seed=args.seed, kv=args.kv, extra_args=extra)
+        except Exception as e:  # noqa: BLE001 — CLI boundary
+            log.error("fleet spawn failed: %s", e)
+            return 1
+        rset.start()
+        log.info("spawned %d replicas (roles=%s); waiting for first "
+                 "serving replica", len(roles), ",".join(roles))
+        try:
+            rc = asyncio.run(serve_router(
+                rset, port=args.port, metrics_port=args.metrics_port,
+                policy=args.policy,
+                max_inflight_per_replica=args.max_inflight,
+                shed_burn=args.shed_burn,
+                default_deadline_s=args.default_deadline_s))
+        except KeyboardInterrupt:
+            log.info("shutting down fleet")
+            rc = 0
+        except Exception as e:  # noqa: BLE001 — CLI boundary
+            log.error("router failed: %s", e)
+            rc = 1
+        finally:
+            rset.stop()
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,216 @@
+"""Routing policy: which replica serves the next request.
+
+Pluggable the way `attn_kernel` and `transport` already are — a policy
+is a name in `POLICIES` resolved at router construction, and the whole
+interface is one method over one dataclass, so adding a policy is a
+registry entry, not a router edit.
+
+Signals come from two places with very different freshness:
+
+  * scrape-time rows the replicas already export (queue depth, KV-slot
+    utilization, TTFT/ITL percentiles, error-budget burn rates) —
+    polled by the ReplicaSet's FleetCollector on its interval, so they
+    lag by up to one poll;
+  * the router's OWN per-replica in-flight count — exact, updated on
+    every forward, and the only signal that survives a replica whose
+    obs endpoint is down.
+
+Every policy therefore treats the scraped fields as OPTIONAL (None =
+unknown) and falls back to `inflight`; a fleet with no obs endpoints
+at all degrades to round-robin-by-load instead of failing.
+
+Pure stdlib — no jax, no grpc — so policies unit-test as goldens with
+injected signals (tests/test_control.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import math
+from typing import Dict, List, Optional
+
+__all__ = ["ReplicaView", "Policy", "POLICIES", "get_policy",
+           "shed_reason", "wanted_replicas"]
+
+ROLES = ("prefill", "decode", "both")
+
+
+@dataclasses.dataclass
+class ReplicaView:
+    """One replica as the policy sees it: lifecycle + freshest signals.
+
+    `inflight` is the router's local count of forwards currently
+    outstanding against this replica (exact); everything else is the
+    last scrape (None = never scraped / endpoint down / older build).
+    `burn` maps SLO name -> error-budget burn rate (>= 1.0 means the
+    objective is being violated right now)."""
+
+    name: str
+    state: str = "serving"          # replicaset lifecycle state
+    role: str = "both"              # prefill | decode | both
+    inflight: int = 0
+    queue_depth: Optional[float] = None
+    kv_util: Optional[float] = None
+    ttft_p99_ms: Optional[float] = None
+    inter_token_p99_ms: Optional[float] = None
+    tokens_per_sec: Optional[float] = None
+    burn: Optional[Dict[str, float]] = None
+
+    @property
+    def burn_max(self) -> Optional[float]:
+        if not self.burn:
+            return None
+        return max(self.burn.values())
+
+    def load(self) -> float:
+        """Best-known load: scraped queue depth plus the router's own
+        in-flight count (the scrape lags one poll; the local count
+        covers the gap — and is the whole signal when scraping is
+        off)."""
+        q = self.queue_depth if self.queue_depth is not None else 0.0
+        return float(q) + float(self.inflight)
+
+
+class Policy:
+    """Base: `pick` one of `cands` (non-empty, all routable). Policies
+    must be deterministic given the same views + internal state — the
+    test goldens depend on it."""
+
+    name = "base"
+
+    def pick(self, cands: List[ReplicaView]) -> ReplicaView:
+        raise NotImplementedError
+
+
+class RoundRobin(Policy):
+    """Strict rotation over the candidate NAMES (not list positions, so
+    a replica dropping out mid-rotation doesn't double-serve its
+    neighbor)."""
+
+    name = "round_robin"
+
+    def __init__(self):
+        self._counter = itertools.count()
+
+    def pick(self, cands: List[ReplicaView]) -> ReplicaView:
+        ordered = sorted(cands, key=lambda v: v.name)
+        return ordered[next(self._counter) % len(ordered)]
+
+
+class LeastQueue(Policy):
+    """Lowest load (scraped queue depth + local in-flight); name-order
+    tiebreak keeps it deterministic."""
+
+    name = "least_queue"
+
+    def pick(self, cands: List[ReplicaView]) -> ReplicaView:
+        return min(cands, key=lambda v: (v.load(), v.name))
+
+
+class SloBurn(Policy):
+    """Goodput-aware pick (the Gemma-on-TPU serving comparison's
+    per-replica goodput accounting as the routing signal): score each
+    replica by how close it is to violating its objectives, then by
+    load, then by tail latency. Burn rate dominates — a replica
+    burning error budget at 2x gets no new work while a quiet sibling
+    exists, whatever the queue depths say — because queue depth leads
+    the SLO breach by seconds while burn rate IS the breach."""
+
+    name = "slo_burn"
+
+    # weights: one unit of burn rate outranks ~8 queued requests; tail
+    # latency breaks the remaining ties at 1/100 ms granularity
+    W_BURN, W_LOAD, W_TTFT = 8.0, 1.0, 0.01
+
+    def score(self, v: ReplicaView) -> float:
+        burn = v.burn_max if v.burn_max is not None else 0.0
+        ttft = v.ttft_p99_ms if v.ttft_p99_ms is not None else 0.0
+        return (self.W_BURN * burn + self.W_LOAD * v.load()
+                + self.W_TTFT * ttft)
+
+    def pick(self, cands: List[ReplicaView]) -> ReplicaView:
+        return min(cands, key=lambda v: (self.score(v), v.name))
+
+
+POLICIES = {p.name: p for p in (RoundRobin, LeastQueue, SloBurn)}
+
+
+def get_policy(name: str) -> Policy:
+    """Resolve a policy NAME to a fresh instance (policies carry
+    internal state — round_robin's counter — so sharing one across
+    routers would entangle their rotations)."""
+    cls = POLICIES.get(name)
+    if cls is None:
+        raise ValueError(
+            f"unknown routing policy {name!r}; choose one of "
+            f"{sorted(POLICIES)}")
+    return cls()
+
+
+# ----------------------------------------------------------------------
+# admission (SLO-driven shedding) + the autoscaling signal
+# ----------------------------------------------------------------------
+
+def shed_reason(cands: List[ReplicaView], *,
+                max_inflight: int,
+                shed_burn: Optional[float] = None) -> Optional[str]:
+    """Admission decision for ONE arriving request: None = admit, else
+    the shed reason (the router maps it onto the existing
+    breaker/UNAVAILABLE ladder — UNAVAILABLE is the status every
+    dnn_tpu client already treats as retriable-elsewhere).
+
+    Sheds when EVERY candidate is saturated — `max_inflight` bounds the
+    router's outstanding forwards per replica (the exact, local
+    signal: it is what keeps an overloaded fleet's queues short enough
+    that admitted work still finishes inside its deadline, instead of
+    the admit-then-deadline-cancel waste a FIFO queue degenerates to)
+    — or when every candidate's worst error-budget burn rate is at or
+    past `shed_burn` (None disables the burn gate)."""
+    if not cands:
+        return "no_serving_replica"
+    if all(v.inflight >= max_inflight for v in cands):
+        return "saturated"
+    if shed_burn is not None:
+        burns = [v.burn_max for v in cands]
+        if all(b is not None and b >= shed_burn for b in burns):
+            return "slo_burn"
+    return None
+
+
+def wanted_replicas(views: List[ReplicaView], *,
+                    slots_hint: int = 4,
+                    max_replicas: int = 64,
+                    shedding: bool = False) -> int:
+    """The `dnn_tpu_wanted_replicas` autoscaling signal (ROADMAP item
+    1: emitted even though nothing consumes it yet): how many SERVING
+    replicas this fleet's current pressure calls for.
+
+    Derivation — queue depth plus burn rate, the two signals that lead
+    a breach: pressure = total queued work / total slot capacity of
+    the serving replicas (`slots_hint` per replica when the scrape
+    doesn't say). Want enough replicas to bring pressure to ~1; any
+    objective burning >= 1 adds one more (latency objectives breach
+    before queues look deep); `shedding=True` (the router is actively
+    turning arrivals away RIGHT NOW) wants at least one more whatever
+    the queues say — admission control keeps replica queues short
+    precisely when demand exceeds the fleet, so queue depth alone is
+    blind to the pressure the shed counter carries; a fleet with zero
+    queue everywhere, no shedding and all burns < 0.25 can give one
+    back (never below 1)."""
+    serving = [v for v in views if v.state == "serving"]
+    n = len(serving)
+    if n == 0:
+        return 1
+    cap = max(n * slots_hint, 1)
+    queued = sum(v.load() for v in serving)
+    want = max(n, math.ceil(n * queued / cap)) if queued > cap else n
+    burns = [v.burn_max for v in serving if v.burn_max is not None]
+    if burns and max(burns) >= 1.0:
+        want += 1
+    if shedding:
+        want = max(want, n + 1)
+    elif (queued == 0 and n > 1
+          and all(b < 0.25 for b in burns or [0.0])):
+        want = n - 1
+    return max(1, min(want, max_replicas))

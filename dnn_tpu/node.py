@@ -112,6 +112,42 @@ def build_parser() -> argparse.ArgumentParser:
                    help="GPT families: run the continuous-batching LM daemon "
                         "on this node's port — SendTensor(prompt ids) answers "
                         "with generated tokens (runtime/lm_server.py)")
+    p.add_argument("--role", choices=["prefill", "decode", "both"],
+                   default="both",
+                   help="--serve_lm: this replica's fleet role "
+                        "(dnn_tpu/control): a front door routes prompt "
+                        "prefill exports to 'prefill' replicas and "
+                        "generation to 'decode'/'both' — the "
+                        "disaggregated split. Advisory (every endpoint "
+                        "still serves); advertised on /statusz and the "
+                        "dnn_tpu_replica_role gauge")
+    p.add_argument("--route", action="store_true",
+                   help="run the FLEET FRONT DOOR on this node's port "
+                        "instead of a model: route Generate/"
+                        "GenerateStream across --route_targets replicas "
+                        "with SLO-driven admission, session affinity "
+                        "and sibling retry (dnn_tpu/control/router.py; "
+                        "NodeClient — or a reference-built client — "
+                        "points at it unchanged). To also SPAWN the "
+                        "replicas, use `python -m dnn_tpu.control`")
+    p.add_argument("--route_targets", default=None,
+                   help="--route: comma-separated replica gRPC "
+                        "addresses (host:port)")
+    p.add_argument("--route_signals", default=None,
+                   help="--route: comma-separated replica obs base "
+                        "URLs (http://host:port), one per target in "
+                        "order — enables signal-fed policies "
+                        "(least_queue/slo_burn read queue depth, "
+                        "KV-slot utilization, latency percentiles and "
+                        "SLO burn from each replica's /metrics) and "
+                        "HTTP health probing; omitted, health falls "
+                        "back to gRPC HealthCheck and policies to the "
+                        "router's own in-flight counts")
+    p.add_argument("--policy",
+                   choices=["round_robin", "least_queue", "slo_burn"],
+                   default="least_queue",
+                   help="--route: routing policy (dnn_tpu/control/"
+                        "policy.py)")
     p.add_argument("--slots", type=int, default=4,
                    help="--serve_lm: concurrent decode slots in the pool")
     p.add_argument("--max_len", type=int, default=None,
@@ -367,6 +403,28 @@ def main(argv=None) -> int:
         log.error("%s", e)
         return 1
 
+    if args.role != "both" and not args.serve_lm:
+        log.error("--role applies to --serve_lm (the replica's fleet "
+                  "role; the router's own role is implicit)")
+        return 1
+    if (args.route_targets or args.route_signals) and not args.route:
+        log.error("--route_targets/--route_signals apply only with "
+                  "--route")
+        return 1
+    if args.route:
+        # front-door mode: no model, no engine — the router is pure
+        # control plane over the listed replicas
+        if args.serve or args.serve_lm or args.generate is not None:
+            log.error("--route is a standalone mode (no --serve/"
+                      "--serve_lm/--generate)")
+            return 1
+        if not args.route_targets:
+            log.error("--route needs --route_targets (comma-separated "
+                      "replica host:port addresses); to spawn replicas "
+                      "too, use `python -m dnn_tpu.control`")
+            return 1
+        return _route(args, config, me)
+
     if config.device_type == "cpu":
         # Platform choice must land before first backend use; on hosts where
         # a TPU plugin wins selection regardless of JAX_PLATFORMS (see
@@ -598,6 +656,52 @@ def main(argv=None) -> int:
     return 0
 
 
+def _route(args, config, me) -> int:
+    """Front-door mode (dnn_tpu/control): serve the router on this
+    node's port across already-running replicas (attach mode — nothing
+    is spawned; `python -m dnn_tpu.control` owns the spawn-everything
+    shape). SIGTERM drains and exits 0."""
+    from dnn_tpu.control.replicaset import ReplicaHandle, ReplicaSet
+    from dnn_tpu.control.router import serve_router
+
+    if me.port is None:
+        log.error("node '%s' has no IP:Port address in the config; the "
+                  "router needs one to bind", args.node_id)
+        return 1
+    targets = [t.strip() for t in args.route_targets.split(",")
+               if t.strip()]
+    signals = [u.strip() for u in (args.route_signals or "").split(",")
+               if u.strip()]
+    if signals and len(signals) != len(targets):
+        log.error("--route_signals must list one obs URL per "
+                  "--route_targets entry (%d vs %d)", len(signals),
+                  len(targets))
+        return 1
+    try:
+        handles = [
+            ReplicaHandle(f"r{i}", addr,
+                          obs_url=signals[i] if signals else None)
+            for i, addr in enumerate(targets)]
+        rset = ReplicaSet(handles).start()
+    except Exception as e:  # noqa: BLE001 — CLI boundary
+        log.error("router setup failed: %s", e)
+        return 1
+    log.info("routing %d replicas (policy=%s, signals=%s)",
+             len(targets), args.policy, "scraped" if signals else "local")
+    try:
+        return asyncio.run(serve_router(
+            rset, port=me.port, metrics_port=args.metrics_port,
+            policy=args.policy))
+    except KeyboardInterrupt:
+        log.info("router shutting down")
+        return 0
+    except Exception as e:  # noqa: BLE001 — CLI boundary (bind etc.)
+        log.error("router failed: %s", e)
+        return 1
+    finally:
+        rset.stop()
+
+
 def _supervise(args, raw_argv) -> int:
     """Supervisor-parent mode: spawn the SAME node command (minus
     --supervise) as a child and keep it alive — restart-with-backoff on
@@ -803,7 +907,7 @@ def _serve_lm(engine: PipelineEngine, args) -> int:
     try:
         rc = asyncio.run(serve_lm(
             cfg, prepared, port=me.port, slots=args.slots, slo=slo,
-            on_wedged=args.on_wedged,
+            on_wedged=args.on_wedged, role=args.role,
             **spec_kwargs,
             max_len=args.max_len, prompt_pad=args.prompt_pad,
             temperature=args.temperature, top_k=args.top_k,

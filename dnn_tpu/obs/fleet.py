@@ -461,7 +461,8 @@ class FleetCollector:
     renders a consistent snapshot while the poller runs."""
 
     def __init__(self, targets, *, interval_s: float = 5.0,
-                 timeout_s: float = 5.0, span_cap: int = 20000):
+                 timeout_s: float = 5.0, span_cap: int = 20000,
+                 poll_traces: bool = True):
         if isinstance(targets, (list, tuple)):
             targets = {u.split("//")[-1]: u for u in targets}
         self.targets: Dict[str, str] = {
@@ -471,6 +472,10 @@ class FleetCollector:
         self.interval_s = float(interval_s)
         self.timeout_s = float(timeout_s)
         self._span_cap = int(span_cap)
+        # poll_traces=False skips each target's /trace.jsonl entirely —
+        # signal-plane consumers (the router's ReplicaSet) poll at
+        # ~1 s cadence and want fresh rows, not span stitching
+        self._poll_traces = bool(poll_traces)
         self._lock = threading.Lock()
         self._snaps: Dict[str, dict] = {}
         # per-stage span cache keyed by span_id: successive polls of a
@@ -503,13 +508,14 @@ class FleetCollector:
             snap["metrics"] = parse_prometheus(
                 self._fetch(url + "/metrics"))
             spans = []
-            for ln in self._fetch(url + "/trace.jsonl").splitlines():
-                ln = ln.strip()
-                if ln:
-                    try:
-                        spans.append(json.loads(ln))
-                    except ValueError:
-                        pass
+            if self._poll_traces:
+                for ln in self._fetch(url + "/trace.jsonl").splitlines():
+                    ln = ln.strip()
+                    if ln:
+                        try:
+                            spans.append(json.loads(ln))
+                        except ValueError:
+                            pass
             with self._lock:
                 # scrape threads snapshot these caches under the same
                 # lock (spans_by_stage) — hold it for the mutation so
@@ -669,6 +675,12 @@ class FleetCollector:
                "url": None if snap is None else snap["url"],
                "error": "not polled yet" if snap is None
                else snap["error"]}
+        stz = (snap["statusz"] if snap is not None else None) or {}
+        if stz.get("role"):
+            # fleet role (dnn_tpu/control): replicas advertise
+            # prefill|decode|both, the router advertises "router" — the
+            # rollup's per-target role column
+            row["role"] = stz["role"]
         if snap is None or snap["metrics"] is None:
             return row
         s = _Samples(snap["metrics"])
@@ -697,11 +709,23 @@ class FleetCollector:
             "rpc_p99_ms": ms(s.hist_quantile("comm_rpc_latency_seconds",
                                              0.99)),
             "compiles_total": s.get("jax_compilations_total"),
+            "kv_util": s.get("serving_kv_slot_utilization"),
             "slo_burn": {
                 labs.get("slo"): v
                 for name, labs, v in snap["metrics"]["samples"]
                 if name == "dnn_tpu_slo_burn_rate"} or None,
         })
+        # router-target series (dnn_tpu/control/router.py): present only
+        # when this target IS a router — queue of in-flight forwards,
+        # shed counts by reason, the autoscaling signal
+        for fam, key in (("dnn_tpu_router_queue_depth", "router_queue"),
+                         ("dnn_tpu_wanted_replicas", "wanted_replicas")):
+            v = s.get(fam)
+            if v is not None:
+                row[key] = v
+        sheds = s.sum("dnn_tpu_router_shed_total")
+        if sheds is not None:
+            row["shed_total"] = sheds
         return row
 
     def fleetz(self) -> dict:
@@ -730,6 +754,12 @@ class FleetCollector:
                 "stages_total": len(self.targets),
                 "stages_ok": sum(1 for r in stages.values()
                                  if r["state"] == "ok"),
+                # the autoscaling signal (a router target exports it;
+                # first non-None wins — one router per fleet view)
+                "wanted_replicas": next(
+                    (r["wanted_replicas"] for r in stages.values()
+                     if r.get("wanted_replicas") is not None), None),
+                "shed_total": total("shed_total"),
             },
             "clock_offsets_s": {k: round(v, 6)
                                 for k, v in self.offsets().items()},
@@ -755,12 +785,23 @@ class FleetCollector:
                 m.set(f"dnn_tpu_fleet_{key}", z["fleet"][key])
         m.set("dnn_tpu_fleet_stages_ok", z["fleet"]["stages_ok"])
         m.set("dnn_tpu_fleet_stages_total", z["fleet"]["stages_total"])
+        if z["fleet"].get("wanted_replicas") is not None:
+            m.set("dnn_tpu_wanted_replicas",
+                  z["fleet"]["wanted_replicas"])
+        if z["fleet"].get("shed_total") is not None:
+            m.set("dnn_tpu_fleet_shed_total", z["fleet"]["shed_total"])
         for name, row in z["stages"].items():
             m.set(labeled("dnn_tpu_fleet_stage_up", stage=name),
                   1.0 if row["state"] == "ok" else 0.0)
             m.set(labeled("dnn_tpu_fleet_stage_state", stage=name),
                   float(_STATE_RANK.get(row["state"], 1)))
-            for key in ("tokens_per_sec", "mfu", "mbu"):
+            if row.get("role"):
+                # role as a one-hot labeled gauge — the prom idiom for
+                # a string-valued attribute
+                m.set(labeled("dnn_tpu_fleet_stage_role", stage=name,
+                              role=row["role"]), 1.0)
+            for key in ("tokens_per_sec", "mfu", "mbu", "router_queue",
+                        "shed_total"):
                 if row.get(key) is not None:
                     m.set(labeled(f"dnn_tpu_fleet_stage_{key}",
                                   stage=name), row[key])
@@ -777,7 +818,7 @@ class FleetCollector:
         lines = [f"fleet state: {z['state']}  "
                  f"({z['fleet']['stages_ok']}/{z['fleet']['stages_total']}"
                  f" stages ok)"]
-        cols = [("state", 11), ("tokens_per_sec", 9),
+        cols = [("state", 11), ("role", 8), ("tokens_per_sec", 9),
                 ("mfu", 7), ("mbu", 7), ("queue_depth", 6),
                 ("ttft_p99_ms", 12), ("inter_token_p99_ms", 13),
                 ("rpc_p99_ms", 11)]

@@ -107,6 +107,11 @@ def parse_gen_options(request_id: str, default_max_new: int):
              # (LMServer._dedup) so a client retry after a drain or a
              # worker-death requeue can never run the generation twice
              "d": ("dedup", str),
+             # disaggregated prefill/decode (dnn_tpu/control): consume
+             # the kvput:<key> payload a prefill replica handed off —
+             # admission then ADOPTS the KV instead of prefilling
+             # (LMServer._resolve_kv_handle -> submit(prefilled=...))
+             "h": ("kv_handle", str),
              # JSON mode: constrain the completion to a JSON value nested
              # up to DEPTH levels (runtime/constrain.json_regex); resolved
              # to a compiled TokenConstraint in LMServer._preflight
@@ -734,6 +739,8 @@ class LMServer:
                  max_request_retries: int = 1,
                  drain_grace_s: float = 30.0,
                  weights: str = "f32",
+                 role: str = "both",
+                 kv_handoff_cap: int = 64,
                  **batcher_kwargs):
         # weight-only quantized serving (ISSUE 12 satellite — the first
         # rung of ROADMAP item 4's weight-quant ladder): weights="int8"
@@ -763,6 +770,24 @@ class LMServer:
         if on_wedged not in ("503", "restart", "drain"):
             raise ValueError(
                 f"on_wedged must be 503|restart|drain, got {on_wedged!r}")
+        # fleet role (dnn_tpu/control, disaggregated prefill/decode):
+        # ADVISORY — the router routes prefill exports to `prefill`
+        # replicas and generation to `decode`/`both`; the server itself
+        # serves every endpoint whatever its role (a mis-routed request
+        # still answers correctly, just on the wrong replica's FLOPs).
+        # Advertised on /statusz (the FleetCollector's per-replica role
+        # column) and as the dnn_tpu_replica_role gauge.
+        if role not in ("prefill", "decode", "both"):
+            raise ValueError(
+                f"role must be prefill|decode|both, got {role!r}")
+        self.role = role
+        # prefill->decode KV handoff inbox (kvput:<key> ingests, the
+        # h=<key> gen option consumes exactly once): bounded LRU — an
+        # orphaned handoff (router died between kvput and gen) must not
+        # hold row-cache-sized payloads forever
+        self._kv_handoff: "dict" = {}
+        self._kv_lock = threading.Lock()
+        self._kv_handoff_cap = int(kv_handoff_cap)
         self.on_wedged = on_wedged
         self.worker_restarts = int(worker_restarts)
         self.max_request_retries = int(max_request_retries)
@@ -782,6 +807,10 @@ class LMServer:
         # the batcher's first program compiles, so jax_compilations_total
         # counts the daemon's own warmup too (dnn_tpu/obs)
         obs.install_compile_telemetry()
+        if (m := obs.metrics()) is not None:
+            from dnn_tpu.utils.metrics import labeled
+
+            m.set(labeled("dnn_tpu_replica_role", role=self.role), 1.0)
         if obs.enabled():
             # black box: an unhandled crash anywhere in this process
             # dumps the flight ring (obs/flight.py) — the daemon is the
@@ -955,9 +984,22 @@ class LMServer:
             comps = dict(s.get("components") or {})
             comps["step"] = sc.status_component()
             s["components"] = comps
+        if s is None:
+            # no watchdog and no step record yet: synthesize the
+            # handler's worker-liveness shape so the payload still
+            # carries the fleet-facing fields below (role — the
+            # FleetCollector's per-replica role column reads /statusz)
+            alive = (w := getattr(self, "worker", None)) is not None \
+                and w.is_alive()
+            s = {"state": "ok" if alive else "wedged",
+                 "components": {"worker": {
+                     "state": "ok" if alive else "wedged",
+                     "detail": "serving worker thread liveness"}}}
+        else:
+            s = dict(s)
+        s["role"] = self.role
         if not self._draining:
             return s
-        s = dict(s) if s is not None else {"state": "ok", "components": {}}
         comps = dict(s.get("components") or {})
         comps["drain"] = {"state": "draining",
                           "detail": "admission closed; finishing "
@@ -1289,6 +1331,7 @@ class LMServer:
         try:
             max_new, seed, opts = await self._preflight(request_id,
                                                         context)
+            await self._resolve_kv_handle(opts, context)
             # propagated deadline (dl= segment, comm/transport.py): the
             # caller's REMAINING budget caps the server-side wait, so a
             # nearly-dead request can't hold a slot for the full local
@@ -1444,14 +1487,113 @@ class LMServer:
             with guard.lock:
                 self._embed_inflight -= 1
 
+    # -- disaggregated prefill/decode (dnn_tpu/control) -----------------
+
+    def _prefill_export(self, prompt: np.ndarray) -> np.ndarray:
+        """Run the chunk loop only (no slot, no sampling) and pack the
+        handoff payload. Same off-worker device-work discipline as the
+        embed endpoint: the _embed_inflight counter (really "aux device
+        work in flight") fences the worker's cache guard so a clear
+        can never land mid-program."""
+        from dnn_tpu.control import handoff as _handoff
+
+        guard = self.worker.cache_guard
+        with guard.lock:
+            self._embed_inflight += 1
+        try:
+            return np.asarray(
+                _handoff.pack(self.batcher.export_prefill(prompt)))
+        finally:
+            with guard.lock:
+                self._embed_inflight -= 1
+
+    async def _kvput(self, key: str, request: pb.TensorRequest,
+                     context) -> pb.TensorResponse:
+        """Ingest a prefill replica's packed KV payload under `key`.
+        Unpacked and geometry-checked NOW — a mismatched handoff fails
+        at ingest with a readable diff, not at admission; handles are
+        single-use (the h= gen option consumes them) and the inbox is
+        a bounded LRU."""
+        key = key.strip()
+        if not key:
+            await context.abort(
+                grpc.StatusCode.INVALID_ARGUMENT,
+                "kvput needs a nonempty handle key (kvput:<key>)")
+        if getattr(self.batcher, "spec_k", None) is not None:
+            await context.abort(
+                grpc.StatusCode.INVALID_ARGUMENT,
+                "speculative servers cannot adopt handed-off KV (the "
+                "draft cache needs its own prompt prefill)")
+        if getattr(self.batcher, "_ilv", 0):
+            await context.abort(
+                grpc.StatusCode.INVALID_ARGUMENT,
+                "interleaved-admission servers (prefill_chunk_tokens) "
+                "cannot adopt handed-off KV — adoption rides the "
+                "convoy install path")
+        try:
+            raw = _tensor_arr(request.tensor)
+        except PayloadCorruptError as e:
+            await context.abort(grpc.StatusCode.DATA_LOSS, str(e))
+        from dnn_tpu.control import handoff as _handoff
+
+        try:
+            # full-payload byte parse: host-only, but row-cache-sized —
+            # off the event loop like every other non-trivial handler leg
+            payload = await asyncio.to_thread(_handoff.unpack, raw)
+        except ValueError as e:
+            await context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
+        mine = self.batcher.handoff_fingerprint()
+        theirs = payload.get("fingerprint") or {}
+        if theirs and theirs != mine:
+            diff = {k: (theirs.get(k), mine.get(k))
+                    for k in set(theirs) | set(mine)
+                    if theirs.get(k) != mine.get(k)}
+            await context.abort(
+                grpc.StatusCode.INVALID_ARGUMENT,
+                f"handoff geometry mismatch (theirs, mine): {diff} — "
+                "prefill and decode replicas must share model config, "
+                "max_len, prompt_pad and kv dtype")
+        with self._kv_lock:
+            self._kv_handoff[key] = payload
+            while len(self._kv_handoff) > self._kv_handoff_cap:
+                self._kv_handoff.pop(next(iter(self._kv_handoff)))
+        obs.flight.record("kv_staged", key=key[:80],
+                          prompt_len=payload["prompt_len"])
+        return wc.TensorResponse(
+            status=f"[lm] ok: kv handle {key!r} staged "
+                   f"({payload['prompt_len']} prompt positions)")
+
+    async def _resolve_kv_handle(self, opts: dict, context):
+        """Swap a parsed h=<key> option for its staged payload
+        (single-use). Unknown handle = INVALID_ARGUMENT — generating
+        WITHOUT the adopted KV would silently re-prefill, hiding a
+        broken handoff path."""
+        h = opts.pop("kv_handle", None)
+        if h is None:
+            return
+        with self._kv_lock:
+            payload = self._kv_handoff.pop(h, None)
+        if payload is None:
+            await context.abort(
+                grpc.StatusCode.INVALID_ARGUMENT,
+                f"unknown or already-consumed kv handle {h!r} "
+                "(kvput: it first; handles are single-use)")
+        opts["prefilled"] = payload
+
     async def SendTensor(self, request: pb.TensorRequest, context) -> pb.TensorResponse:
-        prompt = await self._validated_prompt(request, context)
         rid = request.request_id or ""
         # client-side transport metadata may ride any request_id — the
         # trace tag (tr=...) and the propagated deadline (dl=...); both
         # are stripped before endpoint parse (the deadline is honored
         # inside _submit_and_await, which reads the RAW rid)
         rid_clean = _tx.strip_deadline(obs.strip_wire_tag(rid))
+        if rid_clean.startswith("kvput:"):
+            # KV-handoff ingest (disaggregated serving): the tensor is
+            # a packed export_prefill payload, NOT token ids — decoded
+            # raw, before the vocab-range prompt validation below
+            return await self._kvput(rid_clean.split(":", 1)[1],
+                                     request, context)
+        prompt = await self._validated_prompt(request, context)
         if rid_clean == "embed" or rid_clean.startswith("embed:"):
             # embedding endpoint: 'embed[:mean|last]' returns the pooled
             # final hidden state instead of generated tokens
@@ -1473,6 +1615,25 @@ class LMServer:
             return wc.TensorResponse(
                 status=f"[lm] ok: embedding dim {vec.shape[-1]}",
                 result_tensor=_tensor_msg(vec),
+            )
+        if rid_clean == "prefill":
+            # prefill-export endpoint (disaggregated serving): run ONLY
+            # the chunk loop for this prompt and answer with the packed
+            # KV payload — the router (or any client) hands it to a
+            # decode replica via kvput: + h=. Device work off-loop,
+            # cache-guard-fenced, exactly like the embed endpoint.
+            root = self._request_span(rid, method="prefill")
+            try:
+                payload = await asyncio.to_thread(
+                    self._prefill_export, np.asarray(prompt))
+            except ValueError as e:
+                await context.abort(grpc.StatusCode.INVALID_ARGUMENT,
+                                    str(e))
+            finally:
+                root.end()
+            return wc.TensorResponse(
+                status=f"[lm] ok: prefill kv {payload.size} bytes",
+                result_tensor=_tensor_msg(payload),
             )
         tokens = await self._submit_and_await(prompt, rid, context)
         return wc.TensorResponse(
@@ -1500,6 +1661,9 @@ class LMServer:
             # stream to one consumer) — drop the key rather than let it
             # reach batcher.submit as an unknown kwarg
             opts.pop("dedup", None)
+            # ...but they CAN adopt handed-off KV: resolve h= the same
+            # way the unary front does
+            await self._resolve_kv_handle(opts, context)
             root.set(max_new=max_new, prompt_len=int(prompt.size))
             loop = asyncio.get_running_loop()
             q: "asyncio.Queue" = asyncio.Queue()

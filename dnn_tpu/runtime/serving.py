@@ -1023,7 +1023,7 @@ class ContinuousBatcher:
                stop: Optional[list] = None,
                logprobs: bool = False,
                adapter: Optional[int] = None,
-               constraint=None, trace=None) -> int:
+               constraint=None, prefilled=None, trace=None) -> int:
         """Prefill `prompt` (1-D int array) into a free slot; returns the
         request id. The first token is sampled during prefill and counts
         toward max_new_tokens. `seed` names the request's private rng
@@ -1061,7 +1061,21 @@ class ContinuousBatcher:
         span with a nested "prefill", and each step maintains a
         per-bucket "decode" span until the request retires. None (the
         default) skips all span work; metrics counters are recorded
-        either way when observability is on."""
+        either way when observability is on.
+
+        `prefilled` (disaggregated serving, dnn_tpu/control): a
+        PREFILL replica's `export_prefill` payload — this request's
+        transient row cache plus the final chunk's true-last logit
+        row. Admission then ADOPTS the handed-off KV instead of
+        running the chunk loop: same slot install, same
+        `_prefill_finish` program, same rng derivation, so tokens
+        agree draw-for-draw with a locally-prefilled submission of the
+        same seed. Requires matching geometry on both replicas (model
+        config, max_len, prompt_pad, kv dtype — mismatches fail loud);
+        rejects interleaved admission (`prefill_chunk_tokens` — the
+        convoy install path IS the adoption path) and `adapter` (the
+        exported row was computed against the prefill replica's base
+        weights)."""
         # step-timeline: this submit's whole wall (validation, slot
         # install, prefill chunks, first-token sample) is the "admit"
         # phase, attached to the NEXT step's record in note_admit
@@ -1173,6 +1187,18 @@ class ContinuousBatcher:
                     f"adapter {adapter} out of range "
                     f"[0, {self._n_adapters})")
             aid = int(adapter) + 1  # stack row 0 is the base model
+        if prefilled is not None:
+            if self._ilv:
+                raise ValueError(
+                    "prefilled= does not compose with "
+                    "prefill_chunk_tokens: interleaved admission folds "
+                    "chunks into decode steps — KV adoption rides the "
+                    "convoy install path")
+            if adapter is not None:
+                raise ValueError(
+                    "prefilled= does not compose with adapter=: the "
+                    "handed-off row was computed against the prefill "
+                    "replica's base weights")
         try:
             slot = self._slot_req.index(None)
         except ValueError:
@@ -1187,7 +1213,7 @@ class ContinuousBatcher:
         key_ns = np.int32(aid).tobytes()
         n_chunks = -(-len(prompt) // p_pad)
         hit_c, hit_entry = 0, None
-        if self._prefix_cache is not None:
+        if self._prefix_cache is not None and prefilled is None:
             for c in range(len(prompt) // p_pad, 0, -1):
                 e = self._prefix_cache.get(
                     key_ns + prompt[: c * p_pad].tobytes())
@@ -1348,7 +1374,9 @@ class ContinuousBatcher:
             # to max_len - max_new) reuse the one compiled chunk program
             padded = np.zeros((1, n_chunks * p_pad), np.int32)
             padded[0, : len(prompt)] = prompt
-            row = self._new_row()
+            # prefilled (KV adoption): the row arrives from the prefill
+            # replica — never allocate (or compute) one here
+            row = self._new_row() if prefilled is None else None
             logits = None
             start_chunk = 0
             if hit_c:
@@ -1386,33 +1414,47 @@ class ContinuousBatcher:
             # submit-entry-to-here is validation/slot/host bookkeeping,
             # which belongs to the admit span, not this metric
             chunks_before = self.prefill_chunks_run
-            for c in range(start_chunk, n_chunks):
-                with _prof_annotation("serving.prefill_chunk"):
-                    logits, row = self._prefill_chunk(
-                        pf_prepared, row,
-                        jnp.asarray(padded[:, c * p_pad:(c + 1) * p_pad]),
-                        jnp.int32(c * p_pad),
-                    )
-                self.prefill_chunks_run += 1
-                if self._prefix_cache is not None and (c + 1) * p_pad <= len(prompt):
-                    key = key_ns + prompt[: (c + 1) * p_pad].tobytes()
-                    if self._paged:
-                        # block-sharing entries point at THIS request's
-                        # blocks, which only hold data after the install —
-                        # record now, create after _prefill_finish
-                        put_candidates.append(
-                            (c + 1, key, jnp.copy(logits[0, -1])))
-                        continue
-                    # scan-resistant insertion: evict the current LRU first,
-                    # then park the NEW entry at the LRU end — only a HIT
-                    # promotes to MRU. A long novel prompt therefore cycles
-                    # its own one-shot chunks through the LRU slot instead of
-                    # flushing the hot shared-prefix entries it never matches.
-                    while len(self._prefix_cache) >= self._prefix_cap:
-                        self._evict_prefix_entry()
-                    self._prefix_cache[key] = (
-                        jax.tree.map(jnp.copy, row), jnp.copy(logits[0, -1]))
-                    self._prefix_cache.move_to_end(key, last=False)
+            if prefilled is not None:
+                # KV ADOPTION (disaggregated serving, dnn_tpu/control):
+                # the prefill replica already ran this chunk loop;
+                # rebuild its transient row + the finish-shaped logits
+                # and fall through to the SAME _prefill_finish install
+                # below — the decode replica spends zero prompt FLOPs
+                row, logits = self._adopt_prefilled(prefilled, prompt)
+            else:
+                for c in range(start_chunk, n_chunks):
+                    with _prof_annotation("serving.prefill_chunk"):
+                        logits, row = self._prefill_chunk(
+                            pf_prepared, row,
+                            jnp.asarray(
+                                padded[:, c * p_pad:(c + 1) * p_pad]),
+                            jnp.int32(c * p_pad),
+                        )
+                    self.prefill_chunks_run += 1
+                    if self._prefix_cache is not None \
+                            and (c + 1) * p_pad <= len(prompt):
+                        key = key_ns + prompt[: (c + 1) * p_pad].tobytes()
+                        if self._paged:
+                            # block-sharing entries point at THIS
+                            # request's blocks, which only hold data
+                            # after the install — record now, create
+                            # after _prefill_finish
+                            put_candidates.append(
+                                (c + 1, key, jnp.copy(logits[0, -1])))
+                            continue
+                        # scan-resistant insertion: evict the current
+                        # LRU first, then park the NEW entry at the LRU
+                        # end — only a HIT promotes to MRU. A long novel
+                        # prompt therefore cycles its own one-shot
+                        # chunks through the LRU slot instead of
+                        # flushing the hot shared-prefix entries it
+                        # never matches.
+                        while len(self._prefix_cache) >= self._prefix_cap:
+                            self._evict_prefix_entry()
+                        self._prefix_cache[key] = (
+                            jax.tree.map(jnp.copy, row),
+                            jnp.copy(logits[0, -1]))
+                        self._prefix_cache.move_to_end(key, last=False)
             last_local = len(prompt) - 1 - (n_chunks - 1) * p_pad
             t_arr = jnp.float32(temp)
             k_arr = jnp.int32(tk)
@@ -1561,6 +1603,139 @@ class ContinuousBatcher:
         m = obs.metrics()
         if m is not None:
             m.inc("serving.decode_bucket_grow_total")
+
+    # -- disaggregated prefill/decode (dnn_tpu/control) -----------------
+
+    def handoff_fingerprint(self) -> dict:
+        """The geometry both sides of a KV handoff must agree on. The
+        adopt path re-verifies leaf-by-leaf anyway (shapes + dtypes vs
+        this pool's own row structure); the fingerprint exists so a
+        kvput against a mismatched replica fails at INGEST with a
+        readable diff instead of at admission."""
+        leaves = jax.tree_util.tree_flatten(self._row_shape())[0]
+        return {
+            "family": type(self.family).__name__,
+            "vocab_size": int(self.cfg.vocab_size),
+            "prompt_pad": int(self.prompt_pad),
+            "row_len": int(self._row_len),
+            "row_leaves": [[list(x.shape), str(x.dtype)] for x in leaves],
+        }
+
+    def _row_shape(self):
+        """ShapeDtypeStruct pytree of the transient row cache (no
+        allocation) — the adoption path's geometry oracle."""
+        struct = getattr(self, "_row_struct", None)
+        if struct is None:
+            struct = jax.eval_shape(self._new_row)
+            self._row_struct = struct
+        return struct
+
+    def export_prefill(self, prompt, *, max_new_tokens: int = 1):
+        """PREFILL-replica half of the disaggregated split: run ONLY
+        the chunk loop for `prompt` — no slot held, no install, no
+        sampling — and return the handoff payload a decode replica
+        adopts via `submit(prefilled=...)`: the transient row cache's
+        leaves (host arrays) plus the final chunk's true-last logit
+        row. `max_new_tokens` only sizes the length check (the decode
+        side re-validates with the request's real budget).
+
+        Prices like any prefill: the chunk counter, the prefill-
+        seconds series and the goodput tracker's prefill FLOPs all
+        tick here, so MFU/MBU on the prefill replica account the work
+        it actually does (the handoff's wire cost is priced by the
+        router's handoff gauges)."""
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        if len(prompt) == 0:
+            raise ValueError("prompt must have at least one token")
+        if len(prompt) + max(int(max_new_tokens), 1) > self.max_len:
+            raise ValueError(
+                f"prompt {len(prompt)} + max_new_tokens "
+                f"{max_new_tokens} exceeds max_len {self.max_len}")
+        p_pad = self.prompt_pad
+        n_chunks = -(-len(prompt) // p_pad)
+        padded = np.zeros((1, n_chunks * p_pad), np.int32)
+        padded[0, : len(prompt)] = prompt
+        # the row is built the convoy way — _new_row + the chunk
+        # program — even on an interleaved-admission server (the chunk
+        # program is compiled unconditionally), so ANY replica can
+        # take the prefill role
+        row = self._new_row()
+        logits = None
+        t_pf = time.perf_counter()
+        for c in range(n_chunks):
+            with _prof_annotation("serving.prefill_chunk"):
+                logits, row = self._prefill_chunk(
+                    self.prepared, row,
+                    jnp.asarray(padded[:, c * p_pad:(c + 1) * p_pad]),
+                    jnp.int32(c * p_pad),
+                )
+            self.prefill_chunks_run += 1
+        last_local = len(prompt) - 1 - (n_chunks - 1) * p_pad
+        logits_row = np.asarray(logits[0, last_local])
+        leaves = [np.asarray(x) for x in jax.tree_util.tree_flatten(row)[0]]
+        m = obs.metrics()
+        if m is not None:
+            m.bulk(
+                counters={"serving.prefill_chunks_total": n_chunks},
+                observations={"serving.prefill_seconds":
+                              [time.perf_counter() - t_pf]},
+            )
+            if (g := self.goodput) is not None:
+                g.on_prefill(len(prompt))
+        return {"row": leaves, "logits_row": logits_row,
+                "prompt_len": len(prompt),
+                "fingerprint": self.handoff_fingerprint()}
+
+    def _adopt_prefilled(self, prefilled, prompt) -> tuple:
+        """Decode-replica half: verify the handed-off payload against
+        THIS pool's row geometry, rebuild the row pytree and the
+        finish-shaped logits array (the stored true-last row placed at
+        `last_local`, exactly like a whole-prompt prefix hit). Every
+        mismatch is a loud ValueError — adopting mis-shaped KV would
+        generate plausible garbage."""
+        struct = self._row_shape()
+        want, treedef = jax.tree_util.tree_flatten(struct)
+        got = prefilled.get("row") if isinstance(prefilled, dict) else None
+        if not isinstance(got, (list, tuple)):
+            raise ValueError(
+                "prefilled= expects an export_prefill payload dict "
+                "with a 'row' leaf list")
+        if len(got) != len(want):
+            raise ValueError(
+                f"handoff row has {len(got)} leaves but this pool's "
+                f"row cache has {len(want)} — prefill and decode "
+                "replicas must share model config and kv dtype")
+        for i, (w, h) in enumerate(zip(want, got)):
+            h = np.asarray(h)
+            if tuple(h.shape) != tuple(w.shape) \
+                    or str(h.dtype) != str(np.dtype(w.dtype)):
+                raise ValueError(
+                    f"handoff row leaf {i} is {h.dtype}{h.shape} but "
+                    f"this pool expects {w.dtype}{tuple(w.shape)} — "
+                    "prefill and decode replicas must share model "
+                    "config, max_len, prompt_pad and kv dtype")
+        plen = prefilled.get("prompt_len")
+        if plen is not None and int(plen) != len(prompt):
+            raise ValueError(
+                f"handoff was exported for a {plen}-token prompt but "
+                f"this request's prompt has {len(prompt)} tokens")
+        row = jax.tree_util.tree_unflatten(
+            treedef, [jnp.asarray(x) for x in got])
+        lr = np.asarray(prefilled.get("logits_row"))
+        if lr.shape != (self.cfg.vocab_size,):
+            raise ValueError(
+                f"handoff logits_row has shape {lr.shape}, expected "
+                f"({self.cfg.vocab_size},)")
+        p_pad = self.prompt_pad
+        n_chunks = -(-len(prompt) // p_pad)
+        last_local = len(prompt) - 1 - (n_chunks - 1) * p_pad
+        lr_j = jnp.asarray(lr)
+        logits = jnp.zeros((1, p_pad, lr_j.shape[0]), lr_j.dtype
+                           ).at[0, last_local].set(lr_j)
+        m = obs.metrics()
+        if m is not None:
+            m.inc("serving.kv_adoptions_total")
+        return row, logits
 
     def _evict_prefix_entry(self):
         """Drop the LRU prefix entry; paged entries release their block

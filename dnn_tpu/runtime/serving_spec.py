@@ -439,6 +439,14 @@ class SpeculativeBatcher(ContinuousBatcher):
                 "SpeculativeBatcher uses the server-level sampling "
                 f"configuration; per-request {bad}= is the dense "
                 "batcher's feature")
+        if opts.get("prefilled") is not None:
+            # KV adoption (dnn_tpu/control) would install the TARGET
+            # cache only — the draft cache would never see the prompt
+            # and every verify chunk would diverge
+            raise ValueError(
+                "prefilled= (disaggregated KV adoption) does not "
+                "compose with speculative serving: the draft cache "
+                "needs its own prompt prefill")
         prompt_arr = np.asarray(prompt, np.int32).reshape(-1)
         k = self.spec_k
         if len(prompt_arr) < k + 1:

@@ -109,6 +109,13 @@ _MODULE_COST_S = {
     # ordering, fused-sampling logprob agreement, the un-aliased-mixed
     # gate test, int8-weights serving parity + byte pricing — certified
     # inside the tier-1 budget with the serving modules
+    "test_control": 55.0,  # ISSUE 13 fleet front door: policy/admission
+    # goldens, REPLICA/ROUTER protocol tables + buggy fixtures, KV
+    # handoff pack/adopt parity (incl. paged), router e2e over real
+    # gRPC (round trip, round-robin spread, dedup affinity join,
+    # streaming, disaggregated prefill/decode parity, shed, drain-to-
+    # sibling) — in-process replicas; certified inside the tier-1
+    # budget with the serving-resilience modules
     "test_chaos": 42.0,  # ISSUE 8 chaos + self-healing: injection
     # goldens, supervisor restart/backoff/crash-loop (tiny python -c
     # children), requeue token parity, drain-under-load, circuit

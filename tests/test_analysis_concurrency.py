@@ -386,7 +386,8 @@ def test_protocol_audit_clean_on_head():
         f"{f.path}:{f.line} {f.rule} {f.message}" for f in findings)
     assert all(m["clean"] for m in report["machines"])
     assert {m["name"] for m in report["machines"]} == {
-        "circuit_breaker", "supervisor", "drain", "relay_accept_window"}
+        "circuit_breaker", "supervisor", "drain", "relay_accept_window",
+        "replica_lifecycle", "router"}
 
 
 def test_pro002_unsettled_probe_slot_is_a_model_failure():
