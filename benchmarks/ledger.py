@@ -1,0 +1,347 @@
+"""Perf-trajectory ledger: the repo's measured history as ONE table,
+with every ratchet assert in ONE place.
+
+Two artifact families record this repo's trajectory and, until now,
+nothing read them together:
+
+  * BENCH_r*.json (repo root): one headline row per round from
+    bench.py — tokens/sec vs baseline, substrate, and (since PR 6/7)
+    the decode-goodput and relay-transport riders;
+  * the run_all rows: benchmarks/.bench_rows.jsonl when a round ran
+    here, else the committed benchmarks/RESULTS.md table.
+
+The ledger parses both into one trend view (`python
+benchmarks/ledger.py`) and CENTRALIZES the ratchet asserts that were
+scattered across probe modules: each ratchet names its config, the
+field it reads, and the threshold IMPORTED from the probe that owns it
+(single source of truth — the ledger can never drift from the gate).
+`--assert` exits nonzero when any evaluated ratchet fails; a missing
+row is reported as `missing`, and `--strict` fails those too (the
+whole-round gate: a trajectory that silently dropped its decode_mbu
+row must not read as green).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import sys
+from typing import Callable, List, Optional
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+STATE_PATH = os.path.join(REPO, "benchmarks", ".bench_rows.jsonl")
+RESULTS_PATH = os.path.join(REPO, "benchmarks", "RESULTS.md")
+
+
+# ----------------------------------------------------------------------
+# parsing: BENCH_r*.json rounds
+# ----------------------------------------------------------------------
+
+def bench_rounds(repo_dir: str = REPO) -> List[dict]:
+    """One dict per committed round, ascending: {round, metric, value,
+    vs_baseline, substrate, mbu, hop_p50_ratio, bubble_drop, ...} with
+    absent riders left out (older rounds predate them). Tolerates both
+    driver shapes: a `parsed` object, or the bench line inside `tail`."""
+    out = []
+    for name in sorted(os.listdir(repo_dir)):
+        m = re.fullmatch(r"BENCH_r(\d+)\.json", name)
+        if not m:
+            continue
+        try:
+            with open(os.path.join(repo_dir, name)) as f:
+                obj = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            continue
+        row = obj.get("parsed") if isinstance(obj, dict) else None
+        if not isinstance(row, dict) or "metric" not in row:
+            row = {}
+            for line in (obj.get("tail", "") if isinstance(obj, dict)
+                         else "").splitlines():
+                if line.startswith("{"):
+                    try:
+                        cand = json.loads(line)
+                        if isinstance(cand, dict) and "metric" in cand:
+                            row = cand
+                    except json.JSONDecodeError:
+                        pass
+        if not row:
+            # a round that crashed before printing its row is part of
+            # the trajectory too — silence would read as "no round ran"
+            out.append({"round": int(m.group(1)), "metric": None,
+                        "value": None,
+                        "substrate": f"no row (rc={obj.get('rc')})"})
+            continue
+        entry = {
+            "round": int(m.group(1)),
+            "metric": row.get("metric"),
+            "value": row.get("value"),
+            "vs_baseline": row.get("vs_baseline"),
+            "substrate": row.get("round_substrate", row.get("platform")),
+        }
+        dg = row.get("decode_goodput")
+        if isinstance(dg, dict) and "mbu" in dg:
+            entry["mbu"] = dg["mbu"]
+        rt = row.get("relay_transport")
+        if isinstance(rt, dict) and "hop_p50_ratio" in rt:
+            entry["hop_p50_ratio"] = rt["hop_p50_ratio"]
+            entry["bubble_drop"] = rt.get("bubble_drop")
+        if row.get("stale_tpu_reference"):
+            entry["stale_tpu_reference"] = True
+        out.append(entry)
+    return sorted(out, key=lambda e: e["round"])
+
+
+# ----------------------------------------------------------------------
+# parsing: run_all rows (state file first, committed table as fallback)
+# ----------------------------------------------------------------------
+
+def run_rows(state_path: str = STATE_PATH,
+             results_path: str = RESULTS_PATH) -> List[dict]:
+    """The latest run_all row per config: the committed RESULTS.md
+    table is the floor (values + the k=v detail cells the ratchets
+    read), and the machine-readable state file a local round leaves
+    behind OVERLAYS it per config — a subset round (`run_all
+    --scenarios`) writes only the rows it ran, and exclusivity would
+    erase the committed history underneath. Later rows of one config
+    supersede earlier ones."""
+    latest: dict = {}
+    if os.path.exists(results_path):
+        _results_md_rows(results_path, latest)
+    if os.path.exists(state_path):
+        with open(state_path) as f:
+            for line in f:
+                try:
+                    obj = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                r = obj.get("_row")
+                if isinstance(r, dict) and "config" in r:
+                    latest[r["config"]] = r
+    return list(latest.values())
+
+
+def _results_md_rows(results_path: str, latest: dict) -> None:
+    with open(results_path) as f:
+        for line in f:
+            cells = [c.strip() for c in line.split("|")][1:-1]
+            if len(cells) != 6 or cells[0] in ("config", "---") \
+                    or set(cells[0]) == {"-"}:
+                continue
+            config, metric, value, _mfu, platform, details = cells
+            row = {"config": config, "metric": metric,
+                   "platform": platform, "_details": details}
+            try:
+                row["value"] = float(value)
+            except ValueError:
+                row["value"] = value
+            # the detail cell is prose-bearing ("note=..."), so k=v
+            # extraction is per-key regex, never a naive comma split
+            for key in ("ok", "fleet_availability", "fleet_vs_single",
+                        "fleet_silently_lost", "coverage",
+                        "availability", "slo_verdict", "reconstructed"):
+                m = re.search(rf"\b{key}=([^,|]+)", details)
+                if not m:
+                    continue
+                v = m.group(1).strip()
+                if v in ("True", "False"):
+                    row[key] = v == "True"
+                else:
+                    try:
+                        row[key] = float(v)
+                    except ValueError:
+                        row[key] = v
+            latest[config] = row
+
+
+# ----------------------------------------------------------------------
+# the centralized ratchets
+# ----------------------------------------------------------------------
+
+class Ratchet:
+    """One regression-asserted number: read `field` off the row named
+    `config`, compare against `threshold()` (a callable importing the
+    floor from the probe module that owns it — one source of truth)
+    with `op` ('>=' floors, '<=' ceilings, '==' exact)."""
+
+    def __init__(self, name: str, config: str, field: str, op: str,
+                 threshold: Callable[[], float], note: str = ""):
+        self.name, self.config, self.field = name, config, field
+        self.op, self.threshold, self.note = op, threshold, note
+
+    def evaluate(self, rows: List[dict]) -> dict:
+        row = next((r for r in rows if r.get("config") == self.config),
+                   None)
+        out = {"ratchet": self.name, "config": self.config,
+               "field": self.field, "op": self.op, "note": self.note}
+        try:
+            out["threshold"] = self.threshold()
+        except Exception as e:  # noqa: BLE001 — a probe module that no
+            # longer imports is itself a finding, not a crash
+            out.update({"status": "error",
+                        "error": f"threshold import failed: {e}"})
+            return out
+        if row is None:
+            out["status"] = "missing"
+            return out
+        val = row.get(self.field)
+        if val is None:
+            out["status"] = "missing"
+            out["detail"] = f"row has no {self.field!r} field"
+            return out
+        out["value"] = val
+        thr = out["threshold"]
+        ok = {"<=": val <= thr, ">=": val >= thr, "==": val == thr}[
+            self.op]
+        out["status"] = "ok" if ok else "FAIL"
+        return out
+
+
+def _t(module: str, const: str, scale: float = 1.0):
+    def read() -> float:
+        import importlib
+
+        return getattr(importlib.import_module(module), const) * scale
+    return read
+
+
+def _const(v: float):
+    return lambda: v
+
+
+RATCHETS: List[Ratchet] = [
+    Ratchet("decode_mbu_floor", "decode_mbu", "value", ">=",
+            _t("benchmarks.decode_mbu_probe", "MBU_FLOOR", 100.0),
+            "live decode MBU %, ratcheted 5->10 (BASELINE.md)"),
+    Ratchet("host_fraction_ceiling", "step_timeline", "value", "<=",
+            _t("benchmarks.step_timeline_probe", "HOST_FRACTION_CEIL",
+               100.0),
+            "host-serialization % of decode wall, ratcheted from 54.9"),
+    Ratchet("obs_overhead_budget", "obs_overhead", "value", "<=",
+            _const(2.0), "obs tax % of a decode step (ISSUE 3 contract)"),
+    Ratchet("fleet_overhead_budget", "fleet_overhead", "value", "<=",
+            _const(2.0), "obs tax with the fleet surface live"),
+    Ratchet("hop_p50_floor", "relay_transport", "value", ">=",
+            _t("benchmarks.relay_transport_probe", "HOP_RATIO_FLOOR"),
+            "negotiated-transport hop speedup vs nested grpc"),
+    Ratchet("chaos_availability_floor", "chaos_resilience", "value",
+            ">=",
+            _t("benchmarks.chaos_probe", "AVAILABILITY_FLOOR", 100.0),
+            "availability % under kill+wedge injection"),
+    Ratchet("fleet_availability_floor", "fleet_serving",
+            "fleet_availability", ">=",
+            _t("benchmarks.fleet_serving_probe", "AVAILABILITY_FLOOR"),
+            "router-leg availability through a replica kill"),
+    Ratchet("fleet_speedup_floor", "fleet_serving", "fleet_vs_single",
+            ">=",
+            _t("benchmarks.fleet_serving_probe", "FLEET_SPEEDUP_FLOOR"),
+            "fleet delivered tokens/sec vs the unfronted replica"),
+    # the workload suite: each scenario's SLO verdict is the assert —
+    # `ok` carries it (inverted + bundle-verified for breach_chaos)
+    Ratchet("workload_chat", "workload_chat", "ok", "==", _const(True),
+            "chat scenario SLO verdict"),
+    Ratchet("workload_longcontext", "workload_longcontext", "ok", "==",
+            _const(True), "long-context scenario SLO verdict"),
+    Ratchet("workload_json_mode", "workload_json_mode", "ok", "==",
+            _const(True), "constrained-decoding scenario SLO verdict"),
+    Ratchet("workload_spec_mix", "workload_spec_mix", "ok", "==",
+            _const(True), "speculative-mix scenario SLO verdict"),
+    Ratchet("workload_lora", "workload_lora", "ok", "==", _const(True),
+            "multi-tenant LoRA scenario SLO verdict"),
+    Ratchet("workload_breach_reconstructs", "workload_breach_chaos",
+            "ok", "==", _const(True),
+            "forced breach produced a reconstructable incident bundle"),
+]
+
+
+def check_ratchets(rows: List[dict]) -> List[dict]:
+    return [r.evaluate(rows) for r in RATCHETS]
+
+
+# ----------------------------------------------------------------------
+# rendering
+# ----------------------------------------------------------------------
+
+def _fmt(v, nd=2) -> str:
+    if v is None:
+        return "—"
+    if isinstance(v, bool):
+        return str(v)
+    if isinstance(v, float):
+        return f"{v:.{nd}f}".rstrip("0").rstrip(".")
+    return str(v)
+
+
+def trend_table(rounds: List[dict]) -> str:
+    """The round-over-round view BENCH_r*.json was always meant to be:
+    headline + substrate + the riders, one row per round."""
+    lines = ["| round | metric | value | vs_baseline | substrate | "
+             "live mbu | hop ratio | bubble drop |",
+             "|---|---|---|---|---|---|---|---|"]
+    for e in rounds:
+        sub = e.get("substrate") or "?"
+        if e.get("stale_tpu_reference"):
+            sub += " (stale tpu echo)"
+        lines.append(
+            f"| r{e['round']:02d} | {e.get('metric')} "
+            f"| {_fmt(e.get('value'))} | {_fmt(e.get('vs_baseline'))} "
+            f"| {sub} | {_fmt(e.get('mbu'), 3)} "
+            f"| {_fmt(e.get('hop_p50_ratio'))} "
+            f"| {_fmt(e.get('bubble_drop'))} |")
+    return "\n".join(lines)
+
+
+def ratchet_table(verdicts: List[dict]) -> str:
+    lines = ["| ratchet | config.field | value | op threshold | status |",
+             "|---|---|---|---|---|"]
+    for v in verdicts:
+        lines.append(
+            f"| {v['ratchet']} | {v['config']}.{v['field']} "
+            f"| {_fmt(v.get('value'))} | {v['op']} "
+            f"{_fmt(v.get('threshold'))} | {v.get('status')} |")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--assert", dest="do_assert", action="store_true",
+                    help="exit nonzero when any evaluated ratchet FAILs")
+    ap.add_argument("--strict", action="store_true",
+                    help="with --assert: missing ratchet rows fail too "
+                         "(the whole-round gate)")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable dump instead of the tables")
+    args = ap.parse_args(argv)
+
+    rounds = bench_rounds()
+    rows = run_rows()
+    verdicts = check_ratchets(rows)
+    if args.json:
+        print(json.dumps({"rounds": rounds, "ratchets": verdicts},
+                         indent=2))
+    else:
+        print(f"# Perf trajectory — {len(rounds)} committed rounds\n")
+        print(trend_table(rounds))
+        src = ("RESULTS.md + .bench_rows.jsonl overlay"
+               if os.path.exists(STATE_PATH) else "RESULTS.md")
+        print(f"\n# Ratchets (rows from {src}; thresholds imported "
+              "from their probes)\n")
+        print(ratchet_table(verdicts))
+    bad = [v for v in verdicts if v.get("status") == "FAIL"
+           or (args.strict
+               and v.get("status") in ("missing", "error"))]
+    if args.do_assert and bad:
+        print("ASSERT FAILED: "
+              + ", ".join(f"{v['ratchet']}={v.get('status')}"
+                          for v in bad), file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

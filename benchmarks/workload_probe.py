@@ -1,0 +1,152 @@
+"""workload_<name>: the open-loop scenario suite as asserted bench rows.
+
+One row per scenario in dnn_tpu/workloads/scenarios.py, each SLO
+asserted IN-RUN: the row's `ok` is the verdict engine's judgment of
+the recorded traffic against the scenario's own declared objectives
+(obs/slo.py). The breach scenario inverts the assertion — it is green
+only when it BREACHES and its incident bundle reconstructs, checked by
+READING THE BUNDLE BACK off disk (manifest verdict, chaos events in
+the dumped timeline, CLI render) — never from in-memory state.
+
+`python -m benchmarks.workload_probe --scenario chat [--light]
+[--assert]` prints one JSON row; `--all` runs every scenario. The
+run_all `workload_<name>` rows ride `measure()`; `run_all.py
+--scenarios chat,json_mode` filters a round to the suite.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+
+def _p95_ms(rep, name: str):
+    for o in rep.objectives:
+        if o["name"].startswith(name) and o["measured"] is not None:
+            return round(o["measured"] * 1e3, 2)
+    return None
+
+
+def _verify_bundle(path: str) -> dict:
+    """Read an incident bundle BACK off disk and judge it — the
+    'reconstructable from the flight recorder' assertion. Checks:
+    manifest says breach, the dumped timeline carries the injected
+    faults that caused it, and the CLI's renderer produces the
+    event-by-event view."""
+    from dnn_tpu.obs.slo import load_incident, render_incident
+
+    out = {"bundle": path, "reconstructed": False}
+    try:
+        bundle = load_incident(path)
+    except (ValueError, OSError, json.JSONDecodeError) as e:
+        out["error"] = f"unreadable bundle: {e}"
+        return out
+    rep = bundle["manifest"]["report"]
+    events = bundle["flight"]
+    injected = [e for e in events if e.get("kind") == "chaos_inject"]
+    rendered = render_incident(bundle)
+    out.update({
+        "manifest_verdict_breach": not rep["ok"],
+        "flight_events": len(events),
+        "chaos_events_in_bundle": len(injected),
+        "render_lines": len(rendered.splitlines()),
+        "reconstructed": bool(not rep["ok"] and events and injected
+                              and "SLO BREACH" in rendered),
+    })
+    return out
+
+
+def measure(name: str, *, light: bool = False, seed: int = 0) -> dict:
+    """One scenario end to end -> one bench row (plain dict). `ok` is
+    the in-run SLO assertion (inverted + bundle-verified for
+    expect_breach scenarios)."""
+    import jax
+
+    from dnn_tpu.workloads import get_scenario, run_scenario
+
+    sc = get_scenario(name, light=light)
+    incident_dir = None
+    if sc.expect_breach:
+        incident_dir = os.path.join(
+            tempfile.mkdtemp(prefix=f"workload_{name}_"), "bundle")
+    t0 = time.perf_counter()
+    res = run_scenario(sc, seed=seed, incident_dir=incident_dir)
+    rep = res["report"]
+    row = {
+        "scenario": name, "light": bool(light), "seed": seed,
+        "requests": rep.requests, "completed": rep.completed,
+        "rejected": rep.rejected, "lost": rep.lost,
+        "availability": round(rep.completed / rep.requests, 4)
+        if rep.requests else 0.0,
+        "goodput_tokens_per_sec": rep.goodput_tps,
+        "ttft_p95_ms": _p95_ms(rep, "ttft"),
+        "itl_p95_ms": _p95_ms(rep, "itl"),
+        "slo": sc.slo.to_dict(),
+        "slo_verdict": "ok" if rep.ok else "breach",
+        "burn_rates": rep.burn_rates,
+        "wall_s": res["wall_s"],
+        "probe_wall_s": round(time.perf_counter() - t0, 1),
+        "platform": jax.default_backend(),
+    }
+    row["round_substrate"] = row["platform"]
+    row.update(res["extras"])
+    if sc.expect_breach:
+        row["expect_breach"] = True
+        if rep.ok:
+            row.update({"ok": False,
+                        "note": "scenario was expected to breach but "
+                                "the verdict came back ok — the chaos "
+                                "injection did not bite"})
+        else:
+            v = _verify_bundle(res["bundle"] or "")
+            row.update(v)
+            row["ok"] = bool(v["reconstructed"])
+    else:
+        row["ok"] = bool(rep.ok)
+    return row
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    from dnn_tpu.workloads.scenarios import SCENARIOS
+
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--scenario", default=None,
+                    help="one scenario name "
+                         f"({', '.join(sorted(SCENARIOS))})")
+    ap.add_argument("--all", action="store_true",
+                    help="run every registered scenario")
+    ap.add_argument("--light", action="store_true",
+                    help="shortened durations (smoke use; the "
+                         "acceptance configuration is the full run)")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--assert", dest="do_assert", action="store_true",
+                    help="exit nonzero when any row's in-run SLO "
+                         "assertion fails")
+    args = ap.parse_args(argv)
+    if not args.all and not args.scenario:
+        ap.error("need --scenario NAME or --all")
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    names = sorted(SCENARIOS) if args.all else [args.scenario]
+    rc = 0
+    for name in names:
+        row = measure(name, light=args.light, seed=args.seed)
+        print(json.dumps(row), flush=True)
+        if args.do_assert and not row["ok"]:
+            print(f"ASSERT FAILED: workload_{name} "
+                  f"(verdict={row.get('slo_verdict')}, "
+                  f"ok={row['ok']})", file=sys.stderr)
+            rc = 1
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

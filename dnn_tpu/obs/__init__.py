@@ -64,6 +64,16 @@ arc (ROADMAP item 4):
     baseline (benchmarks/step_timeline_probe.py) whose measured
     host-serialization fraction is the item-4 ratchet (BASELINE.md).
 
+v5 adds the JUDGMENT layer — the workload suite's verdict machinery
+(ISSUE 14):
+
+  * SLO verdicts + incident bundles (obs/slo.py): a scenario's
+    per-request records judged against its declared SLOSpec into one
+    ok/breach report, and — on breach — an on-disk incident bundle
+    (flight ring over the breach window, /stepz, /fleetz) that
+    `python -m dnn_tpu.obs incident PATH` renders back as the
+    event-by-event post-mortem (dnn_tpu/workloads drives it).
+
 Gate: DNN_TPU_OBS=off (or 0/false) disables everything — producers see
 `metrics()` return None, `start_span` return the free NULL_SPAN, and
 `flight.record` short-circuit on one boolean. The gate is re-checked

@@ -1,5 +1,12 @@
-"""CLI: `python -m dnn_tpu.obs {trace,flight,fleet,timeline} ...` — obs
-tooling.
+"""CLI: `python -m dnn_tpu.obs {trace,flight,fleet,timeline,incident}
+...` — obs tooling.
+
+    python -m dnn_tpu.obs incident PATH [--json]
+        Render an SLO-breach incident bundle (obs/slo.py — written
+        automatically by the workload runner when a scenario's verdict
+        is a breach): the verdict header, each failed objective, and
+        the flight ring's event-by-event timeline over the breach
+        window, plus the step-clock and fleet snapshots when captured.
 
     python -m dnn_tpu.obs timeline --url http://host:port
         Fetch a running server's /stepz and print the per-phase
@@ -542,6 +549,14 @@ def main(argv=None) -> int:
                          "here (one-shot mode)")
     fz.add_argument("--id", dest="trace_id", default=None,
                     help="restrict the report/stitch to one trace id")
+    inc = sub.add_parser("incident", help="render an SLO-breach "
+                         "incident bundle (obs/slo.py) as an event-by-"
+                         "event timeline")
+    inc.add_argument("path", help="bundle directory (manifest.json + "
+                                  "flight.jsonl [+ stepz/fleetz.json])")
+    inc.add_argument("--json", action="store_true",
+                     help="print the raw loaded bundle instead of the "
+                          "rendered timeline")
     tl = sub.add_parser("timeline", help="step-timeline attribution: "
                         "/stepz fetch + device-capture analysis "
                         "(obs/timeline.py)")
@@ -582,6 +597,15 @@ def main(argv=None) -> int:
         if args.selftest:
             return _fleet_selftest()
         return _fleet_cmd(args)
+    if args.cmd == "incident":
+        from dnn_tpu.obs.slo import load_incident, render_incident
+
+        bundle = load_incident(args.path)
+        if args.json:
+            print(json.dumps(bundle, indent=2, default=str))
+        else:
+            print(render_incident(bundle))
+        return 0
     if args.cmd == "timeline":
         if args.selftest:
             return _timeline_selftest()

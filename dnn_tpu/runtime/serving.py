@@ -653,7 +653,18 @@ class ContinuousBatcher:
             OrderedDict() if prefix_cache > 0 else None)
         self._prefix_cap = prefix_cache
         self.prefix_hits = 0       # submissions that reused >= 1 chunk
+        self.prefix_misses = 0     # lookups that reused nothing (the
+        # denominator half serving.prefix_hits_total always lacked — a
+        # hit counter alone can't say whether 100 hits is a hot cache
+        # or a rounding error against 1e6 misses)
+        self.prefix_evictions = 0
         self.prefill_chunks_run = 0  # chunk programs actually executed
+        if self._prefix_cache is not None:
+            # scrape-time effectiveness ratio (ROADMAP item 2's metric):
+            # hits / (hits + misses) over the pool's lifetime, weakly
+            # bound like every pool gauge
+            self._obs_gauges["dnn_tpu_prefix_hit_ratio"] = _weak_gauge(
+                "_prefix_ratio_read")
 
         logprobs_k = self._logprobs_k
 
@@ -1379,6 +1390,9 @@ class ContinuousBatcher:
             row = self._new_row() if prefilled is None else None
             logits = None
             start_chunk = 0
+            if not hit_c and self._prefix_cache is not None \
+                    and prefilled is None:
+                self.prefix_misses += 1
             if hit_c:
                 start_chunk = hit_c
                 self.prefix_hits += 1
@@ -1507,6 +1521,11 @@ class ContinuousBatcher:
                 }
                 if hit_c:
                     counters["serving.prefix_hits_total"] = 1
+                elif self._prefix_cache is not None \
+                        and prefilled is None:
+                    # the lookup ran (prefilled= adoptions skip it) and
+                    # reused nothing — the other half of the ratio
+                    counters["serving.prefix_misses_total"] = 1
                 m.bulk(
                     counters=counters,
                     observations={"serving.prefill_seconds":
@@ -1742,6 +1761,7 @@ class ContinuousBatcher:
         references (blocks still shared by live slots survive via
         refcount until those retire)."""
         _, entry = self._prefix_cache.popitem(last=False)
+        self.prefix_evictions += 1
         if self._paged:
             self._allocator.free(list(entry[0]))
         m = obs.metrics()
@@ -1973,6 +1993,13 @@ class ContinuousBatcher:
 
     def _active_hw_read(self) -> float:
         return float(self._active_hw)
+
+    def _prefix_ratio_read(self) -> float:
+        # lifetime hit ratio of the prefix-cache LOOKUP (prefilled=
+        # adoptions never consult it); 0.0 before the first lookup —
+        # what "no evidence yet" reads as on every other pool gauge
+        looked = self.prefix_hits + self.prefix_misses
+        return self.prefix_hits / looked if looked else 0.0
 
     def _paged_used_read(self) -> float:
         return float(self._allocator.n_used)

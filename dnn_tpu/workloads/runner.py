@@ -1,0 +1,347 @@
+"""Open-loop scenario runner: fire the schedule, record, judge, dump.
+
+The runner is the glue between a Scenario's pure script and the SLO
+verdict: it fires each request at its SCHEDULED offset (open-loop —
+arrivals never wait for completions; a saturated server faces the same
+demand a healthy one does), records per-request TTFT / inter-token
+gaps / outcome from the serving stack's own callbacks, hands the
+records to obs/slo.evaluate, and on a breach writes the incident
+bundle (flight ring filtered to the breach window + /stepz + /fleetz)
+so the post-mortem exists the moment the verdict does.
+
+Targets:
+
+  * in-process (default): the scenario's own `build_server()` LMServer;
+    requests ride `server.worker.submit(..., on_token=...)` — the same
+    queue/admission/batcher path a gRPC request takes, minus the wire.
+    First-token and inter-token timestamps come from the worker's
+    per-token commit callback, so TTFT here is the queue+prefill time
+    the server's own `serving.ttft_seconds` metric measures;
+  * `target="host:port"`: a live LM daemon or a PR-12 router front
+    door; requests ride NodeClient.generate_stream (one client per
+    request, the chaos-probe pattern — a shared channel against an
+    in-process router produces CANCELLED storms), and the incident
+    bundle snapshots the target's obs endpoint over HTTP when
+    `target_obs_url` is given.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import List, Optional
+
+import numpy as np
+
+from dnn_tpu.obs import slo as _slo
+from dnn_tpu.workloads.scenarios import Scenario
+
+__all__ = ["run_scenario"]
+
+
+class _Rec:
+    """Mutable per-request record; `as_dict` emits the obs/slo schema.
+    Token timestamps append from the worker thread (single producer per
+    request — list.append is atomic under the GIL)."""
+
+    def __init__(self, i: int, client: str, t_sched: float):
+        self.i = i
+        self.client = client
+        self.t_sched = t_sched   # scheduled offset (script time)
+        self.t_sub: Optional[float] = None   # actual submit offset
+        self.token_ts: List[float] = []      # commit offsets
+        self.outcome: Optional[str] = None
+        self.error: Optional[str] = None
+        self.tokens = 0
+        self.t_done: Optional[float] = None
+
+    def as_dict(self) -> dict:
+        ttft = None
+        itl: List[float] = []
+        if self.token_ts:
+            base = self.t_sub if self.t_sub is not None else self.t_sched
+            ttft = self.token_ts[0] - base
+            itl = [b - a for a, b in zip(self.token_ts,
+                                         self.token_ts[1:])]
+        d = {"i": self.i, "client": self.client,
+             "t": round(self.t_sched, 4),
+             "lag_s": (None if self.t_sub is None
+                       else round(self.t_sub - self.t_sched, 4)),
+             "outcome": self.outcome, "tokens": self.tokens,
+             "ttft_s": None if ttft is None else round(ttft, 5),
+             "itl_s": [round(x, 5) for x in itl],
+             "t_done": (None if self.t_done is None
+                        else round(self.t_done, 4))}
+        if self.error:
+            d["error"] = self.error[:160]
+        return d
+
+
+class _LocalTarget:
+    """Drive an in-process LMServer through its batcher worker."""
+
+    def __init__(self, server):
+        self.server = server
+
+    def submit(self, req, rec: _Rec, now):
+        def on_token(_tok, _rec=rec, _now=now):
+            _rec.token_ts.append(_now())
+
+        fut = self.server.worker.submit(
+            np.asarray(req.prompt, np.int32), req.max_new, req.seed,
+            opts=req.opts, on_token=on_token)
+
+        def done(f, _rec=rec, _now=now):
+            try:
+                out = f.result()
+                _rec.outcome = "ok"
+                _rec.tokens = int(np.asarray(out).size)
+            except Exception as e:  # noqa: BLE001 — explicit rejection
+                _rec.outcome = "rejected"
+                _rec.error = f"{type(e).__name__}: {e}"
+            # t_done LAST: the drain loop polls it, and a record seen
+            # resolved before its outcome landed would judge as lost
+            _rec.t_done = _now()
+        fut.add_done_callback(done)
+        return fut
+
+    def warm(self, req, deadline_s: float = 240.0):
+        """One request through the full admit/prefill/decode path before
+        the clock starts — the timed window must measure serving, not
+        XLA (every probe in this repo warms to steady state; STUDIES
+        §16's warmup-artifact post-mortem is why). Uses the script's own
+        first request so the compiled shapes match the traffic."""
+        fut = self.server.worker.submit(
+            np.asarray(req.prompt, np.int32), max(2, req.max_new // 2),
+            0, opts=req.opts)
+        fut.result(timeout=deadline_s)
+        b = getattr(self.server, "batcher", None)
+        if b is not None and getattr(b, "_prefix_cache", None) is not None:
+            # the warm request primes the prefix cache (fine — real
+            # fleets run warm) but must not inflate the REPORTED ratio
+            b.prefix_hits = b.prefix_misses = 0
+
+    def grace_s(self) -> float:
+        return float(getattr(self.server, "request_timeout", 30.0)) + 5.0
+
+    def forensics(self) -> dict:
+        srv = self.server
+        return {"stepclock": getattr(srv, "step_clock", None),
+                "goodput": getattr(srv, "goodput", None),
+                "batcher": getattr(srv, "batcher", None)}
+
+    def close(self):
+        self.server.close()
+
+
+class _GrpcTarget:
+    """Drive a live daemon / router at `address` over the wire. One
+    NodeClient + thread per request; token timestamps come from the
+    GenerateStream commits, so TTFT/ITL are wire-true."""
+
+    def __init__(self, address: str, *, timeout_s: float = 30.0):
+        self.address = address
+        self.timeout_s = timeout_s
+        self._threads: List[threading.Thread] = []
+
+    def submit(self, req, rec: _Rec, now):
+        def run():
+            # EVERYTHING inside the try — a client-construction (or
+            # import) failure must record an explicit rejection, never
+            # leave the record outcome-less to be judged silently lost
+            cl = None
+            try:
+                from dnn_tpu.comm.client import NodeClient
+
+                cl = NodeClient(self.address, transport="grpc",
+                                breaker=False)
+                opts = dict(req.opts or {})
+                if "constraint" in opts:
+                    # constraints have no wire spelling (gen options
+                    # carry scalars); a remote constrained scenario
+                    # must fail loud, not silently unconstrained
+                    raise ValueError(
+                        "constraint= requests cannot ride the gRPC "
+                        "target; run json_mode in-process")
+                n = 0
+                for _tok in cl.generate_stream(
+                        req.prompt, max_new_tokens=req.max_new,
+                        seed=req.seed, timeout=self.timeout_s, **opts):
+                    rec.token_ts.append(now())
+                    n += 1
+                rec.outcome = "ok"
+                rec.tokens = n
+            except Exception as e:  # noqa: BLE001 — explicit rejection
+                rec.outcome = "rejected"
+                rec.error = f"{type(e).__name__}: {e}"
+            finally:
+                rec.t_done = now()
+                if cl is not None:
+                    cl.close()
+
+        th = threading.Thread(target=run, daemon=True)
+        th.start()
+        self._threads.append(th)
+        return th
+
+    def grace_s(self) -> float:
+        return self.timeout_s + 5.0
+
+    def warm(self, req, deadline_s: float = 240.0):
+        """Polled first request (the fleet probe's pattern): a mid-boot
+        UNAVAILABLE is 'not yet', not 'failed'."""
+        from dnn_tpu.comm.client import NodeClient
+
+        t_end = time.monotonic() + deadline_s
+        last = "no attempt"
+        while time.monotonic() < t_end:
+            cl = NodeClient(self.address, transport="grpc",
+                            breaker=False)
+            try:
+                cl.generate(req.prompt, max_new_tokens=2, seed=0,
+                            timeout=min(120.0, deadline_s))
+                return
+            except Exception as e:  # noqa: BLE001 — still booting
+                last = f"{type(e).__name__}: {e}"
+            finally:
+                cl.close()
+            time.sleep(1.0)
+        raise RuntimeError(f"warm request never completed: {last[:200]}")
+
+    def forensics(self) -> dict:
+        return {}
+
+    def close(self):
+        for th in self._threads:
+            th.join(timeout=1.0)
+
+
+def run_scenario(scenario: Scenario, *, seed: int = 0,
+                 target: Optional[str] = None,
+                 target_obs_url: Optional[str] = None,
+                 incident_dir: Optional[str] = None) -> dict:
+    """Run one scenario end to end; returns
+
+        {"report": SLOReport, "records": [dict], "wall_s": float,
+         "bundle": path|None, "extras": {...}}
+
+    `target` (a "host:port" string) redirects the load onto a live
+    daemon or router instead of the scenario's own in-process server;
+    `target_obs_url` then lets breach forensics snapshot that process's
+    obs endpoint. The incident bundle is written whenever the verdict
+    is a breach — under `incident_dir` (default: a fresh directory in
+    $DNN_TPU_OBS_DIR / tmp) — and its path rides the result."""
+    from dnn_tpu import obs
+    from dnn_tpu.chaos import inject as chaos_inject
+
+    script = sorted(scenario.script(seed), key=lambda r: r.at)
+    if not script:
+        raise ValueError(f"scenario {scenario.name!r} produced an "
+                         "empty script")
+    own_server = target is None
+    tgt = (_LocalTarget(scenario.build_server()) if own_server
+           else _GrpcTarget(target))
+    injector = None
+    try:
+        # warm BEFORE the chaos plan installs: the injected fault
+        # schedule counts from the measured window's first step, and
+        # the warm request must pay the compiles, not the timed traffic
+        tgt.warm(script[0])
+        if scenario.chaos_plan is not None:
+            injector = chaos_inject.install(dict(scenario.chaos_plan))
+        t0 = time.monotonic()
+        t0_epoch = time.time()
+
+        def now() -> float:
+            return time.monotonic() - t0
+
+        obs.flight.record("workload_begin", scenario=scenario.name,
+                          seed=seed, requests=len(script),
+                          duration_s=scenario.duration_s,
+                          target=target or "in-process")
+        records = [_Rec(i, r.client, r.at)
+                   for i, r in enumerate(script)]
+        try:
+            for req, rec in zip(script, records):
+                while (dt := req.at - now()) > 0:
+                    time.sleep(min(dt, 0.02))
+                rec.t_sub = now()
+                try:
+                    tgt.submit(req, rec, now)
+                except Exception as e:  # noqa: BLE001 — a submit-time
+                    # crash is an explicit rejection, never a lost record
+                    rec.outcome = "rejected"
+                    rec.error = f"{type(e).__name__}: {e}"
+                    rec.t_done = now()
+
+            # drain: open-loop stops ARRIVING at duration_s; completions
+            # get the settle window beyond that. Stragglers still in
+            # flight then get the target's request-timeout grace on top
+            # — the serving stack promises EXPLICIT resolution within
+            # that bound, so only a record that outlasts it has truly
+            # violated the no-silent-loss contract (slow != lost)
+            deadline = scenario.duration_s + scenario.settle_s
+            hard = deadline + tgt.grace_s()
+            while now() < hard and any(r.t_done is None
+                                       for r in records):
+                time.sleep(0.05)
+        finally:
+            if injector is not None:
+                chaos_inject.uninstall()
+                injector = None
+
+        wall = now()
+        fx = tgt.forensics()
+        burn = None
+        if fx.get("goodput") is not None:
+            try:
+                burn = {k: round(v, 4) for k, v in
+                        fx["goodput"].burn_rates().items()}
+            except Exception:  # noqa: BLE001 — a dead tracker loses
+                burn = None    # only the rider field, never the verdict
+        rec_dicts = [r.as_dict() for r in records]
+        report = _slo.evaluate(scenario.name, rec_dicts, scenario.slo,
+                               wall_s=wall, t0_epoch=t0_epoch,
+                               burn_rates=burn)
+        obs.flight.record("workload_verdict", scenario=scenario.name,
+                          ok=report.ok, completed=report.completed,
+                          rejected=report.rejected, lost=report.lost,
+                          goodput_tps=report.goodput_tps)
+
+        extras: dict = {}
+        b = fx.get("batcher")
+        if b is not None \
+                and getattr(b, "_prefix_cache", None) is not None:
+            looked = b.prefix_hits + b.prefix_misses
+            extras["prefix_hits"] = b.prefix_hits
+            extras["prefix_misses"] = b.prefix_misses
+            extras["prefix_hit_ratio"] = round(
+                b.prefix_hits / looked, 4) if looked else 0.0
+
+        bundle = None
+        if not report.ok:
+            if incident_dir is None:
+                from dnn_tpu.obs.flight import default_dump_dir
+
+                incident_dir = os.path.join(
+                    default_dump_dir(),
+                    f"incident-{scenario.name}-{os.getpid()}-"
+                    f"{int(t0_epoch)}")
+            if target_obs_url is not None:
+                bundle = _slo.write_incident_bundle(
+                    incident_dir, report, url=target_obs_url,
+                    records=rec_dicts)
+            else:
+                bundle = _slo.write_incident_bundle(
+                    incident_dir, report,
+                    stepclock=fx.get("stepclock"), records=rec_dicts)
+    finally:
+        # a failed warm / mid-run crash must not leak the in-process
+        # server (its worker thread and obs endpoint outlive the call)
+        if injector is not None:
+            chaos_inject.uninstall()
+        tgt.close()
+    return {"report": report, "records": rec_dicts,
+            "wall_s": round(wall, 3), "bundle": bundle,
+            "extras": extras}

@@ -79,6 +79,12 @@ _MODULE_COST_S = {
     # MFU/MBU, SLO burn rates + the `obs fleet --selftest` CLI smoke):
     # cheap HTTP endpoints + one real 2-stage gRPC request, certified
     # inside the tier-1 budget ahead of the obs integration modules
+    "test_workloads": 20.0,  # ISSUE 14 SLO observatory: golden arrival
+    # schedules, scenario-script determinism, SLO-verdict arithmetic,
+    # incident-bundle roundtrip + CLI render, ledger parsing vs the
+    # real BENCH_r*.json/RESULTS.md, prefix-cache counters/gauge, one
+    # green light scenario + the chaos breach asserted from its bundle
+    # — cheap, certified early in the tier-1 budget
     "test_grad_accum": 12.9, "test_train_ckpt": 14.3, "test_remat": 14.6,
     "test_qwen2": 14.7, "test_olmo2": 14.8, "test_tp_generate": 15.6,
     "test_pipeline": 16.5, "test_seq_parallel": 17.0,
