@@ -239,6 +239,16 @@ RATCHETS: List[Ratchet] = [
             ">=",
             _t("benchmarks.fleet_serving_probe", "FLEET_SPEEDUP_FLOOR"),
             "fleet delivered tokens/sec vs the unfronted replica"),
+    # the fleet KV tier (ISSUE 15): cross-replica block reuse and the
+    # warm-vs-cold TTFT win, thresholds owned by the probe
+    Ratchet("kvtier_cross_hit_floor", "kv_tier",
+            "cross_replica_hit_ratio", ">=",
+            _t("benchmarks.kv_tier_probe", "CROSS_HIT_FLOOR"),
+            "block hits served from migrated (adopted) blocks"),
+    Ratchet("kvtier_ttft_floor", "kv_tier", "ttft_cold_over_warm",
+            ">=",
+            _t("benchmarks.kv_tier_probe", "TTFT_RATIO_FLOOR"),
+            "forced-cold over warm-turn TTFT p95 through the router"),
     # the workload suite: each scenario's SLO verdict is the assert —
     # `ok` carries it (inverted + bundle-verified for breach_chaos)
     Ratchet("workload_chat", "workload_chat", "ok", "==", _const(True),

@@ -162,6 +162,64 @@ def measure() -> dict:
     return _measure_steps(srv)
 
 
+def measure_kvtier() -> dict:
+    """obs tax on the RADIX ADMISSION path (dnn_tpu/kvtier, ISSUE 15):
+    with kv=paged + prefix_cache the per-admission bill now includes
+    the radix lookup plus its obs-gated block-granular counters
+    (prefix_blocks_reused / kvtier remote hits) and the kvtier gauges
+    in the one bulk update. This leg alternates the gate per ADMISSION
+    (submit of a store-resident prompt + cancel, the full-hit regime —
+    the worst counter-to-work ratio: near-zero prefill compute, full
+    obs bill) and holds the SAME <2% contract on the admission wall.
+    The lookup itself runs in both populations (it is serving work,
+    not obs work); the delta is exactly the observability tax."""
+    import jax
+    import numpy as np
+
+    from dnn_tpu import obs
+    from dnn_tpu.models import gpt
+    from dnn_tpu.runtime.serving import ContinuousBatcher
+
+    cfg = gpt.GPTConfig(block_size=64, vocab_size=512, n_layer=4,
+                        n_head=4, n_embd=256)
+    prepared = gpt.prepare_stacked(gpt.init(jax.random.PRNGKey(0), cfg),
+                                   cfg)
+    srv = ContinuousBatcher(cfg, prepared, slots=SLOTS,
+                            max_len=cfg.block_size, prompt_pad=16,
+                            kv="paged", block_len=16, prefix_cache=64)
+    prompt = np.arange(1, 33)  # 2 full blocks: a block-aligned FULL
+    # hit after the seeding admission (zero chunks, stored logit row)
+    was = obs.enabled()
+    obs.set_enabled(True)
+    rid = srv.submit(prompt, 2)  # seed the store (+ compile programs)
+    srv.drain()
+    srv.claim(rid)
+    n = 600
+    on_t, off_t = [], []
+    try:
+        for i in range(2 * n):
+            on = i % 2 == 0
+            obs.set_enabled(on)
+            t0 = time.perf_counter()
+            r = srv.submit(prompt, 2)
+            dt = time.perf_counter() - t0
+            (on_t if on else off_t).append(dt)
+            srv.cancel(r)
+    finally:
+        obs.set_enabled(was)
+    on_t.sort()
+    off_t.sort()
+    med_on = on_t[len(on_t) // 2]
+    med_off = off_t[len(off_t) // 2]
+    return {
+        "kvtier_admit_overhead_frac": med_on / med_off - 1.0,
+        "kvtier_admit_ms_on": round(med_on * 1e3, 4),
+        "kvtier_admit_ms_off": round(med_off * 1e3, 4),
+        "kvtier_admissions_per_population": n,
+        "kvtier_resident_blocks": srv._prefix_store.n_blocks,
+    }
+
+
 def _measure_steps(srv) -> dict:
     from dnn_tpu import obs
     from dnn_tpu.obs.timeline import StepClock
@@ -236,6 +294,16 @@ def _measure_steps(srv) -> dict:
 
 def main(argv=None) -> int:
     args = set(argv if argv is not None else sys.argv[1:])
+    if "--kvtier" in args:
+        row = measure_kvtier()
+        row["ok"] = row["kvtier_admit_overhead_frac"] < 0.02
+        print(json.dumps(row), flush=True)
+        if "--assert" in args and not row["ok"]:
+            print(f"FAIL: kvtier admission obs overhead "
+                  f"{row['kvtier_admit_overhead_frac'] * 100:.2f}% "
+                  f">= 2% budget", file=sys.stderr)
+            return 1
+        return 0
     row = measure_fleet() if "--fleet" in args else measure()
     row["ok"] = row["overhead_frac"] < 0.02
     print(json.dumps(row), flush=True)

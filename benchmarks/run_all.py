@@ -563,16 +563,25 @@ def dev_obs_overhead():
     # (benchmarks/obs_overhead_probe.py documents why coarser A/B
     # designs all produced measurement artifacts on this host). The
     # layer's contract is < 2% (ISSUE 3); `ok` records the verdict.
-    from benchmarks.obs_overhead_probe import measure
+    from benchmarks.obs_overhead_probe import measure, measure_kvtier
 
     results = []
     row = measure()
     overhead = row.pop("overhead_frac")
+    # the KV-tier admission leg (ISSUE 15): the radix lookup + its
+    # block-granular counters/gauges in the admission path, same
+    # contract — both legs must hold or the row is red
+    kv = measure_kvtier()
+    kv_overhead = kv.pop("kvtier_admit_overhead_frac")
+    row.update(kv)
+    row["kvtier_admit_overhead_pct"] = round(kv_overhead * 100, 2)
     _emit(results, config="obs_overhead", metric="overhead_pct",
           value=round(overhead * 100, 2), platform=_platform(),
-          ok=bool(overhead < 0.02),
+          ok=bool(overhead < 0.02 and kv_overhead < 0.02),
           note="serving decode step, obs on (traced) vs off, per-step "
-               "interleave; contract < 2%", **row)
+               "interleave; + kvtier radix-admission leg "
+               "(per-admission interleave); contract < 2% on both",
+          **row)
     return results
 
 
@@ -769,6 +778,45 @@ def dev_fleet_serving():
     _emit(results, config="fleet_serving",
           metric="fleet_tokens_per_sec", value=tps, ok=ok,
           note=note, **row)
+    return results
+
+
+@device_config("kv_tier")
+def dev_kv_tier():
+    # ISSUE 15: the fleet KV tier's measured contract — router + 2
+    # real paged-radix replica subprocesses under the multi-turn-chat
+    # arrival schedule with affinity deliberately broken (round-robin
+    # placement, kvtier="pull"): cross-replica block-hit ratio >= 0.5,
+    # adopted-vs-local token parity exact (greedy + seeded-sampled),
+    # warm-turn TTFT p95 >= 2x forced-cold, migrated bytes under the
+    # full-KV row-handoff baseline, and the donor-death chaos leg
+    # (lease expiry + kvtier_fallback read back from the dumped rings,
+    # zero token divergence, zero leaked blocks).
+    from benchmarks.kv_tier_probe import (
+        CROSS_HIT_FLOOR,
+        TTFT_RATIO_FLOOR,
+        measure,
+    )
+
+    results = []
+    row = measure()
+    ok = row.pop("ok")
+    require = os.environ.get("DNN_TPU_REQUIRE_SUBSTRATE")
+    note = (f"router + 2 paged-radix replicas, anti-affinity chat; "
+            f"floors: cross-replica block-hit >= {CROSS_HIT_FLOOR}, "
+            f"warm TTFT p95 >= {TTFT_RATIO_FLOOR}x vs cold, migrated "
+            "bytes < row-handoff baseline, parity exact, donor-death "
+            "leg green")
+    if require:
+        row["required_substrate"] = require
+        if row.get("round_substrate") != require:
+            ok = False
+            note += (f"; required substrate '{require}' but the probe "
+                     f"ran on '{row.get('round_substrate')}'")
+    ratio = row.pop("cross_replica_hit_ratio")
+    _emit(results, config="kv_tier",
+          metric="cross_replica_hit_ratio", value=ratio, ok=ok,
+          note=note, cross_replica_hit_ratio=ratio, **row)
     return results
 
 

@@ -39,7 +39,8 @@ from typing import Dict, List, Optional, Sequence, Set, Tuple
 from dnn_tpu.analysis.findings import Finding
 
 __all__ = ["Edge", "Machine", "MACHINES", "REPLICA", "ROUTER",
-           "check_machine", "check_machine_sites", "run_protocol_audit"]
+           "KVLEASE", "check_machine", "check_machine_sites",
+           "run_protocol_audit"]
 
 
 @dataclasses.dataclass(frozen=True)
@@ -240,8 +241,40 @@ ROUTER = Machine(
                  "router_drain", "router_stop"),
 )
 
+KVLEASE = Machine(
+    name="kvtier_lease",
+    states=("offered", "pulling", "adopted", "released", "expired"),
+    initial="offered",
+    terminal=("released",),
+    edges=(
+        # the adopter started a grpc fetch of the staged bytes
+        Edge("offered", "lease_pull", "pulling"),
+        # ingest confirmed (kvack). From `offered` directly too: the
+        # shm rung memcpys out of the published segment without ever
+        # calling kvfetch, so the first thing the donor hears is the ack
+        Edge("offered", "lease_adopt", "adopted"),
+        Edge("pulling", "lease_adopt", "adopted"),
+        # the donor frees the staging (bytes + shm segment)
+        Edge("adopted", "lease_release", "released"),
+        # TTL: the adopter died / went quiet — mark expired...
+        Edge("offered", "lease_expire", "expired"),
+        Edge("pulling", "lease_expire", "expired"),
+        # ...and RECLAIM the staged payload. expired is deliberately
+        # NON-terminal with this single exit: delete it and every
+        # abandoned migration pins its staged blocks (and shm segment)
+        # forever — "blocks leak forever" as a PRO002 model failure,
+        # pinned both directions by tests/test_kvtier.py
+        Edge("expired", "lease_reclaim", "released"),
+    ),
+    module="dnn_tpu/kvtier/migrate.py",
+    cls="Lease",
+    state_attr="state",
+    event_kinds=("lease_pull", "lease_adopt", "lease_release",
+                 "lease_expire", "lease_reclaim"),
+)
+
 MACHINES: Tuple[Machine, ...] = (BREAKER, SUPERVISOR, DRAIN,
-                                 RELAY_WINDOW, REPLICA, ROUTER)
+                                 RELAY_WINDOW, REPLICA, ROUTER, KVLEASE)
 
 
 # ----------------------------------------------------------------------

@@ -176,6 +176,22 @@ class Injector:
                 raise RuntimeError(
                     f"chaos: injected device step fault (step n={n})")
 
+    def kv_migrate(self):
+        """KV-tier migration seam (runtime/lm_server kvpull): a
+        `kv_migrate_fault` severs the pull AS IF the donor died
+        mid-migration — the adopter must take its kvtier_fallback
+        path (re-prefill loud), never adopt partial blocks. Counter-
+        positioned like step_fault for deterministic replay."""
+        n = self._tick("kv_migrate")
+        for f in self._faults:
+            if f.kind != "kv_migrate_fault" or f.at_n < 0:
+                continue
+            if f.at_n <= n < f.at_n + f.count:
+                _record("kv_migrate_fault", n=n)
+                raise ConnectionError(
+                    f"chaos: injected donor death mid-migration "
+                    f"(pull n={n})")
+
     # -- wedge (watchdog probe hook) ------------------------------------
 
     def activate_wedge(self, duration_s: Optional[float] = None):
@@ -272,6 +288,12 @@ def step_fault():
     inj = _active
     if inj is not None:
         inj.step_fault()
+
+
+def kv_migrate():
+    inj = _active
+    if inj is not None:
+        inj.kv_migrate()
 
 
 def wedge_detail() -> Optional[str]:

@@ -50,10 +50,11 @@ __all__ = ["Fault", "FaultPlan", "decide", "KINDS"]
 # process-level (driven by the harness/supervisor) vs in-process
 # (installed as an Injector) — partitioned so each consumer takes only
 # the faults it can execute
-PROCESS_KINDS = frozenset({"kill_stage", "hang_stage"})
+PROCESS_KINDS = frozenset({"kill_stage", "hang_stage", "kill_donor"})
 INPROCESS_KINDS = frozenset({
     "wedge_device", "rpc_drop", "rpc_delay", "rpc_corrupt",
     "relay_drop", "relay_corrupt", "kv_exhaust", "step_fault",
+    "kv_migrate_fault",
 })
 FILE_KINDS = frozenset({"ckpt_corrupt"})
 KINDS = PROCESS_KINDS | INPROCESS_KINDS | FILE_KINDS
@@ -163,17 +164,32 @@ def standard_plan(*, kill_target: str = "node2",
                   hang_target: str = "node1",
                   kill_at_s: float = 15.0,
                   hang_at_s: float = 40.0,
-                  hang_duration_s: float = 120.0) -> FaultPlan:
+                  hang_duration_s: float = 120.0,
+                  donor_kill_at_s: Optional[float] = None,
+                  donor_target: str = "") -> FaultPlan:
     """THE standard FaultPlan the acceptance contract names: one stage
     kill plus one injected wedge (a hang the supervisor must detect and
     recover) during an open-loop run. `hang_duration_s` outlives any
     plausible health-poll detection window, so recovery always comes
-    from the supervisor's kill+restart, never from the hang expiring."""
-    return FaultPlan(faults=(
+    from the supervisor's kill+restart, never from the hang expiring.
+
+    `donor_kill_at_s` (the KV-tier leg, dnn_tpu/kvtier) appends a
+    `kill_donor` fault: the harness SIGKILLs the replica currently
+    acting as a block-migration DONOR at that offset — mid-migration
+    by construction when the driver times it inside a pull window.
+    The asserted outcome (kv_tier probe / tests/test_kvtier.py): the
+    donor's lease expires, the adopter re-prefills via its
+    `kvtier_fallback` path with ZERO token divergence, and the pool
+    high-water returns to baseline (zero leaked blocks)."""
+    faults = [
         Fault(kind="kill_stage", target=kill_target, at_s=kill_at_s),
         Fault(kind="hang_stage", target=hang_target, at_s=hang_at_s,
               duration_s=hang_duration_s),
-    ))
+    ]
+    if donor_kill_at_s is not None:
+        faults.append(Fault(kind="kill_donor", target=donor_target,
+                            at_s=float(donor_kill_at_s)))
+    return FaultPlan(faults=tuple(faults))
 
 
 __all__ += ["standard_plan", "PROCESS_KINDS", "INPROCESS_KINDS",

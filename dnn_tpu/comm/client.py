@@ -228,7 +228,8 @@ class NodeClient:
                 f"{transport!r}")
         self.address = address
         self.transport = transport
-        self._channel = grpc.insecure_channel(address)
+        self._channel = grpc.insecure_channel(
+            address, options=_tx.GRPC_MSG_OPTIONS)
         self._chan_lock = threading.Lock()
         self._conn_fail_streak = 0
         self._last_rebuild = 0.0
@@ -271,7 +272,7 @@ class NodeClient:
                 return
             self._last_rebuild = now
             old, self._channel = self._channel, grpc.insecure_channel(
-                self.address)
+                self.address, options=_tx.GRPC_MSG_OPTIONS)
             self._conn_fail_streak = 0
             self.channel_rebuilds += 1
         try:
@@ -754,6 +755,70 @@ class NodeClient:
             np.asarray(payload, np.uint8).reshape(-1),
             request_id=f"kvput:{key}", timeout=timeout,
         )
+        return status
+
+    # -- fleet KV tier (dnn_tpu/kvtier): block-granular migration -------
+
+    def kv_stage(self, prompt_ids, *, timeout: float = 60.0) -> str:
+        """Ask a replica to prefill these tokens' full blocks straight
+        into its radix prefix store (no decode slot held) — the
+        prefill half of disaggregated BLOCK handoff. Returns the
+        status line (stage stats as JSON suffix)."""
+        status, _ = self.send_tensor(
+            np.asarray(prompt_ids, np.int32).reshape(-1),
+            request_id="kvstage", timeout=timeout)
+        return status
+
+    def kv_lease(self, prompt_ids, *, timeout: float = 30.0) -> dict:
+        """Donor side of a block pull: lease the longest resident
+        block run for these tokens. Returns the offer meta — {lease,
+        bytes, blocks, n_tokens, shm?, nonce?} (kvtier/migrate.py)."""
+        import json as _json
+
+        status, result = self.send_tensor(
+            np.asarray(prompt_ids, np.int32).reshape(-1),
+            request_id="kvlease", timeout=timeout)
+        if result is None:
+            raise RuntimeError(f"kvlease returned no meta: {status}")
+        return _json.loads(np.asarray(result, np.uint8).tobytes())
+
+    def kv_fetch(self, lease_id: str, *, timeout: float = 30.0
+                 ) -> np.ndarray:
+        """grpc rung of a block pull: the staged payload bytes for a
+        lease. NOT_FOUND (raised as RpcError) = expired; the caller
+        re-prefills."""
+        status, result = self.send_tensor(
+            np.zeros((1,), np.int32),
+            request_id=f"kvfetch:{lease_id}", timeout=timeout)
+        if result is None:
+            raise RuntimeError(f"kvfetch returned no payload: {status}")
+        return np.asarray(result, np.uint8)
+
+    def kv_ack(self, lease_id: str, *, timeout: float = 10.0) -> str:
+        """Confirm ingest of a pulled lease so the donor releases its
+        staging NOW instead of waiting out the TTL."""
+        status, _ = self.send_tensor(
+            np.zeros((1,), np.int32),
+            request_id=f"kvack:{lease_id}", timeout=timeout)
+        return status
+
+    def kv_pull_from(self, donor_address: str, prompt_ids, *,
+                     timeout: float = 60.0) -> str:
+        """Instruct THIS replica to pull the prefix's blocks from
+        `donor_address` and adopt them (the router's migration
+        instruction). Advisory: a failed pull answers a
+        kvtier_fallback status, never an error — the follow-up
+        generate re-prefills."""
+        import json as _json
+
+        spec = _json.dumps({
+            "donor": donor_address,
+            "tokens": [int(x) for x in
+                       np.asarray(prompt_ids, np.int32).reshape(-1)],
+        }).encode()
+        status, _ = self.send_tensor(
+            np.frombuffer(spec, np.uint8),
+            request_id="kvpull", timeout=timeout)
         return status
 
     def send_tensor_stream(self, arr, *, request_id: str,

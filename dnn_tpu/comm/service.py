@@ -869,7 +869,7 @@ async def serve_stage(engine, node_id: str, *, port: Optional[int] = None,
     the LM daemon's step loop) — over stdlib HTTP."""
     obs.install_compile_telemetry()
     servicer = StageServer(engine, node_id, transport=transport)
-    server = grpc.aio.server()
+    server = grpc.aio.server(options=_tx.GRPC_MSG_OPTIONS)
     server.add_generic_rpc_handlers((_handlers(servicer),))
     bind_port = _resolve_port(servicer, node_id, port)
     listen = f"[::]:{bind_port}"
@@ -919,7 +919,7 @@ def start_stage_server_in_background(engine, node_id: str, *,
         # inside this thread's loop, not the caller's.
         try:
             servicer = StageServer(engine, node_id, transport=transport)
-            server = grpc.aio.server()
+            server = grpc.aio.server(options=_tx.GRPC_MSG_OPTIONS)
             server.add_generic_rpc_handlers((_handlers(servicer),))
             bind_port = _resolve_port(servicer, node_id, port)
             if server.add_insecure_port(f"[::]:{bind_port}") == 0:

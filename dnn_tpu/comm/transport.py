@@ -64,6 +64,19 @@ log = logging.getLogger("dnn_tpu.comm")
 
 TRANSPORTS = ("auto", "grpc", "shm", "device")
 
+#: channel/server options every dnn_tpu gRPC endpoint shares: the
+#: stock 4 MB message cap silently breaks KV-sized unary payloads —
+#: a gpt2 row handoff (control/handoff.py) packs ~7 MB, and kvtier
+#: block payloads (kvtier/migrate.py) scale with prefix length — so
+#: both sides raise it to one bound, high enough for any single
+#: tensor the serving stack ships, low enough to still catch runaway
+#: frames. (The streamed relay chunks its frames and never needed
+#: this; unary KV tensors cannot chunk.)
+GRPC_MSG_OPTIONS = [
+    ("grpc.max_receive_message_length", 64 * 1024 * 1024),
+    ("grpc.max_send_message_length", 64 * 1024 * 1024),
+]
+
 # The negotiation side-channel rides SendMessage with this sender_id
 # prefix; every dnn_tpu server (stage + LM daemon) routes it to
 # answer_hello / decline_hello instead of its normal text handling.

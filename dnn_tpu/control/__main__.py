@@ -70,6 +70,11 @@ def build_parser() -> argparse.ArgumentParser:
                         "worst SLO burn rate reaches this (needs the "
                         "replicas to run --slo_* objectives)")
     p.add_argument("--default_deadline_s", type=float, default=30.0)
+    p.add_argument("--kvtier", choices=["auto", "pull", "off"],
+                   default="auto",
+                   help="prefix-aware placement over the fleet KV "
+                        "tier (dnn_tpu/kvtier); 'off' = dedup-key "
+                        "affinity only")
     p.add_argument("--replica_arg", action="append", default=None,
                    help="extra argv token passed to every replica "
                         "child (repeatable), e.g. "
@@ -123,7 +128,7 @@ def main(argv=None) -> int:
         try:
             rc = asyncio.run(serve_router(
                 rset, port=args.port, metrics_port=args.metrics_port,
-                policy=args.policy,
+                policy=args.policy, kvtier=args.kvtier,
                 max_inflight_per_replica=args.max_inflight,
                 shed_burn=args.shed_burn,
                 default_deadline_s=args.default_deadline_s))

@@ -56,6 +56,12 @@ class ReplicaView:
     inter_token_p99_ms: Optional[float] = None
     tokens_per_sec: Optional[float] = None
     burn: Optional[Dict[str, float]] = None
+    # KV-tier residency (dnn_tpu/kvtier): non-None iff the replica
+    # exports dnn_tpu_kvtier_blocks — i.e. it actually serves the
+    # radix store. The router's prefix-aware placement and pull
+    # instructions gate on this: preferring a "holder" (or pulling
+    # onto a target) with no tier is pure loss.
+    kvtier_blocks: Optional[float] = None
 
     @property
     def burn_max(self) -> Optional[float]:

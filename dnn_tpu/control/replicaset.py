@@ -316,6 +316,7 @@ class ReplicaSet:
                 inter_token_p99_ms=row.get("inter_token_p99_ms"),
                 tokens_per_sec=row.get("tokens_per_sec"),
                 burn=row.get("slo_burn"),
+                kvtier_blocks=row.get("kvtier_blocks"),
             ))
         return out
 

@@ -59,6 +59,7 @@ from collections import OrderedDict
 from typing import Dict, Optional, Set
 
 import grpc
+import numpy as np
 
 from dnn_tpu import obs
 from dnn_tpu.comm import transport as _tx
@@ -137,10 +138,34 @@ class Router:
                  retry_siblings: int = 2,
                  disagg: str = "auto",
                  slots_hint: int = 4,
-                 affinity_cap: int = 4096):
+                 affinity_cap: int = 4096,
+                 kvtier: str = "auto",
+                 kv_block_len: int = 16,
+                 kv_pull_timeout_s: float = 10.0):
         if disagg not in ("auto", "on", "off"):
             raise ValueError(
                 f"disagg must be auto|on|off, got {disagg!r}")
+        # fleet KV tier (dnn_tpu/kvtier): prefix-aware placement.
+        #   "auto" — route a gen request to the replica the directory
+        #     says holds its deepest prefix (when routable); otherwise
+        #     pick by policy and INSTRUCT A PULL from the holder —
+        #     affinity stops being a cache-correctness constraint;
+        #   "pull" — never prefer the holder (the policy alone places),
+        #     always instruct pulls — the migration-stress mode the
+        #     kv_tier probe measures cross-replica hits under;
+        #   "off"  — PR 12 behavior (dedup-key affinity only).
+        if kvtier not in ("auto", "pull", "off"):
+            raise ValueError(
+                f"kvtier must be auto|pull|off, got {kvtier!r}")
+        self._kvtier = kvtier
+        self._kvdir = None
+        self.kv_pull_timeout_s = float(kv_pull_timeout_s)
+        self._kv_on_names: Set[str] = set()
+        self._kv_on_ts = 0.0
+        if kvtier != "off":
+            from dnn_tpu.kvtier.directory import PrefixDirectory
+
+            self._kvdir = PrefixDirectory(kv_block_len)
         self.replicaset = replicaset
         self.policy: Policy = policy if isinstance(policy, Policy) \
             else get_policy(policy)
@@ -303,6 +328,84 @@ class Router:
         return max(inbound if inbound is not None
                    else self.default_deadline_s, 0.001)
 
+    # -- prefix-aware placement (dnn_tpu/kvtier) ------------------------
+
+    def _kv_is_gen(self, rid: str, arr, need: str) -> bool:
+        """Whether this request participates in prefix-aware placement
+        (KV tier on, a decode-role gen forward with real tokens)."""
+        if self._kvdir is None or need != "decode" or arr is None:
+            return False
+        rid_clean = _tx.strip_deadline(obs.strip_wire_tag(rid))
+        return rid_clean.split(":")[0] == "gen"
+
+    def _kv_replica_on(self, name: str) -> bool:
+        """Scrape-evidenced: the replica exports kvtier residency, so
+        it actually serves the radix store. Preferring a 'holder' (or
+        instructing a pull onto a target) with no tier is pure loss —
+        on a dense fleet the directory must never steer placement.
+        Cached ~1 s: this runs up to twice per request and the views
+        walk behind it costs a fleet-snapshot build."""
+        now = time.monotonic()
+        if now - self._kv_on_ts > 1.0:
+            self._kv_on_names = {
+                v.name for v in self._views()
+                if v.kvtier_blocks is not None}
+            self._kv_on_ts = now
+        return name in self._kv_on_names
+
+    def _kv_locate(self, rid: str, arr, need: str):
+        """-> (prefer_replica or None, PrefixLocation or None) for a
+        gen request when the KV tier is on. "auto" prefers the holder
+        (placement follows the blocks); "pull" never does (placement
+        follows the policy, the blocks follow the placement)."""
+        if not self._kv_is_gen(rid, arr, need):
+            return None, None
+        loc = self._kvdir.locate(arr)
+        if loc is None:
+            return None, None
+        prefer = (loc.replica if self._kvtier == "auto"
+                  and self._kv_replica_on(loc.replica) else None)
+        return prefer, loc
+
+    async def _kv_maybe_pull(self, target: ReplicaHandle, arr, loc,
+                             remaining: float):
+        """Instruct `target` to pull `loc`'s blocks from their holder
+        before the gen forward lands — ADVISORY end to end: a failed
+        pull only costs the optimization (the replica re-prefills),
+        recorded loud either way."""
+        if loc is None or loc.replica == target.name:
+            return
+        donor = self.replicaset.replicas.get(loc.replica)
+        if donor is None or donor.state not in ("serving", "draining"):
+            return
+        if not self._kv_replica_on(target.name):
+            # the target has no radix store — a pull could only fail
+            # (and on a dense fleet this path must cost nothing)
+            return
+        m = obs.metrics()
+        try:
+            with self._track(target.name):
+                status = await asyncio.to_thread(
+                    self._client(target).kv_pull_from, donor.address,
+                    np.asarray(arr, np.int32)[
+                        : loc.n_blocks * self._kvdir.block_len],
+                    timeout=max(min(remaining,
+                                    self.kv_pull_timeout_s), 0.5))
+            if m is not None:
+                m.inc("dnn_tpu_router_kvtier_pulls_total")
+            if "kvtier_fallback" in (status or ""):
+                obs.flight.record("kvtier_pull_fallback",
+                                  target=target.name, donor=donor.name,
+                                  detail=str(status)[:160])
+        except Exception as e:  # noqa: BLE001 — advisory by contract
+            obs.flight.record("kvtier_pull_failed", target=target.name,
+                              donor=loc.replica,
+                              error=f"{type(e).__name__}: {e}"[:160])
+
+    def _kv_observe(self, arr, replica_name: str):
+        if self._kvdir is not None and arr is not None:
+            self._kvdir.observe(arr, replica_name)
+
     def _wants_disagg(self, rid_clean: str) -> bool:
         """gen requests take the prefill->decode handoff — except when
         the client already carries a handle (`h=`), or rides a LoRA
@@ -318,10 +421,15 @@ class Router:
     # -- admission + pick ----------------------------------------------
 
     def _admit(self, need: str, sticky: Optional[str],
-               excluded: Set[str]) -> ReplicaHandle:
+               excluded: Set[str],
+               prefer: Optional[str] = None) -> ReplicaHandle:
         """One admission decision: shed (raises _Shed) or the picked
         replica handle. Policy sees only routable candidates (serving,
-        role-compatible, not excluded, below the inflight bound)."""
+        role-compatible, not excluded, below the inflight bound).
+        `prefer` (prefix-aware placement, dnn_tpu/kvtier): route to
+        this replica when it is routable — the directory says it holds
+        the request's prefix blocks; overridden by dedup-key affinity
+        (a `d=` join MUST land where the original runs)."""
         cands = [v for v in self._views()
                  if v.state == "serving" and v.name not in excluded
                  and _role_ok(v.role, need)]
@@ -337,6 +445,11 @@ class Router:
             if bound in names:
                 pick = bound
                 self._affinity.move_to_end(sticky)
+        if pick is None and prefer is not None and prefer in names:
+            m = obs.metrics()
+            if m is not None:
+                m.inc("dnn_tpu_router_kvtier_route_hits_total")
+            pick = prefer
         if pick is None:
             pick = self.policy.pick(routable).name
             if sticky is not None:
@@ -381,6 +494,9 @@ class Router:
         excluded: Set[str] = set()
         attempts = self.retry_siblings + 1
         last = "no replica attempted"
+        kv_gen = self._kv_is_gen(rid, arr, need)
+        kv_prefer, kv_loc = self._kv_locate(rid, arr, need) if kv_gen \
+            else (None, None)
 
         def _revert_to_plain():
             # fall back LOUD to plain decode-side prefill — same
@@ -408,12 +524,20 @@ class Router:
                 # the ordinary pick on the next attempt
             else:
                 try:
-                    target = self._admit(need, sticky, excluded)
+                    target = self._admit(need, sticky, excluded,
+                                         prefer=kv_prefer)
                 except _Shed as s:
                     self._note_shed(s.args[0])
                     await context.abort(
                         grpc.StatusCode.UNAVAILABLE,
                         f"router shedding: {s.args[0]}")
+            if kv_loc is not None and target.name != kv_loc.replica:
+                # placement went somewhere the blocks are NOT (holder
+                # saturated/dead on "auto", policy pick on "pull"):
+                # instruct the migration before the forward, once
+                await self._kv_maybe_pull(target, arr, kv_loc,
+                                          remaining)
+                kv_loc = None
             client = self._client(target)
             try:
                 with self._track(target.name):
@@ -421,6 +545,10 @@ class Router:
                         client.send_tensor, arr, request_id=rid,
                         timeout=max(remaining, 0.001), retries=0)
                 self._count("ok")
+                if kv_gen:
+                    # feed the directory: this replica now holds the
+                    # prompt's blocks (admission inserted the path)
+                    self._kv_observe(arr, target.name)
                 if result is None:
                     return wc.TensorResponse(status=status)
                 return wc.TensorResponse(
@@ -481,14 +609,82 @@ class Router:
 
     # -- disaggregated prefill/decode ----------------------------------
 
+    async def _disagg_blocks(self, arr, rid: str, context,
+                             budget: float):
+        """Block-migration disaggregation (dnn_tpu/kvtier): the
+        prefill replica STAGES the prompt's blocks into its radix
+        store, the decode replica PULLS them over the lease rungs, and
+        the generate forwards PLAIN — admission adopts the blocks from
+        its own store, no single-use handle, and a warm decode replica
+        pulls only what it is missing (zero bytes for a shared system
+        prompt it has seen before — the thing the packed-row handoff
+        re-shipped on every request). Returns the response, or None to
+        fall back to the row-pack handoff (recorded loud). _Shed
+        propagates to the caller's abort."""
+        # precondition, SILENT: without scrape evidence of a radix
+        # store on a serving prefill-capable replica, this fleet is a
+        # PR 12 row-handoff fleet — skipping without a flight event
+        # per request (not a failure, just not applicable)
+        if not any(v.state == "serving"
+                   and v.kvtier_blocks is not None
+                   and _role_ok(v.role, "prefill")
+                   for v in self._views()):
+            return None
+        m = obs.metrics()
+        try:
+            pre = self._admit("prefill", None, set())
+            t_h = time.perf_counter()
+            with self._track(pre.name):
+                await asyncio.to_thread(
+                    self._client(pre).kv_stage, arr,
+                    timeout=max(budget / 2, 1.0))
+            dec = self._admit("decode", _affinity_key(rid), set())
+            with self._track(dec.name):
+                pull_status = await asyncio.to_thread(
+                    self._client(dec).kv_pull_from, pre.address, arr,
+                    timeout=max(budget / 2, 1.0))
+            if "kvtier_fallback" in (pull_status or ""):
+                raise RuntimeError(
+                    f"pull degraded: {str(pull_status)[:160]}")
+            dt = time.perf_counter() - t_h
+            if m is not None:
+                m.observe("dnn_tpu_router_handoff_seconds", dt)
+                m.inc("dnn_tpu_router_kvtier_pulls_total")
+            obs.flight.record("kv_handoff", prefill=pre.name,
+                              decode=dec.name, mode="blocks",
+                              ms=round(dt * 1e3, 2))
+            self._kv_observe(arr, dec.name)
+        except _Shed:
+            raise
+        except Exception as e:  # noqa: BLE001 — ANY block-leg failure
+            # degrades to the row-pack handoff, recorded loud
+            if m is not None:
+                m.inc("dnn_tpu_kvtier_fallback_total")
+            obs.flight.record("kvtier_fallback",
+                              error=f"{type(e).__name__}: {e}"[:200])
+            return None
+        return await self._forward_unary(arr, rid, context, pinned=dec)
+
     async def _forward_disagg(self, arr, rid: str, context):
         """gen request on a role-split fleet: prefill replica computes
         the KV, decode replica adopts it, generate forwards with the
-        handle. Any handoff-leg failure falls back LOUD (flight event
-        + counter) to plain decode-side prefill — availability beats
-        disaggregation."""
+        handle. When the KV tier is live the BLOCK-migration path runs
+        first (stage + pull — kvtier/migrate.py) and the packed-row
+        handoff is its fallback. Any handoff-leg failure falls back
+        LOUD (flight event + counter) to plain decode-side prefill —
+        availability beats disaggregation."""
         m = obs.metrics()
         budget = self._budget(rid)
+        if self._kvdir is not None:
+            try:
+                resp = await self._disagg_blocks(arr, rid, context,
+                                                 budget)
+            except _Shed as s:
+                self._note_shed(s.args[0])
+                await context.abort(grpc.StatusCode.UNAVAILABLE,
+                                    f"router shedding: {s.args[0]}")
+            if resp is not None:
+                return resp
         try:
             pre = self._admit("prefill", None, set())
             t_h = time.perf_counter()
@@ -576,12 +772,20 @@ class Router:
             await context.abort(grpc.StatusCode.DATA_LOSS, str(e))
         rid = request.request_id or ""
         budget = self._budget(rid)
+        kv_gen = self._kv_is_gen(rid, arr, "decode")
+        kv_prefer, kv_loc = self._kv_locate(rid, arr, "decode") \
+            if kv_gen else (None, None)
         try:
-            target = self._admit("decode", _affinity_key(rid), set())
+            target = self._admit("decode", _affinity_key(rid), set(),
+                                 prefer=kv_prefer)
         except _Shed as s:
             self._note_shed(s.args[0])
             await context.abort(grpc.StatusCode.UNAVAILABLE,
                                 f"router shedding: {s.args[0]}")
+        if kv_loc is not None and target.name != kv_loc.replica:
+            await self._kv_maybe_pull(target, arr, kv_loc, budget)
+        if kv_gen:
+            self._kv_observe(arr, target.name)
         client = self._client(target)
         loop = asyncio.get_running_loop()
         q: "asyncio.Queue" = asyncio.Queue()
@@ -708,7 +912,7 @@ async def serve_router(replicaset: ReplicaSet, *, port: int,
             fleet=replicaset.collector,
             healthy=lambda: not router._draining
             and bool(replicaset.serving()))
-    server = grpc.aio.server()
+    server = grpc.aio.server(options=_tx.GRPC_MSG_OPTIONS)
     server.add_generic_rpc_handlers((_handlers(router),))
     if server.add_insecure_port(f"[::]:{port}") == 0:
         raise RuntimeError(f"failed to bind router to [::]:{port}")
@@ -764,7 +968,7 @@ def start_router_in_background(replicaset: ReplicaSet, *, port: int,
     async def _run():
         try:
             router = Router(replicaset, **router_kwargs)
-            server = grpc.aio.server()
+            server = grpc.aio.server(options=_tx.GRPC_MSG_OPTIONS)
             server.add_generic_rpc_handlers((_handlers(router),))
             if server.add_insecure_port(f"[::]:{port}") == 0:
                 raise RuntimeError(f"failed to bind router to :{port}")

@@ -1,0 +1,102 @@
+"""PrefixDirectory: which replica holds which prefix.
+
+The router's bounded, observation-fed map from block-aligned prefix
+DIGESTS to the replica last seen holding (or being handed) those
+blocks. Fed two ways:
+
+  * admission observation — after routing a gen request (or a pull
+    instruction) to replica R, every block-aligned prefix of its
+    prompt is recorded as resident on R (the radix store inserts
+    exactly those paths at admission, and retire-time insertion only
+    extends them);
+  * replica scrape — /statusz carries per-replica kvtier residency
+    counts (obs/fleet.py), which the router uses for health, not keys:
+    shipping the actual key set per poll would be unbounded.
+
+`locate` walks a prompt's digests LONGEST-first, so the answer is the
+replica with the deepest known coverage. Entries are a bounded LRU —
+stale claims (evicted store entries, dead replicas) cost one wasted
+pull instruction, never correctness: the kvpull path is advisory end
+to end, and the adopter re-prefills on any miss.
+
+Pure stdlib — unit-tests as goldens with no jax, no grpc.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+from collections import OrderedDict
+from typing import Optional
+
+import numpy as np
+
+__all__ = ["PrefixDirectory", "PrefixLocation"]
+
+
+@dataclasses.dataclass(frozen=True)
+class PrefixLocation:
+    replica: str
+    n_blocks: int
+
+
+def _digest(tokens: np.ndarray) -> bytes:
+    return hashlib.blake2s(
+        np.ascontiguousarray(tokens, np.int32).tobytes(),
+        digest_size=16).digest()
+
+
+class PrefixDirectory:
+    """See module docstring. `cap` bounds entries (one per distinct
+    block-aligned prefix seen fleet-wide); `max_blocks` bounds the
+    per-prompt digest walk."""
+
+    def __init__(self, block_len: int = 16, *, cap: int = 8192,
+                 max_blocks: int = 64):
+        if block_len < 1:
+            raise ValueError(f"block_len must be >= 1, got {block_len}")
+        self.block_len = int(block_len)
+        self.cap = int(cap)
+        self.max_blocks = int(max_blocks)
+        self._map: "OrderedDict[bytes, PrefixLocation]" = OrderedDict()
+
+    def __len__(self) -> int:
+        return len(self._map)
+
+    def _n_full(self, tokens: np.ndarray) -> int:
+        return min(int(np.asarray(tokens).size) // self.block_len,
+                   self.max_blocks)
+
+    def observe(self, tokens, replica: str):
+        """Record every block-aligned prefix of `tokens` as resident on
+        `replica` (latest claim wins — the most recent admission/pull
+        is the best guess for where the blocks live NOW)."""
+        tokens = np.asarray(tokens, np.int32).reshape(-1)
+        bp = self.block_len
+        for k in range(1, self._n_full(tokens) + 1):
+            d = _digest(tokens[: k * bp])
+            self._map[d] = PrefixLocation(str(replica), k)
+            self._map.move_to_end(d)
+        while len(self._map) > self.cap:
+            self._map.popitem(last=False)
+
+    def locate(self, tokens) -> Optional[PrefixLocation]:
+        """The replica with the DEEPEST known coverage of `tokens`'s
+        block-aligned prefixes, or None. A hit promotes to MRU."""
+        tokens = np.asarray(tokens, np.int32).reshape(-1)
+        bp = self.block_len
+        for k in range(self._n_full(tokens), 0, -1):
+            loc = self._map.get(_digest(tokens[: k * bp]))
+            if loc is not None:
+                self._map.move_to_end(_digest(tokens[: k * bp]))
+                return PrefixLocation(loc.replica, k)
+        return None
+
+    def forget(self, replica: str) -> int:
+        """Drop every claim naming `replica` (death/teardown); returns
+        how many were dropped."""
+        dead = [d for d, loc in self._map.items()
+                if loc.replica == replica]
+        for d in dead:
+            del self._map[d]
+        return len(dead)

@@ -148,6 +148,15 @@ def build_parser() -> argparse.ArgumentParser:
                    default="least_queue",
                    help="--route: routing policy (dnn_tpu/control/"
                         "policy.py)")
+    p.add_argument("--kvtier", choices=["auto", "pull", "off"],
+                   default="auto",
+                   help="--route: prefix-aware placement over the "
+                        "fleet KV tier (dnn_tpu/kvtier) — 'auto' "
+                        "routes to the replica holding a prompt's "
+                        "prefix blocks (else instructs a pull), "
+                        "'pull' always places by policy and migrates "
+                        "the blocks, 'off' restores dedup-key "
+                        "affinity only")
     p.add_argument("--slots", type=int, default=4,
                    help="--serve_lm: concurrent decode slots in the pool")
     p.add_argument("--max_len", type=int, default=None,
@@ -189,6 +198,17 @@ def build_parser() -> argparse.ArgumentParser:
                         "runtime/paged_kvcache.py)")
     p.add_argument("--block_len", type=int, default=16,
                    help="--serve_lm: positions per paged-cache block")
+    p.add_argument("--kv_lease_ttl_s", type=float, default=30.0,
+                   help="--serve_lm: KV-tier migration lease TTL "
+                        "(dnn_tpu/kvtier): a staged block export an "
+                        "adopter never pulls/acks is reclaimed after "
+                        "this many seconds (lease_expire/lease_reclaim "
+                        "flight events)")
+    p.add_argument("--kv_handoff_ttl_s", type=float, default=120.0,
+                   help="--serve_lm: kvput inbox TTL — a staged "
+                        "prefill handoff nobody consumes is swept "
+                        "after this many seconds (kvput_expired "
+                        "flight event; <= 0 disables)")
     p.add_argument("--prefix_cache", type=int, default=0,
                    help="--serve_lm: prefix-cache capacity (LRU entries); "
                         "requests sharing a prompt prefix skip re-prefilling "
@@ -691,7 +711,10 @@ def _route(args, config, me) -> int:
     try:
         return asyncio.run(serve_router(
             rset, port=me.port, metrics_port=args.metrics_port,
-            policy=args.policy))
+            policy=args.policy, kvtier=args.kvtier,
+            # the directory must index at the REPLICAS' block
+            # granularity or locate/pull truncate at the wrong depth
+            kv_block_len=args.block_len))
     except KeyboardInterrupt:
         log.info("router shutting down")
         return 0
@@ -922,6 +945,8 @@ def _serve_lm(engine: PipelineEngine, args) -> int:
             paged_blocks=args.paged_blocks, block_len=args.block_len,
             decode_buckets=args.decode_buckets,
             weights=args.weights,
+            kv_lease_ttl_s=args.kv_lease_ttl_s,
+            kv_handoff_ttl_s=args.kv_handoff_ttl_s,
             prefill_chunk_tokens=args.prefill_chunk_tokens,
             overlap=args.overlap,
             # the daemon's clients choose options per request, so the

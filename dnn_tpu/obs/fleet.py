@@ -723,6 +723,17 @@ class FleetCollector:
             v = s.get(fam)
             if v is not None:
                 row[key] = v
+        # KV-tier series (dnn_tpu/kvtier): per-replica radix residency
+        # + prefix effectiveness — present only when the replica serves
+        # kv=paged with prefix_cache on
+        for fam, key in (
+                ("dnn_tpu_kvtier_blocks", "kvtier_blocks"),
+                ("dnn_tpu_prefix_hit_ratio", "prefix_hit_ratio"),
+                ("dnn_tpu_kvtier_remote_hit_ratio",
+                 "kvtier_remote_ratio")):
+            v = s.get(fam)
+            if v is not None:
+                row[key] = v
         sheds = s.sum("dnn_tpu_router_shed_total")
         if sheds is not None:
             row["shed_total"] = sheds
@@ -801,7 +812,8 @@ class FleetCollector:
                 m.set(labeled("dnn_tpu_fleet_stage_role", stage=name,
                               role=row["role"]), 1.0)
             for key in ("tokens_per_sec", "mfu", "mbu", "router_queue",
-                        "shed_total"):
+                        "shed_total", "kvtier_blocks",
+                        "prefix_hit_ratio", "kvtier_remote_ratio"):
                 if row.get(key) is not None:
                     m.set(labeled(f"dnn_tpu_fleet_stage_{key}",
                                   stage=name), row[key])
@@ -821,7 +833,8 @@ class FleetCollector:
         cols = [("state", 11), ("role", 8), ("tokens_per_sec", 9),
                 ("mfu", 7), ("mbu", 7), ("queue_depth", 6),
                 ("ttft_p99_ms", 12), ("inter_token_p99_ms", 13),
-                ("rpc_p99_ms", 11)]
+                ("rpc_p99_ms", 11), ("kvtier_blocks", 8),
+                ("prefix_hit_ratio", 9)]
         hdr = "stage".ljust(14) + "".join(h.rjust(w + 1)
                                           for h, w in cols)
         lines.append(hdr)

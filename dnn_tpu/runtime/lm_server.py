@@ -30,6 +30,7 @@ node.py:181 — SURVEY §3.3).
 from __future__ import annotations
 
 import asyncio
+import json
 import logging
 import queue
 import threading
@@ -225,6 +226,17 @@ class _BatcherWorker(threading.Thread):
         # waits here — retried ahead of the queue once decodes retire —
         # instead of failing its caller
         self._held = None
+        # control ops (dnn_tpu/kvtier): batcher mutations that are NOT
+        # request admissions — stage_prefix / kvtier_export /
+        # kvtier_adopt all reassign pool leaves, so they MUST run on
+        # this thread between steps (the single-producer contract the
+        # donation invariant rests on). Drained at the top of every
+        # loop iteration: a busy pool still applies a pull within one
+        # step, not only at idle.
+        self._cq: list = []
+        # periodic housekeeping hook (LMServer wires lease/handoff TTL
+        # sweeps): called once per loop iteration, rate-limited inside
+        self.tick = None
 
     def submit(self, prompt: np.ndarray, max_new: int, seed, *,
                opts=None, on_token=None, cancel_evt=None, trace=None):
@@ -269,6 +281,48 @@ class _BatcherWorker(threading.Thread):
                 # every scrape instead
                 m.set_fn("serving.queue_depth", self.q.qsize)
         return fut
+
+    def submit_control(self, fn):
+        """Queue `fn()` to run on the worker thread between steps (the
+        KV-tier seam: stage/export/adopt mutate pool state the step
+        loop owns). Returns a concurrent.futures.Future resolving to
+        fn()'s result; fails fast when the worker is dead."""
+        import concurrent.futures
+
+        fut = concurrent.futures.Future()
+        with self._lock:
+            if self._dead is not None:
+                _fail_future(fut, self._dead)
+                return fut
+            self._cq.append((fn, fut))
+        return fut
+
+    def _run_control_ops(self):
+        """Drain queued control ops — top of every loop iteration, so
+        a pull lands within one step even on a busy pool. Settles are
+        guarded (CON002): the caller may have deadline-cancelled."""
+        while True:
+            with self._lock:
+                if not self._cq:
+                    return
+                fn, fut = self._cq.pop(0)
+            try:
+                res = fn()
+            except BaseException as e:  # noqa: BLE001 — the op's error
+                # belongs to its caller, never to the serving loop
+                _fail_future(fut, e)
+            else:
+                try:
+                    fut.set_result(res)
+                except Exception:  # noqa: BLE001 — abandoned future
+                    pass
+
+    def _fail_control(self, exc):
+        """Fail every queued control op (worker death / shutdown)."""
+        with self._lock:
+            ops, self._cq = self._cq, []
+        for _fn, fut in ops:
+            _fail_future(fut, exc)
 
     def _resubmit(self, item: _QueuedRequest) -> bool:
         """Requeue a surviving item from a DEAD predecessor worker,
@@ -476,6 +530,8 @@ class _BatcherWorker(threading.Thread):
             if self._held is not None:
                 held, self._held = self._held, None
                 _fail_future(held.fut, self._dead)
+        self._fail_control(self._dead)
+        with self._lock:
             while True:
                 try:
                     _fail_future(self.q.get_nowait().fut, self._dead)
@@ -492,6 +548,11 @@ class _BatcherWorker(threading.Thread):
         with self._lock:
             if self._dead is None:
                 self._dead = RuntimeError("LM batcher worker died")
+        # control ops are replica-local pool mutations: never requeued
+        # onto a successor (its pool is fresh — a stale pull would
+        # ingest against different block ids); their callers retry
+        self._fail_control(self._dead)
+        with self._lock:
             inflight = [(rid, rec["item"])
                         for rid, rec in self._futures.items()
                         if rec.get("item") is not None]
@@ -508,6 +569,7 @@ class _BatcherWorker(threading.Thread):
         return inflight, queued
 
     def _fail_all(self, exc):
+        self._fail_control(exc)
         with self._lock:
             self._dead = exc  # submits from here on fail immediately
             failed = len(self._futures)
@@ -569,7 +631,16 @@ class _BatcherWorker(threading.Thread):
             hb = self.heartbeat
             if hb is not None:
                 hb()
+            # KV-tier control ops + housekeeping tick: between steps,
+            # on the one thread that owns the pool (one len-check /
+            # None-check each when idle)
+            if self._cq:
+                self._run_control_ops()
+            tk = self.tick
+            if tk is not None:
+                tk()
             if self._abandon:
+                self._fail_control(RuntimeError("LM server shut down"))
                 with self._lock:
                     for rec in self._futures.values():
                         rec["fut"].cancel()
@@ -589,6 +660,7 @@ class _BatcherWorker(threading.Thread):
                         if self._dead is None:
                             self._dead = DrainingError(
                                 "LM server drained and exited")
+                    self._fail_control(self._dead)
                     obs.flight.record("drain_done")
                     return
             elif b.n_active == 0 and self.q.empty() and self._held is None:
@@ -741,6 +813,8 @@ class LMServer:
                  weights: str = "f32",
                  role: str = "both",
                  kv_handoff_cap: int = 64,
+                 kv_handoff_ttl_s: float = 120.0,
+                 kv_lease_ttl_s: float = 30.0,
                  **batcher_kwargs):
         # weight-only quantized serving (ISSUE 12 satellite — the first
         # rung of ROADMAP item 4's weight-quant ladder): weights="int8"
@@ -784,10 +858,20 @@ class LMServer:
         # prefill->decode KV handoff inbox (kvput:<key> ingests, the
         # h=<key> gen option consumes exactly once): bounded LRU — an
         # orphaned handoff (router died between kvput and gen) must not
-        # hold row-cache-sized payloads forever
+        # hold row-cache-sized payloads forever. Entries are ALSO
+        # time-bounded: staged handoffs carry an ingest timestamp and
+        # the worker's housekeeping tick sweeps entries older than
+        # `kv_handoff_ttl_s` with a `kvput_expired` flight event — a
+        # cap alone let one abandoned prefill pin a row-sized payload
+        # until 63 siblings arrived to push it out (ttl <= 0 disables)
         self._kv_handoff: "dict" = {}
         self._kv_lock = threading.Lock()
         self._kv_handoff_cap = int(kv_handoff_cap)
+        self._kv_handoff_ttl_s = float(kv_handoff_ttl_s)
+        self._kv_lease_ttl_s = float(kv_lease_ttl_s)
+        self._kvtier_leases = None  # built after the batcher (kvtier
+        # endpoints exist only when the radix store is on)
+        self._hk_last = 0.0
         self.on_wedged = on_wedged
         self.worker_restarts = int(worker_restarts)
         self.max_request_retries = int(max_request_retries)
@@ -853,6 +937,15 @@ class LMServer:
                 draft_cfg=draft_cfg, draft_prepared=draft_prepared,
                 spec_k=spec_k, compile_cache_budget=compile_cache_budget,
                 **batcher_kwargs)
+            if getattr(self.batcher, "_prefix_store", None) is not None:
+                # fleet KV tier live on this replica: donor-side lease
+                # staging (kvlease/kvfetch/kvack — kvtier/migrate.py)
+                from dnn_tpu.kvtier.migrate import LeaseTable
+
+                self._kvtier_leases = LeaseTable(ttl_s=kv_lease_ttl_s)
+            # housekeeping rides the worker loop (lease TTL + kvput
+            # inbox TTL), rate-limited inside the tick
+            self.worker.tick = self._housekeeping_tick
         except BaseException:
             # a failed construction (bad batcher kwargs) must release the
             # already-bound endpoint, or a retry hits EADDRINUSE forever
@@ -998,6 +1091,20 @@ class LMServer:
         else:
             s = dict(s)
         s["role"] = self.role
+        if self._kvtier_on():
+            # KV-tier residency rides /statusz (informational): the
+            # FleetCollector's per-replica rows read it next to role
+            st = self.batcher._prefix_store
+            comps = dict(s.get("components") or {})
+            comps["kvtier"] = {
+                "state": "ok",
+                "detail": (f"resident_blocks={st.n_blocks} "
+                           f"block_hits={st.block_hits} "
+                           f"remote_hits={st.remote_block_hits} "
+                           f"leases={self._kvtier_leases.n_leases}"),
+                "kvtier_blocks": st.n_blocks,
+            }
+            s["components"] = comps
         if not self._draining:
             return s
         comps = dict(s.get("components") or {})
@@ -1553,8 +1660,9 @@ class LMServer:
                 f"handoff geometry mismatch (theirs, mine): {diff} — "
                 "prefill and decode replicas must share model config, "
                 "max_len, prompt_pad and kv dtype")
+        self._sweep_kv_handoffs()
         with self._kv_lock:
-            self._kv_handoff[key] = payload
+            self._kv_handoff[key] = (payload, time.monotonic())
             while len(self._kv_handoff) > self._kv_handoff_cap:
                 self._kv_handoff.pop(next(iter(self._kv_handoff)))
         obs.flight.record("kv_staged", key=key[:80],
@@ -1562,6 +1670,189 @@ class LMServer:
         return wc.TensorResponse(
             status=f"[lm] ok: kv handle {key!r} staged "
                    f"({payload['prompt_len']} prompt positions)")
+
+    def _sweep_kv_handoffs(self, now: Optional[float] = None):
+        """TTL sweep over the kvput inbox: staged handoffs are single-
+        use and were previously unbounded-LIFETIME until collected — an
+        abandoned prefill (router death between kvput and generate)
+        pinned its row-sized payload until cap pressure pushed it out.
+        Swept from the worker's housekeeping tick AND on every ingest;
+        each expiry is a `kvput_expired` flight event."""
+        ttl = self._kv_handoff_ttl_s
+        if ttl <= 0:
+            return
+        now = time.monotonic() if now is None else now
+        expired = []
+        with self._kv_lock:
+            for k in list(self._kv_handoff):
+                payload, t0 = self._kv_handoff[k]
+                if now - t0 > ttl:
+                    expired.append((k, payload.get("prompt_len")))
+                    del self._kv_handoff[k]
+        if expired:
+            m = obs.metrics()
+            for k, plen in expired:
+                if m is not None:
+                    m.inc("serving.kvput_expired_total")
+                obs.flight.record("kvput_expired", key=str(k)[:80],
+                                  prompt_len=plen, ttl_s=ttl)
+
+    def _housekeeping_tick(self):
+        """Worker-loop housekeeping (rate-limited to ~1 Hz so the hot
+        loop pays one float compare): kvput inbox TTL + kvtier lease
+        TTL sweeps."""
+        now = time.monotonic()
+        if now - self._hk_last < 1.0:
+            return
+        self._hk_last = now
+        self._sweep_kv_handoffs(now)
+        if self._kvtier_leases is not None:
+            self._kvtier_leases.sweep()
+
+    # -- fleet KV tier endpoints (dnn_tpu/kvtier) -----------------------
+
+    def _kvtier_on(self) -> bool:
+        return getattr(self.batcher, "_prefix_store", None) is not None
+
+    async def _kvtier_require(self, context):
+        if not self._kvtier_on():
+            await context.abort(
+                grpc.StatusCode.INVALID_ARGUMENT,
+                "the KV tier is off on this replica: serve with "
+                "kv=paged (or paged_blocks>0) and prefix_cache>0")
+
+    async def _kvtier_stage(self, request, context):
+        """kvstage: prefill these tokens' full blocks straight into
+        the radix store (no slot, no sampling) — the prefill-replica
+        half of disaggregated block migration."""
+        await self._kvtier_require(context)
+        prompt = await self._validated_prompt(request, context)
+        fut = self.worker.submit_control(
+            lambda: self.batcher.stage_prefix(np.asarray(prompt)))
+        try:
+            stats = await asyncio.wrap_future(fut)
+        except ValueError as e:
+            await context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
+        except Exception as e:  # noqa: BLE001 — InsufficientBlocks etc:
+            # transient, the caller treats staging as advisory
+            await context.abort(grpc.StatusCode.RESOURCE_EXHAUSTED,
+                                f"{type(e).__name__}: {e}")
+        return wc.TensorResponse(
+            status="[lm] ok: kvstage " + json.dumps(stats))
+
+    async def _kvtier_lease(self, request, context):
+        """kvlease: export the longest resident block run for these
+        tokens, stage it under a TTL'd lease (kvtier/migrate.py), and
+        answer the offer meta — lease id, byte count, and the shm
+        segment + nonce when this host can publish one. The adopter
+        pulls via shm attach or kvfetch and acks via kvack."""
+        await self._kvtier_require(context)
+        prompt = await self._validated_prompt(request, context)
+        fut = self.worker.submit_control(
+            lambda: self.batcher.kvtier_export(np.asarray(prompt)))
+        try:
+            payload = await asyncio.wrap_future(fut)
+        except Exception as e:  # noqa: BLE001 — export failures are the
+            # donor's problem, reported readable
+            await context.abort(grpc.StatusCode.INTERNAL,
+                                f"{type(e).__name__}: {e}")
+        if payload is None:
+            await context.abort(
+                grpc.StatusCode.NOT_FOUND,
+                "no resident prefix blocks for these tokens")
+        from dnn_tpu.kvtier import migrate as _mig
+
+        # host-side pack is row-cache-sized — off the event loop
+        wire = await asyncio.to_thread(_mig.pack_blocks, payload)
+        meta = self._kvtier_leases.offer(wire.tobytes())
+        meta["n_tokens"] = int(np.asarray(payload["tokens"]).size)
+        meta["blocks"] = int(
+            np.asarray(payload["tokens"]).size // payload["block_len"])
+        return wc.TensorResponse(
+            status=f"[lm] ok: lease {meta['lease']} offered "
+                   f"({meta['bytes']} bytes)",
+            result_tensor=_tensor_msg(np.frombuffer(
+                json.dumps(meta).encode(), np.uint8)))
+
+    async def _kvtier_fetch(self, lease_id: str, context):
+        """kvfetch:<lease>: the grpc rung — staged bytes back to the
+        adopter. An expired/unknown lease is NOT_FOUND: the adopter
+        records kvtier_fallback and re-prefills."""
+        await self._kvtier_require(context)
+        try:
+            data = self._kvtier_leases.fetch(lease_id)
+        except KeyError:
+            await context.abort(
+                grpc.StatusCode.NOT_FOUND,
+                f"unknown or expired kvtier lease {lease_id!r}")
+        return wc.TensorResponse(
+            status=f"[lm] ok: lease {lease_id} ({len(data)} bytes)",
+            result_tensor=_tensor_msg(np.frombuffer(data, np.uint8)))
+
+    async def _kvtier_ack(self, lease_id: str, context):
+        await self._kvtier_require(context)
+        ok = self._kvtier_leases.ack(lease_id)
+        return wc.TensorResponse(
+            status=f"[lm] ok: lease {lease_id} "
+                   + ("released" if ok else "already gone"))
+
+    async def _kvtier_pull(self, request, context):
+        """kvpull: {donor, tokens} — pull the prefix's blocks FROM the
+        donor replica and adopt them locally. ADVISORY by design: any
+        failure (donor dead, lease expired, geometry mismatch, pool
+        full) answers a `kvtier_fallback` status instead of an error —
+        the follow-up generate simply re-prefills, loud in the flight
+        ring, never wrong."""
+        await self._kvtier_require(context)
+        try:
+            raw = _tensor_arr(request.tensor)
+            spec = json.loads(np.asarray(raw, np.uint8).tobytes())
+            donor = str(spec["donor"])
+            tokens = np.asarray(spec["tokens"], np.int32).reshape(-1)
+        except (PayloadCorruptError, ValueError, KeyError, TypeError):
+            await context.abort(
+                grpc.StatusCode.INVALID_ARGUMENT,
+                'kvpull expects a uint8 JSON tensor '
+                '{"donor": "host:port", "tokens": [...]}')
+
+        def _pull():
+            from dnn_tpu.comm.client import NodeClient
+            from dnn_tpu.kvtier import migrate as _mig
+
+            cl = NodeClient(donor, transport="grpc", breaker=False)
+            try:
+                return _mig.pull_blocks(cl, tokens,
+                                        timeout=self._kv_lease_ttl_s)
+            finally:
+                cl.close()
+
+        m = obs.metrics()
+        try:
+            _chaos_inject.kv_migrate()  # donor-death-mid-migration seam
+            payload = await asyncio.to_thread(_pull)
+            fut = self.worker.submit_control(
+                lambda: self.batcher.kvtier_adopt(payload))
+            n = await asyncio.wrap_future(fut)
+        except Exception as e:  # noqa: BLE001 — the whole point: a
+            # dying donor (or an expired lease) must never fail the
+            # request, only the OPTIMIZATION — loud, then re-prefill
+            if m is not None:
+                m.inc("dnn_tpu_kvtier_fallback_total")
+            obs.flight.record("kvtier_fallback", donor=donor,
+                              error=f"{type(e).__name__}: {e}"[:200])
+            return wc.TensorResponse(
+                status="[lm] kvtier_fallback: "
+                       f"{type(e).__name__}: {e}"[:240])
+        nbytes = int(payload.get("_wire_bytes", 0))
+        if m is not None and n:
+            m.inc("dnn_tpu_kvtier_migrated_blocks_total", n)
+            if nbytes:
+                m.inc("dnn_tpu_kvtier_migrated_bytes_total", nbytes)
+        obs.flight.record("kvtier_adopted", donor=donor, blocks=n,
+                          bytes=nbytes)
+        return wc.TensorResponse(
+            status=f"[lm] ok: kvpull adopted {n} blocks "
+                   f"({nbytes} bytes) from {donor}")
 
     async def _resolve_kv_handle(self, opts: dict, context):
         """Swap a parsed h=<key> option for its staged payload
@@ -1572,13 +1863,14 @@ class LMServer:
         if h is None:
             return
         with self._kv_lock:
-            payload = self._kv_handoff.pop(h, None)
-        if payload is None:
+            entry = self._kv_handoff.pop(h, None)
+        if entry is None:
             await context.abort(
                 grpc.StatusCode.INVALID_ARGUMENT,
                 f"unknown or already-consumed kv handle {h!r} "
-                "(kvput: it first; handles are single-use)")
-        opts["prefilled"] = payload
+                "(kvput: it first; handles are single-use — an expired "
+                "handle was TTL-swept, re-stage it)")
+        opts["prefilled"] = entry[0]
 
     async def SendTensor(self, request: pb.TensorRequest, context) -> pb.TensorResponse:
         rid = request.request_id or ""
@@ -1593,6 +1885,21 @@ class LMServer:
             # raw, before the vocab-range prompt validation below
             return await self._kvput(rid_clean.split(":", 1)[1],
                                      request, context)
+        # fleet KV tier (dnn_tpu/kvtier): block-granular stage / lease /
+        # fetch / ack / pull — kvpull and kvfetch/kvack carry non-token
+        # tensors, so they too dispatch before prompt validation
+        if rid_clean == "kvstage":
+            return await self._kvtier_stage(request, context)
+        if rid_clean == "kvlease":
+            return await self._kvtier_lease(request, context)
+        if rid_clean.startswith("kvfetch:"):
+            return await self._kvtier_fetch(
+                rid_clean.split(":", 1)[1], context)
+        if rid_clean.startswith("kvack:"):
+            return await self._kvtier_ack(
+                rid_clean.split(":", 1)[1], context)
+        if rid_clean == "kvpull":
+            return await self._kvtier_pull(request, context)
         prompt = await self._validated_prompt(request, context)
         if rid_clean == "embed" or rid_clean.startswith("embed:"):
             # embedding endpoint: 'embed[:mean|last]' returns the pooled
@@ -1754,6 +2061,12 @@ class LMServer:
                 prefix = (f", prefix cache: {b.prefix_hits} hits / "
                           f"{b.prefill_chunks_run} chunks run / "
                           f"{len(b._prefix_cache)} entries")
+            elif getattr(b, "_prefix_store", None) is not None:
+                s = b._prefix_store
+                prefix = (f", kvtier: {b.prefix_hits} hits / "
+                          f"{s.block_hits} block hits "
+                          f"({s.remote_block_hits} remote) / "
+                          f"{s.n_blocks} resident blocks")
             return pb.MessageReply(
                 confirmation_text=(
                     f"[lm] pool: {b.n_active}/{b.slots} slots active, "
@@ -1797,7 +2110,7 @@ async def serve_lm(cfg, prepared, *, port: int, **server_kwargs) -> int:
     import signal
 
     servicer = LMServer(cfg, prepared, **server_kwargs)
-    server = grpc.aio.server()
+    server = grpc.aio.server(options=_tx.GRPC_MSG_OPTIONS)
     server.add_generic_rpc_handlers((_handlers(servicer),))
     listen = f"[::]:{port}"
     if server.add_insecure_port(listen) == 0:
@@ -1883,7 +2196,7 @@ def start_lm_server_in_background(cfg, prepared, *, port: int, **server_kwargs):
     async def _run():
         try:
             servicer = LMServer(cfg, prepared, **server_kwargs)
-            server = grpc.aio.server()
+            server = grpc.aio.server(options=_tx.GRPC_MSG_OPTIONS)
             server.add_generic_rpc_handlers((_handlers(servicer),))
             if server.add_insecure_port(f"[::]:{port}") == 0:
                 servicer.close()

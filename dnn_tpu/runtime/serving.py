@@ -635,22 +635,41 @@ class ContinuousBatcher:
         self.finish_reasons: Dict[int, str] = {}
         self.token_logprobs: Dict[int, dict] = {}
 
-        # prefix cache (`prefix_cache` = LRU entry count; 0 disables):
-        # requests sharing a prompt prefix (system prompts) skip
-        # re-prefilling identical chunks. Keyed by the TOKEN BYTES of every
-        # completed full-chunk boundary (K/V at a position depends on all
-        # tokens before it, so only whole prefixes are reusable); the value
-        # is a COPY of the transient row cache after that chunk plus the
-        # chunk's last logit row (enough to sample the first token when
-        # the whole prompt hits). Copies are mandatory — the live row is
-        # donated through the chunk loop. Memory per entry = one row cache
-        # (L, 1, H, row_len, D) x2 in the cache dtype; size the capacity
-        # to HBM. Same three compiled programs: hits/puts are host
-        # bookkeeping + device-to-device copies, never new jit shapes.
+        # prefix cache (`prefix_cache` = capacity; 0 disables). Two
+        # implementations by cache layout:
+        #   * DENSE pools: the legacy exact-prefix LRU (OrderedDict
+        #     keyed on the token bytes of every completed full-chunk
+        #     boundary; values are COPIES of the transient row — the
+        #     live row is donated through the chunk loop);
+        #   * PAGED pools: the RADIX prefix store (dnn_tpu/kvtier) — a
+        #     trie over block_len token chunks mapped onto the shared
+        #     BlockAllocator. Longest-prefix-match returns a run of
+        #     refcounted physical blocks (copy-free sharing), mid-block
+        #     divergence copy-on-writes ONLY the boundary block, and
+        #     eviction is leaf-LRU under refcount protection; capacity
+        #     counts resident BLOCKS. The store is also the fleet
+        #     tier's substrate: stage_prefix/kvtier_export/kvtier_adopt
+        #     below move its blocks between replicas (kvtier/migrate).
+        # Either way: same compiled admission programs — hits/puts are
+        # host bookkeeping + block-sized device work, never new shapes.
+        # LoRA caveat (paged): radix entries are base-model KV and the
+        # trie has no adapter axis, so adapted submissions on a PAGED
+        # pool run UNCACHED (full prefill every time) — a documented
+        # regression vs the removed per-adapter paged LRU; adapter-
+        # heavy prefix workloads should serve dense pools, whose LRU
+        # still keys by (adapter, tokens).
         from collections import OrderedDict
 
-        self._prefix_cache: "Optional[OrderedDict]" = (
-            OrderedDict() if prefix_cache > 0 else None)
+        self._prefix_store = None
+        self._prefix_cache: "Optional[OrderedDict]" = None
+        if prefix_cache > 0:
+            if self._paged:
+                from dnn_tpu.kvtier.store import PrefixStore
+
+                self._prefix_store = PrefixStore(
+                    self._allocator, self._block_len, prefix_cache)
+            else:
+                self._prefix_cache = OrderedDict()
         self._prefix_cap = prefix_cache
         self.prefix_hits = 0       # submissions that reused >= 1 chunk
         self.prefix_misses = 0     # lookups that reused nothing (the
@@ -659,12 +678,21 @@ class ContinuousBatcher:
         # or a rounding error against 1e6 misses)
         self.prefix_evictions = 0
         self.prefill_chunks_run = 0  # chunk programs actually executed
-        if self._prefix_cache is not None:
+        if self._prefix_cache is not None or self._prefix_store is not None:
             # scrape-time effectiveness ratio (ROADMAP item 2's metric):
             # hits / (hits + misses) over the pool's lifetime, weakly
             # bound like every pool gauge
             self._obs_gauges["dnn_tpu_prefix_hit_ratio"] = _weak_gauge(
                 "_prefix_ratio_read")
+        if self._prefix_store is not None:
+            # KV-tier residency + cross-replica effectiveness: resident
+            # radix blocks, and the fraction of block-granular hits
+            # served from ADOPTED (migrated-in) blocks — the fleet
+            # tier's whole point, asserted by benchmarks/kv_tier_probe
+            self._obs_gauges["dnn_tpu_kvtier_blocks"] = _weak_gauge(
+                "_kvtier_blocks_read")
+            self._obs_gauges["dnn_tpu_kvtier_remote_hit_ratio"] = \
+                _weak_gauge("_kvtier_remote_ratio_read")
 
         logprobs_k = self._logprobs_k
 
@@ -824,6 +852,64 @@ class ContinuousBatcher:
         # donation is real
         self._prefill_finish = jax.jit(prefill_finish, donate_argnums=(0,))
 
+        # KV-tier device programs (dnn_tpu/kvtier) — only compiled-in
+        # when the radix store is on:
+        #   * _cow_copy: the copy-on-write boundary — duplicate ONE
+        #     physical block (all leaves: K/V and, on quantized pools,
+        #     their scale blocks) so a divergent request can extend a
+        #     shared prefix mid-block without scribbling the original;
+        #   * _kv_put_block: block-granular ingest for migration — one
+        #     migrated block's leaves scattered at a physical id;
+        #   * _kvtier_install: install a staged transient row into pool
+        #     blocks WITHOUT a slot (stage_prefix: the prefill-replica
+        #     half of block migration computes KV straight into the
+        #     store; no finish, no sampling, no slot scatter). The row
+        #     is sliced per block, never returned whole — only the pool
+        #     cache donation is real (the prefill_finish lesson).
+        self._cow_copy = None
+        self._kv_put_block = None
+        self._kvtier_install = None
+        self._kv_get_block = None
+        if self._prefix_store is not None:
+            def cow_copy(cache, src, dst):
+                out = {"tables": cache["tables"]}
+                for kk in cache:
+                    if kk != "tables":
+                        out[kk] = cache[kk].at[:, dst].set(
+                            cache[kk][:, src])
+                return out
+
+            def kv_put_block(cache, vals, dst):
+                out = {"tables": cache["tables"]}
+                for kk in cache:
+                    if kk != "tables":
+                        out[kk] = cache[kk].at[:, dst].set(
+                            vals[kk].astype(cache[kk].dtype))
+                return out
+
+            def kvtier_install(cache, row, install_ids):
+                return codec.install_row(cache, row, install_ids)
+
+            def kv_get_block(cache, idx):
+                # read-only (no donation): one block's leaves, int4
+                # widened to int8 values for the host trip
+                out = {}
+                for kk in cache:
+                    if kk != "tables":
+                        x = lax.dynamic_index_in_dim(
+                            cache[kk], idx, axis=1, keepdims=False)
+                        if x.dtype == jnp.int4:
+                            x = x.astype(jnp.int8)
+                        out[kk] = x
+                return out
+
+            self._cow_copy = jax.jit(cow_copy, donate_argnums=(0,))
+            self._kv_put_block = jax.jit(kv_put_block,
+                                         donate_argnums=(0,))
+            self._kvtier_install = jax.jit(kvtier_install,
+                                           donate_argnums=(0,))
+            self._kv_get_block = jax.jit(kv_get_block)
+
         # --------------------------------------------------------------
         # overlap & fusion (ISSUE 12): interleaved chunked prefill + the
         # one-step double-buffered dispatch pipeline
@@ -862,12 +948,15 @@ class ContinuousBatcher:
                     "on host before the slot's next dispatch, which is "
                     "exactly the sync the interleave removes — "
                     "constrained serving keeps the convoy admission path")
-            if self._prefix_cache is not None:
+            if self._prefix_cache is not None \
+                    or self._prefix_store is not None:
                 raise ValueError(
                     "prefill_chunk_tokens does not compose with the "
-                    "prefix cache (entries are keyed/shaped on the convoy "
-                    "path's prompt_pad chunk geometry) — prefix-heavy "
-                    "workloads keep the convoy admission path")
+                    "prefix cache (dense entries are keyed/shaped on "
+                    "the convoy path's chunk geometry, and the radix "
+                    "store's resume/COW/insert bookkeeping lives on "
+                    "the convoy admission path) — prefix-heavy "
+                    "workloads keep convoy admission")
         # overlap=True runs a ONE-STEP dispatch pipeline: step() DISPATCHES
         # step N and commits step N-1's tokens, so the host slot loop
         # (commit/obs, and the next admission's bookkeeping) runs while
@@ -987,6 +1076,9 @@ class ContinuousBatcher:
             fns.append(self._grow_cache)
         if self._mixed is not None:
             fns += [self._mixed, self._ilv_finish]
+        if self._prefix_store is not None:
+            fns += [self._cow_copy, self._kv_put_block,
+                    self._kvtier_install, self._kv_get_block]
         return fns
 
     # ------------------------------------------------------------------
@@ -1215,11 +1307,10 @@ class ContinuousBatcher:
         except ValueError:
             raise RuntimeError("no free slot; call step()/drain() first") from None
 
-        # longest cached full-chunk prefix (host lookup; shared by the
-        # dense copy path and the paged block-sharing path below).
-        # K/V rows depend on the WEIGHTS that produced them, so prefix
-        # entries are keyed by (adapter, tokens) — a base-model prefix
-        # must never serve an adapted request or vice versa.
+        # longest cached prefix (host lookup). K/V rows depend on the
+        # WEIGHTS that produced them, so dense entries are keyed by
+        # (adapter, tokens) and the paged RADIX store serves only
+        # base-model requests (adapted submissions bypass it).
         p_pad = self.prompt_pad
         key_ns = np.int32(aid).tobytes()
         n_chunks = -(-len(prompt) // p_pad)
@@ -1233,8 +1324,18 @@ class ContinuousBatcher:
                         key_ns + prompt[: c * p_pad].tobytes())
                     hit_c, hit_entry = c, e
                     break
+        # radix lookup (paged + kvtier store): longest block-aligned
+        # run of resident blocks, plus the copy-on-write boundary — the
+        # cached block whose first `cow_tokens` positions this prompt
+        # still agrees with past the last full-block match
+        kv_hit = None
+        use_radix = (self._prefix_store is not None and prefilled is None
+                     and aid == 0)
+        if use_radix:
+            kv_hit = self._prefix_store.lookup(prompt)
 
         paged_taken, install_ids, n_shared = None, None, 0
+        cow_src, cow_tok = -1, 0
         if self._paged:
             from dnn_tpu.runtime.paged_kvcache import InsufficientBlocks
 
@@ -1251,17 +1352,28 @@ class ContinuousBatcher:
                 raise ValueError(
                     f"request needs {n_need} blocks but the pool only has "
                     f"{self._allocator.n_blocks - 1} allocatable")
-            shared_ids = list(hit_entry[0])[:n_need] if hit_c else []
+            if kv_hit is not None:
+                shared_ids = list(kv_hit.shared)[:n_need]
+                if len(shared_ids) == len(kv_hit.shared):
+                    cow_src, cow_tok = kv_hit.cow_src, kv_hit.cow_tokens
+            else:
+                # no radix store consulted (prefix_cache off, an
+                # adapted request, or prefilled= adoption): paged
+                # admission shares nothing — the dense LRU never
+                # serves paged pools
+                shared_ids = []
             n_shared = len(shared_ids)
-            # ref the shared prefix BEFORE any eviction below can run:
-            # the hit entry itself may be evicted while we hunt for tail
-            # blocks, and without our reference its blocks could recycle
-            # into this very allocation (aliasing the prefix)
-            if shared_ids:
-                self._allocator.ref(shared_ids)
+            # ref the shared prefix (and the COW source) BEFORE any
+            # eviction below can run: the hit entry itself may be
+            # evicted while we hunt for tail blocks, and without our
+            # reference its blocks could recycle into this very
+            # allocation (aliasing the prefix)
+            ref_ids = shared_ids + ([cow_src] if cow_tok > 0 else [])
+            if ref_ids:
+                self._allocator.ref(ref_ids)
             try:
                 owned = self._allocator.alloc(n_need - n_shared)
-                while owned is None and self._prefix_cache:
+                while owned is None and self._evictable_prefix():
                     # entry-pinned blocks must never starve admission
                     # (livelock: entries only evict on insertion, which
                     # needs a successful prefill): evict LRU entries until
@@ -1293,8 +1405,8 @@ class ContinuousBatcher:
                         f"(pool {self._allocator.n_blocks}, block {bp} "
                         f"pos)")
             except BaseException:
-                if shared_ids:
-                    self._allocator.free(shared_ids)
+                if ref_ids:
+                    self._allocator.free(ref_ids)
                 raise
             self._pool_exhausted_episode = False  # blocks came free
             paged_taken = shared_ids + owned
@@ -1303,11 +1415,39 @@ class ContinuousBatcher:
             ids_row[:n_need] = paged_taken
             self.cache["tables"] = self.cache["tables"].at[:, slot].set(
                 jnp.asarray(ids_row))
+            if cow_tok > 0:
+                # copy-on-write at the divergence boundary: duplicate
+                # the ONE cached block this prompt still partially
+                # agrees with into this request's first owned block
+                # (logical index n_shared); prefill then resumes
+                # MID-BLOCK after the agreed tokens instead of
+                # recomputing the whole block. The original stays
+                # intact for its own holders — the temporary reference
+                # taken above kept it alive through the eviction hunt,
+                # and is dropped now that the copy is enqueued
+                # (in-order backends run the copy before any later
+                # write could recycle the source).
+                try:
+                    self.cache = self._cow_copy(
+                        self.cache, jnp.int32(cow_src),
+                        jnp.int32(owned[0]))
+                finally:
+                    # the temporary reference drops either way — a
+                    # failed dispatch must not strand the source block
+                    self._allocator.free([cow_src])
             # install must NOT touch shared blocks (another request's live
             # prefix): their install targets are routed to junk block 0
             inst = ids_row.copy()
             inst[:n_shared] = 0
             install_ids = jnp.asarray(inst)
+            if kv_hit is not None and (n_shared or cow_tok):
+                # admission HOLDS the blocks now — record the reuse
+                # (post-truncation, post-allocation: the ratio the
+                # kv_tier probe floors must never count blocks the
+                # request didn't actually get)
+                self._prefix_store.note_reuse(
+                    n_shared + (1 if cow_tok > 0 else 0),
+                    kv_hit.remote_used(n_shared, cow_tok > 0))
 
         if self._buckets is not None:
             # the installed prompt must fit the pool AND the first decode
@@ -1390,26 +1530,26 @@ class ContinuousBatcher:
             row = self._new_row() if prefilled is None else None
             logits = None
             start_chunk = 0
-            if not hit_c and self._prefix_cache is not None \
-                    and prefilled is None:
-                self.prefix_misses += 1
-            if hit_c:
-                start_chunk = hit_c
-                self.prefix_hits += 1
-                last_logit_row = hit_entry[1]
-                if self._paged:
-                    # copy-free hit: the slot's table already points at
-                    # the entry's shared blocks. The transient row
-                    # rebuilds from the pool ONLY when remaining chunks
-                    # still need the prefix for their attention.
-                    if hit_c < n_chunks:
-                        row = self._gather_row(
-                            self.cache, self.cache["tables"][0, slot])
+            prefix_hit_flag = False
+            prefix_lookup_ran = prefilled is None and (
+                self._prefix_cache is not None or use_radix)
+            if use_radix:
+                prefix_hit_flag = n_shared > 0 or cow_tok > 0
+            elif hit_c:
+                prefix_hit_flag = True
+            if prefix_lookup_ran:
+                if prefix_hit_flag:
+                    self.prefix_hits += 1
                 else:
-                    # dense hit: copy out — the live row is donated
-                    # through the chunk loop and must not invalidate the
-                    # cached entry
-                    row = jax.tree.map(jnp.copy, hit_entry[0])
+                    self.prefix_misses += 1
+            if hit_c:
+                # dense-LRU hit (the radix store replaces this path on
+                # paged pools): copy out — the live row is donated
+                # through the chunk loop and must not invalidate the
+                # cached entry
+                start_chunk = hit_c
+                last_logit_row = hit_entry[1]
+                row = jax.tree.map(jnp.copy, hit_entry[0])
                 if hit_c == n_chunks:
                     # whole prompt cached: rebuild a chunk-shaped logits
                     # array with the stored last row in place (position
@@ -1420,7 +1560,6 @@ class ContinuousBatcher:
                         (1, p_pad, last_logit_row.shape[-1]),
                         last_logit_row.dtype,
                     ).at[0, p_pad - 1].set(last_logit_row)
-            put_candidates = []
             pf_prepared = self._lora_prefill_view(aid)
             sp_pf = adm.child("prefill", chunks=n_chunks - start_chunk,
                               prompt_len=len(prompt))
@@ -1428,6 +1567,8 @@ class ContinuousBatcher:
             # submit-entry-to-here is validation/slot/host bookkeeping,
             # which belongs to the admit span, not this metric
             chunks_before = self.prefill_chunks_run
+            last_local = len(prompt) - 1 - (n_chunks - 1) * p_pad
+            kv_boundary_rows: dict = {}
             if prefilled is not None:
                 # KV ADOPTION (disaggregated serving, dnn_tpu/control):
                 # the prefill replica already ran this chunk loop;
@@ -1435,6 +1576,10 @@ class ContinuousBatcher:
                 # and fall through to the SAME _prefill_finish install
                 # below — the decode replica spends zero prompt FLOPs
                 row, logits = self._adopt_prefilled(prefilled, prompt)
+            elif use_radix:
+                row, logits, last_local = self._radix_prefill(
+                    prompt, slot, pf_prepared, row, kv_hit, n_shared,
+                    cow_tok, kv_boundary_rows)
             else:
                 for c in range(start_chunk, n_chunks):
                     with _prof_annotation("serving.prefill_chunk"):
@@ -1448,14 +1593,6 @@ class ContinuousBatcher:
                     if self._prefix_cache is not None \
                             and (c + 1) * p_pad <= len(prompt):
                         key = key_ns + prompt[: (c + 1) * p_pad].tobytes()
-                        if self._paged:
-                            # block-sharing entries point at THIS
-                            # request's blocks, which only hold data
-                            # after the install — record now, create
-                            # after _prefill_finish
-                            put_candidates.append(
-                                (c + 1, key, jnp.copy(logits[0, -1])))
-                            continue
                         # scan-resistant insertion: evict the current
                         # LRU first, then park the NEW entry at the LRU
                         # end — only a HIT promotes to MRU. A long novel
@@ -1469,7 +1606,6 @@ class ContinuousBatcher:
                             jax.tree.map(jnp.copy, row),
                             jnp.copy(logits[0, -1]))
                         self._prefix_cache.move_to_end(key, last=False)
-            last_local = len(prompt) - 1 - (n_chunks - 1) * p_pad
             t_arr = jnp.float32(temp)
             k_arr = jnp.int32(tk)
             p_arr = jnp.float32(tp)
@@ -1486,21 +1622,24 @@ class ContinuousBatcher:
                           else c_off + constraint.start),
                 self._ctable,
             )
-            if self._paged and put_candidates:
-                # create the block-sharing entries now that the install has
-                # populated this request's owned blocks. Each entry takes
-                # its own REFERENCE on the prefix blocks (shared + owned),
-                # so the blocks outlive the request until eviction.
-                nbp = p_pad // self._block_len
-                for c1, key, logit_row in put_candidates:
-                    if key in self._prefix_cache:
-                        continue
-                    while len(self._prefix_cache) >= self._prefix_cap:
-                        self._evict_prefix_entry()
-                    ids_prefix = [int(x) for x in paged_taken[: c1 * nbp]]
-                    self._allocator.ref(ids_prefix)
-                    self._prefix_cache[key] = (tuple(ids_prefix), logit_row)
-                    self._prefix_cache.move_to_end(key, last=False)
+            if use_radix:
+                # insert this prompt's full-block path now that the
+                # install has populated the owned blocks. The store
+                # refs every NEWLY resident block (existing nodes are
+                # reused untouched); the slot keeps its own references
+                # until retirement, so the trie and the live request
+                # share blocks exactly as two requests would. Origins
+                # propagate per block: re-creating an evicted ADOPTED
+                # node must not launder it local (the cross-replica
+                # accounting would decay with cache churn).
+                n_cover = len(prompt) // self._block_len
+                kv_borig = list(kv_hit.origins[:n_shared]) \
+                    if kv_hit is not None else []
+                if n_cover:
+                    self._prefix_store.insert(
+                        prompt[: n_cover * self._block_len],
+                        [int(x) for x in paged_taken[:n_cover]],
+                        logit_rows=kv_boundary_rows, origin=kv_borig)
             if self._logprobs_k:
                 self.cache, first, c_lp, t_lp, t_ids = fin
             else:
@@ -1519,10 +1658,26 @@ class ContinuousBatcher:
                     "serving.prefill_chunks_total":
                         self.prefill_chunks_run - chunks_before,
                 }
-                if hit_c:
+                if prefix_hit_flag:
                     counters["serving.prefix_hits_total"] = 1
-                elif self._prefix_cache is not None \
-                        and prefilled is None:
+                    if use_radix:
+                        # block-granular effectiveness (the radix
+                        # extension of the hit/miss pair): how many
+                        # physical blocks this admission reused, and
+                        # how many of them arrived by MIGRATION from a
+                        # sibling replica (origin="adopted") — the
+                        # cross-replica number the kv_tier probe
+                        # floors. Post-truncation counts, matching
+                        # note_reuse above.
+                        counters["serving.prefix_blocks_reused_total"] \
+                            = n_shared + (1 if cow_tok > 0 else 0)
+                        remote_used = kv_hit.remote_used(
+                            n_shared, cow_tok > 0)
+                        if remote_used:
+                            counters[
+                                "serving.kvtier_remote_block_hits_total"
+                            ] = remote_used
+                elif prefix_lookup_ran:
                     # the lookup ran (prefilled= adoptions skip it) and
                     # reused nothing — the other half of the ratio
                     counters["serving.prefix_misses_total"] = 1
@@ -1553,6 +1708,12 @@ class ContinuousBatcher:
                    "stop": stop_seqs, "logprobs": logprobs and self._logprobs_k,
                    "blocks": paged_taken, "prompt_len": len(prompt),
                    "freed": 0}
+            if use_radix:
+                # retire-time store insertion needs the token ids and
+                # the per-block provenance (adopted blocks re-inserted
+                # after eviction must stay adopted)
+                req["ptoks"] = prompt
+                req["borig"] = kv_borig
             if constraint is not None:
                 req["constraint"] = constraint
                 req["c_state"] = constraint.start
@@ -1756,19 +1917,332 @@ class ContinuousBatcher:
             m.inc("serving.kv_adoptions_total")
         return row, logits
 
+    # -- fleet KV tier (dnn_tpu/kvtier): stage / export / adopt ---------
+
+    def _require_store(self):
+        if self._prefix_store is None:
+            raise ValueError(
+                "the KV tier needs the radix prefix store: construct "
+                "with kv='paged' (or paged_blocks>0) and prefix_cache>0")
+
+    def kvtier_fingerprint(self) -> dict:
+        """Block geometry both sides of a block migration must share —
+        checked at adopt with a readable diff, exactly like the
+        row-handoff fingerprint. int4 pools report their true dtype
+        (blocks cross the host boundary as int8 values and re-pack on
+        ingest)."""
+        self._require_store()
+        leaves = {}
+        for kk in self.cache:
+            if kk == "tables":
+                continue
+            shp = list(self.cache[kk].shape)
+            # one block's leaf: drop the n_blocks axis (axis 1)
+            leaves[kk] = [[shp[0]] + shp[2:], str(self.cache[kk].dtype)]
+        return {"family": type(self.family).__name__,
+                "vocab_size": int(self.cfg.vocab_size),
+                "block_len": int(self._block_len),
+                "leaves": leaves}
+
+    def _read_block(self, block_id: int) -> dict:
+        """One physical block's leaves on host — fixed-shape jitted
+        gather (a per-run-length gather would compile per length).
+        int4 payloads widen to int8 VALUES for the host trip (native
+        int4 has no stable host view; the wire codec nibble-packs
+        them back to half a byte)."""
+        got = self._kv_get_block(self.cache, jnp.int32(block_id))
+        return {kk: np.asarray(v) for kk, v in got.items()}
+
+    def kvtier_export(self, tokens):
+        """Donor half of block migration: the longest resident run of
+        full blocks matching `tokens`, read off the pool. Returns the
+        payload dict `kvtier_adopt` ingests (kvtier/migrate.py packs it
+        for the wire), or None when nothing is resident. Worker-thread
+        only (reads pool leaves between steps)."""
+        self._require_store()
+        tokens = np.asarray(tokens, np.int32).reshape(-1)
+        nodes = self._prefix_store.nodes_for(tokens)
+        if not nodes:
+            return None
+        blocks = [self._read_block(n.block) for n in nodes]
+        leaves = {kk: np.stack([b[kk] for b in blocks], axis=1)
+                  for kk in blocks[0]}
+        logit_rows = {i: np.asarray(n.logit_row)
+                      for i, n in enumerate(nodes)
+                      if n.logit_row is not None}
+        bp = self._block_len
+        return {"tokens": tokens[: len(nodes) * bp],
+                "block_len": bp, "leaves": leaves,
+                "logit_rows": logit_rows,
+                "fingerprint": self.kvtier_fingerprint()}
+
+    def kvtier_adopt(self, payload, *, origin: str = "adopted") -> int:
+        """Adopter half: ingest a sibling's exported block run — verify
+        geometry, allocate fresh LOCAL blocks for the non-resident
+        suffix (never aliasing anything live: a dying donor cannot
+        corrupt an adopter, because nothing of the donor's is mapped),
+        scatter the payload in block-by-block, and insert the radix
+        path with origin="adopted" so hit accounting knows these
+        blocks crossed replicas. Returns blocks actually migrated
+        (0 = everything was already resident). Worker-thread only."""
+        from dnn_tpu.runtime.paged_kvcache import InsufficientBlocks
+
+        self._require_store()
+        mine = self.kvtier_fingerprint()
+        theirs = payload.get("fingerprint") or {}
+        if theirs and theirs != mine:
+            diff = {k: (theirs.get(k), mine.get(k))
+                    for k in set(theirs) | set(mine)
+                    if theirs.get(k) != mine.get(k)}
+            raise ValueError(
+                f"kvtier geometry mismatch (theirs, mine): {diff} — "
+                "donor and adopter must share model config, block_len "
+                "and kv dtype")
+        tokens = np.asarray(payload["tokens"], np.int32).reshape(-1)
+        bp = self._block_len
+        n_total = tokens.size // bp
+        if n_total == 0:
+            return 0
+        have = self._prefix_store.nodes_for(tokens)
+        n_have = len(have)
+        if n_have >= n_total:
+            return 0
+        n_missing = n_total - n_have
+        # ref the matched resident run BEFORE the make-room loop: the
+        # eviction hunt below may otherwise evict those very nodes,
+        # free their blocks, and recycle them into `owned` — the
+        # insert would then map two trie paths onto one physical block
+        # (the same aliasing hazard submit() guards against)
+        have_ids = [n.block for n in have]
+        if have_ids:
+            self._allocator.ref(have_ids)
+        try:
+            owned = self._allocator.alloc(n_missing)
+            while owned is None and self._evictable_prefix():
+                self._evict_prefix_entry()
+                owned = self._allocator.alloc(n_missing)
+        except BaseException:
+            if have_ids:
+                self._allocator.free(have_ids)
+            raise
+        if owned is None:
+            if have_ids:
+                self._allocator.free(have_ids)
+            raise InsufficientBlocks(
+                f"kvtier adopt needs {n_missing} free blocks, have "
+                f"{self._allocator.n_free}")
+        try:
+            for j, dst in zip(range(n_have, n_total), owned):
+                vals = {kk: jnp.asarray(np.ascontiguousarray(
+                    payload["leaves"][kk][:, j]))
+                    for kk in payload["leaves"]}
+                self.cache = self._kv_put_block(self.cache, vals,
+                                                jnp.int32(dst))
+            ids = have_ids + owned
+            lrs = {int(i): jnp.asarray(r)
+                   for i, r in (payload.get("logit_rows") or {}).items()}
+            self._prefix_store.insert(tokens[: n_total * bp], ids,
+                                      logit_rows=lrs, origin=origin)
+        finally:
+            # the store now holds its own reference per inserted node;
+            # dropping ours (owned allocs + the matched-run guards)
+            # frees exactly the blocks that did NOT make it in (cap
+            # pressure, or an exception mid-scatter)
+            self._allocator.free(owned + have_ids)
+        m = obs.metrics()
+        if m is not None:
+            m.inc("serving.kvtier_blocks_adopted_total", n_missing)
+        return n_missing
+
+    def stage_prefix(self, prompt) -> dict:
+        """Prefill `prompt`'s full blocks STRAIGHT INTO the radix store
+        — no slot held, no sampling, no install into any request's
+        table: the prefill-replica half of disaggregated block
+        migration (the router stages here, then tells the decode
+        replica to pull), and a warm-up hook. Resumes at the first
+        non-resident block like any admission; a fully resident prompt
+        is a no-op. Worker-thread only."""
+        from dnn_tpu.runtime.paged_kvcache import InsufficientBlocks
+
+        self._require_store()
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        bp = self._block_len
+        p_pad = self.prompt_pad
+        n_cover = prompt.size // bp
+        stats = {"covered_blocks": n_cover, "staged_blocks": 0,
+                 "computed_chunks": 0}
+        if n_cover == 0:
+            return stats
+        nodes = self._prefix_store.nodes_for(prompt[: n_cover * bp])
+        n_shared = len(nodes)
+        if n_shared >= n_cover:
+            return stats
+        shared_ids = [n.block for n in nodes]
+        if shared_ids:
+            self._allocator.ref(shared_ids)
+        owned = None
+        try:
+            owned = self._allocator.alloc(n_cover - n_shared)
+            while owned is None and self._evictable_prefix():
+                self._evict_prefix_entry()
+                owned = self._allocator.alloc(n_cover - n_shared)
+            if owned is None:
+                raise InsufficientBlocks(
+                    f"stage_prefix needs {n_cover - n_shared} free "
+                    f"blocks, have {self._allocator.n_free}")
+            nb_max = self.cache["tables"].shape[-1]
+            ids_row = np.zeros((nb_max,), np.int32)
+            ids_row[:n_cover] = shared_ids + owned
+            end = n_cover * bp
+            resume = n_shared * bp
+            if resume + (-(-(end - resume) // p_pad)) * p_pad \
+                    > self._row_len:
+                resume = (resume // p_pad) * p_pad
+            row = (self._gather_row(self.cache, jnp.asarray(ids_row))
+                   if resume else self._new_row())
+            n_k = -(-(end - resume) // p_pad)
+            padded = np.zeros((1, n_k * p_pad), np.int32)
+            padded[0, : end - resume] = prompt[resume:end]
+            boundary: dict = {}
+            logits = None
+            t_pf = time.perf_counter()
+            for i in range(n_k):
+                start = resume + i * p_pad
+                with _prof_annotation("serving.prefill_chunk"):
+                    logits, row = self._prefill_chunk(
+                        self.prepared, row,
+                        jnp.asarray(padded[:, i * p_pad:(i + 1) * p_pad]),
+                        jnp.int32(start))
+                self.prefill_chunks_run += 1
+                for b in range(start // bp, n_cover):
+                    pos = (b + 1) * bp - 1
+                    if pos >= start + p_pad:
+                        break
+                    if pos >= start:
+                        boundary[b] = jnp.copy(logits[0, pos - start])
+            inst = ids_row.copy()
+            inst[:n_shared] = 0
+            self.cache = self._kvtier_install(self.cache, row,
+                                              jnp.asarray(inst))
+            self._prefix_store.insert(
+                prompt[:end], [int(x) for x in ids_row[:n_cover]],
+                logit_rows=boundary)
+            m = obs.metrics()
+            if m is not None:
+                m.bulk(counters={"serving.prefill_chunks_total": n_k},
+                       observations={"serving.prefill_seconds":
+                                     [time.perf_counter() - t_pf]},
+                       gauge_fns=self._obs_gauges)
+                if (g := self.goodput) is not None:
+                    g.on_prefill(end - resume)
+            stats.update(staged_blocks=n_cover - n_shared,
+                         computed_chunks=n_k)
+            return stats
+        finally:
+            # transient references only: the store refs what it keeps
+            if shared_ids:
+                self._allocator.free(shared_ids)
+            if owned:
+                self._allocator.free(owned)
+
+    def _evictable_prefix(self) -> bool:
+        """Whether the admission make-room loop has anything left to
+        evict — either prefix-cache form."""
+        if self._prefix_store is not None:
+            return self._prefix_store.n_blocks > 0
+        return bool(self._prefix_cache)
+
     def _evict_prefix_entry(self):
-        """Drop the LRU prefix entry; paged entries release their block
-        references (blocks still shared by live slots survive via
-        refcount until those retire)."""
-        _, entry = self._prefix_cache.popitem(last=False)
-        self.prefix_evictions += 1
-        if self._paged:
-            self._allocator.free(list(entry[0]))
+        """Drop the LRU prefix entry — the dense dict's LRU head, or
+        the radix store's LRU LEAF (interior nodes carry every
+        descendant's prefix). Either way blocks still shared by live
+        slots survive via refcount until those retire."""
+        if self._prefix_store is not None:
+            if not self._prefix_store.evict_one():
+                return
+            self.prefix_evictions += 1
+            left = self._prefix_store.n_blocks
+        else:
+            _, _entry = self._prefix_cache.popitem(last=False)
+            self.prefix_evictions += 1
+            left = len(self._prefix_cache)
         m = obs.metrics()
         if m is not None:
             m.inc("serving.prefix_evictions_total")
-        obs.flight.record("prefix_evict",
-                          entries_left=len(self._prefix_cache))
+        obs.flight.record("prefix_evict", entries_left=left)
+
+    def _radix_prefill(self, prompt, slot, pf_prepared, row, kv_hit,
+                       n_shared, cow_tok, boundary_rows):
+        """The radix-store admission prefill: resume the chunk loop at
+        the first non-cached position instead of chunk 0.
+
+        Returns (row, logits, last_local). Three regimes:
+
+          * FULL HIT — the prompt is exactly the shared block run and
+            the final node stored its logit row: zero chunks, rebuild
+            the finish-shaped logits with the stored row in place;
+          * PARTIAL — resume at `n_shared * block_len + cow_tok` (the
+            copy-on-write boundary block, already duplicated into this
+            request's first owned block, covers the agreed mid-block
+            tokens); the transient row is GATHERED from the slot's
+            table so later chunks attend the shared prefix, then
+            full-width chunks run from the (block- or mid-block-
+            aligned) resume position — `chunk_start` is a dynamic
+            scalar, so unaligned starts reuse the one compiled chunk
+            program;
+          * capacity BACKOFF — a resume point whose remaining chunks
+            would overhang the transient row is rounded down to its
+            chunk boundary (a dynamic-update overhang would CLAMP the
+            write onto real positions — the standing row-rounding
+            lesson); backing into already-shared territory only
+            recomputes values the install then routes to junk.
+
+        Boundary logit rows (the model's logits after each completed
+        block) are collected into `boundary_rows` for the store insert
+        — what makes a later exactly-block-aligned prompt a zero-chunk
+        full hit."""
+        p_len = len(prompt)
+        bp = self._block_len
+        p_pad = self.prompt_pad
+        if kv_hit.logit_row is not None and p_len == n_shared * bp \
+                and cow_tok == 0:
+            lr = jnp.asarray(kv_hit.logit_row)
+            last_local = (p_len - 1) % p_pad
+            logits = jnp.zeros((1, p_pad, lr.shape[-1]), lr.dtype
+                               ).at[0, last_local].set(lr)
+            return row, logits, last_local
+        resume = min(n_shared * bp + cow_tok, p_len - 1)
+        if resume + (-(-(p_len - resume) // p_pad)) * p_pad \
+                > self._row_len:
+            # overhang only ever comes from an UNALIGNED resume near a
+            # full row; rounding down to the chunk boundary always fits
+            # (end <= ceil(p/P)*P <= row_len), at the price of
+            # recomputing at most one chunk's worth of already-shared
+            # positions — whose installs route to junk, never corrupt
+            resume = (resume // p_pad) * p_pad
+        if resume:
+            row = self._gather_row(self.cache,
+                                   self.cache["tables"][0, slot])
+        n_k = -(-(p_len - resume) // p_pad)
+        padded_r = np.zeros((1, n_k * p_pad), np.int32)
+        padded_r[0, : p_len - resume] = prompt[resume:]
+        logits = None
+        for i in range(n_k):
+            start = resume + i * p_pad
+            with _prof_annotation("serving.prefill_chunk"):
+                logits, row = self._prefill_chunk(
+                    pf_prepared, row,
+                    jnp.asarray(padded_r[:, i * p_pad:(i + 1) * p_pad]),
+                    jnp.int32(start))
+            self.prefill_chunks_run += 1
+            for b in range(start // bp, p_len // bp):
+                pos = (b + 1) * bp - 1
+                if pos >= start + p_pad:
+                    break
+                if pos >= start:
+                    boundary_rows[b] = jnp.copy(logits[0, pos - start])
+        last_local = (p_len - resume - 1) - (n_k - 1) * p_pad
+        return row, logits, last_local
 
     @staticmethod
     def _stop_match(emitted: list, stop_seqs: list):
@@ -2001,6 +2475,19 @@ class ContinuousBatcher:
         looked = self.prefix_hits + self.prefix_misses
         return self.prefix_hits / looked if looked else 0.0
 
+    def _kvtier_blocks_read(self) -> float:
+        s = self._prefix_store
+        return float(s.n_blocks) if s is not None else 0.0
+
+    def _kvtier_remote_ratio_read(self) -> float:
+        # of all block-granular hits, the fraction served from blocks
+        # MIGRATED in from a sibling replica — the fleet tier's working
+        # number (0.0 on a replica that has never adopted anything)
+        s = self._prefix_store
+        if s is None or not s.block_hits:
+            return 0.0
+        return s.remote_block_hits / s.block_hits
+
     def _paged_used_read(self) -> float:
         return float(self._allocator.n_used)
 
@@ -2059,6 +2546,27 @@ class ContinuousBatcher:
                 "top_logprobs": np.stack([t[1] for t in req["lp_top"][:n]])
                 if n else np.zeros((0, self._logprobs_k), np.float32),
             }
+        if self._prefix_store is not None \
+                and req.get("ptoks") is not None and req["blocks"] \
+                and not req["freed"]:
+            # retire-time insertion (the chat-follow-up win): this
+            # request's transcript KV — prompt plus every FED decode
+            # token (the last sampled token was never fed, so its
+            # position holds nothing) — is sitting in blocks about to
+            # be released. Inserting the full-block path into the
+            # radix store keeps them resident, so turn N+1's prompt
+            # (= turn N's transcript + the new user message) adopts
+            # them instead of re-prefilling the whole conversation.
+            fed = req["prompt_len"] + len(req["emitted"]) - 1
+            n_cover = min(fed // self._block_len, len(req["blocks"]))
+            if n_cover:
+                toks = np.concatenate([
+                    np.asarray(req["ptoks"], np.int32),
+                    np.asarray(req["emitted"][:-1], np.int32)])
+                self._prefix_store.insert(
+                    toks[: n_cover * self._block_len],
+                    req["blocks"][:n_cover],
+                    origin=req.get("borig") or [])
         if req["blocks"]:
             # windowed pools already reclaimed the rolled-out prefix
             self._allocator.free(req["blocks"][req["freed"]:])

@@ -122,6 +122,14 @@ _MODULE_COST_S = {
     # streaming, disaggregated prefill/decode parity, shed, drain-to-
     # sibling) — in-process replicas; certified inside the tier-1
     # budget with the serving-resilience modules
+    "test_kvtier": 46.0,  # ISSUE 15 fleet KV tier: radix trie goldens
+    # (insert/lookup/COW/leaf-LRU/refcount protection), block wire
+    # codec incl. int4 nibble packing, lease machine + TTL + shm nonce
+    # proof + PRO002-both-directions, radix admission parity (COW /
+    # full-hit / retire-insert / row-backoff), cross-pool export/adopt
+    # parity with block accounting, donor-death fallback with zero
+    # divergence and zero leaks, kvput inbox TTL sweep, worker control
+    # ops — certified inside the tier-1 budget with the serving modules
     "test_chaos": 42.0,  # ISSUE 8 chaos + self-healing: injection
     # goldens, supervisor restart/backoff/crash-loop (tiny python -c
     # children), requeue token parity, drain-under-load, circuit

@@ -387,7 +387,7 @@ def test_protocol_audit_clean_on_head():
     assert all(m["clean"] for m in report["machines"])
     assert {m["name"] for m in report["machines"]} == {
         "circuit_breaker", "supervisor", "drain", "relay_accept_window",
-        "replica_lifecycle", "router"}
+        "replica_lifecycle", "router", "kvtier_lease"}
 
 
 def test_pro002_unsettled_probe_slot_is_a_model_failure():
