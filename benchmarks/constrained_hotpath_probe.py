@@ -1,0 +1,211 @@
+"""Constrained hot-path probe: the ISSUE 16 ratchet pair, asserted.
+
+PR 16 moved the grammar DFA walk ON DEVICE (an int32 transition-table
+pool next to the mask pool, the state advance folded into the decode
+programs as donated per-slot carried state) and lifted the composition
+rejections that pinned constrained decoding to convoy admission. This
+probe measures exactly that delta and pins correctness while doing it:
+
+  * **convoy** (the BEFORE leg, report-only): every request grammar-
+    constrained ([0-9]+ over the byte vocab), admitted through inline
+    prefill — the only path constraints had before this PR. This leg
+    doubles as the ORACLE: its per-request token streams come from the
+    same seeds as the hot leg's, so divergence means the device walk
+    and the host walk disagree.
+
+  * **hot** (ASSERTED): the same constrained population on the ISSUE 12
+    machinery — interleaved chunked prefill + double-buffered overlap —
+    which the on-device walk just unlocked for constrained traffic.
+    Asserted: tokens/sec >= SPEEDUP_FLOOR x the convoy leg,
+    host-serialization fraction <= step_timeline_probe's
+    HOST_FRACTION_CEIL (0.40 — the same ceiling the unconstrained hot
+    path answers to: constraints may no longer buy a softer ratchet),
+    and EXACT token parity with the convoy leg.
+
+Every emitted token is ALSO replayed through the host-side DFA
+(TokenConstraint.table/allowed) — a pure-host oracle independent of
+both serving legs: each sampled token must be legal at the walked
+state, whatever the device said.
+
+Standalone:  python benchmarks/constrained_hotpath_probe.py [--assert]
+Suite row:   benchmarks/run_all.py config `constrained_hotpath`
+             (cpu-runnable); ledger ratchets `constrained_speedup_floor`
+             + `constrained_host_fraction` read it.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+#: asserted floor on hot-leg tokens/sec over the convoy leg's, both
+#: legs fully grammar-constrained. The convoy leg pays an inline
+#: prefill stall per admit; measured ~1.5-2.1x on this host — 1.15
+#: catches a regression to convoy-class admission with margin while
+#: tolerating scheduler noise.
+SPEEDUP_FLOOR = 1.15
+
+SLOTS = 4
+REQUESTS = 16     # timed round: admitted continuously into the 4 slots
+NEW_TOKENS = 24   # short decodes keep the admission pressure on
+PROMPT = 8
+
+
+def _build(hot: bool):
+    import jax
+
+    from dnn_tpu.models import gpt
+    from dnn_tpu.runtime.serving import ContinuousBatcher
+
+    # the step_timeline_probe shape (s10/s11 standard: dense bucketed
+    # f32) + the constraint machinery on BOTH legs; the hot leg adds
+    # ONLY the ISSUE 12 knobs, so the delta between the legs is the
+    # admission path and nothing else.
+    cfg = gpt.GPTConfig(block_size=256, vocab_size=512, n_layer=4,
+                        n_head=4, n_embd=256)
+    prepared = gpt.prepare_stacked(gpt.init(jax.random.PRNGKey(0), cfg),
+                                   cfg)
+    kw = {}
+    if hot:
+        kw = {"prefill_chunk_tokens": 16, "overlap": True}
+    return ContinuousBatcher(cfg, prepared, slots=SLOTS,
+                             max_len=cfg.block_size, prompt_pad=16,
+                             decode_buckets=True, temperature=1.0,
+                             allow_constraints=True, constraint_rows=8,
+                             **kw)
+
+
+def _constraint(vocab_size: int):
+    from dnn_tpu.runtime.constrain import TokenConstraint, byte_vocab
+
+    return TokenConstraint.from_regex(r"[0-9]+", byte_vocab(vocab_size))
+
+
+def _host_walk_ok(c, tokens) -> bool:
+    """Pure-host DFA oracle: replay `tokens` from the start state —
+    every token must be allowed where it was sampled."""
+    s = c.start
+    for t in tokens:
+        if not bool(c.allowed[s, t]):
+            return False
+        s = int(c.table[s, t])
+    return True
+
+
+def _leg(hot: bool, n_requests: int, new_tokens: int) -> tuple:
+    """One measured constrained leg -> (row dict, per-request tokens)."""
+    import numpy as np
+
+    from dnn_tpu.obs.timeline import PHASES, StepClock
+
+    srv = _build(hot)
+    cons = _constraint(srv.cfg.vocab_size)
+    clock = StepClock(capacity=8192).install()
+    srv.step_clock = clock
+
+    def round_(n_req=n_requests, collect=False):
+        rids = []
+        for i in range(n_req):
+            while srv.free_slots() == 0:
+                srv.step()
+            rids.append(srv.submit(np.arange(1, PROMPT + 1), new_tokens,
+                                   seed=i, constraint=cons))
+        srv.drain()
+        toks = [list(srv.results[r]) for r in rids] if collect else None
+        srv.results.clear()
+        srv.finish_reasons.clear()
+        return toks
+
+    # steady state: two warm rounds (bucket-ladder growth, then the
+    # admission programs at the grown rungs), as in step_timeline_probe
+    round_(SLOTS)
+    round_(SLOTS)
+    base = clock.steps_total
+    t0 = time.perf_counter()
+    toks = round_(collect=True)
+    wall = time.perf_counter() - t0
+    n_steps = clock.steps_total - base
+    recs = clock.records()[-n_steps:]
+    sums = {p: 0.0 for p in PHASES}
+    for r in recs:
+        for p, v in r["phases"].items():
+            sums[p] = sums.get(p, 0.0) + v
+    host_s = sum(sums[p] for p in ("admit", "host", "commit", "obs"))
+    tokens = sum(len(t) for t in toks)
+    leg = {
+        "wall_s": round(wall, 4),
+        "steps": n_steps,
+        "tokens": tokens,
+        "tokens_per_sec": round(tokens / wall, 1),
+        # same denominator discipline as step_timeline_probe: the
+        # EXTERNAL wall, so an attribution hole cannot deflate it
+        "host_serialization_fraction": round(host_s / wall, 4),
+        "host_walk_oracle_ok": all(_host_walk_ok(cons, t) for t in toks),
+    }
+    return leg, toks
+
+
+def measure(light: bool = False) -> dict:
+    from dnn_tpu import obs
+
+    from benchmarks.step_timeline_probe import HOST_FRACTION_CEIL
+
+    was = obs.enabled()
+    obs.set_enabled(True)
+    try:
+        n_req = 8 if light else REQUESTS
+        new_tokens = 12 if light else NEW_TOKENS
+        convoy, convoy_toks = _leg(hot=False, n_requests=n_req,
+                                   new_tokens=new_tokens)
+        hot, hot_toks = _leg(hot=True, n_requests=n_req,
+                             new_tokens=new_tokens)
+        row = {
+            "slots": SLOTS, "requests": n_req, "new_tokens": new_tokens,
+            "leg": "all slots grammar-constrained ([0-9]+), seeded "
+                   "sampled (t=1.0): interleaved prefill (chunk=16) + "
+                   "overlap vs the convoy-admission control",
+            "convoy": convoy,
+            "hot": hot,
+            "vs_convoy_tps": round(
+                hot["tokens_per_sec"] / convoy["tokens_per_sec"], 3),
+            "host_fraction": hot["host_serialization_fraction"],
+            # parity oracle: same seeds, same grammar — the device walk
+            # must reproduce the convoy streams token for token
+            "parity_ok": bool(hot_toks == convoy_toks),
+            "oracle_ok": bool(convoy["host_walk_oracle_ok"]
+                              and hot["host_walk_oracle_ok"]),
+            "speedup_floor": SPEEDUP_FLOOR,
+            "host_fraction_ceil": HOST_FRACTION_CEIL,
+        }
+        row["ok_speedup"] = bool(row["vs_convoy_tps"] >= SPEEDUP_FLOOR)
+        row["ok_host_fraction"] = bool(
+            row["host_fraction"] <= HOST_FRACTION_CEIL)
+        row["ok"] = (row["parity_ok"] and row["oracle_ok"]
+                     and row["ok_speedup"] and row["ok_host_fraction"])
+        return row
+    finally:
+        obs.set_enabled(was)
+
+
+def main(argv=None) -> int:
+    args = set(argv if argv is not None else sys.argv[1:])
+    row = measure(light="--light" in args)
+    print(json.dumps(row), flush=True)
+    if "--assert" in args and not row["ok"]:
+        print(f"FAIL: parity={row['parity_ok']} oracle={row['oracle_ok']}"
+              f" vs_convoy_tps={row['vs_convoy_tps']} "
+              f"(floor {SPEEDUP_FLOOR}), host_fraction="
+              f"{row['host_fraction']} (ceil {row['host_fraction_ceil']})",
+              file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

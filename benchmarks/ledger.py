@@ -142,7 +142,8 @@ def _results_md_rows(results_path: str, latest: dict) -> None:
             # extraction is per-key regex, never a naive comma split
             for key in ("ok", "fleet_availability", "fleet_vs_single",
                         "fleet_silently_lost", "coverage",
-                        "availability", "slo_verdict", "reconstructed"):
+                        "availability", "slo_verdict", "reconstructed",
+                        "host_fraction", "parity_ok"):
                 m = re.search(rf"\b{key}=([^,|]+)", details)
                 if not m:
                     continue
@@ -257,6 +258,20 @@ RATCHETS: List[Ratchet] = [
             _const(True), "long-context scenario SLO verdict"),
     Ratchet("workload_json_mode", "workload_json_mode", "ok", "==",
             _const(True), "constrained-decoding scenario SLO verdict"),
+    Ratchet("workload_json_mode_fast", "workload_json_mode_fast", "ok",
+            "==", _const(True),
+            "constrained decoding on the interleave+overlap hot path"),
+    # constrained hot path (ISSUE 16): the on-device DFA walk must beat
+    # convoy admission and answer to the SAME host-fraction ceiling as
+    # unconstrained decode — both thresholds imported from their owners
+    Ratchet("constrained_speedup_floor", "constrained_hotpath", "value",
+            ">=",
+            _t("benchmarks.constrained_hotpath_probe", "SPEEDUP_FLOOR"),
+            "constrained hot-path tokens/sec over the convoy control"),
+    Ratchet("constrained_host_fraction", "constrained_hotpath",
+            "host_fraction", "<=",
+            _t("benchmarks.step_timeline_probe", "HOST_FRACTION_CEIL"),
+            "host-serialization fraction with constraints live"),
     Ratchet("workload_spec_mix", "workload_spec_mix", "ok", "==",
             _const(True), "speculative-mix scenario SLO verdict"),
     Ratchet("workload_lora", "workload_lora", "ok", "==", _const(True),

@@ -91,25 +91,53 @@ def _build():
     # tax is priced on it: interleaved admissions (prefill_chunk_tokens)
     # mean every refill's prompts fold into the first timed steps after
     # it as MIXED steps — the new program shape rides the same <2%
-    # contract. overlap stays off here: the per-step A/B gate flip
-    # needs each timed step's work attributable to that step.
+    # contract. Constrained decoding (ISSUE 16) is live too: one slot
+    # per refill carries a grammar, so the timed loop prices the
+    # on-device DFA walk plus the constrained_slots gauge pushes under
+    # the same budget. overlap stays off here: the per-step A/B gate
+    # flip needs each timed step's work attributable to that step.
     return ContinuousBatcher(cfg, prepared, slots=SLOTS,
                              max_len=cfg.block_size, prompt_pad=16,
-                             prefill_chunk_tokens=16)
+                             prefill_chunk_tokens=16,
+                             allow_constraints=True, constraint_rows=8)
+
+
+_CONSTRAINT = None
+
+
+def _digit_constraint(vocab_size):
+    """Compile-once [0-9]+ grammar over the probe's byte vocab (no eos
+    on this server, so constrained requests run to budget like every
+    other slot — the refill cadence is unchanged)."""
+    global _CONSTRAINT
+    if _CONSTRAINT is None:
+        from dnn_tpu.runtime.constrain import TokenConstraint, byte_vocab
+
+        _CONSTRAINT = TokenConstraint.from_regex(
+            r"[0-9]+", byte_vocab(vocab_size))
+    return _CONSTRAINT
 
 
 def _fill(srv, traced: bool):
     """Fill every free slot; traced legs parent each request's spans
-    under a throwaway root (the served path's shape)."""
+    under a throwaway root (the served path's shape). The FIRST
+    admission of every refill is constrained (ISSUE 16): the timed
+    steps gather its mask row and walk its DFA on device, and the
+    commit path runs the host finish-detection mirror — the
+    constrained hot path priced under the same <2% obs contract."""
     import numpy as np
 
     from dnn_tpu import obs
 
     roots = []
+    first = True
     while srv.free_slots():
         root = obs.start_span("bench.request") if traced else None
         srv.submit(np.arange(1, PROMPT + 1), srv.max_len - PROMPT - 1,
-                   trace=root)
+                   trace=root,
+                   constraint=_digit_constraint(srv.cfg.vocab_size)
+                   if first else None)
+        first = False
         if root is not None:
             roots.append(root)
     return roots
@@ -289,6 +317,9 @@ def _measure_steps(srv) -> dict:
                                 round(off_t[-1 - len(off_t) // 10] * 1e3,
                                       4)],
         "steps_per_population": STEPS, "slots": SLOTS,
+        # ISSUE 16 receipt: the timed loop really carried a grammar
+        # (the StepClock gauge the /stepz scrape now exports)
+        "constrained_slots_live": srv._n_constrained,
     }
 
 

@@ -853,6 +853,37 @@ def dev_step_timeline():
     return results
 
 
+@device_config("constrained_hotpath")
+def dev_constrained_hotpath():
+    # ISSUE 16: constrained decoding on the interleaved+overlap hot
+    # path (on-device DFA walk). Paired legs, both fully grammar-
+    # constrained: convoy admission (the only path constraints had
+    # before the transition-table pool) vs interleave+overlap. Asserted
+    # in the probe: exact token parity between the legs AND against a
+    # pure-host DFA replay, hot tokens/sec >= SPEEDUP_FLOOR x convoy,
+    # and host fraction <= the step_timeline ceiling — constraints
+    # answer to the SAME 0.40 ratchet as unconstrained decode.
+    from benchmarks.constrained_hotpath_probe import (
+        SPEEDUP_FLOOR,
+        measure,
+    )
+    from benchmarks.step_timeline_probe import HOST_FRACTION_CEIL
+
+    results = []
+    row = measure()
+    ok = row.pop("ok")
+    _emit(results, config="constrained_hotpath",
+          metric="vs_convoy_tps", value=row.pop("vs_convoy_tps"),
+          platform=_platform(), ok=ok,
+          note=f"constrained hot-path tokens/sec over the convoy-"
+               f"admission control, all slots grammar-constrained; "
+               f"ASSERTED: token parity (cross-leg + host DFA oracle), "
+               f"speedup >= {SPEEDUP_FLOOR}, host fraction <= "
+               f"{HOST_FRACTION_CEIL:.2f} (the ISSUE 16 ratchet pair)",
+          **row)
+    return results
+
+
 @device_config("substrate")
 def dev_substrate():
     # ROADMAP 5a prep: ONE preflight row that probes the device (the
@@ -928,8 +959,9 @@ DEVICE_CONFIGS.insert(0, DEVICE_CONFIGS.pop(
 # the workload suite (ISSUE 14): one asserted row per scenario
 # ----------------------------------------------------------------------
 
-WORKLOAD_SCENARIOS = ("chat", "longcontext", "json_mode", "spec_mix",
-                      "lora", "breach_chaos")
+WORKLOAD_SCENARIOS = ("chat", "longcontext", "json_mode",
+                      "json_mode_fast", "spec_mix", "lora",
+                      "breach_chaos")
 
 
 def _workload_config(scen: str):

@@ -356,7 +356,7 @@ def audit_serving_decode(cfg=None, *, slots: int = 2,
     def batcher_args(b):
         return (b._decode_view, b.cache, b.pos, b.tok, b.active, b.keys,
                 b._temp, b._topk, b._topp, b._minp, b._rep, b._seen,
-                b._bias, b._crow, b._ctable)
+                b._bias, b._crow, b._ctable, b._ctrans)
 
     variants = {
         "dense_f32": {},
@@ -364,6 +364,15 @@ def audit_serving_decode(cfg=None, *, slots: int = 2,
         "dense_int4": {"kv_dtype": "int4"},
         "bucketed": {"decode_buckets": True},
         "paged": {"kv": "paged"},
+        # constrained decoding (ISSUE 16): the grammar DFA walk is
+        # carried device state — crow joins the donate set, and the
+        # gate must see it aliased (an un-aliased crow would copy per
+        # step; the (S, V) ctable/ctrans pools are read-only gathers
+        # and must NOT appear as cache-sized copies)
+        "dense_constrained": {"allow_constraints": True,
+                              "constraint_rows": 8},
+        "paged_constrained": {"kv": "paged", "allow_constraints": True,
+                              "constraint_rows": 8},
     }
     hd = cfg.n_embd // cfg.n_head
     for name, kw in variants.items():
@@ -374,10 +383,10 @@ def audit_serving_decode(cfg=None, *, slots: int = 2,
                            * b._block_len * hd)
         else:
             layer_elems = slots * cfg.n_head * b._cache_len * hd
-        # donated argnums mirror serving.py's jit construction:
-        # cache, pos, tok, keys, seen
+        # donated argnums mirror serving.py's jit construction
+        # (cache, pos, tok, keys, seen — plus crow when constrained)
         lower_and_check(name, b._decode, batcher_args(b),
-                        (1, 2, 3, 5, 11), layer_elems)
+                        b._decode_donate, layer_elems)
 
     # the speculative step (serving_spec.py): both caches + the per-slot
     # vectors it returns must all alias
@@ -418,12 +427,19 @@ def audit_serving_decode(cfg=None, *, slots: int = 2,
                   jnp.zeros((v,), jnp.bool_),
                   jnp.zeros((v if b._allow_bias else 0,), jnp.float32),
                   jnp.int32(8),
-                  jnp.zeros((nb_max,), jnp.int32))
+                  jnp.zeros((nb_max,), jnp.int32),
+                  b._crow, jnp.int32(0), b._ctable, b._ctrans)
         return m_args, f_args
 
     for name, kw in {"mixed_dense": {},
                      "mixed_paged": {"kv": "paged"},
-                     "mixed_bucketed": {"decode_buckets": True}}.items():
+                     "mixed_bucketed": {"decode_buckets": True},
+                     # ISSUE 16: constrained requests ride the mixed/
+                     # overlap hot path — both the mixed step (carried
+                     # crow donated+aliased) and the fused finish (crow
+                     # scatter-seeded on device) pass the same gate
+                     "mixed_constrained": {"allow_constraints": True,
+                                           "constraint_rows": 8}}.items():
         b = ContinuousBatcher(cfg, prepared, slots=slots, max_len=max_len,
                               prompt_pad=16, prefill_chunk_tokens=p_c,
                               **kw)
@@ -464,6 +480,7 @@ def audit_serving_decode(cfg=None, *, slots: int = 2,
                 jnp.zeros((v,), jnp.bool_),
                 jnp.zeros((v if sbm._allow_bias else 0,), jnp.float32),
                 jnp.int32(8), jnp.zeros((0,), jnp.int32),
+                sbm._crow, jnp.int32(0), sbm._ctable, sbm._ctrans,
                 jnp.zeros((sbm.spec_k + 1,), jnp.int32),
                 sbm.prev_chunk, sbm.prev_pos)
     lower_and_check("mixed_speculative_finish", sbm._spec_ilv_finish,

@@ -237,16 +237,17 @@ def build_parser() -> argparse.ArgumentParser:
                         "request into each decode step (the mixed "
                         "program) instead of convoying the whole "
                         "prefill through submit. 0 (default) keeps the "
-                        "convoy path. Disables JSON-mode constraints "
-                        "(per-token grammar masks need the admission "
-                        "sync the interleave removes)")
+                        "convoy path. JSON-mode constraints ride the "
+                        "interleave (the grammar DFA advances on "
+                        "device)")
     p.add_argument("--overlap", action="store_true",
                    help="--serve_lm: double-buffered dispatch — the "
                         "worker dispatches step N+1's device work "
                         "before committing step N's tokens, hiding "
                         "host bookkeeping under the device step "
-                        "(tokens surface one step later). Disables "
-                        "JSON-mode constraints")
+                        "(tokens surface one step later). JSON-mode "
+                        "constraints ride the overlap (the device DFA "
+                        "walk is idempotent under the replayed step)")
     p.add_argument("--tokenizer", default=None,
                    help="--serve_lm: text endpoint tokenizer — 'bytes' "
                         "(UTF-8 bytes as ids; any vocab >= 256) or a LOCAL "
@@ -921,11 +922,11 @@ def _serve_lm(engine: PipelineEngine, args) -> int:
             availability=args.slo_avail,
             target=args.slo_target
             if args.slo_target is not None else 0.99)
-    overlap_opts = bool(args.prefill_chunk_tokens or args.overlap)
-    if overlap_opts:
+    if args.prefill_chunk_tokens or args.overlap:
         log.info("overlap/interleave serving enabled "
                  "(prefill_chunk_tokens=%d, overlap=%s): JSON-mode "
-                 "constraints are off on this configuration",
+                 "constraints ride this hot path too (the grammar DFA "
+                 "walks on device)",
                  args.prefill_chunk_tokens, args.overlap)
     try:
         rc = asyncio.run(serve_lm(
@@ -952,13 +953,14 @@ def _serve_lm(engine: PipelineEngine, args) -> int:
             # the daemon's clients choose options per request, so the
             # per-slot bias capability is on at this edge — except for
             # speculative serving, whose batcher rejects per-request
-            # bias anyway (the buffer would be dead weight); constraints
-            # (JSON mode, j=) share the buffer and the same gate, and
-            # additionally drop out on the overlap/interleave paths
-            # (per-token grammar masks need the admission/commit syncs
-            # those remove — serving.py documents the restriction)
+            # bias anyway (the buffer would be dead weight). Constraints
+            # (JSON mode, j=) share the gate: on for every dense
+            # configuration INCLUDING overlap/interleave (the grammar
+            # DFA walks on device — serving.py), off only for
+            # speculative serving, whose k-token verify the per-token
+            # masks cannot gate (the batcher rejects constraint= loud).
             allow_logit_bias=not spec_kwargs,
-            allow_constraints=not spec_kwargs and not overlap_opts,
+            allow_constraints=not spec_kwargs,
             **lora_kwargs,
         ))
     except KeyboardInterrupt:

@@ -233,6 +233,12 @@ class StepClock:
         # 1 = the batcher's double-buffered dispatch is live). Set by
         # the producer with one attr store; scraped like every gauge.
         self.overlap_depth = 0
+        # constrained_slots: how many of the producer's live slots hold
+        # a grammar constraint (ISSUE 16: constrained requests ride the
+        # same hot path, so the scrape must say WHEN the host_fraction
+        # it reports covered constraint-live traffic). Set by the
+        # producer at admit/retire with one attr store, never per step.
+        self.constrained_slots = 0
         self._gauges = {
             "step.dispatch_slack": _weak("dispatch_slack"),
             "step.sync_tax": _weak("sync_tax"),
@@ -240,6 +246,7 @@ class StepClock:
             "step.per_sec": _weak("steps_per_sec"),
             "step.last_wall_ms": _weak("last_wall_ms"),
             "step.overlap_depth": _weak("_overlap_depth_read"),
+            "step.constrained_slots": _weak("_constrained_slots_read"),
         }
 
     def install(self) -> "StepClock":
@@ -400,6 +407,9 @@ class StepClock:
     def _overlap_depth_read(self) -> float:
         return float(self.overlap_depth)
 
+    def _constrained_slots_read(self) -> float:
+        return float(self.constrained_slots)
+
     def last_wall_ms(self) -> float:
         with self._lock:
             if not self._ring:
@@ -455,6 +465,9 @@ class StepClock:
             # the producer's dispatch-pipeline depth (0 = no overlap,
             # 1 = double-buffered dispatch live)
             "overlap_depth": self.overlap_depth,
+            # live slots holding a grammar constraint — says whether
+            # the window's host_fraction covered constrained traffic
+            "constrained_slots": self.constrained_slots,
             "phases": phases,
             "device_s": round(dev, 6),
             "host_s": round(host, 6),
@@ -496,7 +509,7 @@ class StepClock:
         for k in ("steps_total", "window_steps", "window_wall_s",
                   "host_fraction", "dispatch_slack", "sync_tax",
                   "steps_per_sec", "last_wall_ms", "mixed_steps",
-                  "overlap_depth"):
+                  "overlap_depth", "constrained_slots"):
             m.set(f"dnn_tpu_step_{k}", float(s[k]))
         for p, d in s["phases"].items():
             m.set(labeled("dnn_tpu_step_phase_seconds_total", phase=p),

@@ -546,6 +546,24 @@ class TokenConstraint:
             tab[:, eos_id] = self.accepting.astype(bool)
         return tab
 
+    def trans_table(self, eos_id: Optional[int]) -> np.ndarray:
+        """(S, V) int32 LOCAL next-state table with SELF-LOOP closure —
+        the device-resident walk form: next[s, t] = advance(s, t) where
+        the grammar allows t, s otherwise. Dead transitions never index
+        out of range (masking already bans those tokens; the self-loop
+        makes the walk total), and the EOS column holds the state — a
+        sampled EOS retires on host, and under the overlap pipeline the
+        one garbage step dispatched past it must be idempotent. Both
+        closures make replaying any masked-off token a no-op, which is
+        exactly what the one-step dispatch pipeline needs: a stale step
+        can never corrupt a slot's DFA state, only re-derive it."""
+        S = self.table.shape[0]
+        hold = np.arange(S, dtype=np.int32)[:, None]
+        tab = np.where(self.allowed, self.table, hold).astype(np.int32)
+        if eos_id is not None:
+            tab[:, eos_id] = hold[:, 0]
+        return tab
+
 
 # ----------------------------------------------------------------------
 # JSON mode

@@ -531,16 +531,26 @@ class ContinuousBatcher:
         self._bias = (jnp.zeros((slots, cfg.vocab_size), jnp.float32)
                       if self._allow_bias
                       else jnp.zeros((slots, 0), jnp.float32))
-        # constrained decoding (runtime/constrain.TokenConstraint) rides a
-        # DEVICE-RESIDENT mask-table pool: each grammar's (S, V) allowed
-        # table uploads ONCE into `_ctable` (bool rows; row 0 reserved
-        # all-True = unconstrained), and the decode program gathers each
-        # slot's current row by the per-slot state vector `_crow` — the
-        # only per-step host->device constraint traffic is that (slots,)
-        # int32 vector (the host walks the DFA one int per committed
-        # token for finish detection). `constraint_rows` bounds the pool
-        # (bool bytes: rows x vocab — 1024 x 50257 ≈ 51 MB); entries are
-        # refcounted by live slots and evicted LRU when unreferenced.
+        # constrained decoding (runtime/constrain.TokenConstraint) rides
+        # DEVICE-RESIDENT table pools: each grammar uploads ONCE into
+        #   * `_ctable` (S, V) bool mask rows — what the decode program
+        #     gathers per slot to ban off-grammar logits (row 0 reserved
+        #     all-True = unconstrained), and
+        #   * `_ctrans` (S, V) int32 next-state rows in GLOBAL pool
+        #     coordinates — the DFA walk itself, so the decode program
+        #     advances each slot's state `crow' = ctrans[crow, sampled]`
+        #     in the same dispatch that sampled the token (row 0 all-zero
+        #     = the unconstrained self-loop).
+        # The per-slot state vector `_crow` is CARRIED DEVICE STATE,
+        # donated through the step exactly like pos/tok/keys — there is
+        # NO per-step host->device constraint traffic at all, which is
+        # what lets constrained requests ride the interleaved/overlap
+        # hot path (the host still mirrors the walk per committed token
+        # for finish detection, off the dispatch critical path).
+        # `constraint_rows` bounds both pools (bytes: rows x vocab x 1
+        # bool + rows x vocab x 4 int32 — 1024 x 50257 ≈ 51 + 206 MB);
+        # entries are refcounted by live slots, evicted LRU when
+        # unreferenced.
         self._ctab_rows = int(constraint_rows) if self._allow_constraints \
             else 0
         if self._allow_constraints:
@@ -549,15 +559,16 @@ class ContinuousBatcher:
                     f"constraint_rows must be >= 2, got {constraint_rows}")
             self._ctable = jnp.ones(
                 (self._ctab_rows, cfg.vocab_size), jnp.bool_)
+            self._ctrans = jnp.zeros(
+                (self._ctab_rows, cfg.vocab_size), jnp.int32)
             from collections import OrderedDict as _OD
 
             # id(constraint) -> {"off", "n", "refs", "c"} in LRU order
             self._ctab_entries: dict = _OD()
         else:
             self._ctable = jnp.ones((1, 0), jnp.bool_)
-        self._crow_np = np.zeros((slots,), np.int32)
-        self._crow = jnp.asarray(self._crow_np)
-        self._crow_dirty = False
+            self._ctrans = jnp.zeros((1, 0), jnp.int32)
+        self._crow = jnp.zeros((slots,), jnp.int32)
 
         # host bookkeeping
         self._next_rid = 0
@@ -582,6 +593,10 @@ class ContinuousBatcher:
         # and the clock itself gates on DNN_TPU_OBS (begin() returns
         # None when off).
         self.step_clock = None
+        # live slots holding a grammar constraint — pushed to the
+        # StepClock's constrained_slots gauge at admit/retire (one attr
+        # store per transition, nothing per step)
+        self._n_constrained = 0
         # scrape-time callable gauges, (re-)registered with every bulk
         # update below: the most recently ACTIVE pool owns the series —
         # a once-only registration would let a dead pool keep reporting,
@@ -706,17 +721,23 @@ class ContinuousBatcher:
             return chosen_lp, top_lp, top_ids.astype(jnp.int32)
 
         def _decode_core(prepared, cache, pos, tok, active, keys,
-                         temp, tk, tp, mp, rep, seen, bias, crow, ctable):
+                         temp, tk, tp, mp, rep, seen, bias, crow, ctable,
+                         ctrans):
             """Advance every active slot one token (per-slot sampling
             parameters — see _sample_rows; `rep`/`seen` drive the
             repetition penalty, `mp` the min-p cutoff, `bias` (B, V) the
             per-slot additive logit bias, `crow` (B,) the per-slot
             constraint-table row index into the device-resident bool
             mask pool `ctable` — row 0 is the reserved all-allowed
-            row, so unconstrained slots add nothing). Shared by the
-            plain decode step and the MIXED step (decode + one
-            interleaved prefill chunk in the same compiled program),
-            so the two paths' decode math is identical by
+            row, so unconstrained slots add nothing). The grammar walk
+            happens HERE too: `ctrans` holds each grammar's next-state
+            rows in global pool coordinates, so the step returns
+            `crow' = ctrans[crow, sampled]` as donated carried state —
+            no host sync between steps, which is what admits
+            constrained requests to the interleaved/overlap hot path.
+            Shared by the plain decode step and the MIXED step (decode
+            + one interleaved prefill chunk in the same compiled
+            program), so the two paths' decode math is identical by
             construction — the mixed==convoy token-parity contract."""
             logits, new_cache = self.family.decode_rows(
                 prepared, cache, tok, pos, active, codec)
@@ -745,8 +766,15 @@ class ContinuousBatcher:
             new_keys = jnp.where(active[:, None], new_keys, keys)
             seen_upd = seen.at[jnp.arange(b), nxt].set(True)
             new_seen = jnp.where(active[:, None], seen_upd, seen)
+            if self._allow_constraints:
+                # device DFA walk: self-loop closure (trans_table) makes
+                # the gather total over masked-off tokens AND eos, so a
+                # stale overlap step replays to the same state
+                new_crow = jnp.where(active, ctrans[crow, nxt], crow)
+            else:
+                new_crow = crow
             out = (new_cache, pos + active.astype(jnp.int32), nxt, new_keys,
-                   new_seen)
+                   new_seen, new_crow)
             if logprobs_k:
                 # logprobs report the MODEL's distribution (pre-penalty,
                 # pre-temperature — the usual serving-API convention)
@@ -754,14 +782,15 @@ class ContinuousBatcher:
             return out
 
         def decode_step(prepared, cache, pos, tok, active, keys,
-                        temp, tk, tp, mp, rep, seen, bias, crow, ctable):
+                        temp, tk, tp, mp, rep, seen, bias, crow, ctable,
+                        ctrans):
             return _decode_core(prepared, cache, pos, tok, active, keys,
                                 temp, tk, tp, mp, rep, seen, bias, crow,
-                                ctable)
+                                ctable, ctrans)
 
         def mixed_step(prepared, pf_prepared, cache, pos, tok, active,
                        keys, temp, tk, tp, mp, rep, seen, bias, crow,
-                       ctable, row, chunk, chunk_start):
+                       ctable, ctrans, row, chunk, chunk_start):
             """One INTERLEAVED step (ISSUE 12): the decode leg advances
             every active slot exactly as decode_step, and the same
             compiled program folds one prompt chunk of an admitting
@@ -775,7 +804,7 @@ class ContinuousBatcher:
             `prepared` otherwise)."""
             out = _decode_core(prepared, cache, pos, tok, active, keys,
                                temp, tk, tp, mp, rep, seen, bias, crow,
-                               ctable)
+                               ctable, ctrans)
             pf_logits, new_row = self.family.prefill(
                 pf_prepared, chunk, row, chunk_start)
             return out + (pf_logits, new_row)
@@ -838,13 +867,20 @@ class ContinuousBatcher:
         # step at real sizes). The call sites reassign from the results,
         # so the donated inputs are never reused. Alongside the cache:
         # every per-slot state vector the step RETURNS (pos, tok, keys,
-        # seen) — `active`, `bias`, `crow` and `ctable` are read-only
-        # through the step (host-updated between calls) and must NOT be
-        # donated. Full aliasing of every donated leaf is a standing
+        # seen — and `crow` on constrained servers, where the DFA walk
+        # makes it carried device state) — `active`, `bias`, `ctable`
+        # and `ctrans` are read-only through the step (host-updated
+        # between calls) and must NOT be donated; on UNconstrained
+        # servers `crow` is a read-only pass-through too (the core
+        # returns it untouched), so donating it would be an un-aliasable
+        # copy. Full aliasing of every donated leaf is a standing
         # invariant, asserted statically by the analysis gate
         # (dnn_tpu/analysis/program.audit_serving_decode via
         # hlo_audit.count_aliased).
-        self._decode = jax.jit(decode_step, donate_argnums=(1, 2, 3, 5, 11))
+        self._decode_donate = (1, 2, 3, 5, 11) + (
+            (13,) if self._allow_constraints else ())
+        self._decode = jax.jit(decode_step,
+                               donate_argnums=self._decode_donate)
         self._prefill_chunk = jax.jit(prefill_chunk, donate_argnums=(1,))
         # the transient row (arg 1) is SLICED into the pool, never
         # returned whole — donating it aliases nothing (an unusable
@@ -941,13 +977,11 @@ class ContinuousBatcher:
                     f"prefill_chunk_tokens {self._ilv} must tile "
                     f"block_len {self._block_len} (prefill rows install "
                     "whole blocks)")
-            if self._allow_constraints:
-                raise ValueError(
-                    "prefill_chunk_tokens does not compose with "
-                    "allow_constraints: the admission DFA must be walked "
-                    "on host before the slot's next dispatch, which is "
-                    "exactly the sync the interleave removes — "
-                    "constrained serving keeps the convoy admission path")
+            # allow_constraints composes with interleaved admission
+            # since the DFA walk moved on device: the fused finish masks
+            # the first token with the grammar's start row and seeds the
+            # slot's crow in-program — no admission-time host walk, no
+            # sync. (It used to reject loud here.)
             if self._prefix_cache is not None \
                     or self._prefix_store is not None:
                 raise ValueError(
@@ -964,11 +998,12 @@ class ContinuousBatcher:
         # StepClock measured, actually spent. Tokens surface one step()
         # call later; drain()/flush_overlap() commit the trailing step.
         self._overlap = bool(overlap)
-        if self._overlap and self._allow_constraints:
-            raise ValueError(
-                "overlap=True does not compose with allow_constraints: "
-                "dispatching step N+1 before step N's tokens reach the "
-                "host would run the grammar mask one state stale")
+        # allow_constraints composes with the one-step pipeline since
+        # the DFA walk moved on device: step N+1's mask row comes from
+        # the crow that step N's program computed and carried — never
+        # one state stale. The one garbage step dispatched past a
+        # retirement replays through trans_table's self-loop closure
+        # and is overwritten at commit, like tok/active.
         self._pending_q: List[int] = []   # slots awaiting interleaved
         # prefill, FIFO (one chunk folds per step)
         self._inflight = None             # overlap: the dispatched,
@@ -992,7 +1027,8 @@ class ContinuousBatcher:
             # donate the decode leg's state exactly as _decode does, plus
             # the prefill leg's transient row — audited like every other
             # decode program (analysis/program.audit_serving_decode)
-            self._mixed_donate = (2, 3, 4, 6, 12, 16)
+            self._mixed_donate = (2, 3, 4, 6, 12, 17) + (
+                (14,) if self._allow_constraints else ())
             self._mixed = jax.jit(mixed_step,
                                   donate_argnums=self._mixed_donate)
 
@@ -1000,24 +1036,31 @@ class ContinuousBatcher:
                            slot_key, pos, tok, active, keys, temp_v,
                            tk_v, tp_v, mp_v, rep_v, seen, bias_buf,
                            t, k, p, mp_, rp, seen_row, b_row,
-                           prompt_len, install_ids):
+                           prompt_len, install_ids, crow, c_row,
+                           ctable, ctrans):
                 """Fused admission finish: sample the first token from
                 the final chunk's true-last logit row (the request's own
                 temperature/top-k/top-p/min-p/repetition params and rng
                 stream — the same math as the convoy prefill_finish, so
                 sampled streams agree draw-for-draw), install the row
                 cache into `slot`, and scatter EVERY per-slot state
-                vector (pos/tok/active/keys/sampling params/seen/bias)
-                in the same program. Only the sampled token id (+
-                logprobs when compiled in) ever crosses to host, and
-                even that readback is deferred to the next step's
-                commit — admission costs zero blocking syncs."""
+                vector (pos/tok/active/keys/sampling params/seen/bias
+                — and the slot's DFA state: `c_row` (scalar) is the
+                grammar's global start row, masking the FIRST token and
+                seeding `crow[slot] = ctrans[c_row, first]` on device,
+                so constrained interleaved admission never syncs). Only
+                the sampled token id (+ logprobs when compiled in) ever
+                crosses to host, and even that readback is deferred to
+                the next step's commit — admission costs zero blocking
+                syncs."""
                 lg = logits[:, last_local][0:1]  # (1, V)
                 raw = lg
                 lg = apply_repetition_penalty(
                     lg, (rp != 1.0) & seen_row[None, :], rp)
                 if self._allow_bias:
                     lg = lg + b_row[None, :]
+                if self._allow_constraints:
+                    lg = jnp.where(ctable[c_row][None, :], lg, _NEG_BIG)
                 first = _sample_rows(
                     lg, rng[None], temperature=t[None], top_k=k[None],
                     top_p=p[None], min_p=mp_[None],
@@ -1038,8 +1081,10 @@ class ContinuousBatcher:
                 seen = seen.at[slot].set(seen_row.at[first].set(True))
                 if self._allow_bias:
                     bias_buf = bias_buf.at[slot].set(b_row)
+                if self._allow_constraints:
+                    crow = crow.at[slot].set(ctrans[c_row, first])
                 out = (cache, pos, tok, active, keys, temp_v, tk_v,
-                       tp_v, mp_v, rep_v, seen, bias_buf, first)
+                       tp_v, mp_v, rep_v, seen, bias_buf, crow, first)
                 if logprobs_k:
                     out += _lp_outputs(raw, first[None])
                 return out
@@ -1051,10 +1096,14 @@ class ContinuousBatcher:
             # (active included — the finish RETURNS it, unlike the decode
             # step where it is host-updated between calls); the transient
             # row is sliced, never returned whole (the prefill_finish
-            # lesson), and the bias buffer only when it is real
+            # lesson), the bias buffer only when it is real, and crow
+            # only on constrained servers (unconstrained finishes return
+            # it untouched — an un-aliasable donation)
             donate = [0, 7, 8, 9, 10, 11, 12, 13, 14, 15, 16]
             if self._allow_bias:
                 donate.append(17)
+            if self._allow_constraints:
+                donate.append(27)
             self._ilv_finish_donate = tuple(donate)
             self._ilv_finish = jax.jit(
                 ilv_finish, donate_argnums=self._ilv_finish_donate)
@@ -1510,7 +1559,18 @@ class ContinuousBatcher:
                            "install_ids": install_ids
                            if install_ids is not None
                            else jnp.zeros((0,), jnp.int32),
+                           # the grammar's global start row: the fused
+                           # finish masks the first token with it and
+                           # seeds crow[slot] on device (0 = the
+                           # reserved unconstrained row)
+                           "c_row": (0 if c_off is None
+                                     else c_off + constraint.start),
                        }}
+                if constraint is not None:
+                    req["constraint"] = constraint
+                    req["c_state"] = constraint.start
+                    req["c_off"] = c_off
+                    self._note_constrained(+1)
                 if req["logprobs"]:
                     req["lp"] = []
                     req["lp_top"] = []
@@ -1718,6 +1778,7 @@ class ContinuousBatcher:
                 req["constraint"] = constraint
                 req["c_state"] = constraint.start
                 req["c_off"] = c_off
+                self._note_constrained(+1)
             if req["logprobs"]:
                 req["lp"] = [float(np.asarray(c_lp)[0])]
                 req["lp_top"] = [(np.asarray(t_ids)[0], np.asarray(t_lp)[0])]
@@ -1733,7 +1794,18 @@ class ContinuousBatcher:
                 req["install_step"] = self._step_idx - 1
             self._slot_req[slot] = req
             if constraint is not None:
+                # convoy admission is the one place the host seeds the
+                # device walk: the first token was sampled by
+                # _prefill_finish (masked with the grammar's start row)
+                # and read back above, so mirror-walk it and install
+                # the post-first-token state — every later advance
+                # happens inside the decode program. Prefix-cache /
+                # kvtier / prefilled adoption changes nothing: the
+                # grammar constrains GENERATED tokens only, so the
+                # adopted prefix's state is still `start`.
                 self._constraint_advance(slot, first)
+                self._crow = self._crow.at[slot].set(
+                    jnp.int32(c_off + req["c_state"]))
             # a prompt longer than the window rolls blocks out at install
             self._free_rolled_blocks(slot)
             self._retire_if_done(slot)
@@ -2298,6 +2370,13 @@ class ContinuousBatcher:
             off = _free_gap()
         self._ctable = self._ctable.at[off:off + n].set(
             jnp.asarray(c.mask_table(self.eos_id)))
+        # transition rows upload in GLOBAL pool coordinates (local next
+        # state + this grammar's offset), so the decode program's walk
+        # `ctrans[crow, tok]` needs no per-grammar rebase — and the
+        # functional .at[].set means an in-flight overlap step keeps
+        # its own (pre-upload) buffers untouched
+        self._ctrans = self._ctrans.at[off:off + n].set(
+            jnp.asarray(c.trans_table(self.eos_id) + np.int32(off)))
         self._ctab_entries[key] = {"off": off, "n": n, "refs": 1, "c": c}
         return off
 
@@ -2332,12 +2411,15 @@ class ContinuousBatcher:
         req["freed"] = n_dead
 
     def _constraint_advance(self, slot: int, token: int):
-        """Walk a constrained slot's DFA over the token it just committed
-        and point the slot's device state-row at the new state (the
-        (slots,) int32 vector is flushed once per step — the only
-        per-step host->device constraint traffic). Sets `c_done` when the
-        match is complete with no possible continuation (retires as
-        "constraint" — the grammar, not the budget, ended the stream)."""
+        """HOST MIRROR of the device DFA walk, for finish detection
+        only: the device already advanced `crow[slot]` in the step (or
+        fused finish) that sampled `token` — this walks the same
+        transition on host bookkeeping so retirement logic can ask
+        "is the match complete with no continuation?". Sets `c_done`
+        when nothing can extend the match and EOS can't express the
+        stop (retires as "constraint" — the grammar, not the budget,
+        ended the stream). Runs at commit, OFF the dispatch critical
+        path: zero per-step host->device constraint traffic."""
         req = self._slot_req[slot]
         c = req.get("constraint")
         if c is None or (self.eos_id is not None and token == self.eos_id):
@@ -2353,9 +2435,6 @@ class ContinuousBatcher:
                 self.eos_id is None or not c.is_accepting(ns)):
             # nothing can extend the match and EOS can't express the stop
             req["c_done"] = True
-            return
-        self._crow_np[slot] = req["c_off"] + ns
-        self._crow_dirty = True
 
     # ------------------------------------------------------------------
     # observability helpers (dnn_tpu/obs) — shared by the dense step and
@@ -2576,16 +2655,26 @@ class ContinuousBatcher:
         self.active = self.active.at[slot].set(False)
         self._obs_retire(req, reason)
 
+    def _note_constrained(self, delta: int):
+        """Track the live constrained-slot count and mirror it onto the
+        attached StepClock's scrape-time gauge (obs/timeline.py)."""
+        self._n_constrained += delta
+        sc = self.step_clock
+        if sc is not None:
+            sc.constrained_slots = self._n_constrained
+
     def _release_slot_constraint(self, slot: int, req: dict):
         """Drop a retiring slot's constraint: refcount down, device
-        state-row back to the reserved all-allowed row 0."""
+        state-row back to the reserved all-allowed row 0 (a functional
+        edit of the CURRENT crow buffer — under overlap that is the
+        in-flight step's OUTPUT, already unpacked at dispatch, so the
+        reset lands before the next dispatch reads it)."""
         c = req.get("constraint")
         if c is None:
             return
         self._ctab_release(c)
-        if self._crow_np[slot] != 0:
-            self._crow_np[slot] = 0
-            self._crow_dirty = True
+        self._crow = self._crow.at[slot].set(0)
+        self._note_constrained(-1)
 
     def claim(self, rid: int):
         """Pop a finished (or cancelled) request's COMPLETE record —
@@ -2700,11 +2789,13 @@ class ContinuousBatcher:
             jnp.float32(p["t"]), jnp.int32(p["k"]), jnp.float32(p["p"]),
             jnp.float32(p["mp"]), jnp.float32(p["rp"]),
             p["seen_row"], p["b_row"],
-            jnp.int32(req["prompt_len"]), p["install_ids"])
+            jnp.int32(req["prompt_len"]), p["install_ids"],
+            self._crow, jnp.int32(p["c_row"]),
+            self._ctable, self._ctrans)
         (self.cache, self.pos, self.tok, self.active, self.keys,
          self._temp, self._topk, self._topp, self._minp, self._rep,
-         self._seen, self._bias, first) = fin[:13]
-        req["first_dev"] = (first, fin[13:] if req["logprobs"] else None)
+         self._seen, self._bias, self._crow, first) = fin[:14]
+        req["first_dev"] = (first, fin[14:] if req["logprobs"] else None)
         req["install_step"] = s_idx
         del req["pending"]
 
@@ -2746,6 +2837,10 @@ class ContinuousBatcher:
                         # prefill goodput is credited when its first
                         # token commits (the convoy path: at submit)
                         g.on_prefill(req["prompt_len"])
+                    if "constraint" in req:
+                        # host mirror of the walk the fused finish
+                        # already did on device (finish detection only)
+                        self._constraint_advance(slot, tok0)
                     self._free_rolled_blocks(slot)
                     self._retire_if_done(slot)
             if self._slot_req[slot] is req:
@@ -2758,8 +2853,8 @@ class ContinuousBatcher:
                 self._obs_commit(req, m, t_now, n_new=len(committed),
                                  samples=it_samples)
                 if "constraint" in req:
-                    # host DFA walk updates the (slots,) state vector
-                    # only; the mask rows live on device (_ctable)
+                    # host mirror of the device walk — finish
+                    # detection only, never a device write
                     self._constraint_advance(slot, token)
                 self._free_rolled_blocks(slot)  # windowed pools reclaim
                 self._retire_if_done(slot)
@@ -2855,9 +2950,6 @@ class ContinuousBatcher:
             need = self._uncommitted_need(1)
             if need:
                 self._ensure_cache_len(need)
-        if self._crow_dirty:
-            self._crow = jnp.asarray(self._crow_np)
-            self._crow_dirty = False
         ilv = self._ilv_next() if self._ilv else None
         if rec is not None:
             rec.marks.append(("host", time.perf_counter()))
@@ -2871,7 +2963,7 @@ class ContinuousBatcher:
         state = (self.cache, self.pos, self.tok, self.active, self.keys,
                  self._temp, self._topk, self._topp, self._minp,
                  self._rep, self._seen, self._bias, self._crow,
-                 self._ctable)
+                 self._ctable, self._ctrans)
         with _prof_annotation("serving.decode_step"):
             if ilv is None:
                 res = self._decode(self._decode_view, *state)
@@ -2894,10 +2986,11 @@ class ContinuousBatcher:
         lp_refs = None
         if self._logprobs_k:
             (self.cache, self.pos, self.tok, self.keys, self._seen,
-             c_lp_d, t_lp_d, t_ids_d) = res
+             self._crow, c_lp_d, t_lp_d, t_ids_d) = res
             lp_refs = (c_lp_d, t_lp_d, t_ids_d)
         else:
-            self.cache, self.pos, self.tok, self.keys, self._seen = res
+            (self.cache, self.pos, self.tok, self.keys, self._seen,
+             self._crow) = res
         s_idx = self._step_idx
         self._step_idx += 1
         if ilv is not None:

@@ -353,13 +353,14 @@ class SpeculativeBatcher(ContinuousBatcher):
                                 tok, active, keys, temp_v, tk_v, tp_v,
                                 mp_v, rep_v, seen, bias_buf, t, kk_, p,
                                 mp_, rp, seen_row, b_row, prompt_len,
-                                install_ids, tail, prev_chunk,
-                                prev_pos):
+                                install_ids, crow, c_row, ctable,
+                                ctrans, tail, prev_chunk, prev_pos):
                 out = parent_fin(cache, row, logits, last_local, slot,
                                  rng, slot_key, pos, tok, active, keys,
                                  temp_v, tk_v, tp_v, mp_v, rep_v, seen,
                                  bias_buf, t, kk_, p, mp_, rp, seen_row,
-                                 b_row, prompt_len, install_ids)
+                                 b_row, prompt_len, install_ids, crow,
+                                 c_row, ctable, ctrans)
                 # draft-row install: the one shared clamped install
                 # (serving.install_dense_row)
                 d_cache = install_dense_row(d_cache, d_row, slot)
@@ -369,8 +370,12 @@ class SpeculativeBatcher(ContinuousBatcher):
                 prev_pos = prev_pos.at[slot].set(prompt_len - kk1)
                 return out + (d_cache, prev_chunk, prev_pos)
 
+            # the spec batcher never enables constraints
+            # (_constraints_ok=False), so the parent core passes crow
+            # through untouched — not donated (args 29-32 are the
+            # constraint tail, all placeholders here)
             donate = [0, 1, 9, 10, 11, 12, 13, 14, 15, 16, 17, 18,
-                      30, 31]
+                      34, 35]
             if self._allow_bias:
                 donate.append(19)
             self._spec_ilv_finish_donate = tuple(sorted(donate))
@@ -525,14 +530,16 @@ class SpeculativeBatcher(ContinuousBatcher):
             jnp.float32(p["t"]), jnp.int32(p["k"]), jnp.float32(p["p"]),
             jnp.float32(p["mp"]), jnp.float32(p["rp"]),
             p["seen_row"], p["b_row"], jnp.int32(req["prompt_len"]),
-            p["install_ids"], p["tail"], self.prev_chunk, self.prev_pos)
+            p["install_ids"], self._crow, jnp.int32(0),
+            self._ctable, self._ctrans,
+            p["tail"], self.prev_chunk, self.prev_pos)
         (self.cache, self.pos, self.tok, self.active, self.keys,
          self._temp, self._topk, self._topp, self._minp, self._rep,
-         self._seen, self._bias, first) = fin[:13]
+         self._seen, self._bias, self._crow, first) = fin[:14]
         # the parent core appends logprob outputs only when logprobs_k
         # is compiled in — the spec batcher bans it, so the tail is
         # exactly (d_cache, prev_chunk, prev_pos)
-        self.d_cache, self.prev_chunk, self.prev_pos = fin[13:]
+        self.d_cache, self.prev_chunk, self.prev_pos = fin[14:]
         req["first_dev"] = (first, None)
         req["install_step"] = s_idx
         del req["pending"]

@@ -23,6 +23,10 @@ The registry (`SCENARIOS`) covers ROADMAP item 5b's diversity list:
                vocab) under a BURSTY envelope — constrained decoding at
                load, the per-step DFA walk paying rent while arrivals
                spike;
+  json_mode_fast
+               the SAME constrained population on the interleaved+
+               overlap hot path (on-device DFA walk, ISSUE 16) —
+               json_mode is its convoy-admission control row;
   spec_mix     a speculative server (int8 self-draft, the repo's
                standard pair) under a mixed client population — draft
                acceptance meets heterogeneous budgets. (Beam search
@@ -290,6 +294,51 @@ def _make_json_mode(light: bool) -> Scenario:
         settle_s=8.0)
 
 
+def _make_json_mode_fast(light: bool) -> Scenario:
+    """json_mode's script on the HOT path (ISSUE 16): the same bursty
+    grammar-constrained population, served by an interleaved-admission +
+    overlap server — the composition the on-device DFA walk unlocked
+    (constrained x chunked prefill x one-step pipelining; prefix cache
+    stays off: ilv x prefix reuse is still rejected loud). The paired
+    `json_mode` row is the convoy-admission control; the ledger ratchets
+    the throughput ratio and the host fraction via
+    benchmarks/constrained_hotpath_probe.py."""
+    dur = 4.0 if light else 10.0
+    base = 1.5 if light else 2.0
+    cfg = _cfg()
+    slo = SLOSpec(ttft_s=3.0, itl_s=1.5, availability=0.98,
+                  goodput_floor_tps=1.0)
+
+    def build():
+        return _lm_server(cfg, _prepared(cfg), allow_constraints=True,
+                          constraint_rows=8, temperature=1.0,
+                          prefill_chunk_tokens=PROMPT_PAD, overlap=True,
+                          slo_spec=slo)
+
+    def script(seed: int):
+        from dnn_tpu.runtime.constrain import TokenConstraint, byte_vocab
+
+        cons = TokenConstraint.from_regex(r"[0-9]+", byte_vocab(VOCAB))
+        arrivals = bursty_arrivals(base, dur, seed=seed,
+                                   burst_factor=3.0, period_s=dur,
+                                   name="jsonf:arr")
+        out = []
+        for i, at in enumerate(arrivals):
+            out.append(Request(
+                at=at, prompt=_tokens(seed, f"jsonf:prompt:{i}", 6),
+                max_new=6, client=f"c{i % 4}", seed=3000 + i,
+                opts={"constraint": cons, "temperature": 1.0}))
+        return out
+
+    return Scenario(
+        name="json_mode_fast",
+        description="grammar-constrained decoding on the interleaved+"
+                    "overlap hot path (device-side DFA walk), bursty "
+                    "envelope",
+        slo=slo, duration_s=dur, script=script, build_server=build,
+        settle_s=8.0)
+
+
 def _make_spec_mix(light: bool) -> Scenario:
     dur = 4.0 if light else 10.0
     rate = 2.0 if light else 3.0
@@ -407,6 +456,7 @@ SCENARIOS: Dict[str, Callable[[bool], Scenario]] = {
     "chat": _make_chat,
     "longcontext": _make_longcontext,
     "json_mode": _make_json_mode,
+    "json_mode_fast": _make_json_mode_fast,
     "spec_mix": _make_spec_mix,
     "lora": _make_lora,
     "breach_chaos": _make_breach_chaos,

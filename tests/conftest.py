@@ -109,6 +109,13 @@ _MODULE_COST_S = {
     # quantized byte accounting — certified inside the tier-1 budget
     "test_spec_buckets": 36.0,  # speculative x bucketed composition
     # parity (greedy + sampled, rung crossings, draft-pool lockstep)
+    "test_constrained_hotpath": 56.2,  # ISSUE 16 on-device grammar
+    # walk: constrained mixed/overlap token parity vs convoy (dense/
+    # paged/bucketed, mid-decode admission, rung crossing, multi-
+    # grammar pool, EOS-at-accept), overlap ordering + crow reset,
+    # prefix-cache DFA-state adoption, loud spec rejection, transition-
+    # pool LRU golden — measured cost (nine parity server builds
+    # dominate); sorts with the heavy serving integration modules
     "test_overlap": 50.0,  # ISSUE 12 overlap & fusion: mixed-step token
     # parity vs the convoy path (dense/paged/bucketed/speculative,
     # sampled draw-for-draw, mid-decode admission), double-buffer

@@ -342,7 +342,7 @@ def test_constraints_need_no_bias_buffer():
     if req is not None:  # still live: device row tracks the host state
         assert int(np.asarray(srv._crow)[0]) == off + req["c_state"]
     srv.drain()
-    assert int(srv._crow_np[0]) == 0  # released back to the zero row
+    assert int(np.asarray(srv._crow)[0]) == 0  # released back to the zero row
 
 
 def test_choice_constraint_picks_exactly_one_label():
