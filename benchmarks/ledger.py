@@ -272,6 +272,12 @@ RATCHETS: List[Ratchet] = [
             "host_fraction", "<=",
             _t("benchmarks.step_timeline_probe", "HOST_FRACTION_CEIL"),
             "host-serialization fraction with constraints live"),
+    # the static-analysis gate (ISSUE 17): the CI gate's wall time is a
+    # perf surface too — every new pass (the sharded-program audit most
+    # recently) pays against this ceiling instead of silently growing
+    Ratchet("analysis_gate_wall_s", "analysis_gate", "value", "<=",
+            _t("benchmarks.run_all", "ANALYSIS_GATE_WALL_CEIL_S"),
+            "full `python -m dnn_tpu.analysis` gate wall seconds"),
     Ratchet("workload_spec_mix", "workload_spec_mix", "ok", "==",
             _const(True), "speculative-mix scenario SLO verdict"),
     Ratchet("workload_lora", "workload_lora", "ok", "==", _const(True),

@@ -19,10 +19,21 @@ CLI paths), plus one runtime companion:
     (reachability, no absorbing non-terminal state) and cross-checked
     against their code transition sites in both directions.
   * program pass (analysis/program.py) — rules PRG001..PRG004 over the
-    REAL entrypoints' jaxprs/lowerings: collective-sequence consistency
-    across pipeline stage programs, allocation-sized baked constants,
-    cache-donation coverage, and a recompile census with the bucketed
-    decode's ladder bound. Device-free (eval_shape avals), CPU-only.
+    REAL entrypoints' jaxprs/lowerings: mesh-axis-aware collective-
+    sequence consistency across pipeline stage programs, allocation-
+    sized baked constants, cache-donation coverage, and a recompile
+    census (bucketed decode ladder bound; pipeline/transport pinned at
+    one program). Device-free (eval_shape avals), CPU-only.
+  * sharding pass (analysis/shardcheck.py) — rules SHD001..SHD009:
+    SHD001-006 are AST rules merged into the lint walk (hard-coded
+    device-count arithmetic, mesh-axis-name drift, sharded-in/
+    replicated-out shard_maps, host materialization reachable from spmd
+    bodies, per-host RNG divergence, donation/output sharding
+    mismatch); SHD007-009 fire from a compiled audit of the REAL
+    sharded programs (zero1 train step, llama dp x tp, stacked
+    pipeline, moe EP): allocation-sized collectives, the per-shard
+    memory bill, and conformance to sharding contracts declared next
+    to the code with `shardcheck.contract`.
   * loop-lag sanitizer (analysis/sanitize.py) — the RUNTIME companion
     for blocking calls no per-module AST pass can see through an
     indirection: an env-gated event-loop self-timer emitting bounded
@@ -43,6 +54,8 @@ from dnn_tpu.analysis.findings import (  # noqa: F401
     render_finding,
 )
 from dnn_tpu.analysis.lint import lint_paths, lint_source  # noqa: F401
+from dnn_tpu.analysis.shardcheck import contract  # noqa: F401
 
 __all__ = ["Finding", "RULES", "lint_paths", "lint_source",
-           "load_baseline", "diff_against_baseline", "render_finding"]
+           "load_baseline", "diff_against_baseline", "render_finding",
+           "contract"]

@@ -1,10 +1,13 @@
 """CLI + CI gate: `python -m dnn_tpu.analysis`.
 
-Runs the AST lint (trace/shard TPU rules + concurrency CON rules) over
-the package (plus any extra paths), the protocol state-machine pass
-over the declared serving machines, and the device-free program pass
-over the real entrypoints, diffs everything against
-analysis/baseline.json, and exits nonzero on any NEW finding.
+Runs the AST lint (trace/shard TPU rules + concurrency CON rules +
+sharding SHD rules) over the package (plus any extra paths), the
+protocol state-machine pass over the declared serving machines, the
+device-free program pass over the real entrypoints, and the sharded-
+program audit (shardcheck: memory bill, contract conformance,
+allocation-sized collectives over the zero1/llama/pipeline/moe
+programs), diffs everything against analysis/baseline.json, and exits
+nonzero on any NEW finding.
 Baselined findings are printed (enumerated, not hidden) with their
 justification; baseline entries that no longer fire are reported stale.
 `--diff REV` restricts the lint to package files changed since REV;
@@ -200,13 +203,17 @@ def main(argv=None) -> int:
         findings = assign_occurrences(findings + list(proto_findings))
 
     program_report = None
+    shard_report = None
     if not args.no_program:
         _force_cpu()
         from dnn_tpu.analysis.program import run_program_audit
+        from dnn_tpu.analysis.shardcheck import run_shard_audit
 
         program_report, prog_findings = run_program_audit(
             max_len=args.max_len)
-        findings = assign_occurrences(findings + list(prog_findings))
+        shard_report, shard_findings = run_shard_audit()
+        findings = assign_occurrences(
+            findings + list(prog_findings) + list(shard_findings))
 
     entries = []
     if not args.no_baseline and os.path.exists(args.baseline):
@@ -243,6 +250,7 @@ def main(argv=None) -> int:
                            for f in suppressed],
             "stale_baseline": stale,
             "program_report": program_report,
+            "shard_report": shard_report,
             "protocol_report": protocol_report,
         }, indent=2, default=str))
         return 1 if new else 0
@@ -283,6 +291,35 @@ def main(argv=None) -> int:
               f"{eng.get('batch_census', {}).get('programs')} programs "
               f"/ {eng.get('batch_census', {}).get('calls')} batch "
               "shapes")
+    if shard_report is not None:
+        print("shard pass:")
+        for name in ("zero1", "llama_dp_tp"):
+            sec = shard_report.get(name, {})
+            bill = sec.get("bill", {}).get("params", {})
+            col = sec.get("collectives", {})
+            line = (f"  {name}{sec.get('mesh')}: params bill "
+                    f"{bill.get('actual_per_device_bytes')}/"
+                    f"{bill.get('expected_per_device_bytes')} B/device "
+                    f"({len(bill.get('mismatches', []))} mismatches), "
+                    f"largest collective "
+                    f"{col.get('largest_frac', 0):.2f}x of "
+                    "tree-frac threshold "
+                    f"{col.get('threshold_frac')}")
+            print(line)
+        z = shard_report.get("zero1", {})
+        don = z.get("donation", {})
+        print(f"  zero1 donation under NamedSharding: "
+              f"{don.get('aliased')}/{don.get('expected')} sharded "
+              "buffers aliased; sharding census "
+              f"{z.get('sharding_census', {}).get('programs')} programs"
+              f"/{z.get('sharding_census', {}).get('calls')} calls "
+              f"(bound {z.get('sharding_census', {}).get('bound')})")
+        pl = shard_report.get("pipeline_stacked", {})
+        moe = shard_report.get("moe_ep", {})
+        print(f"  stacked pipeline placement bill: "
+              f"{pl.get('bill', {}).get('stacked', {}).get('mismatches')}"
+              " mismatches; moe EP axis signature: "
+              f"{moe.get('collective_signature')}")
     if suppressed:
         just = {e["fingerprint"]: e.get("justification", "")
                 for e in entries}

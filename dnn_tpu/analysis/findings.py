@@ -128,6 +128,70 @@ RULES: Dict[str, Tuple[str, str]] = {
         "RuntimeError), or a non-daemon thread started without a join "
         "path (strands interpreter exit).",
     ),
+    # sharding pass (analysis/shardcheck.py): mesh/GSPMD safety —
+    # SHD001-006 are AST rules merged into lint_source, SHD007-009 fire
+    # from the sharded-program audit over the real train/pipeline/moe
+    # programs
+    "SHD001": (
+        "hard-coded device-count arithmetic",
+        "arithmetic on jax.device_count()/len(jax.devices()) with an "
+        "integer literal — breaks the moment a replica gets a different "
+        "chip count; size from mesh.shape[axis] instead (comparisons, "
+        "i.e. capability checks, are fine).",
+    ),
+    "SHD002": (
+        "mesh-axis-name drift",
+        "a string-literal axis name at a P(...)/collective/axis_index "
+        "site does not match any axis declared at this module's "
+        "Mesh/make_mesh site — fails at runtime on the real mesh or "
+        "silently no-ops a collective.",
+    ),
+    "SHD003": (
+        "sharded inputs, replicated outputs, no collective",
+        "shard_map consumes sharded operands but declares every output "
+        "replicated (missing or P()-everything out_specs) while the "
+        "mapped body issues no collective — a mis-declared output or an "
+        "implicit full gather.",
+    ),
+    "SHD004": (
+        "host materialization reachable from spmd body",
+        "a same-module call chain from a shard_map/pmap-mapped body "
+        "reaches host materialization (.item()/np.*/host callback) — a "
+        "per-rank device->host sync inside the mapped program.",
+    ),
+    "SHD005": (
+        "per-host RNG divergence in spmd region",
+        "a PRNG key created inside an spmd-mapped body is consumed "
+        "without fold_in of the axis index — every rank draws the SAME "
+        "'random' values; fold_in(key, lax.axis_index(axis)) first.",
+    ),
+    "SHD006": (
+        "donation with mismatched donor/output sharding",
+        "a donated argument is declared with a sharding no output "
+        "carries — XLA only aliases matching layouts, so the donation "
+        "silently dies and the step pays a full copy.",
+    ),
+    "SHD007": (
+        "allocation-sized collective (accidental replication)",
+        "a collective in the optimized HLO of a sharded program whose "
+        "result is weight-tree-sized — the replication-repair "
+        "all-gather GSPMD inserts around mismatched shardings; a "
+        "healthy step's largest gather is one parameter leaf.",
+    ),
+    "SHD008": (
+        "per-shard memory bill violation",
+        "a leaf's actual per-device bytes in the compiled program "
+        "disagree with the bytes its declared PartitionSpec promises — "
+        "a supposedly-sharded leaf lowering replicated erases the "
+        "sharding's memory win.",
+    ),
+    "SHD009": (
+        "sharding-contract mismatch",
+        "the compiled program's sharding attributes disagree with the "
+        "PartitionSpec contract declared next to the code "
+        "(shardcheck.contract) — the implementation drifted from its "
+        "declaration.",
+    ),
     # protocol pass (analysis/protocol.py): serving state machines as
     # checked transition tables
     "PRO001": (

@@ -727,8 +727,10 @@ def lint_source(src: str, path: str = "<string>") -> List[Finding]:
             local_defs=local_defs)
         findings.extend(checker.run())
     from dnn_tpu.analysis.concurrency import check_source
+    from dnn_tpu.analysis.shardcheck import check_source as shard_check
 
     findings.extend(check_source(src, path))
+    findings.extend(shard_check(src, path))
     return assign_occurrences(findings)
 
 

@@ -43,7 +43,8 @@ from dnn_tpu.utils.hlo_audit import (
 )
 
 __all__ = [
-    "collective_signature", "check_branch_collectives", "baked_constants",
+    "collective_signature", "axis_collective_signature",
+    "check_branch_collectives", "baked_constants",
     "donation_report", "recompile_census", "audit_decode_paths",
     "audit_serving_decode", "audit_pipeline_programs", "audit_engine",
     "check_decode_program", "run_program_audit",
@@ -89,18 +90,50 @@ def collective_signature(jaxpr) -> Tuple[str, ...]:
     return tuple(out)
 
 
+def _eqn_axes(eqn) -> Tuple[str, ...]:
+    """The mesh axes one collective equation operates over. psum-family
+    primitives carry `axes`; gather/permute/scatter carry `axis_name`
+    (either may be a bare name or a tuple)."""
+    v = eqn.params.get("axes", eqn.params.get("axis_name"))
+    if v is None:
+        return ()
+    if not isinstance(v, (tuple, list)):
+        v = (v,)
+    return tuple(str(a) for a in v)
+
+
+def axis_collective_signature(jaxpr) -> Tuple[str, ...]:
+    """collective_signature with the mesh axes each collective operates
+    over: `psum@data`, `ppermute@stage`, ... Two branches can agree on
+    primitive NAMES while reducing over different axes — that still
+    deadlocks a real mesh, so PRG001 compares THIS signature."""
+    jaxpr = getattr(jaxpr, "jaxpr", jaxpr)
+    out: List[str] = []
+    for eqn in jaxpr.eqns:
+        if eqn.primitive.name in _COLLECTIVE_PRIMS:
+            axes = ",".join(_eqn_axes(eqn))
+            out.append(f"{eqn.primitive.name}@{axes}" if axes
+                       else eqn.primitive.name)
+        for _, sub in _sub_jaxprs(eqn):
+            out.extend(axis_collective_signature(sub))
+    return tuple(out)
+
+
 def check_branch_collectives(jaxpr, where: str = "<program>"
                              ) -> List[Finding]:
     """PRG001: walk a jaxpr; at every cond/switch equation, compare the
-    collective signature of each branch. The stage programs of
-    spmd_pipeline ARE these branches (lax.switch on the stage coord), so
-    this is the 'collective sequences identical across pipeline stage
-    programs' check of the paper-scale SPMD contract."""
+    MESH-AXIS-AWARE collective signature of each branch. The stage
+    programs of spmd_pipeline ARE these branches (lax.switch on the
+    stage coord), so this is the 'collective sequences identical across
+    pipeline stage programs' check of the paper-scale SPMD contract —
+    and since ISSUE 17 it also fails two branches that agree on
+    primitive names but reduce over DIFFERENT mesh axes (a dropped or
+    re-axed psum deadlocks ranks just the same)."""
     jaxpr = getattr(jaxpr, "jaxpr", jaxpr)
     findings: List[Finding] = []
     for eqn in jaxpr.eqns:
         if eqn.primitive.name == "cond":
-            sigs = [collective_signature(b)
+            sigs = [axis_collective_signature(b)
                     for b in eqn.params.get("branches", ())]
             if len(set(sigs)) > 1:
                 detail = " vs ".join(
@@ -165,12 +198,18 @@ def donation_report(fn, args, donate_argnums: Sequence[int],
 # ----------------------------------------------------------------------
 
 def _aval_signature(args) -> Tuple:
-    """What jit keys its program cache on (per arg: shape+dtype), via
-    eval_shape avals — no tracing of the function body needed."""
+    """What jit keys its program cache on (per arg: shape+dtype, plus
+    the declared sharding when the aval carries one — identical avals
+    under DIFFERENT shardings compile different partitioned programs,
+    so the sharded-program census must count them separately)."""
     leaves = jax.tree.leaves(
         jax.tree.map(lambda l: jax.ShapeDtypeStruct(
-            jnp.shape(l), getattr(l, "dtype", jnp.result_type(l))), args))
-    return tuple((tuple(l.shape), str(l.dtype)) for l in leaves)
+            jnp.shape(l), getattr(l, "dtype", jnp.result_type(l)),
+            sharding=getattr(l, "sharding", None)), args),
+        is_leaf=lambda x: isinstance(x, jax.ShapeDtypeStruct))
+    return tuple((tuple(l.shape), str(l.dtype),
+                  str(l.sharding) if l.sharding is not None else None)
+                 for l in leaves)
 
 
 def recompile_census(arg_sets: Sequence[Tuple], *, bound: Optional[int]
@@ -535,8 +574,24 @@ def audit_pipeline_programs(num_stages: int = 2, *, feature: int = 8,
     findings += baked_constants(
         closed, where="parallel/pipeline.spmd_pipeline")
     sig = collective_signature(closed)
+
+    # PRG004 (ISSUE 17): the pipeline program count. Steps at the same
+    # batch shape are ONE program — the stage coordinate and microbatch
+    # index are traced, not static — so a repeated-call sweep must stay
+    # at exactly one compile. The sharded serving PR cannot silently
+    # start multiplying compilations per rung without tripping this.
+    x_aval = jax.ShapeDtypeStruct(x.shape, x.dtype)
+    p_avals = jax.tree.map(
+        lambda l: jax.ShapeDtypeStruct(l.shape, l.dtype), tuple(params))
+    census = recompile_census(
+        [(p_avals, x_aval)] * 4, bound=1,
+        where="parallel/pipeline.spmd_pipeline")
+    findings += census["findings"]
     return {"collective_signature": list(sig),
-            "stages": num_stages, "findings": findings}
+            "stages": num_stages,
+            "step_census": {k: census[k]
+                            for k in ("calls", "programs", "bound")},
+            "findings": findings}
 
 
 def audit_transport_programs(num_stages: int = 4, *, feature: int = 8,
@@ -579,8 +634,22 @@ def audit_transport_programs(num_stages: int = 4, *, feature: int = 8,
                     f"ppermute per hop branch ({num_stages - 1} hops), "
                     f"traced {list(sig) or 'none'}",
             snippet=f"stages={num_stages}"))
+
+    # PRG004 (ISSUE 17): the hop INDEX is a traced int32 — all
+    # num_stages-1 hops of a relay dispatch through ONE switch program.
+    # Pin that a full hop sweep compiles exactly one program; a hop
+    # index leaking into a static arg would show up here as n-1.
+    hop_aval = jax.ShapeDtypeStruct((), jnp.int32)
+    buf_aval = jax.ShapeDtypeStruct(buf.shape, buf.dtype)
+    census = recompile_census(
+        [(hop_aval, buf_aval) for _ in range(num_stages - 1)],
+        bound=1, where="comm/transport.make_hop_program")
+    findings += census["findings"]
     return {"collective_signature": list(sig),
-            "stages": num_stages, "findings": findings}
+            "stages": num_stages,
+            "hop_census": {k: census[k]
+                           for k in ("calls", "programs", "bound")},
+            "findings": findings}
 
 
 def audit_engine(*, batch_sweep: Sequence[int] = (1, 2, 4, 8)) -> dict:

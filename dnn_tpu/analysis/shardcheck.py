@@ -1,0 +1,1117 @@
+"""Mesh/GSPMD sharding-safety analyzer: rules SHD001-SHD009.
+
+ROADMAP items 1 and 2 put the mesh code and the zero1 paths on real
+chips; the classic failure modes there are SILENT — an accidentally
+replicated weight tree erases the ZeRO memory win ("Automatic
+Cross-Replica Sharding of Weight Update", 2004.13336), and an implicit
+all-gather XLA inserts to repair mismatched shardings turns
+tensor-parallel decode into a comms-bound crawl (the pjit pitfalls of
+2204.06514). This module is the instrument built BEFORE those PRs, in
+three connected pieces riding the findings/fingerprint/baseline/SARIF
+machinery of analysis/lint.py and analysis/program.py:
+
+  1. AST rules SHD001-006 (`check_source`, merged into `lint_source`;
+     pure ast, NO jax import at module scope so the lint path stays
+     importable anywhere):
+       SHD001  hard-coded device-count arithmetic — `len(jax.devices())
+               // 2`-style code breaks the moment a replica gets a
+               different chip count; mesh axis sizes should flow.
+       SHD002  mesh-axis-name string literal drifting from the
+               declaring Mesh/make_mesh site (module-scoped resolution,
+               the CON004 discipline applied to axis names).
+       SHD003  shard_map with sharded in_specs whose out_specs are
+               missing/`P()`-everything while the mapped body issues NO
+               collective — the output is either mis-declared or an
+               implicit full gather.
+       SHD004  host materialization (`.item()`, `np.asarray`, host
+               callbacks) reachable from an spmd-mapped body through a
+               same-module call chain (the CON001 closure engine; the
+               direct tainted case is TPU002's).
+       SHD005  per-host RNG divergence: a PRNGKey created inside an
+               spmd body and consumed without `fold_in` of the axis
+               index — every rank draws identical "randomness".
+       SHD006  donation of a sharded argument whose declared donor
+               sharding matches no output sharding — the donation
+               silently dies (XLA only aliases matching layouts).
+
+  2. A device-free sharded-program audit (`run_shard_audit`) over the
+     REAL programs — the zero1 train step, llama dp x tp, the stacked
+     pipeline placement, the expert-parallel moe ffn — lowered once on
+     CPU with forced virtual devices:
+       SHD007  allocation-sized all-gather: any collective in the
+               OPTIMIZED HLO whose result is weight-tree-sized is the
+               accidental-replication repair (threshold priced via
+               utils/flops.tree_weight_bytes).
+       SHD008  per-shard memory bill: expected per-device bytes from
+               the declared PartitionSpecs vs the actual buffer sizes
+               the program lowered — a supposedly-sharded leaf that
+               lowers replicated fails.
+       SHD009  sharding-contract mismatch: the compiled program's
+               input/output sharding attributes disagree with the
+               contract declared next to the code.
+     Donation-aliasing under NamedSharding rides the existing PRG003
+     (hlo_audit.count_aliased), and branch-collective consistency is
+     the mesh-axis-aware PRG001 (analysis/program.py).
+
+  3. The sharding-contract API: `@contract(name)` registers a
+     PartitionSpec builder NEXT TO the code it describes (train.py's
+     zero1/llama specs, pipeline.py's stage placement); the audit
+     builds the real program from the contract and verifies the
+     compiled sharding attributes match the declaration — so the
+     upcoming GSPMD serving PR ships with its contract checked in CI
+     from day one.
+
+CPU-only by design: jit signatures and GSPMD partitioning decisions are
+backend-independent, so a bill/contract verdict computed on 8 virtual
+host devices transfers to a TPU slice of the same mesh shape.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Callable, Dict, List, Optional, Sequence, Set, Tuple
+
+from dnn_tpu.analysis.findings import Finding
+
+__all__ = [
+    "check_source", "contract", "get_contract", "contract_names",
+    "memory_bill", "collective_allocation_findings", "contract_findings",
+    "audit_zero1_train", "audit_llama_dp_tp", "audit_stacked_pipeline",
+    "audit_moe_ep", "run_shard_audit",
+]
+
+# ----------------------------------------------------------------------
+# sharding-contract registry
+# ----------------------------------------------------------------------
+
+# name -> PartitionSpec-tree builder, registered next to the code it
+# describes. Modules register at import; the audit imports them lazily
+# (shardcheck itself must stay jax-free at module scope).
+_CONTRACTS: Dict[str, Callable] = {}
+
+# modules whose import populates the registry (grow this list when a
+# new subsystem declares a contract)
+_CONTRACT_MODULES = ("dnn_tpu.train", "dnn_tpu.parallel.pipeline")
+
+
+def contract(name: str):
+    """Decorator: register `fn` as the sharding contract `name`. The
+    builder returns the INTENDED PartitionSpec tree for its subject
+    (given shape pytrees / meshes as its own signature demands); the
+    audit verifies the compiled program matches it. Re-registration
+    overwrites (module reload)."""
+
+    def register(fn: Callable) -> Callable:
+        _CONTRACTS[name] = fn
+        return fn
+
+    return register
+
+
+def _load_contracts():
+    import importlib
+
+    for mod in _CONTRACT_MODULES:
+        importlib.import_module(mod)
+
+
+def get_contract(name: str) -> Callable:
+    if name not in _CONTRACTS:
+        _load_contracts()
+    return _CONTRACTS[name]
+
+
+def contract_names() -> List[str]:
+    _load_contracts()
+    return sorted(_CONTRACTS)
+
+
+# ----------------------------------------------------------------------
+# AST pass: SHD001-006
+# ----------------------------------------------------------------------
+
+_DEVICE_COUNT_CALLS = {"device_count", "local_device_count"}
+_DEVICE_LIST_CALLS = {"devices", "local_devices"}
+_ARITH_OPS = (ast.Mult, ast.Div, ast.FloorDiv, ast.Mod, ast.Sub, ast.Pow)
+_SPMD_WRAPPERS = {"shard_map", "pmap"}
+_COLLECTIVE_NAMES = {
+    "psum", "ppermute", "all_gather", "all_to_all", "psum_scatter",
+    "pmean", "pmin", "pmax", "pbroadcast", "all_gather_invariant",
+}
+_AXIS_KWARGS = {"axis_name", "axis_names"}
+_HOST_MAT_METHODS = {"item", "tolist"}
+_HOST_MAT_NP = {"asarray", "array", "ascontiguousarray", "copy", "save"}
+_HOST_CALLBACKS = {"pure_callback", "io_callback", "call_host",
+                   "device_get"}
+_KEY_CTORS = {"PRNGKey", "key"}
+_KEY_CONSUMERS = {
+    "normal", "uniform", "split", "bernoulli", "categorical", "randint",
+    "truncated_normal", "gumbel", "choice", "permutation", "bits",
+    "exponential", "laplace", "poisson",
+}
+
+
+def _callee(call: ast.Call) -> str:
+    try:
+        return ast.unparse(call.func)
+    except Exception:  # pragma: no cover — exotic nodes
+        return ""
+
+
+def _last(name: str) -> str:
+    return name.rsplit(".", 1)[-1]
+
+
+def _is_device_count_expr(node) -> bool:
+    """`jax.device_count()`, `jax.local_device_count()`, or
+    `len(jax.devices())` (the count, not the list)."""
+    if not isinstance(node, ast.Call):
+        return False
+    name = _last(_callee(node))
+    if name in _DEVICE_COUNT_CALLS:
+        return True
+    if name == "len" and node.args and isinstance(node.args[0], ast.Call):
+        return _last(_callee(node.args[0])) in _DEVICE_LIST_CALLS
+    return False
+
+
+def _p_calls(node) -> List[ast.Call]:
+    """Every P(...) / PartitionSpec(...) call in a subtree."""
+    out = []
+    for n in ast.walk(node):
+        if isinstance(n, ast.Call) and \
+                _last(_callee(n)) in ("P", "PartitionSpec"):
+            out.append(n)
+    return out
+
+
+def _p_axis_literals(node) -> Set[str]:
+    """String-literal axis names inside the P(...) calls of a subtree."""
+    axes: Set[str] = set()
+    for p in _p_calls(node):
+        for a in list(p.args) + [kw.value for kw in p.keywords]:
+            for c in ast.walk(a):
+                if isinstance(c, ast.Constant) and isinstance(c.value, str):
+                    axes.add(c.value)
+    return axes
+
+
+def _spec_is_sharded(node) -> bool:
+    """True when a specs expression carries at least one named axis —
+    a string literal inside a P(...) call, or a non-literal P argument
+    (an axis-name constant like DATA_AXIS counts as sharded)."""
+    for p in _p_calls(node):
+        for a in list(p.args) + [kw.value for kw in p.keywords]:
+            if isinstance(a, ast.Constant):
+                if isinstance(a.value, str):
+                    return True
+            else:
+                return True  # Name/attribute axis: assume sharded
+    return False
+
+
+def _spec_all_replicated(node) -> Optional[bool]:
+    """True when EVERY P(...) in the expression is an argument-free
+    `P()` and the expression holds nothing but those literals (tuples/
+    lists/None). None (undecidable) when non-P names appear."""
+    ps = _p_calls(node)
+    if not ps:
+        return None
+    if any(p.args or p.keywords for p in ps):
+        return False
+    for n in ast.walk(node):
+        if isinstance(n, ast.Name) and n.id not in ("P", "PartitionSpec"):
+            return None
+        if isinstance(n, ast.Attribute):
+            return None
+    return True
+
+
+class _SpmdIndex(ast.NodeVisitor):
+    """spmd-mapped function names/nodes for one module: defs decorated
+    with shard_map/pmap, names passed to them, and (via the checker)
+    everything lexically nested inside."""
+
+    def __init__(self):
+        self.names: Set[str] = set()
+        self.nodes: Set[int] = set()
+
+    def visit_Call(self, node: ast.Call):
+        if _last(_callee(node)) in _SPMD_WRAPPERS:
+            for a in node.args:
+                targets = a.elts if isinstance(a, (ast.List, ast.Tuple)) \
+                    else [a]
+                for t in targets:
+                    if isinstance(t, ast.Name):
+                        self.names.add(t.id)
+        self.generic_visit(node)
+
+    def _visit_def(self, node):
+        for dec in node.decorator_list:
+            name = _last(_callee(dec)) if isinstance(dec, ast.Call) else \
+                _last(ast.unparse(dec)) if isinstance(
+                    dec, (ast.Name, ast.Attribute)) else ""
+            if name in _SPMD_WRAPPERS:
+                self.nodes.add(id(node))
+        self.generic_visit(node)
+
+    visit_FunctionDef = _visit_def
+    visit_AsyncFunctionDef = _visit_def
+
+
+def _walk_own(fn):
+    """A function's own body, excluding nested def subtrees (they are
+    judged as their own functions) — the concurrency-pass discipline."""
+    stack = [fn]
+    while stack:
+        node = stack.pop()
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            yield child
+            stack.append(child)
+
+
+def _walk_functions(tree):
+    stack = [(tree, [])]
+    while stack:
+        node, anc = stack.pop()
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield child, anc
+                stack.append((child, anc + [child]))
+            else:
+                stack.append((child, anc))
+
+
+class _ShardChecker:
+    def __init__(self, tree: ast.Module, path: str, src_lines: List[str]):
+        self.tree = tree
+        self.path = path
+        self.src_lines = src_lines
+        self.findings: List[Finding] = []
+        self._flagged: Set[Tuple[str, int]] = set()
+        self.spmd = _SpmdIndex()
+        self.spmd.visit(tree)
+        self.module_defs: Dict[str, ast.AST] = {}
+        for fn, _anc in _walk_functions(tree):
+            self.module_defs.setdefault(fn.name, fn)
+        self.declared_axes = self._declared_axes()
+        self.host_fns = self._host_closure()
+
+    # -- plumbing ------------------------------------------------------
+
+    def _flag(self, rule: str, node, message: str):
+        line = getattr(node, "lineno", 0)
+        if (rule, line) in self._flagged:
+            return
+        self._flagged.add((rule, line))
+        snippet = ""
+        if 0 < line <= len(self.src_lines):
+            snippet = self.src_lines[line - 1].strip()
+        self.findings.append(Finding(rule=rule, path=self.path, line=line,
+                                     message=message, snippet=snippet))
+
+    def _is_spmd_fn(self, fn, ancestors) -> bool:
+        return any(id(n) in self.spmd.nodes or n.name in self.spmd.names
+                   for n in ancestors + [fn])
+
+    # -- SHD002 index: axis names declared at Mesh/make_mesh sites -----
+
+    def _declared_axes(self) -> Set[str]:
+        axes: Set[str] = set()
+        for node in ast.walk(self.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = _last(_callee(node))
+            if name == "Mesh":
+                cands = list(node.args[1:2]) + [
+                    kw.value for kw in node.keywords
+                    if kw.arg in ("axis_names", "axis_name")]
+                for c in cands:
+                    elts = c.elts if isinstance(c, (ast.Tuple, ast.List)) \
+                        else [c]
+                    for e in elts:
+                        if isinstance(e, ast.Constant) and \
+                                isinstance(e.value, str):
+                            axes.add(e.value)
+            elif name == "make_mesh":
+                for c in list(node.args) + [kw.value
+                                            for kw in node.keywords]:
+                    if isinstance(c, ast.Dict):
+                        for k in c.keys:
+                            if isinstance(k, ast.Constant) and \
+                                    isinstance(k.value, str):
+                                axes.add(k.value)
+                    elif isinstance(c, (ast.Tuple, ast.List)):
+                        for e in c.elts:
+                            if isinstance(e, ast.Constant) and \
+                                    isinstance(e.value, str):
+                                axes.add(e.value)
+        return axes
+
+    # -- SHD004 index: host-materializing closure ----------------------
+
+    def _directly_materializes(self, fn) -> bool:
+        for node in _walk_own(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            callee = _callee(node)
+            name = _last(callee)
+            if name in _HOST_CALLBACKS:
+                return True
+            if name in _HOST_MAT_NP and \
+                    callee.split(".")[0] in ("np", "numpy"):
+                return True
+            if name in _HOST_MAT_METHODS and \
+                    isinstance(node.func, ast.Attribute):
+                return True
+        return False
+
+    def _called_names(self, fn) -> Set[str]:
+        out: Set[str] = set()
+        for node in _walk_own(fn):
+            if isinstance(node, ast.Call):
+                f = node.func
+                if isinstance(f, ast.Name):
+                    out.add(f.id)
+                elif isinstance(f, ast.Attribute) and isinstance(
+                        f.value, ast.Name) and f.value.id == "self":
+                    out.add(f.attr)
+        return out
+
+    def _host_closure(self) -> Set[str]:
+        """Module function names whose bodies reach host materialization
+        (direct, or through same-module calls) — the CON001 fixpoint
+        applied to device->host transfers."""
+        host = {name for name, fn in self.module_defs.items()
+                if self._directly_materializes(fn)}
+        changed = True
+        while changed:
+            changed = False
+            for name, fn in self.module_defs.items():
+                if name in host:
+                    continue
+                if self._called_names(fn) & host:
+                    host.add(name)
+                    changed = True
+        return host
+
+    # -- driver --------------------------------------------------------
+
+    def run(self) -> List[Finding]:
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.BinOp):
+                self._check_shd001(node)
+            elif isinstance(node, ast.Call):
+                name = _last(_callee(node))
+                if name in _SPMD_WRAPPERS or name in ("pjit", "jit"):
+                    self._check_shd003(node, name)
+                    self._check_shd006(node)
+                if self.declared_axes:
+                    self._check_shd002(node)
+        for fn, ancestors in _walk_functions(self.tree):
+            if self._is_spmd_fn(fn, ancestors):
+                self._check_shd004(fn)
+                self._check_shd005(fn)
+        return self.findings
+
+    # -- SHD001 --------------------------------------------------------
+
+    def _check_shd001(self, node: ast.BinOp):
+        if not isinstance(node.op, _ARITH_OPS):
+            return
+        pairs = ((node.left, node.right), (node.right, node.left))
+        for count_side, other in pairs:
+            if _is_device_count_expr(count_side) and isinstance(
+                    other, ast.Constant) and isinstance(other.value, int):
+                self._flag(
+                    "SHD001", node,
+                    "arithmetic on a global device count with a "
+                    "hard-coded integer — breaks the moment a replica "
+                    "gets a different chip count; size from "
+                    "mesh.shape[axis] instead")
+                return
+
+    # -- SHD002 --------------------------------------------------------
+
+    def _axis_use_literals(self, call: ast.Call) -> List[ast.Constant]:
+        """String literals used AS AXIS NAMES at this call site."""
+        name = _last(_callee(call))
+        out: List[ast.Constant] = []
+
+        def strs(node):
+            elts = node.elts if isinstance(node, (ast.Tuple, ast.List)) \
+                else [node]
+            return [e for e in elts
+                    if isinstance(e, ast.Constant) and
+                    isinstance(e.value, str)]
+
+        if name in ("P", "PartitionSpec"):
+            for a in call.args:
+                out.extend(strs(a))
+        elif name in _COLLECTIVE_NAMES and len(call.args) >= 2:
+            out.extend(strs(call.args[1]))
+        elif name == "axis_index" and call.args:
+            out.extend(strs(call.args[0]))
+        for kw in call.keywords:
+            if kw.arg in _AXIS_KWARGS:
+                out.extend(strs(kw.value))
+        return out
+
+    def _check_shd002(self, call: ast.Call):
+        for lit in self._axis_use_literals(call):
+            if lit.value not in self.declared_axes:
+                self._flag(
+                    "SHD002", lit,
+                    f"axis name {lit.value!r} does not match any axis "
+                    "declared at this module's Mesh/make_mesh site(s) "
+                    f"({sorted(self.declared_axes)}) — a drifted axis "
+                    "literal fails at runtime on the real mesh (or "
+                    "silently no-ops a collective)")
+
+    # -- SHD003 --------------------------------------------------------
+
+    def _resolve_mapped(self, node) -> Optional[ast.AST]:
+        if isinstance(node, ast.Lambda):
+            return node
+        if isinstance(node, ast.Name):
+            return self.module_defs.get(node.id)
+        return None
+
+    def _body_has_collective(self, fn) -> bool:
+        for n in ast.walk(fn):
+            if isinstance(n, ast.Call) and \
+                    _last(_callee(n)) in _COLLECTIVE_NAMES:
+                return True
+        return False
+
+    def _check_shd003(self, call: ast.Call, wrapper: str):
+        kwargs = {kw.arg: kw.value for kw in call.keywords}
+        in_specs = kwargs.get("in_specs") or kwargs.get("in_shardings")
+        out_specs = kwargs.get("out_specs") or kwargs.get("out_shardings")
+        if in_specs is None or not _spec_is_sharded(in_specs):
+            return
+        if out_specs is None:
+            if wrapper not in _SPMD_WRAPPERS:
+                return  # jit/pjit: GSPMD infers outputs; omission is fine
+            replicated = True  # shard_map without out_specs = undeclared
+        else:
+            replicated = _spec_all_replicated(out_specs)
+        if not replicated:
+            return
+        mapped = self._resolve_mapped(call.args[0]) if call.args else None
+        if mapped is None or self._body_has_collective(mapped):
+            return  # a reduction to replicated via psum etc. is legit
+        self._flag(
+            "SHD003", call,
+            f"{wrapper} consumes sharded operands but declares every "
+            "output replicated (missing/P()-everything out specs) with "
+            "no collective in the mapped body — either the outputs are "
+            "mis-declared or the program pays an implicit full gather")
+
+    # -- SHD004 --------------------------------------------------------
+
+    def _check_shd004(self, fn):
+        for node in _walk_own(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            called = None
+            if isinstance(f, ast.Name):
+                called = f.id
+            elif isinstance(f, ast.Attribute) and isinstance(
+                    f.value, ast.Name) and f.value.id == "self":
+                called = f.attr
+            if called in self.host_fns and called != fn.name:
+                self._flag(
+                    "SHD004", node,
+                    f"`{called}` reaches host materialization "
+                    "(.item()/np.*/host callback) and is called from an "
+                    "spmd-mapped body — a per-rank device->host sync "
+                    "inside the mapped program; keep the chain on "
+                    "device (jnp.*)")
+
+    # -- SHD005 --------------------------------------------------------
+
+    def _is_key_ctor(self, node) -> bool:
+        return isinstance(node, ast.Call) and \
+            _last(_callee(node)) in _KEY_CTORS and \
+            "random" in _callee(node)
+
+    def _fold_has_axis_index(self, call: ast.Call) -> bool:
+        for a in list(call.args) + [kw.value for kw in call.keywords]:
+            for n in ast.walk(a):
+                if isinstance(n, ast.Call) and \
+                        _last(_callee(n)) == "axis_index":
+                    return True
+        return False
+
+    def _check_shd005(self, fn):
+        unfolded: Set[str] = set()
+        for node in _walk_own(fn):
+            if isinstance(node, ast.Assign) and isinstance(
+                    node.value, ast.Call):
+                call = node.value
+                name = _last(_callee(call))
+                is_unfolded_key = self._is_key_ctor(call)
+                if name == "fold_in":
+                    # fold_in of the axis index decorrelates ranks; a
+                    # fold of anything else keeps every rank identical
+                    feeds_key = any(
+                        self._is_key_ctor(a) or (
+                            isinstance(a, ast.Name) and a.id in unfolded)
+                        for a in call.args)
+                    is_unfolded_key = feeds_key and \
+                        not self._fold_has_axis_index(call)
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        if is_unfolded_key:
+                            unfolded.add(t.id)
+                        else:
+                            unfolded.discard(t.id)
+            if isinstance(node, ast.Call):
+                callee = _callee(node)
+                if "random" in callee and \
+                        _last(callee) in _KEY_CONSUMERS:
+                    for a in node.args:
+                        hit = (isinstance(a, ast.Name) and
+                               a.id in unfolded) or self._is_key_ctor(a)
+                        if hit:
+                            self._flag(
+                                "SHD005", node,
+                                "PRNG key created inside an spmd body "
+                                "is consumed without fold_in of the "
+                                "axis index — every rank draws the "
+                                "SAME 'random' values; "
+                                "fold_in(key, lax.axis_index(axis)) "
+                                "first")
+                            if isinstance(a, ast.Name):
+                                unfolded.discard(a.id)
+
+    # -- SHD006 --------------------------------------------------------
+
+    def _spec_strings(self, node) -> List[str]:
+        """Canonical per-position spec strings of a shardings literal:
+        one entry per top-level element (tuple/list), else a single
+        entry. '' when a position holds no P(...) literal."""
+        elts = node.elts if isinstance(node, (ast.Tuple, ast.List)) \
+            else [node]
+        out = []
+        for e in elts:
+            ps = _p_calls(e)
+            out.append(ast.unparse(ps[0]).replace("PartitionSpec", "P")
+                       if ps else "")
+        return out
+
+    def _check_shd006(self, call: ast.Call):
+        kwargs = {kw.arg: kw.value for kw in call.keywords}
+        donate = kwargs.get("donate_argnums")
+        ins = kwargs.get("in_shardings") or kwargs.get("in_specs")
+        outs = kwargs.get("out_shardings") or kwargs.get("out_specs")
+        if donate is None or ins is None or outs is None:
+            return
+        try:
+            idxs = ast.literal_eval(donate)
+        except (ValueError, SyntaxError):
+            return
+        if isinstance(idxs, int):
+            idxs = (idxs,)
+        in_strs = self._spec_strings(ins)
+        out_strs = [s for s in self._spec_strings(outs) if s]
+        if not out_strs:
+            return
+        for i in idxs:
+            if not isinstance(i, int) or i >= len(in_strs):
+                continue
+            spec = in_strs[i]
+            if not spec or spec == "P()":
+                continue  # replicated donors alias against anything
+            if spec not in out_strs:
+                self._flag(
+                    "SHD006", call,
+                    f"donated argument {i} is sharded {spec} but no "
+                    "declared output carries that sharding — XLA only "
+                    "aliases matching layouts, so this donation "
+                    "silently dies and the step pays a full copy")
+
+
+def check_source(src: str, path: str = "<string>") -> List[Finding]:
+    """SHD001-006 over one module's source. Called by lint_source (the
+    merged lint walk); returns raw findings — occurrence assignment
+    happens in the caller."""
+    try:
+        tree = ast.parse(src)
+    except SyntaxError:
+        return []  # lint.py already reports TPU000
+    return _ShardChecker(tree, path, src.splitlines()).run()
+
+
+# ----------------------------------------------------------------------
+# program audit: SHD007-009 over the real sharded programs
+# ----------------------------------------------------------------------
+
+def _shard_nbytes(sharding, shape, dtype) -> int:
+    import numpy as np
+
+    shard = sharding.shard_shape(tuple(shape))
+    n = 1
+    for d in shard:
+        n *= int(d)
+    return n * np.dtype(dtype).itemsize
+
+
+def _leaf_paths(tree, is_leaf=None):
+    import jax
+
+    flat, _ = jax.tree_util.tree_flatten_with_path(tree, is_leaf=is_leaf)
+    return [(jax.tree_util.keystr(path), leaf) for path, leaf in flat]
+
+
+def memory_bill(shapes, declared_specs, actual_shardings, mesh, *,
+                where: str = "<program>", label: str = "params"
+                ) -> Tuple[dict, List[Finding]]:
+    """SHD008: the static per-shard memory bill. For every leaf, the
+    expected per-device bytes follow from the DECLARED PartitionSpec
+    (NamedSharding.shard_shape on the global shape); the actual bytes
+    follow from the sharding the compiled program assigned. A leaf whose
+    declaration shards it but whose program replicates it erases the
+    memory win the spec promised — that is the 2004.13336 failure mode,
+    caught on paper."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    spec_leaves = dict(_leaf_paths(
+        declared_specs, is_leaf=lambda x: isinstance(x, P)))
+    findings: List[Finding] = []
+    expected_total = actual_total = global_total = 0
+    mismatches: List[dict] = []
+    n_leaves = 0
+    for (path, leaf), (_, actual) in zip(
+            _leaf_paths(shapes), _leaf_paths(actual_shardings)):
+        n_leaves += 1
+        spec = spec_leaves.get(path, P())
+        declared = NamedSharding(mesh, spec)
+        exp = _shard_nbytes(declared, leaf.shape, leaf.dtype)
+        act = _shard_nbytes(actual, leaf.shape, leaf.dtype)
+        import numpy as np
+
+        glob = int(np.prod(leaf.shape, dtype=np.int64) or 1) * \
+            np.dtype(leaf.dtype).itemsize
+        expected_total += exp
+        actual_total += act
+        global_total += glob
+        if act != exp:
+            entry = {"leaf": path, "spec": str(spec),
+                     "expected_bytes": exp, "actual_bytes": act,
+                     "global_bytes": glob}
+            mismatches.append(entry)
+            if act >= glob and exp < glob:
+                msg = (f"leaf {path} declared {spec} lowers REPLICATED "
+                       f"({act} B/device vs declared {exp} B) — the "
+                       "sharding annotation bought no memory")
+            else:
+                msg = (f"leaf {path} per-device bytes {act} != declared "
+                       f"{exp} (spec {spec})")
+            findings.append(Finding(
+                rule="SHD008", path=where, line=0, message=msg,
+                snippet=f"{label}:{path}"))
+    report = {
+        "leaves": n_leaves,
+        "expected_per_device_bytes": expected_total,
+        "actual_per_device_bytes": actual_total,
+        "global_bytes": global_total,
+        "mismatches": mismatches,
+    }
+    return report, findings
+
+
+def contract_findings(name: str, declared_specs, actual_shardings,
+                      shapes, mesh, *, where: str) -> List[Finding]:
+    """SHD009: the compiled program's shardings vs the declared contract
+    — per leaf, the actual per-device shard shape must equal the shape
+    the contract's PartitionSpec produces."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    spec_leaves = dict(_leaf_paths(
+        declared_specs, is_leaf=lambda x: isinstance(x, P)))
+    findings = []
+    for (path, leaf), (_, actual) in zip(
+            _leaf_paths(shapes), _leaf_paths(actual_shardings)):
+        spec = spec_leaves.get(path, P())
+        want = NamedSharding(mesh, spec).shard_shape(tuple(leaf.shape))
+        got = actual.shard_shape(tuple(leaf.shape))
+        if tuple(want) != tuple(got):
+            findings.append(Finding(
+                rule="SHD009", path=where, line=0,
+                message=f"contract {name!r}: leaf {path} lowered with "
+                        f"per-device shard {tuple(got)} but the "
+                        f"declared spec {spec} demands {tuple(want)} — "
+                        "the implementation drifted from its contract",
+                snippet=f"{name}:{path}"))
+    return findings
+
+
+_OPT_COLLECTIVES = ("all-gather", "all-reduce", "reduce-scatter",
+                    "all-to-all", "collective-permute")
+
+
+def collective_allocation_findings(optimized_hlo: str, tree_bytes: float,
+                                   *, frac: float = 0.25,
+                                   where: str = "<program>"
+                                   ) -> Tuple[dict, List[Finding]]:
+    """SHD007: walk the optimized HLO for collectives whose RESULT is
+    weight-tree-sized. A healthy sharded step's largest gather is one
+    leaf (zero1 gathers each updated param leaf, ~single-digit % of the
+    tree); a collective at >= `frac` of the whole tree is the
+    replication-repair all-gather GSPMD inserts around mismatched
+    shardings — the 2204.06514 comms-bound failure, caught at lowering
+    time."""
+    import re
+
+    import numpy as np
+
+    sizes: List[Tuple[str, int]] = []
+    pat = re.compile(
+        r"=\s*(?:\(?)([a-z0-9]+)\[([0-9,]*)\][^=]*?\s"
+        r"(all-gather|all-reduce|reduce-scatter|all-to-all|"
+        r"collective-permute)\(")
+    for line in optimized_hlo.splitlines():
+        m = pat.search(line)
+        if not m:
+            continue
+        dtype_s, dims, op = m.groups()
+        n = 1
+        for d in dims.split(","):
+            if d:
+                n *= int(d)
+        try:
+            itemsize = np.dtype(
+                dtype_s.replace("bf16", "float16")
+                .replace("f", "float").replace("s", "int")
+                .replace("u", "uint").replace("pred", "bool")).itemsize
+        except TypeError:
+            itemsize = 4
+        sizes.append((op, n * itemsize))
+    biggest = max((b for _, b in sizes), default=0)
+    threshold = max(int(frac * tree_bytes), 1)
+    findings = []
+    for op, nbytes in sizes:
+        if nbytes >= threshold:
+            findings.append(Finding(
+                rule="SHD007", path=where, line=0,
+                message=f"{op} result is {nbytes / 1e3:.1f} kB — "
+                        f">= {frac:.0%} of the {tree_bytes / 1e3:.1f} kB "
+                        "weight tree; an allocation-sized collective is "
+                        "the accidental-replication repair, not a "
+                        "sharded step",
+                snippet=f"{op}:{nbytes}"))
+    report = {"collectives": len(sizes), "largest_bytes": biggest,
+              "tree_bytes": int(tree_bytes),
+              "largest_frac": (biggest / tree_bytes) if tree_bytes else 0.0,
+              "threshold_frac": frac}
+    return report, findings
+
+
+def _aval_tree(shapes, shardings):
+    import jax
+
+    return jax.tree.map(
+        lambda l, s: jax.ShapeDtypeStruct(l.shape, l.dtype, sharding=s),
+        shapes, shardings,
+        is_leaf=lambda x: isinstance(x, jax.ShapeDtypeStruct))
+
+
+def _input_shardings_tree(compiled, *example_trees):
+    """compiled.input_shardings -> one sharding pytree per positional
+    argument (jax already returns them mirroring the arg pytrees; the
+    example trees just pin the expected arity)."""
+    ins = list(compiled.input_shardings[0])
+    assert len(ins) == len(example_trees), (len(ins), len(example_trees))
+    return ins
+
+
+def _output_shardings_tree(compiled, out_example):
+    """compiled.output_shardings mirrors the output pytree already."""
+    del out_example
+    return compiled.output_shardings
+
+
+# -- the audited programs ----------------------------------------------
+
+def _tiny_gpt_cfg():
+    from dnn_tpu.models.gpt import GPTConfig
+
+    return GPTConfig(vocab_size=64, block_size=64, n_layer=2, n_head=2,
+                     n_embd=32)
+
+
+def audit_zero1_train(*, data: int = 2, model: int = 4,
+                      batch: int = 4, seq: int = 17) -> dict:
+    """The zero1 (dp x tp + ZeRO-1) GPT train step, built FROM its
+    declared contracts and audited end to end: per-shard memory bill for
+    params AND optimizer moments (SHD008), contract conformance on the
+    step's param/opt outputs (SHD009), full donation aliasing under
+    NamedSharding (PRG003, donate=True), allocation-sized collectives in
+    the optimized HLO (SHD007), and the sharding-aware recompile census
+    (a resharded call is a new program — pinned so the count is a
+    choice, not an accident)."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from dnn_tpu import train as T
+    from dnn_tpu.analysis.program import recompile_census
+    from dnn_tpu.models import gpt
+    from dnn_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS, make_mesh
+    from dnn_tpu.utils.flops import tree_weight_bytes
+    from dnn_tpu.utils.hlo_audit import count_aliased_compiled
+
+    cfg = _tiny_gpt_cfg()
+    mesh = make_mesh({DATA_AXIS: data, MODEL_AXIS: model})
+    where = "train.make_sharded_train_step[zero1]"
+    findings: List[Finding] = []
+
+    shapes = jax.eval_shape(lambda k: gpt.init(k, cfg),
+                            jax.random.PRNGKey(0))
+    param_specs = get_contract("train.gpt_dp_tp.params")(shapes)
+    opt = optax.adam(1e-3)
+    opt_shapes = jax.eval_shape(opt.init, shapes)
+    opt_specs = get_contract("train.zero1.opt_state")(
+        opt_shapes, shapes, param_specs, mesh)
+
+    apply_fn = gpt.make_apply(cfg)
+    step = T.make_sharded_train_step(
+        lambda p, b: T.next_token_loss(apply_fn, p, b),
+        opt, mesh, param_specs, zero1=True, donate=True)
+
+    p_avals = _aval_tree(shapes, T.specs_to_shardings(mesh, param_specs))
+    o_avals = _aval_tree(opt_shapes, T.specs_to_shardings(mesh, opt_specs))
+    batch_aval = jax.ShapeDtypeStruct((batch, seq), jnp.int32)
+    compiled = step.lower(p_avals, o_avals, batch_aval).compile()
+
+    # SHD008: the bill, on the program's INPUT shardings
+    p_in, o_in, _ = _input_shardings_tree(
+        compiled, shapes, opt_shapes, batch_aval)
+    bill_p, f_p = memory_bill(shapes, param_specs, p_in, mesh,
+                              where=where, label="params")
+    bill_o, f_o = memory_bill(opt_shapes, opt_specs, o_in, mesh,
+                              where=where, label="opt_state")
+    findings += f_p + f_o
+
+    # SHD009: the step's param/opt OUTPUTS must still match the
+    # contract (an internal with_sharding_constraint drifting from the
+    # declaration shows up here, not on the inputs)
+    out_shardings = _output_shardings_tree(
+        compiled, (shapes, opt_shapes, jax.ShapeDtypeStruct(
+            (), jnp.float32)))
+    findings += contract_findings(
+        "train.gpt_dp_tp.params", param_specs, out_shardings[0],
+        shapes, mesh, where=where)
+    findings += contract_findings(
+        "train.zero1.opt_state", opt_specs, out_shardings[1],
+        opt_shapes, mesh, where=where)
+
+    # SHD007: optimized-HLO collective allocation walk
+    tree_bytes = tree_weight_bytes(shapes)
+    try:
+        hlo = "\n".join(m.to_string()
+                        for m in compiled.runtime_executable()
+                        .hlo_modules())
+    except Exception:  # pragma: no cover — compiled.as_text fallback
+        hlo = compiled.as_text()
+    alloc, f_a = collective_allocation_findings(hlo, tree_bytes,
+                                                where=where)
+    findings += f_a
+
+    # PRG003 under NamedSharding: with donate=True every (params + opt)
+    # leaf must alias an output. GSPMD donations resolve in the COMPILED
+    # HLO's input_output_alias header (jit only emits buffer_donor hints
+    # at the StableHLO level once shardings are in play), so the count
+    # reads the optimized module, not lowered.as_text().
+    expected = len(jax.tree.leaves(shapes)) + len(jax.tree.leaves(
+        opt_shapes, is_leaf=lambda x: isinstance(x, jax.ShapeDtypeStruct)))
+    aliased = count_aliased_compiled(hlo)
+    if aliased < expected:
+        findings.append(Finding(
+            rule="PRG003", path=where, line=0,
+            message=f"only {aliased}/{expected} donated sharded buffers "
+                    "are aliased to outputs — un-aliased donations copy "
+                    "every step",
+            snippet=f"aliased={aliased} expected={expected}"))
+
+    # sharding-aware census: identical avals under different shardings
+    # ARE different programs — pin that the step holds exactly two in a
+    # sharded-vs-replicated sweep (the count is a choice, not a leak)
+    repl = jax.tree.map(lambda s: jax.sharding.NamedSharding(
+        mesh, jax.sharding.PartitionSpec()), T.specs_to_shardings(
+            mesh, param_specs))
+    census = recompile_census(
+        [(p_avals, o_avals, batch_aval),
+         (_aval_tree(shapes, repl), o_avals, batch_aval),
+         (p_avals, o_avals, batch_aval)],
+        bound=2, where=where)
+    findings += census["findings"]
+
+    return {"mesh": dict(mesh.shape),
+            "bill": {"params": bill_p, "opt_state": bill_o},
+            "donation": {"aliased": aliased, "expected": expected},
+            "collectives": alloc,
+            "sharding_census": {k: census[k]
+                                for k in ("calls", "programs", "bound")},
+            "findings": findings}
+
+
+def audit_llama_dp_tp(*, data: int = 2, model: int = 4,
+                      batch: int = 4, seq: int = 17) -> dict:
+    """The llama dp x tp train step (the PR-2 configuration whose
+    init-partitioning drift motivated this analyzer): bill + contract +
+    allocation-sized collectives, no zero1."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from dnn_tpu import train as T
+    from dnn_tpu.models import llama
+    from dnn_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS, make_mesh
+    from dnn_tpu.utils.flops import tree_weight_bytes
+
+    cfg = llama.PRESETS["llama-test"]
+    mesh = make_mesh({DATA_AXIS: data, MODEL_AXIS: model})
+    where = "train.make_sharded_train_step[llama_dp_tp]"
+    findings: List[Finding] = []
+
+    shapes = jax.eval_shape(lambda k: llama.init(k, cfg),
+                            jax.random.PRNGKey(0))
+    param_specs = get_contract("train.llama_dp_tp.params")(shapes)
+    opt = optax.sgd(1e-2)
+    opt_shapes = jax.eval_shape(opt.init, shapes)
+
+    apply_fn = llama.make_apply(cfg)
+    step = T.make_sharded_train_step(
+        lambda p, b: T.next_token_loss(apply_fn, p, b),
+        opt, mesh, param_specs)
+
+    p_avals = _aval_tree(shapes, T.specs_to_shardings(mesh, param_specs))
+    batch_aval = jax.ShapeDtypeStruct((batch, seq), jnp.int32)
+    compiled = step.lower(p_avals, opt_shapes, batch_aval).compile()
+
+    p_in, _, _ = _input_shardings_tree(
+        compiled, shapes, opt_shapes, batch_aval)
+    bill, f_b = memory_bill(shapes, param_specs, p_in, mesh,
+                            where=where, label="params")
+    findings += f_b
+
+    out_shardings = _output_shardings_tree(
+        compiled, (shapes, opt_shapes,
+                   jax.ShapeDtypeStruct((), jnp.float32)))
+    findings += contract_findings(
+        "train.llama_dp_tp.params", param_specs, out_shardings[0],
+        shapes, mesh, where=where)
+
+    tree_bytes = tree_weight_bytes(shapes)
+    try:
+        hlo = "\n".join(m.to_string()
+                        for m in compiled.runtime_executable()
+                        .hlo_modules())
+    except Exception:  # pragma: no cover
+        hlo = compiled.as_text()
+    alloc, f_a = collective_allocation_findings(hlo, tree_bytes,
+                                                where=where)
+    findings += f_a
+
+    return {"mesh": dict(mesh.shape), "bill": {"params": bill},
+            "collectives": alloc, "findings": findings}
+
+
+def audit_stacked_pipeline(*, stages: int = 2, feature: int = 8,
+                           batch: int = 4) -> dict:
+    """The stacked pipeline's declared placement
+    (pipeline.stacked_param_placement): each device must hold exactly
+    its 1/S stage slice of every stacked leaf — bill + contract over
+    the lowered spmd_pipeline_stacked program."""
+    import jax
+    import jax.numpy as jnp
+
+    from dnn_tpu import train as T
+    from dnn_tpu.parallel.mesh import STAGE_AXIS, make_mesh
+    from dnn_tpu.parallel.pipeline import spmd_pipeline_stacked
+
+    if len(jax.devices()) < stages:
+        return {"skipped": f"need {stages} devices", "findings": []}
+    mesh = make_mesh({STAGE_AXIS: stages})
+    where = "parallel/pipeline.spmd_pipeline_stacked"
+
+    def block(p, x):
+        return jnp.tanh(x @ p["w"] + p["b"])
+
+    stacked_shapes = {
+        "w": jax.ShapeDtypeStruct((stages, feature, feature),
+                                  jnp.float32),
+        "b": jax.ShapeDtypeStruct((stages, feature), jnp.float32),
+    }
+    specs = get_contract("pipeline.stacked_param_placement")(
+        stacked_shapes)
+    sharded = _aval_tree(stacked_shapes, T.specs_to_shardings(mesh, specs))
+    x_aval = jax.ShapeDtypeStruct((batch, feature), jnp.float32)
+
+    def pipe_step(sp, x):
+        return spmd_pipeline_stacked(block, sp, x, mesh=mesh,
+                                     num_microbatches=2)
+
+    compiled = jax.jit(pipe_step).lower(sharded, x_aval).compile()
+    p_in, _ = _input_shardings_tree(compiled, stacked_shapes, x_aval)
+    bill, findings = memory_bill(stacked_shapes, specs, p_in, mesh,
+                                 where=where, label="stacked")
+    findings += contract_findings(
+        "pipeline.stacked_param_placement", specs, p_in,
+        stacked_shapes, mesh, where=where)
+    return {"mesh": dict(mesh.shape), "bill": {"stacked": bill},
+            "findings": findings}
+
+
+def audit_moe_ep(*, experts: int = 4, ep: int = 2, batch: int = 4,
+                 seq: int = 4, d: int = 8) -> dict:
+    """The expert-parallel moe ffn (parallel/moe.make_moe_ffn_ep):
+    mesh-axis-aware branch-collective consistency plus the per-axis
+    collective signature of the traced program (the routing all_to_all /
+    psum schedule every rank must agree on)."""
+    import jax
+    import jax.numpy as jnp
+
+    from dnn_tpu.analysis.program import (
+        axis_collective_signature,
+        check_branch_collectives,
+    )
+    from dnn_tpu.parallel.mesh import EXPERT_AXIS, make_mesh
+    from dnn_tpu.parallel.moe import init_moe, make_moe_ffn_ep
+
+    if len(jax.devices()) < ep:
+        return {"skipped": f"need {ep} devices", "findings": []}
+    mesh = make_mesh({EXPERT_AXIS: ep})
+    params = jax.eval_shape(
+        lambda k: init_moe(k, d, experts), jax.random.PRNGKey(0))
+    apply = make_moe_ffn_ep(mesh)
+    x = jax.ShapeDtypeStruct((batch, seq, d), jnp.float32)
+    closed = jax.make_jaxpr(apply)(params, x)
+    findings = check_branch_collectives(closed,
+                                        "parallel/moe.make_moe_ffn_ep")
+    sig = axis_collective_signature(closed)
+    return {"mesh": dict(mesh.shape),
+            "collective_signature": list(sig),
+            "findings": findings}
+
+
+def run_shard_audit() -> Tuple[dict, List[Finding]]:
+    """The full sharded-program audit. Returns (report, findings) —
+    same shape as program.run_program_audit, same gate."""
+    from dnn_tpu.analysis.findings import assign_occurrences
+
+    report: Dict[str, dict] = {}
+    findings: List[Finding] = []
+    report["zero1"] = audit_zero1_train()
+    report["llama_dp_tp"] = audit_llama_dp_tp()
+    report["pipeline_stacked"] = audit_stacked_pipeline()
+    report["moe_ep"] = audit_moe_ep()
+    for section in report.values():
+        findings.extend(section.pop("findings", []))
+    return report, assign_occurrences(findings)

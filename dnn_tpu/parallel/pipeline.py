@@ -46,6 +46,7 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from dnn_tpu.analysis.shardcheck import contract as _shardcheck_contract
 from dnn_tpu.obs.profile import annotation_ctx as _prof_annotation
 from dnn_tpu.parallel.mesh import STAGE_AXIS
 
@@ -810,6 +811,21 @@ def spmd_pipeline_interleaved(
     return result.reshape(m_count * mb, *trail)
 
 
+def stacked_param_placement(stacked_params, *, axis_name: str = STAGE_AXIS):
+    """The declared placement contract of the stacked pipeline: every
+    leaf of the (S, ...)-stacked param tree shards its leading stage
+    axis — each device holds exactly its own stage's 1/S slice (the
+    HBM-resident per-stage weights of BASELINE.json's north star).
+    Registered as the `pipeline.stacked_param_placement` sharding
+    contract: the analysis gate lowers spmd_pipeline_stacked and fails
+    if any leaf's compiled placement drifts from this declaration."""
+    return jax.tree.map(lambda _: P(axis_name), stacked_params)
+
+
+_shardcheck_contract("pipeline.stacked_param_placement")(
+    stacked_param_placement)
+
+
 def spmd_pipeline_stacked(
     block_fn: Callable,
     stacked_params,
@@ -859,7 +875,8 @@ def spmd_pipeline_stacked(
     mb_local = mb // d_size
 
     if param_specs is None:
-        param_specs = jax.tree.map(lambda _: P(axis_name), stacked_params)
+        param_specs = stacked_param_placement(stacked_params,
+                                              axis_name=axis_name)
     # map over the PARAMS tree: flatten_up_to stops at its array leaves, so
     # the P specs (themselves tuples) come through whole
     stacked_params = jax.tree.map(

@@ -28,6 +28,7 @@ import jax.numpy as jnp
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from dnn_tpu.analysis.shardcheck import contract
 from dnn_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS, STAGE_AXIS
 from dnn_tpu.parallel.pipeline import (
     spmd_pipeline_interleaved,
@@ -245,6 +246,7 @@ def _tp_base_spec(keys, nd, axis):
     return P()
 
 
+@contract("train.gpt_dp_tp.params")
 def gpt_tp_specs(params, *, axis: str = MODEL_AXIS):
     """PartitionSpecs for the GPT family's flat param dict
     (dnn_tpu/models/gpt.py init): attention qkv / mlp fc shard their output
@@ -258,6 +260,12 @@ def gpt_tp_specs(params, *, axis: str = MODEL_AXIS):
         return _tp_base_spec(keys, leaf.ndim, axis)
 
     return jax.tree_util.tree_map_with_path(spec_for, params)
+
+
+# _tp_base_spec covers the LLaMA key family too (q/k/v/gate/up/o/down) —
+# the dp x tp llama step's contract IS this builder, registered under its
+# own name so the shardcheck audit verifies the llama program against it
+contract("train.llama_dp_tp.params")(gpt_tp_specs)
 
 
 def gpt_tp_specs_stacked(prepared, *, axis: str = MODEL_AXIS):
@@ -337,6 +345,7 @@ def _spec_with_data_axis(spec, leaf, n_data: int, data_axis: str):
     return spec if spec is not None else P()  # unchanged, as documented
 
 
+@contract("train.zero1.opt_state")
 def zero1_opt_state_specs(opt_state, params, param_specs, mesh: Mesh,
                           *, data_axis: str = DATA_AXIS):
     """ZeRO-1: PartitionSpecs that shard the OPTIMIZER STATE over the data
@@ -437,6 +446,7 @@ def make_sharded_train_step(
     *,
     batch_axis: str = DATA_AXIS,
     zero1: bool = False,
+    donate: bool = False,
 ):
     """dp x tp train step. Params must be placed with `shard_pytree(params,
     mesh, param_specs)`; the batch is sharded over `batch_axis` here. The
@@ -449,14 +459,24 @@ def make_sharded_train_step(
     the data axis instead of replicated — pass a state built by
     `init_zero1_opt_state` (a replicated one is resharded on first
     step). Loss/params stay numerically identical to zero1=False; only
-    memory and the collective schedule change."""
+    memory and the collective schedule change.
+
+    `donate=True` donates params and opt_state to the step (the sharded
+    steady state: old and new params never coexist in HBM). Opt-in
+    because donated buffers are invalidated — callers that reread the
+    previous state after stepping (the default-off safety) must rebind
+    from the step's results. The shardcheck audit lowers the donating
+    variant and fails the gate if any donated sharded leaf loses its
+    output alias (PRG003 under NamedSharding)."""
     param_shardings = specs_to_shardings(mesh, param_specs)
     batch_sharding = NamedSharding(mesh, P(batch_axis))
     # ZeRO-1 opt-state specs depend on the state's tree structure, which
     # only exists inside the traced step — resolved once, at first trace
     opt_sharding_cache = {}
+    jit = jax.jit if not donate else (
+        lambda f: jax.jit(f, donate_argnums=(0, 1)))
 
-    @jax.jit
+    @jit
     def step(params, opt_state, batch):
         batch = jax.lax.with_sharding_constraint(batch, batch_sharding)
         loss, grads = jax.value_and_grad(loss_fn)(params, batch)

@@ -40,8 +40,8 @@ import jax
 import jax.numpy as jnp
 
 __all__ = ["lowered_text", "op_result_sizes", "count_cache_sized",
-           "count_aliased", "gpt_decode_step", "llama_decode_step",
-           "audit_decode_step"]
+           "count_aliased", "count_aliased_compiled", "gpt_decode_step",
+           "llama_decode_step", "audit_decode_step"]
 
 # `%3 = stablehlo.transpose %2 ... -> tensor<8x12x64x256xf32>` (the last
 # tensor<...> on the line is the result type; rank-0 tensors have no dims)
@@ -100,6 +100,25 @@ def count_aliased(text: str) -> int:
     copy of it per call. Consumed by the analyzer's donation-coverage
     check (dnn_tpu/analysis/program.donation_report)."""
     return text.count("tf.aliasing_output")
+
+
+_ALIAS_PAIR = re.compile(r"\{[0-9,\s]*\}:\s*\(\d+,")
+
+
+def count_aliased_compiled(hlo_text: str) -> int:
+    """Donation aliasing at the COMPILED level: under GSPMD shardings
+    jit lowers donations as `jax.buffer_donor` hints (no
+    tf.aliasing_output at the StableHLO level — the aliasing decision
+    belongs to XLA once partitioning is resolved), and the verdict lands
+    in the optimized HLO's `input_output_alias={ {out}: (arg, ...) }`
+    header. Counts those pairs; a donated sharded buffer missing here
+    pays a full per-device copy every step. Consumed by the analyzer's
+    sharded-donation check (dnn_tpu/analysis/shardcheck)."""
+    m = re.search(r"input_output_alias=\{(.*?)\}\s*(?:\n|,\s*[a-z_]+=)",
+                  hlo_text, re.S)
+    if not m:
+        return 0
+    return len(_ALIAS_PAIR.findall(m.group(1)))
 
 
 def count_cache_sized(text: str, min_elems: int,

@@ -59,6 +59,12 @@ _MODULE_COST_S = {
     "test_torch_export": 11.1, "test_models_gpt": 11.4,
     "test_analysis": 13.7,  # the static-analyzer gate: cheap, CPU-only,
     # and placed early so the tier-1 budget always certifies it
+    "test_analysis_shard": 8.5,  # ISSUE 17 sharding-safety analyzer:
+    # SHD rule fixture pairs, buggy-program variants through the audit
+    # helpers (replicated bill, axis-divergent psum, contract drift,
+    # un-aliased sharded donation), the real-program goldens (one
+    # module-scoped run_shard_audit), SARIF + CLI exit codes — cheap,
+    # certified early in the tier-1 budget next to test_analysis
     "test_analysis_concurrency": 8.0,  # ISSUE 10 concurrency-hazard
     # analyzer: CON rule fixture pairs, the three historical shipped
     # bugs as fixtures, protocol-table goldens, loop-lag sanitizer,

@@ -1,0 +1,529 @@
+"""Fixture suite for the sharding-safety analyzer (analysis/shardcheck).
+
+One known-bad snippet per SHD AST rule (must be flagged) and a known-good
+twin (must not be); buggy-variant PROGRAM fixtures through the audit's
+own helpers (a declared-sharded leaf compiled replicated FAILS the
+memory bill; branches that psum over different mesh axes FAIL the
+mesh-axis-aware PRG001; a contract/lowering mismatch FAILS SHD009; an
+un-aliased sharded donation reads 0 in the compiled alias table); the
+real-program goldens on HEAD (the zero1 bill, donation coverage, and
+sharding census, pinned); and the CLI/SARIF exit-code contract.
+"""
+
+import json
+import os
+import textwrap
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from dnn_tpu.analysis.lint import lint_source
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def rules_of(src):
+    return sorted({f.rule for f in lint_source(textwrap.dedent(src), "t")})
+
+
+# ----------------------------------------------------------------------
+# AST rule fixtures: (known-bad, known-good twin)
+# ----------------------------------------------------------------------
+
+SHD_FIXTURES = {
+    "SHD001": (
+        """
+        import jax
+        def shards_per_replica():
+            return len(jax.devices()) // 2
+        """,
+        """
+        import jax
+        def has_pair():
+            # a COMPARISON on the count is a capability check, not a
+            # baked topology assumption
+            return len(jax.devices()) >= 2
+        """,
+    ),
+    "SHD002": (
+        """
+        import numpy as np
+        from jax.sharding import Mesh, PartitionSpec as P
+        def build(devs):
+            mesh = Mesh(np.array(devs), ("data", "model"))
+            return mesh, P("dta", None)
+        """,
+        """
+        import numpy as np
+        from jax.sharding import Mesh, PartitionSpec as P
+        def build(devs):
+            mesh = Mesh(np.array(devs), ("data", "model"))
+            return mesh, P("data", None)
+        """,
+    ),
+    "SHD003": (
+        """
+        import jax
+        from jax.sharding import PartitionSpec as P
+        def body(x):
+            return x * 2.0
+        def build(mesh):
+            return jax.shard_map(body, mesh=mesh,
+                                 in_specs=P("data"), out_specs=P())
+        """,
+        """
+        import jax
+        from jax.sharding import PartitionSpec as P
+        def body(x):
+            return jax.lax.psum(x, "data")
+        def build(mesh):
+            # replicated output EARNED by a psum reduction
+            return jax.shard_map(body, mesh=mesh,
+                                 in_specs=P("data"), out_specs=P())
+        """,
+    ),
+    "SHD004": (
+        """
+        import jax
+        import numpy as np
+        from jax.sharding import PartitionSpec as P
+        def log_stats(x):
+            return np.asarray(x).mean()
+        def body(x):
+            log_stats(x)
+            return x * 2.0
+        def build(mesh):
+            return jax.shard_map(body, mesh=mesh,
+                                 in_specs=P("data"), out_specs=P("data"))
+        """,
+        """
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import PartitionSpec as P
+        def log_stats(x):
+            return jnp.mean(x)
+        def body(x):
+            log_stats(x)
+            return x * 2.0
+        def build(mesh):
+            return jax.shard_map(body, mesh=mesh,
+                                 in_specs=P("data"), out_specs=P("data"))
+        """,
+    ),
+    "SHD005": (
+        """
+        import jax
+        from jax.sharding import PartitionSpec as P
+        def body(x):
+            key = jax.random.PRNGKey(0)
+            noise = jax.random.normal(key, x.shape)
+            return x + noise
+        def build(mesh):
+            return jax.shard_map(body, mesh=mesh,
+                                 in_specs=P("data"), out_specs=P("data"))
+        """,
+        """
+        import jax
+        from jax.sharding import PartitionSpec as P
+        def body(x):
+            key = jax.random.PRNGKey(0)
+            key = jax.random.fold_in(key, jax.lax.axis_index("data"))
+            noise = jax.random.normal(key, x.shape)
+            return x + noise
+        def build(mesh):
+            return jax.shard_map(body, mesh=mesh,
+                                 in_specs=P("data"), out_specs=P("data"))
+        """,
+    ),
+    "SHD006": (
+        """
+        import jax
+        from jax.sharding import PartitionSpec as P
+        def _step(w, x):
+            return (x,)
+        def build():
+            return jax.jit(_step, donate_argnums=(0,),
+                           in_shardings=(P("model", None), P()),
+                           out_shardings=(P(),))
+        """,
+        """
+        import jax
+        from jax.sharding import PartitionSpec as P
+        def _step(w, x):
+            return (w * 0.9,)
+        def build():
+            return jax.jit(_step, donate_argnums=(0,),
+                           in_shardings=(P("model", None), P()),
+                           out_shardings=(P("model", None),))
+        """,
+    ),
+}
+
+
+@pytest.mark.parametrize("rule", sorted(SHD_FIXTURES))
+def test_shd_fixture_pair(rule):
+    bad, good = SHD_FIXTURES[rule]
+    assert rule in rules_of(bad), f"{rule} must flag its bad fixture"
+    assert rule not in rules_of(good), f"{rule} must pass its good twin"
+
+
+def test_shd001_comparison_is_not_arithmetic():
+    """program.py:~600's `len(jax.devices()) >= 2` shape — a capability
+    check — must stay quiet; only arithmetic with an int literal fires."""
+    assert "SHD001" not in rules_of("""
+        import jax
+        ok = len(jax.devices()) >= 2
+        also_ok = jax.device_count() == 8
+        """)
+    assert "SHD001" in rules_of("""
+        import jax
+        n = jax.device_count() * 4
+        """)
+
+
+def test_shd002_silent_without_mesh_declaration():
+    """Modules that never declare a Mesh (the whole package: axis names
+    flow from parallel/mesh.py constants) get no axis-literal policing —
+    the rule is module-scoped by design."""
+    assert "SHD002" not in rules_of("""
+        from jax.sharding import PartitionSpec as P
+        spec = P("anything_goes")
+        """)
+
+
+def test_shd003_pjit_inference_not_flagged():
+    """jit/pjit with sharded in_shardings and OMITTED out_shardings is
+    fine — GSPMD propagates; only shard_map's undeclared outputs fire."""
+    assert "SHD003" not in rules_of("""
+        import jax
+        from jax.sharding import PartitionSpec as P
+        def f(x):
+            return x * 2.0
+        g = jax.jit(f, in_shardings=(P("data"),))
+        """)
+
+
+# ----------------------------------------------------------------------
+# sharding-contract API
+# ----------------------------------------------------------------------
+
+def test_contract_registry():
+    from dnn_tpu.analysis.shardcheck import contract_names, get_contract
+
+    names = contract_names()
+    for expected in ("train.gpt_dp_tp.params", "train.llama_dp_tp.params",
+                     "train.zero1.opt_state",
+                     "pipeline.stacked_param_placement"):
+        assert expected in names
+    specs = get_contract("pipeline.stacked_param_placement")(
+        {"w": jax.ShapeDtypeStruct((2, 4, 4), jnp.float32)})
+    assert specs == {"w": P("stage")}
+
+
+# ----------------------------------------------------------------------
+# program-audit helpers on buggy-variant fixtures
+# ----------------------------------------------------------------------
+
+def _mesh_dm():
+    from dnn_tpu.parallel.mesh import make_mesh
+
+    return make_mesh({"data": 2, "model": 2})
+
+
+def test_memory_bill_replicated_leaf_fails():
+    """The ISSUE 17 acceptance fixture: a leaf DECLARED sharded that the
+    program lowers replicated fails the per-shard memory bill (SHD008)
+    — the accidentally-replicated weight tree of 2004.13336, on paper."""
+    from dnn_tpu.analysis.shardcheck import memory_bill
+
+    mesh = _mesh_dm()
+    shapes = {"w": jax.ShapeDtypeStruct((8, 16), jnp.float32)}
+    declared = {"w": P(None, "model")}
+
+    # healthy: compiled with the declared sharding — bill balances
+    sharded_aval = {"w": jax.ShapeDtypeStruct(
+        (8, 16), jnp.float32,
+        sharding=NamedSharding(mesh, P(None, "model")))}
+    comp = jax.jit(lambda p: p).lower(sharded_aval).compile()
+    rep, findings = memory_bill(shapes, declared,
+                                comp.input_shardings[0][0], mesh,
+                                where="fixture")
+    assert findings == [] and rep["mismatches"] == []
+    assert rep["actual_per_device_bytes"] == rep["global_bytes"] // 2
+
+    # buggy: same declaration, program compiled fully replicated
+    repl_aval = {"w": jax.ShapeDtypeStruct(
+        (8, 16), jnp.float32, sharding=NamedSharding(mesh, P()))}
+    comp = jax.jit(lambda p: p).lower(repl_aval).compile()
+    rep, findings = memory_bill(shapes, declared,
+                                comp.input_shardings[0][0], mesh,
+                                where="fixture")
+    assert any(f.rule == "SHD008" for f in findings)
+    assert "REPLICATED" in findings[0].message
+    assert rep["mismatches"][0]["actual_bytes"] == \
+        rep["mismatches"][0]["global_bytes"]
+
+
+def test_contract_mismatch_fails():
+    """An implementation whose out_shardings drift from the declared
+    contract fails SHD009 on the compiled output shardings. (A
+    with_sharding_constraint on a pass-through is NOT enough to drift:
+    GSPMD re-propagates the input sharding over the intermediate
+    constraint — the check watches what the program FINALLY commits.)"""
+    from dnn_tpu.analysis.shardcheck import contract_findings
+
+    mesh = _mesh_dm()
+    shapes = {"w": jax.ShapeDtypeStruct((8, 16), jnp.float32)}
+    declared = {"w": P(None, "model")}
+    aval = {"w": jax.ShapeDtypeStruct(
+        (8, 16), jnp.float32,
+        sharding=NamedSharding(mesh, P(None, "model")))}
+
+    def step(p):
+        return jax.tree.map(lambda x: x * 0.9, p)
+
+    drifted = jax.jit(  # silently re-replicates the declared-sharded leaf
+        step, out_shardings={"w": NamedSharding(mesh, P())})
+    comp = drifted.lower(aval).compile()
+    findings = contract_findings("fixture.params", declared,
+                                 comp.output_shardings, shapes, mesh,
+                                 where="fixture")
+    assert any(f.rule == "SHD009" for f in findings)
+    assert "fixture.params" in findings[0].message
+
+    faithful = jax.jit(
+        step, out_shardings={"w": NamedSharding(mesh, P(None, "model"))})
+    comp = faithful.lower(aval).compile()
+    assert contract_findings("fixture.params", declared,
+                             comp.output_shardings, shapes, mesh,
+                             where="fixture") == []
+
+
+def test_allocation_sized_collective_flagged():
+    """SHD007's optimized-HLO walk: a collective whose result reaches the
+    tree-size threshold fires; leaf-sized gathers (healthy zero1) don't."""
+    from dnn_tpu.analysis.shardcheck import collective_allocation_findings
+
+    tree_bytes = 4 * 1024 * 32  # a 128 kB f32 weight tree
+    healthy = (
+        "  %ag = f32[64,32]{1,0} all-gather(f32[16,32]{1,0} %p), "
+        "dimensions={0}\n")
+    rep, findings = collective_allocation_findings(
+        healthy, tree_bytes, where="fixture")
+    assert findings == [] and rep["collectives"] == 1
+
+    repaired = (
+        "  %ag = f32[1024,32]{1,0} all-gather(f32[256,32]{1,0} %p), "
+        "dimensions={0}\n")
+    rep, findings = collective_allocation_findings(
+        repaired, tree_bytes, where="fixture")
+    assert any(f.rule == "SHD007" for f in findings)
+    assert rep["largest_frac"] == 1.0
+
+
+def test_prg001_axis_aware():
+    """The ISSUE 17 dropped-psum fixture: two branches agreeing on the
+    primitive NAME but reducing over different mesh axes fail the
+    mesh-axis-aware PRG001 (the name-level signature cannot see this)."""
+    from dnn_tpu.analysis.program import (
+        axis_collective_signature,
+        check_branch_collectives,
+        collective_signature,
+    )
+
+    mesh = Mesh(np.array(jax.devices()[:4]).reshape(2, 2), ("a", "b"))
+
+    def body(x):
+        return lax.cond(lax.axis_index("a") == 0,
+                        lambda v: lax.psum(v, "a"),
+                        lambda v: lax.psum(v, "b"), x)
+
+    f = jax.shard_map(body, mesh=mesh, in_specs=P(), out_specs=P(),
+                      check_vma=False)
+    closed = jax.make_jaxpr(f)(jnp.ones((4,)))
+    # the name-level signature sees only "psum" — blind to the split
+    assert set(collective_signature(closed)) == {"psum"}
+    findings = check_branch_collectives(closed, "fixture")
+    assert any(f.rule == "PRG001" for f in findings)
+    assert any("@a" in s for s in axis_collective_signature(closed))
+
+    def matched(x):
+        return lax.cond(lax.axis_index("a") == 0,
+                        lambda v: lax.psum(2 * v, "a"),
+                        lambda v: lax.psum(v, "a"), x)
+
+    g = jax.shard_map(matched, mesh=mesh, in_specs=P(), out_specs=P(),
+                      check_vma=False)
+    assert check_branch_collectives(
+        jax.make_jaxpr(g)(jnp.ones((4,))), "fixture") == []
+
+
+def test_unaliased_sharded_donation_detected():
+    """A sharded donated buffer whose output cannot alias reads ZERO in
+    the compiled input_output_alias table (the count the zero1 audit
+    gates on); a faithful donating update reads full coverage."""
+    import warnings
+
+    from dnn_tpu.utils.hlo_audit import count_aliased_compiled, lowered_text
+
+    mesh = _mesh_dm()
+    sh = NamedSharding(mesh, P("data"))
+    w = jax.ShapeDtypeStruct((8, 16), jnp.float32, sharding=sh)
+
+    def update(buf):
+        return buf * 0.9
+
+    text = lowered_text(update, w, donate_argnums=(0,), optimize=True)
+    assert count_aliased_compiled(text) == 1
+
+    def shrink(buf):  # output shape can never alias the donated input
+        return buf[:1]
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", UserWarning)
+        text = lowered_text(shrink, w, donate_argnums=(0,), optimize=True)
+    assert count_aliased_compiled(text) == 0
+
+
+def test_sharding_aware_census():
+    """PRG004's census keys on declared shardings too: identical avals
+    under different NamedShardings are different compiled programs."""
+    from dnn_tpu.analysis.program import recompile_census
+
+    mesh = _mesh_dm()
+    shard = jax.ShapeDtypeStruct(
+        (8, 16), jnp.float32, sharding=NamedSharding(mesh, P("data")))
+    repl = jax.ShapeDtypeStruct(
+        (8, 16), jnp.float32, sharding=NamedSharding(mesh, P()))
+    plain = jax.ShapeDtypeStruct((8, 16), jnp.float32)
+    rep = recompile_census([(shard,), (repl,), (shard,), (plain,)],
+                           bound=2, where="fixture")
+    assert rep["programs"] == 3
+    assert any(f.rule == "PRG004" for f in rep["findings"])
+
+
+# ----------------------------------------------------------------------
+# real-program goldens on HEAD
+# ----------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def shard_audit():
+    from dnn_tpu.analysis.shardcheck import run_shard_audit
+
+    return run_shard_audit()
+
+
+def test_audit_clean_on_head(shard_audit):
+    rep, findings = shard_audit
+    assert findings == []
+
+
+def test_zero1_bill_golden(shard_audit):
+    """The acceptance golden: the zero1 step's per-shard bytes match the
+    declared PartitionSpecs exactly — params sliced to 40448 B/device of
+    a 126464 B tree on the {data:2, model:4} mesh, adam moments sliced
+    by the same specs plus the ZeRO-1 data axis."""
+    rep, _ = shard_audit
+    bill = rep["zero1"]["bill"]
+    assert bill["params"]["mismatches"] == []
+    assert bill["params"]["expected_per_device_bytes"] == \
+        bill["params"]["actual_per_device_bytes"] == 40448
+    assert bill["params"]["global_bytes"] == 126464
+    assert bill["opt_state"]["mismatches"] == []
+    assert bill["opt_state"]["actual_per_device_bytes"] == \
+        bill["opt_state"]["expected_per_device_bytes"]
+    # the sharded state is a fraction of the replicated tree — the ZeRO
+    # memory win the bill certifies
+    assert bill["opt_state"]["actual_per_device_bytes"] < \
+        bill["opt_state"]["global_bytes"] / 2
+
+
+def test_zero1_donation_and_census_golden(shard_audit):
+    rep, _ = shard_audit
+    don = rep["zero1"]["donation"]
+    assert don["aliased"] == don["expected"] == 88
+    census = rep["zero1"]["sharding_census"]
+    assert census["programs"] == 2 and census["bound"] == 2
+
+
+def test_zero1_collectives_leaf_sized(shard_audit):
+    """Healthy zero1 all-gathers LEAF-sized updates (observed max ~6% of
+    the tree) — far under the 25% accidental-replication threshold."""
+    rep, _ = shard_audit
+    col = rep["zero1"]["collectives"]
+    assert 0 < col["largest_frac"] < col["threshold_frac"]
+    assert rep["llama_dp_tp"]["collectives"]["largest_frac"] < 0.25
+
+
+def test_stacked_pipeline_and_moe_goldens(shard_audit):
+    rep, _ = shard_audit
+    pl = rep["pipeline_stacked"]
+    assert pl["bill"]["stacked"]["mismatches"] == []
+    # each device holds exactly its 1/S stage slice
+    assert pl["bill"]["stacked"]["actual_per_device_bytes"] == \
+        pl["bill"]["stacked"]["global_bytes"] // 2
+    assert rep["moe_ep"]["collective_signature"] == \
+        ["all_to_all@expert", "all_to_all@expert"]
+
+
+def test_program_censuses_pinned():
+    """Satellite: the mesh/pipeline/transport program counts are pinned
+    (PRG004) — the sharded serving PR can't silently multiply
+    compilations per rung."""
+    from dnn_tpu.analysis.program import (
+        audit_pipeline_programs,
+        audit_transport_programs,
+    )
+
+    pipe = audit_pipeline_programs()
+    assert pipe.get("skipped") is None
+    assert pipe["findings"] == []
+    assert pipe["step_census"]["programs"] == 1
+    tp = audit_transport_programs()
+    assert tp.get("skipped") is None
+    assert tp["findings"] == []
+    assert tp["hop_census"]["programs"] == 1
+
+
+# ----------------------------------------------------------------------
+# CLI gate + SARIF
+# ----------------------------------------------------------------------
+
+@pytest.mark.parametrize("rule", sorted(SHD_FIXTURES))
+def test_cli_nonzero_per_shd_rule(rule, tmp_path):
+    from dnn_tpu.analysis.__main__ import main
+
+    bad = tmp_path / f"inject_{rule.lower()}.py"
+    bad.write_text(textwrap.dedent(SHD_FIXTURES[rule][0]))
+    assert main([str(bad), "--no-program", "--no-baseline"]) == 1
+    good = tmp_path / f"clean_{rule.lower()}.py"
+    good.write_text(textwrap.dedent(SHD_FIXTURES[rule][1]))
+    assert main([str(good), "--no-program", "--no-baseline"]) == 0
+
+
+def test_sarif_carries_shd_findings(tmp_path, capsys):
+    from dnn_tpu.analysis.__main__ import main
+
+    bad = tmp_path / "user_mesh_code.py"
+    bad.write_text(textwrap.dedent(SHD_FIXTURES["SHD001"][0]))
+    rc = main([str(bad), "--no-program", "--no-baseline",
+               "--format", "sarif"])
+    assert rc == 1
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["version"] == "2.1.0"
+    results = doc["runs"][0]["results"]
+    assert any(r["ruleId"] == "SHD001" and r["level"] == "error"
+               for r in results)
+    rules = {r["id"] for r in doc["runs"][0]["tool"]["driver"]["rules"]}
+    assert "SHD001" in rules
+
+
+def test_shd_rules_registered():
+    from dnn_tpu.analysis.findings import RULES
+
+    for n in range(1, 10):
+        assert f"SHD00{n}" in RULES

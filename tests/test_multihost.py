@@ -59,6 +59,88 @@ def test_single_process_is_noop():
     assert initialize_from_config(DistributedConfig("h:1", 1)) is False
 
 
+# ----------------------------------------------------------------------
+# capability probe: does THIS jaxlib's CPU client have a cross-process
+# collective transport (gloo)? Answered structurally, not by matching
+# error prose: a pure-jax 2-process job inits jax.distributed, then runs
+# exactly one boundary-crossing collective inside try/except and exits
+# with a SENTINEL code when only the collective raises. The verdict is
+# cached module-wide — one probe pair per session, however many tests
+# come to depend on it.
+# ----------------------------------------------------------------------
+
+_PROBE = textwrap.dedent("""
+    import os, sys
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    jax.distributed.initialize(coordinator_address=sys.argv[2],
+                               num_processes=2,
+                               process_id=int(sys.argv[1]))
+    import numpy as np
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    mesh = Mesh(np.array(jax.devices()), ("d",))
+    local = [jax.device_put(jnp.ones((1,)), d)
+             for d in jax.local_devices()]
+    garr = jax.make_array_from_single_device_arrays(
+        (4,), NamedSharding(mesh, P("d")), local)
+    try:
+        float(jax.jit(lambda x: jnp.sum(x),
+                      out_shardings=NamedSharding(mesh, P()))(garr))
+    except Exception:
+        sys.exit(42)  # init succeeded; the COLLECTIVE is what's missing
+""")
+
+_PROBE_SENTINEL = 42
+_probe_verdict = {}
+
+
+def _cpu_multiprocess_collectives_supported() -> bool:
+    """True unless the probe pair structurally reports the sentinel
+    (distributed init worked, the cross-process collective raised). Any
+    OTHER probe failure — init timeout, crash — deliberately reads as
+    'supported' so the real test runs and surfaces full diagnostics
+    instead of a silent skip."""
+    if "ok" in _probe_verdict:
+        return _probe_verdict["ok"]
+    import socket
+    import tempfile
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
+    with tempfile.TemporaryDirectory() as td:
+        script = os.path.join(td, "probe.py")
+        with open(script, "w") as f:
+            f.write(_PROBE)
+        procs = [
+            subprocess.Popen(
+                [sys.executable, script, str(pid), f"127.0.0.1:{port}"],
+                stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+                env=env)
+            for pid in (0, 1)
+        ]
+        try:
+            for p in procs:
+                p.wait(timeout=120)
+        except subprocess.TimeoutExpired:
+            pass
+        finally:
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
+                    p.wait()
+    _probe_verdict["ok"] = not any(
+        p.returncode == _PROBE_SENTINEL for p in procs)
+    return _probe_verdict["ok"]
+
+
 _WORKER = textwrap.dedent("""
     import json, os, sys
     sys.path.insert(0, {repo!r})
@@ -108,6 +190,12 @@ def test_two_process_distributed_psum(tmp_path):
     crossing the process boundary."""
     import socket
 
+    if not _cpu_multiprocess_collectives_supported():
+        # a toolchain limit (no gloo in this jaxlib's CPU client), not a
+        # framework bug; the same job spec runs on TPU pods and
+        # gloo-enabled builds — verdict from the structural probe above
+        pytest.skip("jaxlib CPU backend lacks multiprocess collectives")
+
     with socket.socket() as s:
         s.bind(("127.0.0.1", 0))
         port = s.getsockname()[1]
@@ -135,13 +223,6 @@ def test_two_process_distributed_psum(tmp_path):
             if p.poll() is None:
                 p.kill()
                 outs.append(p.communicate())
-    if all(p.returncode != 0 for p in procs) and all(
-            "Multiprocess computations aren't implemented on the CPU "
-            "backend" in e for _, e in outs):
-        # this jaxlib's CPU client has no cross-process collective
-        # transport (no gloo) — a toolchain limit, not a framework bug;
-        # the same job spec runs on TPU pods and gloo-enabled builds
-        pytest.skip("jaxlib CPU backend lacks multiprocess collectives")
     for p, (out, err) in zip(procs, outs):
         assert p.returncode == 0, "worker failed:\n" + "\n---\n".join(
             f"rc={q.returncode}\n{o}\n{e}" for q, (o, e) in zip(procs, outs)
