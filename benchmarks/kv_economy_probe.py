@@ -1,0 +1,210 @@
+"""KV-economy probe: the miss-ratio curve's self-validation (ISSUE 18).
+
+kvlens (dnn_tpu/obs/kvlens.py) claims its sampled reuse-distance curve
+PREDICTS the block-hit ratio the radix KV tier would measure at pool
+sizes nobody has run. A prediction instrument that is never checked
+against ground truth is a dashboard decoration, so this probe closes
+the loop on a real in-process batcher:
+
+  1. Replay the PR 13 multi-turn-chat arrival schedule
+     (workloads.arrivals.poisson_arrivals, seed=15, name
+     "kvtier:chat" — the same deterministic order kv_tier_probe
+     drives) over N_TENANTS tenants with Zipf-skewed tenant choice
+     (arrivals.uniform, inverse-CDF — zero wall-clock randomness).
+     Each tenant owns BLOCKS_PER_TENANT blocks of shared prefix; the
+     working set is WORKING_SET_X times the configured pool, so the
+     store evicts continuously at capacity A.
+  2. At pool capacity A (CAP_A blocks) record what the lens's curve
+     PREDICTS for capacity B = CAP_A // 2 — the 0.5x multiplier, a
+     pool size this process has never run.
+  3. Rebuild the batcher at capacity B, replay the IDENTICAL trace,
+     and read the lens's exact per-block measured hit ratio (counted
+     from the real store's lookup results, not from the sample).
+  4. Assert |predicted − measured| <= MRC_ERROR_CEIL (0.10 absolute
+     hit-ratio — benchmarks/ledger.py imports the constant for the
+     `mrc_prediction_error` ratchet), and that the pressured run's
+     thrash detector billed a non-zero evict→refetch tax (the forensic
+     leg: re-prefill chunk-seconds with a live EMA price).
+
+Workload-shape note (learned the hard way): a CYCLIC working set is
+LRU's adversarial case — pure-LRU stack distance predicts 0 hits at
+1x while the real leaf-LRU store (with parking) measures ~0.19 — so
+the probe uses the skewed tenant-reuse shape real chat traffic has
+(Zipf s=1.1 over tenants). The curve's contract is "predicts the
+store's behaviour on serving-shaped traffic", not "models every
+adversarial reference string"; STUDIES §22 records both numbers.
+
+The prediction run and the measurement run share every seed, so the
+whole probe is bit-deterministic on a host: once green, green.
+
+Standalone:  python benchmarks/kv_economy_probe.py [--assert]
+Suite row:   benchmarks/run_all.py config `kv_economy`
+             (cpu-runnable).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+#: asserted ceiling on |predicted − measured| block-hit ratio at the
+#: untested pool size (absolute). Measured ~0.01-0.05 on this host;
+#: 0.10 is the issue's contracted tolerance — a curve that drifts a
+#: full decile from ground truth is mis-sizing pools. ledger.py reads
+#: this constant for the `mrc_prediction_error` ratchet.
+MRC_ERROR_CEIL = 0.10
+
+CAP_A = 32            # pool capacity A (blocks) — the observed run
+CAP_B = CAP_A // 2    # prediction target: the curve's 0.5x point
+N_TENANTS = 96        # x1 block each = 96 distinct blocks...
+BLOCKS_PER_TENANT = 1  # single-block prefixes: on 1-block chains the
+# trie's leaf-LRU IS flat LRU, the reuse-distance model's policy —
+# with deeper chains leaf-first eviction protects popular inner
+# blocks and the real store BEATS the LRU curve (STUDIES §22 records
+# the 2-block gap: the curve is then a conservative lower bound)
+WORKING_SET_X = (N_TENANTS * BLOCKS_PER_TENANT) / CAP_A  # ...= 3.0x A
+ZIPF_S = 1.1          # tenant-popularity skew (chat-shaped reuse)
+CHAT_RATE_HZ = 60.0   # arrival schedule: ~300 turns over 5 s of the
+CHAT_DUR_S = 5.0      # PR 13 chat process (replayed back-to-back —
+# the probe needs the deterministic ORDER and COUNT, not the pacing)
+BLOCK_LEN = 16
+SEED = 15             # the kv_tier_probe chat seed
+
+
+def _tenant_sequence(n: int):
+    """Zipf(s)-skewed tenant id per arrival via inverse CDF over
+    arrivals.uniform — deterministic, seed-pinned, no numpy RNG."""
+    from dnn_tpu.workloads.arrivals import uniform
+
+    w = [1.0 / (k + 1) ** ZIPF_S for k in range(N_TENANTS)]
+    tot = sum(w)
+    cdf, acc = [], 0.0
+    for x in w:
+        acc += x
+        cdf.append(acc / tot)
+    out = []
+    for i in range(n):
+        u = uniform(SEED, "kv_economy:tenant", i)
+        t = 0
+        while t < N_TENANTS - 1 and u > cdf[t]:
+            t += 1
+        out.append(t)
+    return out
+
+
+def _prompt(tenant: int):
+    """BLOCKS_PER_TENANT blocks of tenant-owned tokens. 37 is coprime
+    to 510, so no two tenants share even their first block."""
+    import numpy as np
+
+    n = BLOCKS_PER_TENANT * BLOCK_LEN
+    return (np.arange(n) + 37 * tenant) % 510 + 1
+
+
+def _replay(prefix_cache: int, tenants):
+    """Build a paged batcher with `prefix_cache` store blocks, run the
+    whole tenant sequence through submit→drain→claim (each turn's
+    prefill really inserts / evicts in the radix store), and return
+    the attached lens."""
+    import jax
+
+    from dnn_tpu import obs
+    from dnn_tpu.models import gpt
+    from dnn_tpu.runtime.serving import ContinuousBatcher
+
+    cfg = gpt.GPTConfig(block_size=64, vocab_size=512, n_layer=4,
+                        n_head=4, n_embd=256)
+    prepared = gpt.prepare_stacked(gpt.init(jax.random.PRNGKey(0), cfg),
+                                   cfg)
+    was = obs.enabled()
+    obs.set_enabled(True)  # the lens attaches at construction
+    try:
+        # explicit paged_blocks: prefix_cache + live-request headroom
+        # (slots x max_len/block_len + the reserved null block), so
+        # the STORE CAP is the binding constraint — the auto-sized
+        # pool (17 blocks here) would bound residency below either
+        # capacity under test and make A and B measure identically
+        pool = prefix_cache + 4 * (cfg.block_size // BLOCK_LEN) + 1
+        srv = ContinuousBatcher(cfg, prepared, slots=4,
+                                max_len=cfg.block_size, prompt_pad=16,
+                                kv="paged", block_len=BLOCK_LEN,
+                                paged_blocks=pool,
+                                prefix_cache=prefix_cache)
+        lens = srv._kvlens
+        assert lens is not None, "kvlens did not attach"
+        for t in tenants:
+            rid = srv.submit(_prompt(t), 1)
+            srv.drain()
+            srv.claim(rid)
+        return lens
+    finally:
+        obs.set_enabled(was)
+
+
+def measure() -> dict:
+    from dnn_tpu.workloads.arrivals import poisson_arrivals
+
+    arrivals = poisson_arrivals(CHAT_RATE_HZ, CHAT_DUR_S, seed=SEED,
+                                name="kvtier:chat")
+    tenants = _tenant_sequence(len(arrivals))
+
+    # ---- run at capacity A: record the curve's 0.5x prediction -----
+    lens_a = _replay(CAP_A, tenants)
+    predicted_b = lens_a.predicted_hit_ratio(0.5)
+    curve_a = lens_a.curve()
+    # self-consistency receipt (reported, not the asserted leg): the
+    # 1x point predicts the run it was sampled FROM
+    self_err = abs(lens_a.predicted_hit_ratio(1.0)
+                   - lens_a.measured_hit_ratio())
+
+    # ---- re-run at capacity B: ground truth for the prediction -----
+    lens_b = _replay(CAP_B, tenants)
+    measured_b = lens_b.measured_hit_ratio()
+    thrash_b = lens_b.thrash()
+
+    err = abs(predicted_b - measured_b)
+    return {
+        "mrc_prediction_error": round(err, 4),
+        "predicted_hit_ratio_at_B": round(predicted_b, 4),
+        "measured_hit_ratio_at_B": round(measured_b, 4),
+        "cap_A_blocks": CAP_A, "cap_B_blocks": CAP_B,
+        "working_set_blocks": N_TENANTS * BLOCKS_PER_TENANT,
+        "working_set_x": round(WORKING_SET_X, 2),
+        "turns": len(tenants),
+        "curve_at_A": {c["mult"]: c["predicted_hit_ratio"]
+                       for c in curve_a},
+        "measured_hit_ratio_at_A": round(lens_a.measured_hit_ratio(), 4),
+        "self_consistency_err_at_A": round(self_err, 4),
+        "sampled_at_A": lens_a.sampled,
+        "sample_rate": lens_a.rate,
+        # the forensic leg: the pressured pool's evict→refetch bill
+        "thrash_refetch_blocks_at_B": thrash_b["refetch_blocks"],
+        "thrash_chunk_seconds_at_B": round(thrash_b["chunk_seconds"], 4),
+        "evictions_by_cause_at_B": dict(lens_b.evictions_by_cause),
+        "ok": bool(err <= MRC_ERROR_CEIL
+                   and thrash_b["refetch_blocks"] > 0),
+    }
+
+
+def main(argv=None) -> int:
+    args = set(argv if argv is not None else sys.argv[1:])
+    row = measure()
+    print(json.dumps(row), flush=True)
+    if "--assert" in args and not row["ok"]:
+        print(f"FAIL: mrc_prediction_error "
+              f"{row['mrc_prediction_error']} > {MRC_ERROR_CEIL} "
+              f"(predicted {row['predicted_hit_ratio_at_B']} vs "
+              f"measured {row['measured_hit_ratio_at_B']} at "
+              f"{CAP_B} blocks) or zero thrash refetches",
+              file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -143,7 +143,9 @@ def _results_md_rows(results_path: str, latest: dict) -> None:
             for key in ("ok", "fleet_availability", "fleet_vs_single",
                         "fleet_silently_lost", "coverage",
                         "availability", "slo_verdict", "reconstructed",
-                        "host_fraction", "parity_ok"):
+                        "host_fraction", "parity_ok",
+                        "kvlens_admit_overhead_pct",
+                        "thrash_refetch_blocks_at_B"):
                 m = re.search(rf"\b{key}=([^,|]+)", details)
                 if not m:
                     continue
@@ -278,6 +280,19 @@ RATCHETS: List[Ratchet] = [
     Ratchet("analysis_gate_wall_s", "analysis_gate", "value", "<=",
             _t("benchmarks.run_all", "ANALYSIS_GATE_WALL_CEIL_S"),
             "full `python -m dnn_tpu.analysis` gate wall seconds"),
+    # the memory-economy observatory (ISSUE 18): the miss-ratio curve
+    # must keep predicting ground truth at an untested pool size, the
+    # pressured run must bill real thrash, and the reuse-distance
+    # tracker must stay inside the admission-path obs budget
+    Ratchet("mrc_prediction_error", "kv_economy", "value", "<=",
+            _t("benchmarks.kv_economy_probe", "MRC_ERROR_CEIL"),
+            "|predicted − measured| block-hit ratio at capacity B"),
+    Ratchet("kv_economy_thrash_billed", "kv_economy",
+            "thrash_refetch_blocks_at_B", ">=", _const(1.0),
+            "evict→refetch blocks billed at the pressured capacity"),
+    Ratchet("kvlens_overhead_budget", "obs_overhead",
+            "kvlens_admit_overhead_pct", "<=", _const(2.0),
+            "admission obs tax % with the reuse-distance tracker live"),
     Ratchet("workload_spec_mix", "workload_spec_mix", "ok", "==",
             _const(True), "speculative-mix scenario SLO verdict"),
     Ratchet("workload_lora", "workload_lora", "ok", "==", _const(True),

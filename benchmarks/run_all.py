@@ -563,24 +563,38 @@ def dev_obs_overhead():
     # (benchmarks/obs_overhead_probe.py documents why coarser A/B
     # designs all produced measurement artifacts on this host). The
     # layer's contract is < 2% (ISSUE 3); `ok` records the verdict.
-    from benchmarks.obs_overhead_probe import measure, measure_kvtier
+    from benchmarks.obs_overhead_probe import (
+        measure,
+        measure_kvlens,
+        measure_kvtier,
+    )
 
     results = []
     row = measure()
     overhead = row.pop("overhead_frac")
     # the KV-tier admission leg (ISSUE 15): the radix lookup + its
     # block-granular counters/gauges in the admission path, same
-    # contract — both legs must hold or the row is red
+    # contract — all legs must hold or the row is red
     kv = measure_kvtier()
     kv_overhead = kv.pop("kvtier_admit_overhead_frac")
     row.update(kv)
     row["kvtier_admit_overhead_pct"] = round(kv_overhead * 100, 2)
+    # the kvlens leg (ISSUE 18): the same admission wall with the
+    # reuse-distance tracker LIVE — blake2s chunk digests + SHARDS
+    # sampling + LRU-stack bookkeeping in the ON population, one gate
+    # check in the OFF population; same contract
+    kl = measure_kvlens()
+    kl_overhead = kl.pop("kvlens_admit_overhead_frac")
+    row.update(kl)
+    row["kvlens_admit_overhead_pct"] = round(kl_overhead * 100, 2)
     _emit(results, config="obs_overhead", metric="overhead_pct",
           value=round(overhead * 100, 2), platform=_platform(),
-          ok=bool(overhead < 0.02 and kv_overhead < 0.02),
+          ok=bool(overhead < 0.02 and kv_overhead < 0.02
+                  and kl_overhead < 0.02),
           note="serving decode step, obs on (traced) vs off, per-step "
                "interleave; + kvtier radix-admission leg "
-               "(per-admission interleave); contract < 2% on both",
+               "(per-admission interleave); + kvlens reuse-distance "
+               "leg (tracker live on admission); contract < 2% on all",
           **row)
     return results
 
@@ -829,6 +843,32 @@ def dev_kv_tier():
     _emit(results, config="kv_tier",
           metric="cross_replica_hit_ratio", value=ratio, ok=ok,
           note=note, cross_replica_hit_ratio=ratio, **row)
+    return results
+
+
+@device_config("kv_economy")
+def dev_kv_economy():
+    # ISSUE 18: kvlens's miss-ratio curve validated against ground
+    # truth — replay the deterministic chat-arrival schedule (working
+    # set 3x the pool) at capacity A, record the curve's 0.5x
+    # prediction, re-run the identical trace at capacity B = A/2, and
+    # assert |predicted − measured| <= MRC_ERROR_CEIL on the real
+    # store's per-block hit tally. The pressured run must also bill a
+    # non-zero evict→refetch thrash tax (the forensics leg).
+    from benchmarks.kv_economy_probe import MRC_ERROR_CEIL, measure
+
+    results = []
+    row = measure()
+    ok = row.pop("ok")
+    err = row.pop("mrc_prediction_error")
+    _emit(results, config="kv_economy",
+          metric="mrc_prediction_error", value=err, ok=ok,
+          platform=_platform(),
+          note=f"curve@{row['cap_A_blocks']}blk predicts hit ratio at "
+               f"{row['cap_B_blocks']}blk; ceiling "
+               f"{MRC_ERROR_CEIL} absolute; thrash refetches > 0 "
+               "required at the pressured capacity",
+          mrc_prediction_error=err, **row)
     return results
 
 

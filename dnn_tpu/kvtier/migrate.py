@@ -404,10 +404,12 @@ class LeaseTable:
         obs.flight.record("lease_expire", lease=lease.lease_id,
                           bytes=lease.nbytes,
                           age_s=round(time.monotonic() - lease.t_offer,
-                                      2))
+                                      2),
+                          cause="lease_reclaim")
         lease._free()
         lease.state = "released"
-        obs.flight.record("lease_reclaim", lease=lease.lease_id)
+        obs.flight.record("lease_reclaim", lease=lease.lease_id,
+                          cause="lease_reclaim")
         self._leases.pop(lease.lease_id, None)
 
     def sweep(self, now: Optional[float] = None) -> int:

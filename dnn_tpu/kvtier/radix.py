@@ -57,7 +57,7 @@ class RadixNode:
     asserts reads this)."""
 
     __slots__ = ("chunk", "block", "children", "parent", "logit_row",
-                 "origin", "lru")
+                 "origin", "lru", "obskey")
 
     def __init__(self, chunk: np.ndarray, block: int,
                  parent: "Optional[RadixNode]", *, origin: str = "local"):
@@ -68,6 +68,11 @@ class RadixNode:
         self.logit_row = None
         self.origin = origin
         self.lru = 0
+        # path digest stamped by obs/kvlens.py at insert time — evicted
+        # nodes are detached (parent=None), so the forensics key must be
+        # captured while the path is still walkable; None when the lens
+        # was off at birth (forensics degrade, eviction counts hold)
+        self.obskey = None
 
     @property
     def depth(self) -> int:

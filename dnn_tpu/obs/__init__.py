@@ -74,6 +74,23 @@ v5 adds the JUDGMENT layer — the workload suite's verdict machinery
     `python -m dnn_tpu.obs incident PATH` renders back as the
     event-by-event post-mortem (dnn_tpu/workloads drives it).
 
+v6 adds the MEMORY-ECONOMY layer — the sizing instrument for the KV
+capacity hierarchy (ROADMAP item 4) and the autoscaler's
+capacity-vs-compute question (item 3):
+
+  * kvlens (obs/kvlens.py): SHARDS-style sampled reuse-distance
+    tracking over the radix KV tier's admission stream (deterministic
+    blake2s spatial sampling — zero wall-clock randomness), miss-ratio
+    curves predicting the block-hit ratio at 0.5x..8x of the
+    configured pool on /kvz (+ weak scrape gauges, /fleetz rollup
+    columns, `python -m dnn_tpu.obs kvlens`), a bounded per-block
+    lifecycle ledger (birth/share/COW/evict/migrate/refetch with
+    cause attribution), and a thrash detector pricing
+    evict→refetch-within-window churn in re-prefill chunk-seconds and
+    migrated bytes; benchmarks/kv_economy_probe.py asserts the curve
+    against ground truth (|predicted − measured| ≤ 0.10 at an
+    untested pool size).
+
 Gate: DNN_TPU_OBS=off (or 0/false) disables everything — producers see
 `metrics()` return None, `start_span` return the free NULL_SPAN, and
 `flight.record` short-circuit on one boolean. The gate is re-checked
@@ -170,7 +187,7 @@ def install_compile_telemetry() -> bool:
 
 def serve_metrics(port: int = 0, host: str = "127.0.0.1", *,
                   healthy=None, status=None, profiler=None, fleet=None,
-                  drain=None, stepclock=None):
+                  drain=None, stepclock=None, kvlens=None):
     """Start the observability HTTP endpoint on a daemon thread; returns
     the MetricsHTTPServer (`.port` for port=0 ephemeral binds,
     `.close()` to stop; loopback by default — pass host="0.0.0.0" to
@@ -188,7 +205,11 @@ def serve_metrics(port: int = 0, host: str = "127.0.0.1", *,
     POST /drainz — connection draining (runtime/lm_server.LMServer
     passes its handler). `stepclock` (an obs.timeline.StepClock)
     additionally serves the step-timeline attribution on /stepz (JSON;
-    ?format=prom|trace). See obs/http.py."""
+    ?format=prom|trace). `kvlens` (an obs.kvlens.KVLens) additionally
+    serves the memory-economy observatory on /kvz (JSON;
+    ?format=prom) — LMServer attaches its batcher's lens after
+    construction by assigning `server._kvlens` (the batcher is built
+    after the endpoint comes up). See obs/http.py."""
     from dnn_tpu.obs.http import MetricsHTTPServer
     from dnn_tpu.obs.mem import install_memory_gauges
 
@@ -200,4 +221,4 @@ def serve_metrics(port: int = 0, host: str = "127.0.0.1", *,
     return MetricsHTTPServer(port=port, host=host, healthy=healthy,
                              status=status, profiler=profiler or None,
                              fleet=fleet, drain=drain,
-                             stepclock=stepclock)
+                             stepclock=stepclock, kvlens=kvlens)

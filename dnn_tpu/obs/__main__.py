@@ -1,5 +1,26 @@
-"""CLI: `python -m dnn_tpu.obs {trace,flight,fleet,timeline,incident}
-...` — obs tooling.
+"""CLI: `python -m dnn_tpu.obs {trace,flight,fleet,timeline,incident,
+kvlens} ...` — obs tooling.
+
+    python -m dnn_tpu.obs kvlens --url http://host:port
+        Fetch a running server's /kvz (the memory-economy observatory,
+        obs/kvlens.py) and print the miss-ratio curve — predicted
+        block-hit ratio at 0.5x..8x of the configured KV pool — next
+        to the measured ratio at the real capacity, the sampling
+        stats, and the thrash bill (evict→refetch re-prefill
+        chunk-seconds + migrated bytes). --json for the raw dict.
+
+    python -m dnn_tpu.obs kvlens PATH
+        Render a saved /kvz JSON dump (a `curl .../kvz > kvz.json`
+        capture) with the same table — post-mortems read dumps, not
+        live servers.
+
+    python -m dnn_tpu.obs kvlens --selftest
+        In-process smoke: hand-computed LRU stack-distance/MRC
+        goldens (rate=1), SHARDS sampling determinism (same seed ⇒
+        bit-identical curve), thrash-window arithmetic on an injected
+        clock, gate-off-records-nothing, and the /kvz endpoint in both
+        formats; exit 0 on success. Tier-1 wired
+        (tests/test_obs_kvlens.py).
 
     python -m dnn_tpu.obs incident PATH [--json]
         Render an SLO-breach incident bundle (obs/slo.py — written
@@ -446,6 +467,164 @@ def _timeline_path(path: str, as_json: bool, top: int) -> int:
     return 0
 
 
+def _kvlens_selftest() -> int:
+    """Deterministic KVLens end to end: MRC goldens at rate=1 (every
+    access sampled — stack distances are exact), sampling determinism,
+    thrash-window arithmetic on an injected clock, the gate, and the
+    /kvz endpoint in both formats."""
+    from types import SimpleNamespace
+    from urllib.request import urlopen
+
+    import numpy as np
+
+    from dnn_tpu import obs
+    from dnn_tpu.obs.kvlens import KVLens
+
+    obs.set_enabled(True)
+    # -- MRC golden: pool=4, caps (2,4,8,16,32); trace A B C A --------
+    bp = 4
+    A = np.arange(0, bp)
+    B = np.arange(100, 100 + bp)
+    C = np.arange(200, 200 + bp)
+    lens = KVLens(4, bp, seed=0, rate=1.0, now=lambda: 0.0)
+    for p in (A, B, C, A):
+        lens.on_access(p)
+    # the re-accessed A sits at stack distance 2 (B, C more recent):
+    # a hit at every capacity > 2, a miss at the 0.5x (=2) pool
+    got = [c["predicted_hit_ratio"] for c in lens.curve()]
+    assert got == [0.0, 0.25, 0.25, 0.25, 0.25], got
+    assert lens.sampled == 4 and lens.sampled_cold == 3, (
+        lens.sampled, lens.sampled_cold)
+
+    # -- sampling determinism: same seed ⇒ bit-identical curve --------
+    def run(seed):
+        ln = KVLens(8, bp, seed=seed, rate=0.3, now=lambda: 0.0)
+        for i in range(200):
+            ln.on_access(np.arange((i % 17) * bp, (i % 17) * bp + bp))
+        return ln
+
+    l1, l2 = run(7), run(7)
+    assert l1.curve() == l2.curve() and l1.sampled == l2.sampled
+    assert 0 < l1.sampled < l1.accesses  # the rate really subsamples
+
+    # -- thrash-window arithmetic (injected clock) --------------------
+    t = [0.0]
+    lens = KVLens(4, bp, seed=0, rate=1.0, thrash_window_s=10.0,
+                  bytes_per_block=64, now=lambda: t[0])
+    lens.note_prefill(2, 1.0)   # EMA seeds at 0.5 s/chunk
+    node = SimpleNamespace(depth=1, obskey=None)
+    lens.on_insert(A, [node])
+    assert node.obskey is not None
+    lens.on_evict([node.obskey], cause="capacity")
+    t[0] = 5.0                  # inside the window: a refetch
+    lens.on_insert(A, [SimpleNamespace(depth=1, obskey=None)])
+    assert lens.refetch_blocks == 1, lens.refetch_blocks
+    assert abs(lens.thrash_chunk_seconds - 0.5) < 1e-9
+    nb = SimpleNamespace(depth=1, obskey=None)
+    lens.on_insert(B, [nb])
+    lens.on_evict([nb.obskey], cause="capacity")
+    t[0] = 16.0                 # past the window: churn, not thrash
+    lens.on_insert(B, [SimpleNamespace(depth=1, obskey=None)])
+    assert lens.refetch_blocks == 1, lens.refetch_blocks
+    # an ADOPTED refetch bills the wire too
+    na = SimpleNamespace(depth=1, obskey=None)
+    lens.on_insert(C, [na], origin="adopted")
+    lens.on_evict([na.obskey], cause="capacity")
+    t[0] = 17.0
+    lens.on_insert(C, [SimpleNamespace(depth=1, obskey=None)],
+                   origin="adopted")
+    assert lens.refetch_blocks == 2
+    assert lens.thrash_migrated_bytes == 64
+    kinds = [e["kind"] for e in lens.ledger.events()]
+    assert kinds.count("refetch") == 2 and "evict" in kinds, kinds
+
+    # -- gate off records NOTHING -------------------------------------
+    obs.set_enabled(False)
+    try:
+        off = KVLens(4, bp, seed=0, rate=1.0)
+        off.on_access(A)
+        off.on_insert(A, [SimpleNamespace(depth=1, obskey=None)])
+        off.on_evict([b"x" * 16])
+        off.on_share(3)
+        off.note_prefill(1, 1.0)
+        assert off.accesses == 0 and off.births == 0
+        assert off.shares == 0 and len(off.ledger) == 0
+    finally:
+        obs.set_enabled(True)
+
+    # -- /kvz endpoint, both formats ----------------------------------
+    srv = obs.serve_metrics(0, kvlens=lens)
+    try:
+        base = f"http://127.0.0.1:{srv.port}/kvz"
+        z = json.loads(urlopen(base, timeout=10).read().decode())
+        assert [c["mult"] for c in z["curve"]] == \
+            ["0.5x", "1x", "2x", "4x", "8x"], z["curve"]
+        assert z["thrash"]["refetch_blocks"] == 2, z["thrash"]
+        prom = urlopen(base + "?format=prom",
+                       timeout=10).read().decode()
+        assert 'dnn_tpu_kvlens_pred_hit_ratio{mult="2x"}' in prom
+        assert "dnn_tpu_kvlens_thrash_chunk_seconds_total" in prom
+    finally:
+        srv.close()
+    print("kvlens selftest ok: MRC golden [0, .25, .25, .25, .25] at "
+          f"caps (2..32), determinism ({l1.sampled}/{l1.accesses} "
+          "sampled twice, bit-identical), thrash 2 refetches = "
+          f"{lens.thrash_chunk_seconds:.1f} chunk-s + 64 B wire, gate "
+          "off silent, /kvz json+prom served")
+    return 0
+
+
+def _kvlens_render(z: dict) -> None:
+    cfg = z.get("config", {})
+    smp = z.get("samples", {})
+    meas = z.get("measured", {})
+    print(f"pool {cfg.get('pool_blocks')} blocks x block_len "
+          f"{cfg.get('block_len')} | sampling rate {cfg.get('rate')} "
+          f"seed {cfg.get('seed')} | {smp.get('sampled')}/"
+          f"{smp.get('accesses')} accesses sampled "
+          f"({smp.get('cold')} cold)")
+    print(f"{'capacity':>10} {'mult':>6} {'predicted hit':>14}")
+    for c in z.get("curve", []):
+        v = c.get("predicted_hit_ratio")
+        print(f"{c.get('capacity_blocks'):>10} {c.get('mult'):>6} "
+              + (f"{v:>13.1%}" if v is not None else f"{'—':>13}"))
+    mr = meas.get("hit_ratio")
+    print(f"measured at 1x: "
+          + (f"{mr:.1%}" if mr is not None else "—")
+          + f" ({meas.get('hits')}/{meas.get('accesses')} blocks)")
+    th = z.get("thrash", {})
+    print(f"thrash: {th.get('refetch_blocks')} refetches inside "
+          f"{th.get('window_s')}s = {th.get('chunk_seconds')} "
+          f"re-prefill chunk-s + {th.get('migrated_bytes')} B "
+          "re-migrated")
+    lc = z.get("lifecycle", {})
+    print(f"lifecycle: {lc.get('births')} births, {lc.get('shares')} "
+          f"shares ({lc.get('cows')} COW), {lc.get('migrations')} "
+          f"migrated blocks, evictions {lc.get('evictions_by_cause')}")
+
+
+def _kvlens_url(url: str, as_json: bool) -> int:
+    from urllib.request import urlopen
+
+    z = json.loads(urlopen(url.rstrip("/") + "/kvz",
+                           timeout=10).read().decode())
+    if as_json:
+        print(json.dumps(z, indent=2, default=str))
+    else:
+        _kvlens_render(z)
+    return 0
+
+
+def _kvlens_path(path: str, as_json: bool) -> int:
+    with open(path) as f:
+        z = json.load(f)
+    if as_json:
+        print(json.dumps(z, indent=2, default=str))
+    else:
+        _kvlens_render(z)
+    return 0
+
+
 def _fleet_cmd(args) -> int:
     from dnn_tpu.obs.fleet import FleetCollector, targets_from_config
 
@@ -578,6 +757,19 @@ def main(argv=None) -> int:
                          "report")
     tl.add_argument("--top", type=int, default=10,
                     help="top-K device ops to report (default 10)")
+    kv = sub.add_parser("kvlens", help="memory-economy observatory: "
+                        "/kvz fetch — miss-ratio curve, thrash bill, "
+                        "block forensics (obs/kvlens.py)")
+    kv.add_argument("path", nargs="?", default=None,
+                    help="saved /kvz JSON dump to render")
+    kv.add_argument("--selftest", action="store_true",
+                    help="in-process smoke (MRC goldens, sampling "
+                         "determinism, thrash arithmetic, /kvz); "
+                         "exit 0 on pass")
+    kv.add_argument("--url", default=None,
+                    help="obs endpoint base URL to fetch /kvz from")
+    kv.add_argument("--json", action="store_true",
+                    help="print the raw /kvz dict instead of the table")
     args = ap.parse_args(argv)
 
     if args.cmd == "trace":
@@ -615,6 +807,15 @@ def main(argv=None) -> int:
             return _timeline_path(args.path, args.json, args.top)
         ap.error("timeline needs --selftest, --url URL, or a capture "
                  "PATH")
+    if args.cmd == "kvlens":
+        if args.selftest:
+            return _kvlens_selftest()
+        if args.url:
+            return _kvlens_url(args.url, args.json)
+        if args.path:
+            return _kvlens_path(args.path, args.json)
+        ap.error("kvlens needs --selftest, --url URL, or a saved /kvz "
+                 "JSON PATH")
     return 2
 
 

@@ -50,7 +50,7 @@ import logging
 import re
 import threading
 import time
-from collections import defaultdict
+from collections import defaultdict, deque
 from typing import Dict, List, Optional
 from urllib.request import urlopen
 
@@ -492,6 +492,16 @@ class FleetCollector:
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
         self._polls = 0
+        # autoscaling-signal memory: dnn_tpu_wanted_replicas is a
+        # scrape-time gauge with no history — the collector is the one
+        # place that sees every sample, so it records TRANSITIONS as
+        # bounded flight events (`wanted_replicas_change`) and keeps a
+        # bounded recent series on /fleetz: the demand trace a future
+        # autoscaler replays (ROADMAP item 3). A step-function series —
+        # one point per change — is complete: the gauge holds its value
+        # between transitions.
+        self._wanted_last: Optional[float] = None
+        self._wanted_hist: "deque" = deque(maxlen=256)
 
     # -- polling -------------------------------------------------------
 
@@ -563,11 +573,35 @@ class FleetCollector:
                     counts[tid] += 1
         tids = [t for t, _ in
                 sorted(counts.items(), key=lambda kv: -kv[1])]
+        # wanted_replicas transition detection (first non-None across
+        # targets — one router per fleet view, matching the fleetz
+        # rollup). Outside the lock for the fetch, inside for the
+        # history append; the flight record self-gates on the obs env.
+        wanted = None
+        for snap in results.values():
+            if snap.get("metrics") is not None:
+                v = _Samples(snap["metrics"]).get(
+                    "dnn_tpu_wanted_replicas")
+                if v is not None:
+                    wanted = v
+                    break
         with self._lock:
             self._snaps.update(results)
             self._offsets = offs
             self._tids = tids
             self._polls += 1
+            if wanted is not None and wanted != self._wanted_last:
+                self._wanted_hist.append(
+                    {"t": round(time.time(), 3), "v": wanted})
+                prev = self._wanted_last
+                self._wanted_last = wanted
+            else:
+                prev = wanted = None
+        if wanted is not None:
+            from dnn_tpu.obs import flight as _flight
+
+            _flight.record("wanted_replicas_change", prev=prev,
+                           to=wanted)
         return results
 
     def start(self) -> "FleetCollector":
@@ -734,6 +768,20 @@ class FleetCollector:
             v = s.get(fam)
             if v is not None:
                 row[key] = v
+        # memory-economy series (obs/kvlens.py): the predicted hit
+        # ratio at 1x/2x/4x of the replica's pool + the thrash bill —
+        # present only when a lens rides the replica's radix store.
+        # The 2x column is the capacity-sizing headline: "what would
+        # doubling this replica's pool buy"
+        for mult, key in (("1x", "kvlens_pred_1x"),
+                          ("2x", "kvlens_pred_2x"),
+                          ("4x", "kvlens_pred_4x")):
+            v = s.get("dnn_tpu_kvlens_pred_hit_ratio", mult=mult)
+            if v is not None:
+                row[key] = v
+        v = s.get("dnn_tpu_kvlens_thrash_chunk_seconds_total")
+        if v is not None:
+            row["kvlens_thrash_chunk_s"] = v
         sheds = s.sum("dnn_tpu_router_shed_total")
         if sheds is not None:
             row["shed_total"] = sheds
@@ -746,6 +794,7 @@ class FleetCollector:
         with self._lock:
             snaps = dict(self._snaps)
             polls = self._polls
+            wanted_hist = list(self._wanted_hist)
         stages = {name: self._stage_row(snaps.get(name))
                   for name in self.targets}
         status = self.status()
@@ -770,6 +819,10 @@ class FleetCollector:
                 "wanted_replicas": next(
                     (r["wanted_replicas"] for r in stages.values()
                      if r.get("wanted_replicas") is not None), None),
+                # the signal's recent history: one {"t", "v"} point per
+                # TRANSITION observed by this collector (bounded; the
+                # flight ring holds the same changes as events)
+                "wanted_replicas_recent": wanted_hist,
                 "shed_total": total("shed_total"),
             },
             "clock_offsets_s": {k: round(v, 6)
@@ -799,6 +852,11 @@ class FleetCollector:
         if z["fleet"].get("wanted_replicas") is not None:
             m.set("dnn_tpu_wanted_replicas",
                   z["fleet"]["wanted_replicas"])
+        if z["fleet"].get("wanted_replicas_recent"):
+            # how many transitions this collector has witnessed — a flat
+            # line and a flapping autoscaler signal scrape differently
+            m.set("dnn_tpu_wanted_replicas_changes_total",
+                  float(len(z["fleet"]["wanted_replicas_recent"])))
         if z["fleet"].get("shed_total") is not None:
             m.set("dnn_tpu_fleet_shed_total", z["fleet"]["shed_total"])
         for name, row in z["stages"].items():
@@ -813,7 +871,9 @@ class FleetCollector:
                               role=row["role"]), 1.0)
             for key in ("tokens_per_sec", "mfu", "mbu", "router_queue",
                         "shed_total", "kvtier_blocks",
-                        "prefix_hit_ratio", "kvtier_remote_ratio"):
+                        "prefix_hit_ratio", "kvtier_remote_ratio",
+                        "kvlens_pred_1x", "kvlens_pred_2x",
+                        "kvlens_pred_4x", "kvlens_thrash_chunk_s"):
                 if row.get(key) is not None:
                     m.set(labeled(f"dnn_tpu_fleet_stage_{key}",
                                   stage=name), row[key])

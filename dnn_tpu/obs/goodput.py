@@ -216,6 +216,19 @@ class GoodputTracker:
         self._flops = Throughput(window_s, now=now)
         self._bytes = Throughput(window_s, now=now)
         self._tokens = Throughput(window_s, now=now)
+        # decode-step accumulator, flushed into the windows every
+        # _FLUSH_STEPS steps by the ONE producer thread: three
+        # locked deque updates per sub-ms step were measurable against
+        # the serving obs budget, and a 60 s rate window cannot resolve
+        # a <100 ms batching delay anyway. Scrapes read the windows
+        # as-is (≤ _FLUSH_STEPS-steps stale, idle decay unaffected);
+        # only the producer touches the _acc_* fields, so there is no
+        # lock and no race.
+        self._acc_flops = 0.0
+        self._acc_bytes = 0.0
+        self._acc_tokens = 0
+        self._acc_steps = 0
+        self._acc_t = 0.0  # first-unflushed-step stamp, for readers
         self.slo = slo
         self._slo_windows = {}
         self._breach_latched: dict = {}
@@ -249,10 +262,34 @@ class GoodputTracker:
         if n_tokens <= 0:
             return
         mean_ctx = live_positions / n_tokens
-        self._flops.add(n_tokens * self.cost.flops_per_token(mean_ctx))
-        self._bytes.add(self.cost.weight_bytes
-                        + live_positions * self.cost.kv_bytes_per_pos)
-        self._tokens.add(n_tokens)
+        if self._acc_steps == 0:
+            # stamp the batch ONCE (readers age pending out of the
+            # window by it) — the other 31 steps never read the clock
+            self._acc_t = self._flops._now()
+        self._acc_flops += n_tokens * self.cost.flops_per_token(mean_ctx)
+        self._acc_bytes += (self.cost.weight_bytes
+                            + live_positions * self.cost.kv_bytes_per_pos)
+        self._acc_tokens += n_tokens
+        self._acc_steps += 1
+        if self._acc_steps >= self._FLUSH_STEPS:
+            self._flush_steps()
+
+    #: decode-step batching cadence (see __init__; StepClock.FLUSH_EVERY
+    #: is the same idea for histograms)
+    _FLUSH_STEPS = 32
+
+    def _flush_steps(self):
+        """Land the accumulated decode-step work in the rate windows —
+        one clock read, three locked updates, every _FLUSH_STEPS steps
+        instead of every step. Producer-thread only."""
+        t = self._flops._now()
+        self._flops.add_at(t, self._acc_flops)
+        self._bytes.add_at(t, self._acc_bytes)
+        self._tokens.add_at(t, self._acc_tokens)
+        self._acc_flops = 0.0
+        self._acc_bytes = 0.0
+        self._acc_tokens = 0
+        self._acc_steps = 0
 
     def on_ttft(self, seconds: float):
         if "ttft" in self._slo_windows:
@@ -313,22 +350,26 @@ class GoodputTracker:
         self._resolve_peaks()
         if not self._peak_flops:
             return 0.0
-        return self._flops.per_sec / self._peak_flops
+        return self.achieved_flops_per_sec() / self._peak_flops
 
     def mbu(self) -> float:
         self._resolve_peaks()
         if not self._peak_bytes:
             return 0.0
-        return self._bytes.per_sec / self._peak_bytes
+        return self.achieved_bytes_per_sec() / self._peak_bytes
+
+    # every rate read folds in the pending (unflushed) decode-step
+    # batch via per_sec_with — scrapes stay exact between flushes, and
+    # stale pending ages out of the window like landed events
 
     def tokens_per_sec(self) -> float:
-        return self._tokens.per_sec
+        return self._tokens.per_sec_with(self._acc_tokens, self._acc_t)
 
     def achieved_flops_per_sec(self) -> float:
-        return self._flops.per_sec
+        return self._flops.per_sec_with(self._acc_flops, self._acc_t)
 
     def achieved_bytes_per_sec(self) -> float:
-        return self._bytes.per_sec
+        return self._bytes.per_sec_with(self._acc_bytes, self._acc_t)
 
     def burn_rates(self) -> dict:
         return {k: w.burn_rate() for k, w in self._slo_windows.items()}

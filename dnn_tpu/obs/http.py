@@ -36,6 +36,13 @@ Prometheus scraper or a plain curl can watch the serving stack:
                        ?format=trace exports the last N steps as a
                        Perfetto-loadable host track, ?last=N bounds
                        the window)
+    GET  /kvz          memory-economy observatory (obs/kvlens.py) when
+                       a KVLens is attached: sampled reuse-distance
+                       stats, the predicted hit-ratio-vs-capacity
+                       curve (0.5x..8x of the pool), block lifecycle
+                       counts, thrash pricing, and the bounded
+                       per-block ledger tail (JSON; ?format=prom
+                       re-renders the curve + thrash as gauges)
     GET  /trace        Chrome-trace JSON of collected spans; ?id=<trace>
                        filters to one request's tree (load the response
                        in Perfetto / chrome://tracing)
@@ -108,7 +115,7 @@ class MetricsHTTPServer:
                  status: Optional[Callable[[], dict]] = None,
                  profiler=None, flight=None, fleet=None,
                  drain: Optional[Callable[[], dict]] = None,
-                 stepclock=None):
+                 stepclock=None, kvlens=None):
         from dnn_tpu import obs
         from dnn_tpu.obs import flight as _flight
         from dnn_tpu.utils import metrics as _metrics
@@ -132,6 +139,11 @@ class MetricsHTTPServer:
         self._drain = drain
         # step-timeline clock (obs/timeline.StepClock): serves /stepz
         self._stepclock = stepclock
+        # memory-economy lens (obs/kvlens.KVLens): serves /kvz. The LM
+        # daemon attaches it AFTER construction (the batcher — and its
+        # lens — is built after the endpoint comes up), so the handler
+        # reads it per request rather than capturing it here
+        self._kvlens = kvlens
         if fleet is not None and status is None:
             self._status = fleet.status
         outer = self
@@ -235,6 +247,22 @@ class MetricsHTTPServer:
                                "(json|prom|trace)\n",
                                "text/plain; charset=utf-8")
 
+            def _kvz(self, q):
+                if outer._kvlens is None:
+                    self._send(404, "no kvlens attached\n",
+                               "text/plain; charset=utf-8")
+                    return
+                fmt = q.get("format", ["json"])[0]
+                if fmt == "json":
+                    self._send_json(200, outer._kvlens.summary())
+                elif fmt == "prom":
+                    self._send(200, outer._kvlens.render_prom(),
+                               "text/plain; version=0.0.4; charset=utf-8")
+                else:
+                    self._send(400, f"unknown format {fmt!r} "
+                               "(json|prom)\n",
+                               "text/plain; charset=utf-8")
+
             def do_GET(self):
                 try:
                     url = urlparse(self.path)
@@ -292,6 +320,8 @@ class MetricsHTTPServer:
                         self._fleetz(q)
                     elif url.path == "/stepz":
                         self._stepz(q)
+                    elif url.path == "/kvz":
+                        self._kvz(q)
                     elif url.path == "/profilez":
                         if outer._profiler is None:
                             self._send(404, "no profiler attached\n",

@@ -108,6 +108,12 @@ STEP_BUCKETS = (2.5e-5, 5e-5, 1e-4, 2.5e-4, 5e-4, 1e-3, 2.5e-3, 5e-3,
 _HOST_PHASES = ("admit", "host", "commit", "obs")
 _DEVICE_PHASES = ("dispatch", "wait")
 
+#: shared empty admit-slice seq — most steps have no admissions, and
+#: the per-step allocation was measurable against the <2% obs budget;
+#: end() REPLACES the attribute (never appends) when slices exist, and
+#: every consumer (fold/summary/stepz) only iterates, so sharing is safe
+_NO_ADMITS: tuple = ()
+
 
 class _StepRec:
     """One step's phase boundaries: t0 at step entry, then (phase, t)
@@ -128,7 +134,7 @@ class _StepRec:
         self.n_adv = 0
         self.wall = 0.0
         self.phases: "Optional[Dict[str, float]]" = None
-        self.admit_slices: list = []
+        self.admit_slices = _NO_ADMITS
         # mixed = this step's dispatch folded an interleaved prefill
         # chunk (serving prefill_chunk_tokens) — /stepz distinguishes
         # interleaved-prefill steps from pure-decode steps with it
@@ -211,6 +217,7 @@ class StepClock:
         # registry batch: records awaiting the bulk flush (end() only
         # appends; flush() does the per-phase fan-out off the hot path)
         self._pending_flush: list = []
+        self._pending_bulk: list = []  # landed, not yet billed
         # (steps_total, {...}) memo for the derived gauges — see _derived
         self._derived_cache = None
         # memoized labeled histogram keys — string formatting is
@@ -289,20 +296,21 @@ class StepClock:
 
     def end(self, rec: _StepRec, n_adv: int = 0):
         """Stamp and publish one step. Deliberately MINIMAL — one
-        perf_counter read and three GIL-atomic appends, no lock: this
+        perf_counter read and ONE GIL-atomic append, no lock: this
         runs inside the decode loop the clock exists to measure, and
         the obs_overhead <2% contract prices every microsecond here.
-        Single-producer by the batcher's threading contract; scrape
-        readers snapshot the ring/pending lists via atomic swaps or
-        list() copies, both safe against a concurrent append. The
-        phase fold and the registry bulk run off this path (_fold at
-        flush/scrape time; flush once per FLUSH_EVERY steps)."""
+        Single-producer by the batcher's threading contract. The rec
+        lands only in the pending batch here; flush() moves the batch
+        into the scrape ring (and runs the ring's evictions) every
+        FLUSH_EVERY steps — ring maintenance per step was measurable
+        against the budget, and every ring reader (_sums, records,
+        summary, render_prom) flushes first, so scrapes stay exact.
+        The phase fold and the registry bulk run off this path too."""
         rec.t_end = self._now()
         rec.n_adv = n_adv
         if self._pending_admit:
             rec.admit_slices, self._pending_admit = \
                 self._pending_admit, []
-        self._ring.append(rec)
         self.steps_total += 1
         self._t_last_end = rec.t_end
         pf = self._pending_flush
@@ -310,24 +318,40 @@ class StepClock:
         if len(pf) >= self.FLUSH_EVERY:
             self.flush()
 
+    def _land(self):
+        """Move the pending batch into the scrape ring (one extend +
+        up to FLUSH_EVERY evictions instead of an append+eviction per
+        step). This is the HALF of flush() ring readers need — and the
+        only half they may run: the registry's own gauge render calls
+        the ring-derived series (dispatch_slack & co.) while HOLDING
+        the registry lock, so a reader that reached Metrics.bulk from
+        there would self-deadlock on that non-reentrant lock. Landed
+        recs queue in _pending_bulk for the next real flush()'s
+        histogram bill. The swap is locked against concurrent landers
+        (two scrapes must not double-land a batch); a producer append
+        racing the swap is GIL-atomic and lands in one of the two
+        lists, never lost."""
+        if not self._pending_flush:
+            return
+        with self._lock:
+            pending, self._pending_flush = self._pending_flush, []
+            self._ring.extend(pending)
+            self._pending_bulk.extend(pending)
+
     def flush(self):
         """Land the accumulated observations in ONE bulk registry
         update. Called every FLUSH_EVERY steps by end(), and by
-        summary()/render_prom() so a scrape never reads a stale
-        histogram. Pending work is dropped (not retried) when the gate
-        went off mid-batch — re-enabling starts clean."""
+        summary()/render_prom() — StepClock's own scrape surfaces,
+        never reached from inside a registry render — so a /stepz
+        scrape never reads a stale histogram. Pending work is dropped
+        (not retried) when the gate went off mid-batch — re-enabling
+        starts clean."""
         m = self._registry if self._registry is not None \
             else _obs.metrics()
-        if not self._pending_flush:
-            return
-        # the swap is locked against OTHER flushers (two concurrent
-        # scrapes must not both drain the same batch and double-count);
-        # a producer append racing the swap is GIL-atomic and lands in
-        # one of the two lists, never lost — end() itself stays
-        # lock-free except for the 1-in-FLUSH_EVERY call into here
+        self._land()
         with self._lock:
-            pending, self._pending_flush = self._pending_flush, []
-        if m is None:
+            pending, self._pending_bulk = self._pending_bulk, []
+        if m is None or not pending:
             return
         hists: Dict[str, list] = {}
         walls = []
@@ -344,6 +368,7 @@ class StepClock:
     # -- derived series (scrape-time reads over the ring) --------------
 
     def _sums(self, last: Optional[int] = None):
+        self._land()  # ring readers: land only, never the registry
         with self._lock:
             recs = list(self._ring)
         if last:
@@ -394,6 +419,7 @@ class StepClock:
         """Rate over the ring's newest 60 s of records — computed at
         scrape time (a per-step Throughput feed measurably taxed the
         step; the ring already carries every timestamp needed)."""
+        self._land()  # gauge-reachable: land only (registry deadlock)
         now = self._now()
         with self._lock:
             n = sum(1 for r in self._ring if now - r.t0 <= 60.0)
@@ -411,6 +437,7 @@ class StepClock:
         return float(self.constrained_slots)
 
     def last_wall_ms(self) -> float:
+        self._land()  # gauge-reachable: land only (registry deadlock)
         with self._lock:
             if not self._ring:
                 return 0.0
@@ -425,6 +452,7 @@ class StepClock:
     def records(self, last: Optional[int] = None) -> List[dict]:
         """Ring records as plain dicts (newest last) — what the probe's
         coverage assertion and analyze()'s step alignment read."""
+        self._land()
         with self._lock:
             recs = list(self._ring)
         if last:
@@ -527,6 +555,7 @@ class StepClock:
         origin (the profiler session start), so the two files do not
         overlay directly — `analyze()` + the sidecar meta do that
         correlation numerically (per-step device busy / overlap)."""
+        self._land()
         with self._lock:
             recs = list(self._ring)
         if last:

@@ -943,6 +943,14 @@ class LMServer:
                 from dnn_tpu.kvtier.migrate import LeaseTable
 
                 self._kvtier_leases = LeaseTable(ttl_s=kv_lease_ttl_s)
+            if self.metrics_server is not None:
+                # /kvz comes alive once the batcher (and its lens)
+                # exists — the endpoint was bound before the batcher,
+                # so the lens is attached late (http.py reads it per
+                # request). None when the obs gate or the KV tier is
+                # off: /kvz then 404s honestly.
+                self.metrics_server._kvlens = getattr(
+                    self.batcher, "_kvlens", None)
             # housekeeping rides the worker loop (lease TTL + kvput
             # inbox TTL), rate-limited inside the tick
             self.worker.tick = self._housekeeping_tick
@@ -1695,7 +1703,8 @@ class LMServer:
                 if m is not None:
                     m.inc("serving.kvput_expired_total")
                 obs.flight.record("kvput_expired", key=str(k)[:80],
-                                  prompt_len=plen, ttl_s=ttl)
+                                  prompt_len=plen, ttl_s=ttl,
+                                  cause="kvput_ttl")
 
     def _housekeeping_tick(self):
         """Worker-loop housekeeping (rate-limited to ~1 Hz so the hot

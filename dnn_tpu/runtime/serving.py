@@ -634,6 +634,17 @@ class ContinuousBatcher:
         }
         self._kv_live_hw = 0
         self._active_hw = 0
+        # step-obs accumulator (see _obs_flush): the per-step registry
+        # bulk (lock + counter updates + reservoir + gauge check) was
+        # the single largest line in the obs_overhead bill, so steps
+        # batch into plain fields and land every _OBS_FLUSH_STEPS
+        # steps, on a bucket switch, or when the pool goes idle (end
+        # of every drain — tests and scrapes that look after traffic
+        # see exact totals). Producer-thread only, no locks.
+        self._obs_acc_steps = 0
+        self._obs_acc_tokens = 0
+        self._obs_acc_bk: Optional[str] = None
+        self._obs_acc_samples: list = []
         self._pool_exhausted_episode = False  # latch: one flight event /
         # counter tick per shortage episode, cleared when blocks return
         # to the pool (retire/cancel/window reclaim) or a paged admission
@@ -708,6 +719,37 @@ class ContinuousBatcher:
                 "_kvtier_blocks_read")
             self._obs_gauges["dnn_tpu_kvtier_remote_hit_ratio"] = \
                 _weak_gauge("_kvtier_remote_ratio_read")
+        # memory-economy observatory (obs/kvlens.py): reuse-distance
+        # sampling + miss-ratio curves + block-lifetime forensics over
+        # the radix store. Attached only when the obs gate is ON at
+        # construction — a gate-off process pays exactly one
+        # `lens is not None` check per store hook. The lens itself
+        # re-checks the gate per call, so runtime flips (the overhead
+        # probe's on/off interleave) stop recording immediately.
+        self._kvlens = None
+        if self._prefix_store is not None and obs.enabled():
+            from dnn_tpu.obs.kvlens import KVLens
+
+            try:
+                per_block = int(self._kv_bytes_read()) // max(
+                    1, self._allocator.n_blocks)
+            except Exception:  # noqa: BLE001 — pricing is advisory
+                per_block = 0
+            # curve axis = the EFFECTIVE pool: with auto-sized
+            # paged_blocks the allocator (minus the reserved null
+            # block) can be smaller than the prefix_cache knob, and
+            # the allocator is what actually bounds residency — a 1x
+            # label pinned to the nominal knob would mis-scale every
+            # multiplier
+            eff_pool = min(int(prefix_cache),
+                           self._allocator.n_blocks - 1)
+            self._kvlens = KVLens(
+                eff_pool, self._block_len, seed=seed,
+                bytes_per_block=per_block)
+            self._prefix_store.lens = self._kvlens
+            # curve + thrash as weak scrape-time gauges next to the
+            # kvtier residency pair (fleet.py rolls these up per stage)
+            self._obs_gauges.update(self._kvlens.prom_gauges())
 
         logprobs_k = self._logprobs_k
 
@@ -1496,7 +1538,8 @@ class ContinuousBatcher:
                 # request didn't actually get)
                 self._prefix_store.note_reuse(
                     n_shared + (1 if cow_tok > 0 else 0),
-                    kv_hit.remote_used(n_shared, cow_tok > 0))
+                    kv_hit.remote_used(n_shared, cow_tok > 0),
+                    cow=cow_tok > 0)
 
         if self._buckets is not None:
             # the installed prompt must fit the pool AND the first decode
@@ -1749,6 +1792,13 @@ class ContinuousBatcher:
                 )
                 if (g := self.goodput) is not None:
                     g.on_prefill(len(prompt))
+                if self._kvlens is not None:
+                    # the thrash detector's price signal: what ONE
+                    # prefill chunk costs on this host right now — an
+                    # evict→refetch bills this EMA per re-run chunk
+                    self._kvlens.note_prefill(
+                        self.prefill_chunks_run - chunks_before,
+                        time.perf_counter() - t_pf)
             self.pos = self.pos.at[slot].set(len(prompt))
             self.tok = self.tok.at[slot].set(first)
             self.active = self.active.at[slot].set(True)
@@ -2124,6 +2174,11 @@ class ContinuousBatcher:
         m = obs.metrics()
         if m is not None:
             m.inc("serving.kvtier_blocks_adopted_total", n_missing)
+        if self._kvlens is not None:
+            # migration forensics: blocks that crossed the wire, priced
+            # in payload bytes when the transport recorded them
+            self._kvlens.on_migrate(
+                n_missing, int(payload.get("_wire_bytes") or 0))
         return n_missing
 
     def stage_prefix(self, prompt) -> dict:
@@ -2207,6 +2262,9 @@ class ContinuousBatcher:
                        gauge_fns=self._obs_gauges)
                 if (g := self.goodput) is not None:
                     g.on_prefill(end - resume)
+                if self._kvlens is not None:
+                    self._kvlens.note_prefill(
+                        n_k, time.perf_counter() - t_pf)
             stats.update(staged_blocks=n_cover - n_shared,
                          computed_chunks=n_k)
             return stats
@@ -2224,13 +2282,18 @@ class ContinuousBatcher:
             return self._prefix_store.n_blocks > 0
         return bool(self._prefix_cache)
 
-    def _evict_prefix_entry(self):
+    def _evict_prefix_entry(self, cause: str = "capacity"):
         """Drop the LRU prefix entry — the dense dict's LRU head, or
         the radix store's LRU LEAF (interior nodes carry every
         descendant's prefix). Either way blocks still shared by live
-        slots survive via refcount until those retire."""
+        slots survive via refcount until those retire. `cause`
+        attributes the eviction ("capacity" = admission pressure; the
+        kvput-TTL and lease-reclaim sweeps are separate paths that
+        label their own events) — the unlabeled total stays as-is, the
+        by-cause family rides alongside so forensics can tell real
+        pressure from housekeeping."""
         if self._prefix_store is not None:
-            if not self._prefix_store.evict_one():
+            if not self._prefix_store.evict_one(cause=cause):
                 return
             self.prefix_evictions += 1
             left = self._prefix_store.n_blocks
@@ -2241,7 +2304,9 @@ class ContinuousBatcher:
         m = obs.metrics()
         if m is not None:
             m.inc("serving.prefix_evictions_total")
-        obs.flight.record("prefix_evict", entries_left=left)
+            m.inc(labeled("serving.prefix_evictions_cause_total",
+                          cause=cause))
+        obs.flight.record("prefix_evict", entries_left=left, cause=cause)
 
     def _radix_prefill(self, prompt, slot, pf_prepared, row, kv_hit,
                        n_shared, cow_tok, boundary_rows):
@@ -2488,7 +2553,6 @@ class ContinuousBatcher:
         decay, occupancy would report the retired batch forever)."""
         if m is None:
             return
-        self._tps.add(n_adv)
         # memory high-waters, maintained at step end (slots is small, so
         # this stays inside the bulk-update budget): the gauges above
         # read them at scrape time. One pass over the slots for both
@@ -2504,14 +2568,20 @@ class ContinuousBatcher:
             self._kv_live_hw = live
         if n_act > self._active_hw:
             self._active_hw = n_act
-        m.bulk(
-            counters={"serving.decode_steps_total": 1,
-                      "serving.tokens_total": n_adv,
-                      self._bucket_key(): 1},
-            observations={"serving.inter_token_seconds": samples}
-            if samples else None,
-            gauge_fns=self._obs_gauges,
-        )
+        # batched registry feed (fields documented at construction): a
+        # bucket switch flushes first so the whole batch shares one
+        # dispatch-counter key; an idle pool flushes so totals are
+        # exact the moment a drain returns
+        bk = self._bucket_key()
+        if bk is not self._obs_acc_bk:
+            self._obs_flush(m)
+            self._obs_acc_bk = bk
+        self._obs_acc_steps += 1
+        self._obs_acc_tokens += n_adv
+        if samples:
+            self._obs_acc_samples.extend(samples)
+        if self._obs_acc_steps >= self._OBS_FLUSH_STEPS or n_act == 0:
+            self._obs_flush(m)
         if (g := self.goodput) is not None:
             # live MFU/MBU numerators + the inter-token SLO window
             # (obs/goodput.py) — `live` is the summed live positions the
@@ -2519,6 +2589,38 @@ class ContinuousBatcher:
             g.on_decode_step(n_adv, live)
             if samples:
                 g.on_inter_token(samples)
+
+    #: step-obs batching cadence — same idea (and number) as
+    #: StepClock.FLUSH_EVERY and goodput's _FLUSH_STEPS: a 60 s rate
+    #: window and a human scrape cannot resolve a <100 ms batching
+    #: delay, and the per-step bulk was the obs bill's largest line
+    _OBS_FLUSH_STEPS = 32
+
+    def _obs_flush(self, m):
+        """Land the accumulated step counters / inter-token samples in
+        ONE bulk registry update. Called by _obs_step_end every
+        _OBS_FLUSH_STEPS steps, on a bucket switch (the batch shares
+        one dispatch-counter key — _bucket_key memoizes, so the `is`
+        check in the caller is exact), and whenever the pool goes idle
+        (every drain ends flushed). Producer-thread only."""
+        n = self._obs_acc_steps
+        if not n:
+            return
+        if self._obs_acc_tokens:
+            self._tps.add(self._obs_acc_tokens)
+        samples = self._obs_acc_samples
+        m.bulk(
+            counters={"serving.decode_steps_total": n,
+                      "serving.tokens_total": self._obs_acc_tokens,
+                      self._obs_acc_bk: n},
+            observations={"serving.inter_token_seconds": samples}
+            if samples else None,
+            gauge_fns=self._obs_gauges,
+        )
+        self._obs_acc_steps = 0
+        self._obs_acc_tokens = 0
+        if samples:
+            self._obs_acc_samples = []
 
     def _tps_read(self) -> float:
         return self._tps.per_sec

@@ -136,6 +136,16 @@ class Metrics:
         self.gauges: Dict[str, float] = {}
         self.latencies: Dict[str, LatencyReservoir] = {}
         self.histograms: Dict[str, Histogram] = {}
+        # last gauge_fns dict registered via bulk(): a producer passing
+        # the SAME dict every step (the serving hot path) skips the
+        # re-register until something could have changed ownership —
+        # a clear(), a set()/set_fn() from any producer, a different
+        # dict, or new entries in the same dict. Held STRONGLY so a
+        # recycled id() can never alias a dead producer's dict (the
+        # entries themselves are weak-bound closures by convention, so
+        # this pins a small dict, never the producer).
+        self._gauge_src = None
+        self._gauge_src_len = -1
 
     def inc(self, name: str, value: float = 1.0):
         with self._lock:
@@ -144,6 +154,7 @@ class Metrics:
     def set(self, name: str, value: float):
         with self._lock:
             self.gauges[name] = value
+            self._gauge_src = None  # may overwrite a bulk-owned series
 
     def set_fn(self, name: str, fn):
         """Register a CALLABLE gauge, evaluated at snapshot/render time —
@@ -151,6 +162,7 @@ class Metrics:
         while the producer is idle; a stored float would go stale)."""
         with self._lock:
             self.gauges[name] = fn
+            self._gauge_src = None  # may overwrite a bulk-owned series
 
     def observe(self, name: str, seconds: float):
         with self._lock:
@@ -177,7 +189,11 @@ class Metrics:
         would cost 3-5x this). Semantics match inc/set/observe/set_fn;
         `gauge_fns` re-registers callable gauges idempotently, so the
         most recently active producer owns the series even across
-        registry clear()s or multiple producers. `hists` observe into
+        registry clear()s or multiple producers — but the re-register
+        is SKIPPED when the same unchanged dict was already the most
+        recent registrant (a hot-path producer passes its gauge dict
+        every step; the N-entry update would be pure re-hashing).
+        `hists` observe into
         fixed-bucket histograms (created with `hist_buckets`, default
         DEFAULT_BUCKETS — only consulted at first creation)."""
         with self._lock:
@@ -187,7 +203,11 @@ class Metrics:
             if gauges:
                 self.gauges.update(gauges)
             if gauge_fns:
-                self.gauges.update(gauge_fns)
+                if (gauge_fns is not self._gauge_src
+                        or len(gauge_fns) != self._gauge_src_len):
+                    self.gauges.update(gauge_fns)
+                    self._gauge_src = gauge_fns
+                    self._gauge_src_len = len(gauge_fns)
             if observations:
                 for k, vals in observations.items():
                     r = self.latencies.get(k)
@@ -238,6 +258,7 @@ class Metrics:
             self.gauges.clear()
             self.latencies.clear()
             self.histograms.clear()
+            self._gauge_src = None  # producers must re-register
 
 
 class _Timer:
@@ -287,7 +308,13 @@ class Throughput:
             self._items -= n
 
     def add(self, n: int):
-        t = self._now()
+        self.add_at(self._now(), n)
+
+    def add_at(self, t: float, n: int):
+        """add() with a caller-supplied timestamp — a producer updating
+        several windows in one step (goodput's flops/bytes/tokens) reads
+        the clock once and shares it; three clock reads per step were
+        measurable against the serving obs budget."""
         with self._lock:
             self._evict(t)
             self._events.append((t, n))
@@ -302,6 +329,24 @@ class Throughput:
                 return 0.0
             dt = min(self.window_s, max(t - self._t0, 1e-9))
             return self._items / dt
+
+    def per_sec_with(self, extra: float, t_extra: float) -> float:
+        """per_sec, also counting a producer-side PENDING accumulation
+        of `extra` items stamped at `t_extra` (goodput batches its
+        decode-step updates; a scrape between flushes must still read
+        them). Pending older than the window is ignored, so an idle
+        producer's unflushed tail decays to zero exactly like landed
+        events do."""
+        t = self._now()
+        with self._lock:
+            self._evict(t)
+            items = self._items
+            if extra and t_extra >= t - self.window_s:
+                items += extra
+            if not items:
+                return 0.0
+            dt = min(self.window_s, max(t - self._t0, 1e-9))
+            return items / dt
 
 
 # ----------------------------------------------------------------------

@@ -81,6 +81,12 @@ _MODULE_COST_S = {
     # goldens over synthetic Perfetto JSON, one real profiler capture
     # with sidecar-meta alignment, /stepz scrape, CLI smoke — cheap,
     # certified early in the tier-1 budget with the other obs modules
+    "test_obs_kvlens": 12.0,  # ISSUE 18 memory-economy observatory:
+    # MRC goldens at rate=1 (exact LRU), sampling determinism, thrash
+    # arithmetic on an injected clock, /kvz json+prom, CLI smoke, and
+    # one real forced-eviction batcher feeding the radix-store seams —
+    # the CLI subprocess and batcher compile dominate; placed with the
+    # other obs modules inside the tier-1 budget
     "test_obs_fleet": 21.0,  # fleet layer (cross-host stitching, goodput
     # MFU/MBU, SLO burn rates + the `obs fleet --selftest` CLI smoke):
     # cheap HTTP endpoints + one real 2-stage gRPC request, certified

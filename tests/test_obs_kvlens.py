@@ -1,0 +1,280 @@
+"""kvlens tests (ISSUE 18): the memory-economy observatory.
+
+The acceptance contract this module pins: the SHARDS-sampled
+reuse-distance tracker's miss-ratio curve matches the exact LRU golden
+at rate=1 (every access sampled — stack distances are exact), the
+hash sampler is bit-deterministic per seed, the thrash detector bills
+evict→refetch churn in re-prefill chunk-seconds on an injected clock
+(inside the window only, adopted refetches pay the wire again), the
+obs gate makes every producer a no-op when off, /kvz serves JSON and
+Prometheus text, the `python -m dnn_tpu.obs kvlens` CLI smoke passes —
+and one real in-process ContinuousBatcher under a forced-eviction
+working set feeds the lens from the actual radix-store seams (access/
+insert/evict/refetch with cause attribution), with the curve axis
+pinned to the EFFECTIVE pool (the allocator bound, not the nominal
+prefix_cache knob) so the multipliers never mis-scale."""
+
+import json
+import subprocess
+import sys
+import urllib.request
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+from dnn_tpu import obs
+from dnn_tpu.obs.kvlens import DEFAULT_MULTS, KVLens
+
+BP = 4  # tiny block_len for the unit legs: 1 chunk = 4 tokens
+
+
+def _blk(base):
+    """One full chunk of distinct tokens starting at `base`."""
+    return np.arange(base, base + BP)
+
+
+@pytest.fixture(autouse=True)
+def _obs_on():
+    """Producers self-gate; unit legs run with the gate ON and restore."""
+    was = obs.enabled()
+    obs.set_enabled(True)
+    yield
+    obs.set_enabled(was)
+
+
+# ----------------------------------------------------------------------
+# miss-ratio curve: exact LRU golden + sampling determinism
+# ----------------------------------------------------------------------
+
+def test_mrc_golden_exact_lru():
+    # pool=4 ⇒ hypothetical caps (2, 4, 8, 16, 32); rate=1 makes the
+    # sampled stack the exact LRU stack. Trace A B C A: the re-accessed
+    # A sits at stack distance 2 (B, C more recent) — a hit at every
+    # capacity > 2, a miss at the 0.5x (=2-block) pool
+    lens = KVLens(4, BP, seed=0, rate=1.0, now=lambda: 0.0)
+    for p in (_blk(0), _blk(100), _blk(200), _blk(0)):
+        lens.on_access(p, n_resident=0)
+    got = [c["predicted_hit_ratio"] for c in lens.curve()]
+    assert got == [0.0, 0.25, 0.25, 0.25, 0.25], got
+    assert lens.sampled == 4 and lens.sampled_cold == 3
+    assert [c["capacity_blocks"] for c in lens.curve()] == [2, 4, 8, 16, 32]
+    # per-mult reader agrees with the curve rows
+    assert lens.predicted_hit_ratio(0.5) == 0.0
+    assert lens.predicted_hit_ratio(2.0) == 0.25
+
+
+def test_curve_is_monotone_nondecreasing():
+    # structural: a re-access that fits under cap_i fits under every
+    # larger cap, so the curve can never dip as capacity grows
+    lens = KVLens(8, BP, seed=3, rate=1.0, now=lambda: 0.0)
+    for i in range(300):
+        lens.on_access(_blk((i % 23) * 1000))
+    vals = [c["predicted_hit_ratio"] for c in lens.curve()]
+    assert all(a <= b for a, b in zip(vals, vals[1:])), vals
+
+
+def test_sampling_is_deterministic_per_seed():
+    def run(seed):
+        lens = KVLens(8, BP, seed=seed, rate=0.3, now=lambda: 0.0)
+        for i in range(200):
+            lens.on_access(_blk((i % 17) * 1000))
+        return lens
+
+    a, b = run(7), run(7)
+    assert a.curve() == b.curve() and a.sampled == b.sampled
+    assert 0 < a.sampled < a.accesses  # the rate really subsamples
+    # a different seed picks a different deterministic slice of keys
+    c = run(8)
+    assert (c.sampled, c.curve()) != (a.sampled, a.curve())
+
+
+def test_measured_tally_anchors_the_prediction():
+    lens = KVLens(4, BP, seed=0, rate=1.0, now=lambda: 0.0)
+    assert lens.measured_hit_ratio() is None  # no accesses yet
+    lens.on_access(np.concatenate([_blk(0), _blk(100)]), n_resident=1)
+    lens.on_access(_blk(0), n_resident=1)
+    assert lens.measured_accesses == 3 and lens.measured_hits == 2
+    assert lens.measured_hit_ratio() == pytest.approx(2 / 3)
+    # n_resident is clamped to the chunks actually presented
+    lens.on_access(_blk(200), n_resident=99)
+    assert lens.measured_hits == 3
+
+
+# ----------------------------------------------------------------------
+# thrash detector + forensics ledger (injected clock)
+# ----------------------------------------------------------------------
+
+def test_thrash_window_arithmetic():
+    t = [0.0]
+    lens = KVLens(4, BP, seed=0, rate=1.0, thrash_window_s=10.0,
+                  bytes_per_block=64, now=lambda: t[0])
+    lens.note_prefill(2, 1.0)  # EMA seeds at 0.5 s/chunk
+    node = SimpleNamespace(depth=1, obskey=None)
+    lens.on_insert(_blk(0), [node])
+    assert node.obskey is not None  # the stamp evict reads back
+    lens.on_evict([node.obskey], cause="capacity")
+    t[0] = 5.0  # inside the window: a refetch, billed at the EMA price
+    lens.on_insert(_blk(0), [SimpleNamespace(depth=1, obskey=None)])
+    assert lens.refetch_blocks == 1
+    assert lens.thrash_chunk_seconds == pytest.approx(0.5)
+    # outside the window: churn, not thrash
+    nb = SimpleNamespace(depth=1, obskey=None)
+    lens.on_insert(_blk(100), [nb])
+    lens.on_evict([nb.obskey], cause="capacity")
+    t[0] = 16.0
+    lens.on_insert(_blk(100), [SimpleNamespace(depth=1, obskey=None)])
+    assert lens.refetch_blocks == 1
+    # an ADOPTED refetch pays the wire again
+    na = SimpleNamespace(depth=1, obskey=None)
+    lens.on_insert(_blk(200), [na], origin="adopted")
+    lens.on_evict([na.obskey], cause="capacity")
+    t[0] = 17.0
+    lens.on_insert(_blk(200), [SimpleNamespace(depth=1, obskey=None)],
+                   origin="adopted")
+    assert lens.refetch_blocks == 2
+    assert lens.thrash_migrated_bytes == 64
+    kinds = [e["kind"] for e in lens.ledger.events()]
+    assert kinds.count("refetch") == 2 and "birth" in kinds
+
+
+def test_eviction_cause_labels():
+    lens = KVLens(4, BP, seed=0, rate=1.0, now=lambda: 0.0)
+    lens.on_evict([b"k" * 16, b"l" * 16], cause="capacity")
+    lens.on_evict([b"m" * 16], cause="lease_expiry")
+    lens.on_evict([None], cause="shutdown")  # pre-lens node: cause holds
+    assert lens.evictions_by_cause == {
+        "capacity": 2, "lease_expiry": 1, "shutdown": 1}
+    prom = lens.render_prom()
+    assert 'dnn_tpu_kvlens_evictions_total{cause="capacity"} 2' in prom
+    assert 'dnn_tpu_kvlens_evictions_total{cause="lease_expiry"} 1' in prom
+
+
+def test_gate_off_records_nothing():
+    obs.set_enabled(False)
+    lens = KVLens(4, BP, seed=0, rate=1.0)
+    lens.on_access(_blk(0), n_resident=1)
+    lens.on_insert(_blk(0), [SimpleNamespace(depth=1, obskey=None)])
+    lens.on_evict([b"x" * 16])
+    lens.on_share(3)
+    lens.on_migrate(2, 128)
+    lens.note_prefill(1, 1.0)
+    assert lens.accesses == 0 and lens.births == 0 and lens.shares == 0
+    assert lens.evictions_by_cause == {} and len(lens.ledger) == 0
+    assert lens.measured_hit_ratio() is None
+
+
+# ----------------------------------------------------------------------
+# /kvz endpoint + CLI
+# ----------------------------------------------------------------------
+
+def test_kvz_endpoint_json_and_prom():
+    lens = KVLens(4, BP, seed=0, rate=1.0, now=lambda: 0.0)
+    for p in (_blk(0), _blk(100), _blk(200), _blk(0)):
+        lens.on_access(p, n_resident=0)
+    srv = obs.serve_metrics(0, kvlens=lens)
+    try:
+        base = f"http://127.0.0.1:{srv.port}/kvz"
+        z = json.loads(urllib.request.urlopen(
+            base, timeout=10).read().decode())
+        assert [c["mult"] for c in z["curve"]] == [
+            "0.5x", "1x", "2x", "4x", "8x"]
+        assert z["samples"]["sampled"] == 4
+        assert z["config"]["pool_blocks"] == 4
+        prom = urllib.request.urlopen(
+            base + "?format=prom", timeout=10).read().decode()
+        assert 'dnn_tpu_kvlens_pred_hit_ratio{mult="2x"} 0.250000' in prom
+        assert "dnn_tpu_kvlens_sampled_total 4" in prom
+    finally:
+        srv.close()
+
+
+def test_cli_selftest_and_saved_dump(tmp_path):
+    r = subprocess.run([sys.executable, "-m", "dnn_tpu.obs", "kvlens",
+                        "--selftest"], capture_output=True, text=True,
+                       timeout=120)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "kvlens selftest ok" in r.stdout
+    # the offline render path: a saved `curl .../kvz` dump
+    lens = KVLens(4, BP, seed=0, rate=1.0, now=lambda: 0.0)
+    for p in (_blk(0), _blk(100), _blk(0)):
+        lens.on_access(p)
+    path = tmp_path / "kvz.json"
+    path.write_text(json.dumps(lens.summary()))
+    r = subprocess.run([sys.executable, "-m", "dnn_tpu.obs", "kvlens",
+                        str(path)], capture_output=True, text=True,
+                       timeout=120)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "0.5x" in r.stdout
+
+
+# ----------------------------------------------------------------------
+# in-process batcher e2e: the real store seams feed the lens
+# ----------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def gpt_prepared():
+    import jax
+
+    from dnn_tpu.models import gpt
+
+    cfg = gpt.GPTConfig(block_size=64, vocab_size=512, n_layer=2,
+                        n_head=2, n_embd=64)
+    prepared = gpt.prepare_stacked(gpt.init(jax.random.PRNGKey(0), cfg),
+                                   cfg)
+    return cfg, prepared
+
+
+def _mk_batcher(cfg, prepared, *, prefix_cache, paged_blocks=None):
+    from dnn_tpu.runtime.serving import ContinuousBatcher
+
+    return ContinuousBatcher(cfg, prepared, slots=2,
+                             max_len=cfg.block_size, prompt_pad=16,
+                             kv="paged", block_len=16,
+                             paged_blocks=paged_blocks,
+                             prefix_cache=prefix_cache)
+
+
+def test_batcher_forced_eviction_feeds_the_lens(gpt_prepared):
+    cfg, prepared = gpt_prepared
+    # store cap 4 blocks, 12 single-block tenants = a 3x working set —
+    # continuous capacity eviction; explicit paged_blocks so the STORE
+    # cap binds (auto-sizing would bound residency below prefix_cache)
+    cache = 4
+    pool = cache + 2 * (cfg.block_size // 16) + 1
+    srv = _mk_batcher(cfg, prepared, prefix_cache=cache,
+                      paged_blocks=pool)
+    lens = srv._kvlens
+    assert lens is not None, "lens must attach when obs is on at build"
+    assert lens.pool_blocks == cache  # store cap < allocator here
+    for rnd in range(2):
+        for tenant in range(12):
+            prompt = (np.arange(16) + 37 * tenant) % 510 + 1
+            rid = srv.submit(prompt, 1)
+            srv.drain()
+            srv.claim(rid)
+    # every turn's admission was one full-chunk access
+    assert lens.accesses == 24 and lens.measured_accesses == 24
+    assert lens.births > 0
+    assert lens.evictions_by_cause.get("capacity", 0) > 0
+    # round 2 re-touches evicted tenants within seconds: thrash bills
+    assert lens.refetch_blocks > 0
+    assert lens.thrash_chunk_seconds > 0  # prefill EMA was live
+    mr = lens.measured_hit_ratio()
+    assert mr is not None and 0.0 <= mr < 1.0
+    # the curve gauges ride the serving registry next to kvtier's
+    assert "dnn_tpu_kvlens_measured_hit_ratio" in srv._obs_gauges
+    # the ledger saw the real lifecycle, causes attributed
+    kinds = {e["kind"] for e in lens.ledger.events()}
+    assert "birth" in kinds and "evict" in kinds and "refetch" in kinds
+
+
+def test_curve_axis_is_the_effective_pool(gpt_prepared):
+    cfg, prepared = gpt_prepared
+    # auto-sized allocator: slots*(max_len/block_len)+1 = 9 blocks; a
+    # nominal prefix_cache=64 cannot exceed what the allocator can
+    # hold — the 1x label must pin to the allocator bound, not the knob
+    srv = _mk_batcher(cfg, prepared, prefix_cache=64)
+    lens = srv._kvlens
+    assert lens is not None
+    assert lens.pool_blocks == srv._allocator.n_blocks - 1 == 8
