@@ -145,7 +145,8 @@ def _results_md_rows(results_path: str, latest: dict) -> None:
                         "availability", "slo_verdict", "reconstructed",
                         "host_fraction", "parity_ok",
                         "kvlens_admit_overhead_pct",
-                        "thrash_refetch_blocks_at_B"):
+                        "thrash_refetch_blocks_at_B",
+                        "overhead_pct"):
                 m = re.search(rf"\b{key}=([^,|]+)", details)
                 if not m:
                     continue
@@ -300,6 +301,20 @@ RATCHETS: List[Ratchet] = [
     Ratchet("workload_breach_reconstructs", "workload_breach_chaos",
             "ok", "==", _const(True),
             "forced breach produced a reconstructable incident bundle"),
+    # the training-step observatory (ISSUE 19): MFU priced off the
+    # pinned roofline must clear the estimator-sanity floor, the phase
+    # clock must attribute (not lose) the fit wall, and the whole
+    # observatory — clock + gradient sentinel — pays against the same
+    # 2% obs budget every other surface answers to
+    Ratchet("train_mfu_floor", "train_goodput", "value", ">=",
+            _t("benchmarks.train_goodput_probe", "MFU_FLOOR"),
+            "probe-fit MFU vs the PINNED 1e12 FLOP/s roofline"),
+    Ratchet("train_phase_coverage", "train_goodput", "coverage", ">=",
+            _t("benchmarks.train_goodput_probe", "COVERAGE_FLOOR"),
+            "fraction of fit() wall attributed to a named phase"),
+    Ratchet("trainlens_overhead_budget", "train_goodput",
+            "overhead_pct", "<=", _const(2.0),
+            "TrainClock+GradSentinel tax % of a training step"),
 ]
 
 

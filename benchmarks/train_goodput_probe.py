@@ -1,0 +1,398 @@
+"""Train-goodput probe: the asserted trainlens baseline (ISSUE 19).
+
+The instrument-first pattern again (PR 10's step_timeline, PR 18's
+kv_economy): trainlens ships BEFORE the training-at-scale PR it will
+judge, so its numbers must already be trustworthy — this probe pins
+them against ground truth a benchmark can hold:
+
+  * **coverage** (ASSERTED): a real `train.fit` run on the pinned
+    gpt-mini shape, phase-attributed by a TrainClock; the per-step
+    phase accounting must cover >= COVERAGE_FLOOR of the externally
+    measured fit() wall (no unattributed dark time) — the same 95%
+    contract step_timeline holds on the serving side.
+
+  * **mfu floor** (ASSERTED): step-time MFU priced by
+    utils/flops.gpt_train_step_flops against an explicitly PINNED
+    roofline (PINNED_PEAK_FLOPS — CPU has no table entry, and an
+    asserted floor against an env-dependent denominator would be
+    noise). The floor is deliberately conservative (1e-3 at a 1e12
+    roofline tolerates ~190 ms/step on a ~2e8-FLOP step): it catches
+    a broken pipeline (rate reading 0, flops mispriced by orders of
+    magnitude), not host speed.
+
+  * **stall attribution** (ASSERTED): the chaos `train_fault` sleep
+    vector — a known injected input-pipeline stall (count x delay_s,
+    landed inside fit's data window by the seam) must come back out
+    as `data_stall_fraction` within STALL_TOLERANCE of the
+    ground-truth sleep/wall ratio.
+
+  * **sentinel latency** (ASSERTED): the chaos nan vector on a FLOAT
+    toy model (token batches are int — NaN cannot ride them, which is
+    itself the poison_batch contract) — the GradSentinel must fire
+    `loss_nan` within SENTINEL_MAX_STEPS of the poisoned step, and
+    the event must be present in the DUMPED flight ring (the /debugz
+    jsonl a post-mortem actually reads).
+
+  * **overhead** (ASSERTED): trainlens-live obs tax on the training
+    step, ABBA-paired per iteration (the obs_overhead_probe
+    estimator: gate ON,OFF,OFF,ON..., median per-pair difference over
+    the median OFF wall) — clock + sentinel + flight, all inside the
+    measured iteration, must stay under OVERHEAD_BUDGET.
+
+Standalone:  python benchmarks/train_goodput_probe.py [--assert]
+Suite row:   benchmarks/run_all.py config `train_goodput`
+             (cpu-runnable). Ledger ratchets: train_mfu_floor,
+             train_phase_coverage, trainlens_overhead_budget.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+#: asserted floor: attributed phase seconds / external fit() wall.
+#: Measured ~99% on this host (fit's only uncovered time is loop entry
+#: + the inter-iteration residue); 95% = the step_timeline contract.
+COVERAGE_FLOOR = 0.95
+
+#: asserted MFU floor at the PINNED roofline below. The gpt-mini step
+#: costs ~1.3e9 FLOPs (3x forward, B=8, T=32, 4L/128d), so the floor
+#: trips only when a step takes > ~1.3 s — a broken rate/pricing
+#: pipeline, not a slow host. Measured ~0.04-0.06 here.
+MFU_FLOOR = 1e-3
+
+#: the explicit MFU denominator (utils/flops has no CPU table entry on
+#: purpose — an asserted floor needs a pinned denominator, not an
+#: env-dependent one)
+PINNED_PEAK_FLOPS = 1e12
+
+#: |measured data_stall_fraction − injected sleep/wall| ceiling
+STALL_TOLERANCE = 0.10
+
+#: loss_nan must fire within this many steps of the poisoned step
+SENTINEL_MAX_STEPS = 2
+
+#: trainlens-live obs tax budget (the ISSUE 3 contract, extended to
+#: the training loop)
+OVERHEAD_BUDGET = 0.02
+
+BATCH = 8
+SEQ = 32          # forward length; token batches carry SEQ+1 tokens
+FIT_STEPS = 48
+STALL_STEPS = 16
+STALL_SLEEPS = 8
+STALL_DELAY_S = 0.05
+NAN_AT = 5        # poisoned iteration (0-indexed chaos counter)
+OVERHEAD_PAIRS = 250
+
+
+def _abba_on(i: int) -> bool:
+    """obs_overhead_probe's gate schedule: ON,OFF,OFF,ON,ON,OFF,... —
+    every adjacent pair holds one ON and one OFF in alternating order,
+    so paired differencing cancels drift in both directions."""
+    return i % 4 in (0, 3)
+
+
+def _paired_overhead(seq):
+    """[(on, wall_s), ...] ABBA-ordered -> (overhead_frac, med_on,
+    med_off): median per-pair (on − off) over the median off wall."""
+    on_t = sorted(dt for on, dt in seq if on)
+    off_t = sorted(dt for on, dt in seq if not on)
+    diffs = []
+    for k in range(0, len(seq) - 1, 2):
+        (a_on, a), (_b_on, b) = seq[k], seq[k + 1]
+        diffs.append((a - b) if a_on else (b - a))
+    diffs.sort()
+    med_diff = diffs[len(diffs) // 2]
+    med_off = off_t[len(off_t) // 2]
+    return med_diff / med_off, on_t[len(on_t) // 2], med_off
+
+
+def _gpt_mini():
+    """The pinned probe shape + its jitted (state, batch) step, wrapped
+    to fit()'s signature with the grad_stats leg live."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from dnn_tpu.models import gpt
+    from dnn_tpu.train import cross_entropy, make_train_step
+
+    # 4L/128d: a ~1.3e9-FLOP (~25 ms on this host) step. Deliberately
+    # NOT smaller: the sentinel's one readback/step costs a fixed
+    # ~100 us (first host read of the fresh loss + stats buffers), so
+    # a toy few-ms step would spend the <2% budget on buffer-read
+    # constants rather than measuring the instrumentation.
+    cfg = gpt.GPTConfig(block_size=64, vocab_size=256, n_layer=4,
+                        n_head=4, n_embd=128)
+    prepared = gpt.prepare_stacked(gpt.init(jax.random.PRNGKey(0), cfg),
+                                   cfg)
+    apply_fn = gpt.make_apply_stacked(cfg)
+
+    def loss_fn(p, tokens):
+        return cross_entropy(apply_fn(p, tokens[:, :-1]), tokens[:, 1:])
+
+    opt = optax.adamw(1e-4)
+    raw = make_train_step(loss_fn, opt, grad_stats=True)
+
+    def step_fn(state, batch):
+        p, s = state
+        p, s, loss, stats = raw(p, s, batch)
+        return (p, s), loss, stats
+
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (BATCH, SEQ + 1),
+                                0, cfg.vocab_size, dtype=jnp.int32)
+    state = (prepared, opt.init(prepared))
+    return cfg, step_fn, state, tokens
+
+
+def _batches(tokens):
+    while True:
+        yield tokens
+
+
+def _fit_leg() -> dict:
+    """Coverage + MFU on a real fit() run (warmed: the compile lands
+    before the clock starts)."""
+    import jax
+
+    from dnn_tpu.obs.trainlens import TrainClock
+    from dnn_tpu.train import fit
+    from dnn_tpu.utils.flops import gpt_train_step_flops
+
+    cfg, step_fn, state, tokens = _gpt_mini()
+    state = jax.block_until_ready(step_fn(state, tokens)[0])  # warm
+    fps = gpt_train_step_flops(cfg, BATCH, SEQ)
+    clock = TrainClock(capacity=FIT_STEPS + 8, flops_per_step=fps,
+                       tokens_per_step=BATCH * SEQ,
+                       peak_flops=PINNED_PEAK_FLOPS).install()
+    t0 = time.perf_counter()
+    fit(step_fn, state, _batches(tokens), num_steps=FIT_STEPS,
+        clock=clock)
+    wall = time.perf_counter() - t0
+    recs = clock.records()
+    attributed = sum(r["wall"] for r in recs)
+    s = clock.summary()
+    # hand MFU from the records themselves (rate over first-begin ->
+    # last-end, the same span the ring rate converges to): the clock's
+    # published number must agree with arithmetic a reviewer can redo
+    span = (recs[-1]["t0"] + recs[-1]["wall"]) - recs[0]["t0"]
+    hand_mfu = fps * (len(recs) / span) / PINNED_PEAK_FLOPS
+    return {
+        "steps": len(recs),
+        "wall_s": round(wall, 4),
+        "coverage": round(attributed / wall, 4),
+        "mfu": s["mfu"],
+        "hand_mfu": round(hand_mfu, 6),
+        "flops_per_step": fps,
+        "tokens_per_sec": s["tokens_per_sec"],
+        "data_stall_baseline": s["data_stall_fraction"],
+        "step_ms": round(attributed / len(recs) * 1e3, 3),
+    }
+
+
+def _stall_leg() -> dict:
+    """Injected-sleep attribution: STALL_SLEEPS x STALL_DELAY_S of
+    chaos sleep must come back as data_stall_fraction within
+    STALL_TOLERANCE of ground truth."""
+    import jax
+
+    from dnn_tpu.chaos import inject as chaos
+    from dnn_tpu.obs.trainlens import TrainClock
+    from dnn_tpu.train import fit
+
+    _cfg, step_fn, state, tokens = _gpt_mini()
+    state = jax.block_until_ready(step_fn(state, tokens)[0])
+    clock = TrainClock(capacity=STALL_STEPS + 8).install()
+    chaos.install({"seed": 0, "faults": [
+        {"kind": "train_fault", "target": "sleep", "at_n": 0,
+         "count": STALL_SLEEPS, "delay_s": STALL_DELAY_S}]})
+    try:
+        fit(step_fn, state, _batches(tokens), num_steps=STALL_STEPS,
+            clock=clock)
+    finally:
+        chaos.uninstall()
+    s = clock.summary()
+    expected = STALL_SLEEPS * STALL_DELAY_S / s["window_wall_s"]
+    return {
+        "injected_sleep_s": STALL_SLEEPS * STALL_DELAY_S,
+        "window_wall_s": s["window_wall_s"],
+        "data_stall_fraction": s["data_stall_fraction"],
+        "expected_stall_fraction": round(expected, 4),
+        "stall_error": round(abs(s["data_stall_fraction"] - expected),
+                             4),
+    }
+
+
+def _sentinel_leg(tmpdir: str) -> dict:
+    """Injected-NaN detection on a FLOAT toy model: the chaos nan
+    vector poisons iteration NAN_AT's batch, the sentinel must fire
+    loss_nan within SENTINEL_MAX_STEPS, and the event must be present
+    in the DUMPED flight ring."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from dnn_tpu.chaos import inject as chaos
+    from dnn_tpu.obs import flight
+    from dnn_tpu.obs.trainlens import GradSentinel
+    from dnn_tpu.train import fit, make_train_step
+
+    k = jax.random.PRNGKey(0)
+    params = {"w": jax.random.normal(k, (16,)), "b": jnp.zeros(())}
+    x = jax.random.normal(jax.random.PRNGKey(1), (32, 16))
+    y = x @ jax.random.normal(jax.random.PRNGKey(2), (16,))
+
+    def loss_fn(p, batch):
+        pred = batch["x"] @ p["w"] + p["b"]
+        return jnp.mean((pred - batch["y"]) ** 2)
+
+    opt = optax.sgd(1e-2)
+    raw = make_train_step(loss_fn, opt, grad_stats=True)
+
+    def step_fn(state, batch):
+        p, s = state
+        p, s, loss, stats = raw(p, s, batch)
+        return (p, s), loss, stats
+
+    sentinel = GradSentinel(warmup=2, bundle_dir=os.path.join(
+        tmpdir, "incident"))
+    chaos.install({"seed": 0, "faults": [
+        {"kind": "train_fault", "target": "nan", "at_n": NAN_AT,
+         "count": 1}]})
+    try:
+        fit(step_fn, (params, opt.init(params)),
+            _batches({"x": x, "y": y}), num_steps=NAN_AT + 4,
+            clock=None, sentinel=sentinel)
+    finally:
+        chaos.uninstall()
+    evs = flight.recorder().events(kind="loss_nan")
+    fired_step = evs[-1]["step"] if evs else None
+    # the dumped ring — what an operator actually reads post-mortem
+    dump = os.path.join(tmpdir, "ring.jsonl")
+    flight.recorder().dump(dump)
+    with open(dump) as f:
+        dumped_kinds = [json.loads(ln)["kind"] for ln in f if ln.strip()]
+    # chaos fires at 0-indexed iteration NAN_AT == fit step NAN_AT+1
+    latency = None if fired_step is None else fired_step - (NAN_AT + 1)
+    return {
+        "poisoned_step": NAN_AT + 1,
+        "loss_nan_step": fired_step,
+        "sentinel_latency_steps": latency,
+        "loss_nan_in_dumped_ring": "loss_nan" in dumped_kinds,
+        "bundle_written": os.path.isdir(
+            os.path.join(tmpdir, "incident")),
+    }
+
+
+def _overhead_leg() -> dict:
+    """trainlens-live obs tax, ABBA-paired: each sample is one full
+    fit-shaped iteration (begin/marks/end + sentinel.observe + the
+    periodic registry flush) with the gate ON vs OFF."""
+    import jax
+
+    from dnn_tpu import obs
+    from dnn_tpu.obs.trainlens import GradSentinel, TrainClock
+
+    _cfg, step_fn, state, tokens = _gpt_mini()
+    state = jax.block_until_ready(step_fn(state, tokens)[0])
+    clock = TrainClock(capacity=256).install()
+    sentinel = GradSentinel(warmup=2)
+    it = _batches(tokens)
+    was = obs.enabled()
+    seq = []
+    step = 0
+    try:
+        for i in range(2 * OVERHEAD_PAIRS):
+            on = _abba_on(i)
+            obs.set_enabled(on)
+            t0 = time.perf_counter()
+            rec = clock.begin()
+            batch = next(it)
+            if rec is not None:
+                clock.mark(rec, "data")
+            state, loss, stats = step_fn(state, batch)
+            if rec is not None:
+                clock.mark(rec, "dispatch")
+            loss, stats = jax.block_until_ready((loss, stats))
+            if rec is not None:
+                clock.mark(rec, "wait")
+                clock.mark(rec, "ckpt")
+                clock.mark(rec, "eval")
+            step += 1
+            sentinel.observe(step, loss, stats)
+            if rec is not None:
+                clock.end(rec)
+            seq.append((on, time.perf_counter() - t0))
+    finally:
+        obs.set_enabled(was)
+    overhead, med_on, med_off = _paired_overhead(seq)
+    return {
+        "overhead_frac": round(overhead, 5),
+        "step_ms_on": round(med_on * 1e3, 4),
+        "step_ms_off": round(med_off * 1e3, 4),
+        "pairs": OVERHEAD_PAIRS,
+    }
+
+
+def measure() -> dict:
+    import shutil
+    import tempfile
+
+    from dnn_tpu import obs
+
+    was = obs.enabled()
+    obs.set_enabled(True)
+    tmpdir = tempfile.mkdtemp(prefix="train-goodput-")
+    try:
+        fitl = _fit_leg()
+        stall = _stall_leg()
+        sent = _sentinel_leg(tmpdir)
+        over = _overhead_leg()
+    finally:
+        obs.set_enabled(was)
+        shutil.rmtree(tmpdir, ignore_errors=True)
+    row = dict(fitl)
+    row.update(stall)
+    row.update(sent)
+    row.update(over)
+    row["overhead_pct"] = round(over["overhead_frac"] * 100, 2)
+    row["coverage_floor"] = COVERAGE_FLOOR
+    row["mfu_floor"] = MFU_FLOOR
+    row["pinned_peak_flops"] = PINNED_PEAK_FLOPS
+    row["ok_coverage"] = bool(fitl["coverage"] >= COVERAGE_FLOOR)
+    row["ok_mfu"] = bool(fitl["mfu"] is not None
+                         and fitl["mfu"] >= MFU_FLOOR)
+    row["ok_stall"] = bool(stall["stall_error"] <= STALL_TOLERANCE)
+    row["ok_sentinel"] = bool(
+        sent["sentinel_latency_steps"] is not None
+        and 0 <= sent["sentinel_latency_steps"] <= SENTINEL_MAX_STEPS
+        and sent["loss_nan_in_dumped_ring"])
+    row["ok_overhead"] = bool(
+        over["overhead_frac"] < OVERHEAD_BUDGET)
+    row["ok"] = (row["ok_coverage"] and row["ok_mfu"] and row["ok_stall"]
+                 and row["ok_sentinel"] and row["ok_overhead"])
+    return row
+
+
+def main(argv=None) -> int:
+    args = set(argv if argv is not None else sys.argv[1:])
+    row = measure()
+    print(json.dumps(row), flush=True)
+    if "--assert" in args and not row["ok"]:
+        print("FAIL: " + ", ".join(
+            k for k in ("ok_coverage", "ok_mfu", "ok_stall",
+                        "ok_sentinel", "ok_overhead") if not row[k]),
+            file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -18,6 +18,13 @@ degradation discipline as `obs.flight.record`:
     step_fault()                LM batcher step: raises at the
                                 scheduled step counter (worker-death /
                                 requeue path)
+    train_fault()               training loop (train.fit): non-None ->
+                                a directive dict — {"mode": "nan"}
+                                poisons the batch's float leaves (the
+                                gradient-sentinel vector) or
+                                {"mode": "sleep", "delay_s": s} stalls
+                                the input pipeline (the data_stall
+                                attribution vector)
     wedge_detail()              watchdog probe: non-None -> the probe
                                 reports a structural timeout (wedged)
                                 without touching any device
@@ -39,8 +46,8 @@ from typing import Optional
 from dnn_tpu.chaos.plan import FaultPlan, decide
 
 __all__ = ["Injector", "install", "uninstall", "active", "perturb_rpc",
-           "perturb_relay", "kv_exhaust", "step_fault", "wedge_detail",
-           "corrupt_file", "InjectedFault"]
+           "perturb_relay", "kv_exhaust", "step_fault", "train_fault",
+           "wedge_detail", "corrupt_file", "InjectedFault"]
 
 
 class InjectedFault(Exception):
@@ -176,6 +183,24 @@ class Injector:
                 raise RuntimeError(
                     f"chaos: injected device step fault (step n={n})")
 
+    def train_fault(self) -> Optional[dict]:
+        """Training-loop seam (train.fit's input phase): a `train_fault`
+        fires at exact step counters and returns a DIRECTIVE rather
+        than raising — the loop executes it inside its data window, so
+        the injected cost lands exactly where the fault claims to live.
+        `target` picks the mode: "nan" (default) poisons the batch's
+        float leaves — the gradient-sentinel test vector — and "sleep"
+        stalls for `delay_s` — the data_stall attribution vector."""
+        n = self._tick("train")
+        for f in self._faults:
+            if f.kind != "train_fault" or f.at_n < 0:
+                continue
+            if f.at_n <= n < f.at_n + f.count:
+                mode = f.target or "nan"
+                _record("train_fault", n=n, mode=mode)
+                return {"mode": mode, "delay_s": f.delay_s}
+        return None
+
     def kv_migrate(self):
         """KV-tier migration seam (runtime/lm_server kvpull): a
         `kv_migrate_fault` severs the pull AS IF the donor died
@@ -288,6 +313,11 @@ def step_fault():
     inj = _active
     if inj is not None:
         inj.step_fault()
+
+
+def train_fault() -> Optional[dict]:
+    inj = _active
+    return inj.train_fault() if inj is not None else None
 
 
 def kv_migrate():

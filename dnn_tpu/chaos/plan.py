@@ -28,6 +28,9 @@ path or inline JSON):
       {"kind": "relay_corrupt","p": 0.2, "count": 2},
       {"kind": "kv_exhaust",   "from_n": 4, "count": 3},
       {"kind": "step_fault",   "at_n": 10, "count": 1},
+      {"kind": "train_fault",  "target": "nan", "at_n": 6, "count": 1},
+      {"kind": "train_fault",  "target": "sleep", "at_n": 3,
+       "count": 4, "delay_s": 0.05},
       {"kind": "ckpt_corrupt", "target": "/path/ckpt.npz"}
     ]}
 
@@ -54,7 +57,7 @@ PROCESS_KINDS = frozenset({"kill_stage", "hang_stage", "kill_donor"})
 INPROCESS_KINDS = frozenset({
     "wedge_device", "rpc_drop", "rpc_delay", "rpc_corrupt",
     "relay_drop", "relay_corrupt", "kv_exhaust", "step_fault",
-    "kv_migrate_fault",
+    "kv_migrate_fault", "train_fault",
 })
 FILE_KINDS = frozenset({"ckpt_corrupt"})
 KINDS = PROCESS_KINDS | INPROCESS_KINDS | FILE_KINDS

@@ -91,6 +91,27 @@ capacity-vs-compute question (item 3):
     against ground truth (|predicted − measured| ≤ 0.10 at an
     untested pool size).
 
+v7 adds the TRAINING layer — the observatory for the one ROADMAP
+pillar that had none (built before the training-at-scale PR it
+judges, the instrument-first pattern):
+
+  * trainlens (obs/trainlens.py): a per-step TRAINING clock in the
+    StepClock idiom — train.fit splits every iteration into
+    data/dispatch/wait/ckpt/eval/obs phases with a derived
+    `data_stall_fraction` and step-time MFU/tokens-per-sec priced by
+    the utils/flops.py training helpers against the same
+    device_peak_flops rooflines goodput uses (weak gauges
+    dnn_tpu_train_mfu / _tokens_per_sec / _data_stall; /trainz
+    JSON|prom|trace; `python -m dnn_tpu.obs trainlens`) — plus
+    gradient-health sentinels over the train steps' opt-in on-device
+    stats leg (grad_spike / loss_nan / train_stall flight events, an
+    incident bundle on divergence) and checkpoint observability
+    (save/restore histograms, dnn_tpu_ckpt_last_good_step /
+    staleness gauges, ckpt_saved/ckpt_restored events);
+    benchmarks/train_goodput_probe.py asserts phase coverage, the
+    MFU floor, stall attribution, sentinel latency, and the <2%
+    overhead budget.
+
 Gate: DNN_TPU_OBS=off (or 0/false) disables everything — producers see
 `metrics()` return None, `start_span` return the free NULL_SPAN, and
 `flight.record` short-circuit on one boolean. The gate is re-checked
@@ -187,7 +208,8 @@ def install_compile_telemetry() -> bool:
 
 def serve_metrics(port: int = 0, host: str = "127.0.0.1", *,
                   healthy=None, status=None, profiler=None, fleet=None,
-                  drain=None, stepclock=None, kvlens=None):
+                  drain=None, stepclock=None, kvlens=None,
+                  trainlens=None):
     """Start the observability HTTP endpoint on a daemon thread; returns
     the MetricsHTTPServer (`.port` for port=0 ephemeral binds,
     `.close()` to stop; loopback by default — pass host="0.0.0.0" to
@@ -209,7 +231,10 @@ def serve_metrics(port: int = 0, host: str = "127.0.0.1", *,
     serves the memory-economy observatory on /kvz (JSON;
     ?format=prom) — LMServer attaches its batcher's lens after
     construction by assigning `server._kvlens` (the batcher is built
-    after the endpoint comes up). See obs/http.py."""
+    after the endpoint comes up). `trainlens` (an
+    obs.trainlens.TrainClock) additionally serves the training-step
+    observatory on /trainz (JSON; ?format=prom|trace) — the training
+    counterpart of /stepz. See obs/http.py."""
     from dnn_tpu.obs.http import MetricsHTTPServer
     from dnn_tpu.obs.mem import install_memory_gauges
 
@@ -221,4 +246,5 @@ def serve_metrics(port: int = 0, host: str = "127.0.0.1", *,
     return MetricsHTTPServer(port=port, host=host, healthy=healthy,
                              status=status, profiler=profiler or None,
                              fleet=fleet, drain=drain,
-                             stepclock=stepclock, kvlens=kvlens)
+                             stepclock=stepclock, kvlens=kvlens,
+                             trainlens=trainlens)

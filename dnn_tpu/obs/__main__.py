@@ -1,5 +1,25 @@
 """CLI: `python -m dnn_tpu.obs {trace,flight,fleet,timeline,incident,
-kvlens} ...` — obs tooling.
+kvlens,trainlens} ...` — obs tooling.
+
+    python -m dnn_tpu.obs trainlens --url http://host:port
+        Fetch a running trainer's /trainz (the training-step
+        observatory, obs/trainlens.py) and print the per-phase step
+        decomposition (data/dispatch/wait/ckpt/eval/obs with fractions),
+        the data-stall fraction, MFU against the device roofline,
+        tokens/sec, and the checkpoint staleness. --json for the raw
+        dict.
+
+    python -m dnn_tpu.obs trainlens PATH
+        Render a saved /trainz JSON dump (a `curl .../trainz >
+        trainz.json` capture) with the same table — post-mortems read
+        dumps, not live servers.
+
+    python -m dnn_tpu.obs trainlens --selftest
+        In-process smoke: hand-computed phase/stall/MFU goldens on an
+        injected clock, checkpoint staleness arithmetic, the
+        gradient-sentinel NaN latch, gate-off-records-nothing, and the
+        /trainz endpoint in both formats; exit 0 on success. Tier-1
+        wired (tests/test_obs_trainlens.py).
 
     python -m dnn_tpu.obs kvlens --url http://host:port
         Fetch a running server's /kvz (the memory-economy observatory,
@@ -625,6 +645,139 @@ def _kvlens_path(path: str, as_json: bool) -> int:
     return 0
 
 
+def _trainlens_selftest() -> int:
+    """Deterministic trainlens end to end: hand-computed phase/stall/
+    MFU goldens on an injected clock, checkpoint staleness arithmetic,
+    the sentinel's NaN latch, gate-off-records-nothing, and the /trainz
+    endpoint in both formats."""
+    from urllib.request import urlopen
+
+    from dnn_tpu import obs
+    from dnn_tpu.obs.trainlens import GradSentinel, TrainClock
+    from dnn_tpu.utils.metrics import Metrics
+
+    obs.set_enabled(True)
+    t = [100.0]
+    reg = Metrics()
+    clk = TrainClock(capacity=8, registry=reg, flops_per_step=1e6,
+                     tokens_per_step=64, peak_flops=1e9,
+                     now=lambda: t[0])
+    # 4 steps: data 10 ms, dispatch 2 ms, wait 30 ms, 2 ms tail -> obs
+    for _i in range(4):
+        rec = clk.begin()
+        assert rec is not None
+        for phase, dt in (("data", 0.010), ("dispatch", 0.002),
+                          ("wait", 0.030)):
+            t[0] += dt
+            clk.mark(rec, phase)
+        t[0] += 0.002
+        clk.end(rec)
+    s = clk.summary()
+    assert s["window_steps"] == 4 and s["steps_total"] == 4, s
+    # per step: wall 44 ms, data 10 ms -> stall fraction 10/44
+    assert abs(s["data_stall_fraction"] - 10.0 / 44.0) < 1e-3, s
+    assert abs(s["window_wall_s"] - 4 * 0.044) < 1e-9, s
+    assert s["tokens"] == 4 * 64, s
+    # rate window: 4 steps over the 176 ms the ring spans
+    sps = 4 / 0.176
+    assert abs(s["steps_per_sec"] - sps) < 0.1, s
+    # MFU golden: flops_per_step x steps/s / peak, hand-computed
+    assert s["mfu"] is not None
+    assert abs(s["mfu"] - 1e6 * sps / 1e9) < 1e-4, s["mfu"]
+    # checkpoint freshness: a save at now, read 7 s later
+    clk.ckpt_saved(4, 0.01, 12345)
+    t[0] += 7.0
+    assert abs(clk.ckpt_staleness_s() - 7.0) < 1e-9
+    s = clk.summary()
+    assert s["ckpt"]["last_good_step"] == 4, s["ckpt"]
+    ct = clk.chrome_trace()
+    xs = [e for e in ct["traceEvents"] if e.get("ph") == "X"]
+    assert len(xs) == 4 * 3, len(xs)  # 3 marked slices per step
+    prom = clk.render_prom()
+    assert "dnn_tpu_train_mfu" in prom, prom
+    assert 'dnn_tpu_train_phase_frac{phase="data"}' in prom, prom
+    snap = reg.snapshot()
+    assert 'train.phase_seconds{phase="wait"}' in snap["histogram"], snap
+
+    # sentinel: NaN latches ONCE per episode, recovers, re-fires
+    sen = GradSentinel(warmup=1, spike_factor=4.0)
+    assert sen.observe(1, 1.0, [1.0, 0.01, 0]) == []
+    assert sen.observe(2, float("nan"), [1.0, 0.01, 0]) == ["loss_nan"]
+    assert sen.observe(3, float("nan"), [1.0, 0.01, 0]) == []  # latched
+    assert sen.observe(4, 0.9, [1.0, 0.01, 0]) == []           # recovers
+    assert sen.observe(5, 1.0, [99.0, 0.01, 0]) == ["grad_spike"]
+
+    # gate off records NOTHING
+    obs.set_enabled(False)
+    try:
+        assert clk.begin() is None
+        assert sen.observe(6, float("nan")) == []
+    finally:
+        obs.set_enabled(True)
+
+    # /trainz endpoint, both formats
+    srv = obs.serve_metrics(0, trainlens=clk)
+    try:
+        base = f"http://127.0.0.1:{srv.port}/trainz"
+        z = json.loads(urlopen(base, timeout=10).read().decode())
+        assert z["steps_total"] == 4, z
+        assert set(z["phases"]) == {"data", "dispatch", "wait", "ckpt",
+                                    "eval", "obs"}, z["phases"]
+        ptext = urlopen(base + "?format=prom",
+                        timeout=10).read().decode()
+        assert "dnn_tpu_train_data_stall" in ptext
+        assert "dnn_tpu_ckpt_staleness_seconds" in ptext
+    finally:
+        srv.close()
+    print("trainlens selftest ok: 4 deterministic steps (data stall "
+          f"{10 / 44:.1%}, mfu {1e6 * sps / 1e9:.2%} hand-checked), "
+          "ckpt staleness 7.0s, sentinel nan-latch + spike, gate off "
+          "silent, /trainz json+prom served")
+    return 0
+
+
+def _trainlens_render(z: dict) -> None:
+    print(f"steps: {z.get('steps_total')} total, "
+          f"{z.get('window_steps')} in window "
+          f"({z.get('window_wall_s', 0) * 1e3:.1f} ms wall, "
+          f"{z.get('tokens')} tokens)")
+    for p, d in z.get("phases", {}).items():
+        print(f"  {p:<9} {d['frac']:7.1%}  {d['mean_ms']:9.3f} ms/step")
+    mfu = z.get("mfu")
+    print(f"data stall {z.get('data_stall_fraction', 0):.1%} | "
+          + (f"mfu {mfu:.2%} | " if mfu is not None
+             else "mfu - (no roofline) | ")
+          + f"{z.get('steps_per_sec', 0):.2f} steps/s | "
+          f"{z.get('tokens_per_sec', 0):.0f} tokens/s | last step "
+          f"{z.get('last_wall_ms', 0):.2f} ms")
+    ck = z.get("ckpt", {})
+    print(f"ckpt: last good step {ck.get('last_good_step')}, "
+          f"staleness {ck.get('staleness_s')}s")
+
+
+def _trainlens_url(url: str, as_json: bool, last=None) -> int:
+    from urllib.request import urlopen
+
+    base = url.rstrip("/") + "/trainz"
+    q = f"?last={last}" if last else ""
+    z = json.loads(urlopen(base + q, timeout=10).read().decode())
+    if as_json:
+        print(json.dumps(z, indent=2, default=str))
+    else:
+        _trainlens_render(z)
+    return 0
+
+
+def _trainlens_path(path: str, as_json: bool) -> int:
+    with open(path) as f:
+        z = json.load(f)
+    if as_json:
+        print(json.dumps(z, indent=2, default=str))
+    else:
+        _trainlens_render(z)
+    return 0
+
+
 def _fleet_cmd(args) -> int:
     from dnn_tpu.obs.fleet import FleetCollector, targets_from_config
 
@@ -770,6 +923,22 @@ def main(argv=None) -> int:
                     help="obs endpoint base URL to fetch /kvz from")
     kv.add_argument("--json", action="store_true",
                     help="print the raw /kvz dict instead of the table")
+    tn = sub.add_parser("trainlens", help="training-step observatory: "
+                        "/trainz fetch — phase decomposition, MFU, "
+                        "data-stall, ckpt freshness (obs/trainlens.py)")
+    tn.add_argument("path", nargs="?", default=None,
+                    help="saved /trainz JSON dump to render")
+    tn.add_argument("--selftest", action="store_true",
+                    help="in-process smoke (phase/stall/MFU goldens, "
+                         "sentinel latch, /trainz); exit 0 on pass")
+    tn.add_argument("--url", default=None,
+                    help="obs endpoint base URL to fetch /trainz from")
+    tn.add_argument("--json", action="store_true",
+                    help="print the raw /trainz dict instead of the "
+                         "table")
+    tn.add_argument("--last", type=int, default=None,
+                    help="bound the /trainz window to the newest N "
+                         "steps")
     args = ap.parse_args(argv)
 
     if args.cmd == "trace":
@@ -816,6 +985,15 @@ def main(argv=None) -> int:
             return _kvlens_path(args.path, args.json)
         ap.error("kvlens needs --selftest, --url URL, or a saved /kvz "
                  "JSON PATH")
+    if args.cmd == "trainlens":
+        if args.selftest:
+            return _trainlens_selftest()
+        if args.url:
+            return _trainlens_url(args.url, args.json, args.last)
+        if args.path:
+            return _trainlens_path(args.path, args.json)
+        ap.error("trainlens needs --selftest, --url URL, or a saved "
+                 "/trainz JSON PATH")
     return 2
 
 

@@ -782,6 +782,19 @@ class FleetCollector:
         v = s.get("dnn_tpu_kvlens_thrash_chunk_seconds_total")
         if v is not None:
             row["kvlens_thrash_chunk_s"] = v
+        # training series (obs/trainlens.py): present only when the
+        # target is a training job serving /trainz's weak gauges — the
+        # fleet view then answers "is the run compute-bound or
+        # input-bound, and how stale is its newest checkpoint" without
+        # a separate training dashboard
+        for fam, key in (
+                ("dnn_tpu_train_mfu", "train_mfu"),
+                ("dnn_tpu_train_data_stall", "train_data_stall"),
+                ("dnn_tpu_train_tokens_per_sec", "train_tokens_per_sec"),
+                ("dnn_tpu_ckpt_staleness_seconds", "ckpt_staleness")):
+            v = s.get(fam)
+            if v is not None:
+                row[key] = v
         sheds = s.sum("dnn_tpu_router_shed_total")
         if sheds is not None:
             row["shed_total"] = sheds
@@ -873,7 +886,9 @@ class FleetCollector:
                         "shed_total", "kvtier_blocks",
                         "prefix_hit_ratio", "kvtier_remote_ratio",
                         "kvlens_pred_1x", "kvlens_pred_2x",
-                        "kvlens_pred_4x", "kvlens_thrash_chunk_s"):
+                        "kvlens_pred_4x", "kvlens_thrash_chunk_s",
+                        "train_mfu", "train_data_stall",
+                        "train_tokens_per_sec", "ckpt_staleness"):
                 if row.get(key) is not None:
                     m.set(labeled(f"dnn_tpu_fleet_stage_{key}",
                                   stage=name), row[key])

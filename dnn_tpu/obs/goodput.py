@@ -56,7 +56,8 @@ from typing import Optional
 
 from dnn_tpu.utils.metrics import Throughput, labeled
 
-__all__ = ["ModelCost", "model_cost", "SLOConfig", "GoodputTracker"]
+__all__ = ["ModelCost", "model_cost", "train_step_flops", "SLOConfig",
+           "GoodputTracker"]
 
 
 @dataclass(frozen=True)
@@ -108,6 +109,28 @@ def model_cost(cfg, prepared=None, *, kv_bytes: float = 2,
         flops_per_token=per_tok, prefill_flops=pf, weight_bytes=wbytes,
         kv_bytes_per_pos=F.kv_bytes_per_pos(cfg, kv_bytes=kv_bytes,
                                             kv_dtype=kv_dtype))
+
+
+def train_step_flops(cfg, batch: int, seq: int, *, accum_steps: int = 1,
+                     remat: bool = False) -> float:
+    """Total FLOPs one optimizer step costs for `cfg` at (batch, seq) —
+    the TRAINING counterpart of ModelCost, dispatched by the same
+    family sniff model_cost uses (n_kv_head/d_ff means LLaMA layout).
+    Delegates to utils/flops.{gpt,llama}_train_step_flops so serving
+    and training price from ONE analytic walk: trainlens's MFU
+    numerator and goodput's serving numerators can never drift onto
+    different conventions. `accum_steps` validates divisibility (the
+    total is linear in batch, so accumulation leaves it unchanged);
+    `remat=True` prices the recompute forward (factor 4x instead of
+    3x)."""
+    from dnn_tpu.utils import flops as F
+
+    if hasattr(cfg, "n_kv_head") and hasattr(cfg, "d_ff"):
+        return F.llama_train_step_flops(cfg, batch, seq,
+                                        accum_steps=accum_steps,
+                                        remat=remat)
+    return F.gpt_train_step_flops(cfg, batch, seq,
+                                  accum_steps=accum_steps, remat=remat)
 
 
 @dataclass(frozen=True)

@@ -36,6 +36,14 @@ Prometheus scraper or a plain curl can watch the serving stack:
                        ?format=trace exports the last N steps as a
                        Perfetto-loadable host track, ?last=N bounds
                        the window)
+    GET  /trainz       training-step observatory (obs/trainlens.py)
+                       when a TrainClock is attached: per-phase
+                       training-iteration decomposition (data/dispatch/
+                       wait/ckpt/eval/obs), data_stall_fraction, MFU /
+                       tokens-per-sec, checkpoint freshness (JSON;
+                       ?format=prom re-renders as gauges, ?format=trace
+                       exports the last N steps as a Perfetto host
+                       track, ?last=N bounds the window)
     GET  /kvz          memory-economy observatory (obs/kvlens.py) when
                        a KVLens is attached: sampled reuse-distance
                        stats, the predicted hit-ratio-vs-capacity
@@ -115,7 +123,7 @@ class MetricsHTTPServer:
                  status: Optional[Callable[[], dict]] = None,
                  profiler=None, flight=None, fleet=None,
                  drain: Optional[Callable[[], dict]] = None,
-                 stepclock=None, kvlens=None):
+                 stepclock=None, kvlens=None, trainlens=None):
         from dnn_tpu import obs
         from dnn_tpu.obs import flight as _flight
         from dnn_tpu.utils import metrics as _metrics
@@ -144,6 +152,8 @@ class MetricsHTTPServer:
         # lens — is built after the endpoint comes up), so the handler
         # reads it per request rather than capturing it here
         self._kvlens = kvlens
+        # training-step clock (obs/trainlens.TrainClock): serves /trainz
+        self._trainlens = trainlens
         if fleet is not None and status is None:
             self._status = fleet.status
         outer = self
@@ -247,6 +257,36 @@ class MetricsHTTPServer:
                                "(json|prom|trace)\n",
                                "text/plain; charset=utf-8")
 
+            def _trainz(self, q):
+                if outer._trainlens is None:
+                    self._send(404, "no train clock attached\n",
+                               "text/plain; charset=utf-8")
+                    return
+                last = None
+                if "last" in q:
+                    try:
+                        last = int(q["last"][0])
+                    except ValueError:
+                        last = 0
+                    if last < 1:
+                        self._send(400, "last must be an int >= 1\n",
+                                   "text/plain; charset=utf-8")
+                        return
+                fmt = q.get("format", ["json"])[0]
+                if fmt == "json":
+                    self._send_json(200, outer._trainlens.summary(last))
+                elif fmt == "prom":
+                    self._send(200, outer._trainlens.render_prom(last),
+                               "text/plain; version=0.0.4; charset=utf-8")
+                elif fmt == "trace":
+                    self._send(200, json.dumps(
+                        outer._trainlens.chrome_trace(last)),
+                        "application/json")
+                else:
+                    self._send(400, f"unknown format {fmt!r} "
+                               "(json|prom|trace)\n",
+                               "text/plain; charset=utf-8")
+
             def _kvz(self, q):
                 if outer._kvlens is None:
                     self._send(404, "no kvlens attached\n",
@@ -322,6 +362,8 @@ class MetricsHTTPServer:
                         self._stepz(q)
                     elif url.path == "/kvz":
                         self._kvz(q)
+                    elif url.path == "/trainz":
+                        self._trainz(q)
                     elif url.path == "/profilez":
                         if outer._profiler is None:
                             self._send(404, "no profiler attached\n",

@@ -21,6 +21,7 @@ Three entry points:
 
 from __future__ import annotations
 
+import time
 from typing import Any, Callable, Optional
 
 import jax
@@ -28,7 +29,11 @@ import jax.numpy as jnp
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from dnn_tpu import obs as _obs
 from dnn_tpu.analysis.shardcheck import contract
+from dnn_tpu.chaos import inject as _chaos
+from dnn_tpu.obs import flight as _flight
+from dnn_tpu.obs import trainlens as _trainlens
 from dnn_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS, STAGE_AXIS
 from dnn_tpu.parallel.pipeline import (
     spmd_pipeline_interleaved,
@@ -166,8 +171,42 @@ def distill_loss(student_apply: Callable, teacher_logits, student_params,
 # generic step
 # --------------------------------------------------------------------------
 
+def _health_stats(grads, updates, params):
+    """The gradient-health 3-vector the `grad_stats=True` steps return:
+    [global grad-norm, update/param-norm ratio, nonfinite grad count] —
+    fused into the step program (a handful of reductions next to a full
+    backward is noise) and read back as ONE small f32 array per step.
+    Donation-safe: built purely from values the step already computed,
+    returned as a fresh output (no donated buffer is re-read)."""
+
+    def sq(tree):
+        return sum(jnp.sum(jnp.square(leaf.astype(jnp.float32)))
+                   for leaf in jax.tree.leaves(tree))
+
+    gnorm = jnp.sqrt(sq(grads))
+    unorm = jnp.sqrt(sq(updates))
+    pnorm = jnp.sqrt(sq(params))
+    nonfinite = sum(jnp.sum(~jnp.isfinite(leaf))
+                    for leaf in jax.tree.leaves(grads))
+    return jnp.stack([gnorm, unorm / jnp.maximum(pnorm, 1e-12),
+                      nonfinite.astype(jnp.float32)])
+
+
+def poison_batch(batch):
+    """NaN-poison every FLOAT leaf of a batch pytree (int token arrays
+    cannot hold a NaN — the chaos train_fault's nan mode only makes
+    sense for float inputs, and fit() applies it inside its data
+    window so the poisoned batch flows through the real step)."""
+    def bad(x):
+        if hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.floating):
+            return jnp.full_like(x, jnp.nan)
+        return x
+
+    return jax.tree.map(bad, batch)
+
+
 def make_train_step(loss_fn: Callable, optimizer: optax.GradientTransformation,
-                    *, accum_steps: int = 1):
+                    *, accum_steps: int = 1, grad_stats: bool = False):
     """(params, opt_state, batch) -> (params, opt_state, loss). `loss_fn`
     is (params, batch) -> scalar. Jit-compiled; shardings of the inputs
     propagate (pass pre-sharded params for dp/tp/pp).
@@ -180,7 +219,13 @@ def make_train_step(loss_fn: Callable, optimizer: optax.GradientTransformation,
     full-batch step when the loss is a uniform mean over examples
     (cross_entropy without ignore_index); with masked losses the
     mean-of-means weights microbatches equally, the usual accumulation
-    semantics."""
+    semantics.
+
+    `grad_stats=True` fuses the gradient-health leg into the program:
+    the step additionally returns the `_health_stats` 3-vector
+    ([grad-norm, update/param-norm ratio, nonfinite count]) as a 4th
+    output — one small readback per step, what trainlens.GradSentinel
+    observes."""
     if accum_steps < 1:
         raise ValueError(f"accum_steps must be >= 1, got {accum_steps}")
 
@@ -189,8 +234,11 @@ def make_train_step(loss_fn: Callable, optimizer: optax.GradientTransformation,
         def step(params, opt_state, batch):
             loss, grads = jax.value_and_grad(loss_fn)(params, batch)
             updates, opt_state = optimizer.update(grads, opt_state, params)
-            params = optax.apply_updates(params, updates)
-            return params, opt_state, loss
+            new_params = optax.apply_updates(params, updates)
+            if grad_stats:
+                return new_params, opt_state, loss, \
+                    _health_stats(grads, updates, params)
+            return new_params, opt_state, loss
 
         return step
 
@@ -216,8 +264,11 @@ def make_train_step(loss_fn: Callable, optimizer: optax.GradientTransformation,
         scale = 1.0 / accum_steps
         grads = jax.tree.map(lambda g: g * scale, grads)
         updates, opt_state = optimizer.update(grads, opt_state, params)
-        params = optax.apply_updates(params, updates)
-        return params, opt_state, loss_sum * scale
+        new_params = optax.apply_updates(params, updates)
+        if grad_stats:
+            return new_params, opt_state, loss_sum * scale, \
+                _health_stats(grads, updates, params)
+        return new_params, opt_state, loss_sum * scale
 
     return step
 
@@ -447,6 +498,7 @@ def make_sharded_train_step(
     batch_axis: str = DATA_AXIS,
     zero1: bool = False,
     donate: bool = False,
+    grad_stats: bool = False,
 ):
     """dp x tp train step. Params must be placed with `shard_pytree(params,
     mesh, param_specs)`; the batch is sharded over `batch_axis` here. The
@@ -467,7 +519,14 @@ def make_sharded_train_step(
     previous state after stepping (the default-off safety) must rebind
     from the step's results. The shardcheck audit lowers the donating
     variant and fails the gate if any donated sharded leaf loses its
-    output alias (PRG003 under NamedSharding)."""
+    output alias (PRG003 under NamedSharding).
+
+    `grad_stats=True` adds the gradient-health 3-vector as a 4th
+    output (_health_stats) — its reductions all-reduce over the mesh
+    under GSPMD, so the readback is the GLOBAL grad norm, not one
+    shard's. Donation-safe: the stats are fresh outputs computed
+    before the donated buffers are overwritten (the program audit's
+    alias check covers the donating variant unchanged)."""
     param_shardings = specs_to_shardings(mesh, param_specs)
     batch_sharding = NamedSharding(mesh, P(batch_axis))
     # ZeRO-1 opt-state specs depend on the state's tree structure, which
@@ -491,9 +550,13 @@ def make_sharded_train_step(
                         data_axis=batch_axis))
             opt_state = jax.lax.with_sharding_constraint(
                 opt_state, opt_sharding_cache["specs"])
-        params = optax.apply_updates(params, updates)
-        params = jax.lax.with_sharding_constraint(params, param_shardings)
-        return params, opt_state, loss
+        new_params = optax.apply_updates(params, updates)
+        new_params = jax.lax.with_sharding_constraint(
+            new_params, param_shardings)
+        if grad_stats:
+            return new_params, opt_state, loss, \
+                _health_stats(grads, updates, params)
+        return new_params, opt_state, loss
 
     return step
 
@@ -525,13 +588,24 @@ def resume_or_init(ckpt_dir: Optional[str], init_state):
     `init_state`), or start fresh. Returns (state, start_step). The
     resume half of SURVEY §5's checkpoint mandate (the reference has
     neither — node.py:294-317 only ever loads)."""
-    from dnn_tpu.io.train_ckpt import restore_train_state
+    from dnn_tpu.io.train_ckpt import checkpoint_path, restore_train_state
 
     if ckpt_dir:
+        t0 = time.perf_counter()
         try:
-            return restore_train_state(ckpt_dir, like=init_state)
+            state, step = restore_train_state(ckpt_dir, like=init_state)
         except FileNotFoundError:
             pass
+        else:
+            try:
+                import os
+
+                nbytes = os.path.getsize(checkpoint_path(ckpt_dir, step))
+            except OSError:
+                nbytes = 0
+            _trainlens.note_ckpt_restored(
+                step, time.perf_counter() - t0, nbytes)
+            return state, step
     return init_state, 0
 
 
@@ -547,13 +621,43 @@ def fit(
     keep_checkpoints: int = 3,
     on_step: Optional[Callable] = None,
     advance_batches: bool = True,
+    eval_every: int = 0,
+    eval_fn: Optional[Callable] = None,
+    clock=None,
+    sentinel=None,
 ):
-    """Generic training loop with periodic checkpointing.
+    """Generic training loop with periodic checkpointing, phase-attributed
+    by trainlens (obs/trainlens.py).
 
     `step_fn(state, batch) -> (state, loss)` over any state pytree (wrap
-    the make_*_train_step outputs to this signature). `batch_iter` yields
-    batches. Saves every `ckpt_every` steps into `ckpt_dir` and prunes to
-    `keep_checkpoints`. Returns (state, last_loss).
+    the make_*_train_step outputs to this signature); a step built with
+    `grad_stats=True` may return `(state, loss, stats)` — the 3-vector
+    feeds the sentinel. `batch_iter` yields batches. Saves every
+    `ckpt_every` steps into `ckpt_dir` and prunes to `keep_checkpoints`.
+    `eval_fn(step, state)` runs every `eval_every` steps inside its own
+    attributed phase. Returns (state, last_loss).
+
+    Observability (all behind the one-None/boolean obs gate):
+      * `clock` (a trainlens.TrainClock; default the installed
+        `active_trainlens()`) splits every iteration into the
+        data/dispatch/wait/ckpt/eval/obs phases — fit BLOCKS on each
+        step's loss (`jax.block_until_ready`), so "wait" is the real
+        device window and the loop never silently runs ahead of a
+        failing program;
+      * compile telemetry installs once at entry, and the FIRST step +
+        every checkpointed step emit a `train_step` flight event — a
+        cold-compile stall is a /debugz event, not an opaque hang;
+      * checkpoint saves/restores land duration+bytes histograms,
+        freshness gauges, and `ckpt_saved` flight events
+        (trainlens.note_ckpt_saved);
+      * `sentinel` (a trainlens.GradSentinel) observes every step's
+        loss (+ stats when the step returns them): grad_spike /
+        loss_nan / train_stall flight events, incident bundle on
+        divergence;
+      * the chaos `train_fault` seam is consulted per iteration inside
+        the data window: "sleep" stalls the input pipeline (the
+        data_stall attribution vector), "nan" poisons the batch's
+        float leaves (the sentinel's test vector).
 
     On resume (`start_step > 0`) the default `advance_batches=True` skips
     the first `start_step` batches, so a deterministic data pipeline
@@ -561,6 +665,9 @@ def fit(
     this a resumed run would silently re-train on the earliest batches.
     Pass False only when `batch_iter` is already positioned at
     `start_step`."""
+    _obs.install_compile_telemetry()
+    if clock is None:
+        clock = _trainlens.active_trainlens()
     if advance_batches:
         for skipped in range(start_step):
             try:
@@ -573,7 +680,9 @@ def fit(
                 ) from None
 
     loss = None
+    first = True
     for step in range(start_step, num_steps):
+        rec = clock.begin() if clock is not None else None
         try:
             batch = next(batch_iter)
         except StopIteration:
@@ -581,14 +690,69 @@ def fit(
                 f"batch_iter exhausted at step {step} (wanted {num_steps}); "
                 "pass an infinite iterator or lower num_steps"
             ) from None
-        state, loss = step_fn(state, batch)
-        if on_step is not None:
-            on_step(step + 1, loss)
+        fault = _chaos.train_fault()
+        if fault is not None:
+            if fault["mode"] == "sleep":
+                time.sleep(fault["delay_s"])
+            elif fault["mode"] == "nan":
+                batch = poison_batch(batch)
+        if rec is not None:
+            clock.mark(rec, "data")
+        out = step_fn(state, batch)
+        stats = None
+        if len(out) == 3:
+            state, loss, stats = out
+        else:
+            state, loss = out
+        if rec is not None:
+            clock.mark(rec, "dispatch")
+        # block on the step's outputs: "wait" is the real device window,
+        # and a NaN/crash surfaces at ITS step instead of steps later
+        loss, stats = jax.block_until_ready((loss, stats))
+        if rec is not None:
+            clock.mark(rec, "wait")
         if ckpt_dir and ckpt_every and (step + 1) % ckpt_every == 0:
+            t_ck = time.perf_counter()
             save_checkpoint_multihost(
                 ckpt_dir, step + 1, state, keep=keep_checkpoints
             )
+            _trainlens.note_ckpt_saved(
+                step + 1, time.perf_counter() - t_ck,
+                _ckpt_nbytes(ckpt_dir, step + 1), clock=clock)
+            _flight.record("train_step", step=step + 1,
+                           checkpointed=True)
+        if rec is not None:
+            clock.mark(rec, "ckpt")
+        if eval_fn is not None and eval_every \
+                and (step + 1) % eval_every == 0:
+            eval_fn(step + 1, state)
+        if rec is not None:
+            clock.mark(rec, "eval")
+        if first:
+            # the first step carries the cold compile: its flight event
+            # is what distinguishes "compiling" from "hung" in /debugz
+            _flight.record("train_step", step=step + 1, first=True)
+            first = False
+        if sentinel is not None:
+            sentinel.observe(step + 1, loss, stats)
+        if on_step is not None:
+            on_step(step + 1, loss)
+        if rec is not None:
+            clock.end(rec)
     return state, loss
+
+
+def _ckpt_nbytes(ckpt_dir: str, step: int) -> int:
+    """Size of the checkpoint a save just wrote (0 when this process is
+    not the multihost writer — only process 0 has the file)."""
+    import os
+
+    from dnn_tpu.io.train_ckpt import checkpoint_path
+
+    try:
+        return os.path.getsize(checkpoint_path(ckpt_dir, step))
+    except OSError:
+        return 0
 
 
 def save_checkpoint_multihost(ckpt_dir: str, step: int, state, *, keep: int = 3):

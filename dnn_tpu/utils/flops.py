@@ -231,10 +231,41 @@ def decode_step_bytes(weight_bytes: float, kv_live_positions: float,
         kv_bytes_per_pos(cfg, kv_bytes=kv_bytes)
 
 
-def gpt_train_step_flops(cfg, batch: int, seq: int) -> float:
-    """Training step ~= 3x forward (fwd + backward's two matmuls per fwd
-    matmul); remat adds another forward where enabled — not counted here."""
-    return 3.0 * gpt_forward_flops(cfg, batch, seq)
+def _train_step_factor(batch: int, accum_steps: int, remat: bool) -> float:
+    """The forward→train-step multiplier (the PaLM-appendix bookkeeping):
+    3x a forward (fwd + backward's two matmuls per forward matmul), 4x
+    under full rematerialization (the backward replays the forward).
+    Microbatch accumulation does not change TOTAL step FLOPs — the
+    forward is linear in batch, so `accum_steps` microbatches of B/a
+    rows cost exactly one batch-B pass — but the divisibility check
+    here catches the same misconfiguration make_train_step rejects, so
+    the priced shape and the executed shape cannot drift apart."""
+    if accum_steps < 1:
+        raise ValueError(f"accum_steps must be >= 1, got {accum_steps}")
+    if batch % accum_steps:
+        raise ValueError(
+            f"batch {batch} not divisible by accum_steps {accum_steps}")
+    return 4.0 if remat else 3.0
+
+
+def gpt_train_step_flops(cfg, batch: int, seq: int, *,
+                         accum_steps: int = 1, remat: bool = False) -> float:
+    """Training-step FLOPs for one GPT batch: factor x forward (3x, or
+    4x with remat — the backward replays the forward). `accum_steps`
+    validates the microbatch split but leaves the total unchanged
+    (forward FLOPs are linear in batch). The trainlens MFU numerator
+    (obs/trainlens.py) and the dev_gpt2_train_step row both price from
+    this one walk."""
+    return _train_step_factor(batch, accum_steps, remat) \
+        * gpt_forward_flops(cfg, batch, seq)
+
+
+def llama_train_step_flops(cfg, batch: int, seq: int, *,
+                           accum_steps: int = 1, remat: bool = False) -> float:
+    """Training-step FLOPs for one LLaMA batch — same factor bookkeeping
+    as gpt_train_step_flops over the GQA/SwiGLU forward walk."""
+    return _train_step_factor(batch, accum_steps, remat) \
+        * llama_forward_flops(cfg, batch, seq)
 
 
 def cifar_forward_flops(batch: int) -> float:

@@ -87,6 +87,12 @@ _MODULE_COST_S = {
     # one real forced-eviction batcher feeding the radix-store seams —
     # the CLI subprocess and batcher compile dominate; placed with the
     # other obs modules inside the tier-1 budget
+    "test_obs_trainlens": 14.0,  # ISSUE 19 training-step observatory:
+    # TrainClock phase arithmetic + stall attribution on an injected
+    # clock, MFU vs hand arithmetic, GradSentinel NaN/spike/stall
+    # episodes, ckpt staleness, /trainz json+prom, CLI selftest, and
+    # one real fit() on a tiny GPT feeding every seam — the fit
+    # compile dominates; placed with the other obs modules
     "test_obs_fleet": 21.0,  # fleet layer (cross-host stitching, goodput
     # MFU/MBU, SLO burn rates + the `obs fleet --selftest` CLI smoke):
     # cheap HTTP endpoints + one real 2-stage gRPC request, certified

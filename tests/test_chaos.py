@@ -155,6 +155,46 @@ def test_rpc_and_relay_seams():
     assert chaos_inject.kv_exhaust() is False
 
 
+def test_train_fault_seam_golden():
+    # the training-loop seam (ISSUE 19): exact step counters, a
+    # DIRECTIVE dict instead of a raise — train.fit executes it inside
+    # its data window so the injected cost lands where the fault claims
+    chaos.install({"seed": 0, "faults": [
+        {"kind": "train_fault", "target": "sleep", "at_n": 1,
+         "count": 2, "delay_s": 0.25},
+        {"kind": "train_fault", "target": "nan", "at_n": 4, "count": 1},
+    ]})
+    try:
+        got = [chaos_inject.train_fault() for _ in range(6)]
+    finally:
+        chaos_inject.uninstall()
+    # GOLDEN firing pattern over the seeded "train" counter: n=0 clear,
+    # n∈[1,3) sleep with the configured delay, n=3 clear, n=4 nan
+    assert got[0] is None and got[3] is None and got[5] is None
+    assert got[1] == {"mode": "sleep", "delay_s": 0.25} == got[2]
+    assert got[4]["mode"] == "nan"
+    # every firing left a flight event carrying its mode
+    fired = [(e["n"], e["mode"]) for e in flight.recorder().events(
+        kind="chaos_inject") if e.get("fault") == "train_fault"]
+    assert fired[-3:] == [(1, "sleep"), (2, "sleep"), (4, "nan")]
+    # uninstalled: the seam is one None check
+    assert chaos_inject.train_fault() is None
+
+
+def test_poison_batch_floats_only():
+    # the nan directive's executor: float leaves drown, int leaves
+    # (token batches) pass through untouched — the documented contract
+    # that forces the sentinel probe onto a float toy model
+    from dnn_tpu.train import poison_batch
+
+    batch = {"tokens": np.arange(6, dtype=np.int32).reshape(2, 3),
+             "x": np.ones((2, 2), dtype=np.float32)}
+    out = poison_batch(batch)
+    assert np.isnan(out["x"]).all()
+    assert out["tokens"].dtype == np.int32
+    assert (out["tokens"] == batch["tokens"]).all()
+
+
 def test_deadline_propagation_plumbing():
     rid = tx.tag_deadline("gen:8:tr=ab.cd", 12.5)
     assert tx.extract_deadline(rid) == 12.5

@@ -24,6 +24,44 @@ def test_gpt_train_flops_is_3x_forward():
         3 * flops.gpt_forward_flops(cfg, 2, 32)
 
 
+def test_train_step_factor_goldens():
+    # hand-computed factors: 3x forward plain, 4x under remat (the
+    # backward replays the forward); microbatch accumulation leaves
+    # the TOTAL unchanged (forward FLOPs are linear in batch)
+    cfg = gpt.PRESETS["gpt2-test"]
+    fwd = flops.gpt_forward_flops(cfg, 8, 32)
+    assert flops.gpt_train_step_flops(cfg, 8, 32, remat=True) == 4 * fwd
+    assert flops.gpt_train_step_flops(cfg, 8, 32, accum_steps=4) == \
+        3 * fwd
+    # the divisibility check mirrors make_train_step's own rejection
+    with pytest.raises(ValueError):
+        flops.gpt_train_step_flops(cfg, 8, 32, accum_steps=3)
+    with pytest.raises(ValueError):
+        flops.gpt_train_step_flops(cfg, 8, 32, accum_steps=0)
+
+    from dnn_tpu.models import llama
+
+    lcfg = llama.PRESETS["tinyllama-1.1b"]
+    lfwd = flops.llama_forward_flops(lcfg, 2, 64)
+    assert flops.llama_train_step_flops(lcfg, 2, 64) == 3 * lfwd
+    assert flops.llama_train_step_flops(lcfg, 2, 64, remat=True) == \
+        4 * lfwd
+
+
+def test_goodput_train_step_flops_delegates_per_family():
+    # one analytic walk: the serving-side helper must sniff the config
+    # family and agree exactly with the utils/flops owners
+    from dnn_tpu.models import llama
+    from dnn_tpu.obs.goodput import train_step_flops
+
+    gcfg = gpt.PRESETS["gpt2-test"]
+    assert train_step_flops(gcfg, 4, 32) == \
+        flops.gpt_train_step_flops(gcfg, 4, 32)
+    lcfg = llama.PRESETS["tinyllama-1.1b"]
+    assert train_step_flops(lcfg, 2, 64, remat=True) == \
+        flops.llama_train_step_flops(lcfg, 2, 64, remat=True)
+
+
 def test_cifar_forward_flops_ballpark():
     per_image = flops.cifar_forward_flops(1)
     assert 1e7 < per_image < 3e7, per_image  # ~15.4 MFLOP/image
