@@ -145,7 +145,9 @@ def _results_md_rows(results_path: str, latest: dict) -> None:
                         "availability", "slo_verdict", "reconstructed",
                         "host_fraction", "parity_ok",
                         "kvlens_admit_overhead_pct",
+                        "caplens_admit_overhead_pct",
                         "thrash_refetch_blocks_at_B",
+                        "coldstart_coverage",
                         "overhead_pct"):
                 m = re.search(rf"\b{key}=([^,|]+)", details)
                 if not m:
@@ -315,6 +317,23 @@ RATCHETS: List[Ratchet] = [
     Ratchet("trainlens_overhead_budget", "train_goodput",
             "overhead_pct", "<=", _const(2.0),
             "TrainClock+GradSentinel tax % of a training step"),
+    # the capacity observatory (ISSUE 20): the what-if planner's
+    # 2-replica prediction must keep matching the real 2-replica fleet
+    # on the identical seeded trace, the cold-start ledger must keep
+    # covering the spawn→first-token wall, and the demand estimator in
+    # the router admission path pays the same 2% obs budget
+    Ratchet("capacity_prediction_error", "capacity_plan", "value",
+            "<=", _t("benchmarks.capacity_plan_probe",
+                     "PRED_ERROR_CEIL"),
+            "|predicted − measured| 2-replica availability"),
+    Ratchet("coldstart_coverage", "capacity_plan",
+            "coldstart_coverage", ">=",
+            _t("benchmarks.capacity_plan_probe",
+               "COLDSTART_COVERAGE_FLOOR"),
+            "spawn→first-token wall attributed to a named bucket"),
+    Ratchet("caplens_overhead_budget", "obs_overhead",
+            "caplens_admit_overhead_pct", "<=", _const(2.0),
+            "router-admission obs tax % with the demand estimator live"),
 ]
 
 

@@ -365,6 +365,98 @@ def measure_kvlens() -> dict:
     }
 
 
+def measure_caplens() -> dict:
+    """obs tax on the PER-REQUEST path WITH THE CAPLENS LIVE (ISSUE
+    20): a Router built under the gate attaches its capacity
+    observatory, then the gate alternates per request over the full
+    producer seam — `on_arrival` (ring append + scenario tally), the
+    real `_admit` decision (policy pick over live views), the
+    replica-side admission as the serving work in the window (the
+    kvtier leg's store-resident full-hit submit — the CHEAPEST real
+    per-request serving wall, so the fraction is an upper bound on
+    deployed configs whose wall also holds an RPC + decode), and
+    `on_commit` with the measured submit wall (free-slot reservoir
+    push + tokens/s EMA + ledger first-token stamp — the worst-case
+    commit). OFF requests run the identical path with every obs site
+    degraded to its gate check, so the delta is the TOTAL obs bill on
+    this wall — the kvtier counters it already carried plus the new
+    caplens hooks; the contract is that the new lens keeps the
+    combined tax under the same <2%. Planning/windowing stay
+    scrape-side and never enter the timed window (that is the design
+    claim this leg enforces). No network: one forced-serving handle
+    on an unstarted ReplicaSet — nothing here waits on a socket."""
+    import jax
+    import numpy as np
+
+    from dnn_tpu import obs
+    from dnn_tpu.control.replicaset import ReplicaHandle, ReplicaSet
+    from dnn_tpu.control.router import Router
+    from dnn_tpu.models import gpt
+    from dnn_tpu.runtime.serving import ContinuousBatcher
+
+    was = obs.enabled()
+    obs.set_enabled(True)  # BEFORE construction: the router attaches
+    # its lens only when the gate is up (gate-off routers carry none)
+    h = ReplicaHandle("r0", "127.0.0.1:1", role="both")
+    h.state = "serving"
+    h.t_spawn = time.monotonic() - 1.0
+    h.t_ready = time.monotonic()
+    rset = ReplicaSet([h], scrape=False)  # never started: no monitor
+    router = Router(rset, policy="round_robin", disagg="off",
+                    kvtier="off", slots_hint=SLOTS,
+                    max_inflight_per_replica=2 * SLOTS)
+    assert router.caplens is not None, "lens did not attach"
+    lens = router.caplens
+    router.start()
+    # the serving work: the kvtier leg's admission regime (paged KV,
+    # block-aligned store-resident prompt => full hit, near-zero
+    # prefill compute — the worst counter-to-work ratio)
+    cfg = gpt.GPTConfig(block_size=64, vocab_size=512, n_layer=4,
+                        n_head=4, n_embd=256)
+    prepared = gpt.prepare_stacked(gpt.init(jax.random.PRNGKey(0), cfg),
+                                   cfg)
+    srv = ContinuousBatcher(cfg, prepared, slots=SLOTS,
+                            max_len=cfg.block_size, prompt_pad=16,
+                            kv="paged", block_len=16, prefix_cache=64)
+    prompt = np.arange(1, 33)
+    rid = srv.submit(prompt, 2)  # seed the store (+ compile programs)
+    srv.drain()
+    srv.claim(rid)
+    # 1200 pairs, double the kvtier/kvlens legs: the lens bill here is
+    # single-digit microseconds against a ~9 ms wall, so the pair-diff
+    # noise needs the larger population to keep the estimate stable
+    # (600-pair runs scattered 1.5-2.0% around the same code)
+    n = 1200
+    seq = []
+    try:
+        for i in range(2 * n):
+            on = _abba_on(i)
+            obs.set_enabled(on)
+            t0 = time.perf_counter()
+            lens.on_arrival(len(prompt), scenario="gen")
+            target = router._admit("decode", None, set())
+            r = srv.submit(prompt, 2)
+            t1 = time.perf_counter()
+            lens.on_commit(target.name, role=target.role, tokens=2,
+                           wall_s=t1 - t0, inflight_at_dispatch=0)
+            dt = time.perf_counter() - t0
+            seq.append((on, dt))
+            srv.cancel(r)
+    finally:
+        obs.set_enabled(was)
+    overhead, med_on, med_off = _paired_overhead(seq)
+    return {
+        "caplens_admit_overhead_frac": overhead,
+        "caplens_admit_ms_on": round(med_on * 1e3, 4),
+        "caplens_admit_ms_off": round(med_off * 1e3, 4),
+        "caplens_admissions_per_population": n,
+        # receipts: the ON population really fed the observatory
+        "caplens_arrivals": lens.arrivals_total,
+        "caplens_commits": lens.commits_total,
+        "caplens_service_samples": len(lens._planning_services()),
+    }
+
+
 def _measure_steps(srv) -> dict:
     from dnn_tpu import obs
     from dnn_tpu.obs.timeline import StepClock
@@ -445,6 +537,16 @@ def main(argv=None) -> int:
         if "--assert" in args and not row["ok"]:
             print(f"FAIL: kvlens admission obs overhead "
                   f"{row['kvlens_admit_overhead_frac'] * 100:.2f}% "
+                  f">= 2% budget", file=sys.stderr)
+            return 1
+        return 0
+    if "--caplens" in args:
+        row = measure_caplens()
+        row["ok"] = row["caplens_admit_overhead_frac"] < 0.02
+        print(json.dumps(row), flush=True)
+        if "--assert" in args and not row["ok"]:
+            print(f"FAIL: caplens admission obs overhead "
+                  f"{row['caplens_admit_overhead_frac'] * 100:.2f}% "
                   f">= 2% budget", file=sys.stderr)
             return 1
         return 0

@@ -565,6 +565,7 @@ def dev_obs_overhead():
     # layer's contract is < 2% (ISSUE 3); `ok` records the verdict.
     from benchmarks.obs_overhead_probe import (
         measure,
+        measure_caplens,
         measure_kvlens,
         measure_kvtier,
     )
@@ -587,14 +588,23 @@ def dev_obs_overhead():
     kl_overhead = kl.pop("kvlens_admit_overhead_frac")
     row.update(kl)
     row["kvlens_admit_overhead_pct"] = round(kl_overhead * 100, 2)
+    # the caplens leg (ISSUE 20): the router admission wall with the
+    # capacity observatory LIVE — arrival ring + dispersion window +
+    # conditioned service reservoir in the ON population; same contract
+    cl = measure_caplens()
+    cl_overhead = cl.pop("caplens_admit_overhead_frac")
+    row.update(cl)
+    row["caplens_admit_overhead_pct"] = round(cl_overhead * 100, 2)
     _emit(results, config="obs_overhead", metric="overhead_pct",
           value=round(overhead * 100, 2), platform=_platform(),
           ok=bool(overhead < 0.02 and kv_overhead < 0.02
-                  and kl_overhead < 0.02),
+                  and kl_overhead < 0.02 and cl_overhead < 0.02),
           note="serving decode step, obs on (traced) vs off, per-step "
                "interleave; + kvtier radix-admission leg "
                "(per-admission interleave); + kvlens reuse-distance "
-               "leg (tracker live on admission); contract < 2% on all",
+               "leg (tracker live on admission); + caplens router-"
+               "admission leg (demand estimator live); contract < 2% "
+               "on all",
           **row)
     return results
 
@@ -869,6 +879,48 @@ def dev_kv_economy():
                f"{MRC_ERROR_CEIL} absolute; thrash refetches > 0 "
                "required at the pressured capacity",
           mrc_prediction_error=err, **row)
+    return results
+
+
+@device_config("capacity_plan")
+def dev_capacity_plan():
+    # ISSUE 20: caplens's what-if planner validated against ground
+    # truth — observe a 1-replica fleet under the seeded bursty trace,
+    # take the lens's 2-replica prediction, then measure a REAL
+    # 2-replica fleet replaying the identical trace. Floors:
+    # |predicted − measured| availability <= PRED_ERROR_CEIL, wall-p95
+    # ratio inside the documented bound, cold-start ledger coverage >=
+    # 95% of spawn→first-token wall with compile as its own bucket,
+    # zero silent losses. Honors --require-substrate via
+    # $DNN_TPU_REQUIRE_SUBSTRATE.
+    from benchmarks.capacity_plan_probe import (
+        COLDSTART_COVERAGE_FLOOR,
+        PRED_ERROR_CEIL,
+        WAIT_RATIO_BOUND,
+        measure,
+    )
+
+    results = []
+    row = measure()
+    ok = row.pop("ok")
+    row.pop("coldstart_entries", None)  # per-spawn detail: JSONL bloat
+    require = os.environ.get("DNN_TPU_REQUIRE_SUBSTRATE")
+    note = (f"1-replica observations predict the 2-replica fleet on "
+            f"the identical seeded trace; floors: abs(pred-measured) "
+            f"availability <= {PRED_ERROR_CEIL}, wall-p95 ratio <= "
+            f"{WAIT_RATIO_BOUND}x, cold-start coverage >= "
+            f"{COLDSTART_COVERAGE_FLOOR:.0%} with compile bucketed, "
+            "zero silent losses")
+    if require:
+        row["required_substrate"] = require
+        if row.get("round_substrate") != require:
+            ok = False
+            note += (f"; required substrate '{require}' but the probe "
+                     f"ran on '{row.get('round_substrate')}'")
+    err = row.pop("value")
+    _emit(results, config="capacity_plan",
+          metric="capacity_prediction_error", value=err, ok=ok,
+          note=note, **row)
     return results
 
 

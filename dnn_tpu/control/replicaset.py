@@ -69,6 +69,12 @@ class ReplicaHandle:
         self.state = "idle"  # idle|warming|serving|draining|dead
         self._lock = threading.Lock()
         self._health_fails = 0
+        # lifecycle stamps: every transition event carries its duration
+        # (spawn->ready, ready->drain), so the cold-start ledger
+        # (obs/caplens) and a future autoscaler read ONE event stream
+        self.t_spawn: Optional[float] = None
+        self.t_ready: Optional[float] = None
+        self._caplens = None  # set by ReplicaSet.attach_caplens
 
     # -- lifecycle entry points (ReplicaSet/monitor-thread callers) ----
 
@@ -80,9 +86,14 @@ class ReplicaHandle:
             if self.state != "idle":
                 return
             self.state = "warming"
+        self.t_spawn = time.monotonic()
+        self.t_ready = None
         flight.record("replica_spawn", replica=self.name,
                       role=self.role, address=self.address,
                       supervised=self.supervisor is not None)
+        lens = self._caplens
+        if lens is not None:
+            lens.spawn_begin(self.name, self.role, now=self.t_spawn)
         if self.supervisor is not None:
             self.supervisor.start()
 
@@ -97,7 +108,13 @@ class ReplicaHandle:
             if self.state != "serving":
                 return False
             self.state = "draining"
-        flight.record("replica_drain", replica=self.name)
+        t = time.monotonic()
+        flight.record("replica_drain", replica=self.name,
+                      served_s=round(t - self.t_ready, 3)
+                      if self.t_ready is not None else None)
+        lens = self._caplens
+        if lens is not None:
+            lens.spawn_gone(self.name)
         if self.obs_url is None:
             return False
         try:
@@ -122,21 +139,40 @@ class ReplicaHandle:
         with self._lock:
             prev, self.state = self.state, "serving"
         if prev != "serving":
+            t = time.monotonic()
+            self.t_ready = t
             flight.record("replica_ready", replica=self.name,
-                          role=self.role)
+                          role=self.role,
+                          spawn_to_ready_s=round(t - self.t_spawn, 3)
+                          if self.t_spawn is not None else None)
+            lens = self._caplens
+            if lens is not None:
+                lens.spawn_ready(self.name, now=t)
 
     def _mark_dead(self, reason: str):
         with self._lock:
             prev, self.state = self.state, "dead"
         if prev != "dead":
+            t = time.monotonic()
             flight.record("replica_dead", replica=self.name,
-                          was=prev, reason=reason)
+                          was=prev, reason=reason,
+                          alive_s=round(t - self.t_spawn, 3)
+                          if self.t_spawn is not None else None)
+            lens = self._caplens
+            if lens is not None:
+                lens.spawn_gone(self.name)
 
     def _mark_respawning(self):
         with self._lock:
             prev, self.state = self.state, "warming"
         if prev != "warming":
+            self.t_spawn = time.monotonic()
+            self.t_ready = None
             flight.record("replica_respawn", replica=self.name)
+            lens = self._caplens
+            if lens is not None:
+                lens.spawn_begin(self.name, self.role,
+                                 now=self.t_spawn)
 
     # -- health --------------------------------------------------------
 
@@ -197,6 +233,30 @@ class ReplicaSet:
                 poll_traces=False)
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        self.caplens = None
+
+    def attach_caplens(self, lens):
+        """Wire the capacity observatory (obs/caplens) into the
+        lifecycle seams: every handle's spawn/ready/drain transition
+        feeds the cold-start ledger, and the lens reads each child's
+        boot/compile gauges through this set's collector (its default
+        `signals` source, unless the lens already has one)."""
+        self.caplens = lens
+        for r in self.replicas.values():
+            r._caplens = lens
+            if lens is None:
+                continue
+            # backfill spawns that predate the lens (the usual order:
+            # fleet starts, THEN the router builds its lens) — the
+            # handles' stamps keep the walls honest
+            if r.t_spawn is not None and r.state in ("warming",
+                                                     "serving"):
+                lens.spawn_begin(r.name, r.role, now=r.t_spawn)
+                if r.t_ready is not None:
+                    lens.spawn_ready(r.name, now=r.t_ready)
+        if lens is not None and lens._signals is None \
+                and self.collector is not None:
+            lens._signals = self.collector.boot_signals
 
     # -- lifecycle -----------------------------------------------------
 

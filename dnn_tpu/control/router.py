@@ -188,6 +188,23 @@ class Router:
         self._affinity_cap = int(affinity_cap)
         self._handle_seq = itertools.count()
         self.shed_total = 0
+        # the capacity observatory (obs/caplens): demand from this
+        # router's admission seam, capacity from its commits, the
+        # cold-start ledger from the replicaset's lifecycle seams.
+        # One lens per router; every hook below guards with one
+        # `lens is not None` test (the kvlens overhead contract).
+        self.caplens = None
+        m = obs.metrics()
+        if m is not None:
+            from dnn_tpu.obs.caplens import CapLens
+
+            self.caplens = CapLens(
+                slots_per_replica=self.slots_hint,
+                max_inflight=self.max_inflight,
+                deadline_s=self.default_deadline_s)
+            replicaset.attach_caplens(self.caplens)
+            for k, fn in self.caplens.prom_gauges().items():
+                m.set_fn(k, fn)
         self._install_gauges()
 
     @property
@@ -209,6 +226,9 @@ class Router:
 
     def _note_shed(self, reason: str):
         self.shed_total += 1
+        lens = self.caplens
+        if lens is not None:
+            lens.on_shed(reason)
         m = obs.metrics()
         if m is not None:
             m.inc(labeled("dnn_tpu_router_shed_total", reason=reason))
@@ -269,6 +289,16 @@ class Router:
             r = ref()
             if r is None:
                 return 0.0
+            # v2 (obs/caplens): the audited what-if planner's verdict,
+            # when it has evidence; the v1 occupancy heuristic until
+            # then (and whenever obs is off)
+            lens = r.caplens
+            if lens is not None:
+                n_live = sum(1 for v in r._views()
+                             if v.state == "serving")
+                w = lens.wanted_replicas(n_live=n_live)
+                if w is not None:
+                    return float(w)
             return float(wanted_replicas(
                 r._views(), slots_hint=r.slots_hint,
                 shedding=r.state == "shedding"))
@@ -540,11 +570,25 @@ class Router:
                 kv_loc = None
             client = self._client(target)
             try:
+                # capacity signal: inflight BEFORE this dispatch — a
+                # commit that rode a free slot is pure service time,
+                # one that queued behind a full batch is not, and the
+                # caplens planner must not learn the queue it simulates
+                infl0 = self._inflight.get(target.name, 0)
+                t_fwd = time.monotonic()
                 with self._track(target.name):
                     status, result = await asyncio.to_thread(
                         client.send_tensor, arr, request_id=rid,
                         timeout=max(remaining, 0.001), retries=0)
                 self._count("ok")
+                lens = self.caplens
+                if lens is not None:
+                    lens.on_commit(
+                        target.name, role=target.role,
+                        tokens=int(result.size)
+                        if result is not None else 0,
+                        wall_s=time.monotonic() - t_fwd,
+                        inflight_at_dispatch=infl0)
                 if kv_gen:
                     # feed the directory: this replica now holds the
                     # prompt's blocks (admission inserted the path)
@@ -736,6 +780,11 @@ class Router:
             await context.abort(grpc.StatusCode.DATA_LOSS, str(e))
         rid = request.request_id or ""
         rid_clean = _tx.strip_deadline(obs.strip_wire_tag(rid))
+        lens = self.caplens
+        if lens is not None:
+            lens.on_arrival(arr.size if arr is not None else 0,
+                            scenario=rid_clean.split(":", 1)[0]
+                            or "other")
         if rid_clean == "prefill" or rid_clean.startswith("prefill:"):
             return await self._forward_unary(arr, rid, context,
                                              need="prefill")
@@ -771,6 +820,10 @@ class Router:
         except PayloadCorruptError as e:
             await context.abort(grpc.StatusCode.DATA_LOSS, str(e))
         rid = request.request_id or ""
+        lens = self.caplens
+        if lens is not None:
+            lens.on_arrival(arr.size if arr is not None else 0,
+                            scenario="stream")
         budget = self._budget(rid)
         kv_gen = self._kv_is_gen(rid, arr, "decode")
         kv_prefer, kv_loc = self._kv_locate(rid, arr, "decode") \
@@ -805,15 +858,25 @@ class Router:
                 except BaseException as e:  # noqa: BLE001 — surfaced
                     loop.call_soon_threadsafe(q.put_nowait, ("err", e))
 
+        infl0 = self._inflight.get(target.name, 0)
+        t_fwd = time.monotonic()
+        n_resp = 0
         threading.Thread(target=pump, daemon=True,
                          name="router-stream-pump").start()
         try:
             while True:
                 kind, val = await q.get()
                 if kind == "resp":
+                    n_resp += 1
                     yield val
                 elif kind == "done":
                     self._count("ok")
+                    if lens is not None:
+                        lens.on_commit(
+                            target.name, role=target.role,
+                            tokens=n_resp,
+                            wall_s=time.monotonic() - t_fwd,
+                            inflight_at_dispatch=infl0)
                     return
                 else:
                     self._count("error")
@@ -910,6 +973,7 @@ async def serve_router(replicaset: ReplicaSet, *, port: int,
         srv = obs.serve_metrics(
             metrics_port, status=router.statusz,
             fleet=replicaset.collector,
+            caplens=router.caplens,
             healthy=lambda: not router._draining
             and bool(replicaset.serving()))
     server = grpc.aio.server(options=_tx.GRPC_MSG_OPTIONS)

@@ -209,7 +209,7 @@ def install_compile_telemetry() -> bool:
 def serve_metrics(port: int = 0, host: str = "127.0.0.1", *,
                   healthy=None, status=None, profiler=None, fleet=None,
                   drain=None, stepclock=None, kvlens=None,
-                  trainlens=None):
+                  trainlens=None, caplens=None):
     """Start the observability HTTP endpoint on a daemon thread; returns
     the MetricsHTTPServer (`.port` for port=0 ephemeral binds,
     `.close()` to stop; loopback by default — pass host="0.0.0.0" to
@@ -234,7 +234,10 @@ def serve_metrics(port: int = 0, host: str = "127.0.0.1", *,
     after the endpoint comes up). `trainlens` (an
     obs.trainlens.TrainClock) additionally serves the training-step
     observatory on /trainz (JSON; ?format=prom|trace) — the training
-    counterpart of /stepz. See obs/http.py."""
+    counterpart of /stepz. `caplens` (an obs.caplens.CapLens)
+    additionally serves the capacity observatory on /capz (JSON;
+    ?format=prom) — serve_router passes its router's lens. See
+    obs/http.py."""
     from dnn_tpu.obs.http import MetricsHTTPServer
     from dnn_tpu.obs.mem import install_memory_gauges
 
@@ -247,4 +250,4 @@ def serve_metrics(port: int = 0, host: str = "127.0.0.1", *,
                              status=status, profiler=profiler or None,
                              fleet=fleet, drain=drain,
                              stepclock=stepclock, kvlens=kvlens,
-                             trainlens=trainlens)
+                             trainlens=trainlens, caplens=caplens)

@@ -1,5 +1,28 @@
 """CLI: `python -m dnn_tpu.obs {trace,flight,fleet,timeline,incident,
-kvlens,trainlens} ...` — obs tooling.
+kvlens,trainlens,caplens} ...` — obs tooling.
+
+    python -m dnn_tpu.obs caplens --url http://host:port
+        Fetch a running router's /capz (the capacity observatory,
+        obs/caplens.py) and print the demand window (arrival rate,
+        burstiness, per-scenario tokens), the learned per-role service
+        distribution, the cold-start ledger (spawn->first-token p50
+        with process-start/weight-load/compile/warmup buckets and
+        coverage), the what-if plans at 1/2/4 replicas, and the
+        audited wanted-replicas verdict. --json for the raw dict.
+
+    python -m dnn_tpu.obs caplens PATH
+        Render a saved /capz JSON dump (a `curl .../capz > capz.json`
+        capture) with the same table — post-mortems read dumps, not
+        live servers.
+
+    python -m dnn_tpu.obs caplens --selftest
+        In-process smoke: hand-computed planner goldens on an injected
+        clock (1 replica shed-bound at 0.50 availability, 2 warm at
+        1.00, bit-identical replay, cold-start debt priced), the
+        audited 1->2 wanted transition, demand-window arithmetic,
+        cold-start bucket attribution, gate-off-records-nothing, and
+        the /capz endpoint in both formats; exit 0 on success. Tier-1
+        wired (tests/test_obs_caplens.py).
 
     python -m dnn_tpu.obs trainlens --url http://host:port
         Fetch a running trainer's /trainz (the training-step
@@ -645,6 +668,184 @@ def _kvlens_path(path: str, as_json: bool) -> int:
     return 0
 
 
+def _caplens_selftest() -> int:
+    """Deterministic CapLens end to end: planner replay goldens on an
+    injected clock (hand-computed shed/availability at 1 and 2
+    replicas), bit-identical replay, demand-window arithmetic,
+    cold-start bucket attribution, the audit trail, the gate, and the
+    /capz endpoint in both formats."""
+    from urllib.request import urlopen
+
+    from dnn_tpu import obs
+    from dnn_tpu.obs.caplens import CapLens, CapSLO
+
+    obs.set_enabled(True)
+    t = [0.0]
+    clock = lambda: t[0]  # noqa: E731
+
+    def build(seed=0):
+        lens = CapLens(slots_per_replica=1, max_inflight=1,
+                       deadline_s=2.0, seed=seed, window_s=60.0,
+                       slo=CapSLO(availability=0.9), now=clock)
+        # 20 arrivals 0.25 s apart; 10 committed forwards of exactly
+        # 0.5 s on a free slot — the learned service CDF is a spike
+        for i in range(20):
+            t[0] = i * 0.25
+            lens.on_arrival(8, scenario="gen")
+        for i in range(10):
+            t[0] = 5.0 + i * 0.1
+            lens.on_commit("r0", role="both", tokens=24, wall_s=0.5,
+                           inflight_at_dispatch=0)
+        return lens
+
+    lens = build()
+    # -- planner golden, 1 replica: service 0.5 s, arrivals 0.25 s
+    # apart, in-system bound 1 => exactly every other arrival sheds
+    p1 = lens.plan(1)
+    assert p1["availability"] == 0.5 and p1["shed_frac"] == 0.5, p1
+    assert p1["ttft_p95_s"] == 0.5 and p1["wait_p95_s"] == 0.0, p1
+    # -- 2 warm replicas: alternate servers, no queue, no shed
+    p2 = lens.plan(2, warm=2)
+    assert p2["availability"] == 1.0 and p2["shed_frac"] == 0.0, p2
+    # -- replay determinism: same ring + reservoir => bit-identical
+    assert lens.plan(1) == p1 and build().plan(1) == p1
+    # -- cold replica priced: default cold delay exceeds the trace
+    # span, so plan(2, warm=1) cannot reach the warm-pair verdict
+    p2c = lens.plan(2, warm=1)
+    assert p2c["cold"] == 1 and p2c["coldstart_debt_s"] > 0.0
+    assert p2c["availability"] < p2["availability"], (p2c, p2)
+    # -- wanted: 1 replica misses the 0.9 SLO, 2 warm meet it; the
+    # transition lands in the audit trail with its decision inputs
+    t[0] = 6.0
+    w = lens.wanted_replicas(n_live=2)
+    assert w == 2, w
+    audit = list(lens._audit)
+    assert audit and audit[-1]["to"] == 2 \
+        and audit[-1]["plans"][0]["meets_slo"] is False, audit
+    # -- demand-window arithmetic: 20 arrivals in 60 s, steady trace
+    d = lens.demand()
+    assert d["arrivals"] == 20 and abs(
+        d["rate_hz"] - 20 / 60.0) < 1e-3, d
+    assert d["change_point"] is False and d["peak_to_mean"] is not None
+    assert d["scenarios"]["gen"]["count"] == 20, d["scenarios"]
+    # -- queued commits stay OUT of the planning reservoir
+    t[0] = 7.0
+    lens.on_commit("r0", role="both", tokens=24, wall_s=3.0,
+                   inflight_at_dispatch=5)
+    assert lens._queued_commits == 1 and lens.plan(1) == p1
+    # -- cold-start bucket attribution (child-measured signals)
+    cl = CapLens(now=clock, settle_s=1.0, signals=lambda name: {
+        "boot_imports_s": 3.0, "boot_weight_load_s": 1.0,
+        "compile_seconds_total": 2.5, "boot_compile_preready_s": 0.5,
+        "boot_ready_total_s": 4.5})
+    t[0] = 0.0
+    cl.spawn_begin("r0", "both")
+    t[0] = 5.0
+    cl.spawn_ready("r0")
+    t[0] = 10.0
+    cl.on_commit("r0", tokens=24, wall_s=2.4, inflight_at_dispatch=0)
+    t[0] = 12.0
+    cs = cl.coldstart()
+    e = cs["entries"][0]
+    # total 10; ready_total 4.5; post-ready compile 2.0; warmup =
+    # 10 - 4.5 - 2.0 = 3.5; coverage (3+1+2.5+3.5)/10 = 1.0
+    assert e["total_s"] == 10.0 and e["buckets"]["warmup_s"] == 3.5, e
+    assert e["coverage"] == 1.0 and cs["finalized"] == 1, cs
+    assert any(ev["kind"] == "coldstart"
+               for ev in cl.ledger.events()), cl.ledger.events()
+    # -- gate off records NOTHING
+    obs.set_enabled(False)
+    try:
+        off = CapLens(now=clock)
+        off.on_arrival(8)
+        off.on_shed("saturated")
+        off.on_commit("r0", tokens=4, wall_s=0.1)
+        off.spawn_begin("r0")
+        assert off.arrivals_total == 0 and off.commits_total == 0
+        assert not off._pending and len(off.ledger) == 0
+    finally:
+        obs.set_enabled(True)
+    # -- /capz endpoint, both formats ---------------------------------
+    srv = obs.serve_metrics(0, caplens=lens)
+    try:
+        base = f"http://127.0.0.1:{srv.port}/capz"
+        z = json.loads(urlopen(base, timeout=10).read().decode())
+        assert z["demand"]["arrivals_total"] == 20, z["demand"]
+        assert z["wanted_replicas"] == 2, z["wanted_replicas"]
+        assert any(p["n"] == 1 for p in z["plans"]), z["plans"]
+        prom = urlopen(base + "?format=prom",
+                       timeout=10).read().decode()
+        assert "dnn_tpu_caplens_arrival_rate_hz" in prom
+        assert 'dnn_tpu_caplens_plan_availability{n="2"}' in prom
+    finally:
+        srv.close()
+    print("caplens selftest ok: planner goldens (1 replica 0.50 avail "
+          "shed-bound, 2 warm 1.00, bit-identical replay, cold debt "
+          "priced), wanted 1->2 audited, demand window 0.333 Hz, "
+          "cold-start buckets 3.0/1.0/2.5/3.5 cover 100%, gate off "
+          "silent, /capz json+prom served")
+    return 0
+
+
+def _caplens_render(z: dict) -> None:
+    cfg = z.get("config", {})
+    d = z.get("demand", {})
+    print(f"slots/replica {cfg.get('slots_per_replica')} x inflight "
+          f"bound {cfg.get('max_inflight_per_replica')} | deadline "
+          f"{cfg.get('deadline_s')}s | slo {cfg.get('slo')}")
+    print(f"demand: {d.get('rate_hz')} Hz over {d.get('window_s')}s "
+          f"({d.get('arrivals')} arrivals; total "
+          f"{d.get('arrivals_total')}) | dispersion "
+          f"{d.get('index_of_dispersion')} peak/mean "
+          f"{d.get('peak_to_mean')} change_point "
+          f"{d.get('change_point')}")
+    print(f"tokens/s: prefill-in {d.get('prefill_tokens_per_s')} "
+          f"committed {d.get('committed_tokens_per_s')} | scenarios "
+          f"{d.get('scenarios')}")
+    cap = z.get("capacity", {})
+    print(f"capacity: service {cap.get('service_by_role')} | "
+          f"tokens/s by replica {cap.get('tokens_per_s_by_replica')} "
+          f"| cold-start price {cap.get('coldstart_delay_s')}s")
+    cs = z.get("coldstart", {})
+    print(f"cold-start: {cs.get('finalized')}/{cs.get('spawns')} "
+          f"spawns finalized, p50 {cs.get('total_p50_s')}s, buckets "
+          f"p50 {cs.get('buckets_p50_s')}, coverage "
+          f"{cs.get('coverage_mean')}")
+    plans = z.get("plans") or []
+    if plans:
+        print(f"{'n':>3} {'avail':>7} {'shed':>7} {'wait_p95':>9} "
+              f"{'ttft_p95':>9} {'cold_debt':>10}")
+        for p in plans:
+            print(f"{p['n']:>3} {p['availability']:>7.3f} "
+                  f"{p['shed_frac']:>7.3f} {p['wait_p95_s']:>8.3f}s "
+                  f"{p['ttft_p95_s']:>8.3f}s "
+                  f"{p['coldstart_debt_s']:>9.3f}s")
+    print(f"wanted_replicas: {z.get('wanted_replicas')} "
+          f"({len(z.get('audit') or [])} audited transitions shown)")
+
+
+def _caplens_url(url: str, as_json: bool) -> int:
+    from urllib.request import urlopen
+
+    z = json.loads(urlopen(url.rstrip("/") + "/capz",
+                           timeout=10).read().decode())
+    if as_json:
+        print(json.dumps(z, indent=2, default=str))
+    else:
+        _caplens_render(z)
+    return 0
+
+
+def _caplens_path(path: str, as_json: bool) -> int:
+    with open(path) as f:
+        z = json.load(f)
+    if as_json:
+        print(json.dumps(z, indent=2, default=str))
+    else:
+        _caplens_render(z)
+    return 0
+
+
 def _trainlens_selftest() -> int:
     """Deterministic trainlens end to end: hand-computed phase/stall/
     MFU goldens on an injected clock, checkpoint staleness arithmetic,
@@ -939,6 +1140,20 @@ def main(argv=None) -> int:
     tn.add_argument("--last", type=int, default=None,
                     help="bound the /trainz window to the newest N "
                          "steps")
+    cp = sub.add_parser("caplens", help="capacity observatory: /capz "
+                        "fetch — demand window, cold-start ledger, "
+                        "what-if replica plans (obs/caplens.py)")
+    cp.add_argument("path", nargs="?", default=None,
+                    help="saved /capz JSON dump to render")
+    cp.add_argument("--selftest", action="store_true",
+                    help="in-process smoke (planner goldens, replay "
+                         "determinism, cold-start buckets, /capz); "
+                         "exit 0 on pass")
+    cp.add_argument("--url", default=None,
+                    help="obs endpoint base URL to fetch /capz from")
+    cp.add_argument("--json", action="store_true",
+                    help="print the raw /capz dict instead of the "
+                         "table")
     args = ap.parse_args(argv)
 
     if args.cmd == "trace":
@@ -994,6 +1209,15 @@ def main(argv=None) -> int:
             return _trainlens_path(args.path, args.json)
         ap.error("trainlens needs --selftest, --url URL, or a saved "
                  "/trainz JSON PATH")
+    if args.cmd == "caplens":
+        if args.selftest:
+            return _caplens_selftest()
+        if args.url:
+            return _caplens_url(args.url, args.json)
+        if args.path:
+            return _caplens_path(args.path, args.json)
+        ap.error("caplens needs --selftest, --url URL, or a saved "
+                 "/capz JSON PATH")
     return 2
 
 

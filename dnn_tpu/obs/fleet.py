@@ -573,18 +573,18 @@ class FleetCollector:
                     counts[tid] += 1
         tids = [t for t, _ in
                 sorted(counts.items(), key=lambda kv: -kv[1])]
-        # wanted_replicas transition detection (first non-None across
-        # targets — one router per fleet view, matching the fleetz
-        # rollup). Outside the lock for the fetch, inside for the
-        # history append; the flight record self-gates on the obs env.
+        # wanted_replicas transition detection (explicit MAX across
+        # targets, matching the fleetz rollup — a multi-router fleet
+        # provisions for its hungriest front door). Outside the lock
+        # for the fetch, inside for the history append; the flight
+        # record self-gates on the obs env.
         wanted = None
         for snap in results.values():
             if snap.get("metrics") is not None:
                 v = _Samples(snap["metrics"]).get(
                     "dnn_tpu_wanted_replicas")
-                if v is not None:
+                if v is not None and (wanted is None or v > wanted):
                     wanted = v
-                    break
         with self._lock:
             self._snaps.update(results)
             self._offsets = offs
@@ -650,6 +650,30 @@ class FleetCollector:
                 worst = st
         return {"state": _STATE_AS_WATCHDOG[worst], "fleet_state": worst,
                 "components": comps, "t": time.time()}
+
+    def boot_signals(self, name: str) -> dict:
+        """Raw boot/compile samples for one target — the caplens
+        cold-start ledger's `signals` source (obs/caplens): the child
+        measures its own boot (node.py `dnn_tpu_boot_*` gauges + the
+        compile-telemetry counter), this collector scrapes it, the
+        lens attributes the spawn->first-token wall. Empty dict while
+        the target has no successful poll yet."""
+        with self._lock:
+            snap = self._snaps.get(name)
+        if snap is None or snap.get("metrics") is None:
+            return {}
+        s = _Samples(snap["metrics"])
+        return {
+            "compile_seconds_total":
+                s.sum("jax_compile_seconds_total"),
+            "boot_imports_s": s.get("dnn_tpu_boot_imports_seconds"),
+            "boot_weight_load_s":
+                s.get("dnn_tpu_boot_weight_load_seconds"),
+            "boot_compile_preready_s":
+                s.get("dnn_tpu_boot_compile_preready_seconds"),
+            "boot_ready_total_s":
+                s.get("dnn_tpu_boot_ready_total_seconds"),
+        }
 
     def spans_by_stage(self) -> Dict[str, List[dict]]:
         with self._lock:
@@ -798,6 +822,26 @@ class FleetCollector:
         sheds = s.sum("dnn_tpu_router_shed_total")
         if sheds is not None:
             row["shed_total"] = sheds
+        # capacity series (obs/caplens.py on a router target) + the
+        # per-replica cold-start evidence (node.py boot gauges,
+        # obs/compile_watch compile counter) the ledger attributes from
+        for fam, key in (
+                ("dnn_tpu_caplens_arrival_rate_hz", "caplens_rate_hz"),
+                ("dnn_tpu_caplens_peak_to_mean", "caplens_peak_to_mean"),
+                ("dnn_tpu_caplens_coldstart_p50_seconds",
+                 "coldstart_p50_s"),
+                ("dnn_tpu_caplens_coldstart_coverage",
+                 "coldstart_coverage"),
+                ("dnn_tpu_boot_imports_seconds", "boot_imports_s"),
+                ("dnn_tpu_boot_weight_load_seconds",
+                 "boot_weight_load_s"),
+                ("jax_compile_seconds_total", "compile_seconds")):
+            v = s.get(fam)
+            if v is not None:
+                row[key] = v
+        v = s.get("dnn_tpu_caplens_plan_availability", n="2")
+        if v is not None:
+            row["caplens_plan2_availability"] = v
         return row
 
     def fleetz(self) -> dict:
@@ -827,11 +871,19 @@ class FleetCollector:
                 "stages_total": len(self.targets),
                 "stages_ok": sum(1 for r in stages.values()
                                  if r["state"] == "ok"),
-                # the autoscaling signal (a router target exports it;
-                # first non-None wins — one router per fleet view)
-                "wanted_replicas": next(
+                # the autoscaling signal: explicit MAX across router
+                # targets (a multi-front-door fleet must provision for
+                # its hungriest router, and "first non-None" depended
+                # on dict order) — the per-stage map keeps each
+                # router's own verdict visible
+                "wanted_replicas": max(
                     (r["wanted_replicas"] for r in stages.values()
-                     if r.get("wanted_replicas") is not None), None),
+                     if r.get("wanted_replicas") is not None),
+                    default=None),
+                "wanted_replicas_by_stage": {
+                    name: r["wanted_replicas"]
+                    for name, r in stages.items()
+                    if r.get("wanted_replicas") is not None} or None,
                 # the signal's recent history: one {"t", "v"} point per
                 # TRANSITION observed by this collector (bounded; the
                 # flight ring holds the same changes as events)
@@ -888,7 +940,12 @@ class FleetCollector:
                         "kvlens_pred_1x", "kvlens_pred_2x",
                         "kvlens_pred_4x", "kvlens_thrash_chunk_s",
                         "train_mfu", "train_data_stall",
-                        "train_tokens_per_sec", "ckpt_staleness"):
+                        "train_tokens_per_sec", "ckpt_staleness",
+                        "wanted_replicas", "caplens_rate_hz",
+                        "caplens_peak_to_mean", "coldstart_p50_s",
+                        "coldstart_coverage", "compile_seconds",
+                        "boot_imports_s", "boot_weight_load_s",
+                        "caplens_plan2_availability"):
                 if row.get(key) is not None:
                     m.set(labeled(f"dnn_tpu_fleet_stage_{key}",
                                   stage=name), row[key])

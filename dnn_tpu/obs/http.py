@@ -51,6 +51,13 @@ Prometheus scraper or a plain curl can watch the serving stack:
                        counts, thrash pricing, and the bounded
                        per-block ledger tail (JSON; ?format=prom
                        re-renders the curve + thrash as gauges)
+    GET  /capz         capacity observatory (obs/caplens.py) when a
+                       CapLens is attached: windowed demand (rate,
+                       burstiness, change points), learned per-role
+                       service capacity, the cold-start ledger, what-if
+                       plans at 1/2/4 replicas, the wanted-replicas
+                       audit trail (JSON; ?format=prom re-renders the
+                       headline series as gauges)
     GET  /trace        Chrome-trace JSON of collected spans; ?id=<trace>
                        filters to one request's tree (load the response
                        in Perfetto / chrome://tracing)
@@ -123,7 +130,8 @@ class MetricsHTTPServer:
                  status: Optional[Callable[[], dict]] = None,
                  profiler=None, flight=None, fleet=None,
                  drain: Optional[Callable[[], dict]] = None,
-                 stepclock=None, kvlens=None, trainlens=None):
+                 stepclock=None, kvlens=None, trainlens=None,
+                 caplens=None):
         from dnn_tpu import obs
         from dnn_tpu.obs import flight as _flight
         from dnn_tpu.utils import metrics as _metrics
@@ -154,6 +162,8 @@ class MetricsHTTPServer:
         self._kvlens = kvlens
         # training-step clock (obs/trainlens.TrainClock): serves /trainz
         self._trainlens = trainlens
+        # capacity observatory (obs/caplens.CapLens): serves /capz
+        self._caplens = caplens
         if fleet is not None and status is None:
             self._status = fleet.status
         outer = self
@@ -303,6 +313,22 @@ class MetricsHTTPServer:
                                "(json|prom)\n",
                                "text/plain; charset=utf-8")
 
+            def _capz(self, q):
+                if outer._caplens is None:
+                    self._send(404, "no caplens attached\n",
+                               "text/plain; charset=utf-8")
+                    return
+                fmt = q.get("format", ["json"])[0]
+                if fmt == "json":
+                    self._send_json(200, outer._caplens.summary())
+                elif fmt == "prom":
+                    self._send(200, outer._caplens.render_prom(),
+                               "text/plain; version=0.0.4; charset=utf-8")
+                else:
+                    self._send(400, f"unknown format {fmt!r} "
+                               "(json|prom)\n",
+                               "text/plain; charset=utf-8")
+
             def do_GET(self):
                 try:
                     url = urlparse(self.path)
@@ -362,6 +388,8 @@ class MetricsHTTPServer:
                         self._stepz(q)
                     elif url.path == "/kvz":
                         self._kvz(q)
+                    elif url.path == "/capz":
+                        self._capz(q)
                     elif url.path == "/trainz":
                         self._trainz(q)
                     elif url.path == "/profilez":

@@ -87,6 +87,13 @@ _MODULE_COST_S = {
     # one real forced-eviction batcher feeding the radix-store seams —
     # the CLI subprocess and batcher compile dominate; placed with the
     # other obs modules inside the tier-1 budget
+    "test_obs_caplens": 6.0,  # ISSUE 20 capacity observatory: planner
+    # replay goldens + determinism on an injected clock, demand-window
+    # and change-point arithmetic, cold-start bucket attribution off
+    # the boot gauges, audit-trailed wanted-replicas transitions,
+    # /capz json+prom, the /fleetz wanted-rollup max regression, CLI
+    # selftest, and the replica-handle lifecycle seams — the CLI
+    # subprocess dominates; placed with the other obs modules
     "test_obs_trainlens": 14.0,  # ISSUE 19 training-step observatory:
     # TrainClock phase arithmetic + stall attribution on an injected
     # clock, MFU vs hand arithmetic, GradSentinel NaN/spike/stall

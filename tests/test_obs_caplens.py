@@ -1,0 +1,383 @@
+"""caplens tests (ISSUE 20): the capacity observatory.
+
+The acceptance contract this module pins: the what-if planner's
+discrete-event replay is a hand-checkable golden under an injected
+clock (1 shed-bound replica -> 0.50 availability, a warm pair -> 1.0)
+and bit-identical across lens instances (same ring + reservoir + seed),
+a cold replica prices its spawn->first-token debt into the verdict,
+the demand window's arithmetic (rate, dispersion, change-point) is
+exact on planted arrivals, the cold-start ledger attributes the
+spawn->first-token wall into process-start/weight-load/compile/warmup
+buckets off the child's boot gauges (with the settle_s deferral that
+lets the fleet scrape flush the compile counter), every
+wanted-replicas transition lands in the audit trail with its full
+decision inputs, queued commits stay OUT of the planning reservoir,
+the obs gate makes every producer a no-op, /capz serves JSON and
+Prometheus text, the `python -m dnn_tpu.obs caplens --selftest` CLI
+smoke passes — and the /fleetz wanted-replicas rollup is the explicit
+MAX across stages with a per-stage column (the "first non-None"
+regression this PR fixed)."""
+
+import json
+import subprocess
+import sys
+import urllib.request
+
+import pytest
+
+from dnn_tpu import obs
+from dnn_tpu.obs.caplens import MIN_RING, CapLens, CapSLO
+
+
+@pytest.fixture(autouse=True)
+def _obs_on():
+    was = obs.enabled()
+    obs.set_enabled(True)
+    yield
+    obs.set_enabled(was)
+
+
+def _clock():
+    t = [0.0]
+    return t, (lambda: t[0])
+
+
+def _shed_bound_lens(clock, **kw):
+    """The selftest's golden regime: 1 slot, in-system bound 1,
+    service 0.5 s, arrivals every 0.25 s — every other arrival finds
+    the single slot busy and sheds, so plan(1) is exactly 0.50."""
+    kw.setdefault("slots_per_replica", 1)
+    kw.setdefault("max_inflight", 1)
+    kw.setdefault("deadline_s", 2.0)
+    kw.setdefault("window_s", 60.0)
+    kw.setdefault("slo", CapSLO(availability=0.9))
+    lens = CapLens(now=clock, **kw)
+    for i in range(20):
+        lens.on_arrival(8, scenario="gen", now=i * 0.25)
+    for i in range(10):
+        lens.on_commit("r0", role="both", tokens=4, wall_s=0.5,
+                       inflight_at_dispatch=0, now=i * 0.5)
+    return lens
+
+
+# ----------------------------------------------------------------------
+# the planner: replay goldens, determinism, cold-start debt
+# ----------------------------------------------------------------------
+
+def test_plan_golden_shed_bound():
+    t, clock = _clock()
+    lens = _shed_bound_lens(clock)
+    p1 = lens.plan(1)
+    # arrivals at 0, .25, .5, ...; service exactly .5: the in-flight
+    # request finishes AT the next even arrival (active[0] <= t pops
+    # it), so evens admit and odds shed — half and half, no queueing
+    assert p1["availability"] == 0.5
+    assert p1["shed_frac"] == 0.5
+    assert p1["wait_p95_s"] == 0.0
+    assert p1["ttft_p95_s"] == 0.5
+    assert p1["deadline_frac"] == 0.0
+    # a warm pair has a slot free at every arrival: nothing sheds
+    p2 = lens.plan(2, warm=2)
+    assert p2["availability"] == 1.0 and p2["shed_frac"] == 0.0
+
+
+def test_plan_is_deterministic_across_instances():
+    t1, c1 = _clock()
+    t2, c2 = _clock()
+    a, b = _shed_bound_lens(c1), _shed_bound_lens(c2)
+    assert a.plan(1) == b.plan(1)
+    assert a.plan(2, warm=2) == b.plan(2, warm=2)
+    assert a.plan(3, warm=1) == b.plan(3, warm=1)
+
+
+def test_plan_refuses_below_evidence_floor():
+    t, clock = _clock()
+    lens = CapLens(now=clock)
+    for i in range(MIN_RING - 1):
+        lens.on_arrival(4, now=float(i))
+    lens.on_commit("r0", wall_s=0.1, now=1.0)
+    assert lens.plan(1) is None
+    # the caller's contract: no evidence -> defer to the v1 heuristic
+    assert lens.wanted_replicas(n_live=1) is None
+
+
+def test_cold_replica_pays_coldstart_debt():
+    t, clock = _clock()
+    lens = _shed_bound_lens(clock, coldstart_default_s=3.0)
+    warm = lens.plan(2, warm=2)
+    cold = lens.plan(2, warm=1)
+    assert cold["cold"] == 1
+    assert cold["coldstart_debt_s"] == 3.0
+    # the cold replica's slot is unavailable for the first 3 s of the
+    # 5 s trace: sheds resume there, availability must drop
+    assert cold["availability"] < warm["availability"]
+
+
+# ----------------------------------------------------------------------
+# demand window arithmetic
+# ----------------------------------------------------------------------
+
+def test_demand_window_arithmetic():
+    t, clock = _clock()
+    lens = _shed_bound_lens(clock)
+    t[0] = 20 * 0.25
+    d = lens.demand()
+    assert d["arrivals"] == 20 and d["arrivals_total"] == 20
+    assert d["rate_hz"] == pytest.approx(20 / 60.0, abs=1e-4)
+    assert d["prefill_tokens_per_s"] == pytest.approx(160 / 60.0,
+                                                      abs=0.01)
+    assert d["scenarios"] == {"gen": {"count": 20,
+                                      "prefill_tokens": 160}}
+    # evenly spaced arrivals: no change point, near-uniform buckets
+    assert d["change_point"] is False
+    assert d["index_of_dispersion"] is not None
+    # arrivals older than window_s age out of the window (totals stay)
+    t[0] = 100.0
+    d2 = lens.demand()
+    assert d2["arrivals"] == 0 and d2["arrivals_total"] == 20
+
+
+def test_demand_change_point_fires_on_rate_shift():
+    t, clock = _clock()
+    lens = CapLens(now=clock, window_s=60.0)
+    for i in range(5):                  # sparse early half
+        lens.on_arrival(1, now=float(i))
+    for i in range(30):                 # heavy late half
+        lens.on_arrival(1, now=6.0 + i * 0.1)
+    t[0] = 9.0
+    d = lens.demand()
+    assert d["change_point"] is True
+    assert d["rate_ratio_recent"] > 2.0
+    assert d["peak_to_mean"] > 1.0
+
+
+# ----------------------------------------------------------------------
+# cold-start ledger: bucket attribution off the boot gauges
+# ----------------------------------------------------------------------
+
+_SIGNALS = {"boot_imports_s": 3.0, "boot_weight_load_s": 1.0,
+            "compile_seconds_total": 2.5,
+            "boot_compile_preready_s": 0.5,
+            "boot_ready_total_s": 4.5}
+
+
+def test_coldstart_bucket_attribution():
+    t, clock = _clock()
+    lens = CapLens(now=clock, settle_s=1.0,
+                   signals=lambda name: dict(_SIGNALS))
+    lens.spawn_begin("r0", "both", now=0.0)
+    lens.spawn_ready("r0", now=5.0)
+    lens.on_commit("r0", wall_s=0.4, now=10.0)  # first token
+    t[0] = 12.0  # past settle_s
+    cs = lens.coldstart()
+    assert cs["finalized"] == 1 and cs["pending"] == 0
+    e = cs["entries"][0]
+    assert e["total_s"] == 10.0
+    assert e["spawn_to_ready_s"] == 5.0
+    assert e["buckets"] == {"process_start_s": 3.0,
+                            "weight_load_s": 1.0,
+                            "compile_s": 2.5,
+                            # total - ready_total - post-ready compile
+                            # = 10 - 4.5 - (2.5 - 0.5)
+                            "warmup_s": 3.5}
+    assert e["coverage"] == 1.0
+    assert lens.coldstart_delay_s() == 10.0  # the planner's p50 price
+    kinds = [ev["kind"] for ev in lens.ledger.events()]
+    assert kinds == ["spawn_begin", "spawn_ready", "coldstart"]
+
+
+def test_coldstart_settle_defers_finalize():
+    t, clock = _clock()
+    lens = CapLens(now=clock, settle_s=1.0,
+                   signals=lambda name: dict(_SIGNALS))
+    lens.spawn_begin("r0", now=0.0)
+    lens.on_commit("r0", wall_s=0.1, now=10.0)
+    t[0] = 10.5  # first token seen, but the scrape hasn't settled
+    assert lens.coldstart()["finalized"] == 0
+    assert lens.coldstart()["pending"] == 1
+    t[0] = 11.5
+    assert lens.coldstart()["finalized"] == 1
+
+
+def test_spawn_gone_abandons_unserved_spawn():
+    t, clock = _clock()
+    lens = CapLens(now=clock)
+    lens.spawn_begin("r0", now=0.0)
+    lens.spawn_gone("r0")
+    t[0] = 100.0
+    cs = lens.coldstart()
+    assert cs["finalized"] == 0 and cs["pending"] == 0
+    assert cs["spawns"] == 1
+    assert [ev["kind"] for ev in lens.ledger.events()] \
+        == ["spawn_begin", "spawn_abandoned"]
+
+
+# ----------------------------------------------------------------------
+# wanted_replicas: audit-trailed transitions, replan cache
+# ----------------------------------------------------------------------
+
+def test_wanted_replicas_audit_trail():
+    t, clock = _clock()
+    lens = _shed_bound_lens(clock)  # SLO avail 0.9: needs the pair
+    w = lens.wanted_replicas(n_live=2)
+    assert w == 2
+    assert len(lens._audit) == 1
+    a = lens._audit[-1]
+    assert a["from"] is None and a["to"] == 2
+    assert a["n_live"] == 2 and a["slo_unmet"] is False
+    # the decision carries its full inputs: every candidate's plan
+    # with the SLO verdict and margin, plus demand + capacity
+    assert a["plans"][0]["n"] == 1
+    assert a["plans"][0]["meets_slo"] is False
+    assert a["plans"][1]["meets_slo"] is True
+    assert "availability_margin" in a["plans"][0]
+    assert a["demand"]["arrivals_total"] == 20
+    # inside replan_interval_s the cached verdict answers: no new entry
+    assert lens.wanted_replicas(n_live=2) == 2
+    assert len(lens._audit) == 1
+    # a stable verdict past the interval re-plans but does not re-audit
+    t[0] = 10.0
+    assert lens.wanted_replicas(n_live=2) == 2
+    assert len(lens._audit) == 1
+    kinds = [e["kind"] for e in lens.ledger.events(
+        kind="caplens_decision")]
+    assert kinds == ["caplens_decision"]
+
+
+def test_queued_commit_stays_out_of_planning_reservoir():
+    t, clock = _clock()
+    lens = _shed_bound_lens(clock)
+    p1 = lens.plan(1)
+    # a commit whose wall includes replica-internal queueing (no free
+    # slot at dispatch) must not poison the service sample the sim
+    # draws from — the sim already simulates that queue
+    lens.on_commit("r0", wall_s=3.0, inflight_at_dispatch=5, now=6.0)
+    assert lens._queued_commits == 1
+    assert lens.plan(1) == p1
+    assert lens.capacity()["queued_commits_excluded"] == 1
+
+
+# ----------------------------------------------------------------------
+# gate, /capz endpoint, CLI
+# ----------------------------------------------------------------------
+
+def test_gate_off_records_nothing():
+    t, clock = _clock()
+    lens = CapLens(now=clock)
+    obs.set_enabled(False)
+    try:
+        lens.on_arrival(8, scenario="gen")
+        lens.on_shed("saturated")
+        lens.on_commit("r0", tokens=4, wall_s=0.5)
+        lens.spawn_begin("r0")
+        lens.spawn_ready("r0")
+        lens.spawn_gone("r0")
+    finally:
+        obs.set_enabled(True)
+    assert lens.arrivals_total == 0 and lens.commits_total == 0
+    assert lens.sheds_by_reason == {} and lens.spawns_total == 0
+    assert not lens._pending and len(lens.ledger) == 0
+
+
+def test_capz_endpoint_json_and_prom():
+    t, clock = _clock()
+    lens = _shed_bound_lens(clock)
+    lens.wanted_replicas(n_live=2)
+    srv = obs.serve_metrics(0, caplens=lens)
+    try:
+        base = f"http://127.0.0.1:{srv.port}"
+        r = urllib.request.urlopen(base + "/capz", timeout=10)
+        assert r.headers["Content-Type"] == "application/json"
+        z = json.load(r)
+        assert z["demand"]["arrivals_total"] == 20
+        assert z["wanted_replicas"] == 2
+        assert z["plans"][0]["n"] == 1
+        assert z["audit"][-1]["to"] == 2
+        prom = urllib.request.urlopen(
+            base + "/capz?format=prom", timeout=10).read().decode()
+        assert "dnn_tpu_caplens_arrival_rate_hz" in prom
+        assert "dnn_tpu_caplens_wanted_replicas 2.0" in prom
+        assert 'dnn_tpu_caplens_plan_availability{n="2"} 1.0' in prom
+        assert 'dnn_tpu_caplens_coldstart_coverage' in prom
+    finally:
+        srv.close()
+
+
+def test_cli_selftest_smoke():
+    r = subprocess.run(
+        [sys.executable, "-m", "dnn_tpu.obs", "caplens", "--selftest"],
+        capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "caplens selftest ok" in r.stdout
+
+
+# ----------------------------------------------------------------------
+# /fleetz wanted-replicas rollup (the satellite's regression)
+# ----------------------------------------------------------------------
+
+def test_fleetz_wanted_rollup_is_stage_max_with_column():
+    # two routers wanting different counts + one stage with no gauge:
+    # the rollup is the MAX (a multi-front-door fleet provisions for
+    # its hungriest router, not whichever stage the dict yields first)
+    # and the per-stage column keeps each verdict visible, omitting
+    # gauge-less stages
+    from dnn_tpu.obs.fleet import FleetCollector
+    from dnn_tpu.obs.http import MetricsHTTPServer
+    from dnn_tpu.utils.metrics import Metrics
+
+    regs = {"ra": Metrics(), "rb": Metrics(), "plain": Metrics()}
+    regs["ra"].set("dnn_tpu_wanted_replicas", 2.0)
+    regs["rb"].set("dnn_tpu_wanted_replicas", 5.0)
+    regs["plain"].set("serving.tokens_per_sec", 1.0)
+    srvs = {k: MetricsHTTPServer(port=0, registry=v)
+            for k, v in regs.items()}
+    fc = None
+    try:
+        fc = FleetCollector(
+            {k: f"http://127.0.0.1:{s.port}" for k, s in srvs.items()})
+        fc.poll_once()
+        z = fc.fleetz()
+        assert z["fleet"]["wanted_replicas"] == 5.0
+        assert z["fleet"]["wanted_replicas_by_stage"] \
+            == {"ra": 2.0, "rb": 5.0}
+        assert z["stages"]["ra"]["wanted_replicas"] == 2.0
+        prom = fc.render_prom()
+        assert 'dnn_tpu_fleet_stage_wanted_replicas{stage="ra"} 2' \
+            in prom
+        assert 'dnn_tpu_fleet_stage_wanted_replicas{stage="rb"} 5' \
+            in prom
+        assert 'stage="plain"} ' not in prom.split(
+            "dnn_tpu_fleet_stage_wanted_replicas", 1)[1].split("#")[0]
+    finally:
+        if fc is not None:
+            fc.close()
+        for s in srvs.values():
+            s.close()
+
+
+# ----------------------------------------------------------------------
+# lifecycle seams: the handles feed the lens + the flight ring
+# ----------------------------------------------------------------------
+
+def test_replica_handle_lifecycle_feeds_lens_and_flight():
+    from dnn_tpu.control.replicaset import ReplicaHandle, ReplicaSet
+    from dnn_tpu.obs import flight
+
+    flight.recorder().clear()
+    h = ReplicaHandle("r0", "127.0.0.1:1")
+    rset = ReplicaSet([h], scrape=False)
+    lens = CapLens()
+    h.start()                 # idle -> warming (no supervisor: no-op)
+    rset.attach_caplens(lens)  # backfills the spawn from the stamps
+    h._mark_serving()
+    assert "r0" in lens._pending
+    assert lens._pending["r0"]["t_ready"] is not None
+    ev = {e["kind"]: e for e in flight.recorder().events()}
+    assert ev["replica_spawn"]["replica"] == "r0"
+    # the ready event carries its DURATION (spawn->ready wall)
+    assert ev["replica_ready"]["spawn_to_ready_s"] is not None
+    assert ev["replica_ready"]["spawn_to_ready_s"] >= 0.0
+    h._mark_dead("test")
+    assert "r0" not in lens._pending  # unserved spawn abandoned
+    ev = {e["kind"]: e for e in flight.recorder().events()}
+    assert ev["replica_dead"]["alive_s"] is not None
